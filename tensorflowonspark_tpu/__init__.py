@@ -1,0 +1,38 @@
+"""tensorflowonspark_tpu — a TPU-native cluster ML framework.
+
+A ground-up rebuild of the capabilities of TensorFlowOnSpark
+(reference: ``tensorflowonspark/`` in yahoo/TensorFlowOnSpark; see SURVEY.md)
+designed for TPU hardware: rendezvous hands out a ``jax.distributed``
+coordinator instead of TF_CONFIG roles, the push-based data plane feeds
+host-local queues into TPU infeed, and data-parallel / FSDP training is
+expressed as ``jit`` + ``NamedSharding`` over an ICI device mesh instead of
+parameter servers or MultiWorkerMirroredStrategy.
+
+Public surface mirrors the reference so users can switch:
+
+- :class:`TFCluster` / :func:`TFCluster.run` — cluster orchestration
+  (reference: ``tensorflowonspark/TFCluster.py``)
+- :class:`InputMode` — SPARK (push feed) vs TENSORFLOW (node-side readers)
+- :mod:`~tensorflowonspark_tpu.cluster.node` — node runtime
+  (reference: ``tensorflowonspark/TFSparkNode.py``)
+- :mod:`~tensorflowonspark_tpu.feed` — ``DataFeed`` in-graph API
+  (reference: ``tensorflowonspark/TFNode.py``)
+- :mod:`~tensorflowonspark_tpu.api.pipeline` — ``TFEstimator`` / ``TFModel``
+  (reference: ``tensorflowonspark/pipeline.py``)
+- :mod:`~tensorflowonspark_tpu.data.dfutil` — TFRecord interop
+  (reference: ``tensorflowonspark/dfutil.py``)
+"""
+
+__version__ = "0.1.0"
+
+from tensorflowonspark_tpu.cluster.tfcluster import InputMode, TFCluster  # noqa: E402
+from tensorflowonspark_tpu.feed.datafeed import DataFeed  # noqa: E402
+from tensorflowonspark_tpu.cluster.context import TFNodeContext  # noqa: E402
+
+__all__ = [
+    "InputMode",
+    "TFCluster",
+    "DataFeed",
+    "TFNodeContext",
+    "__version__",
+]

@@ -1,0 +1,11 @@
+"""Cluster control plane: rendezvous, per-node manager, node runtime,
+and the driver-side orchestrator.
+
+Reference parity map (see SURVEY.md §2.1):
+
+- ``reservation.py``  → :mod:`.reservation` (roster rendezvous over TCP)
+- ``TFManager.py``    → :mod:`.manager` (per-node queues + KV store)
+- ``marker.py``       → :mod:`.marker` (feed sentinels)
+- ``TFSparkNode.py``  → :mod:`.node` (node runtime)
+- ``TFCluster.py``    → :mod:`.tfcluster` (driver orchestrator)
+"""

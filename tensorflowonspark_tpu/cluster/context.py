@@ -1,0 +1,144 @@
+"""Per-node context handed to the user's ``map_fun``.
+
+Reference parity: ``tensorflowonspark/TFSparkNode.py:TFNodeContext``
+(fields ``executor_id``/``worker_num``, ``job_name``, ``task_index``,
+``cluster_spec``, ``num_workers``, ``defaultFS``, ``working_dir``, ``mgr``;
+methods ``get_data_feed``, ``absolute_path``, ``start_cluster_server``,
+``export_saved_model``).
+
+TPU-native differences: instead of a TF ``ClusterSpec``/``TF_CONFIG``, the
+context carries the ``jax.distributed`` coordinator address and exposes
+:meth:`initialize_distributed` + :meth:`mesh` — the SPMD replacement for
+both the PS and MultiWorkerMirroredStrategy wiring.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any
+
+from tensorflowonspark_tpu.feed.datafeed import DataFeed
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TFNodeContext:
+    executor_id: int
+    job_name: str  # 'chief' | 'worker' | 'evaluator'
+    task_index: int
+    cluster_info: list[dict[str, Any]]
+    num_workers: int
+    default_fs: str
+    working_dir: str
+    mgr: Any = None  # ManagerHandle
+    coordinator_address: str | None = None
+    distributed: bool = False
+    tb_port: int | None = None
+    extras: dict[str, Any] = field(default_factory=dict)
+
+    # --- reference-compat aliases -------------------------------------
+    @property
+    def worker_num(self) -> int:
+        """Reference alias for executor_id."""
+        return self.executor_id
+
+    @property
+    def num_processes(self) -> int:
+        return self.num_workers
+
+    @property
+    def cluster_spec(self) -> dict[str, list[str]]:
+        """TF_CONFIG-shaped view of the roster: {job: ["host:port", ...]}.
+
+        Provided for reference-API compatibility; TPU code should use
+        ``coordinator_address`` / ``mesh()`` instead.
+        """
+        spec: dict[str, list[str]] = {}
+        for node in sorted(self.cluster_info, key=lambda n: n["executor_id"]):
+            spec.setdefault(node["job_name"], []).append(
+                f"{node['host']}:{node['port']}"
+            )
+        return spec
+
+    # --- data plane ----------------------------------------------------
+    def get_data_feed(
+        self,
+        train_mode: bool = True,
+        qname_in: str = "input",
+        qname_out: str = "output",
+        input_mapping: dict[str, str] | None = None,
+    ) -> DataFeed:
+        """Reference: ``TFNodeContext.get_data_feed``."""
+        return DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+
+    # --- paths ----------------------------------------------------------
+    def absolute_path(self, path: str) -> str:
+        """Resolve a user path against default_fs / working_dir.
+
+        Reference: ``TFNode.py:hdfs_path`` resolution matrix — scheme-
+        qualified paths pass through; absolute paths go under default_fs;
+        relative paths resolve against the working dir.
+        """
+        if "://" in path:  # fully qualified (hdfs://, gs://, file://, ...)
+            return path
+        if path.startswith("/"):
+            fs = self.default_fs.rstrip("/")
+            return f"{fs}{path}" if fs and "://" in self.default_fs else path
+        base = self.working_dir.rstrip("/")
+        return f"{base}/{path}"
+
+    # --- distributed runtime --------------------------------------------
+    def initialize_distributed(self) -> None:
+        """Join the jax.distributed coordination service.
+
+        This is the TPU-native replacement for the reference's
+        ``TFNode.start_cluster_server`` (which built a ``tf.train.Server``
+        from the ClusterSpec): the roster agreed through the reservation
+        server already names a coordinator (chief's reserved port), so every
+        process just calls ``jax.distributed.initialize`` with it.
+        """
+        if not self.distributed:
+            logger.info("single-process mode; skipping jax.distributed")
+            return
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator_address,
+            num_processes=self.num_workers,
+            process_id=self.executor_id,
+        )
+        logger.info(
+            "jax.distributed initialized: process %d/%d, coordinator %s",
+            self.executor_id,
+            self.num_workers,
+            self.coordinator_address,
+        )
+
+    # Reference-compat name.
+    def start_cluster_server(self, *_args, **_kwargs) -> None:
+        self.initialize_distributed()
+
+    def mesh(self, axis_shapes: dict[str, int] | None = None):
+        """Build the device mesh for this cluster (all global devices).
+
+        Delegates to :func:`tensorflowonspark_tpu.compute.mesh.make_mesh`;
+        defaults to pure data-parallel over every device.
+        """
+        from tensorflowonspark_tpu.compute.mesh import make_mesh
+
+        return make_mesh(axis_shapes)
+
+    def export_saved_model(self, state, export_dir: str, **kwargs) -> str:
+        """Chief-only model export (reference: ``TFNodeContext.export_saved_model``).
+
+        Writes an orbax checkpoint usable by ``TFModel``/AOT inference.
+        """
+        from tensorflowonspark_tpu.compute.checkpoint import save_checkpoint
+
+        if self.job_name == "chief" or (
+            self.job_name == "worker" and self.task_index == 0
+        ):
+            return save_checkpoint(self.absolute_path(export_dir), state, **kwargs)
+        return export_dir

@@ -1,0 +1,188 @@
+"""Launchers: who plays Spark's role of getting node processes running.
+
+The reference leaned on Spark's scheduler (``sc.parallelize(...)
+.foreachPartition(TFSparkNode.run)`` — one long-lived task per executor,
+SURVEY.md §3.1). With no Spark in the picture, a launcher owns that step:
+
+- :class:`LocalLauncher` — N processes on this host (the test/CI analog of
+  the reference's local-mode Spark trick, and the single-TPU-VM path).
+- :class:`HostListLauncher` — one process per remote host via a command
+  template (ssh by default); the multi-host TPU-pod path where each TPU-VM
+  host runs one node process that owns its local chips.
+"""
+
+from __future__ import annotations
+
+import logging
+import multiprocessing as mp
+import os
+import shlex
+import subprocess
+import sys
+import time
+from typing import Any, Callable, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+class LocalLauncher:
+    """Spawn node processes on the local host.
+
+    Uses the ``spawn`` start method: node processes initialize their own
+    JAX runtime, and forking a process that may already hold TPU/XLA
+    runtime threads is unsafe.
+    """
+
+    def __init__(self, env: dict[str, str] | None = None):
+        self.env = env or {}
+        self._procs: list[mp.Process] = []
+
+    def launch(
+        self,
+        num_nodes: int,
+        target: Callable[..., None],
+        args_for: Callable[[int], tuple],
+    ) -> None:
+        ctx = mp.get_context("spawn")
+        # Env vars must be in place BEFORE the child interpreter boots:
+        # sitecustomize-style hooks (e.g. TPU plugin registration) run at
+        # interpreter start, long before _child_main gets to apply env.
+        # Spawn inherits the parent's environ at exec, so set/restore here.
+        saved = {k: os.environ.get(k) for k in self.env}
+        os.environ.update(self.env)
+        try:
+            for i in range(num_nodes):
+                proc = ctx.Process(
+                    target=_child_main,
+                    args=(dict(self.env), target, args_for(i)),
+                    name=f"tfos-node-{i}",
+                    daemon=False,
+                )
+                proc.start()
+                self._procs.append(proc)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def poll_failed(self) -> list[int]:
+        """Indices of processes that already exited nonzero."""
+        return [
+            i
+            for i, p in enumerate(self._procs)
+            if p.exitcode is not None and p.exitcode != 0
+        ]
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Join all processes; True if all exited within the timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for p in self._procs:
+            remaining = (
+                None if deadline is None else max(0.0, deadline - time.monotonic())
+            )
+            p.join(remaining)
+        return all(p.exitcode is not None for p in self._procs)
+
+    def exitcodes(self) -> list[int | None]:
+        return [p.exitcode for p in self._procs]
+
+    def terminate(self) -> None:
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(5)
+        for p in self._procs:
+            if p.is_alive():  # pragma: no cover - last resort
+                p.kill()
+
+
+def _child_main(env: dict[str, str], target, args) -> None:
+    os.environ.update(env)
+    target(*args)
+
+
+class HostListLauncher:
+    """Launch one node process per remote host via a command template.
+
+    Runs ``python -m tensorflowonspark_tpu.cluster.node_main --payload ...``
+    on each host through ``cmd_template`` (plain ssh by default). This is
+    the spark-submit-shaped path for real pods; the user ``map_fun``'s
+    module must be importable on every host (the contract Spark imposed on
+    the reference's ``map_fun`` too).
+    """
+
+    def __init__(
+        self,
+        hosts: Sequence[str],
+        cmd_template: str = "ssh {host} {command}",
+        python: str = "python",
+    ):
+        self.hosts = list(hosts)
+        self.cmd_template = cmd_template
+        self.python = python
+        self._procs: list[subprocess.Popen] = []
+
+    def launch(
+        self,
+        num_nodes: int,
+        target: Callable[..., None],
+        args_for: Callable[[int], tuple],
+    ) -> None:
+        from tensorflowonspark_tpu.cluster.node_main import encode_payload
+
+        if num_nodes != len(self.hosts):
+            raise ValueError(
+                f"{num_nodes} nodes requested but {len(self.hosts)} hosts "
+                "configured"
+            )
+        commands = []
+        for i in range(num_nodes):
+            payload = encode_payload(*args_for(i))
+            commands.append(
+                f"{self.python} -m tensorflowonspark_tpu.cluster.node_main "
+                f"--payload {payload}"
+            )
+        self.launch_command(commands)
+
+    def launch_command(self, commands: Sequence[str]) -> None:
+        assert len(commands) == len(self.hosts)
+        for host, command in zip(self.hosts, commands):
+            full = self.cmd_template.format(host=host, command=command)
+            logger.info("launching on %s: %s", host, full)
+            self._procs.append(subprocess.Popen(shlex.split(full)))
+
+    def wait(self, timeout: float | None = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for p in self._procs:
+            try:
+                remaining = (
+                    None
+                    if deadline is None
+                    else max(0.0, deadline - time.monotonic())
+                )
+                p.wait(remaining)
+            except subprocess.TimeoutExpired:
+                return False
+        return True
+
+    def poll_failed(self) -> list[int]:
+        return [
+            i
+            for i, p in enumerate(self._procs)
+            if p.poll() is not None and p.returncode != 0
+        ]
+
+    def exitcodes(self) -> list[int | None]:
+        return [p.poll() for p in self._procs]
+
+    def terminate(self) -> None:
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+
+
+def default_launcher(num_nodes: int) -> LocalLauncher:
+    return LocalLauncher()

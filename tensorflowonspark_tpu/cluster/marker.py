@@ -1,0 +1,44 @@
+"""Sentinel objects pushed through the feed queues.
+
+Reference parity: ``tensorflowonspark/marker.py`` (``Marker``,
+``EndPartition``). The consumer side (:class:`~tensorflowonspark_tpu.feed.
+datafeed.DataFeed`) interprets these to emit partial batches at partition
+boundaries and to flip ``should_stop`` at end of feed.
+"""
+
+from __future__ import annotations
+
+
+class Marker:
+    """Base class for queue sentinels."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<{type(self).__name__}>"
+
+    def __eq__(self, other: object) -> bool:
+        # Sentinels cross process boundaries by pickling, so identity
+        # comparison is wrong; type equality is the contract.
+        return type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(type(self))
+
+
+class EndPartition(Marker):
+    """One data partition is exhausted; the consumer may emit a partial
+    batch but must keep reading (more partitions may follow)."""
+
+    __slots__ = ()
+
+
+class EndOfFeed(Marker):
+    """The whole feed is exhausted; ``DataFeed.should_stop()`` becomes True.
+
+    The reference signalled this with a terminal marker pushed by
+    ``TFCluster.shutdown`` / ``TFSparkNode._shutdown``; we give it a named
+    type so queue traffic is self-describing.
+    """
+
+    __slots__ = ()

@@ -1,0 +1,327 @@
+"""Node runtime — runs inside each cluster process.
+
+Reference parity: ``tensorflowonspark/TFSparkNode.py`` (``_mapfn``: device
+allocation → manager start → port reservation → reservation register →
+roster barrier → TF_CONFIG → run ``map_fun``; plus ``_train``/
+``_inference``/``_shutdown`` feeder-side partition functions).
+
+Structural difference (deliberate): the reference ran inside borrowed Spark
+tasks, so ``InputMode.SPARK`` had to fork the TF process into the background
+to free the executor slot for later feed tasks. Our launcher owns the node
+processes outright and the driver feeds queues over TCP, so ``map_fun``
+always runs in the node process itself — one fewer process hop on the feed
+path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue as _queue
+import socket
+import time
+import traceback
+from typing import Any, Callable
+
+from tensorflowonspark_tpu.cluster import manager as tf_manager
+from tensorflowonspark_tpu.cluster import reservation
+from tensorflowonspark_tpu.cluster.context import TFNodeContext
+from tensorflowonspark_tpu.cluster.marker import EndOfFeed, EndPartition
+from tensorflowonspark_tpu.utils import util
+
+logger = logging.getLogger(__name__)
+
+# Chunk size for remote queue puts (records per proxied put).
+FEED_CHUNK = 512
+
+# Control-queue message asking the node process to exit.
+STOP = "STOP"
+
+
+def _assign_role(
+    executor_id: int, cluster_template: dict[str, list[int]]
+) -> tuple[str, int]:
+    """Map an executor id to (job_name, task_index) per the role template.
+
+    Reference: the role map built in ``TFCluster.py:run`` and consumed in
+    ``TFSparkNode._mapfn``.
+    """
+    for job_name, ids in cluster_template.items():
+        if executor_id in ids:
+            return job_name, ids.index(executor_id)
+    raise ValueError(f"executor {executor_id} not in cluster template")
+
+
+def run_node(
+    executor_id: int,
+    map_fun: Callable[[Any, TFNodeContext], Any],
+    tf_args: Any,
+    cluster_meta: dict[str, Any],
+) -> None:
+    """Entry point of one node process (reference: ``TFSparkNode._mapfn``)."""
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(asctime)s [node{executor_id}] %(levelname)s %(name)s: %(message)s",
+    )
+    # NOTE: unlike the reference, executor identity is launcher-assigned (the
+    # arg above), not rediscovered from a cwd file — co-located local nodes
+    # share a cwd, so the reference's write_executor_id pinning would
+    # clobber itself here. util.write/read_executor_id remain for remote
+    # launchers whose retries do land in a per-node working dir.
+
+    job_name, task_index = _assign_role(
+        executor_id, cluster_meta["cluster_template"]
+    )
+    authkey = bytes.fromhex(cluster_meta["authkey"])
+
+    # 1. data-plane manager (queues + KV), reachable by remote feeders
+    mgr = tf_manager.start(
+        authkey,
+        queues=cluster_meta.get("queues") or tf_manager.DEFAULT_QUEUES,
+        mode=cluster_meta.get("manager_mode", "remote"),
+        maxsize=cluster_meta.get("queue_maxsize", tf_manager.DEFAULT_MAXSIZE),
+    )
+
+    # 2. reserve a port: the chief's becomes the jax.distributed coordinator
+    #    address (replaces the reference's TF server port in TF_CONFIG)
+    port = util.find_free_port()
+    host = util.get_ip_address()
+
+    # 3. optional tensorboard on chief (reference: _mapfn tensorboard spawn)
+    tb_port, tb_pid = None, 0
+    if cluster_meta.get("tensorboard") and executor_id == 0:
+        tb_port, tb_pid = _maybe_start_tensorboard(cluster_meta.get("log_dir"))
+
+    # 4. register + roster barrier
+    client = reservation.Client(cluster_meta["server_addr"])
+    client.register(
+        {
+            "executor_id": executor_id,
+            "host": host,
+            "port": port,
+            "job_name": job_name,
+            "task_index": task_index,
+            "addr": list(mgr.address),
+            "authkey": cluster_meta["authkey"],
+            "tb_port": tb_port,
+            "tb_pid": tb_pid,
+            "pid": os.getpid(),
+        }
+    )
+    cluster_info = client.await_reservations(
+        timeout=cluster_meta.get("reservation_timeout", 600)
+    )
+
+    chief = next(
+        n
+        for n in cluster_info
+        if n["job_name"] == "chief"
+        or (n["job_name"] == "worker" and n["task_index"] == 0)
+    )
+    ctx = TFNodeContext(
+        executor_id=executor_id,
+        job_name=job_name,
+        task_index=task_index,
+        cluster_info=cluster_info,
+        num_workers=cluster_meta["num_executors"],
+        default_fs=cluster_meta.get("default_fs", ""),
+        working_dir=cluster_meta.get("working_dir", os.getcwd()),
+        mgr=mgr,
+        coordinator_address=f"{chief['host']}:{chief['port']}",
+        distributed=cluster_meta.get("distributed", False),
+    )
+
+    # 5. run the user fn; ferry exceptions to the driver via the error queue
+    #    (reference: the 'error' queue contract in TFSparkNode)
+    try:
+        if cluster_meta.get("auto_initialize_distributed", True):
+            ctx.initialize_distributed()
+        map_fun(tf_args, ctx)
+        mgr.set("state", "finished")
+    except Exception:
+        tb = traceback.format_exc()
+        logger.error("map_fun failed:\n%s", tb)
+        mgr.set("state", "error")
+        try:
+            mgr.get_queue("error").put(
+                {"executor_id": executor_id, "traceback": tb}, timeout=10
+            )
+        except _queue.Full:
+            pass
+        _await_stop(mgr, timeout=cluster_meta.get("error_linger_secs", 60))
+        raise
+    # 6. linger until the driver collected results and posted STOP, so the
+    #    output queue (which lives in this process) survives until drained
+    _await_stop(mgr, timeout=cluster_meta.get("linger_secs", 1800))
+
+
+def _await_stop(mgr, timeout: float) -> None:
+    """Block until the driver posts STOP on the control queue (or timeout)."""
+    control = mgr.get_queue("control")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            msg = control.get(block=True, timeout=1.0)
+            control.task_done()
+            if msg == STOP:
+                return
+        except _queue.Empty:
+            continue
+    logger.warning("node linger timeout (%ss) without STOP; exiting", timeout)
+
+
+def _maybe_start_tensorboard(log_dir: str | None) -> tuple[int | None, int]:
+    """Spawn a tensorboard subprocess if the binary exists (chief only).
+
+    Reference: ``TFSparkNode._mapfn`` tensorboard block
+    (``util.find_in_path`` + subprocess + record tb_port/tb_pid).
+    """
+    import subprocess
+
+    tb_bin = util.find_in_path(os.environ.get("PATH", ""), "tensorboard")
+    if tb_bin is None or not log_dir:
+        return None, 0
+    tb_port = util.find_free_port()
+    try:
+        proc = subprocess.Popen(
+            [tb_bin, "--logdir", log_dir, "--port", str(tb_port), "--bind_all"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        return tb_port, proc.pid
+    except OSError:
+        return None, 0
+
+
+# ---------------------------------------------------------------------------
+# Feeder-side partition functions (driver/feeder process side).
+# Reference: TFSparkNode.train/_train, inference/_inference, shutdown/_shutdown.
+# ---------------------------------------------------------------------------
+
+
+def connect_manager(node: dict[str, Any]) -> tf_manager.ManagerHandle:
+    """Reconnect to a node's long-lived manager (reference: ``_get_manager``)."""
+    return tf_manager.connect(node["addr"], bytes.fromhex(node["authkey"]))
+
+
+def feed_partition(
+    mgr: tf_manager.ManagerHandle,
+    partition,
+    feed_timeout: float = 600.0,
+    qname: str = "input",
+    chunk: int = FEED_CHUNK,
+) -> int:
+    """Push one data partition into a node's input queue, chunked.
+
+    Returns the number of records fed (0 if the node is terminating and the
+    partition was skipped). Raises TimeoutError if the consumer stopped
+    pulling (reference: "Timeout while feeding partition").
+    """
+    if str(mgr.get("state")) == "terminating":
+        # Early-stop path: consume and discard remaining partitions
+        # (reference: the state check at the top of ``_train``).
+        for _ in partition:
+            pass
+        return 0
+    q = mgr.get_queue(qname)
+    count = 0
+    buf: list[Any] = []
+    try:
+        for item in partition:
+            buf.append(item)
+            if len(buf) >= chunk:
+                q.put(buf, timeout=feed_timeout)
+                count += len(buf)
+                buf = []
+        if buf:
+            q.put(buf, timeout=feed_timeout)
+            count += len(buf)
+        q.put(EndPartition(), timeout=feed_timeout)
+    except _queue.Full:
+        raise TimeoutError(
+            f"timeout while feeding partition (feed_timeout={feed_timeout}s); "
+            "consumer appears to have stopped pulling"
+        ) from None
+    return count
+
+
+def collect_results(
+    mgr: tf_manager.ManagerHandle,
+    count: int,
+    timeout: float = 600.0,
+    qname: str = "output",
+) -> list[Any]:
+    """Pull exactly ``count`` results off a node's output queue.
+
+    Results arrive as chunks (lists) — the equal-count contract of the
+    reference's ``_inference`` (one result per input record, in order).
+    """
+    out: list[Any] = []
+    deadline = time.monotonic() + timeout
+    q = mgr.get_queue(qname)
+    while len(out) < count:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(
+                f"timeout collecting inference results ({len(out)}/{count})"
+            )
+        try:
+            item = q.get(block=True, timeout=min(remaining, 5.0))
+        except _queue.Empty:
+            # Fail fast if the consumer crashed instead of blocking for the
+            # whole feed_timeout; the driver will surface its traceback
+            # from the error queue.
+            if str(mgr.get("state")) == "error":
+                raise RuntimeError(
+                    "node entered error state while collecting results"
+                ) from None
+            continue
+        q.task_done()
+        if isinstance(item, list):
+            out.extend(item)
+        else:
+            out.append(item)
+    if len(out) > count:
+        raise RuntimeError(
+            f"inference produced {len(out)} results for {count} inputs; "
+            "map_fun must emit exactly one result per record"
+        )
+    return out
+
+
+def shutdown_node(node: dict[str, Any], queues=("input",)) -> None:
+    """Signal one node to finish: EndOfFeed on data queues, STOP on control.
+
+    Reference: ``TFSparkNode._shutdown`` (set state, push terminal markers).
+    """
+    mgr = connect_manager(node)
+    state = str(mgr.get("state"))
+    if state == "running":
+        mgr.set("state", "terminating")
+    for qname in queues:
+        try:
+            mgr.get_queue(qname).put(EndOfFeed(), timeout=30)
+        except _queue.Full:
+            logger.warning(
+                "could not push EndOfFeed to node %s queue %s (full)",
+                node["executor_id"],
+                qname,
+            )
+    mgr.get_queue("control").put(STOP)
+
+
+def drain_errors(node: dict[str, Any]) -> list[dict[str, Any]]:
+    """Non-blocking read of a node's error queue (exception ferry)."""
+    mgr = connect_manager(node)
+    errors = []
+    q = mgr.get_queue("error")
+    while True:
+        try:
+            errors.append(q.get_nowait())
+            q.task_done()
+        except _queue.Empty:
+            return errors
+
+
+def _hostname() -> str:  # pragma: no cover - trivial
+    return socket.gethostname()

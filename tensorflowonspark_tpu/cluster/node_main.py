@@ -1,0 +1,42 @@
+"""Standalone node-process entry for remote launchers.
+
+``HostListLauncher`` starts one of these per host (via ssh or a custom
+command template)::
+
+    python -m tensorflowonspark_tpu.cluster.node_main --payload <b64>
+
+The payload is a base64 pickle of ``(executor_id, map_fun, tf_args,
+cluster_meta)`` — the same tuple :func:`~tensorflowonspark_tpu.cluster.
+node.run_node` takes from the local launcher. ``map_fun`` is pickled by
+qualified name, so the user's module must be importable on every host
+(the same contract Spark imposed on the reference's ``map_fun``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import pickle
+
+
+def encode_payload(executor_id, map_fun, tf_args, cluster_meta) -> str:
+    return base64.b64encode(
+        pickle.dumps((executor_id, map_fun, tf_args, cluster_meta))
+    ).decode("ascii")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="tfos-tpu-node")
+    parser.add_argument("--payload", required=True, help="base64 node payload")
+    args = parser.parse_args(argv)
+    executor_id, map_fun, tf_args, cluster_meta = pickle.loads(
+        base64.b64decode(args.payload)
+    )
+    from tensorflowonspark_tpu.cluster.node import run_node
+
+    run_node(executor_id, map_fun, tf_args, cluster_meta)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,263 @@
+"""Rendezvous: turn N anonymous worker processes into an addressed cluster.
+
+Reference parity: ``tensorflowonspark/reservation.py`` (``Reservations``,
+``MessageSocket``, ``Server``, ``Client``). Same protocol shape — a driver-
+side TCP server that nodes register with, a barrier until the roster is
+complete, and an out-of-band STOP — but TPU-native payload: instead of
+TF_CONFIG ps/worker role maps, the roster carries what
+``jax.distributed.initialize`` needs (coordinator address, process ids) plus
+per-node manager addresses for the data plane.
+
+Wire format: 4-byte big-endian length prefix + JSON (the reference used
+pickle; JSON avoids arbitrary-code deserialization from the network and is
+plenty for roster dicts).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import struct
+import threading
+import time
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">I")
+_MAX_MSG = 64 * 1024 * 1024
+
+
+class Reservations:
+    """Thread-safe roster of registered nodes.
+
+    Reference: ``reservation.py:Reservations`` (add/done/remaining).
+    """
+
+    def __init__(self, required: int):
+        self.required = required
+        self._lock = threading.RLock()
+        self._reservations: list[dict[str, Any]] = []
+
+    def add(self, meta: dict[str, Any]) -> None:
+        with self._lock:
+            self._reservations.append(meta)
+
+    def done(self) -> bool:
+        with self._lock:
+            return len(self._reservations) >= self.required
+
+    def get(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return list(self._reservations)
+
+    def remaining(self) -> int:
+        with self._lock:
+            return self.required - len(self._reservations)
+
+
+class MessageSocket:
+    """Length-prefixed JSON messages over a stream socket.
+
+    Reference: ``reservation.py:MessageSocket`` (which framed pickle the
+    same way: 4-byte length prefix + payload).
+    """
+
+    @staticmethod
+    def send(sock: socket.socket, msg: dict[str, Any]) -> None:
+        data = json.dumps(msg).encode("utf-8")
+        sock.sendall(_LEN.pack(len(data)) + data)
+
+    @staticmethod
+    def receive(sock: socket.socket) -> dict[str, Any]:
+        header = MessageSocket._recv_exact(sock, _LEN.size)
+        (length,) = _LEN.unpack(header)
+        if length > _MAX_MSG:
+            raise ValueError(f"message too large: {length}")
+        data = MessageSocket._recv_exact(sock, length)
+        return json.loads(data.decode("utf-8"))
+
+    @staticmethod
+    def _recv_exact(sock: socket.socket, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("socket closed mid-message")
+            buf.extend(chunk)
+        return bytes(buf)
+
+
+class Server:
+    """Driver-side rendezvous server.
+
+    Message types (reference: ``reservation.py:Server`` REG/QUERY/QINFO/STOP):
+
+    - ``REG``   {node: {...}} → ack; adds the node to the roster
+    - ``QUERY`` → {done: bool} — is the roster complete?
+    - ``QINFO`` → {cluster_info: [...]} — the full roster (valid once done)
+    - ``QNUM``  → {remaining: int}
+    - ``STOP``  → ack; raises the stop flag that `Client.await_stop` and
+      node watchdogs observe (out-of-band cluster kill)
+    """
+
+    def __init__(self, count: int):
+        self.reservations = Reservations(count)
+        self.done = threading.Event()
+        self._stop = threading.Event()
+        self._sock: socket.socket | None = None
+        self._thread: threading.Thread | None = None
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop.is_set()
+
+    def start(self, host: str = "", port: int = 0) -> tuple[str, int]:
+        """Bind, spawn the listener thread, return the advertised address."""
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        addr = self._sock.getsockname()
+        advertised = addr[0] if addr[0] not in ("0.0.0.0", "") else _local_ip()
+        self._thread = threading.Thread(
+            target=self._serve, name="reservation-server", daemon=True
+        )
+        self._thread.start()
+        logger.info("reservation server listening on %s:%d", advertised, addr[1])
+        return (advertised, addr[1])
+
+    def _serve(self) -> None:
+        assert self._sock is not None
+        self._sock.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._handle, args=(conn,), daemon=True)
+            t.start()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _handle(self, conn: socket.socket) -> None:
+        with conn:
+            conn.settimeout(60)
+            while True:
+                try:
+                    msg = MessageSocket.receive(conn)
+                except (ConnectionError, socket.timeout, ValueError):
+                    return
+                mtype = msg.get("type")
+                if mtype == "REG":
+                    self.reservations.add(msg["node"])
+                    if self.reservations.done():
+                        self.done.set()
+                    MessageSocket.send(conn, {"type": "OK"})
+                elif mtype == "QUERY":
+                    MessageSocket.send(
+                        conn, {"type": "OK", "done": self.reservations.done()}
+                    )
+                elif mtype == "QINFO":
+                    MessageSocket.send(
+                        conn,
+                        {"type": "OK", "cluster_info": self.reservations.get()},
+                    )
+                elif mtype == "QNUM":
+                    MessageSocket.send(
+                        conn,
+                        {"type": "OK", "remaining": self.reservations.remaining()},
+                    )
+                elif mtype == "STOP":
+                    self._stop.set()
+                    MessageSocket.send(conn, {"type": "OK"})
+                    return
+                else:
+                    MessageSocket.send(
+                        conn, {"type": "ERR", "error": f"unknown type {mtype!r}"}
+                    )
+
+    def await_reservations(
+        self,
+        timeout: float = 600.0,
+        status_fn=None,
+        poll_interval: float = 1.0,
+    ) -> list[dict[str, Any]]:
+        """Block until all nodes registered, else raise.
+
+        Reference: ``reservation.py:Server.await_reservations`` — the
+        ``reservation_timeout`` (default 600 s) is the cluster-startup
+        failure detector: one lost node fails the job loudly instead of
+        hanging it.
+        """
+        deadline = time.monotonic() + timeout
+        while not self.done.wait(poll_interval):
+            if self._stop.is_set():
+                raise RuntimeError("reservation server stopped while waiting")
+            if status_fn is not None:
+                status_fn(self.reservations.remaining())
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"timed out waiting for {self.reservations.remaining()} of "
+                    f"{self.reservations.required} nodes to register "
+                    f"(reservation_timeout={timeout}s)"
+                )
+        return self.reservations.get()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class Client:
+    """Node-side rendezvous client.
+
+    Reference: ``reservation.py:Client`` (register, get_reservations,
+    await_reservations with a 1 s poll loop, request_stop).
+    """
+
+    def __init__(self, server_addr: tuple[str, int] | list):
+        self.server_addr = (server_addr[0], int(server_addr[1]))
+
+    def _call(self, msg: dict[str, Any], timeout: float = 60.0) -> dict[str, Any]:
+        with socket.create_connection(self.server_addr, timeout=timeout) as sock:
+            MessageSocket.send(sock, msg)
+            reply = MessageSocket.receive(sock)
+        if reply.get("type") == "ERR":
+            raise RuntimeError(f"reservation server error: {reply.get('error')}")
+        return reply
+
+    def register(self, node_meta: dict[str, Any]) -> None:
+        self._call({"type": "REG", "node": node_meta})
+
+    def get_reservations(self) -> list[dict[str, Any]]:
+        return self._call({"type": "QINFO"})["cluster_info"]
+
+    def await_reservations(
+        self, timeout: float = 600.0, poll_interval: float = 1.0
+    ) -> list[dict[str, Any]]:
+        deadline = time.monotonic() + timeout
+        while True:
+            if self._call({"type": "QUERY"})["done"]:
+                return self.get_reservations()
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    "timed out waiting for cluster roster "
+                    f"(reservation_timeout={timeout}s)"
+                )
+            time.sleep(poll_interval)
+
+    def request_stop(self) -> None:
+        self._call({"type": "STOP"})
+
+
+def _local_ip() -> str:
+    from tensorflowonspark_tpu.utils.util import get_ip_address
+
+    return get_ip_address()

@@ -1,0 +1,35 @@
+"""Standalone CLI to query or stop a running reservation server.
+
+Reference parity: ``tensorflowonspark/reservation_client.py`` — the
+out-of-band cluster kill switch.
+
+Usage::
+
+    python -m tensorflowonspark_tpu.cluster.reservation_client <host> <port> [stop]
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tensorflowonspark_tpu.cluster.reservation import Client
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 2:
+        print(__doc__)
+        return 2
+    host, port = argv[0], int(argv[1])
+    client = Client((host, port))
+    if len(argv) > 2 and argv[2] == "stop":
+        client.request_stop()
+        print("requested stop")
+    else:
+        for node in client.get_reservations():
+            print(node)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,398 @@
+"""Driver-side cluster orchestrator.
+
+Reference parity: ``tensorflowonspark/TFCluster.py`` — ``InputMode``,
+``run()`` (role template → reservation server → launch nodes → roster
+barrier → handle), ``TFCluster.train/inference/shutdown/tensorboard_url``.
+
+TPU-native differences:
+
+- ``num_ps`` is rejected: parameter servers dissolve into sharded optimizer
+  state (FSDP) on the mesh — see SURVEY.md §2.3 and
+  :mod:`tensorflowonspark_tpu.compute`.
+- The roster carries a ``jax.distributed`` coordinator address instead of a
+  TF_CONFIG role map.
+- Data feeding runs from driver-side threads over TCP to each node's
+  manager (Spark's feed *tasks* collapse into these threads).
+"""
+
+from __future__ import annotations
+
+import logging
+import secrets
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+from tensorflowonspark_tpu.cluster import node as tfnode_runtime
+from tensorflowonspark_tpu.cluster import reservation
+from tensorflowonspark_tpu.cluster.launchers import LocalLauncher
+
+logger = logging.getLogger(__name__)
+
+
+class InputMode:
+    """Reference: ``TFCluster.py:InputMode``."""
+
+    TENSORFLOW = 0  # nodes read data themselves (files / grain / tf.data)
+    SPARK = 1  # driver pushes partitions into node queues (the push plane)
+
+
+class TFCluster:
+    """Handle to a running cluster; returned by :func:`run`."""
+
+    def __init__(
+        self,
+        launcher,
+        server: reservation.Server,
+        server_addr: tuple[str, int],
+        cluster_info: list[dict[str, Any]],
+        cluster_meta: dict[str, Any],
+        input_mode: int,
+        queues: Sequence[str],
+    ):
+        self.launcher = launcher
+        self.server = server
+        self.server_addr = server_addr
+        self.cluster_info = cluster_info
+        self.cluster_meta = cluster_meta
+        self.input_mode = input_mode
+        self.queues = queues
+        self._shutdown_done = False
+
+    # ------------------------------------------------------------------
+    @property
+    def workers(self) -> list[dict[str, Any]]:
+        """Data-plane nodes (everything except evaluators), roster order."""
+        return sorted(
+            (n for n in self.cluster_info if n["job_name"] != "evaluator"),
+            key=lambda n: n["executor_id"],
+        )
+
+    def tensorboard_url(self) -> str | None:
+        """Reference: ``TFCluster.tensorboard_url``."""
+        for n in self.cluster_info:
+            if n.get("tb_port"):
+                return f"http://{n['host']}:{n['tb_port']}"
+        return None
+
+    # ------------------------------------------------------------------
+    def train(
+        self,
+        data: Iterable,
+        num_epochs: int = 1,
+        feed_timeout: float = 600.0,
+        qname: str = "input",
+    ) -> None:
+        """Feed data partitions to the workers (InputMode.SPARK only).
+
+        ``data`` is either an iterable of partitions (each an iterable of
+        records) or a flat iterable of records (auto-partitioned). Partitions
+        go round-robin to workers; each worker's partitions are fed
+        sequentially by a dedicated thread (the moral equivalent of Spark's
+        waves of ``foreachPartition`` feed tasks, reference ``TFCluster.train``
+        → ``TFSparkNode._train``).
+        """
+        self._require_spark_mode("train")
+        workers = self.workers
+        partitions = _as_partitions(data, len(workers))
+        assignments: list[list[Any]] = [[] for _ in workers]
+        n_parts = 0
+        for epoch in range(num_epochs):
+            for i, part in enumerate(partitions):
+                assignments[(n_parts) % len(workers)].append(part)
+                n_parts += 1
+        self._check_errors()
+        errors: list[BaseException] = []
+
+        def feed_worker(widx: int) -> None:
+            try:
+                mgr = tfnode_runtime.connect_manager(workers[widx])
+                for part in assignments[widx]:
+                    tfnode_runtime.feed_partition(
+                        mgr, part, feed_timeout=feed_timeout, qname=qname
+                    )
+            except BaseException as e:  # noqa: BLE001 - ferried to caller
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=feed_worker, args=(i,), daemon=True)
+            for i in range(len(workers))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            self._check_errors()
+            raise errors[0]
+        self._check_errors()
+
+    def inference(
+        self,
+        data: Iterable,
+        feed_timeout: float = 600.0,
+        qname: str = "input",
+    ) -> list[Any]:
+        """Feed partitions and gather results, preserving input order.
+
+        Reference: ``TFCluster.inference`` → ``TFSparkNode._inference``.
+        Equal-count contract: the user fn must emit exactly one result per
+        input record via ``DataFeed.batch_results``.
+        """
+        self._require_spark_mode("inference")
+        workers = self.workers
+        partitions = _as_partitions(data, len(workers))
+        results: dict[int, list[Any]] = {}
+        errors: list[BaseException] = []
+        lock = threading.Lock()
+
+        def run_worker(widx: int) -> None:
+            try:
+                mgr = tfnode_runtime.connect_manager(workers[widx])
+                for pidx in range(widx, len(partitions), len(workers)):
+                    part = list(partitions[pidx])
+                    fed = tfnode_runtime.feed_partition(
+                        mgr, part, feed_timeout=feed_timeout, qname=qname
+                    )
+                    out = tfnode_runtime.collect_results(
+                        mgr, fed, timeout=feed_timeout
+                    )
+                    with lock:
+                        results[pidx] = out
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run_worker, args=(i,), daemon=True)
+            for i in range(len(workers))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            self._check_errors()
+            raise errors[0]
+        self._check_errors()
+        flat: list[Any] = []
+        for pidx in sorted(results):
+            flat.extend(results[pidx])
+        return flat
+
+    # ------------------------------------------------------------------
+    def shutdown(
+        self,
+        grace_secs: float = 0.0,
+        timeout: float = 259200.0,
+    ) -> None:
+        """Graceful teardown with a force-kill watchdog.
+
+        Reference: ``TFCluster.shutdown`` (grace sleep → terminal markers on
+        every queue → join nodes → watchdog force-terminate → reservation
+        STOP). Raises if any node ferried an exception or exited nonzero.
+        """
+        if self._shutdown_done:
+            return
+        if grace_secs:
+            time.sleep(grace_secs)
+
+        node_errors = self._collect_errors()
+        feed_queues = (
+            [q for q in self.queues if q not in ("output", "error", "control")]
+            if self.input_mode == InputMode.SPARK
+            else []
+        )
+        for node_meta in self.workers:
+            try:
+                tfnode_runtime.shutdown_node(node_meta, queues=feed_queues)
+            except (ConnectionError, OSError) as e:
+                logger.warning(
+                    "could not signal node %s: %s", node_meta["executor_id"], e
+                )
+
+        if not self.launcher.wait(timeout=timeout):
+            logger.error("shutdown watchdog fired after %ss; terminating", timeout)
+            self.launcher.terminate()
+        self.server.stop()
+        self._shutdown_done = True
+
+        exitcodes = self.launcher.exitcodes()
+        bad = [
+            (i, c) for i, c in enumerate(exitcodes) if c is not None and c != 0
+        ]
+        if node_errors:
+            tracebacks = "\n".join(e["traceback"] for e in node_errors)
+            raise RuntimeError(f"cluster node(s) failed:\n{tracebacks}")
+        if bad:
+            raise RuntimeError(f"node process(es) exited nonzero: {bad}")
+
+    # ------------------------------------------------------------------
+    def _require_spark_mode(self, op: str) -> None:
+        if self.input_mode != InputMode.SPARK:
+            raise RuntimeError(
+                f"cluster.{op}() requires InputMode.SPARK; in "
+                "InputMode.TENSORFLOW nodes read data themselves"
+            )
+
+    def _collect_errors(self) -> list[dict[str, Any]]:
+        errors: list[dict[str, Any]] = []
+        for node_meta in self.cluster_info:
+            try:
+                errors.extend(tfnode_runtime.drain_errors(node_meta))
+            except (ConnectionError, OSError):
+                pass  # node already gone; exitcode check will catch it
+        return errors
+
+    def _check_errors(self) -> None:
+        errs = self._collect_errors()
+        if errs:
+            tracebacks = "\n".join(e["traceback"] for e in errs)
+            try:
+                self.shutdown(timeout=60)
+            except RuntimeError:
+                pass
+            raise RuntimeError(f"cluster node(s) failed:\n{tracebacks}")
+
+
+def run(
+    map_fun: Callable,
+    tf_args: Any,
+    num_executors: int,
+    num_ps: int = 0,
+    tensorboard: bool = False,
+    input_mode: int = InputMode.SPARK,
+    log_dir: str | None = None,
+    master_node: str | None = None,
+    reservation_timeout: float = 600.0,
+    queues: Sequence[str] | None = None,
+    eval_node: bool = False,
+    launcher=None,
+    default_fs: str = "",
+    working_dir: str | None = None,
+    distributed: bool = False,
+    queue_maxsize: int = 1024,
+    env: dict[str, str] | None = None,
+) -> TFCluster:
+    """Start a cluster and return its handle.
+
+    Reference signature parity: ``TFCluster.run(sc, map_fun, tf_args,
+    num_executors, num_ps, tensorboard, input_mode, log_dir, driver_ps_nodes,
+    master_node, reservation_timeout, queues, eval_node, release_port)`` —
+    minus ``sc`` (the launcher replaces Spark) and minus PS knobs.
+    """
+    if num_ps:
+        raise ValueError(
+            "num_ps > 0 is not supported on TPU: parameter servers are an "
+            "asymmetric-role design that SPMD cannot express. Shard optimizer "
+            "state over the mesh instead (FSDP): see "
+            "tensorflowonspark_tpu.compute.train and SURVEY.md §2.3."
+        )
+    if num_executors < 1:
+        raise ValueError("num_executors must be >= 1")
+
+    # Role template (reference: TFCluster.py:run role map). All roles are
+    # mesh-symmetric workers on TPU; 'chief' marks process 0 (checkpoint
+    # writer, coordinator host), 'evaluator' an optional sidecar.
+    n_train = num_executors - (1 if eval_node else 0)
+    if n_train < 1:
+        raise ValueError("need at least one non-evaluator node")
+    cluster_template: dict[str, list[int]] = {"chief": [0]}
+    if n_train > 1:
+        cluster_template["worker"] = list(range(1, n_train))
+    if eval_node:
+        cluster_template["evaluator"] = [num_executors - 1]
+
+    server = reservation.Server(num_executors)
+    server_addr = server.start()
+
+    # The node runtime itself requires 'error' (exception ferry) and
+    # 'control' (STOP); 'output' is needed by inference. Union them in so a
+    # reference-style custom queue list can't break the runtime.
+    queues = tuple(queues) if queues else ("input",)
+    for required in ("output", "error", "control"):
+        if required not in queues:
+            queues = queues + (required,)
+    cluster_meta: dict[str, Any] = {
+        "id": secrets.token_hex(4),
+        "cluster_template": cluster_template,
+        "num_executors": num_executors,
+        "server_addr": list(server_addr),
+        "authkey": secrets.token_hex(16),
+        "queues": list(queues),
+        "input_mode": input_mode,
+        "default_fs": default_fs,
+        "working_dir": working_dir or "",
+        "tensorboard": tensorboard,
+        "log_dir": log_dir,
+        "reservation_timeout": reservation_timeout,
+        "distributed": distributed,
+        "queue_maxsize": queue_maxsize,
+        "manager_mode": "remote",
+    }
+    logger.info(
+        "starting cluster %s: %d nodes, template %s",
+        cluster_meta["id"],
+        num_executors,
+        cluster_template,
+    )
+
+    launcher = launcher or LocalLauncher(env=env)
+    try:
+        launcher.launch(
+            num_executors,
+            tfnode_runtime.run_node,
+            lambda i: (i, map_fun, tf_args, cluster_meta),
+        )
+    except Exception:
+        launcher.terminate()
+        server.stop()
+        raise
+
+    try:
+        cluster_info = server.await_reservations(
+            timeout=reservation_timeout,
+            status_fn=lambda rem: _abort_if_node_died(launcher, rem),
+        )
+    except Exception:
+        launcher.terminate()
+        server.stop()
+        raise
+    logger.info("cluster %s up: %s", cluster_meta["id"], cluster_info)
+    return TFCluster(
+        launcher, server, server_addr, cluster_info, cluster_meta, input_mode, queues
+    )
+
+
+# Reference-compat: the reference exposes `TFCluster.run(...)` as a module
+# function; callers importing our class get the same spelling.
+TFCluster.run = staticmethod(run)
+
+
+def _abort_if_node_died(launcher, remaining: int) -> None:
+    failed = launcher.poll_failed()
+    if failed:
+        raise RuntimeError(
+            f"node process(es) {failed} died during startup "
+            f"({remaining} reservations still pending)"
+        )
+
+
+def _as_partitions(data: Iterable, num_workers: int) -> list[list[Any]]:
+    """Normalize user data into a list of record-list partitions.
+
+    Convention (documented in ``TFCluster.train``): if every element is a
+    ``list`` or an iterator/generator, the elements ARE the partitions
+    (generators are drained); otherwise the whole iterable is a flat
+    sequence of records, split round-robin into ``num_workers`` partitions
+    so every worker receives data. Records may be tuples, arrays, dicts, or
+    scalars — use tuples (not lists) for row records, exactly as a
+    DataFrame ``Row`` would arrive in the reference.
+    """
+    data = list(data)
+    if data and all(
+        isinstance(p, list) or isinstance(p, Iterator) for p in data
+    ):
+        return [list(p) for p in data]
+    if len(data) <= num_workers:
+        return [data]
+    return [data[i::num_workers] for i in range(num_workers)]

@@ -1,0 +1,32 @@
+"""TPU compute layer: device mesh, sharded train steps, checkpointing.
+
+This layer replaces the reference's delegation to TensorFlow's distributed
+runtime (PS + MultiWorkerMirroredStrategy, SURVEY.md §2.3): data-parallel
+and FSDP training are expressed as ``jax.jit`` over a ``Mesh`` with
+``NamedSharding``; XLA inserts the collectives (psum over ICI) that NCCL
+all-reduce performed in the reference.
+"""
+
+from tensorflowonspark_tpu.compute.mesh import (
+    MESH_AXES,
+    make_mesh,
+    batch_sharding,
+    replicated,
+)
+from tensorflowonspark_tpu.compute.train import (
+    TrainState,
+    build_train_step,
+    build_eval_step,
+    fsdp_shardings,
+)
+
+__all__ = [
+    "MESH_AXES",
+    "make_mesh",
+    "batch_sharding",
+    "replicated",
+    "TrainState",
+    "build_train_step",
+    "build_eval_step",
+    "fsdp_shardings",
+]

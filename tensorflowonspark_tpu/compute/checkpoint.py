@@ -1,0 +1,90 @@
+"""Checkpoint / restore via orbax.
+
+Reference parity (SURVEY.md §5.4): the reference delegated checkpointing to
+TF (``ModelCheckpoint``/``BackupAndRestore``) and contributed pathing plus a
+chief-only export convention. Here orbax gives async + sharded checkpoints;
+the chief-writes convention is enforced by the caller
+(``TFNodeContext.export_saved_model``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+
+def _abs(path: str) -> str:
+    if "://" in path:
+        return path
+    return os.path.abspath(path)
+
+
+def save_checkpoint(path: str, state: Any, force: bool = True) -> str:
+    """Synchronously write ``state`` (any pytree) to ``path``."""
+    path = _abs(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state, force=force)
+    return path
+
+
+def restore_checkpoint(path: str, target: Any | None = None) -> Any:
+    """Restore a pytree; ``target`` (abstract or concrete) pins structure,
+    dtypes, and — when built from abstract arrays with shardings — the
+    placement of restored arrays on the mesh."""
+    path = _abs(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        if target is None:
+            return ckptr.restore(path)
+        import jax
+
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+        return ckptr.restore(path, abstract)
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention + async write.
+
+    The async writer overlaps checkpoint I/O with the next training steps —
+    part of the MFU recipe (SURVEY.md §7 "hard parts").
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = True):
+        self.directory = _abs(directory)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, enable_async_checkpointing=async_save
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any) -> bool:
+        return self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def restore(self, step: int | None = None, target: Any | None = None) -> Any:
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        if target is not None:
+            import jax
+
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+        return self._mgr.restore(step)
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.wait()
+        self.close()

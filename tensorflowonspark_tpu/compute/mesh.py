@@ -1,0 +1,96 @@
+"""Device mesh construction and common shardings.
+
+The mesh axes are fixed project-wide (SURVEY.md §7 step 3):
+
+- ``data``  — pure data parallel (replicated params, sharded batch)
+- ``fsdp``  — data parallel with sharded params/optimizer state (the TPU
+  replacement for the reference's parameter servers)
+- ``model`` — tensor parallel (reserved; reference had none — §2.3)
+- ``seq``   — sequence/context parallel for ring attention (reserved, §5.7)
+
+Axis *placement* determines which interconnect collectives ride: inner axes
+map to ICI within a slice, outer axes to DCN across slices — use
+``create_hybrid_device_mesh`` when spanning slices.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("data", "fsdp", "model", "seq")
+
+# Batch dimension shards over every data-like axis.
+BATCH_AXES = ("data", "fsdp")
+
+
+def make_mesh(
+    axis_shapes: Mapping[str, int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all global devices).
+
+    ``axis_shapes`` maps axis name → size; at most one axis may be ``-1``
+    (inferred). Missing axes get size 1, so downstream code can always
+    refer to every name in :data:`MESH_AXES`. Default: everything on
+    ``data``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    shapes = dict(axis_shapes or {"data": n})
+    for ax in shapes:
+        if ax not in MESH_AXES:
+            raise ValueError(f"unknown mesh axis {ax!r}; expected {MESH_AXES}")
+    infer = [ax for ax, s in shapes.items() if s == -1]
+    if len(infer) > 1:
+        raise ValueError("at most one axis may be -1")
+    known = math.prod(s for s in shapes.values() if s != -1)
+    if infer:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        shapes[infer[0]] = n // known
+    full = [shapes.get(ax, 1) for ax in MESH_AXES]
+    if math.prod(full) != n:
+        raise ValueError(
+            f"mesh {dict(zip(MESH_AXES, full))} needs {math.prod(full)} "
+            f"devices, have {n}"
+        )
+    try:
+        dev_array = mesh_utils.create_device_mesh(full, devices=devices)
+    except (ValueError, AssertionError):
+        # CPU/test meshes where ICI topology assignment has no meaning
+        dev_array = np.asarray(devices).reshape(full)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Sharding for a batch: leading dim over (data, fsdp), rest replicated.
+
+    A PartitionSpec shorter than the array rank leaves trailing dims
+    unsharded, so the default works for any-rank leaves of a batch pytree.
+    """
+    return NamedSharding(mesh, P(BATCH_AXES, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    return mesh.shape["data"] * mesh.shape["fsdp"]
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host-global numpy batch onto the mesh, sharded on the batch dim.
+
+    Single-controller path (one process sees all devices). Multi-host uses
+    :func:`jax.make_array_from_process_local_data` via the infeed module.
+    """
+    return jax.tree.map(
+        lambda x: jax.device_put(x, batch_sharding(mesh, np.ndim(x))), batch
+    )

@@ -1,0 +1,180 @@
+"""Sharded train/eval step builders.
+
+This module replaces the reference's two data-parallel families
+(async parameter-server and MultiWorkerMirroredStrategy, SURVEY.md §2.3)
+with one mechanism: ``jax.jit`` over a mesh with ``NamedSharding``.
+
+- DP   = params replicated, batch sharded on ``('data','fsdp')`` — XLA
+  inserts the gradient psum that NCCL all-reduce did in the reference.
+- FSDP = additionally shard params/optimizer state on ``'fsdp'`` — the
+  sharded-state role the reference's parameter servers played, without the
+  asymmetric-role processes.
+
+Adding TP/SP later is a sharding-rule change, not a rewrite (the mesh
+already carries ``model``/``seq`` axes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensorflowonspark_tpu.compute.mesh import batch_sharding, replicated
+
+
+@struct.dataclass
+class TrainState:
+    """Minimal train state pytree: step counter, params, optimizer state.
+
+    (flax's ``train_state.TrainState`` keeps ``apply_fn``/``tx`` inside the
+    pytree; we keep the state pure data so it shards, checkpoints, and
+    crosses process boundaries cleanly.)
+    """
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+    @classmethod
+    def create(cls, params, tx: optax.GradientTransformation) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+        )
+
+
+def fsdp_shardings(
+    params: Any,
+    mesh: Mesh,
+    min_shard_elements: int = 1024,
+    axis: str = "fsdp",
+) -> Any:
+    """Derive FSDP NamedShardings for a param pytree.
+
+    Rule: shard the *largest* dimension divisible by the fsdp axis size;
+    tiny tensors (biases, norms) stay replicated. This mirrors how the
+    reference's PS spread variables across ps shards
+    (greedy variable placement), re-expressed as mesh sharding.
+    """
+    n_shard = mesh.shape[axis]
+
+    def rule(x) -> NamedSharding:
+        shape = np.shape(x)
+        if n_shard == 1 or np.size(x) < min_shard_elements:
+            return replicated(mesh)
+        dims = sorted(
+            range(len(shape)), key=lambda d: shape[d], reverse=True
+        )
+        for d in dims:
+            if shape[d] % n_shard == 0:
+                spec = [None] * len(shape)
+                spec[d] = axis
+                return NamedSharding(mesh, P(*spec))
+        return replicated(mesh)
+
+    return jax.tree.map(rule, params)
+
+
+def state_shardings(state: TrainState, mesh: Mesh, param_shardings: Any) -> TrainState:
+    """Shardings for a full TrainState.
+
+    Optimizer-state subtrees that structurally mirror the param tree (Adam
+    moments, momentum, etc.) reuse the param shardings position-for-
+    position; everything else (step counts, scalars) is replicated.
+    """
+    params_treedef = jax.tree.structure(state.params)
+    single_param = params_treedef.num_leaves == 1
+    param_leaf_shapes = [np.shape(p) for p in jax.tree.leaves(state.params)]
+
+    def mirrors_params(node) -> bool:
+        if jax.tree.structure(node) != params_treedef:
+            return False
+        if single_param:
+            # A one-leaf treedef matches any lone array (e.g. Adam's
+            # `count` scalar); require the shape to match too.
+            return [np.shape(x) for x in jax.tree.leaves(node)] == param_leaf_shapes
+        return True
+
+    def rec(node):
+        if mirrors_params(node):
+            return param_shardings
+        if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
+            return type(node)(*(rec(c) for c in node))
+        if isinstance(node, (tuple, list)):
+            return type(node)(rec(c) for c in node)
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        return jax.tree.map(lambda _: replicated(mesh), node)
+
+    return TrainState(
+        step=replicated(mesh),
+        params=param_shardings,
+        opt_state=rec(state.opt_state),
+    )
+
+
+def build_train_step(
+    loss_fn: Callable[[Any, Any], jax.Array],
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    param_shardings: Any | None = None,
+    donate: bool = True,
+) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
+    """Compile ``(state, batch) -> (state, loss)`` with mesh shardings.
+
+    ``loss_fn(params, batch) -> scalar`` must mean-reduce over the global
+    batch; since the batch is sharded over ``('data','fsdp')``, XLA lowers
+    the mean's reduction to a psum over ICI — the entire gradient-sync
+    machinery the reference delegated to NCCL/PS.
+    """
+
+    def step(state: TrainState, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(
+                step=state.step + 1, params=new_params, opt_state=new_opt
+            ),
+            loss,
+        )
+
+    def jit_with(state_sh):
+        return jax.jit(
+            step,
+            in_shardings=(state_sh, batch_sharding(mesh)),
+            out_shardings=(state_sh, replicated(mesh)),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    compiled: dict[str, Any] = {}
+
+    def wrapped(state: TrainState, batch):
+        if "fn" not in compiled:
+            psh = (
+                param_shardings
+                if param_shardings is not None
+                else jax.tree.map(lambda _: replicated(mesh), state.params)
+            )
+            compiled["fn"] = jit_with(state_shardings(state, mesh, psh))
+        return compiled["fn"](state, batch)
+
+    return wrapped
+
+
+def build_eval_step(
+    metric_fn: Callable[[Any, Any], Any], mesh: Mesh
+) -> Callable[[Any, Any], Any]:
+    """Compile ``(params, batch) -> metrics`` with batch sharded on the mesh."""
+    return jax.jit(
+        metric_fn,
+        in_shardings=(None, batch_sharding(mesh)),
+        out_shardings=replicated(mesh),
+    )

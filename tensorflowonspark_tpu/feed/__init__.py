@@ -1,0 +1,9 @@
+"""The push data plane: host-side queues into the training loop.
+
+Reference parity: the ``DataFeed`` class of ``tensorflowonspark/TFNode.py``
+plus the queue sentinels of ``marker.py``.
+"""
+
+from tensorflowonspark_tpu.feed.datafeed import DataFeed
+
+__all__ = ["DataFeed"]

@@ -1,0 +1,131 @@
+"""``DataFeed`` — the in-graph consumer API for the push data plane.
+
+Reference parity: ``tensorflowonspark/TFNode.py:DataFeed``
+(``next_batch``, ``should_stop``, ``batch_results``, ``terminate``), plus
+the sentinel semantics of ``marker.py``.
+
+Queue protocol: each element on the input queue is either a
+:class:`~tensorflowonspark_tpu.cluster.marker.Marker` or a *chunk* (a list
+of records). Producers put chunks — not single records — so a remote
+(proxied) put amortizes its round-trip over many records; this removes the
+per-item pickle-proxy tax SURVEY.md §3.2 identifies as the reference's
+dominant overhead.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as _queue
+from typing import Any, Sequence
+
+import numpy as np
+
+from tensorflowonspark_tpu.cluster.marker import EndOfFeed, EndPartition, Marker
+
+logger = logging.getLogger(__name__)
+
+
+class DataFeed:
+    """Pulls host-fed batches off the node's input queue; pushes inference
+    results back on the output queue.
+
+    Args mirror the reference: ``mgr`` is the node's manager handle,
+    ``train_mode`` selects whether ``batch_results`` is expected,
+    ``input_mapping`` (ordered dict of record-field → tensor name) makes
+    ``next_batch`` return a dict of stacked columns instead of a flat list.
+    """
+
+    def __init__(
+        self,
+        mgr,
+        train_mode: bool = True,
+        qname_in: str = "input",
+        qname_out: str = "output",
+        input_mapping: dict[str, str] | None = None,
+    ):
+        self.mgr = mgr
+        self.train_mode = train_mode
+        self.qname_in = qname_in
+        self.qname_out = qname_out
+        self.input_mapping = input_mapping
+        self.input_tensors = (
+            list(input_mapping.values()) if input_mapping is not None else None
+        )
+        self.done_feeding = False
+        self._queue_in = mgr.get_queue(qname_in)
+        self._queue_out = mgr.get_queue(qname_out)
+        self._buffer: list[Any] = []  # records from a partially-consumed chunk
+
+    def next_batch(self, batch_size: int) -> list | dict[str, np.ndarray]:
+        """Return up to ``batch_size`` records.
+
+        Blocks until records arrive. Returns a *partial* batch when an
+        :class:`EndPartition` marker is hit (partition boundary) and an
+        empty/partial batch with ``should_stop() == True`` once
+        :class:`EndOfFeed` is seen. Reference: ``TFNode.py:DataFeed.next_batch``.
+        """
+        batch: list[Any] = []
+        while len(batch) < batch_size:
+            take = batch_size - len(batch)
+            if self._buffer:
+                batch.extend(self._buffer[:take])
+                del self._buffer[:take]
+                continue
+            if self.done_feeding:
+                break
+            item = self._queue_in.get()
+            self._queue_in.task_done()
+            if isinstance(item, Marker) or item is None:
+                if isinstance(item, EndPartition):
+                    if batch:
+                        break  # partial batch at partition boundary
+                    continue  # nothing buffered; keep reading next partition
+                # EndOfFeed / legacy None terminal marker
+                self.done_feeding = True
+                break
+            elif isinstance(item, list):
+                self._buffer.extend(item)
+            else:  # single record (legacy per-item producers)
+                batch.append(item)
+        if self.input_mapping is None:
+            return batch
+        return self._columnize(batch)
+
+    def _columnize(self, batch: Sequence[Any]) -> dict[str, np.ndarray]:
+        """Stack a list of row-records into {tensor_name: array} columns."""
+        out: dict[str, np.ndarray] = {}
+        for i, tensor in enumerate(self.input_tensors):
+            out[tensor] = np.array([row[i] for row in batch])
+        return out
+
+    def should_stop(self) -> bool:
+        """True once the feed is exhausted. Reference: ``DataFeed.should_stop``."""
+        return self.done_feeding
+
+    def batch_results(self, results: Sequence[Any]) -> None:
+        """Push one batch of inference results to the output queue.
+
+        Contract (reference ``_inference`` equal-count rule): over a whole
+        feed, exactly one result per input record, in order.
+        """
+        self._queue_out.put(list(results))
+
+    def terminate(self) -> None:
+        """Signal early termination and drain the input queue.
+
+        Sets the node KV ``state`` to ``'terminating'`` so in-flight feeder
+        tasks fast-drain their partitions instead of blocking on a full
+        queue (reference: ``DataFeed.terminate`` + the ``state`` check at
+        the top of ``TFSparkNode._train``).
+        """
+        logger.info("DataFeed terminating; draining input queue")
+        self.mgr.set("state", "terminating")
+        done = False
+        while not done:
+            try:
+                item = self._queue_in.get(block=True, timeout=3)
+                self._queue_in.task_done()
+                if isinstance(item, EndOfFeed) or item is None:
+                    self.done_feeding = True
+            except _queue.Empty:
+                done = True

@@ -1,0 +1,90 @@
+"""``tpu-submit`` — the spark-submit-shaped entry point.
+
+In the reference, ``spark-submit`` *is* the CLI (SURVEY.md §1): it starts
+the user's driver script, which then calls ``TFCluster.run``. This launcher
+keeps that UX with zero Spark: it accepts the familiar flags, exports them
+as ``TFOS_TPU_*`` env vars (read by :func:`cluster_args_from_env`), and
+executes the user script as ``__main__``.
+
+Usage::
+
+    tpu-submit --num-executors 4 [--conf K=V ...] script.py [script args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-submit",
+        description="Run a driver script against a TPU cluster "
+        "(spark-submit-compatible surface).",
+    )
+    p.add_argument("--num-executors", type=int, default=1)
+    p.add_argument(
+        "--master",
+        default="local",
+        help="'local' (this host) or 'hosts:h1,h2,...' (one node per host)",
+    )
+    p.add_argument(
+        "--conf",
+        action="append",
+        default=[],
+        metavar="K=V",
+        help="extra configuration, exported as env vars",
+    )
+    p.add_argument("--name", default=None, help="job name (informational)")
+    p.add_argument("--queue", default=None, help="accepted for CLI parity; unused")
+    p.add_argument(
+        "--deploy-mode", default="client", help="accepted for CLI parity; unused"
+    )
+    p.add_argument("script", help="driver script to run")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def cluster_args_from_env() -> dict:
+    """Read launcher-provided defaults inside a driver script.
+
+    Returns kwargs directly usable as ``tfcluster.run(fn, args, **these)``:
+    ``num_executors`` plus, for ``--master hosts:h1,h2,...``, a configured
+    ``launcher`` (one node per host over ssh) and ``distributed=True``.
+    """
+    out: dict = {
+        "num_executors": int(os.environ.get("TFOS_TPU_NUM_EXECUTORS", "1"))
+    }
+    master = os.environ.get("TFOS_TPU_MASTER", "local")
+    if master.startswith("hosts:"):
+        from tensorflowonspark_tpu.cluster.launchers import HostListLauncher
+
+        hosts = master[len("hosts:") :].split(",")
+        out["num_executors"] = len(hosts)
+        out["launcher"] = HostListLauncher(hosts)
+        out["distributed"] = True
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    os.environ["TFOS_TPU_NUM_EXECUTORS"] = str(args.num_executors)
+    os.environ["TFOS_TPU_MASTER"] = args.master
+    if args.name:
+        os.environ["TFOS_TPU_JOB_NAME"] = args.name
+    for conf in args.conf:
+        if "=" not in conf:
+            raise SystemExit(f"--conf expects K=V, got {conf!r}")
+        k, v = conf.split("=", 1)
+        os.environ[k] = v
+
+    sys.argv = [args.script] + list(args.script_args)
+    runpy.run_path(args.script, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

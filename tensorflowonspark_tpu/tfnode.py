@@ -1,0 +1,42 @@
+"""User-facing in-graph API module.
+
+Reference parity: ``tensorflowonspark/TFNode.py`` module-level functions
+(``hdfs_path``, ``start_cluster_server``, ``export_saved_model``) plus the
+``DataFeed`` class (re-exported from :mod:`tensorflowonspark_tpu.feed`).
+User ``map_fun`` code written against the reference's ``from
+tensorflowonspark import TFNode`` maps 1:1 onto ``from
+tensorflowonspark_tpu import tfnode as TFNode``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tensorflowonspark_tpu.feed.datafeed import DataFeed  # noqa: F401
+
+__all__ = ["DataFeed", "hdfs_path", "start_cluster_server", "export_saved_model"]
+
+
+def hdfs_path(ctx, path: str) -> str:
+    """Resolve a path against the cluster's default FS / working dir.
+
+    Reference: ``TFNode.py:hdfs_path``.
+    """
+    return ctx.absolute_path(path)
+
+
+def start_cluster_server(ctx, num_gpus: int = 0, rdma: bool = False):
+    """Join the distributed runtime (reference: ``TFNode.start_cluster_server``).
+
+    ``num_gpus``/``rdma`` are accepted for signature compatibility and
+    ignored: on TPU, device ownership is per-process by construction and
+    transport selection (ICI vs DCN) is a property of mesh-axis placement,
+    not a protocol flag.
+    """
+    ctx.initialize_distributed()
+    return None
+
+
+def export_saved_model(ctx, state, export_dir: str, **kwargs) -> str:
+    """Chief-only export (reference: ``TFNode.export_saved_model``)."""
+    return ctx.export_saved_model(state, export_dir, **kwargs)

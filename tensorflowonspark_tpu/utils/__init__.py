@@ -1,0 +1,17 @@
+"""Utility helpers (reference: ``tensorflowonspark/util.py``, ``compat.py``)."""
+
+from tensorflowonspark_tpu.utils.util import (
+    get_ip_address,
+    find_in_path,
+    read_executor_id,
+    write_executor_id,
+    single_node_env,
+)
+
+__all__ = [
+    "get_ip_address",
+    "find_in_path",
+    "read_executor_id",
+    "write_executor_id",
+    "single_node_env",
+]

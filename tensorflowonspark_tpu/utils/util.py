@@ -1,0 +1,133 @@
+"""Small host-side utilities.
+
+Reference parity: ``tensorflowonspark/util.py`` (get_ip_address,
+find_in_path, write_executor_id/read_executor_id, single_node_env).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import socket
+
+
+EXECUTOR_ID_FILE = "executor_id"
+
+
+def get_ip_address() -> str:
+    """Best-effort externally-routable IP of this host.
+
+    Uses the UDP-connect trick (no packets are actually sent): connect a
+    datagram socket to a public address and read the local endpoint the
+    kernel chose. Falls back to loopback in fully isolated environments.
+    Reference: ``util.py:get_ip_address``.
+    """
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 53))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def find_in_path(path: str, file_name: str) -> str | None:
+    """Find ``file_name`` in the ``os.pathsep``-separated ``path`` string.
+
+    Reference: ``util.py:find_in_path`` (used to locate the tensorboard
+    binary on executors).
+    """
+    for p in path.split(os.pathsep):
+        candidate = os.path.join(p, file_name)
+        if os.path.exists(candidate) and os.path.isfile(candidate):
+            return candidate
+    return None
+
+
+def write_executor_id(num: int, cwd: str | None = None) -> None:
+    """Pin this executor's logical id to a file in its working dir.
+
+    Task retries land in the same working directory, so a retried feed task
+    rediscovers which logical node it belongs to instead of grabbing a fresh
+    partition id. Reference: ``util.py:write_executor_id``.
+    """
+    path = os.path.join(cwd or os.getcwd(), EXECUTOR_ID_FILE)
+    with open(path, "w") as f:
+        f.write(str(num))
+
+
+def read_executor_id(cwd: str | None = None) -> int | None:
+    """Read the pinned executor id, or None if this is the first task here.
+
+    Reference: ``util.py:read_executor_id``.
+    """
+    path = os.path.join(cwd or os.getcwd(), EXECUTOR_ID_FILE)
+    try:
+        with open(path) as f:
+            return int(f.read())
+    except (OSError, ValueError):
+        return None
+
+
+def cpu_only_env(num_cpu_devices: int | None = None) -> dict[str, str]:
+    """Env vars that force a subprocess to boot pure-CPU JAX.
+
+    Besides ``JAX_PLATFORMS=cpu``, TPU-plugin autoload hooks (sitecustomize
+    entries keyed on ``PALLAS_AXON_POOL_IPS``-style vars) must be disabled —
+    they dial the accelerator at *interpreter start*, before any user code,
+    and concurrent subprocess dials can wedge a single-chip runtime. Empty
+    string disables them (falsy to the hook) while remaining inheritable.
+    """
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "PALLAS_AXON_REMOTE_COMPILE": "",
+    }
+    if num_cpu_devices is not None:
+        env["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={num_cpu_devices}"
+        ).strip()
+    return env
+
+
+def single_node_env(num_cpu_devices: int | None = None) -> None:
+    """Configure env vars for a single-process, host-only JAX run.
+
+    Used by inference/transform workers and tests that must not grab the TPU.
+    Reference: ``util.py:single_node_env`` (which hid GPUs and capped
+    threads for single-node TF).
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if num_cpu_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        opt = f"--xla_force_host_platform_device_count={num_cpu_devices}"
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " " + opt).strip()
+
+
+def find_free_port(host: str = "") -> int:
+    """Reserve an OS-assigned free TCP port and release it immediately.
+
+    Mirrors the reference's reserve-then-release port dance
+    (``TFSparkNode.py:_mapfn``: bind on port 0, hand the port to the
+    reservation, close the socket just before the engine binds it). There is
+    an inherent race window; callers must tolerate rebinding.
+    """
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+def ensure_dir(path: str) -> str:
+    """mkdir -p that tolerates concurrent creation across hosts."""
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:  # pragma: no cover - exotic FS races
+        if e.errno != errno.EEXIST:
+            raise
+    return path

@@ -1,0 +1,95 @@
+"""Top-level map_fun functions for cluster e2e tests.
+
+Node processes are spawned (not forked), so these must live in an importable
+module — the analog of the reference's pattern of defining ``map_fun`` at
+module scope so Spark can pickle it to executors.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def sum_fn(args, ctx):
+    """Trivial SPARK-mode map_fun: sums fed numbers, writes result to a file.
+
+    Mirrors the reference's test_TFCluster 'sum numbers' map_fun.
+    """
+    feed = ctx.get_data_feed(train_mode=True)
+    total = 0
+    count = 0
+    while not feed.should_stop():
+        batch = feed.next_batch(16)
+        total += sum(r[0] for r in batch)
+        count += len(batch)
+    out = os.path.join(args["out_dir"], f"node{ctx.executor_id}.txt")
+    with open(out, "w") as f:
+        f.write(f"{total} {count}")
+
+
+def square_inference_fn(args, ctx):
+    """SPARK-mode inference map_fun: one squared result per input record."""
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        batch = feed.next_batch(8)
+        if batch:
+            feed.batch_results([r[0] ** 2 for r in batch])
+
+
+def failing_fn(args, ctx):
+    raise ValueError("intentional failure for error-ferry test")
+
+
+def file_reader_fn(args, ctx):
+    """TENSORFLOW-mode map_fun: nodes read their own data (no feed)."""
+    path = ctx.absolute_path(args["data_file"])
+    with open(path) as f:
+        values = [int(line) for line in f]
+    # shard by executor like a real per-host reader would
+    mine = values[ctx.executor_id :: ctx.num_workers]
+    out = os.path.join(args["out_dir"], f"node{ctx.executor_id}.txt")
+    with open(out, "w") as f:
+        f.write(str(sum(mine)))
+
+
+def train_linear_fn(args, ctx):
+    """A real (tiny) JAX training loop fed through the data plane.
+
+    Fits y = w*x + b on fed (x, y) records with a jitted SGD step, then the
+    chief exports the final params — the minimum end-to-end slice of
+    SURVEY.md §7 (queue → DataFeed → jit step → export).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    feed = ctx.get_data_feed(train_mode=True)
+
+    @jax.jit
+    def step(params, x, y):
+        def loss_fn(p):
+            pred = p["w"] * x + p["b"]
+            return jnp.mean((pred - y) ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        return {k: params[k] - 0.1 * g[k] for k in params}, loss
+
+    params = {"w": jnp.zeros(()), "b": jnp.zeros(())}
+    loss = None
+    while not feed.should_stop():
+        batch = feed.next_batch(32)
+        if not batch:
+            continue
+        x = jnp.asarray(np.array([r[0] for r in batch], dtype=np.float32))
+        y = jnp.asarray(np.array([r[1] for r in batch], dtype=np.float32))
+        params, loss = step(params, x, y)
+
+    out = os.path.join(args["out_dir"], f"node{ctx.executor_id}.json")
+    with open(out, "w") as f:
+        import json
+
+        json.dump(
+            {"w": float(params["w"]), "b": float(params["b"]),
+             "loss": float(loss) if loss is not None else None},
+            f,
+        )

@@ -1,0 +1,47 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax loads.
+
+This is the rebuild's version of the reference's local-mode Spark trick
+(SURVEY.md §4): the whole distributed surface — mesh, shardings, the
+control/data planes — is exercised on one box with no TPU pod.
+"""
+
+import os
+
+# Must happen before any `import jax` anywhere in the test process, and
+# before any node subprocess is spawned (children inherit this environ at
+# exec, which is when sitecustomize TPU hooks would otherwise dial the
+# accelerator — see utils.util.cpu_only_env).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["PALLAS_AXON_REMOTE_COMPILE"] = ""
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# sitecustomize-style TPU hooks may have imported jax at interpreter boot,
+# BEFORE this file ran — in that case the env vars above were snapshotted
+# too late and jax would still dial the TPU plugin at first backend init.
+# jax_platforms is config-updatable any time before backends initialize,
+# and XLA_FLAGS is read at CPU-client creation, so this pins tests to the
+# 8-device virtual CPU mesh either way.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+
+    return make_mesh({"data": 2, "fsdp": 4})
+
+
+@pytest.fixture(scope="session")
+def mesh_dp():
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+
+    return make_mesh({"data": 8})

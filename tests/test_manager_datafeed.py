@@ -1,0 +1,104 @@
+"""Manager + DataFeed tests (reference parity: test/test_TFNode.py DataFeed
+tests against a locally-started TFManager)."""
+
+import secrets
+import threading
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.cluster import manager
+from tensorflowonspark_tpu.cluster.marker import EndOfFeed, EndPartition
+from tensorflowonspark_tpu.feed.datafeed import DataFeed
+
+
+@pytest.fixture()
+def mgr():
+    h = manager.start(secrets.token_bytes(16), mode="local")
+    yield h
+    h.stop()
+
+
+def test_kv_local_and_remote(mgr):
+    mgr.set("state", "running")
+    remote = manager.connect(mgr.address, mgr._authkey)
+    assert str(remote.get("state")) == "running"
+    remote.set("state", "terminating")
+    assert str(mgr.get("state")) == "terminating"
+
+
+def test_queue_roundtrip_remote(mgr):
+    remote = manager.connect(mgr.address, mgr._authkey)
+    q = remote.get_queue("input")
+    q.put([1, 2, 3])
+    local_q = mgr.get_queue("input")
+    assert local_q.get() == [1, 2, 3]
+
+
+def test_datafeed_batches(mgr):
+    q = mgr.get_queue("input")
+    q.put([(i, i * 2) for i in range(10)])  # one chunk of 10 records
+    q.put(EndPartition())
+    q.put([(10, 20), (11, 22)])
+    q.put(EndOfFeed())
+
+    feed = DataFeed(mgr)
+    b1 = feed.next_batch(4)
+    assert len(b1) == 4
+    b2 = feed.next_batch(100)  # rest of partition: partial batch of 6
+    assert len(b2) == 6
+    assert not feed.should_stop()
+    b3 = feed.next_batch(100)
+    assert len(b3) == 2
+    assert feed.should_stop()
+    assert feed.next_batch(4) == []
+
+
+def test_datafeed_input_mapping(mgr):
+    q = mgr.get_queue("input")
+    q.put([(np.ones(4), 7), (np.zeros(4), 8)])
+    q.put(EndOfFeed())
+    feed = DataFeed(mgr, input_mapping={"image": "x", "label": "y"})
+    batch = feed.next_batch(2)
+    assert set(batch) == {"x", "y"}
+    assert batch["x"].shape == (2, 4)
+    assert batch["y"].tolist() == [7, 8]
+
+
+def test_datafeed_results_and_terminate(mgr):
+    feed = DataFeed(mgr, train_mode=False)
+    feed.batch_results([1, 2, 3])
+    out = mgr.get_queue("output").get()
+    assert out == [1, 2, 3]
+
+    # fill input then terminate: queue drains, state flips
+    q = mgr.get_queue("input")
+    for _ in range(5):
+        q.put([(0,)] * 10)
+    q.put(EndOfFeed())
+    feed.terminate()
+    assert str(mgr.get("state")) == "terminating"
+    assert feed.should_stop()
+    assert q.qsize() == 0
+
+
+def test_producer_consumer_threads(mgr):
+    """Concurrent feed: producer fills while consumer batches."""
+    total = 1000
+
+    def produce():
+        remote = manager.connect(mgr.address, mgr._authkey)
+        q = remote.get_queue("input")
+        for start in range(0, total, 100):
+            q.put([(i,) for i in range(start, start + 100)])
+        q.put(EndOfFeed())
+
+    t = threading.Thread(target=produce)
+    t.start()
+    feed = DataFeed(mgr)
+    seen = []
+    while not feed.should_stop():
+        seen.extend(feed.next_batch(64))
+    t.join()
+    assert len(seen) == total
+    assert [r[0] for r in seen] == list(range(total))

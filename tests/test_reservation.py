@@ -1,0 +1,83 @@
+"""Reservation protocol tests (reference test parity: test/test_reservation.py)."""
+
+import threading
+
+import pytest
+
+from tensorflowonspark_tpu.cluster.reservation import Client, Server
+
+
+def test_register_and_await():
+    server = Server(3)
+    addr = server.start()
+    client = Client(addr)
+    for i in range(3):
+        client.register({"executor_id": i, "host": "h", "port": 1000 + i})
+    info = server.await_reservations(timeout=10)
+    assert len(info) == 3
+    assert sorted(n["executor_id"] for n in info) == [0, 1, 2]
+    # client sees the same roster
+    assert len(client.get_reservations()) == 3
+    server.stop()
+
+
+def test_await_from_clients_concurrently():
+    server = Server(4)
+    addr = server.start()
+    results = []
+
+    def node(i):
+        c = Client(addr)
+        c.register({"executor_id": i})
+        results.append(c.await_reservations(timeout=10))
+
+    threads = [threading.Thread(target=node, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 4
+    assert all(len(r) == 4 for r in results)
+    server.stop()
+
+
+def test_reservation_timeout():
+    server = Server(2)
+    addr = server.start()
+    Client(addr).register({"executor_id": 0})
+    with pytest.raises(TimeoutError):
+        server.await_reservations(timeout=1.5, poll_interval=0.2)
+    server.stop()
+
+
+def test_client_timeout():
+    server = Server(2)
+    addr = server.start()
+    c = Client(addr)
+    c.register({"executor_id": 0})
+    with pytest.raises(TimeoutError):
+        c.await_reservations(timeout=1.5, poll_interval=0.2)
+    server.stop()
+
+
+def test_request_stop():
+    server = Server(5)
+    addr = server.start()
+    assert not server.stopped
+    Client(addr).request_stop()
+    # server thread observes stop promptly
+    import time
+
+    deadline = time.monotonic() + 5
+    while not server.stopped and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert server.stopped
+
+
+def test_remaining_query():
+    server = Server(3)
+    addr = server.start()
+    c = Client(addr)
+    c.register({"executor_id": 0})
+    assert c._call({"type": "QNUM"})["remaining"] == 2
+    server.stop()

@@ -1,0 +1,118 @@
+"""End-to-end cluster tests (reference parity: test/test_TFCluster.py).
+
+Local launcher spawns real node processes; the driver feeds them over TCP —
+the whole control + data plane on one box, no pod.
+"""
+
+import json
+import os
+
+import pytest
+
+from tensorflowonspark_tpu.cluster import tfcluster
+from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+
+from tests import cluster_fns
+
+pytestmark = pytest.mark.e2e
+
+# Node processes must not initialize a TPU backend in CI.
+from tensorflowonspark_tpu.utils.util import cpu_only_env
+
+NODE_ENV = cpu_only_env()
+
+
+def test_spark_mode_train_sum(tmp_path):
+    cluster = tfcluster.run(
+        cluster_fns.sum_fn,
+        {"out_dir": str(tmp_path)},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        env=NODE_ENV,
+    )
+    # 4 partitions of 25 numbers each -> round-robin over 2 nodes
+    partitions = [list((i,) for i in range(p * 25, (p + 1) * 25)) for p in range(4)]
+    cluster.train(partitions)
+    cluster.shutdown(timeout=120)
+
+    totals = []
+    counts = []
+    for i in range(2):
+        total, count = open(tmp_path / f"node{i}.txt").read().split()
+        totals.append(int(total))
+        counts.append(int(count))
+    assert sum(counts) == 100
+    assert sum(totals) == sum(range(100))
+
+
+def test_spark_mode_inference(tmp_path):
+    cluster = tfcluster.run(
+        cluster_fns.square_inference_fn,
+        {},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        env=NODE_ENV,
+    )
+    partitions = [[(i,) for i in range(p * 10, (p + 1) * 10)] for p in range(3)]
+    results = cluster.inference(partitions)
+    cluster.shutdown(timeout=120)
+    assert results == [i**2 for i in range(30)]
+
+
+def test_tensorflow_mode(tmp_path):
+    data_file = tmp_path / "data.txt"
+    data_file.write_text("\n".join(str(i) for i in range(50)) + "\n")
+    cluster = tfcluster.run(
+        cluster_fns.file_reader_fn,
+        {"data_file": str(data_file), "out_dir": str(tmp_path)},
+        num_executors=2,
+        input_mode=InputMode.TENSORFLOW,
+        reservation_timeout=120,
+        env=NODE_ENV,
+    )
+    with pytest.raises(RuntimeError):
+        cluster.train([[1, 2]])  # feeding is a SPARK-mode operation
+    cluster.shutdown(timeout=120)
+    vals = [int(open(tmp_path / f"node{i}.txt").read()) for i in range(2)]
+    assert sum(vals) == sum(range(50))
+
+
+def test_error_ferry(tmp_path):
+    cluster = tfcluster.run(
+        cluster_fns.failing_fn,
+        {},
+        num_executors=1,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        env=NODE_ENV,
+    )
+    with pytest.raises(RuntimeError, match="intentional failure"):
+        cluster.shutdown(timeout=120)
+
+
+def test_train_linear_e2e(tmp_path):
+    """The minimum end-to-end slice: queue -> DataFeed -> jit step -> export."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=512).astype("float32")
+    y = 3.0 * x + 1.5
+    records = list(zip(x.tolist(), y.tolist()))
+    partitions = [records[i::4] for i in range(4)]
+
+    cluster = tfcluster.run(
+        cluster_fns.train_linear_fn,
+        {"out_dir": str(tmp_path)},
+        num_executors=1,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=180,
+        env=NODE_ENV,
+    )
+    cluster.train(partitions, num_epochs=8)
+    cluster.shutdown(timeout=180)
+
+    result = json.load(open(tmp_path / "node0.json"))
+    assert abs(result["w"] - 3.0) < 0.2
+    assert abs(result["b"] - 1.5) < 0.2
