@@ -1,0 +1,59 @@
+"""Stage MNIST to TFRecord files.
+
+Reference parity: ``examples/mnist/mnist_data_setup.py`` (staged MNIST to
+HDFS as CSV/TFRecords for the other examples). This environment has no
+dataset egress, so ``--synthetic`` (default) generates a deterministic fake
+MNIST; point ``--from-npz`` at a real ``mnist.npz`` when available.
+
+Usage::
+
+    python examples/mnist/mnist_data_setup.py --output /tmp/mnist_tfr \
+        [--num-examples 10000] [--from-npz mnist.npz]
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+
+# examples are runnable without installing the package
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
+
+import argparse
+
+import numpy as np
+
+
+def load_mnist(args) -> tuple[np.ndarray, np.ndarray]:
+    if args.from_npz:
+        with np.load(args.from_npz) as d:
+            return d["x_train"], d["y_train"]
+    rng = np.random.default_rng(42)
+    images = (rng.random((args.num_examples, 28, 28)) * 255).astype(np.uint8)
+    labels = rng.integers(0, 10, size=args.num_examples).astype(np.int64)
+    return images, labels
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--output", required=True)
+    p.add_argument("--num-examples", type=int, default=10000)
+    p.add_argument("--from-npz", default=None)
+    p.add_argument("--records-per-file", type=int, default=5000)
+    args = p.parse_args()
+
+    from tensorflowonspark_tpu.data import dfutil
+
+    images, labels = load_mnist(args)
+    rows = (
+        {"image": img.reshape(-1).astype(np.int64), "label": int(lab)}
+        for img, lab in zip(images, labels)
+    )
+    paths = dfutil.saveAsTFRecords(
+        rows, args.output, records_per_file=args.records_per_file
+    )
+    print(f"wrote {len(images)} examples to {len(paths)} files under {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""MNIST via the TFEstimator / TFModel pipeline API.
+
+Reference parity: ``examples/mnist/estimator/mnist_spark.py`` +
+``pipeline.TFEstimator`` — fit on a record set, then transform.
+
+Usage::
+
+    tpu-submit --num-executors 1 examples/mnist/mnist_estimator.py \
+        --export-dir /tmp/mnist_est [--cpu]
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+
+# examples are runnable without installing the package
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
+
+import argparse
+
+
+def train_fn(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState, build_train_step
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.models import mnist
+
+    model = mnist.MLP(hidden=128)
+    mesh = make_mesh()
+    feed = ctx.get_data_feed(
+        train_mode=True, input_mapping={"image": "image", "label": "label"}
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((2, 784), np.float32)
+    )["params"]
+    tx = optax.adam(1e-3)
+    state = TrainState.create(params, tx)
+    step = build_train_step(mnist.loss_fn(model.apply), tx, mesh)
+
+    bs = int(args["batch_size"])
+    while not feed.should_stop():
+        cols = feed.next_batch(bs)
+        n = len(cols["label"])
+        n -= n % jax.device_count()
+        if n == 0:
+            continue
+        batch = {
+            "image": np.asarray(cols["image"], np.float32)[:n] / 255.0,
+            "label": np.asarray(cols["label"], np.int32)[:n],
+        }
+        state, _ = step(state, shard_batch(mesh, batch))
+
+    ctx.export_saved_model(jax.device_get(state.params), args["export_dir"])
+
+
+def export_fn(args):
+    """(apply_fn, target_state) for TFModel.transform."""
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import mnist
+
+    model = mnist.MLP(hidden=128)
+    target = model.init(
+        jax.random.PRNGKey(0), np.zeros((2, 784), np.float32)
+    )["params"]
+
+    def apply_fn(params, batch):
+        logits = model.apply({"params": params}, batch["x"] / 255.0)
+        return {"prediction": jax.numpy.argmax(logits, -1)}
+
+    return apply_fn, target
+
+
+if __name__ == "__main__":
+    import numpy as np
+
+    from tensorflowonspark_tpu.api.pipeline import TFEstimator
+    from tensorflowonspark_tpu.launcher import cluster_args_from_env
+    from tensorflowonspark_tpu.utils.util import cpu_only_env
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--export-dir", required=True)
+    p.add_argument("--num-records", type=int, default=2048)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    largs = cluster_args_from_env()
+
+    rng = np.random.default_rng(0)
+    records = [
+        (rng.integers(0, 255, size=784), int(rng.integers(0, 10)))
+        for _ in range(args.num_records)
+    ]
+
+    est = TFEstimator(
+        train_fn,
+        cluster_size=largs["num_executors"],
+        epochs=2,
+        batch_size=256,
+        export_dir=args.export_dir,
+        input_mapping={"image": "image", "label": "label"},
+    )
+    model = est.fit(
+        [records[i::8] for i in range(8)],
+        env=cpu_only_env() if args.cpu else None,
+    )
+    model.export_fn = export_fn
+    model.args.input_mapping = {"image": "x"}
+    model.args.output_mapping = {"prediction": "pred"}
+    preds = model.transform(records[:16])
+    print("sample predictions:", [int(r["pred"]) for r in preds])

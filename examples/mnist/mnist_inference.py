@@ -1,0 +1,90 @@
+"""MNIST batch inference through the cluster feed (equal-count contract).
+
+Reference parity: ``examples/mnist/keras/mnist_inference.py`` — feed
+records, get one prediction per record, in order.
+
+Usage::
+
+    tpu-submit --num-executors 2 examples/mnist/mnist_inference.py \
+        --model-dir /tmp/mnist_model [--cpu]
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+
+# examples are runnable without installing the package
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
+
+import argparse
+
+
+def infer_fun(args, ctx):
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.compute.checkpoint import restore_checkpoint
+    from tensorflowonspark_tpu.models import mnist
+
+    model = mnist.CNN()
+    target = model.init(
+        jax.random.PRNGKey(0), np.zeros((2, 28, 28, 1), np.float32)
+    )["params"]
+    params = restore_checkpoint(args.model_dir, target=target)
+
+    @jax.jit
+    def predict(images):
+        logits = model.apply({"params": params}, images)
+        return jax.numpy.argmax(logits, -1)
+
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        batch = feed.next_batch(args.batch_size)
+        if not batch:
+            continue
+        images = (
+            np.stack([np.asarray(r[0], np.float32) for r in batch]).reshape(
+                -1, 28, 28, 1
+            )
+            / 255.0
+        )
+        preds = np.asarray(predict(images))
+        feed.batch_results([int(p) for p in preds])
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model-dir", required=True)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--num-records", type=int, default=1024)
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args(argv)
+
+
+if __name__ == "__main__":
+    import numpy as np
+
+    from tensorflowonspark_tpu.cluster import tfcluster
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+    from tensorflowonspark_tpu.launcher import cluster_args_from_env
+    from tensorflowonspark_tpu.utils.util import cpu_only_env
+
+    args = parse_args()
+    largs = cluster_args_from_env()
+    rng = np.random.default_rng(0)
+    records = [
+        (rng.integers(0, 255, size=784),) for _ in range(args.num_records)
+    ]
+    cluster = tfcluster.run(
+        infer_fun,
+        args,
+        num_executors=largs["num_executors"],
+        input_mode=InputMode.SPARK,
+        env=cpu_only_env() if args.cpu else None,
+        launcher=largs.get("launcher"),
+        distributed=largs.get("distributed", False),
+    )
+    preds = cluster.inference([records[i::4] for i in range(4)])
+    cluster.shutdown()
+    print(f"predictions: {len(preds)} records; first 10: {preds[:10]}")

@@ -1,0 +1,5 @@
+"""High-level estimator-style API (reference: ``tensorflowonspark/pipeline.py``)."""
+
+from tensorflowonspark_tpu.api.pipeline import TFEstimator, TFModel, Namespace
+
+__all__ = ["TFEstimator", "TFModel", "Namespace"]

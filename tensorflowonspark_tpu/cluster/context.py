@@ -137,8 +137,19 @@ class TFNodeContext:
         """
         from tensorflowonspark_tpu.compute.checkpoint import save_checkpoint
 
-        if self.job_name == "chief" or (
-            self.job_name == "worker" and self.task_index == 0
-        ):
+        if self.is_chief:
             return save_checkpoint(self.absolute_path(export_dir), state, **kwargs)
         return export_dir
+
+    @property
+    def is_chief(self) -> bool:
+        """True on exactly one node: the 'chief' role, or worker:0 only in
+        rosters that have no explicit chief (reference convention)."""
+        if self.job_name == "chief":
+            return True
+        has_chief = any(n["job_name"] == "chief" for n in self.cluster_info)
+        return (
+            not has_chief
+            and self.job_name == "worker"
+            and self.task_index == 0
+        )

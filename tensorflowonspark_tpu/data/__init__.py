@@ -1,0 +1,6 @@
+"""Data interop: record formats and feed adapters.
+
+Reference parity: ``tensorflowonspark/dfutil.py`` (DataFrame↔TFRecord) →
+:mod:`.dfutil`, operating on python record iterables instead of Spark
+DataFrames (no pyspark in this stack; the launcher plays Spark's role).
+"""

@@ -1,0 +1,166 @@
+"""TFRecord interop: save/load record sets, Example conversion, schema
+inference.
+
+Reference parity: ``tensorflowonspark/dfutil.py`` — ``saveAsTFRecords``,
+``loadTFRecords``, ``toTFExample``, ``fromTFExample``, ``infer_schema``.
+The reference delegated file I/O to the Hadoop ``tensorflow-hadoop``
+connector jar (SURVEY.md §2.2); here the installed TensorFlow writes/reads
+TFRecord files directly, and "DataFrame" means any iterable of dict rows
+(or tuple rows + column names).
+
+TensorFlow is imported lazily — it is only needed for this interop layer,
+never for training.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Iterable, Iterator, Sequence
+
+import numpy as np
+
+
+def _tf():
+    import tensorflow as tf  # heavy import, deferred
+
+    return tf
+
+
+# --- schema ----------------------------------------------------------------
+
+
+def infer_schema(row: dict[str, Any]) -> dict[str, str]:
+    """Map column → feature kind ('int64' | 'float' | 'bytes').
+
+    Reference: ``dfutil.infer_schema`` (from DataFrame dtypes; here from a
+    sample row).
+    """
+    schema: dict[str, str] = {}
+    for col, val in row.items():
+        arr = np.asarray(val)
+        if arr.dtype.kind in "iub":
+            schema[col] = "int64"
+        elif arr.dtype.kind == "f":
+            schema[col] = "float"
+        elif arr.dtype.kind in "SU" or isinstance(val, (bytes, str)):
+            schema[col] = "bytes"
+        else:
+            raise TypeError(f"column {col!r}: unsupported dtype {arr.dtype}")
+    return schema
+
+
+# --- Example conversion ----------------------------------------------------
+
+
+def toTFExample(row: dict[str, Any], schema: dict[str, str] | None = None):
+    """dict row → ``tf.train.Example`` (reference: ``dfutil.toTFExample``)."""
+    tf = _tf()
+    schema = schema or infer_schema(row)
+    feature = {}
+    for col, kind in schema.items():
+        val = np.asarray(row[col]).reshape(-1)
+        if kind == "int64":
+            feature[col] = tf.train.Feature(
+                int64_list=tf.train.Int64List(value=val.astype(np.int64))
+            )
+        elif kind == "float":
+            feature[col] = tf.train.Feature(
+                float_list=tf.train.FloatList(value=val.astype(np.float32))
+            )
+        else:
+            vals = [
+                v.encode() if isinstance(v, str) else bytes(v) for v in val.tolist()
+            ]
+            feature[col] = tf.train.Feature(
+                bytes_list=tf.train.BytesList(value=vals)
+            )
+    return tf.train.Example(features=tf.train.Features(feature=feature))
+
+
+def fromTFExample(
+    serialized: bytes, binary_features: Sequence[str] = ()
+) -> dict[str, Any]:
+    """Serialized Example → dict row (reference: ``dfutil.fromTFExample``).
+
+    ``binary_features`` names bytes columns to keep as raw bytes (others
+    are decoded to str) — same knob as the reference's ``loadTFRecords``.
+    """
+    tf = _tf()
+    ex = tf.train.Example.FromString(serialized)
+    row: dict[str, Any] = {}
+    for col, feat in ex.features.feature.items():
+        kind = feat.WhichOneof("kind")
+        if kind == "int64_list":
+            vals: Any = np.asarray(feat.int64_list.value, dtype=np.int64)
+        elif kind == "float_list":
+            vals = np.asarray(feat.float_list.value, dtype=np.float32)
+        else:
+            raw = list(feat.bytes_list.value)
+            vals = (
+                raw if col in binary_features else [b.decode("utf-8", "replace") for b in raw]
+            )
+        if isinstance(vals, np.ndarray) and vals.size == 1:
+            vals = vals[0]
+        elif isinstance(vals, list) and len(vals) == 1:
+            vals = vals[0]
+        row[col] = vals
+    return row
+
+
+# --- file I/O ---------------------------------------------------------------
+
+
+def saveAsTFRecords(
+    rows: Iterable[dict[str, Any]],
+    output_dir: str,
+    schema: dict[str, str] | None = None,
+    records_per_file: int = 10000,
+) -> list[str]:
+    """Write rows as sharded TFRecord files (reference: ``saveAsTFRecords``,
+    which used ``saveAsNewAPIHadoopFile`` + ``TFRecordFileOutputFormat``).
+    Returns the shard paths (``part-rNNNNN`` naming, like the connector)."""
+    tf = _tf()
+    os.makedirs(output_dir, exist_ok=True)
+    paths: list[str] = []
+    writer = None
+    count = 0
+    try:
+        for row in rows:
+            if schema is None:
+                schema = infer_schema(row)
+            if writer is None or count >= records_per_file:
+                if writer is not None:
+                    writer.close()
+                path = os.path.join(
+                    output_dir, f"part-r-{len(paths):05d}.tfrecord"
+                )
+                paths.append(path)
+                writer = tf.io.TFRecordWriter(path)
+                count = 0
+            writer.write(toTFExample(row, schema).SerializeToString())
+            count += 1
+    finally:
+        if writer is not None:
+            writer.close()
+    return paths
+
+
+def loadTFRecords(
+    input_dir: str, binary_features: Sequence[str] = ()
+) -> Iterator[dict[str, Any]]:
+    """Iterate dict rows from TFRecord files (reference: ``loadTFRecords``)."""
+    tf = _tf()
+    pattern = (
+        input_dir
+        if any(ch in input_dir for ch in "*?[")
+        else os.path.join(input_dir, "part-*")
+    )
+    files = sorted(glob.glob(pattern)) or sorted(
+        glob.glob(os.path.join(input_dir, "*.tfrecord"))
+    )
+    if not files:
+        raise FileNotFoundError(f"no TFRecord files under {input_dir}")
+    ds = tf.data.TFRecordDataset(files)
+    for serialized in ds.as_numpy_iterator():
+        yield fromTFExample(serialized, binary_features)

@@ -1,0 +1,226 @@
+"""Llama-family decoder (the flagship model for the FSDP baseline).
+
+TPU-first design notes:
+
+- bf16 activations/params with fp32 RMSNorm accumulations and fp32 softmax
+  (inside the attention op) — the MXU-friendly mix.
+- RoPE applied functionally; no Python control flow under jit.
+- Grouped-query attention via the shared
+  :func:`tensorflowonspark_tpu.ops.attention.dot_product_attention`
+  (Pallas flash kernel on TPU, XLA fallback elsewhere).
+- Megatron-style mesh sharding rules in :func:`llama_param_shardings`:
+  'fsdp' shards every matrix's non-TP dimension; 'model' (TP) shards
+  attention heads and MLP hidden. DP/FSDP is the parity target
+  (BASELINE.md Llama-2-7B config); TP rules ship so scaling past FSDP is a
+  sharding change, not a rewrite (SURVEY.md §2.3 implication).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensorflowonspark_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "auto"
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def llama2_7b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(**overrides) -> "LlamaConfig":
+        """Test-size config (also used by __graft_entry__ dry runs)."""
+        base = dict(
+            vocab_size=256,
+            hidden_size=128,
+            intermediate_size=256,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            max_seq_len=128,
+        )
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        )
+        return (norm * scale).astype(self.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding; x (B, S, H, D), positions (B, S)."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B, S, D/2)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=cfg.dtype, name=name,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+        q = dense(cfg.num_heads * cfg.head_dim, "q_proj")(x)
+        k = dense(cfg.num_kv_heads * cfg.head_dim, "k_proj")(x)
+        v = dense(cfg.num_kv_heads * cfg.head_dim, "v_proj")(x)
+        b, s, _ = x.shape
+        q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        out = dot_product_attention(
+            q, k, v, causal=True, segment_ids=segment_ids,
+            impl=cfg.attention_impl,
+        )
+        out = out.reshape(b, s, cfg.num_heads * cfg.head_dim)
+        return dense(cfg.hidden_size, "o_proj")(out)
+
+
+class MLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=cfg.dtype, name=name,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+        gate = dense(cfg.intermediate_size, "gate_proj")(x)
+        up = dense(cfg.intermediate_size, "up_proj")(x)
+        return dense(cfg.hidden_size, "down_proj")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        h = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x),
+            positions,
+            segment_ids,
+        )
+        return h + MLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(h)
+        )
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, segment_ids=None):
+        """tokens (B, S) int32 -> logits (B, S, vocab)."""
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
+            )
+        embed = self.param(
+            "embed",
+            nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.hidden_size),
+        )
+        x = embed[tokens].astype(cfg.dtype)
+        block = Block
+        if cfg.remat:
+            # Rematerialize each layer's activations in backward: trades
+            # FLOPs for HBM, the standard long-sequence TPU memory lever.
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"layer{i}")(x, positions, segment_ids)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
+        # untied output head
+        head = self.param(
+            "lm_head",
+            nn.initializers.normal(0.02),
+            (cfg.hidden_size, cfg.vocab_size),
+        )
+        return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+
+
+def llama_param_shardings(params, mesh: Mesh):
+    """Mesh sharding rules for a Llama param tree.
+
+    Megatron layout on the ('fsdp', 'model') axes; biases/norms replicated.
+    With mesh model=1 this degrades to pure FSDP (the Llama-2-7B baseline
+    config); with fsdp=1 to pure TP.
+    """
+
+    def rule(path, leaf) -> NamedSharding:
+        names = [
+            p.key for p in path if isinstance(p, jax.tree_util.DictKey)
+        ]
+        joined = "/".join(names)
+        ndim = leaf.ndim
+        if ndim <= 1:
+            return NamedSharding(mesh, P())
+        if "embed" in joined:
+            return NamedSharding(mesh, P("fsdp", "model"))
+        if "lm_head" in joined:
+            return NamedSharding(mesh, P("fsdp", "model"))
+        if any(k in joined for k in ("q_proj", "k_proj", "v_proj")):
+            return NamedSharding(mesh, P("fsdp", "model"))  # col-parallel
+        if "o_proj" in joined:
+            return NamedSharding(mesh, P("model", "fsdp"))  # row-parallel
+        if any(k in joined for k in ("gate_proj", "up_proj")):
+            return NamedSharding(mesh, P("fsdp", "model"))
+        if "down_proj" in joined:
+            return NamedSharding(mesh, P("model", "fsdp"))
+        return NamedSharding(mesh, P("fsdp"))
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array, mask=None):
+    """Mean next-token cross entropy; logits (B,S,V), targets (B,S)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(nll)

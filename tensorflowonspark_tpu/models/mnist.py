@@ -1,0 +1,70 @@
+"""MNIST models — the canonical first example, as in the reference
+(``examples/mnist/keras/mnist_spark.py:main_fun`` built a small Keras
+dense net; SURVEY.md §2.4)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class MLP(nn.Module):
+    """512-512-10 dense net (mirror of the reference example's Keras model)."""
+
+    hidden: int = 512
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape(x.shape[0], -1).astype(self.dtype)
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        return nn.Dense(10, dtype=self.dtype)(x)
+
+
+class CNN(nn.Module):
+    """Small convnet (conv-pool x2 + dense), bf16-friendly."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (3, 3), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
+        return nn.Dense(10, dtype=self.dtype)(x)
+
+
+def loss_fn(apply_fn):
+    """Build a ``loss(params, batch)`` for batches {'image', 'label'}."""
+
+    def loss(params, batch):
+        logits = apply_fn({"params": params}, batch["image"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+
+    return loss
+
+
+def accuracy(apply_fn, params, batch) -> jax.Array:
+    logits = apply_fn({"params": params}, batch["image"])
+    return jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+
+
+def synthetic_batch(rng: jax.Array | int, batch_size: int):
+    """Deterministic fake MNIST batch (no dataset download in this env)."""
+    key = jax.random.PRNGKey(rng) if isinstance(rng, int) else rng
+    kimg, klab = jax.random.split(key)
+    return {
+        "image": jax.random.uniform(kimg, (batch_size, 28, 28, 1)),
+        "label": jax.random.randint(klab, (batch_size,), 0, 10),
+    }

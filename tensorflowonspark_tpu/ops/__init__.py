@@ -1,0 +1,10 @@
+"""Compute ops: attention kernels and friends.
+
+The reference had no kernels of its own (all math delegated to TF —
+SURVEY.md §1); here the hot ops get TPU-aware implementations: XLA-fused
+defaults plus Pallas kernels where fusion isn't enough.
+"""
+
+from tensorflowonspark_tpu.ops.attention import dot_product_attention
+
+__all__ = ["dot_product_attention"]

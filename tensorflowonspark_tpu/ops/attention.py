@@ -1,0 +1,98 @@
+"""Attention ops.
+
+``dot_product_attention`` routes to the best available implementation:
+
+- ``impl='xla'`` — plain einsum attention; XLA fuses softmax chains well
+  and this is the safest default on CPU/testing.
+- ``impl='flash'`` — the Pallas TPU flash-attention kernel from
+  :mod:`tensorflowonspark_tpu.ops.flash_attention` (blockwise online
+  softmax in VMEM; O(seq) memory).
+- ``impl='auto'`` — flash on TPU when shapes allow, else xla.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    segment_ids: jax.Array | None = None,
+) -> jax.Array:
+    """Reference attention: (B, Sq, H, D) x (B, Sk, H, D) -> (B, Sq, H, D).
+
+    Supports grouped-query attention: k/v may have fewer heads than q as
+    long as q_heads % kv_heads == 0.
+    """
+    b, sq, hq, d = q.shape
+    _, sk, hk, _ = k.shape
+    scale = (d**-0.5) if scale is None else scale
+    if hq != hk:
+        if hq % hk:
+            raise ValueError(f"q heads {hq} not divisible by kv heads {hk}")
+        k = jnp.repeat(k, hq // hk, axis=2)
+        v = jnp.repeat(v, hq // hk, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask[None, None], logits, jnp.finfo(logits.dtype).min)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(
+            seg_mask[:, None], logits, jnp.finfo(logits.dtype).min
+        )
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "impl")
+)
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    segment_ids: jax.Array | None = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Multi-head attention with optional causal masking and GQA.
+
+    Shapes: q (B, Sq, Hq, D); k/v (B, Sk, Hkv, D); returns (B, Sq, Hq, D).
+    """
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        shapes_ok = (
+            segment_ids is None
+            and q.shape[1] >= 128
+            and q.shape[1] % 128 == 0
+            and k.shape[1] % 128 == 0
+            and q.shape[3] >= 64
+        )
+        impl = "flash" if (on_tpu and shapes_ok) else "xla"
+    if impl == "flash":
+        if segment_ids is not None:
+            # The flash kernel has no segment masking yet; silently dropping
+            # it would leak attention across packed sequences.
+            impl = "xla"
+        else:
+            from tensorflowonspark_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+
+            # positional: custom_vjp functions reject keyword arguments
+            return flash_attention(q, k, v, causal, scale)
+    return _xla_attention(
+        q, k, v, causal=causal, scale=scale, segment_ids=segment_ids
+    )

@@ -1,0 +1,169 @@
+"""Pallas TPU flash attention (blockwise online-softmax forward).
+
+The kernel streams K/V blocks through VMEM against one Q block per grid
+step, keeping the O(Sq x Sk) logits matrix out of HBM entirely — the
+standard flash recipe expressed for the MXU/VPU split (matmuls in the MXU,
+the online-softmax rescale on the VPU). See /opt/skills/guides/
+pallas_guide.md for the kernel idioms used here.
+
+Round-1 scope: the forward pass is Pallas; the backward pass recomputes
+attention with the XLA implementation via ``jax.custom_vjp`` (correct, but
+O(S^2) memory in backward). A Pallas backward kernel is planned.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+# Test hook: run the kernel in the Pallas interpreter (works on CPU).
+INTERPRET = False
+
+
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int, seq_q: int,
+    causal: bool, scale: float, block_q: int
+):
+    qi = pl.program_id(1)  # q-block index
+    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
+    d = q.shape[-1]
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_k_blocks = seq_k // block_k
+    # End-aligned causal semantics (matches the XLA path's tril(k=sk-sq)):
+    # query i attends keys j <= i + (sk - sq).
+    offset = seq_k - seq_q
+    if causal:
+        # Only K blocks at or before this Q block's diagonal contribute.
+        num_live = jnp.minimum(
+            ((qi + 1) * block_q + offset + block_k - 1) // block_k,
+            num_k_blocks,
+        )
+    else:
+        num_live = num_k_blocks
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block_q, block_k)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_live, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool,
+    scale: float | None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jax.Array:
+    """(B, Sq, H, D) attention with GQA head broadcast, Pallas forward."""
+    b, sq, hq, d = q.shape
+    _, sk, hk, _ = k.shape
+    scale = (d**-0.5) if scale is None else scale
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"flash attention needs seq lengths divisible by block sizes: "
+            f"sq={sq} block_q={block_q}, sk={sk} block_k={block_k}; "
+            "pad sequences or use impl='xla'"
+        )
+    if hq % hk:
+        raise ValueError(f"q heads {hq} not divisible by kv heads {hk}")
+    if hq != hk:
+        k = jnp.repeat(k, hq // hk, axis=2)
+        v = jnp.repeat(v, hq // hk, axis=2)
+
+    # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hq, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hq, sk, d)
+
+    grid = (b * hq, sq // block_q)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel,
+        block_k=block_k,
+        seq_k=sk,
+        seq_q=sq,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, qi: (h, qi, 0)),
+            pl.BlockSpec((1, sk, d), lambda h, qi: (h, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda h, qi: (h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, qi: (h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
+        interpret=INTERPRET,
+    )(qt, kt, vt)
+    return out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    return _flash_forward(q, k, v, causal, scale)
+
+
+def _fwd(q, k, v, causal, scale):
+    return _flash_forward(q, k, v, causal, scale), (q, k, v)
+
+
+def _bwd(causal, scale, res, g):
+    from tensorflowonspark_tpu.ops.attention import _xla_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _xla_attention(q, k, v, causal=causal, scale=scale),
+        q,
+        k,
+        v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,30 @@
+"""Version shims (reference parity: ``tensorflowonspark/compat.py``).
+
+The reference papered over TF 2.0/2.1 API drift (``export_saved_model``,
+``disable_auto_shard``, ``is_gpu_available``). The rebuild's equivalents:
+
+- ``export_saved_model`` → orbax checkpoint export (the SavedModel analog)
+- ``disable_auto_shard`` → a no-op by construction: the queue feed already
+  delivers distinct per-host data, and jit+NamedSharding splits the global
+  batch by sharding, so there is no competing auto-shard machinery to turn
+  off. Kept callable so reference-shaped user code ports unchanged.
+- ``is_gpu_available`` → accelerator probe.
+"""
+
+from __future__ import annotations
+
+from tensorflowonspark_tpu.utils.device_info import (  # noqa: F401
+    is_gpu_available,
+    is_tpu_available,
+)
+
+
+def export_saved_model(state, export_dir: str, **kwargs) -> str:
+    from tensorflowonspark_tpu.compute.checkpoint import save_checkpoint
+
+    return save_checkpoint(export_dir, state, **kwargs)
+
+
+def disable_auto_shard(options=None) -> None:
+    """No-op (see module docstring); accepts and ignores tf.data options."""
+    return None

@@ -1,0 +1,64 @@
+"""Accelerator discovery/allocation helpers.
+
+Reference parity: ``tensorflowonspark/gpu_info.py`` (``get_gpus`` parsed
+nvidia-smi, randomly picked free GPUs with retries, and emitted
+``CUDA_VISIBLE_DEVICES``). On TPU there is no multi-tenant allocation race
+to dodge: libtpu owns the host's chips and hands each process its local
+set. What remains useful is discovery, visibility control for
+tests/colocated processes, and a capability probe.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+MAX_RETRIES = 3  # kept for API parity; TPU allocation does not race
+
+
+def get_gpus(num_gpu: int = 1, worker_index: int = -1) -> str:
+    """Compatibility shim for reference callers: returns a CSV of local
+    device ordinals (the string the reference put in CUDA_VISIBLE_DEVICES).
+
+    On TPU hosts this is ``TPU_VISIBLE_CHIPS`` material; on CPU it is
+    informational only.
+    """
+    devices = get_local_devices()
+    n = min(num_gpu, len(devices))
+    return ",".join(str(i) for i in range(n))
+
+
+def get_local_devices() -> list:
+    import jax
+
+    return jax.local_devices()
+
+
+def is_gpu_available() -> bool:
+    """Reference name; answers 'is an accelerator available'."""
+    return is_tpu_available()
+
+
+def is_tpu_available() -> bool:
+    import jax
+
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def set_visible_chips(chips: str | None) -> None:
+    """Restrict which TPU chips this process binds (set BEFORE jax init).
+
+    The moral replacement for the reference writing CUDA_VISIBLE_DEVICES in
+    ``TFSparkNode._mapfn``: on multi-process-per-host TPU setups each
+    process pins its chip subset.
+    """
+    if chips is None:
+        os.environ.pop("TPU_VISIBLE_CHIPS", None)
+    else:
+        os.environ["TPU_VISIBLE_CHIPS"] = chips
+        os.environ.setdefault("TPU_CHIPS_PER_PROCESS_BOUNDS", "1,1,1")

@@ -52,13 +52,8 @@ def file_reader_fn(args, ctx):
         f.write(str(sum(mine)))
 
 
-def train_linear_fn(args, ctx):
-    """A real (tiny) JAX training loop fed through the data plane.
-
-    Fits y = w*x + b on fed (x, y) records with a jitted SGD step, then the
-    chief exports the final params — the minimum end-to-end slice of
-    SURVEY.md §7 (queue → DataFeed → jit step → export).
-    """
+def _fit_linear(ctx, batch_size: int):
+    """Shared feed-loop fitting y = w*x + b with a jitted SGD step."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -68,8 +63,7 @@ def train_linear_fn(args, ctx):
     @jax.jit
     def step(params, x, y):
         def loss_fn(p):
-            pred = p["w"] * x + p["b"]
-            return jnp.mean((pred - y) ** 2)
+            return jnp.mean((p["w"] * x + p["b"] - y) ** 2)
 
         loss, g = jax.value_and_grad(loss_fn)(params)
         return {k: params[k] - 0.1 * g[k] for k in params}, loss
@@ -77,12 +71,42 @@ def train_linear_fn(args, ctx):
     params = {"w": jnp.zeros(()), "b": jnp.zeros(())}
     loss = None
     while not feed.should_stop():
-        batch = feed.next_batch(32)
+        batch = feed.next_batch(batch_size)
         if not batch:
             continue
         x = jnp.asarray(np.array([r[0] for r in batch], dtype=np.float32))
         y = jnp.asarray(np.array([r[1] for r in batch], dtype=np.float32))
         params, loss = step(params, x, y)
+    return params, loss
+
+
+def estimator_train_fn(args, ctx):
+    """TFEstimator map_fun: fit y = w*x + b on fed records, chief exports."""
+    params, _ = _fit_linear(ctx, int(args["batch_size"]))
+    ctx.export_saved_model(params, args["export_dir"])
+
+
+def estimator_export_fn(args):
+    """Rebuild (apply_fn, target_state) for TFModel.transform."""
+    import jax.numpy as jnp
+
+    def apply_fn(state, batch):
+        # jit-traced: batch is already an array (N, 1)
+        x = batch.reshape(-1).astype(jnp.float32)
+        return state["w"] * x + state["b"]
+
+    target = {"w": jnp.zeros(()), "b": jnp.zeros(())}
+    return apply_fn, target
+
+
+def train_linear_fn(args, ctx):
+    """A real (tiny) JAX training loop fed through the data plane.
+
+    Fits y = w*x + b on fed (x, y) records with a jitted SGD step, then
+    writes the result — the minimum end-to-end slice of SURVEY.md §7
+    (queue → DataFeed → jit step → export).
+    """
+    params, loss = _fit_linear(ctx, 32)
 
     out = os.path.join(args["out_dir"], f"node{ctx.executor_id}.json")
     with open(out, "w") as f:

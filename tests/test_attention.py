@@ -1,0 +1,101 @@
+"""Attention op tests: XLA path semantics + Pallas kernel numerics
+(interpreter mode on CPU; the same kernel compiles for TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.ops import flash_attention as fa
+from tensorflowonspark_tpu.ops.attention import _xla_attention, dot_product_attention
+
+
+def _qkv(b=2, sq=256, sk=256, hq=4, hk=4, d=64, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, sq, hq, d), dtype)
+    k = jax.random.normal(ks[1], (b, sk, hk, d), dtype)
+    v = jax.random.normal(ks[2], (b, sk, hk, d), dtype)
+    return q, k, v
+
+
+def test_xla_attention_causal():
+    q, k, v = _qkv(sq=8, sk=8, d=4)
+    out = _xla_attention(q, k, v, causal=True)
+    # position 0 attends only to itself: out[0] == v[0] (softmax of 1 element)
+    np.testing.assert_allclose(
+        np.asarray(out[:, 0]), np.asarray(v[:, 0]), rtol=1e-5
+    )
+
+
+def test_xla_attention_gqa():
+    q, k, v = _qkv(hq=8, hk=2, sq=16, sk=16, d=8)
+    out = _xla_attention(q, k, v)
+    assert out.shape == q.shape
+    # GQA must equal manually-repeated full MHA
+    k_full = jnp.repeat(k, 4, axis=2)
+    v_full = jnp.repeat(v, 4, axis=2)
+    ref = _xla_attention(q, k_full, v_full)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_xla(causal, monkeypatch):
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    q, k, v = _qkv()
+    out_flash = fa._flash_forward(q, k, v, causal, None)
+    out_ref = _xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_flash_gqa_matches_xla(monkeypatch):
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    q, k, v = _qkv(hq=8, hk=2)
+    out_flash = fa._flash_forward(q, k, v, True, None)
+    out_ref = _xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_flash_causal_cross_attention_alignment(monkeypatch):
+    """sq != sk causal: flash must match XLA's end-aligned tril(k=sk-sq)."""
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    q, k, v = _qkv(sq=128, sk=256)
+    out_flash = fa._flash_forward(q, k, v, True, None)
+    out_ref = _xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_flash_rejects_ragged_seq(monkeypatch):
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    q, k, v = _qkv(sq=192, sk=192)
+    with pytest.raises(ValueError, match="divisible"):
+        fa._flash_forward(q, k, v, False, None)
+
+
+def test_flash_grad_matches_xla(monkeypatch):
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    q, k, v = _qkv(sq=128, sk=128)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, True, None) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), rtol=5e-3, atol=5e-3
+        )
+
+
+def test_dot_product_attention_auto_on_cpu():
+    q, k, v = _qkv(sq=16, sk=16, d=8)
+    out = dot_product_attention(q, k, v, causal=True, impl="auto")
+    assert out.shape == q.shape

@@ -1,0 +1,91 @@
+"""Pipeline (fit/transform) and dfutil (TFRecord) tests.
+
+Reference parity: test/test_pipeline.py and test/test_dfutil.py.
+"""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.api.pipeline import Namespace, TFEstimator, TFModel
+from tensorflowonspark_tpu.utils.util import cpu_only_env
+
+from tests import cluster_fns
+
+
+def test_namespace_argv_roundtrip():
+    ns = Namespace(["--batch_size", "64", "--verbose", "--name=x"])
+    assert ns.batch_size == "64"
+    assert ns.verbose is True
+    assert ns.name == "x"
+    ns2 = Namespace({"a": 1}, b=2)
+    assert ns2.a == 1 and ns2.b == 2
+    assert "--a" in ns2.argv()
+    with pytest.raises(AttributeError):
+        _ = ns.missing
+
+
+def test_estimator_fit_transform(tmp_path):
+    """Tiny linear model: estimator trains via the cluster, model transforms."""
+    export_dir = str(tmp_path / "export")
+
+    est = TFEstimator(
+        cluster_fns.estimator_train_fn,
+        cluster_size=1,
+        epochs=4,
+        export_dir=export_dir,
+        batch_size=32,
+    )
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=256).astype(np.float32)
+    records = list(zip(x.tolist(), (3.0 * x - 1.0).tolist()))
+    model = est.fit([records[i::4] for i in range(4)], env=cpu_only_env())
+    assert isinstance(model, TFModel)
+
+    model.export_fn = cluster_fns.estimator_export_fn
+    preds = model.transform([(v,) for v in [0.0, 1.0, 2.0]])
+    preds = [float(p) for p in preds]
+    assert abs(preds[0] - (-1.0)) < 0.3
+    assert abs(preds[1] - 2.0) < 0.3
+    assert abs(preds[2] - 5.0) < 0.3
+
+
+def test_dfutil_roundtrip(tmp_path):
+    from tensorflowonspark_tpu.data import dfutil
+
+    rows = [
+        {
+            "idx": i,
+            "vec": np.arange(4, dtype=np.float32) * i,
+            "name": f"row{i}",
+            "blob": b"\x00\x01" + bytes([i]),
+        }
+        for i in range(25)
+    ]
+    schema = dfutil.infer_schema(rows[0])
+    assert schema == {
+        "idx": "int64",
+        "vec": "float",
+        "name": "bytes",
+        "blob": "bytes",
+    }
+    paths = dfutil.saveAsTFRecords(rows, str(tmp_path), records_per_file=10)
+    assert len(paths) == 3  # 25 rows / 10 per file
+
+    back = list(dfutil.loadTFRecords(str(tmp_path), binary_features=["blob"]))
+    assert len(back) == 25
+    r = back[3]
+    assert int(r["idx"]) == 3
+    np.testing.assert_allclose(r["vec"], np.arange(4, dtype=np.float32) * 3)
+    assert r["name"] == "row3"
+    assert r["blob"] == b"\x00\x01\x03"
+
+
+def test_dfutil_example_conversion():
+    from tensorflowonspark_tpu.data import dfutil
+
+    row = {"a": 7, "b": [1.5, 2.5], "s": "hi"}
+    ex = dfutil.toTFExample(row)
+    back = dfutil.fromTFExample(ex.SerializeToString())
+    assert int(back["a"]) == 7
+    np.testing.assert_allclose(back["b"], [1.5, 2.5])
+    assert back["s"] == "hi"
