@@ -55,8 +55,16 @@ def main_fun(args, ctx):
     state = TrainState.create(params, tx)
     step = build_train_step(mnist.loss_fn(model.apply), tx, mesh)
 
-    bs = args.batch_size - args.batch_size % jax.device_count()
-    loss = None
+    dc = jax.device_count()
+    bs = args.batch_size - args.batch_size % dc
+    if bs > len(labels):  # shard smaller than one batch: shrink, don't skip
+        bs = len(labels) - len(labels) % dc
+    if bs == 0:
+        raise RuntimeError(
+            f"node{ctx.executor_id}: shard of {len(labels)} records is "
+            f"smaller than the {dc}-device mesh; nothing to train on"
+        )
+    steps = 0
     for epoch in range(args.epochs):
         for start in range(0, len(labels) - bs + 1, bs):
             batch = {
@@ -64,15 +72,11 @@ def main_fun(args, ctx):
                 "label": labels[start : start + bs],
             }
             state, loss = step(state, shard_batch(mesh, batch))
-        if loss is not None:
-            print(f"node{ctx.executor_id} epoch {epoch} loss {float(loss):.4f}")
-        else:
-            print(
-                f"node{ctx.executor_id} shard smaller than batch size {bs}; "
-                "no steps run"
-            )
+            steps += 1
+        print(f"node{ctx.executor_id} epoch {epoch} loss {float(loss):.4f}")
 
     if args.model_dir:
+        assert steps > 0  # never export random-init params
         ctx.export_saved_model(jax.device_get(state.params), args.model_dir)
 
 

@@ -252,8 +252,24 @@ class TFModel(_HasParams):
         if mapping is None:
             return np.asarray(chunk)
         cols = list(mapping.keys())
+        if isinstance(chunk[0], (tuple, list)):
+            # Positional contract (reference: pipeline.py input_mapping is
+            # "ordered dict of input DataFrame column to input tensor"):
+            # the mapping's key order IS the record layout, so it must
+            # enumerate every field — a subset would silently bind fields
+            # to the wrong tensors.
+            if len(chunk[0]) != len(cols):
+                raise ValueError(
+                    f"input_mapping has {len(cols)} columns {cols} but "
+                    f"records have {len(chunk[0])} fields; for tuple "
+                    "records the mapping must name every field, in order"
+                )
+            index = {col: i for i, col in enumerate(cols)}
+            get = lambda rec, col: rec[index[col]]  # noqa: E731
+        else:
+            get = lambda rec, col: rec[col]  # noqa: E731
         return {
-            tensor: np.asarray([rec[cols.index(col)] if isinstance(rec, (tuple, list)) else rec[col] for rec in chunk])
+            tensor: np.asarray([get(rec, col) for rec in chunk])
             for col, tensor in mapping.items()
         }
 

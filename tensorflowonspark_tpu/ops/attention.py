@@ -54,9 +54,6 @@ def _xla_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("causal", "scale", "impl")
-)
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,
@@ -70,7 +67,52 @@ def dot_product_attention(
     """Multi-head attention with optional causal masking and GQA.
 
     Shapes: q (B, Sq, Hq, D); k/v (B, Sk, Hkv, D); returns (B, Sq, Hq, D).
+
+    ``impl='ring'`` runs sequence-parallel ring attention over the ambient
+    mesh's ``seq`` axis (set with ``parallel.use_mesh``); the mesh is a
+    trace-time object, so this path is dispatched outside the jit cache —
+    it is meant to be called from inside an outer jitted train step.
     """
+    if impl == "ring":
+        from tensorflowonspark_tpu.parallel import (
+            current_mesh,
+            mesh_ring_attention,
+        )
+
+        mesh = current_mesh()
+        if mesh is None:
+            raise ValueError(
+                "impl='ring' needs an ambient mesh; wrap the call (or the "
+                "train-step trace) in tensorflowonspark_tpu.parallel.use_mesh"
+            )
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "ring attention does not support segment_ids yet"
+            )
+        if mesh.shape.get("seq", 1) == 1 and mesh.shape.get("model", 1) == 1:
+            return _jitted_attention(
+                q, k, v, causal=causal, scale=scale, impl="auto"
+            )
+        return mesh_ring_attention(q, k, v, mesh, causal=causal, scale=scale)
+    return _jitted_attention(
+        q, k, v, causal=causal, scale=scale,
+        segment_ids=segment_ids, impl=impl,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "impl")
+)
+def _jitted_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    segment_ids: jax.Array | None = None,
+    impl: str = "auto",
+) -> jax.Array:
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         shapes_ok = (

@@ -1,0 +1,35 @@
+"""Parallelism layer: sequence/context, tensor, pipeline, expert.
+
+The reference had only data parallelism (SURVEY.md §2.3 — PS and
+MultiWorkerMirroredStrategy, both DP). This package is where the rebuild
+goes past parity: every strategy is expressed as shardings + XLA
+collectives over the project mesh axes (``data``, ``fsdp``, ``model``,
+``seq`` — :mod:`tensorflowonspark_tpu.compute.mesh`), so strategies
+compose by construction instead of by glue code.
+
+- :mod:`.ring_attention` — sequence/context parallelism: blockwise
+  attention with K/V blocks rotated around the ``seq`` axis ring via
+  ``ppermute`` (long-context training; SURVEY.md §5.7).
+- :mod:`.pipeline` — pipeline parallelism: stage-sharded layer stacks,
+  microbatches streamed with collective permutes.
+- :mod:`.moe` — mixture-of-experts with expert parallelism via
+  ``all_to_all`` dispatch/combine.
+- :mod:`.context` — ambient mesh plumbing so model code can reach the
+  mesh without threading it through every module attribute.
+"""
+
+from tensorflowonspark_tpu.parallel.context import (  # noqa: F401
+    current_mesh,
+    use_mesh,
+)
+from tensorflowonspark_tpu.parallel.ring_attention import (  # noqa: F401
+    mesh_ring_attention,
+    ring_attention,
+)
+
+__all__ = [
+    "current_mesh",
+    "use_mesh",
+    "ring_attention",
+    "mesh_ring_attention",
+]

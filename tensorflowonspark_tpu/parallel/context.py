@@ -1,0 +1,36 @@
+"""Ambient mesh context.
+
+Model code (flax modules) should not have to carry a ``jax.sharding.Mesh``
+in hashable module attributes just to reach a ``shard_map``; the train-step
+builder knows the mesh and publishes it here for the duration of tracing.
+
+This mirrors the role the reference's ``TF_CONFIG`` environment variable
+played (``TFSparkNode._mapfn`` writes it, strategy objects deep inside user
+code read it — SURVEY.md §3.1): ambient cluster topology, set by the
+runtime, consumed by the model layer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from jax.sharding import Mesh
+
+_state = threading.local()
+
+
+def current_mesh() -> Mesh | None:
+    """The mesh published by the innermost :func:`use_mesh`, or None."""
+    return getattr(_state, "mesh", None)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Publish ``mesh`` as the ambient mesh for code traced inside."""
+    prev = current_mesh()
+    _state.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _state.mesh = prev

@@ -1,0 +1,82 @@
+"""Tests for the parallelism layer (ring attention, TP, pipeline, MoE).
+
+All on the 8-device virtual CPU mesh from conftest — the rebuild's
+local-mode-Spark equivalent (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.compute.mesh import make_mesh
+from tensorflowonspark_tpu.ops.attention import dot_product_attention
+
+
+@pytest.fixture(scope="module")
+def mesh_seq():
+    return make_mesh({"data": 2, "seq": 4})
+
+
+class TestRingAttention:
+    def _rand(self, b=4, s=64, hq=4, hk=2, d=16):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (b, s, hq, d), jnp.float32)
+        k = jax.random.normal(ks[1], (b, s, hk, d), jnp.float32)
+        v = jax.random.normal(ks[2], (b, s, hk, d), jnp.float32)
+        return q, k, v
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_xla_attention(self, mesh_seq, causal):
+        from tensorflowonspark_tpu.parallel import mesh_ring_attention
+
+        q, k, v = self._rand()
+        ref = dot_product_attention(q, k, v, causal=causal, impl="xla")
+        out = mesh_ring_attention(q, k, v, mesh_seq, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_gradients_match(self, mesh_seq):
+        from tensorflowonspark_tpu.parallel import mesh_ring_attention
+
+        q, k, v = self._rand()
+
+        def loss_ring(q, k, v):
+            return jnp.sum(mesh_ring_attention(q, k, v, mesh_seq) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                dot_product_attention(q, k, v, causal=True, impl="xla") ** 2
+            )
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
+
+    def test_llama_with_ring_attention(self, mesh_seq):
+        """Full decoder forward with attention_impl='ring' == xla impl."""
+        from tensorflowonspark_tpu.models.llama import Llama, LlamaConfig
+        from tensorflowonspark_tpu.parallel import use_mesh
+
+        cfg_xla = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="xla")
+        cfg_ring = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="ring")
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(3), (2, 32), 0, cfg_xla.vocab_size
+        )
+        params = Llama(cfg_xla).init(jax.random.PRNGKey(0), tokens)["params"]
+        ref = Llama(cfg_xla).apply({"params": params}, tokens)
+        with use_mesh(mesh_seq):
+            out = jax.jit(
+                lambda p, t: Llama(cfg_ring).apply({"params": p}, t)
+            )(params, tokens)
+        np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+    def test_composes_with_tensor_parallel_heads(self):
+        """seq and model axes together: heads sharded, sequence ringed."""
+        from tensorflowonspark_tpu.parallel import mesh_ring_attention
+
+        mesh = make_mesh({"model": 2, "seq": 4})
+        q, k, v = self._rand(b=2, s=32, hq=4, hk=2, d=8)
+        ref = dot_product_attention(q, k, v, causal=True, impl="xla")
+        out = mesh_ring_attention(q, k, v, mesh, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
