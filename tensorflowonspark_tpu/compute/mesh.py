@@ -5,8 +5,16 @@ The mesh axes are fixed project-wide (SURVEY.md §7 step 3):
 - ``data``  — pure data parallel (replicated params, sharded batch)
 - ``fsdp``  — data parallel with sharded params/optimizer state (the TPU
   replacement for the reference's parameter servers)
-- ``model`` — tensor parallel (reserved; reference had none — §2.3)
-- ``seq``   — sequence/context parallel for ring attention (reserved, §5.7)
+- ``pipe``  — pipeline parallel: layer stages ring-scheduled with
+  collective permutes (:mod:`tensorflowonspark_tpu.parallel.pipeline`)
+- ``expert`` — expert parallel: MoE expert banks sharded across devices,
+  tokens exchanged via XLA all_to_all
+  (:mod:`tensorflowonspark_tpu.parallel.moe`)
+- ``model`` — tensor parallel (Megatron-style column/row shardings)
+- ``seq``   — sequence/context parallel for ring attention
+  (:mod:`tensorflowonspark_tpu.parallel.ring_attention`)
+
+The reference had none of these beyond plain DP (SURVEY.md §2.3).
 
 Axis *placement* determines which interconnect collectives ride: inner axes
 map to ICI within a slice, outer axes to DCN across slices — use
@@ -23,7 +31,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-MESH_AXES = ("data", "fsdp", "model", "seq")
+MESH_AXES = ("data", "fsdp", "pipe", "expert", "model", "seq")
 
 # Batch dimension shards over every data-like axis.
 BATCH_AXES = ("data", "fsdp")

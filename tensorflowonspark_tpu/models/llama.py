@@ -41,6 +41,11 @@ class LlamaConfig:
     dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"
     remat: bool = True
+    # MoE: when num_experts > 0 every block's MLP is a routed expert bank
+    # (expert-parallel over the mesh 'expert' axis — parallel/moe.py).
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
 
     @property
     def head_dim(self) -> int:
@@ -145,7 +150,23 @@ class Block(nn.Module):
             positions,
             segment_ids,
         )
-        return h + MLP(cfg, name="mlp")(
+        if cfg.num_experts > 0:
+            from tensorflowonspark_tpu.parallel.moe import MoEConfig, MoEMLP
+
+            mlp = MoEMLP(
+                MoEConfig(
+                    num_experts=cfg.num_experts,
+                    top_k=cfg.moe_top_k,
+                    capacity_factor=cfg.moe_capacity_factor,
+                    hidden_size=cfg.hidden_size,
+                    intermediate_size=cfg.intermediate_size,
+                    dtype=cfg.dtype,
+                ),
+                name="mlp",
+            )
+        else:
+            mlp = MLP(cfg, name="mlp")
+        return h + mlp(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(h)
         )
 
@@ -200,6 +221,14 @@ def llama_param_shardings(params, mesh: Mesh):
         ndim = leaf.ndim
         if ndim <= 1:
             return NamedSharding(mesh, P())
+        if ndim == 3:  # MoE expert banks (E, d, f) / (E, f, d)
+            from tensorflowonspark_tpu.parallel.moe import (
+                moe_expert_bank_spec,
+            )
+
+            return NamedSharding(mesh, moe_expert_bank_spec(joined))
+        if "router" in joined:
+            return NamedSharding(mesh, P())
         if "embed" in joined:
             return NamedSharding(mesh, P("fsdp", "model"))
         if "lm_head" in joined:
@@ -215,6 +244,25 @@ def llama_param_shardings(params, mesh: Mesh):
         return NamedSharding(mesh, P("fsdp"))
 
     return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def llama_loss_fn(model: "Llama"):
+    """Next-token loss closure ``(params, tokens(B,S+1)) -> scalar`` that
+    also collects sown auxiliary losses (the MoE router load-balancing
+    loss — ``parallel/moe.py:MoEMLP``). A bare ``model.apply`` without
+    ``mutable=['losses']`` silently discards those, so MoE configs MUST
+    train through this (or an equivalent mutable-collecting) loss."""
+
+    def loss(params, tokens):
+        logits, state = model.apply(
+            {"params": params}, tokens[:, :-1], mutable=["losses"]
+        )
+        total = cross_entropy_loss(logits, tokens[:, 1:])
+        for leaf in jax.tree.leaves(state.get("losses", {})):
+            total = total + jnp.sum(leaf)
+        return total
+
+    return loss
 
 
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array, mask=None):

@@ -22,6 +22,15 @@ from tensorflowonspark_tpu.parallel.context import (  # noqa: F401
     current_mesh,
     use_mesh,
 )
+from tensorflowonspark_tpu.parallel.moe import (  # noqa: F401
+    MoEConfig,
+    MoEMLP,
+    moe_param_shardings,
+)
+from tensorflowonspark_tpu.parallel.pipeline import (  # noqa: F401
+    gpipe,
+    stack_stages,
+)
 from tensorflowonspark_tpu.parallel.ring_attention import (  # noqa: F401
     mesh_ring_attention,
     ring_attention,
@@ -32,4 +41,9 @@ __all__ = [
     "use_mesh",
     "ring_attention",
     "mesh_ring_attention",
+    "gpipe",
+    "stack_stages",
+    "MoEConfig",
+    "MoEMLP",
+    "moe_param_shardings",
 ]

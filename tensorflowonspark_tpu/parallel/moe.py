@@ -1,0 +1,175 @@
+"""Mixture-of-experts with expert parallelism over the ``expert`` axis.
+
+GShard-style top-k routing with a fixed per-expert capacity so every shape
+is static under ``jit``: tokens are scattered into an ``(experts,
+capacity, d)`` buffer with one einsum against a dispatch mask, the expert
+FFN bank runs as a single batched matmul over the stacked expert dimension
+(one big MXU-friendly contraction, not a Python loop over experts), and a
+second einsum with the combine weights gathers results back to token order.
+
+Expert parallelism is pure sharding: the stacked expert dim of the FFN
+params and of the dispatched buffer carries ``PartitionSpec('expert')``,
+and XLA lowers the token exchange implied by resharding (tokens sharded on
+batch → buffers sharded on expert) to ``all_to_all`` over ICI. There is no
+hand-written dispatch collective to maintain.
+
+Load balancing is the standard Switch/GShard auxiliary loss
+(``aux_load_balancing_loss``): mean fraction of tokens routed to each
+expert × mean router probability per expert, × num_experts.
+
+The reference has no MoE/expert parallelism (SURVEY.md §2.3) — this is
+beyond-parity capability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    hidden_size: int = 128
+    intermediate_size: int = 256
+    dtype: jnp.dtype = jnp.bfloat16
+    router_aux_weight: float = 0.01
+
+
+def _capacity(num_tokens: int, cfg: MoEConfig) -> int:
+    # ceil, per GShard/Switch: capacity_factor=1.0 must mean "exactly
+    # enough slots under perfect balance", never fewer.
+    cap = math.ceil(
+        num_tokens * cfg.top_k * cfg.capacity_factor / cfg.num_experts
+    )
+    return max(cap, cfg.top_k)
+
+
+def top_k_routing(
+    router_logits: jax.Array, cfg: MoEConfig, num_tokens: int
+):
+    """Build dispatch mask and combine weights from router logits.
+
+    router_logits (T, E) → dispatch (T, E, C) bool-ish float, combine
+    (T, E, C) float32, aux_loss scalar. Tokens over an expert's capacity
+    are dropped (standard fixed-capacity semantics); priority is token
+    order, matching GShard/Switch.
+    """
+    t, e = router_logits.shape
+    c = _capacity(num_tokens, cfg)
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    # top-k expert choices per token
+    gate_vals, expert_idx = jax.lax.top_k(probs, cfg.top_k)  # (T, k)
+    # normalise the selected gates to sum to 1 per token
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+
+    # position of each (token, choice) within its expert's capacity buffer:
+    # cumulative count of earlier assignments to the same expert, counting
+    # across choices-major-then-token order.
+    choice_mask = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)  # (T,k,E)
+    flat_mask = choice_mask.reshape(t * cfg.top_k, e)  # choices flattened
+    pos_in_expert = jnp.cumsum(flat_mask, axis=0) - flat_mask  # (T*k, E)
+    pos = jnp.sum(pos_in_expert * flat_mask, axis=-1).reshape(t, cfg.top_k)
+    keep = pos < c  # over-capacity assignments dropped
+
+    gates = gate_vals * keep
+    # scatter into (T, E, C)
+    combine = jnp.einsum(
+        "tk,tke,tkc->tec",
+        gates,
+        jax.nn.one_hot(expert_idx, e, dtype=jnp.float32),
+        jax.nn.one_hot(jnp.where(keep, pos, 0), c, dtype=jnp.float32)
+        * keep[..., None],
+    )
+    dispatch = (combine > 0).astype(jnp.float32)
+
+    # Switch-style load-balancing aux loss on the top-1 assignment.
+    top1 = jax.nn.one_hot(expert_idx[:, 0], e, dtype=jnp.float32)
+    frac_tokens = jnp.mean(top1, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MLP block: top-k routed bank of SwiGLU experts.
+
+    Call with x (B, S, d); returns (B, S, d). Stores the aux loss with
+    ``self.sow('losses', 'router_aux', ...)`` — collect via
+    ``mutable=['losses']`` or read it from a surrounding train step.
+    """
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, s, d = x.shape
+        t = b * s
+        tokens = x.reshape(t, d)
+
+        router = nn.Dense(
+            cfg.num_experts, use_bias=False, dtype=jnp.float32,
+            name="router", kernel_init=nn.initializers.normal(0.02),
+        )
+        logits = router(tokens.astype(jnp.float32))
+        dispatch, combine, aux = top_k_routing(logits, cfg, t)
+        self.sow("losses", "router_aux", cfg.router_aux_weight * aux)
+
+        init = nn.initializers.normal(0.02)
+        e, f = cfg.num_experts, cfg.intermediate_size
+        w_gate = self.param("w_gate", init, (e, d, f))
+        w_up = self.param("w_up", init, (e, d, f))
+        w_down = self.param("w_down", init, (e, f, d))
+
+        # (T,E,C) x (T,d) -> (E,C,d): the resharding T-sharded -> E-sharded
+        # is the all_to_all dispatch.
+        xs = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(cfg.dtype), tokens.astype(cfg.dtype)
+        )
+        gate = jnp.einsum("ecd,edf->ecf", xs, w_gate.astype(cfg.dtype))
+        up = jnp.einsum("ecd,edf->ecf", xs, w_up.astype(cfg.dtype))
+        ys = jnp.einsum(
+            "ecf,efd->ecd", nn.silu(gate) * up, w_down.astype(cfg.dtype)
+        )
+        out = jnp.einsum(
+            "tec,ecd->td", combine.astype(cfg.dtype), ys
+        )
+        return out.reshape(b, s, d).astype(x.dtype)
+
+
+def moe_expert_bank_spec(param_name: str) -> P:
+    """PartitionSpec for one 3-dim expert bank leaf: stacked dim on
+    ``expert``, FFN hidden on ``model``, the remaining dim on ``fsdp``.
+
+    Single source of truth — ``llama_param_shardings`` delegates here for
+    MoE leaves, so model-level and module-level rules cannot diverge.
+    """
+    if "w_down" in param_name:  # (E, f, d)
+        return P("expert", "model", "fsdp")
+    return P("expert", "fsdp", "model")  # (E, d, f)
+
+
+def moe_param_shardings(params, mesh: Mesh):
+    """Sharding rules for an MoEMLP param tree: expert banks per
+    :func:`moe_expert_bank_spec`; the router is replicated."""
+
+    def rule(path, leaf):
+        names = "/".join(
+            p.key for p in path if isinstance(p, jax.tree_util.DictKey)
+        )
+        if leaf.ndim == 3:  # (E, d, f) or (E, f, d) expert banks
+            return NamedSharding(mesh, moe_expert_bank_spec(names))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(rule, params)

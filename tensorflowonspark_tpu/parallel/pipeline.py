@@ -1,0 +1,117 @@
+"""Pipeline parallelism — GPipe microbatch schedule over the ``pipe`` axis.
+
+Layers are grouped into ``n = mesh.shape['pipe']`` stages; each device in
+the pipe ring owns one stage's parameters (sharded on the stacked stage
+dimension, so optimizer state shards with them for free). Microbatches
+stream through the ring: every tick, each device applies its stage to its
+current activation and passes the result to the next stage with
+``jax.lax.ppermute``. After ``num_micro + n - 1`` ticks all microbatches
+have exited the last stage (the standard GPipe bubble:
+``(n-1)/(num_micro+n-1)`` idle fraction — amortised away by more
+microbatches).
+
+The whole schedule is a ``lax.scan`` — one traced tick, compiler-friendly —
+and every op is differentiable, so ``jax.grad`` through a pipelined forward
+yields the reverse schedule automatically. Each tick is wrapped in
+``jax.checkpoint`` so backward rematerialises per-tick activations rather
+than storing all of them.
+
+The reference has no pipeline parallelism (SURVEY.md §2.3); this is part of
+the rebuild's beyond-parity parallelism layer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _gpipe_local(
+    stage_params: Any,
+    microbatches: jax.Array,
+    *,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    axis_name: str,
+):
+    """Per-device GPipe schedule; call under ``shard_map``.
+
+    ``stage_params``: this stage's parameter pytree (leading stacked-stage
+    dim of size 1, squeezed here). ``microbatches``: (num_micro, mb, ...)
+    replicated along the pipe axis. Returns (num_micro, mb, ...) outputs,
+    summed-broadcast from the last stage so ``out_specs`` can replicate.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    params = jax.tree.map(lambda x: x[0], stage_params)
+    num_micro = microbatches.shape[0]
+    ticks = num_micro + n - 1
+
+    # No wraparound: the last stage's output leaves the ring.
+    perm = [(j, j + 1) for j in range(n - 1)]
+
+    act0 = jnp.zeros_like(microbatches[0])
+
+    @jax.checkpoint
+    def tick(carry, t):
+        act = carry
+        mb_idx = jnp.clip(t, 0, num_micro - 1)
+        inp = jnp.where(idx == 0, microbatches[mb_idx], act)
+        out = stage_fn(params, inp)
+        nxt = lax.ppermute(out, axis_name, perm)
+        return nxt, out
+
+    _, outs = lax.scan(tick, act0, jnp.arange(ticks, dtype=jnp.int32))
+    # Valid last-stage outputs are ticks [n-1, n-1+num_micro).
+    outs = lax.dynamic_slice_in_dim(outs, n - 1, num_micro, axis=0)
+    # Broadcast from the last stage to the whole pipe ring (other stages
+    # contribute garbage -> zero them and psum).
+    outs = jnp.where(idx == n - 1, outs, 0)
+    return lax.psum(outs, axis_name)
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    mesh: Mesh,
+    *,
+    pipe_axis: str = "pipe",
+    batch_axes: tuple[str, ...] = ("data", "fsdp"),
+) -> jax.Array:
+    """Run a stage-stacked network over microbatches, pipelined on the mesh.
+
+    - ``stage_fn(params, x) -> y``: one pipeline stage (may itself contain
+      many layers, e.g. a ``lax.scan`` over the layers it owns).
+    - ``stage_params``: pytree whose leaves have leading dim ``n_stages``
+      (== mesh.shape[pipe_axis]); sharded on ``pipe`` here.
+    - ``microbatches``: (num_micro, mb_size, ...) global array; the
+      microbatch *content* dims may additionally be sharded on
+      ``batch_axes`` (dp) / ``model`` inside ``stage_fn``'s own ops.
+
+    Returns (num_micro, mb_size, ...) outputs of the final stage.
+    """
+    param_specs = jax.tree.map(lambda _: P(pipe_axis), stage_params)
+    mb_spec = P(None, batch_axes)
+    fn = jax.shard_map(
+        functools.partial(
+            _gpipe_local, stage_fn=stage_fn, axis_name=pipe_axis
+        ),
+        mesh=mesh,
+        in_specs=(param_specs, mb_spec),
+        out_specs=mb_spec,
+        check_vma=False,
+    )
+    return fn(stage_params, microbatches)
+
+
+def stack_stages(params_per_stage: list[Any]) -> Any:
+    """Stack per-stage param pytrees into one pytree with a leading
+    stage dim (the layout ``gpipe`` shards on the ``pipe`` axis)."""
+    return jax.tree.map(
+        lambda *leaves: jnp.stack(leaves), *params_per_stage
+    )
