@@ -8,8 +8,20 @@ models are flax.linen modules designed for bf16 MXU math and mesh sharding
 """
 
 from tensorflowonspark_tpu.models import mnist  # noqa: F401
+from tensorflowonspark_tpu.models.bert import (  # noqa: F401
+    Bert,
+    BertConfig,
+    BertForClassification,
+    BertForMLM,
+    bert_param_shardings,
+)
 from tensorflowonspark_tpu.models.llama import (  # noqa: F401
     LlamaConfig,
     Llama,
     llama_param_shardings,
+)
+from tensorflowonspark_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    ResNetConfig,
+    resnet_param_shardings,
 )
