@@ -17,8 +17,10 @@ from __future__ import annotations
 
 import logging
 import os
+import pickle
 import queue as _queue
 import socket
+import threading
 import time
 import traceback
 from typing import Any, Callable
@@ -82,6 +84,20 @@ def run_node(
         maxsize=cluster_meta.get("queue_maxsize", tf_manager.DEFAULT_MAXSIZE),
     )
 
+    # 1b. same-host feed fast path: a shared-memory ring that co-located
+    #     feeders use instead of the TCP manager proxy (the reference's
+    #     per-item pickle+socket put was its dominant feed overhead —
+    #     SURVEY.md §3.2). A drain thread forwards ring records into the
+    #     in-process queues so consumers (DataFeed) are oblivious.
+    ring_name = None
+    if cluster_meta.get("use_shm_ring", True):
+        ring_name = _start_ring_drain(
+            str(cluster_meta.get("id", "c")),
+            executor_id,
+            mgr,
+            capacity=int(cluster_meta.get("shm_ring_mb", 64)) * 1024 * 1024,
+        )
+
     # 2. reserve a port: the chief's becomes the jax.distributed coordinator
     #    address (replaces the reference's TF server port in TF_CONFIG)
     port = util.find_free_port()
@@ -106,6 +122,7 @@ def run_node(
             "tb_port": tb_port,
             "tb_pid": tb_pid,
             "pid": os.getpid(),
+            "shm_ring": ring_name,
         }
     )
     cluster_info = client.await_reservations(
@@ -170,6 +187,93 @@ def _await_stop(mgr, timeout: float) -> None:
     logger.warning("node linger timeout (%ss) without STOP; exiting", timeout)
 
 
+def _start_ring_drain(
+    cluster_id: str, executor_id: int, mgr, capacity: int
+) -> str | None:
+    """Create this node's shm ring and start the drain thread.
+
+    Ring records are pickled ``(qname, payload)`` tuples; the drain thread
+    forwards each payload into the named in-process queue (bounded, so
+    queue backpressure propagates to the ring and from there to the
+    producer's ``push`` timeout). Returns the ring name to advertise in
+    the reservation roster, or None when native support is unavailable.
+    """
+    try:
+        from tensorflowonspark_tpu.native.shmring import ShmRing, available
+    except Exception:  # pragma: no cover - import guard
+        return None
+    if not available():
+        return None
+    name = f"/tfos_{cluster_id[:12]}_{executor_id}"
+    try:
+        ring = ShmRing.create(name, capacity)
+    except OSError as e:
+        logger.warning("shm ring unavailable (%s); TCP feed only", e)
+        return None
+    # The segment must not outlive this node process even if no producer
+    # ever attaches (close() is idempotent and unlinks as owner).
+    import atexit
+
+    atexit.register(ring.close)
+
+    def drain() -> None:
+        try:
+            while True:
+                try:
+                    data = ring.pop(timeout=1.0)
+                except TimeoutError:
+                    continue
+                if data is None:  # producer closed and ring drained
+                    return
+                qname, payload = pickle.loads(data)
+                mgr.get_queue(qname).put(payload)
+        except Exception:
+            # Ferry the real error to the driver; dying silently would
+            # surface as an opaque feed timeout on the producer side.
+            tb = traceback.format_exc()
+            logger.error("ring drain failed:\n%s", tb)
+            try:
+                mgr.get_queue("error").put(
+                    {"executor_id": executor_id, "traceback": tb}, timeout=10
+                )
+            except _queue.Full:
+                pass
+        finally:
+            ring.close()
+
+    threading.Thread(target=drain, daemon=True, name="ring-drain").start()
+    logger.info("shm ring %s ready (%d MiB)", name, capacity // (1024 * 1024))
+    return name
+
+
+# Producer-side cache: one ring handle per advertised name, shared by all
+# driver threads so pushes are serialized by the handle's lock.
+_ring_cache: dict[str, Any] = {}
+_ring_cache_lock = threading.Lock()
+
+
+def _node_ring(node: dict[str, Any] | None):
+    """Return an attached ShmRing for a co-located node, else None."""
+    if not node or not node.get("shm_ring"):
+        return None
+    try:
+        from tensorflowonspark_tpu.native.shmring import ShmRing, available
+    except Exception:  # pragma: no cover - import guard
+        return None
+    if not available() or node["host"] != util.get_ip_address():
+        return None
+    name = node["shm_ring"]
+    with _ring_cache_lock:
+        ring = _ring_cache.get(name)
+        if ring is None:
+            try:
+                ring = ShmRing.open(name)
+            except OSError:
+                return None
+            _ring_cache[name] = ring
+        return ring
+
+
 def _maybe_start_tensorboard(log_dir: str | None) -> tuple[int | None, int]:
     """Spawn a tensorboard subprocess if the binary exists (chief only).
 
@@ -210,12 +314,16 @@ def feed_partition(
     feed_timeout: float = 600.0,
     qname: str = "input",
     chunk: int = FEED_CHUNK,
+    node: dict[str, Any] | None = None,
 ) -> int:
     """Push one data partition into a node's input queue, chunked.
 
-    Returns the number of records fed (0 if the node is terminating and the
-    partition was skipped). Raises TimeoutError if the consumer stopped
-    pulling (reference: "Timeout while feeding partition").
+    Pass the node's roster entry via ``node`` to enable the shared-memory
+    fast path when the feeder is co-located with the node; otherwise (or
+    when native support is missing) chunks go through the TCP manager
+    proxy. Returns the number of records fed (0 if the node is terminating
+    and the partition was skipped). Raises TimeoutError if the consumer
+    stopped pulling (reference: "Timeout while feeding partition").
     """
     if str(mgr.get("state")) == "terminating":
         # Early-stop path: consume and discard remaining partitions
@@ -223,21 +331,39 @@ def feed_partition(
         for _ in partition:
             pass
         return 0
-    q = mgr.get_queue(qname)
+    ring = _node_ring(node)
+    if ring is not None:
+
+        def put(obj, _cap=ring.capacity):
+            payload = pickle.dumps((qname, obj), protocol=pickle.HIGHEST_PROTOCOL)
+            if len(payload) + 4 > _cap and isinstance(obj, list) and len(obj) > 1:
+                # Chunk pickles bigger than the whole ring (huge records):
+                # split recursively so the fast path keeps working. The TCP
+                # path has no such limit, but mixing paths mid-partition
+                # would break record ordering.
+                mid = len(obj) // 2
+                put(obj[:mid], _cap)
+                put(obj[mid:], _cap)
+                return
+            ring.push(payload, timeout=feed_timeout)
+
+    else:
+        q = mgr.get_queue(qname)
+        put = lambda obj: q.put(obj, timeout=feed_timeout)  # noqa: E731
     count = 0
     buf: list[Any] = []
     try:
         for item in partition:
             buf.append(item)
             if len(buf) >= chunk:
-                q.put(buf, timeout=feed_timeout)
+                put(buf)
                 count += len(buf)
                 buf = []
         if buf:
-            q.put(buf, timeout=feed_timeout)
+            put(buf)
             count += len(buf)
-        q.put(EndPartition(), timeout=feed_timeout)
-    except _queue.Full:
+        put(EndPartition())
+    except (_queue.Full, TimeoutError):
         raise TimeoutError(
             f"timeout while feeding partition (feed_timeout={feed_timeout}s); "
             "consumer appears to have stopped pulling"
@@ -298,15 +424,34 @@ def shutdown_node(node: dict[str, Any], queues=("input",)) -> None:
     state = str(mgr.get("state"))
     if state == "running":
         mgr.set("state", "terminating")
+    # If this driver fed the node through the shm ring, the EndOfFeed must
+    # travel the same path (behind any in-flight data) or it could overtake
+    # records still sitting in the ring.
+    ring = _ring_cache.get(node.get("shm_ring") or "")
     for qname in queues:
         try:
-            mgr.get_queue(qname).put(EndOfFeed(), timeout=30)
-        except _queue.Full:
+            if ring is not None:
+                ring.push(
+                    pickle.dumps(
+                        (qname, EndOfFeed()), protocol=pickle.HIGHEST_PROTOCOL
+                    ),
+                    timeout=30,
+                )
+            else:
+                mgr.get_queue(qname).put(EndOfFeed(), timeout=30)
+        except (_queue.Full, TimeoutError):
             logger.warning(
                 "could not push EndOfFeed to node %s queue %s (full)",
                 node["executor_id"],
                 qname,
             )
+    if ring is not None:
+        ring.close_write()
+        # Drop the producer handle: keeping it mapped would pin the (now
+        # unlinked) segment's pages for the driver's whole lifetime.
+        with _ring_cache_lock:
+            _ring_cache.pop(node.get("shm_ring"), None)
+        ring.close()
     mgr.get_queue("control").put(STOP)
 
 

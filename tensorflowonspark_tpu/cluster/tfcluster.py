@@ -109,7 +109,11 @@ class TFCluster:
                 mgr = tfnode_runtime.connect_manager(workers[widx])
                 for part in assignments[widx]:
                     tfnode_runtime.feed_partition(
-                        mgr, part, feed_timeout=feed_timeout, qname=qname
+                        mgr,
+                        part,
+                        feed_timeout=feed_timeout,
+                        qname=qname,
+                        node=workers[widx],
                     )
             except BaseException as e:  # noqa: BLE001 - ferried to caller
                 errors.append(e)
@@ -152,7 +156,11 @@ class TFCluster:
                 for pidx in range(widx, len(partitions), len(workers)):
                     part = list(partitions[pidx])
                     fed = tfnode_runtime.feed_partition(
-                        mgr, part, feed_timeout=feed_timeout, qname=qname
+                        mgr,
+                        part,
+                        feed_timeout=feed_timeout,
+                        qname=qname,
+                        node=workers[widx],
                     )
                     out = tfnode_runtime.collect_results(
                         mgr, fed, timeout=feed_timeout
@@ -272,6 +280,8 @@ def run(
     distributed: bool = False,
     queue_maxsize: int = 1024,
     env: dict[str, str] | None = None,
+    use_shm_ring: bool = True,
+    shm_ring_mb: int = 64,
 ) -> TFCluster:
     """Start a cluster and return its handle.
 
@@ -328,6 +338,9 @@ def run(
         "distributed": distributed,
         "queue_maxsize": queue_maxsize,
         "manager_mode": "remote",
+        # Ring only pays off when a feeder will attach, i.e. SPARK mode.
+        "use_shm_ring": use_shm_ring and input_mode == InputMode.SPARK,
+        "shm_ring_mb": shm_ring_mb,
     }
     logger.info(
         "starting cluster %s: %d nodes, template %s",

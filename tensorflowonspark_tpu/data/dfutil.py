@@ -120,7 +120,8 @@ def saveAsTFRecords(
     """Write rows as sharded TFRecord files (reference: ``saveAsTFRecords``,
     which used ``saveAsNewAPIHadoopFile`` + ``TFRecordFileOutputFormat``).
     Returns the shard paths (``part-rNNNNN`` naming, like the connector)."""
-    tf = _tf()
+    from tensorflowonspark_tpu.native.tfrecord import TFRecordWriter
+
     os.makedirs(output_dir, exist_ok=True)
     paths: list[str] = []
     writer = None
@@ -136,7 +137,10 @@ def saveAsTFRecords(
                     output_dir, f"part-r-{len(paths):05d}.tfrecord"
                 )
                 paths.append(path)
-                writer = tf.io.TFRecordWriter(path)
+                # Record framing by the in-repo C++ codec (the reference
+                # delegated it to the tensorflow-hadoop jar); Example
+                # protos still come from TF via toTFExample.
+                writer = TFRecordWriter(path)
                 count = 0
             writer.write(toTFExample(row, schema).SerializeToString())
             count += 1
@@ -150,7 +154,8 @@ def loadTFRecords(
     input_dir: str, binary_features: Sequence[str] = ()
 ) -> Iterator[dict[str, Any]]:
     """Iterate dict rows from TFRecord files (reference: ``loadTFRecords``)."""
-    tf = _tf()
+    from tensorflowonspark_tpu.native.tfrecord import read_records
+
     pattern = (
         input_dir
         if any(ch in input_dir for ch in "*?[")
@@ -161,6 +166,6 @@ def loadTFRecords(
     )
     if not files:
         raise FileNotFoundError(f"no TFRecord files under {input_dir}")
-    ds = tf.data.TFRecordDataset(files)
-    for serialized in ds.as_numpy_iterator():
-        yield fromTFExample(serialized, binary_features)
+    for path in files:
+        for serialized in read_records(path):
+            yield fromTFExample(serialized, binary_features)

@@ -1,0 +1,64 @@
+// CRC32C (Castagnoli) — needed for TFRecord framing (each record carries
+// masked crc32c checksums of its length header and payload).
+//
+// Hardware path: SSE4.2 crc32 instruction when compiled with -msse4.2;
+// portable slicing table fallback otherwise. From-scratch implementation
+// (the reference delegated record checksums to the Java
+// tensorflow-hadoop connector — SURVEY.md §2.2).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+#if defined(__SSE4_2__)
+#include <nmmintrin.h>
+#endif
+
+namespace tfos_native {
+
+namespace detail {
+
+// Generate the CRC32C lookup table at first use (reflected poly 0x82F63B78).
+inline const uint32_t* crc32c_table() {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+      table[i] = c;
+    }
+    init = true;
+  }
+  return table;
+}
+
+}  // namespace detail
+
+inline uint32_t crc32c(const void* data, size_t n, uint32_t seed = 0) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  uint32_t crc = ~seed;
+#if defined(__SSE4_2__)
+  while (n >= 8) {
+    uint64_t v;
+    __builtin_memcpy(&v, p, 8);
+    crc = static_cast<uint32_t>(_mm_crc32_u64(crc, v));
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = _mm_crc32_u8(crc, *p++);
+#else
+  const uint32_t* table = detail::crc32c_table();
+  while (n--) crc = table[(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+#endif
+  return ~crc;
+}
+
+// TFRecord "masked" crc: rotate right 15 and add a constant, so checksums
+// of checksums don't collide with data checksums.
+inline uint32_t masked_crc32c(const void* data, size_t n) {
+  uint32_t crc = crc32c(data, n);
+  return ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+}
+
+}  // namespace tfos_native

@@ -1,0 +1,233 @@
+// Shared-memory SPSC ring buffer — C ABI for ctypes.
+//
+// The feed data plane's same-host fast path. The reference's hot loop
+// paid a pickle+socket proxy call per queue op (SURVEY.md §3.2 calls this
+// "the dominant overhead of the whole design"); here a co-located
+// producer (feeder task) streams length-prefixed byte records through
+// POSIX shared memory to the node process, with no syscalls on the data
+// path (mmap'd memory + atomics; short sleeps only when full/empty).
+//
+// Layout: a 128-byte header followed by a power-of-two-free byte region
+// of `capacity` bytes. `head`/`tail` are monotonic byte offsets
+// (position = offset % capacity); records are a 4-byte little-endian
+// length + payload byte stream that wraps modularly, so no space is lost
+// at the end of the region and no wrap markers are needed.
+//
+// Contract: exactly one producer thread and one consumer thread at a
+// time (the Python wrapper serializes concurrent users per handle).
+// The consumer creates+owns the segment (shmring_create + shmring_unlink);
+// the producer attaches by name (shmring_open).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <new>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x54464f535f52494eULL;  // "TFOS_RIN"
+
+struct alignas(64) Header {
+  uint64_t magic;
+  uint64_t capacity;
+  alignas(64) std::atomic<uint64_t> head;    // producer-advanced
+  alignas(64) std::atomic<uint64_t> tail;    // consumer-advanced
+  alignas(64) std::atomic<uint32_t> closed;  // producer done writing
+};
+
+struct Ring {
+  Header* hdr;
+  uint8_t* data;
+  size_t map_len;
+  int fd;
+  bool owner;
+};
+
+constexpr int kOk = 0;
+constexpr int kTimeout = -1;
+constexpr int kClosed = -2;
+constexpr int kTooBig = -3;
+constexpr int kError = -4;
+
+int64_t now_ms() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000LL + ts.tv_nsec / 1000000LL;
+}
+
+void backoff(int iter) {
+  if (iter < 64) return;  // pure spin first
+  timespec ts{0, iter < 1024 ? 50'000 : 500'000};  // 50us then 500us
+  nanosleep(&ts, nullptr);
+}
+
+// Copy n bytes into the ring at byte-offset `off` (modular).
+void ring_write(Ring* r, uint64_t off, const uint8_t* src, uint64_t n) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t pos = off % cap;
+  uint64_t first = n < cap - pos ? n : cap - pos;
+  std::memcpy(r->data + pos, src, first);
+  if (n > first) std::memcpy(r->data, src + first, n - first);
+}
+
+void ring_read(Ring* r, uint64_t off, uint8_t* dst, uint64_t n) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t pos = off % cap;
+  uint64_t first = n < cap - pos ? n : cap - pos;
+  std::memcpy(dst, r->data + pos, first);
+  if (n > first) std::memcpy(dst + first, r->data, n - first);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* shmring_create(const char* name, uint64_t capacity) {
+  size_t map_len = sizeof(Header) + capacity;
+  shm_unlink(name);  // stale segment from a crashed prior run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(map_len)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  Header* hdr = new (mem) Header();
+  hdr->capacity = capacity;
+  hdr->head.store(0);
+  hdr->tail.store(0);
+  hdr->closed.store(0);
+  hdr->magic = kMagic;  // last: flags segment as initialized
+  return new Ring{hdr, static_cast<uint8_t*>(mem) + sizeof(Header), map_len, fd, true};
+}
+
+void* shmring_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < static_cast<off_t>(sizeof(Header))) {
+    close(fd);
+    return nullptr;
+  }
+  size_t map_len = static_cast<size_t>(st.st_size);
+  void* mem = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Header* hdr = static_cast<Header*>(mem);
+  if (hdr->magic != kMagic || sizeof(Header) + hdr->capacity != map_len) {
+    munmap(mem, map_len);
+    close(fd);
+    return nullptr;
+  }
+  return new Ring{hdr, static_cast<uint8_t*>(mem) + sizeof(Header), map_len, fd, false};
+}
+
+// Append one record. Blocks while the ring lacks space, up to timeout_ms
+// (-1 = wait forever). 0 on success.
+int shmring_push(void* handle, const uint8_t* data, uint64_t len,
+                 int64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(handle);
+  uint64_t need = 4 + len;
+  uint64_t cap = r->hdr->capacity;
+  // The on-wire length prefix is 4 bytes: guard the uint32 cast too.
+  if (need > cap || len > UINT32_MAX - 4) return kTooBig;
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+  int iter = 0;
+  while (cap - (head - r->hdr->tail.load(std::memory_order_acquire)) < need) {
+    if (r->hdr->closed.load(std::memory_order_relaxed)) return kClosed;
+    if (deadline >= 0 && now_ms() > deadline) return kTimeout;
+    backoff(iter++);
+  }
+  uint32_t len32 = static_cast<uint32_t>(len);
+  uint8_t lenbuf[4];
+  std::memcpy(lenbuf, &len32, 4);
+  ring_write(r, head, lenbuf, 4);
+  ring_write(r, head + 4, data, len);
+  r->hdr->head.store(head + need, std::memory_order_release);
+  return kOk;
+}
+
+// Wait for a record; returns its payload length without consuming it.
+// kTimeout / kClosed (closed AND drained) otherwise.
+int64_t shmring_peek_len(void* handle, int64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(handle);
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  int iter = 0;
+  while (r->hdr->head.load(std::memory_order_acquire) - tail < 4) {
+    if (r->hdr->closed.load(std::memory_order_acquire) &&
+        r->hdr->head.load(std::memory_order_acquire) == tail)
+      return kClosed;
+    if (deadline >= 0 && now_ms() > deadline) return kTimeout;
+    backoff(iter++);
+  }
+  uint8_t lenbuf[4];
+  ring_read(r, tail, lenbuf, 4);
+  uint32_t len32;
+  std::memcpy(&len32, lenbuf, 4);
+  return static_cast<int64_t>(len32);
+}
+
+// Consume the record previously sized by shmring_peek_len into `out`
+// (cap must be >= its length). Returns the length, or kError on misuse.
+int64_t shmring_pop(void* handle, uint8_t* out, uint64_t out_cap) {
+  Ring* r = static_cast<Ring*>(handle);
+  uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  if (r->hdr->head.load(std::memory_order_acquire) - tail < 4) return kError;
+  uint8_t lenbuf[4];
+  ring_read(r, tail, lenbuf, 4);
+  uint32_t len32;
+  std::memcpy(&len32, lenbuf, 4);
+  if (len32 > out_cap) return kTooBig;
+  ring_read(r, tail + 4, out, len32);
+  r->hdr->tail.store(tail + 4 + len32, std::memory_order_release);
+  return static_cast<int64_t>(len32);
+}
+
+void shmring_close_write(void* handle) {
+  static_cast<Ring*>(handle)->hdr->closed.store(1, std::memory_order_release);
+}
+
+int shmring_is_closed(void* handle) {
+  return static_cast<Ring*>(handle)->hdr->closed.load(std::memory_order_acquire)
+             ? 1
+             : 0;
+}
+
+uint64_t shmring_capacity(void* handle) {
+  return static_cast<Ring*>(handle)->hdr->capacity;
+}
+
+// Bytes currently buffered (diagnostics / tests).
+uint64_t shmring_size(void* handle) {
+  Ring* r = static_cast<Ring*>(handle);
+  return r->hdr->head.load(std::memory_order_acquire) -
+         r->hdr->tail.load(std::memory_order_acquire);
+}
+
+void shmring_detach(void* handle) {
+  Ring* r = static_cast<Ring*>(handle);
+  munmap(r->hdr, r->map_len);
+  close(r->fd);
+  delete r;
+}
+
+int shmring_unlink(const char* name) { return shm_unlink(name); }
+
+}  // extern "C"

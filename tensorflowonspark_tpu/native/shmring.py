@@ -1,0 +1,137 @@
+"""ctypes bindings for the shared-memory ring buffer (``shmring.cc``).
+
+The feed plane's same-host fast path: a co-located producer streams
+pickled record chunks through POSIX shm instead of the TCP manager proxy
+(the reference's per-item proxied ``queue.put`` — SURVEY.md §3.2).
+
+Ownership: the CONSUMER side (node process) creates the segment and
+advertises its name in the reservation roster; producers attach by name.
+One producer and one consumer at a time (per-handle locks serialize
+threads within a process; the cluster feed plane already guarantees one
+feeder per node).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+from tensorflowonspark_tpu.native import load_library
+
+DEFAULT_CAPACITY = 64 * 1024 * 1024
+_TIMEOUT = -1
+_CLOSED = -2
+_TOO_BIG = -3
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+class ShmRing:
+    """One endpoint of a shared-memory ring (see module docstring)."""
+
+    def __init__(self, name: str, *, handle, owner: bool):
+        self._lib = load_library()
+        self.name = name
+        self._h = handle
+        self._owner = owner
+        self._lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @classmethod
+    def create(cls, name: str, capacity: int = DEFAULT_CAPACITY) -> "ShmRing":
+        lib = load_library()
+        if lib is None:
+            raise OSError("native library unavailable")
+        h = lib.shmring_create(name.encode(), capacity)
+        if not h:
+            raise OSError(f"shmring_create({name!r}) failed")
+        return cls(name, handle=h, owner=True)
+
+    @classmethod
+    def open(cls, name: str) -> "ShmRing":
+        lib = load_library()
+        if lib is None:
+            raise OSError("native library unavailable")
+        h = lib.shmring_open(name.encode())
+        if not h:
+            raise OSError(f"shmring_open({name!r}) failed")
+        return cls(name, handle=h, owner=False)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._h is None:
+                return
+            self._lib.shmring_detach(self._h)
+            self._h = None
+            if self._owner:
+                self._lib.shmring_unlink(self.name.encode())
+
+    def __del__(self):  # best-effort cleanup of the shm segment
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- producer ------------------------------------------------------------
+
+    def push(self, record: bytes, timeout: float | None = None) -> None:
+        """Append one record; raises TimeoutError / BrokenPipeError /
+        ValueError (record larger than the whole ring)."""
+        ms = -1 if timeout is None else int(timeout * 1000)
+        with self._lock:
+            if self._h is None:
+                raise BrokenPipeError("shmring detached")
+            rc = self._lib.shmring_push(self._h, record, len(record), ms)
+        if rc == 0:
+            return
+        if rc == _TIMEOUT:
+            raise TimeoutError(f"shmring push timed out after {timeout}s")
+        if rc == _CLOSED:
+            raise BrokenPipeError("shmring closed")
+        if rc == _TOO_BIG:
+            raise ValueError(f"record of {len(record)}B exceeds ring capacity")
+        raise OSError(f"shmring_push failed: {rc}")
+
+    def close_write(self) -> None:
+        """Producer EOF: consumers drain the ring then see StopIteration."""
+        with self._lock:
+            if self._h is not None:
+                self._lib.shmring_close_write(self._h)
+
+    # -- consumer ------------------------------------------------------------
+
+    def pop(self, timeout: float | None = None) -> bytes | None:
+        """Next record; None when the producer closed and the ring drained;
+        TimeoutError on timeout."""
+        ms = -1 if timeout is None else int(timeout * 1000)
+        with self._lock:
+            if self._h is None:
+                return None
+            n = self._lib.shmring_peek_len(self._h, ms)
+            if n == _CLOSED:
+                return None
+            if n == _TIMEOUT:
+                raise TimeoutError(f"shmring pop timed out after {timeout}s")
+            if n < 0:
+                raise OSError(f"shmring_peek_len failed: {n}")
+            buf = (ctypes.c_uint8 * n)()
+            got = self._lib.shmring_pop(self._h, buf, n)
+            if got != n:
+                raise OSError(f"shmring_pop failed: {got}")
+            return bytes(buf)
+
+    def size(self) -> int:
+        with self._lock:
+            if self._h is None:
+                return 0
+            return int(self._lib.shmring_size(self._h))
+
+    @property
+    def capacity(self) -> int:
+        with self._lock:
+            if self._h is None:
+                return 0
+            return int(self._lib.shmring_capacity(self._h))

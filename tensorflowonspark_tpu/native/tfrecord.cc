@@ -1,0 +1,122 @@
+// Native TFRecord codec — C ABI for ctypes.
+//
+// Replaces the reference's Java record-I/O path (the tensorflow-hadoop /
+// spark-tensorflow-connector jar consumed by tensorflowonspark/dfutil.py —
+// SURVEY.md §2.2) with an in-repo C++ reader/writer, so record framing
+// does not round-trip through tf.io on the hot path.
+//
+// Format (per record): uint64le length | uint32le masked_crc(length bytes)
+//                      | payload | uint32le masked_crc(payload).
+//
+// API contract (see native/tfrecord.py):
+//  - writer: open -> append* -> flush/close. append is buffered (fwrite).
+//  - reader: open -> next* -> close. next returns a pointer into an
+//    internal buffer valid until the following next/close. Returns the
+//    payload length, 0 on clean EOF, negative on framing/crc errors.
+// Thread safety: one handle per thread (same as FILE*).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include "crc32c.h"
+
+using tfos_native::masked_crc32c;
+
+namespace {
+
+struct Writer {
+  FILE* f;
+};
+
+struct Reader {
+  FILE* f;
+  std::vector<uint8_t> buf;
+};
+
+constexpr int kErrIo = -1;
+constexpr int kErrCorruptHeader = -2;
+constexpr int kErrCorruptData = -3;
+constexpr int kErrTruncated = -4;
+
+}  // namespace
+
+extern "C" {
+
+void* tfr_writer_open(const char* path) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return nullptr;
+  return new Writer{f};
+}
+
+// Returns 0 on success, kErrIo on write failure.
+int tfr_writer_append(void* handle, const uint8_t* data, uint64_t len) {
+  Writer* w = static_cast<Writer*>(handle);
+  uint8_t header[12];
+  std::memcpy(header, &len, 8);  // x86_64: already little-endian
+  uint32_t len_crc = masked_crc32c(header, 8);
+  std::memcpy(header + 8, &len_crc, 4);
+  uint32_t data_crc = masked_crc32c(data, len);
+  if (std::fwrite(header, 1, 12, w->f) != 12) return kErrIo;
+  if (len && std::fwrite(data, 1, len, w->f) != len) return kErrIo;
+  if (std::fwrite(&data_crc, 1, 4, w->f) != 4) return kErrIo;
+  return 0;
+}
+
+int tfr_writer_flush(void* handle) {
+  return std::fflush(static_cast<Writer*>(handle)->f) == 0 ? 0 : kErrIo;
+}
+
+int tfr_writer_close(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  int rc = std::fclose(w->f) == 0 ? 0 : kErrIo;
+  delete w;
+  return rc;
+}
+
+void* tfr_reader_open(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  return new Reader{f, {}};
+}
+
+// Reads the next record. *out points into an internal buffer valid until
+// the next call. Returns payload length (>= 0... 0-length payloads are
+// reported via *ok=1 with return 0), clean EOF via *ok=0 with return 0,
+// negative on error.
+int64_t tfr_reader_next(void* handle, const uint8_t** out, int* ok) {
+  Reader* r = static_cast<Reader*>(handle);
+  *ok = 0;
+  *out = nullptr;
+  uint8_t header[12];
+  size_t got = std::fread(header, 1, 12, r->f);
+  if (got == 0 && std::feof(r->f)) return 0;  // clean EOF
+  if (got != 12) return kErrTruncated;
+  uint64_t len;
+  uint32_t len_crc;
+  std::memcpy(&len, header, 8);
+  std::memcpy(&len_crc, header + 8, 4);
+  if (masked_crc32c(header, 8) != len_crc) return kErrCorruptHeader;
+  r->buf.resize(len);
+  if (len && std::fread(r->buf.data(), 1, len, r->f) != len) return kErrTruncated;
+  uint32_t data_crc;
+  if (std::fread(&data_crc, 1, 4, r->f) != 4) return kErrTruncated;
+  if (masked_crc32c(r->buf.data(), len) != data_crc) return kErrCorruptData;
+  *out = r->buf.data();
+  *ok = 1;
+  return static_cast<int64_t>(len);
+}
+
+void tfr_reader_close(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  std::fclose(r->f);
+  delete r;
+}
+
+uint32_t tfr_masked_crc32c(const uint8_t* data, uint64_t len) {
+  return masked_crc32c(data, len);
+}
+
+}  // extern "C"

@@ -1,0 +1,143 @@
+"""ctypes bindings for the native TFRecord codec (``tfrecord.cc``), with a
+pure-Python fallback so record I/O never requires the C++ toolchain.
+
+Reference parity: record framing done by the tensorflow-hadoop connector
+jar (SURVEY.md §2.2); surfaced through :mod:`..data.dfutil`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from collections.abc import Iterator
+
+from tensorflowonspark_tpu.native import load_library
+
+_ERRORS = {
+    -1: "I/O error",
+    -2: "corrupt length header (crc mismatch)",
+    -3: "corrupt payload (crc mismatch)",
+    -4: "truncated record",
+}
+
+
+class TFRecordWriter:
+    """Write length+crc framed records. ``native`` property says which path."""
+
+    def __init__(self, path: str):
+        self._lib = load_library()
+        self._path = path
+        if self._lib is not None:
+            self._h = self._lib.tfr_writer_open(path.encode())
+            if not self._h:
+                raise OSError(f"cannot open {path!r} for writing")
+            self._f = None
+        else:
+            self._h = None
+            self._f = open(path, "wb")
+
+    @property
+    def native(self) -> bool:
+        return self._h is not None
+
+    def write(self, record: bytes) -> None:
+        if self._h is not None:
+            rc = self._lib.tfr_writer_append(self._h, record, len(record))
+            if rc != 0:
+                raise OSError(f"write failed: {_ERRORS.get(rc, rc)}")
+        else:
+            header = struct.pack("<Q", len(record))
+            self._f.write(header)
+            self._f.write(struct.pack("<I", _py_masked_crc(header)))
+            self._f.write(record)
+            self._f.write(struct.pack("<I", _py_masked_crc(record)))
+
+    def flush(self) -> None:
+        if self._h is not None:
+            self._lib.tfr_writer_flush(self._h)
+        else:
+            self._f.flush()
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.tfr_writer_close(self._h)
+            self._h = None
+        elif self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_records(path: str) -> Iterator[bytes]:
+    """Yield record payloads from one TFRecord file (native or fallback)."""
+    lib = load_library()
+    if lib is None:
+        yield from _py_read_records(path)
+        return
+    h = lib.tfr_reader_open(path.encode())
+    if not h:
+        raise OSError(f"cannot open {path!r}")
+    try:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        ok = ctypes.c_int()
+        while True:
+            n = lib.tfr_reader_next(h, ctypes.byref(out), ctypes.byref(ok))
+            if n < 0:
+                raise OSError(f"{path}: {_ERRORS.get(n, n)}")
+            if not ok.value:
+                return
+            yield ctypes.string_at(out, n)
+    finally:
+        lib.tfr_reader_close(h)
+
+
+# --- pure-Python fallback ---------------------------------------------------
+
+_CRC_TABLE: list[int] | None = None
+
+
+def _crc32c_py(data: bytes) -> int:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (0x82F63B78 ^ (c >> 1)) if c & 1 else (c >> 1)
+            table.append(c)
+        _CRC_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _py_masked_crc(data: bytes) -> int:
+    crc = _crc32c_py(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _py_read_records(path: str) -> Iterator[bytes]:
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if not header:
+                return
+            if len(header) != 12:
+                raise OSError(f"{path}: truncated record")
+            (length,) = struct.unpack("<Q", header[:8])
+            (len_crc,) = struct.unpack("<I", header[8:])
+            if _py_masked_crc(header[:8]) != len_crc:
+                raise OSError(f"{path}: corrupt length header (crc mismatch)")
+            payload = f.read(length)
+            tail = f.read(4)
+            if len(payload) != length or len(tail) != 4:
+                raise OSError(f"{path}: truncated record")
+            if _py_masked_crc(payload) != struct.unpack("<I", tail)[0]:
+                raise OSError(f"{path}: corrupt payload (crc mismatch)")
+            yield payload

@@ -117,3 +117,17 @@ def train_linear_fn(args, ctx):
              "loss": float(loss) if loss is not None else None},
             f,
         )
+
+
+def sum_sizes_fn(args, ctx):
+    """Sum len() of byte records; writes 'total count' like sum_fn."""
+    import os
+
+    feed = ctx.get_data_feed()
+    total = count = 0
+    while not feed.should_stop():
+        for rec in feed.next_batch(8):
+            total += len(rec)
+            count += 1
+    with open(os.path.join(args["out_dir"], f"node{ctx.executor_id}.txt"), "w") as f:
+        f.write(f"{total} {count}")

@@ -1,0 +1,148 @@
+"""Native layer tests: TFRecord codec (native + fallback + TF interop) and
+the shared-memory feed ring."""
+
+import struct
+import threading
+
+import pytest
+
+from tensorflowonspark_tpu import native
+from tensorflowonspark_tpu.native import tfrecord as ntfr
+from tensorflowonspark_tpu.native.shmring import ShmRing
+
+RECORDS = [b"hello", b"", b"x" * 100_000, bytes(range(256)) * 7]
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.load_library()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    return lib
+
+
+def test_tfrecord_roundtrip_native(lib, tmp_path):
+    p = str(tmp_path / "a.tfrecord")
+    with ntfr.TFRecordWriter(p) as w:
+        assert w.native
+        for r in RECORDS:
+            w.write(r)
+    assert list(ntfr.read_records(p)) == RECORDS
+
+
+def test_tfrecord_python_fallback_matches_native(lib, tmp_path):
+    """Fallback writer produces byte-identical files to the native writer."""
+    p1, p2 = str(tmp_path / "n.tfrecord"), str(tmp_path / "p.tfrecord")
+    with ntfr.TFRecordWriter(p1) as w:
+        for r in RECORDS:
+            w.write(r)
+    w2 = ntfr.TFRecordWriter.__new__(ntfr.TFRecordWriter)
+    w2._lib, w2._h, w2._path = None, None, p2
+    w2._f = open(p2, "wb")
+    for r in RECORDS:
+        w2.write(r)
+    w2.close()
+    assert open(p1, "rb").read() == open(p2, "rb").read()
+    assert list(ntfr._py_read_records(p1)) == RECORDS
+
+
+def test_tfrecord_tf_interop(lib, tmp_path):
+    """TF's reader accepts our files and vice versa (format authority)."""
+    tf = pytest.importorskip("tensorflow")
+    ours = str(tmp_path / "ours.tfrecord")
+    theirs = str(tmp_path / "theirs.tfrecord")
+    with ntfr.TFRecordWriter(ours) as w:
+        for r in RECORDS:
+            w.write(r)
+    got = [bytes(x) for x in tf.data.TFRecordDataset(ours).as_numpy_iterator()]
+    assert got == RECORDS
+    with tf.io.TFRecordWriter(theirs) as w:
+        for r in RECORDS:
+            w.write(r)
+    assert list(ntfr.read_records(theirs)) == RECORDS
+
+
+def test_tfrecord_crc_native_matches_python(lib):
+    for r in RECORDS + [b"q" * 13]:
+        assert lib.tfr_masked_crc32c(r, len(r)) == ntfr._py_masked_crc(r)
+
+
+def test_tfrecord_detects_corruption(lib, tmp_path):
+    p = str(tmp_path / "c.tfrecord")
+    with ntfr.TFRecordWriter(p) as w:
+        w.write(b"payload-payload-payload")
+    blob = bytearray(open(p, "rb").read())
+    blob[14] ^= 0xFF  # flip a payload byte
+    open(p, "wb").write(bytes(blob))
+    with pytest.raises(OSError, match="corrupt"):
+        list(ntfr.read_records(p))
+    # truncated file
+    open(p, "wb").write(bytes(blob[:10]))
+    with pytest.raises(OSError, match="truncated"):
+        list(ntfr._py_read_records(p))
+
+
+def test_shmring_order_and_wraparound(lib):
+    cons = ShmRing.create("/tfos_t_wrap", capacity=1 << 16)  # 64 KiB: wraps
+    prod = ShmRing.open("/tfos_t_wrap")
+    try:
+        sent = [struct.pack("<I", i) + b"v" * (i * 131 % 3000) for i in range(500)]
+
+        def producer():
+            for r in sent:
+                prod.push(r, timeout=10)
+            prod.close_write()
+
+        t = threading.Thread(target=producer)
+        t.start()
+        got = []
+        while (r := cons.pop(timeout=10)) is not None:
+            got.append(r)
+        t.join()
+        assert got == sent
+    finally:
+        prod.close()
+        cons.close()
+
+
+def test_shmring_backpressure_timeout(lib):
+    cons = ShmRing.create("/tfos_t_bp", capacity=1 << 12)
+    prod = ShmRing.open("/tfos_t_bp")
+    try:
+        with pytest.raises(TimeoutError):
+            for _ in range(100):  # no consumer: ring fills, push times out
+                prod.push(b"z" * 1024, timeout=0.2)
+        with pytest.raises(ValueError):
+            prod.push(b"z" * (1 << 13), timeout=0.2)  # bigger than the ring
+    finally:
+        prod.close()
+        cons.close()
+
+
+def test_shmring_pop_timeout_and_close(lib):
+    cons = ShmRing.create("/tfos_t_to", capacity=1 << 12)
+    prod = ShmRing.open("/tfos_t_to")
+    try:
+        with pytest.raises(TimeoutError):
+            cons.pop(timeout=0.2)
+        prod.push(b"last", timeout=1)
+        prod.close_write()
+        assert cons.pop(timeout=1) == b"last"  # drain completes after close
+        assert cons.pop(timeout=1) is None
+    finally:
+        prod.close()
+        cons.close()
+
+
+def test_shmring_stale_segment_recreated(lib):
+    """create() must clobber a leftover segment from a crashed run."""
+    a = ShmRing.create("/tfos_t_stale", capacity=1 << 12)
+    # simulate crash: no close/unlink, just recreate
+    b = ShmRing.create("/tfos_t_stale", capacity=1 << 12)
+    prod = ShmRing.open("/tfos_t_stale")
+    prod.push(b"fresh", timeout=1)
+    assert b.pop(timeout=1) == b"fresh"
+    prod.close()
+    b.close()
+    a._owner = False  # the old handle must not unlink the new segment
+    a.close()

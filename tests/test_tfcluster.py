@@ -116,3 +116,24 @@ def test_train_linear_e2e(tmp_path):
     result = json.load(open(tmp_path / "node0.json"))
     assert abs(result["w"] - 3.0) < 0.2
     assert abs(result["b"] - 1.5) < 0.2
+
+
+def test_shm_ring_oversized_chunks(tmp_path):
+    """Chunks whose pickle exceeds the ring are split, not dropped: feed
+    records far bigger than a 1 MiB ring and check every byte arrives."""
+    cluster = tfcluster.run(
+        cluster_fns.sum_sizes_fn,
+        {"out_dir": str(tmp_path)},
+        num_executors=1,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        env=NODE_ENV,
+        shm_ring_mb=1,
+    )
+    # 40 records x 200 KiB -> one 512-record chunk would pickle to ~8 MiB
+    partitions = [[b"x" * 200_000 for _ in range(20)] for _ in range(2)]
+    cluster.train(partitions)
+    cluster.shutdown(timeout=120)
+    total, count = open(tmp_path / "node0.txt").read().split()
+    assert int(count) == 40
+    assert int(total) == 40 * 200_000
