@@ -108,6 +108,13 @@ def run_node(
     if cluster_meta.get("tensorboard") and executor_id == 0:
         tb_port, tb_pid = _maybe_start_tensorboard(cluster_meta.get("log_dir"))
 
+    # 3b. optional per-host jax.profiler trace server (SURVEY.md §5.1: the
+    #     coordinator-knows-every-host's-profiler-URL pattern; the TPU
+    #     equivalent of the reference's per-node tf.profiler endpoints).
+    prof_port = None
+    if cluster_meta.get("profiler"):
+        prof_port = _maybe_start_profiler_server()
+
     # 4. register + roster barrier
     client = reservation.Client(cluster_meta["server_addr"])
     client.register(
@@ -121,6 +128,7 @@ def run_node(
             "authkey": cluster_meta["authkey"],
             "tb_port": tb_port,
             "tb_pid": tb_pid,
+            "prof_port": prof_port,
             "pid": os.getpid(),
             "shm_ring": ring_name,
         }
@@ -274,6 +282,33 @@ def _node_ring(node: dict[str, Any] | None):
         return ring
 
 
+# The profiler server object must outlive this module scope: jax tears the
+# server down when the object is garbage-collected.
+_profiler_server = None
+
+
+def _maybe_start_profiler_server() -> int | None:
+    """Start an in-process ``jax.profiler`` trace server on a free port.
+
+    Every node runs one, so a TensorBoard profile session (or
+    ``jax.profiler.trace``) can capture any host in the cluster; the port
+    is advertised through the reservation roster
+    (:meth:`TFCluster.profiler_urls`).
+    """
+    global _profiler_server
+    try:
+        import jax.profiler
+    except Exception:  # pragma: no cover - jax is a hard dep in practice
+        return None
+    port = util.find_free_port()
+    try:
+        _profiler_server = jax.profiler.start_server(port)
+    except Exception as e:  # pragma: no cover - e.g. double start
+        logger.warning("profiler server unavailable: %s", e)
+        return None
+    return port
+
+
 def _maybe_start_tensorboard(log_dir: str | None) -> tuple[int | None, int]:
     """Spawn a tensorboard subprocess if the binary exists (chief only).
 
@@ -315,22 +350,25 @@ def feed_partition(
     qname: str = "input",
     chunk: int = FEED_CHUNK,
     node: dict[str, Any] | None = None,
-) -> int:
+) -> int | None:
     """Push one data partition into a node's input queue, chunked.
 
     Pass the node's roster entry via ``node`` to enable the shared-memory
     fast path when the feeder is co-located with the node; otherwise (or
     when native support is missing) chunks go through the TCP manager
-    proxy. Returns the number of records fed (0 if the node is terminating
-    and the partition was skipped). Raises TimeoutError if the consumer
+    proxy. Returns the number of records fed, or ``None`` if the node is
+    terminating and the partition was skipped (distinct from feeding an
+    empty partition, which returns 0). Raises TimeoutError if the consumer
     stopped pulling (reference: "Timeout while feeding partition").
     """
-    if str(mgr.get("state")) == "terminating":
+    if str(mgr.get("state")) in ("terminating", "finished", "error"):
         # Early-stop path: consume and discard remaining partitions
-        # (reference: the state check at the top of ``_train``).
+        # (reference: the state check at the top of ``_train``; 'finished'
+        # and 'error' additionally, since our map_fun may have already
+        # returned — feeding a consumer-less queue would only fill it up).
         for _ in partition:
             pass
-        return 0
+        return None
     ring = _node_ring(node)
     if ring is not None:
 

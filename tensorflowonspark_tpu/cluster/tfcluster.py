@@ -18,6 +18,7 @@ TPU-native differences:
 from __future__ import annotations
 
 import logging
+import queue as _stdqueue
 import secrets
 import threading
 import time
@@ -75,6 +76,20 @@ class TFCluster:
                 return f"http://{n['host']}:{n['tb_port']}"
         return None
 
+    def profiler_urls(self) -> dict[int, str]:
+        """Per-node ``jax.profiler`` trace-server addresses, by executor id.
+
+        Populated when the cluster was started with ``profiler=True``
+        (SURVEY.md §5.1: the coordinator knows every host's profiler URL —
+        point TensorBoard's profile capture, or ``jax.profiler.trace``, at
+        any of these).
+        """
+        return {
+            n["executor_id"]: f"{n['host']}:{n['prof_port']}"
+            for n in self.cluster_info
+            if n.get("prof_port")
+        }
+
     # ------------------------------------------------------------------
     def train(
         self,
@@ -131,6 +146,150 @@ class TFCluster:
             raise errors[0]
         self._check_errors()
 
+    def train_stream(
+        self,
+        stream: Iterable[Iterable],
+        feed_timeout: float = 600.0,
+        qname: str = "input",
+    ) -> None:
+        """Feed an unbounded stream of micro-batches (Spark Streaming parity).
+
+        Reference: ``TFCluster.train`` with a DStream — each RDD of the
+        stream is fed on arrival via ``foreachRDD`` (``TFCluster.py:train``).
+        Here ``stream`` yields micro-batches; each micro-batch is
+        partitioned like :meth:`train` and its partitions are handed
+        round-robin to persistent per-worker feeder threads, so feeding
+        micro-batch *k+1* overlaps with workers still consuming *k*.
+
+        Returns when the stream is exhausted or every worker has entered
+        the ``terminating`` state (early stop). The stream may be infinite;
+        call :meth:`shutdown` from another thread (or let the workers call
+        ``DataFeed.terminate``) to end training. The stream generator runs
+        in a pump thread, so worker termination and feeder errors are
+        noticed within ~5 s even while the source is quiet between
+        micro-batches (a slow generator itself cannot be interrupted
+        mid-``next()``, only abandoned).
+        """
+        self._require_spark_mode("train_stream")
+        workers = self.workers
+        errors: list[BaseException] = []
+        work_qs: list[Any] = []
+        feeders: list[threading.Thread] = []
+        terminated = [False] * len(workers)
+        pump_done = threading.Event()
+        pump_stop = threading.Event()
+        # Bounded so an unbounded stream can't buffer itself into the
+        # driver's memory.
+        micro_q: _stdqueue.Queue = _stdqueue.Queue(maxsize=2)
+
+        def pump() -> None:
+            try:
+                for micro_batch in stream:
+                    while not pump_stop.is_set():
+                        try:
+                            micro_q.put(micro_batch, timeout=1.0)
+                            break
+                        except _stdqueue.Full:
+                            continue
+                    if pump_stop.is_set():
+                        return
+            except BaseException as e:  # noqa: BLE001 - ferried to caller
+                errors.append(e)
+            finally:
+                pump_done.set()
+
+        def feed_worker(widx: int) -> None:
+            try:
+                mgr = tfnode_runtime.connect_manager(workers[widx])
+                while True:
+                    part = work_qs[widx].get()
+                    if part is None:
+                        return
+                    fed = tfnode_runtime.feed_partition(
+                        mgr,
+                        part,
+                        feed_timeout=feed_timeout,
+                        qname=qname,
+                        node=workers[widx],
+                    )
+                    if fed is None:  # node terminating; partition skipped
+                        terminated[widx] = True
+            except BaseException as e:  # noqa: BLE001 - ferried to caller
+                errors.append(e)
+                terminated[widx] = True
+
+        for i in range(len(workers)):
+            # 4 pending partitions per worker keeps the pipeline full
+            # across micro-batch boundaries.
+            work_qs.append(_stdqueue.Queue(maxsize=4))
+            t = threading.Thread(target=feed_worker, args=(i,), daemon=True)
+            feeders.append(t)
+            t.start()
+        threading.Thread(target=pump, daemon=True, name="stream-pump").start()
+
+        def poll_node_states() -> None:
+            # Worker-initiated termination (DataFeed.terminate) only flips
+            # terminated[i] when a feed attempt observes it; on a quiet
+            # stream no feed happens, so poll manager state directly.
+            for i, w in enumerate(workers):
+                if not terminated[i]:
+                    try:
+                        mgr = tfnode_runtime.connect_manager(w)
+                        # 'finished' too: a map_fun that terminate()s and
+                        # returns flips terminating -> finished immediately.
+                        state = str(mgr.get("state"))
+                        if state in ("terminating", "finished", "error"):
+                            terminated[i] = True
+                    except (ConnectionError, OSError):
+                        terminated[i] = True
+
+        n_parts = 0
+        last_err_check = time.monotonic()
+        try:
+            while not (all(terminated) or errors):
+                # Node-side failures and worker-initiated termination
+                # surface through the managers, not the feeder threads —
+                # poll them, but at most every 5 s (each poll opens a
+                # connection to every node).
+                if time.monotonic() - last_err_check > 5.0:
+                    self._check_errors()
+                    poll_node_states()
+                    last_err_check = time.monotonic()
+                try:
+                    micro_batch = micro_q.get(timeout=1.0)
+                except _stdqueue.Empty:
+                    if pump_done.is_set() and micro_q.empty():
+                        break
+                    continue
+                for part in _as_partitions(micro_batch, len(workers)):
+                    if not part:
+                        continue  # empty partition: nothing to feed
+                    widx = n_parts % len(workers)
+                    n_parts += 1
+                    while not terminated[widx] and not errors:
+                        try:
+                            work_qs[widx].put(part, timeout=1.0)
+                            break
+                        except _stdqueue.Full:
+                            continue
+        finally:
+            pump_stop.set()
+            for q, t in zip(work_qs, feeders):
+                # A dead feeder no longer drains its (bounded) queue, so an
+                # unconditional put could block forever — poll instead.
+                while t.is_alive():
+                    try:
+                        q.put(None, timeout=1.0)
+                        break
+                    except _stdqueue.Full:
+                        continue
+            for t in feeders:
+                t.join()
+        if errors:
+            self._check_errors()
+            raise errors[0]
+        self._check_errors()
+
     def inference(
         self,
         data: Iterable,
@@ -162,6 +321,8 @@ class TFCluster:
                         qname=qname,
                         node=workers[widx],
                     )
+                    if fed is None:  # node terminating; partition skipped
+                        continue
                     out = tfnode_runtime.collect_results(
                         mgr, fed, timeout=feed_timeout
                     )
@@ -268,6 +429,7 @@ def run(
     num_executors: int,
     num_ps: int = 0,
     tensorboard: bool = False,
+    profiler: bool = False,
     input_mode: int = InputMode.SPARK,
     log_dir: str | None = None,
     master_node: str | None = None,
@@ -333,6 +495,7 @@ def run(
         "default_fs": default_fs,
         "working_dir": working_dir or "",
         "tensorboard": tensorboard,
+        "profiler": profiler,
         "log_dir": log_dir,
         "reservation_timeout": reservation_timeout,
         "distributed": distributed,

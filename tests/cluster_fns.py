@@ -119,6 +119,19 @@ def train_linear_fn(args, ctx):
         )
 
 
+def terminate_after_fn(args, ctx):
+    """Consume until ``limit`` records, then DataFeed.terminate (early stop)."""
+    feed = ctx.get_data_feed(train_mode=True)
+    seen = 0
+    while not feed.should_stop() and seen < int(args["limit"]):
+        seen += len(feed.next_batch(8))
+    feed.terminate()
+    with open(
+        os.path.join(args["out_dir"], f"node{ctx.executor_id}.txt"), "w"
+    ) as f:
+        f.write(str(seen))
+
+
 def sum_sizes_fn(args, ctx):
     """Sum len() of byte records; writes 'total count' like sum_fn."""
     import os

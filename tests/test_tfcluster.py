@@ -118,6 +118,82 @@ def test_train_linear_e2e(tmp_path):
     assert abs(result["b"] - 1.5) < 0.2
 
 
+def test_train_stream_micro_batches(tmp_path):
+    """Spark Streaming parity: micro-batches fed on arrival via train_stream."""
+    cluster = tfcluster.run(
+        cluster_fns.sum_fn,
+        {"out_dir": str(tmp_path)},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        env=NODE_ENV,
+    )
+
+    def stream():
+        # 5 micro-batches of 20 records each, arriving over time; empty
+        # micro-batches (quiet stream intervals) must be a no-op, not an
+        # early-stop signal
+        for mb in range(5):
+            yield []
+            yield [[(i,) for i in range(mb * 20, mb * 20 + 10)],
+                   [(i,) for i in range(mb * 20 + 10, (mb + 1) * 20)]]
+
+    cluster.train_stream(stream())
+    cluster.shutdown(timeout=120)
+
+    totals, counts = [], []
+    for i in range(2):
+        total, count = open(tmp_path / f"node{i}.txt").read().split()
+        totals.append(int(total))
+        counts.append(int(count))
+    assert sum(counts) == 100
+    assert sum(totals) == sum(range(100))
+
+
+def test_train_stream_early_stop_on_quiet_stream(tmp_path):
+    """Worker-initiated terminate is noticed while the stream is quiet:
+    train_stream must return without waiting for the (slow) next yield."""
+    import time as _time
+
+    cluster = tfcluster.run(
+        cluster_fns.terminate_after_fn,
+        {"out_dir": str(tmp_path), "limit": 8},
+        num_executors=1,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        env=NODE_ENV,
+    )
+
+    def stream():
+        yield [[(i,) for i in range(16)]]  # enough to hit the limit
+        _time.sleep(120)  # quiet "infinite" stream; must not be awaited
+        yield [[(99,)]]
+
+    t0 = _time.monotonic()
+    cluster.train_stream(stream())
+    elapsed = _time.monotonic() - t0
+    cluster.shutdown(timeout=120)
+    assert elapsed < 60, f"train_stream did not early-stop ({elapsed:.0f}s)"
+    assert int(open(tmp_path / "node0.txt").read()) >= 8
+
+
+def test_profiler_urls(tmp_path):
+    """profiler=True starts a per-node jax.profiler server; roster has URLs."""
+    cluster = tfcluster.run(
+        cluster_fns.sum_fn,
+        {"out_dir": str(tmp_path)},
+        num_executors=1,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        profiler=True,
+        env=NODE_ENV,
+    )
+    urls = cluster.profiler_urls()
+    cluster.train([[(1,), (2,)]])
+    cluster.shutdown(timeout=120)
+    assert 0 in urls and ":" in urls[0]
+
+
 def test_shm_ring_oversized_chunks(tmp_path):
     """Chunks whose pickle exceeds the ring are split, not dropped: feed
     records far bigger than a 1 MiB ring and check every byte arrives."""
