@@ -1,0 +1,170 @@
+"""Self-contained model export + AOT batch inference.
+
+Reference parity: the Scala inference API
+(``src/main/scala/com/yahoo/tensorflowonspark/TFModel.scala`` + ``DFUtil``,
+SURVEY.md §2.2) — DataFrame batch inference from a SavedModel with *no user
+Python code*, via TF Java's ``SavedModelBundle``. The TPU-native artifact is:
+
+- ``stablehlo.bin`` — a :mod:`jax.export` serialization of the apply
+  function (StableHLO, language-neutral, loadable from any PJRT frontend),
+  batch-dimension-polymorphic so one artifact serves any batch size;
+- ``params/`` — the model state as an orbax checkpoint;
+- ``aot_meta.json`` — input/output column↔tensor mappings and provenance,
+  the analog of a SavedModel's signature-def (reference:
+  ``pipeline.py:TFModel`` signature/tag params).
+
+``python -m tensorflowonspark_tpu.tools.run_model`` is the no-user-code
+entry: TFRecords in → TFRecords/JSONL out, like the Scala API's
+DataFrame → DataFrame ``transform``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Iterable, Sequence
+
+import numpy as np
+
+_STABLEHLO = "stablehlo.bin"
+_META = "aot_meta.json"
+_PARAMS = "params"
+
+
+def export_model(
+    apply_fn: Callable[[Any, Any], Any],
+    state: Any,
+    example_batch: Any,
+    export_dir: str,
+    input_mapping: dict[str, str] | None = None,
+    output_mapping: dict[str, str] | None = None,
+    platforms: Sequence[str] | None = None,
+) -> str:
+    """Serialize ``apply_fn(state, batch)`` + ``state`` into ``export_dir``.
+
+    ``example_batch`` fixes every shape except the leading (batch) dim of
+    each batch leaf, which is exported symbolically. ``platforms`` defaults
+    to the current default export platform; pass ``("cpu", "tpu")`` for an
+    artifact that runs on either.
+    """
+    import jax
+    import jax.export as jex
+
+    from tensorflowonspark_tpu.compute.checkpoint import save_checkpoint
+
+    scope = jex.SymbolicScope()
+    (b,) = jex.symbolic_shape("b", scope=scope)
+    batch_specs = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            (b,) + np.shape(x)[1:], np.asarray(x).dtype
+        ),
+        example_batch,
+    )
+    state_specs = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        state,
+    )
+    kwargs = {"platforms": tuple(platforms)} if platforms else {}
+    exported = jex.export(jax.jit(apply_fn), **kwargs)(
+        state_specs, batch_specs
+    )
+
+    os.makedirs(export_dir, exist_ok=True)
+    with open(os.path.join(export_dir, _STABLEHLO), "wb") as f:
+        f.write(exported.serialize())
+    save_checkpoint(os.path.join(export_dir, _PARAMS), state)
+    with open(os.path.join(export_dir, _META), "w") as f:
+        json.dump(
+            {
+                "input_mapping": input_mapping,
+                "output_mapping": output_mapping,
+                "platforms": list(exported.platforms),
+                "jax_version": jax.__version__,
+            },
+            f,
+            indent=2,
+        )
+    return export_dir
+
+
+def is_aot_export(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, _STABLEHLO))
+
+
+class AOTModel:
+    """A loaded export: callable on batches, knows its column mappings."""
+
+    def __init__(self, exported, state: Any, meta: dict[str, Any]):
+        import jax
+
+        self._exported = exported
+        # jit once: per-call jax.jit(...) would rebuild the wrapper (and its
+        # trace/compile cache) for every batch.
+        self._call = jax.jit(exported.call)
+        self.state = state
+        self.meta = meta
+        self.input_mapping = meta.get("input_mapping")
+        self.output_mapping = meta.get("output_mapping")
+
+    def __call__(self, batch: Any) -> Any:
+        return self._call(self.state, batch)
+
+    def transform(
+        self, records: Iterable[Any], batch_size: int = 64
+    ) -> list[Any]:
+        """Batch rows through the model, preserving order (equal-count
+        contract, like ``TFModel.transform``)."""
+        from tensorflowonspark_tpu.api.pipeline import columnize, rowize
+
+        records = list(records)
+        out: list[Any] = []
+        for start in range(0, len(records), batch_size):
+            chunk = records[start : start + batch_size]
+            batch = columnize(chunk, self.input_mapping)
+            out.extend(rowize(self(batch), len(chunk), self.output_mapping))
+        return out
+
+
+def load_model(export_dir: str) -> AOTModel:
+    """Load an :func:`export_model` artifact. No user code needed — the
+    function, weights, and signature all come from the artifact."""
+    import jax.export as jex
+
+    from tensorflowonspark_tpu.compute.checkpoint import restore_checkpoint
+
+    with open(os.path.join(export_dir, _STABLEHLO), "rb") as f:
+        exported = jex.deserialize(f.read())
+    with open(os.path.join(export_dir, _META)) as f:
+        meta = json.load(f)
+    state = restore_checkpoint(os.path.join(export_dir, _PARAMS))
+    return AOTModel(exported, state, meta)
+
+
+def export_tf_saved_model(
+    apply_fn: Callable[[Any, Any], Any],
+    state: Any,
+    example_batch: Any,
+    export_dir: str,
+) -> str:
+    """Export as a TensorFlow SavedModel via ``jax2tf`` (TF-serving interop;
+    the closest analog of the artifact the reference's Scala API consumed).
+    Requires the optional TensorFlow install."""
+    import tensorflow as tf
+    from jax.experimental import jax2tf
+
+    tf_fn = tf.function(
+        jax2tf.convert(
+            lambda batch: apply_fn(state, batch), polymorphic_shapes="(b, ...)"
+        ),
+        autograph=False,
+        input_signature=[
+            tf.TensorSpec(
+                (None,) + np.shape(example_batch)[1:],
+                np.asarray(example_batch).dtype.name,
+            )
+        ],
+    )
+    module = tf.Module()
+    module.f = tf_fn
+    tf.saved_model.save(module, export_dir)
+    return export_dir

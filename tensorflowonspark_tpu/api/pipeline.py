@@ -15,6 +15,7 @@ equivalent of the reference's SavedModel-session singleton per executor.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Callable, Iterable, Sequence
 
 import numpy as np
@@ -189,6 +190,7 @@ class TFModel(_HasParams):
 
     _singleton: tuple[Any, Any] | None = None
     _singleton_key: tuple | None = None
+    _singleton_aot_mappings: tuple[Any, Any] = (None, None)
 
     def __init__(
         self,
@@ -208,16 +210,40 @@ class TFModel(_HasParams):
         if export_dir is None:
             raise ValueError("TFModel needs export_dir or model_dir")
         if self.export_fn is None:
-            raise ValueError(
-                "TFModel needs export_fn=(args)->(apply_fn, target_state) to "
-                "rebuild the model (the SavedModel-signature analog)"
-            )
+            from tensorflowonspark_tpu.api import export as aot_export
+
+            if not aot_export.is_aot_export(export_dir):
+                raise ValueError(
+                    "TFModel needs export_fn=(args)->(apply_fn, target_state) "
+                    "to rebuild the model, or an export_dir written by "
+                    "api.export.export_model (a self-describing AOT artifact, "
+                    "the SavedModel-signature analog)"
+                )
+            try:
+                mtime = os.path.getmtime(export_dir)
+            except OSError:
+                mtime = None
+            key = (export_dir, "aot", mtime)
+            if TFModel._singleton_key != key:
+                aot = aot_export.load_model(export_dir)
+                TFModel._singleton = (
+                    lambda state, batch: aot(batch),
+                    aot.state,
+                )
+                TFModel._singleton_key = key
+                TFModel._singleton_aot_mappings = (
+                    aot.input_mapping,
+                    aot.output_mapping,
+                )
+            if args.input_mapping is None:
+                args.input_mapping = TFModel._singleton_aot_mappings[0]
+            if args.output_mapping is None:
+                args.output_mapping = TFModel._singleton_aot_mappings[1]
+            return TFModel._singleton
         # Key by checkpoint mtime and export_fn identity too, so refitting
         # into the same directory (or swapping export_fn) invalidates the
         # cached model instead of serving stale predictions.
         try:
-            import os
-
             mtime = os.path.getmtime(export_dir)
         except OSError:
             mtime = None
@@ -248,40 +274,45 @@ class TFModel(_HasParams):
         return out
 
     def _columnize(self, chunk: Sequence[Any]):
-        mapping = self.args.input_mapping
-        if mapping is None:
-            return np.asarray(chunk)
-        cols = list(mapping.keys())
-        if isinstance(chunk[0], (tuple, list)):
-            # Positional contract (reference: pipeline.py input_mapping is
-            # "ordered dict of input DataFrame column to input tensor"):
-            # the mapping's key order IS the record layout, so it must
-            # enumerate every field — a subset would silently bind fields
-            # to the wrong tensors.
-            if len(chunk[0]) != len(cols):
-                raise ValueError(
-                    f"input_mapping has {len(cols)} columns {cols} but "
-                    f"records have {len(chunk[0])} fields; for tuple "
-                    "records the mapping must name every field, in order"
-                )
-            index = {col: i for i, col in enumerate(cols)}
-            get = lambda rec, col: rec[index[col]]  # noqa: E731
-        else:
-            get = lambda rec, col: rec[col]  # noqa: E731
-        return {
-            tensor: np.asarray([get(rec, col) for rec in chunk])
-            for col, tensor in mapping.items()
-        }
+        return columnize(chunk, self.args.input_mapping)
 
     def _rowize(self, result: Any, n: int) -> list[Any]:
-        mapping = self.args.output_mapping
-        if mapping is None:
-            arr = np.asarray(result)
-            return [arr[i] for i in range(n)]
-        named = {
-            out_col: np.asarray(result[tensor])
-            for tensor, out_col in mapping.items()
-        }
-        return [
-            {col: vals[i] for col, vals in named.items()} for i in range(n)
-        ]
+        return rowize(result, n, self.args.output_mapping)
+
+
+def columnize(chunk: Sequence[Any], mapping: dict[str, str] | None):
+    """Rows → named (or bare) input arrays per ``input_mapping``."""
+    if mapping is None:
+        return np.asarray(chunk)
+    cols = list(mapping.keys())
+    if isinstance(chunk[0], (tuple, list)):
+        # Positional contract (reference: pipeline.py input_mapping is
+        # "ordered dict of input DataFrame column to input tensor"):
+        # the mapping's key order IS the record layout, so it must
+        # enumerate every field — a subset would silently bind fields
+        # to the wrong tensors.
+        if len(chunk[0]) != len(cols):
+            raise ValueError(
+                f"input_mapping has {len(cols)} columns {cols} but "
+                f"records have {len(chunk[0])} fields; for tuple "
+                "records the mapping must name every field, in order"
+            )
+        index = {col: i for i, col in enumerate(cols)}
+        get = lambda rec, col: rec[index[col]]  # noqa: E731
+    else:
+        get = lambda rec, col: rec[col]  # noqa: E731
+    return {
+        tensor: np.asarray([get(rec, col) for rec in chunk])
+        for col, tensor in mapping.items()
+    }
+
+
+def rowize(result: Any, n: int, mapping: dict[str, str] | None) -> list[Any]:
+    """Model output → per-row results per ``output_mapping``."""
+    if mapping is None:
+        arr = np.asarray(result)
+        return [arr[i] for i in range(n)]
+    named = {
+        out_col: np.asarray(result[tensor]) for tensor, out_col in mapping.items()
+    }
+    return [{col: vals[i] for col, vals in named.items()} for i in range(n)]

@@ -1,0 +1,1 @@
+"""Standalone command-line tools (no user code required)."""

@@ -1,0 +1,93 @@
+"""Batch inference CLI over an exported model — no user Python needed.
+
+Reference parity: the Scala inference API (SURVEY.md §2.2,
+``src/main/scala/com/yahoo/tensorflowonspark/TFModel.scala``): load a
+self-describing exported model, map input columns to tensors, run batches,
+write an output "DataFrame". Here the artifact is a
+:func:`tensorflowonspark_tpu.api.export.export_model` directory and the
+DataFrames are TFRecord files (or JSONL).
+
+Usage::
+
+    python -m tensorflowonspark_tpu.tools.run_model \
+        --export-dir model/ --input records/ --output out/ \
+        [--format tfrecord|jsonl] [--batch-size 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="run_model", description="AOT batch inference over TFRecords"
+    )
+    p.add_argument("--export-dir", required=True)
+    p.add_argument("--input", required=True, help="TFRecord dir/glob or JSONL file")
+    p.add_argument("--output", required=True, help="output dir (tfrecord) or file (jsonl)")
+    p.add_argument("--format", choices=("tfrecord", "jsonl"), default="tfrecord")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument(
+        "--binary-features",
+        default="",
+        help="comma-separated bytes columns to keep raw when reading TFRecords",
+    )
+    return p
+
+
+def _read_rows(args) -> list[dict[str, Any]]:
+    if args.format == "jsonl":
+        with open(args.input) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    from tensorflowonspark_tpu.data import dfutil
+
+    binary = tuple(c for c in args.binary_features.split(",") if c)
+    return list(dfutil.loadTFRecords(args.input, binary_features=binary))
+
+
+def _to_jsonable(row: Any) -> Any:
+    if isinstance(row, dict):
+        return {k: _to_jsonable(v) for k, v in row.items()}
+    if isinstance(row, np.ndarray):
+        return row.tolist()
+    if isinstance(row, (np.generic,)):
+        return row.item()
+    return row
+
+
+def _write_rows(args, rows: list[Any]) -> None:
+    if args.format == "jsonl":
+        with open(args.output, "w") as f:
+            for row in rows:
+                f.write(json.dumps(_to_jsonable(row)) + "\n")
+        return
+    from tensorflowonspark_tpu.data import dfutil
+
+    dict_rows = [
+        row if isinstance(row, dict) else {"prediction": np.asarray(row)}
+        for row in rows
+    ]
+    dfutil.saveAsTFRecords(dict_rows, args.output)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    from tensorflowonspark_tpu.api.export import load_model
+
+    model = load_model(args.export_dir)
+    rows = _read_rows(args)
+    results = model.transform(rows, batch_size=args.batch_size)
+    _write_rows(args, results)
+    print(f"wrote {len(results)} predictions to {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,155 @@
+"""AOT export artifact tests (L7 parity: the Scala inference API's role —
+self-describing exported model, batch inference with no user code).
+Reference: src/main/scala/com/yahoo/tensorflowonspark/TFModel.scala (SURVEY §2.2).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.api import export as aot_export
+
+W = np.array([[2.0], [1.0]], np.float32)
+B = 0.5
+
+
+def _linear_state():
+    import jax.numpy as jnp
+
+    return {"w": jnp.asarray(W), "b": jnp.asarray([B])}
+
+
+def _apply_array(state, batch):
+    """batch: (n, 2) array -> (n, 1)."""
+    return batch @ state["w"] + state["b"]
+
+
+def _apply_dict(state, batch):
+    """batch: {'x0': (n,), 'x1': (n,)} -> {'y': (n,)}."""
+    x = batch["x0"] * state["w"][0, 0] + batch["x1"] * state["w"][1, 0]
+    return {"y": x + state["b"][0]}
+
+
+@pytest.fixture(scope="module")
+def array_artifact(tmp_path_factory):
+    d = tmp_path_factory.mktemp("aot") / "array_model"
+    aot_export.export_model(
+        _apply_array, _linear_state(), np.zeros((4, 2), np.float32), str(d)
+    )
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def dict_artifact(tmp_path_factory):
+    d = tmp_path_factory.mktemp("aot") / "dict_model"
+    example = {
+        "x0": np.zeros((4,), np.float32),
+        "x1": np.zeros((4,), np.float32),
+    }
+    aot_export.export_model(
+        _apply_dict,
+        _linear_state(),
+        example,
+        str(d),
+        input_mapping={"x0": "x0", "x1": "x1"},
+        output_mapping={"y": "pred"},
+    )
+    return str(d)
+
+
+def test_export_round_trip_poly_batch(array_artifact):
+    model = aot_export.load_model(array_artifact)
+    # batch-polymorphic: sizes the exporter never saw
+    for n in (1, 3, 7):
+        x = np.arange(2 * n, dtype=np.float32).reshape(n, 2)
+        np.testing.assert_allclose(
+            np.asarray(model(x)), x @ W + B, rtol=1e-6
+        )
+
+
+def test_aot_transform_bare_rows(array_artifact):
+    model = aot_export.load_model(array_artifact)
+    rows = [(1.0, 2.0), (3.0, 4.0), (5.0, 6.0)]
+    out = model.transform(rows, batch_size=2)
+    got = [float(np.asarray(r).reshape(())) for r in out]
+    np.testing.assert_allclose(got, [4.5, 10.5, 16.5], rtol=1e-6)
+
+
+def test_aot_transform_column_mappings(dict_artifact):
+    """Mappings travel inside the artifact: dict rows in, named cols out."""
+    model = aot_export.load_model(dict_artifact)
+    rows = [{"x0": 1.0, "x1": 2.0}, {"x0": 3.0, "x1": 4.0}]
+    out = model.transform(rows, batch_size=8)
+    assert [set(r) for r in out] == [{"pred"}, {"pred"}]
+    np.testing.assert_allclose(
+        [float(r["pred"]) for r in out], [4.5, 10.5], rtol=1e-6
+    )
+
+
+def test_tfmodel_loads_aot_artifact(array_artifact):
+    """TFModel without export_fn falls back to the self-describing artifact."""
+    from tensorflowonspark_tpu.api.pipeline import TFModel
+
+    model = TFModel(export_dir=array_artifact, batch_size=2)
+    out = model.transform([(1.0, 0.0), (0.0, 1.0)])
+    got = [float(np.asarray(r).reshape(())) for r in out]
+    np.testing.assert_allclose(got, [2.5, 1.5], rtol=1e-6)
+
+
+def test_tfmodel_without_export_fn_or_artifact(tmp_path):
+    from tensorflowonspark_tpu.api.pipeline import TFModel
+
+    model = TFModel(export_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="export_fn"):
+        model.transform([(1.0, 2.0)])
+
+
+def test_run_model_cli_jsonl(array_artifact, tmp_path):
+    from tensorflowonspark_tpu.tools import run_model
+
+    inp = tmp_path / "in.jsonl"
+    with open(inp, "w") as f:
+        for row in [[1.0, 2.0], [3.0, 4.0]]:
+            f.write(json.dumps(row) + "\n")
+    out = tmp_path / "out.jsonl"
+    rc = run_model.main(
+        [
+            "--export-dir", array_artifact,
+            "--input", str(inp),
+            "--output", str(out),
+            "--format", "jsonl",
+            "--batch-size", "2",
+        ]
+    )
+    assert rc == 0
+    rows = [json.loads(line) for line in open(out)]
+    np.testing.assert_allclose(
+        np.asarray(rows, np.float32).reshape(-1), [4.5, 10.5], rtol=1e-6
+    )
+
+
+def test_run_model_cli_tfrecord(dict_artifact, tmp_path):
+    pytest.importorskip("tensorflow")
+    from tensorflowonspark_tpu.data import dfutil
+    from tensorflowonspark_tpu.tools import run_model
+
+    in_dir = tmp_path / "records"
+    dfutil.saveAsTFRecords(
+        [{"x0": np.float32(1.0), "x1": np.float32(2.0)},
+         {"x0": np.float32(3.0), "x1": np.float32(4.0)}],
+        str(in_dir),
+    )
+    out_dir = tmp_path / "preds"
+    rc = run_model.main(
+        [
+            "--export-dir", dict_artifact,
+            "--input", str(in_dir),
+            "--output", str(out_dir),
+            "--format", "tfrecord",
+        ]
+    )
+    assert rc == 0
+    rows = list(dfutil.loadTFRecords(str(out_dir)))
+    got = sorted(float(np.asarray(r["pred"]).reshape(())) for r in rows)
+    np.testing.assert_allclose(got, [4.5, 10.5], rtol=1e-6)
