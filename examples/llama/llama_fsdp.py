@@ -1,0 +1,185 @@
+"""Llama fine-tune with FSDP over the ICI mesh — the BASELINE.md headline.
+
+Reference parity: there is no reference equivalent (TFoS topped out at
+data-parallel, SURVEY.md §2.3); this is the config BASELINE.json adds:
+"Llama-2-7B fine-tune, FSDP over ICI, v4-32, ≥40% MFU". The same script
+scales from a tiny CPU smoke run to the real thing by flags: mesh axes,
+model size, remat, and checkpoint/resume are all config.
+
+MFU accounting: 6*P*T model flops per token (fwd+bwd) over the measured
+step time, against per-chip peak (float from --peak-tflops; v4 bf16 = 275).
+
+Usage::
+
+    tpu-submit --num-executors 1 examples/llama/llama_fsdp.py \
+        [--model tiny|7b] [--fsdp -1] [--tp 1] [--steps 20] \
+        [--seq 512] [--batch-size 8] [--model-dir DIR] [--cpu]
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
+import argparse
+import time
+
+
+def _config(name: str, seq: int):
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.llama import LlamaConfig
+
+    if name == "7b":
+        return LlamaConfig(
+            hidden_size=4096,
+            intermediate_size=11008,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=32,
+            vocab_size=32000,
+            max_seq_len=seq,
+            dtype=jnp.bfloat16,
+            remat=True,
+        )
+    return LlamaConfig.tiny(
+        hidden_size=256,
+        intermediate_size=512,
+        num_layers=4,
+        num_heads=8,
+        num_kv_heads=4,
+        vocab_size=1024,
+        max_seq_len=seq,
+        dtype=jnp.bfloat16,
+        remat=True,
+    )
+
+
+def main_fun(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState, build_train_step
+    from tensorflowonspark_tpu.compute.checkpoint import CheckpointManager
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.models.llama import (
+        Llama,
+        llama_loss_fn,
+        llama_param_shardings,
+    )
+    from tensorflowonspark_tpu.parallel import use_mesh
+
+    cfg = _config(args.model, args.seq)
+    model = Llama(cfg)
+    mesh = make_mesh({"data": args.dp, "fsdp": args.fsdp, "model": args.tp})
+    if ctx.executor_id == 0:
+        print(f"mesh: {dict(mesh.shape)}")
+
+    rng = np.random.default_rng(ctx.executor_id)
+    tokens0 = np.zeros((2, args.seq + 1), np.int32)
+    with use_mesh(mesh):
+        params = model.init(jax.random.PRNGKey(0), tokens0[:, :-1])["params"]
+    psh = llama_param_shardings(params, mesh)
+    params = jax.tree.map(jax.device_put, params, psh)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+    tx = optax.adamw(float(args.lr))
+    state = TrainState.create(params, tx)
+    token_loss = llama_loss_fn(model)
+    step = build_train_step(
+        lambda p, b: token_loss(p, b["tokens"]), tx, mesh, param_shardings=psh
+    )
+
+    ckpt = None
+    if args.model_dir:
+        ckpt = CheckpointManager(ctx.absolute_path(args.model_dir))
+        latest = ckpt.latest_step()
+        if latest is not None and ctx.is_chief:
+            print(f"resuming from step {latest}")
+        if latest is not None:
+            state = ckpt.restore(latest, target=state)
+
+    def batch():
+        return {
+            "tokens": rng.integers(
+                0, cfg.vocab_size, size=(args.batch_size, args.seq + 1)
+            ).astype(np.int32)
+        }
+
+    with use_mesh(mesh):
+        # compile + warmup excluded from timing
+        state, loss = step(state, shard_batch(mesh, batch()))
+        jax.block_until_ready(loss)
+        t0 = time.time()
+        for i in range(args.steps):
+            state, loss = step(state, shard_batch(mesh, batch()))
+            if (i + 1) % 10 == 0:
+                print(
+                    f"node{ctx.executor_id} step {i + 1} "
+                    f"loss {float(loss):.4f}"
+                )
+        jax.block_until_ready(loss)
+    dt = time.time() - t0
+
+    step_time = dt / args.steps
+    tokens_per_step = args.batch_size * args.seq
+    model_flops = 6 * n_params * tokens_per_step  # fwd+bwd, no attn term
+    mfu = model_flops / step_time / jax.device_count() / (
+        args.peak_tflops * 1e12
+    )
+    print(
+        f"node{ctx.executor_id}: {n_params / 1e6:.1f}M params, "
+        f"step {step_time * 1e3:.1f}ms, "
+        f"{tokens_per_step / step_time:.0f} tokens/sec "
+        f"({tokens_per_step / step_time / jax.device_count():.0f} /chip), "
+        f"MFU {mfu * 100:.1f}%"
+    )
+    if ckpt is not None:
+        # Chief-only: with the local launcher every node is an independent
+        # single-controller process, so concurrent saves to the same orbax
+        # directory would race on the step-dir commit.
+        if ctx.is_chief:
+            ckpt.save(int(state.step), state)
+            print(f"checkpointed step {int(state.step)} to {args.model_dir}")
+        ckpt.close()
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", choices=("tiny", "7b"), default="tiny")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--fsdp", type=int, default=-1, help="-1: all devices")
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--model-dir", default=None)
+    p.add_argument(
+        "--peak-tflops", type=float, default=275.0, help="per-chip bf16 peak"
+    )
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args(argv)
+
+
+if __name__ == "__main__":
+    from tensorflowonspark_tpu.cluster import tfcluster
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+    from tensorflowonspark_tpu.launcher import cluster_args_from_env
+    from tensorflowonspark_tpu.utils.util import cpu_only_env
+
+    args = parse_args()
+    largs = cluster_args_from_env()
+    cluster = tfcluster.run(
+        main_fun,
+        args,
+        num_executors=largs["num_executors"],
+        input_mode=InputMode.TENSORFLOW,
+        env=cpu_only_env() if args.cpu else None,
+        launcher=largs.get("launcher"),
+        distributed=largs.get("distributed", False),
+    )
+    cluster.shutdown()
+    print("llama_fsdp done")

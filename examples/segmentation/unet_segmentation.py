@@ -1,0 +1,129 @@
+"""U-Net semantic segmentation, InputMode.TENSORFLOW.
+
+Reference parity: ``examples/segmentation`` (TF2 port of the TF
+image-segmentation tutorial: U-Net on Oxford-IIIT Pet, nodes read their own
+data — SURVEY.md §2.4). Synthetic stand-in data: random circles rendered
+into images, mask = {0: background, 1: disk, 2: outline}, so the model has
+real structure to learn and mIoU is a meaningful metric.
+
+Usage::
+
+    tpu-submit --num-executors 1 examples/segmentation/unet_segmentation.py \
+        [--steps 100] [--size 64] [--tiny] [--cpu] [--model-dir DIR]
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
+import argparse
+import time
+
+
+def _render_circles(rng, n, size):
+    """(images, masks): anti-aliased disks with distinct outline class."""
+    import numpy as np
+
+    yy, xx = np.mgrid[0:size, 0:size]
+    images = np.zeros((n, size, size, 3), np.float32)
+    masks = np.zeros((n, size, size), np.int32)
+    for i in range(n):
+        cx, cy = rng.uniform(size * 0.25, size * 0.75, size=2)
+        r = rng.uniform(size * 0.1, size * 0.3)
+        d = np.sqrt((xx - cx) ** 2 + (yy - cy) ** 2)
+        disk = d < r - 1.5
+        outline = (d >= r - 1.5) & (d < r + 1.5)
+        masks[i][disk] = 1
+        masks[i][outline] = 2
+        color = rng.uniform(0.3, 1.0, size=3).astype(np.float32)
+        images[i][disk] = color
+        images[i][outline] = 1.0 - color
+        images[i] += rng.normal(0, 0.05, size=(size, size, 3))
+    return images, masks
+
+
+def main_fun(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState, build_train_step
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.models import unet
+
+    cfg = unet.UNetConfig.tiny() if args.tiny else unet.UNetConfig()
+    model = unet.UNet(cfg)
+    mesh = make_mesh()
+    rng = np.random.default_rng(ctx.executor_id)
+
+    params = model.init(
+        jax.random.PRNGKey(0),
+        np.zeros((2, args.size, args.size, 3), np.float32),
+    )["params"]
+    psh = unet.unet_param_shardings(params, mesh)
+    params = jax.tree.map(jax.device_put, params, psh)
+    tx = optax.adam(1e-3)
+    state = TrainState.create(params, tx)
+    step = build_train_step(unet.loss_fn(model), tx, mesh, param_shardings=psh)
+
+    t0 = time.time()
+    loss = None
+    for i in range(args.steps):
+        images, masks = _render_circles(rng, args.batch_size, args.size)
+        state, loss = step(
+            state, shard_batch(mesh, {"image": images, "mask": masks})
+        )
+        if (i + 1) % 20 == 0:
+            print(
+                f"node{ctx.executor_id} step {i + 1} loss {float(loss):.4f}"
+            )
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+
+    images, masks = _render_circles(rng, args.batch_size, args.size)
+    miou = unet.iou(
+        model,
+        jax.device_get(state.params),
+        {"image": images, "mask": masks},
+        cfg.num_classes,
+    )
+    print(
+        f"node{ctx.executor_id}: {args.steps} steps in {dt:.1f}s, "
+        f"final loss {float(loss):.4f}, mIoU {float(miou):.3f}"
+    )
+    if args.model_dir and ctx.is_chief:
+        ctx.export_saved_model(jax.device_get(state.params), args.model_dir)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--size", type=int, default=64)
+    p.add_argument("--model-dir", default=None)
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args(argv)
+
+
+if __name__ == "__main__":
+    from tensorflowonspark_tpu.cluster import tfcluster
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+    from tensorflowonspark_tpu.launcher import cluster_args_from_env
+    from tensorflowonspark_tpu.utils.util import cpu_only_env
+
+    args = parse_args()
+    largs = cluster_args_from_env()
+    cluster = tfcluster.run(
+        main_fun,
+        args,
+        num_executors=largs["num_executors"],
+        input_mode=InputMode.TENSORFLOW,
+        env=cpu_only_env() if args.cpu else None,
+        launcher=largs.get("launcher"),
+        distributed=largs.get("distributed", False),
+    )
+    cluster.shutdown()
+    print("unet_segmentation done")

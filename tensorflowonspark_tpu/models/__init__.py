@@ -25,3 +25,8 @@ from tensorflowonspark_tpu.models.resnet import (  # noqa: F401
     ResNetConfig,
     resnet_param_shardings,
 )
+from tensorflowonspark_tpu.models.unet import (  # noqa: F401
+    UNet,
+    UNetConfig,
+    unet_param_shardings,
+)

@@ -36,6 +36,44 @@ def test_mnist_mlp_trains():
     assert float(l) < l0
 
 
+def test_unet_trains_and_shards():
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+    from tensorflowonspark_tpu.models import unet
+
+    cfg = unet.UNetConfig.tiny()
+    model = unet.UNet(cfg)
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(rng.normal(size=(4, 16, 16, 3)), jnp.float32),
+        "mask": jnp.asarray(rng.integers(0, 3, size=(4, 16, 16))),
+    }
+    params = model.init(jax.random.PRNGKey(0), batch["image"])["params"]
+    logits = model.apply({"params": params}, batch["image"])
+    assert logits.shape == (4, 16, 16, 3)
+    assert logits.dtype == jnp.float32
+
+    mesh = make_mesh({"data": -1, "fsdp": 2})
+    shardings = unet.unet_param_shardings(params, mesh)
+    params = jax.tree.map(jax.device_put, params, shardings)
+    loss = unet.loss_fn(model)
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        l, g = jax.value_and_grad(loss)(params, batch)
+        upd, opt_state = tx.update(g, opt_state)
+        return optax.apply_updates(params, upd), opt_state, l
+
+    l0 = None
+    for _ in range(5):
+        params, opt_state, l = step(params, opt_state, batch)
+        l0 = l0 if l0 is not None else float(l)
+    assert float(l) < l0
+    m_iou = unet.iou(model, params, batch, cfg.num_classes)
+    assert 0.0 <= float(m_iou) <= 1.0
+
+
 def test_mnist_cnn_forward():
     model = mnist.CNN()
     batch = mnist.synthetic_batch(1, 4)
