@@ -36,6 +36,7 @@ class TFNodeContext:
     coordinator_address: str | None = None
     distributed: bool = False
     tb_port: int | None = None
+    log_dir: str | None = None
     extras: dict[str, Any] = field(default_factory=dict)
 
     # --- reference-compat aliases -------------------------------------
@@ -81,13 +82,9 @@ class TFNodeContext:
         qualified paths pass through; absolute paths go under default_fs;
         relative paths resolve against the working dir.
         """
-        if "://" in path:  # fully qualified (hdfs://, gs://, file://, ...)
-            return path
-        if path.startswith("/"):
-            fs = self.default_fs.rstrip("/")
-            return f"{fs}{path}" if fs and "://" in self.default_fs else path
-        base = self.working_dir.rstrip("/")
-        return f"{base}/{path}"
+        from tensorflowonspark_tpu.utils.util import resolve_path
+
+        return resolve_path(path, self.default_fs, self.working_dir)
 
     # --- distributed runtime --------------------------------------------
     def initialize_distributed(self) -> None:
@@ -129,6 +126,25 @@ class TFNodeContext:
         from tensorflowonspark_tpu.compute.mesh import make_mesh
 
         return make_mesh(axis_shapes)
+
+    def metrics_writer(self, log_dir: str | None = None):
+        """Per-node step-metrics writer (SURVEY.md §5.5).
+
+        Writes under ``{log_dir}/node{N}/`` so the chief's tensorboard
+        (``run(tensorboard=True, log_dir=...)``) aggregates every node's
+        scalars — the host-0-aggregator pattern. TB event files when
+        TensorFlow is importable, JSONL otherwise (same API).
+        """
+        from tensorflowonspark_tpu.utils.metrics import MetricsWriter
+
+        base = log_dir or self.log_dir
+        if base is None:
+            raise ValueError(
+                "no log_dir: pass one here or to TFCluster.run(log_dir=...)"
+            )
+        return MetricsWriter(
+            f"{self.absolute_path(base).rstrip('/')}/node{self.executor_id}"
+        )
 
     def export_saved_model(self, state, export_dir: str, **kwargs) -> str:
         """Chief-only model export (reference: ``TFNodeContext.export_saved_model``).

@@ -118,11 +118,16 @@ class HostListLauncher:
         self,
         hosts: Sequence[str],
         cmd_template: str = "ssh {host} {command}",
-        python: str = "python",
+        python: str | None = None,
+        env: dict[str, str] | None = None,
     ):
         self.hosts = list(hosts)
         self.cmd_template = cmd_template
-        self.python = python
+        # sys.executable, not bare "python": PATH on the remote side may
+        # name a different interpreter (or none) — callers with genuinely
+        # heterogeneous hosts can still pass python="python3" etc.
+        self.python = python or sys.executable
+        self.env = dict(env or {})
         self._procs: list[subprocess.Popen] = []
 
     def launch(
@@ -138,11 +143,20 @@ class HostListLauncher:
                 f"{num_nodes} nodes requested but {len(self.hosts)} hosts "
                 "configured"
             )
+        # Env must be on the remote command line (a local os.environ set
+        # would not cross the ssh boundary).
+        env_prefix = ""
+        if self.env:
+            assignments = " ".join(
+                f"{k}={shlex.quote(v)}" for k, v in self.env.items()
+            )
+            env_prefix = f"env {assignments} "
         commands = []
         for i in range(num_nodes):
             payload = encode_payload(*args_for(i))
             commands.append(
-                f"{self.python} -m tensorflowonspark_tpu.cluster.node_main "
+                f"{env_prefix}{self.python} "
+                f"-m tensorflowonspark_tpu.cluster.node_main "
                 f"--payload {payload}"
             )
         self.launch_command(commands)

@@ -103,10 +103,19 @@ def run_node(
     port = util.find_free_port()
     host = util.get_ip_address()
 
-    # 3. optional tensorboard on chief (reference: _mapfn tensorboard spawn)
+    # 3. optional tensorboard on chief (reference: _mapfn tensorboard spawn).
+    #    The log dir resolves exactly like ctx.metrics_writer's, so the
+    #    chief's TB aggregates what the nodes write.
+    log_dir = cluster_meta.get("log_dir")
+    if log_dir:
+        log_dir = util.resolve_path(
+            log_dir,
+            cluster_meta.get("default_fs", ""),
+            cluster_meta.get("working_dir", ""),
+        )
     tb_port, tb_pid = None, 0
     if cluster_meta.get("tensorboard") and executor_id == 0:
-        tb_port, tb_pid = _maybe_start_tensorboard(cluster_meta.get("log_dir"))
+        tb_port, tb_pid = _maybe_start_tensorboard(log_dir)
 
     # 3b. optional per-host jax.profiler trace server (SURVEY.md §5.1: the
     #     coordinator-knows-every-host's-profiler-URL pattern; the TPU
@@ -154,6 +163,8 @@ def run_node(
         mgr=mgr,
         coordinator_address=f"{chief['host']}:{chief['port']}",
         distributed=cluster_meta.get("distributed", False),
+        tb_port=tb_port,
+        log_dir=log_dir,
     )
 
     # 5. run the user fn; ferry exceptions to the driver via the error queue

@@ -512,7 +512,17 @@ def run(
         cluster_template,
     )
 
-    launcher = launcher or LocalLauncher(env=env)
+    if launcher is None:
+        launcher = LocalLauncher(env=env)
+    elif env:
+        # A custom launcher must actually carry the env to its nodes —
+        # silently dropping it would e.g. let TPU-plugin boot hooks dial
+        # the chip from processes the caller asked to keep CPU-only.
+        if getattr(launcher, "env", None) is None:
+            raise ValueError(
+                f"launcher {type(launcher).__name__} does not support env="
+            )
+        launcher.env.update(env)
     try:
         launcher.launch(
             num_executors,

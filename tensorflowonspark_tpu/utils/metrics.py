@@ -1,0 +1,93 @@
+"""Step-metric writing: TensorBoard scalars with per-node aggregation.
+
+Reference parity: the reference had no metrics pipeline of its own
+(SURVEY.md §5.5) — per-process ``logging`` plus whatever the user's TF code
+wrote to TensorBoard. The rebuild makes the common case first-class: every
+node gets a :class:`MetricsWriter` under ``log_dir/node{N}/``, and the
+chief's tensorboard (``TFCluster.run(tensorboard=True, log_dir=...)``)
+aggregates all nodes' runs — the "host-0 aggregator" pattern with zero
+extra plumbing.
+
+Backend: ``tf.summary`` event files when TensorFlow is importable (so plain
+TensorBoard reads them), else a JSONL fallback with the same API.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+__all__ = ["MetricsWriter"]
+
+
+class MetricsWriter:
+    """Write scalar step metrics; TB event files or JSONL fallback."""
+
+    def __init__(self, log_dir: str, use_tensorboard: bool | None = None):
+        self.log_dir = log_dir
+        remote = "://" in log_dir  # gs://, hdfs://, ... — TF filesystems
+        if not remote:
+            os.makedirs(log_dir, exist_ok=True)
+        self._tb = None
+        self._jsonl = None
+        if use_tensorboard is None or use_tensorboard:
+            try:
+                import tensorflow as tf
+
+                self._tb = tf.summary.create_file_writer(log_dir)
+            except Exception:
+                if use_tensorboard:
+                    raise
+        if self._tb is None:
+            if remote:
+                raise ValueError(
+                    f"metrics log_dir {log_dir!r} is a filesystem URI; the "
+                    "JSONL fallback only writes local paths (install/enable "
+                    "TensorFlow for remote filesystems)"
+                )
+            self._jsonl = open(
+                os.path.join(log_dir, "metrics.jsonl"), "a", buffering=1
+            )
+
+    def scalar(self, name: str, value: Any, step: int) -> None:
+        if self._tb is not None:
+            import tensorflow as tf
+
+            with self._tb.as_default():
+                tf.summary.scalar(name, float(value), step=step)
+        else:
+            self._jsonl.write(
+                json.dumps(
+                    {
+                        "name": name,
+                        "value": float(value),
+                        "step": int(step),
+                        "ts": time.time(),
+                    }
+                )
+                + "\n"
+            )
+
+    def scalars(self, values: dict[str, Any], step: int) -> None:
+        for name, value in values.items():
+            self.scalar(name, value, step)
+
+    def flush(self) -> None:
+        if self._tb is not None:
+            self._tb.flush()
+        else:
+            self._jsonl.flush()
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
+        else:
+            self._jsonl.close()
+
+    def __enter__(self) -> "MetricsWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

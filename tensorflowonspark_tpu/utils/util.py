@@ -14,6 +14,24 @@ import socket
 EXECUTOR_ID_FILE = "executor_id"
 
 
+def resolve_path(path: str, default_fs: str = "", working_dir: str = "") -> str:
+    """Resolve a user path against a default FS / working dir.
+
+    Reference: ``TFNode.py:hdfs_path`` resolution matrix — scheme-qualified
+    paths pass through; absolute paths go under default_fs (when it is a
+    scheme URI); relative paths resolve against the working dir (cwd when
+    unset). Shared by ``TFNodeContext.absolute_path`` and the node
+    runtime's tensorboard/log-dir handling so they always agree.
+    """
+    if "://" in path:  # fully qualified (hdfs://, gs://, file://, ...)
+        return path
+    if path.startswith("/"):
+        fs = default_fs.rstrip("/")
+        return f"{fs}{path}" if fs and "://" in default_fs else path
+    base = (working_dir or os.getcwd()).rstrip("/")
+    return f"{base}/{path}"
+
+
 def get_ip_address() -> str:
     """Best-effort externally-routable IP of this host.
 

@@ -132,6 +132,22 @@ def terminate_after_fn(args, ctx):
         f.write(str(seen))
 
 
+def stalling_consumer_fn(args, ctx):
+    """Reads one batch then stops pulling forever (feed-timeout injection)."""
+    import time
+
+    feed = ctx.get_data_feed(train_mode=True)
+    feed.next_batch(4)
+    time.sleep(600)
+
+
+def crashing_consumer_fn(args, ctx):
+    """Reads one batch then hard-crashes the node process (no error ferry)."""
+    feed = ctx.get_data_feed(train_mode=True)
+    feed.next_batch(4)
+    os._exit(3)
+
+
 def sum_sizes_fn(args, ctx):
     """Sum len() of byte records; writes 'total count' like sum_fn."""
     import os

@@ -1,0 +1,67 @@
+"""Metrics writer tests (SURVEY.md §5.5: step scalars + host-0 aggregation)."""
+
+import glob
+import json
+
+import pytest
+
+from tensorflowonspark_tpu.utils.metrics import MetricsWriter
+
+
+def test_jsonl_fallback(tmp_path):
+    with MetricsWriter(str(tmp_path), use_tensorboard=False) as w:
+        w.scalar("loss", 1.5, step=1)
+        w.scalars({"loss": 1.25, "lr": 1e-3}, step=2)
+    rows = [
+        json.loads(line) for line in open(tmp_path / "metrics.jsonl")
+    ]
+    assert [(r["name"], r["value"], r["step"]) for r in rows] == [
+        ("loss", 1.5, 1),
+        ("loss", 1.25, 2),
+        ("lr", 1e-3, 2),
+    ]
+
+
+def test_tensorboard_backend(tmp_path):
+    pytest.importorskip("tensorflow")
+    with MetricsWriter(str(tmp_path), use_tensorboard=True) as w:
+        w.scalar("loss", 0.5, step=3)
+    assert glob.glob(str(tmp_path / "events.out.tfevents.*"))
+
+
+def test_context_metrics_writer_per_node_dir(tmp_path):
+    from tensorflowonspark_tpu.cluster.context import TFNodeContext
+
+    ctx = TFNodeContext(
+        executor_id=2,
+        job_name="worker",
+        task_index=1,
+        cluster_info=[],
+        num_workers=3,
+        default_fs="",
+        working_dir=str(tmp_path),
+        log_dir="logs",
+    )
+    w = ctx.metrics_writer()
+    w.scalar("x", 1.0, step=0)
+    w.close()
+    assert (
+        glob.glob(str(tmp_path / "logs" / "node2" / "events.out.tfevents.*"))
+        or (tmp_path / "logs" / "node2" / "metrics.jsonl").exists()
+    )
+
+
+def test_context_metrics_writer_requires_log_dir(tmp_path):
+    from tensorflowonspark_tpu.cluster.context import TFNodeContext
+
+    ctx = TFNodeContext(
+        executor_id=0,
+        job_name="chief",
+        task_index=0,
+        cluster_info=[],
+        num_workers=1,
+        default_fs="",
+        working_dir=str(tmp_path),
+    )
+    with pytest.raises(ValueError, match="log_dir"):
+        ctx.metrics_writer()

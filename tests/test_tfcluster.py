@@ -194,6 +194,73 @@ def test_profiler_urls(tmp_path):
     assert 0 in urls and ":" in urls[0]
 
 
+def test_hostlist_launcher_local_shell(tmp_path):
+    """HostListLauncher end-to-end with a local shell standing in for ssh:
+    exercises the node_main payload path (encode -> CLI -> run_node)."""
+    from tensorflowonspark_tpu.cluster.launchers import HostListLauncher
+
+    launcher = HostListLauncher(
+        hosts=["hostA", "hostB"], cmd_template='sh -c "{command}"'
+    )
+    cluster = tfcluster.run(
+        cluster_fns.sum_fn,
+        {"out_dir": str(tmp_path)},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        launcher=launcher,
+        env=NODE_ENV,
+    )
+    partitions = [[(i,) for i in range(p * 10, (p + 1) * 10)] for p in range(4)]
+    cluster.train(partitions)
+    cluster.shutdown(timeout=120)
+    totals = [
+        int(open(tmp_path / f"node{i}.txt").read().split()[0]) for i in range(2)
+    ]
+    assert sum(totals) == sum(range(40))
+
+
+def test_feed_timeout_on_stalled_consumer(tmp_path):
+    """Fault injection (SURVEY §4 gap): a consumer that stops pulling must
+    surface as a feed TimeoutError in the driver, not a silent hang."""
+    cluster = tfcluster.run(
+        cluster_fns.stalling_consumer_fn,
+        {},
+        num_executors=1,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        queue_maxsize=2,
+        use_shm_ring=False,  # exercise manager-queue backpressure
+        env=NODE_ENV,
+    )
+    # >> queue_maxsize chunks so the producer must block on the full queue
+    partitions = [[(i,) for i in range(4096)]]
+    with pytest.raises(TimeoutError, match="feeding partition"):
+        cluster.train(partitions, feed_timeout=5)
+    with pytest.raises(RuntimeError):  # watchdog force-kill -> nonzero exit
+        cluster.shutdown(timeout=5)
+
+
+def test_node_crash_mid_feed(tmp_path):
+    """Fault injection: a node that hard-crashes (no error ferry) must fail
+    the train call and shutdown must report the nonzero exit."""
+    cluster = tfcluster.run(
+        cluster_fns.crashing_consumer_fn,
+        {},
+        num_executors=1,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        queue_maxsize=2,
+        use_shm_ring=False,
+        env=NODE_ENV,
+    )
+    partitions = [[(i,) for i in range(4096)]]
+    with pytest.raises((TimeoutError, ConnectionError, EOFError, OSError)):
+        cluster.train(partitions, feed_timeout=10)
+    with pytest.raises(RuntimeError, match="nonzero"):
+        cluster.shutdown(timeout=10)
+
+
 def test_shm_ring_oversized_chunks(tmp_path):
     """Chunks whose pickle exceeds the ring are split, not dropped: feed
     records far bigger than a 1 MiB ring and check every byte arrives."""
