@@ -46,43 +46,20 @@ def main_fun(args, ctx):
     state = TrainState.create(params, tx)
     step = build_train_step(mnist.loss_fn(model.apply), tx, mesh)
 
-    # DataFeed returns a partial batch at every EndPartition boundary, not
-    # just the feed tail — buffer across them so no records are dropped.
-    dc = jax.device_count()
-    buf: dict[str, list] = {"image": [], "label": []}
+    # batch_stream re-buffers EndPartition partials into steady jit shapes;
+    # the tail is trimmed to a device-count multiple so it still shards.
     steps = 0
-
-    def train_buffered(state, min_take: int):
-        nonlocal steps
-        loss = None
-        while len(buf["label"]) >= max(min_take, dc):
-            n = min(args.batch_size, len(buf["label"]))
-            n -= n % dc
-            if n == 0:
-                break
-            batch = {
-                "image": np.asarray(buf["image"][:n], np.float32).reshape(
-                    n, 28, 28, 1
-                )
-                / 255.0,
-                "label": np.asarray(buf["label"][:n], np.int32),
-            }
-            del buf["image"][:n], buf["label"][:n]
-            state, loss = step(state, shard_batch(mesh, batch))
-            steps += 1
-            if steps % 20 == 0:
-                print(
-                    f"node{ctx.executor_id} step {steps} "
-                    f"loss {float(loss):.4f}"
-                )
-        return state
-
-    while not feed.should_stop():
-        cols = feed.next_batch(args.batch_size)
-        buf["image"].extend(cols["image"])
-        buf["label"].extend(cols["label"])
-        state = train_buffered(state, args.batch_size)
-    state = train_buffered(state, dc)  # flush the tail (< dc rows dropped)
+    for cols in feed.batch_stream(args.batch_size, multiple_of=jax.device_count()):
+        n = len(cols["label"])
+        batch = {
+            "image": np.asarray(cols["image"], np.float32).reshape(n, 28, 28, 1)
+            / 255.0,
+            "label": np.asarray(cols["label"], np.int32),
+        }
+        state, loss = step(state, shard_batch(mesh, batch))
+        steps += 1
+        if steps % 20 == 0:
+            print(f"node{ctx.executor_id} step {steps} loss {float(loss):.4f}")
 
     if args.model_dir and ctx.is_chief:
         ctx.export_saved_model(

@@ -42,19 +42,21 @@ class LocalLauncher:
         num_nodes: int,
         target: Callable[..., None],
         args_for: Callable[[int], tuple],
+        env: dict[str, str] | None = None,
     ) -> None:
+        merged = {**self.env, **(env or {})}
         ctx = mp.get_context("spawn")
         # Env vars must be in place BEFORE the child interpreter boots:
         # sitecustomize-style hooks (e.g. TPU plugin registration) run at
         # interpreter start, long before _child_main gets to apply env.
         # Spawn inherits the parent's environ at exec, so set/restore here.
-        saved = {k: os.environ.get(k) for k in self.env}
-        os.environ.update(self.env)
+        saved = {k: os.environ.get(k) for k in merged}
+        os.environ.update(merged)
         try:
             for i in range(num_nodes):
                 proc = ctx.Process(
                     target=_child_main,
-                    args=(dict(self.env), target, args_for(i)),
+                    args=(merged, target, args_for(i)),
                     name=f"tfos-node-{i}",
                     daemon=False,
                 )
@@ -108,10 +110,12 @@ class HostListLauncher:
     """Launch one node process per remote host via a command template.
 
     Runs ``python -m tensorflowonspark_tpu.cluster.node_main --payload ...``
-    on each host through ``cmd_template`` (plain ssh by default). This is
-    the spark-submit-shaped path for real pods; the user ``map_fun``'s
-    module must be importable on every host (the contract Spark imposed on
-    the reference's ``map_fun`` too).
+    on each host through ``cmd_template`` (plain ssh by default; reference
+    ``{command}`` unquoted — it is substituted pre-quoted as one shell
+    word, see :meth:`launch_command`). This is the spark-submit-shaped
+    path for real pods; the user ``map_fun``'s module must be importable
+    on every host (the contract Spark imposed on the reference's
+    ``map_fun`` too).
     """
 
     def __init__(
@@ -135,6 +139,7 @@ class HostListLauncher:
         num_nodes: int,
         target: Callable[..., None],
         args_for: Callable[[int], tuple],
+        env: dict[str, str] | None = None,
     ) -> None:
         from tensorflowonspark_tpu.cluster.node_main import encode_payload
 
@@ -145,10 +150,11 @@ class HostListLauncher:
             )
         # Env must be on the remote command line (a local os.environ set
         # would not cross the ssh boundary).
+        merged = {**self.env, **(env or {})}
         env_prefix = ""
-        if self.env:
+        if merged:
             assignments = " ".join(
-                f"{k}={shlex.quote(v)}" for k, v in self.env.items()
+                f"{k}={shlex.quote(v)}" for k, v in merged.items()
             )
             env_prefix = f"env {assignments} "
         commands = []
@@ -162,11 +168,22 @@ class HostListLauncher:
         self.launch_command(commands)
 
     def launch_command(self, commands: Sequence[str]) -> None:
+        """Run one command per host through the template.
+
+        ``{command}`` is substituted pre-quoted as ONE shell word, and the
+        full line runs through the local shell — so every template sees
+        exactly two shell parses: local (strips the quoting; the command
+        reaches ssh/sh as a single argument) and remote/inner (parses the
+        command itself, where per-value ``shlex.quote``s apply). This is
+        what lets env values with spaces survive an ssh hop.
+        """
         assert len(commands) == len(self.hosts)
         for host, command in zip(self.hosts, commands):
-            full = self.cmd_template.format(host=host, command=command)
+            full = self.cmd_template.format(
+                host=shlex.quote(host), command=shlex.quote(command)
+            )
             logger.info("launching on %s: %s", host, full)
-            self._procs.append(subprocess.Popen(shlex.split(full)))
+            self._procs.append(subprocess.Popen(full, shell=True))
 
     def wait(self, timeout: float | None = None) -> bool:
         deadline = None if timeout is None else time.monotonic() + timeout

@@ -513,21 +513,16 @@ def run(
     )
 
     if launcher is None:
-        launcher = LocalLauncher(env=env)
-    elif env:
-        # A custom launcher must actually carry the env to its nodes —
-        # silently dropping it would e.g. let TPU-plugin boot hooks dial
-        # the chip from processes the caller asked to keep CPU-only.
-        if getattr(launcher, "env", None) is None:
-            raise ValueError(
-                f"launcher {type(launcher).__name__} does not support env="
-            )
-        launcher.env.update(env)
+        launcher = LocalLauncher()
     try:
+        # env rides the launch call (never mutate a caller's launcher):
+        # per-node interpreters must see it at boot, when TPU-plugin
+        # sitecustomize hooks run.
         launcher.launch(
             num_executors,
             tfnode_runtime.run_node,
             lambda i: (i, map_fun, tf_args, cluster_meta),
+            env=env,
         )
     except Exception:
         launcher.terminate()

@@ -64,6 +64,13 @@ class DataFeed:
         empty/partial batch with ``should_stop() == True`` once
         :class:`EndOfFeed` is seen. Reference: ``TFNode.py:DataFeed.next_batch``.
         """
+        batch = self._next_raw(batch_size)
+        if self.input_mapping is None:
+            return batch
+        return self._columnize(batch)
+
+    def _next_raw(self, batch_size: int) -> list:
+        """``next_batch`` core: up to ``batch_size`` raw records, no mapping."""
         batch: list[Any] = []
         while len(batch) < batch_size:
             take = batch_size - len(batch)
@@ -87,9 +94,7 @@ class DataFeed:
                 self._buffer.extend(item)
             else:  # single record (legacy per-item producers)
                 batch.append(item)
-        if self.input_mapping is None:
-            return batch
-        return self._columnize(batch)
+        return batch
 
     def _columnize(self, batch: Sequence[Any]) -> dict[str, np.ndarray]:
         """Stack a list of row-records into {tensor_name: array} columns."""
@@ -97,6 +102,43 @@ class DataFeed:
         for i, tensor in enumerate(self.input_tensors):
             out[tensor] = np.array([row[i] for row in batch])
         return out
+
+    def batch_stream(self, batch_size: int, multiple_of: int = 1):
+        """Yield fixed-size batches, buffering across partition boundaries.
+
+        ``next_batch`` returns *partial* batches at every
+        :class:`EndPartition` (the reference contract) — every training
+        loop that wants steady shapes for ``jit`` must re-buffer them.
+        This generator does that once, centrally: every yielded batch has
+        exactly ``batch_size`` records — rounded down to a multiple of
+        ``multiple_of`` so full batches shard — until the feed tail, which
+        is trimmed to the largest multiple of ``multiple_of`` (pass
+        ``jax.device_count()``; the sub-multiple remainder is dropped with
+        a log line, like the reference's drop-remainder datasets).
+        """
+        # Full batches must shard too, not just the tail.
+        batch_size -= batch_size % multiple_of
+        if batch_size == 0:
+            raise ValueError(
+                f"batch_size < multiple_of ({multiple_of}); nothing to yield"
+            )
+        mapping = self.input_mapping
+        pending: list[Any] = []
+        while not self.should_stop():
+            pending.extend(self._next_raw(batch_size - len(pending)))
+            if len(pending) == batch_size:
+                yield self._columnize(pending) if mapping else pending
+                pending = []
+        tail = len(pending) - len(pending) % multiple_of
+        if len(pending) % multiple_of:
+            logger.warning(
+                "batch_stream dropping %d tail records (not a multiple of %d)",
+                len(pending) % multiple_of,
+                multiple_of,
+            )
+        if tail:
+            pending = pending[:tail]
+            yield self._columnize(pending) if mapping else pending
 
     def should_stop(self) -> bool:
         """True once the feed is exhausted. Reference: ``DataFeed.should_stop``."""

@@ -35,11 +35,15 @@ class MetricsWriter:
         if use_tensorboard is None or use_tensorboard:
             try:
                 import tensorflow as tf
-
-                self._tb = tf.summary.create_file_writer(log_dir)
-            except Exception:
+            except ImportError:
                 if use_tensorboard:
                     raise
+                tf = None
+            if tf is not None:
+                # Writer-creation failures (bad URI, missing filesystem
+                # plugin, permissions) must propagate — silently degrading
+                # to JSONL would hide scalars from the chief's TB.
+                self._tb = tf.summary.create_file_writer(log_dir)
         if self._tb is None:
             if remote:
                 raise ValueError(

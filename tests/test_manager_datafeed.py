@@ -102,3 +102,37 @@ def test_producer_consumer_threads(mgr):
     t.join()
     assert len(seen) == total
     assert [r[0] for r in seen] == list(range(total))
+
+
+def test_batch_stream_buffers_across_partitions(mgr):
+    """batch_stream re-buffers EndPartition partials into steady shapes."""
+    q = mgr.get_queue("input")
+    # partitions of 7, 5, 6 records -> 18 total; batch_size 4 -> 4 full + tail 2
+    n = 0
+    for size in (7, 5, 6):
+        q.put([(n + i,) for i in range(size)])
+        n += size
+        q.put(EndPartition())
+    q.put(EndOfFeed())
+    feed = DataFeed(mgr, train_mode=True)
+    batches = list(feed.batch_stream(4))
+    assert [len(b) for b in batches] == [4, 4, 4, 4, 2]
+    flat = [r[0] for b in batches for r in b]
+    assert flat == list(range(18))
+
+
+def test_batch_stream_tail_trim_and_mapping(mgr):
+    q = mgr.get_queue("input")
+    q.put([(i, i * 10) for i in range(11)])
+    q.put(EndPartition())
+    q.put(EndOfFeed())
+    feed = DataFeed(
+        mgr, train_mode=True, input_mapping={"a": "x", "b": "y"}
+    )
+    # multiple_of=4: 11 records -> one full batch of 8, tail of 3 dropped... 
+    # batch_size 8 -> first batch 8, pending 3, tail trimmed to 0
+    batches = list(feed.batch_stream(8, multiple_of=4))
+    assert len(batches) == 1
+    np.testing.assert_array_equal(batches[0]["x"], np.arange(8))
+    np.testing.assert_array_equal(batches[0]["y"], np.arange(8) * 10)
+    assert feed.input_mapping is not None  # restored after the generator

@@ -1,0 +1,126 @@
+"""ThreadSanitizer tier for the C++ feed path (SURVEY.md §5.2: "any C++
+feed code gets TSAN in CI").
+
+Builds a TSAN-instrumented copy of the native library and stress-runs the
+shm ring producer/consumer concurrently in a subprocess (TSAN must own the
+process from exec, hence LD_PRELOAD rather than in-process dlopen).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.e2e
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "tensorflowonspark_tpu", "native"
+)
+
+DRIVER = r"""
+import ctypes, threading, sys
+
+lib = ctypes.CDLL(sys.argv[1])
+c = ctypes
+lib.shmring_create.restype = c.c_void_p
+lib.shmring_create.argtypes = [c.c_char_p, c.c_uint64]
+lib.shmring_open.restype = c.c_void_p
+lib.shmring_open.argtypes = [c.c_char_p]
+lib.shmring_push.restype = c.c_int
+lib.shmring_push.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64, c.c_int64]
+lib.shmring_pop.restype = c.c_int64
+lib.shmring_pop.argtypes = [c.c_void_p, c.POINTER(c.c_uint8), c.c_uint64]
+lib.shmring_peek_len.restype = c.c_int64
+lib.shmring_peek_len.argtypes = [c.c_void_p, c.c_int64]
+lib.shmring_close_write.restype = None
+lib.shmring_close_write.argtypes = [c.c_void_p]
+lib.shmring_detach.restype = None
+lib.shmring_detach.argtypes = [c.c_void_p]
+lib.shmring_unlink.restype = c.c_int
+lib.shmring_unlink.argtypes = [c.c_char_p]
+
+NAME = b"/tfos_tsan_test"
+N = 2000
+lib.shmring_unlink(NAME)
+cons = lib.shmring_create(NAME, 1 << 16)  # small ring: force wraparound
+assert cons
+prod = lib.shmring_open(NAME)
+assert prod
+
+def produce():
+    for i in range(N):
+        payload = (b"%06d" % i) * 11
+        rc = lib.shmring_push(prod, payload, len(payload), 10_000)
+        assert rc == 0, rc
+    lib.shmring_close_write(prod)
+
+t = threading.Thread(target=produce)
+t.start()
+got = 0
+while True:
+    n = lib.shmring_peek_len(cons, 10_000)  # size next record (ms timeout)
+    if n == -2:  # closed and drained
+        break
+    assert n > 0, n
+    buf = (c.c_uint8 * n)()
+    m = lib.shmring_pop(cons, buf, n)
+    assert m == n, (m, n)
+    got += 1
+t.join()
+assert got == N, (got, N)
+lib.shmring_detach(prod)
+lib.shmring_detach(cons)
+lib.shmring_unlink(NAME)
+print("TSAN_DRIVER_OK")
+"""
+
+
+def _libtsan():
+    try:
+        out = subprocess.run(
+            ["g++", "-print-file-name=libtsan.so"],
+            capture_output=True,
+            text=True,
+            check=True,
+        ).stdout.strip()
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    # g++ echoes the bare name back when the runtime is not installed
+    return out if os.path.isabs(out) and os.path.exists(out) else None
+
+
+@pytest.fixture(scope="module")
+def tsan_lib(tmp_path_factory):
+    if _libtsan() is None:
+        pytest.skip("libtsan not available")
+    lib_path = str(tmp_path_factory.mktemp("tsan") / "libtfos_tsan.so")
+    srcs = [
+        os.path.join(NATIVE_DIR, s) for s in ("tfrecord.cc", "shmring.cc")
+    ]
+    subprocess.run(
+        ["g++", "-O1", "-g", "-std=c++17", "-shared", "-fPIC",
+         "-fsanitize=thread", *srcs, "-o", lib_path, "-lrt", "-pthread"],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+    return lib_path
+
+
+def test_shmring_concurrent_push_pop_tsan_clean(tsan_lib, tmp_path):
+    driver = tmp_path / "driver.py"
+    driver.write_text(DRIVER)
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = _libtsan()
+    env["TSAN_OPTIONS"] = "halt_on_error=0 exitcode=66"
+    proc = subprocess.run(
+        [sys.executable, str(driver), tsan_lib],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=180,
+    )
+    assert "TSAN_DRIVER_OK" in proc.stdout, (proc.stdout, proc.stderr[-3000:])
+    assert "WARNING: ThreadSanitizer" not in proc.stderr, proc.stderr[-5000:]
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-3000:])

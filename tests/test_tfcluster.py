@@ -200,7 +200,7 @@ def test_hostlist_launcher_local_shell(tmp_path):
     from tensorflowonspark_tpu.cluster.launchers import HostListLauncher
 
     launcher = HostListLauncher(
-        hosts=["hostA", "hostB"], cmd_template='sh -c "{command}"'
+        hosts=["hostA", "hostB"], cmd_template="sh -c {command}"
     )
     cluster = tfcluster.run(
         cluster_fns.sum_fn,
@@ -209,7 +209,9 @@ def test_hostlist_launcher_local_shell(tmp_path):
         input_mode=InputMode.SPARK,
         reservation_timeout=120,
         launcher=launcher,
-        env=NODE_ENV,
+        # The space-containing value proves env quoting survives the
+        # template's two shell parses (the ssh-hop failure mode).
+        env={**NODE_ENV, "XLA_FLAGS": "--xla_a=1 --xla_b=2"},
     )
     partitions = [[(i,) for i in range(p * 10, (p + 1) * 10)] for p in range(4)]
     cluster.train(partitions)
