@@ -517,12 +517,27 @@ def run(
     try:
         # env rides the launch call (never mutate a caller's launcher):
         # per-node interpreters must see it at boot, when TPU-plugin
-        # sitecustomize hooks run.
+        # sitecustomize hooks run. Custom launchers advertise support by
+        # accepting an `env` kwarg; silently dropping it could let boot
+        # hooks dial the chip from processes the caller wanted CPU-only,
+        # so an env-less launcher + env is a loud error.
+        import inspect
+
+        sig = inspect.signature(launcher.launch).parameters
+        accepts_env = "env" in sig or any(
+            p.kind == p.VAR_KEYWORD for p in sig.values()
+        )
+        if env and not accepts_env:
+            raise ValueError(
+                f"launcher {type(launcher).__name__}.launch() does not "
+                "accept env=; it cannot carry env vars to node processes"
+            )
+        launch_kwargs = {"env": env} if accepts_env else {}
         launcher.launch(
             num_executors,
             tfnode_runtime.run_node,
             lambda i: (i, map_fun, tf_args, cluster_meta),
-            env=env,
+            **launch_kwargs,
         )
     except Exception:
         launcher.terminate()

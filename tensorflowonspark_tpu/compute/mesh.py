@@ -94,11 +94,23 @@ def data_parallel_size(mesh: Mesh) -> int:
 
 
 def shard_batch(mesh: Mesh, batch):
-    """Place a host-global numpy batch onto the mesh, sharded on the batch dim.
+    """Place a host batch onto the mesh, sharded on the batch dim.
 
-    Single-controller path (one process sees all devices). Multi-host uses
-    :func:`jax.make_array_from_process_local_data` via the infeed module.
+    Single-controller: ``device_put`` of the full batch. Multi-process
+    (``jax.process_count() > 1``): each process passes its *local* slice
+    of the global batch — the per-host share the feed plane delivered —
+    and :func:`jax.make_array_from_process_local_data` assembles the
+    global array (the TPU equivalent of the reference's per-worker
+    MWMS input pipelines: every host contributes distinct data,
+    ``compat.disable_auto_shard`` semantics by construction).
     """
+    if jax.process_count() > 1:
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                batch_sharding(mesh, np.ndim(x)), np.asarray(x)
+            ),
+            batch,
+        )
     return jax.tree.map(
         lambda x: jax.device_put(x, batch_sharding(mesh, np.ndim(x))), batch
     )

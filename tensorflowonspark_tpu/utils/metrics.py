@@ -15,9 +15,12 @@ TensorBoard reads them), else a JSONL fallback with the same API.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from typing import Any
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["MetricsWriter"]
 
@@ -35,9 +38,13 @@ class MetricsWriter:
         if use_tensorboard is None or use_tensorboard:
             try:
                 import tensorflow as tf
-            except ImportError:
+            except Exception as e:  # broken installs raise non-ImportErrors
                 if use_tensorboard:
                     raise
+                logger.warning(
+                    "tensorflow unavailable (%s); metrics fall back to JSONL",
+                    e,
+                )
                 tf = None
             if tf is not None:
                 # Writer-creation failures (bad URI, missing filesystem

@@ -148,6 +148,80 @@ def crashing_consumer_fn(args, ctx):
     os._exit(3)
 
 
+def distributed_allgather_fn(args, ctx):
+    """Join jax.distributed (done by run_node), allgather across processes.
+
+    The CPU analog of multi-host pod wiring: N spawned processes, one
+    coordinator address from the roster, a real cross-process collective.
+    """
+    import json
+
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray([ctx.executor_id], np.int32)
+    )
+    out = {
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "global_devices": len(jax.devices()),
+        "gathered": np.asarray(gathered).reshape(-1).tolist(),
+    }
+    with open(
+        os.path.join(args["out_dir"], f"node{ctx.executor_id}.json"), "w"
+    ) as f:
+        json.dump(out, f)
+
+
+def distributed_train_fn(args, ctx):
+    """Multi-controller DP training: every process runs the same jit over
+    the global mesh, feeding its local half of the global batch."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState, build_train_step
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+
+    mesh = make_mesh()  # all GLOBAL devices, data-parallel
+
+    def loss_fn(params, batch):
+        pred = batch["x"] * params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    params = {"w": jnp.zeros(()), "b": jnp.zeros(())}
+    tx = optax.sgd(0.1)
+    state = TrainState.create(params, tx)
+    step = build_train_step(loss_fn, tx, mesh)
+
+    # Deterministic global data; each process feeds its own slice.
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=64).astype(np.float32)
+    y = 3.0 * x + 1.5
+    n_local = len(x) // ctx.num_workers
+    lo = ctx.executor_id * n_local
+    local = {"x": x[lo : lo + n_local], "y": y[lo : lo + n_local]}
+
+    loss = None
+    for _ in range(60):
+        state, loss = step(state, shard_batch(mesh, local))
+    out = {
+        "w": float(state.params["w"]),
+        "b": float(state.params["b"]),
+        "loss": float(loss),
+        "global_devices": len(jax.devices()),
+    }
+    with open(
+        os.path.join(args["out_dir"], f"node{ctx.executor_id}.json"), "w"
+    ) as f:
+        json.dump(out, f)
+
+
 def sum_sizes_fn(args, ctx):
     """Sum len() of byte records; writes 'total count' like sum_fn."""
     import os

@@ -129,10 +129,9 @@ def test_batch_stream_tail_trim_and_mapping(mgr):
     feed = DataFeed(
         mgr, train_mode=True, input_mapping={"a": "x", "b": "y"}
     )
-    # multiple_of=4: 11 records -> one full batch of 8, tail of 3 dropped... 
-    # batch_size 8 -> first batch 8, pending 3, tail trimmed to 0
+    # 11 records, batch_size 8, multiple_of 4: one full batch of 8; the
+    # 3-record tail is below the multiple and dropped.
     batches = list(feed.batch_stream(8, multiple_of=4))
     assert len(batches) == 1
     np.testing.assert_array_equal(batches[0]["x"], np.arange(8))
     np.testing.assert_array_equal(batches[0]["y"], np.arange(8) * 10)
-    assert feed.input_mapping is not None  # restored after the generator
