@@ -29,22 +29,8 @@ def main_fun(args, ctx):
 
     from tensorflowonspark_tpu.compute import TrainState, build_train_step
     from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
-    from tensorflowonspark_tpu.data import dfutil
+    from tensorflowonspark_tpu.data import readers
     from tensorflowonspark_tpu.models import mnist
-
-    # Per-node shard of the record files (InputMode.TENSORFLOW contract).
-    rows = [
-        r
-        for i, r in enumerate(dfutil.loadTFRecords(args.tfrecords))
-        if i % ctx.num_workers == ctx.executor_id
-    ]
-    images = (
-        np.stack([np.asarray(r["image"], np.float32) for r in rows]).reshape(
-            -1, 28, 28, 1
-        )
-        / 255.0
-    )
-    labels = np.asarray([int(r["label"]) for r in rows], np.int32)
 
     model = mnist.CNN()
     mesh = make_mesh()
@@ -55,28 +41,44 @@ def main_fun(args, ctx):
     state = TrainState.create(params, tx)
     step = build_train_step(mnist.loss_fn(model.apply), tx, mesh)
 
-    dc = jax.device_count()
-    bs = args.batch_size - args.batch_size % dc
-    if bs > len(labels):  # shard smaller than one batch: shrink, don't skip
-        bs = len(labels) - len(labels) % dc
-    if bs == 0:
+    # Streaming per-node pipeline: shard -> shuffle -> repeat -> batch
+    # (the tf.data role, InputMode.TENSORFLOW contract).
+    def preprocess(b):
+        return {
+            "image": b["image"].astype(np.float32).reshape(-1, 28, 28, 1)
+            / 255.0,
+            "label": b["label"].astype(np.int32),
+        }
+
+    batches = readers.column_batches(
+        readers.repeated(
+            lambda epoch: readers.shuffled(
+                readers.sharded_rows(
+                    args.tfrecords, ctx.executor_id, ctx.num_workers
+                ),
+                # fresh permutation each epoch, distinct per node
+                seed=ctx.executor_id * 10007 + epoch,
+            ),
+            epochs=args.epochs,
+        ),
+        args.batch_size,
+        multiple_of=jax.device_count(),
+        transform=preprocess,
+    )
+    steps, loss = 0, None
+    for batch in batches:
+        state, loss = step(state, shard_batch(mesh, batch))
+        steps += 1
+        if steps % 20 == 0:
+            print(f"node{ctx.executor_id} step {steps} loss {float(loss):.4f}")
+    if steps == 0:
         raise RuntimeError(
-            f"node{ctx.executor_id}: shard of {len(labels)} records is "
-            f"smaller than the {dc}-device mesh; nothing to train on"
+            f"node{ctx.executor_id}: shard too small for the "
+            f"{jax.device_count()}-device mesh; nothing to train on"
         )
-    steps = 0
-    for epoch in range(args.epochs):
-        for start in range(0, len(labels) - bs + 1, bs):
-            batch = {
-                "image": images[start : start + bs],
-                "label": labels[start : start + bs],
-            }
-            state, loss = step(state, shard_batch(mesh, batch))
-            steps += 1
-        print(f"node{ctx.executor_id} epoch {epoch} loss {float(loss):.4f}")
+    print(f"node{ctx.executor_id}: {steps} steps, loss {float(loss):.4f}")
 
     if args.model_dir:
-        assert steps > 0  # never export random-init params
         ctx.export_saved_model(jax.device_get(state.params), args.model_dir)
 
 

@@ -155,11 +155,39 @@ class TFEstimator(_HasParams):
         self.args = self._init_params(tf_args, params)
 
     def fit(self, data: Iterable, launcher=None, env=None) -> "TFModel":
-        """Reference: ``TFEstimator._fit`` — run TFCluster, train, shutdown."""
+        """Reference: ``TFEstimator._fit`` — run TFCluster, train, shutdown.
+
+        In ``InputMode.TENSORFLOW`` with ``tfrecord_dir`` set, ``data`` is
+        staged as TFRecords first and the nodes read the files themselves
+        (reference ``_fit``: ``dfutil.saveAsTFRecords(df)`` when
+        ``tfrecord_dir`` is configured); the path is handed to ``train_fn``
+        via ``args.tfrecord_dir``.
+        """
         from tensorflowonspark_tpu.cluster import tfcluster
         from tensorflowonspark_tpu.cluster.tfcluster import InputMode
 
         args = self.args
+        if (
+            int(args.input_mode) == InputMode.TENSORFLOW
+            and args.tfrecord_dir
+            and data is not None
+        ):
+            import glob as _glob
+            import os as _os
+
+            from tensorflowonspark_tpu.data import dfutil
+
+            # Restaging must replace, not mix: a prior (larger) run's
+            # leftover shards would otherwise be globbed in silently.
+            for stale in _glob.glob(
+                _os.path.join(args.tfrecord_dir, "part-*")
+            ):
+                _os.remove(stale)
+            rows = (
+                row if isinstance(row, dict) else self._rowdict(row)
+                for row in data
+            )
+            dfutil.saveAsTFRecords(rows, args.tfrecord_dir)
         cluster = tfcluster.run(
             self.train_fn,
             args,
@@ -177,6 +205,23 @@ class TFEstimator(_HasParams):
             cluster.train(data, num_epochs=int(args.epochs))
         cluster.shutdown(grace_secs=float(args.grace_secs))
         return TFModel(self.args, export_fn=self.export_fn)
+
+    def _rowdict(self, row) -> dict[str, Any]:
+        """Tuple row → dict keyed by input_mapping columns (the positional
+        contract of :func:`columnize`)."""
+        mapping = self.args.input_mapping
+        if mapping is None:
+            raise ValueError(
+                "tfrecord_dir staging needs dict rows or an input_mapping "
+                "naming the tuple fields in order"
+            )
+        cols = list(mapping.keys())
+        if len(row) != len(cols):
+            raise ValueError(
+                f"record has {len(row)} fields but input_mapping names "
+                f"{len(cols)} columns"
+            )
+        return dict(zip(cols, row))
 
 
 class TFModel(_HasParams):

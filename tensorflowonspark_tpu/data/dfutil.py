@@ -150,12 +150,8 @@ def saveAsTFRecords(
     return paths
 
 
-def loadTFRecords(
-    input_dir: str, binary_features: Sequence[str] = ()
-) -> Iterator[dict[str, Any]]:
-    """Iterate dict rows from TFRecord files (reference: ``loadTFRecords``)."""
-    from tensorflowonspark_tpu.native.tfrecord import read_records
-
+def tfrecord_files(input_dir: str) -> list[str]:
+    """Resolve a TFRecord directory or glob to its sorted shard paths."""
     pattern = (
         input_dir
         if any(ch in input_dir for ch in "*?[")
@@ -166,6 +162,15 @@ def loadTFRecords(
     )
     if not files:
         raise FileNotFoundError(f"no TFRecord files under {input_dir}")
-    for path in files:
+    return files
+
+
+def loadTFRecords(
+    input_dir: str, binary_features: Sequence[str] = ()
+) -> Iterator[dict[str, Any]]:
+    """Iterate dict rows from TFRecord files (reference: ``loadTFRecords``)."""
+    from tensorflowonspark_tpu.native.tfrecord import read_records
+
+    for path in tfrecord_files(input_dir):
         for serialized in read_records(path):
             yield fromTFExample(serialized, binary_features)

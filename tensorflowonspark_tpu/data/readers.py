@@ -1,0 +1,99 @@
+"""Pull-mode input readers — the ``InputMode.TENSORFLOW`` data path.
+
+Reference parity: in ``InputMode.TENSORFLOW`` the reference's nodes built
+their own ``tf.data`` pipelines over HDFS TFRecord shards (SURVEY.md §2.4,
+``examples/mnist/keras/mnist_tf.py`` pattern). These are the composable
+pieces of that role for our nodes: shard → shuffle → repeat → batch,
+streaming throughout (no whole-dataset materialization), pure Python over
+the native TFRecord codec so the hot path has no TF dependency.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["sharded_rows", "shuffled", "repeated", "column_batches"]
+
+
+def sharded_rows(
+    input_dir: str,
+    shard_index: int = 0,
+    num_shards: int = 1,
+    binary_features: Sequence[str] = (),
+) -> Iterator[dict[str, Any]]:
+    """This shard's rows of a TFRecord directory, round-robin by record.
+
+    ``sharded_rows(dir, ctx.executor_id, ctx.num_workers)`` is the per-node
+    shard — every node sees distinct records, together covering the set
+    (the reference's file-sharding / ``disable_auto_shard`` concern).
+    Sharding happens on the *serialized* record index, so a node never
+    pays proto decoding for records it does not own.
+    """
+    from tensorflowonspark_tpu.data import dfutil
+    from tensorflowonspark_tpu.native.tfrecord import read_records
+
+    i = 0
+    for path in dfutil.tfrecord_files(input_dir):
+        for serialized in read_records(path):
+            if i % num_shards == shard_index:
+                yield dfutil.fromTFExample(serialized, binary_features)
+            i += 1
+
+
+def shuffled(
+    rows: Iterable[Any], buffer_size: int = 4096, seed: int | None = None
+) -> Iterator[Any]:
+    """Streaming shuffle with a bounded reservoir (tf.data ``shuffle``)."""
+    rng = np.random.default_rng(seed)
+    buf: list[Any] = []
+    for row in rows:
+        buf.append(row)
+        if len(buf) >= buffer_size:
+            j = int(rng.integers(len(buf)))
+            buf[j], buf[-1] = buf[-1], buf[j]
+            yield buf.pop()
+    rng.shuffle(buf)
+    yield from buf
+
+
+def repeated(
+    make_rows: Callable[[int], Iterable[Any]], epochs: int | None = None
+) -> Iterator[Any]:
+    """Re-open the source per epoch (tf.data ``repeat``); None = forever.
+
+    ``make_rows`` receives the epoch index — fold it into the shuffle seed
+    so each epoch gets a fresh permutation (``reshuffle_each_iteration``),
+    not a replay of the first.
+    """
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        yield from make_rows(epoch)
+        epoch += 1
+
+
+def column_batches(
+    rows: Iterable[dict[str, Any]],
+    batch_size: int,
+    multiple_of: int = 1,
+    transform: Callable[[dict[str, np.ndarray]], Any] | None = None,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Stack dict rows into {column: array} batches of exactly
+    ``batch_size`` (rounded down to ``multiple_of``, so batches shard over
+    the mesh); the sub-multiple tail is dropped with a log line."""
+    from tensorflowonspark_tpu.utils.batching import fixed_size_batches
+
+    yield from fixed_size_batches(
+        rows, batch_size, multiple_of, assemble=lambda p: _stack(p, transform)
+    )
+
+
+def _stack(rows: list[dict[str, Any]], transform) -> Any:
+    batch = {
+        col: np.stack([np.asarray(r[col]) for r in rows]) for col in rows[0]
+    }
+    return transform(batch) if transform is not None else batch

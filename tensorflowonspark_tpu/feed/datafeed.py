@@ -116,29 +116,22 @@ class DataFeed:
         ``jax.device_count()``; the sub-multiple remainder is dropped with
         a log line, like the reference's drop-remainder datasets).
         """
-        # Full batches must shard too, not just the tail.
-        batch_size -= batch_size % multiple_of
-        if batch_size == 0:
-            raise ValueError(
-                f"batch_size < multiple_of ({multiple_of}); nothing to yield"
-            )
+        from tensorflowonspark_tpu.utils.batching import fixed_size_batches
+
         mapping = self.input_mapping
-        pending: list[Any] = []
-        while not self.should_stop():
-            pending.extend(self._next_raw(batch_size - len(pending)))
-            if len(pending) == batch_size:
-                yield self._columnize(pending) if mapping else pending
-                pending = []
-        tail = len(pending) - len(pending) % multiple_of
-        if len(pending) % multiple_of:
-            logger.warning(
-                "batch_stream dropping %d tail records (not a multiple of %d)",
-                len(pending) % multiple_of,
-                multiple_of,
-            )
-        if tail:
-            pending = pending[:tail]
-            yield self._columnize(pending) if mapping else pending
+
+        def records():
+            while not self.should_stop():
+                yield from self._next_raw(batch_size)
+
+        yield from fixed_size_batches(
+            records(),
+            batch_size,
+            multiple_of,
+            assemble=(
+                self._columnize if mapping else lambda rows: list(rows)
+            ),
+        )
 
     def should_stop(self) -> bool:
         """True once the feed is exhausted. Reference: ``DataFeed.should_stop``."""

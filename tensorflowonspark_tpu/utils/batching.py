@@ -1,0 +1,43 @@
+"""Shared fixed-size batching core.
+
+Both batch producers — the push plane's ``DataFeed.batch_stream`` and the
+pull plane's ``readers.column_batches`` — need the same contract: every
+batch exactly ``batch_size`` records (rounded down to ``multiple_of`` so
+batches shard over the mesh), tail trimmed to the largest multiple, the
+sub-multiple remainder dropped loudly. One implementation, two callers.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterable, Iterator
+
+logger = logging.getLogger(__name__)
+
+
+def fixed_size_batches(
+    records: Iterable[Any],
+    batch_size: int,
+    multiple_of: int,
+    assemble: Callable[[list[Any]], Any],
+) -> Iterator[Any]:
+    batch_size -= batch_size % multiple_of
+    if batch_size == 0:
+        raise ValueError(
+            f"batch_size < multiple_of ({multiple_of}); nothing to yield"
+        )
+    pending: list[Any] = []
+    for record in records:
+        pending.append(record)
+        if len(pending) == batch_size:
+            yield assemble(pending)
+            pending = []
+    tail = len(pending) - len(pending) % multiple_of
+    if len(pending) % multiple_of:
+        logger.warning(
+            "dropping %d tail records (not a multiple of %d)",
+            len(pending) % multiple_of,
+            multiple_of,
+        )
+    if tail:
+        yield assemble(pending[:tail])

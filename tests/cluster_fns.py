@@ -86,6 +86,35 @@ def estimator_train_fn(args, ctx):
     ctx.export_saved_model(params, args["export_dir"])
 
 
+def tfrecord_train_fn(args, ctx):
+    """TENSORFLOW-mode estimator train_fn: read the staged TFRecords and
+    fit y = w*x + b, chief exports (reference: nodes read files directly
+    after _fit staged them)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.data import dfutil
+
+    rows = list(dfutil.loadTFRecords(args["tfrecord_dir"]))
+    rows = rows[ctx.executor_id :: ctx.num_workers]
+    x = jnp.asarray(np.array([r["x"] for r in rows], np.float32))
+    y = jnp.asarray(np.array([r["y"] for r in rows], np.float32))
+
+    @jax.jit
+    def step(params):
+        def loss_fn(p):
+            return jnp.mean((p["w"] * x + p["b"] - y) ** 2)
+
+        g = jax.grad(loss_fn)(params)
+        return {k: params[k] - 0.1 * g[k] for k in params}
+
+    params = {"w": jnp.zeros(()), "b": jnp.zeros(())}
+    for _ in range(200):
+        params = step(params)
+    ctx.export_saved_model(params, args["export_dir"])
+
+
 def estimator_export_fn(args):
     """Rebuild (apply_fn, target_state) for TFModel.transform."""
     import jax.numpy as jnp

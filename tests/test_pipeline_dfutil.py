@@ -49,6 +49,37 @@ def test_estimator_fit_transform(tmp_path):
     assert abs(preds[2] - 5.0) < 0.3
 
 
+def test_estimator_tensorflow_mode_stages_tfrecords(tmp_path):
+    """InputMode.TENSORFLOW + tfrecord_dir: fit stages the data as
+    TFRecords and nodes read the files (reference _fit staging path)."""
+    import glob
+
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+
+    tfrecord_dir = str(tmp_path / "staged")
+    export_dir = str(tmp_path / "export")
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=128).astype(np.float32)
+    records = list(zip(x.tolist(), (2.0 * x + 0.5).tolist()))
+
+    est = TFEstimator(
+        cluster_fns.tfrecord_train_fn,
+        {"export_dir": export_dir, "tfrecord_dir": tfrecord_dir},
+        cluster_size=1,
+        input_mode=InputMode.TENSORFLOW,
+        tfrecord_dir=tfrecord_dir,
+        export_dir=export_dir,
+        input_mapping={"x": "x", "y": "y"},  # names the tuple fields
+    )
+    model = est.fit(records, env=cpu_only_env())
+    assert glob.glob(f"{tfrecord_dir}/part-*")  # staging really happened
+    model.export_fn = cluster_fns.estimator_export_fn
+    model.args.input_mapping = None  # transform takes bare (x,) records
+    preds = model.transform([(v,) for v in [0.0, 1.0]])
+    assert abs(float(preds[0]) - 0.5) < 0.1
+    assert abs(float(preds[1]) - 2.5) < 0.1
+
+
 def test_dfutil_roundtrip(tmp_path):
     from tensorflowonspark_tpu.data import dfutil
 

@@ -1,0 +1,82 @@
+"""Pull-mode reader tests (InputMode.TENSORFLOW data path)."""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.data import dfutil, readers
+
+
+@pytest.fixture(scope="module")
+def record_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("records")
+    rows = [{"x": np.float32(i), "y": np.int64(i * 2)} for i in range(30)]
+    dfutil.saveAsTFRecords(rows, str(d), records_per_file=7)
+    return str(d)
+
+
+def test_sharded_rows_cover_and_partition(record_dir):
+    shards = [
+        [int(r["x"]) for r in readers.sharded_rows(record_dir, i, 3)]
+        for i in range(3)
+    ]
+    assert sorted(sum(shards, [])) == list(range(30))
+    assert all(len(s) == 10 for s in shards)
+    assert not (set(shards[0]) & set(shards[1]))
+
+
+def test_shuffled_is_permutation(record_dir):
+    rows = list(readers.sharded_rows(record_dir, 0, 1))
+    out = list(readers.shuffled(rows, buffer_size=8, seed=0))
+    assert sorted(int(r["x"]) for r in out) == list(range(30))
+    assert [int(r["x"]) for r in out] != list(range(30))  # actually shuffled
+
+
+def test_repeated_reopens_with_epoch_index(record_dir):
+    epochs_seen = []
+
+    def make(epoch):
+        epochs_seen.append(epoch)
+        return readers.sharded_rows(record_dir, 0, 1)
+
+    assert sum(1 for _ in readers.repeated(make, epochs=2)) == 60
+    assert epochs_seen == [0, 1]
+
+
+def test_repeated_reshuffles_each_epoch(record_dir):
+    it = readers.repeated(
+        lambda epoch: readers.shuffled(
+            readers.sharded_rows(record_dir, 0, 1), buffer_size=8, seed=epoch
+        ),
+        epochs=2,
+    )
+    rows = [int(r["x"]) for r in it]
+    first, second = rows[:30], rows[30:]
+    assert sorted(first) == sorted(second) == list(range(30))
+    assert first != second  # fresh permutation per epoch
+
+
+def test_column_batches_shapes_and_tail(record_dir):
+    batches = list(
+        readers.column_batches(
+            readers.sharded_rows(record_dir, 0, 1), 8, multiple_of=4
+        )
+    )
+    # 30 rows, batches of 8: three full batches + tail of 6 -> trimmed to 4
+    assert [len(b["x"]) for b in batches] == [8, 8, 8, 4]
+    np.testing.assert_array_equal(batches[0]["y"], np.arange(8) * 2)
+
+
+def test_column_batches_transform(record_dir):
+    batches = list(
+        readers.column_batches(
+            readers.sharded_rows(record_dir, 0, 1),
+            16,
+            transform=lambda b: {"x2": b["x"] * 2},
+        )
+    )
+    np.testing.assert_array_equal(batches[0]["x2"], np.arange(16) * 2.0)
+
+
+def test_column_batches_rejects_degenerate():
+    with pytest.raises(ValueError, match="multiple_of"):
+        list(readers.column_batches(iter([]), 2, multiple_of=4))

@@ -81,3 +81,24 @@ def test_remaining_query():
     c.register({"executor_id": 0})
     assert c._call({"type": "QNUM"})["remaining"] == 2
     server.stop()
+
+
+def test_reservation_client_cli(capsys):
+    """Out-of-band query + stop via the CLI entry (reference:
+    reservation_client.py, the cluster kill switch)."""
+    from tensorflowonspark_tpu.cluster import reservation, reservation_client
+
+    server = reservation.Server(1)
+    host, port = server.start()
+    reservation.Client((host, port)).register(
+        {"executor_id": 0, "host": "h", "port": 1, "job_name": "chief",
+         "task_index": 0, "addr": ["h", 2], "authkey": "00"}
+    )
+    rc = reservation_client.main([host, str(port)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "'executor_id': 0" in out and "chief" in out
+    rc = reservation_client.main([host, str(port), "stop"])
+    assert rc == 0
+    assert "requested stop" in capsys.readouterr().out
+    assert reservation_client.main([]) == 2  # usage
