@@ -145,6 +145,32 @@ def main_fun(args, ctx):
             print(f"checkpointed step {int(state.step)} to {args.model_dir}")
         ckpt.close()
 
+    if args.generate:
+        from tensorflowonspark_tpu.models.llama import generate
+
+        # SPMD: every process runs the same decode over the (possibly
+        # globally sharded) params; only the chief prints. A device_get of
+        # FSDP-sharded params would fail multi-host — keep them on-mesh.
+        gen_rng = np.random.default_rng(0)  # same prompt on every process
+        prompt = gen_rng.integers(
+            0, cfg.vocab_size, size=(2, 8)
+        ).astype(np.int32)
+        t0 = time.time()
+        with use_mesh(mesh):
+            out = generate(
+                model,
+                state.params,
+                jax.numpy.asarray(prompt),
+                max_new_tokens=args.generate,
+            )
+        jax.block_until_ready(out)
+        dt = time.time() - t0
+        if ctx.is_chief:
+            print(
+                f"generated {args.generate} tokens/seq (KV-cache scan) in "
+                f"{dt:.1f}s: {np.asarray(out)[0][:10].tolist()}"
+            )
+
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser()
@@ -157,6 +183,12 @@ def parse_args(argv=None):
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument("--model-dir", default=None)
+    p.add_argument(
+        "--generate",
+        type=int,
+        default=0,
+        help="after training, decode N tokens via the KV cache (chief)",
+    )
     p.add_argument(
         "--peak-tflops", type=float, default=275.0, help="per-chip bf16 peak"
     )

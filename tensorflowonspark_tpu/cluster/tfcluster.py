@@ -371,9 +371,14 @@ class TFCluster:
             if self.input_mode == InputMode.SPARK
             else []
         )
-        for node_meta in self.workers:
+        for node_meta in self.cluster_info:
+            # Every node gets the control STOP; feed-queue end markers only
+            # go where feeders did (evaluator sidecars have no feed).
+            is_worker = node_meta["job_name"] != "evaluator"
             try:
-                tfnode_runtime.shutdown_node(node_meta, queues=feed_queues)
+                tfnode_runtime.shutdown_node(
+                    node_meta, queues=feed_queues if is_worker else ()
+                )
             except (ConnectionError, OSError) as e:
                 logger.warning(
                     "could not signal node %s: %s", node_meta["executor_id"], e

@@ -18,6 +18,7 @@ TPU-first design notes:
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import flax.linen as nn
 import jax
@@ -101,7 +102,7 @@ class Attention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, decode=False):
         cfg = self.cfg
         dense = lambda feats, name: nn.Dense(  # noqa: E731
             feats, use_bias=False, dtype=cfg.dtype, name=name,
@@ -116,12 +117,71 @@ class Attention(nn.Module):
         v = v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        out = dot_product_attention(
-            q, k, v, causal=True, segment_ids=segment_ids,
-            impl=cfg.attention_impl,
-        )
+        if decode:
+            out = self._cached_attention(q, k, v, positions)
+        else:
+            out = dot_product_attention(
+                q, k, v, causal=True, segment_ids=segment_ids,
+                impl=cfg.attention_impl,
+            )
         out = out.reshape(b, s, cfg.num_heads * cfg.head_dim)
         return dense(cfg.hidden_size, "o_proj")(out)
+
+    def _cached_attention(self, q, k, v, positions):
+        """Autoregressive attention against a static-shape KV cache.
+
+        The cache spans ``max_seq_len``; new K/V land at the running write
+        index (``lax.dynamic_update_slice``, so one jit covers prefill and
+        every decode step) and queries mask keys by absolute position —
+        unwritten cache slots sit past the mask and contribute nothing.
+        Decode is HBM-bandwidth-bound; plain einsum is the right shape for
+        it (flash targets the O(S^2) training pass).
+        """
+        cfg = self.cfg
+        b, s = q.shape[:2]
+        ck = self.variable(
+            "cache", "k", jnp.zeros,
+            (b, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim), cfg.dtype,
+        )
+        cv = self.variable(
+            "cache", "v", jnp.zeros,
+            (b, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim), cfg.dtype,
+        )
+        ci = self.variable(
+            "cache", "idx", lambda: jnp.zeros((), jnp.int32)
+        )
+        cur = ci.value
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(cfg.dtype), (0, cur, 0, 0)
+        )
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(cfg.dtype), (0, cur, 0, 0)
+        )
+        ci.value = cur + s
+        # Grouped einsum against the un-repeated cache: materializing a
+        # jnp.repeat of (b, max_seq_len, heads, d) K/V — plus an fp32 copy
+        # — per layer per step would multiply exactly the HBM traffic that
+        # bounds decode. Only the (b, h, q, k) logits live in fp32.
+        rep = cfg.num_heads // cfg.num_kv_heads
+        qg = q.reshape(b, s, cfg.num_kv_heads, rep, cfg.head_dim)
+        logits = (
+            jnp.einsum(
+                "bqhrd,bkhd->bhrqk",
+                qg,
+                ck.value,
+                preferred_element_type=jnp.float32,
+            )
+            * cfg.head_dim**-0.5
+        )
+        key_pos = jnp.arange(cfg.max_seq_len)
+        mask = (
+            key_pos[None, None, None, None, :]
+            <= positions[:, None, None, :, None]
+        )
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, cv.value)
+        return out.reshape(b, s, cfg.num_heads, cfg.head_dim)
 
 
 class MLP(nn.Module):
@@ -143,12 +203,13 @@ class Block(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, decode=False):
         cfg = self.cfg
         h = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x),
             positions,
             segment_ids,
+            decode,
         )
         if cfg.num_experts > 0:
             from tensorflowonspark_tpu.parallel.moe import MoEConfig, MoEMLP
@@ -175,8 +236,13 @@ class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, segment_ids=None):
-        """tokens (B, S) int32 -> logits (B, S, vocab)."""
+    def __call__(self, tokens, positions=None, segment_ids=None, decode=False):
+        """tokens (B, S) int32 -> logits (B, S, vocab).
+
+        ``decode=True`` runs against per-layer KV caches (apply with
+        ``mutable=["cache"]``; see :func:`generate`): ``positions`` must
+        then be the absolute positions of ``tokens`` in the sequence.
+        """
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(
@@ -188,13 +254,19 @@ class Llama(nn.Module):
             (cfg.vocab_size, cfg.hidden_size),
         )
         x = embed[tokens].astype(cfg.dtype)
-        block = Block
-        if cfg.remat:
+        if cfg.remat and not decode:
             # Rematerialize each layer's activations in backward: trades
             # FLOPs for HBM, the standard long-sequence TPU memory lever.
+            # (decode stays out of the remat'd arg list: as a traced
+            # operand it could not drive Python control flow.)
             block = nn.remat(Block, static_argnums=())
-        for i in range(cfg.num_layers):
-            x = block(cfg, name=f"layer{i}")(x, positions, segment_ids)
+            for i in range(cfg.num_layers):
+                x = block(cfg, name=f"layer{i}")(x, positions, segment_ids)
+        else:
+            for i in range(cfg.num_layers):
+                x = Block(cfg, name=f"layer{i}")(
+                    x, positions, segment_ids, decode
+                )
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
         # untied output head
         head = self.param(
@@ -244,6 +316,90 @@ def llama_param_shardings(params, mesh: Mesh):
         return NamedSharding(mesh, P("fsdp"))
 
     return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def generate(
+    model: "Llama",
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Autoregressive sampling with a KV cache: (B, S) -> (B, max_new_tokens).
+
+    One jitted prefill over the prompt, then a ``lax.scan`` of single-token
+    steps against the per-layer caches — static shapes throughout, so the
+    whole loop is one compilation (cached across calls with the same model
+    and shapes). ``temperature=0`` is greedy argmax; otherwise tokens are
+    sampled from ``logits / temperature``. The prompt must be unpadded
+    (all rows the same true length).
+    """
+    cfg = model.cfg
+    b, s = prompt.shape
+    if s + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq_len ({cfg.max_seq_len}); the KV cache cannot hold it"
+        )
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    run = _build_generate(model, b, s, max_new_tokens, float(temperature))
+    return run(params, prompt, rng)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_generate(
+    model: "Llama", b: int, s: int, max_new_tokens: int, temperature: float
+):
+    """Compile-once generate body per (model config, shapes, temperature).
+
+    flax Modules hash by their dataclass fields, so two ``Llama`` instances
+    with equal configs share the cache entry; a per-call ``jax.jit`` would
+    recompile the prefill + scan graph on every invocation.
+    """
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature).astype(
+            jnp.int32
+        )
+
+    @jax.jit
+    def run(params, prompt, rng):
+        positions = jnp.broadcast_to(
+            jnp.arange(s, dtype=jnp.int32), (b, s)
+        )
+        logits, prefill = model.apply(
+            {"params": params},
+            prompt,
+            positions=positions,
+            decode=True,
+            mutable=["cache"],
+        )
+        keys = jax.random.split(rng, max_new_tokens)
+        tok = sample(logits[:, -1], keys[0])
+
+        def step(carry, key):
+            cache, tok, pos = carry
+            logits, updated = model.apply(
+                {"params": params, "cache": cache},
+                tok[:, None],
+                positions=pos[:, None],
+                decode=True,
+                mutable=["cache"],
+            )
+            next_tok = sample(logits[:, -1], key)
+            return (updated["cache"], next_tok, pos + 1), tok
+
+        init = (prefill["cache"], tok, jnp.full((b,), s, jnp.int32))
+        (_, last, _), toks = jax.lax.scan(step, init, keys[1:])
+        # scan emitted each step's *input* token; the final sample closes it
+        return jnp.concatenate(
+            [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1
+        )
+
+    return run
 
 
 def llama_loss_fn(model: "Llama"):

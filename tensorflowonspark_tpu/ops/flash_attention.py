@@ -1,10 +1,17 @@
 """Pallas TPU flash attention (blockwise online-softmax forward).
 
-The kernel streams K/V blocks through VMEM against one Q block per grid
-step, keeping the O(Sq x Sk) logits matrix out of HBM entirely — the
-standard flash recipe expressed for the MXU/VPU split (matmuls in the MXU,
-the online-softmax rescale on the VPU). See /opt/skills/guides/
-pallas_guide.md for the kernel idioms used here.
+The kernel streams one (block_q x block_k) tile per grid step, keeping the
+O(Sq x Sk) logits matrix out of HBM entirely — the standard flash recipe
+expressed for the MXU/VPU split (matmuls in the MXU, the online-softmax
+rescale on the VPU). See /opt/skills/guides/pallas_guide.md for the kernel
+idioms used here.
+
+Memory shape: the K-block index is a *grid* dimension (innermost, so the
+online-softmax state lives in VMEM scratch across K steps), which keeps
+VMEM pressure at O(block_q x d + block_k x d) regardless of sequence
+length — full-length K/V staging would cap usable context at a few K
+tokens. GQA is a BlockSpec index-map (each Q head reads its KV group's
+block directly from HBM), not a materialized ``jnp.repeat``.
 
 Round-1 scope: the forward pass is Pallas; the backward pass recomputes
 attention with the XLA implementation via ``jax.custom_vjp`` (correct, but
@@ -18,6 +25,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
@@ -28,34 +36,34 @@ INTERPRET = False
 
 
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int, seq_q: int,
-    causal: bool, scale: float, block_q: int
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+    block_q: int, block_k: int, seq_q: int, seq_k: int,
+    causal: bool, scale: float, num_k_blocks: int,
 ):
-    qi = pl.program_id(1)  # q-block index
-    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
-    d = q.shape[-1]
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    num_k_blocks = seq_k // block_k
     # End-aligned causal semantics (matches the XLA path's tril(k=sk-sq)):
     # query i attends keys j <= i + (sk - sq).
     offset = seq_k - seq_q
     if causal:
-        # Only K blocks at or before this Q block's diagonal contribute.
-        num_live = jnp.minimum(
-            ((qi + 1) * block_q + offset + block_k - 1) // block_k,
-            num_k_blocks,
-        )
+        # K blocks strictly past this Q block's diagonal contribute nothing
+        # — skip their MXU work entirely.
+        live = ki * block_k <= (qi + 1) * block_q - 1 + offset
     else:
-        num_live = num_k_blocks
+        live = ki >= 0  # always true, as a traced predicate
 
-    def body(ki, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (block_q, block_k)
@@ -67,17 +75,21 @@ def _flash_fwd_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = alpha * acc + jax.lax.dot_general(
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, num_live, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        o_ref[0] = (
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        ).astype(o_ref.dtype)
 
 
 def _flash_forward(
@@ -103,36 +115,46 @@ def _flash_forward(
         )
     if hq % hk:
         raise ValueError(f"q heads {hq} not divisible by kv heads {hk}")
-    if hq != hk:
-        k = jnp.repeat(k, hq // hk, axis=2)
-        v = jnp.repeat(v, hq // hk, axis=2)
+    group = hq // hk
 
-    # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head)
+    # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, q-head); K/V
+    # stay at their kv-head count — the index map does the GQA broadcast.
     qt = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * hq, sk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * hq, sk, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
 
-    grid = (b * hq, sq // block_q)
+    num_k_blocks = sk // block_k
+    grid = (b * hq, sq // block_q, num_k_blocks)
+
+    def kv_row(h, qi, ki):
+        # grid row h = batch * hq + q_head; its KV row in the (b*hk) array
+        return (h // hq) * hk + (h % hq) // group, ki, 0
 
     kernel = functools.partial(
         _flash_fwd_kernel,
+        block_q=block_q,
         block_k=block_k,
-        seq_k=sk,
         seq_q=sq,
+        seq_k=sk,
         causal=causal,
         scale=scale,
-        block_q=block_q,
+        num_k_blocks=num_k_blocks,
     )
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda h, qi: (h, qi, 0)),
-            pl.BlockSpec((1, sk, d), lambda h, qi: (h, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda h, qi: (h, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
+            pl.BlockSpec((1, block_k, d), kv_row),
+            pl.BlockSpec((1, block_k, d), kv_row),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda h, qi: (h, qi, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running denominator
+        ],
         interpret=INTERPRET,
     )(qt, kt, vt)
     return out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)

@@ -251,6 +251,22 @@ def distributed_train_fn(args, ctx):
         json.dump(out, f)
 
 
+def role_aware_fn(args, ctx):
+    """Branches on role: data-plane nodes consume the feed; the evaluator
+    sidecar never touches it (reference eval_node semantics)."""
+    out = os.path.join(args["out_dir"], f"node{ctx.executor_id}.txt")
+    if ctx.job_name == "evaluator":
+        with open(out, "w") as f:
+            f.write("evaluator 0")
+        return
+    feed = ctx.get_data_feed(train_mode=True)
+    total = 0
+    while not feed.should_stop():
+        total += sum(r[0] for r in feed.next_batch(16))
+    with open(out, "w") as f:
+        f.write(f"{ctx.job_name} {total}")
+
+
 def sum_sizes_fn(args, ctx):
     """Sum len() of byte records; writes 'total count' like sum_fn."""
     import os

@@ -59,6 +59,19 @@ def test_flash_gqa_matches_xla(monkeypatch):
     )
 
 
+def test_flash_gqa_multibatch_kv_rows(monkeypatch):
+    """The BlockSpec kv-row index map must land each (batch, q-head) grid
+    row on ITS batch's kv head — wrong arithmetic reads another batch's
+    K/V, which only shows up with b > 1 and asymmetric heads."""
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    q, k, v = _qkv(b=3, hq=6, hk=3, sq=128, sk=128)
+    out_flash = fa._flash_forward(q, k, v, False, None)
+    out_ref = _xla_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_ref), rtol=2e-3, atol=2e-3
+    )
+
+
 def test_flash_causal_cross_attention_alignment(monkeypatch):
     """sq != sk causal: flash must match XLA's end-aligned tril(k=sk-sq)."""
     monkeypatch.setattr(fa, "INTERPRET", True)

@@ -129,6 +129,87 @@ def test_llama_grad_and_loss(tiny_llama):
     assert any(n > 0 for n in norms)
 
 
+def test_llama_kv_cache_matches_full_forward(tiny_llama):
+    """Decode-mode attention against the KV cache must reproduce the
+    training-path logits: prefill == full forward, and each cached
+    single-token step == the last position of a full forward."""
+    import numpy as np
+
+    _, model, params = tiny_llama
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, model.cfg.vocab_size, size=(2, 12)), jnp.int32
+    )
+
+    full = model.apply({"params": params}, tokens)
+    prefill_logits, state = model.apply(
+        {"params": params},
+        tokens[:, :8],
+        positions=jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (2, 8)),
+        decode=True,
+        mutable=["cache"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(prefill_logits),
+        np.asarray(full[:, :8]),
+        rtol=2e-2,
+        atol=2e-2,
+    )
+    cache = state["cache"]
+    for pos in range(8, 12):
+        step_logits, state = model.apply(
+            {"params": params, "cache": cache},
+            tokens[:, pos : pos + 1],
+            positions=jnp.full((2, 1), pos, jnp.int32),
+            decode=True,
+            mutable=["cache"],
+        )
+        cache = state["cache"]
+        np.testing.assert_allclose(
+            np.asarray(step_logits[:, 0]),
+            np.asarray(full[:, pos]),
+            rtol=2e-2,
+            atol=2e-2,
+        )
+
+
+def test_llama_generate_greedy_matches_naive(tiny_llama):
+    """generate() (cached scan) == naive greedy via full recompute."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.models.llama import generate
+
+    _, model, params = tiny_llama
+    rng = np.random.default_rng(1)
+    prompt = jnp.asarray(
+        rng.integers(0, model.cfg.vocab_size, size=(2, 6)), jnp.int32
+    )
+    out = generate(model, params, prompt, max_new_tokens=5)
+    assert out.shape == (2, 5)
+
+    seq = prompt
+    naive = []
+    for _ in range(5):
+        logits = model.apply({"params": params}, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        naive.append(nxt)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.stack([np.asarray(t) for t in naive], axis=1)
+    )
+
+
+def test_llama_generate_respects_max_seq_len(tiny_llama):
+    import pytest as _pytest
+
+    from tensorflowonspark_tpu.models.llama import generate
+
+    _, model, params = tiny_llama
+    prompt = jnp.zeros((1, model.cfg.max_seq_len - 2), jnp.int32)
+    with _pytest.raises(ValueError, match="max_seq_len"):
+        generate(model, params, prompt, max_new_tokens=8)
+
+
 def test_llama_sharded_train_step(mesh8):
     """Full FSDP+TP sharded train step on the 8-device CPU mesh."""
     from tensorflowonspark_tpu.compute import TrainState, build_train_step

@@ -222,6 +222,34 @@ def test_hostlist_launcher_local_shell(tmp_path):
     assert sum(totals) == sum(range(40))
 
 
+def test_eval_node_sidecar(tmp_path):
+    """eval_node=True: last node gets the 'evaluator' role and is excluded
+    from the data plane; feeds go only to chief/workers."""
+    cluster = tfcluster.run(
+        cluster_fns.role_aware_fn,
+        {"out_dir": str(tmp_path)},
+        num_executors=3,
+        eval_node=True,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        env=NODE_ENV,
+    )
+    roles = {n["executor_id"]: n["job_name"] for n in cluster.cluster_info}
+    assert roles == {0: "chief", 1: "worker", 2: "evaluator"}
+    assert [w["executor_id"] for w in cluster.workers] == [0, 1]
+    partitions = [[(i,) for i in range(p * 10, (p + 1) * 10)] for p in range(4)]
+    cluster.train(partitions)
+    cluster.shutdown(timeout=120)
+
+    out = {}
+    for i in range(3):
+        role, total = open(tmp_path / f"node{i}.txt").read().split()
+        out[i] = (role, int(total))
+    assert out[2] == ("evaluator", 0)
+    assert out[0][0] == "chief" and out[1][0] == "worker"
+    assert out[0][1] + out[1][1] == sum(range(40))
+
+
 def test_feed_timeout_on_stalled_consumer(tmp_path):
     """Fault injection (SURVEY §4 gap): a consumer that stops pulling must
     surface as a feed TimeoutError in the driver, not a silent hang."""
