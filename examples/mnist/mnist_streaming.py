@@ -1,0 +1,117 @@
+"""MNIST training from a micro-batch stream — the Spark Streaming path.
+
+Reference parity: ``TFCluster.train`` with a DStream (``foreachRDD`` fed
+each RDD on arrival; SURVEY.md §3.2). Here a generator yields micro-batches
+(simulating records arriving over time) into ``cluster.train_stream``;
+workers consume through the same ``DataFeed``/``batch_stream`` surface as
+batch training, and stop via ``DataFeed.terminate`` when they have seen
+enough — which ``train_stream`` notices and returns early.
+
+Usage::
+
+    tpu-submit --num-executors 2 examples/mnist/mnist_streaming.py \
+        [--micro-batches 20] [--interval 0.2] [--target-steps 30] [--cpu]
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
+import argparse
+import time
+
+
+def main_fun(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState, build_train_step
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.models import mnist
+
+    model = mnist.CNN()
+    mesh = make_mesh()
+    feed = ctx.get_data_feed(
+        train_mode=True, input_mapping={"image": "image", "label": "label"}
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((2, 28, 28, 1), np.float32)
+    )["params"]
+    tx = optax.adam(1e-3)
+    state = TrainState.create(params, tx)
+    step = build_train_step(mnist.loss_fn(model.apply), tx, mesh)
+
+    steps = 0
+    for cols in feed.batch_stream(
+        args.batch_size, multiple_of=jax.device_count()
+    ):
+        n = len(cols["label"])
+        batch = {
+            "image": np.asarray(cols["image"], np.float32).reshape(
+                n, 28, 28, 1
+            )
+            / 255.0,
+            "label": np.asarray(cols["label"], np.int32),
+        }
+        state, loss = step(state, shard_batch(mesh, batch))
+        steps += 1
+        if steps % 10 == 0:
+            print(
+                f"node{ctx.executor_id} step {steps} loss {float(loss):.4f}"
+            )
+        if steps >= args.target_steps:
+            # Early stop: train_stream sees 'terminating' and returns even
+            # if the stream is still producing.
+            feed.terminate()
+            break
+    print(f"node{ctx.executor_id}: trained {steps} streamed steps")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--micro-batches", type=int, default=20)
+    p.add_argument("--records-per-batch", type=int, default=512)
+    p.add_argument("--interval", type=float, default=0.2)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--target-steps", type=int, default=30)
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args(argv)
+
+
+if __name__ == "__main__":
+    import numpy as np
+
+    from tensorflowonspark_tpu.cluster import tfcluster
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+    from tensorflowonspark_tpu.launcher import cluster_args_from_env
+    from tensorflowonspark_tpu.utils.util import cpu_only_env
+
+    args = parse_args()
+    largs = cluster_args_from_env()
+
+    def stream():
+        """Micro-batches arriving over time (the DStream)."""
+        rng = np.random.default_rng(0)
+        for mb in range(args.micro_batches):
+            records = [
+                (rng.integers(0, 255, size=784), int(rng.integers(0, 10)))
+                for _ in range(args.records_per_batch)
+            ]
+            yield [records]
+            time.sleep(args.interval)
+
+    cluster = tfcluster.run(
+        main_fun,
+        args,
+        num_executors=largs["num_executors"],
+        input_mode=InputMode.SPARK,
+        env=cpu_only_env() if args.cpu else None,
+        launcher=largs.get("launcher"),
+        distributed=largs.get("distributed", False),
+    )
+    cluster.train_stream(stream())
+    cluster.shutdown()
+    print("mnist_streaming done")
