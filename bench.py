@@ -17,7 +17,8 @@ import os
 import threading
 import time
 
-WATCHDOG_SECS = 600
+WATCHDOG_SECS = 480  # fire before any outer ~600s kill, so the failure
+# JSON line still reaches the driver when backend init wedges
 _result_printed = threading.Event()
 
 

@@ -1,21 +1,22 @@
-"""Pallas TPU flash attention (blockwise online-softmax forward).
+"""Pallas TPU flash attention (blockwise online-softmax, fwd + bwd).
 
-The kernel streams one (block_q x block_k) tile per grid step, keeping the
+The kernels stream one (block_q x block_k) tile per grid step, keeping the
 O(Sq x Sk) logits matrix out of HBM entirely — the standard flash recipe
 expressed for the MXU/VPU split (matmuls in the MXU, the online-softmax
 rescale on the VPU). See /opt/skills/guides/pallas_guide.md for the kernel
 idioms used here.
 
-Memory shape: the K-block index is a *grid* dimension (innermost, so the
-online-softmax state lives in VMEM scratch across K steps), which keeps
-VMEM pressure at O(block_q x d + block_k x d) regardless of sequence
-length — full-length K/V staging would cap usable context at a few K
-tokens. GQA is a BlockSpec index-map (each Q head reads its KV group's
-block directly from HBM), not a materialized ``jnp.repeat``.
+Memory shape: the K-block (or Q-block, in backward) index is a *grid*
+dimension — innermost, so accumulators live in VMEM scratch across steps —
+which keeps VMEM pressure at O(block x d) regardless of sequence length.
+GQA is a BlockSpec index-map (each Q head reads its KV group's block
+directly from HBM), not a materialized ``jnp.repeat``.
 
-Round-1 scope: the forward pass is Pallas; the backward pass recomputes
-attention with the XLA implementation via ``jax.custom_vjp`` (correct, but
-O(S^2) memory in backward). A Pallas backward kernel is planned.
+Backward follows FlashAttention's two-pass scheme against saved
+log-sum-exp residuals: a dQ kernel (grid over Q blocks, streaming K), and
+a dK/dV kernel (grid over K blocks, streaming Q). dK/dV are computed per
+*query* head and group-summed outside the kernel — inside, multiple grid
+rows would otherwise race on one KV head's output block.
 """
 
 from __future__ import annotations
@@ -35,8 +36,34 @@ NEG_INF = -1e30
 INTERPRET = False
 
 
-def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _causal_live(qi, ki, block_q: int, block_k: int, offset: int):
+    """This (Q, K) block pair intersects the causal frontier."""
+    return ki * block_k <= (qi + 1) * block_q - 1 + offset
+
+
+def _tile_logits(q, k, qi, ki, block_q, block_k, offset, causal, scale):
+    """Scaled (block_q, block_k) logits with the causal mask applied."""
+    s = scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+    return s
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     block_q: int, block_k: int, seq_q: int, seq_k: int,
     causal: bool, scale: float, num_k_blocks: int,
 ):
@@ -52,29 +79,16 @@ def _flash_fwd_kernel(
     # End-aligned causal semantics (matches the XLA path's tril(k=sk-sq)):
     # query i attends keys j <= i + (sk - sq).
     offset = seq_k - seq_q
-    if causal:
-        # K blocks strictly past this Q block's diagonal contribute nothing
-        # — skip their MXU work entirely.
-        live = ki * block_k <= (qi + 1) * block_q - 1 + offset
-    else:
-        live = ki >= 0  # always true, as a traced predicate
+    live = (
+        _causal_live(qi, ki, block_q, block_k, offset) if causal else ki >= 0
+    )
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (block_q, block_k)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+        s = _tile_logits(q, k, qi, ki, block_q, block_k, offset, causal, scale)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -87,9 +101,9 @@ def _flash_fwd_kernel(
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
-        o_ref[0] = (
-            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
-        ).astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
 
 
 def _flash_forward(
@@ -100,7 +114,8 @@ def _flash_forward(
     scale: float | None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
-) -> jax.Array:
+    return_lse: bool = False,
+):
     """(B, Sq, H, D) attention with GQA head broadcast, Pallas forward."""
     b, sq, hq, d = q.shape
     _, sk, hk, _ = k.shape
@@ -131,7 +146,7 @@ def _flash_forward(
         return (h // hq) * hk + (h % hq) // group, ki, 0
 
     kernel = functools.partial(
-        _flash_fwd_kernel,
+        _fwd_kernel,
         block_q=block_q,
         block_k=block_k,
         seq_q=sq,
@@ -140,7 +155,7 @@ def _flash_forward(
         scale=scale,
         num_k_blocks=num_k_blocks,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -148,8 +163,14 @@ def _flash_forward(
             pl.BlockSpec((1, block_k, d), kv_row),
             pl.BlockSpec((1, block_k, d), kv_row),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda h, qi, ki: (h, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * hq, sq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
             pltpu.VMEM((block_q, 1), jnp.float32),  # running max
@@ -157,7 +178,211 @@ def _flash_forward(
         ],
         interpret=INTERPRET,
     )(qt, kt, vt)
-    return out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+    if return_lse:
+        return out, lse
+    return out
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+
+def _probs(s, lse_col):
+    """p = exp(s - lse), zeroed for fully-masked rows.
+
+    A row with no live keys has lse = NEG_INF, and ``NEG_INF - NEG_INF``
+    would make every masked entry exp(0) = 1. The forward emits 0 for such
+    rows (a constant), so their correct gradient contribution is exactly 0.
+    """
+    return jnp.where(lse_col > NEG_INF / 2, jnp.exp(s - lse_col), 0.0)
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *,
+    block_q: int, block_k: int, seq_q: int, seq_k: int,
+    causal: bool, scale: float, num_k_blocks: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    offset = seq_k - seq_q
+    live = (
+        _causal_live(qi, ki, block_q, block_k, offset) if causal else ki >= 0
+    )
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _tile_logits(q, k, qi, ki, block_q, block_k, offset, causal, scale)
+        p = _probs(s, lse_ref[0][:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0][:, None])
+        dq_acc[...] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *,
+    block_q: int, block_k: int, seq_q: int, seq_k: int,
+    causal: bool, scale: float, num_q_blocks: int,
+):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    offset = seq_k - seq_q
+    live = (
+        _causal_live(qi, ki, block_q, block_k, offset) if causal else qi >= 0
+    )
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _tile_logits(q, k, qi, ki, block_q, block_k, offset, causal, scale)
+        p = _probs(s, lse_ref[0][:, None])  # (block_q, block_k)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0][:, None])
+        dk_acc[...] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, out, lse, g, causal, scale,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+):
+    b, sq, hq, d = q.shape
+    _, sk, hk, _ = k.shape
+    scale = (d**-0.5) if scale is None else scale
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    group = hq // hk
+
+    qt = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
+    ot = out.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
+    gt = g.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
+    # delta_i = rowsum(dO_i * O_i): cheap elementwise; XLA fuses it.
+    delta = jnp.sum(
+        gt.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1
+    )
+
+    num_q_blocks = sq // block_q
+    num_k_blocks = sk // block_k
+
+    def kv_row3(h, a, c):
+        return (h // hq) * hk + (h % hq) // group
+
+    common = dict(
+        block_q=block_q,
+        block_k=block_k,
+        seq_q=sq,
+        seq_k=sk,
+        causal=causal,
+        scale=scale,
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, num_k_blocks=num_k_blocks, **common
+        ),
+        grid=(b * hq, num_q_blocks, num_k_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, qi, ki: (kv_row3(h, qi, ki), ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, qi, ki: (kv_row3(h, qi, ki), ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda h, qi, ki: (h, qi)),
+            pl.BlockSpec((1, block_q), lambda h, qi, ki: (h, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=INTERPRET,
+    )(qt, kt, vt, gt, lse, delta)
+
+    # dK/dV per *query* head (b*hq rows): several q heads share one KV head,
+    # and revisiting an output block from non-consecutive grid rows is not
+    # allowed — group-sum afterwards instead.
+    dk_q, dv_q = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, num_q_blocks=num_q_blocks, **common
+        ),
+        grid=(b * hq, num_k_blocks, num_q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, ki, qi: (h, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (kv_row3(h, ki, qi), ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (kv_row3(h, ki, qi), ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda h, ki, qi: (h, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda h, ki, qi: (h, qi)),
+            pl.BlockSpec((1, block_q), lambda h, ki, qi: (h, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (h, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (h, ki, 0)),
+        ],
+        out_shape=[
+            # f32: the group-sum below must accumulate in full precision —
+            # bf16 kernel outputs would round before the reduction.
+            jax.ShapeDtypeStruct((b * hq, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * hq, sk, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=INTERPRET,
+    )(qt, kt, vt, gt, lse, delta)
+
+    dq = dq.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+    dk = (
+        dk_q.reshape(b, hk, group, sk, d).sum(axis=2).transpose(0, 2, 1, 3)
+    ).astype(k.dtype)
+    dv = (
+        dv_q.reshape(b, hk, group, sk, d).sum(axis=2).transpose(0, 2, 1, 3)
+    ).astype(v.dtype)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public op
+# --------------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -172,20 +397,13 @@ def flash_attention(
 
 
 def _fwd(q, k, v, causal, scale):
-    return _flash_forward(q, k, v, causal, scale), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, scale, return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, scale, res, g):
-    from tensorflowonspark_tpu.ops.attention import _xla_attention
-
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _xla_attention(q, k, v, causal=causal, scale=scale),
-        q,
-        k,
-        v,
-    )
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal, scale)
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -108,6 +108,56 @@ def test_flash_grad_matches_xla(monkeypatch):
         )
 
 
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(hq=8, hk=2, sq=128, sk=128),  # GQA: dk/dv group-sum path
+        dict(sq=128, sk=256),  # causal cross-length (offset != 0)
+        dict(b=3, hq=6, hk=3, sq=128, sk=128, d=32),  # multibatch + GQA
+    ],
+)
+def test_flash_grad_variants_match_xla(kw, monkeypatch):
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    q, k, v = _qkv(**kw)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, True, None) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), rtol=5e-3, atol=5e-3
+        )
+
+
+def test_flash_grad_fully_masked_rows(monkeypatch):
+    """causal with sq > sk leaves the first sq-sk query rows with NO live
+    keys. The forward emits 0 for them (a constant), so their grads must be
+    exactly 0 and must not pollute dk/dv; live rows must match XLA when the
+    loss only reads live rows."""
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    q, k, v = _qkv(sq=128, sk=64)
+    dead = 64  # queries 0..63 attend nothing (offset = -64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, True, None)[:, dead:] ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, causal=True)[:, dead:] ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_array_equal(np.asarray(g_flash[0][:, :dead]), 0.0)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), rtol=5e-3, atol=5e-3
+        )
+
+
 def test_dot_product_attention_auto_on_cpu():
     q, k, v = _qkv(sq=16, sk=16, d=8)
     out = dot_product_attention(q, k, v, causal=True, impl="auto")
