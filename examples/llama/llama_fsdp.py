@@ -57,6 +57,8 @@ def _config(name: str, seq: int):
 
 
 def main_fun(args, ctx):
+    import dataclasses
+
     import jax
     import numpy as np
     import optax
@@ -72,13 +74,22 @@ def main_fun(args, ctx):
     from tensorflowonspark_tpu.parallel import use_mesh
 
     cfg = _config(args.model, args.seq)
+    if args.sp > 1:
+        # Sequence parallelism: ring attention shards the sequence axis and
+        # passes KV blocks around the ring (parallel/ring_attention.py).
+        cfg = dataclasses.replace(cfg, attention_impl="ring")
     model = Llama(cfg)
-    mesh = make_mesh({"data": args.dp, "fsdp": args.fsdp, "model": args.tp})
+    mesh = make_mesh(
+        {"data": args.dp, "fsdp": args.fsdp, "model": args.tp, "seq": args.sp}
+    )
     if ctx.executor_id == 0:
         print(f"mesh: {dict(mesh.shape)}")
 
     rng = np.random.default_rng(ctx.executor_id)
-    tokens0 = np.zeros((2, args.seq + 1), np.int32)
+    # Init batch must divide over (data, fsdp): ring attention's shard_map
+    # rejects a batch smaller than the data-parallel extent.
+    dp_size = mesh.shape["data"] * mesh.shape["fsdp"]
+    tokens0 = np.zeros((dp_size, args.seq + 1), np.int32)
     with use_mesh(mesh):
         params = model.init(jax.random.PRNGKey(0), tokens0[:, :-1])["params"]
     psh = llama_param_shardings(params, mesh)
@@ -181,6 +192,10 @@ def parse_args(argv=None):
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--fsdp", type=int, default=-1, help="-1: all devices")
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument(
+        "--sp", type=int, default=1,
+        help="sequence-parallel (ring attention) axis size",
+    )
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument("--model-dir", default=None)
     p.add_argument(

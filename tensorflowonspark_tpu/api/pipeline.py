@@ -236,6 +236,12 @@ class TFModel(_HasParams):
     _singleton: tuple[Any, Any] | None = None
     _singleton_key: tuple | None = None
     _singleton_aot_mappings: tuple[Any, Any] = (None, None)
+    # export_fn-path models accept resharded inputs; AOT replays cannot.
+    _singleton_shardable: bool = False
+    # Replicated-state cache: broadcasting a large state across devices on
+    # every transform() call would defeat the load-once singleton.
+    _replicated: Any = None
+    _replicated_key: tuple | None = None
 
     def __init__(
         self,
@@ -276,6 +282,7 @@ class TFModel(_HasParams):
                     aot.state,
                 )
                 TFModel._singleton_key = key
+                TFModel._singleton_shardable = False
                 TFModel._singleton_aot_mappings = (
                     aot.input_mapping,
                     aot.output_mapping,
@@ -302,20 +309,54 @@ class TFModel(_HasParams):
             state = restore_checkpoint(export_dir, target=target)
             TFModel._singleton = (jax.jit(apply_fn), state)
             TFModel._singleton_key = key
+            TFModel._singleton_shardable = True
         return TFModel._singleton
 
     def transform(self, data: Iterable) -> list[Any]:
-        """Map records through the model in batches, preserving order."""
+        """Map records through the model in batches, preserving order.
+
+        On multi-device hosts the export_fn path runs data-parallel: each
+        batch is sharded over the local devices (ragged tails padded with
+        the last record, trimmed from the output). AOT artifacts replay a
+        fixed StableHLO program and keep single-device placement.
+        """
+        import jax as _jax
+
         apply_fn, state = self._load()
         args = self.args
         batch_size = int(args.batch_size)
+        dc = _jax.local_device_count()
+        shard = TFModel._singleton_shardable and dc > 1
+        if shard:
+            from tensorflowonspark_tpu.compute.mesh import (
+                make_mesh,
+                replicated,
+                shard_batch,
+            )
+
+            mesh = make_mesh({"data": dc}, devices=_jax.local_devices())
+            # The restored state sits committed on device 0; a batch that
+            # spans the mesh needs it replicated across every device —
+            # once per loaded model, not per transform call.
+            rkey = (TFModel._singleton_key, dc)
+            if TFModel._replicated_key != rkey:
+                TFModel._replicated = _jax.device_put(
+                    state, replicated(mesh)
+                )
+                TFModel._replicated_key = rkey
+            state = TFModel._replicated
         records = list(data)
         out: list[Any] = []
         for start in range(0, len(records), batch_size):
             chunk = records[start : start + batch_size]
+            n = len(chunk)
+            if shard and n % dc:
+                chunk = list(chunk) + [chunk[-1]] * (dc - n % dc)
             batch = self._columnize(chunk)
+            if shard:
+                batch = shard_batch(mesh, batch)
             result = apply_fn(state, batch)
-            out.extend(self._rowize(result, len(chunk)))
+            out.extend(self._rowize(result, n))
         return out
 
     def _columnize(self, chunk: Sequence[Any]):
