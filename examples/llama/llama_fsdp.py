@@ -86,10 +86,11 @@ def main_fun(args, ctx):
         print(f"mesh: {dict(mesh.shape)}")
 
     rng = np.random.default_rng(ctx.executor_id)
-    # Init batch must divide over (data, fsdp): ring attention's shard_map
-    # rejects a batch smaller than the data-parallel extent.
+    # Ring attention's shard_map needs the init batch to divide over
+    # (data, fsdp); other impls keep the cheap batch-2 init.
     dp_size = mesh.shape["data"] * mesh.shape["fsdp"]
-    tokens0 = np.zeros((dp_size, args.seq + 1), np.int32)
+    init_b = dp_size if cfg.attention_impl == "ring" else 2
+    tokens0 = np.zeros((init_b, args.seq + 1), np.int32)
     with use_mesh(mesh):
         params = model.init(jax.random.PRNGKey(0), tokens0[:, :-1])["params"]
     psh = llama_param_shardings(params, mesh)

@@ -238,9 +238,9 @@ class TFModel(_HasParams):
     _singleton_aot_mappings: tuple[Any, Any] = (None, None)
     # export_fn-path models accept resharded inputs; AOT replays cannot.
     _singleton_shardable: bool = False
-    # Replicated-state cache: broadcasting a large state across devices on
-    # every transform() call would defeat the load-once singleton.
-    _replicated: Any = None
+    # Marks that the singleton's state has been replicated over the local
+    # mesh (done once per loaded model, replacing the device-0-committed
+    # copy so only one copy of the weights survives).
     _replicated_key: tuple | None = None
 
     def __init__(
@@ -336,15 +336,17 @@ class TFModel(_HasParams):
 
             mesh = make_mesh({"data": dc}, devices=_jax.local_devices())
             # The restored state sits committed on device 0; a batch that
-            # spans the mesh needs it replicated across every device —
-            # once per loaded model, not per transform call.
+            # spans the mesh needs it replicated across every device. Done
+            # once per loaded model, and written back into the singleton so
+            # the device-0-only copy is dropped (keeping both would double
+            # device-0 memory).
             rkey = (TFModel._singleton_key, dc)
             if TFModel._replicated_key != rkey:
-                TFModel._replicated = _jax.device_put(
-                    state, replicated(mesh)
-                )
+                state = _jax.device_put(state, replicated(mesh))
+                TFModel._singleton = (apply_fn, state)
                 TFModel._replicated_key = rkey
-            state = TFModel._replicated
+            else:
+                state = TFModel._singleton[1]
         records = list(data)
         out: list[Any] = []
         for start in range(0, len(records), batch_size):

@@ -37,32 +37,23 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _repeat_kv(k: jax.Array, v: jax.Array, hq: int):
-    hk = k.shape[2]
-    if hq == hk:
-        return k, v
-    if hq % hk:
-        raise ValueError(f"q heads {hq} not divisible by kv heads {hk}")
-    rep = hq // hk
-    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
-
-
-def _block_attend(q, k, v, q_pos, k_pos, m, l, acc, *, causal, scale):
+def _block_attend(qg, k, v, q_pos, k_pos, m, l, acc, *, causal, scale):
     """One online-softmax accumulation step against a K/V block.
 
-    q (B,Sq,H,D) fp-any; k/v (B,Sk,H,D); q_pos (Sq,), k_pos (Sk,) global
-    positions; m/l (B,H,Sq,1) fp32 running max / normaliser; acc
-    (B,H,Sq,D) fp32 running numerator.
+    GQA stays grouped throughout — no ``jnp.repeat`` of K/V per device per
+    ring step. qg (B,Sq,Hk,G,D) fp-any; k/v (B,Sk,Hk,D); q_pos (Sq,),
+    k_pos (Sk,) global positions; m/l (B,Hk,G,Sq,1) fp32 running max /
+    normaliser; acc (B,Hk,G,Sq,D) fp32 running numerator.
     """
     s = jnp.einsum(
-        "bqhd,bkhd->bhqk",
-        q,
+        "bqhgd,bkhd->bhgqk",
+        qg,
         k,
         preferred_element_type=jnp.float32,
     ) * scale
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     # Guard fully-masked rows: keep the running max finite once anything
     # has been seen; before that, exp(NEG_INF - NEG_INF) must not be 1.
@@ -72,7 +63,7 @@ def _block_attend(q, k, v, q_pos, k_pos, m, l, acc, *, causal, scale):
     correction = jnp.where(m <= NEG_INF / 2, 0.0, correction)
     l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
     pv = jnp.einsum(
-        "bhqk,bkhd->bhqd",
+        "bhgqk,bkhd->bhgqd",
         p,
         v.astype(jnp.float32),
         preferred_element_type=jnp.float32,
@@ -98,8 +89,12 @@ def ring_attention(
     (B, S_loc, Hq, D) in q's dtype.
     """
     b, s_loc, hq, d = q.shape
+    hk = k.shape[2]
+    if hq % hk:
+        raise ValueError(f"q heads {hq} not divisible by kv heads {hk}")
+    group = hq // hk
     scale = (d**-0.5) if scale is None else scale
-    k, v = _repeat_kv(k, v, hq)
+    qg = q.reshape(b, s_loc, hk, group, d)
 
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -108,16 +103,15 @@ def ring_attention(
 
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    m0 = jnp.full((b, hq, s_loc, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, hq, s_loc, 1), jnp.float32)
-    acc0 = jnp.zeros((b, hq, s_loc, d), jnp.float32)
+    m0 = jnp.full((b, hk, group, s_loc, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hk, group, s_loc, 1), jnp.float32)
+    acc0 = jnp.zeros((b, hk, group, s_loc, d), jnp.float32)
 
     # Step 0 attends the locally-owned (diagonal) block with no permute;
     # the scan then rotates-and-attends n-1 times, so exactly n-1 permute
     # pairs go around the ring (none after the last block is consumed).
-    m, l, acc = _block_attend(
-        q, k, v, q_pos, idx * s_loc + local_pos, m0, l0, acc0,
-        causal=causal, scale=scale,
+    m, l, acc = _block_attend(  # diagonal block: k_pos == q_pos
+        qg, k, v, q_pos, q_pos, m0, l0, acc0, causal=causal, scale=scale,
     )
 
     @jax.checkpoint
@@ -128,7 +122,7 @@ def ring_attention(
         src = (idx - t) % n  # owner of the block just received
         k_pos = src * s_loc + local_pos
         m, l, acc = _block_attend(
-            q, k_blk, v_blk, q_pos, k_pos, m, l, acc,
+            qg, k_blk, v_blk, q_pos, k_pos, m, l, acc,
             causal=causal, scale=scale,
         )
         return (k_blk, v_blk, m, l, acc), None
@@ -137,8 +131,9 @@ def ring_attention(
         (_, _, m, l, acc), _ = lax.scan(
             step, (k, v, m, l, acc), jnp.arange(1, n, dtype=jnp.int32)
         )
-    out = acc / jnp.maximum(l, 1e-30)
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    out = acc / jnp.maximum(l, 1e-30)  # (B, Hk, G, Sq, D)
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, s_loc, hq, d)
+    return out.astype(q.dtype)
 
 
 def mesh_ring_attention(
