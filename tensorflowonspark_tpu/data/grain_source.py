@@ -1,0 +1,153 @@
+"""grain integration: random-access TFRecord source + a configured loader.
+
+SURVEY.md §2.2 names ``grain`` as the TPU-native record-reader equivalent
+of the reference's Hadoop connector. grain wants *random access*
+(``__len__``/``__getitem__``) so its samplers own ordering, sharding, and
+reproducible shuffling; TFRecord is a sequential format — so this module
+builds a one-pass byte-offset index over the shard files (framing: 8-byte
+length + 4-byte length-crc + payload + 4-byte payload-crc) and serves
+records by ``pread``. The index costs one sequential metadata scan
+(payload bytes are skipped, not read).
+
+Everything here is optional: the core framework never imports grain.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Any, Sequence
+
+_LEN = struct.Struct("<Q")
+_HEADER = 8 + 4  # length + masked length-crc
+_FOOTER = 4  # masked payload-crc
+
+
+def _index_file(path: str) -> list[tuple[int, int]]:
+    """[(payload_offset, payload_len)] for one TFRecord file.
+
+    Each 12-byte header's length-crc is verified, so a corrupted length
+    field fails here instead of mis-framing every later record into
+    garbage rows. Payload bytes are genuinely skipped (unbuffered reads).
+    """
+    from tensorflowonspark_tpu.native.tfrecord import _py_masked_crc
+
+    out: list[tuple[int, int]] = []
+    size = os.path.getsize(path)
+    with open(path, "rb", buffering=0) as f:
+        pos = 0
+        while pos + _HEADER <= size:
+            f.seek(pos)
+            header = f.read(_HEADER)
+            n = _LEN.unpack(header[:8])[0]
+            if _py_masked_crc(header[:8]) != struct.unpack("<I", header[8:])[0]:
+                raise ValueError(
+                    f"{path}: corrupt record length at offset {pos}"
+                )
+            payload = pos + _HEADER
+            end = payload + n + _FOOTER
+            if end > size:
+                raise ValueError(
+                    f"{path}: truncated record at offset {pos} "
+                    f"(needs {end - size} more bytes)"
+                )
+            out.append((payload, n))
+            pos = end
+        if pos != size:
+            raise ValueError(
+                f"{path}: truncated record at offset {pos} "
+                f"({size - pos} trailing bytes, less than a record header)"
+            )
+    return out
+
+
+class TFRecordDataSource:
+    """grain ``RandomAccessDataSource`` over a TFRecord directory.
+
+    ``__getitem__`` returns the decoded dict row (``dfutil.fromTFExample``)
+    — plug into ``grain.python.DataLoader`` with any sampler.
+    """
+
+    def __init__(
+        self, input_dir: str, binary_features: Sequence[str] = ()
+    ):
+        from tensorflowonspark_tpu.data import dfutil
+
+        self._binary = tuple(binary_features)
+        self._files = dfutil.tfrecord_files(input_dir)
+        self._entries: list[tuple[int, int, int]] = []  # (file, off, len)
+        for fi, path in enumerate(self._files):
+            for off, n in _index_file(path):
+                self._entries.append((fi, off, n))
+        self._handles: dict[int, Any] = {}
+
+    def __getstate__(self):
+        # grain spawns worker processes and pickles the source into them:
+        # raw fd numbers are meaningless (or worse, unrelated-but-valid)
+        # in another process, so workers must reopen lazily.
+        state = self.__dict__.copy()
+        state["_handles"] = {}
+        return state
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __getitem__(self, index: int) -> dict[str, Any]:
+        from tensorflowonspark_tpu.data import dfutil
+
+        fi, off, n = self._entries[index]
+        fd = self._handles.get(fi)
+        if fd is None:
+            # raw fds: os.pread is thread-safe (grain reads from workers)
+            fd = os.open(self._files[fi], os.O_RDONLY)
+            self._handles[fi] = fd
+        return dfutil.fromTFExample(os.pread(fd, n, off), self._binary)
+
+    def __del__(self):  # pragma: no cover - best-effort cleanup
+        for fd in getattr(self, "_handles", {}).values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+
+def grain_loader(
+    input_dir: str,
+    *,
+    shard_index: int = 0,
+    num_shards: int = 1,
+    shuffle: bool = True,
+    seed: int = 0,
+    num_epochs: int | None = 1,
+    batch_size: int | None = None,
+    worker_count: int = 0,
+    binary_features: Sequence[str] = (),
+    transformations: Sequence[Any] = (),
+):
+    """A configured ``grain.python.DataLoader`` over TFRecords.
+
+    The grain-native spelling of ``readers.sharded_rows`` + ``shuffled`` +
+    ``column_batches``: sharding and shuffling are the sampler's
+    (deterministic, resumable), batching a ``Batch`` transformation.
+    """
+    import grain.python as gp
+
+    source = TFRecordDataSource(input_dir, binary_features)
+    sampler = gp.IndexSampler(
+        num_records=len(source),
+        shard_options=gp.ShardOptions(
+            shard_index=shard_index, shard_count=num_shards, drop_remainder=False
+        ),
+        shuffle=shuffle,
+        num_epochs=num_epochs,
+        seed=seed,
+    )
+    ops = list(transformations)
+    if batch_size is not None:
+        ops.append(gp.Batch(batch_size=batch_size, drop_remainder=True))
+    return gp.DataLoader(
+        data_source=source,
+        sampler=sampler,
+        operations=ops,
+        worker_count=worker_count,
+    )
