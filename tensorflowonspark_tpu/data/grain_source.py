@@ -98,9 +98,14 @@ class TFRecordDataSource:
         fi, off, n = self._entries[index]
         fd = self._handles.get(fi)
         if fd is None:
-            # raw fds: os.pread is thread-safe (grain reads from workers)
+            # raw fds: os.pread is thread-safe (grain reads from a thread
+            # pool). Racing first-touchers must not leak the loser's fd —
+            # setdefault keeps exactly one open handle per file.
             fd = os.open(self._files[fi], os.O_RDONLY)
-            self._handles[fi] = fd
+            winner = self._handles.setdefault(fi, fd)
+            if winner != fd:
+                os.close(fd)
+                fd = winner
         return dfutil.fromTFExample(os.pread(fd, n, off), self._binary)
 
     def __del__(self):  # pragma: no cover - best-effort cleanup

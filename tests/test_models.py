@@ -384,3 +384,38 @@ def test_bert_classifier_trains(mesh8):
     for _ in range(4):
         state, l = step(state, sharded)
     assert float(l) < float(l1)
+
+
+def test_bert_mlm_trains(tiny_bert):
+    """Masked-LM head: masked-position CE drops over a few steps."""
+    from tensorflowonspark_tpu.models.bert import BertForMLM
+
+    cfg, _, _ = tiny_bert
+    model = BertForMLM(config=cfg)
+    rng = np.random.default_rng(4)
+    tokens = jnp.asarray(
+        rng.integers(1, cfg.vocab_size, size=(4, 16)), jnp.int32
+    )
+    mask_pos = jnp.asarray(rng.random(size=(4, 16)) < 0.25)
+    inputs = jnp.where(mask_pos, 0, tokens)  # 0 = [MASK]
+    params = model.init(jax.random.PRNGKey(0), inputs)["params"]
+
+    def loss_fn(p):
+        logits = model.apply({"params": p}, inputs)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tokens)
+        return jnp.sum(ce * mask_pos) / jnp.maximum(jnp.sum(mask_pos), 1)
+
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        l, g = jax.value_and_grad(loss_fn)(params)
+        upd, opt_state = tx.update(g, opt_state)
+        return optax.apply_updates(params, upd), opt_state, l
+
+    l0 = None
+    for _ in range(10):
+        params, opt_state, l = step(params, opt_state)
+        l0 = l0 if l0 is not None else float(l)
+    assert float(l) < l0
