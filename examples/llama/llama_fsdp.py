@@ -75,9 +75,11 @@ def main_fun(args, ctx):
 
     cfg = _config(args.model, args.seq)
     if args.sp > 1:
-        # Sequence parallelism: ring attention shards the sequence axis and
-        # passes KV blocks around the ring (parallel/ring_attention.py).
-        cfg = dataclasses.replace(cfg, attention_impl="ring")
+        # Sequence parallelism: 'ring' rotates KV blocks around the ring
+        # (memory-optimal for long S_local); 'ulysses' does two
+        # all-to-alls and runs full-sequence attention per head subset
+        # (fewer collectives; needs heads divisible by sp).
+        cfg = dataclasses.replace(cfg, attention_impl=args.sp_impl)
     model = Llama(cfg)
     mesh = make_mesh(
         {"data": args.dp, "fsdp": args.fsdp, "model": args.tp, "seq": args.sp}
@@ -86,10 +88,10 @@ def main_fun(args, ctx):
         print(f"mesh: {dict(mesh.shape)}")
 
     rng = np.random.default_rng(ctx.executor_id)
-    # Ring attention's shard_map needs the init batch to divide over
-    # (data, fsdp); other impls keep the cheap batch-2 init.
+    # The SP shard_maps need the init batch to divide over (data, fsdp);
+    # other impls keep the cheap batch-2 init.
     dp_size = mesh.shape["data"] * mesh.shape["fsdp"]
-    init_b = dp_size if cfg.attention_impl == "ring" else 2
+    init_b = dp_size if cfg.attention_impl in ("ring", "ulysses") else 2
     tokens0 = np.zeros((init_b, args.seq + 1), np.int32)
     with use_mesh(mesh):
         params = model.init(jax.random.PRNGKey(0), tokens0[:, :-1])["params"]
@@ -195,7 +197,11 @@ def parse_args(argv=None):
     p.add_argument("--tp", type=int, default=1)
     p.add_argument(
         "--sp", type=int, default=1,
-        help="sequence-parallel (ring attention) axis size",
+        help="sequence-parallel axis size",
+    )
+    p.add_argument(
+        "--sp-impl", choices=("ring", "ulysses"), default="ring",
+        help="sequence-parallel strategy",
     )
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument("--model-dir", default=None)

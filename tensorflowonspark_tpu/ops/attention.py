@@ -73,27 +73,33 @@ def dot_product_attention(
     trace-time object, so this path is dispatched outside the jit cache —
     it is meant to be called from inside an outer jitted train step.
     """
-    if impl == "ring":
-        from tensorflowonspark_tpu.parallel import (
-            current_mesh,
-            mesh_ring_attention,
-        )
+    if impl in ("ring", "ulysses"):
+        from tensorflowonspark_tpu.parallel import current_mesh
 
         mesh = current_mesh()
         if mesh is None:
             raise ValueError(
-                "impl='ring' needs an ambient mesh; wrap the call (or the "
-                "train-step trace) in tensorflowonspark_tpu.parallel.use_mesh"
+                f"impl={impl!r} needs an ambient mesh; wrap the call (or "
+                "the train-step trace) in "
+                "tensorflowonspark_tpu.parallel.use_mesh"
             )
         if segment_ids is not None:
             raise NotImplementedError(
-                "ring attention does not support segment_ids yet"
+                f"{impl} attention does not support segment_ids yet"
             )
         if mesh.shape.get("seq", 1) == 1 and mesh.shape.get("model", 1) == 1:
             return _jitted_attention(
                 q, k, v, causal=causal, scale=scale, impl="auto"
             )
-        return mesh_ring_attention(q, k, v, mesh, causal=causal, scale=scale)
+        if impl == "ring":
+            from tensorflowonspark_tpu.parallel import mesh_ring_attention
+
+            return mesh_ring_attention(
+                q, k, v, mesh, causal=causal, scale=scale
+            )
+        from tensorflowonspark_tpu.parallel import mesh_ulysses_attention
+
+        return mesh_ulysses_attention(q, k, v, mesh, causal=causal, scale=scale)
     return _jitted_attention(
         q, k, v, causal=causal, scale=scale,
         segment_ids=segment_ids, impl=impl,

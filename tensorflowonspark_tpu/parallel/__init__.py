@@ -35,12 +35,16 @@ from tensorflowonspark_tpu.parallel.ring_attention import (  # noqa: F401
     mesh_ring_attention,
     ring_attention,
 )
+from tensorflowonspark_tpu.parallel.ulysses import (  # noqa: F401
+    mesh_ulysses_attention,
+)
 
 __all__ = [
     "current_mesh",
     "use_mesh",
     "ring_attention",
     "mesh_ring_attention",
+    "mesh_ulysses_attention",
     "gpipe",
     "stack_stages",
     "MoEConfig",

@@ -1,0 +1,108 @@
+"""Ulysses-style sequence parallelism — all-to-all over the ``seq`` axis.
+
+The second sequence-parallel strategy beside ring attention
+(:mod:`~tensorflowonspark_tpu.parallel.ring_attention`): instead of
+rotating K/V blocks around a ring, one ``lax.all_to_all`` reshards
+activations from sequence-sharded to *head*-sharded, each device runs
+ordinary full-sequence attention over its head subset, and a second
+all-to-all reshards back. Two collectives total (vs n-1 permutes), at the
+cost of requiring heads divisible by the seq-axis size — the classic
+DeepSpeed-Ulysses trade: better for moderate sequence lengths with many
+heads, while the ring wins when S_local is the memory constraint.
+
+The reference had neither strategy (SURVEY.md §5.7).
+
+Composition: attention is head-independent, so after the first all-to-all
+each device holds FULL sequences for Hq/n heads and any single-device
+attention implementation applies — including the Pallas flash kernel on
+TPU (``impl`` passthrough), which the ring formulation cannot use without
+reworking its online-softmax merge.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ulysses_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool,
+    scale: float | None,
+    impl: str,
+):
+    """Per-device body; call under ``shard_map``.
+
+    Shards: q (B, S_loc, Hq, D), k/v (B, S_loc, Hkv, D). Heads must be
+    divisible by the axis size (enforced by the caller).
+    """
+    from tensorflowonspark_tpu.ops.attention import dot_product_attention
+
+    # seq-sharded -> head-sharded: split the head axis across devices,
+    # concatenate the sequence axis. (B, S_loc, H, D) -> (B, S, H/n, D).
+    def to_heads(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = dot_product_attention(
+        qh, kh, vh, causal=causal, scale=scale, impl=impl
+    )
+    # head-sharded -> seq-sharded: the inverse resharding.
+    return lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def mesh_ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    seq_axis: str = "seq",
+    impl: str = "auto",
+) -> jax.Array:
+    """Global-view Ulysses attention: shard_map over the mesh ``seq`` axis.
+
+    Inputs are global arrays (B, S, H, D); batch shards over
+    ``(data, fsdp)``, sequence over ``seq``, heads over ``model`` (TP
+    composes as usual). Requires S and *both* head counts divisible by the
+    seq-axis size.
+    """
+    n = mesh.shape.get(seq_axis, 1)
+    tp = mesh.shape.get("model", 1)
+    hq, hk = q.shape[2], k.shape[2]
+    # Heads are already split over 'model' by the in_specs; what each
+    # device all-to-alls must still divide by the seq-axis size.
+    if hq % (tp * n) or hk % (tp * n):
+        raise ValueError(
+            f"ulysses needs q heads ({hq}) and kv heads ({hk}) divisible "
+            f"by model x {seq_axis} ({tp} x {n}); use ring attention for "
+            "head-poor configs"
+        )
+    spec = P(("data", "fsdp"), seq_axis, "model", None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ulysses_local,
+            axis_name=seq_axis,
+            causal=causal,
+            scale=scale,
+            impl=impl,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
