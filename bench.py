@@ -1,134 +1,196 @@
 """Benchmark entry: prints ONE JSON line with the headline metric.
 
-Round-1 headline: MNIST CNN training examples/sec through the framework's
-own data plane (producer thread -> manager queue -> DataFeed -> shard_batch
--> jitted train step on the mesh), i.e. the BASELINE.md "MNIST
-InputMode.SPARK" config measured end-to-end, not a bare matmul loop.
+Headline: **training MFU of a 1B-param Llama decoder** on the local
+chip(s) — the metric BASELINE.md's north star is written in ("MNIST and
+a Llama fine-tune complete from the launcher at >=40% MFU"), and the one
+that is hardware-bound rather than tunnel-bound in this environment.
+``vs_baseline`` is measured MFU / the 40% target. The model/mesh/timing
+code is shared with ``benchmarks/real_chip.py`` (one implementation, one
+set of barrier workarounds).
 
-Runs single-process on whatever backend jax gives (the real TPU chip under
-the driver; CPU elsewhere). A watchdog prints a failure JSON line and
-exits if backend init wedges (this environment's TPU relay is fragile).
+Secondary fields in the same line: MNIST CNN examples/sec end-to-end
+through the framework's own data plane (producer -> manager queue ->
+DataFeed -> DevicePrefetcher -> jit step), i.e. the BASELINE.md "MNIST
+InputMode.SPARK" config. That number is bounded by host->device
+transfer (~35 MB/s through this environment's TPU tunnel), so it is
+reported but not the headline.
+
+Synchronization note: on the tunneled TPU backend, block_until_ready
+returns before execution finishes; all timing barriers here are host
+fetches of a scalar.
+
+A watchdog prints whatever has been measured so far (plus an error
+marker) and exits if the run wedges — this environment's TPU relay is
+fragile, and a partial line beats silence.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import threading
 import time
 
-WATCHDOG_SECS = 480  # fire before any outer ~600s kill, so the failure
-# JSON line still reaches the driver when backend init wedges
+WATCHDOG_SECS = 510  # fire before any outer ~600s kill, so a JSON line
+# still reaches the driver when backend init or a compile wedges
+MFU_TARGET = 0.40  # BASELINE.md acceptance threshold
+
 _result_printed = threading.Event()
+_partial: dict = {}  # results land here as they finish, for the watchdog
+
+
+def _emit(fields: dict) -> None:
+    print(json.dumps(fields), flush=True)
+    _result_printed.set()
 
 
 def _watchdog():
     if not _result_printed.wait(WATCHDOG_SECS):
-        print(
-            json.dumps(
-                {
-                    "metric": "mnist_train_examples_per_sec",
-                    "value": 0,
-                    "unit": "examples/sec",
-                    "vs_baseline": 0.0,
-                    "error": f"watchdog: no result within {WATCHDOG_SECS}s "
-                    "(backend init wedged?)",
-                }
-            ),
-            flush=True,
+        _emit(
+            {
+                "metric": "llama1b_train_mfu",
+                "value": _partial.get("mfu_pct", 0),
+                "unit": "%",
+                "vs_baseline": round(
+                    _partial.get("mfu_pct", 0) / (MFU_TARGET * 100), 3
+                ),
+                "error": f"watchdog: incomplete after {WATCHDOG_SECS}s "
+                "(backend init or compile wedged?)",
+                **{k: v for k, v in _partial.items() if k != "mfu_pct"},
+            }
         )
         os._exit(2)
 
 
-def main() -> None:
-    threading.Thread(target=_watchdog, daemon=True).start()
+def _bench_llama(steps: int = 10) -> None:
+    """1B Llama train step (shared impl: benchmarks/real_chip.py)."""
+    import jax
 
+    from benchmarks import real_chip
+
+    ns = argparse.Namespace(
+        steps=steps, batch_size=8, seq=1024, attention="auto"
+    )
+    res = real_chip.bench_llama1b(ns)
+    n_chips = len(jax.devices())
+    step_time = res["dt"] / steps
+    tflops_per_chip = res["flops_fallback"] / step_time / n_chips / 1e12
+    peak = (
+        real_chip.V5E_PEAK_TFLOPS
+        if jax.default_backend() == "tpu"
+        else None
+    )
+    _partial.update(
+        step_time_ms=round(step_time * 1e3, 1),
+        tokens_per_sec_per_chip=round(res["tokens"] / step_time / n_chips),
+        n_params=res["n_params"],
+        final_loss=round(res["loss"], 4),
+        model_tflops_per_sec_per_chip=round(tflops_per_chip, 1),
+    )
+    if peak is not None:
+        _partial["mfu_pct"] = tflops_per_chip / peak * 100
+
+
+def _bench_mnist_feed(steps: int = 40) -> None:
+    """MNIST end-to-end through the data plane, uint8 feed + prefetch."""
     import secrets
 
     import jax
+    import jax.numpy as jnp
     import numpy as np
     import optax
 
     from tensorflowonspark_tpu.cluster import manager as tf_manager
     from tensorflowonspark_tpu.cluster.marker import EndOfFeed
     from tensorflowonspark_tpu.compute import TrainState, build_train_step
-    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
-    from tensorflowonspark_tpu.feed.datafeed import DataFeed
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+    from tensorflowonspark_tpu.feed import DataFeed, DevicePrefetcher
     from tensorflowonspark_tpu.models import mnist
 
-    backend = jax.default_backend()
     mesh = make_mesh({"data": len(jax.devices())})
-
     batch_size = 1024
-    warmup_steps, bench_steps = 10, 50
-    total_steps = warmup_steps + bench_steps
+    warmup = 3
+    total = steps + warmup
 
     model = mnist.CNN()
     rng = np.random.default_rng(0)
-    images = rng.random((batch_size, 28, 28, 1), dtype=np.float32)
+    # uint8 records: what a real MNIST pipeline ships; normalize on device
+    images = (rng.random((batch_size, 28, 28, 1)) * 255).astype(np.uint8)
     labels = rng.integers(0, 10, size=batch_size).astype(np.int32)
-    params = model.init(jax.random.PRNGKey(0), images[:2])["params"]
+    params = model.init(
+        jax.random.PRNGKey(0), images[:2].astype(np.float32)
+    )["params"]
     tx = optax.adam(1e-3)
     state = TrainState.create(params, tx)
-    step = build_train_step(mnist.loss_fn(model.apply), tx, mesh)
+    base_loss = mnist.loss_fn(model.apply)
 
-    # The framework's push data plane, in-process: producer thread fills the
-    # node manager queue with record chunks; DataFeed consumes.
+    def loss(p, b):
+        img = b["image"].astype(jnp.float32) / 255.0
+        return base_loss(p, {"image": img, "label": b["label"]})
+
+    step = build_train_step(loss, tx, mesh)
+
     mgr = tf_manager.start(secrets.token_bytes(8), mode="local", maxsize=64)
 
     def produce():
         q = mgr.get_queue("input")
-        for _ in range(total_steps):
+        for _ in range(total):
             q.put(list(zip(images, labels)))
         q.put(EndOfFeed())
 
     threading.Thread(target=produce, daemon=True).start()
     feed = DataFeed(mgr, input_mapping={"image": "image", "label": "label"})
 
-    def next_device_batch():
-        cols = feed.next_batch(batch_size)
-        return shard_batch(
-            mesh, {"image": cols["image"], "label": cols["label"]}
-        )
+    def host_batches():
+        while not feed.should_stop():
+            cols = feed.next_batch(batch_size)
+            if cols and len(cols["image"]):
+                yield {"image": cols["image"], "label": cols["label"]}
 
-    # warmup (includes compile)
-    for _ in range(warmup_steps):
-        state, loss = step(state, next_device_batch())
-    jax.block_until_ready(loss)
-
-    t0 = time.perf_counter()
-    for _ in range(bench_steps):
-        state, loss = step(state, next_device_batch())
-    jax.block_until_ready(loss)
+    n = 0
+    t0 = None
+    with DevicePrefetcher(host_batches(), mesh, depth=2) as pf:
+        for dev_batch in pf:
+            state, loss_v = step(state, dev_batch)
+            n += 1
+            if n == warmup:
+                float(loss_v)
+                t0 = time.perf_counter()
+    final = float(loss_v)
     dt = time.perf_counter() - t0
-
-    examples_per_sec = bench_steps * batch_size / dt
-    step_ms = dt / bench_steps * 1000
-    n_chips = len(jax.devices())
-
-    # The reference publishes no absolute numbers (BASELINE.md): baseline is
-    # self-defined as this round's first TPU measurement, recorded below
-    # once known. vs_baseline = value / baseline.
-    baseline = 40000.0  # examples/sec, provisional round-1 target (TPU)
-    print(
-        json.dumps(
-            {
-                "metric": "mnist_train_examples_per_sec",
-                "value": round(examples_per_sec, 1),
-                "unit": "examples/sec",
-                "vs_baseline": round(examples_per_sec / baseline, 3),
-                "step_time_ms": round(step_ms, 2),
-                "batch_size": batch_size,
-                "backend": backend,
-                "chips": n_chips,
-                "per_chip": round(examples_per_sec / n_chips, 1),
-                "final_loss": float(loss),
-            }
-        ),
-        flush=True,
-    )
-    _result_printed.set()
     mgr.stop()
+    timed = n - warmup
+    _partial.update(
+        mnist_examples_per_sec=round(timed * batch_size / dt, 1),
+        mnist_step_time_ms=round(dt / timed * 1e3, 2),
+        mnist_final_loss=round(final, 4),
+    )
+
+
+def main() -> None:
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    import jax
+
+    _partial["backend"] = jax.default_backend()
+    _partial["chips"] = len(jax.devices())
+
+    _bench_llama()  # headline first, so a late wedge still reports it
+    _bench_mnist_feed()
+
+    mfu = _partial.pop("mfu_pct", None)
+    _emit(
+        {
+            "metric": "llama1b_train_mfu",
+            "value": round(mfu, 1) if mfu is not None else 0,
+            "unit": "%",
+            "vs_baseline": (
+                round(mfu / (MFU_TARGET * 100), 3) if mfu is not None else 0.0
+            ),
+            **_partial,
+        }
+    )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,298 @@
+"""Single-chip benchmark runner for the BASELINE.md configs.
+
+Runs ONE config per process invocation (the TPU relay in this environment
+tolerates exactly one dialing process), entirely in the main process, and
+prints one JSON line: step time, examples/sec(/chip), and MFU.
+
+MFU accounting: transformers use the standard 6*P*T model-flops rule
+(fwd+bwd, no attention or remat term); ResNet uses 3x its 4.1 GFLOP
+forward. Peak defaults to v5e bf16 (197 TFLOP/s); override with
+--peak-tflops (v4: 275, v5p: 459).
+
+Usage::
+
+    python benchmarks/real_chip.py --config resnet50 [--steps 30] ...
+
+Configs map to BASELINE.md rows: mnist, resnet50, bert_base, llama1b.
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), ".."))
+)
+
+import argparse
+import json
+import time
+
+
+def _bench_step(step, state, make_batch, steps: int, warmup: int = 3):
+    """Time `steps` executions of step(state, batch); return (state, dt).
+
+    Synchronization is a host fetch of the loss scalar, NOT
+    ``block_until_ready``: on the tunneled TPU backend in this environment
+    block_until_ready returns before the computation actually finishes,
+    which silently times dispatch instead of execution. The batch is put
+    on device once and reused so the timing measures the train step, not
+    host->device transfer over the tunnel.
+    """
+    batch = make_batch()  # device-resident, reused every step
+    for _ in range(warmup):
+        state, loss = step(state, batch)
+    float(loss)  # host fetch = real barrier
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, batch)
+    loss = float(loss)
+    return state, time.perf_counter() - t0, loss
+
+
+def bench_mnist(args):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState, build_train_step
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.models import mnist
+
+    mesh = make_mesh({"data": len(jax.devices())})
+    b = args.batch_size or 1024
+    model = mnist.CNN()
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": rng.random((b, 28, 28, 1), dtype=np.float32),
+        "label": rng.integers(0, 10, size=b).astype(np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), batch["image"][:2])["params"]
+    tx = optax.adam(1e-3)
+    state = TrainState.create(params, tx)
+    step = build_train_step(mnist.loss_fn(model.apply), tx, mesh)
+    make_batch = lambda: shard_batch(mesh, batch)
+    state, dt, loss = _bench_step(step, state, make_batch, args.steps)
+    return dict(examples=b, dt=dt, loss=loss, flops_fallback=None)
+
+
+def bench_resnet50(args):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState, build_train_step
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.models import resnet
+
+    mesh = make_mesh({"data": len(jax.devices())})
+    b = args.batch_size or 256
+    model = resnet.ResNet(resnet.ResNetConfig.resnet50())
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": rng.random((b, 224, 224, 3), dtype=np.float32),
+        "label": rng.integers(0, 1000, size=b).astype(np.int32),
+    }
+    variables = model.init(jax.random.PRNGKey(0), batch["image"][:2])
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    loss_fn = resnet.loss_fn(model)
+    state = TrainState.create(params, tx)
+
+    @jax.jit
+    def step(state, stats, batch):
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, stats, batch
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(
+                step=state.step + 1, params=new_params, opt_state=new_opt
+            ),
+            new_stats,
+            loss,
+        )
+
+    # inline warm/time loop (extra carried batch_stats); same sync rules
+    # as _bench_step: device-resident batch, host-fetch barrier.
+    dev_batch = shard_batch(mesh, batch)
+    for _ in range(3):
+        state, batch_stats, loss = step(state, batch_stats, dev_batch)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, batch_stats, loss = step(state, batch_stats, dev_batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+    # ResNet-50 training ≈ 3x forward (4.1 GFLOPs) per image
+    return dict(
+        examples=b, dt=dt, loss=float(loss), flops_fallback=3 * 4.1e9 * b
+    )
+
+
+def bench_bert_base(args):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState, build_train_step
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.models import bert
+
+    mesh = make_mesh({"data": len(jax.devices())})
+    b = args.batch_size or 64
+    seq = args.seq or 128
+    cfg = bert.BertConfig(vocab_size=30522, max_seq_len=seq)
+    model = bert.BertForMLM(cfg)
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": rng.integers(0, cfg.vocab_size, size=(b, seq)).astype(
+            np.int32
+        ),
+        "targets": rng.integers(0, cfg.vocab_size, size=(b, seq)).astype(
+            np.int32
+        ),
+    }
+    params = model.init(jax.random.PRNGKey(0), batch["tokens"][:2])["params"]
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    tx = optax.adamw(1e-4)
+    state = TrainState.create(params, tx)
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["tokens"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["targets"]
+        ).mean()
+
+    step = build_train_step(loss_fn, tx, mesh)
+    make_batch = lambda: shard_batch(mesh, batch)
+    state, dt, loss = _bench_step(step, state, make_batch, args.steps)
+    return dict(
+        examples=b,
+        dt=dt,
+        loss=loss,
+        flops_fallback=6 * n_params * b * seq,
+    )
+
+
+def bench_llama1b(args):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState, build_train_step
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.models.llama import (
+        Llama,
+        LlamaConfig,
+        llama_loss_fn,
+        llama_param_shardings,
+    )
+    from tensorflowonspark_tpu.parallel import use_mesh
+    import jax.numpy as jnp
+
+    mesh = make_mesh({"fsdp": len(jax.devices())})
+    b = args.batch_size or 8
+    seq = args.seq or 1024
+    cfg = LlamaConfig(
+        vocab_size=32000,
+        hidden_size=2048,
+        intermediate_size=5632,
+        num_layers=16,
+        num_heads=16,
+        num_kv_heads=16,
+        max_seq_len=seq,
+        dtype=jnp.bfloat16,
+        remat=True,
+        attention_impl=args.attention,
+    )
+    model = Llama(cfg)
+    rng = np.random.default_rng(0)
+    tokens0 = np.zeros((2, seq + 1), np.int32)
+    with use_mesh(mesh):
+        params = model.init(jax.random.PRNGKey(0), tokens0[:, :-1])["params"]
+    psh = llama_param_shardings(params, mesh)
+    params = jax.tree.map(jax.device_put, params, psh)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    tx = optax.adamw(1e-4)
+    state = TrainState.create(params, tx)
+    token_loss = llama_loss_fn(model)
+    step = build_train_step(
+        lambda p, bt: token_loss(p, bt["tokens"]), tx, mesh, param_shardings=psh
+    )
+    batch = {
+        "tokens": rng.integers(0, cfg.vocab_size, size=(b, seq + 1)).astype(
+            np.int32
+        )
+    }
+    make_batch = lambda: shard_batch(mesh, batch)
+    with use_mesh(mesh):
+        state, dt, loss = _bench_step(step, state, make_batch, args.steps)
+    return dict(
+        examples=b,
+        dt=dt,
+        loss=loss,
+        flops_fallback=6 * n_params * b * seq,
+        n_params=n_params,
+        tokens=b * seq,
+    )
+
+
+V5E_PEAK_TFLOPS = 197.0  # per-chip bf16 peak (shared with bench.py)
+
+CONFIGS = {
+    "mnist": bench_mnist,
+    "resnet50": bench_resnet50,
+    "bert_base": bench_bert_base,
+    "llama1b": bench_llama1b,
+}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", choices=sorted(CONFIGS), required=True)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--seq", type=int, default=None)
+    p.add_argument("--attention", default="auto")
+    p.add_argument(
+        "--peak-tflops",
+        type=float,
+        default=V5E_PEAK_TFLOPS,
+        help="per-chip bf16 peak",
+    )
+    args = p.parse_args(argv)
+
+    import jax
+
+    res = CONFIGS[args.config](args)
+    n_chips = len(jax.devices())
+    step_time = res["dt"] / args.steps
+    eps = res["examples"] / step_time
+    out = {
+        "config": args.config,
+        "backend": jax.default_backend(),
+        "chips": n_chips,
+        "step_time_ms": round(step_time * 1e3, 2),
+        "examples_per_sec": round(eps, 1),
+        "examples_per_sec_per_chip": round(eps / n_chips, 1),
+        "final_loss": round(res["loss"], 4),
+    }
+    if res.get("tokens"):
+        out["tokens_per_sec_per_chip"] = round(
+            res["tokens"] / step_time / n_chips
+        )
+    if res.get("flops_fallback"):
+        mfu = res["flops_fallback"] / step_time / n_chips / (
+            args.peak_tflops * 1e12
+        )
+        out["mfu_pct"] = round(mfu * 100, 1)
+    if res.get("n_params"):
+        out["n_params_m"] = round(res["n_params"] / 1e6)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

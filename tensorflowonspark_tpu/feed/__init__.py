@@ -1,9 +1,12 @@
 """The push data plane: host-side queues into the training loop.
 
 Reference parity: the ``DataFeed`` class of ``tensorflowonspark/TFNode.py``
-plus the queue sentinels of ``marker.py``.
+plus the queue sentinels of ``marker.py``. ``DevicePrefetcher`` extends
+the plane one hop further than the reference could: host batch ->
+device, overlapped with the training step.
 """
 
 from tensorflowonspark_tpu.feed.datafeed import DataFeed
+from tensorflowonspark_tpu.feed.prefetch import DevicePrefetcher
 
-__all__ = ["DataFeed"]
+__all__ = ["DataFeed", "DevicePrefetcher"]

@@ -31,6 +31,11 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+# Per-row stats (LSE, delta) are stored lane-replicated to NUM_LANES so
+# their blocks satisfy Mosaic's (8, 128) tiling rule — a (1, block_q)
+# block on a (rows, seq) array is rejected on real TPUs. Same layout the
+# reference TPU kernel in jax.experimental.pallas.ops.tpu uses.
+NUM_LANES = 128
 
 # Test hook: run the kernel in the Pallas interpreter (works on CPU).
 INTERPRET = False
@@ -103,7 +108,9 @@ def _fwd_kernel(
     def _finalize():
         l = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
+        lse_ref[0] = jnp.broadcast_to(
+            m_ref[...] + jnp.log(l), (o_ref.shape[1], NUM_LANES)
+        )
 
 
 def _flash_forward(
@@ -165,11 +172,11 @@ def _flash_forward(
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda h, qi, ki: (h, qi)),
+            pl.BlockSpec((1, block_q, NUM_LANES), lambda h, qi, ki: (h, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * hq, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * hq, sq, NUM_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
@@ -180,7 +187,7 @@ def _flash_forward(
     )(qt, kt, vt)
     out = out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
     if return_lse:
-        return out, lse
+        return out, lse[:, :, 0]
     return out
 
 
@@ -223,11 +230,11 @@ def _dq_kernel(
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         s = _tile_logits(q, k, qi, ki, block_q, block_k, offset, causal, scale)
-        p = _probs(s, lse_ref[0][:, None])
+        p = _probs(s, lse_ref[0][:, :1])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0][:, :1])
         dq_acc[...] += scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -263,14 +270,14 @@ def _dkv_kernel(
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         s = _tile_logits(q, k, qi, ki, block_q, block_k, offset, causal, scale)
-        p = _probs(s, lse_ref[0][:, None])  # (block_q, block_k)
+        p = _probs(s, lse_ref[0][:, :1])  # (block_q, block_k)
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0][:, :1])
         dk_acc[...] += scale * jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -302,6 +309,10 @@ def _flash_backward(
     delta = jnp.sum(
         gt.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1
     )
+    # Lane-replicate the per-row stats so their blocks tile legally (see
+    # NUM_LANES above).
+    lse_l = jnp.broadcast_to(lse[:, :, None], (b * hq, sq, NUM_LANES))
+    delta_l = jnp.broadcast_to(delta[:, :, None], (b * hq, sq, NUM_LANES))
 
     num_q_blocks = sq // block_q
     num_k_blocks = sk // block_k
@@ -328,14 +339,14 @@ def _flash_backward(
             pl.BlockSpec((1, block_k, d), lambda h, qi, ki: (kv_row3(h, qi, ki), ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda h, qi, ki: (kv_row3(h, qi, ki), ki, 0)),
             pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda h, qi, ki: (h, qi)),
-            pl.BlockSpec((1, block_q), lambda h, qi, ki: (h, qi)),
+            pl.BlockSpec((1, block_q, NUM_LANES), lambda h, qi, ki: (h, qi, 0)),
+            pl.BlockSpec((1, block_q, NUM_LANES), lambda h, qi, ki: (h, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=INTERPRET,
-    )(qt, kt, vt, gt, lse, delta)
+    )(qt, kt, vt, gt, lse_l, delta_l)
 
     # dK/dV per *query* head (b*hq rows): several q heads share one KV head,
     # and revisiting an output block from non-consecutive grid rows is not
@@ -350,8 +361,8 @@ def _flash_backward(
             pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (kv_row3(h, ki, qi), ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (kv_row3(h, ki, qi), ki, 0)),
             pl.BlockSpec((1, block_q, d), lambda h, ki, qi: (h, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda h, ki, qi: (h, qi)),
-            pl.BlockSpec((1, block_q), lambda h, ki, qi: (h, qi)),
+            pl.BlockSpec((1, block_q, NUM_LANES), lambda h, ki, qi: (h, qi, 0)),
+            pl.BlockSpec((1, block_q, NUM_LANES), lambda h, ki, qi: (h, qi, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (h, ki, 0)),
@@ -368,7 +379,7 @@ def _flash_backward(
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=INTERPRET,
-    )(qt, kt, vt, gt, lse, delta)
+    )(qt, kt, vt, gt, lse_l, delta_l)
 
     dq = dq.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
     dk = (
@@ -385,25 +396,52 @@ def _flash_backward(
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _default_blocks(sq: int, sk: int) -> tuple[int, int]:
+    """Block sizes by sequence length, measured on v5e: bigger blocks
+    amortize grid overhead once the sequence is long enough (512 wins at
+    >=4k, 256 at >=1k, 128 below)."""
+
+    def pick(s):
+        for cand in (512, 256, 128):
+            if s >= 4096 and cand == 512 and s % cand == 0:
+                return cand
+            if s >= 1024 and cand == 256 and s % cand == 0:
+                return cand
+        return 128
+
+    return pick(sq), pick(sk)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
     scale: float | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ) -> jax.Array:
-    return _flash_forward(q, k, v, causal, scale)
+    bq, bk = _default_blocks(q.shape[1], k.shape[1])
+    return _flash_forward(
+        q, k, v, causal, scale, block_q or bq, block_k or bk
+    )
 
 
-def _fwd(q, k, v, causal, scale):
-    out, lse = _flash_forward(q, k, v, causal, scale, return_lse=True)
+def _fwd(q, k, v, causal, scale, block_q, block_k):
+    bq, bk = _default_blocks(q.shape[1], k.shape[1])
+    out, lse = _flash_forward(
+        q, k, v, causal, scale, block_q or bq, block_k or bk, return_lse=True
+    )
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, scale, res, g):
+def _bwd(causal, scale, block_q, block_k, res, g):
     q, k, v, out, lse = res
-    return _flash_backward(q, k, v, out, lse, g, causal, scale)
+    bq, bk = _default_blocks(q.shape[1], k.shape[1])
+    return _flash_backward(
+        q, k, v, out, lse, g, causal, scale, block_q or bq, block_k or bk
+    )
 
 
 flash_attention.defvjp(_fwd, _bwd)

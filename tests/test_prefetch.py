@@ -1,0 +1,48 @@
+"""DevicePrefetcher: ordering, sharding, error ferry, early close."""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.feed import DevicePrefetcher
+
+
+def test_prefetch_orders_and_shards(mesh8):
+    batches = [
+        {"x": np.full((8, 4), i, np.float32), "y": np.arange(8) + i}
+        for i in range(5)
+    ]
+    out = list(DevicePrefetcher(iter(batches), mesh8, depth=2))
+    assert len(out) == 5
+    for i, b in enumerate(out):
+        assert b["x"].sharding.mesh.shape == mesh8.shape
+        np.testing.assert_array_equal(np.asarray(b["x"]), batches[i]["x"])
+        np.testing.assert_array_equal(np.asarray(b["y"]), batches[i]["y"])
+
+
+def test_prefetch_transform_override():
+    out = list(
+        DevicePrefetcher([1, 2, 3], transform=lambda b: b * 10, depth=1)
+    )
+    assert out == [10, 20, 30]
+
+
+def test_prefetch_producer_error_reraised(mesh8):
+    def gen():
+        yield {"x": np.zeros((8, 2), np.float32)}
+        raise TimeoutError("feed died")
+
+    pf = DevicePrefetcher(gen(), mesh8)
+    next(pf)
+    with pytest.raises(TimeoutError, match="feed died"):
+        next(pf)
+
+
+def test_prefetch_close_unblocks_producer(mesh8):
+    def gen():
+        for i in range(1000):
+            yield {"x": np.zeros((8, 2), np.float32)}
+
+    pf = DevicePrefetcher(gen(), mesh8, depth=1)
+    next(pf)
+    pf.close()  # must not hang on the producer's blocked put
+    assert not pf._thread.is_alive()
