@@ -205,7 +205,8 @@ def bench_llama1b(args):
         num_kv_heads=16,
         max_seq_len=seq,
         dtype=jnp.bfloat16,
-        remat=True,
+        remat=getattr(args, "remat", "full") != "none",
+        remat_policy=getattr(args, "remat", "full"),
         attention_impl=args.attention,
     )
     model = Llama(cfg)
@@ -257,6 +258,9 @@ def main(argv=None):
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--seq", type=int, default=None)
     p.add_argument("--attention", default="auto")
+    p.add_argument(
+        "--remat", choices=("full", "dots", "none"), default="full"
+    )
     p.add_argument(
         "--peak-tflops",
         type=float,

@@ -42,6 +42,11 @@ class LlamaConfig:
     dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"
     remat: bool = True
+    # 'full': recompute the whole block in backward (min memory);
+    # 'dots': save matmul/einsum outputs, recompute the cheap elementwise
+    # ops only (XLA's dots_with_no_batch_dims_saveable — usually the best
+    # MFU/memory point when the model fits); ignored when remat=False.
+    remat_policy: str = "full"
     # MoE: when num_experts > 0 every block's MLP is a routed expert bank
     # (expert-parallel over the mesh 'expert' axis — parallel/moe.py).
     num_experts: int = 0
@@ -259,7 +264,17 @@ class Llama(nn.Module):
             # FLOPs for HBM, the standard long-sequence TPU memory lever.
             # (decode stays out of the remat'd arg list: as a traced
             # operand it could not drive Python control flow.)
-            block = nn.remat(Block, static_argnums=())
+            if cfg.remat_policy not in ("full", "dots", "none"):
+                raise ValueError(
+                    f"unknown remat_policy {cfg.remat_policy!r}; "
+                    "expected 'full', 'dots', or 'none'"
+                )
+            policy = (
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                if cfg.remat_policy == "dots"
+                else None
+            )
+            block = nn.remat(Block, static_argnums=(), policy=policy)
             for i in range(cfg.num_layers):
                 x = block(cfg, name=f"layer{i}")(x, positions, segment_ids)
         else:

@@ -11,6 +11,7 @@ from tensorflowonspark_tpu.models.llama import (
     Llama,
     LlamaConfig,
     cross_entropy_loss,
+    llama_loss_fn,
     llama_param_shardings,
 )
 
@@ -419,3 +420,29 @@ def test_bert_mlm_trains(tiny_bert):
         params, opt_state, l = step(params, opt_state)
         l0 = l0 if l0 is not None else float(l)
     assert float(l) < l0
+
+
+@pytest.mark.parametrize("policy", ["full", "dots"])
+def test_llama_remat_policies_match_no_remat(policy):
+    """Every remat policy computes the same loss and grads as remat=False."""
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(3), (2, 17), 0, 64
+    ).astype(jnp.int32)
+
+    def loss_and_grad(remat, remat_policy="full"):
+        cfg = LlamaConfig.tiny(
+            dtype=jnp.float32,
+            vocab_size=64,
+            remat=remat,
+            remat_policy=remat_policy,
+        )
+        model = Llama(cfg)
+        params = model.init(jax.random.PRNGKey(0), tokens[:, :-1])["params"]
+        fn = llama_loss_fn(model)
+        return jax.value_and_grad(lambda p: fn(p, tokens))(params)
+
+    chex = pytest.importorskip("chex")
+    base_loss, base_grad = loss_and_grad(False)
+    l, g = loss_and_grad(True, policy)
+    assert float(l) == pytest.approx(float(base_loss), rel=1e-6)
+    chex.assert_trees_all_close(g, base_grad, rtol=1e-5, atol=1e-6)
