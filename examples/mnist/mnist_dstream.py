@@ -1,0 +1,114 @@
+"""MNIST training from a DStream — the full Spark Streaming object model.
+
+Reference parity: the pyspark-Streaming examples (``TFCluster.train`` with
+a DStream built from ``ssc.textFileStream(HDFS dir)``, SURVEY.md §3.2).
+Here :mod:`tensorflowonspark_tpu.streaming` provides the object model: a
+``StreamingContext`` watches a directory, each new CSV file becomes one
+partition of a micro-batch, a ``map`` parses lines into records, and
+``cluster.train(stream)`` feeds them as they arrive. Teardown goes
+through ``cluster.shutdown(ssc=ssc)`` like the reference's
+``shutdown(ssc)``.
+
+The writer thread below simulates the "new files land in HDFS" side by
+dropping CSV shards into the watched directory.
+
+Usage::
+
+    tpu-submit --num-executors 2 examples/mnist/mnist_dstream.py \
+        [--files 10] [--rows-per-file 512] [--interval 0.3] [--cpu]
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
+import argparse
+import os
+import tempfile
+import threading
+import time
+
+# Workers run the same consumer loop as the generator-based streaming
+# example: batch_stream + early terminate after target steps.
+from examples.mnist.mnist_streaming import main_fun  # noqa: E402
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--dir", default=None, help="directory to watch")
+    p.add_argument("--files", type=int, default=10)
+    p.add_argument("--rows-per-file", type=int, default=512)
+    p.add_argument("--interval", type=float, default=0.3)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--target-steps", type=int, default=20)
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args(argv)
+
+
+def write_files(directory: str, n_files: int, rows: int, interval: float):
+    """Simulate files arriving: each is 'label,pix0,...,pix783' CSV rows."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    for i in range(n_files):
+        path = os.path.join(directory, f"part-{i:05d}.csv")
+        # dot-prefixed while writing: textFileStream skips hidden names,
+        # so the watcher only ever sees the completed file (atomic rename)
+        tmp = os.path.join(directory, f".part-{i:05d}.csv.tmp")
+        with open(tmp, "w") as f:
+            for _ in range(rows):
+                label = int(rng.integers(0, 10))
+                pixels = rng.integers(0, 255, size=784)
+                f.write(f"{label}," + ",".join(map(str, pixels)) + "\n")
+        os.rename(tmp, path)
+        time.sleep(interval)
+
+
+def parse_line(line: str):
+    import numpy as np
+
+    parts = line.split(",")
+    label = int(parts[0])
+    image = np.asarray(parts[1:], dtype=np.int64)
+    return (image, label)
+
+
+if __name__ == "__main__":
+    from tensorflowonspark_tpu.cluster import tfcluster
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+    from tensorflowonspark_tpu.launcher import cluster_args_from_env
+    from tensorflowonspark_tpu.streaming import StreamingContext
+    from tensorflowonspark_tpu.utils.util import cpu_only_env
+
+    args = parse_args()
+    largs = cluster_args_from_env()
+    watch_dir = args.dir or tempfile.mkdtemp(prefix="mnist_dstream_")
+
+    cluster = tfcluster.run(
+        main_fun,
+        args,
+        num_executors=largs["num_executors"],
+        input_mode=InputMode.SPARK,
+        env=cpu_only_env() if args.cpu else None,
+        launcher=largs.get("launcher"),
+        distributed=largs.get("distributed", False),
+    )
+
+    ssc = StreamingContext(batch_interval=max(0.1, args.interval / 2))
+    stream = ssc.textFileStream(watch_dir).map(parse_line)
+    cluster.train(stream)  # registers the foreachRDD feed bridge
+    ssc.start()
+
+    writer = threading.Thread(
+        target=write_files,
+        args=(watch_dir, args.files, args.rows_per_file, args.interval),
+        daemon=True,
+    )
+    writer.start()
+    writer.join()
+    time.sleep(2 * args.interval)  # let the last tick deliver
+
+    cluster.shutdown(ssc=ssc)
+    print("mnist_dstream done")

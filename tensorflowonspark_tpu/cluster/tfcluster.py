@@ -59,6 +59,7 @@ class TFCluster:
         self.input_mode = input_mode
         self.queues = queues
         self._shutdown_done = False
+        self._dstream_bridge: tuple | None = None
 
     # ------------------------------------------------------------------
     @property
@@ -106,7 +107,24 @@ class TFCluster:
         sequentially by a dedicated thread (the moral equivalent of Spark's
         waves of ``foreachPartition`` feed tasks, reference ``TFCluster.train``
         → ``TFSparkNode._train``).
+
+        A :class:`~tensorflowonspark_tpu.streaming.DStream` is also
+        accepted (reference: ``TFCluster.train`` with a DStream →
+        ``foreachRDD`` feeding): the call registers the feed bridge and
+        returns immediately; micro-batches flow once the stream's
+        ``StreamingContext.start()`` runs. End with
+        ``shutdown(ssc=ssc)``.
         """
+        from tensorflowonspark_tpu.streaming import DStream
+
+        if isinstance(data, DStream):
+            if num_epochs != 1:
+                raise ValueError(
+                    "num_epochs does not apply to a DStream (each "
+                    "micro-batch is fed once, on arrival)"
+                )
+            self._train_dstream(data, feed_timeout, qname)
+            return
         self._require_spark_mode("train")
         workers = self.workers
         partitions = _as_partitions(data, len(workers))
@@ -145,6 +163,75 @@ class TFCluster:
             self._check_errors()
             raise errors[0]
         self._check_errors()
+
+    def _train_dstream(self, dstream, feed_timeout: float, qname: str) -> None:
+        """Bridge a DStream into :meth:`train_stream`: ``foreachRDD``
+        pushes micro-batches into a bounded queue; a background thread
+        drains it through the normal streaming feed path. Non-blocking —
+        mirrors the reference, where ``train(DStream)`` just registered
+        the ``foreachRDD`` and Spark Streaming drove the feeding."""
+        self._require_spark_mode("train")
+        if getattr(self, "_dstream_bridge", None) is not None:
+            raise RuntimeError("a DStream is already being trained on")
+        bridge: _stdqueue.Queue = _stdqueue.Queue(maxsize=2)
+        end = object()
+        errors: list[BaseException] = []
+        stop_evt = threading.Event()
+
+        def micro_batches():
+            while True:
+                item = bridge.get()
+                if item is end:
+                    return
+                yield item
+
+        def run() -> None:
+            try:
+                self.train_stream(
+                    micro_batches(), feed_timeout=feed_timeout, qname=qname
+                )
+            except BaseException as e:  # noqa: BLE001 - ferried to shutdown
+                errors.append(e)
+
+        thread = threading.Thread(
+            target=run, name="dstream-feed", daemon=True
+        )
+
+        def bridge_put(rdd) -> None:
+            # Never block the scheduler forever: if the feed thread died
+            # (worker early-stop, feeder error) or shutdown started, drop
+            # the micro-batch instead of wedging the tick loop — the
+            # reference's foreachRDD feed task failed/no-opped the same
+            # way once the TF side stopped consuming.
+            while not stop_evt.is_set() and thread.is_alive():
+                try:
+                    bridge.put(rdd, timeout=0.2)
+                    return
+                except _stdqueue.Full:
+                    continue
+
+        dstream.foreachRDD(bridge_put)
+        thread.start()
+        self._dstream_bridge = (bridge, end, thread, errors, stop_evt)
+
+    def _drain_dstream(self) -> None:
+        bridge, end, thread, errors, stop_evt = self._dstream_bridge
+        self._dstream_bridge = None
+        stop_evt.set()  # scheduler callbacks stop feeding / unblock
+        while thread.is_alive():
+            try:
+                bridge.put(end, timeout=0.2)
+                break
+            except _stdqueue.Full:
+                # Feed thread stopped consuming (early stop) — make room
+                # by dropping pending micro-batches; shutdown means stop.
+                try:
+                    bridge.get_nowait()
+                except _stdqueue.Empty:
+                    pass
+        thread.join()
+        if errors:
+            raise errors[0]
 
     def train_stream(
         self,
@@ -353,15 +440,29 @@ class TFCluster:
         self,
         grace_secs: float = 0.0,
         timeout: float = 259200.0,
+        ssc=None,
     ) -> None:
         """Graceful teardown with a force-kill watchdog.
 
-        Reference: ``TFCluster.shutdown`` (grace sleep → terminal markers on
-        every queue → join nodes → watchdog force-terminate → reservation
+        Reference: ``TFCluster.shutdown`` (await streaming termination if
+        an ``ssc`` is given → grace sleep → terminal markers on every
+        queue → join nodes → watchdog force-terminate → reservation
         STOP). Raises if any node ferried an exception or exited nonzero.
         """
         if self._shutdown_done:
             return
+        stream_error: BaseException | None = None
+        if ssc is not None:
+            ssc.stop()
+            try:
+                ssc.awaitTermination(timeout=timeout)
+            except BaseException as e:  # noqa: BLE001 - raised after teardown
+                stream_error = e
+        if self._dstream_bridge is not None:
+            try:
+                self._drain_dstream()
+            except BaseException as e:  # noqa: BLE001 - raised after teardown
+                stream_error = stream_error or e
         if grace_secs:
             time.sleep(grace_secs)
 
@@ -399,6 +500,8 @@ class TFCluster:
             raise RuntimeError(f"cluster node(s) failed:\n{tracebacks}")
         if bad:
             raise RuntimeError(f"node process(es) exited nonzero: {bad}")
+        if stream_error is not None:
+            raise stream_error
 
     # ------------------------------------------------------------------
     def _require_spark_mode(self, op: str) -> None:

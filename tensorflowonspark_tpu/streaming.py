@@ -1,0 +1,292 @@
+"""Spark-Streaming-shaped micro-batch streaming (DStream object model).
+
+Reference parity: ``TFCluster.train`` accepted a ``DStream`` and fed each
+arriving RDD via ``foreachRDD`` (``TFCluster.py:train``, SURVEY.md §3.2),
+and ``TFCluster.shutdown(ssc, ...)`` awaited streaming termination. The
+reference delegated the object model to pyspark; this module provides the
+TPU-native equivalent: a :class:`StreamingContext` scheduler thread turns
+sources into micro-batch "RDDs" (lists of partitions) on a fixed
+interval, :class:`DStream` carries the record-level transformation chain,
+and ``foreachRDD`` delivers to output callbacks — e.g. the bridge
+``TFCluster.train`` installs to feed workers through the data plane.
+
+Sources mirror the pyspark ones the reference's examples used:
+``textFileStream`` (watch a directory, one partition per new file —
+the HDFS-dir pattern of ``examples/mnist`` streaming), ``queueStream``
+(pre-staged RDDs), and ``generatorStream`` (callable per tick; the
+escape hatch for custom receivers).
+
+Usage::
+
+    ssc = StreamingContext(batch_interval=1.0)
+    stream = ssc.textFileStream("/data/incoming").map(parse_line)
+    cluster.train(stream)          # registers the feed bridge
+    ssc.start()
+    ...
+    cluster.shutdown(ssc=ssc)      # stop stream, drain, tear down
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Iterable, Sequence
+
+logger = logging.getLogger(__name__)
+
+# An "RDD" in this model: a list of partitions, each a list of records.
+RDD = list
+
+
+class DStream:
+    """A discretized stream: per-tick RDDs flowing through a
+    transformation chain. Transformations return new DStreams; output
+    operations (:meth:`foreachRDD`) register callbacks on the context."""
+
+    def __init__(self, ssc: "StreamingContext", parent: "DStream | None",
+                 op: Callable[[RDD], RDD] | None):
+        self._ssc = ssc
+        self._parent = parent
+        self._op = op
+
+    # -- transformations (record level) --------------------------------
+    def map(self, fn: Callable[[Any], Any]) -> "DStream":
+        return self._derive(
+            lambda rdd: [[fn(r) for r in part] for part in rdd]
+        )
+
+    def filter(self, fn: Callable[[Any], bool]) -> "DStream":
+        return self._derive(
+            lambda rdd: [[r for r in part if fn(r)] for part in rdd]
+        )
+
+    def flatMap(self, fn: Callable[[Any], Iterable[Any]]) -> "DStream":
+        return self._derive(
+            lambda rdd: [
+                [x for r in part for x in fn(r)] for part in rdd
+            ]
+        )
+
+    def mapPartitions(
+        self, fn: Callable[[Iterable[Any]], Iterable[Any]]
+    ) -> "DStream":
+        return self._derive(lambda rdd: [list(fn(iter(p))) for p in rdd])
+
+    def repartition(self, n: int) -> "DStream":
+        def op(rdd: RDD) -> RDD:
+            records = [r for part in rdd for r in part]
+            k = max(1, n)
+            size = -(-len(records) // k) if records else 0
+            return [
+                records[i * size : (i + 1) * size] for i in range(k)
+            ] if size else [[] for _ in range(k)]
+
+        return self._derive(op)
+
+    def _derive(self, op: Callable[[RDD], RDD]) -> "DStream":
+        return DStream(self._ssc, self, op)
+
+    # -- output --------------------------------------------------------
+    def foreachRDD(self, fn: Callable[[RDD], None]) -> None:
+        """Register ``fn`` to run on each materialized micro-batch."""
+        self._ssc._register_output(self, fn)
+
+    # -- evaluation ----------------------------------------------------
+    def _materialize(self, source_rdd: RDD) -> RDD:
+        chain: list[DStream] = []
+        node: DStream | None = self
+        while node is not None and node._op is not None:
+            chain.append(node)
+            node = node._parent
+        rdd = source_rdd
+        for n in reversed(chain):
+            rdd = n._op(rdd)
+        return rdd
+
+    def _source(self) -> "DStream":
+        node = self
+        while node._parent is not None:
+            node = node._parent
+        return node
+
+
+class StreamingContext:
+    """Scheduler for DStreams: ticks every ``batch_interval`` seconds,
+    materializes each source's new micro-batch, and runs output ops.
+
+    Errors raised by sources, transformations, or outputs stop the
+    context and re-raise from :meth:`awaitTermination` (the reference's
+    behavior: a failing foreachRDD killed the streaming job)."""
+
+    def __init__(self, batch_interval: float = 1.0):
+        self.batch_interval = float(batch_interval)
+        self._sources: list[tuple[DStream, Callable[[], RDD | None]]] = []
+        self._outputs: list[tuple[DStream, Callable[[RDD], None]]] = []
+        self._thread: threading.Thread | None = None
+        self._stopped = threading.Event()
+        self._terminated = threading.Event()
+        self._error: BaseException | None = None
+        self._started = False
+
+    # -- sources -------------------------------------------------------
+    def queueStream(
+        self,
+        rdds: Sequence[Iterable] | Any,
+        one_at_a_time: bool = True,
+        default: RDD | None = None,
+    ) -> DStream:
+        """Stream from a pre-staged sequence (or ``queue.Queue``) of RDDs.
+
+        ``one_at_a_time=False`` drains everything available each tick
+        into one micro-batch, like pyspark's queueStream."""
+        import queue as stdqueue
+
+        if not isinstance(rdds, stdqueue.Queue):
+            q: stdqueue.Queue = stdqueue.Queue()
+            for rdd in rdds:
+                q.put(rdd)
+            rdds = q
+
+        def poll() -> RDD | None:
+            batches: list[RDD] = []
+            try:
+                while True:
+                    batches.append(_as_rdd(rdds.get_nowait()))
+                    if one_at_a_time:
+                        break
+            except stdqueue.Empty:
+                pass
+            if not batches:
+                return default
+            if len(batches) == 1:
+                return batches[0]
+            return [part for rdd in batches for part in rdd]
+
+        return self._add_source(poll)
+
+    def textFileStream(self, directory: str) -> DStream:
+        """Watch ``directory``; each tick emits newly appeared files as
+        one partition of text lines per file (the reference examples'
+        HDFS-directory streaming pattern)."""
+        seen: set[str] = set()
+
+        def poll() -> RDD | None:
+            try:
+                names = sorted(os.listdir(directory))
+            except FileNotFoundError:
+                return None
+            new = [n for n in names if n not in seen and not n.startswith(".")]
+            seen.update(new)
+            parts: RDD = []
+            for name in new:
+                path = os.path.join(directory, name)
+                if not os.path.isfile(path):
+                    continue
+                with open(path) as f:
+                    parts.append([line.rstrip("\n") for line in f])
+            return parts or None
+
+        return self._add_source(poll)
+
+    def generatorStream(self, fn: Callable[[], RDD | None]) -> DStream:
+        """Custom receiver: ``fn()`` is called every tick and returns the
+        micro-batch's partitions (or None for an empty tick)."""
+        return self._add_source(lambda: _maybe_rdd(fn()))
+
+    def _add_source(self, poll: Callable[[], RDD | None]) -> DStream:
+        ds = DStream(self, None, None)
+        self._sources.append((ds, poll))
+        return ds
+
+    def _register_output(
+        self, ds: DStream, fn: Callable[[RDD], None]
+    ) -> None:
+        self._outputs.append((ds, fn))
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        if self._started:
+            raise RuntimeError("StreamingContext already started")
+        if not self._outputs:
+            raise RuntimeError(
+                "no output operations registered (call foreachRDD, or "
+                "pass the stream to TFCluster.train, before start())"
+            )
+        self._started = True
+        self._thread = threading.Thread(
+            target=self._run, name="dstream-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            while not self._stopped.is_set():
+                tick_start = time.monotonic()
+                for src_ds, poll in self._sources:
+                    rdd = poll()
+                    if rdd is None:
+                        continue
+                    # Materialize each distinct stream once per tick, so
+                    # several outputs on one stream (e.g. the train feed
+                    # bridge plus a monitor) share the transformed RDD.
+                    cache: dict[int, RDD] = {}
+                    for out_ds, fn in self._outputs:
+                        if out_ds._source() is src_ds:
+                            key = id(out_ds)
+                            if key not in cache:
+                                cache[key] = out_ds._materialize(rdd)
+                            fn(cache[key])
+                # fixed-rate schedule, like Spark's batch interval
+                elapsed = time.monotonic() - tick_start
+                self._stopped.wait(max(0.0, self.batch_interval - elapsed))
+        except BaseException as e:  # noqa: BLE001 - ferried to awaiter
+            self._error = e
+            logger.exception("streaming scheduler failed")
+        finally:
+            self._terminated.set()
+
+    def stop(self, stop_grace_fully: bool = True) -> None:
+        """Stop ticking. With ``stop_grace_fully`` the current tick
+        finishes (the scheduler thread is joined either way). If a
+        bounded non-graceful join times out, the context is NOT marked
+        terminated — the scheduler's own exit does that, so
+        :meth:`awaitTermination` never reports a still-running thread."""
+        self._stopped.set()
+        if self._thread is None:
+            self._terminated.set()
+            return
+        self._thread.join(timeout=None if stop_grace_fully else 5.0)
+        # _terminated is set by the scheduler's finally on actual exit
+
+    def awaitTermination(self, timeout: float | None = None) -> bool:
+        """Block until stopped (or ``timeout`` seconds); re-raises a
+        scheduler error. Returns True if terminated."""
+        done = self._terminated.wait(timeout)
+        if self._error is not None:
+            raise self._error
+        return done
+
+    def awaitTerminationOrTimeout(self, timeout: float) -> bool:
+        return self.awaitTermination(timeout)
+
+
+def _as_rdd(obj: Any) -> RDD:
+    """Coerce an iterable-of-partitions or flat record list into an RDD."""
+    items = list(obj)
+    if items and all(
+        isinstance(p, (list, tuple)) and not _is_record(p) for p in items
+    ):
+        return [list(p) for p in items]
+    return [items]
+
+
+def _is_record(p: Any) -> bool:
+    # tuples are records (the framework's record convention); lists of
+    # scalars are partitions
+    return isinstance(p, tuple)
+
+
+def _maybe_rdd(obj: Any) -> RDD | None:
+    return None if obj is None else _as_rdd(obj)

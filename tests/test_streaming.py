@@ -1,0 +1,162 @@
+"""DStream object model: transformations, sources, scheduler, and the
+TFCluster.train(DStream) / shutdown(ssc=...) integration (reference:
+``TFCluster.train`` with a DStream -> foreachRDD feeding)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu.cluster import tfcluster
+from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+from tensorflowonspark_tpu.streaming import DStream, StreamingContext
+from tensorflowonspark_tpu.utils.util import cpu_only_env
+
+from tests import cluster_fns
+
+NODE_ENV = cpu_only_env()
+
+
+def _collect(ssc, stream, ticks=None):
+    out = []
+    stream.foreachRDD(out.append)
+    ssc.start()
+    return out
+
+
+def test_queue_stream_transformations():
+    ssc = StreamingContext(batch_interval=0.05)
+    # two RDDs: one flat (auto-partitioned), one pre-partitioned
+    stream = (
+        ssc.queueStream([[1, 2, 3, 4], [[5, 6], [7, 8]]])
+        .map(lambda x: x * 10)
+        .filter(lambda x: x != 20)
+    )
+    out = _collect(ssc, stream)
+    deadline = time.time() + 10
+    while len(out) < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    ssc.stop()
+    assert out[0] == [[10, 30, 40]]
+    assert out[1] == [[50, 60], [70, 80]]
+
+
+def test_flatmap_mappartitions_repartition():
+    ssc = StreamingContext(batch_interval=0.05)
+    stream = (
+        ssc.queueStream([[[1, 2], [3]]])
+        .flatMap(lambda x: [x, x])
+        .mapPartitions(lambda it: [sum(it)])
+        .repartition(1)
+    )
+    out = _collect(ssc, stream)
+    deadline = time.time() + 10
+    while not out and time.time() < deadline:
+        time.sleep(0.05)
+    ssc.stop()
+    # [1,2]->[1,1,2,2]=6, [3]->[3,3]=6; repartitioned into one partition
+    assert out[0] == [[6, 6]]
+
+
+def test_text_file_stream(tmp_path):
+    ssc = StreamingContext(batch_interval=0.05)
+    stream = ssc.textFileStream(str(tmp_path))
+    out = _collect(ssc, stream)
+    (tmp_path / "a.txt").write_text("1\n2\n")
+    deadline = time.time() + 10
+    while not out and time.time() < deadline:
+        time.sleep(0.05)
+    (tmp_path / "b.txt").write_text("3\n")
+    while len(out) < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    ssc.stop()
+    assert out[0] == [["1", "2"]]
+    assert out[1] == [["3"]]
+    # files are only delivered once
+    assert len(out) == 2
+
+
+def test_scheduler_error_ferried_to_await():
+    ssc = StreamingContext(batch_interval=0.05)
+    stream = ssc.queueStream([[1]]).map(lambda x: 1 / 0)
+    stream.foreachRDD(lambda rdd: None)
+    ssc.start()
+    with pytest.raises(ZeroDivisionError):
+        ssc.awaitTermination(timeout=10)
+
+
+def test_start_without_output_raises():
+    ssc = StreamingContext()
+    ssc.queueStream([[1]])
+    with pytest.raises(RuntimeError, match="no output operations"):
+        ssc.start()
+
+
+def test_cluster_train_dstream_e2e(tmp_path):
+    """train(DStream) + shutdown(ssc=...): records flow source->feed->nodes."""
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    cluster = tfcluster.run(
+        cluster_fns.sum_fn,
+        {"out_dir": str(out_dir)},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        env=NODE_ENV,
+    )
+    ssc = StreamingContext(batch_interval=0.1)
+    rdds = [
+        [[(i,) for i in range(mb * 20, mb * 20 + 10)],
+         [(i,) for i in range(mb * 20 + 10, (mb + 1) * 20)]]
+        for mb in range(5)
+    ]
+    stream = ssc.queueStream(rdds)
+    cluster.train(stream)  # registers the bridge; returns immediately
+    delivered = []
+    stream.foreachRDD(lambda rdd: delivered.append(len(rdd)))
+    ssc.start()
+    deadline = time.time() + 30
+    while len(delivered) < 5 and time.time() < deadline:
+        time.sleep(0.1)
+    assert len(delivered) == 5
+    cluster.shutdown(timeout=120, ssc=ssc)
+
+    totals, counts = [], []
+    for i in range(2):
+        total, count = open(out_dir / f"node{i}.txt").read().split()
+        totals.append(int(total))
+        counts.append(int(count))
+    assert sum(counts) == 100
+    assert sum(totals) == sum(range(100))
+
+
+def test_dstream_early_stop_does_not_deadlock_shutdown(tmp_path):
+    """Workers terminate early while the source keeps producing: the
+    scheduler must not wedge on the full feed bridge, and
+    shutdown(ssc=...) must return (regression: blocking bridge.put)."""
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    cluster = tfcluster.run(
+        cluster_fns.terminate_after_fn,
+        {"out_dir": str(out_dir), "limit": 8},
+        num_executors=1,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        env=NODE_ENV,
+    )
+    ssc = StreamingContext(batch_interval=0.05)
+    # infinite source: one partition of 16 records every tick
+    ticks = []
+    stream = ssc.generatorStream(
+        lambda: ticks.append(1) or [[(i,) for i in range(16)]]
+    )
+    cluster.train(stream)
+    ssc.start()
+    deadline = time.time() + 30
+    while len(ticks) < 10 and time.time() < deadline:
+        time.sleep(0.05)
+    t0 = time.time()
+    cluster.shutdown(timeout=120, ssc=ssc)
+    assert time.time() - t0 < 60, "shutdown wedged on the stream bridge"
+    assert int(open(out_dir / "node0.txt").read()) >= 8
