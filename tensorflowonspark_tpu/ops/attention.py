@@ -122,25 +122,23 @@ def _jitted_attention(
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         shapes_ok = (
-            segment_ids is None
-            and q.shape[1] >= 128
+            q.shape[1] >= 128
             and q.shape[1] % 128 == 0
             and k.shape[1] % 128 == 0
             and q.shape[3] >= 64
+            # segment masking needs square attention (one id per position)
+            and (segment_ids is None or q.shape[1] == k.shape[1])
         )
         impl = "flash" if (on_tpu and shapes_ok) else "xla"
     if impl == "flash":
-        if segment_ids is not None:
-            # The flash kernel has no segment masking yet; silently dropping
-            # it would leak attention across packed sequences.
-            impl = "xla"
-        else:
-            from tensorflowonspark_tpu.ops.flash_attention import (
-                flash_attention,
-            )
+        from tensorflowonspark_tpu.ops.flash_attention import (
+            flash_attention,
+        )
 
-            # positional: custom_vjp functions reject keyword arguments
-            return flash_attention(q, k, v, causal, scale)
+        # positional: custom_vjp functions reject keyword arguments
+        return flash_attention(
+            q, k, v, causal, scale, None, None, segment_ids
+        )
     return _xla_attention(
         q, k, v, causal=causal, scale=scale, segment_ids=segment_ids
     )

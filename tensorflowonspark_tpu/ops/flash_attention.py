@@ -34,8 +34,11 @@ NEG_INF = -1e30
 # Per-row stats (LSE, delta) are stored lane-replicated to NUM_LANES so
 # their blocks satisfy Mosaic's (8, 128) tiling rule — a (1, block_q)
 # block on a (rows, seq) array is rejected on real TPUs. Same layout the
-# reference TPU kernel in jax.experimental.pallas.ops.tpu uses.
+# reference TPU kernel in jax.experimental.pallas.ops.tpu uses. Segment
+# ids ride the same way: q ids lane-replicated, kv ids sublane-replicated
+# (so the kernel reads a (1, block_k) row without a transpose).
 NUM_LANES = 128
+NUM_SUBLANES = 8
 
 # Test hook: run the kernel in the Pallas interpreter (works on CPU).
 INTERPRET = False
@@ -62,16 +65,37 @@ def _tile_logits(q, k, qi, ki, block_q, block_k, offset, causal, scale):
     return s
 
 
+def _segment_masked(s, qseg_ref, kseg_ref, block_k: int):
+    """Mask logits where q and k segment ids differ (trace-time no-op
+    when no segment refs are bound). The online-softmax rescale makes a
+    leading fully-masked tile harmless: its uniform exp(0) garbage is
+    zeroed by alpha the moment a live tile raises the running max."""
+    if qseg_ref is None:
+        return s
+    q_ids = qseg_ref[0]  # (block_q, NUM_LANES), lane-replicated
+    if block_k % NUM_LANES == 0:
+        q_ids = jnp.tile(q_ids, (1, block_k // NUM_LANES))
+    else:  # short sequences: block_k < one lane tile
+        q_ids = q_ids[:, :block_k]
+    k_ids = kseg_ref[0][:1, :]  # (1, block_k) from the sublane-replicated row
+    return jnp.where(q_ids == k_ids, s, NEG_INF)
+
+
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-    block_q: int, block_k: int, seq_q: int, seq_k: int,
-    causal: bool, scale: float, num_k_blocks: int,
+    *refs, block_q: int, block_k: int, seq_q: int, seq_k: int,
+    causal: bool, scale: float, num_k_blocks: int, has_segments: bool,
 ):
+    if has_segments:
+        (q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -94,6 +118,7 @@ def _fwd_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         s = _tile_logits(q, k, qi, ki, block_q, block_k, offset, causal, scale)
+        s = _segment_masked(s, qseg_ref, kseg_ref, block_k)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -113,6 +138,30 @@ def _fwd_kernel(
         )
 
 
+def _segment_operands(segment_ids, sq: int, sk: int):
+    """Broadcast (B, S) segment ids into the kernel layouts: q ids
+    lane-replicated (B, Sq, NUM_LANES), kv ids sublane-replicated
+    (B, NUM_SUBLANES, Sk)."""
+    b = segment_ids.shape[0]
+    seg = segment_ids.astype(jnp.int32)
+    qseg = jax.lax.broadcast_in_dim(seg, (b, sq, NUM_LANES), (0, 1))
+    kseg = jax.lax.broadcast_in_dim(seg, (b, NUM_SUBLANES, sk), (0, 2))
+    return qseg, kseg
+
+
+def _check_segment_ids(segment_ids, b: int, sq: int, sk: int) -> None:
+    if segment_ids is None:
+        return
+    if sq != sk:
+        raise ValueError(
+            "segment_ids needs sq == sk (one id array covers both sides)"
+        )
+    if segment_ids.shape != (b, sq):
+        raise ValueError(
+            f"segment_ids shape {segment_ids.shape} != {(b, sq)}"
+        )
+
+
 def _flash_forward(
     q: jax.Array,
     k: jax.Array,
@@ -122,6 +171,7 @@ def _flash_forward(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     return_lse: bool = False,
+    segment_ids: jax.Array | None = None,
 ):
     """(B, Sq, H, D) attention with GQA head broadcast, Pallas forward."""
     b, sq, hq, d = q.shape
@@ -137,6 +187,7 @@ def _flash_forward(
         )
     if hq % hk:
         raise ValueError(f"q heads {hq} not divisible by kv heads {hk}")
+    _check_segment_ids(segment_ids, b, sq, sk)
     group = hq // hk
 
     # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, q-head); K/V
@@ -161,15 +212,28 @@ def _flash_forward(
         causal=causal,
         scale=scale,
         num_k_blocks=num_k_blocks,
+        has_segments=segment_ids is not None,
     )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
+        pl.BlockSpec((1, block_k, d), kv_row),
+        pl.BlockSpec((1, block_k, d), kv_row),
+    ]
+    operands = [qt, kt, vt]
+    if segment_ids is not None:
+        in_specs += [
+            pl.BlockSpec(
+                (1, block_q, NUM_LANES), lambda h, qi, ki: (h // hq, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, NUM_SUBLANES, block_k), lambda h, qi, ki: (h // hq, 0, ki)
+            ),
+        ]
+        operands += list(_segment_operands(segment_ids, sq, sk))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
-            pl.BlockSpec((1, block_k, d), kv_row),
-            pl.BlockSpec((1, block_k, d), kv_row),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
             pl.BlockSpec((1, block_q, NUM_LANES), lambda h, qi, ki: (h, qi, 0)),
@@ -184,7 +248,7 @@ def _flash_forward(
             pltpu.VMEM((block_q, 1), jnp.float32),  # running denominator
         ],
         interpret=INTERPRET,
-    )(qt, kt, vt)
+    )(*operands)
     out = out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
     if return_lse:
         return out, lse[:, :, 0]
@@ -207,10 +271,16 @@ def _probs(s, lse_col):
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *,
-    block_q: int, block_k: int, seq_q: int, seq_k: int,
-    causal: bool, scale: float, num_k_blocks: int,
+    *refs, block_q: int, block_k: int, seq_q: int, seq_k: int,
+    causal: bool, scale: float, num_k_blocks: int, has_segments: bool,
 ):
+    if has_segments:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         qseg_ref, kseg_ref, dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -230,6 +300,7 @@ def _dq_kernel(
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         s = _tile_logits(q, k, qi, ki, block_q, block_k, offset, causal, scale)
+        s = _segment_masked(s, qseg_ref, kseg_ref, block_k)
         p = _probs(s, lse_ref[0][:, :1])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -245,11 +316,16 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, *,
-    block_q: int, block_k: int, seq_q: int, seq_k: int,
-    causal: bool, scale: float, num_q_blocks: int,
+    *refs, block_q: int, block_k: int, seq_q: int, seq_k: int,
+    causal: bool, scale: float, num_q_blocks: int, has_segments: bool,
 ):
+    if has_segments:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        qseg_ref = kseg_ref = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -270,6 +346,7 @@ def _dkv_kernel(
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         s = _tile_logits(q, k, qi, ki, block_q, block_k, offset, causal, scale)
+        s = _segment_masked(s, qseg_ref, kseg_ref, block_k)
         p = _probs(s, lse_ref[0][:, :1])  # (block_q, block_k)
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -292,6 +369,7 @@ def _flash_backward(
     q, k, v, out, lse, g, causal, scale,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    segment_ids: jax.Array | None = None,
 ):
     b, sq, hq, d = q.shape
     _, sk, hk, _ = k.shape
@@ -313,6 +391,9 @@ def _flash_backward(
     # NUM_LANES above).
     lse_l = jnp.broadcast_to(lse[:, :, None], (b * hq, sq, NUM_LANES))
     delta_l = jnp.broadcast_to(delta[:, :, None], (b * hq, sq, NUM_LANES))
+    seg_operands: list = []
+    if segment_ids is not None:
+        seg_operands = list(_segment_operands(segment_ids, sq, sk))
 
     num_q_blocks = sq // block_q
     num_k_blocks = sk // block_k
@@ -329,41 +410,68 @@ def _flash_backward(
         scale=scale,
     )
 
+    has_segments = segment_ids is not None
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda h, qi, ki: (kv_row3(h, qi, ki), ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda h, qi, ki: (kv_row3(h, qi, ki), ki, 0)),
+        pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
+        pl.BlockSpec((1, block_q, NUM_LANES), lambda h, qi, ki: (h, qi, 0)),
+        pl.BlockSpec((1, block_q, NUM_LANES), lambda h, qi, ki: (h, qi, 0)),
+    ]
+    if has_segments:
+        dq_in_specs += [
+            pl.BlockSpec(
+                (1, block_q, NUM_LANES), lambda h, qi, ki: (h // hq, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, NUM_SUBLANES, block_k), lambda h, qi, ki: (h // hq, 0, ki)
+            ),
+        ]
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, num_k_blocks=num_k_blocks, **common
+            _dq_kernel,
+            num_k_blocks=num_k_blocks,
+            has_segments=has_segments,
+            **common,
         ),
         grid=(b * hq, num_q_blocks, num_k_blocks),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, qi, ki: (kv_row3(h, qi, ki), ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, qi, ki: (kv_row3(h, qi, ki), ki, 0)),
-            pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
-            pl.BlockSpec((1, block_q, NUM_LANES), lambda h, qi, ki: (h, qi, 0)),
-            pl.BlockSpec((1, block_q, NUM_LANES), lambda h, qi, ki: (h, qi, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=INTERPRET,
-    )(qt, kt, vt, gt, lse_l, delta_l)
+    )(qt, kt, vt, gt, lse_l, delta_l, *seg_operands)
 
     # dK/dV per *query* head (b*hq rows): several q heads share one KV head,
     # and revisiting an output block from non-consecutive grid rows is not
     # allowed — group-sum afterwards instead.
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda h, ki, qi: (h, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (kv_row3(h, ki, qi), ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (kv_row3(h, ki, qi), ki, 0)),
+        pl.BlockSpec((1, block_q, d), lambda h, ki, qi: (h, qi, 0)),
+        pl.BlockSpec((1, block_q, NUM_LANES), lambda h, ki, qi: (h, qi, 0)),
+        pl.BlockSpec((1, block_q, NUM_LANES), lambda h, ki, qi: (h, qi, 0)),
+    ]
+    if has_segments:
+        dkv_in_specs += [
+            pl.BlockSpec(
+                (1, block_q, NUM_LANES), lambda h, ki, qi: (h // hq, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, NUM_SUBLANES, block_k), lambda h, ki, qi: (h // hq, 0, ki)
+            ),
+        ]
     dk_q, dv_q = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, num_q_blocks=num_q_blocks, **common
+            _dkv_kernel,
+            num_q_blocks=num_q_blocks,
+            has_segments=has_segments,
+            **common,
         ),
         grid=(b * hq, num_k_blocks, num_q_blocks),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda h, ki, qi: (h, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (kv_row3(h, ki, qi), ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (kv_row3(h, ki, qi), ki, 0)),
-            pl.BlockSpec((1, block_q, d), lambda h, ki, qi: (h, qi, 0)),
-            pl.BlockSpec((1, block_q, NUM_LANES), lambda h, ki, qi: (h, qi, 0)),
-            pl.BlockSpec((1, block_q, NUM_LANES), lambda h, ki, qi: (h, qi, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (h, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (h, ki, 0)),
@@ -379,7 +487,7 @@ def _flash_backward(
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=INTERPRET,
-    )(qt, kt, vt, gt, lse_l, delta_l)
+    )(qt, kt, vt, gt, lse_l, delta_l, *seg_operands)
 
     dq = dq.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
     dk = (
@@ -421,27 +529,34 @@ def flash_attention(
     scale: float | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
+    """Flash attention; ``segment_ids`` (B, S) masks cross-segment
+    attention for packed sequences (requires sq == sk)."""
     bq, bk = _default_blocks(q.shape[1], k.shape[1])
     return _flash_forward(
-        q, k, v, causal, scale, block_q or bq, block_k or bk
+        q, k, v, causal, scale, block_q or bq, block_k or bk,
+        segment_ids=segment_ids,
     )
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k):
+def _fwd(q, k, v, causal, scale, block_q, block_k, segment_ids):
     bq, bk = _default_blocks(q.shape[1], k.shape[1])
     out, lse = _flash_forward(
-        q, k, v, causal, scale, block_q or bq, block_k or bk, return_lse=True
+        q, k, v, causal, scale, block_q or bq, block_k or bk,
+        return_lse=True, segment_ids=segment_ids,
     )
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, out, lse, segment_ids)
 
 
 def _bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v, out, lse = res
+    q, k, v, out, lse, segment_ids = res
     bq, bk = _default_blocks(q.shape[1], k.shape[1])
-    return _flash_backward(
-        q, k, v, out, lse, g, causal, scale, block_q or bq, block_k or bk
+    dq, dk, dv = _flash_backward(
+        q, k, v, out, lse, g, causal, scale, block_q or bq, block_k or bk,
+        segment_ids=segment_ids,
     )
+    return dq, dk, dv, None
 
 
 flash_attention.defvjp(_fwd, _bwd)
