@@ -13,7 +13,9 @@ Usage::
 
     python benchmarks/real_chip.py --config resnet50 [--steps 30] ...
 
-Configs map to BASELINE.md rows: mnist, resnet50, bert_base, llama1b.
+Configs map to BASELINE.md rows: mnist, resnet50, bert_base, llama1b,
+llama1b_decode (KV-cache decode; --new-tokens sets the decode length,
+step_time_ms is one single-token step, examples_per_sec is tokens/sec).
 """
 
 from __future__ import annotations
@@ -241,6 +243,53 @@ def bench_llama1b(args):
     )
 
 
+def bench_llama1b_decode(args):
+    """KV-cache autoregressive decode: tokens/sec at batch 8."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.models.llama import (
+        Llama,
+        LlamaConfig,
+        generate,
+    )
+
+    b = args.batch_size or 8
+    prompt_len = 128
+    new_tokens = args.new_tokens
+    cfg = LlamaConfig(
+        vocab_size=32000,
+        hidden_size=2048,
+        intermediate_size=5632,
+        num_layers=16,
+        num_heads=16,
+        num_kv_heads=16,
+        max_seq_len=prompt_len + new_tokens,
+        dtype=jnp.bfloat16,
+        remat=False,
+        attention_impl="xla",  # decode is single-token; flash n/a
+    )
+    model = Llama(cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(b, prompt_len)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), prompt[:2])["params"]
+    params = jax.tree.map(jax.device_put, params)
+    out = generate(model, params, prompt, new_tokens)  # compile + warm
+    np.asarray(out[0, :1])
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        out = generate(model, params, prompt, new_tokens)
+        np.asarray(out[0, :1])  # host fetch = real barrier
+    dt = time.perf_counter() - t0
+    # Reported so that step_time_ms is ONE single-token decode step and
+    # examples_per_sec is new tokens/sec: examples = batch rows, dt
+    # rescaled by tokens-per-generate.
+    return dict(examples=b, dt=dt / new_tokens, loss=0.0)
+
+
 V5E_PEAK_TFLOPS = 197.0  # per-chip bf16 peak (shared with bench.py)
 
 CONFIGS = {
@@ -248,6 +297,7 @@ CONFIGS = {
     "resnet50": bench_resnet50,
     "bert_base": bench_bert_base,
     "llama1b": bench_llama1b,
+    "llama1b_decode": bench_llama1b_decode,
 }
 
 
@@ -260,6 +310,12 @@ def main(argv=None):
     p.add_argument("--attention", default="auto")
     p.add_argument(
         "--remat", choices=("full", "dots", "none"), default="full"
+    )
+    p.add_argument(
+        "--new-tokens",
+        type=int,
+        default=256,
+        help="decode length for llama1b_decode",
     )
     p.add_argument(
         "--peak-tflops",
