@@ -74,6 +74,12 @@ def main_fun(args, ctx):
     from tensorflowonspark_tpu.parallel import use_mesh
 
     cfg = _config(args.model, args.seq)
+    if args.remat != "full":
+        cfg = dataclasses.replace(
+            cfg, remat=args.remat != "none", remat_policy=args.remat
+        )
+    if args.attention != "auto":
+        cfg = dataclasses.replace(cfg, attention_impl=args.attention)
     if args.sp > 1:
         # Sequence parallelism: 'ring' rotates KV blocks around the ring
         # (memory-optimal for long S_local); 'ulysses' does two
@@ -213,6 +219,14 @@ def parse_args(argv=None):
     )
     p.add_argument(
         "--peak-tflops", type=float, default=275.0, help="per-chip bf16 peak"
+    )
+    p.add_argument(
+        "--remat", choices=("full", "dots", "none"), default="full",
+        help="rematerialization policy (none = keep activations)",
+    )
+    p.add_argument(
+        "--attention", choices=("auto", "xla", "flash"), default="auto",
+        help="attention impl when not sequence-parallel",
     )
     p.add_argument("--cpu", action="store_true")
     return p.parse_args(argv)

@@ -160,3 +160,26 @@ def test_dstream_early_stop_does_not_deadlock_shutdown(tmp_path):
     cluster.shutdown(timeout=120, ssc=ssc)
     assert time.time() - t0 < 60, "shutdown wedged on the stream bridge"
     assert int(open(out_dir / "node0.txt").read()) >= 8
+
+
+def test_shutdown_reraises_scheduler_error(tmp_path):
+    """A failing transformation kills the stream; shutdown(ssc=...) must
+    re-raise it after teardown (reference: a failing foreachRDD killed
+    the streaming job)."""
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    cluster = tfcluster.run(
+        cluster_fns.sum_fn,
+        {"out_dir": str(out_dir)},
+        num_executors=1,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        env=NODE_ENV,
+    )
+    ssc = StreamingContext(batch_interval=0.05)
+    stream = ssc.queueStream([[ (1,), (2,) ]]).map(lambda r: r[0] / 0)
+    cluster.train(stream)
+    ssc.start()
+    ssc._terminated.wait(20)
+    with pytest.raises(ZeroDivisionError):
+        cluster.shutdown(timeout=120, ssc=ssc)
