@@ -464,31 +464,39 @@ def collect_results(
     return out
 
 
-def shutdown_node(node: dict[str, Any], queues=("input",)) -> None:
-    """Signal one node to finish: EndOfFeed on data queues, STOP on control.
+def _push_end_of_feed(
+    node: dict[str, Any],
+    qnames,
+    timeout: float,
+    must_deliver: bool,
+) -> None:
+    """Push EndOfFeed markers behind any in-flight data (via the shm ring
+    when this driver fed through it — the marker must not overtake records
+    still in the ring), then close the ring's write side.
 
-    Reference: ``TFSparkNode._shutdown`` (set state, push terminal markers).
+    ``must_deliver=True`` raises on a push timeout: a dropped marker means
+    the consumer never sees end-of-stream and blocks forever.
     """
-    mgr = connect_manager(node)
-    state = str(mgr.get("state"))
-    if state == "running":
-        mgr.set("state", "terminating")
-    # If this driver fed the node through the shm ring, the EndOfFeed must
-    # travel the same path (behind any in-flight data) or it could overtake
-    # records still sitting in the ring.
     ring = _ring_cache.get(node.get("shm_ring") or "")
-    for qname in queues:
+    for qname in qnames:
         try:
             if ring is not None:
                 ring.push(
                     pickle.dumps(
                         (qname, EndOfFeed()), protocol=pickle.HIGHEST_PROTOCOL
                     ),
-                    timeout=30,
+                    timeout=timeout,
                 )
             else:
-                mgr.get_queue(qname).put(EndOfFeed(), timeout=30)
+                mgr = connect_manager(node)
+                mgr.get_queue(qname).put(EndOfFeed(), timeout=timeout)
         except (_queue.Full, TimeoutError):
+            if must_deliver:
+                raise TimeoutError(
+                    f"could not deliver EndOfFeed to node "
+                    f"{node['executor_id']} queue {qname!r} within "
+                    f"{timeout}s (consumer stopped pulling?)"
+                ) from None
             logger.warning(
                 "could not push EndOfFeed to node %s queue %s (full)",
                 node["executor_id"],
@@ -501,6 +509,39 @@ def shutdown_node(node: dict[str, Any], queues=("input",)) -> None:
         with _ring_cache_lock:
             _ring_cache.pop(node.get("shm_ring"), None)
         ring.close()
+
+
+def close_feed(
+    node: dict[str, Any], qname: str = "input", timeout: float = 600.0
+) -> None:
+    """Mark a node's feed complete: EndOfFeed behind any in-flight data,
+    leaving the node *running* so it finishes consuming. Unlike
+    :func:`shutdown_node` the state is untouched — the training loop sees
+    a clean end-of-stream, not early termination. After this no more data
+    may be fed to ``qname`` (the shm ring's write side is closed).
+
+    This is what lets multi-controller SPARK-mode workers use
+    ``DataFeed.synchronized_batch_stream``: feeds must actually END for
+    the all-hosts exhaustion agreement to trigger (a merely-quiet feed
+    blocks in the queue, never reaching the agreement). Raises
+    TimeoutError if the marker cannot be delivered — a silently dropped
+    marker would hang every process in that agreement.
+    """
+    _push_end_of_feed(node, (qname,), timeout=timeout, must_deliver=True)
+
+
+def shutdown_node(node: dict[str, Any], queues=("input",)) -> None:
+    """Signal one node to finish: EndOfFeed on data queues, STOP on control.
+
+    Reference: ``TFSparkNode._shutdown`` (set state, push terminal markers).
+    """
+    mgr = connect_manager(node)
+    state = str(mgr.get("state"))
+    if state == "running":
+        mgr.set("state", "terminating")
+    # Best-effort markers: the 'terminating' state already makes the node
+    # drain, so a full queue here is a warning, not a hang.
+    _push_end_of_feed(node, queues, timeout=30, must_deliver=False)
     mgr.get_queue("control").put(STOP)
 
 

@@ -98,6 +98,7 @@ class TFCluster:
         num_epochs: int = 1,
         feed_timeout: float = 600.0,
         qname: str = "input",
+        close_feed: bool = False,
     ) -> None:
         """Feed data partitions to the workers (InputMode.SPARK only).
 
@@ -114,6 +115,13 @@ class TFCluster:
         returns immediately; micro-batches flow once the stream's
         ``StreamingContext.start()`` runs. End with
         ``shutdown(ssc=ssc)``.
+
+        ``close_feed=True`` pushes EndOfFeed after the last partition, so
+        worker loops see a clean end-of-stream without waiting for
+        ``shutdown()``. Required for multi-controller workers consuming
+        via ``DataFeed.synchronized_batch_stream`` (feeds must end for
+        the cross-process exhaustion agreement to fire); no further
+        ``train()`` calls are allowed on ``qname`` afterwards.
         """
         from tensorflowonspark_tpu.streaming import DStream
 
@@ -147,6 +155,10 @@ class TFCluster:
                         feed_timeout=feed_timeout,
                         qname=qname,
                         node=workers[widx],
+                    )
+                if close_feed:
+                    tfnode_runtime.close_feed(
+                        workers[widx], qname=qname, timeout=feed_timeout
                     )
             except BaseException as e:  # noqa: BLE001 - ferried to caller
                 errors.append(e)

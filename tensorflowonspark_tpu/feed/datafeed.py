@@ -164,3 +164,70 @@ class DataFeed:
                     self.done_feeding = True
             except _queue.Empty:
                 done = True
+
+    def synchronized_batch_stream(
+        self,
+        batch_size: int,
+        multiple_of: int = 1,
+        stop_when=None,
+    ):
+        """Multi-controller-safe :meth:`batch_stream`.
+
+        In multi-process (``jax.distributed``) training every process
+        must run every collective: if one host's feed drains a wave
+        earlier than another's, the short host leaves the training loop
+        while the others enter the next jit step, and the program
+        deadlocks in a psum (SURVEY.md §7 "hard parts": the all-hosts
+        feed-exhausted agreement, the moral equivalent of
+        ``queue.join()``). This generator closes that hole: before each
+        yield, processes agree — via a tiny cross-process allgather —
+        that *all* of them hold a full next batch. The first round where
+        any process is short (exhausted, or ``stop_when()`` true —
+        use that instead of ``break`` so early stop is also agreed),
+        every process stops together; ragged tails are dropped, like the
+        reference's drop-remainder datasets.
+
+        Single-process: degrades to plain :meth:`batch_stream` (with
+        ``stop_when`` honored) at zero collective cost.
+        """
+        import jax
+
+        it = self.batch_stream(batch_size, multiple_of)
+        if jax.process_count() == 1:
+            for batch in it:
+                if stop_when is not None and stop_when():
+                    return
+                yield batch
+            return
+
+        from jax.experimental import multihost_utils
+
+        def n_records(b):
+            if isinstance(b, dict):
+                return len(next(iter(b.values())))
+            return len(b)
+
+        while True:
+            batch = next(it, None)
+            # Only a FULL batch counts: batch_stream's trimmed tail can be
+            # shorter, and one process yielding a different local batch
+            # size than the others breaks the very shape agreement this
+            # method exists for.
+            have = (
+                batch is not None
+                and n_records(batch) == batch_size
+                and not (stop_when is not None and stop_when())
+            )
+            all_have = bool(
+                multihost_utils.process_allgather(
+                    np.asarray([1 if have else 0], np.int32)
+                ).min()
+            )
+            if not all_have:
+                if batch is not None:
+                    logger.info(
+                        "synchronized_batch_stream: dropping tail batch "
+                        "(another process is exhausted)"
+                    )
+                return
+            yield batch

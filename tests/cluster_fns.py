@@ -279,3 +279,61 @@ def sum_sizes_fn(args, ctx):
             count += 1
     with open(os.path.join(args["out_dir"], f"node{ctx.executor_id}.txt"), "w") as f:
         f.write(f"{total} {count}")
+
+
+def distributed_spark_train_fn(args, ctx):
+    """Multi-controller DP over the PUSH feed: each process consumes its
+    own queue via synchronized_batch_stream, so unequal feeds stop every
+    process together instead of deadlocking the psum."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState, build_train_step
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+
+    mesh = make_mesh()  # all GLOBAL devices, data-parallel
+    feed = ctx.get_data_feed(
+        train_mode=True, input_mapping={"x": "x", "y": "y"}
+    )
+
+    def loss_fn(params, batch):
+        pred = batch["x"] * params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    params = {"w": jnp.zeros(()), "b": jnp.zeros(())}
+    tx = optax.sgd(0.1)
+    state = TrainState.create(params, tx)
+    step = build_train_step(loss_fn, tx, mesh)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(mesh.axis_names))
+    steps = 0
+    loss = None
+    for cols in feed.synchronized_batch_stream(8):
+        batch = {
+            name: jax.make_array_from_process_local_data(
+                sharding, np.asarray(cols[name], np.float32)
+            )
+            for name in ("x", "y")
+        }
+        state, loss = step(state, batch)
+        steps += 1
+    # Drain whatever this process's queue still holds (the agreement may
+    # stop all processes while the longer feeds have records left) so the
+    # driver's feeders aren't stuck on a full queue.
+    feed.terminate()
+    out = {
+        "w": float(state.params["w"]),
+        "b": float(state.params["b"]),
+        "steps": steps,
+        "global_devices": len(jax.devices()),
+    }
+    with open(
+        os.path.join(args["out_dir"], f"node{ctx.executor_id}.json"), "w"
+    ) as f:
+        json.dump(out, f)

@@ -66,3 +66,82 @@ def test_two_process_distributed_training(tmp_path):
         assert abs(r["b"] - 1.5) < 0.05, r
     # Multi-controller SPMD: both processes hold identical replicated state.
     assert results[0] == results[1]
+
+
+def test_spark_feed_unequal_partitions_no_deadlock(tmp_path):
+    """The push feed + multi-controller combination from SURVEY §7's hard
+    parts: processes receive UNEQUAL amounts of data (5 partitions round-
+    robin over 2 workers), so without the all-hosts agreement the shorter
+    process would exit while the longer one blocks in the psum forever.
+    synchronized_batch_stream must stop both together, same step count,
+    converged identical state."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+
+    def part(n):
+        x = rng.normal(size=n).astype(np.float32)
+        return [(float(xi), float(3.0 * xi + 1.5)) for xi in x]
+
+    # alternating 32/16-record partitions round-robin over 2 workers:
+    # worker0 gets 96 records/epoch (12 batches), worker1 48 (6 batches)
+    partitions = [part(32), part(16)] * 3
+
+    cluster = tfcluster.run(
+        cluster_fns.distributed_spark_train_fn,
+        {"out_dir": str(tmp_path)},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=180,
+        distributed=True,
+        env=cpu_only_env(num_cpu_devices=1),
+    )
+    cluster.train(partitions, num_epochs=12, close_feed=True)
+    cluster.shutdown(timeout=180)
+
+    results = [
+        json.load(open(tmp_path / f"node{i}.json")) for i in range(2)
+    ]
+    # agreement: both processes ran the same number of global steps — the
+    # shorter feed's count (48*12 records / batch 8 = 72 steps)
+    assert results[0]["steps"] == results[1]["steps"] == 72
+    for r in results:
+        assert r["global_devices"] == 2
+        assert abs(r["w"] - 3.0) < 0.05, r
+        assert abs(r["b"] - 1.5) < 0.05, r
+    assert results[0] == results[1]
+
+
+def test_spark_feed_ragged_tail_agreement(tmp_path):
+    """Regression: one process's feed ends on a SHORT tail batch while the
+    other still holds a full one. The agreement must treat the short tail
+    as exhaustion (only full batches shard identically across processes),
+    stopping both at the same full-batch count."""
+    import numpy as np
+
+    rng = np.random.default_rng(1)
+
+    def part(n):
+        x = rng.normal(size=n).astype(np.float32)
+        return [(float(xi), float(3.0 * xi + 1.5)) for xi in x]
+
+    # worker0: 100 records -> 12 full batches + 4-record tail
+    # worker1: 104 records -> 13 full batches
+    partitions = [part(100), part(104)]
+
+    cluster = tfcluster.run(
+        cluster_fns.distributed_spark_train_fn,
+        {"out_dir": str(tmp_path)},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=180,
+        distributed=True,
+        env=cpu_only_env(num_cpu_devices=1),
+    )
+    cluster.train(partitions, close_feed=True)
+    cluster.shutdown(timeout=180)
+
+    results = [
+        json.load(open(tmp_path / f"node{i}.json")) for i in range(2)
+    ]
+    assert results[0]["steps"] == results[1]["steps"] == 12
