@@ -132,6 +132,43 @@ class _HasParams:
     def getParam(self, name: str) -> Any:  # noqa: N802
         return self.args[name]
 
+    # The reference's per-param accessors (``setBatchSize``, ``setNumPS``,
+    # ``getModelDir``, ... — one Has* mixin each, pipeline.py ~L60-300)
+    # are generated from the table: chainable setters, plain getters.
+    _CAMEL_OVERRIDES = {"num_ps": "NumPS", "tfrecord_dir": "TFRecordDir"}
+
+    @classmethod
+    def _accessor_map(cls) -> dict[str, tuple[str, str]]:
+        if "_ACCESSORS" not in cls.__dict__:
+            table = {}
+            for key in cls.PARAMS:
+                camel = cls._CAMEL_OVERRIDES.get(
+                    key, "".join(p.capitalize() for p in key.split("_"))
+                )
+                table["set" + camel] = ("set", key)
+                table["get" + camel] = ("get", key)
+            cls._ACCESSORS = table
+        return cls._ACCESSORS
+
+    def __getattr__(self, name: str):
+        kind_key = self._accessor_map().get(name)
+        if kind_key is not None:
+            kind, key = kind_key
+            if kind == "set":
+
+                def setter(value):
+                    return self.setParam(key, value)
+
+                return setter
+
+            def getter():
+                return self.getParam(key)
+
+            return getter
+        raise AttributeError(
+            f"{type(self).__name__!s} has no attribute {name!r}"
+        )
+
 
 class TFEstimator(_HasParams):
     """Train via a full cluster job; returns a :class:`TFModel`.

@@ -120,3 +120,33 @@ def test_dfutil_example_conversion():
     assert int(back["a"]) == 7
     np.testing.assert_allclose(back["b"], [1.5, 2.5])
     assert back["s"] == "hi"
+
+
+def test_estimator_has_param_accessors():
+    """Reference Has* mixin surface: chainable setXxx / getXxx per param
+    (setBatchSize, setNumPS, setTFRecordDir, ...)."""
+    from tensorflowonspark_tpu.api.pipeline import TFEstimator
+
+    est = TFEstimator(train_fn=lambda a, c: None, tf_args={})
+    est.setBatchSize(128).setNumPS(0).setModelDir("/tmp/m").setTFRecordDir(
+        "/tmp/r"
+    ).setGraceSecs(5.0)
+    assert est.getBatchSize() == 128
+    assert est.getNumPS() == 0
+    assert est.getModelDir() == "/tmp/m"
+    assert est.getTFRecordDir() == "/tmp/r"
+    assert est.getGraceSecs() == 5.0
+    with pytest.raises(AttributeError):
+        est.setNoSuchParam(1)
+
+
+def test_has_param_accessor_arity():
+    """Accessors have exact arity — a stray argument must raise, not
+    silently redirect to another param."""
+    from tensorflowonspark_tpu.api.pipeline import TFEstimator
+
+    est = TFEstimator(train_fn=lambda a, c: None, tf_args={})
+    with pytest.raises(TypeError):
+        est.setBatchSize(128, "steps")
+    with pytest.raises(TypeError):
+        est.getBatchSize("epochs")
