@@ -1,0 +1,216 @@
+"""CIFAR-10 image classification, InputMode.TENSORFLOW.
+
+Reference parity: ``examples/cifar10`` (SURVEY.md §2.4 "v1-era legacy" —
+the multi-GPU-towers CIFAR trainer). TPU-native shape: the towers
+disappear into the mesh (DP = batch sharded over ``('data','fsdp')``,
+XLA inserts the gradient psum); each node reads its own shard of the
+classic CIFAR-10 binary format (1 label byte + 3072 RGB bytes per
+record, the same files the reference's ``cifar10_input.py`` consumed).
+
+Usage::
+
+    tpu-submit --num-executors 1 examples/cifar10/cifar10_train.py \
+        [--data-dir DIR] [--model resnet18|inception] [--steps 200]
+
+Without ``--data-dir`` (no ``data_batch_*.bin`` around), synthetic
+CIFAR-shaped data is used so the example runs anywhere.
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
+import argparse
+import glob
+import os
+import time
+
+RECORD_BYTES = 1 + 32 * 32 * 3  # label byte + HWC uint8 image (binary format)
+
+
+def _read_cifar_bin(path):
+    """Yield (image_hwc_float32, label) from one CIFAR-10 binary batch file."""
+    import numpy as np
+
+    raw = np.fromfile(path, np.uint8)
+    n = len(raw) // RECORD_BYTES
+    recs = raw[: n * RECORD_BYTES].reshape(n, RECORD_BYTES)
+    labels = recs[:, 0].astype(np.int32)
+    # stored CHW planar; transpose to the TPU-native NHWC
+    images = (
+        recs[:, 1:].reshape(n, 3, 32, 32).transpose(0, 2, 3, 1).astype(
+            np.float32
+        )
+        / 255.0
+    )
+    return images, labels
+
+
+def main_fun(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState
+    from tensorflowonspark_tpu.compute.checkpoint import CheckpointManager
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.models import inception, resnet
+
+    if args.model == "inception":
+        cfg = inception.InceptionConfig.tiny(width_mult=0.5)
+        model = inception.InceptionV3(cfg)
+        loss_fn = inception.loss_fn(model)
+        shardings_of = inception.inception_param_shardings
+    else:
+        cfg = resnet.ResNetConfig.resnet18(num_classes=10)
+        model = resnet.ResNet(cfg)
+        loss_fn = resnet.loss_fn(model)
+        shardings_of = resnet.resnet_param_shardings
+    mesh = make_mesh({"data": -1, "fsdp": args.fsdp})
+    rng = np.random.default_rng(ctx.executor_id)
+
+    def host_batches():
+        files = (
+            sorted(glob.glob(os.path.join(args.data_dir, "data_batch_*.bin")))
+            if args.data_dir
+            else []
+        )
+        if files:
+            # Node i takes every num_workers-th file; with fewer files
+            # than nodes, everyone reads all files and shards records.
+            shard_records = len(files) < ctx.num_workers
+            mine = (
+                files
+                if shard_records
+                else files[ctx.executor_id :: ctx.num_workers]
+            )
+            while True:
+                for f in mine:
+                    images, labels = _read_cifar_bin(f)
+                    if shard_records:
+                        images = images[ctx.executor_id :: ctx.num_workers]
+                        labels = labels[ctx.executor_id :: ctx.num_workers]
+                    order = rng.permutation(len(labels))
+                    for s in range(0, len(order) - args.batch_size + 1, args.batch_size):
+                        idx = order[s : s + args.batch_size]
+                        yield {"image": images[idx], "label": labels[idx]}
+        else:
+            while True:
+                yield {
+                    "image": rng.normal(size=(args.batch_size, 32, 32, 3)).astype(
+                        np.float32
+                    ),
+                    "label": rng.integers(0, 10, size=args.batch_size).astype(
+                        np.int32
+                    ),
+                }
+
+    variables = model.init(
+        jax.random.PRNGKey(0), np.zeros((2, 32, 32, 3), np.float32)
+    )
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    psh = shardings_of(params, mesh)
+    params = jax.tree.map(jax.device_put, params, psh)
+    tx = optax.sgd(args.lr, momentum=0.9)
+    state = TrainState.create(params, tx)
+
+    @jax.jit
+    def step(state, batch_stats, batch):
+        (l, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch_stats, batch
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(step=state.step + 1, params=new_params, opt_state=new_opt),
+            new_bs,
+            l,
+        )
+
+    batches = host_batches()
+    state, batch_stats, l = step(
+        state, batch_stats, shard_batch(mesh, next(batches))
+    )
+    jax.block_until_ready(l)  # compile excluded from timing
+    t0 = time.time()
+    for _ in range(args.steps):
+        state, batch_stats, l = step(
+            state, batch_stats, shard_batch(mesh, next(batches))
+        )
+    jax.block_until_ready(l)
+    dt = time.time() - t0
+    eps = args.steps * args.batch_size / dt
+    print(
+        f"node{ctx.executor_id}: {args.steps} steps in {dt:.1f}s -> "
+        f"{eps:.1f} examples/sec, loss {float(l):.4f}"
+    )
+
+    if args.data_dir and ctx.is_chief:
+        test_file = os.path.join(args.data_dir, "test_batch.bin")
+        if os.path.exists(test_file):
+            images, labels = _read_cifar_bin(test_file)
+
+            @jax.jit
+            def logits_of(params, batch_stats, image):
+                return model.apply(
+                    {"params": params, "batch_stats": batch_stats}, image
+                )
+
+            correct = total = 0
+            for s in range(0, len(labels) - args.batch_size + 1, args.batch_size):
+                lg = logits_of(
+                    state.params, batch_stats, images[s : s + args.batch_size]
+                )
+                correct += int(
+                    (np.asarray(lg).argmax(-1) == labels[s : s + args.batch_size]).sum()
+                )
+                total += args.batch_size
+            print(f"test accuracy: {correct / total:.4f} ({total} examples)")
+
+    if args.model_dir and ctx.is_chief:
+        ckpt = CheckpointManager(ctx.absolute_path(args.model_dir))
+        ckpt.save(
+            int(state.step),
+            {
+                "params": jax.device_get(state.params),
+                "batch_stats": jax.device_get(batch_stats),
+            },
+        )
+        ckpt.close()
+        print(f"chief checkpointed to {args.model_dir}")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", default=None, help="dir with data_batch_*.bin")
+    p.add_argument("--model", choices=("resnet18", "inception"), default="resnet18")
+    p.add_argument("--model-dir", default=None)
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--fsdp", type=int, default=1, help="fsdp axis size")
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args(argv)
+
+
+if __name__ == "__main__":
+    from tensorflowonspark_tpu.cluster import tfcluster
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+    from tensorflowonspark_tpu.launcher import cluster_args_from_env
+    from tensorflowonspark_tpu.utils.util import cpu_only_env
+
+    args = parse_args()
+    largs = cluster_args_from_env()
+    cluster = tfcluster.run(
+        main_fun,
+        args,
+        num_executors=largs["num_executors"],
+        input_mode=InputMode.TENSORFLOW,
+        env=cpu_only_env() if args.cpu else None,
+        launcher=largs.get("launcher"),
+        distributed=largs.get("distributed", False),
+    )
+    cluster.shutdown()
+    print("cifar10_train done")

@@ -1,0 +1,172 @@
+"""Inception-v3 ImageNet training, InputMode.TENSORFLOW.
+
+Reference parity: ``examples/imagenet/inception`` (SURVEY.md §2.4) — the
+model behind the reference's headline "near-linear scalability" chart
+(SURVEY.md §6). Per-node host pipeline -> ``shard_batch`` onto the mesh
+-> jit train step; aux classifier folded into the loss at 0.4 (the
+paper's weight); chief checkpoints via orbax.
+
+Usage::
+
+    tpu-submit --num-executors 1 examples/imagenet/inception_imagenet.py \
+        [--tfrecords DIR] [--model-dir DIR] [--steps 50] [--tiny] [--cpu]
+
+Without ``--tfrecords``, synthetic 299x299 data is used (input cost ~0,
+so the printed number is the compute ceiling).
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
+import argparse
+import time
+
+
+def main_fun(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState
+    from tensorflowonspark_tpu.compute.checkpoint import CheckpointManager
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.models import inception
+
+    cfg = (
+        inception.InceptionConfig.tiny()
+        if args.tiny
+        else inception.InceptionConfig.v3()
+    )
+    size = 64 if args.tiny else 299
+    model = inception.InceptionV3(cfg)
+    mesh = make_mesh({"data": -1, "fsdp": args.fsdp})
+    rng = np.random.default_rng(ctx.executor_id)
+
+    def host_batches():
+        if args.tfrecords:
+            from tensorflowonspark_tpu.data import dfutil
+
+            images: list = []
+            labels: list = []
+            produced = False
+            while True:
+                for i, r in enumerate(dfutil.loadTFRecords(args.tfrecords)):
+                    if i % ctx.num_workers != ctx.executor_id:
+                        continue  # shard by node
+                    images.append(
+                        np.asarray(r["image"], np.float32).reshape(size, size, 3)
+                    )
+                    labels.append(int(r["label"]))
+                    if len(labels) == args.batch_size:
+                        produced = True
+                        yield {
+                            "image": np.stack(images),
+                            "label": np.asarray(labels, np.int32),
+                        }
+                        images, labels = [], []
+                if not produced and not labels:
+                    raise ValueError(
+                        f"no records for node {ctx.executor_id} in "
+                        f"{args.tfrecords}"
+                    )
+        else:
+            while True:
+                yield {
+                    "image": rng.normal(
+                        size=(args.batch_size, size, size, 3)
+                    ).astype(np.float32),
+                    "label": rng.integers(
+                        0, cfg.num_classes, size=args.batch_size
+                    ).astype(np.int32),
+                }
+
+    # train=True so the aux head's params exist before the first train step
+    variables = model.init(
+        jax.random.PRNGKey(0), np.zeros((2, size, size, 3), np.float32),
+        train=True,
+    )
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    psh = inception.inception_param_shardings(params, mesh)
+    params = jax.tree.map(jax.device_put, params, psh)
+    tx = optax.sgd(0.045, momentum=0.9)
+    state = TrainState.create(params, tx)
+    loss_fn = inception.loss_fn(model)
+
+    @jax.jit
+    def step(state, batch_stats, batch):
+        (l, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch_stats, batch
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(step=state.step + 1, params=new_params, opt_state=new_opt),
+            new_bs,
+            l,
+        )
+
+    batches = host_batches()
+    state, batch_stats, l = step(
+        state, batch_stats, shard_batch(mesh, next(batches))
+    )
+    jax.block_until_ready(l)  # compile excluded from timing
+    t0 = time.time()
+    for _ in range(args.steps):
+        state, batch_stats, l = step(
+            state, batch_stats, shard_batch(mesh, next(batches))
+        )
+    jax.block_until_ready(l)
+    dt = time.time() - t0
+    eps = args.steps * args.batch_size / dt
+    print(
+        f"node{ctx.executor_id}: {args.steps} steps in {dt:.1f}s -> "
+        f"{eps:.1f} examples/sec ({eps / jax.device_count():.1f} /chip), "
+        f"loss {float(l):.4f}"
+    )
+    if args.model_dir and ctx.is_chief:
+        ckpt = CheckpointManager(ctx.absolute_path(args.model_dir))
+        ckpt.save(
+            int(state.step),
+            {
+                "params": jax.device_get(state.params),
+                "batch_stats": jax.device_get(batch_stats),
+            },
+        )
+        ckpt.close()
+        print(f"chief checkpointed to {args.model_dir}")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--tfrecords", default=None)
+    p.add_argument("--model-dir", default=None)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--fsdp", type=int, default=1, help="fsdp axis size")
+    p.add_argument("--tiny", action="store_true", help="tiny config (CI)")
+    p.add_argument("--cpu", action="store_true")
+    return p.parse_args(argv)
+
+
+if __name__ == "__main__":
+    from tensorflowonspark_tpu.cluster import tfcluster
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+    from tensorflowonspark_tpu.launcher import cluster_args_from_env
+    from tensorflowonspark_tpu.utils.util import cpu_only_env
+
+    args = parse_args()
+    largs = cluster_args_from_env()
+    cluster = tfcluster.run(
+        main_fun,
+        args,
+        num_executors=largs["num_executors"],
+        input_mode=InputMode.TENSORFLOW,
+        env=cpu_only_env() if args.cpu else None,
+        launcher=largs.get("launcher"),
+        distributed=largs.get("distributed", False),
+    )
+    cluster.shutdown()
+    print("inception_imagenet done")

@@ -15,6 +15,11 @@ from tensorflowonspark_tpu.models.bert import (  # noqa: F401
     BertForMLM,
     bert_param_shardings,
 )
+from tensorflowonspark_tpu.models.inception import (  # noqa: F401
+    InceptionConfig,
+    InceptionV3,
+    inception_param_shardings,
+)
 from tensorflowonspark_tpu.models.llama import (  # noqa: F401
     LlamaConfig,
     Llama,
