@@ -70,9 +70,12 @@ def _bench_llama(steps: int = 10) -> None:
     from benchmarks import real_chip
 
     # remat off: the 1B state+activations fit a single chip's HBM, and
-    # skipping the recompute is worth ~5 MFU points (49.8 vs 45.0).
+    # skipping the recompute is worth ~5 MFU points. bf16 Adam moments:
+    # frees 3.8 GB of HBM, which un-spills XLA's schedule on this 16 GB
+    # chip (measured 49.8% -> 57.3% MFU; see compute/optim.py).
     ns = argparse.Namespace(
-        steps=steps, batch_size=8, seq=1024, attention="auto", remat="none"
+        steps=steps, batch_size=8, seq=1024, attention="auto", remat="none",
+        precision="fp32", moments="bf16",
     )
     res = real_chip.bench_llama1b(ns)
     n_chips = len(jax.devices())

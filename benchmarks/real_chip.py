@@ -216,12 +216,29 @@ def bench_llama1b(args):
     tokens0 = np.zeros((2, seq + 1), np.int32)
     with use_mesh(mesh):
         params = model.init(jax.random.PRNGKey(0), tokens0[:, :-1])["params"]
+    # HBM-footprint knobs (see compute/optim.py): on a 16 GB chip the
+    # fp32-everything state is what caps MFU, not the matmuls.
+    precision = getattr(args, "precision", "fp32")
+    moments = getattr(args, "moments", "fp32")
+    moment_dtype = jnp.bfloat16 if moments == "bf16" else None
+    if precision == "mixed":
+        from tensorflowonspark_tpu.compute import mixed_precision_adamw
+
+        params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+        tx = mixed_precision_adamw(1e-4, moment_dtype=moment_dtype)
+    elif moment_dtype is not None:
+        from tensorflowonspark_tpu.compute import optim
+
+        tx = optim.adamw(1e-4, moment_dtype=moment_dtype)
+    else:
+        tx = optax.adamw(1e-4)
     psh = llama_param_shardings(params, mesh)
     params = jax.tree.map(jax.device_put, params, psh)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
-    tx = optax.adamw(1e-4)
     state = TrainState.create(params, tx)
-    token_loss = llama_loss_fn(model)
+    token_loss = llama_loss_fn(
+        model, logit_chunk=getattr(args, "logit_chunk", None)
+    )
     step = build_train_step(
         lambda p, bt: token_loss(p, bt["tokens"]), tx, mesh, param_shardings=psh
     )
@@ -310,6 +327,24 @@ def main(argv=None):
     p.add_argument("--attention", default="auto")
     p.add_argument(
         "--remat", choices=("full", "dots", "none"), default="full"
+    )
+    p.add_argument(
+        "--precision",
+        choices=("fp32", "mixed"),
+        default="fp32",
+        help="llama1b: param storage (mixed = bf16 params + fp32 master)",
+    )
+    p.add_argument(
+        "--moments",
+        choices=("fp32", "bf16"),
+        default="fp32",
+        help="llama1b: Adam moment storage dtype",
+    )
+    p.add_argument(
+        "--logit-chunk",
+        type=int,
+        default=None,
+        help="llama1b: chunked-CE chunk length (skips (B,S,V) logits)",
     )
     p.add_argument(
         "--new-tokens",

@@ -60,6 +60,7 @@ def main_fun(args, ctx):
     import dataclasses
 
     import jax
+    import jax.numpy as jnp
     import numpy as np
     import optax
 
@@ -105,9 +106,21 @@ def main_fun(args, ctx):
     params = jax.tree.map(jax.device_put, params, psh)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
 
-    tx = optax.adamw(float(args.lr))
+    moment_dtype = jnp.bfloat16 if args.moments == "bf16" else None
+    if args.precision == "mixed":
+        from tensorflowonspark_tpu.compute import mixed_precision_adamw
+
+        # bf16 stored params + fp32 master in the optimizer state
+        params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+        tx = mixed_precision_adamw(float(args.lr), moment_dtype=moment_dtype)
+    elif args.moments == "bf16":
+        from tensorflowonspark_tpu.compute import optim
+
+        tx = optim.adamw(float(args.lr), moment_dtype=jnp.bfloat16)
+    else:
+        tx = optax.adamw(float(args.lr))
     state = TrainState.create(params, tx)
-    token_loss = llama_loss_fn(model)
+    token_loss = llama_loss_fn(model, logit_chunk=args.logit_chunk)
     step = build_train_step(
         lambda p, b: token_loss(p, b["tokens"]), tx, mesh, param_shardings=psh
     )
@@ -210,6 +223,24 @@ def parse_args(argv=None):
         help="sequence-parallel strategy",
     )
     p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument(
+        "--precision",
+        choices=("fp32", "mixed"),
+        default="fp32",
+        help="mixed: bf16 stored params + fp32 master (compute/optim.py)",
+    )
+    p.add_argument(
+        "--moments",
+        choices=("fp32", "bf16"),
+        default="bf16",
+        help="Adam moment storage dtype (bf16 frees 4 bytes/param of HBM)",
+    )
+    p.add_argument(
+        "--logit-chunk",
+        type=int,
+        default=None,
+        help="chunked-CE chunk length; skips the (B,S,V) fp32 logits",
+    )
     p.add_argument("--model-dir", default=None)
     p.add_argument(
         "--generate",

@@ -13,6 +13,10 @@ from tensorflowonspark_tpu.compute.mesh import (
     batch_sharding,
     replicated,
 )
+from tensorflowonspark_tpu.compute.optim import (
+    adamw,
+    mixed_precision_adamw,
+)
 from tensorflowonspark_tpu.compute.train import (
     TrainState,
     build_train_step,
@@ -29,4 +33,6 @@ __all__ = [
     "build_train_step",
     "build_eval_step",
     "fsdp_shardings",
+    "adamw",
+    "mixed_precision_adamw",
 ]

@@ -241,12 +241,25 @@ class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, segment_ids=None, decode=False):
+    def __call__(
+        self,
+        tokens,
+        positions=None,
+        segment_ids=None,
+        decode=False,
+        return_hidden=False,
+    ):
         """tokens (B, S) int32 -> logits (B, S, vocab).
 
         ``decode=True`` runs against per-layer KV caches (apply with
         ``mutable=["cache"]``; see :func:`generate`): ``positions`` must
         then be the absolute positions of ``tokens`` in the sequence.
+
+        ``return_hidden=True`` returns ``(hidden, lm_head)`` instead of
+        logits — the final-norm hidden states (B, S, H) and the untied
+        head weight — so callers can run the vocab projection in chunks
+        (:func:`llama_loss_fn` with ``logit_chunk``) without ever
+        materializing the (B, S, vocab) fp32 logits.
         """
         cfg = self.cfg
         if positions is None:
@@ -289,6 +302,8 @@ class Llama(nn.Module):
             nn.initializers.normal(0.02),
             (cfg.hidden_size, cfg.vocab_size),
         )
+        if return_hidden:
+            return x, head
         return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
 
 
@@ -417,18 +432,60 @@ def _build_generate(
     return run
 
 
-def llama_loss_fn(model: "Llama"):
+def llama_loss_fn(model: "Llama", logit_chunk: int | None = None):
     """Next-token loss closure ``(params, tokens(B,S+1)) -> scalar`` that
     also collects sown auxiliary losses (the MoE router load-balancing
     loss — ``parallel/moe.py:MoEMLP``). A bare ``model.apply`` without
     ``mutable=['losses']`` silently discards those, so MoE configs MUST
-    train through this (or an equivalent mutable-collecting) loss."""
+    train through this (or an equivalent mutable-collecting) loss.
+
+    ``logit_chunk``: compute the vocab projection + cross entropy per
+    sequence chunk of this length under ``jax.checkpoint``, so the
+    (B, S, vocab) fp32 logits are never materialized (backward
+    recomputes each chunk's logits). At seq 4096 / vocab 32000 / b 8 the
+    full logits alone are 4.2 GB of HBM — this trades one extra head
+    matmul pass for that footprint. Must divide the sequence length.
+    """
 
     def loss(params, tokens):
-        logits, state = model.apply(
-            {"params": params}, tokens[:, :-1], mutable=["losses"]
-        )
-        total = cross_entropy_loss(logits, tokens[:, 1:])
+        if logit_chunk is None:
+            logits, state = model.apply(
+                {"params": params}, tokens[:, :-1], mutable=["losses"]
+            )
+            total = cross_entropy_loss(logits, tokens[:, 1:])
+        else:
+            (hidden, head), state = model.apply(
+                {"params": params},
+                tokens[:, :-1],
+                return_hidden=True,
+                mutable=["losses"],
+            )
+            b, s, h = hidden.shape
+            if s % logit_chunk:
+                raise ValueError(
+                    f"logit_chunk {logit_chunk} must divide seq len {s}"
+                )
+            targets = tokens[:, 1:]
+            head16 = head.astype(hidden.dtype)
+
+            @jax.checkpoint
+            def chunk_nll_sum(hc, tc):
+                # (B, C, H) @ (H, V) -> fp32 logits for this chunk only
+                logits = (hc @ head16).astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(logp, tc[..., None], axis=-1)
+                return jnp.sum(nll)
+
+            n_chunks = s // logit_chunk
+            hs = hidden.reshape(b, n_chunks, logit_chunk, h).swapaxes(0, 1)
+            ts = targets.reshape(b, n_chunks, logit_chunk).swapaxes(0, 1)
+
+            def body(acc, ht):
+                hc, tc = ht
+                return acc + chunk_nll_sum(hc, tc), None
+
+            total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ts))
+            total = total / (b * s)
         for leaf in jax.tree.leaves(state.get("losses", {})):
             total = total + jnp.sum(leaf)
         return total

@@ -204,6 +204,31 @@ def test_llama_grad_and_loss(tiny_llama):
     assert any(n > 0 for n in norms)
 
 
+def test_llama_chunked_loss_matches_full(tiny_llama):
+    """logit_chunk CE (no materialized (B,S,V) logits) must reproduce the
+    full-logits loss and its gradients."""
+    cfg, model, params = tiny_llama
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(3), (2, 17), 0, cfg.vocab_size
+    )
+    full = llama_loss_fn(model)
+    chunked = llama_loss_fn(model, logit_chunk=4)
+    lf, gf = jax.value_and_grad(full)(params, tokens)
+    lc, gc = jax.value_and_grad(chunked)(params, tokens)
+    np.testing.assert_allclose(float(lf), float(lc), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        gf,
+        gc,
+    )
+    with pytest.raises(ValueError, match="must divide"):
+        jax.value_and_grad(llama_loss_fn(model, logit_chunk=5))(
+            params, tokens
+        )
+
+
 def test_llama_kv_cache_matches_full_forward(tiny_llama):
     """Decode-mode attention against the KV cache must reproduce the
     training-path logits: prefill == full forward, and each cached

@@ -1,0 +1,181 @@
+"""Numerics tests for the memory-footprint-aware optimizers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensorflowonspark_tpu.compute import optim
+from tensorflowonspark_tpu.compute import (
+    TrainState,
+    build_train_step,
+    mixed_precision_adamw,
+)
+from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+
+
+def _params(dtype=jnp.float32):
+    k = jax.random.PRNGKey(0)
+    return {
+        "w": jax.random.normal(k, (16, 8), dtype) * 0.1,
+        "b": jnp.zeros((8,), dtype),
+    }
+
+
+def _grad_seq(n):
+    return [
+        jax.tree.map(
+            lambda p: jax.random.normal(
+                jax.random.PRNGKey(100 + i), p.shape, jnp.float32
+            )
+            * 0.01,
+            _params(),
+        )
+        for i in range(n)
+    ]
+
+
+def test_adamw_fp32_matches_optax():
+    """With fp32 moments ours must track optax.adamw to float tolerance."""
+    params_a = _params()
+    params_b = _params()
+    tx_a = optim.adamw(1e-2, weight_decay=1e-3)
+    tx_b = optax.adamw(1e-2, weight_decay=1e-3)
+    sa, sb = tx_a.init(params_a), tx_b.init(params_b)
+    for g in _grad_seq(5):
+        ua, sa = tx_a.update(g, sa, params_a)
+        params_a = optax.apply_updates(params_a, ua)
+        ub, sb = tx_b.update(g, sb, params_b)
+        params_b = optax.apply_updates(params_b, ub)
+    np.testing.assert_allclose(
+        np.asarray(params_a["w"]), np.asarray(params_b["w"]),
+        rtol=1e-4, atol=1e-7,
+    )
+
+
+def test_adamw_bf16_moments_close_to_fp32():
+    """bf16 moments: same trajectory within bf16-rounding tolerance, and
+    the stored state really is bf16."""
+    params_a = _params()
+    params_b = _params()
+    tx_a = optim.adamw(1e-2, moment_dtype=jnp.bfloat16)
+    tx_b = optim.adamw(1e-2)
+    sa, sb = tx_a.init(params_a), tx_b.init(params_b)
+    assert sa[0].mu["w"].dtype == jnp.bfloat16
+    assert sa[0].nu["w"].dtype == jnp.bfloat16
+    for g in _grad_seq(10):
+        ua, sa = tx_a.update(g, sa, params_a)
+        params_a = optax.apply_updates(params_a, ua)
+        ub, sb = tx_b.update(g, sb, params_b)
+        params_b = optax.apply_updates(params_b, ub)
+    # ~1% relative agreement after 10 steps is the bf16-moment contract
+    np.testing.assert_allclose(
+        np.asarray(params_a["w"]), np.asarray(params_b["w"]), rtol=1e-2,
+        atol=1e-4,
+    )
+
+
+def test_mixed_precision_params_track_master():
+    """bf16 params must equal the fp32 master's bf16 rounding every step."""
+    params = _params(jnp.bfloat16)
+    tx = mixed_precision_adamw(1e-2)
+    state = tx.init(params)
+    assert state.master["w"].dtype == jnp.float32
+    for g in _grad_seq(5):
+        g16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), g)
+        upd, state = tx.update(g16, state, params)
+        params = optax.apply_updates(params, upd)
+        np.testing.assert_array_equal(
+            np.asarray(params["w"]),
+            np.asarray(state.master["w"].astype(jnp.bfloat16)),
+        )
+
+
+def test_mixed_precision_accumulates_tiny_updates():
+    """Updates far below one bf16 ulp must accumulate via the master
+    instead of rounding to zero (the reason master weights exist)."""
+    params = {"w": jnp.ones((4,), jnp.bfloat16)}
+    tx = mixed_precision_adamw(
+        learning_rate=1e-6, b1=0.0, b2=0.0, eps=1.0, weight_decay=0.0
+    )
+    state = tx.init(params)
+    g = {"w": jnp.ones((4,), jnp.bfloat16)}
+    # each step moves the master by ~lr*(g/(|g|+1)) ~ 5e-7; a bf16 param
+    # at 1.0 has ulp ~0.0078 so params alone would never move
+    for _ in range(100):
+        upd, state = tx.update(g, state, params)
+        params = optax.apply_updates(params, upd)
+    master = float(state.master["w"][0])
+    assert master < 1.0 - 1e-5, "master did not accumulate tiny updates"
+    # naive bf16 adam with the same schedule moves nothing
+    naive = jnp.ones((4,), jnp.bfloat16) - jnp.bfloat16(5e-7) * 100
+    assert float(naive[0]) == 1.0
+
+
+def test_mixed_precision_close_to_fp32_adamw():
+    """End-to-end trajectory of bf16 params + master ≈ fp32 optax.adamw."""
+    params_r = _params(jnp.float32)
+    # same start point: the bf16 run begins at the fp32 params' rounding
+    params_m = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params_r)
+    tx_m = mixed_precision_adamw(1e-2, weight_decay=1e-3)
+    tx_r = optax.adamw(1e-2, weight_decay=1e-3)
+    sm, sr = tx_m.init(params_m), tx_r.init(params_r)
+    for g in _grad_seq(10):
+        um, sm = tx_m.update(
+            jax.tree.map(lambda x: x.astype(jnp.bfloat16), g), params=params_m,
+            state=sm,
+        )
+        params_m = optax.apply_updates(params_m, um)
+        ur, sr = tx_r.update(g, sr, params_r)
+        params_r = optax.apply_updates(params_r, ur)
+    np.testing.assert_allclose(
+        np.asarray(sm.master["w"]),
+        np.asarray(params_r["w"]),
+        rtol=2e-2,
+        atol=2e-4,
+    )
+
+
+def test_mixed_precision_in_build_train_step():
+    """The mixed optimizer must ride build_train_step's sharded path
+    (master/moments mirror the param tree -> FSDP shardings apply)."""
+    mesh = make_mesh({"data": -1, "fsdp": 2})
+    params = {
+        "w": jnp.ones((8, 4), jnp.bfloat16) * 0.5,
+        "b": jnp.zeros((4,), jnp.bfloat16),
+    }
+    tx = mixed_precision_adamw(1e-2)
+
+    def loss(p, batch):
+        pred = batch["x"].astype(jnp.bfloat16) @ p["w"] + p["b"]
+        return jnp.mean(
+            (pred.astype(jnp.float32) - batch["y"]) ** 2
+        )
+
+    state = TrainState.create(params, tx)
+    step = build_train_step(loss, tx, mesh)
+    rng = np.random.default_rng(0)
+    batch = shard_batch(
+        mesh,
+        {
+            "x": rng.normal(size=(16, 8)).astype(np.float32),
+            "y": rng.normal(size=(16, 4)).astype(np.float32),
+        },
+    )
+    l0 = None
+    for _ in range(10):
+        state, l = step(state, batch)
+        l0 = l0 if l0 is not None else float(l)
+    assert float(l) < l0
+    assert state.params["w"].dtype == jnp.bfloat16
+    assert state.opt_state.master["w"].dtype == jnp.float32
+
+
+def test_adamw_accepts_schedule():
+    sched = optax.linear_schedule(1e-2, 0.0, 10)
+    params = _params()
+    tx = optim.adamw(sched, moment_dtype=jnp.bfloat16)
+    state = tx.init(params)
+    upd, state = tx.update(_grad_seq(1)[0], state, params)
+    assert jnp.isfinite(jax.tree.leaves(upd)[0]).all()
