@@ -198,15 +198,8 @@ def bench_llama1b(args):
     mesh = make_mesh({"fsdp": len(jax.devices())})
     b = args.batch_size or 8
     seq = args.seq or 1024
-    cfg = LlamaConfig(
-        vocab_size=32000,
-        hidden_size=2048,
-        intermediate_size=5632,
-        num_layers=16,
-        num_heads=16,
-        num_kv_heads=16,
+    cfg = LlamaConfig.llama_1b(
         max_seq_len=seq,
-        dtype=jnp.bfloat16,
         remat=getattr(args, "remat", "full") != "none",
         remat_policy=getattr(args, "remat", "full"),
         attention_impl=args.attention,

@@ -56,18 +56,27 @@ def main_fun(args, ctx):
     from tensorflowonspark_tpu.compute import TrainState
     from tensorflowonspark_tpu.compute.checkpoint import CheckpointManager
     from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
-    from tensorflowonspark_tpu.models import inception, resnet
+    from tensorflowonspark_tpu.models import inception, zoo
 
-    if args.model == "inception":
+    if args.model.startswith("inception"):
+        # full Inception-v3 is built for 299px; at 32px its aux head
+        # pools below zero size, so CIFAR uses the half-width tiny config
         cfg = inception.InceptionConfig.tiny(width_mult=0.5)
         model = inception.InceptionV3(cfg)
         loss_fn = inception.loss_fn(model)
         shardings_of = inception.inception_param_shardings
     else:
-        cfg = resnet.ResNetConfig.resnet18(num_classes=10)
-        model = resnet.ResNet(cfg)
-        loss_fn = resnet.loss_fn(model)
-        shardings_of = resnet.resnet_param_shardings
+        # any image model from the zoo factory (the slim nets_factory
+        # surface): resnet18/34/50/101, vgg11/16, ...
+        entry = zoo.build(args.model, num_classes=10)
+        if entry.kind != "image":
+            raise ValueError(
+                f"--model {args.model} is a {entry.kind} model; this "
+                "example trains image classifiers"
+            )
+        model = entry.model
+        loss_fn = entry.make_loss()
+        shardings_of = entry.param_shardings
     mesh = make_mesh({"data": -1, "fsdp": args.fsdp})
     rng = np.random.default_rng(ctx.executor_id)
 
@@ -185,7 +194,12 @@ def main_fun(args, ctx):
 def parse_args(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--data-dir", default=None, help="dir with data_batch_*.bin")
-    p.add_argument("--model", choices=("resnet18", "inception"), default="resnet18")
+    p.add_argument(
+        "--model",
+        default="resnet18",
+        help="'inception' (CIFAR-size) or any image model from "
+        "models/zoo.py (resnet18/34/50/101, vgg11/16)",
+    )
     p.add_argument("--model-dir", default=None)
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--batch-size", type=int, default=256)

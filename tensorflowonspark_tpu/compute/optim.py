@@ -164,8 +164,10 @@ def mixed_precision_adamw(
         direction, inner = adam.update(
             grads, ScaleByAdamState(state.count, state.mu, state.nu)
         )
+        # schedule indexed at the pre-increment count: first step uses
+        # schedule(0), matching optax/scale_by_learning_rate convention
         lr = (
-            learning_rate(inner.count)
+            learning_rate(state.count)
             if callable(learning_rate)
             else learning_rate
         )

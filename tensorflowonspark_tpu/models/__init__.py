@@ -35,3 +35,9 @@ from tensorflowonspark_tpu.models.unet import (  # noqa: F401
     UNetConfig,
     unet_param_shardings,
 )
+from tensorflowonspark_tpu.models.vgg import (  # noqa: F401
+    VGG,
+    VGGConfig,
+    vgg_param_shardings,
+)
+from tensorflowonspark_tpu.models import zoo  # noqa: F401

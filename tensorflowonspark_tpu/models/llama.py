@@ -62,6 +62,22 @@ class LlamaConfig:
         return LlamaConfig()
 
     @staticmethod
+    def llama_1b(**overrides) -> "LlamaConfig":
+        """The BASELINE.md single-chip benchmark config (953M params)."""
+        base = dict(
+            vocab_size=32000,
+            hidden_size=2048,
+            intermediate_size=5632,
+            num_layers=16,
+            num_heads=16,
+            num_kv_heads=16,
+            max_seq_len=1024,
+            dtype=jnp.bfloat16,
+        )
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+    @staticmethod
     def tiny(**overrides) -> "LlamaConfig":
         """Test-size config (also used by __graft_entry__ dry runs)."""
         base = dict(

@@ -1,0 +1,103 @@
+"""VGG (A/D variants — VGG-11/VGG-16) for the classic-zoo parity line.
+
+Parity note: the reference's ``examples/slim`` tree vendored TF-slim's
+nets (vgg/inception/resnet/lenet with a ``nets_factory``) — SURVEY.md
+§2.4. VGG is the remaining classic family; from scratch in flax.
+
+TPU-first design notes: NHWC, convs in bf16 (the 3x3 stacks are pure MXU
+food), fp32 classifier head. BatchNorm instead of the original's
+local-response-free plain convs — the standard modern training recipe —
+so the same TrainState/batch_stats plumbing as ResNet/Inception applies.
+The giant fc6/fc7 dense layers are kept (they are most of the 138M
+params) but expressed as 1x1 convs on the pooled 7x7 map collapsed by
+reshape — identical math, friendlier XLA layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class VGGConfig:
+    # channels per conv stage; each stage ends in a 2x2 maxpool
+    stage_sizes: tuple[int, ...] = (2, 2, 3, 3, 3)  # VGG-16 (variant D)
+    num_classes: int = 1000
+    width: int = 64
+    fc_features: int = 4096
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @staticmethod
+    def vgg11(**kw) -> "VGGConfig":
+        return VGGConfig(stage_sizes=(1, 1, 2, 2, 2), **kw)
+
+    @staticmethod
+    def vgg16(**kw) -> "VGGConfig":
+        return VGGConfig(**kw)
+
+    @staticmethod
+    def tiny(**overrides) -> "VGGConfig":
+        base = dict(
+            stage_sizes=(1, 1), width=8, fc_features=32, num_classes=10
+        )
+        base.update(overrides)
+        return VGGConfig(**base)
+
+
+class VGG(nn.Module):
+    config: VGGConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.config
+        x = x.astype(cfg.dtype)
+        for stage, size in enumerate(cfg.stage_sizes):
+            feats = cfg.width * 2 ** min(stage, 3)  # caps at 512 like the paper
+            for _ in range(size):
+                x = nn.Conv(
+                    feats, (3, 3), padding="SAME", use_bias=False,
+                    dtype=cfg.dtype,
+                )(x)
+                x = nn.BatchNorm(
+                    use_running_average=not train,
+                    momentum=0.9,
+                    epsilon=1e-5,
+                    dtype=jnp.float32,
+                )(x)
+                x = nn.relu(x).astype(cfg.dtype)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)  # flatten the final grid (fc6 input)
+        x = nn.relu(nn.Dense(cfg.fc_features, dtype=cfg.dtype)(x))
+        x = nn.relu(nn.Dense(cfg.fc_features, dtype=cfg.dtype)(x))
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32)(x)
+
+
+def vgg_param_shardings(params, mesh: Mesh):
+    """Same conv-model FSDP rule set as ResNet/Inception."""
+    from tensorflowonspark_tpu.models.resnet import resnet_param_shardings
+
+    return resnet_param_shardings(params, mesh)
+
+
+def loss_fn(model: VGG):
+    """``loss(params, batch_stats, batch) -> (loss, new_batch_stats)``."""
+    import optax
+
+    def loss(params, batch_stats, batch):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["image"],
+            train=True,
+            mutable=["batch_stats"],
+        )
+        l = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+        return l, mutated["batch_stats"]
+
+    return loss
