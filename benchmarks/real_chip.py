@@ -134,6 +134,74 @@ def bench_resnet50(args):
     )
 
 
+def bench_inception_v3(args):
+    """Inception-v3 (the reference's headline scaling-chart model)."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.models import inception
+
+    mesh = make_mesh({"data": len(jax.devices())})
+    b = args.batch_size or 128
+    size = 299
+    cfg = inception.InceptionConfig.v3()
+    model = inception.InceptionV3(cfg)
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": rng.random((b, size, size, 3), dtype=np.float32),
+        "label": rng.integers(0, 1000, size=b).astype(np.int32),
+    }
+    variables = model.init(
+        jax.random.PRNGKey(0), batch["image"][:2], train=True
+    )
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.045, momentum=0.9)
+    loss_fn = inception.loss_fn(model)
+    state = TrainState.create(params, tx)
+
+    @jax.jit
+    def step(state, stats, batch):
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, stats, batch
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(
+                step=state.step + 1, params=new_params, opt_state=new_opt
+            ),
+            new_stats,
+            loss,
+        )
+
+    dev_batch = shard_batch(mesh, batch)
+    # honest FLOP count from XLA's own cost analysis (covers the SAME-
+    # padding grid variant exactly); fall back to the classic 3x5.7 GF/img.
+    # cost_analysis reports the per-device SPMD module, so scale by chip
+    # count to match the global-batch flops convention of the other
+    # configs (main() divides by n_chips for the per-chip MFU).
+    n_chips = len(jax.devices())
+    try:
+        cost = step.lower(state, batch_stats, dev_batch).compile().cost_analysis()
+        flops = float(cost.get("flops", 0.0)) * n_chips or 3 * 5.7e9 * b
+    except Exception:
+        flops = 3 * 5.7e9 * b
+    for _ in range(3):
+        state, batch_stats, loss = step(state, batch_stats, dev_batch)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, batch_stats, loss = step(state, batch_stats, dev_batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return dict(
+        examples=b, dt=dt, loss=float(loss), flops_fallback=flops
+    )
+
+
 def bench_bert_base(args):
     import jax
     import numpy as np
@@ -305,6 +373,7 @@ V5E_PEAK_TFLOPS = 197.0  # per-chip bf16 peak (shared with bench.py)
 CONFIGS = {
     "mnist": bench_mnist,
     "resnet50": bench_resnet50,
+    "inception_v3": bench_inception_v3,
     "bert_base": bench_bert_base,
     "llama1b": bench_llama1b,
     "llama1b_decode": bench_llama1b_decode,

@@ -58,7 +58,7 @@ def main_fun(args, ctx):
     from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
     from tensorflowonspark_tpu.models import inception, zoo
 
-    if args.model.startswith("inception"):
+    if args.model in ("inception", "inception_v3"):
         # full Inception-v3 is built for 299px; at 32px its aux head
         # pools below zero size, so CIFAR uses the half-width tiny config
         cfg = inception.InceptionConfig.tiny(width_mult=0.5)

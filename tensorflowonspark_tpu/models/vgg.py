@@ -85,19 +85,8 @@ def vgg_param_shardings(params, mesh: Mesh):
 
 
 def loss_fn(model: VGG):
-    """``loss(params, batch_stats, batch) -> (loss, new_batch_stats)``."""
-    import optax
+    """``loss(params, batch_stats, batch) -> (loss, new_batch_stats)`` —
+    the shared BN-classifier loss (same contract as ResNet's)."""
+    from tensorflowonspark_tpu.models.resnet import loss_fn as _bn_loss
 
-    def loss(params, batch_stats, batch):
-        logits, mutated = model.apply(
-            {"params": params, "batch_stats": batch_stats},
-            batch["image"],
-            train=True,
-            mutable=["batch_stats"],
-        )
-        l = optax.softmax_cross_entropy_with_integer_labels(
-            logits, batch["label"]
-        ).mean()
-        return l, mutated["batch_stats"]
-
-    return loss
+    return _bn_loss(model)

@@ -46,9 +46,11 @@ class DStream:
     operations (:meth:`foreachRDD`) register callbacks on the context."""
 
     def __init__(self, ssc: "StreamingContext", parent: "DStream | None",
-                 op: Callable[[RDD], RDD] | None):
+                 op: Callable[..., RDD] | None,
+                 parent2: "DStream | None" = None):
         self._ssc = ssc
         self._parent = parent
+        self._parent2 = parent2  # set for two-input ops (union)
         self._op = op
 
     # -- transformations (record level) --------------------------------
@@ -85,8 +87,69 @@ class DStream:
 
         return self._derive(op)
 
-    def _derive(self, op: Callable[[RDD], RDD]) -> "DStream":
+    def _derive(self, op: Callable[..., RDD]) -> "DStream":
         return DStream(self._ssc, self, op)
+
+    # -- windowed transformations (micro-batch level) -------------------
+    #
+    # Windows are counted in MICRO-BATCHES, not seconds (a discretized
+    # stream's natural unit; pyspark's windowDuration/batch_interval
+    # ratio). A window advances once per scheduler tick on which its
+    # source produced a micro-batch — empty ticks (source returned None)
+    # do not slide the window. Window state lives in the op closure; the
+    # per-tick node memo in :meth:`_materialize` guarantees exactly one
+    # advance per tick however many outputs share the windowed node.
+
+    def window(self, num_batches: int) -> "DStream":
+        """Union of the last ``num_batches`` micro-batches."""
+        if num_batches < 1:
+            raise ValueError("window needs num_batches >= 1")
+        import collections
+
+        buf: collections.deque[RDD] = collections.deque(maxlen=num_batches)
+
+        def op(rdd: RDD) -> RDD:
+            buf.append(rdd)
+            return [part for r in buf for part in r]
+
+        return self._derive(op)
+
+    def countByWindow(self, num_batches: int) -> "DStream":
+        """Record count over the window: one single-record partition."""
+        return self.window(num_batches)._derive(
+            lambda rdd: [[sum(len(p) for p in rdd)]]
+        )
+
+    def reduceByWindow(
+        self, fn: Callable[[Any, Any], Any], num_batches: int
+    ) -> "DStream":
+        """Fold all records in the window with ``fn``; empty window ->
+        empty micro-batch."""
+        import functools
+
+        def reduce_op(rdd: RDD) -> RDD:
+            records = [r for part in rdd for r in part]
+            return [[functools.reduce(fn, records)]] if records else [[]]
+
+        return self.window(num_batches)._derive(reduce_op)
+
+    def count(self) -> "DStream":
+        """Per-micro-batch record count (pyspark ``DStream.count``)."""
+        return self._derive(lambda rdd: [[sum(len(p) for p in rdd)]])
+
+    def union(self, other: "DStream") -> "DStream":
+        """Merge two streams derived from the same source (their per-tick
+        partitions are concatenated)."""
+        if other._ssc is not self._ssc:
+            raise ValueError("union across StreamingContexts")
+        if other._source() is not self._source():
+            raise ValueError(
+                "union requires streams derived from the same source "
+                "(cross-source joins are not part of the feed model)"
+            )
+        return DStream(
+            self._ssc, self, lambda a, b: list(a) + list(b), parent2=other
+        )
 
     # -- output --------------------------------------------------------
     def foreachRDD(self, fn: Callable[[RDD], None]) -> None:
@@ -94,16 +157,26 @@ class DStream:
         self._ssc._register_output(self, fn)
 
     # -- evaluation ----------------------------------------------------
-    def _materialize(self, source_rdd: RDD) -> RDD:
-        chain: list[DStream] = []
-        node: DStream | None = self
-        while node is not None and node._op is not None:
-            chain.append(node)
-            node = node._parent
-        rdd = source_rdd
-        for n in reversed(chain):
-            rdd = n._op(rdd)
-        return rdd
+    def _materialize(
+        self, source_rdd: RDD, memo: dict[int, RDD] | None = None
+    ) -> RDD:
+        """Evaluate this node for one tick. ``memo`` (id(node) -> RDD)
+        makes every node evaluate at most once per tick — required for
+        correctness of stateful window ops shared by several outputs."""
+        if memo is None:
+            memo = {}
+        if self._op is None:
+            return source_rdd
+        key = id(self)
+        if key in memo:
+            return memo[key]
+        a = self._parent._materialize(source_rdd, memo)
+        if self._parent2 is not None:
+            out = self._op(a, self._parent2._materialize(source_rdd, memo))
+        else:
+            out = self._op(a)
+        memo[key] = out
+        return out
 
     def _source(self) -> "DStream":
         node = self
@@ -228,16 +301,14 @@ class StreamingContext:
                     rdd = poll()
                     if rdd is None:
                         continue
-                    # Materialize each distinct stream once per tick, so
-                    # several outputs on one stream (e.g. the train feed
-                    # bridge plus a monitor) share the transformed RDD.
-                    cache: dict[int, RDD] = {}
+                    # One shared per-tick memo: every node (not just each
+                    # leaf) evaluates once, so outputs sharing ancestors
+                    # reuse work and stateful window ops advance exactly
+                    # once per tick.
+                    memo: dict[int, RDD] = {}
                     for out_ds, fn in self._outputs:
                         if out_ds._source() is src_ds:
-                            key = id(out_ds)
-                            if key not in cache:
-                                cache[key] = out_ds._materialize(rdd)
-                            fn(cache[key])
+                            fn(out_ds._materialize(rdd, memo))
                 # fixed-rate schedule, like Spark's batch interval
                 elapsed = time.monotonic() - tick_start
                 self._stopped.wait(max(0.0, self.batch_interval - elapsed))

@@ -183,3 +183,73 @@ def test_shutdown_reraises_scheduler_error(tmp_path):
     ssc._terminated.wait(20)
     with pytest.raises(ZeroDivisionError):
         cluster.shutdown(timeout=120, ssc=ssc)
+
+
+def test_window_and_countByWindow():
+    ssc = StreamingContext(batch_interval=0.05)
+    src = ssc.queueStream([[1, 2], [3], [4, 5, 6]])
+    win = src.window(2)
+    counts = []
+    src.countByWindow(2).foreachRDD(
+        lambda rdd: counts.append(rdd[0][0])
+    )
+    out = _collect(ssc, win)
+    deadline = time.time() + 10
+    while len(out) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+    ssc.stop()
+    # tick1: [1,2]; tick2: [1,2]+[3]; tick3: [3]+[4,5,6] (window slid)
+    assert [sorted(r for p in rdd for r in p) for rdd in out] == [
+        [1, 2], [1, 2, 3], [3, 4, 5, 6],
+    ]
+    assert counts == [2, 3, 4]
+
+
+def test_window_shared_by_two_outputs_advances_once():
+    """Two outputs downstream of ONE window node must not double-advance
+    its buffer (the per-tick node memo)."""
+    ssc = StreamingContext(batch_interval=0.05)
+    win = ssc.queueStream([[1], [2], [3]]).window(2)
+    a, b = [], []
+    win.map(lambda x: x).foreachRDD(a.append)
+    win.map(lambda x: -x).foreachRDD(b.append)
+    ssc.start()
+    deadline = time.time() + 10
+    while len(a) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+    ssc.stop()
+    flat = lambda rdd: sorted(r for p in rdd for r in p)  # noqa: E731
+    assert [flat(r) for r in a] == [[1], [1, 2], [2, 3]]
+    assert [flat(r) for r in b] == [[-1], [-2, -1], [-3, -2]]
+
+
+def test_reduceByWindow_union_count():
+    ssc = StreamingContext(batch_interval=0.05)
+    src = ssc.queueStream([[1, 2], [3]])
+    evens = src.filter(lambda x: x % 2 == 0)
+    odds = src.filter(lambda x: x % 2 == 1)
+    both = evens.union(odds)
+    sums = []
+    src.reduceByWindow(lambda a, b: a + b, 2).foreachRDD(
+        lambda rdd: sums.append(rdd[0][0] if rdd[0] else None)
+    )
+    counts = []
+    both.count().foreachRDD(lambda rdd: counts.append(rdd[0][0]))
+    ssc.start()
+    deadline = time.time() + 10
+    while len(sums) < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    ssc.stop()
+    assert sums == [3, 6]  # [1,2] then [1,2]+[3]
+    assert counts == [2, 1]
+
+    other = StreamingContext(batch_interval=0.05)
+    foreign = other.queueStream([[9]])
+    with pytest.raises(ValueError, match="same source|StreamingContexts"):
+        src.union(foreign)
+    with pytest.raises(ValueError, match="same source"):
+        src.union(other_stream_same_ctx(ssc))
+
+
+def other_stream_same_ctx(ssc):
+    return ssc.queueStream([[7]])
