@@ -79,16 +79,58 @@ def bench_mnist(args):
     return dict(examples=b, dt=dt, loss=loss, flops_fallback=None)
 
 
-def bench_resnet50(args):
+def _bench_bn_model(model, loss_fn, tx, batch, steps, flops_of=None):
+    """Shared warm/time loop for BatchNorm models (carried batch_stats).
+
+    Same sync rules as _bench_step: device-resident batch, host-fetch
+    barrier. ``flops_of(step_fn, state, stats, dev_batch)`` may supply a
+    FLOP count (e.g. XLA cost analysis); None means caller's fallback.
+    """
     import jax
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+
+    mesh = make_mesh({"data": len(jax.devices())})
+    variables = model.init(jax.random.PRNGKey(0), batch["image"][:2], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    state = TrainState.create(params, tx)
+
+    @jax.jit
+    def step(state, stats, batch):
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, stats, batch
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(
+                step=state.step + 1, params=new_params, opt_state=new_opt
+            ),
+            new_stats,
+            loss,
+        )
+
+    dev_batch = shard_batch(mesh, batch)
+    flops = flops_of(step, state, batch_stats, dev_batch) if flops_of else None
+    for _ in range(3):
+        state, batch_stats, loss = step(state, batch_stats, dev_batch)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, batch_stats, loss = step(state, batch_stats, dev_batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return dt, float(loss), flops
+
+
+def bench_resnet50(args):
     import numpy as np
     import optax
 
-    from tensorflowonspark_tpu.compute import TrainState, build_train_step
-    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
     from tensorflowonspark_tpu.models import resnet
 
-    mesh = make_mesh({"data": len(jax.devices())})
     b = args.batch_size or 256
     model = resnet.ResNet(resnet.ResNetConfig.resnet50())
     rng = np.random.default_rng(0)
@@ -96,109 +138,52 @@ def bench_resnet50(args):
         "image": rng.random((b, 224, 224, 3), dtype=np.float32),
         "label": rng.integers(0, 1000, size=b).astype(np.int32),
     }
-    variables = model.init(jax.random.PRNGKey(0), batch["image"][:2])
-    params, batch_stats = variables["params"], variables["batch_stats"]
-    tx = optax.sgd(0.1, momentum=0.9)
-    loss_fn = resnet.loss_fn(model)
-    state = TrainState.create(params, tx)
-
-    @jax.jit
-    def step(state, stats, batch):
-        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, stats, batch
-        )
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        return (
-            TrainState(
-                step=state.step + 1, params=new_params, opt_state=new_opt
-            ),
-            new_stats,
-            loss,
-        )
-
-    # inline warm/time loop (extra carried batch_stats); same sync rules
-    # as _bench_step: device-resident batch, host-fetch barrier.
-    dev_batch = shard_batch(mesh, batch)
-    for _ in range(3):
-        state, batch_stats, loss = step(state, batch_stats, dev_batch)
-    float(loss)
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, batch_stats, loss = step(state, batch_stats, dev_batch)
-    float(loss)
-    dt = time.perf_counter() - t0
+    dt, loss, _ = _bench_bn_model(
+        model, resnet.loss_fn(model), optax.sgd(0.1, momentum=0.9),
+        batch, args.steps,
+    )
     # ResNet-50 training ≈ 3x forward (4.1 GFLOPs) per image
     return dict(
-        examples=b, dt=dt, loss=float(loss), flops_fallback=3 * 4.1e9 * b
+        examples=b, dt=dt, loss=loss, flops_fallback=3 * 4.1e9 * b
     )
 
 
 def bench_inception_v3(args):
     """Inception-v3 (the reference's headline scaling-chart model)."""
-    import jax
     import numpy as np
     import optax
 
-    from tensorflowonspark_tpu.compute import TrainState
-    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
     from tensorflowonspark_tpu.models import inception
 
-    mesh = make_mesh({"data": len(jax.devices())})
     b = args.batch_size or 128
-    size = 299
-    cfg = inception.InceptionConfig.v3()
-    model = inception.InceptionV3(cfg)
+    model = inception.InceptionV3(inception.InceptionConfig.v3())
     rng = np.random.default_rng(0)
     batch = {
-        "image": rng.random((b, size, size, 3), dtype=np.float32),
+        "image": rng.random((b, 299, 299, 3), dtype=np.float32),
         "label": rng.integers(0, 1000, size=b).astype(np.int32),
     }
-    variables = model.init(
-        jax.random.PRNGKey(0), batch["image"][:2], train=True
+
+    def flops_of(step, state, stats, dev_batch):
+        # honest FLOP count from XLA's own cost analysis (covers the
+        # SAME-padding grid variant exactly). cost_analysis reports the
+        # per-device SPMD module, so scale by chip count to match the
+        # global-batch flops convention of the other configs (main()
+        # divides by n_chips for the per-chip MFU).
+        import jax
+
+        try:
+            cost = step.lower(state, stats, dev_batch).compile().cost_analysis()
+            return float(cost.get("flops", 0.0)) * len(jax.devices()) or None
+        except Exception:
+            return None
+
+    dt, loss, flops = _bench_bn_model(
+        model, inception.loss_fn(model), optax.sgd(0.045, momentum=0.9),
+        batch, args.steps, flops_of=flops_of,
     )
-    params, batch_stats = variables["params"], variables["batch_stats"]
-    tx = optax.sgd(0.045, momentum=0.9)
-    loss_fn = inception.loss_fn(model)
-    state = TrainState.create(params, tx)
-
-    @jax.jit
-    def step(state, stats, batch):
-        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, stats, batch
-        )
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        return (
-            TrainState(
-                step=state.step + 1, params=new_params, opt_state=new_opt
-            ),
-            new_stats,
-            loss,
-        )
-
-    dev_batch = shard_batch(mesh, batch)
-    # honest FLOP count from XLA's own cost analysis (covers the SAME-
-    # padding grid variant exactly); fall back to the classic 3x5.7 GF/img.
-    # cost_analysis reports the per-device SPMD module, so scale by chip
-    # count to match the global-batch flops convention of the other
-    # configs (main() divides by n_chips for the per-chip MFU).
-    n_chips = len(jax.devices())
-    try:
-        cost = step.lower(state, batch_stats, dev_batch).compile().cost_analysis()
-        flops = float(cost.get("flops", 0.0)) * n_chips or 3 * 5.7e9 * b
-    except Exception:
-        flops = 3 * 5.7e9 * b
-    for _ in range(3):
-        state, batch_stats, loss = step(state, batch_stats, dev_batch)
-    float(loss)
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, batch_stats, loss = step(state, batch_stats, dev_batch)
-    float(loss)
-    dt = time.perf_counter() - t0
+    # fallback: the classic 3x5.7 GF/img training estimate
     return dict(
-        examples=b, dt=dt, loss=float(loss), flops_fallback=flops
+        examples=b, dt=dt, loss=loss, flops_fallback=flops or 3 * 5.7e9 * b
     )
 
 

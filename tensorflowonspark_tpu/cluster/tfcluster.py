@@ -339,7 +339,7 @@ class TFCluster:
                         state = str(mgr.get("state"))
                         if state in ("terminating", "finished", "error"):
                             terminated[i] = True
-                    except (ConnectionError, OSError):
+                    except (ConnectionError, OSError, EOFError):
                         terminated[i] = True
 
         n_parts = 0
@@ -492,7 +492,7 @@ class TFCluster:
                 tfnode_runtime.shutdown_node(
                     node_meta, queues=feed_queues if is_worker else ()
                 )
-            except (ConnectionError, OSError) as e:
+            except (ConnectionError, OSError, EOFError) as e:
                 logger.warning(
                     "could not signal node %s: %s", node_meta["executor_id"], e
                 )
@@ -528,7 +528,7 @@ class TFCluster:
         for node_meta in self.cluster_info:
             try:
                 errors.extend(tfnode_runtime.drain_errors(node_meta))
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError, EOFError):
                 pass  # node already gone; exitcode check will catch it
         return errors
 
@@ -682,6 +682,73 @@ def run(
 # Reference-compat: the reference exposes `TFCluster.run(...)` as a module
 # function; callers importing our class get the same spelling.
 TFCluster.run = staticmethod(run)
+
+
+def run_with_restarts(
+    map_fun: Callable,
+    tf_args: Any,
+    num_executors: int,
+    max_restarts: int = 2,
+    launcher_factory: Callable[[], Any] | None = None,
+    shutdown_timeout: float = 259200.0,
+    **run_kwargs,
+) -> int:
+    """Supervised whole-cluster auto-restart for ``InputMode.TENSORFLOW``
+    jobs; returns the number of restarts that were needed.
+
+    The reference had no elasticity — its recovery story was "Spark
+    retries the job; TF restores from checkpoint" (SURVEY.md §5.3). This
+    is that story made first-class on the TPU side: run the cluster, and
+    if any node dies or ferries an exception, tear the whole cluster
+    down, relaunch it (fresh reservation round), and let the user's
+    ``map_fun`` resume from its latest orbax checkpoint — the resume
+    convention the examples already follow (``CheckpointManager.
+    latest_step()`` + restore at startup, e.g. ``examples/llama/
+    llama_fsdp.py``). After ``max_restarts`` failed attempts the last
+    error propagates.
+
+    Only ``InputMode.TENSORFLOW`` is supervisable: a push feed's consumed
+    partitions cannot be replayed by the driver (``InputMode.SPARK`` is
+    rejected). Pass ``launcher_factory`` (not a launcher instance) so
+    each attempt gets a fresh launcher.
+    """
+    if run_kwargs.get("input_mode", InputMode.SPARK) != InputMode.TENSORFLOW:
+        raise ValueError(
+            "run_with_restarts requires input_mode=InputMode.TENSORFLOW "
+            "(a push feed's consumed partitions cannot be replayed)"
+        )
+    if "launcher" in run_kwargs:
+        raise ValueError(
+            "pass launcher_factory=callable, not launcher=: each restart "
+            "attempt needs a fresh launcher"
+        )
+    restarts = 0
+    while True:
+        try:
+            # run() failures (e.g. a node dying before its reservation)
+            # count against the restart budget too: startup flakiness is
+            # exactly what the supervisor exists for. run() cleans up its
+            # own launcher/server on the way out.
+            cluster = run(
+                map_fun,
+                tf_args,
+                num_executors,
+                launcher=launcher_factory() if launcher_factory else None,
+                **run_kwargs,
+            )
+            cluster.shutdown(timeout=shutdown_timeout)
+            return restarts
+        except RuntimeError as e:
+            restarts += 1
+            if restarts > max_restarts:
+                raise
+            logger.warning(
+                "cluster attempt failed (%s); restarting (%d/%d) — nodes "
+                "resume from their latest checkpoint",
+                e,
+                restarts,
+                max_restarts,
+            )
 
 
 def _abort_if_node_died(launcher, remaining: int) -> None:

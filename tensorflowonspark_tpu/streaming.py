@@ -116,9 +116,7 @@ class DStream:
 
     def countByWindow(self, num_batches: int) -> "DStream":
         """Record count over the window: one single-record partition."""
-        return self.window(num_batches)._derive(
-            lambda rdd: [[sum(len(p) for p in rdd)]]
-        )
+        return self.window(num_batches).count()
 
     def reduceByWindow(
         self, fn: Callable[[Any, Any], Any], num_batches: int
@@ -162,21 +160,39 @@ class DStream:
     ) -> RDD:
         """Evaluate this node for one tick. ``memo`` (id(node) -> RDD)
         makes every node evaluate at most once per tick — required for
-        correctness of stateful window ops shared by several outputs."""
+        correctness of stateful window ops shared by several outputs.
+        Iterative post-order walk: arbitrarily long transformation
+        chains must not hit the Python recursion limit."""
         if memo is None:
             memo = {}
         if self._op is None:
             return source_rdd
-        key = id(self)
-        if key in memo:
-            return memo[key]
-        a = self._parent._materialize(source_rdd, memo)
-        if self._parent2 is not None:
-            out = self._op(a, self._parent2._materialize(source_rdd, memo))
-        else:
-            out = self._op(a)
-        memo[key] = out
-        return out
+
+        def value_of(node: "DStream") -> RDD:
+            return source_rdd if node._op is None else memo[id(node)]
+
+        stack: list[DStream] = [self]
+        while stack:
+            node = stack[-1]
+            if node._op is None or id(node) in memo:
+                stack.pop()
+                continue
+            pending = [
+                p
+                for p in (node._parent, node._parent2)
+                if p is not None and p._op is not None and id(p) not in memo
+            ]
+            if pending:
+                stack.extend(pending)
+                continue
+            stack.pop()
+            if node._parent2 is not None:
+                memo[id(node)] = node._op(
+                    value_of(node._parent), value_of(node._parent2)
+                )
+            else:
+                memo[id(node)] = node._op(value_of(node._parent))
+        return memo[id(self)]
 
     def _source(self) -> "DStream":
         node = self

@@ -337,3 +337,23 @@ def distributed_spark_train_fn(args, ctx):
         os.path.join(args["out_dir"], f"node{ctx.executor_id}.json"), "w"
     ) as f:
         json.dump(out, f)
+
+
+def flaky_checkpoint_fn(args, ctx):
+    """TENSORFLOW-mode map_fun for the supervised-restart test: node 0
+    crashes hard on its first attempt (before 'checkpointing' progress),
+    then every node completes on the retry — the whole-cluster restart +
+    resume-from-checkpoint convention (SURVEY.md §5.3)."""
+    d = args["dir"]
+    attempt_file = os.path.join(d, f"attempts{ctx.executor_id}")
+    n = int(open(attempt_file).read()) if os.path.exists(attempt_file) else 0
+    with open(attempt_file, "w") as f:
+        f.write(str(n + 1))
+    if ctx.executor_id == 0 and n == 0:
+        os._exit(5)  # simulated node crash; no cleanup, like a real one
+    with open(os.path.join(d, f"done{ctx.executor_id}"), "w") as f:
+        f.write("ok")
+
+
+def always_crash_fn(args, ctx):
+    os._exit(7)

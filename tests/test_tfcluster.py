@@ -310,3 +310,43 @@ def test_shm_ring_oversized_chunks(tmp_path):
     total, count = open(tmp_path / "node0.txt").read().split()
     assert int(count) == 40
     assert int(total) == 40 * 200_000
+
+
+def test_run_with_restarts_resumes_after_node_crash(tmp_path):
+    """Node 0 dies on attempt 1; the supervisor relaunches the whole
+    cluster and attempt 2 completes on every node."""
+    restarts = tfcluster.run_with_restarts(
+        cluster_fns.flaky_checkpoint_fn,
+        {"dir": str(tmp_path)},
+        num_executors=2,
+        input_mode=InputMode.TENSORFLOW,
+        max_restarts=2,
+        reservation_timeout=120,
+        shutdown_timeout=120,
+        env=NODE_ENV,
+    )
+    assert restarts == 1
+    assert (tmp_path / "done0").exists() and (tmp_path / "done1").exists()
+    # node 0 ran twice, node 1's attempt count depends on how far it got
+    assert (tmp_path / "attempts0").read_text() == "2"
+
+
+def test_run_with_restarts_exhausts(tmp_path):
+    with pytest.raises(RuntimeError, match="exited nonzero"):
+        tfcluster.run_with_restarts(
+            cluster_fns.always_crash_fn,
+            {},
+            num_executors=1,
+            input_mode=InputMode.TENSORFLOW,
+            max_restarts=1,
+            reservation_timeout=120,
+            shutdown_timeout=120,
+            env=NODE_ENV,
+        )
+
+
+def test_run_with_restarts_rejects_spark_mode():
+    with pytest.raises(ValueError, match="TENSORFLOW"):
+        tfcluster.run_with_restarts(
+            cluster_fns.sum_fn, {}, num_executors=1, max_restarts=1
+        )
