@@ -195,6 +195,9 @@ def main_fun(args, ctx):
                 state.params,
                 jax.numpy.asarray(prompt),
                 max_new_tokens=args.generate,
+                temperature=args.temperature,
+                top_k=args.top_k,
+                top_p=args.top_p,
             )
         jax.block_until_ready(out)
         dt = time.time() - t0
@@ -248,6 +251,9 @@ def parse_args(argv=None):
         default=0,
         help="after training, decode N tokens via the KV cache (chief)",
     )
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
     p.add_argument(
         "--peak-tflops", type=float, default=275.0, help="per-chip bf16 peak"
     )

@@ -28,8 +28,15 @@ def _index_file(path: str) -> list[tuple[int, int]]:
 
     Each 12-byte header's length-crc is verified, so a corrupted length
     field fails here instead of mis-framing every later record into
-    garbage rows. Payload bytes are genuinely skipped (unbuffered reads).
+    garbage rows. Payload bytes are genuinely skipped.
+
+    Fast path: the native scanner (``tfrecord.cc:tfr_index_file`` —
+    hardware crc32c, one buffered pass) when the C++ library is built;
+    the pure-Python scan below is the fallback (unbuffered header reads).
     """
+    native = _index_file_native(path)
+    if native is not None:
+        return native
     from tensorflowonspark_tpu.native.tfrecord import _py_masked_crc
 
     out: list[tuple[int, int]] = []
@@ -59,6 +66,31 @@ def _index_file(path: str) -> list[tuple[int, int]]:
                 f"({size - pos} trailing bytes, less than a record header)"
             )
     return out
+
+
+def _index_file_native(path: str) -> list[tuple[int, int]] | None:
+    """Native index scan; None when the C++ library is unavailable."""
+    import ctypes
+
+    from tensorflowonspark_tpu.native import load_library
+    from tensorflowonspark_tpu.native.tfrecord import _ERRORS
+
+    lib = load_library()
+    if lib is None:
+        return None
+    out = ctypes.POINTER(ctypes.c_uint64)()
+    n = lib.tfr_index_file(path.encode(), ctypes.byref(out))
+    if n < 0:
+        raise ValueError(f"{path}: {_ERRORS.get(n, f'index error {n}')}")
+    if n == 0:
+        return []
+    try:
+        import numpy as np
+
+        flat = np.ctypeslib.as_array(out, shape=(2 * n,)).copy()
+    finally:
+        lib.tfr_index_free(out)
+    return list(zip(flat[0::2].tolist(), flat[1::2].tolist()))
 
 
 class TFRecordDataSource:

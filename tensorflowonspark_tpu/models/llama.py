@@ -370,6 +370,8 @@ def generate(
     prompt: jax.Array,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
     rng: jax.Array | None = None,
 ) -> jax.Array:
     """Autoregressive sampling with a KV cache: (B, S) -> (B, max_new_tokens).
@@ -378,8 +380,11 @@ def generate(
     steps against the per-layer caches — static shapes throughout, so the
     whole loop is one compilation (cached across calls with the same model
     and shapes). ``temperature=0`` is greedy argmax; otherwise tokens are
-    sampled from ``logits / temperature``. The prompt must be unpadded
-    (all rows the same true length).
+    sampled from ``logits / temperature``, optionally truncated to the
+    ``top_k`` most likely tokens and/or the smallest nucleus with
+    cumulative probability ``top_p`` (top-k applies first, like the
+    standard decoding stacks). The prompt must be unpadded (all rows the
+    same true length).
     """
     cfg = model.cfg
     b, s = prompt.shape
@@ -388,16 +393,40 @@ def generate(
             f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"max_seq_len ({cfg.max_seq_len}); the KV cache cannot hold it"
         )
+    if top_k is not None and top_k < 1:
+        raise ValueError("top_k must be >= 1")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError("top_p must be in (0, 1]")
+    if temperature == 0.0 and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p require temperature > 0 (temperature=0 is greedy "
+            "argmax, which would silently ignore them)"
+        )
     rng = jax.random.PRNGKey(0) if rng is None else rng
-    run = _build_generate(model, b, s, max_new_tokens, float(temperature))
+    run = _build_generate(
+        model,
+        b,
+        s,
+        max_new_tokens,
+        float(temperature),
+        None if top_k is None else int(top_k),
+        None if top_p is None else float(top_p),
+    )
     return run(params, prompt, rng)
 
 
 @functools.lru_cache(maxsize=32)
 def _build_generate(
-    model: "Llama", b: int, s: int, max_new_tokens: int, temperature: float
+    model: "Llama",
+    b: int,
+    s: int,
+    max_new_tokens: int,
+    temperature: float,
+    top_k: int | None = None,
+    top_p: float | None = None,
 ):
-    """Compile-once generate body per (model config, shapes, temperature).
+    """Compile-once generate body per (model config, shapes, sampling
+    params).
 
     flax Modules hash by their dataclass fields, so two ``Llama`` instances
     with equal configs share the cache entry; a per-call ``jax.jit`` would
@@ -407,9 +436,35 @@ def _build_generate(
     def sample(logits, key):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits / temperature).astype(
-            jnp.int32
-        )
+        logits = logits / temperature
+        vocab = logits.shape[-1]
+        k_active = top_k is not None and top_k < vocab
+        p_active = top_p is not None and top_p < 1.0
+        if k_active or p_active:
+            # one descending sort serves both filters (this runs inside
+            # the scanned single-token decode loop)
+            sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+            if k_active:
+                kth = sorted_desc[..., top_k - 1, None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+                # the top-k mask in sorted order: positions >= k drop out
+                sorted_desc = jnp.where(
+                    jnp.arange(vocab) >= top_k, -jnp.inf, sorted_desc
+                )
+            if p_active:
+                cum = jnp.cumsum(
+                    jax.nn.softmax(sorted_desc, axis=-1), axis=-1
+                )
+                # index of the last kept token: everything before the
+                # point where cumulative mass reaches top_p, and always
+                # >= 0 (the most likely token survives even when it
+                # alone exceeds p)
+                cutoff_index = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+                cutoff_logit = jnp.take_along_axis(
+                    sorted_desc, cutoff_index, axis=-1
+                )
+                logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
+        return jax.random.categorical(key, logits).astype(jnp.int32)
 
     @jax.jit
     def run(params, prompt, rng):

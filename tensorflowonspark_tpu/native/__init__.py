@@ -125,6 +125,13 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.tfr_reader_close.argtypes = [c.c_void_p]
     lib.tfr_masked_crc32c.restype = u32
     lib.tfr_masked_crc32c.argtypes = [c.c_char_p, u64]
+    lib.tfr_index_file.restype = i64
+    lib.tfr_index_file.argtypes = [
+        c.c_char_p,
+        c.POINTER(c.POINTER(c.c_uint64)),
+    ]
+    lib.tfr_index_free.restype = None
+    lib.tfr_index_free.argtypes = [c.POINTER(c.c_uint64)]
     # shmring
     lib.shmring_create.restype = c.c_void_p
     lib.shmring_create.argtypes = [c.c_char_p, u64]

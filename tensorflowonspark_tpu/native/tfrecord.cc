@@ -119,4 +119,62 @@ uint32_t tfr_masked_crc32c(const uint8_t* data, uint64_t len) {
   return masked_crc32c(data, len);
 }
 
+// Builds a byte-offset index for random access (the grain data-source
+// path): scans the file reading only the 12-byte headers, verifying each
+// length-crc, and skipping payloads with fseeko. On success returns the
+// record count and sets *out to a malloc'd array of 2*count uint64
+// values interleaved as (payload_offset, payload_len); the caller frees
+// it with tfr_index_free. Negative error codes as in the reader.
+int64_t tfr_index_file(const char* path, uint64_t** out) {
+  *out = nullptr;
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return kErrIo;
+  std::vector<uint64_t> entries;
+  uint64_t pos = 0;
+  int64_t rc = 0;
+  for (;;) {
+    uint8_t header[12];
+    size_t got = std::fread(header, 1, 12, f);
+    if (got == 0 && std::feof(f)) break;  // clean EOF at a boundary
+    if (got != 12) {
+      rc = kErrTruncated;
+      break;
+    }
+    uint64_t len;
+    uint32_t len_crc;
+    std::memcpy(&len, header, 8);
+    std::memcpy(&len_crc, header + 8, 4);
+    if (masked_crc32c(header, 8) != len_crc) {
+      rc = kErrCorruptHeader;
+      break;
+    }
+    entries.push_back(pos + 12);
+    entries.push_back(len);
+    if (fseeko(f, static_cast<off_t>(len) + 4, SEEK_CUR) != 0) {
+      rc = kErrIo;
+      break;
+    }
+    pos += 12 + len + 4;
+  }
+  if (rc == 0) {
+    // fseeko past EOF succeeds silently, so a truncated final record is
+    // caught here: the walk must end exactly at the file size.
+    if (fseeko(f, 0, SEEK_END) != 0 ||
+        static_cast<uint64_t>(ftello(f)) != pos) {
+      rc = kErrTruncated;
+    }
+  }
+  std::fclose(f);
+  if (rc != 0) return rc;
+  uint64_t* arr = static_cast<uint64_t*>(
+      std::malloc(entries.size() * sizeof(uint64_t)));
+  if (!arr && !entries.empty()) return kErrIo;
+  if (!entries.empty())
+    std::memcpy(arr, entries.data(), entries.size() * sizeof(uint64_t));
+  *out = arr;
+  return static_cast<int64_t>(entries.size() / 2);
+}
+
+void tfr_index_free(uint64_t* p) { std::free(p); }
+
 }  // extern "C"

@@ -204,6 +204,40 @@ def test_llama_grad_and_loss(tiny_llama):
     assert any(n > 0 for n in norms)
 
 
+def test_llama_generate_topk_topp(tiny_llama):
+    """top_k=1 and a vanishing nucleus must both reduce to greedy; bad
+    sampling params are rejected before compilation."""
+    from tensorflowonspark_tpu.models.llama import generate
+
+    cfg, model, params = tiny_llama
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(5), (2, 8), 0, cfg.vocab_size
+    )
+    greedy = generate(model, params, prompt, 6, temperature=0.0)
+    k1 = generate(model, params, prompt, 6, temperature=1.0, top_k=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+    p_tiny = generate(model, params, prompt, 6, temperature=1.0, top_p=1e-9)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(p_tiny))
+    # sampled path stays in-vocab and respects the rng
+    s1 = generate(
+        model, params, prompt, 6, temperature=1.0, top_k=5, top_p=0.9,
+        rng=jax.random.PRNGKey(1),
+    )
+    s2 = generate(
+        model, params, prompt, 6, temperature=1.0, top_k=5, top_p=0.9,
+        rng=jax.random.PRNGKey(1),
+    )
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    assert int(np.asarray(s1).min()) >= 0
+    assert int(np.asarray(s1).max()) < cfg.vocab_size
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, 2, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, 2, top_p=1.5)
+    with pytest.raises(ValueError, match="temperature"):
+        generate(model, params, prompt, 2, top_k=5)  # greedy + top_k
+
+
 def test_llama_chunked_loss_matches_full(tiny_llama):
     """logit_chunk CE (no materialized (B,S,V) logits) must reproduce the
     full-logits loss and its gradients."""

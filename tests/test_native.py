@@ -146,3 +146,45 @@ def test_shmring_stale_segment_recreated(lib):
     b.close()
     a._owner = False  # the old handle must not unlink the new segment
     a.close()
+
+
+def test_index_file_native_matches_python(lib, tmp_path):
+    from tensorflowonspark_tpu.data import grain_source
+
+    p = str(tmp_path / "idx.tfrecord")
+    with ntfr.TFRecordWriter(p) as w:
+        for r in RECORDS:
+            w.write(r)
+    native_idx = grain_source._index_file_native(p)
+    assert native_idx is not None
+    # force the pure-Python scan for comparison
+    import unittest.mock as mock
+
+    with mock.patch.object(
+        grain_source, "_index_file_native", return_value=None
+    ):
+        py_idx = grain_source._index_file(p)
+    assert native_idx == py_idx
+    assert [n for _, n in native_idx] == [len(r) for r in RECORDS]
+
+
+def test_index_file_native_detects_corruption(lib, tmp_path):
+    from tensorflowonspark_tpu.data import grain_source
+
+    p = str(tmp_path / "bad.tfrecord")
+    with ntfr.TFRecordWriter(p) as w:
+        w.write(b"payload-one")
+        w.write(b"payload-two")
+    raw = bytearray(open(p, "rb").read())
+
+    truncated = str(tmp_path / "trunc.tfrecord")
+    open(truncated, "wb").write(raw[:-3])
+    with pytest.raises(ValueError, match="truncated"):
+        grain_source._index_file_native(truncated)
+
+    corrupt = str(tmp_path / "corrupt.tfrecord")
+    flipped = bytearray(raw)
+    flipped[0] ^= 0xFF  # corrupt the first record's length field
+    open(corrupt, "wb").write(flipped)
+    with pytest.raises(ValueError, match="corrupt"):
+        grain_source._index_file_native(corrupt)
