@@ -127,7 +127,10 @@ def main_fun(args, ctx):
 
     ckpt = None
     if args.model_dir:
-        ckpt = CheckpointManager(ctx.absolute_path(args.model_dir))
+        ckpt = CheckpointManager(
+            ctx.absolute_path(args.model_dir),
+            save_interval_steps=args.save_every or 1,
+        )
         latest = ckpt.latest_step()
         if latest is not None and ctx.is_chief:
             print(f"resuming from step {latest}")
@@ -153,6 +156,10 @@ def main_fun(args, ctx):
                     f"node{ctx.executor_id} step {i + 1} "
                     f"loss {float(loss):.4f}"
                 )
+            if ckpt is not None and ctx.is_chief and args.save_every:
+                # async save overlapped with the next steps; the manager's
+                # save_interval policy decides which steps actually land
+                ckpt.save(int(state.step), state)
         jax.block_until_ready(loss)
     dt = time.time() - t0
 
@@ -174,7 +181,13 @@ def main_fun(args, ctx):
         # single-controller process, so concurrent saves to the same orbax
         # directory would race on the step-dir commit.
         if ctx.is_chief:
-            ckpt.save(int(state.step), state)
+            # force: the end-of-training state must land even when the
+            # last step falls off the --save-every interval. wait() first:
+            # async mid-loop saves may still be landing, and orbax rejects
+            # a forced re-save of an already-existing step.
+            ckpt.wait()
+            if ckpt.latest_step() != int(state.step):
+                ckpt.save(int(state.step), state, force=True)
             print(f"checkpointed step {int(state.step)} to {args.model_dir}")
         ckpt.close()
 
@@ -246,6 +259,12 @@ def parse_args(argv=None):
     )
     p.add_argument("--model-dir", default=None)
     p.add_argument(
+        "--save-every",
+        type=int,
+        default=0,
+        help="mid-training checkpoint interval in steps (0: only at end)",
+    )
+    p.add_argument(
         "--generate",
         type=int,
         default=0,
@@ -266,7 +285,13 @@ def parse_args(argv=None):
         help="attention impl when not sequence-parallel",
     )
     p.add_argument("--cpu", action="store_true")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if (args.top_k is not None or args.top_p is not None) and (
+        args.temperature == 0.0
+    ):
+        # fail at parse time, not after the whole training run
+        p.error("--top-k/--top-p require --temperature > 0")
+    return args
 
 
 if __name__ == "__main__":

@@ -50,15 +50,52 @@ class CheckpointManager:
     part of the MFU recipe (SURVEY.md §7 "hard parts").
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = True):
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        async_save: bool = True,
+        save_interval_steps: int = 1,
+        keep_best_metric: str | None = None,
+        keep_best_mode: str = "min",
+    ):
+        """``save_interval_steps``: calls to :meth:`save` off the interval
+        are no-ops returning False (callers can save unconditionally every
+        step and let the policy decide). ``keep_best_metric``: retain the
+        ``max_to_keep`` checkpoints with the best value of that key in the
+        metrics dict passed to :meth:`save` (``keep_best_mode`` 'min' for
+        losses, 'max' for accuracies) instead of the most recent ones.
+        """
         self.directory = _abs(directory)
+        if keep_best_mode not in ("min", "max"):
+            raise ValueError("keep_best_mode must be 'min' or 'max'")
+        best: dict[str, Any] = {}
+        if keep_best_metric is not None:
+            best = dict(
+                best_fn=lambda metrics: metrics[keep_best_metric],
+                best_mode=keep_best_mode,
+            )
         options = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep, enable_async_checkpointing=async_save
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=async_save,
+            save_interval_steps=save_interval_steps,
+            **best,
         )
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
 
-    def save(self, step: int, state: Any) -> bool:
-        return self._mgr.save(step, args=ocp.args.StandardSave(state))
+    def save(
+        self,
+        step: int,
+        state: Any,
+        metrics: dict[str, Any] | None = None,
+        force: bool = False,
+    ) -> bool:
+        """``force=True`` bypasses the save-interval policy (use for the
+        end-of-training save, which must land regardless of interval)."""
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(state), metrics=metrics,
+            force=force,
+        )
 
     def restore(self, step: int | None = None, target: Any | None = None) -> Any:
         step = self.latest_step() if step is None else step

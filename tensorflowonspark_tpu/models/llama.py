@@ -440,30 +440,27 @@ def _build_generate(
         vocab = logits.shape[-1]
         k_active = top_k is not None and top_k < vocab
         p_active = top_p is not None and top_p < 1.0
-        if k_active or p_active:
-            # one descending sort serves both filters (this runs inside
-            # the scanned single-token decode loop)
+        if k_active:
+            # lax.top_k beats a full-vocab sort inside the scanned
+            # single-token decode loop; when top_p is also set, the
+            # nucleus scan then runs on k values instead of the vocab
+            sorted_desc = jax.lax.top_k(logits, top_k)[0]
+            logits = jnp.where(
+                logits < sorted_desc[..., -1, None], -jnp.inf, logits
+            )
+        elif p_active:
             sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
-            if k_active:
-                kth = sorted_desc[..., top_k - 1, None]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-                # the top-k mask in sorted order: positions >= k drop out
-                sorted_desc = jnp.where(
-                    jnp.arange(vocab) >= top_k, -jnp.inf, sorted_desc
-                )
-            if p_active:
-                cum = jnp.cumsum(
-                    jax.nn.softmax(sorted_desc, axis=-1), axis=-1
-                )
-                # index of the last kept token: everything before the
-                # point where cumulative mass reaches top_p, and always
-                # >= 0 (the most likely token survives even when it
-                # alone exceeds p)
-                cutoff_index = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-                cutoff_logit = jnp.take_along_axis(
-                    sorted_desc, cutoff_index, axis=-1
-                )
-                logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
+        if p_active:
+            cum = jnp.cumsum(jax.nn.softmax(sorted_desc, axis=-1), axis=-1)
+            # index of the last kept token: everything before the point
+            # where cumulative mass reaches top_p, and always >= 0 (the
+            # most likely token survives even when it alone exceeds p;
+            # an index == k clamps to the last top-k entry = keep all)
+            cutoff_index = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+            cutoff_logit = jnp.take_along_axis(
+                sorted_desc, cutoff_index, axis=-1
+            )
+            logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
         return jax.random.categorical(key, logits).astype(jnp.int32)
 
     @jax.jit

@@ -166,11 +166,11 @@ int64_t tfr_index_file(const char* path, uint64_t** out) {
   }
   std::fclose(f);
   if (rc != 0) return rc;
+  if (entries.empty()) return 0;  // *out stays nullptr: nothing to free
   uint64_t* arr = static_cast<uint64_t*>(
       std::malloc(entries.size() * sizeof(uint64_t)));
-  if (!arr && !entries.empty()) return kErrIo;
-  if (!entries.empty())
-    std::memcpy(arr, entries.data(), entries.size() * sizeof(uint64_t));
+  if (!arr) return kErrIo;
+  std::memcpy(arr, entries.data(), entries.size() * sizeof(uint64_t));
   *out = arr;
   return static_cast<int64_t>(entries.size() / 2);
 }

@@ -149,3 +149,38 @@ def test_checkpoint_manager(tmp_path):
         assert mgr.latest_step() == 3
         restored = mgr.restore(3, target=state)
         np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(4.0) * 3)
+
+
+def test_checkpoint_manager_save_interval(tmp_path):
+    from tensorflowonspark_tpu.compute.checkpoint import CheckpointManager
+
+    with CheckpointManager(
+        str(tmp_path / "mgr"), save_interval_steps=5, async_save=False
+    ) as mgr:
+        results = [mgr.save(s, {"w": jnp.ones(2) * s}) for s in range(11)]
+        mgr.wait()
+        # only steps 0, 5, 10 land; off-interval saves are no-ops
+        assert [s for s, r in enumerate(results) if r] == [0, 5, 10]
+        assert mgr.latest_step() == 10
+
+
+def test_checkpoint_manager_keep_best(tmp_path):
+    from tensorflowonspark_tpu.compute.checkpoint import CheckpointManager
+
+    losses = {1: 3.0, 2: 1.0, 3: 2.0, 4: 5.0}
+    with CheckpointManager(
+        str(tmp_path / "mgr"),
+        max_to_keep=2,
+        keep_best_metric="loss",
+        async_save=False,
+    ) as mgr:
+        for step, loss in losses.items():
+            mgr.save(step, {"w": jnp.ones(2) * step}, metrics={"loss": loss})
+        mgr.wait()
+        kept = sorted(mgr._mgr.all_steps())
+        assert kept == [2, 3]  # the two lowest-loss checkpoints survive
+
+    import pytest
+
+    with pytest.raises(ValueError, match="keep_best_mode"):
+        CheckpointManager(str(tmp_path / "bad"), keep_best_mode="sideways")
