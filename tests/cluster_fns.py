@@ -357,3 +357,65 @@ def flaky_checkpoint_fn(args, ctx):
 
 def always_crash_fn(args, ctx):
     os._exit(7)
+
+
+def distributed_llama_fsdp_fn(args, ctx):
+    """Multi-controller FSDP: a tiny Llama's params and optimizer state
+    sharded over ALL processes' devices (the fsdp axis spans the process
+    boundary, where a pod's DCN/ICI would sit), gradients synced by the
+    jit-inserted collectives. Every process must observe identical losses."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.compute import TrainState, build_train_step, optim
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.models.llama import (
+        Llama,
+        LlamaConfig,
+        llama_loss_fn,
+        llama_param_shardings,
+    )
+    from tensorflowonspark_tpu.parallel import use_mesh
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=False, attention_impl="xla")
+    model = Llama(cfg)
+    mesh = make_mesh({"fsdp": len(jax.devices())})  # spans both processes
+    seq, global_batch = 16, 8
+    tokens0 = np.zeros((2, seq + 1), np.int32)
+    with use_mesh(mesh):
+        params = model.init(jax.random.PRNGKey(0), tokens0[:, :-1])["params"]
+    psh = llama_param_shardings(params, mesh)
+    params = jax.tree.map(jax.device_put, params, psh)
+    tx = optim.adamw(1e-2, moment_dtype=jnp.bfloat16)
+    state = TrainState.create(params, tx)
+    token_loss = llama_loss_fn(model, logit_chunk=8)
+    step = build_train_step(
+        lambda p, b: token_loss(p, b["tokens"]), tx, mesh, param_shardings=psh
+    )
+
+    # deterministic GLOBAL batch; each process feeds its local slice
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, size=(global_batch, seq + 1)).astype(
+        np.int32
+    )
+    n_local = global_batch // ctx.num_workers
+    lo = ctx.executor_id * n_local
+    local = {"tokens": toks[lo : lo + n_local]}
+
+    losses = []
+    with use_mesh(mesh):
+        for _ in range(4):
+            state, loss = step(state, shard_batch(mesh, local))
+            losses.append(float(loss))
+    out = {
+        "losses": losses,
+        "global_devices": len(jax.devices()),
+        "process_count": jax.process_count(),
+    }
+    with open(
+        os.path.join(args["out_dir"], f"node{ctx.executor_id}.json"), "w"
+    ) as f:
+        json.dump(out, f)
