@@ -1,0 +1,148 @@
+"""HTTP inference server over an AOT export artifact.
+
+Extends the no-user-code inference surface (reference parity: the Scala
+``TFModel`` batch API — SURVEY.md §2.2 — covered for batch by
+``tools/run_model``) to an online endpoint: load the artifact once, then
+serve JSON predictions. stdlib-only (``http.server``), threaded, one
+model instance shared across requests (jit-compiled call is thread-safe
+to invoke).
+
+Endpoints::
+
+    GET  /healthz            -> {"status": "ok", "export_dir": ...}
+    GET  /signature          -> the artifact's signature metadata
+    POST /predict            -> body {"rows": [<row>, ...]}
+                                (rows as dicts per input_mapping, or raw
+                                arrays for single-input models)
+                                -> {"predictions": [...]}
+
+Usage::
+
+    python -m tensorflowonspark_tpu.tools.serve_model \
+        --export-dir /models/mnist [--port 8500] [--batch-size 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from tensorflowonspark_tpu.tools.run_model import _to_jsonable
+
+logger = logging.getLogger(__name__)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # set by make_server():
+    model: Any = None
+    export_dir: str = ""
+    batch_size: int = 64
+    # per-server lock (set in make_server): serializes jax dispatch on
+    # one model while the HTTP layer stays threaded, so health checks
+    # never queue behind a big batch
+    predict_lock: threading.Lock
+
+    def log_message(self, fmt, *fargs):  # route to logging, not stderr
+        logger.info("%s " + fmt, self.client_address[0], *fargs)
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        if self.path == "/healthz":
+            self._reply(200, {"status": "ok", "export_dir": self.export_dir})
+        elif self.path == "/signature":
+            self._reply(200, self.model.meta)
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server API
+        if self.path != "/predict":
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            rows = payload["rows"]
+            if not isinstance(rows, list) or not rows:
+                raise ValueError("'rows' must be a non-empty list")
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        try:
+            with self.predict_lock:
+                preds = self.model.transform(
+                    rows, batch_size=self.batch_size
+                )
+            self._reply(
+                200, {"predictions": [_to_jsonable(p) for p in preds]}
+            )
+        except Exception as e:  # noqa: BLE001 - ferried to the client
+            logger.exception("prediction failed")
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+def make_server(
+    export_dir: str,
+    port: int = 8500,
+    batch_size: int = 64,
+    host: str = "127.0.0.1",
+) -> ThreadingHTTPServer:
+    """Load the artifact and return a ready (unstarted) HTTP server;
+    callers drive ``serve_forever``/``shutdown`` (tests bind port 0).
+    Binds localhost by default — the endpoint is unauthenticated, so
+    exposing it (``host='0.0.0.0'``) is an explicit operator choice."""
+    from tensorflowonspark_tpu.api.export import load_model
+
+    handler = type(
+        "_BoundHandler",
+        (_Handler,),
+        {
+            "model": load_model(export_dir),
+            "export_dir": export_dir,
+            "batch_size": batch_size,
+            "predict_lock": threading.Lock(),  # per-server, not shared
+        },
+    )
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="serve_model", description="HTTP inference over an AOT export"
+    )
+    p.add_argument("--export-dir", required=True)
+    p.add_argument("--port", type=int, default=8500)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument(
+        "--host",
+        default="127.0.0.1",
+        help="bind address (unauthenticated endpoint: exposing beyond "
+        "localhost is an explicit choice)",
+    )
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = make_server(
+        args.export_dir, args.port, args.batch_size, host=args.host
+    )
+    logger.info(
+        "serving %s on :%d", args.export_dir, server.server_address[1]
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

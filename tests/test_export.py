@@ -153,3 +153,49 @@ def test_run_model_cli_tfrecord(dict_artifact, tmp_path):
     rows = list(dfutil.loadTFRecords(str(out_dir)))
     got = sorted(float(np.asarray(r["pred"]).reshape(())) for r in rows)
     np.testing.assert_allclose(got, [4.5, 10.5], rtol=1e-6)
+
+
+def test_serve_model_http(dict_artifact):
+    """The HTTP serving entry: health, signature, predictions, and error
+    paths against a live (ephemeral-port) server."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from tensorflowonspark_tpu.tools import serve_model
+
+    server = serve_model.make_server(dict_artifact, port=0, batch_size=8)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        health = json.load(urllib.request.urlopen(f"{base}/healthz"))
+        assert health["status"] == "ok"
+        sig = json.load(urllib.request.urlopen(f"{base}/signature"))
+        assert sig["input_mapping"] == {"x0": "x0", "x1": "x1"}
+
+        rows = [{"x0": 1.0, "x1": 2.0}, {"x0": 0.0, "x1": 0.0}]
+        req = urllib.request.Request(
+            f"{base}/predict",
+            data=json.dumps({"rows": rows}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        out = json.load(urllib.request.urlopen(req))
+        preds = out["predictions"]
+        # y = 2*x0 + 1*x1 + 0.5, surfaced under the output_mapping name
+        assert preds[0]["pred"] == pytest.approx(4.5)
+        assert preds[1]["pred"] == pytest.approx(0.5)
+
+        bad = urllib.request.Request(
+            f"{base}/predict", data=json.dumps({"rows": []}).encode()
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(bad)
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/nope")
+        assert e.value.code == 404
+    finally:
+        server.shutdown()
+        t.join(timeout=10)
