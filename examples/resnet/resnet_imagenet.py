@@ -100,6 +100,20 @@ def main_fun(args, ctx):
     state = TrainState.create(params, tx)
     loss_fn = resnet.loss_fn(model)
 
+    ckpt = None
+    if args.model_dir:
+        # every node opens the manager and restores (resume-from-latest,
+        # the run_with_restarts recovery convention); only the chief saves
+        ckpt = CheckpointManager(ctx.absolute_path(args.model_dir))
+        latest = ckpt.latest_step()
+        if latest is not None:
+            if ctx.is_chief:
+                print(f"resuming from step {latest}")
+            restored = ckpt.restore(
+                latest, target={"state": state, "batch_stats": batch_stats}
+            )
+            state, batch_stats = restored["state"], restored["batch_stats"]
+
     @jax.jit
     def step(state, batch_stats, batch):
         (l, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -115,11 +129,6 @@ def main_fun(args, ctx):
             l,
         )
 
-    ckpt = (
-        CheckpointManager(ctx.absolute_path(args.model_dir))
-        if args.model_dir and ctx.is_chief
-        else None
-    )
     batches = host_batches()
     # warmup/compile step excluded from timing
     state, batch_stats, l = step(state, batch_stats, shard_batch(mesh, next(batches)))
@@ -138,17 +147,20 @@ def main_fun(args, ctx):
         f"loss {float(l):.4f}"
     )
     if ckpt is not None:
-        # batch_stats must travel with the params: a restored BatchNorm
-        # model is unusable without its moving statistics.
-        ckpt.save(
-            int(state.step),
-            {
-                "params": jax.device_get(state.params),
-                "batch_stats": jax.device_get(batch_stats),
-            },
-        )
+        if ctx.is_chief:
+            # the FULL train state (params, optimizer, step) plus the BN
+            # batch_stats: a restored model is unusable without its moving
+            # statistics, and a resumed run without its optimizer state.
+            # Guard against re-saving a step a previous attempt already
+            # landed (orbax rejects that even with force).
+            ckpt.wait()
+            if ckpt.latest_step() != int(state.step):
+                ckpt.save(
+                    int(state.step),
+                    {"state": state, "batch_stats": batch_stats},
+                )
+            print(f"chief checkpointed to {args.model_dir}")
         ckpt.close()
-        print(f"chief checkpointed to {args.model_dir}")
 
 
 def parse_args(argv=None):
@@ -159,6 +171,13 @@ def parse_args(argv=None):
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--fsdp", type=int, default=1, help="fsdp axis size")
     p.add_argument("--tiny", action="store_true", help="tiny config (CI)")
+    p.add_argument(
+        "--max-restarts",
+        type=int,
+        default=0,
+        help="supervised whole-cluster auto-restart budget (nodes resume "
+        "from --model-dir's latest checkpoint; see run_with_restarts)",
+    )
     p.add_argument("--cpu", action="store_true")
     return p.parse_args(argv)
 
@@ -171,14 +190,29 @@ if __name__ == "__main__":
 
     args = parse_args()
     largs = cluster_args_from_env()
-    cluster = tfcluster.run(
-        main_fun,
-        args,
+    common = dict(
         num_executors=largs["num_executors"],
         input_mode=InputMode.TENSORFLOW,
         env=cpu_only_env() if args.cpu else None,
-        launcher=largs.get("launcher"),
         distributed=largs.get("distributed", False),
     )
-    cluster.shutdown()
+    if args.max_restarts:
+        restarts = tfcluster.run_with_restarts(
+            main_fun,
+            args,
+            max_restarts=args.max_restarts,
+            # each attempt needs a fresh launcher; the env-configured one
+            # (hosts: mode) is an instance, so rebuild it per attempt
+            launcher_factory=(
+                (lambda: cluster_args_from_env().get("launcher"))
+                if largs.get("launcher") is not None
+                else None
+            ),
+            **common,
+        )
+        if restarts:
+            print(f"recovered after {restarts} restart(s)")
+    else:
+        cluster = tfcluster.run(main_fun, args, launcher=largs.get("launcher"), **common)
+        cluster.shutdown()
     print("resnet_imagenet done")

@@ -83,12 +83,13 @@ class _Handler(BaseHTTPRequestHandler):
                 preds = self.model.transform(
                     rows, batch_size=self.batch_size
                 )
-            self._reply(
-                200, {"predictions": [_to_jsonable(p) for p in preds]}
-            )
         except Exception as e:  # noqa: BLE001 - ferried to the client
             logger.exception("prediction failed")
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        # outside the try: a client hanging up mid-response must not be
+        # logged as a prediction failure nor answered with a second reply
+        self._reply(200, {"predictions": [_to_jsonable(p) for p in preds]})
 
 
 def make_server(
