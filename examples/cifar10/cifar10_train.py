@@ -14,6 +14,10 @@ Usage::
 
 Without ``--data-dir`` (no ``data_batch_*.bin`` around), synthetic
 CIFAR-shaped data is used so the example runs anywhere.
+
+Checkpoint format: ``{'state': TrainState, 'batch_stats': ...}`` (full
+train state, resumable); directories written by the earlier params-only
+layout are rejected at startup with a clear error.
 """
 
 from __future__ import annotations
@@ -54,7 +58,11 @@ def main_fun(args, ctx):
     import optax
 
     from tensorflowonspark_tpu.compute import TrainState
-    from tensorflowonspark_tpu.compute.checkpoint import CheckpointManager
+    from tensorflowonspark_tpu.compute.checkpoint import (
+        CheckpointManager,
+        chief_final_save,
+        restore_latest,
+    )
     from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
     from tensorflowonspark_tpu.models import inception, zoo
 
@@ -125,6 +133,18 @@ def main_fun(args, ctx):
     tx = optax.sgd(args.lr, momentum=0.9)
     state = TrainState.create(params, tx)
 
+    ckpt = None
+    if args.model_dir:
+        # resume-from-latest on every node; only the chief saves
+        ckpt = CheckpointManager(ctx.absolute_path(args.model_dir))
+        latest, restored = restore_latest(
+            ckpt, {"state": state, "batch_stats": batch_stats}
+        )
+        if latest is not None:
+            if ctx.is_chief:
+                print(f"resuming from step {latest}")
+            state, batch_stats = restored["state"], restored["batch_stats"]
+
     @jax.jit
     def step(state, batch_stats, batch):
         (l, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -178,17 +198,15 @@ def main_fun(args, ctx):
                 total += args.batch_size
             print(f"test accuracy: {correct / total:.4f} ({total} examples)")
 
-    if args.model_dir and ctx.is_chief:
-        ckpt = CheckpointManager(ctx.absolute_path(args.model_dir))
-        ckpt.save(
+    if ckpt is not None:
+        chief_final_save(
+            ckpt,
+            {"state": state, "batch_stats": batch_stats},
             int(state.step),
-            {
-                "params": jax.device_get(state.params),
-                "batch_stats": jax.device_get(batch_stats),
-            },
+            ctx.is_chief,
         )
-        ckpt.close()
-        print(f"chief checkpointed to {args.model_dir}")
+        if ctx.is_chief:
+            print(f"chief checkpointed to {args.model_dir}")
 
 
 def parse_args(argv=None):

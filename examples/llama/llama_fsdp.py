@@ -65,7 +65,11 @@ def main_fun(args, ctx):
     import optax
 
     from tensorflowonspark_tpu.compute import TrainState, build_train_step
-    from tensorflowonspark_tpu.compute.checkpoint import CheckpointManager
+    from tensorflowonspark_tpu.compute.checkpoint import (
+        CheckpointManager,
+        chief_final_save,
+        restore_latest,
+    )
     from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
     from tensorflowonspark_tpu.models.llama import (
         Llama,
@@ -131,11 +135,11 @@ def main_fun(args, ctx):
             ctx.absolute_path(args.model_dir),
             save_interval_steps=args.save_every or 1,
         )
-        latest = ckpt.latest_step()
-        if latest is not None and ctx.is_chief:
-            print(f"resuming from step {latest}")
+        latest, restored = restore_latest(ckpt, state)
         if latest is not None:
-            state = ckpt.restore(latest, target=state)
+            if ctx.is_chief:
+                print(f"resuming from step {latest}")
+            state = restored
 
     def batch():
         return {
@@ -180,19 +184,12 @@ def main_fun(args, ctx):
         f"MFU {mfu * 100:.1f}%"
     )
     if ckpt is not None:
-        # Chief-only: with the local launcher every node is an independent
+        # Chief-only (with the local launcher every node is an independent
         # single-controller process, so concurrent saves to the same orbax
-        # directory would race on the step-dir commit.
+        # directory would race); forced past the --save-every interval.
+        chief_final_save(ckpt, state, int(state.step), ctx.is_chief)
         if ctx.is_chief:
-            # force: the end-of-training state must land even when the
-            # last step falls off the --save-every interval. wait() first:
-            # async mid-loop saves may still be landing, and orbax rejects
-            # a forced re-save of an already-existing step.
-            ckpt.wait()
-            if ckpt.latest_step() != int(state.step):
-                ckpt.save(int(state.step), state, force=True)
             print(f"checkpointed step {int(state.step)} to {args.model_dir}")
-        ckpt.close()
 
     if args.generate:
         from tensorflowonspark_tpu.models.llama import generate

@@ -32,7 +32,11 @@ def main_fun(args, ctx):
     import optax
 
     from tensorflowonspark_tpu.compute import TrainState
-    from tensorflowonspark_tpu.compute.checkpoint import CheckpointManager
+    from tensorflowonspark_tpu.compute.checkpoint import (
+        CheckpointManager,
+        chief_final_save,
+        restore_latest,
+    )
     from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
     from tensorflowonspark_tpu.models import resnet
 
@@ -105,13 +109,12 @@ def main_fun(args, ctx):
         # every node opens the manager and restores (resume-from-latest,
         # the run_with_restarts recovery convention); only the chief saves
         ckpt = CheckpointManager(ctx.absolute_path(args.model_dir))
-        latest = ckpt.latest_step()
+        latest, restored = restore_latest(
+            ckpt, {"state": state, "batch_stats": batch_stats}
+        )
         if latest is not None:
             if ctx.is_chief:
                 print(f"resuming from step {latest}")
-            restored = ckpt.restore(
-                latest, target={"state": state, "batch_stats": batch_stats}
-            )
             state, batch_stats = restored["state"], restored["batch_stats"]
 
     @jax.jit
@@ -147,20 +150,17 @@ def main_fun(args, ctx):
         f"loss {float(l):.4f}"
     )
     if ckpt is not None:
+        # the FULL train state (params, optimizer, step) plus the BN
+        # batch_stats: a restored model is unusable without its moving
+        # statistics, and a resumed run without its optimizer state
+        chief_final_save(
+            ckpt,
+            {"state": state, "batch_stats": batch_stats},
+            int(state.step),
+            ctx.is_chief,
+        )
         if ctx.is_chief:
-            # the FULL train state (params, optimizer, step) plus the BN
-            # batch_stats: a restored model is unusable without its moving
-            # statistics, and a resumed run without its optimizer state.
-            # Guard against re-saving a step a previous attempt already
-            # landed (orbax rejects that even with force).
-            ckpt.wait()
-            if ckpt.latest_step() != int(state.step):
-                ckpt.save(
-                    int(state.step),
-                    {"state": state, "batch_stats": batch_stats},
-                )
             print(f"chief checkpointed to {args.model_dir}")
-        ckpt.close()
 
 
 def parse_args(argv=None):

@@ -125,3 +125,43 @@ class CheckpointManager:
     def __exit__(self, *exc) -> None:
         self.wait()
         self.close()
+
+
+def restore_latest(ckpt: CheckpointManager, target: Any):
+    """Resume convention: restore the newest checkpoint into ``target``'s
+    structure. Returns ``(step, restored)``, or ``(None, target)`` when
+    the directory has no checkpoints. A structure mismatch (e.g. a
+    directory written by different code) fails with a clear error
+    instead of an orbax tree-diff traceback."""
+    step = ckpt.latest_step()
+    if step is None:
+        return None, target
+    try:
+        return step, ckpt.restore(step, target=target)
+    except (ValueError, KeyError, TypeError) as e:
+        # tree/structure errors only — IO failures (network, partial step
+        # dirs) propagate unchanged so operators retry, not delete
+        keys = (
+            sorted(target) if isinstance(target, dict) else type(target).__name__
+        )
+        raise ValueError(
+            f"checkpoint step {step} in {ckpt.directory} does not match "
+            f"the expected structure ({keys}); it was probably written by "
+            "a different trainer — delete the directory or point the "
+            "model dir elsewhere"
+        ) from e
+
+
+def chief_final_save(
+    ckpt: CheckpointManager, state: Any, step: int, is_chief: bool
+) -> None:
+    """End-of-training save convention: chief-only, forced past any
+    save-interval policy, and skipped when a previous attempt (e.g. a
+    ``run_with_restarts`` relaunch or an in-loop interval save) already
+    landed this step — orbax rejects re-saving an existing step. Every
+    process closes the manager."""
+    if is_chief:
+        ckpt.wait()  # async in-loop saves may still be landing
+        if ckpt.latest_step() != step:
+            ckpt.save(step, state, force=True)
+    ckpt.close()
