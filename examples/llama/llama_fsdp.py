@@ -197,6 +197,35 @@ def main_fun(args, ctx):
         # SPMD: every process runs the same decode over the (possibly
         # globally sharded) params; only the chief prints. A device_get of
         # FSDP-sharded params would fail multi-host — keep them on-mesh.
+        gen_params = state.params
+        if args.quantize_decode:
+            from tensorflowonspark_tpu.ops.quant import (
+                QuantTensor,
+                quantize_tree,
+            )
+
+            # int8 weight-only decode (ops/quant.py): the model consumes
+            # the quantized tree natively (QDense/quantized_dot), so
+            # weights stay int8 through the decode. Drop the bf16 state
+            # so its buffers can actually be freed.
+            gen_params = quantize_tree(gen_params)
+            state = None
+            n_q = sum(
+                isinstance(leaf, QuantTensor)
+                for leaf in jax.tree.leaves(
+                    gen_params, is_leaf=lambda x: isinstance(x, QuantTensor)
+                )
+            )
+            if ctx.is_chief:
+                print(
+                    f"quantized {n_q} weight tensors for decode"
+                    + (
+                        " (NONE met quantize_tree's size threshold — "
+                        "tiny configs decode unquantized)"
+                        if n_q == 0
+                        else ""
+                    )
+                )
         gen_rng = np.random.default_rng(0)  # same prompt on every process
         prompt = gen_rng.integers(
             0, cfg.vocab_size, size=(2, 8)
@@ -205,7 +234,7 @@ def main_fun(args, ctx):
         with use_mesh(mesh):
             out = generate(
                 model,
-                state.params,
+                gen_params,
                 jax.numpy.asarray(prompt),
                 max_new_tokens=args.generate,
                 temperature=args.temperature,
@@ -273,6 +302,11 @@ def parse_args(argv=None):
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-k", type=int, default=None)
     p.add_argument("--top-p", type=float, default=None)
+    p.add_argument(
+        "--quantize-decode",
+        action="store_true",
+        help="int8 weight-only storage for the --generate decode pass",
+    )
     p.add_argument(
         "--peak-tflops", type=float, default=275.0, help="per-chip bf16 peak"
     )

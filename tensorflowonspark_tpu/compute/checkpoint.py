@@ -138,18 +138,35 @@ def restore_latest(ckpt: CheckpointManager, target: Any):
         return None, target
     try:
         return step, ckpt.restore(step, target=target)
-    except (ValueError, KeyError, TypeError) as e:
-        # tree/structure errors only — IO failures (network, partial step
-        # dirs) propagate unchanged so operators retry, not delete
-        keys = (
-            sorted(target) if isinstance(target, dict) else type(target).__name__
-        )
-        raise ValueError(
-            f"checkpoint step {step} in {ckpt.directory} does not match "
-            f"the expected structure ({keys}); it was probably written by "
-            "a different trainer — delete the directory or point the "
-            "model dir elsewhere"
-        ) from e
+    except Exception as e:
+        # Only claim "wrong trainer" when the stored tree's top-level
+        # keys genuinely differ from the target's; any other failure
+        # (IO, partial step dir, truncated arrays) propagates unchanged
+        # so operators retry instead of deleting good checkpoints.
+        stored_keys = _stored_top_level_keys(ckpt, step)
+        if (
+            isinstance(target, dict)
+            and stored_keys is not None
+            and stored_keys != set(target)
+        ):
+            raise ValueError(
+                f"checkpoint step {step} in {ckpt.directory} has keys "
+                f"{sorted(stored_keys)} but this trainer expects "
+                f"{sorted(target)}; it was written by a different trainer "
+                "— delete the directory or point the model dir elsewhere"
+            ) from e
+        raise
+
+
+def _stored_top_level_keys(ckpt: CheckpointManager, step: int):
+    """Top-level keys of a stored checkpoint's tree, or None if the
+    metadata cannot be read (caller treats that as 'unknown')."""
+    try:
+        meta = ckpt._mgr.item_metadata(step)
+        tree = getattr(meta, "tree", meta)
+        return set(tree) if isinstance(tree, dict) else None
+    except Exception:
+        return None
 
 
 def chief_final_save(

@@ -119,15 +119,45 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+class QDense(nn.Module):
+    """Bias-free Dense that also accepts int8 ``QuantTensor`` kernels.
+
+    With a regular array kernel this is exactly ``nn.Dense(use_bias=
+    False, dtype=...)``; with a quantized kernel (``ops/quant.py``,
+    e.g. a tree from ``quantize_tree``) the dot runs against the int8
+    weight with the per-channel scales folded into the fp32 accumulator
+    — weights stay int8 in HBM through the whole decode, which is the
+    point (decode is weight-bandwidth-bound)."""
+
+    features: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel",
+            nn.initializers.normal(0.02),
+            (jnp.shape(x)[-1], self.features),
+        )
+        from tensorflowonspark_tpu.ops.quant import (
+            QuantTensor,
+            quantized_dot,
+        )
+
+        x = x.astype(self.dtype)
+        if isinstance(kernel, QuantTensor):
+            return quantized_dot(x, kernel)
+        return x @ kernel.astype(self.dtype)
+
+
 class Attention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
     def __call__(self, x, positions, segment_ids=None, decode=False):
         cfg = self.cfg
-        dense = lambda feats, name: nn.Dense(  # noqa: E731
-            feats, use_bias=False, dtype=cfg.dtype, name=name,
-            kernel_init=nn.initializers.normal(0.02),
+        dense = lambda feats, name: QDense(  # noqa: E731
+            feats, cfg.dtype, name=name
         )
         q = dense(cfg.num_heads * cfg.head_dim, "q_proj")(x)
         k = dense(cfg.num_kv_heads * cfg.head_dim, "k_proj")(x)
@@ -211,9 +241,8 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        dense = lambda feats, name: nn.Dense(  # noqa: E731
-            feats, use_bias=False, dtype=cfg.dtype, name=name,
-            kernel_init=nn.initializers.normal(0.02),
+        dense = lambda feats, name: QDense(  # noqa: E731
+            feats, cfg.dtype, name=name
         )
         gate = dense(cfg.intermediate_size, "gate_proj")(x)
         up = dense(cfg.intermediate_size, "up_proj")(x)
@@ -282,12 +311,20 @@ class Llama(nn.Module):
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
             )
+        from tensorflowonspark_tpu.ops.quant import QuantTensor
+
         embed = self.param(
             "embed",
             nn.initializers.normal(0.02),
             (cfg.vocab_size, cfg.hidden_size),
         )
-        x = embed[tokens].astype(cfg.dtype)
+        if isinstance(embed, QuantTensor):
+            # gather int8 rows, then scale: the table stays int8 in HBM
+            x = (
+                embed.q[tokens].astype(jnp.float32) * embed.scale
+            ).astype(cfg.dtype)
+        else:
+            x = embed[tokens].astype(cfg.dtype)
         if cfg.remat and not decode:
             # Rematerialize each layer's activations in backward: trades
             # FLOPs for HBM, the standard long-sequence TPU memory lever.
@@ -320,6 +357,10 @@ class Llama(nn.Module):
         )
         if return_hidden:
             return x, head
+        if isinstance(head, QuantTensor):
+            from tensorflowonspark_tpu.ops.quant import quantized_dot
+
+            return quantized_dot(x, head).astype(jnp.float32)
         return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
 
 
@@ -403,6 +444,10 @@ def generate(
             "argmax, which would silently ignore them)"
         )
     rng = jax.random.PRNGKey(0) if rng is None else rng
+    # int8 weight-only decode: quantized trees (ops/quant.py
+    # quantize_tree) pass straight through — QDense / the embed gather /
+    # the head projection consume QuantTensor leaves natively, so the
+    # weights stay int8 in HBM for the whole decode.
     run = _build_generate(
         model,
         b,

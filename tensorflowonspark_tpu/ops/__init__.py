@@ -6,5 +6,17 @@ defaults plus Pallas kernels where fusion isn't enough.
 """
 
 from tensorflowonspark_tpu.ops.attention import dot_product_attention
+from tensorflowonspark_tpu.ops.quant import (
+    QuantTensor,
+    dequantize_tree,
+    quantize_tree,
+    quantized_dot,
+)
 
-__all__ = ["dot_product_attention"]
+__all__ = [
+    "dot_product_attention",
+    "QuantTensor",
+    "quantize_tree",
+    "dequantize_tree",
+    "quantized_dot",
+]

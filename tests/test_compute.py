@@ -184,3 +184,34 @@ def test_checkpoint_manager_keep_best(tmp_path):
 
     with pytest.raises(ValueError, match="keep_best_mode"):
         CheckpointManager(str(tmp_path / "bad"), keep_best_mode="sideways")
+
+
+def test_restore_latest_helper(tmp_path):
+    from tensorflowonspark_tpu.compute.checkpoint import (
+        CheckpointManager,
+        restore_latest,
+    )
+
+    target = {"state": jnp.zeros(3), "extra": jnp.zeros(())}
+    with CheckpointManager(str(tmp_path / "empty")) as mgr:
+        step, restored = restore_latest(mgr, target)
+        assert step is None and restored is target
+
+    with CheckpointManager(str(tmp_path / "mgr"), async_save=False) as mgr:
+        mgr.save(5, {"state": jnp.arange(3.0), "extra": jnp.ones(())})
+        mgr.wait()
+        step, restored = restore_latest(mgr, target)
+        assert step == 5
+        np.testing.assert_array_equal(
+            np.asarray(restored["state"]), np.arange(3.0)
+        )
+
+    # a directory written with DIFFERENT keys -> the clear wrong-trainer
+    # error (the legacy params-only layout scenario)
+    import pytest
+
+    with CheckpointManager(str(tmp_path / "old"), async_save=False) as mgr:
+        mgr.save(1, {"params": jnp.zeros(2), "batch_stats": jnp.zeros(())})
+        mgr.wait()
+        with pytest.raises(ValueError, match="different trainer"):
+            restore_latest(mgr, target)

@@ -1,0 +1,96 @@
+"""Int8 weight-only quantization numerics and llama-decode integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.ops import quant
+
+
+def test_quantize_roundtrip_accuracy():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(256, 128)).astype(np.float32)) * 0.05
+    t = quant.quantize(w)
+    assert t.q.dtype == jnp.int8
+    assert t.scale.shape == (1, 128)  # one scale per OUTPUT channel
+    back = quant.dequantize(t, jnp.float32)
+    err = np.abs(np.asarray(back - w))
+    # symmetric int8: error bounded by scale/2 per element
+    assert float(err.max()) <= float(np.asarray(t.scale).max()) / 2 + 1e-7
+    rel = float(np.linalg.norm(err) / np.linalg.norm(np.asarray(w)))
+    # quant step ~ amax/127; RMS error step/sqrt(12) -> ~0.8% relative
+    # for a normal weight distribution
+    assert rel < 0.01
+
+
+def test_quantize_zero_and_outlier_channels():
+    w = jnp.zeros((64, 4), jnp.float32).at[:, 1].set(100.0).at[0, 2].set(1e-3)
+    t = quant.quantize(w)
+    back = np.asarray(quant.dequantize(t, jnp.float32))
+    np.testing.assert_allclose(back[:, 0], 0.0)  # zero channel stays zero
+    np.testing.assert_allclose(back[:, 1], 100.0, rtol=1e-2)
+    # per-channel scales keep the tiny channel from being crushed by the
+    # outlier channel
+    assert back[0, 2] == pytest.approx(1e-3, rel=0.05)
+
+
+def test_quantize_tree_thresholds_and_dequantize():
+    params = {
+        "big": jnp.ones((512, 256), jnp.float32),
+        "small": jnp.ones((8,), jnp.float32),
+        "ints": jnp.ones((512, 256), jnp.int32),
+    }
+    qt = quant.quantize_tree(params, min_size=1024)
+    assert isinstance(qt["big"], quant.QuantTensor)
+    assert not isinstance(qt["small"], quant.QuantTensor)
+    assert not isinstance(qt["ints"], quant.QuantTensor)
+    back = quant.dequantize_tree(qt, jnp.float32)
+    np.testing.assert_allclose(np.asarray(back["big"]), 1.0, rtol=1e-2)
+
+
+def test_quantized_dot_matches_dequantized_matmul():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(4, 64)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32)) * 0.1
+    t = quant.quantize(w)
+    ref = x.astype(jnp.bfloat16) @ quant.dequantize(t)
+    out = quant.quantized_dot(x, t)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+    with pytest.raises(ValueError, match="axis"):
+        quant.quantized_dot(x, quant.quantize(w, axis=0))
+
+
+def test_llama_generate_with_quantized_weights():
+    """Decode against int8 weights: logits stay close to full precision
+    and the jitted generate path accepts the quantized tree directly."""
+    from tensorflowonspark_tpu.models.llama import Llama, LlamaConfig, generate
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=False)
+    model = Llama(cfg)
+    tokens = jnp.zeros((2, 12), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    qparams = quant.quantize_tree(params, min_size=1024)
+    n_q = sum(
+        isinstance(leaf, quant.QuantTensor)
+        for leaf in jax.tree.leaves(
+            qparams, is_leaf=lambda x: isinstance(x, quant.QuantTensor)
+        )
+    )
+    assert n_q > 0
+
+    full = model.apply({"params": params}, tokens)
+    deq = model.apply(
+        {"params": quant.dequantize_tree(qparams, jnp.float32)}, tokens
+    )
+    # weight-only int8 keeps logits close at tiny scale
+    assert float(jnp.max(jnp.abs(full - deq))) < 0.05
+
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size)
+    out = generate(model, qparams, prompt, 6)
+    assert out.shape == (2, 6)
+    assert int(np.asarray(out).min()) >= 0
+    assert int(np.asarray(out).max()) < cfg.vocab_size
