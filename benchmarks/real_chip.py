@@ -339,6 +339,11 @@ def bench_llama1b_decode(args):
         rng.integers(0, cfg.vocab_size, size=(b, prompt_len)), jnp.int32
     )
     params = model.init(jax.random.PRNGKey(0), prompt[:2])["params"]
+    if getattr(args, "quantize", False):
+        from tensorflowonspark_tpu.ops.quant import quantize_tree
+
+        # int8 weight-only decode: weights consumed as int8 by the model
+        params = quantize_tree(params)
     params = jax.tree.map(jax.device_put, params)
     out = generate(model, params, prompt, new_tokens)  # compile + warm
     np.asarray(out[0, :1])
@@ -398,6 +403,11 @@ def main(argv=None):
         type=int,
         default=256,
         help="decode length for llama1b_decode",
+    )
+    p.add_argument(
+        "--quantize",
+        action="store_true",
+        help="llama1b_decode: int8 weight-only decode (ops/quant.py)",
     )
     p.add_argument(
         "--peak-tflops",

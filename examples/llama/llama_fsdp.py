@@ -206,9 +206,12 @@ def main_fun(args, ctx):
 
             # int8 weight-only decode (ops/quant.py): the model consumes
             # the quantized tree natively (QDense/quantized_dot), so
-            # weights stay int8 through the decode. Drop the bf16 state
-            # so its buffers can actually be freed.
-            gen_params = quantize_tree(gen_params)
+            # weights stay int8 through the decode. jit so quantization
+            # runs as SPMD on FSDP-sharded (non-fully-addressable) params
+            # instead of eagerly; drop the bf16 state so its buffers can
+            # actually be freed.
+            with use_mesh(mesh):
+                gen_params = jax.jit(quantize_tree)(gen_params)
             state = None
             n_q = sum(
                 isinstance(leaf, QuantTensor)

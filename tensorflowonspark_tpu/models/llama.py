@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tensorflowonspark_tpu.ops.attention import dot_product_attention
+from tensorflowonspark_tpu.ops.quant import QuantTensor, quantized_dot
 
 
 @dataclasses.dataclass(frozen=True)
@@ -139,11 +140,6 @@ class QDense(nn.Module):
             nn.initializers.normal(0.02),
             (jnp.shape(x)[-1], self.features),
         )
-        from tensorflowonspark_tpu.ops.quant import (
-            QuantTensor,
-            quantized_dot,
-        )
-
         x = x.astype(self.dtype)
         if isinstance(kernel, QuantTensor):
             return quantized_dot(x, kernel)
@@ -311,8 +307,6 @@ class Llama(nn.Module):
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
             )
-        from tensorflowonspark_tpu.ops.quant import QuantTensor
-
         embed = self.param(
             "embed",
             nn.initializers.normal(0.02),
@@ -358,8 +352,6 @@ class Llama(nn.Module):
         if return_hidden:
             return x, head
         if isinstance(head, QuantTensor):
-            from tensorflowonspark_tpu.ops.quant import quantized_dot
-
             return quantized_dot(x, head).astype(jnp.float32)
         return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
 

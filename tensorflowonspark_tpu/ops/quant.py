@@ -76,13 +76,16 @@ def dequantize(t: QuantTensor, dtype=jnp.bfloat16) -> jax.Array:
 def quantize_tree(
     params: Any, min_size: int = 1 << 16, axis: int = -1
 ) -> Any:
-    """Quantize every floating leaf with ``>= min_size`` elements and
-    ``ndim >= 2``; small leaves (norm scales, biases) stay as-is."""
+    """Quantize every 2-D floating leaf with ``>= min_size`` elements;
+    small leaves (norm scales, biases) stay as-is. Only matrices: that is
+    what the consumers handle (``QDense``, the embed gather, the head
+    projection) — 3-D MoE expert banks are deliberately left unquantized
+    (``parallel/moe.py`` consumes plain arrays)."""
 
     def rule(x):
         if (
             hasattr(x, "ndim")
-            and x.ndim >= 2
+            and x.ndim == 2
             and x.size >= min_size
             and jnp.issubdtype(x.dtype, jnp.floating)
         ):

@@ -41,10 +41,13 @@ def test_quantize_tree_thresholds_and_dequantize():
         "small": jnp.ones((8,), jnp.float32),
         "ints": jnp.ones((512, 256), jnp.int32),
     }
+    params["moe_bank"] = jnp.ones((4, 64, 32), jnp.float32)  # 3-D expert bank
     qt = quant.quantize_tree(params, min_size=1024)
     assert isinstance(qt["big"], quant.QuantTensor)
     assert not isinstance(qt["small"], quant.QuantTensor)
     assert not isinstance(qt["ints"], quant.QuantTensor)
+    # 3-D MoE banks stay unquantized (parallel/moe.py consumes arrays)
+    assert not isinstance(qt["moe_bank"], quant.QuantTensor)
     back = quant.dequantize_tree(qt, jnp.float32)
     np.testing.assert_allclose(np.asarray(back["big"]), 1.0, rtol=1e-2)
 
