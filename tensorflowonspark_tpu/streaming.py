@@ -154,6 +154,52 @@ class DStream:
         """Register ``fn`` to run on each materialized micro-batch."""
         self._ssc._register_output(self, fn)
 
+    def pprint(self, num: int = 10) -> None:
+        """Print the first ``num`` records of each micro-batch (pyspark
+        ``DStream.pprint``): a timestamp header, records, a truncation
+        marker — the debugging output op."""
+
+        def show(rdd: RDD) -> None:
+            records = [r for part in rdd for r in part]
+            print(f"-------- micro-batch @ {time.strftime('%X')} --------")
+            for r in records[:num]:
+                print(r)
+            if len(records) > num:
+                print(f"... ({len(records) - num} more)")
+
+        self.foreachRDD(show)
+
+    def saveAsTextFiles(self, prefix: str, suffix: str = "") -> None:
+        """Write each micro-batch as a directory of part files (pyspark
+        ``DStream.saveAsTextFiles``): ``<prefix>-<epoch_ms>[.suffix]/
+        part-NNNNN``, one part per partition, one ``str(record)`` per
+        line. Timestamp naming never collides across job restarts
+        (pyspark's convention), and each batch dir is written under a
+        dot-prefixed temp name then renamed, so directory watchers
+        (e.g. ``textFileStream`` on the parent) never observe a
+        half-written batch."""
+
+        def save(rdd: RDD) -> None:
+            stamp = int(time.time() * 1000)
+            while True:
+                d = f"{prefix}-{stamp}"
+                if suffix:
+                    d = f"{d}.{suffix}"
+                parent, base = os.path.split(d)
+                tmp = os.path.join(parent or ".", f".{base}.tmp")
+                try:
+                    os.makedirs(tmp, exist_ok=False)
+                    break
+                except FileExistsError:
+                    stamp += 1  # two ticks in one ms; bump
+            for i, part in enumerate(rdd):
+                with open(os.path.join(tmp, f"part-{i:05d}"), "w") as f:
+                    for r in part:
+                        f.write(f"{r}\n")
+            os.rename(tmp, d)  # atomic materialization
+
+        self.foreachRDD(save)
+
     # -- evaluation ----------------------------------------------------
     def _materialize(
         self, source_rdd: RDD, memo: dict[int, RDD] | None = None

@@ -253,3 +253,27 @@ def test_reduceByWindow_union_count():
 
 def other_stream_same_ctx(ssc):
     return ssc.queueStream([[7]])
+
+
+def test_saveAsTextFiles_and_pprint(tmp_path, capfd):
+    ssc = StreamingContext(batch_interval=0.05)
+    src = ssc.queueStream([[1, 2, 3], [[4], [5, 6]]])
+    src.saveAsTextFiles(str(tmp_path / "out"), suffix="txt")
+    src.pprint(num=2)
+    ssc.start()
+    deadline = time.time() + 10
+    while len(list(tmp_path.glob("out-*"))) < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    ssc.stop()
+
+    dirs = sorted(tmp_path.glob("out-*"))
+    assert len(dirs) == 2 and all(d.suffix == ".txt" for d in dirs)
+    assert not list(tmp_path.glob(".out-*"))  # temp dirs renamed away
+    d0, d1 = dirs  # timestamp naming sorts in batch order
+    assert (d0 / "part-00000").read_text() == "1\n2\n3\n"
+    # second batch was pre-partitioned into two parts
+    assert (d1 / "part-00000").read_text() == "4\n"
+    assert (d1 / "part-00001").read_text() == "5\n6\n"
+    out = capfd.readouterr().out
+    assert "micro-batch @" in out
+    assert "... (1 more)" in out  # 3 records, num=2
