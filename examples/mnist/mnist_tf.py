@@ -50,21 +50,45 @@ def main_fun(args, ctx):
             "label": b["label"].astype(np.int32),
         }
 
-    batches = readers.column_batches(
-        readers.repeated(
-            lambda epoch: readers.shuffled(
-                readers.sharded_rows(
-                    args.tfrecords, ctx.executor_id, ctx.num_workers
+    if args.pipeline == "tfdata":
+        # the tf.data tier (data/tfdata.py): parallel interleaved reads,
+        # parallel Example parsing, autotuned prefetch — file-sharded
+        from tensorflowonspark_tpu.data.tfdata import tfdata_batches
+
+        # same guard as the python tier's multiple_of: the batch must
+        # split evenly over the mesh's data axis
+        bs = max(
+            jax.device_count(),
+            args.batch_size // jax.device_count() * jax.device_count(),
+        )
+        batches = (
+            preprocess(b)
+            for b in tfdata_batches(
+                args.tfrecords,
+                bs,
+                shard_index=ctx.executor_id,
+                num_shards=ctx.num_workers,
+                shuffle_buffer=4096,
+                num_epochs=args.epochs,
+                seed=ctx.executor_id,
+            )
+        )
+    else:
+        batches = readers.column_batches(
+            readers.repeated(
+                lambda epoch: readers.shuffled(
+                    readers.sharded_rows(
+                        args.tfrecords, ctx.executor_id, ctx.num_workers
+                    ),
+                    # fresh permutation each epoch, distinct per node
+                    seed=ctx.executor_id * 10007 + epoch,
                 ),
-                # fresh permutation each epoch, distinct per node
-                seed=ctx.executor_id * 10007 + epoch,
+                epochs=args.epochs,
             ),
-            epochs=args.epochs,
-        ),
-        args.batch_size,
-        multiple_of=jax.device_count(),
-        transform=preprocess,
-    )
+            args.batch_size,
+            multiple_of=jax.device_count(),
+            transform=preprocess,
+        )
     steps, loss = 0, None
     for batch in batches:
         state, loss = step(state, shard_batch(mesh, batch))
@@ -88,6 +112,12 @@ def parse_args(argv=None):
     p.add_argument("--model-dir", default=None)
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument(
+        "--pipeline",
+        choices=("python", "tfdata"),
+        default="python",
+        help="input tier: pure-Python readers or the tf.data adapter",
+    )
     p.add_argument("--cpu", action="store_true")
     return p.parse_args(argv)
 

@@ -1,0 +1,120 @@
+"""tf.data pull-mode adapter: TFRecord dir -> numpy batch iterator.
+
+Reference parity: the ``InputMode.TENSORFLOW`` examples consumed their
+shards through ``tf.data`` (``mnist_tf.py``'s
+``TFRecordDataset -> parse -> shuffle -> batch`` chain — SURVEY.md
+§2.4), and SURVEY.md §2.2 names tf.data as one of the record-reader
+equivalents of the Hadoop connector. This module is that chain behind
+one call, ending at the JAX boundary: the dataset's output is a plain
+iterator of numpy dicts, ready for ``shard_batch``/``DevicePrefetcher``.
+
+tf.data brings what the pure-Python tier (``data/readers.py``) doesn't:
+parallel interleaved file reads, parallel Example parsing, and an
+autotuned prefetch pipeline — the host-side input throughput story for
+image-scale training. TensorFlow stays an optional dependency of this
+module only (the core framework never imports it).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Sequence
+
+
+def _tf():
+    import tensorflow as tf
+
+    try:
+        tf.config.set_visible_devices([], "GPU")  # host-side pipeline only
+    except RuntimeError:
+        pass  # TF runtime already initialized elsewhere in the process
+    return tf
+
+
+def tfdata_batches(
+    input_dir: str,
+    batch_size: int,
+    shard_index: int = 0,
+    num_shards: int = 1,
+    shuffle_buffer: int = 0,
+    num_epochs: int | None = None,
+    drop_remainder: bool = True,
+    binary_features: Sequence[str] = (),
+    seed: int = 0,
+) -> Iterator[dict[str, Any]]:
+    """Stream column-batched numpy dicts from a TFRecord directory.
+
+    Sharding: by FILE when the file count divides ``num_shards`` evenly
+    (each worker reads only its files), otherwise by RECORD (stride over
+    the interleaved stream, every worker reads all files) — so per-shard
+    record counts never differ by more than one, and multi-process SPMD
+    jobs keep equal step counts (unequal feeds deadlock collectives;
+    SURVEY.md §7 "hard parts"). Each node of an ``InputMode.TENSORFLOW``
+    job passes its ``ctx.executor_id``/``ctx.num_workers``. Feature
+    shapes and dtypes come from the first record (``dfutil.infer_schema``
+    on a decoded row); every record must share that layout, the TFRecord
+    convention this package writes (``dfutil.saveAsTFRecords``).
+
+    ``num_epochs=None`` repeats forever (the training default — pair
+    with a step budget); ``drop_remainder=True`` keeps jit shapes
+    static.
+    """
+    tf = _tf()
+
+    from tensorflowonspark_tpu.data import dfutil
+
+    files = dfutil.tfrecord_files(input_dir)
+
+    # schema + fixed shapes from the first record
+    first = next(iter(dfutil.loadTFRecords(input_dir, binary_features)))
+    schema = dfutil.infer_schema(first)
+    features = {}
+    for col, kind in schema.items():
+        val = first[col]
+        if kind == "int64":
+            shape = list(getattr(val, "shape", ())) or []
+            features[col] = tf.io.FixedLenFeature(shape, tf.int64)
+        elif kind == "float":
+            shape = list(getattr(val, "shape", ())) or []
+            features[col] = tf.io.FixedLenFeature(shape, tf.float32)
+        else:
+            # bytes columns decode to a single value or a list of values
+            shape = [len(val)] if isinstance(val, (list, tuple)) else []
+            features[col] = tf.io.FixedLenFeature(shape, tf.string)
+
+    def parse(serialized):
+        return tf.io.parse_example(serialized, features)
+
+    ds = tf.data.Dataset.from_tensor_slices(sorted(files))
+    shard_records = num_shards > 1 and len(files) % num_shards != 0
+    if num_shards > 1 and not shard_records:
+        ds = ds.shard(num_shards, shard_index)
+    ds = ds.interleave(
+        tf.data.TFRecordDataset,
+        cycle_length=tf.data.AUTOTUNE,
+        num_parallel_calls=tf.data.AUTOTUNE,
+    )
+    if shard_records:
+        ds = ds.shard(num_shards, shard_index)
+    ds = ds.repeat(num_epochs)
+    if shuffle_buffer:
+        ds = ds.shuffle(shuffle_buffer, seed=seed)
+    ds = ds.batch(batch_size, drop_remainder=drop_remainder)
+    ds = ds.map(parse, num_parallel_calls=tf.data.AUTOTUNE)
+    ds = ds.prefetch(tf.data.AUTOTUNE)
+
+    import numpy as np
+
+    str_cols = [
+        c
+        for c, kind in schema.items()
+        if kind == "bytes" and c not in binary_features
+    ]
+    for batch in ds.as_numpy_iterator():
+        if str_cols:
+            batch = dict(batch)
+            for c in str_cols:
+                # elementwise decode, any rank (scalar or multi-value)
+                batch[c] = np.char.decode(
+                    np.asarray(batch[c]).astype("S"), "utf-8"
+                )
+        yield batch
