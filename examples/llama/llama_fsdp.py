@@ -64,11 +64,16 @@ def main_fun(args, ctx):
     import numpy as np
     import optax
 
-    from tensorflowonspark_tpu.compute import TrainState, build_train_step
+    from tensorflowonspark_tpu.compute import (
+        TrainState,
+        build_train_step,
+        shard_state,
+    )
     from tensorflowonspark_tpu.compute.checkpoint import (
         CheckpointManager,
         chief_final_save,
         restore_latest,
+        saves_on_this_process,
     )
     from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
     from tensorflowonspark_tpu.models.llama import (
@@ -123,7 +128,10 @@ def main_fun(args, ctx):
         tx = optim.adamw(float(args.lr), moment_dtype=jnp.bfloat16)
     else:
         tx = optax.adamw(float(args.lr))
-    state = TrainState.create(params, tx)
+    # commit ALL state leaves (moments, masters, step scalar) to their
+    # mesh shardings — required for checkpoint restore to reproduce
+    # placements exactly under multi-controller FSDP
+    state = shard_state(TrainState.create(params, tx), mesh, psh)
     token_loss = llama_loss_fn(model, logit_chunk=args.logit_chunk)
     step = build_train_step(
         lambda p, b: token_loss(p, b["tokens"]), tx, mesh, param_shardings=psh
@@ -163,9 +171,15 @@ def main_fun(args, ctx):
                     f"node{ctx.executor_id} step {i + 1} "
                     f"loss {float(loss):.4f}"
                 )
-            if ckpt is not None and ctx.is_chief and args.save_every:
+            if (
+                ckpt is not None
+                and args.save_every
+                and saves_on_this_process(ctx.is_chief)
+            ):
                 # async save overlapped with the next steps; the manager's
-                # save_interval policy decides which steps actually land
+                # save_interval policy decides which steps actually land.
+                # Under multi-controller FSDP the state is sharded across
+                # processes, so EVERY process participates in the save.
                 ckpt.save(step_base + 1 + i, state)
         jax.block_until_ready(loss)
     dt = time.time() - t0
@@ -184,9 +198,9 @@ def main_fun(args, ctx):
         f"MFU {mfu * 100:.1f}%"
     )
     if ckpt is not None:
-        # Chief-only (with the local launcher every node is an independent
-        # single-controller process, so concurrent saves to the same orbax
-        # directory would race); forced past the --save-every interval.
+        # Single-controller: chief-only (independent replicas would race
+        # on the directory). Multi-controller: collective all-process save
+        # of the cross-process-sharded state. chief_final_save picks.
         chief_final_save(ckpt, state, int(state.step), ctx.is_chief)
         if ctx.is_chief:
             print(f"checkpointed step {int(state.step)} to {args.model_dir}")

@@ -22,6 +22,8 @@ from tensorflowonspark_tpu.compute.train import (
     build_train_step,
     build_eval_step,
     fsdp_shardings,
+    shard_state,
+    state_shardings,
 )
 
 __all__ = [
@@ -33,6 +35,8 @@ __all__ = [
     "build_train_step",
     "build_eval_step",
     "fsdp_shardings",
+    "shard_state",
+    "state_shardings",
     "adamw",
     "mixed_precision_adamw",
 ]

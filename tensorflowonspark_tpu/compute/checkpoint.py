@@ -169,15 +169,41 @@ def _stored_top_level_keys(ckpt: CheckpointManager, step: int):
         return None
 
 
+def saves_on_this_process(is_chief: bool) -> bool:
+    """Which processes must call ``save`` (and ``wait``):
+
+    - **Single-controller** (``jax.process_count() == 1`` — e.g. the local
+      launcher, where every node is an independent JAX runtime holding a
+      full replica): chief only. Concurrent saves of the same fully-
+      addressable state to one orbax directory would race.
+    - **Multi-controller** (``jax.distributed`` initialized,
+      ``process_count > 1``): EVERY process. State is jax.Arrays sharded
+      across processes; orbax save/restore of non-fully-addressable
+      arrays is a collective — each process writes its addressable
+      shards and process 0 coordinates the commit. A chief-only save
+      there raises or hangs.
+
+    Gate *logging* on ``is_chief``; gate *saving* on this.
+    """
+    import jax
+
+    return is_chief or jax.process_count() > 1
+
+
 def chief_final_save(
     ckpt: CheckpointManager, state: Any, step: int, is_chief: bool
 ) -> None:
-    """End-of-training save convention: chief-only, forced past any
-    save-interval policy, and skipped when a previous attempt (e.g. a
+    """End-of-training save convention: forced past any save-interval
+    policy, and skipped when a previous attempt (e.g. a
     ``run_with_restarts`` relaunch or an in-loop interval save) already
-    landed this step — orbax rejects re-saving an existing step. Every
-    process closes the manager."""
-    if is_chief:
+    landed this step — orbax rejects re-saving an existing step.
+
+    "chief" in the name is the single-controller convention; under
+    multi-controller (``jax.process_count() > 1``) the save runs on
+    every process because sharded-state checkpointing is a collective
+    (see :func:`saves_on_this_process`). Every process closes the
+    manager."""
+    if saves_on_this_process(is_chief):
         ckpt.wait()  # async in-loop saves may still be landing
         if ckpt.latest_step() != step:
             ckpt.save(step, state, force=True)

@@ -61,8 +61,15 @@ def scale_by_adam(
     """
 
     def init(params):
-        zeros = lambda p: jnp.zeros(  # noqa: E731
-            jnp.shape(p), moment_dtype or jnp.float32
+        # zeros_like (not zeros): inherits each param's committed sharding,
+        # so FSDP-sharded params get FSDP-sharded moments at init. Plain
+        # jnp.zeros would land moments on the default device — uncommitted
+        # arrays that jit happens to reshard, but that poison a checkpoint
+        # restore target with single-device placements (restored arrays
+        # come back committed there, and the AOT train step then rejects
+        # them under multi-controller FSDP).
+        zeros = lambda p: jnp.zeros_like(  # noqa: E731
+            p, dtype=moment_dtype or jnp.float32
         )
         return ScaleByAdamState(
             count=jnp.zeros((), jnp.int32),

@@ -120,6 +120,26 @@ def state_shardings(state: TrainState, mesh: Mesh, param_shardings: Any) -> Trai
     )
 
 
+def shard_state(
+    state: TrainState, mesh: Mesh, param_shardings: Any
+) -> TrainState:
+    """Commit every leaf of ``state`` to its mesh sharding: params to
+    ``param_shardings``, optimizer subtrees that mirror the param tree
+    likewise, scalars (step, Adam count) replicated.
+
+    Create train state as ``shard_state(TrainState.create(p, tx), mesh,
+    psh)`` whenever it will be checkpointed: orbax restores each array to
+    the *target's* committed sharding, and a target with stray
+    default-device leaves (e.g. from an optimizer init that used plain
+    ``jnp.zeros``) restores to committed single-device arrays, which the
+    train step's explicit in_shardings then reject under
+    multi-controller FSDP instead of implicitly resharding.
+    """
+    return jax.tree.map(
+        jax.device_put, state, state_shardings(state, mesh, param_shardings)
+    )
+
+
 def build_train_step(
     loss_fn: Callable[[Any, Any], jax.Array],
     tx: optax.GradientTransformation,

@@ -359,19 +359,23 @@ def always_crash_fn(args, ctx):
     os._exit(7)
 
 
-def distributed_llama_fsdp_fn(args, ctx):
-    """Multi-controller FSDP: a tiny Llama's params and optimizer state
-    sharded over ALL processes' devices (the fsdp axis spans the process
-    boundary, where a pod's DCN/ICI would sit), gradients synced by the
-    jit-inserted collectives. Every process must observe identical losses."""
-    import json
-
+def _tiny_llama_fsdp_setup(logit_chunk=None):
+    """Shared recipe for the multi-controller FSDP Llama tests: a tiny
+    fp32 Llama with params + bf16-moment Adam state sharded over ALL
+    processes' devices (the fsdp axis spans the process boundary, where
+    a pod's DCN/ICI would sit). Returns (cfg, mesh, psh, state, step);
+    seq length is 16 (batches are ``(b, 17)`` token arrays)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from tensorflowonspark_tpu.compute import TrainState, build_train_step, optim
-    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.compute import (
+        TrainState,
+        build_train_step,
+        optim,
+        shard_state,
+    )
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
     from tensorflowonspark_tpu.models.llama import (
         Llama,
         LlamaConfig,
@@ -383,18 +387,38 @@ def distributed_llama_fsdp_fn(args, ctx):
     cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=False, attention_impl="xla")
     model = Llama(cfg)
     mesh = make_mesh({"fsdp": len(jax.devices())})  # spans both processes
-    seq, global_batch = 16, 8
-    tokens0 = np.zeros((2, seq + 1), np.int32)
+    tokens0 = np.zeros((2, 17), np.int32)
     with use_mesh(mesh):
         params = model.init(jax.random.PRNGKey(0), tokens0[:, :-1])["params"]
     psh = llama_param_shardings(params, mesh)
     params = jax.tree.map(jax.device_put, params, psh)
     tx = optim.adamw(1e-2, moment_dtype=jnp.bfloat16)
-    state = TrainState.create(params, tx)
-    token_loss = llama_loss_fn(model, logit_chunk=8)
+    # commit ALL state leaves (incl. bf16 moments + step scalar) to their
+    # mesh shardings: the restore target's committed placements are what
+    # orbax restores to
+    state = shard_state(TrainState.create(params, tx), mesh, psh)
+    token_loss = llama_loss_fn(model, logit_chunk=logit_chunk)
     step = build_train_step(
         lambda p, b: token_loss(p, b["tokens"]), tx, mesh, param_shardings=psh
     )
+    return cfg, mesh, psh, state, step
+
+
+def distributed_llama_fsdp_fn(args, ctx):
+    """Multi-controller FSDP: a tiny Llama's params and optimizer state
+    sharded over ALL processes' devices (the fsdp axis spans the process
+    boundary, where a pod's DCN/ICI would sit), gradients synced by the
+    jit-inserted collectives. Every process must observe identical losses."""
+    import json
+
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.compute.mesh import shard_batch
+    from tensorflowonspark_tpu.parallel import use_mesh
+
+    cfg, mesh, psh, state, step = _tiny_llama_fsdp_setup(logit_chunk=8)
+    seq, global_batch = 16, 8
 
     # deterministic GLOBAL batch; each process feeds its local slice
     rng = np.random.default_rng(0)
@@ -414,6 +438,82 @@ def distributed_llama_fsdp_fn(args, ctx):
         "losses": losses,
         "global_devices": len(jax.devices()),
         "process_count": jax.process_count(),
+    }
+    with open(
+        os.path.join(args["out_dir"], f"node{ctx.executor_id}.json"), "w"
+    ) as f:
+        json.dump(out, f)
+
+
+def distributed_llama_ckpt_fn(args, ctx):
+    """Multi-controller FSDP checkpoint/resume: the state is sharded over
+    BOTH processes' devices, so orbax save/restore is a collective — every
+    process calls save (writes its addressable shards; process 0 commits).
+    Phase "train": 2 steps -> all-process save -> 2 more steps, recording
+    the post-save losses. Phase "resume": restore (collective), assert the
+    resumed step, replay the same 2 batches -> losses must be bit-identical
+    to phase train's (the checkpoint captured params AND optimizer state
+    exactly). Reference parity: SURVEY.md §5.4 multi-host done right."""
+    import json
+
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.compute.checkpoint import (
+        CheckpointManager,
+        chief_final_save,
+        restore_latest,
+        saves_on_this_process,
+    )
+    from tensorflowonspark_tpu.compute.mesh import shard_batch
+    from tensorflowonspark_tpu.parallel import use_mesh
+
+    cfg, mesh, psh, state, step = _tiny_llama_fsdp_setup()
+    seq, global_batch = 16, 8
+
+    def local_batch(i):
+        # deterministic per-step GLOBAL batch; each process feeds its slice
+        rng = np.random.default_rng(1000 + i)
+        toks = rng.integers(
+            0, cfg.vocab_size, size=(global_batch, seq + 1)
+        ).astype(np.int32)
+        n_local = global_batch // ctx.num_workers
+        lo = ctx.executor_id * n_local
+        return shard_batch(mesh, {"tokens": toks[lo : lo + n_local]})
+
+    assert saves_on_this_process(is_chief=ctx.is_chief), (
+        "multi-controller mode must make EVERY process a save participant"
+    )
+    ckpt = CheckpointManager(args["model_dir"], async_save=False)
+    losses = []
+    with use_mesh(mesh):
+        if args["phase"] == "train":
+            for i in range(2):
+                state, loss = step(state, local_batch(i))
+            ckpt.save(2, state, force=True)  # collective in-loop save
+            for i in range(2, 4):
+                state, loss = step(state, local_batch(i))
+            chief_final_save(ckpt, state, 4, ctx.is_chief)  # collective
+            # post-checkpoint steps: the resume phase must reproduce
+            # these losses bit-identically from the step-4 checkpoint
+            for i in range(4, 6):
+                state, loss = step(state, local_batch(i))
+                losses.append(float(loss))
+        else:  # resume
+            latest, state = restore_latest(ckpt, state)  # collective
+            assert latest == args["expect_step"], (latest, args["expect_step"])
+            for i in range(latest, latest + 2):
+                state, loss = step(state, local_batch(i))
+                losses.append(float(loss))
+            ckpt.close()
+
+    with CheckpointManager(args["model_dir"]) as reader:
+        latest_after = reader.latest_step()
+    out = {
+        "losses": losses,
+        "latest_after": latest_after,
+        "process_count": jax.process_count(),
+        "global_devices": len(jax.devices()),
     }
     with open(
         os.path.join(args["out_dir"], f"node{ctx.executor_id}.json"), "w"

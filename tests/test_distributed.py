@@ -148,6 +148,53 @@ def test_spark_feed_ragged_tail_agreement(tmp_path):
     assert results[0]["steps"] == results[1]["steps"] == 12
 
 
+def test_two_process_fsdp_checkpoint_resume(tmp_path):
+    """Multi-controller checkpoint/restore across the process boundary
+    (VERDICT round-1 item 3): a tiny Llama's params + bf16-moment Adam
+    state sharded over 2 processes, saved COLLECTIVELY by both processes
+    (chief-only saves of cross-process-sharded arrays hang/raise), then
+    restored by a brand-new cluster which must replay the post-checkpoint
+    steps bit-identically."""
+    train_dir, resume_dir = tmp_path / "train", tmp_path / "resume"
+    train_dir.mkdir(), resume_dir.mkdir()
+    model_dir = str(tmp_path / "ckpt")
+
+    def run(phase, out_dir, expect_step=None):
+        cluster = tfcluster.run(
+            cluster_fns.distributed_llama_ckpt_fn,
+            {
+                "out_dir": str(out_dir),
+                "model_dir": model_dir,
+                "phase": phase,
+                "expect_step": expect_step,
+            },
+            num_executors=2,
+            input_mode=InputMode.TENSORFLOW,
+            reservation_timeout=180,
+            distributed=True,
+            env=cpu_only_env(num_cpu_devices=2),
+        )
+        cluster.shutdown(timeout=300)
+        return [
+            json.load(open(out_dir / f"node{i}.json")) for i in range(2)
+        ]
+
+    trained = run("train", train_dir)
+    for r in trained:
+        assert r["process_count"] == 2
+        assert r["global_devices"] == 4
+        assert r["latest_after"] == 4  # collective final save landed
+        assert all(math.isfinite(l) for l in r["losses"])
+    assert trained[0]["losses"] == trained[1]["losses"]
+
+    # a NEW cluster (fresh processes — the "kill") restores and resumes
+    resumed = run("resume", resume_dir, expect_step=4)
+    for r in resumed:
+        # bit-identical replay: the checkpoint captured params AND
+        # optimizer state (incl. bf16 moments) exactly
+        assert r["losses"] == trained[0]["losses"], (r, trained[0])
+
+
 def test_two_process_llama_fsdp(tmp_path):
     """FSDP across the process boundary: a tiny Llama trained with its
     params/optimizer state sharded over 2 processes x 4 devices, bf16
