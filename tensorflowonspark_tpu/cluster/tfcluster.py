@@ -777,5 +777,8 @@ def _as_partitions(data: Iterable, num_workers: int) -> list[list[Any]]:
     ):
         return [list(p) for p in data]
     if len(data) <= num_workers:
-        return [data]
+        # Per-record partitions: one big partition here would feed ONLY
+        # worker 0 and leave every other worker blocking until shutdown
+        # (harmless at scale, baffling in smoke tests).
+        return [[r] for r in data]
     return [data[i::num_workers] for i in range(num_workers)]

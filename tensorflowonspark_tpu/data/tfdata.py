@@ -62,10 +62,20 @@ def tfdata_batches(
 
     from tensorflowonspark_tpu.data import dfutil
 
-    files = dfutil.tfrecord_files(input_dir)
+    files = dfutil.tfrecord_files(input_dir)  # raises on a fileless dir
 
-    # schema + fixed shapes from the first record
-    first = next(iter(dfutil.loadTFRecords(input_dir, binary_features)))
+    # schema + fixed shapes from the first record. Eager (this function
+    # returns a generator rather than being one, so both this and the
+    # fileless-dir case raise at call time); the explicit StopIteration
+    # catch stops record-less shard files surfacing as an opaque PEP 479
+    # "generator raised StopIteration" RuntimeError.
+    try:
+        first = next(iter(dfutil.loadTFRecords(input_dir, binary_features)))
+    except StopIteration:
+        raise ValueError(
+            f"TFRecord files in {input_dir} contain no records "
+            f"({len(files)} shard file(s), all empty)"
+        ) from None
     schema = dfutil.infer_schema(first)
     features = {}
     for col, kind in schema.items():
@@ -109,12 +119,16 @@ def tfdata_batches(
         for c, kind in schema.items()
         if kind == "bytes" and c not in binary_features
     ]
-    for batch in ds.as_numpy_iterator():
-        if str_cols:
-            batch = dict(batch)
-            for c in str_cols:
-                # elementwise decode, any rank (scalar or multi-value)
-                batch[c] = np.char.decode(
-                    np.asarray(batch[c]).astype("S"), "utf-8"
-                )
-        yield batch
+
+    def batches():
+        for batch in ds.as_numpy_iterator():
+            if str_cols:
+                batch = dict(batch)
+                for c in str_cols:
+                    # elementwise decode, any rank (scalar or multi-value)
+                    batch[c] = np.char.decode(
+                        np.asarray(batch[c]).astype("S"), "utf-8"
+                    )
+            yield batch
+
+    return batches()

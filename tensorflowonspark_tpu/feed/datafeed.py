@@ -97,8 +97,25 @@ class DataFeed:
         return batch
 
     def _columnize(self, batch: Sequence[Any]) -> dict[str, np.ndarray]:
-        """Stack a list of row-records into {tensor_name: array} columns."""
+        """Stack a list of row-records into {tensor_name: array} columns.
+
+        Tuple/list records are read by *position* (mapping order = column
+        order, the reference's contract); dict records by the mapping's
+        field-name keys. A dict record missing a mapped field fails loudly
+        — silently indexing dicts by position was the round-1 trap.
+        """
         out: dict[str, np.ndarray] = {}
+        if batch and isinstance(batch[0], dict):
+            for field, tensor in self.input_mapping.items():
+                try:
+                    out[tensor] = np.array([row[field] for row in batch])
+                except (KeyError, TypeError) as e:
+                    raise KeyError(
+                        f"input_mapping field {field!r} not present in a "
+                        f"dict record (record keys: "
+                        f"{sorted(batch[0])}); mapping={self.input_mapping}"
+                    ) from e
+            return out
         for i, tensor in enumerate(self.input_tensors):
             out[tensor] = np.array([row[i] for row in batch])
         return out

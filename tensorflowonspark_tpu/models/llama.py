@@ -313,10 +313,12 @@ class Llama(nn.Module):
             (cfg.vocab_size, cfg.hidden_size),
         )
         if isinstance(embed, QuantTensor):
-            # gather int8 rows, then scale: the table stays int8 in HBM
-            x = (
-                embed.q[tokens].astype(jnp.float32) * embed.scale
-            ).astype(cfg.dtype)
+            # gather int8 rows, then scale: the table stays int8 in HBM.
+            # Per-row (axis=0) scales — quantize_tree's default for the
+            # embedding — gather alongside the rows; axis=-1 broadcasts.
+            rows = embed.q[tokens].astype(jnp.float32)
+            scale = embed.scale[tokens] if embed.axis == 0 else embed.scale
+            x = (rows * scale).astype(cfg.dtype)
         else:
             x = embed[tokens].astype(cfg.dtype)
         if cfg.remat and not decode:

@@ -74,25 +74,48 @@ def dequantize(t: QuantTensor, dtype=jnp.bfloat16) -> jax.Array:
 
 
 def quantize_tree(
-    params: Any, min_size: int = 1 << 16, axis: int = -1
+    params: Any,
+    min_size: int = 1 << 16,
+    axis: int = -1,
+    axis_overrides: dict[str, int] | None = None,
 ) -> Any:
     """Quantize every 2-D floating leaf with ``>= min_size`` elements;
     small leaves (norm scales, biases) stay as-is. Only matrices: that is
     what the consumers handle (``QDense``, the embed gather, the head
     projection) — 3-D MoE expert banks are deliberately left unquantized
-    (``parallel/moe.py`` consumes plain arrays)."""
+    (``parallel/moe.py`` consumes plain arrays).
 
-    def rule(x):
+    ``axis_overrides`` maps a leaf's *name* (its last pytree path key)
+    to a quantization axis. The default ``{"embed": 0}`` stores the
+    ``(vocab, hidden)`` embedding table with per-ROW scales: an axis=-1
+    scale would be a max-abs over the whole 32k-row vocab per hidden
+    unit, so a single outlier token row inflates quantization error for
+    every token. The head projection keeps axis=-1 (its name is
+    ``lm_head``), matching ``quantized_dot``'s output-channel contract.
+    """
+    if axis_overrides is None:
+        axis_overrides = {"embed": 0}
+
+    def leaf_name(path) -> str:
+        if not path:
+            return ""
+        last = path[-1]
+        for attr in ("key", "name", "idx"):
+            if hasattr(last, attr):
+                return str(getattr(last, attr))
+        return str(last)
+
+    def rule(path, x):
         if (
             hasattr(x, "ndim")
             and x.ndim == 2
             and x.size >= min_size
             and jnp.issubdtype(x.dtype, jnp.floating)
         ):
-            return quantize(x, axis=axis)
+            return quantize(x, axis=axis_overrides.get(leaf_name(path), axis))
         return x
 
-    return jax.tree.map(rule, params)
+    return jax.tree_util.tree_map_with_path(rule, params)
 
 
 def dequantize_tree(params: Any, dtype=jnp.bfloat16) -> Any:

@@ -187,6 +187,13 @@ class DStream:
                     d = f"{d}.{suffix}"
                 parent, base = os.path.split(d)
                 tmp = os.path.join(parent or ".", f".{base}.tmp")
+                # Bump past BOTH an in-flight temp dir and an already-
+                # materialized destination (e.g. a prior run's output with
+                # a colliding ms stamp) — otherwise the final os.rename
+                # raises inside the scheduler thread.
+                if os.path.exists(d):
+                    stamp += 1
+                    continue
                 try:
                     os.makedirs(tmp, exist_ok=False)
                     break

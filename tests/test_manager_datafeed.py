@@ -135,3 +135,30 @@ def test_batch_stream_tail_trim_and_mapping(mgr):
     assert len(batches) == 1
     np.testing.assert_array_equal(batches[0]["x"], np.arange(8))
     np.testing.assert_array_equal(batches[0]["y"], np.arange(8) * 10)
+
+
+def test_datafeed_dict_records(mgr):
+    """Dict records are columnized by the mapping's field-name keys
+    (round-1 trap: they were silently indexed by position)."""
+    q = mgr.get_queue("input")
+    q.put(
+        [
+            {"image": np.ones(4), "label": 7},
+            {"image": np.zeros(4), "label": 8},
+        ]
+    )
+    q.put(EndOfFeed())
+    feed = DataFeed(mgr, input_mapping={"image": "x", "label": "y"})
+    batch = feed.next_batch(2)
+    assert set(batch) == {"x", "y"}
+    assert batch["x"].shape == (2, 4)
+    assert batch["y"].tolist() == [7, 8]
+
+
+def test_datafeed_dict_records_missing_field_raises(mgr):
+    q = mgr.get_queue("input")
+    q.put([{"pixels": np.ones(4), "label": 7}])
+    q.put(EndOfFeed())
+    feed = DataFeed(mgr, input_mapping={"image": "x", "label": "y"})
+    with pytest.raises(KeyError, match="image"):
+        feed.next_batch(1)

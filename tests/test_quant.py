@@ -97,3 +97,58 @@ def test_llama_generate_with_quantized_weights():
     assert out.shape == (2, 6)
     assert int(np.asarray(out).min()) >= 0
     assert int(np.asarray(out).max()) < cfg.vocab_size
+
+
+def test_embedding_per_row_scales_beat_per_column_on_outlier_rows():
+    """quantize_tree stores embedding tables with per-ROW (axis=0)
+    scales: one outlier token row must not inflate every other token's
+    quantization error, which is exactly what axis=-1 (a max-abs over
+    the whole vocab per hidden unit) does."""
+    rng = np.random.default_rng(3)
+    vocab, hidden = 512, 256
+    table = rng.normal(size=(vocab, hidden)).astype(np.float32) * 0.02
+    table[7] *= 100.0  # one outlier token row
+    tree = {"embed": jnp.asarray(table)}
+
+    q_default = quant.quantize_tree(tree, min_size=1)["embed"]
+    assert q_default.axis == 0
+    assert q_default.scale.shape == (vocab, 1)
+
+    q_col = quant.quantize_tree(tree, min_size=1, axis_overrides={})["embed"]
+    assert q_col.axis == 1
+
+    def err(t):
+        back = np.asarray(quant.dequantize(t, jnp.float32))
+        mask = np.ones(vocab, bool)
+        mask[7] = False  # error on the NON-outlier rows
+        d = back[mask] - table[mask]
+        return float(np.linalg.norm(d) / np.linalg.norm(table[mask]))
+
+    assert err(q_default) < 0.01  # per-row: unaffected by the outlier
+    assert err(q_col) > 10 * err(q_default)  # per-column: poisoned
+
+    # head projections keep output-channel scales (quantized_dot contract)
+    q_head = quant.quantize_tree({"lm_head": jnp.asarray(table.T)}, min_size=1)
+    assert q_head["lm_head"].axis == 1
+
+
+def test_llama_embed_consumes_per_row_quantized_table():
+    """The embed gather must apply per-row scales row-wise (scale[tokens])
+    and match the dequantized-table reference."""
+    from tensorflowonspark_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="xla")
+    model = Llama(cfg)
+    tokens = jnp.arange(8, dtype=jnp.int32).reshape(1, 8)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    qparams = quant.quantize_tree(params, min_size=1 << 12)
+    if not isinstance(qparams["embed"], quant.QuantTensor):
+        qparams = dict(qparams, embed=quant.quantize(params["embed"], axis=0))
+    assert qparams["embed"].axis == 0
+
+    deq = dict(qparams, embed=quant.dequantize(qparams["embed"], jnp.float32))
+    out_q = model.apply({"params": qparams}, tokens)
+    out_d = model.apply({"params": deq}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out_q), np.asarray(out_d), rtol=2e-2, atol=2e-2
+    )

@@ -277,3 +277,27 @@ def test_saveAsTextFiles_and_pprint(tmp_path, capfd):
     out = capfd.readouterr().out
     assert "micro-batch @" in out
     assert "... (1 more)" in out  # 3 records, num=2
+
+
+def test_saveAsTextFiles_bumps_past_existing_destination(tmp_path, monkeypatch):
+    """A leftover destination dir with a colliding stamp must be skipped
+    (stamp bumped), not crash os.rename in the scheduler thread."""
+    import tensorflowonspark_tpu.streaming as streaming_mod
+
+    monkeypatch.setattr(streaming_mod.time, "time", lambda: 1.0)
+    stamp = int(1.0 * 1000)
+    (tmp_path / f"out-{stamp}.txt").mkdir()  # prior run's output
+    (tmp_path / f".out-{stamp + 1}.txt.tmp").mkdir()  # in-flight temp
+
+    ssc = StreamingContext(batch_interval=0.05)
+    src = ssc.queueStream([[1, 2]])
+    src.saveAsTextFiles(str(tmp_path / "out"), suffix="txt")
+    ssc.start()
+    # monotonic: the time.time monkeypatch above is process-wide, so a
+    # time.time-based deadline would be pinned at 1.0 and never expire
+    deadline = time.monotonic() + 10
+    expect = tmp_path / f"out-{stamp + 2}.txt"
+    while not expect.exists() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    ssc.stop()
+    assert (expect / "part-00000").read_text() == "1\n2\n"

@@ -350,3 +350,14 @@ def test_run_with_restarts_rejects_spark_mode():
         tfcluster.run_with_restarts(
             cluster_fns.sum_fn, {}, num_executors=1, max_restarts=1
         )
+
+
+def test_as_partitions_tiny_input_feeds_all_workers():
+    """len(data) <= num_workers must yield per-record partitions, not one
+    big partition that starves every worker but the first."""
+    from tensorflowonspark_tpu.cluster.tfcluster import _as_partitions
+
+    assert _as_partitions([(1,), (2,)], 4) == [[(1,)], [(2,)]]
+    assert _as_partitions([], 4) == []
+    # above the worker count: round-robin as before
+    assert _as_partitions(list(range(5)), 2) == [[0, 2, 4], [1, 3]]

@@ -72,3 +72,15 @@ def test_repeat_and_shuffle(record_dir):
     assert b["x"].shape == (16, 4)
     # shuffle actually reorders within the buffer
     assert not np.array_equal(np.sort(first["x"][:, 0]), first["x"][:, 0])
+
+
+def test_empty_input_raises_clear_error(tmp_path):
+    """Empty input must raise eagerly at call time (a fileless dir from
+    tfrecord_files, record-less shards from the schema probe), not an
+    opaque PEP 479 RuntimeError at first iteration."""
+    with pytest.raises(FileNotFoundError, match="no TFRecord files"):
+        tfdata_batches(str(tmp_path), batch_size=4)
+
+    (tmp_path / "part-00000").write_bytes(b"")  # shard with zero records
+    with pytest.raises(ValueError, match="contain no records"):
+        tfdata_batches(str(tmp_path), batch_size=4)
