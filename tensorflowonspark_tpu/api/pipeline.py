@@ -241,7 +241,13 @@ class TFEstimator(_HasParams):
         if int(args.input_mode) == InputMode.SPARK:
             cluster.train(data, num_epochs=int(args.epochs))
         cluster.shutdown(grace_secs=float(args.grace_secs))
-        return TFModel(self.args, export_fn=self.export_fn)
+        model = TFModel(self.args, export_fn=self.export_fn)
+        # transform inherits cluster_size from fit, so it also inherits
+        # fit's launcher/env: a model fitted under cpu_only_env must not
+        # scale out its inference through TPU-dialing default workers
+        model._fit_launcher = launcher
+        model._fit_env = env
+        return model
 
     def _rowdict(self, row) -> dict[str, Any]:
         """Tuple row → dict keyed by input_mapping columns (the positional
@@ -349,14 +355,25 @@ class TFModel(_HasParams):
             TFModel._singleton_shardable = True
         return TFModel._singleton
 
-    def transform(self, data: Iterable) -> list[Any]:
+    def transform(self, data: Iterable, launcher=None, env=None) -> list[Any]:
         """Map records through the model in batches, preserving order.
 
-        On multi-device hosts the export_fn path runs data-parallel: each
-        batch is sharded over the local devices (ragged tails padded with
-        the last record, trimmed from the output). AOT artifacts replay a
-        fixed StableHLO program and keep single-device placement.
+        ``cluster_size > 1`` scales out like the reference's
+        ``TFModel._transform`` (which ran ``_run_model`` on every
+        executor over its partitions, ``pipeline.py`` §3.4): a cluster
+        of worker processes each load the model ONCE (per-node
+        singleton) and serve partitions through the order-preserving
+        ``cluster.inference`` plumbing. ``launcher``/``env`` pass
+        through to ``tfcluster.run`` in that mode.
+
+        Single-process (``cluster_size == 1``): on multi-device hosts
+        the export_fn path runs data-parallel — each batch is sharded
+        over the local devices (ragged tails padded with the last
+        record, trimmed from the output). AOT artifacts replay a fixed
+        StableHLO program and keep single-device placement.
         """
+        if int(self.args.cluster_size) > 1:
+            return self._transform_distributed(data, launcher, env)
         import jax as _jax
 
         apply_fn, state = self._load()
@@ -398,11 +415,72 @@ class TFModel(_HasParams):
             out.extend(self._rowize(result, n))
         return out
 
+    def _transform_distributed(self, data: Iterable, launcher, env) -> list[Any]:
+        """Scale-out transform over a cluster of per-node model singletons."""
+        from tensorflowonspark_tpu.cluster import tfcluster
+        from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+
+        if launcher is None:
+            launcher = getattr(self, "_fit_launcher", None)
+        if env is None:
+            env = getattr(self, "_fit_env", None)
+        node_args = Namespace(dict(self.args))
+        # the node runs the LOCAL path; without this every node would
+        # recursively launch its own cluster
+        node_args["cluster_size"] = 1
+        # module-level export_fns pickle by qualified name to the
+        # spawned node processes, exactly like the map_fun itself
+        node_args["_export_fn"] = self.export_fn
+        n = int(self.args.cluster_size)
+        # Partition explicitly, every element a RECORD: handing the flat
+        # iterable to inference would let _as_partitions reinterpret
+        # list-typed records as partitions, silently diverging from the
+        # local path's row semantics.
+        records = list(data)
+        k, m = divmod(len(records), n)
+        bounds = [i * k + min(i, m) for i in range(n + 1)]
+        partitions = [
+            records[bounds[i] : bounds[i + 1]]
+            for i in range(n)
+            if bounds[i] < bounds[i + 1]
+        ]
+        cluster = tfcluster.run(
+            _transform_node_fn,
+            node_args,
+            num_executors=n,
+            input_mode=InputMode.SPARK,
+            reservation_timeout=float(self.args.reservation_timeout),
+            launcher=launcher,
+            env=env,
+        )
+        try:
+            return cluster.inference(partitions)
+        finally:
+            cluster.shutdown(grace_secs=float(self.args.grace_secs))
+
     def _columnize(self, chunk: Sequence[Any]):
         return columnize(chunk, self.args.input_mapping)
 
     def _rowize(self, result: Any, n: int) -> list[Any]:
         return rowize(result, n, self.args.output_mapping)
+
+
+def _transform_node_fn(args, ctx):
+    """Per-node worker for the distributed :meth:`TFModel.transform`.
+
+    Loads the model once (the TFModel singleton lives per node process —
+    the reference's per-executor SavedModel-session pattern), then serves
+    fed partitions through the equal-count inference contract: exactly
+    one result per input record, in order.
+    """
+    export_fn = args.pop("_export_fn", None)
+    model = TFModel(args, export_fn=export_fn)
+    feed = ctx.get_data_feed(train_mode=False)
+    batch_size = int(args.batch_size)
+    while not feed.should_stop():
+        batch = feed.next_batch(batch_size)
+        if batch:
+            feed.batch_results(model.transform(batch))
 
 
 def columnize(chunk: Sequence[Any], mapping: dict[str, str] | None):

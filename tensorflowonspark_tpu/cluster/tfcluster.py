@@ -403,7 +403,9 @@ class TFCluster:
         """
         self._require_spark_mode("inference")
         workers = self.workers
-        partitions = _as_partitions(data, len(workers))
+        # contiguous: partition-order reassembly then preserves flat
+        # input order end-to-end
+        partitions = _as_partitions(data, len(workers), contiguous=True)
         results: dict[int, list[Any]] = {}
         errors: list[BaseException] = []
         lock = threading.Lock()
@@ -760,16 +762,23 @@ def _abort_if_node_died(launcher, remaining: int) -> None:
         )
 
 
-def _as_partitions(data: Iterable, num_workers: int) -> list[list[Any]]:
+def _as_partitions(
+    data: Iterable, num_workers: int, contiguous: bool = False
+) -> list[list[Any]]:
     """Normalize user data into a list of record-list partitions.
 
     Convention (documented in ``TFCluster.train``): if every element is a
     ``list`` or an iterator/generator, the elements ARE the partitions
     (generators are drained); otherwise the whole iterable is a flat
-    sequence of records, split round-robin into ``num_workers`` partitions
-    so every worker receives data. Records may be tuples, arrays, dicts, or
-    scalars — use tuples (not lists) for row records, exactly as a
-    DataFrame ``Row`` would arrive in the reference.
+    sequence of records, split into ``num_workers`` partitions so every
+    worker receives data — round-robin by default (train: strided
+    samples keep per-worker batch statistics close to the input
+    distribution), CONTIGUOUS near-equal when ``contiguous=True``
+    (inference: results are reassembled in partition order, so
+    contiguous splits are what make the order-preserving contract hold
+    for flat inputs). Records may be tuples, arrays, dicts, or scalars
+    — use tuples (not lists) for row records, exactly as a DataFrame
+    ``Row`` would arrive in the reference.
     """
     data = list(data)
     if data and all(
@@ -781,4 +790,8 @@ def _as_partitions(data: Iterable, num_workers: int) -> list[list[Any]]:
         # worker 0 and leave every other worker blocking until shutdown
         # (harmless at scale, baffling in smoke tests).
         return [[r] for r in data]
-    return [data[i::num_workers] for i in range(num_workers)]
+    if not contiguous:
+        return [data[i::num_workers] for i in range(num_workers)]
+    k, m = divmod(len(data), num_workers)
+    bounds = [i * k + min(i, m) for i in range(num_workers + 1)]
+    return [data[bounds[i] : bounds[i + 1]] for i in range(num_workers)]

@@ -30,7 +30,7 @@ def test_estimator_fit_transform(tmp_path):
 
     est = TFEstimator(
         cluster_fns.estimator_train_fn,
-        cluster_size=1,
+        cluster_size=2,
         epochs=4,
         export_dir=export_dir,
         batch_size=32,
@@ -42,6 +42,9 @@ def test_estimator_fit_transform(tmp_path):
     assert isinstance(model, TFModel)
 
     model.export_fn = cluster_fns.estimator_export_fn
+    # cluster_size=2 inherited from fit: transform scales out over a
+    # 2-node cluster and MUST inherit fit's env (cpu_only_env) — no
+    # env kwarg here, yet no node may dial the TPU
     preds = model.transform([(v,) for v in [0.0, 1.0, 2.0]])
     preds = [float(p) for p in preds]
     assert abs(preds[0] - (-1.0)) < 0.3
@@ -150,3 +153,34 @@ def test_has_param_accessor_arity():
         est.setBatchSize(128, "steps")
     with pytest.raises(TypeError):
         est.getBatchSize("epochs")
+
+
+def test_transform_distributed_matches_local(tmp_path):
+    """cluster_size=2 routes transform over cluster nodes (per-node model
+    singletons + order-preserving inference plumbing); outputs must match
+    the local path's exactly, in input order. VERDICT round-1 item 6."""
+    from tensorflowonspark_tpu.compute.checkpoint import save_checkpoint
+
+    export_dir = str(tmp_path / "export")
+    save_checkpoint(export_dir, {"w": np.float32(3.0), "b": np.float32(-1.0)})
+
+    xs = [[float(v)] for v in np.linspace(-2, 2, 37)]  # odd count; LIST
+    # records: the distributed path must not reinterpret them as partitions
+
+    local = TFModel(
+        export_dir=export_dir,
+        batch_size=8,
+        export_fn=cluster_fns.estimator_export_fn,
+    ).transform(xs)
+
+    dist = TFModel(
+        export_dir=export_dir,
+        batch_size=8,
+        cluster_size=2,
+        export_fn=cluster_fns.estimator_export_fn,
+    ).transform(xs, env=cpu_only_env())
+
+    assert len(dist) == len(local) == 37
+    np.testing.assert_allclose(
+        [float(p) for p in dist], [float(p) for p in local], rtol=1e-6
+    )

@@ -359,5 +359,11 @@ def test_as_partitions_tiny_input_feeds_all_workers():
 
     assert _as_partitions([(1,), (2,)], 4) == [[(1,)], [(2,)]]
     assert _as_partitions([], 4) == []
-    # above the worker count: round-robin as before
+    # train default: round-robin (strided per-worker samples)
     assert _as_partitions(list(range(5)), 2) == [[0, 2, 4], [1, 3]]
+    # inference: CONTIGUOUS near-equal splits, so partition-order
+    # reassembly preserves record order
+    assert _as_partitions(list(range(5)), 2, contiguous=True) == [
+        [0, 1, 2],
+        [3, 4],
+    ]
