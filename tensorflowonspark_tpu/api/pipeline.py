@@ -243,9 +243,9 @@ class TFEstimator(_HasParams):
         cluster.shutdown(grace_secs=float(args.grace_secs))
         model = TFModel(self.args, export_fn=self.export_fn)
         # transform inherits cluster_size from fit, so it also inherits
-        # fit's launcher/env: a model fitted under cpu_only_env must not
-        # scale out its inference through TPU-dialing default workers
-        model._fit_launcher = launcher
+        # fit's env: a model fitted under cpu_only_env must not scale
+        # out its inference through TPU-dialing default workers. (The
+        # launcher instance is NOT inherited — launchers are single-use.)
         model._fit_env = env
         return model
 
@@ -420,8 +420,10 @@ class TFModel(_HasParams):
         from tensorflowonspark_tpu.cluster import tfcluster
         from tensorflowonspark_tpu.cluster.tfcluster import InputMode
 
-        if launcher is None:
-            launcher = getattr(self, "_fit_launcher", None)
+        # env (an inert dict) is inherited from fit; a launcher INSTANCE
+        # is not — launchers are single-use (their proc tables outlive a
+        # cluster; see run_with_restarts' fresh-launcher requirement), so
+        # scaled-out transform over custom hosts takes its own launcher.
         if env is None:
             env = getattr(self, "_fit_env", None)
         node_args = Namespace(dict(self.args))
@@ -431,23 +433,21 @@ class TFModel(_HasParams):
         # module-level export_fns pickle by qualified name to the
         # spawned node processes, exactly like the map_fun itself
         node_args["_export_fn"] = self.export_fn
-        n = int(self.args.cluster_size)
         # Partition explicitly, every element a RECORD: handing the flat
         # iterable to inference would let _as_partitions reinterpret
         # list-typed records as partitions, silently diverging from the
         # local path's row semantics.
         records = list(data)
-        k, m = divmod(len(records), n)
-        bounds = [i * k + min(i, m) for i in range(n + 1)]
-        partitions = [
-            records[bounds[i] : bounds[i + 1]]
-            for i in range(n)
-            if bounds[i] < bounds[i + 1]
-        ]
+        if not records:
+            return []
+        partitions = tfcluster.contiguous_split(
+            records, int(self.args.cluster_size)
+        )
         cluster = tfcluster.run(
             _transform_node_fn,
             node_args,
-            num_executors=n,
+            # don't pay whole-cluster startup for workers with no records
+            num_executors=len(partitions),
             input_mode=InputMode.SPARK,
             reservation_timeout=float(self.args.reservation_timeout),
             launcher=launcher,

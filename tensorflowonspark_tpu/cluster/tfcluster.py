@@ -792,6 +792,19 @@ def _as_partitions(
         return [[r] for r in data]
     if not contiguous:
         return [data[i::num_workers] for i in range(num_workers)]
-    k, m = divmod(len(data), num_workers)
-    bounds = [i * k + min(i, m) for i in range(num_workers + 1)]
-    return [data[bounds[i] : bounds[i + 1]] for i in range(num_workers)]
+    return contiguous_split(data, num_workers)
+
+
+def contiguous_split(records: list, n: int) -> list[list[Any]]:
+    """Split ``records`` into at most ``n`` contiguous near-equal
+    partitions (sizes differ by at most one, empties dropped).
+    Contiguity is what makes partition-order reassembly — the
+    ``inference``/distributed-``transform`` result path — preserve the
+    original record order."""
+    k, m = divmod(len(records), n)
+    bounds = [i * k + min(i, m) for i in range(n + 1)]
+    return [
+        records[bounds[i] : bounds[i + 1]]
+        for i in range(n)
+        if bounds[i] < bounds[i + 1]
+    ]
