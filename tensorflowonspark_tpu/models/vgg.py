@@ -63,13 +63,15 @@ class VGG(nn.Module):
                     feats, (3, 3), padding="SAME", use_bias=False,
                     dtype=cfg.dtype,
                 )(x)
+                # normalize in the model dtype; stats stay fp32 (same
+                # bandwidth fix + rationale as models/resnet.py:_ConvBN)
                 x = nn.BatchNorm(
                     use_running_average=not train,
                     momentum=0.9,
                     epsilon=1e-5,
-                    dtype=jnp.float32,
+                    dtype=cfg.dtype,
                 )(x)
-                x = nn.relu(x).astype(cfg.dtype)
+                x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape(x.shape[0], -1)  # flatten the final grid (fc6 input)
         x = nn.relu(nn.Dense(cfg.fc_features, dtype=cfg.dtype)(x))
