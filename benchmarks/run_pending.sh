@@ -1,0 +1,52 @@
+#!/bin/bash
+# The round-2 pending real-chip measurements (BASELINE.md / docs/PARITY.md
+# known-gaps list), batched so one relay window covers them all.
+#
+# Run ONLY when the TPU relay is up:
+#   ss -tln | grep -E ':(808[0-9]|81[01][0-9]) '
+# and with NOTHING else dialing the relay (one python process at a time —
+# a concurrent dial wedges the single-chip session; see the verify skill's
+# environment notes). Never SIGKILL a run mid-compile: the watchdogged
+# bench exits on its own, and a SIGKILLed dialer can take the relay down
+# for hours.
+#
+# Results append to $OUT (one JSON line each, tagged by config).
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-/tmp/pending_measurements.jsonl}
+
+# Refuse to dial a down relay (a wedged dial can take it down for hours).
+if ! ss -tln | grep -qE ':(808[2-9]|809[0-9]|810[0-9]|811[0-7]) '; then
+  echo "TPU relay ports 8082-8117 not listening; aborting before any dial" >&2
+  exit 1
+fi
+if pgrep -f "real_chip.py|bench.py" >/dev/null 2>&1; then
+  echo "another benchmark process is already running (one dialer at a time)" >&2
+  exit 1
+fi
+
+run() {
+  echo "=== $* ===" >&2
+  timeout 900 "$@" | tee -a "$OUT"
+  echo >&2
+}
+
+# 1. end-to-end bench.py with the bf16-moment default (BENCH_r02 headline)
+run python bench.py
+
+# 2. ResNet-50 with the round-2 bf16 BN-normalize fix (was 15.8% MFU)
+run python benchmarks/real_chip.py --config resnet50
+
+# 3. Inception-v3 — the reference's headline scaling model
+run python benchmarks/real_chip.py --config inception_v3
+
+# 4. seq-4096 training with chunked CE (flash attention + remat)
+run python benchmarks/real_chip.py --config llama1b --seq 4096 \
+  --logit-chunk 512 --moments bf16
+
+# 5. int8 weight-only decode (expect up to ~2x tokens/sec: decode is
+#    weight-read-bound)
+run python benchmarks/real_chip.py --config llama1b_decode --quantize
+run python benchmarks/real_chip.py --config llama1b_decode
+
+echo "all pending measurements attempted; results in $OUT" >&2
