@@ -83,23 +83,24 @@ def dot_product_attention(
                 "the train-step trace) in "
                 "tensorflowonspark_tpu.parallel.use_mesh"
             )
-        if segment_ids is not None:
-            raise NotImplementedError(
-                f"{impl} attention does not support segment_ids yet"
-            )
         if mesh.shape.get("seq", 1) == 1 and mesh.shape.get("model", 1) == 1:
             return _jitted_attention(
-                q, k, v, causal=causal, scale=scale, impl="auto"
+                q, k, v, causal=causal, scale=scale,
+                segment_ids=segment_ids, impl="auto",
             )
         if impl == "ring":
             from tensorflowonspark_tpu.parallel import mesh_ring_attention
 
             return mesh_ring_attention(
-                q, k, v, mesh, causal=causal, scale=scale
+                q, k, v, mesh, causal=causal, scale=scale,
+                segment_ids=segment_ids,
             )
         from tensorflowonspark_tpu.parallel import mesh_ulysses_attention
 
-        return mesh_ulysses_attention(q, k, v, mesh, causal=causal, scale=scale)
+        return mesh_ulysses_attention(
+            q, k, v, mesh, causal=causal, scale=scale,
+            segment_ids=segment_ids,
+        )
     return _jitted_attention(
         q, k, v, causal=causal, scale=scale,
         segment_ids=segment_ids, impl=impl,

@@ -34,3 +34,19 @@ def use_mesh(mesh: Mesh):
         yield mesh
     finally:
         _state.mesh = prev
+
+
+def sp_specs_and_args(base_spec, q, k, v, segment_ids=None):
+    """Assemble shard_map ``(in_specs, args)`` for a sequence-parallel
+    attention call with an optional ``(B, S)`` segment-id operand (its
+    spec reuses the batch/seq axes of ``base_spec``). Shared by the ring
+    and Ulysses front-ends so the optional-operand wiring cannot
+    diverge."""
+    from jax.sharding import PartitionSpec as P
+
+    in_specs: tuple = (base_spec, base_spec, base_spec)
+    args: tuple = (q, k, v)
+    if segment_ids is not None:
+        in_specs = in_specs + (P(base_spec[0], base_spec[1]),)
+        args = args + (segment_ids,)
+    return in_specs, args

@@ -37,13 +37,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _block_attend(qg, k, v, q_pos, k_pos, m, l, acc, *, causal, scale):
+def _block_attend(
+    qg, k, v, q_pos, k_pos, m, l, acc, *, causal, scale,
+    q_seg=None, k_seg=None,
+):
     """One online-softmax accumulation step against a K/V block.
 
     GQA stays grouped throughout — no ``jnp.repeat`` of K/V per device per
     ring step. qg (B,Sq,Hk,G,D) fp-any; k/v (B,Sk,Hk,D); q_pos (Sq,),
     k_pos (Sk,) global positions; m/l (B,Hk,G,Sq,1) fp32 running max /
-    normaliser; acc (B,Hk,G,Sq,D) fp32 running numerator.
+    normaliser; acc (B,Hk,G,Sq,D) fp32 running numerator; q_seg (B,Sq) /
+    k_seg (B,Sk) optional packed-sequence segment ids (cross-segment
+    pairs are masked; fully-masked rows stay exact via the NEG_INF
+    guards below).
     """
     s = jnp.einsum(
         "bqhgd,bkhd->bhgqk",
@@ -54,6 +60,9 @@ def _block_attend(qg, k, v, q_pos, k_pos, m, l, acc, *, causal, scale):
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]
         s = jnp.where(mask[None, None, None], s, NEG_INF)
+    if q_seg is not None:
+        seg_mask = q_seg[:, :, None] == k_seg[:, None, :]  # (B, Sq, Sk)
+        s = jnp.where(seg_mask[:, None, None], s, NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     # Guard fully-masked rows: keep the running max finite once anything
     # has been seen; before that, exp(NEG_INF - NEG_INF) must not be 1.
@@ -76,6 +85,7 @@ def ring_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    segment_ids: jax.Array | None = None,
     *,
     axis_name: str = "seq",
     causal: bool = True,
@@ -85,8 +95,11 @@ def ring_attention(
 
     Shapes are per-device shards: q (B, S_loc, Hq, D), k/v (B, S_loc,
     Hkv, D) — the global sequence is ``S_loc * axis_size`` with this
-    device owning block ``axis_index``. Returns the local output shard
-    (B, S_loc, Hq, D) in q's dtype.
+    device owning block ``axis_index``. ``segment_ids`` (B, S_loc),
+    sequence-sharded like q, masks cross-segment attention for packed
+    sequences; the K-side ids rotate around the ring with their K/V
+    block. Returns the local output shard (B, S_loc, Hq, D) in q's
+    dtype.
     """
     b, s_loc, hq, d = q.shape
     hk = k.shape[2]
@@ -107,29 +120,42 @@ def ring_attention(
     l0 = jnp.zeros((b, hk, group, s_loc, 1), jnp.float32)
     acc0 = jnp.zeros((b, hk, group, s_loc, d), jnp.float32)
 
+    # The K-side segment ids travel with their K/V block; a zero-size
+    # placeholder keeps the scan carry structure static when unused.
+    k_seg0 = (
+        segment_ids
+        if segment_ids is not None
+        else jnp.zeros((b, 0), jnp.int32)
+    )
+
     # Step 0 attends the locally-owned (diagonal) block with no permute;
     # the scan then rotates-and-attends n-1 times, so exactly n-1 permute
     # pairs go around the ring (none after the last block is consumed).
     m, l, acc = _block_attend(  # diagonal block: k_pos == q_pos
         qg, k, v, q_pos, q_pos, m0, l0, acc0, causal=causal, scale=scale,
+        q_seg=segment_ids, k_seg=segment_ids,
     )
 
     @jax.checkpoint
     def step(carry, t):
-        k_blk, v_blk, m, l, acc = carry
+        k_blk, v_blk, k_seg, m, l, acc = carry
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
+        if segment_ids is not None:
+            k_seg = lax.ppermute(k_seg, axis_name, perm)
         src = (idx - t) % n  # owner of the block just received
         k_pos = src * s_loc + local_pos
         m, l, acc = _block_attend(
             qg, k_blk, v_blk, q_pos, k_pos, m, l, acc,
             causal=causal, scale=scale,
+            q_seg=segment_ids,
+            k_seg=k_seg if segment_ids is not None else None,
         )
-        return (k_blk, v_blk, m, l, acc), None
+        return (k_blk, v_blk, k_seg, m, l, acc), None
 
     if n > 1:
-        (_, _, m, l, acc), _ = lax.scan(
-            step, (k, v, m, l, acc), jnp.arange(1, n, dtype=jnp.int32)
+        (_, _, _, m, l, acc), _ = lax.scan(
+            step, (k, v, k_seg0, m, l, acc), jnp.arange(1, n, dtype=jnp.int32)
         )
     out = acc / jnp.maximum(l, 1e-30)  # (B, Hk, G, Sq, D)
     out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, s_loc, hq, d)
@@ -145,6 +171,7 @@ def mesh_ring_attention(
     causal: bool = True,
     scale: float | None = None,
     seq_axis: str = "seq",
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Global-view ring attention: shard_map over the mesh's ``seq`` axis.
 
@@ -152,16 +179,21 @@ def mesh_ring_attention(
     ``(data, fsdp)``, heads over ``model`` (tensor parallelism composes —
     attention is head-independent), sequence over ``seq``. Requires S
     divisible by the seq-axis size and heads divisible by the model-axis
-    size.
+    size. ``segment_ids`` (B, S) masks cross-segment attention for
+    packed sequences.
     """
+    from tensorflowonspark_tpu.parallel.context import sp_specs_and_args
+
     qspec = P(("data", "fsdp"), seq_axis, "model", None)
+    body = functools.partial(
+        ring_attention, axis_name=seq_axis, causal=causal, scale=scale
+    )
+    in_specs, args = sp_specs_and_args(qspec, q, k, v, segment_ids)
     fn = jax.shard_map(
-        functools.partial(
-            ring_attention, axis_name=seq_axis, causal=causal, scale=scale
-        ),
+        body,
         mesh=mesh,
-        in_specs=(qspec, qspec, qspec),
+        in_specs=in_specs,
         out_specs=qspec,
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(*args)

@@ -32,6 +32,7 @@ def _ulysses_local(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    segment_ids: jax.Array | None = None,
     *,
     axis_name: str,
     causal: bool,
@@ -40,8 +41,9 @@ def _ulysses_local(
 ):
     """Per-device body; call under ``shard_map``.
 
-    Shards: q (B, S_loc, Hq, D), k/v (B, S_loc, Hkv, D). Heads must be
-    divisible by the axis size (enforced by the caller).
+    Shards: q (B, S_loc, Hq, D), k/v (B, S_loc, Hkv, D), segment_ids
+    (B, S_loc). Heads must be divisible by the axis size (enforced by
+    the caller).
     """
     from tensorflowonspark_tpu.ops.attention import dot_product_attention
 
@@ -53,8 +55,18 @@ def _ulysses_local(
         )
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    seg_full = None
+    if segment_ids is not None:
+        # After the reshard each device attends over the FULL sequence
+        # (for its head subset), so it needs the full segment-id row —
+        # an all-gather of a (B, S_loc) int32 array, negligible next to
+        # the activation all-to-alls.
+        seg_full = lax.all_gather(
+            segment_ids, axis_name, axis=1, tiled=True
+        )
     out = dot_product_attention(
-        qh, kh, vh, causal=causal, scale=scale, impl=impl
+        qh, kh, vh, causal=causal, scale=scale, impl=impl,
+        segment_ids=seg_full,
     )
     # head-sharded -> seq-sharded: the inverse resharding.
     return lax.all_to_all(
@@ -72,13 +84,15 @@ def mesh_ulysses_attention(
     scale: float | None = None,
     seq_axis: str = "seq",
     impl: str = "auto",
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Global-view Ulysses attention: shard_map over the mesh ``seq`` axis.
 
     Inputs are global arrays (B, S, H, D); batch shards over
     ``(data, fsdp)``, sequence over ``seq``, heads over ``model`` (TP
     composes as usual). Requires S and *both* head counts divisible by the
-    seq-axis size.
+    seq-axis size. ``segment_ids`` (B, S) masks cross-segment attention
+    for packed sequences.
     """
     n = mesh.shape.get(seq_axis, 1)
     tp = mesh.shape.get("model", 1)
@@ -91,18 +105,22 @@ def mesh_ulysses_attention(
             f"by model x {seq_axis} ({tp} x {n}); use ring attention for "
             "head-poor configs"
         )
+    from tensorflowonspark_tpu.parallel.context import sp_specs_and_args
+
     spec = P(("data", "fsdp"), seq_axis, "model", None)
+    body = functools.partial(
+        _ulysses_local,
+        axis_name=seq_axis,
+        causal=causal,
+        scale=scale,
+        impl=impl,
+    )
+    in_specs, args = sp_specs_and_args(spec, q, k, v, segment_ids)
     fn = jax.shard_map(
-        functools.partial(
-            _ulysses_local,
-            axis_name=seq_axis,
-            causal=causal,
-            scale=scale,
-            impl=impl,
-        ),
+        body,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=in_specs,
         out_specs=spec,
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(*args)
