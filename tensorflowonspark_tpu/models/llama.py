@@ -296,6 +296,11 @@ class Llama(nn.Module):
         ``mutable=["cache"]``; see :func:`generate`): ``positions`` must
         then be the absolute positions of ``tokens`` in the sequence.
 
+        ``segment_ids`` (B, S) marks packed documents: attention is
+        masked by id EQUALITY and RoPE positions restart at adjacency
+        boundaries, so ids must be unique per document within a row
+        (:func:`llama_loss_fn` canonicalizes adjacency runs for you).
+
         ``return_hidden=True`` returns ``(hidden, lm_head)`` instead of
         logits — the final-norm hidden states (B, S, H) and the untied
         head weight — so callers can run the vocab projection in chunks
@@ -304,9 +309,26 @@ class Llama(nn.Module):
         """
         cfg = self.cfg
         if positions is None:
-            positions = jnp.broadcast_to(
+            idx = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
             )
+            if segment_ids is None:
+                positions = idx
+            else:
+                # Packed sequences: RoPE positions restart at each
+                # document boundary. A position's document start is the
+                # running max of boundary indices up to it.
+                new_doc = jnp.concatenate(
+                    [
+                        jnp.ones_like(segment_ids[:, :1], dtype=bool),
+                        segment_ids[:, 1:] != segment_ids[:, :-1],
+                    ],
+                    axis=1,
+                )
+                doc_start = jax.lax.cummax(
+                    jnp.where(new_doc, idx, 0), axis=1
+                )
+                positions = idx - doc_start
         embed = self.param(
             "embed",
             nn.initializers.normal(0.02),
@@ -552,18 +574,50 @@ def llama_loss_fn(model: "Llama", logit_chunk: int | None = None):
     recomputes each chunk's logits). At seq 4096 / vocab 32000 / b 8 the
     full logits alone are 4.2 GB of HBM — this trades one extra head
     matmul pass for that footprint. Must divide the sequence length.
+
+    Packed sequences: pass ``segment_ids`` (B, S+1), aligned with
+    ``tokens``. Attention is masked within documents (every impl incl.
+    ring/Ulysses SP), and positions whose NEXT token belongs to a
+    different document are dropped from the loss — a document's last
+    token must not be trained to predict the next document's first.
     """
 
-    def loss(params, tokens):
+    def loss(params, tokens, segment_ids=None):
+        if segment_ids is not None:
+            # Canonicalize adjacency runs into per-row document indices:
+            # attention masks by id EQUALITY, so a packer that reuses an
+            # id for a later document (e.g. [0,0,1,1,0,0]) would
+            # silently leak attention between the two id-0 documents.
+            new_doc = segment_ids[:, 1:] != segment_ids[:, :-1]
+            segment_ids = jnp.concatenate(
+                [
+                    jnp.zeros_like(segment_ids[:, :1]),
+                    jnp.cumsum(new_doc.astype(jnp.int32), axis=1),
+                ],
+                axis=1,
+            )
+        seg_in = None if segment_ids is None else segment_ids[:, :-1]
+        # valid target: next token continues the same document
+        mask = (
+            None
+            if segment_ids is None
+            else (segment_ids[:, :-1] == segment_ids[:, 1:]).astype(
+                jnp.float32
+            )
+        )
         if logit_chunk is None:
             logits, state = model.apply(
-                {"params": params}, tokens[:, :-1], mutable=["losses"]
+                {"params": params},
+                tokens[:, :-1],
+                segment_ids=seg_in,
+                mutable=["losses"],
             )
-            total = cross_entropy_loss(logits, tokens[:, 1:])
+            total = cross_entropy_loss(logits, tokens[:, 1:], mask)
         else:
             (hidden, head), state = model.apply(
                 {"params": params},
                 tokens[:, :-1],
+                segment_ids=seg_in,
                 return_hidden=True,
                 mutable=["losses"],
             )
@@ -574,25 +628,29 @@ def llama_loss_fn(model: "Llama", logit_chunk: int | None = None):
                 )
             targets = tokens[:, 1:]
             head16 = head.astype(hidden.dtype)
+            mc = jnp.ones((b, s), jnp.float32) if mask is None else mask
 
             @jax.checkpoint
-            def chunk_nll_sum(hc, tc):
+            def chunk_nll_sum(hc, tc, mk):
                 # (B, C, H) @ (H, V) -> fp32 logits for this chunk only
                 logits = (hc @ head16).astype(jnp.float32)
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 nll = -jnp.take_along_axis(logp, tc[..., None], axis=-1)
-                return jnp.sum(nll)
+                return jnp.sum(nll[..., 0] * mk)
 
             n_chunks = s // logit_chunk
             hs = hidden.reshape(b, n_chunks, logit_chunk, h).swapaxes(0, 1)
             ts = targets.reshape(b, n_chunks, logit_chunk).swapaxes(0, 1)
+            ms = mc.reshape(b, n_chunks, logit_chunk).swapaxes(0, 1)
 
-            def body(acc, ht):
-                hc, tc = ht
-                return acc + chunk_nll_sum(hc, tc), None
+            def body(acc, htm):
+                hc, tc, mk = htm
+                return acc + chunk_nll_sum(hc, tc, mk), None
 
-            total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ts))
-            total = total / (b * s)
+            total, _ = jax.lax.scan(
+                body, jnp.zeros((), jnp.float32), (hs, ts, ms)
+            )
+            total = total / jnp.maximum(jnp.sum(mc), 1)
         for leaf in jax.tree.leaves(state.get("losses", {})):
             total = total + jnp.sum(leaf)
         return total

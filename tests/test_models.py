@@ -579,3 +579,64 @@ def test_llama_remat_policies_match_no_remat(policy):
     l, g = loss_and_grad(True, policy)
     assert float(l) == pytest.approx(float(base_loss), rel=1e-6)
     chex.assert_trees_all_close(g, base_grad, rtol=1e-5, atol=1e-6)
+
+
+def test_llama_packed_sequences_match_separate_docs(tiny_llama):
+    """Packing two documents into one row with segment_ids must give the
+    same total NLL as encoding each document separately: attention is
+    isolated per document, RoPE positions restart at each boundary, and
+    the boundary target (doc A's last token predicting doc B's first) is
+    dropped from the loss."""
+    cfg, model, params = tiny_llama
+    rng = np.random.default_rng(7)
+    a = rng.integers(0, cfg.vocab_size, size=9).astype(np.int32)  # doc A
+    b = rng.integers(0, cfg.vocab_size, size=8).astype(np.int32)  # doc B
+
+    packed = jnp.asarray(np.concatenate([a, b])[None])  # (1, 17)
+    seg = jnp.asarray(
+        np.concatenate([np.zeros(9, np.int32), np.ones(8, np.int32)])[None]
+    )
+
+    loss = llama_loss_fn(model)
+    packed_loss = float(loss(params, packed, segment_ids=seg))
+
+    # separate-document reference: per-doc mean NLL, recombined by
+    # target counts (8 targets in A, 7 in B; the boundary target is
+    # excluded from the packed loss by the mask)
+    la = float(loss(params, jnp.asarray(a[None])))
+    lb = float(loss(params, jnp.asarray(b[None])))
+    expected = (la * 8 + lb * 7) / 15
+    np.testing.assert_allclose(packed_loss, expected, rtol=1e-5)
+
+    # chunked CE agrees on the packed input too (17 -> 16 targets, 4|16)
+    chunked = llama_loss_fn(model, logit_chunk=4)
+    np.testing.assert_allclose(
+        float(chunked(params, packed, segment_ids=seg)),
+        packed_loss,
+        rtol=1e-5,
+    )
+
+
+def test_llama_packed_reused_ids_do_not_leak(tiny_llama):
+    """A packer that reuses a segment id for a later document (e.g.
+    [0,0,1,1,0,0]) must still get document isolation: llama_loss_fn
+    canonicalizes adjacency runs before the equality-based attention
+    mask sees them."""
+    cfg, model, params = tiny_llama
+    rng = np.random.default_rng(11)
+    docs = [
+        rng.integers(0, cfg.vocab_size, size=n).astype(np.int32)
+        for n in (6, 6, 5)
+    ]
+    packed = jnp.asarray(np.concatenate(docs)[None])  # (1, 17)
+    reused = np.concatenate(
+        [np.full(6, 0), np.full(6, 1), np.full(5, 0)]
+    ).astype(np.int32)[None]
+    unique = np.concatenate(
+        [np.full(6, 0), np.full(6, 1), np.full(5, 2)]
+    ).astype(np.int32)[None]
+
+    loss = llama_loss_fn(model)
+    l_reused = float(loss(params, packed, segment_ids=jnp.asarray(reused)))
+    l_unique = float(loss(params, packed, segment_ids=jnp.asarray(unique)))
+    np.testing.assert_allclose(l_reused, l_unique, rtol=1e-6)
