@@ -1,0 +1,125 @@
+"""Sequence packing — variable-length documents into fixed training rows.
+
+Long-context training wants every (B, S) slot doing useful work, but real
+corpora are variable-length: padding each document to S wastes compute
+quadratically with the length spread. Packing concatenates documents into
+rows of exactly ``seq_len + 1`` tokens alongside a ``segment_ids`` plane;
+the model layer (``llama_loss_fn(..., segment_ids=...)``) then isolates
+attention per document, restarts RoPE positions at each boundary, and
+drops the cross-document boundary targets from the loss — so a packed
+batch trains identically to the unpacked documents (guaranteed by
+``tests/test_models.py::test_llama_packed_sequences_match_separate_docs``).
+
+The reference had no packing (its examples padded fixed-shape image/MNIST
+batches; SURVEY.md §5.7 notes the absence of any long-sequence machinery).
+This is greedy first-fit-in-arrival-order packing — streaming-friendly
+(bounded buffer, documents emitted in arrival order), which matters
+because the data plane feeds from partition queues, not a random-access
+corpus.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator, Sequence
+
+import numpy as np
+
+
+def pack_sequences(
+    docs: Iterable[Sequence[int]],
+    seq_len: int,
+    *,
+    pad_id: int = 0,
+    drop_overlong: bool = False,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Greedily pack token documents into ``(seq_len + 1,)`` rows.
+
+    Yields ``{"tokens": (seq_len+1,) int32, "segment_ids": (seq_len+1,)
+    int32}`` — the ``+1`` is the next-token-loss shift, matching
+    ``llama_loss_fn``'s ``tokens (B, S+1)`` contract. Documents longer
+    than ``seq_len + 1`` are split across consecutive rows (their
+    continuation keeps training as one document per row but does NOT
+    attend across the row break — the standard packing tradeoff), or
+    skipped with ``drop_overlong=True``. Rows are flushed when the next
+    document does not fit; the final partial row is padded with
+    ``pad_id`` under segment id 0, which the loss machinery masks out
+    (padding never matches a real document's id because real ids start
+    at 1).
+    """
+    if seq_len < 1:
+        raise ValueError("seq_len must be >= 1")
+    row_len = seq_len + 1
+    tokens: list[int] = []
+    segs: list[int] = []
+    next_id = 1
+
+    def flush():
+        nonlocal tokens, segs, next_id
+        if not tokens:
+            return None
+        pad = row_len - len(tokens)
+        out = {
+            "tokens": np.asarray(
+                tokens + [pad_id] * pad, np.int32
+            ),
+            "segment_ids": np.asarray(segs + [0] * pad, np.int32),
+        }
+        tokens, segs = [], []
+        next_id = 1
+        return out
+
+    for doc in docs:
+        doc = list(doc)
+        if not doc:
+            continue
+        if drop_overlong and len(doc) > row_len:
+            continue
+        while doc:
+            space = row_len - len(tokens)
+            if space == 0 or (len(doc) > space and len(doc) <= row_len):
+                # doesn't fit, but fits a fresh row: flush, don't split
+                row = flush()
+                if row is not None:
+                    yield row
+                space = row_len
+            take = min(len(doc), space)
+            tokens.extend(doc[:take])
+            segs.extend([next_id] * take)
+            doc = doc[take:]
+            if doc:
+                # overlong document continues into the next row
+                row = flush()
+                if row is not None:
+                    yield row
+        next_id += 1
+
+    row = flush()
+    if row is not None:
+        yield row
+
+
+def pack_batches(
+    docs: Iterable[Sequence[int]],
+    batch_size: int,
+    seq_len: int,
+    *,
+    pad_id: int = 0,
+    drop_overlong: bool = False,
+    drop_remainder: bool = True,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Batch :func:`pack_sequences` rows into ``(B, seq_len+1)`` arrays
+    ready for ``shard_batch`` + ``llama_loss_fn(..., segment_ids=...)``.
+    ``drop_remainder`` keeps jit shapes static (the tail short batch is
+    dropped, like the reference's drop-remainder datasets)."""
+    rows: list[dict[str, np.ndarray]] = []
+    for row in pack_sequences(
+        docs, seq_len, pad_id=pad_id, drop_overlong=drop_overlong
+    ):
+        rows.append(row)
+        if len(rows) == batch_size:
+            yield {
+                k: np.stack([r[k] for r in rows]) for k in rows[0]
+            }
+            rows = []
+    if rows and not drop_remainder:
+        yield {k: np.stack([r[k] for r in rows]) for k in rows[0]}

@@ -165,6 +165,13 @@ class Attention(nn.Module):
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         if decode:
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "segment_ids with decode=True: the KV cache is not "
+                    "segment-masked, so packed-row prefill/scoring would "
+                    "silently attend across documents — decode one "
+                    "document per batch row instead"
+                )
             out = self._cached_attention(q, k, v, positions)
         else:
             out = dot_product_attention(
@@ -300,6 +307,8 @@ class Llama(nn.Module):
         masked by id EQUALITY and RoPE positions restart at adjacency
         boundaries, so ids must be unique per document within a row
         (:func:`llama_loss_fn` canonicalizes adjacency runs for you).
+        Training/scoring only — ``decode=True`` raises, since the KV
+        cache is not segment-masked.
 
         ``return_hidden=True`` returns ``(hidden, lm_head)`` instead of
         logits — the final-norm hidden states (B, S, H) and the untied
@@ -576,14 +585,20 @@ def llama_loss_fn(model: "Llama", logit_chunk: int | None = None):
     matmul pass for that footprint. Must divide the sequence length.
 
     Packed sequences: pass ``segment_ids`` (B, S+1), aligned with
-    ``tokens``. Attention is masked within documents (every impl incl.
-    ring/Ulysses SP), and positions whose NEXT token belongs to a
-    different document are dropped from the loss — a document's last
-    token must not be trained to predict the next document's first.
+    ``tokens`` (``data/packing.py`` produces both). Attention is masked
+    within documents (every impl incl. ring/Ulysses SP), positions whose
+    NEXT token belongs to a different document are dropped from the loss
+    — a document's last token must not be trained to predict the next
+    document's first — and segment id 0 marks padding (the t5x/maxtext
+    convention): padding positions never contribute loss.
     """
 
     def loss(params, tokens, segment_ids=None):
+        mask = None
         if segment_ids is not None:
+            # Segment id 0 marks PADDING (the t5x/maxtext convention;
+            # data/packing.py emits it): pad targets never train.
+            not_pad = (segment_ids[:, :-1] != 0).astype(jnp.float32)
             # Canonicalize adjacency runs into per-row document indices:
             # attention masks by id EQUALITY, so a packer that reuses an
             # id for a later document (e.g. [0,0,1,1,0,0]) would
@@ -596,15 +611,12 @@ def llama_loss_fn(model: "Llama", logit_chunk: int | None = None):
                 ],
                 axis=1,
             )
+            # valid target: next token continues the same document, and
+            # the position is not padding
+            mask = (
+                segment_ids[:, :-1] == segment_ids[:, 1:]
+            ).astype(jnp.float32) * not_pad
         seg_in = None if segment_ids is None else segment_ids[:, :-1]
-        # valid target: next token continues the same document
-        mask = (
-            None
-            if segment_ids is None
-            else (segment_ids[:, :-1] == segment_ids[:, 1:]).astype(
-                jnp.float32
-            )
-        )
         if logit_chunk is None:
             logits, state = model.apply(
                 {"params": params},

@@ -593,8 +593,11 @@ def test_llama_packed_sequences_match_separate_docs(tiny_llama):
     b = rng.integers(0, cfg.vocab_size, size=8).astype(np.int32)  # doc B
 
     packed = jnp.asarray(np.concatenate([a, b])[None])  # (1, 17)
+    # ids start at 1: segment id 0 means PADDING and is dropped from loss
     seg = jnp.asarray(
-        np.concatenate([np.zeros(9, np.int32), np.ones(8, np.int32)])[None]
+        np.concatenate([np.full(9, 1, np.int32), np.full(8, 2, np.int32)])[
+            None
+        ]
     )
 
     loss = llama_loss_fn(model)
@@ -619,7 +622,7 @@ def test_llama_packed_sequences_match_separate_docs(tiny_llama):
 
 def test_llama_packed_reused_ids_do_not_leak(tiny_llama):
     """A packer that reuses a segment id for a later document (e.g.
-    [0,0,1,1,0,0]) must still get document isolation: llama_loss_fn
+    [1,1,2,2,1,1]) must still get document isolation: llama_loss_fn
     canonicalizes adjacency runs before the equality-based attention
     mask sees them."""
     cfg, model, params = tiny_llama
@@ -630,10 +633,10 @@ def test_llama_packed_reused_ids_do_not_leak(tiny_llama):
     ]
     packed = jnp.asarray(np.concatenate(docs)[None])  # (1, 17)
     reused = np.concatenate(
-        [np.full(6, 0), np.full(6, 1), np.full(5, 0)]
+        [np.full(6, 1), np.full(6, 2), np.full(5, 1)]
     ).astype(np.int32)[None]
     unique = np.concatenate(
-        [np.full(6, 0), np.full(6, 1), np.full(5, 2)]
+        [np.full(6, 1), np.full(6, 2), np.full(5, 3)]
     ).astype(np.int32)[None]
 
     loss = llama_loss_fn(model)
