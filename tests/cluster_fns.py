@@ -404,6 +404,20 @@ def _tiny_llama_fsdp_setup(logit_chunk=None):
     return cfg, mesh, psh, state, step
 
 
+
+def _llama_local_batch(mesh, cfg, ctx, seed_base, i):
+    """Deterministic GLOBAL batch for step ``i``; each process feeds its
+    slice. Pairs with _tiny_llama_fsdp_setup (seq 16 -> (8, 17) tokens)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.compute.mesh import shard_batch
+
+    rng = np.random.default_rng(seed_base + i)
+    toks = rng.integers(0, cfg.vocab_size, size=(8, 17)).astype(np.int32)
+    n_local = 8 // ctx.num_workers
+    lo = ctx.executor_id * n_local
+    return shard_batch(mesh, {"tokens": toks[lo : lo + n_local]})
+
 def distributed_llama_fsdp_fn(args, ctx):
     """Multi-controller FSDP: a tiny Llama's params and optimizer state
     sharded over ALL processes' devices (the fsdp axis spans the process
@@ -457,7 +471,6 @@ def distributed_llama_ckpt_fn(args, ctx):
     import json
 
     import jax
-    import numpy as np
 
     from tensorflowonspark_tpu.compute.checkpoint import (
         CheckpointManager,
@@ -465,21 +478,12 @@ def distributed_llama_ckpt_fn(args, ctx):
         restore_latest,
         saves_on_this_process,
     )
-    from tensorflowonspark_tpu.compute.mesh import shard_batch
     from tensorflowonspark_tpu.parallel import use_mesh
 
     cfg, mesh, psh, state, step = _tiny_llama_fsdp_setup()
-    seq, global_batch = 16, 8
 
     def local_batch(i):
-        # deterministic per-step GLOBAL batch; each process feeds its slice
-        rng = np.random.default_rng(1000 + i)
-        toks = rng.integers(
-            0, cfg.vocab_size, size=(global_batch, seq + 1)
-        ).astype(np.int32)
-        n_local = global_batch // ctx.num_workers
-        lo = ctx.executor_id * n_local
-        return shard_batch(mesh, {"tokens": toks[lo : lo + n_local]})
+        return _llama_local_batch(mesh, cfg, ctx, 1000, i)
 
     assert saves_on_this_process(is_chief=ctx.is_chief), (
         "multi-controller mode must make EVERY process a save participant"
@@ -514,6 +518,53 @@ def distributed_llama_ckpt_fn(args, ctx):
         "latest_after": latest_after,
         "process_count": jax.process_count(),
         "global_devices": len(jax.devices()),
+    }
+    with open(
+        os.path.join(args["out_dir"], f"node{ctx.executor_id}.json"), "w"
+    ) as f:
+        json.dump(out, f)
+
+
+def distributed_flaky_llama_fn(args, ctx):
+    """Multi-controller FSDP under the restart supervisor: attempt 1
+    trains 2 steps, saves COLLECTIVELY (every process writes its shards),
+    then both processes crash; attempt 2 restores collectively and
+    finishes. Composes the three hard pieces: fresh jax.distributed
+    coordinator per attempt, cross-process-sharded orbax save/restore,
+    and run_with_restarts supervision."""
+    import json
+
+    import jax
+
+    from tensorflowonspark_tpu.compute.checkpoint import (
+        CheckpointManager,
+        restore_latest,
+    )
+    from tensorflowonspark_tpu.parallel import use_mesh
+
+    cfg, mesh, psh, state, step = _tiny_llama_fsdp_setup()
+
+    def local_batch(i):
+        return _llama_local_batch(mesh, cfg, ctx, 2000, i)
+
+    ckpt = CheckpointManager(args["model_dir"], async_save=False)
+    latest, state = restore_latest(ckpt, state)  # collective
+    start = latest or 0
+    losses = []
+    with use_mesh(mesh):
+        if start == 0:  # first attempt: train, save collectively, die
+            for i in range(2):
+                state, loss = step(state, local_batch(i))
+            ckpt.save(2, state, force=True)
+            os._exit(3)
+        for i in range(start, start + 2):  # resumed attempt
+            state, loss = step(state, local_batch(i))
+            losses.append(float(loss))
+    ckpt.close()
+    out = {
+        "resumed_from": start,
+        "losses": losses,
+        "process_count": jax.process_count(),
     }
     with open(
         os.path.join(args["out_dir"], f"node{ctx.executor_id}.json"), "w"

@@ -221,3 +221,32 @@ def test_two_process_llama_fsdp(tmp_path):
         assert r["losses"][-1] < r["losses"][0]  # it actually learns
     # multi-controller SPMD: identical replicated loss on every process
     assert results[0]["losses"] == results[1]["losses"]
+
+
+def test_run_with_restarts_multi_controller_collective_resume(tmp_path):
+    """The supervisor composes with multi-controller FSDP: attempt 1
+    saves the cross-process-sharded state collectively and crashes;
+    attempt 2 gets a fresh jax.distributed coordinator, restores
+    collectively, and finishes identically on both processes."""
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    restarts = tfcluster.run_with_restarts(
+        cluster_fns.distributed_flaky_llama_fn,
+        {"out_dir": str(out_dir), "model_dir": str(tmp_path / "ckpt")},
+        num_executors=2,
+        input_mode=InputMode.TENSORFLOW,
+        max_restarts=2,
+        reservation_timeout=180,
+        shutdown_timeout=180,
+        distributed=True,
+        env=cpu_only_env(num_cpu_devices=2),
+    )
+    assert restarts == 1
+    results = [
+        json.load(open(out_dir / f"node{i}.json")) for i in range(2)
+    ]
+    for r in results:
+        assert r["resumed_from"] == 2  # restored the collective save
+        assert r["process_count"] == 2
+        assert all(math.isfinite(l) for l in r["losses"])
+    assert results[0]["losses"] == results[1]["losses"]
