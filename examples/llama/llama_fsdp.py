@@ -133,9 +133,13 @@ def main_fun(args, ctx):
     # placements exactly under multi-controller FSDP
     state = shard_state(TrainState.create(params, tx), mesh, psh)
     token_loss = llama_loss_fn(model, logit_chunk=args.logit_chunk)
-    step = build_train_step(
-        lambda p, b: token_loss(p, b["tokens"]), tx, mesh, param_shardings=psh
-    )
+    if args.packed:
+        loss_fn = lambda p, b: token_loss(  # noqa: E731
+            p, b["tokens"], segment_ids=b["segment_ids"]
+        )
+    else:
+        loss_fn = lambda p, b: token_loss(p, b["tokens"])  # noqa: E731
+    step = build_train_step(loss_fn, tx, mesh, param_shardings=psh)
 
     ckpt = None
     if args.model_dir:
@@ -149,12 +153,32 @@ def main_fun(args, ctx):
                 print(f"resuming from step {latest}")
             state = restored
 
-    def batch():
-        return {
-            "tokens": rng.integers(
-                0, cfg.vocab_size, size=(args.batch_size, args.seq + 1)
-            ).astype(np.int32)
-        }
+    if args.packed:
+        from tensorflowonspark_tpu.data.packing import pack_batches
+
+        def synthetic_docs():
+            # variable-length documents, the shape real corpora have
+            lo = min(8, max(1, args.seq // 2))
+            hi = max(lo + 1, args.seq)
+            while True:
+                n = int(rng.integers(lo, hi))
+                yield rng.integers(1, cfg.vocab_size, size=n).tolist()
+
+        packed_iter = pack_batches(
+            synthetic_docs(), args.batch_size, args.seq
+        )
+
+        def batch():
+            return next(packed_iter)
+
+    else:
+
+        def batch():
+            return {
+                "tokens": rng.integers(
+                    0, cfg.vocab_size, size=(args.batch_size, args.seq + 1)
+                ).astype(np.int32)
+            }
 
     with use_mesh(mesh):
         # compile + warmup excluded from timing
@@ -296,6 +320,13 @@ def parse_args(argv=None):
         choices=("fp32", "bf16"),
         default="bf16",
         help="Adam moment storage dtype (bf16 frees 4 bytes/param of HBM)",
+    )
+    p.add_argument(
+        "--packed",
+        action="store_true",
+        help="pack variable-length synthetic documents into each row "
+        "(data/packing.py); trains with per-document attention "
+        "isolation + boundary/padding loss masking",
     )
     p.add_argument(
         "--logit-chunk",
