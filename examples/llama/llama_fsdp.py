@@ -281,13 +281,25 @@ def main_fun(args, ctx):
                 temperature=args.temperature,
                 top_k=args.top_k,
                 top_p=args.top_p,
+                eos_id=args.eos_id,
             )
         jax.block_until_ready(out)
         dt = time.time() - t0
         if ctx.is_chief:
+            out_np = np.asarray(out)
+            if args.eos_id is None:
+                n_generated = float(args.generate)
+            else:
+                # count tokens up to and including each row's first EOS;
+                # the eos-padded tail was never decoded (early stop)
+                hit = out_np == args.eos_id
+                first = np.where(
+                    hit.any(axis=1), hit.argmax(axis=1) + 1, out_np.shape[1]
+                )
+                n_generated = float(first.mean())
             print(
-                f"generated {args.generate} tokens/seq (KV-cache scan) in "
-                f"{dt:.1f}s: {np.asarray(out)[0][:10].tolist()}"
+                f"generated {n_generated:.1f} tokens/seq (KV-cache "
+                f"decode) in {dt:.1f}s: {out_np[0][:10].tolist()}"
             )
 
 
@@ -348,6 +360,13 @@ def parse_args(argv=None):
         help="after training, decode N tokens via the KV cache (chief)",
     )
     p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument(
+        "--eos-id",
+        type=int,
+        default=None,
+        help="stop each row at this token (decode exits early once all "
+        "rows finish)",
+    )
     p.add_argument("--top-k", type=int, default=None)
     p.add_argument("--top-p", type=float, default=None)
     p.add_argument(

@@ -439,18 +439,25 @@ def generate(
     top_k: int | None = None,
     top_p: float | None = None,
     rng: jax.Array | None = None,
+    eos_id: int | None = None,
 ) -> jax.Array:
     """Autoregressive sampling with a KV cache: (B, S) -> (B, max_new_tokens).
 
-    One jitted prefill over the prompt, then a ``lax.scan`` of single-token
-    steps against the per-layer caches — static shapes throughout, so the
-    whole loop is one compilation (cached across calls with the same model
-    and shapes). ``temperature=0`` is greedy argmax; otherwise tokens are
-    sampled from ``logits / temperature``, optionally truncated to the
-    ``top_k`` most likely tokens and/or the smallest nucleus with
-    cumulative probability ``top_p`` (top-k applies first, like the
-    standard decoding stacks). The prompt must be unpadded (all rows the
-    same true length).
+    One jitted prefill over the prompt, then single-token steps against
+    the per-layer caches — static shapes throughout, so the whole loop is
+    one compilation (cached across calls with the same model and shapes).
+    ``temperature=0`` is greedy argmax; otherwise tokens are sampled from
+    ``logits / temperature``, optionally truncated to the ``top_k`` most
+    likely tokens and/or the smallest nucleus with cumulative probability
+    ``top_p`` (top-k applies first, like the standard decoding stacks).
+    The prompt must be unpadded (all rows the same true length).
+
+    ``eos_id``: rows that emit it are finished — their remaining slots
+    fill with ``eos_id`` — and decoding exits EARLY once every row has
+    finished (a ``lax.while_loop`` instead of the fixed-length scan; the
+    output stays statically (B, max_new_tokens)). Decode is weight-read
+    bound, so stopping at the true lengths is a proportional wall-clock
+    win on typical generation workloads.
     """
     cfg = model.cfg
     b, s = prompt.shape
@@ -481,6 +488,7 @@ def generate(
         float(temperature),
         None if top_k is None else int(top_k),
         None if top_p is None else float(top_p),
+        None if eos_id is None else int(eos_id),
     )
     return run(params, prompt, rng)
 
@@ -494,6 +502,7 @@ def _build_generate(
     temperature: float,
     top_k: int | None = None,
     top_p: float | None = None,
+    eos_id: int | None = None,
 ):
     """Compile-once generate body per (model config, shapes, sampling
     params).
@@ -548,8 +557,7 @@ def _build_generate(
         keys = jax.random.split(rng, max_new_tokens)
         tok = sample(logits[:, -1], keys[0])
 
-        def step(carry, key):
-            cache, tok, pos = carry
+        def decode_step(cache, tok, pos, key):
             logits, updated = model.apply(
                 {"params": params, "cache": cache},
                 tok[:, None],
@@ -557,15 +565,59 @@ def _build_generate(
                 decode=True,
                 mutable=["cache"],
             )
-            next_tok = sample(logits[:, -1], key)
-            return (updated["cache"], next_tok, pos + 1), tok
+            return updated["cache"], sample(logits[:, -1], key)
 
-        init = (prefill["cache"], tok, jnp.full((b,), s, jnp.int32))
-        (_, last, _), toks = jax.lax.scan(step, init, keys[1:])
-        # scan emitted each step's *input* token; the final sample closes it
-        return jnp.concatenate(
-            [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1
-        )
+        pos0 = jnp.full((b,), s, jnp.int32)
+
+        if eos_id is None:
+
+            def step(carry, key):
+                cache, tok, pos = carry
+                cache, next_tok = decode_step(cache, tok, pos, key)
+                return (cache, next_tok, pos + 1), tok
+
+            init = (prefill["cache"], tok, pos0)
+            (_, last, _), toks = jax.lax.scan(step, init, keys[1:])
+            # scan emitted each step's *input* token; the final sample
+            # closes the sequence
+            return jnp.concatenate(
+                [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1
+            )
+
+        # EOS path: while_loop exits as soon as EVERY row has emitted
+        # eos_id; finished rows keep emitting eos_id. Output shape stays
+        # statically (B, max_new_tokens).
+        buf = jnp.full((b, max_new_tokens), eos_id, jnp.int32)
+        buf = buf.at[:, 0].set(tok)
+        done = tok == eos_id
+
+        def cond(carry):
+            _, _, _, done, _, i = carry
+            return (i < max_new_tokens) & ~jnp.all(done)
+
+        def body(carry):
+            cache, tok, pos, done, buf, i = carry
+            cache, next_tok = decode_step(
+                cache, tok, pos, jax.lax.dynamic_index_in_dim(
+                    keys, i, keepdims=False
+                )
+            )
+            next_tok = jnp.where(done, eos_id, next_tok)
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, next_tok[:, None], i, axis=1
+            )
+            return (
+                cache,
+                next_tok,
+                pos + 1,
+                done | (next_tok == eos_id),
+                buf,
+                i + 1,
+            )
+
+        init = (prefill["cache"], tok, pos0, done, buf, jnp.int32(1))
+        (_, _, _, _, buf, _) = jax.lax.while_loop(cond, body, init)
+        return buf
 
     return run
 

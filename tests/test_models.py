@@ -643,3 +643,37 @@ def test_llama_packed_reused_ids_do_not_leak(tiny_llama):
     l_reused = float(loss(params, packed, segment_ids=jnp.asarray(reused)))
     l_unique = float(loss(params, packed, segment_ids=jnp.asarray(unique)))
     np.testing.assert_allclose(l_reused, l_unique, rtol=1e-6)
+
+
+def test_llama_generate_eos_early_stop(tiny_llama):
+    """eos_id semantics: identical to the plain decode up to and
+    including each row's first EOS, eos_id-filled afterwards; and a
+    never-appearing eos_id reproduces the plain decode exactly."""
+    from tensorflowonspark_tpu.models.llama import generate
+
+    cfg, model, params = tiny_llama
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(9), (2, 4), 0, cfg.vocab_size
+    )
+    ref = np.asarray(generate(model, params, prompt, max_new_tokens=12))
+
+    # pick row 0's 4th generated token as the "EOS": the eos run must
+    # match ref until that emission, then pad with eos_id
+    eos = int(ref[0, 3])
+    out = np.asarray(
+        generate(model, params, prompt, max_new_tokens=12, eos_id=eos)
+    )
+    for row in range(2):
+        hits = np.where(ref[row] == eos)[0]
+        cut = (hits[0] + 1) if len(hits) else 12
+        np.testing.assert_array_equal(out[row, :cut], ref[row, :cut])
+        assert (out[row, cut:] == eos).all()
+
+    # an id outside the vocab can never be emitted: exact match
+    out2 = np.asarray(
+        generate(
+            model, params, prompt, max_new_tokens=12,
+            eos_id=cfg.vocab_size + 1,
+        )
+    )
+    np.testing.assert_array_equal(out2, ref)
