@@ -150,7 +150,9 @@ class Attention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None, decode=False):
+    def __call__(
+        self, x, positions, segment_ids=None, decode=False, padded=False
+    ):
         cfg = self.cfg
         dense = lambda feats, name: QDense(  # noqa: E731
             feats, cfg.dtype, name=name
@@ -172,7 +174,7 @@ class Attention(nn.Module):
                     "silently attend across documents — decode one "
                     "document per batch row instead"
                 )
-            out = self._cached_attention(q, k, v, positions)
+            out = self._cached_attention(q, k, v, positions, padded)
         else:
             out = dot_product_attention(
                 q, k, v, causal=True, segment_ids=segment_ids,
@@ -181,15 +183,22 @@ class Attention(nn.Module):
         out = out.reshape(b, s, cfg.num_heads * cfg.head_dim)
         return dense(cfg.hidden_size, "o_proj")(out)
 
-    def _cached_attention(self, q, k, v, positions):
+    def _cached_attention(self, q, k, v, positions, padded=False):
         """Autoregressive attention against a static-shape KV cache.
 
-        The cache spans ``max_seq_len``; new K/V land at the running write
-        index (``lax.dynamic_update_slice``, so one jit covers prefill and
-        every decode step) and queries mask keys by absolute position —
-        unwritten cache slots sit past the mask and contribute nothing.
-        Decode is HBM-bandwidth-bound; plain einsum is the right shape for
-        it (flash targets the O(S^2) training pass).
+        The cache spans ``max_seq_len``. With uniform rows (``padded=
+        False``) new K/V land at the scalar running write index
+        (``lax.dynamic_update_slice``, so one jit covers prefill and
+        every decode step); with ``padded=True`` each row writes at ITS
+        OWN positions (a per-row scatter — the right-padded mixed-length
+        prompt case, where row r's next slot is its true length). Either
+        way the cache slot of a token IS its position, so the positional
+        query mask below excludes both unwritten slots and the
+        right-padding garbage a padded prefill writes past each row's
+        true length (those slots are only ever attended after being
+        overwritten by that row's real decode tokens). Decode is
+        HBM-bandwidth-bound; plain einsum is the right shape for it
+        (flash targets the O(S^2) training pass).
         """
         cfg = self.cfg
         b, s = q.shape[:2]
@@ -205,12 +214,17 @@ class Attention(nn.Module):
             "cache", "idx", lambda: jnp.zeros((), jnp.int32)
         )
         cur = ci.value
-        ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.astype(cfg.dtype), (0, cur, 0, 0)
-        )
-        cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.astype(cfg.dtype), (0, cur, 0, 0)
-        )
+        if padded:
+            rows = jnp.arange(b)[:, None]
+            ck.value = ck.value.at[rows, positions].set(k.astype(cfg.dtype))
+            cv.value = cv.value.at[rows, positions].set(v.astype(cfg.dtype))
+        else:
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, cur, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, cur, 0, 0)
+            )
         ci.value = cur + s
         # Grouped einsum against the un-repeated cache: materializing a
         # jnp.repeat of (b, max_seq_len, heads, d) K/V — plus an fp32 copy
@@ -256,13 +270,16 @@ class Block(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None, decode=False):
+    def __call__(
+        self, x, positions, segment_ids=None, decode=False, padded=False
+    ):
         cfg = self.cfg
         h = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="attn_norm")(x),
             positions,
             segment_ids,
             decode,
+            padded,
         )
         if cfg.num_experts > 0:
             from tensorflowonspark_tpu.parallel.moe import MoEConfig, MoEMLP
@@ -296,12 +313,16 @@ class Llama(nn.Module):
         segment_ids=None,
         decode=False,
         return_hidden=False,
+        padded=False,
     ):
         """tokens (B, S) int32 -> logits (B, S, vocab).
 
         ``decode=True`` runs against per-layer KV caches (apply with
         ``mutable=["cache"]``; see :func:`generate`): ``positions`` must
         then be the absolute positions of ``tokens`` in the sequence.
+        ``padded=True`` (decode only) makes each row write the cache at
+        its own positions — the right-padded mixed-length prompt case
+        (:func:`generate` with ``prompt_lengths``).
 
         ``segment_ids`` (B, S) marks packed documents: attention is
         masked by id EQUALITY and RoPE positions restart at adjacency
@@ -373,7 +394,7 @@ class Llama(nn.Module):
         else:
             for i in range(cfg.num_layers):
                 x = Block(cfg, name=f"layer{i}")(
-                    x, positions, segment_ids, decode
+                    x, positions, segment_ids, decode, padded
                 )
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
         # untied output head
@@ -440,6 +461,7 @@ def generate(
     top_p: float | None = None,
     rng: jax.Array | None = None,
     eos_id: int | None = None,
+    prompt_lengths: jax.Array | None = None,
 ) -> jax.Array:
     """Autoregressive sampling with a KV cache: (B, S) -> (B, max_new_tokens).
 
@@ -450,7 +472,14 @@ def generate(
     ``logits / temperature``, optionally truncated to the ``top_k`` most
     likely tokens and/or the smallest nucleus with cumulative probability
     ``top_p`` (top-k applies first, like the standard decoding stacks).
-    The prompt must be unpadded (all rows the same true length).
+
+    Mixed-length prompts: RIGHT-pad ``prompt`` and pass
+    ``prompt_lengths`` (B,) true lengths. Each row samples its first
+    token from the logits at ITS last real position, decodes from its
+    own position, and overwrites its padding slots in the KV cache as it
+    goes (per-row scatter writes; the positional mask keeps not-yet-
+    overwritten padding invisible). Without ``prompt_lengths`` the
+    prompt must be unpadded (all rows the same true length).
 
     ``eos_id``: rows that emit it are finished — their remaining slots
     fill with ``eos_id`` — and decoding exits EARLY once every row has
@@ -489,8 +518,26 @@ def generate(
         None if top_k is None else int(top_k),
         None if top_p is None else float(top_p),
         None if eos_id is None else int(eos_id),
+        padded=prompt_lengths is not None,
     )
-    return run(params, prompt, rng)
+    if prompt_lengths is None:
+        return run(params, prompt, rng)
+    lengths = jnp.asarray(prompt_lengths, jnp.int32)
+    if lengths.shape != (b,):
+        raise ValueError(
+            f"prompt_lengths must have shape ({b},), got {lengths.shape}"
+        )
+    # host-side range check: out-of-range lengths would clamp/wrap under
+    # jit and decode plausible-looking garbage instead of raising
+    import numpy as _np
+
+    host = _np.asarray(lengths)
+    if (host < 1).any() or (host > s).any():
+        raise ValueError(
+            f"prompt_lengths must be in [1, {s}] (the padded prompt "
+            f"width); got {host.tolist()}"
+        )
+    return run(params, prompt, rng, lengths)
 
 
 @functools.lru_cache(maxsize=32)
@@ -503,6 +550,7 @@ def _build_generate(
     top_k: int | None = None,
     top_p: float | None = None,
     eos_id: int | None = None,
+    padded: bool = False,
 ):
     """Compile-once generate body per (model config, shapes, sampling
     params).
@@ -543,7 +591,7 @@ def _build_generate(
         return jax.random.categorical(key, logits).astype(jnp.int32)
 
     @jax.jit
-    def run(params, prompt, rng):
+    def run(params, prompt, rng, lengths=None):
         positions = jnp.broadcast_to(
             jnp.arange(s, dtype=jnp.int32), (b, s)
         )
@@ -552,10 +600,21 @@ def _build_generate(
             prompt,
             positions=positions,
             decode=True,
+            padded=padded,
             mutable=["cache"],
         )
         keys = jax.random.split(rng, max_new_tokens)
-        tok = sample(logits[:, -1], keys[0])
+        if padded:
+            # each row's first token samples from the logits at ITS
+            # last real position; decode continues from its own length
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1
+            )[:, 0]
+            tok = sample(last, keys[0])
+            pos0 = lengths
+        else:
+            tok = sample(logits[:, -1], keys[0])
+            pos0 = jnp.full((b,), s, jnp.int32)
 
         def decode_step(cache, tok, pos, key):
             logits, updated = model.apply(
@@ -563,11 +622,10 @@ def _build_generate(
                 tok[:, None],
                 positions=pos[:, None],
                 decode=True,
+                padded=padded,
                 mutable=["cache"],
             )
             return updated["cache"], sample(logits[:, -1], key)
-
-        pos0 = jnp.full((b,), s, jnp.int32)
 
         if eos_id is None:
 

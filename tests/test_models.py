@@ -677,3 +677,55 @@ def test_llama_generate_eos_early_stop(tiny_llama):
         )
     )
     np.testing.assert_array_equal(out2, ref)
+
+
+def test_llama_generate_padded_prompts_match_unpadded(tiny_llama):
+    """Mixed-length batch decode: right-padded prompts + prompt_lengths
+    must produce, row for row, exactly what each prompt generates alone
+    unpadded (per-row first-token selection, per-row positions, padding
+    slots overwritten in the cache)."""
+    from tensorflowonspark_tpu.models.llama import generate
+
+    cfg, model, params = tiny_llama
+    rng = np.random.default_rng(5)
+    p_a = rng.integers(0, cfg.vocab_size, size=4).astype(np.int32)
+    p_b = rng.integers(0, cfg.vocab_size, size=6).astype(np.int32)
+
+    ref_a = np.asarray(
+        generate(model, params, jnp.asarray(p_a[None]), max_new_tokens=8)
+    )
+    ref_b = np.asarray(
+        generate(model, params, jnp.asarray(p_b[None]), max_new_tokens=8)
+    )
+
+    padded = np.zeros((2, 6), np.int32)
+    padded[0, :4] = p_a
+    padded[1] = p_b
+    out = np.asarray(
+        generate(
+            model,
+            params,
+            jnp.asarray(padded),
+            max_new_tokens=8,
+            prompt_lengths=jnp.asarray([4, 6]),
+        )
+    )
+    np.testing.assert_array_equal(out[0], ref_a[0])
+    np.testing.assert_array_equal(out[1], ref_b[0])
+
+    # composes with eos_id (the while_loop path)
+    eos = int(ref_a[0, 2])
+    out_eos = np.asarray(
+        generate(
+            model,
+            params,
+            jnp.asarray(padded),
+            max_new_tokens=8,
+            prompt_lengths=jnp.asarray([4, 6]),
+            eos_id=eos,
+        )
+    )
+    hits = np.where(ref_a[0] == eos)[0]
+    cut = hits[0] + 1
+    np.testing.assert_array_equal(out_eos[0, :cut], ref_a[0, :cut])
+    assert (out_eos[0, cut:] == eos).all()
