@@ -1,0 +1,189 @@
+"""Decode CLI over a Llama orbax checkpoint — no user Python needed.
+
+The decode-side sibling of ``tools/run_model.py`` (which replays AOT
+forward artifacts, the Scala-API parity path — SURVEY.md §2.2): load a
+checkpointed Llama, read JSONL prompt rows, batch them with right-padding
++ per-row true lengths (``generate(prompt_lengths=...)``), sample with
+greedy/top-k/top-p and optional EOS early stop, write JSONL completions
+trimmed at each row's first EOS.
+
+Prompts are token ids (``{"tokens": [1, 5, 9]}`` per line) — tokenizers
+are corpus-specific and out of framework scope; pipe through one on
+either side.
+
+Usage::
+
+    python -m tensorflowonspark_tpu.tools.generate_text \
+        --checkpoint ckpt_dir/ --model tiny --prompts prompts.jsonl \
+        --output out.jsonl [--max-new-tokens 64] [--eos-id N] \
+        [--temperature 0.8 --top-k 40 --top-p 0.95] [--batch-size 8] \
+        [--config-overrides '{"vocab_size": 1024}']
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="generate_text",
+        description="KV-cache decode over a Llama orbax checkpoint",
+    )
+    p.add_argument(
+        "--checkpoint",
+        required=True,
+        help="orbax dir: a CheckpointManager model dir (latest step is "
+        "used; TrainState or bare param trees both work) or a "
+        "save_checkpoint path",
+    )
+    p.add_argument("--model", choices=("tiny", "1b", "7b"), default="tiny")
+    p.add_argument(
+        "--config-overrides",
+        default=None,
+        help='JSON dict of LlamaConfig field overrides, e.g. '
+        '\'{"vocab_size": 1024, "max_seq_len": 512}\'',
+    )
+    p.add_argument("--prompts", required=True, help="JSONL: {'tokens': [...]}")
+    p.add_argument("--output", required=True, help="output JSONL path ('-' = stdout)")
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--eos-id", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def _load_config(args):
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.llama import LlamaConfig
+
+    base = {
+        "tiny": LlamaConfig.tiny,
+        "1b": LlamaConfig.llama_1b,
+        "7b": LlamaConfig.llama2_7b,
+    }[args.model]()
+    if args.config_overrides:
+        overrides = json.loads(args.config_overrides)
+        if "dtype" in overrides:  # JSON carries it as a name string
+            overrides["dtype"] = getattr(jnp, overrides["dtype"])
+        base = dataclasses.replace(base, **overrides)
+    return base
+
+
+def _load_params(checkpoint: str, cfg):
+    """Restore params from either a CheckpointManager dir (latest step)
+    or a bare save_checkpoint path; accept TrainState trees, {'state':
+    ...} wrappers, or bare param trees."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.compute.checkpoint import (
+        CheckpointManager,
+        restore_checkpoint,
+    )
+
+    with CheckpointManager(checkpoint) as mgr:
+        step = mgr.latest_step()
+        tree = mgr.restore(step) if step is not None else None
+    if tree is None:
+        tree = restore_checkpoint(checkpoint)
+    for key in ("state", "params"):
+        if isinstance(tree, dict) and key in tree:
+            tree = tree[key]
+    if isinstance(tree, dict) and "params" in tree:
+        tree = tree["params"]
+    if not (isinstance(tree, dict) and "embed" in tree):
+        raise ValueError(
+            f"checkpoint {checkpoint} does not contain a Llama param tree "
+            f"(top-level keys: {sorted(tree) if isinstance(tree, dict) else type(tree)})"
+        )
+    # decode in the model's compute dtype
+    return jax.tree.map(
+        lambda x: x.astype(cfg.dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        tree,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.models.llama import Llama, generate
+
+    cfg = _load_config(args)
+    model = Llama(cfg)
+    params = _load_params(args.checkpoint, cfg)
+
+    with open(args.prompts) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    prompts = [list(map(int, r["tokens"])) for r in rows]
+    if not prompts:
+        raise ValueError(f"no prompts in {args.prompts}")
+    too_long = [i for i, p in enumerate(prompts) if not p or len(p)
+                + args.max_new_tokens > cfg.max_seq_len]
+    if too_long:
+        raise ValueError(
+            f"prompt rows {too_long} are empty or exceed max_seq_len "
+            f"({cfg.max_seq_len}) minus max_new_tokens"
+        )
+
+    out = open(args.output, "w") if args.output != "-" else sys.stdout
+    rng = jax.random.PRNGKey(args.seed)
+    # ONE (batch_size, global_width) shape for every chunk: the jitted
+    # prefill + decode loop compiles exactly once. Short chunks pad rows
+    # by repeating the last prompt (results trimmed), short prompts
+    # right-pad to the global width (generate's prompt_lengths path).
+    width = max(len(p) for p in prompts)
+    uniform = all(len(p) == width for p in prompts)
+    bsz = min(args.batch_size, len(prompts))
+    try:
+        for lo in range(0, len(prompts), bsz):
+            chunk = prompts[lo : lo + bsz]
+            n_real = len(chunk)
+            chunk = chunk + [chunk[-1]] * (bsz - n_real)
+            padded = np.zeros((bsz, width), np.int32)
+            lengths = np.zeros(bsz, np.int32)
+            for i, p in enumerate(chunk):
+                padded[i, : len(p)] = p
+                lengths[i] = len(p)
+            rng, key = jax.random.split(rng)
+            toks = np.asarray(
+                generate(
+                    model,
+                    params,
+                    jax.numpy.asarray(padded),
+                    max_new_tokens=args.max_new_tokens,
+                    temperature=args.temperature,
+                    top_k=args.top_k,
+                    top_p=args.top_p,
+                    rng=key,
+                    eos_id=args.eos_id,
+                    # uniform corpora skip the padded path's scatter
+                    prompt_lengths=None if uniform else lengths,
+                )
+            )
+            for row in toks[:n_real]:
+                row = row.tolist()
+                if args.eos_id is not None and args.eos_id in row:
+                    row = row[: row.index(args.eos_id) + 1]
+                out.write(json.dumps({"tokens": row}) + "\n")
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
