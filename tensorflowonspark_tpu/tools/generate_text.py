@@ -114,14 +114,93 @@ def _load_params(checkpoint: str, cfg):
     )
 
 
+class PromptError(ValueError):
+    """A problem with the CALLER's prompts (empty / longer than the
+    decode width) — servers map this to a 4xx, unlike server-side
+    configuration errors which stay plain ValueError/500."""
+
+
+def decode_batches(
+    model,
+    params,
+    prompts: list[list[int]],
+    *,
+    batch_size: int,
+    width: int,
+    max_new_tokens: int,
+    rng,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    eos_id: int | None = None,
+    uniform: bool = False,
+):
+    """Decode ``prompts`` at ONE static (batch_size, width) shape so the
+    jitted prefill + decode loop compiles exactly once: short chunks pad
+    rows by repeating the last prompt (results trimmed), short prompts
+    right-pad to ``width`` (``generate``'s prompt_lengths path;
+    ``uniform=True`` skips it when every prompt is exactly ``width``).
+    Returns ``(completions, rng)`` with each completion trimmed at its
+    first ``eos_id``. Shared by the CLI and serve_model's /generate.
+    """
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.models.llama import generate
+
+    if batch_size < 1:
+        raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+    if not prompts:
+        raise PromptError("no prompts given")
+    bad = [i for i, p in enumerate(prompts) if not p or len(p) > width]
+    if bad:
+        raise PromptError(
+            f"prompt rows {bad} are empty or exceed the decode width "
+            f"({width})"
+        )
+    bsz = min(batch_size, len(prompts))
+    out: list[list[int]] = []
+    for lo in range(0, len(prompts), bsz):
+        chunk = prompts[lo : lo + bsz]
+        n_real = len(chunk)
+        chunk = chunk + [chunk[-1]] * (bsz - n_real)
+        padded = np.zeros((bsz, width), np.int32)
+        lengths = np.zeros(bsz, np.int32)
+        for i, p in enumerate(chunk):
+            padded[i, : len(p)] = p
+            lengths[i] = len(p)
+        rng, key = jax.random.split(rng)
+        toks = np.asarray(
+            generate(
+                model,
+                params,
+                jax.numpy.asarray(padded),
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+                rng=key,
+                eos_id=eos_id,
+                prompt_lengths=None if uniform else lengths,
+            )
+        )
+        for row in toks[:n_real]:
+            row = row.tolist()
+            if eos_id is not None and eos_id in row:
+                row = row[: row.index(eos_id) + 1]
+            out.append(row)
+    return out, rng
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
 
     import jax
-    import numpy as np
 
-    from tensorflowonspark_tpu.models.llama import Llama, generate
+    from tensorflowonspark_tpu.models.llama import Llama
 
+    if args.batch_size < 1:
+        raise SystemExit("--batch-size must be >= 1")
     cfg = _load_config(args)
     model = Llama(cfg)
     params = _load_params(args.checkpoint, cfg)
@@ -131,54 +210,33 @@ def main(argv: list[str] | None = None) -> int:
     prompts = [list(map(int, r["tokens"])) for r in rows]
     if not prompts:
         raise ValueError(f"no prompts in {args.prompts}")
-    too_long = [i for i, p in enumerate(prompts) if not p or len(p)
-                + args.max_new_tokens > cfg.max_seq_len]
-    if too_long:
+    width = max((len(p) for p in prompts), default=1)
+    if width + args.max_new_tokens > cfg.max_seq_len:
         raise ValueError(
-            f"prompt rows {too_long} are empty or exceed max_seq_len "
-            f"({cfg.max_seq_len}) minus max_new_tokens"
+            f"longest prompt ({width}) + max_new_tokens "
+            f"({args.max_new_tokens}) exceeds max_seq_len "
+            f"({cfg.max_seq_len})"
         )
 
+    completions, _ = decode_batches(
+        model,
+        params,
+        prompts,
+        batch_size=args.batch_size,
+        width=width,
+        max_new_tokens=args.max_new_tokens,
+        rng=jax.random.PRNGKey(args.seed),
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        eos_id=args.eos_id,
+        # uniform corpora skip the padded path's scatter writes
+        uniform=all(len(p) == width for p in prompts),
+    )
     out = open(args.output, "w") if args.output != "-" else sys.stdout
-    rng = jax.random.PRNGKey(args.seed)
-    # ONE (batch_size, global_width) shape for every chunk: the jitted
-    # prefill + decode loop compiles exactly once. Short chunks pad rows
-    # by repeating the last prompt (results trimmed), short prompts
-    # right-pad to the global width (generate's prompt_lengths path).
-    width = max(len(p) for p in prompts)
-    uniform = all(len(p) == width for p in prompts)
-    bsz = min(args.batch_size, len(prompts))
     try:
-        for lo in range(0, len(prompts), bsz):
-            chunk = prompts[lo : lo + bsz]
-            n_real = len(chunk)
-            chunk = chunk + [chunk[-1]] * (bsz - n_real)
-            padded = np.zeros((bsz, width), np.int32)
-            lengths = np.zeros(bsz, np.int32)
-            for i, p in enumerate(chunk):
-                padded[i, : len(p)] = p
-                lengths[i] = len(p)
-            rng, key = jax.random.split(rng)
-            toks = np.asarray(
-                generate(
-                    model,
-                    params,
-                    jax.numpy.asarray(padded),
-                    max_new_tokens=args.max_new_tokens,
-                    temperature=args.temperature,
-                    top_k=args.top_k,
-                    top_p=args.top_p,
-                    rng=key,
-                    eos_id=args.eos_id,
-                    # uniform corpora skip the padded path's scatter
-                    prompt_lengths=None if uniform else lengths,
-                )
-            )
-            for row in toks[:n_real]:
-                row = row.tolist()
-                if args.eos_id is not None and args.eos_id in row:
-                    row = row[: row.index(args.eos_id) + 1]
-                out.write(json.dumps({"tokens": row}) + "\n")
+        for row in completions:
+            out.write(json.dumps({"tokens": row}) + "\n")
     finally:
         if out is not sys.stdout:
             out.close()

@@ -15,11 +15,20 @@ Endpoints::
                                 (rows as dicts per input_mapping, or raw
                                 arrays for single-input models)
                                 -> {"predictions": [...]}
+    POST /generate           -> body {"prompts": [[token ids], ...]}
+                                -> {"completions": [[token ids], ...]}
+                                (``--llama-checkpoint`` mode; decode
+                                params are fixed server-side at startup
+                                so the jitted decode compiles ONCE for
+                                one static (batch, width) shape)
 
 Usage::
 
     python -m tensorflowonspark_tpu.tools.serve_model \
         --export-dir /models/mnist [--port 8500] [--batch-size 64]
+    python -m tensorflowonspark_tpu.tools.serve_model \
+        --llama-checkpoint ckpt/ --model tiny [--gen-width 128] \
+        [--max-new-tokens 64] [--eos-id N] [--temperature 0.8 ...]
 """
 
 from __future__ import annotations
@@ -41,6 +50,7 @@ class _Handler(BaseHTTPRequestHandler):
     model: Any = None
     export_dir: str = ""
     batch_size: int = 64
+    gen_fn: Any = None  # prompts -> completions (checkpoint mode)
     # per-server lock (set in make_server): serializes jax dispatch on
     # one model while the HTTP layer stays threaded, so health checks
     # never queue behind a big batch
@@ -60,14 +70,23 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 - http.server API
         if self.path == "/healthz":
             self._reply(200, {"status": "ok", "export_dir": self.export_dir})
-        elif self.path == "/signature":
+        elif self.path == "/signature" and self.model is not None:
             self._reply(200, self.model.meta)
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self) -> None:  # noqa: N802 - http.server API
+        if self.path == "/generate":
+            self._do_generate()
+            return
         if self.path != "/predict":
             self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        if self.model is None:
+            self._reply(
+                400, {"error": "server is in --llama-checkpoint mode; "
+                      "POST /generate instead"}
+            )
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -91,26 +110,123 @@ class _Handler(BaseHTTPRequestHandler):
         # logged as a prediction failure nor answered with a second reply
         self._reply(200, {"predictions": [_to_jsonable(p) for p in preds]})
 
+    def _do_generate(self) -> None:
+        if self.gen_fn is None:
+            self._reply(
+                400, {"error": "server was not started with "
+                      "--llama-checkpoint; /generate unavailable"}
+            )
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            prompts = payload["prompts"]
+            if not isinstance(prompts, list) or not prompts:
+                raise ValueError("'prompts' must be a non-empty list")
+            prompts = [[int(t) for t in p] for p in prompts]
+            if any(not p for p in prompts):
+                raise ValueError("prompts must be non-empty token lists")
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        from tensorflowonspark_tpu.tools.generate_text import PromptError
+
+        try:
+            with self.predict_lock:
+                completions = self.gen_fn(prompts)
+        except PromptError as e:  # the caller's prompts are at fault
+            self._reply(400, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - server-side; log + 500
+            logger.exception("generation failed")
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._reply(200, {"completions": completions})
+
+
+def _build_gen_fn(gen: dict):
+    """Build ``prompts -> completions`` over a Llama checkpoint with ONE
+    static decode shape: (gen_batch_size, gen_width). Requests are padded
+    into that shape (rows repeat the last prompt, results trimmed), so
+    the jitted prefill + decode loop compiles exactly once, at startup
+    policy rather than per request — the bucketing discipline every
+    static-shape serving stack uses."""
+    import jax
+
+    from tensorflowonspark_tpu.models.llama import Llama
+    from tensorflowonspark_tpu.tools.generate_text import (
+        _load_config,
+        _load_params,
+        decode_batches,
+    )
+
+    cfg = _load_config(
+        argparse.Namespace(
+            model=gen["model"], config_overrides=gen.get("config_overrides")
+        )
+    )
+    model = Llama(cfg)
+    params = _load_params(gen["checkpoint"], cfg)
+    width = int(gen.get("width", 128))
+    bsz = int(gen.get("batch_size", 8))
+    max_new = int(gen.get("max_new_tokens", 64))
+    if bsz < 1:
+        raise ValueError(f"--gen-batch-size must be >= 1, got {bsz}")
+    if width + max_new > cfg.max_seq_len:
+        raise ValueError(
+            f"--gen-width ({width}) + --max-new-tokens ({max_new}) "
+            f"exceeds max_seq_len ({cfg.max_seq_len})"
+        )
+    rng_box = [jax.random.PRNGKey(int(gen.get("seed", 0)))]
+
+    def gen_fn(prompts: list[list[int]]) -> list[list[int]]:
+        out, rng_box[0] = decode_batches(
+            model,
+            params,
+            prompts,
+            batch_size=bsz,
+            width=width,
+            max_new_tokens=max_new,
+            rng=rng_box[0],
+            temperature=float(gen.get("temperature", 0.0)),
+            top_k=gen.get("top_k"),
+            top_p=gen.get("top_p"),
+            eos_id=gen.get("eos_id"),
+        )
+        return out
+
+    return gen_fn
+
 
 def make_server(
-    export_dir: str,
+    export_dir: str | None,
     port: int = 8500,
     batch_size: int = 64,
     host: str = "127.0.0.1",
+    gen: dict | None = None,
 ) -> ThreadingHTTPServer:
-    """Load the artifact and return a ready (unstarted) HTTP server;
-    callers drive ``serve_forever``/``shutdown`` (tests bind port 0).
-    Binds localhost by default — the endpoint is unauthenticated, so
-    exposing it (``host='0.0.0.0'``) is an explicit operator choice."""
-    from tensorflowonspark_tpu.api.export import load_model
+    """Load the artifact (and/or the ``gen`` Llama checkpoint config)
+    and return a ready (unstarted) HTTP server; callers drive
+    ``serve_forever``/``shutdown`` (tests bind port 0). Binds localhost
+    by default — the endpoint is unauthenticated, so exposing it
+    (``host='0.0.0.0'``) is an explicit operator choice."""
+    model = None
+    if export_dir is not None:
+        from tensorflowonspark_tpu.api.export import load_model
 
+        model = load_model(export_dir)
     handler = type(
         "_BoundHandler",
         (_Handler,),
         {
-            "model": load_model(export_dir),
-            "export_dir": export_dir,
+            "model": model,
+            "export_dir": export_dir or "",
             "batch_size": batch_size,
+            # staticmethod: a bare function class attribute would bind
+            # as a method and receive the handler as its first argument
+            "gen_fn": (
+                staticmethod(_build_gen_fn(gen)) if gen is not None else None
+            ),
             "predict_lock": threading.Lock(),  # per-server, not shared
         },
     )
@@ -119,9 +235,11 @@ def make_server(
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
-        prog="serve_model", description="HTTP inference over an AOT export"
+        prog="serve_model",
+        description="HTTP inference over an AOT export and/or a Llama "
+        "checkpoint (/generate)",
     )
-    p.add_argument("--export-dir", required=True)
+    p.add_argument("--export-dir", default=None)
     p.add_argument("--port", type=int, default=8500)
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument(
@@ -130,13 +248,43 @@ def main(argv: list[str] | None = None) -> int:
         help="bind address (unauthenticated endpoint: exposing beyond "
         "localhost is an explicit choice)",
     )
+    p.add_argument("--llama-checkpoint", default=None)
+    p.add_argument("--model", choices=("tiny", "1b", "7b"), default="tiny")
+    p.add_argument("--config-overrides", default=None)
+    p.add_argument("--gen-width", type=int, default=128)
+    p.add_argument("--gen-batch-size", type=int, default=8)
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--eos-id", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
+    if args.export_dir is None and args.llama_checkpoint is None:
+        p.error("need --export-dir and/or --llama-checkpoint")
     logging.basicConfig(level=logging.INFO)
+    gen = None
+    if args.llama_checkpoint is not None:
+        gen = dict(
+            checkpoint=args.llama_checkpoint,
+            model=args.model,
+            config_overrides=args.config_overrides,
+            width=args.gen_width,
+            batch_size=args.gen_batch_size,
+            max_new_tokens=args.max_new_tokens,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+            eos_id=args.eos_id,
+            seed=args.seed,
+        )
     server = make_server(
-        args.export_dir, args.port, args.batch_size, host=args.host
+        args.export_dir, args.port, args.batch_size, host=args.host, gen=gen
     )
     logger.info(
-        "serving %s on :%d", args.export_dir, server.server_address[1]
+        "serving %s on :%d",
+        args.export_dir or args.llama_checkpoint,
+        server.server_address[1],
     )
     try:
         server.serve_forever()

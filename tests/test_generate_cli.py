@@ -104,3 +104,69 @@ def test_cli_eos_trims_output(tmp_path):
     assert row["tokens"][-1] == eos
     assert eos not in row["tokens"][:-1]
     assert len(row["tokens"]) <= 6
+
+
+def test_serve_model_generate_endpoint(tmp_path):
+    """POST /generate against a live ephemeral-port server in
+    --llama-checkpoint mode; completions match the CLI/library decode."""
+    import threading
+    import urllib.request
+
+    from tensorflowonspark_tpu.tools import serve_model
+
+    cfg, model, params, ckpt_dir = _tiny_checkpoint(tmp_path)
+    server = serve_model.make_server(
+        None,
+        port=0,
+        gen=dict(
+            checkpoint=ckpt_dir,
+            model="tiny",
+            config_overrides='{"remat": false, "dtype": "float32"}',
+            width=8,
+            batch_size=2,
+            max_new_tokens=5,
+        ),
+    )
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        def post(path, payload):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code, body = post(
+            "/generate", {"prompts": [[1, 2, 3], [4, 5, 6, 7, 8]]}
+        )
+        assert code == 200, body
+        comps = body["completions"]
+        assert len(comps) == 2 and all(len(c) == 5 for c in comps)
+
+        # reference: library decode on the same padded batch
+        padded = np.zeros((2, 8), np.int32)
+        padded[0, :3] = [1, 2, 3]
+        padded[1, :5] = [4, 5, 6, 7, 8]
+        key = jax.random.split(jax.random.PRNGKey(0))[1]
+        ref = np.asarray(
+            generate(
+                model, params, jnp.asarray(padded), max_new_tokens=5,
+                rng=key, prompt_lengths=jnp.asarray([3, 5]),
+            )
+        )
+        assert comps == ref.tolist()
+
+        # errors are 400s, not hangs
+        code, body = post("/generate", {"prompts": [[1] * 9]})
+        assert code == 400 and "decode width" in body["error"]
+        code, body = post("/predict", {"rows": [1]})
+        assert code == 400
+    finally:
+        server.shutdown()
