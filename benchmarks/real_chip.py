@@ -251,7 +251,12 @@ def bench_llama1b(args):
     mesh = make_mesh({"fsdp": len(jax.devices())})
     b = args.batch_size or 8
     seq = args.seq or 1024
-    cfg = LlamaConfig.llama_1b(
+    # model_scale="tiny" swaps in the smoke-test decoder so the WHOLE
+    # bench flow (state build, sharded step, timing, JSON assembly) can
+    # run on CPU in seconds — bench.py's BENCH_SMOKE de-risk path
+    scale = getattr(args, "model_scale", "1b")
+    make_cfg = LlamaConfig.tiny if scale == "tiny" else LlamaConfig.llama_1b
+    cfg = make_cfg(
         max_seq_len=seq,
         remat=getattr(args, "remat", "full") != "none",
         remat_policy=getattr(args, "remat", "full"),
