@@ -139,7 +139,9 @@ def main_fun(args, ctx):
         )
     else:
         loss_fn = lambda p, b: token_loss(p, b["tokens"])  # noqa: E731
-    step = build_train_step(loss_fn, tx, mesh, param_shardings=psh)
+    step = build_train_step(
+        loss_fn, tx, mesh, param_shardings=psh, accum_steps=args.accum
+    )
 
     ckpt = None
     if args.model_dir:
@@ -332,6 +334,14 @@ def parse_args(argv=None):
         choices=("fp32", "bf16"),
         default="bf16",
         help="Adam moment storage dtype (bf16 frees 4 bytes/param of HBM)",
+    )
+    p.add_argument(
+        "--accum",
+        type=int,
+        default=1,
+        help="gradient-accumulation microbatches per optimizer step "
+        "(batch-size must divide evenly); the HBM lever when the target "
+        "global batch's activations exceed memory even after remat",
     )
     p.add_argument(
         "--packed",

@@ -146,6 +146,7 @@ def build_train_step(
     mesh: Mesh,
     param_shardings: Any | None = None,
     donate: bool = True,
+    accum_steps: int = 1,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Compile ``(state, batch) -> (state, loss)`` with mesh shardings.
 
@@ -153,10 +154,65 @@ def build_train_step(
     batch; since the batch is sharded over ``('data','fsdp')``, XLA lowers
     the mean's reduction to a psum over ICI — the entire gradient-sync
     machinery the reference delegated to NCCL/PS.
+
+    ``accum_steps > 1`` runs gradient accumulation: the batch's leading
+    dim splits into that many microbatches, a ``lax.scan`` accumulates
+    their gradients in fp32 (so bf16-param configs don't round 8-bit
+    mantissas per add), and ONE optimizer update applies the mean. For
+    losses whose mean weights every microbatch equally (fixed-shape
+    batches — the usual case) this reproduces the full-batch step
+    exactly. For losses that normalize by a per-call VALID count (e.g.
+    the packed/masked CE: ``sum(nll*mask)/sum(mask)``) it weights
+    microbatch *means* equally rather than tokens — the standard
+    approximation every accumulation implementation makes; keep
+    per-microbatch valid counts similar (packed rows are near-full by
+    construction) or use ``accum_steps=1`` for exact token weighting.
+    The memory lever when the target global batch's activations exceed
+    HBM even after remat; each microbatch must still divide the
+    ``('data','fsdp')`` mesh extent.
     """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def grads_of(state: TrainState, batch):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn)(state.params, batch)
+
+        def split(x):
+            if x.shape[0] % accum_steps:
+                raise ValueError(
+                    f"batch dim {x.shape[0]} not divisible by "
+                    f"accum_steps {accum_steps}"
+                )
+            return x.reshape(
+                accum_steps, x.shape[0] // accum_steps, *x.shape[1:]
+            )
+
+        micro = jax.tree.map(split, batch)
+        # fp32 carry regardless of param dtype: summing bf16 gradient
+        # trees would round at each add; optax updates widen anyway
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        )
+
+        def body(carry, mb):
+            loss_sum, grad_sum = carry
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, mb)
+            return (
+                loss_sum + loss,
+                jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), grad_sum, grads
+                ),
+            ), None
+
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zeros), micro
+        )
+        inv = 1.0 / accum_steps
+        return loss_sum * inv, jax.tree.map(lambda g: g * inv, grad_sum)
 
     def step(state: TrainState, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        loss, grads = grads_of(state, batch)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         return (

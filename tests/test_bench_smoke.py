@@ -60,10 +60,17 @@ def test_bench_relay_gate_fails_fast_when_relay_down():
     # test must never connect to it (see bench._relay_ports_listening)
     if bench._relay_ports_listening():
         pytest.skip("relay is up; fail-fast path not reachable")
+    # strip the debug overrides: an inherited BENCH_ALLOW_CPU=1 would
+    # disable the very gate under test and wedge on TPU backend init
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("BENCH_ALLOW_CPU", "BENCH_SMOKE")
+    }
     proc = subprocess.run(
         [sys.executable, "bench.py"],
         cwd=REPO,
-        env=dict(os.environ),
+        env=env,
         capture_output=True,
         text=True,
         timeout=60,
