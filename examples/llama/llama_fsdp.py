@@ -116,18 +116,41 @@ def main_fun(args, ctx):
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
 
     moment_dtype = jnp.bfloat16 if args.moments == "bf16" else None
+    # standard large-model LR recipe: linear warmup -> cosine decay to
+    # 10% of peak; --warmup 0 keeps the constant LR (every optimizer
+    # here accepts a schedule callable)
+    if args.warmup > 0:
+        # The schedule indexes the RESTORED optimizer count on resume, so
+        # its horizon must be the TOTAL run length across all legs —
+        # --total-steps (kept identical on every resume invocation), not
+        # this leg's --steps; otherwise a resumed leg would start past
+        # the decay clamp and train entirely at end_value.
+        total = args.total_steps or args.steps
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=float(args.lr),
+            warmup_steps=args.warmup,
+            decay_steps=max(total, args.warmup + 1),
+            end_value=0.1 * float(args.lr),
+        )
+    else:
+        lr = float(args.lr)
     if args.precision == "mixed":
         from tensorflowonspark_tpu.compute import mixed_precision_adamw
 
         # bf16 stored params + fp32 master in the optimizer state
         params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
-        tx = mixed_precision_adamw(float(args.lr), moment_dtype=moment_dtype)
+        tx = mixed_precision_adamw(lr, moment_dtype=moment_dtype)
     elif args.moments == "bf16":
         from tensorflowonspark_tpu.compute import optim
 
-        tx = optim.adamw(float(args.lr), moment_dtype=jnp.bfloat16)
+        tx = optim.adamw(lr, moment_dtype=jnp.bfloat16)
     else:
-        tx = optax.adamw(float(args.lr))
+        tx = optax.adamw(lr)
+    if args.clip > 0:
+        # global-norm clip BEFORE the optimizer (the usual transformer
+        # training guard against loss spikes)
+        tx = optax.chain(optax.clip_by_global_norm(float(args.clip)), tx)
     # commit ALL state leaves (moments, masters, step scalar) to their
     # mesh shardings — required for checkpoint restore to reproduce
     # placements exactly under multi-controller FSDP
@@ -323,6 +346,26 @@ def parse_args(argv=None):
         help="sequence-parallel strategy",
     )
     p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument(
+        "--warmup",
+        type=int,
+        default=0,
+        help="linear-warmup steps into a cosine decay (0: constant LR)",
+    )
+    p.add_argument(
+        "--total-steps",
+        type=int,
+        default=0,
+        help="cosine-decay horizon across ALL resume legs (0: this "
+        "invocation's --steps); keep identical when resuming so the "
+        "restored optimizer count lands on a coherent schedule",
+    )
+    p.add_argument(
+        "--clip",
+        type=float,
+        default=0.0,
+        help="global-norm gradient clip (0: off)",
+    )
     p.add_argument(
         "--precision",
         choices=("fp32", "mixed"),

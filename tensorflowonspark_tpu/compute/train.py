@@ -178,11 +178,22 @@ def build_train_step(
         if accum_steps == 1:
             return jax.value_and_grad(loss_fn)(state.params, batch)
 
+        dp_extent = mesh.shape["data"] * mesh.shape["fsdp"]
+
         def split(x):
             if x.shape[0] % accum_steps:
                 raise ValueError(
                     f"batch dim {x.shape[0]} not divisible by "
                     f"accum_steps {accum_steps}"
+                )
+            if (x.shape[0] // accum_steps) % dp_extent:
+                # silent GSPMD padding would idle chips on exactly the
+                # big-pod configs accumulation targets — fail fast
+                raise ValueError(
+                    f"microbatch dim {x.shape[0] // accum_steps} "
+                    f"(batch {x.shape[0]} / accum_steps {accum_steps}) "
+                    f"not divisible by the (data, fsdp) mesh extent "
+                    f"{dp_extent}"
                 )
             return x.reshape(
                 accum_steps, x.shape[0] // accum_steps, *x.shape[1:]
