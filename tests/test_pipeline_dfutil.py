@@ -184,3 +184,41 @@ def test_transform_distributed_matches_local(tmp_path):
     np.testing.assert_allclose(
         [float(p) for p in dist], [float(p) for p in local], rtol=1e-6
     )
+
+
+def test_transform_distributed_over_aot_artifact(tmp_path):
+    """Distributed transform with NO export_fn: each node loads the
+    self-describing AOT artifact (the Scala-API-parity path) as its own
+    singleton. The composition the reference ran at scale — per-executor
+    SavedModel sessions over partitions — here as per-node AOT replays."""
+    from tensorflowonspark_tpu.api import export as aot_export
+
+    w, b = np.array([[2.0], [1.0]], np.float32), 0.5
+
+    art = str(tmp_path / "aot_model")
+    aot_export.export_model(
+        lambda state, batch: {
+            "y": batch["x0"] * state["w"][0, 0]
+            + batch["x1"] * state["w"][1, 0]
+            + state["b"][0]
+        },
+        {"w": w, "b": np.array([b], np.float32)},
+        {"x0": np.zeros((4,), np.float32), "x1": np.zeros((4,), np.float32)},
+        art,
+        input_mapping={"x0": "x0", "x1": "x1"},
+        output_mapping={"y": "pred"},
+    )
+
+    rows = [
+        {"x0": float(i), "x1": float(2 * i)} for i in range(11)
+    ]  # odd count: exercises the ragged tail
+    local = TFModel(export_dir=art, batch_size=4).transform(rows)
+    dist = TFModel(export_dir=art, batch_size=4, cluster_size=2).transform(
+        rows, env=cpu_only_env()
+    )
+    assert len(dist) == len(local) == 11
+    for i, (d, l) in enumerate(zip(dist, local)):
+        assert float(d["pred"]) == float(l["pred"])
+        np.testing.assert_allclose(
+            float(d["pred"]), 2.0 * i + 1.0 * 2 * i + 0.5, rtol=1e-6
+        )
