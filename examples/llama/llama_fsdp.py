@@ -156,14 +156,21 @@ def main_fun(args, ctx):
     # placements exactly under multi-controller FSDP
     state = shard_state(TrainState.create(params, tx), mesh, psh)
     token_loss = llama_loss_fn(model, logit_chunk=args.logit_chunk)
+    weight_fn = None
     if args.packed:
+        from tensorflowonspark_tpu.models.llama import packed_valid_count
+
         loss_fn = lambda p, b: token_loss(  # noqa: E731
             p, b["tokens"], segment_ids=b["segment_ids"]
         )
+        # exact token weighting under accumulation: packed microbatches
+        # have data-dependent valid counts, so weight each by its count
+        weight_fn = lambda b: packed_valid_count(b["segment_ids"])  # noqa: E731
     else:
         loss_fn = lambda p, b: token_loss(p, b["tokens"])  # noqa: E731
     step = build_train_step(
-        loss_fn, tx, mesh, param_shardings=psh, accum_steps=args.accum
+        loss_fn, tx, mesh, param_shardings=psh, accum_steps=args.accum,
+        batch_weight_fn=weight_fn,
     )
 
     ckpt = None

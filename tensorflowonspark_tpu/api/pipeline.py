@@ -358,13 +358,25 @@ class TFModel(_HasParams):
     def transform(self, data: Iterable, launcher=None, env=None) -> list[Any]:
         """Map records through the model in batches, preserving order.
 
+        Materializes :meth:`transform_iter`'s stream into a list — use
+        the iterator directly when the OUTPUT is also too big to hold.
+        """
+        return list(self.transform_iter(data, launcher=launcher, env=env))
+
+    def transform_iter(self, data: Iterable, launcher=None, env=None):
+        """Streaming transform: yields one result per input record, in
+        order, consuming ``data`` incrementally batch-by-batch — O(batch)
+        resident input, never O(dataset) (the scale contract the
+        reference got from ``mapPartitions``, SURVEY §3.4).
+
         ``cluster_size > 1`` scales out like the reference's
         ``TFModel._transform`` (which ran ``_run_model`` on every
         executor over its partitions, ``pipeline.py`` §3.4): a cluster
         of worker processes each load the model ONCE (per-node
-        singleton) and serve partitions through the order-preserving
-        ``cluster.inference`` plumbing. ``launcher``/``env`` pass
-        through to ``tfcluster.run`` in that mode.
+        singleton) and serve batch-sized partitions through the
+        order-preserving ``cluster.inference_stream`` plumbing.
+        ``launcher``/``env`` pass through to ``tfcluster.run`` in that
+        mode.
 
         Single-process (``cluster_size == 1``): on multi-device hosts
         the export_fn path runs data-parallel — each batch is sharded
@@ -373,7 +385,8 @@ class TFModel(_HasParams):
         StableHLO program and keep single-device placement.
         """
         if int(self.args.cluster_size) > 1:
-            return self._transform_distributed(data, launcher, env)
+            yield from self._transform_distributed_iter(data, launcher, env)
+            return
         import jax as _jax
 
         apply_fn, state = self._load()
@@ -401,10 +414,7 @@ class TFModel(_HasParams):
                 TFModel._replicated_key = rkey
             else:
                 state = TFModel._singleton[1]
-        records = list(data)
-        out: list[Any] = []
-        for start in range(0, len(records), batch_size):
-            chunk = records[start : start + batch_size]
+        for chunk in _chunked(data, batch_size):
             n = len(chunk)
             if shard and n % dc:
                 chunk = list(chunk) + [chunk[-1]] * (dc - n % dc)
@@ -412,11 +422,12 @@ class TFModel(_HasParams):
             if shard:
                 batch = shard_batch(mesh, batch)
             result = apply_fn(state, batch)
-            out.extend(self._rowize(result, n))
-        return out
+            yield from self._rowize(result, n)
 
-    def _transform_distributed(self, data: Iterable, launcher, env) -> list[Any]:
+    def _transform_distributed_iter(self, data: Iterable, launcher, env):
         """Scale-out transform over a cluster of per-node model singletons."""
+        import itertools
+
         from tensorflowonspark_tpu.cluster import tfcluster
         from tensorflowonspark_tpu.cluster.tfcluster import InputMode
 
@@ -433,28 +444,31 @@ class TFModel(_HasParams):
         # module-level export_fns pickle by qualified name to the
         # spawned node processes, exactly like the map_fun itself
         node_args["_export_fn"] = self.export_fn
-        # Partition explicitly, every element a RECORD: handing the flat
-        # iterable to inference would let _as_partitions reinterpret
-        # list-typed records as partitions, silently diverging from the
-        # local path's row semantics.
-        records = list(data)
-        if not records:
-            return []
-        partitions = tfcluster.contiguous_split(
-            records, int(self.args.cluster_size)
-        )
+        # Batch-sized partitions, every element a RECORD, pulled lazily:
+        # inference_stream takes partitions as-is, so list-typed records
+        # can't be reinterpreted as partitions (the _as_partitions
+        # hazard), and its backpressure caps how far workers run ahead
+        # of the consumer.
+        cluster_size = int(self.args.cluster_size)
+        chunks = _chunked(data, int(self.args.batch_size))
+        # Peek up to cluster_size chunks: short datasets shouldn't pay
+        # whole-cluster startup for workers that would get no records.
+        head = list(itertools.islice(chunks, cluster_size))
+        if not head:
+            return
         cluster = tfcluster.run(
             _transform_node_fn,
             node_args,
-            # don't pay whole-cluster startup for workers with no records
-            num_executors=len(partitions),
+            num_executors=len(head),  # islice caps this at cluster_size
             input_mode=InputMode.SPARK,
             reservation_timeout=float(self.args.reservation_timeout),
             launcher=launcher,
             env=env,
         )
         try:
-            return cluster.inference(partitions)
+            yield from cluster.inference_stream(
+                itertools.chain(head, chunks)
+            )
         finally:
             cluster.shutdown(grace_secs=float(self.args.grace_secs))
 
@@ -481,6 +495,18 @@ def _transform_node_fn(args, ctx):
         batch = feed.next_batch(batch_size)
         if batch:
             feed.batch_results(model.transform(batch))
+
+
+def _chunked(data: Iterable, n: int):
+    """Lazily batch an iterable into lists of ``n`` (last may be short)."""
+    import itertools
+
+    it = iter(data)
+    while True:
+        chunk = list(itertools.islice(it, n))
+        if not chunk:
+            return
+        yield chunk
 
 
 def columnize(chunk: Sequence[Any], mapping: dict[str, str] | None):

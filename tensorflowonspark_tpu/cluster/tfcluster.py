@@ -401,20 +401,77 @@ class TFCluster:
         Equal-count contract: the user fn must emit exactly one result per
         input record via ``DataFeed.batch_results``.
         """
+        # mode check BEFORE draining data: misuse on a TENSORFLOW-mode
+        # cluster must raise promptly, not block on an unbounded iterable
         self._require_spark_mode("inference")
-        workers = self.workers
         # contiguous: partition-order reassembly then preserves flat
         # input order end-to-end
-        partitions = _as_partitions(data, len(workers), contiguous=True)
+        partitions = _as_partitions(data, len(self.workers), contiguous=True)
+        return list(
+            self.inference_stream(
+                partitions, feed_timeout=feed_timeout, qname=qname
+            )
+        )
+
+    def inference_stream(
+        self,
+        partitions: Iterable,
+        feed_timeout: float = 600.0,
+        qname: str = "input",
+    ):
+        """Streaming :meth:`inference`: pull record-list partitions lazily
+        from an iterable and yield results in partition order as they
+        complete.
+
+        Memory contract (the scale fix the reference got from
+        ``mapPartitions``, SURVEY §3.4): the input is never materialized
+        — workers stay at most ``2 × num_workers`` partitions ahead of
+        the consumer (in-flight work plus reorder slack), so a slow
+        consumer throttles the pulls instead of the whole source
+        buffering in the reorder dict. Closing the generator early
+        (``break`` / ``.close()``) stops further pulls; it waits only
+        for each worker's current in-flight partition, not the rest of
+        the source. Unlike :meth:`inference`, ``partitions`` is taken
+        as-is (every element IS one record-list partition); no
+        flat-input convention detection, which would need the whole
+        input up front.
+        """
+        self._require_spark_mode("inference")
+        workers = self.workers
+        source = enumerate(iter(partitions))
         results: dict[int, list[Any]] = {}
         errors: list[BaseException] = []
-        lock = threading.Lock()
+        finished = [0]
+        # head = next partition index to deliver; taken = indices handed
+        # to workers; stop = consumer gone, pull no more
+        state = {"head": 0, "taken": 0, "stop": False}
+        max_ahead = 2 * len(workers)
+        cond = threading.Condition()
+
+        def next_partition():
+            with cond:  # cond's lock doubles as the source lock
+                while (
+                    not state["stop"]
+                    and not errors
+                    and state["taken"] - state["head"] >= max_ahead
+                ):
+                    cond.wait(1.0)  # backpressure: consumer is behind
+                if state["stop"] or errors:
+                    return None
+                item = next(source, None)
+                if item is not None:
+                    state["taken"] = item[0] + 1
+                return item
 
         def run_worker(widx: int) -> None:
             try:
                 mgr = tfnode_runtime.connect_manager(workers[widx])
-                for pidx in range(widx, len(partitions), len(workers)):
-                    part = list(partitions[pidx])
+                while True:
+                    item = next_partition()
+                    if item is None:
+                        return
+                    pidx, part = item
+                    part = list(part)
                     fed = tfnode_runtime.feed_partition(
                         mgr,
                         part,
@@ -423,14 +480,24 @@ class TFCluster:
                         node=workers[widx],
                     )
                     if fed is None:  # node terminating; partition skipped
+                        with cond:
+                            results[pidx] = []
+                            cond.notify_all()
                         continue
                     out = tfnode_runtime.collect_results(
                         mgr, fed, timeout=feed_timeout
                     )
-                    with lock:
+                    with cond:
                         results[pidx] = out
+                        cond.notify_all()
             except BaseException as e:  # noqa: BLE001
-                errors.append(e)
+                with cond:
+                    errors.append(e)
+                    cond.notify_all()
+            finally:
+                with cond:
+                    finished[0] += 1
+                    cond.notify_all()
 
         threads = [
             threading.Thread(target=run_worker, args=(i,), daemon=True)
@@ -438,16 +505,40 @@ class TFCluster:
         ]
         for t in threads:
             t.start()
-        for t in threads:
-            t.join()
+        try:
+            while True:
+                with cond:
+                    head = state["head"]
+                    while (
+                        head not in results
+                        and not errors
+                        and finished[0] < len(threads)
+                    ):
+                        cond.wait(1.0)
+                    if errors:
+                        break
+                    if head in results:
+                        out = results.pop(head)
+                        state["head"] = head + 1
+                        cond.notify_all()  # frees throttled workers
+                    else:  # finished[0] >= len(threads): source drained
+                        break
+                # yield OUTSIDE the lock: a slow consumer must not stall
+                # workers posting results
+                yield from out
+        finally:
+            # normal exhaustion, an error, or the consumer closing the
+            # generator early: stop further pulls, then wait out only
+            # the in-flight partitions
+            with cond:
+                state["stop"] = True
+                cond.notify_all()
+            for t in threads:
+                t.join()
         if errors:
             self._check_errors()
             raise errors[0]
         self._check_errors()
-        flat: list[Any] = []
-        for pidx in sorted(results):
-            flat.extend(results[pidx])
-        return flat
 
     # ------------------------------------------------------------------
     def shutdown(

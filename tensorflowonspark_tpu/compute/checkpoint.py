@@ -190,21 +190,49 @@ def saves_on_this_process(is_chief: bool) -> bool:
     return is_chief or jax.process_count() > 1
 
 
+def _final_save_needed(ckpt: CheckpointManager, step: int) -> bool:
+    """Collectively consistent "does the final save still need to run".
+
+    Under multi-controller, the save of cross-process-sharded arrays is a
+    collective — every process must enter it or none. A per-process
+    ``latest_step() != step`` check can disagree across processes on
+    eventually-consistent shared filesystems (GCS/NFS): some would enter
+    the collective save and others skip, deadlocking the job. Process 0's
+    view is authoritative (orbax's commit is coordinated by process 0, so
+    if process 0 sees the step landed, every process participated in that
+    save) and is broadcast to all."""
+    import jax
+
+    needed = ckpt.latest_step() != step
+    if jax.process_count() > 1:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        needed = bool(
+            multihost_utils.broadcast_one_to_all(
+                np.asarray(needed, dtype=np.int32)
+            )
+        )
+    return needed
+
+
 def chief_final_save(
     ckpt: CheckpointManager, state: Any, step: int, is_chief: bool
 ) -> None:
     """End-of-training save convention: forced past any save-interval
     policy, and skipped when a previous attempt (e.g. a
     ``run_with_restarts`` relaunch or an in-loop interval save) already
-    landed this step — orbax rejects re-saving an existing step.
+    landed this step (``force=True`` also makes a redundant save on a
+    stale-FS miss an overwrite, not an error).
 
     "chief" in the name is the single-controller convention; under
     multi-controller (``jax.process_count() > 1``) the save runs on
     every process because sharded-state checkpointing is a collective
-    (see :func:`saves_on_this_process`). Every process closes the
-    manager."""
+    (see :func:`saves_on_this_process`), and the skip decision is made
+    collectively (see :func:`_final_save_needed`) so no process enters
+    the collective alone. Every process closes the manager."""
     if saves_on_this_process(is_chief):
         ckpt.wait()  # async in-loop saves may still be landing
-        if ckpt.latest_step() != step:
+        if _final_save_needed(ckpt, step):
             ckpt.save(step, state, force=True)
     ckpt.close()

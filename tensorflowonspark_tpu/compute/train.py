@@ -147,6 +147,7 @@ def build_train_step(
     param_shardings: Any | None = None,
     donate: bool = True,
     accum_steps: int = 1,
+    batch_weight_fn: Callable[[Any], jax.Array] | None = None,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Compile ``(state, batch) -> (state, loss)`` with mesh shardings.
 
@@ -162,14 +163,15 @@ def build_train_step(
     losses whose mean weights every microbatch equally (fixed-shape
     batches — the usual case) this reproduces the full-batch step
     exactly. For losses that normalize by a per-call VALID count (e.g.
-    the packed/masked CE: ``sum(nll*mask)/sum(mask)``) it weights
-    microbatch *means* equally rather than tokens — the standard
-    approximation every accumulation implementation makes; keep
-    per-microbatch valid counts similar (packed rows are near-full by
-    construction) or use ``accum_steps=1`` for exact token weighting.
-    The memory lever when the target global batch's activations exceed
-    HBM even after remat; each microbatch must still divide the
-    ``('data','fsdp')`` mesh extent.
+    the packed/masked CE: ``sum(nll*mask)/sum(mask)``), pass
+    ``batch_weight_fn(microbatch) -> scalar`` returning that count
+    (e.g. ``lambda b: b["mask"].sum()``): each microbatch's loss and
+    gradients are then accumulated as (value·count, count) and divided
+    once by the total, reproducing the full-batch token weighting
+    exactly instead of weighting microbatch *means* equally.
+    Accumulation is the memory lever when the target global batch's
+    activations exceed HBM even after remat; each microbatch must still
+    divide the ``('data','fsdp')`` mesh extent.
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -207,19 +209,29 @@ def build_train_step(
         )
 
         def body(carry, mb):
-            loss_sum, grad_sum = carry
+            loss_sum, grad_sum, w_sum = carry
             loss, grads = jax.value_and_grad(loss_fn)(state.params, mb)
+            w = (
+                jnp.ones((), jnp.float32)
+                if batch_weight_fn is None
+                else batch_weight_fn(mb).astype(jnp.float32)
+            )
             return (
-                loss_sum + loss,
+                loss_sum + loss * w,
                 jax.tree.map(
-                    lambda a, g: a + g.astype(jnp.float32), grad_sum, grads
+                    lambda a, g: a + g.astype(jnp.float32) * w,
+                    grad_sum,
+                    grads,
                 ),
+                w_sum + w,
             ), None
 
-        (loss_sum, grad_sum), _ = jax.lax.scan(
-            body, (jnp.zeros((), jnp.float32), zeros), micro
+        (loss_sum, grad_sum, w_sum), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zeros, jnp.zeros((), jnp.float32)), micro
         )
-        inv = 1.0 / accum_steps
+        # w_sum == accum_steps for the unweighted path; guard a fully
+        # masked-out batch (all counts zero) against 0/0
+        inv = 1.0 / jnp.maximum(w_sum, 1e-6)
         return loss_sum * inv, jax.tree.map(lambda g: g * inv, grad_sum)
 
     def step(state: TrainState, batch):

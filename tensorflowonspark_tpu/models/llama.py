@@ -680,6 +680,49 @@ def _build_generate(
     return run
 
 
+def packed_loss_mask(segment_ids: jax.Array):
+    """Loss mask + canonicalized ids for packed rows.
+
+    ``segment_ids`` is (B, S+1), aligned with the (B, S+1) token rows
+    ``llama_loss_fn`` trains on. Returns ``(mask, canonical_ids)``:
+
+    - ``mask`` (B, S) float32 — 1 where the target position trains.
+      Segment id 0 marks PADDING (the t5x/maxtext convention;
+      ``data/packing.py`` emits it): pad targets never train. Positions
+      whose NEXT token belongs to a different document are dropped — a
+      document's last token must not be trained to predict the next
+      document's first.
+    - ``canonical_ids`` (B, S+1) — adjacency runs renumbered into
+      per-row document indices: attention masks by id EQUALITY, so a
+      packer that reuses an id for a later document (e.g.
+      [0,0,1,1,0,0]) would silently leak attention between the two
+      id-0 documents.
+
+    ``mask.sum()`` is the batch's valid-token count — the exact weight
+    to hand ``build_train_step(batch_weight_fn=...)`` when gradient-
+    accumulating packed batches (see :func:`packed_valid_count`).
+    """
+    not_pad = (segment_ids[:, :-1] != 0).astype(jnp.float32)
+    new_doc = segment_ids[:, 1:] != segment_ids[:, :-1]
+    canonical = jnp.concatenate(
+        [
+            jnp.zeros_like(segment_ids[:, :1]),
+            jnp.cumsum(new_doc.astype(jnp.int32), axis=1),
+        ],
+        axis=1,
+    )
+    mask = (canonical[:, :-1] == canonical[:, 1:]).astype(jnp.float32) * not_pad
+    return mask, canonical
+
+
+def packed_valid_count(segment_ids: jax.Array) -> jax.Array:
+    """Scalar count of loss-contributing positions in a packed batch —
+    ``build_train_step``'s ``batch_weight_fn`` for exact token-weighted
+    gradient accumulation over packed/masked CE."""
+    mask, _ = packed_loss_mask(segment_ids)
+    return jnp.sum(mask)
+
+
 def llama_loss_fn(model: "Llama", logit_chunk: int | None = None):
     """Next-token loss closure ``(params, tokens(B,S+1)) -> scalar`` that
     also collects sown auxiliary losses (the MoE router load-balancing
@@ -706,26 +749,7 @@ def llama_loss_fn(model: "Llama", logit_chunk: int | None = None):
     def loss(params, tokens, segment_ids=None):
         mask = None
         if segment_ids is not None:
-            # Segment id 0 marks PADDING (the t5x/maxtext convention;
-            # data/packing.py emits it): pad targets never train.
-            not_pad = (segment_ids[:, :-1] != 0).astype(jnp.float32)
-            # Canonicalize adjacency runs into per-row document indices:
-            # attention masks by id EQUALITY, so a packer that reuses an
-            # id for a later document (e.g. [0,0,1,1,0,0]) would
-            # silently leak attention between the two id-0 documents.
-            new_doc = segment_ids[:, 1:] != segment_ids[:, :-1]
-            segment_ids = jnp.concatenate(
-                [
-                    jnp.zeros_like(segment_ids[:, :1]),
-                    jnp.cumsum(new_doc.astype(jnp.int32), axis=1),
-                ],
-                axis=1,
-            )
-            # valid target: next token continues the same document, and
-            # the position is not padding
-            mask = (
-                segment_ids[:, :-1] == segment_ids[:, 1:]
-            ).astype(jnp.float32) * not_pad
+            mask, segment_ids = packed_loss_mask(segment_ids)
         seg_in = None if segment_ids is None else segment_ids[:, :-1]
         if logit_chunk is None:
             logits, state = model.apply(

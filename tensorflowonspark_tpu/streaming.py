@@ -203,7 +203,28 @@ class DStream:
                 with open(os.path.join(tmp, f"part-{i:05d}"), "w") as f:
                     for r in part:
                         f.write(f"{r}\n")
-            os.rename(tmp, d)  # atomic materialization
+            # Atomic materialization. The destination can still have
+            # materialized since the pre-check above (a concurrent job
+            # with the same prefix writing during our part-file loop),
+            # so retry the rename under a fresh stamp instead of raising
+            # in the scheduler thread. Bounded: a persistent non-
+            # collision error (EACCES, missing parent) must surface.
+            for _ in range(100):
+                try:
+                    os.rename(tmp, d)
+                    break
+                except OSError:
+                    if not os.path.exists(d):
+                        raise  # not a collision — a real filesystem error
+                    stamp += 1
+                    d = f"{prefix}-{stamp}"
+                    if suffix:
+                        d = f"{d}.{suffix}"
+            else:
+                raise OSError(
+                    f"saveAsTextFiles could not materialize a batch dir "
+                    f"for prefix {prefix!r} after 100 stamp bumps"
+                )
 
         self.foreachRDD(save)
 

@@ -134,6 +134,7 @@ def decode_batches(
     top_p: float | None = None,
     eos_id: int | None = None,
     uniform: bool = False,
+    pad_to_batch: bool = False,
 ):
     """Decode ``prompts`` at ONE static (batch_size, width) shape so the
     jitted prefill + decode loop compiles exactly once: short chunks pad
@@ -142,6 +143,15 @@ def decode_batches(
     ``uniform=True`` skips it when every prompt is exactly ``width``).
     Returns ``(completions, rng)`` with each completion trimmed at its
     first ``eos_id``. Shared by the CLI and serve_model's /generate.
+
+    ``pad_to_batch``: always decode at exactly ``batch_size`` rows even
+    when fewer prompts arrive (rows padded by repeating the last
+    prompt). Servers MUST set this: the ``min()`` shortcut below would
+    otherwise compile a fresh (n, width) program per distinct request
+    size — seconds-to-minutes on the request thread — and thrash the
+    compile cache, violating the one-static-shape bucketing policy.
+    The one-shot CLI keeps the shortcut (smaller batch = less wasted
+    compute, and its single compile is paid exactly once either way).
     """
     import jax
     import numpy as np
@@ -158,7 +168,7 @@ def decode_batches(
             f"prompt rows {bad} are empty or exceed the decode width "
             f"({width})"
         )
-    bsz = min(batch_size, len(prompts))
+    bsz = batch_size if pad_to_batch else min(batch_size, len(prompts))
     out: list[list[int]] = []
     for lo in range(0, len(prompts), bsz):
         chunk = prompts[lo : lo + bsz]

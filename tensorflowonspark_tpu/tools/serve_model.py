@@ -185,6 +185,9 @@ def _build_gen_fn(gen: dict):
             params,
             prompts,
             batch_size=bsz,
+            # server mode: one (gen_batch_size, width) shape EVER
+            # compiles — per-request sizes must not each compile
+            pad_to_batch=True,
             width=width,
             max_new_tokens=max_new,
             rng=rng_box[0],

@@ -265,3 +265,62 @@ def test_gradient_accumulation_matches_full_batch(mesh_dp):
     bad = build_train_step(loss_fn, tx, mesh_dp, accum_steps=5)
     with pytest.raises(ValueError, match="not divisible"):
         bad(fresh(), batch)
+
+
+def test_weighted_accumulation_exact_for_masked_loss(mesh_dp):
+    """A count-normalized (packed/masked) loss under accumulation with
+    ``batch_weight_fn`` must match the unaccumulated full-batch step to
+    tight tolerance even when microbatch valid counts differ wildly —
+    the case where averaging microbatch means is only approximate."""
+
+    def loss_fn(params, batch):
+        pred = jnp.tanh(batch["x"] @ params["w1"]) @ params["w2"]
+        err = jnp.sum((pred - batch["y"]) ** 2, axis=-1)
+        m = batch["mask"]
+        return jnp.sum(err * m) / jnp.maximum(jnp.sum(m), 1)
+
+    tx = optax.adamw(1e-2)
+    rng = np.random.default_rng(7)
+    params = {
+        "w1": jnp.asarray(rng.normal(size=(6, 8)).astype(np.float32)),
+        "w2": jnp.asarray(rng.normal(size=(8, 2)).astype(np.float32)),
+    }
+    # strongly unequal per-microbatch valid counts (rows of 8, accum=4):
+    # microbatch 0 nearly full, microbatch 3 nearly empty
+    mask = np.zeros((32,), np.float32)
+    for i, keep in enumerate([8, 5, 2, 1]):
+        mask[8 * i : 8 * i + keep] = 1.0
+    batch = shard_batch(
+        mesh_dp,
+        {
+            "x": rng.normal(size=(32, 6)).astype(np.float32),
+            "y": rng.normal(size=(32, 2)).astype(np.float32),
+            "mask": mask,
+        },
+    )
+
+    def fresh():
+        return TrainState.create(jax.tree.map(jnp.array, params), tx)
+
+    weight = lambda b: jnp.sum(b["mask"])  # noqa: E731
+    full = build_train_step(loss_fn, tx, mesh_dp)
+    exact = build_train_step(
+        loss_fn, tx, mesh_dp, accum_steps=4, batch_weight_fn=weight
+    )
+    approx = build_train_step(loss_fn, tx, mesh_dp, accum_steps=4)
+
+    s_full, l_full = full(fresh(), batch)
+    s_exact, l_exact = exact(fresh(), batch)
+    s_approx, l_approx = approx(fresh(), batch)
+
+    np.testing.assert_allclose(float(l_exact), float(l_full), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        s_exact.params,
+        s_full.params,
+    )
+    # sanity: with these skewed counts the unweighted average is NOT the
+    # full-batch loss — the approximation the weight_fn removes
+    assert abs(float(l_approx) - float(l_full)) > 1e-3

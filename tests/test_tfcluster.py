@@ -61,6 +61,45 @@ def test_spark_mode_inference(tmp_path):
     assert results == [i**2 for i in range(30)]
 
 
+def test_inference_stream_backpressure_and_early_close(tmp_path):
+    """inference_stream's memory contract: workers stay at most
+    2×num_workers partitions ahead of the consumer (backpressure), and
+    closing the generator early stops pulling from the source instead
+    of draining the whole dataset."""
+    cluster = tfcluster.run(
+        cluster_fns.square_inference_fn,
+        {},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        env=NODE_ENV,
+    )
+    try:
+        pulled = [0]
+
+        def partitions(n):
+            for p in range(n):
+                pulled[0] += 1
+                yield [(p,)]
+
+        # full drain: order preserved across lazily pulled partitions
+        out = list(cluster.inference_stream(partitions(20)))
+        assert out == [p**2 for p in range(20)]
+        assert pulled[0] == 20
+
+        # early close: consume one result, then close. The source must
+        # stop near the lookahead bound (head 1 + 2*2 ahead + in-flight
+        # slack), nowhere near 50.
+        pulled[0] = 0
+        stream = cluster.inference_stream(partitions(50))
+        first = next(stream)
+        stream.close()  # must return promptly, not drain 50 partitions
+        assert first == 0
+        assert pulled[0] <= 10, f"early close still pulled {pulled[0]}/50"
+    finally:
+        cluster.shutdown(timeout=120)
+
+
 def test_tensorflow_mode(tmp_path):
     data_file = tmp_path / "data.txt"
     data_file.write_text("\n".join(str(i) for i in range(50)) + "\n")
