@@ -76,6 +76,27 @@ def make_mesh(
     return Mesh(dev_array, MESH_AXES)
 
 
+def parse_axis_spec(spec: str) -> dict[str, int]:
+    """Parse a CLI mesh spec ``'data=2,model=4'`` into the axis-shape
+    mapping :func:`make_mesh` takes (``-1`` = infer, like make_mesh).
+    Axis-name validation is make_mesh's job; this only parses."""
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad mesh spec segment {part!r}; expected axis=size "
+                "(e.g. 'data=2,model=4')"
+            )
+        key, val = part.split("=", 1)
+        out[key.strip()] = int(val)
+    if not out:
+        raise ValueError(f"empty mesh spec {spec!r}")
+    return out
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     """Sharding for a batch: leading dim over (data, fsdp), rest replicated.
 

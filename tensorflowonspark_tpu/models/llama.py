@@ -167,14 +167,15 @@ class Attention(nn.Module):
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         if decode:
-            if segment_ids is not None:
-                raise NotImplementedError(
-                    "segment_ids with decode=True: the KV cache is not "
-                    "segment-masked, so packed-row prefill/scoring would "
-                    "silently attend across documents — decode one "
-                    "document per batch row instead"
+            if segment_ids is not None and padded:
+                raise ValueError(
+                    "segment_ids with padded=True is unsupported: padded "
+                    "decode writes each row's cache at its own positions "
+                    "(mixed-length unpadded prompts), which conflicts "
+                    "with packed rows' global slot indexing"
                 )
-            out = self._cached_attention(q, k, v, positions, padded)
+            out = self._cached_attention(q, k, v, positions, padded,
+                                         segment_ids)
         else:
             out = dot_product_attention(
                 q, k, v, causal=True, segment_ids=segment_ids,
@@ -183,7 +184,9 @@ class Attention(nn.Module):
         out = out.reshape(b, s, cfg.num_heads * cfg.head_dim)
         return dense(cfg.hidden_size, "o_proj")(out)
 
-    def _cached_attention(self, q, k, v, positions, padded=False):
+    def _cached_attention(
+        self, q, k, v, positions, padded=False, segment_ids=None
+    ):
         """Autoregressive attention against a static-shape KV cache.
 
         The cache spans ``max_seq_len``. With uniform rows (``padded=
@@ -192,13 +195,26 @@ class Attention(nn.Module):
         every decode step); with ``padded=True`` each row writes at ITS
         OWN positions (a per-row scatter — the right-padded mixed-length
         prompt case, where row r's next slot is its true length). Either
-        way the cache slot of a token IS its position, so the positional
-        query mask below excludes both unwritten slots and the
-        right-padding garbage a padded prefill writes past each row's
-        true length (those slots are only ever attended after being
-        overwritten by that row's real decode tokens). Decode is
-        HBM-bandwidth-bound; plain einsum is the right shape for it
-        (flash targets the O(S^2) training pass).
+        way the cache slot of a token is its ROW index (== its RoPE
+        position for unpacked rows), so the slot-index query mask below
+        excludes both unwritten slots and the right-padding garbage a
+        padded prefill writes past each row's true length (those slots
+        are only ever attended after being overwritten by that row's
+        real decode tokens).
+
+        Packed rows (``segment_ids`` given): each slot also records its
+        token's segment id in the cache, and queries additionally mask
+        by id EQUALITY — cross-document reads are structurally
+        impossible, which is what makes packed prefill/scoring sound.
+        RoPE ``positions`` restart per document and therefore DIVERGE
+        from slot indices; the slot mask uses the running write index,
+        never ``positions``. Ids must be unique per document within a
+        row (``packed_loss_mask`` canonicalizes). Unpacked callers
+        store zeros everywhere, making the id-equality term vacuous —
+        one code path, one compiled program.
+
+        Decode is HBM-bandwidth-bound; plain einsum is the right shape
+        for it (flash targets the O(S^2) training pass).
         """
         cfg = self.cfg
         b, s = q.shape[:2]
@@ -210,20 +226,35 @@ class Attention(nn.Module):
             "cache", "v", jnp.zeros,
             (b, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim), cfg.dtype,
         )
+        cs = self.variable(
+            "cache", "seg", jnp.zeros, (b, cfg.max_seq_len), jnp.int32
+        )
         ci = self.variable(
             "cache", "idx", lambda: jnp.zeros((), jnp.int32)
         )
         cur = ci.value
+        seg = (
+            jnp.zeros((b, s), jnp.int32)
+            if segment_ids is None
+            else segment_ids.astype(jnp.int32)
+        )
         if padded:
             rows = jnp.arange(b)[:, None]
             ck.value = ck.value.at[rows, positions].set(k.astype(cfg.dtype))
             cv.value = cv.value.at[rows, positions].set(v.astype(cfg.dtype))
+            # positions ARE the slots here (unpacked rows only; the
+            # packed+padded combination is rejected in __call__)
+            slot_q = positions
         else:
             ck.value = jax.lax.dynamic_update_slice(
                 ck.value, k.astype(cfg.dtype), (0, cur, 0, 0)
             )
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v.astype(cfg.dtype), (0, cur, 0, 0)
+            )
+            cs.value = jax.lax.dynamic_update_slice(cs.value, seg, (0, cur))
+            slot_q = jnp.broadcast_to(
+                (cur + jnp.arange(s, dtype=jnp.int32))[None, :], (b, s)
             )
         ci.value = cur + s
         # Grouped einsum against the un-repeated cache: materializing a
@@ -244,7 +275,10 @@ class Attention(nn.Module):
         key_pos = jnp.arange(cfg.max_seq_len)
         mask = (
             key_pos[None, None, None, None, :]
-            <= positions[:, None, None, :, None]
+            <= slot_q[:, None, None, :, None]
+        )
+        mask = mask & (
+            cs.value[:, None, None, None, :] == seg[:, None, None, :, None]
         )
         logits = jnp.where(mask, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
@@ -328,8 +362,12 @@ class Llama(nn.Module):
         masked by id EQUALITY and RoPE positions restart at adjacency
         boundaries, so ids must be unique per document within a row
         (:func:`llama_loss_fn` canonicalizes adjacency runs for you).
-        Training/scoring only — ``decode=True`` raises, since the KV
-        cache is not segment-masked.
+        Works with ``decode=True`` too — the KV cache records each
+        slot's segment id and masks reads by it, so packed prefill and
+        scoring (and continuing a chosen document by passing its id
+        with the new tokens' positions) never attend across documents.
+        Only the ``padded=True`` combination is rejected: per-row
+        scatter slots conflict with packed rows' global slot indexing.
 
         ``return_hidden=True`` returns ``(hidden, lm_head)`` instead of
         logits — the final-norm hidden states (B, S, H) and the untied
@@ -451,6 +489,19 @@ def llama_param_shardings(params, mesh: Mesh):
     return jax.tree_util.tree_map_with_path(rule, params)
 
 
+def decode_cache_spec(x: jax.Array) -> P:
+    """PartitionSpec for one KV-cache leaf under mesh-sharded decode:
+    K/V (B, S, kv_heads, D) shard batch on 'data' and heads on 'model'
+    (each TP shard holds only its heads' cache — the HBM split that
+    makes 7B-class serving fit), the segment-id plane (B, S) shards on
+    'data', the scalar write index replicates."""
+    if x.ndim == 4:
+        return P("data", None, "model", None)
+    if x.ndim == 2:
+        return P("data", None)
+    return P()
+
+
 def generate(
     model: "Llama",
     params,
@@ -462,6 +513,7 @@ def generate(
     rng: jax.Array | None = None,
     eos_id: int | None = None,
     prompt_lengths: jax.Array | None = None,
+    mesh: Mesh | None = None,
 ) -> jax.Array:
     """Autoregressive sampling with a KV cache: (B, S) -> (B, max_new_tokens).
 
@@ -487,6 +539,17 @@ def generate(
     output stays statically (B, max_new_tokens)). Decode is weight-read
     bound, so stopping at the true lengths is a proportional wall-clock
     win on typical generation workloads.
+
+    ``mesh``: run the whole decode sharded over a device mesh — weights
+    TP-sharded on the ``model`` axis (:func:`llama_param_shardings`,
+    the Megatron layout; XLA inserts the per-layer psums over ICI),
+    batch and KV caches sharded on ``data``/``model``
+    (:func:`decode_cache_spec`). This is the multi-chip serving path:
+    7B-class weights exceed one chip's HBM, so TP over ≥2 chips is the
+    capacity floor, and DP over 'data' scales throughput. Tokens are
+    bit-identical to the single-device decode up to TP reduction
+    order. Requires batch % mesh 'data' extent == 0 and num_kv_heads %
+    'model' extent == 0.
     """
     cfg = model.cfg
     b, s = prompt.shape
@@ -505,6 +568,26 @@ def generate(
             "argmax, which would silently ignore them)"
         )
     rng = jax.random.PRNGKey(0) if rng is None else rng
+    if mesh is not None:
+        dp = mesh.shape["data"]
+        tp = mesh.shape["model"]
+        if b % dp:
+            raise ValueError(
+                f"batch {b} not divisible by the mesh 'data' extent {dp}"
+            )
+        if cfg.num_kv_heads % tp or cfg.num_heads % tp:
+            raise ValueError(
+                f"heads ({cfg.num_heads}/{cfg.num_kv_heads} kv) not "
+                f"divisible by the mesh 'model' extent {tp}"
+            )
+        # Commit inputs to their decode shardings; jit then compiles the
+        # SPMD program against the committed placements (device_put is a
+        # no-op for already-placed serving calls).
+        params = jax.device_put(params, llama_param_shardings(params, mesh))
+        prompt = jax.device_put(
+            prompt, NamedSharding(mesh, P("data", None))
+        )
+        rng = jax.device_put(rng, NamedSharding(mesh, P()))
     # int8 weight-only decode: quantized trees (ops/quant.py
     # quantize_tree) pass straight through — QDense / the embed gather /
     # the head projection consume QuantTensor leaves natively, so the
@@ -519,6 +602,7 @@ def generate(
         None if top_p is None else float(top_p),
         None if eos_id is None else int(eos_id),
         padded=prompt_lengths is not None,
+        mesh=mesh,
     )
     if prompt_lengths is None:
         return run(params, prompt, rng)
@@ -537,6 +621,8 @@ def generate(
             f"prompt_lengths must be in [1, {s}] (the padded prompt "
             f"width); got {host.tolist()}"
         )
+    if mesh is not None:
+        lengths = jax.device_put(lengths, NamedSharding(mesh, P("data")))
     return run(params, prompt, rng, lengths)
 
 
@@ -551,14 +637,32 @@ def _build_generate(
     top_p: float | None = None,
     eos_id: int | None = None,
     padded: bool = False,
+    mesh: Mesh | None = None,
 ):
     """Compile-once generate body per (model config, shapes, sampling
     params).
 
     flax Modules hash by their dataclass fields, so two ``Llama`` instances
-    with equal configs share the cache entry; a per-call ``jax.jit`` would
-    recompile the prefill + scan graph on every invocation.
+    with equal configs share the cache entry (``Mesh`` hashes by device
+    assignment + axis names, so a mesh keys its own entry); a per-call
+    ``jax.jit`` would recompile the prefill + scan graph on every
+    invocation.
     """
+
+    def constrain_cache(cache):
+        # Pin the per-layer KV caches to their decode shardings at the
+        # loop boundary; the scan/while carry then keeps them there
+        # instead of letting sharding propagation pick (e.g.) a
+        # replicated layout whose per-step all-gathers would swamp the
+        # HBM-bound decode.
+        if mesh is None:
+            return cache
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, decode_cache_spec(x))
+            ),
+            cache,
+        )
 
     def sample(logits, key):
         if temperature == 0.0:
@@ -625,7 +729,9 @@ def _build_generate(
                 padded=padded,
                 mutable=["cache"],
             )
-            return updated["cache"], sample(logits[:, -1], key)
+            return constrain_cache(updated["cache"]), sample(
+                logits[:, -1], key
+            )
 
         if eos_id is None:
 
@@ -634,7 +740,7 @@ def _build_generate(
                 cache, next_tok = decode_step(cache, tok, pos, key)
                 return (cache, next_tok, pos + 1), tok
 
-            init = (prefill["cache"], tok, pos0)
+            init = (constrain_cache(prefill["cache"]), tok, pos0)
             (_, last, _), toks = jax.lax.scan(step, init, keys[1:])
             # scan emitted each step's *input* token; the final sample
             # closes the sequence
@@ -673,7 +779,10 @@ def _build_generate(
                 i + 1,
             )
 
-        init = (prefill["cache"], tok, pos0, done, buf, jnp.int32(1))
+        init = (
+            constrain_cache(prefill["cache"]), tok, pos0, done, buf,
+            jnp.int32(1),
+        )
         (_, _, _, _, buf, _) = jax.lax.while_loop(cond, body, init)
         return buf
 

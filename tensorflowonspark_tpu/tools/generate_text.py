@@ -55,6 +55,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--top-p", type=float, default=None)
     p.add_argument("--eos-id", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--mesh",
+        default=None,
+        help="decode sharded over a device mesh, e.g. 'data=2,model=4' "
+        "(TP weights on 'model', batch + KV caches on 'data'); "
+        "--batch-size must be divisible by the 'data' extent",
+    )
     return p
 
 
@@ -135,6 +142,7 @@ def decode_batches(
     eos_id: int | None = None,
     uniform: bool = False,
     pad_to_batch: bool = False,
+    mesh=None,
 ):
     """Decode ``prompts`` at ONE static (batch_size, width) shape so the
     jitted prefill + decode loop compiles exactly once: short chunks pad
@@ -152,6 +160,11 @@ def decode_batches(
     compile cache, violating the one-static-shape bucketing policy.
     The one-shot CLI keeps the shortcut (smaller batch = less wasted
     compute, and its single compile is paid exactly once either way).
+
+    ``mesh``: decode sharded over a device mesh (TP weights on 'model',
+    batch + KV caches on 'data' — ``models.llama.generate``'s mesh
+    path). The effective batch size must be divisible by the 'data'
+    extent (set ``pad_to_batch`` so it stays the full ``batch_size``).
     """
     import jax
     import numpy as np
@@ -192,6 +205,7 @@ def decode_batches(
                 rng=key,
                 eos_id=eos_id,
                 prompt_lengths=None if uniform else lengths,
+                mesh=mesh,
             )
         )
         for row in toks[:n_real]:
@@ -228,6 +242,18 @@ def main(argv: list[str] | None = None) -> int:
             f"({cfg.max_seq_len})"
         )
 
+    mesh = None
+    if args.mesh:
+        from tensorflowonspark_tpu.compute.mesh import (
+            make_mesh,
+            parse_axis_spec,
+        )
+        from tensorflowonspark_tpu.models.llama import llama_param_shardings
+
+        mesh = make_mesh(parse_axis_spec(args.mesh))
+        # place the weights in their TP layout once, not per chunk
+        params = jax.device_put(params, llama_param_shardings(params, mesh))
+
     completions, _ = decode_batches(
         model,
         params,
@@ -242,6 +268,10 @@ def main(argv: list[str] | None = None) -> int:
         eos_id=args.eos_id,
         # uniform corpora skip the padded path's scatter writes
         uniform=all(len(p) == width for p in prompts),
+        # sharded decode needs the batch divisible by the 'data' extent;
+        # padding to the full batch keeps one shape that is
+        pad_to_batch=mesh is not None,
+        mesh=mesh,
     )
     out = open(args.output, "w") if args.output != "-" else sys.stdout
     try:

@@ -178,6 +178,25 @@ def _build_gen_fn(gen: dict):
             f"exceeds max_seq_len ({cfg.max_seq_len})"
         )
     rng_box = [jax.random.PRNGKey(int(gen.get("seed", 0)))]
+    mesh = None
+    if gen.get("mesh"):
+        from tensorflowonspark_tpu.compute.mesh import (
+            make_mesh,
+            parse_axis_spec,
+        )
+
+        mesh = make_mesh(parse_axis_spec(gen["mesh"]))
+        if bsz % mesh.shape["data"]:
+            raise ValueError(
+                f"--gen-batch-size ({bsz}) must be divisible by the "
+                f"mesh 'data' extent ({mesh.shape['data']})"
+            )
+        from tensorflowonspark_tpu.models.llama import llama_param_shardings
+
+        # Pre-place the weights in their TP layout ONCE at startup:
+        # generate()'s per-call device_put is then the no-op it assumes,
+        # instead of a full weight reshard on every request.
+        params = jax.device_put(params, llama_param_shardings(params, mesh))
 
     def gen_fn(prompts: list[list[int]]) -> list[list[int]]:
         out, rng_box[0] = decode_batches(
@@ -185,6 +204,7 @@ def _build_gen_fn(gen: dict):
             params,
             prompts,
             batch_size=bsz,
+            mesh=mesh,
             # server mode: one (gen_batch_size, width) shape EVER
             # compiles — per-request sizes must not each compile
             pad_to_batch=True,
@@ -262,6 +282,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--top-p", type=float, default=None)
     p.add_argument("--eos-id", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--gen-mesh",
+        default=None,
+        help="shard /generate decoding over a device mesh, e.g. "
+        "'data=2,model=4' (TP weights on 'model', batch + KV caches on "
+        "'data'); --gen-batch-size must be divisible by the 'data' "
+        "extent",
+    )
     args = p.parse_args(argv)
     if args.export_dir is None and args.llama_checkpoint is None:
         p.error("need --export-dir and/or --llama-checkpoint")
@@ -280,6 +308,7 @@ def main(argv: list[str] | None = None) -> int:
             top_p=args.top_p,
             eos_id=args.eos_id,
             seed=args.seed,
+            mesh=args.gen_mesh,
         )
     server = make_server(
         args.export_dir, args.port, args.batch_size, host=args.host, gen=gen

@@ -72,6 +72,43 @@ def test_cli_decodes_mixed_length_prompts(tmp_path):
         assert rows[i]["tokens"] == ref[i].tolist()
 
 
+def test_cli_mesh_sharded_decode_matches_unsharded(tmp_path):
+    """--mesh 'data=4,model=2' decodes on the 8-device virtual mesh and
+    must emit exactly the tokens the unsharded CLI run emits."""
+    cfg, model, params, ckpt_dir = _tiny_checkpoint(tmp_path)
+    prompts = [[1, 2, 3], [4, 5, 6, 7, 8], [2, 9], [7, 7, 7, 7]]
+    pfile = tmp_path / "prompts.jsonl"
+    pfile.write_text(
+        "".join(json.dumps({"tokens": p}) + "\n" for p in prompts)
+    )
+
+    outs = {}
+    for label, extra in (
+        ("plain", []),
+        ("mesh", ["--mesh", "data=4,model=2"]),
+    ):
+        ofile = tmp_path / f"out_{label}.jsonl"
+        rc = main(
+            [
+                "--checkpoint", ckpt_dir,
+                "--model", "tiny",
+                "--config-overrides", '{"remat": false, "dtype": "float32"}',
+                "--prompts", str(pfile),
+                "--output", str(ofile),
+                "--max-new-tokens", "6",
+                "--batch-size", "4",
+                "--seed", "0",
+                *extra,
+            ]
+        )
+        assert rc == 0
+        outs[label] = [
+            json.loads(l)["tokens"] for l in ofile.read_text().splitlines()
+        ]
+    assert len(outs["mesh"]) == 4
+    assert outs["mesh"] == outs["plain"]
+
+
 def test_cli_eos_trims_output(tmp_path):
     cfg, model, params, ckpt_dir = _tiny_checkpoint(tmp_path)
     pfile = tmp_path / "prompts.jsonl"
