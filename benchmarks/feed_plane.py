@@ -1,0 +1,148 @@
+"""Measure the SPARK-mode push-feed plane's throughput ceiling (CPU).
+
+VERDICT round-2 weak #3: ALL partition data in InputMode.SPARK flows from
+the single driver process to the node managers (shm ring when co-located,
+TCP otherwise) — the reference's feed tasks ran *on the executors* with
+HDFS locality, so its driver shipped closures, not bytes. This bench
+quantifies that design's ceiling so DESIGN.md can state when to switch to
+pull mode (InputMode.TENSORFLOW + grain/tf.data sharding).
+
+What it measures, per (node count, path): wall time from the start of
+``cluster.train(close_feed=True)`` until ``shutdown()`` returns — i.e.
+until every node has DRAINED its feed, not merely until the driver
+buffered it into rings — for a fixed payload of pickled byte records.
+
+Paths:
+- ``shm``: the co-located fast path (``native/shmring.cc``).
+- ``tcp``: the manager-proxy path every remote node uses (forced by
+  disabling the driver-side ring lookup; the node-side ring still
+  exists but no producer attaches).
+- ``manifest``: node-side feeders (``feed/manifest.py``) — the driver
+  ships one FileManifest per node and each node streams its file
+  locally; driver traffic is O(files), so this path's number is the
+  node-local read rate, not a driver ceiling.
+
+Usage::
+
+    python benchmarks/feed_plane.py [--nodes 1,2,4,8] [--mb-per-node 64]
+        [--record-kb 64] [--paths shm,tcp] [--json out.jsonl]
+
+Prints one JSON line per configuration.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def drain_fn(args, ctx):
+    """Consume the feed as fast as possible; count records."""
+    feed = ctx.get_data_feed()
+    if args.get("manifest"):
+        from tensorflowonspark_tpu.feed.manifest import ManifestFeed
+
+        feed = ManifestFeed(feed)
+    n = 0
+    while not feed.should_stop():
+        rows = feed.next_batch(int(args["batch"]))
+        n += len(rows)
+    print(f"node {ctx.worker_num}: drained {n} records", flush=True)
+
+
+def _run_config(n_nodes: int, path: str, mb_per_node: int, record_kb: int,
+                batch: int) -> dict:
+    from tensorflowonspark_tpu.cluster import node as tfnode_runtime
+    from tensorflowonspark_tpu.cluster import tfcluster
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+    from tensorflowonspark_tpu.utils.util import cpu_only_env
+
+    import tempfile
+
+    record = b"x" * (record_kb * 1024)
+    per_node = (mb_per_node * 1024 * 1024) // len(record)
+    tmpdir = None
+    if path == "manifest":
+        # Node-side feeders: the driver ships ONE FileManifest per node;
+        # each node streams its file locally (feed/manifest.py). File
+        # creation is setup, not part of the timed window.
+        from tensorflowonspark_tpu.feed.manifest import FileManifest
+
+        tmpdir = tempfile.TemporaryDirectory(prefix="feed_plane_")
+        line = "x" * (record_kb * 1024 - 1)
+        partitions = []
+        for i in range(n_nodes):
+            fp = f"{tmpdir.name}/node{i}.txt"
+            with open(fp, "w") as f:
+                for _ in range(per_node):
+                    f.write(line + "\n")
+            partitions.append([FileManifest(fp, format="lines")])
+    else:
+        partitions = [[record] * per_node for _ in range(n_nodes)]
+    total_mb = n_nodes * per_node * len(record) / 1e6
+
+    real_node_ring = tfnode_runtime._node_ring
+    if path == "tcp":
+        # Driver-side only: pretend no ring is advertised, forcing every
+        # chunk through the TCP manager proxy (what any remote node gets).
+        tfnode_runtime._node_ring = lambda node: None
+    try:
+        cluster = tfcluster.run(
+            drain_fn,
+            {"batch": batch, "manifest": path == "manifest"},
+            num_executors=n_nodes,
+            input_mode=InputMode.SPARK,
+            reservation_timeout=120,
+            env=cpu_only_env(),
+        )
+        t0 = time.perf_counter()
+        cluster.train(partitions, close_feed=True)
+        cluster.shutdown(timeout=600)
+        secs = time.perf_counter() - t0
+    finally:
+        tfnode_runtime._node_ring = real_node_ring
+        if tmpdir is not None:
+            tmpdir.cleanup()
+    return {
+        "bench": "feed_plane",
+        "nodes": n_nodes,
+        "path": path,
+        "record_kb": record_kb,
+        "mb_total": round(total_mb, 1),
+        "secs": round(secs, 3),
+        "mb_per_s": round(total_mb / secs, 1),
+        "mb_per_s_per_node": round(total_mb / secs / n_nodes, 1),
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--nodes", default="1,2,4,8")
+    p.add_argument("--mb-per-node", type=int, default=64)
+    p.add_argument("--record-kb", type=int, default=64)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--paths", default="shm,tcp")
+    p.add_argument("--json", default=None, help="also append JSONL here")
+    args = p.parse_args(argv)
+
+    out = open(args.json, "a") if args.json else None
+    try:
+        for n in [int(x) for x in args.nodes.split(",") if x.strip()]:
+            for path in [x.strip() for x in args.paths.split(",") if x.strip()]:
+                row = _run_config(
+                    n, path, args.mb_per_node, args.record_kb, args.batch
+                )
+                line = json.dumps(row)
+                print(line, flush=True)
+                if out:
+                    out.write(line + "\n")
+    finally:
+        if out:
+            out.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

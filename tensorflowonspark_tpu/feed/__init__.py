@@ -7,6 +7,7 @@ device, overlapped with the training step.
 """
 
 from tensorflowonspark_tpu.feed.datafeed import DataFeed
+from tensorflowonspark_tpu.feed.manifest import FileManifest, ManifestFeed
 from tensorflowonspark_tpu.feed.prefetch import DevicePrefetcher
 
-__all__ = ["DataFeed", "DevicePrefetcher"]
+__all__ = ["DataFeed", "DevicePrefetcher", "FileManifest", "ManifestFeed"]

@@ -1,0 +1,140 @@
+"""Manifest feeding — node-side feeders over the push control plane.
+
+The measured push-plane ceiling (BASELINE.md "Push-plane ceiling",
+`benchmarks/feed_plane.py`) is ~0.5–0.7 GB/s aggregate from one driver
+host: every byte of ``InputMode.SPARK`` crosses the driver. The
+reference never had this problem because its feed tasks ran *on the
+executors* with HDFS data locality — the driver shipped closures, not
+bytes (SURVEY.md §3.2).
+
+This module restores that property inside SPARK mode: the driver feeds
+:class:`FileManifest` records (tiny — a path and a format), and the
+node-side :class:`ManifestFeed` expands each manifest into its records
+by reading the file locally. Driver traffic drops from O(dataset bytes)
+to O(number of files); assignment, ordering, epochs, and shutdown keep
+the exact ``cluster.train`` semantics (manifests are ordinary records
+on the existing queue plane).
+
+Usage::
+
+    # driver: ship paths, not bytes
+    cluster.train([[FileManifest(p) for p in shard] for shard in shards])
+
+    # node (map_fun): expand locally
+    feed = ManifestFeed(ctx.get_data_feed())
+    while not feed.should_stop():
+        rows = feed.next_batch(batch_size)
+
+When the files live on shared storage (NFS/GCS/HDFS-FUSE) every node
+can read any manifest; with node-local storage, partition the manifests
+to match file placement — the driver controls assignment either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator
+
+__all__ = ["FileManifest", "ManifestFeed", "read_manifest"]
+
+
+@dataclasses.dataclass(frozen=True)
+class FileManifest:
+    """One node-readable unit of input: a file (or a record range of one).
+
+    ``format``: ``'tfrecord'`` (rows decoded via the native codec +
+    ``dfutil.fromTFExample``) or ``'lines'`` (text lines, stripped).
+    Custom formats: pass a ``reader`` callable to :class:`ManifestFeed`
+    instead. ``start``/``stop`` bound the record index range (Python
+    slice semantics), so one large file can be split across nodes.
+    """
+
+    path: str
+    format: str = "tfrecord"
+    start: int = 0
+    stop: int | None = None
+    binary_features: tuple[str, ...] = ()
+
+
+def read_manifest(
+    m: FileManifest, reader: Callable[[FileManifest], Iterator[Any]] | None = None
+) -> Iterator[Any]:
+    """Yield the records a manifest names, reading the file locally."""
+    if reader is not None:
+        yield from _sliced(reader(m), m)
+        return
+    if m.format == "tfrecord":
+        from tensorflowonspark_tpu.data import dfutil
+        from tensorflowonspark_tpu.native.tfrecord import read_records
+
+        # slice the SERIALIZED stream, decode only kept records: a node
+        # taking the tail of a shared file must not pay proto decoding
+        # for every record it skips
+        for s in _sliced(read_records(m.path), m):
+            yield dfutil.fromTFExample(s, list(m.binary_features))
+    elif m.format == "lines":
+        with open(m.path) as f:
+            yield from _sliced((line.rstrip("\n") for line in f), m)
+    else:
+        raise ValueError(
+            f"unknown manifest format {m.format!r}; use 'tfrecord', "
+            "'lines', or pass reader= to ManifestFeed"
+        )
+
+
+def _sliced(rows: Iterator[Any], m: FileManifest) -> Iterator[Any]:
+    import itertools
+
+    if m.start or m.stop is not None:
+        return itertools.islice(rows, m.start, m.stop)
+    return rows
+
+
+class ManifestFeed:
+    """Expand driver-fed :class:`FileManifest` records into data records.
+
+    Wraps a :class:`~tensorflowonspark_tpu.feed.datafeed.DataFeed`: each
+    record pulled from the underlying feed must be a FileManifest (or
+    whatever ``reader`` understands); its records stream out of
+    :meth:`next_batch` without ever crossing the driver.
+    ``should_stop`` matches DataFeed (false until the feed ends AND the
+    last manifest is drained), so existing training loops work
+    unchanged. One deliberate contract difference: batches fill across
+    file AND partition/epoch boundaries (manifests are pulled one at a
+    time, so DataFeed's partial-batch-at-EndPartition signal never
+    fires here) — steady batch shapes are what jitted training wants.
+    Callers needing strict epoch separation should make one ``train``
+    + drain cycle per epoch instead of ``num_epochs > 1``.
+    """
+
+    def __init__(
+        self,
+        feed,
+        reader: Callable[[FileManifest], Iterator[Any]] | None = None,
+    ):
+        self.feed = feed
+        self.reader = reader
+        self._iter: Iterator[Any] | None = None
+
+    def should_stop(self) -> bool:
+        return self._iter is None and self.feed.should_stop()
+
+    def next_batch(self, batch_size: int) -> list[Any]:
+        """Up to ``batch_size`` records; empty once the feed has ended
+        and the last manifest is drained."""
+        out: list[Any] = []
+        while len(out) < batch_size:
+            if self._iter is not None:
+                try:
+                    out.append(next(self._iter))
+                    continue
+                except StopIteration:
+                    self._iter = None
+            got = self.feed.next_batch(1)
+            if not got:
+                break  # EndOfFeed (DataFeed returns [] only then)
+            self._iter = read_manifest(got[0], self.reader)
+        return out
+
+    def terminate(self) -> None:
+        self.feed.terminate()

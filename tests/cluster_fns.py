@@ -52,6 +52,22 @@ def file_reader_fn(args, ctx):
         f.write(str(sum(mine)))
 
 
+def manifest_drain_fn(args, ctx):
+    """SPARK-mode map_fun consuming FileManifest records: the driver
+    ships paths, this node reads the files locally (the node-side
+    feeder pattern — BASELINE.md push-plane ceiling)."""
+    from tensorflowonspark_tpu.feed.manifest import ManifestFeed
+
+    feed = ManifestFeed(ctx.get_data_feed())
+    rows = []
+    while not feed.should_stop():
+        rows.extend(feed.next_batch(4))
+    out = os.path.join(args["out_dir"], f"node{ctx.executor_id}.txt")
+    with open(out, "w") as f:
+        for r in rows:
+            f.write(f"{r}\n")
+
+
 def _fit_linear(ctx, batch_size: int):
     """Shared feed-loop fitting y = w*x + b with a jitted SGD step."""
     import jax

@@ -1,0 +1,50 @@
+"""benchmarks/feed_plane.py smoke: the push-plane throughput bench's
+full path (cluster up, shm + forced-TCP feed, drain-timed JSON rows)
+must run at tiny sizes. The real numbers live in BASELINE.md."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.e2e
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_feed_plane_bench_smoke():
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PALLAS_AXON_REMOTE_COMPILE="",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "benchmarks", "feed_plane.py"),
+            "--nodes", "2",
+            "--mb-per-node", "4",
+            "--record-kb", "16",
+            "--paths", "shm,tcp",
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    assert [r["path"] for r in rows] == ["shm", "tcp"]
+    for r in rows:
+        assert r["nodes"] == 2
+        assert r["mb_per_s"] > 0
+        assert r["secs"] > 0
