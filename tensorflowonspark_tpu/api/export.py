@@ -140,6 +140,16 @@ def load_model(export_dir: str) -> AOTModel:
     return AOTModel(exported, state, meta)
 
 
+CPP_RUNNER_MANIFEST = "cpp_runner_manifest.txt"
+
+# TF DataType enum -> numpy-style name (the values the C runner maps back
+# to TF_* dtypes; tensorflow/core/framework/types.proto)
+_TF_DTYPE_NAMES = {
+    1: "float32", 2: "float64", 3: "int32", 4: "uint8", 9: "int64",
+    10: "bool", 14: "bfloat16",
+}
+
+
 def export_tf_saved_model(
     apply_fn: Callable[[Any, Any], Any],
     state: Any,
@@ -148,7 +158,12 @@ def export_tf_saved_model(
 ) -> str:
     """Export as a TensorFlow SavedModel via ``jax2tf`` (TF-serving interop;
     the closest analog of the artifact the reference's Scala API consumed).
-    Requires the optional TensorFlow install."""
+    Requires the optional TensorFlow install.
+
+    Besides the SavedModel itself, writes ``cpp_runner_manifest.txt`` —
+    the serving_default signature's tensor names and dtypes in a plain
+    line format — so the no-Python C++ runner (``native/aot_runner.cc``)
+    can bind inputs/outputs without parsing protos."""
     import tensorflow as tf
     from jax.experimental import jax2tf
 
@@ -167,4 +182,20 @@ def export_tf_saved_model(
     module = tf.Module()
     module.f = tf_fn
     tf.saved_model.save(module, export_dir)
+    _write_cpp_runner_manifest(export_dir)
     return export_dir
+
+
+def _write_cpp_runner_manifest(export_dir: str) -> None:
+    from tensorflow.python.tools import saved_model_utils
+
+    meta = saved_model_utils.get_meta_graph_def(export_dir, "serve")
+    sig = meta.signature_def["serving_default"]
+    lines = ["signature serving_default"]
+    for kind, entries in (("input", sig.inputs), ("output", sig.outputs)):
+        for key in sorted(entries):
+            v = entries[key]
+            dtype = _TF_DTYPE_NAMES.get(int(v.dtype), str(int(v.dtype)))
+            lines.append(f"{kind} {key} {v.name} {dtype}")
+    with open(os.path.join(export_dir, CPP_RUNNER_MANIFEST), "w") as f:
+        f.write("\n".join(lines) + "\n")

@@ -1,0 +1,306 @@
+// aot_runner — run an exported SavedModel with NO Python interpreter.
+//
+// The last inch of the reference's Scala/JVM inference-API parity
+// (SURVEY.md §2.2 L7): the reference's Scala API loaded SavedModels on
+// executors through the TF JVM runtime; this loads the SavedModel that
+// `api/export.py:export_tf_saved_model` writes (jax2tf-converted JAX
+// model) through the TF C API and runs batches from .npy files.
+// Tensor names come from the export's `cpp_runner_manifest.txt` (plain
+// lines: `input <logical> <op:idx> <dtype>`), so no proto parsing is
+// needed here.
+//
+// Usage:
+//   aot_runner <saved_model_dir> --in <file.npy> [--in <file2.npy> ...]
+//              [--out-prefix <prefix>]
+//
+// Inputs bind to the manifest's inputs in manifest (sorted-key) order.
+// Each output is written as `<prefix><logical>.npy` (default "out_"),
+// and its shape/dtype is printed to stdout.
+//
+// Build (see native/aot_runner.py:build_runner, which does this on
+// demand against the tensorflow pip package's lib + headers):
+//   g++ -O2 -std=c++17 aot_runner.cc -I$TF/include \
+//       -l:libtensorflow_cc.so.2 -l:libtensorflow_framework.so.2 \
+//       -L$TF -Wl,-rpath,$TF -o aot_runner
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "tensorflow/c/c_api.h"
+
+namespace {
+
+struct Npy {
+  std::vector<int64_t> shape;
+  std::string dtype;  // numpy-style: float32, int32, ...
+  std::vector<char> data;
+};
+
+[[noreturn]] void die(const std::string& msg) {
+  std::fprintf(stderr, "aot_runner: %s\n", msg.c_str());
+  std::exit(1);
+}
+
+// bfloat16 is deliberately absent everywhere below (npy has no native
+// bf16 descr): a bf16-signature model fails fast at manifest read
+// instead of after a full inference. Export bf16 models with an fp32
+// boundary (cast in apply_fn) for this runner.
+size_t dtype_size(const std::string& d) {
+  if (d == "float32" || d == "int32") return 4;
+  if (d == "float64" || d == "int64") return 8;
+  if (d == "uint8" || d == "bool") return 1;
+  die("unsupported dtype " + d);
+}
+
+TF_DataType tf_dtype(const std::string& d) {
+  if (d == "float32") return TF_FLOAT;
+  if (d == "float64") return TF_DOUBLE;
+  if (d == "int32") return TF_INT32;
+  if (d == "int64") return TF_INT64;
+  if (d == "uint8") return TF_UINT8;
+  if (d == "bool") return TF_BOOL;
+  die("unsupported dtype " + d);
+}
+
+std::string npy_descr(const std::string& d) {
+  if (d == "float32") return "<f4";
+  if (d == "float64") return "<f8";
+  if (d == "int32") return "<i4";
+  if (d == "int64") return "<i8";
+  if (d == "uint8") return "|u1";
+  if (d == "bool") return "|b1";
+  die("cannot write dtype " + d);
+}
+
+std::string dtype_from_descr(const std::string& descr) {
+  if (descr == "<f4" || descr == "=f4") return "float32";
+  if (descr == "<f8" || descr == "=f8") return "float64";
+  if (descr == "<i4" || descr == "=i4") return "int32";
+  if (descr == "<i8" || descr == "=i8") return "int64";
+  if (descr == "|u1") return "uint8";
+  if (descr == "|b1") return "bool";
+  die("unsupported npy descr " + descr);
+}
+
+// Minimal .npy v1/v2 reader: little-endian C-order arrays only.
+Npy read_npy(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) die("cannot open " + path);
+  char magic[6];
+  f.read(magic, 6);
+  if (!f || std::memcmp(magic, "\x93NUMPY", 6) != 0)
+    die(path + " is not a .npy file");
+  unsigned char ver[2];
+  f.read(reinterpret_cast<char*>(ver), 2);
+  uint32_t hlen = 0;
+  if (ver[0] == 1) {
+    unsigned char b[2];
+    f.read(reinterpret_cast<char*>(b), 2);
+    hlen = b[0] | (b[1] << 8);
+  } else {
+    unsigned char b[4];
+    f.read(reinterpret_cast<char*>(b), 4);
+    hlen = b[0] | (b[1] << 8) | (b[2] << 16) | (b[3] << 24);
+  }
+  std::string header(hlen, '\0');
+  f.read(&header[0], hlen);
+
+  auto field = [&](const std::string& key) -> std::string {
+    size_t k = header.find("'" + key + "'");
+    if (k == std::string::npos) die(path + ": npy header missing " + key);
+    size_t c = header.find(':', k);
+    size_t start = header.find_first_not_of(" ", c + 1);
+    if (header[start] == '\'') {
+      size_t end = header.find('\'', start + 1);
+      return header.substr(start + 1, end - start - 1);
+    }
+    if (header[start] == '(') {
+      size_t end = header.find(')', start);
+      return header.substr(start + 1, end - start - 1);
+    }
+    size_t end = header.find_first_of(",}", start);
+    return header.substr(start, end - start);
+  };
+
+  if (field("fortran_order") != "False")
+    die(path + ": fortran-order npy not supported");
+  Npy out;
+  out.dtype = dtype_from_descr(field("descr"));
+  std::stringstream shape(field("shape"));
+  std::string tok;
+  while (std::getline(shape, tok, ',')) {
+    tok.erase(0, tok.find_first_not_of(" "));
+    if (!tok.empty()) out.shape.push_back(std::stoll(tok));
+  }
+  size_t count = 1;
+  for (int64_t d : out.shape) count *= static_cast<size_t>(d);
+  out.data.resize(count * dtype_size(out.dtype));
+  f.read(out.data.data(), static_cast<std::streamsize>(out.data.size()));
+  if (!f) die(path + ": truncated npy data");
+  return out;
+}
+
+void write_npy(const std::string& path, const std::string& dtype,
+               const std::vector<int64_t>& shape, const void* data,
+               size_t nbytes) {
+  std::ostringstream dict;
+  dict << "{'descr': '" << npy_descr(dtype)
+       << "', 'fortran_order': False, 'shape': (";
+  // every dim emits "N, " — the 1-D case thus gets the trailing comma
+  // python's tuple syntax wants
+  for (size_t i = 0; i < shape.size(); ++i) dict << shape[i] << ", ";
+  dict << "), }";
+  std::string h = dict.str();
+  size_t total = 10 + h.size() + 1;           // magic+ver+len + header + \n
+  size_t pad = (64 - total % 64) % 64;
+  h += std::string(pad, ' ');
+  h += '\n';
+  std::ofstream f(path, std::ios::binary);
+  if (!f) die("cannot write " + path);
+  f.write("\x93NUMPY\x01\x00", 8);
+  uint16_t hlen = static_cast<uint16_t>(h.size());
+  char lenb[2] = {static_cast<char>(hlen & 0xff),
+                  static_cast<char>(hlen >> 8)};
+  f.write(lenb, 2);
+  f.write(h.data(), static_cast<std::streamsize>(h.size()));
+  f.write(static_cast<const char*>(data),
+          static_cast<std::streamsize>(nbytes));
+}
+
+struct Binding {
+  std::string logical;
+  std::string tensor;  // "op:idx"
+  std::string dtype;
+};
+
+struct Manifest {
+  std::vector<Binding> inputs, outputs;
+};
+
+Manifest read_manifest(const std::string& dir) {
+  std::string path = dir + "/cpp_runner_manifest.txt";
+  std::ifstream f(path);
+  if (!f)
+    die("missing " + path +
+        " (re-export with api.export.export_tf_saved_model)");
+  Manifest m;
+  std::string line;
+  while (std::getline(f, line)) {
+    std::stringstream ss(line);
+    std::string kind, logical, tensor, dtype;
+    ss >> kind >> logical >> tensor >> dtype;
+    if (kind == "input") m.inputs.push_back({logical, tensor, dtype});
+    if (kind == "output") m.outputs.push_back({logical, tensor, dtype});
+  }
+  if (m.inputs.empty() || m.outputs.empty())
+    die(path + " has no inputs/outputs");
+  // Fail fast on unsupported dtypes (e.g. a bf16 signature) before any
+  // model load or inference work is spent.
+  for (const Binding& b : m.inputs) tf_dtype(b.dtype);
+  for (const Binding& b : m.outputs) npy_descr(b.dtype);
+  return m;
+}
+
+TF_Output resolve(TF_Graph* graph, const std::string& tensor) {
+  size_t colon = tensor.rfind(':');
+  std::string op = tensor.substr(0, colon);
+  int index = colon == std::string::npos
+                  ? 0
+                  : std::stoi(tensor.substr(colon + 1));
+  TF_Operation* oper = TF_GraphOperationByName(graph, op.c_str());
+  if (!oper) die("graph has no operation " + op);
+  return TF_Output{oper, index};
+}
+
+void check(TF_Status* status) {
+  if (TF_GetCode(status) != TF_OK) die(TF_Message(status));
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr,
+                 "usage: aot_runner <saved_model_dir> --in f.npy [--in ...] "
+                 "[--out-prefix p]\n");
+    return 2;
+  }
+  std::string dir = argv[1];
+  std::vector<std::string> in_paths;
+  std::string out_prefix = "out_";
+  for (int i = 2; i < argc; ++i) {
+    std::string a = argv[i];
+    if (a == "--in" && i + 1 < argc) {
+      in_paths.push_back(argv[++i]);
+    } else if (a == "--out-prefix" && i + 1 < argc) {
+      out_prefix = argv[++i];
+    } else {
+      die("unknown argument " + a);
+    }
+  }
+
+  Manifest m = read_manifest(dir);
+  if (in_paths.size() != m.inputs.size())
+    die("model takes " + std::to_string(m.inputs.size()) +
+        " input(s), got " + std::to_string(in_paths.size()));
+
+  TF_Status* status = TF_NewStatus();
+  TF_SessionOptions* opts = TF_NewSessionOptions();
+  TF_Graph* graph = TF_NewGraph();
+  const char* tags[] = {"serve"};
+  TF_Session* session = TF_LoadSessionFromSavedModel(
+      opts, nullptr, dir.c_str(), tags, 1, graph, nullptr, status);
+  check(status);
+
+  std::vector<TF_Output> in_ops, out_ops;
+  std::vector<TF_Tensor*> in_tensors;
+  for (size_t i = 0; i < m.inputs.size(); ++i) {
+    Npy npy = read_npy(in_paths[i]);
+    if (npy.dtype != m.inputs[i].dtype)
+      die("input " + m.inputs[i].logical + " expects " + m.inputs[i].dtype +
+          ", file has " + npy.dtype);
+    in_ops.push_back(resolve(graph, m.inputs[i].tensor));
+    TF_Tensor* t = TF_AllocateTensor(
+        tf_dtype(npy.dtype), npy.shape.data(),
+        static_cast<int>(npy.shape.size()), npy.data.size());
+    std::memcpy(TF_TensorData(t), npy.data.data(), npy.data.size());
+    in_tensors.push_back(t);
+  }
+  for (const Binding& b : m.outputs) out_ops.push_back(resolve(graph, b.tensor));
+  std::vector<TF_Tensor*> out_tensors(m.outputs.size(), nullptr);
+
+  TF_SessionRun(session, nullptr, in_ops.data(), in_tensors.data(),
+                static_cast<int>(in_tensors.size()), out_ops.data(),
+                out_tensors.data(), static_cast<int>(out_tensors.size()),
+                nullptr, 0, nullptr, status);
+  check(status);
+
+  for (size_t i = 0; i < out_tensors.size(); ++i) {
+    TF_Tensor* t = out_tensors[i];
+    std::vector<int64_t> shape(TF_NumDims(t));
+    std::ostringstream shape_str;
+    for (int d = 0; d < TF_NumDims(t); ++d) {
+      shape[d] = TF_Dim(t, d);
+      shape_str << (d ? "," : "") << shape[d];
+    }
+    const std::string& dtype = m.outputs[i].dtype;
+    std::string path = out_prefix + m.outputs[i].logical + ".npy";
+    write_npy(path, dtype, shape, TF_TensorData(t), TF_TensorByteSize(t));
+    std::printf("%s shape=(%s) dtype=%s -> %s\n",
+                m.outputs[i].logical.c_str(), shape_str.str().c_str(),
+                dtype.c_str(), path.c_str());
+    TF_DeleteTensor(t);
+  }
+  for (TF_Tensor* t : in_tensors) TF_DeleteTensor(t);
+  TF_CloseSession(session, status);
+  TF_DeleteSession(session, status);
+  TF_DeleteGraph(graph);
+  TF_DeleteSessionOptions(opts);
+  TF_DeleteStatus(status);
+  return 0;
+}
