@@ -510,30 +510,17 @@ def _chunked(data: Iterable, n: int):
 
 
 def columnize(chunk: Sequence[Any], mapping: dict[str, str] | None):
-    """Rows → named (or bare) input arrays per ``input_mapping``."""
+    """Rows → named (or bare) input arrays per ``input_mapping``.
+
+    The mapping path (positional contract for tuple records, loud
+    missing-field errors for dict records) is the shared
+    ``feed.datafeed.columnize_rows`` — one implementation for the feed
+    and pipeline planes."""
     if mapping is None:
         return np.asarray(chunk)
-    cols = list(mapping.keys())
-    if isinstance(chunk[0], (tuple, list)):
-        # Positional contract (reference: pipeline.py input_mapping is
-        # "ordered dict of input DataFrame column to input tensor"):
-        # the mapping's key order IS the record layout, so it must
-        # enumerate every field — a subset would silently bind fields
-        # to the wrong tensors.
-        if len(chunk[0]) != len(cols):
-            raise ValueError(
-                f"input_mapping has {len(cols)} columns {cols} but "
-                f"records have {len(chunk[0])} fields; for tuple "
-                "records the mapping must name every field, in order"
-            )
-        index = {col: i for i, col in enumerate(cols)}
-        get = lambda rec, col: rec[index[col]]  # noqa: E731
-    else:
-        get = lambda rec, col: rec[col]  # noqa: E731
-    return {
-        tensor: np.asarray([get(rec, col) for rec in chunk])
-        for col, tensor in mapping.items()
-    }
+    from tensorflowonspark_tpu.feed.datafeed import columnize_rows
+
+    return columnize_rows(chunk, mapping)
 
 
 def rowize(result: Any, n: int, mapping: dict[str, str] | None) -> list[Any]:

@@ -25,6 +25,45 @@ from tensorflowonspark_tpu.cluster.marker import EndOfFeed, EndPartition, Marker
 logger = logging.getLogger(__name__)
 
 
+def columnize_rows(
+    batch: Sequence[Any], input_mapping: dict[str, str]
+) -> dict[str, np.ndarray]:
+    """Stack a list of row-records into {tensor_name: array} columns —
+    THE column-assembly implementation (``api/pipeline.columnize``
+    delegates here for its mapping path).
+
+    Tuple/list records are read by *position* (mapping order = column
+    order, the reference's contract), and the mapping must name every
+    field — a subset would silently bind fields to the wrong tensors.
+    Dict records are read by the mapping's field-name keys; a record
+    missing a mapped field fails loudly — silently indexing dicts by
+    position was the round-1 trap.
+    """
+    out: dict[str, np.ndarray] = {}
+    if batch and isinstance(batch[0], dict):
+        for field, tensor in input_mapping.items():
+            try:
+                out[tensor] = np.array([row[field] for row in batch])
+            except (KeyError, TypeError) as e:
+                raise KeyError(
+                    f"input_mapping field {field!r} not present in a "
+                    f"dict record (record keys: "
+                    f"{sorted(batch[0])}); mapping={input_mapping}"
+                ) from e
+        return out
+    if batch and isinstance(batch[0], (tuple, list)):
+        cols = list(input_mapping)
+        if len(batch[0]) != len(cols):
+            raise ValueError(
+                f"input_mapping has {len(cols)} columns {cols} but "
+                f"records have {len(batch[0])} fields; for tuple "
+                "records the mapping must name every field, in order"
+            )
+    for i, tensor in enumerate(input_mapping.values()):
+        out[tensor] = np.array([row[i] for row in batch])
+    return out
+
+
 class DataFeed:
     """Pulls host-fed batches off the node's input queue; pushes inference
     results back on the output queue.
@@ -48,6 +87,8 @@ class DataFeed:
         self.qname_in = qname_in
         self.qname_out = qname_out
         self.input_mapping = input_mapping
+        # reference-parity public surface (TFNode.py DataFeed exposed it);
+        # derived, not used internally
         self.input_tensors = (
             list(input_mapping.values()) if input_mapping is not None else None
         )
@@ -97,28 +138,7 @@ class DataFeed:
         return batch
 
     def _columnize(self, batch: Sequence[Any]) -> dict[str, np.ndarray]:
-        """Stack a list of row-records into {tensor_name: array} columns.
-
-        Tuple/list records are read by *position* (mapping order = column
-        order, the reference's contract); dict records by the mapping's
-        field-name keys. A dict record missing a mapped field fails loudly
-        — silently indexing dicts by position was the round-1 trap.
-        """
-        out: dict[str, np.ndarray] = {}
-        if batch and isinstance(batch[0], dict):
-            for field, tensor in self.input_mapping.items():
-                try:
-                    out[tensor] = np.array([row[field] for row in batch])
-                except (KeyError, TypeError) as e:
-                    raise KeyError(
-                        f"input_mapping field {field!r} not present in a "
-                        f"dict record (record keys: "
-                        f"{sorted(batch[0])}); mapping={self.input_mapping}"
-                    ) from e
-            return out
-        for i, tensor in enumerate(self.input_tensors):
-            out[tensor] = np.array([row[i] for row in batch])
-        return out
+        return columnize_rows(batch, self.input_mapping)
 
     def batch_stream(self, batch_size: int, multiple_of: int = 1):
         """Yield fixed-size batches, buffering across partition boundaries.

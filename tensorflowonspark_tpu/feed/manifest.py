@@ -136,5 +136,32 @@ class ManifestFeed:
             self._iter = read_manifest(got[0], self.reader)
         return out
 
+    def batch_stream(
+        self,
+        batch_size: int,
+        multiple_of: int = 1,
+        input_mapping: dict[str, str] | None = None,
+    ):
+        """Fixed-size batches, exactly like ``DataFeed.batch_stream``
+        (steady jit shapes; the feed tail trims to ``multiple_of``).
+        Manifest records are rows, so an ``input_mapping`` for column
+        assembly is taken here rather than from the underlying feed
+        (whose records are manifests, not rows)."""
+        from tensorflowonspark_tpu.feed.datafeed import columnize_rows
+        from tensorflowonspark_tpu.utils.batching import fixed_size_batches
+
+        def records():
+            while not self.should_stop():
+                yield from self.next_batch(batch_size)
+
+        assemble = (
+            (lambda rows: columnize_rows(list(rows), input_mapping))
+            if input_mapping is not None
+            else (lambda rows: list(rows))
+        )
+        yield from fixed_size_batches(
+            records(), batch_size, multiple_of, assemble=assemble
+        )
+
     def terminate(self) -> None:
         self.feed.terminate()

@@ -78,6 +78,28 @@ def test_manifest_feed_batches_across_files(tmp_path):
     assert feed.next_batch(8) == ["three"] * 3
 
 
+def test_manifest_feed_batch_stream(tmp_path):
+    """batch_stream parity with DataFeed: fixed shapes, multiple_of
+    trimming, and column assembly from an input_mapping (rows are the
+    manifest-expanded records, not the manifests)."""
+    from tensorflowonspark_tpu.data import dfutil
+
+    rows = [{"x": float(i), "label": i % 3} for i in range(22)]
+    dfutil.saveAsTFRecords(rows, str(tmp_path / "rec"))
+    (path,) = dfutil.tfrecord_files(str(tmp_path / "rec"))
+
+    feed = ManifestFeed(_FakeFeed([FileManifest(path)]))
+    batches = list(
+        feed.batch_stream(
+            8, multiple_of=4, input_mapping={"x": "x", "label": "y"}
+        )
+    )
+    # 22 records -> 8, 8, then tail 6 trimmed to 4 (multiple_of)
+    assert [len(b["y"]) for b in batches] == [8, 8, 4]
+    got = np.concatenate([np.ravel(b["y"]) for b in batches])
+    np.testing.assert_array_equal(got, [i % 3 for i in range(20)])
+
+
 @pytest.mark.e2e
 def test_manifest_feeding_through_cluster(tmp_path):
     """End-to-end: driver feeds ONLY FileManifest records (O(files)
