@@ -31,6 +31,24 @@ import argparse
 import json
 import time
 
+# Set by --profile: after each config's timed loop, a few extra steps run
+# under jax.profiler.trace so the relay window yields a trace to attack
+# the MFU gap with (VERDICT round-2 weak #1: ResNet needs on-chip
+# profiling, not blind dtype fixes), without polluting the timed numbers.
+_PROFILE_DIR = None
+
+
+def _maybe_trace(run_steps) -> None:
+    """Trace a short post-timing window; ``run_steps(n)`` must execute n
+    steps and end with a host-fetch barrier."""
+    if not _PROFILE_DIR:
+        return
+    import jax
+
+    with jax.profiler.trace(_PROFILE_DIR):
+        run_steps(5)
+    print(f"profile trace written to {_PROFILE_DIR}", flush=True)
+
 
 def _bench_step(step, state, make_batch, steps: int, warmup: int = 3):
     """Time `steps` executions of step(state, batch); return (state, dt).
@@ -50,7 +68,18 @@ def _bench_step(step, state, make_batch, steps: int, warmup: int = 3):
     for _ in range(steps):
         state, loss = step(state, batch)
     loss = float(loss)
-    return state, time.perf_counter() - t0, loss
+    dt = time.perf_counter() - t0
+
+    def run_steps(n):
+        # thread the live state (step may donate its input buffers);
+        # the returned float loss stays untouched
+        nonlocal state
+        for _ in range(n):
+            state, l = step(state, batch)
+        float(l)
+
+    _maybe_trace(run_steps)
+    return state, dt, loss
 
 
 def bench_mnist(args):
@@ -120,9 +149,17 @@ def _bench_bn_model(model, loss_fn, tx, batch, steps, flops_of=None):
     t0 = time.perf_counter()
     for _ in range(steps):
         state, batch_stats, loss = step(state, batch_stats, dev_batch)
-    float(loss)
+    loss = float(loss)  # host fetch = timing barrier
     dt = time.perf_counter() - t0
-    return dt, float(loss), flops
+
+    def run_steps(n):
+        nonlocal state, batch_stats
+        for _ in range(n):
+            state, batch_stats, l = step(state, batch_stats, dev_batch)
+        float(l)
+
+    _maybe_trace(run_steps)
+    return dt, loss, flops
 
 
 def bench_resnet50(args):
@@ -357,6 +394,12 @@ def bench_llama1b_decode(args):
         out = generate(model, params, prompt, new_tokens)
         np.asarray(out[0, :1])  # host fetch = real barrier
     dt = time.perf_counter() - t0
+
+    def run_steps(n):
+        for _ in range(n):
+            np.asarray(generate(model, params, prompt, new_tokens)[0, :1])
+
+    _maybe_trace(run_steps)
     # Reported so that step_time_ms is ONE single-token decode step and
     # examples_per_sec is new tokens/sec: examples = batch rows, dt
     # rescaled by tokens-per-generate.
@@ -420,7 +463,17 @@ def main(argv=None):
         default=V5E_PEAK_TFLOPS,
         help="per-chip bf16 peak",
     )
+    p.add_argument(
+        "--profile",
+        default=None,
+        metavar="DIR",
+        help="after the timed loop, trace 5 extra steps with "
+        "jax.profiler into DIR (TensorBoard-readable; does not touch "
+        "the timed numbers)",
+    )
     args = p.parse_args(argv)
+    global _PROFILE_DIR
+    _PROFILE_DIR = args.profile
 
     import jax
 

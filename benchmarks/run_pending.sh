@@ -34,8 +34,12 @@ run() {
 # 1. end-to-end bench.py with the bf16-moment default (BENCH_r02 headline)
 run python bench.py
 
-# 2. ResNet-50 with the round-2 bf16 BN-normalize fix (was 15.8% MFU)
-run python benchmarks/real_chip.py --config resnet50
+# 2. ResNet-50 with the round-2 bf16 BN-normalize fix (was 15.8% MFU).
+#    --profile captures a jax.profiler trace of 5 post-timing steps so
+#    the remaining MFU gap can be attacked from evidence, not guesses
+#    (VERDICT round-2 item 2).
+run python benchmarks/real_chip.py --config resnet50 \
+  --profile "${PROFILE_DIR:-/tmp/resnet50_profile}"
 
 # 3. Inception-v3 — the reference's headline scaling model
 run python benchmarks/real_chip.py --config inception_v3
