@@ -25,6 +25,9 @@ from tensorflowonspark_tpu.models.llama import (  # noqa: F401
     Llama,
     llama_param_shardings,
 )
+from tensorflowonspark_tpu.models.speculative import (  # noqa: F401
+    speculative_generate,
+)
 from tensorflowonspark_tpu.models.resnet import (  # noqa: F401
     ResNet,
     ResNetConfig,

@@ -1,0 +1,263 @@
+"""Speculative decoding — draft-and-verify greedy generation.
+
+A small DRAFT model proposes ``k`` tokens autoregressively; the TARGET
+model scores all ``k+1`` positions in ONE forward and keeps the longest
+prefix it agrees with plus its own correction token. Greedy speculative
+decoding emits EXACTLY the target model's greedy sequence (the
+acceptance rule only ever keeps tokens the target itself would have
+picked) — tested token-identically against :func:`...llama.generate`.
+
+Why it wins on TPU: single-token decode is HBM-bandwidth-bound — every
+step reads every weight once. Verification reads the target weights
+once per ``a+1`` emitted tokens (``a`` = accepted drafts), and the
+(B, k+1) verify forward is a better MXU shape than k+1 single-token
+steps. Net speedup ≈ (accepted+1) / (k·cost_draft/cost_target + 1).
+
+Cache discipline (no rollback needed): both models run their KV caches
+through the per-row scatter path (``padded=True``), where a token's
+slot IS its position and writes land BEFORE attention in each forward
+(``llama.py:_cached_attention``). Rejected drafts leave stale cache
+entries only at positions ≥ the next iteration's write window, and
+every such slot is overwritten by that window before any query's
+position reaches it — so acceptance just moves the position counters.
+
+Reference parity note: the reference had no decode path at all
+(SURVEY.md §2.2 — its serving story was per-executor SavedModel
+replay); this module is capability beyond the reference, built on the
+same KV-cache machinery as :func:`...llama.generate`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["speculative_generate"]
+
+
+def speculative_generate(
+    model,
+    params,
+    draft_model,
+    draft_params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    k: int = 4,
+    eos_id: int | None = None,
+    prompt_lengths: jax.Array | None = None,
+) -> jax.Array:
+    """Greedy speculative decode: (B, S) int32 -> (B, max_new_tokens).
+
+    Token-for-token identical to ``generate(model, params, prompt,
+    max_new_tokens, eos_id=...)`` (greedy) for ANY draft model — the
+    draft only changes speed, never output. ``k`` is the number of
+    draft proposals per verification; both models need
+    ``max_seq_len >= S + max_new_tokens + k`` (the verify window may
+    scratch up to ``k`` slots past the emitted text). Rows finish
+    independently on ``eos_id`` and the loop exits early once every
+    row is done. Mixed-length prompts: RIGHT-pad and pass
+    ``prompt_lengths`` (B,), exactly like ``generate``.
+    """
+    b, s = prompt.shape
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    for name, cfg in (("model", model.cfg), ("draft_model", draft_model.cfg)):
+        if s + max_new_tokens + k > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) + k "
+                f"({k}) exceeds {name}.cfg.max_seq_len ({cfg.max_seq_len})"
+            )
+    run = _build_speculative(
+        model,
+        draft_model,
+        b,
+        s,
+        max_new_tokens,
+        int(k),
+        None if eos_id is None else int(eos_id),
+        mixed=prompt_lengths is not None,
+    )
+    if prompt_lengths is None:
+        return run(params, draft_params, prompt)
+    lengths = jnp.asarray(prompt_lengths, jnp.int32)
+    if lengths.shape != (b,):
+        raise ValueError(
+            f"prompt_lengths must have shape ({b},), got {lengths.shape}"
+        )
+    import numpy as _np
+
+    host = _np.asarray(lengths)
+    if (host < 1).any() or (host > s).any():
+        raise ValueError(
+            f"prompt_lengths must be in [1, {s}] (the padded prompt "
+            f"width); got {host.tolist()}"
+        )
+    return run(params, draft_params, prompt, lengths)
+
+
+@functools.lru_cache(maxsize=16)
+def _build_speculative(
+    model, draft_model, b, s, max_new_tokens, k, eos_id, mixed=False
+):
+    """Compile-once body per (models, shapes, k, eos)."""
+
+    def greedy(logits):
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    @jax.jit
+    def run(params, draft_params, prompt, lengths=None):
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        # Prefill BOTH caches on the prompt. padded=True everywhere:
+        # slots are positions, which is what lets per-row acceptance
+        # advance rows independently.
+        t_logits, t_prefill = model.apply(
+            {"params": params},
+            prompt,
+            positions=positions,
+            decode=True,
+            padded=True,
+            mutable=["cache"],
+        )
+        _, d_prefill = draft_model.apply(
+            {"params": draft_params},
+            prompt,
+            positions=positions,
+            decode=True,
+            padded=True,
+            mutable=["cache"],
+        )
+        # first token: the target's own greedy pick at each row's last
+        # REAL prompt position (cache invariant from here on: `last` is
+        # NOT in either cache; `pos` is the next position to fill).
+        # Mixed-length rows: the pad-slot garbage a full-width prefill
+        # writes past a row's true length is only ever attended after
+        # being overwritten by that row's real tokens (write-before-
+        # attend + query position == write position), exactly as in
+        # ``generate``'s padded path.
+        if mixed:
+            last = greedy(
+                jnp.take_along_axis(
+                    t_logits, (lengths - 1)[:, None, None], axis=1
+                )[:, 0]
+            )
+            pos0 = lengths + 1
+        else:
+            last = greedy(t_logits[:, -1])
+            pos0 = jnp.full((b,), s + 1, jnp.int32)
+        fill = eos_id if eos_id is not None else 0
+        buf = jnp.full((b, max_new_tokens), fill, jnp.int32)
+        buf = buf.at[:, 0].set(last)
+        done = (
+            (last == eos_id)
+            if eos_id is not None
+            else jnp.zeros((b,), bool)
+        )
+        n_out = jnp.ones((b,), jnp.int32)
+
+        def draft_step(cache, tok, pos):
+            logits, updated = draft_model.apply(
+                {"params": draft_params, "cache": cache},
+                tok[:, None],
+                positions=pos[:, None],
+                decode=True,
+                padded=True,
+                mutable=["cache"],
+            )
+            return updated["cache"], greedy(logits[:, -1])
+
+        def cond(carry):
+            _, _, _, _, n_out, done, _ = carry
+            return ~jnp.all(done | (n_out >= max_new_tokens))
+
+        def body(carry):
+            t_cache, d_cache, last, pos, n_out, done, buf = carry
+
+            # --- draft k tokens sequentially -------------------------
+            def dstep(c, j):
+                d_cache, tok = c
+                d_cache, nxt = draft_step(d_cache, tok, pos - 1 + j)
+                return (d_cache, nxt), nxt
+
+            (d_cache, _), drafts = jax.lax.scan(
+                dstep, (d_cache, last), jnp.arange(k, dtype=jnp.int32)
+            )
+            drafts = jnp.swapaxes(drafts, 0, 1)  # (B, k)
+            # feed the draft its own final proposal: when all k are
+            # accepted the next iteration queries slot pos+k-1, which
+            # only this write fills (an unwritten slot would silently
+            # degrade the NEXT round's proposals — never correctness,
+            # which the target alone decides)
+            d_cache, _ = draft_step(d_cache, drafts[:, -1], pos - 1 + k)
+
+            # --- one target forward over [last, drafts[:-1]] ---------
+            # logits[:, j] predicts the token at position pos+j
+            verify_in = jnp.concatenate([last[:, None], drafts], axis=1)[
+                :, : k + 1
+            ]
+            vpos = pos[:, None] - 1 + jnp.arange(k + 1, dtype=jnp.int32)
+            t_logits, t_upd = model.apply(
+                {"params": params, "cache": t_cache},
+                verify_in,
+                positions=vpos,
+                decode=True,
+                padded=True,
+                mutable=["cache"],
+            )
+            t_cache = t_upd["cache"]
+            t_pick = greedy(t_logits)  # (B, k+1) target's own choices
+
+            # accepted = longest prefix where draft == target pick;
+            # emitted tokens are target picks throughout (positions
+            # 0..a-1 equal the drafts there, position a is the
+            # correction / bonus) — which is WHY output == plain greedy
+            match = t_pick[:, :k] == drafts  # (B, k)
+            accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                               axis=1)  # (B,) in [0, k]
+            emit = t_pick  # (B, k+1)
+            j_idx = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+            valid = j_idx <= accepted[:, None]
+
+            if eos_id is not None:
+                # nothing after a row's first EOS is emitted
+                before_eos = (
+                    jnp.cumsum((emit == eos_id).astype(jnp.int32), axis=1)
+                    - (emit == eos_id).astype(jnp.int32)
+                ) == 0
+                valid &= before_eos
+            valid &= ~done[:, None]
+
+            # scatter this iteration's tokens at per-row offsets;
+            # out-of-range (row full) writes drop
+            rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, k + 1))
+            cols = jnp.where(
+                valid, n_out[:, None] + j_idx, max_new_tokens
+            )
+            buf = buf.at[rows, cols].set(emit, mode="drop")
+
+            emitted = jnp.sum(valid.astype(jnp.int32), axis=1)
+            if eos_id is not None:
+                done = done | jnp.any((emit == eos_id) & valid, axis=1)
+            n_out_new = jnp.minimum(n_out + emitted, max_new_tokens)
+            done = done | (n_out_new >= max_new_tokens)
+
+            # next `last` = the last token this row emitted (the
+            # correction, or the last pre-EOS token for finishing
+            # rows); frozen rows keep their state
+            last_j = jnp.maximum(emitted - 1, 0)
+            new_last = jnp.take_along_axis(
+                emit, last_j[:, None], axis=1
+            )[:, 0]
+            step_rows = emitted > 0
+            last = jnp.where(step_rows, new_last, last)
+            pos = jnp.where(done, pos, pos + emitted)
+            n_out = n_out_new
+            return (t_cache, d_cache, last, pos, n_out, done, buf)
+
+        carry = (t_prefill["cache"], d_prefill["cache"], last, pos0,
+                 n_out, done, buf)
+        carry = jax.lax.while_loop(cond, body, carry)
+        return carry[6]
+
+    return run

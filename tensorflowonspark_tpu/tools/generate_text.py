@@ -62,6 +62,24 @@ def build_parser() -> argparse.ArgumentParser:
         "(TP weights on 'model', batch + KV caches on 'data'); "
         "--batch-size must be divisible by the 'data' extent",
     )
+    p.add_argument(
+        "--draft-checkpoint",
+        default=None,
+        help="greedy speculative decoding: orbax checkpoint of a "
+        "(smaller) draft model that proposes --spec-k tokens per "
+        "target verification; output is token-identical to the plain "
+        "greedy decode, only faster. Greedy-only; not combinable with "
+        "--mesh or --temperature",
+    )
+    p.add_argument(
+        "--draft-model", choices=("tiny", "1b", "7b"), default="tiny"
+    )
+    p.add_argument(
+        "--draft-config-overrides",
+        default=None,
+        help="JSON LlamaConfig overrides for the draft model",
+    )
+    p.add_argument("--spec-k", type=int, default=4)
     return p
 
 
@@ -143,6 +161,8 @@ def decode_batches(
     uniform: bool = False,
     pad_to_batch: bool = False,
     mesh=None,
+    draft=None,
+    spec_k: int = 4,
 ):
     """Decode ``prompts`` at ONE static (batch_size, width) shape so the
     jitted prefill + decode loop compiles exactly once: short chunks pad
@@ -165,6 +185,12 @@ def decode_batches(
     batch + KV caches on 'data' — ``models.llama.generate``'s mesh
     path). The effective batch size must be divisible by the 'data'
     extent (set ``pad_to_batch`` so it stays the full ``batch_size``).
+
+    ``draft``: a ``(draft_model, draft_params)`` pair switches decoding
+    to greedy speculative (``models.speculative``): the draft proposes
+    ``spec_k`` tokens per target verification. Output is token-
+    identical to the plain greedy decode — only speed changes.
+    Requires ``temperature == 0`` and no ``mesh``.
     """
     import jax
     import numpy as np
@@ -173,6 +199,19 @@ def decode_batches(
 
     if batch_size < 1:
         raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+    if draft is not None and (
+        temperature != 0.0 or top_k is not None or top_p is not None
+    ):
+        raise ValueError(
+            "speculative decoding is greedy-only (no temperature/"
+            "top_k/top_p): the acceptance rule keeps exactly the "
+            "target's argmax tokens"
+        )
+    if draft is not None and mesh is not None:
+        raise ValueError(
+            "speculative decoding does not compose with mesh-sharded "
+            "decode yet; drop --mesh or the draft"
+        )
     if not prompts:
         raise PromptError("no prompts given")
     bad = [i for i, p in enumerate(prompts) if not p or len(p) > width]
@@ -193,21 +232,41 @@ def decode_batches(
             padded[i, : len(p)] = p
             lengths[i] = len(p)
         rng, key = jax.random.split(rng)
-        toks = np.asarray(
-            generate(
-                model,
-                params,
-                jax.numpy.asarray(padded),
-                max_new_tokens=max_new_tokens,
-                temperature=temperature,
-                top_k=top_k,
-                top_p=top_p,
-                rng=key,
-                eos_id=eos_id,
-                prompt_lengths=None if uniform else lengths,
-                mesh=mesh,
+        if draft is not None:
+            from tensorflowonspark_tpu.models.speculative import (
+                speculative_generate,
             )
-        )
+
+            draft_model, draft_params = draft
+            toks = np.asarray(
+                speculative_generate(
+                    model,
+                    params,
+                    draft_model,
+                    draft_params,
+                    jax.numpy.asarray(padded),
+                    max_new_tokens=max_new_tokens,
+                    k=spec_k,
+                    eos_id=eos_id,
+                    prompt_lengths=None if uniform else lengths,
+                )
+            )
+        else:
+            toks = np.asarray(
+                generate(
+                    model,
+                    params,
+                    jax.numpy.asarray(padded),
+                    max_new_tokens=max_new_tokens,
+                    temperature=temperature,
+                    top_k=top_k,
+                    top_p=top_p,
+                    rng=key,
+                    eos_id=eos_id,
+                    prompt_lengths=None if uniform else lengths,
+                    mesh=mesh,
+                )
+            )
         for row in toks[:n_real]:
             row = row.tolist()
             if eos_id is not None and eos_id in row:
@@ -254,6 +313,16 @@ def main(argv: list[str] | None = None) -> int:
         # place the weights in their TP layout once, not per chunk
         params = jax.device_put(params, llama_param_shardings(params, mesh))
 
+    draft = None
+    if args.draft_checkpoint:
+        dcfg = _load_config(
+            argparse.Namespace(
+                model=args.draft_model,
+                config_overrides=args.draft_config_overrides,
+            )
+        )
+        draft = (Llama(dcfg), _load_params(args.draft_checkpoint, dcfg))
+
     completions, _ = decode_batches(
         model,
         params,
@@ -272,6 +341,8 @@ def main(argv: list[str] | None = None) -> int:
         # padding to the full batch keeps one shape that is
         pad_to_batch=mesh is not None,
         mesh=mesh,
+        draft=draft,
+        spec_k=args.spec_k,
     )
     out = open(args.output, "w") if args.output != "-" else sys.stdout
     try:

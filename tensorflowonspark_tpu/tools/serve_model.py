@@ -178,6 +178,46 @@ def _build_gen_fn(gen: dict):
             f"exceeds max_seq_len ({cfg.max_seq_len})"
         )
     rng_box = [jax.random.PRNGKey(int(gen.get("seed", 0)))]
+    draft = None
+    if gen.get("draft_checkpoint"):
+        # fail at startup, not on the first request — and BEFORE the
+        # (potentially multi-GB) draft checkpoint restore
+        spec_k = int(gen.get("spec_k", 4))
+        if spec_k < 1:
+            raise ValueError(f"--spec-k must be >= 1, got {spec_k}")
+        if (
+            float(gen.get("temperature", 0.0) or 0.0) != 0.0
+            or gen.get("top_k") is not None
+            or gen.get("top_p") is not None
+        ):
+            raise ValueError(
+                "--draft-checkpoint is greedy-only; drop --temperature/"
+                "--top-k/--top-p"
+            )
+        if gen.get("mesh"):
+            raise ValueError(
+                "--draft-checkpoint does not compose with --gen-mesh yet"
+            )
+        dcfg = _load_config(
+            argparse.Namespace(
+                model=gen.get("draft_model", "tiny"),
+                config_overrides=gen.get("draft_config_overrides"),
+            )
+        )
+        # speculative needs k slots of verify-window headroom in BOTH
+        # models' caches (speculative_generate re-checks per call; this
+        # makes a doomed configuration fail before serving starts)
+        for nm, c in (("--model", cfg), ("--draft-model", dcfg)):
+            if width + max_new + spec_k > c.max_seq_len:
+                raise ValueError(
+                    f"--gen-width ({width}) + --max-new-tokens "
+                    f"({max_new}) + --spec-k ({spec_k}) exceeds {nm}'s "
+                    f"max_seq_len ({c.max_seq_len})"
+                )
+        draft = (
+            Llama(dcfg),
+            _load_params(gen["draft_checkpoint"], dcfg),
+        )
     mesh = None
     if gen.get("mesh"):
         from tensorflowonspark_tpu.compute.mesh import (
@@ -205,6 +245,8 @@ def _build_gen_fn(gen: dict):
             prompts,
             batch_size=bsz,
             mesh=mesh,
+            draft=draft,
+            spec_k=int(gen.get("spec_k", 4)),
             # server mode: one (gen_batch_size, width) shape EVER
             # compiles — per-request sizes must not each compile
             pad_to_batch=True,
@@ -283,6 +325,18 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--eos-id", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
+        "--draft-checkpoint",
+        default=None,
+        help="greedy speculative decoding for /generate: draft model "
+        "checkpoint (output identical to plain greedy, only faster); "
+        "greedy-only, not combinable with --gen-mesh/--temperature",
+    )
+    p.add_argument(
+        "--draft-model", choices=("tiny", "1b", "7b"), default="tiny"
+    )
+    p.add_argument("--draft-config-overrides", default=None)
+    p.add_argument("--spec-k", type=int, default=4)
+    p.add_argument(
         "--gen-mesh",
         default=None,
         help="shard /generate decoding over a device mesh, e.g. "
@@ -309,6 +363,10 @@ def main(argv: list[str] | None = None) -> int:
             eos_id=args.eos_id,
             seed=args.seed,
             mesh=args.gen_mesh,
+            draft_checkpoint=args.draft_checkpoint,
+            draft_model=args.draft_model,
+            draft_config_overrides=args.draft_config_overrides,
+            spec_k=args.spec_k,
         )
     server = make_server(
         args.export_dir, args.port, args.batch_size, host=args.host, gen=gen

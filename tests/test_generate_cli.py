@@ -109,6 +109,48 @@ def test_cli_mesh_sharded_decode_matches_unsharded(tmp_path):
     assert outs["mesh"] == outs["plain"]
 
 
+def test_cli_speculative_matches_plain(tmp_path):
+    """--draft-checkpoint switches to speculative decoding; output must
+    be byte-identical to the plain greedy CLI run (self-draft here —
+    the exactness contract holds for any draft)."""
+    cfg, model, params, ckpt_dir = _tiny_checkpoint(tmp_path)
+    prompts = [[1, 2, 3], [4, 5, 6, 7, 8], [9, 9]]
+    pfile = tmp_path / "prompts.jsonl"
+    pfile.write_text(
+        "".join(json.dumps({"tokens": p}) + "\n" for p in prompts)
+    )
+    outs = {}
+    for label, extra in (
+        ("plain", []),
+        (
+            "spec",
+            [
+                "--draft-checkpoint", ckpt_dir,
+                "--draft-model", "tiny",
+                "--draft-config-overrides",
+                '{"remat": false, "dtype": "float32"}',
+                "--spec-k", "3",
+            ],
+        ),
+    ):
+        ofile = tmp_path / f"out_{label}.jsonl"
+        rc = main(
+            [
+                "--checkpoint", ckpt_dir,
+                "--model", "tiny",
+                "--config-overrides", '{"remat": false, "dtype": "float32"}',
+                "--prompts", str(pfile),
+                "--output", str(ofile),
+                "--max-new-tokens", "7",
+                "--batch-size", "3",
+                *extra,
+            ]
+        )
+        assert rc == 0
+        outs[label] = ofile.read_text()
+    assert outs["spec"] == outs["plain"]
+
+
 def test_cli_eos_trims_output(tmp_path):
     cfg, model, params, ckpt_dir = _tiny_checkpoint(tmp_path)
     pfile = tmp_path / "prompts.jsonl"

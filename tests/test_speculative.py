@@ -1,0 +1,112 @@
+"""Speculative decoding: greedy output must be TOKEN-IDENTICAL to the
+target model's plain greedy decode, for any draft model — the draft
+changes speed, never output (models/speculative.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.models.llama import Llama, LlamaConfig, generate
+from tensorflowonspark_tpu.models.speculative import speculative_generate
+
+
+@pytest.fixture(scope="module")
+def target_and_draft():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=False)
+    target = Llama(cfg)
+    t_params = target.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 16), jnp.int32)
+    )["params"]
+    # a genuinely different (smaller) draft — random weights, so it
+    # disagrees with the target often: exercises low-acceptance paths
+    dcfg = LlamaConfig.tiny(
+        dtype=jnp.float32,
+        remat=False,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=1,
+        num_heads=2,
+        num_kv_heads=1,
+    )
+    draft = Llama(dcfg)
+    d_params = draft.init(
+        jax.random.PRNGKey(1), jnp.zeros((2, 16), jnp.int32)
+    )["params"]
+    return target, t_params, draft, d_params
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_speculative_matches_plain_greedy(target_and_draft, k):
+    target, t_params, draft, d_params = target_and_draft
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(7), (3, 10), 0, target.cfg.vocab_size
+    ).astype(jnp.int32)
+    plain = generate(target, t_params, prompt, max_new_tokens=12)
+    spec = speculative_generate(
+        target, t_params, draft, d_params, prompt, max_new_tokens=12, k=k
+    )
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+
+
+def test_speculative_self_draft_all_accepted(target_and_draft):
+    """Draft == target: every proposal accepted (the upper-bound path,
+    and the one that exercises the draft-cache final-slot feed)."""
+    target, t_params, _, _ = target_and_draft
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(9), (2, 8), 0, target.cfg.vocab_size
+    ).astype(jnp.int32)
+    plain = generate(target, t_params, prompt, max_new_tokens=15)
+    spec = speculative_generate(
+        target, t_params, target, t_params, prompt, max_new_tokens=15, k=4
+    )
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+
+
+def test_speculative_eos_semantics(target_and_draft):
+    """EOS contract identical to generate(): identical tokens through
+    each row's first EOS, eos-filled afterwards, early exit."""
+    target, t_params, draft, d_params = target_and_draft
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(11), (2, 6), 0, target.cfg.vocab_size
+    ).astype(jnp.int32)
+    ref = np.asarray(generate(target, t_params, prompt, max_new_tokens=10))
+    eos = int(ref[0, 3])  # a token the plain decode actually emits
+    plain = generate(target, t_params, prompt, max_new_tokens=10, eos_id=eos)
+    spec = speculative_generate(
+        target, t_params, draft, d_params, prompt, max_new_tokens=10, k=3,
+        eos_id=eos,
+    )
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+
+
+def test_speculative_mixed_length_prompts(target_and_draft):
+    """Right-padded prompts + prompt_lengths: rows decode from their own
+    true lengths, exactly like generate's padded path."""
+    target, t_params, draft, d_params = target_and_draft
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(13), (3, 9), 0, target.cfg.vocab_size
+    ).astype(jnp.int32)
+    lengths = jnp.asarray([4, 9, 6], jnp.int32)
+    plain = generate(
+        target, t_params, prompt, max_new_tokens=11, prompt_lengths=lengths
+    )
+    spec = speculative_generate(
+        target, t_params, draft, d_params, prompt, max_new_tokens=11, k=3,
+        prompt_lengths=lengths,
+    )
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+
+
+def test_speculative_validations(target_and_draft):
+    target, t_params, draft, d_params = target_and_draft
+    prompt = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="k must be"):
+        speculative_generate(
+            target, t_params, draft, d_params, prompt, 4, k=0
+        )
+    with pytest.raises(ValueError, match="max_seq_len"):
+        speculative_generate(
+            target, t_params, draft, d_params, prompt,
+            target.cfg.max_seq_len, k=4,
+        )
