@@ -370,7 +370,11 @@ def bench_llama1b_decode(args):
         num_layers=16,
         num_heads=16,
         num_kv_heads=16,
-        max_seq_len=prompt_len + new_tokens,
+        # speculative verification scratches up to spec_k slots past
+        # the emitted text (spec_k re-read below, after model build)
+        max_seq_len=(
+            prompt_len + new_tokens + (getattr(args, "spec_k", 0) or 0)
+        ),
         dtype=jnp.bfloat16,
         remat=False,
         attention_impl="xla",  # decode is single-token; flash n/a
@@ -380,24 +384,56 @@ def bench_llama1b_decode(args):
     prompt = jnp.asarray(
         rng.integers(0, cfg.vocab_size, size=(b, prompt_len)), jnp.int32
     )
-    params = model.init(jax.random.PRNGKey(0), prompt[:2])["params"]
-    if getattr(args, "quantize", False):
-        from tensorflowonspark_tpu.ops.quant import quantize_tree
+    from tensorflowonspark_tpu.ops.quant import quantize_tree
 
+    spec_k = getattr(args, "spec_k", 0) or 0
+    if spec_k and getattr(args, "quantize", False):
+        # int8 target + int8 draft would be the SAME tree: acceptance
+        # trivially 100%, the number would measure nothing
+        raise SystemExit("--spec-k measures a bf16 target with an int8 "
+                         "draft; drop --quantize")
+    raw_params = model.init(jax.random.PRNGKey(0), prompt[:2])["params"]
+    params = raw_params
+    if getattr(args, "quantize", False):
         # int8 weight-only decode: weights consumed as int8 by the model
         params = quantize_tree(params)
     params = jax.tree.map(jax.device_put, params)
-    out = generate(model, params, prompt, new_tokens)  # compile + warm
+    if spec_k:
+        # SELF-speculation: the draft is the SAME weights quantized to
+        # int8 — it mostly agrees with the bf16 target's argmax (high
+        # acceptance) at roughly half the weight-read cost, so this
+        # measures speculative decoding with a REAL acceptance profile
+        # (a random independent draft would accept ~never).
+        from tensorflowonspark_tpu.models.speculative import (
+            speculative_generate,
+        )
+
+        draft_params = jax.tree.map(
+            jax.device_put, quantize_tree(raw_params)
+        )
+
+        def decode():
+            return speculative_generate(
+                model, params, model, draft_params, prompt, new_tokens,
+                k=spec_k,
+            )
+
+    else:
+
+        def decode():
+            return generate(model, params, prompt, new_tokens)
+
+    out = decode()  # compile + warm
     np.asarray(out[0, :1])
     t0 = time.perf_counter()
     for _ in range(args.steps):
-        out = generate(model, params, prompt, new_tokens)
+        out = decode()
         np.asarray(out[0, :1])  # host fetch = real barrier
     dt = time.perf_counter() - t0
 
     def run_steps(n):
         for _ in range(n):
-            np.asarray(generate(model, params, prompt, new_tokens)[0, :1])
+            np.asarray(decode()[0, :1])
 
     _maybe_trace(run_steps)
     # Reported so that step_time_ms is ONE single-token decode step and
@@ -456,6 +492,14 @@ def main(argv=None):
         "--quantize",
         action="store_true",
         help="llama1b_decode: int8 weight-only decode (ops/quant.py)",
+    )
+    p.add_argument(
+        "--spec-k",
+        type=int,
+        default=0,
+        help="llama1b_decode: self-speculative decoding with an int8 "
+        "draft of the same model proposing K tokens per verification "
+        "(0 = off); output identical to plain greedy",
     )
     p.add_argument(
         "--peak-tflops",

@@ -53,4 +53,9 @@ run python benchmarks/real_chip.py --config llama1b --seq 4096 \
 run python benchmarks/real_chip.py --config llama1b_decode --quantize
 run python benchmarks/real_chip.py --config llama1b_decode
 
+# 6. self-speculative decode: int8 draft of the same model proposes 4
+#    tokens per bf16 verification — output identical to plain greedy,
+#    REAL acceptance profile (int8 argmax mostly agrees with bf16)
+run python benchmarks/real_chip.py --config llama1b_decode --spec-k 4
+
 echo "all pending measurements attempted; results in $OUT" >&2
