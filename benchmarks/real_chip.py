@@ -397,6 +397,10 @@ def bench_llama1b_decode(args):
     if getattr(args, "quantize", False):
         # int8 weight-only decode: weights consumed as int8 by the model
         params = quantize_tree(params)
+        # the bf16 tree must actually free — this benchmark is HBM-bound
+        # by construction (spec_k needs it for the draft; the combo with
+        # --quantize is rejected above)
+        raw_params = None
     params = jax.tree.map(jax.device_put, params)
     if spec_k:
         # SELF-speculation: the draft is the SAME weights quantized to

@@ -111,6 +111,14 @@ def main_fun(args, ctx):
     tokens0 = np.zeros((init_b, args.seq + 1), np.int32)
     with use_mesh(mesh):
         params = model.init(jax.random.PRNGKey(0), tokens0[:, :-1])["params"]
+    if args.lora_rank:
+        from tensorflowonspark_tpu.ops.lora import add_lora
+
+        # parameter-efficient fine-tune: only rank-r adapters train;
+        # the frozen base carries no gradients and no optimizer moments
+        params = add_lora(
+            params, rank=int(args.lora_rank), rng=jax.random.PRNGKey(1)
+        )
     psh = llama_param_shardings(params, mesh)
     params = jax.tree.map(jax.device_put, params, psh)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
@@ -151,6 +159,11 @@ def main_fun(args, ctx):
         # global-norm clip BEFORE the optimizer (the usual transformer
         # training guard against loss spikes)
         tx = optax.chain(optax.clip_by_global_norm(float(args.clip)), tx)
+    if args.lora_rank:
+        from tensorflowonspark_tpu.ops.lora import lora_optimizer
+
+        # masks moments down to the adapters — the HBM win
+        tx = lora_optimizer(tx, params)
     # commit ALL state leaves (moments, masters, step scalar) to their
     # mesh shardings — required for checkpoint restore to reproduce
     # placements exactly under multi-controller FSDP
@@ -268,6 +281,14 @@ def main_fun(args, ctx):
         # globally sharded) params; only the chief prints. A device_get of
         # FSDP-sharded params would fail multi-host — keep them on-mesh.
         gen_params = state.params
+        if args.lora_rank:
+            from tensorflowonspark_tpu.ops.lora import merge_lora
+
+            # fold adapters into plain kernels: zero decode overhead,
+            # and quantize_tree below would otherwise descend INTO the
+            # LoraTensor and quantize its base out from under lora_apply
+            with use_mesh(mesh):
+                gen_params = jax.jit(merge_lora)(gen_params)
         if args.quantize_decode:
             from tensorflowonspark_tpu.ops.quant import (
                 QuantTensor,
@@ -405,6 +426,15 @@ def parse_args(argv=None):
         type=int,
         default=None,
         help="chunked-CE chunk length; skips the (B,S,V) fp32 logits",
+    )
+    p.add_argument(
+        "--lora-rank",
+        type=int,
+        default=0,
+        help="parameter-efficient fine-tune: wrap attention/MLP kernels "
+        "in rank-R LoRA adapters (ops/lora.py) — only adapters train, "
+        "the frozen base carries no grads and no optimizer moments "
+        "(0 = full fine-tune)",
     )
     p.add_argument("--model-dir", default=None)
     p.add_argument(

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tensorflowonspark_tpu.ops.attention import dot_product_attention
+from tensorflowonspark_tpu.ops.lora import LoraTensor, lora_apply
 from tensorflowonspark_tpu.ops.quant import QuantTensor, quantized_dot
 
 
@@ -128,7 +129,9 @@ class QDense(nn.Module):
     e.g. a tree from ``quantize_tree``) the dot runs against the int8
     weight with the per-channel scales folded into the fp32 accumulator
     — weights stay int8 in HBM through the whole decode, which is the
-    point (decode is weight-bandwidth-bound)."""
+    point (decode is weight-bandwidth-bound). A ``LoraTensor`` kernel
+    (``ops/lora.py:add_lora``) runs base + low-rank adapter with the
+    base stop-gradiented — the parameter-efficient fine-tune path."""
 
     features: int
     dtype: jnp.dtype
@@ -143,6 +146,8 @@ class QDense(nn.Module):
         x = x.astype(self.dtype)
         if isinstance(kernel, QuantTensor):
             return quantized_dot(x, kernel)
+        if isinstance(kernel, LoraTensor):
+            return lora_apply(x, kernel)
         return x @ kernel.astype(self.dtype)
 
 
@@ -472,19 +477,24 @@ def llama_param_shardings(params, mesh: Mesh):
             return NamedSharding(mesh, moe_expert_bank_spec(joined))
         if "router" in joined:
             return NamedSharding(mesh, P())
-        if "embed" in joined:
-            return NamedSharding(mesh, P("fsdp", "model"))
-        if "lm_head" in joined:
-            return NamedSharding(mesh, P("fsdp", "model"))
-        if any(k in joined for k in ("q_proj", "k_proj", "v_proj")):
-            return NamedSharding(mesh, P("fsdp", "model"))  # col-parallel
-        if "o_proj" in joined:
-            return NamedSharding(mesh, P("model", "fsdp"))  # row-parallel
-        if any(k in joined for k in ("gate_proj", "up_proj")):
-            return NamedSharding(mesh, P("fsdp", "model"))
-        if "down_proj" in joined:
-            return NamedSharding(mesh, P("model", "fsdp"))
-        return NamedSharding(mesh, P("fsdp"))
+        if any(k in joined for k in ("embed", "lm_head", "q_proj",
+                                     "k_proj", "v_proj", "gate_proj",
+                                     "up_proj")):
+            pair = ("fsdp", "model")  # col-parallel
+        elif any(k in joined for k in ("o_proj", "down_proj")):
+            pair = ("model", "fsdp")  # row-parallel
+        else:
+            pair = ("fsdp", None)
+        # LoRA factors inside a wrapped kernel: the base shards like the
+        # kernel it replaces; `a` (in, r) keeps the input half, `b`
+        # (r, out) the output half — consistent with the TP math (the
+        # rank dim stays replicated; it is tiny by construction)
+        attr = getattr(path[-1], "name", None)
+        if attr == "a":
+            return NamedSharding(mesh, P(pair[0], None))
+        if attr == "b":
+            return NamedSharding(mesh, P(None, pair[1]))
+        return NamedSharding(mesh, P(*pair))
 
     return jax.tree_util.tree_map_with_path(rule, params)
 

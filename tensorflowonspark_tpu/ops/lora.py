@@ -1,0 +1,179 @@
+"""LoRA — low-rank adapters for parameter-efficient fine-tuning.
+
+Full fine-tuning of a 7B model needs ~3 copies of every weight in HBM
+(params + grads + Adam moments, ~80 GB fp32); LoRA trains only a pair
+of rank-r factors per targeted matrix (``w ≈ w_base + a @ b · s``),
+shrinking trainable state to well under 1% while the frozen base stays
+a single read-only copy. The TPU shape of the idea:
+
+- :class:`LoraTensor` is a registered pytree node (like
+  ``quant.QuantTensor``), so LoRA-ified param trees ride jit,
+  ``device_put``, mesh sharding, and orbax unchanged.
+- The base matrix is wrapped in ``stop_gradient`` INSIDE the op, so XLA
+  never builds the base-weight gradient matmuls — the backward pass
+  costs scale with the adapters, not the model.
+- :func:`lora_optimizer` masks the frozen leaves out of the optimizer
+  with ``optax.multi_transform``, so Adam moments exist ONLY for the
+  adapters — that is where the HBM win comes from.
+- ``models/llama.py:QDense`` consumes ``LoraTensor`` kernels natively;
+  ``llama_param_shardings`` shards ``base`` like the kernel it wraps
+  and the factors along their matching halves, so FSDP/TP configs work
+  untouched.
+
+Reference parity note: the reference delegated all training machinery
+to TF and had no parameter-efficient path (SURVEY.md §2.3); this is
+capability beyond it, motivated by the same HBM arithmetic as
+BASELINE.md's optimizer-state study.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+DEFAULT_TARGETS = (
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "gate_proj", "up_proj", "down_proj",
+)
+
+
+@struct.dataclass
+class LoraTensor:
+    """``w_eff = base + a @ b * scale`` with ``base`` frozen.
+
+    ``base`` (in, out); ``a`` (in, r) gaussian-init; ``b`` (r, out)
+    zero-init — so a freshly added adapter is an exact no-op (the
+    standard LoRA init). ``scale`` = alpha / r, static.
+    """
+
+    base: jax.Array
+    a: jax.Array
+    b: jax.Array
+    scale: float = struct.field(pytree_node=False, default=1.0)
+
+    @property
+    def shape(self):
+        return self.base.shape
+
+    @property
+    def dtype(self):
+        return self.base.dtype
+
+
+def lora_apply(x: jax.Array, w: LoraTensor) -> jax.Array:
+    """``x @ w_eff`` without materializing the merged matrix: the
+    adapter path is two skinny matmuls (B·S·in·r + B·S·r·out FLOPs —
+    negligible at r≪min(in,out)). ``stop_gradient`` on the base keeps
+    the backward pass adapter-sized."""
+    base = jax.lax.stop_gradient(w.base)
+    y = x @ base.astype(x.dtype)
+    lo = (x @ w.a.astype(x.dtype)) @ w.b.astype(x.dtype)
+    return y + lo * w.scale
+
+
+def add_lora(
+    params: Any,
+    rank: int,
+    rng: jax.Array,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+    alpha: float | None = None,
+    dtype=jnp.float32,
+) -> Any:
+    """Wrap every 2-D leaf whose path contains a target name in a
+    :class:`LoraTensor`. ``alpha`` defaults to ``rank`` (scale 1.0).
+    The wrapped tree's forward output is EXACTLY the base tree's until
+    the adapters train (b starts at zero)."""
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    scale = (alpha if alpha is not None else float(rank)) / float(rank)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    keys = jax.random.split(rng, len(flat))
+
+    def name_of(path) -> str:
+        return "/".join(
+            str(getattr(p, "key", getattr(p, "name", p))) for p in path
+        )
+
+    out = []
+    n_wrapped = 0
+    for (path, leaf), key in zip(flat, keys):
+        joined = name_of(path)
+        if (
+            hasattr(leaf, "ndim")
+            and leaf.ndim == 2
+            and any(t in joined for t in targets)
+        ):
+            d_in, d_out = leaf.shape
+            if rank > min(d_in, d_out):
+                raise ValueError(
+                    f"rank {rank} exceeds min dim of {joined} {leaf.shape}"
+                )
+            a = (
+                jax.random.normal(key, (d_in, rank), dtype)
+                / jnp.sqrt(jnp.asarray(d_in, dtype))
+            )
+            b = jnp.zeros((rank, d_out), dtype)
+            out.append(LoraTensor(base=leaf, a=a, b=b, scale=scale))
+            n_wrapped += 1
+        else:
+            out.append(leaf)
+    if n_wrapped == 0:
+        raise ValueError(
+            f"no 2-D params matched targets {tuple(targets)}; nothing to "
+            "adapt"
+        )
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def merge_lora(params: Any) -> Any:
+    """Fold trained adapters into plain kernels (``base + a@b·s``) for
+    serving/export — zero inference overhead, and the merged tree is a
+    drop-in for every consumer of the original params."""
+
+    def rule(x):
+        if isinstance(x, LoraTensor):
+            merged = (
+                x.base.astype(jnp.float32)
+                + (x.a.astype(jnp.float32) @ x.b.astype(jnp.float32))
+                * x.scale
+            )
+            return merged.astype(x.base.dtype)
+        return x
+
+    return jax.tree.map(
+        rule, params, is_leaf=lambda x: isinstance(x, LoraTensor)
+    )
+
+
+def lora_labels(params: Any) -> Any:
+    """'train' / 'freeze' label tree for ``optax.multi_transform``:
+    adapter factors train, everything else (including every LoraTensor
+    base) freezes. Same structure as ``params``."""
+
+    def rule(x):
+        if isinstance(x, LoraTensor):
+            return LoraTensor(base="freeze", a="train", b="train",
+                              scale=x.scale)
+        return "freeze"
+
+    return jax.tree.map(
+        rule, params, is_leaf=lambda x: isinstance(x, LoraTensor)
+    )
+
+
+def lora_optimizer(
+    tx: optax.GradientTransformation, params: Any
+) -> optax.GradientTransformation:
+    """Wrap ``tx`` so ONLY adapter leaves get optimizer state and
+    updates: frozen leaves carry `set_to_zero` (no moments in HBM —
+    the point of LoRA's memory win). The base's gradients are already
+    zero (``lora_apply`` stop_gradient), this guarantees no optimizer
+    bytes either."""
+    return optax.multi_transform(
+        {"train": tx, "freeze": optax.set_to_zero()},
+        lora_labels(params),
+    )

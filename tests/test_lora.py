@@ -1,0 +1,214 @@
+"""LoRA adapters (ops/lora.py): exact no-op at init, frozen base under
+training, adapter-only optimizer state, merge equivalence, and the
+sharded/decode paths."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensorflowonspark_tpu.models.llama import (
+    Llama,
+    LlamaConfig,
+    llama_loss_fn,
+    llama_param_shardings,
+)
+from tensorflowonspark_tpu.ops.lora import (
+    LoraTensor,
+    add_lora,
+    lora_optimizer,
+    merge_lora,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=False)
+    model = Llama(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 16), jnp.int32)
+    )["params"]
+    return cfg, model, params
+
+
+def test_add_lora_is_exact_noop_at_init(tiny):
+    cfg, model, params = tiny
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    base_logits = model.apply({"params": params}, tokens)
+    lora_params = add_lora(params, rank=4, rng=jax.random.PRNGKey(2))
+    lora_logits = model.apply({"params": lora_params}, tokens)
+    # b is zero-init, so the adapter contributes exactly nothing
+    np.testing.assert_array_equal(
+        np.asarray(base_logits), np.asarray(lora_logits)
+    )
+    wrapped = [
+        x for x in jax.tree.leaves(
+            lora_params, is_leaf=lambda x: isinstance(x, LoraTensor)
+        )
+        if isinstance(x, LoraTensor)
+    ]
+    # 7 targets per layer x 2 layers in tiny()
+    assert len(wrapped) == 14
+
+
+def test_lora_training_freezes_base_and_learns(tiny):
+    cfg, model, params = tiny
+    from tensorflowonspark_tpu.compute import TrainState, build_train_step
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+
+    mesh = make_mesh({"data": -1})
+    # fresh buffers: the step donates its input state, and the module-
+    # scoped fixture's arrays must survive for the other tests
+    lora_params = add_lora(
+        jax.tree.map(jnp.array, params), rank=4, rng=jax.random.PRNGKey(3)
+    )
+    tx = lora_optimizer(optax.adamw(1e-2), lora_params)
+    state = TrainState.create(lora_params, tx)
+
+    # optimizer moments exist ONLY for adapters: full adamw would carry
+    # 2x params worth of moments; masked carries 2x adapter elements
+    n_params = sum(x.size for x in jax.tree.leaves(lora_params))
+    n_adapters = sum(
+        x.a.size + x.b.size
+        for x in jax.tree.leaves(
+            lora_params, is_leaf=lambda x: isinstance(x, LoraTensor)
+        )
+        if isinstance(x, LoraTensor)
+    )
+    n_opt = sum(
+        np.size(x) for x in jax.tree.leaves(state.opt_state)
+    )
+    assert n_opt < 2 * n_adapters + 64, (
+        f"optimizer state has {n_opt} elements; expected ~2x adapters "
+        f"({2 * n_adapters}), params are {n_params}"
+    )
+
+    def bases(tree):
+        return [
+            np.asarray(x.base)
+            for x in jax.tree.leaves(
+                tree, is_leaf=lambda x: isinstance(x, LoraTensor)
+            )
+            if isinstance(x, LoraTensor)
+        ]
+
+    # host copies BEFORE training: the train step donates its input
+    # state, so the original device buffers are gone after step 1
+    bases_before = bases(lora_params)
+
+    token_loss = llama_loss_fn(model)
+    loss_fn = lambda p, b: token_loss(p, b["tokens"])  # noqa: E731
+    step = build_train_step(loss_fn, tx, mesh)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(4), (8, 17), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    batch = shard_batch(mesh, {"tokens": tokens})
+    losses = []
+    for _ in range(5):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    for before, after in zip(bases_before, bases(state.params)):
+        np.testing.assert_array_equal(before, after)  # frozen, bit-exact
+    trained_b = [
+        np.abs(np.asarray(x.b)).max()
+        for x in jax.tree.leaves(
+            state.params, is_leaf=lambda x: isinstance(x, LoraTensor)
+        )
+        if isinstance(x, LoraTensor)
+    ]
+    assert max(trained_b) > 0  # adapters actually moved
+
+
+def test_merge_lora_matches_adapter_forward(tiny):
+    cfg, model, params = tiny
+    lora_params = add_lora(params, rank=4, rng=jax.random.PRNGKey(5))
+    # give the adapters nonzero weights so the merge is non-trivial
+    lora_params = jax.tree.map(
+        lambda x: (
+            LoraTensor(
+                base=x.base,
+                a=x.a,
+                b=jnp.ones_like(x.b) * 0.01,
+                scale=x.scale,
+            )
+            if isinstance(x, LoraTensor)
+            else x
+        ),
+        lora_params,
+        is_leaf=lambda x: isinstance(x, LoraTensor),
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(6), (2, 10), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    with_adapters = model.apply({"params": lora_params}, tokens)
+    merged = merge_lora(lora_params)
+    assert not any(
+        isinstance(x, LoraTensor)
+        for x in jax.tree.leaves(
+            merged, is_leaf=lambda x: isinstance(x, LoraTensor)
+        )
+    )
+    merged_logits = model.apply({"params": merged}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(with_adapters), np.asarray(merged_logits),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_lora_shardings_and_decode(tiny):
+    """LoRA trees ride the mesh (base like its kernel, factors along
+    their matching halves) and the KV-cache decode path."""
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+    from tensorflowonspark_tpu.models.llama import generate
+
+    cfg, model, params = tiny
+    lora_params = add_lora(params, rank=2, rng=jax.random.PRNGKey(7))
+    mesh = make_mesh({"fsdp": 4, "model": 2})
+    sh = llama_param_shardings(lora_params, mesh)
+    placed = jax.device_put(lora_params, sh)
+
+    def spec_of(tree, pred):
+        from jax.sharding import PartitionSpec as P
+
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            if pred("/".join(str(p) for p in path)):
+                return leaf.spec
+        raise AssertionError("leaf not found")
+
+    from jax.sharding import PartitionSpec as P
+
+    assert spec_of(sh, lambda s: "q_proj" in s and s.endswith(".base")) == P(
+        "fsdp", "model"
+    )
+    assert spec_of(sh, lambda s: "q_proj" in s and s.endswith(".a")) == P(
+        "fsdp", None
+    )
+    assert spec_of(sh, lambda s: "q_proj" in s and s.endswith(".b")) == P(
+        None, "model"
+    )
+    assert spec_of(sh, lambda s: "o_proj" in s and s.endswith(".a")) == P(
+        "model", None
+    )
+
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(8), (2, 6), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    plain = generate(model, params, prompt, max_new_tokens=5)
+    lora_out = generate(model, jax.device_get(placed), prompt,
+                        max_new_tokens=5)
+    # zero-init adapters: decode identical to the base model
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(lora_out))
+
+
+def test_add_lora_validations(tiny):
+    _, _, params = tiny
+    with pytest.raises(ValueError, match="rank"):
+        add_lora(params, rank=0, rng=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="no 2-D params"):
+        add_lora(params, rank=2, rng=jax.random.PRNGKey(0),
+                 targets=("nonexistent",))
