@@ -33,6 +33,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["speculative_generate"]
 
@@ -47,6 +48,7 @@ def speculative_generate(
     k: int = 4,
     eos_id: int | None = None,
     prompt_lengths: jax.Array | None = None,
+    mesh: Mesh | None = None,
 ) -> jax.Array:
     """Greedy speculative decode: (B, S) int32 -> (B, max_new_tokens).
 
@@ -59,6 +61,12 @@ def speculative_generate(
     independently on ``eos_id`` and the loop exits early once every
     row is done. Mixed-length prompts: RIGHT-pad and pass
     ``prompt_lengths`` (B,), exactly like ``generate``.
+
+    ``mesh``: the TARGET runs TP/DP-sharded exactly like ``generate``'s
+    mesh path (weights on 'model', batch + caches on 'data'); the DRAFT
+    is fully replicated with only its batch/cache sharded on 'data' —
+    a draft is small by construction, and replication frees it from the
+    target's head-divisibility constraints.
     """
     b, s = prompt.shape
     if k < 1:
@@ -69,6 +77,26 @@ def speculative_generate(
                 f"prompt ({s}) + max_new_tokens ({max_new_tokens}) + k "
                 f"({k}) exceeds {name}.cfg.max_seq_len ({cfg.max_seq_len})"
             )
+    if mesh is not None:
+        from tensorflowonspark_tpu.models.llama import llama_param_shardings
+
+        dp = mesh.shape["data"]
+        tp = mesh.shape["model"]
+        if b % dp:
+            raise ValueError(
+                f"batch {b} not divisible by the mesh 'data' extent {dp}"
+            )
+        if model.cfg.num_kv_heads % tp or model.cfg.num_heads % tp:
+            raise ValueError(
+                f"target heads ({model.cfg.num_heads}/"
+                f"{model.cfg.num_kv_heads} kv) not divisible by the mesh "
+                f"'model' extent {tp}"
+            )
+        params = jax.device_put(params, llama_param_shardings(params, mesh))
+        draft_params = jax.device_put(
+            draft_params, NamedSharding(mesh, P())
+        )
+        prompt = jax.device_put(prompt, NamedSharding(mesh, P("data", None)))
     run = _build_speculative(
         model,
         draft_model,
@@ -78,6 +106,7 @@ def speculative_generate(
         int(k),
         None if eos_id is None else int(eos_id),
         mixed=prompt_lengths is not None,
+        mesh=mesh,
     )
     if prompt_lengths is None:
         return run(params, draft_params, prompt)
@@ -94,17 +123,38 @@ def speculative_generate(
             f"prompt_lengths must be in [1, {s}] (the padded prompt "
             f"width); got {host.tolist()}"
         )
+    if mesh is not None:
+        lengths = jax.device_put(lengths, NamedSharding(mesh, P("data")))
     return run(params, draft_params, prompt, lengths)
 
 
 @functools.lru_cache(maxsize=16)
 def _build_speculative(
-    model, draft_model, b, s, max_new_tokens, k, eos_id, mixed=False
+    model, draft_model, b, s, max_new_tokens, k, eos_id, mixed=False,
+    mesh=None,
 ):
     """Compile-once body per (models, shapes, k, eos)."""
 
     def greedy(logits):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def constrain(cache, tp_sharded):
+        # pin both KV caches at the loop boundary: the target's like
+        # generate's mesh path (batch on 'data', heads on 'model'), the
+        # draft's batch-sharded only (its weights are replicated)
+        if mesh is None:
+            return cache
+        from tensorflowonspark_tpu.models.llama import decode_cache_spec
+
+        def spec(x):
+            sp = decode_cache_spec(x)
+            if not tp_sharded and x.ndim == 4:
+                sp = P("data", None, None, None)
+            return NamedSharding(mesh, sp)
+
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, spec(x)), cache
+        )
 
     @jax.jit
     def run(params, draft_params, prompt, lengths=None):
@@ -190,6 +240,7 @@ def _build_speculative(
             # degrade the NEXT round's proposals — never correctness,
             # which the target alone decides)
             d_cache, _ = draft_step(d_cache, drafts[:, -1], pos - 1 + k)
+            d_cache = constrain(d_cache, tp_sharded=False)
 
             # --- one target forward over [last, drafts[:-1]] ---------
             # logits[:, j] predicts the token at position pos+j
@@ -205,7 +256,7 @@ def _build_speculative(
                 padded=True,
                 mutable=["cache"],
             )
-            t_cache = t_upd["cache"]
+            t_cache = constrain(t_upd["cache"], tp_sharded=True)
             t_pick = greedy(t_logits)  # (B, k+1) target's own choices
 
             # accepted = longest prefix where draft == target pick;
@@ -255,8 +306,11 @@ def _build_speculative(
             n_out = n_out_new
             return (t_cache, d_cache, last, pos, n_out, done, buf)
 
-        carry = (t_prefill["cache"], d_prefill["cache"], last, pos0,
-                 n_out, done, buf)
+        carry = (
+            constrain(t_prefill["cache"], tp_sharded=True),
+            constrain(d_prefill["cache"], tp_sharded=False),
+            last, pos0, n_out, done, buf,
+        )
         carry = jax.lax.while_loop(cond, body, carry)
         return carry[6]
 

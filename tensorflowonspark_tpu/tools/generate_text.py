@@ -68,8 +68,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="greedy speculative decoding: orbax checkpoint of a "
         "(smaller) draft model that proposes --spec-k tokens per "
         "target verification; output is token-identical to the plain "
-        "greedy decode, only faster. Greedy-only; not combinable with "
-        "--mesh or --temperature",
+        "greedy decode, only faster. Greedy-only; composes with --mesh "
+        "(TP/DP target, replicated draft)",
     )
     p.add_argument(
         "--draft-model", choices=("tiny", "1b", "7b"), default="tiny"
@@ -190,7 +190,8 @@ def decode_batches(
     to greedy speculative (``models.speculative``): the draft proposes
     ``spec_k`` tokens per target verification. Output is token-
     identical to the plain greedy decode — only speed changes.
-    Requires ``temperature == 0`` and no ``mesh``.
+    Requires ``temperature == 0``; composes with ``mesh`` (TP/DP
+    target, replicated draft).
     """
     import jax
     import numpy as np
@@ -206,11 +207,6 @@ def decode_batches(
             "speculative decoding is greedy-only (no temperature/"
             "top_k/top_p): the acceptance rule keeps exactly the "
             "target's argmax tokens"
-        )
-    if draft is not None and mesh is not None:
-        raise ValueError(
-            "speculative decoding does not compose with mesh-sharded "
-            "decode yet; drop --mesh or the draft"
         )
     if not prompts:
         raise PromptError("no prompts given")
@@ -249,6 +245,7 @@ def decode_batches(
                     k=spec_k,
                     eos_id=eos_id,
                     prompt_lengths=None if uniform else lengths,
+                    mesh=mesh,
                 )
             )
         else:
@@ -321,7 +318,15 @@ def main(argv: list[str] | None = None) -> int:
                 config_overrides=args.draft_config_overrides,
             )
         )
-        draft = (Llama(dcfg), _load_params(args.draft_checkpoint, dcfg))
+        draft_params = _load_params(args.draft_checkpoint, dcfg)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # replicate the draft once, not per chunk
+            draft_params = jax.device_put(
+                draft_params, NamedSharding(mesh, PartitionSpec())
+            )
+        draft = (Llama(dcfg), draft_params)
 
     completions, _ = decode_batches(
         model,

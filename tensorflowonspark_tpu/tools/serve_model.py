@@ -194,10 +194,6 @@ def _build_gen_fn(gen: dict):
                 "--draft-checkpoint is greedy-only; drop --temperature/"
                 "--top-k/--top-p"
             )
-        if gen.get("mesh"):
-            raise ValueError(
-                "--draft-checkpoint does not compose with --gen-mesh yet"
-            )
         dcfg = _load_config(
             argparse.Namespace(
                 model=gen.get("draft_model", "tiny"),
@@ -231,12 +227,21 @@ def _build_gen_fn(gen: dict):
                 f"--gen-batch-size ({bsz}) must be divisible by the "
                 f"mesh 'data' extent ({mesh.shape['data']})"
             )
+        from jax.sharding import NamedSharding, PartitionSpec
         from tensorflowonspark_tpu.models.llama import llama_param_shardings
 
-        # Pre-place the weights in their TP layout ONCE at startup:
-        # generate()'s per-call device_put is then the no-op it assumes,
-        # instead of a full weight reshard on every request.
+        # Pre-place the weights in their layouts ONCE at startup (target
+        # TP-sharded, draft replicated): the decode path's per-call
+        # device_put is then the no-op it assumes, instead of a full
+        # weight reshard/broadcast on every request.
         params = jax.device_put(params, llama_param_shardings(params, mesh))
+        if draft is not None:
+            draft = (
+                draft[0],
+                jax.device_put(
+                    draft[1], NamedSharding(mesh, PartitionSpec())
+                ),
+            )
 
     def gen_fn(prompts: list[list[int]]) -> list[list[int]]:
         out, rng_box[0] = decode_batches(
@@ -329,7 +334,8 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         help="greedy speculative decoding for /generate: draft model "
         "checkpoint (output identical to plain greedy, only faster); "
-        "greedy-only, not combinable with --gen-mesh/--temperature",
+        "greedy-only; composes with --gen-mesh (TP target, replicated "
+        "draft)",
     )
     p.add_argument(
         "--draft-model", choices=("tiny", "1b", "7b"), default="tiny"

@@ -98,6 +98,45 @@ def test_speculative_mixed_length_prompts(target_and_draft):
     np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
 
 
+def test_speculative_mesh_sharded_matches_single_device(target_and_draft):
+    """Speculative + mesh: TP/DP-sharded target with a replicated draft
+    must still be token-identical to single-device speculative (and so
+    to plain greedy) — serving at scale keeps the exactness contract."""
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+
+    target, t_params, draft, d_params = target_and_draft
+    mesh = make_mesh({"data": 4, "model": 2})
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(17), (4, 10), 0, target.cfg.vocab_size
+    ).astype(jnp.int32)
+    plain = generate(target, t_params, prompt, max_new_tokens=9)
+    spec = speculative_generate(
+        target, t_params, draft, d_params, prompt, max_new_tokens=9, k=3,
+        mesh=mesh,
+    )
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+
+    # mixed-length + EOS under the mesh: per-row lengths shard on
+    # 'data' and per-row early exit must survive the sharded caches
+    lengths = jnp.asarray([4, 10, 7, 5], jnp.int32)
+    eos = int(np.asarray(plain)[0, 2])
+    plain_me = generate(
+        target, t_params, prompt, max_new_tokens=9,
+        prompt_lengths=lengths, eos_id=eos,
+    )
+    spec_me = speculative_generate(
+        target, t_params, draft, d_params, prompt, max_new_tokens=9, k=3,
+        prompt_lengths=lengths, eos_id=eos, mesh=mesh,
+    )
+    np.testing.assert_array_equal(np.asarray(plain_me), np.asarray(spec_me))
+
+    with pytest.raises(ValueError, match="data"):
+        speculative_generate(
+            target, t_params, draft, d_params, prompt[:3],
+            max_new_tokens=4, k=2, mesh=mesh,
+        )
+
+
 def test_speculative_validations(target_and_draft):
     target, t_params, draft, d_params = target_and_draft
     prompt = jnp.zeros((1, 8), jnp.int32)
