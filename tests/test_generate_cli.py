@@ -185,11 +185,76 @@ def test_cli_eos_trims_output(tmp_path):
     assert len(row["tokens"]) <= 6
 
 
+def _post(port, path, payload):
+    """POST JSON to the ephemeral test server; returns (status, body)
+    with HTTP errors surfaced as their JSON bodies, not tracebacks."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_serve_model_generate_mesh_and_draft(tmp_path):
+    """/generate with --gen-mesh AND --draft-checkpoint together: the
+    TP/DP-sharded speculative server must return exactly the plain
+    library decode's tokens."""
+    import threading
+
+    from tensorflowonspark_tpu.tools import serve_model
+
+    cfg, model, params, ckpt_dir = _tiny_checkpoint(tmp_path)
+    server = serve_model.make_server(
+        None,
+        port=0,
+        gen=dict(
+            checkpoint=ckpt_dir,
+            model="tiny",
+            config_overrides='{"remat": false, "dtype": "float32"}',
+            width=8,
+            batch_size=4,
+            max_new_tokens=5,
+            mesh="data=4,model=2",
+            draft_checkpoint=ckpt_dir,
+            draft_model="tiny",
+            draft_config_overrides='{"remat": false, "dtype": "float32"}',
+            spec_k=2,
+        ),
+    )
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        code, body = _post(
+            port, "/generate", {"prompts": [[1, 2, 3], [4, 5, 6, 7, 8]]}
+        )
+        assert code == 200, body
+        comps = body["completions"]
+        padded = np.zeros((2, 8), np.int32)
+        padded[0, :3] = [1, 2, 3]
+        padded[1, :5] = [4, 5, 6, 7, 8]
+        ref = np.asarray(
+            generate(
+                model, params, jnp.asarray(padded), max_new_tokens=5,
+                prompt_lengths=jnp.asarray([3, 5]),
+            )
+        )
+        assert comps == ref.tolist()
+    finally:
+        server.shutdown()
+
+
 def test_serve_model_generate_endpoint(tmp_path):
     """POST /generate against a live ephemeral-port server in
     --llama-checkpoint mode; completions match the CLI/library decode."""
     import threading
-    import urllib.request
 
     from tensorflowonspark_tpu.tools import serve_model
 
@@ -211,16 +276,7 @@ def test_serve_model_generate_endpoint(tmp_path):
     t.start()
     try:
         def post(path, payload):
-            req = urllib.request.Request(
-                f"http://127.0.0.1:{port}{path}",
-                data=json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-            try:
-                with urllib.request.urlopen(req) as r:
-                    return r.status, json.loads(r.read())
-            except urllib.error.HTTPError as e:
-                return e.code, json.loads(e.read())
+            return _post(port, path, payload)
 
         code, body = post(
             "/generate", {"prompts": [[1, 2, 3], [4, 5, 6, 7, 8]]}
