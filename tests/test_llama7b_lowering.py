@@ -11,8 +11,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from tensorflowonspark_tpu.compute import TrainState
+
+pytestmark = pytest.mark.slow  # tracing/lowering the full 7B config
 from tensorflowonspark_tpu.compute.mesh import batch_sharding, make_mesh
 from tensorflowonspark_tpu.compute.train import state_shardings
 from tensorflowonspark_tpu.models.llama import (

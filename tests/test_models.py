@@ -37,6 +37,7 @@ def test_mnist_mlp_trains():
     assert float(l) < l0
 
 
+@pytest.mark.slow
 def test_unet_trains_and_shards():
     from tensorflowonspark_tpu.compute.mesh import make_mesh
     from tensorflowonspark_tpu.models import unet
@@ -75,6 +76,7 @@ def test_unet_trains_and_shards():
     assert 0.0 <= float(m_iou) <= 1.0
 
 
+@pytest.mark.slow
 def test_inception_v3_trains_and_shards():
     from tensorflowonspark_tpu.compute.mesh import make_mesh
     from tensorflowonspark_tpu.models import inception
@@ -118,6 +120,7 @@ def test_inception_v3_trains_and_shards():
     assert float(l) < l0
 
 
+@pytest.mark.slow
 def test_inception_aux_head_train_only():
     """aux_logits configs return (logits, aux) under train, logits alone
     in eval — and the aux loss contributes to the gradient."""

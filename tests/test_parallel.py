@@ -35,6 +35,7 @@ class TestRingAttention:
         out = mesh_ring_attention(q, k, v, mesh_seq, causal=causal)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_gradients_match(self, mesh_seq):
         from tensorflowonspark_tpu.parallel import mesh_ring_attention
 
@@ -75,6 +76,7 @@ class TestRingAttention:
         )
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_segment_ids_gradients_match(self, mesh_seq):
         from tensorflowonspark_tpu.parallel import mesh_ring_attention
 

@@ -19,6 +19,7 @@ def test_names_and_unknown():
         zoo.build("alexnet")
 
 
+@pytest.mark.slow  # one XLA compile per zoo entry
 @pytest.mark.parametrize("name", zoo.names())
 def test_every_entry_trains_one_step_tiny(name):
     entry = zoo.build(name, tiny=True, num_classes=10)
