@@ -31,6 +31,26 @@ from tensorflowonspark_tpu.ops.quant import QuantTensor, quantized_dot
 
 
 @dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3-style RoPE frequency rescaling (hashable, so configs
+    carrying it still key jit/lru caches).
+
+    ``kind='llama3'``: wavelengths longer than
+    ``original_max_seq_len/low_freq_factor`` divide by ``factor``,
+    shorter than ``original_max_seq_len/high_freq_factor`` stay, the
+    band between interpolates smoothly — the published Llama-3.1
+    long-context recipe. ``kind='linear'``: every frequency divides by
+    ``factor`` (position interpolation).
+    """
+
+    kind: str = "llama3"
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_seq_len: int = 8192
+
+
+@dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 32000
     hidden_size: int = 4096
@@ -40,6 +60,7 @@ class LlamaConfig:
     num_kv_heads: int = 32
     max_seq_len: int = 4096
     rope_theta: float = 10000.0
+    rope_scaling: RopeScaling | None = None
     rms_norm_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"
@@ -109,10 +130,42 @@ class RMSNorm(nn.Module):
         return (norm * scale).astype(self.dtype)
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def _scaled_rope_freqs(
+    d: int, theta: float, scaling: "RopeScaling | None"
+) -> jax.Array:
+    """Base (or rescaled) inverse frequencies for head dim ``d``."""
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    if scaling is None:
+        return freqs
+    if scaling.kind == "linear":
+        return freqs / scaling.factor
+    if scaling.kind != "llama3":
+        raise ValueError(f"unknown rope_scaling kind {scaling.kind!r}")
+    # Llama-3.1 recipe: long wavelengths compress by `factor`, short
+    # ones stay, the band between interpolates (matches the HF
+    # implementation — logit-tested in tests/test_hf_import.py)
+    orig = float(scaling.original_max_seq_len)
+    low_wavelen = orig / scaling.low_freq_factor
+    high_wavelen = orig / scaling.high_freq_factor
+    wavelen = 2.0 * jnp.pi / freqs
+    smooth = (orig / wavelen - scaling.low_freq_factor) / (
+        scaling.high_freq_factor - scaling.low_freq_factor
+    )
+    interp = (1.0 - smooth) * freqs / scaling.factor + smooth * freqs
+    out = jnp.where(wavelen > low_wavelen, freqs / scaling.factor, freqs)
+    mid = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+    return jnp.where(mid, interp, out)
+
+
+def rope(
+    x: jax.Array,
+    positions: jax.Array,
+    theta: float,
+    scaling: "RopeScaling | None" = None,
+) -> jax.Array:
     """Rotary embedding; x (B, S, H, D), positions (B, S)."""
     d = x.shape[-1]
-    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    freqs = _scaled_rope_freqs(d, theta, scaling)
     angles = positions[..., None].astype(jnp.float32) * freqs  # (B, S, D/2)
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -169,8 +222,8 @@ class Attention(nn.Module):
         q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
+        q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         if decode:
             if segment_ids is not None and padded:
                 raise ValueError(

@@ -99,6 +99,14 @@ def _load_config(args):
         overrides = json.loads(args.config_overrides)
         if "dtype" in overrides:  # JSON carries it as a name string
             overrides["dtype"] = getattr(jnp, overrides["dtype"])
+        if isinstance(overrides.get("rope_scaling"), dict):
+            # JSON carries the RopeScaling dataclass as a dict
+            # (import_hf_llama's --config-out emits it this way)
+            from tensorflowonspark_tpu.models.llama import RopeScaling
+
+            overrides["rope_scaling"] = RopeScaling(
+                **overrides["rope_scaling"]
+            )
         base = dataclasses.replace(base, **overrides)
     return base
 

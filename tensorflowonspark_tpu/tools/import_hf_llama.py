@@ -34,6 +34,7 @@ JSON, ready for the decode tools' ``--config-overrides``.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import glob
 import json
 import os
@@ -85,15 +86,33 @@ def hf_config_to_llama(hf_cfg: dict):
     - ``attention_bias``/``mlp_bias`` add bias vectors our bias-free
       kernels have no slot for.
     """
-    from tensorflowonspark_tpu.models.llama import LlamaConfig
+    from tensorflowonspark_tpu.models.llama import LlamaConfig, RopeScaling
 
-    if hf_cfg.get("rope_scaling"):
-        raise ValueError(
-            f"rope_scaling={hf_cfg['rope_scaling']!r} is not supported "
-            "(this importer covers vanilla-RoPE Llama/Llama-2-style "
-            "checkpoints); converting anyway would silently change the "
-            "RoPE frequencies"
-        )
+    scaling = None
+    rs = hf_cfg.get("rope_scaling")
+    if rs:
+        kind = rs.get("rope_type", rs.get("type"))
+        if kind == "llama3":
+            scaling = RopeScaling(
+                kind="llama3",
+                factor=float(rs["factor"]),
+                low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+                high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+                original_max_seq_len=int(
+                    rs.get(
+                        "original_max_position_embeddings",
+                        hf_cfg.get("max_position_embeddings", 8192),
+                    )
+                ),
+            )
+        elif kind == "linear":
+            scaling = RopeScaling(kind="linear", factor=float(rs["factor"]))
+        else:
+            raise ValueError(
+                f"rope_scaling type {kind!r} is not supported (llama3 "
+                "and linear are); converting anyway would silently "
+                "change the RoPE frequencies"
+            )
     for flag in ("attention_bias", "mlp_bias"):
         if hf_cfg.get(flag):
             raise ValueError(
@@ -112,6 +131,7 @@ def hf_config_to_llama(hf_cfg: dict):
         ),
         max_seq_len=int(hf_cfg.get("max_position_embeddings", 4096)),
         rope_theta=float(hf_cfg.get("rope_theta", 10000.0)),
+        rope_scaling=scaling,
         rms_norm_eps=float(hf_cfg.get("rms_norm_eps", 1e-5)),
     )
 
@@ -224,6 +244,11 @@ def config_overrides_json(cfg) -> str:
             "max_seq_len": cfg.max_seq_len,
             "rope_theta": cfg.rope_theta,
             "rms_norm_eps": cfg.rms_norm_eps,
+            **(
+                {"rope_scaling": dataclasses.asdict(cfg.rope_scaling)}
+                if cfg.rope_scaling is not None
+                else {}
+            ),
         }
     )
 

@@ -119,6 +119,74 @@ def test_hf_import_feeds_decode_cli(hf_checkpoint, tmp_path):
     assert row["tokens"] == hf_out[0, len(prompt):].tolist()
 
 
+@pytest.mark.parametrize(
+    "rope_scaling",
+    [
+        {
+            "rope_type": "llama3",
+            "factor": 2.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 16,
+        },
+        {"rope_type": "linear", "factor": 2.0},
+    ],
+    ids=["llama3", "linear"],
+)
+def test_hf_import_rope_scaling(tmp_path, rope_scaling):
+    """Llama-3.1-style (and linear) rope_scaling checkpoints convert
+    logit-exactly — the long-context frequency rescale matches HF's."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.llama import Llama
+    from tensorflowonspark_tpu.tools.import_hf_llama import convert
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=96,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rope_scaling=dict(rope_scaling),
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(3)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    d = str(tmp_path / "scaled")
+    model.save_pretrained(d)
+    cfg, params = convert(d, str(tmp_path / "conv"))
+    assert cfg.rope_scaling is not None
+    assert cfg.rope_scaling.kind == rope_scaling["rope_type"]
+
+    # positions past the "original" window exercise the rescale bands
+    tokens = np.arange(40, dtype=np.int32)[None, :] % 96
+    with torch.no_grad():
+        hf_logits = (
+            model(torch.tensor(tokens, dtype=torch.long)).logits.float().numpy()
+        )
+    ours = Llama(dataclasses.replace(cfg, dtype=jnp.float32, remat=False))
+    our_logits = np.asarray(ours.apply({"params": params}, jnp.asarray(tokens)))
+    np.testing.assert_allclose(our_logits, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_import_rejects_unknown_scaling(tmp_path):
+    from tensorflowonspark_tpu.tools.import_hf_llama import hf_config_to_llama
+
+    with pytest.raises(ValueError, match="rope_scaling"):
+        hf_config_to_llama(
+            {
+                "vocab_size": 64, "hidden_size": 32,
+                "intermediate_size": 64, "num_hidden_layers": 1,
+                "num_attention_heads": 2,
+                "rope_scaling": {"rope_type": "yarn", "factor": 4.0},
+            }
+        )
+
+
 def test_hf_import_tied_embeddings(tmp_path):
     """tie_word_embeddings checkpoints (no lm_head key) tie correctly."""
     import jax.numpy as jnp
