@@ -26,6 +26,7 @@ from tensorflowonspark_tpu.models.llama import (  # noqa: F401
     llama_param_shardings,
 )
 from tensorflowonspark_tpu.models.speculative import (  # noqa: F401
+    speculative_accept,
     speculative_generate,
 )
 from tensorflowonspark_tpu.models.resnet import (  # noqa: F401

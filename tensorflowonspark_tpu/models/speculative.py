@@ -35,7 +35,62 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["speculative_generate"]
+__all__ = ["speculative_generate", "speculative_accept"]
+
+
+def speculative_accept(key, t_probs, d_probs, drafts):
+    """One speculative-SAMPLING verification (Leviathan/Chen rejection
+    rule): accept draft ``x_j`` with probability ``min(1, p_j(x_j) /
+    q_j(x_j))``; at the first rejection sample from the residual
+    ``normalize(max(p_j - q_j, 0))``; if all ``k`` drafts survive,
+    sample the bonus token from ``p_k``. The emitted tokens are then
+    distributed EXACTLY as if each had been sampled from the target
+    distribution ``p`` — for ANY draft distribution ``q`` (the draft
+    only moves the acceptance rate). Monte-Carlo-verified in
+    ``tests/test_speculative.py``.
+
+    Args: ``t_probs (B, k+1, V)`` target probabilities, ``d_probs
+    (B, k, V)`` draft probabilities, ``drafts (B, k)`` the draft's
+    samples. Returns ``(emit, accepted)``: ``emit (B, k+1)`` holds the
+    accepted drafts in ``[0, accepted)`` and the residual/bonus sample
+    at index ``accepted`` (later entries are padding), ``accepted
+    (B,)`` in ``[0, k]``.
+    """
+    b, kp1, v = t_probs.shape
+    k = kp1 - 1
+    key_u, key_r = jax.random.split(key)
+    u = jax.random.uniform(key_u, (b, k), jnp.float32)
+    p_x = jnp.take_along_axis(t_probs[:, :k], drafts[..., None], -1)[..., 0]
+    q_x = jnp.take_along_axis(d_probs, drafts[..., None], -1)[..., 0]
+    # u < p/q  <=>  u*q < p (no divide; q=0 with p>0 accepts, both 0
+    # rejects — the residual then resamples safely)
+    accept = u * q_x < p_x
+    accepted = jnp.sum(
+        jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+    )
+    # q padded with a zero row at j=k: the all-accepted bonus case then
+    # falls out of the same residual formula (residual = p_k - 0 = p_k)
+    q_pad = jnp.concatenate(
+        [d_probs, jnp.zeros((b, 1, v), d_probs.dtype)], axis=1
+    )
+    p_at = jnp.take_along_axis(
+        t_probs, accepted[:, None, None], axis=1
+    )[:, 0]
+    q_at = jnp.take_along_axis(q_pad, accepted[:, None, None], axis=1)[:, 0]
+    residual = jnp.clip(
+        p_at.astype(jnp.float32) - q_at.astype(jnp.float32), 0.0, None
+    )
+    # p == q exactly -> empty residual, but rejection then has
+    # probability zero anyway; guard the log with p itself
+    degenerate = jnp.sum(residual, axis=-1, keepdims=True) <= 0
+    weights = jnp.where(degenerate, p_at.astype(jnp.float32), residual)
+    corr = jax.random.categorical(key_r, jnp.log(weights + 1e-38)).astype(
+        jnp.int32
+    )
+    pad = jnp.concatenate([drafts, drafts[:, -1:]], axis=1)
+    j_idx = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    emit = jnp.where(j_idx == accepted[:, None], corr[:, None], pad)
+    return emit, accepted
 
 
 def speculative_generate(
@@ -49,17 +104,27 @@ def speculative_generate(
     eos_id: int | None = None,
     prompt_lengths: jax.Array | None = None,
     mesh: Mesh | None = None,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
 ) -> jax.Array:
-    """Greedy speculative decode: (B, S) int32 -> (B, max_new_tokens).
+    """Speculative decode: (B, S) int32 -> (B, max_new_tokens).
 
-    Token-for-token identical to ``generate(model, params, prompt,
-    max_new_tokens, eos_id=...)`` (greedy) for ANY draft model — the
-    draft only changes speed, never output. ``k`` is the number of
-    draft proposals per verification; both models need
-    ``max_seq_len >= S + max_new_tokens + k`` (the verify window may
-    scratch up to ``k`` slots past the emitted text). Rows finish
-    independently on ``eos_id`` and the loop exits early once every
-    row is done. Mixed-length prompts: RIGHT-pad and pass
+    ``temperature == 0`` (default): token-for-token identical to
+    ``generate(model, params, prompt, max_new_tokens, eos_id=...)``
+    (greedy) for ANY draft model — the draft only changes speed, never
+    output. ``temperature > 0``: speculative SAMPLING — the draft
+    samples ``k`` proposals at the same temperature and the target
+    accepts/resamples via the rejection rule
+    (:func:`speculative_accept`), so emitted tokens are distributed
+    exactly as target-only sampling; ``rng`` seeds it. top-k/top-p
+    truncation is not offered here (it would change the distribution
+    the acceptance rule preserves).
+
+    ``k`` is the number of draft proposals per verification; both
+    models need ``max_seq_len >= S + max_new_tokens + k`` (the verify
+    window may scratch up to ``k`` slots past the emitted text). Rows
+    finish independently on ``eos_id`` and the loop exits early once
+    every row is done. Mixed-length prompts: RIGHT-pad and pass
     ``prompt_lengths`` (B,), exactly like ``generate``.
 
     ``mesh``: the TARGET runs TP/DP-sharded exactly like ``generate``'s
@@ -71,6 +136,9 @@ def speculative_generate(
     b, s = prompt.shape
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    rng = jax.random.PRNGKey(0) if rng is None else rng
     for name, cfg in (("model", model.cfg), ("draft_model", draft_model.cfg)):
         if s + max_new_tokens + k > cfg.max_seq_len:
             raise ValueError(
@@ -107,9 +175,12 @@ def speculative_generate(
         None if eos_id is None else int(eos_id),
         mixed=prompt_lengths is not None,
         mesh=mesh,
+        temperature=float(temperature),
     )
+    if mesh is not None:
+        rng = jax.device_put(rng, NamedSharding(mesh, P()))
     if prompt_lengths is None:
-        return run(params, draft_params, prompt)
+        return run(params, draft_params, prompt, rng)
     lengths = jnp.asarray(prompt_lengths, jnp.int32)
     if lengths.shape != (b,):
         raise ValueError(
@@ -125,18 +196,32 @@ def speculative_generate(
         )
     if mesh is not None:
         lengths = jax.device_put(lengths, NamedSharding(mesh, P("data")))
-    return run(params, draft_params, prompt, lengths)
+    return run(params, draft_params, prompt, rng, lengths)
 
 
 @functools.lru_cache(maxsize=16)
 def _build_speculative(
     model, draft_model, b, s, max_new_tokens, k, eos_id, mixed=False,
-    mesh=None,
+    mesh=None, temperature=0.0,
 ):
-    """Compile-once body per (models, shapes, k, eos)."""
+    """Compile-once body per (models, shapes, k, eos, temperature)."""
+    sampled = temperature > 0.0
 
     def greedy(logits):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def probs_of(logits):
+        return jax.nn.softmax(
+            logits.astype(jnp.float32) / temperature, axis=-1
+        )
+
+    def pick_first(logits, key):
+        # the first emitted token comes from the target alone
+        if not sampled:
+            return greedy(logits)
+        return jax.random.categorical(
+            key, logits.astype(jnp.float32) / temperature
+        ).astype(jnp.int32)
 
     def constrain(cache, tp_sharded):
         # pin both KV caches at the loop boundary: the target's like
@@ -157,7 +242,7 @@ def _build_speculative(
         )
 
     @jax.jit
-    def run(params, draft_params, prompt, lengths=None):
+    def run(params, draft_params, prompt, rng, lengths=None):
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
         # Prefill BOTH caches on the prompt. padded=True everywhere:
         # slots are positions, which is what lets per-row acceptance
@@ -186,15 +271,17 @@ def _build_speculative(
         # being overwritten by that row's real tokens (write-before-
         # attend + query position == write position), exactly as in
         # ``generate``'s padded path.
+        rng, key0 = jax.random.split(rng)
         if mixed:
-            last = greedy(
+            last = pick_first(
                 jnp.take_along_axis(
                     t_logits, (lengths - 1)[:, None, None], axis=1
-                )[:, 0]
+                )[:, 0],
+                key0,
             )
             pos0 = lengths + 1
         else:
-            last = greedy(t_logits[:, -1])
+            last = pick_first(t_logits[:, -1], key0)
             pos0 = jnp.full((b,), s + 1, jnp.int32)
         fill = eos_id if eos_id is not None else 0
         buf = jnp.full((b, max_new_tokens), fill, jnp.int32)
@@ -206,7 +293,7 @@ def _build_speculative(
         )
         n_out = jnp.ones((b,), jnp.int32)
 
-        def draft_step(cache, tok, pos):
+        def draft_step(cache, tok, pos, key=None):
             logits, updated = draft_model.apply(
                 {"params": draft_params, "cache": cache},
                 tok[:, None],
@@ -215,31 +302,48 @@ def _build_speculative(
                 padded=True,
                 mutable=["cache"],
             )
-            return updated["cache"], greedy(logits[:, -1])
+            logits = logits[:, -1]
+            if not sampled:
+                return updated["cache"], greedy(logits), None
+            nxt = jax.random.categorical(
+                key, logits.astype(jnp.float32) / temperature
+            ).astype(jnp.int32)
+            return updated["cache"], nxt, probs_of(logits)
 
         def cond(carry):
-            _, _, _, _, n_out, done, _ = carry
+            _, _, _, _, n_out, done, _, _ = carry
             return ~jnp.all(done | (n_out >= max_new_tokens))
 
         def body(carry):
-            t_cache, d_cache, last, pos, n_out, done, buf = carry
+            t_cache, d_cache, last, pos, n_out, done, buf, rng = carry
+            rng, key_draft, key_verify = jax.random.split(rng, 3)
 
             # --- draft k tokens sequentially -------------------------
-            def dstep(c, j):
+            def dstep(c, xs):
                 d_cache, tok = c
-                d_cache, nxt = draft_step(d_cache, tok, pos - 1 + j)
-                return (d_cache, nxt), nxt
+                j, key = xs
+                d_cache, nxt, q = draft_step(
+                    d_cache, tok, pos - 1 + j, key
+                )
+                return (d_cache, nxt), (nxt, q)
 
-            (d_cache, _), drafts = jax.lax.scan(
-                dstep, (d_cache, last), jnp.arange(k, dtype=jnp.int32)
+            draft_keys = jax.random.split(key_draft, k)
+            (d_cache, _), (drafts, d_probs) = jax.lax.scan(
+                dstep,
+                (d_cache, last),
+                (jnp.arange(k, dtype=jnp.int32), draft_keys),
             )
             drafts = jnp.swapaxes(drafts, 0, 1)  # (B, k)
+            if sampled:
+                d_probs = jnp.swapaxes(d_probs, 0, 1)  # (B, k, V)
             # feed the draft its own final proposal: when all k are
             # accepted the next iteration queries slot pos+k-1, which
             # only this write fills (an unwritten slot would silently
             # degrade the NEXT round's proposals — never correctness,
             # which the target alone decides)
-            d_cache, _ = draft_step(d_cache, drafts[:, -1], pos - 1 + k)
+            d_cache, _, _ = draft_step(
+                d_cache, drafts[:, -1], pos - 1 + k, draft_keys[-1]
+            )
             d_cache = constrain(d_cache, tp_sharded=False)
 
             # --- one target forward over [last, drafts[:-1]] ---------
@@ -257,16 +361,24 @@ def _build_speculative(
                 mutable=["cache"],
             )
             t_cache = constrain(t_upd["cache"], tp_sharded=True)
-            t_pick = greedy(t_logits)  # (B, k+1) target's own choices
-
-            # accepted = longest prefix where draft == target pick;
-            # emitted tokens are target picks throughout (positions
-            # 0..a-1 equal the drafts there, position a is the
-            # correction / bonus) — which is WHY output == plain greedy
-            match = t_pick[:, :k] == drafts  # (B, k)
-            accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
-                               axis=1)  # (B,) in [0, k]
-            emit = t_pick  # (B, k+1)
+            if sampled:
+                # rejection-sampling verification: emitted tokens are
+                # distributed exactly as target-only sampling
+                emit, accepted = speculative_accept(
+                    key_verify, probs_of(t_logits), d_probs, drafts
+                )
+            else:
+                t_pick = greedy(t_logits)  # (B, k+1) target's choices
+                # accepted = longest prefix where draft == target pick;
+                # emitted tokens are target picks throughout (positions
+                # 0..a-1 equal the drafts there, position a is the
+                # correction / bonus) — which is WHY output == plain
+                # greedy
+                match = t_pick[:, :k] == drafts  # (B, k)
+                accepted = jnp.sum(
+                    jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1
+                )  # (B,) in [0, k]
+                emit = t_pick  # (B, k+1)
             j_idx = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
             valid = j_idx <= accepted[:, None]
 
@@ -304,12 +416,12 @@ def _build_speculative(
             last = jnp.where(step_rows, new_last, last)
             pos = jnp.where(done, pos, pos + emitted)
             n_out = n_out_new
-            return (t_cache, d_cache, last, pos, n_out, done, buf)
+            return (t_cache, d_cache, last, pos, n_out, done, buf, rng)
 
         carry = (
             constrain(t_prefill["cache"], tp_sharded=True),
             constrain(d_prefill["cache"], tp_sharded=False),
-            last, pos0, n_out, done, buf,
+            last, pos0, n_out, done, buf, rng,
         )
         carry = jax.lax.while_loop(cond, body, carry)
         return carry[6]

@@ -65,11 +65,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--draft-checkpoint",
         default=None,
-        help="greedy speculative decoding: orbax checkpoint of a "
-        "(smaller) draft model that proposes --spec-k tokens per "
-        "target verification; output is token-identical to the plain "
-        "greedy decode, only faster. Greedy-only; composes with --mesh "
-        "(TP/DP target, replicated draft)",
+        help="speculative decoding: orbax checkpoint of a (smaller) "
+        "draft model that proposes --spec-k tokens per target "
+        "verification; greedy output is token-identical to the plain "
+        "greedy decode, temperature>0 preserves the target's sampling "
+        "distribution via the rejection rule. No --top-k/--top-p; "
+        "composes with --mesh (TP/DP target, replicated draft)",
     )
     p.add_argument(
         "--draft-model", choices=("tiny", "1b", "7b"), default="tiny"
@@ -195,11 +196,12 @@ def decode_batches(
     extent (set ``pad_to_batch`` so it stays the full ``batch_size``).
 
     ``draft``: a ``(draft_model, draft_params)`` pair switches decoding
-    to greedy speculative (``models.speculative``): the draft proposes
-    ``spec_k`` tokens per target verification. Output is token-
-    identical to the plain greedy decode — only speed changes.
-    Requires ``temperature == 0``; composes with ``mesh`` (TP/DP
-    target, replicated draft).
+    to speculative (``models.speculative``): the draft proposes
+    ``spec_k`` tokens per target verification. At ``temperature == 0``
+    output is token-identical to the plain greedy decode; at
+    ``temperature > 0`` the rejection rule preserves the target's
+    sampling distribution exactly. top_k/top_p do not combine with a
+    draft. Composes with ``mesh`` (TP/DP target, replicated draft).
     """
     import jax
     import numpy as np
@@ -208,13 +210,12 @@ def decode_batches(
 
     if batch_size < 1:
         raise ValueError(f"batch_size must be >= 1, got {batch_size}")
-    if draft is not None and (
-        temperature != 0.0 or top_k is not None or top_p is not None
-    ):
+    if draft is not None and (top_k is not None or top_p is not None):
         raise ValueError(
-            "speculative decoding is greedy-only (no temperature/"
-            "top_k/top_p): the acceptance rule keeps exactly the "
-            "target's argmax tokens"
+            "speculative decoding supports greedy (temperature 0) and "
+            "plain-temperature sampling, not top_k/top_p truncation "
+            "(truncation would change the distribution the rejection "
+            "rule preserves)"
         )
     if not prompts:
         raise PromptError("no prompts given")
@@ -254,6 +255,8 @@ def decode_batches(
                     eos_id=eos_id,
                     prompt_lengths=None if uniform else lengths,
                     mesh=mesh,
+                    temperature=temperature,
+                    rng=key,
                 )
             )
         else:

@@ -185,14 +185,12 @@ def _build_gen_fn(gen: dict):
         spec_k = int(gen.get("spec_k", 4))
         if spec_k < 1:
             raise ValueError(f"--spec-k must be >= 1, got {spec_k}")
-        if (
-            float(gen.get("temperature", 0.0) or 0.0) != 0.0
-            or gen.get("top_k") is not None
-            or gen.get("top_p") is not None
-        ):
+        if gen.get("top_k") is not None or gen.get("top_p") is not None:
             raise ValueError(
-                "--draft-checkpoint is greedy-only; drop --temperature/"
-                "--top-k/--top-p"
+                "--draft-checkpoint supports greedy and plain-"
+                "temperature sampling; drop --top-k/--top-p "
+                "(truncation would change the distribution the "
+                "rejection rule preserves)"
             )
         dcfg = _load_config(
             argparse.Namespace(
@@ -332,10 +330,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument(
         "--draft-checkpoint",
         default=None,
-        help="greedy speculative decoding for /generate: draft model "
-        "checkpoint (output identical to plain greedy, only faster); "
-        "greedy-only; composes with --gen-mesh (TP target, replicated "
-        "draft)",
+        help="speculative decoding for /generate: draft model "
+        "checkpoint (greedy output identical to plain greedy; "
+        "temperature>0 preserves the target's sampling distribution "
+        "via the rejection rule); no --top-k/--top-p; composes with "
+        "--gen-mesh (TP target, replicated draft)",
     )
     p.add_argument(
         "--draft-model", choices=("tiny", "1b", "7b"), default="tiny"

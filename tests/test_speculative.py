@@ -137,6 +137,113 @@ def test_speculative_mesh_sharded_matches_single_device(target_and_draft):
         )
 
 
+def test_speculative_accept_preserves_target_distribution():
+    """Monte-Carlo check of the rejection rule (speculative_accept):
+    whatever the draft distribution, each emitted position must be
+    distributed as the TARGET distribution. Fixed seed — deterministic,
+    not a flaky statistical test."""
+    from tensorflowonspark_tpu.models.speculative import speculative_accept
+
+    v, k, n = 12, 3, 4000
+    rng = np.random.default_rng(0)
+    # deliberately mismatched target/draft distributions
+    t_probs = rng.dirichlet(np.ones(v) * 0.7, size=(1, k + 1)).astype(
+        np.float32
+    )
+    d_probs = rng.dirichlet(np.ones(v) * 0.7, size=(1, k)).astype(np.float32)
+
+    @jax.jit
+    def one(key):
+        kd, kv = jax.random.split(key)
+        # drafts sampled FROM the draft distribution, as in the decoder
+        drafts = jax.random.categorical(
+            kd, jnp.log(jnp.asarray(d_probs)), axis=-1
+        ).astype(jnp.int32)
+        emit, accepted = speculative_accept(
+            kv, jnp.asarray(t_probs), jnp.asarray(d_probs), drafts
+        )
+        return emit, accepted
+
+    keys = jax.random.split(jax.random.PRNGKey(42), n)
+    emits, accepts = jax.vmap(one)(keys)
+    emits = np.asarray(emits)[:, 0]  # (n, k+1)
+    accepts = np.asarray(accepts)[:, 0]  # (n,)
+
+    # position 0 is ALWAYS emitted (either an accepted draft or the
+    # j=0 residual), so its empirical distribution must match the
+    # target's position-0 distribution
+    counts = np.bincount(emits[:, 0], minlength=v) / n
+    tv = 0.5 * np.abs(counts - t_probs[0, 0]).sum()
+    assert tv < 0.05, f"total variation {tv:.3f} vs target at position 0"
+
+    # position 1, conditioned on draft 0 accepted, must match the
+    # target's position-1 distribution
+    sel = emits[accepts >= 1, 1]
+    counts1 = np.bincount(sel, minlength=v) / len(sel)
+    tv1 = 0.5 * np.abs(counts1 - t_probs[0, 1]).sum()
+    assert tv1 < 0.07, f"total variation {tv1:.3f} at position 1"
+
+    # sanity: both accept and reject paths actually exercised
+    assert 0 < (accepts == 0).sum() < n
+    assert (accepts >= 1).sum() > n // 10
+
+
+def test_speculative_accept_self_draft_always_accepts():
+    """q == p: acceptance probability is 1 for every draft, and the
+    bonus token is sampled from the target's k-th distribution."""
+    from tensorflowonspark_tpu.models.speculative import speculative_accept
+
+    v, k = 8, 2
+    rng = np.random.default_rng(1)
+    p = rng.dirichlet(np.ones(v), size=(1, k + 1)).astype(np.float32)
+    q = p[:, :k]
+    keys = jax.random.split(jax.random.PRNGKey(7), 500)
+
+    @jax.jit
+    def one(key):
+        kd, kv = jax.random.split(key)
+        drafts = jax.random.categorical(
+            kd, jnp.log(jnp.asarray(q)), axis=-1
+        ).astype(jnp.int32)
+        return speculative_accept(
+            kv, jnp.asarray(p), jnp.asarray(q), drafts
+        )[1]
+
+    accepts = np.asarray(jax.vmap(one)(keys))[:, 0]
+    np.testing.assert_array_equal(accepts, k)
+
+
+def test_speculative_sampling_end_to_end(target_and_draft):
+    """temperature > 0 runs the sampled path end to end: the first
+    emitted token's empirical distribution matches the target's
+    softmax at the prompt's last position (fixed seed, deterministic)."""
+    target, t_params, draft, d_params = target_and_draft
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(21), (1, 6), 0, target.cfg.vocab_size
+    ).astype(jnp.int32)
+    temp = 1.5
+    logits = target.apply({"params": t_params}, prompt)[0, -1]
+    p_ref = np.asarray(jax.nn.softmax(logits / temp))
+
+    # one device call: 300 identical rows sample independently
+    # (categorical noise is per-row), giving 300 first-token draws
+    n = 300
+    tiled = jnp.tile(prompt, (n, 1))
+    toks = speculative_generate(
+        target, t_params, draft, d_params, tiled,
+        max_new_tokens=2, k=2, temperature=temp,
+        rng=jax.random.PRNGKey(1000),
+    )
+    firsts = np.asarray(toks)[:, 0].tolist()
+    counts = np.bincount(firsts, minlength=target.cfg.vocab_size) / n
+    # coarse TV bound: 256-vocab with n=300 draws concentrates on the
+    # high-probability tokens; compare only where p_ref has real mass
+    mask = p_ref > 0.01
+    tv = 0.5 * np.abs(counts[mask] - p_ref[mask]).sum()
+    assert tv < 0.15, f"total variation {tv:.3f}"
+    assert len(set(firsts)) > 3  # actually sampling, not argmaxing
+
+
 def test_speculative_validations(target_and_draft):
     target, t_params, draft, d_params = target_and_draft
     prompt = jnp.zeros((1, 8), jnp.int32)
