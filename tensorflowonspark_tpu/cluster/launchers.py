@@ -20,7 +20,7 @@ import shlex
 import subprocess
 import sys
 import time
-from typing import Any, Callable, Sequence
+from typing import Callable, Sequence
 
 logger = logging.getLogger(__name__)
 

@@ -21,8 +21,6 @@ TPU-first design notes:
 from __future__ import annotations
 
 import dataclasses
-from collections.abc import Sequence
-
 import flax.linen as nn
 import jax
 import jax.numpy as jnp

@@ -10,8 +10,6 @@ tensorflowonspark_tpu import tfnode as TFNode``.
 
 from __future__ import annotations
 
-from typing import Any
-
 from tensorflowonspark_tpu.feed.datafeed import DataFeed  # noqa: F401
 
 __all__ = ["DataFeed", "hdfs_path", "start_cluster_server", "export_saved_model"]
