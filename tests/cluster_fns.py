@@ -40,6 +40,18 @@ def failing_fn(args, ctx):
     raise ValueError("intentional failure for error-ferry test")
 
 
+def poison_inference_fn(args, ctx):
+    """Inference map_fun that dies when it sees the poison record —
+    mid-stream node-failure tests."""
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        batch = feed.next_batch(8)
+        if any(r[0] == -1 for r in batch):
+            raise RuntimeError("poison record consumed")
+        if batch:
+            feed.batch_results([r[0] ** 2 for r in batch])
+
+
 def file_reader_fn(args, ctx):
     """TENSORFLOW-mode map_fun: nodes read their own data (no feed)."""
     path = ctx.absolute_path(args["data_file"])

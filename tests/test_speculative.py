@@ -244,6 +244,25 @@ def test_speculative_sampling_end_to_end(target_and_draft):
     assert len(set(firsts)) > 3  # actually sampling, not argmaxing
 
 
+def test_speculative_int8_target_composes(target_and_draft):
+    """The serving-stack combination run_pending.sh measures: an int8
+    weight-only target verified against an fp draft still emits exactly
+    the int8 target's own greedy tokens (exactness is relative to
+    whatever model the target IS — quantized here)."""
+    from tensorflowonspark_tpu.ops.quant import quantize_tree
+
+    target, t_params, draft, d_params = target_and_draft
+    q_params = quantize_tree(t_params, min_size=1024)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(23), (2, 8), 0, target.cfg.vocab_size
+    ).astype(jnp.int32)
+    plain = generate(target, q_params, prompt, max_new_tokens=8)
+    spec = speculative_generate(
+        target, q_params, draft, d_params, prompt, max_new_tokens=8, k=3
+    )
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+
+
 def test_speculative_validations(target_and_draft):
     target, t_params, draft, d_params = target_and_draft
     prompt = jnp.zeros((1, 8), jnp.int32)

@@ -100,6 +100,40 @@ def test_inference_stream_backpressure_and_early_close(tmp_path):
         cluster.shutdown(timeout=120)
 
 
+def test_inference_stream_surfaces_node_failure(tmp_path):
+    """A node dying MID-STREAM must raise out of inference_stream (with
+    the ferried traceback), not hang the consumer or silently drop the
+    failed partition."""
+    cluster = tfcluster.run(
+        cluster_fns.poison_inference_fn,
+        {},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        env=NODE_ENV,
+    )
+    try:
+        def partitions():
+            for p in range(30):
+                # the poison record kills whichever node consumes it
+                yield [(-1,)] if p == 6 else [(p,)]
+
+        with pytest.raises(Exception) as exc_info:
+            # short feed timeout: the healthy path is seconds; a hang
+            # here would otherwise burn the default 600s
+            list(cluster.inference_stream(partitions(), feed_timeout=60))
+        msg = str(exc_info.value).lower()
+        # normally the ferried traceback ("poison"); under the node-
+        # died-before-ferry race, the driver's lowercase timeout or
+        # error-state message
+        assert "poison" in msg or "timeout" in msg or "error state" in msg
+    finally:
+        try:
+            cluster.shutdown(timeout=60)
+        except Exception:
+            pass  # the dead node already surfaced above
+
+
 def test_tensorflow_mode(tmp_path):
     data_file = tmp_path / "data.txt"
     data_file.write_text("\n".join(str(i) for i in range(50)) + "\n")
