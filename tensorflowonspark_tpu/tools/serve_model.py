@@ -37,6 +37,7 @@ import argparse
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
@@ -51,6 +52,7 @@ class _Handler(BaseHTTPRequestHandler):
     export_dir: str = ""
     batch_size: int = 64
     gen_fn: Any = None  # prompts -> completions (checkpoint mode)
+    gen_batcher: Any = None  # _GenBatcher when --gen-batch-window > 0
     # per-server lock (set in make_server): serializes jax dispatch on
     # one model while the HTTP layer stays threaded, so health checks
     # never queue behind a big batch
@@ -132,8 +134,13 @@ class _Handler(BaseHTTPRequestHandler):
         from tensorflowonspark_tpu.tools.generate_text import PromptError
 
         try:
-            with self.predict_lock:
-                completions = self.gen_fn(prompts)
+            if self.gen_batcher is not None:
+                # coalesced path: the batcher's worker serializes the
+                # decode (and takes predict_lock itself)
+                completions = self.gen_batcher.submit(prompts)
+            else:
+                with self.predict_lock:
+                    completions = self.gen_fn(prompts)
         except PromptError as e:  # the caller's prompts are at fault
             self._reply(400, {"error": str(e)})
             return
@@ -144,13 +151,123 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, {"completions": completions})
 
 
+class _GenBatcher:
+    """Coalesce concurrent /generate requests into shared decode calls.
+
+    Decode throughput is batch-bound (the weight reads amortize over
+    rows), but HTTP requests arrive one at a time; per-request decoding
+    leaves the batch mostly padding. The batcher's worker thread takes
+    the first queued request, lingers up to ``window`` seconds
+    collecting more (up to ``max_rows`` prompt rows — the server's one
+    compiled batch shape), runs ONE decode for all of them, and
+    distributes per-request slices. A failing batch retries each
+    request individually so one bad prompt cannot poison its
+    co-batched neighbors.
+    """
+
+    _STOP = object()
+
+    def __init__(self, gen_fn, lock, window: float, max_rows: int):
+        import queue as _q
+
+        self._gen_fn = gen_fn
+        self._lock = lock
+        self._window = float(window)
+        self._max_rows = int(max_rows)
+        self._queue: "_q.Queue" = _q.Queue()
+        self.decode_calls = 0  # observability (asserted in tests)
+        threading.Thread(
+            target=self._worker, daemon=True, name="gen-batcher"
+        ).start()
+
+    def submit(self, prompts: list[list[int]]) -> list[list[int]]:
+        slot: dict = {"event": threading.Event()}
+        self._queue.put((prompts, slot))
+        slot["event"].wait()
+        if "error" in slot:
+            raise slot["error"]
+        return slot["result"]
+
+    def close(self) -> None:
+        """Release the worker thread (and, with it, the model params
+        its gen_fn closure pins) — the server calls this on shutdown."""
+        self._queue.put(self._STOP)
+
+    def _decode(self, prompts):
+        self.decode_calls += 1
+        with self._lock:
+            return self._gen_fn(prompts)
+
+    def _worker(self) -> None:
+        import queue as _q
+
+        pending = None
+        while True:
+            first = pending if pending is not None else self._queue.get()
+            pending = None
+            if first is self._STOP:
+                return
+            batch = [first]
+            rows = len(first[0])
+            deadline = time.monotonic() + self._window
+            while rows < self._max_rows:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    item = self._queue.get(timeout=remaining)
+                except _q.Empty:
+                    break
+                if item is self._STOP or rows + len(item[0]) > self._max_rows:
+                    # capacity (or shutdown): carry into the next round
+                    # rather than overshooting the one compiled batch
+                    # shape into a second full-size decode
+                    pending = item
+                    break
+                batch.append(item)
+                rows += len(item[0])
+            flat = [p for req, _ in batch for p in req]
+            try:
+                results = self._decode(flat)
+            except Exception as e:  # noqa: BLE001
+                from tensorflowonspark_tpu.tools.generate_text import (
+                    PromptError,
+                )
+
+                if len(batch) > 1 and isinstance(e, PromptError):
+                    # isolate the guilty request(s): PromptError is
+                    # raised by cheap pre-decode validation, so
+                    # per-request retry costs ~nothing and co-batched
+                    # neighbors must not inherit a 400
+                    for req, slot in batch:
+                        try:
+                            slot["result"] = self._decode(req)
+                        except Exception as e_one:  # noqa: BLE001
+                            slot["error"] = e_one
+                        slot["event"].set()
+                else:
+                    # server-side fault: every retry is doomed — fail
+                    # the whole batch at once
+                    for _, slot in batch:
+                        slot["error"] = e
+                        slot["event"].set()
+                continue
+            i = 0
+            for req, slot in batch:
+                slot["result"] = results[i : i + len(req)]
+                i += len(req)
+                slot["event"].set()
+
+
 def _build_gen_fn(gen: dict):
     """Build ``prompts -> completions`` over a Llama checkpoint with ONE
     static decode shape: (gen_batch_size, gen_width). Requests are padded
     into that shape (rows repeat the last prompt, results trimmed), so
     the jitted prefill + decode loop compiles exactly once, at startup
     policy rather than per request — the bucketing discipline every
-    static-shape serving stack uses."""
+    static-shape serving stack uses. Returns ``(gen_fn, batch_size)`` —
+    the batch size actually compiled, so the request batcher's row cap
+    cannot drift from it."""
     import jax
 
     from tensorflowonspark_tpu.models.llama import Llama
@@ -263,7 +380,19 @@ def _build_gen_fn(gen: dict):
         )
         return out
 
-    return gen_fn
+    return gen_fn, bsz
+
+
+class _Server(ThreadingHTTPServer):
+    """ThreadingHTTPServer that also releases the request batcher's
+    worker thread (and the params its closure pins) on shutdown."""
+
+    gen_batcher = None
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        if self.gen_batcher is not None:
+            self.gen_batcher.close()
 
 
 def make_server(
@@ -283,6 +412,14 @@ def make_server(
         from tensorflowonspark_tpu.api.export import load_model
 
         model = load_model(export_dir)
+    gen_fn, gen_bsz = (None, 0)
+    if gen is not None:
+        gen_fn, gen_bsz = _build_gen_fn(gen)
+    lock = threading.Lock()  # per-server, not shared
+    batcher = None
+    window = float(gen.get("batch_window", 0.0) or 0.0) if gen else 0.0
+    if gen_fn is not None and window > 0:
+        batcher = _GenBatcher(gen_fn, lock, window, gen_bsz)
     handler = type(
         "_BoundHandler",
         (_Handler,),
@@ -292,13 +429,14 @@ def make_server(
             "batch_size": batch_size,
             # staticmethod: a bare function class attribute would bind
             # as a method and receive the handler as its first argument
-            "gen_fn": (
-                staticmethod(_build_gen_fn(gen)) if gen is not None else None
-            ),
-            "predict_lock": threading.Lock(),  # per-server, not shared
+            "gen_fn": staticmethod(gen_fn) if gen_fn is not None else None,
+            "gen_batcher": batcher,
+            "predict_lock": lock,
         },
     )
-    return ThreadingHTTPServer((host, port), handler)
+    server = _Server((host, port), handler)
+    server.gen_batcher = batcher
+    return server
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -342,6 +480,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--draft-config-overrides", default=None)
     p.add_argument("--spec-k", type=int, default=4)
     p.add_argument(
+        "--gen-batch-window",
+        type=float,
+        default=0.0,
+        help="coalesce concurrent /generate requests: linger this many "
+        "seconds collecting requests into one shared decode batch (up "
+        "to --gen-batch-size rows); 0 = decode per request. Decode "
+        "cost is per-batch (weight reads amortize over rows), so under "
+        "concurrent load a small window multiplies throughput",
+    )
+    p.add_argument(
         "--gen-mesh",
         default=None,
         help="shard /generate decoding over a device mesh, e.g. "
@@ -368,6 +516,7 @@ def main(argv: list[str] | None = None) -> int:
             eos_id=args.eos_id,
             seed=args.seed,
             mesh=args.gen_mesh,
+            batch_window=args.gen_batch_window,
             draft_checkpoint=args.draft_checkpoint,
             draft_model=args.draft_model,
             draft_config_overrides=args.draft_config_overrides,
