@@ -20,7 +20,17 @@ if ! ss -tln | grep -qE ':(808[2-9]|809[0-9]|810[0-9]|811[0-7]) '; then
   echo "TPU relay ports 8082-8117 not listening; aborting before any dial" >&2
   exit 1
 fi
-if pgrep -f "real_chip.py|bench.py" >/dev/null 2>&1; then
+# Match real python dialers only: a python argv[0] plus an argv token that
+# IS the script path. pgrep -f would also match supervisor processes that
+# merely mention these script names inside a long quoted argument.
+busy=""
+for cmd in /proc/[0-9]*/cmdline; do
+  busy=$(tr '\0' '\n' <"$cmd" 2>/dev/null | awk '
+    NR==1 && $0 !~ /python[0-9.]*$/ { exit }
+    NR>1 && /(^|\/)(real_chip|bench)\.py$/ { print "busy"; exit }')
+  [ -n "$busy" ] && break
+done
+if [ -n "$busy" ]; then
   echo "another benchmark process is already running (one dialer at a time)" >&2
   exit 1
 fi

@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from tensorflowonspark_tpu.ops.batch_norm import FusedBatchNorm
+
 
 @dataclasses.dataclass(frozen=True)
 class InceptionConfig:
@@ -90,17 +92,15 @@ class _ConvBN(nn.Module):
             use_bias=False,
             dtype=self.dtype,
         )(x)
-        # Normalize in the model dtype; flax keeps the batch statistics
-        # (and the running stats — force_float32_reductions, the
-        # default) in float32 regardless.
-        # An fp32 normalize chain doubles activation HBM traffic — see
-        # the same fix + measurement note in models/resnet.py.
-        x = nn.BatchNorm(
-            use_running_average=not train,
+        # Fused-statistics BN: one variadic-reduce pass per direction for
+        # the channel stats (fp32 accumulation over bf16 streams) — see
+        # the chip-profile rationale in ops/batch_norm.py and the
+        # measurement history in models/resnet.py.
+        x = FusedBatchNorm(
             momentum=0.9,
             epsilon=1e-3,
             dtype=self.dtype,
-        )(x)
+        )(x, use_running_average=not train)
         return nn.relu(x)
 
 

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tensorflowonspark_tpu.ops.batch_norm import FusedBatchNorm
+
 
 @dataclasses.dataclass(frozen=True)
 class ResNetConfig:
@@ -76,20 +78,16 @@ class _ConvBN(nn.Module):
             use_bias=False,
             dtype=self.dtype,
         )(x)
-        # BN normalization in the model dtype (bf16): flax computes the
-        # batch statistics in fp32 regardless ("statistics are always at
-        # least float32", flax _compute_stats) and keeps the running
-        # stats fp32 (force_float32_reductions, the default), so only
-        # the normalize/scale/shift arithmetic narrows. Round-1 ran this chain in fp32, which doubled the
-        # bytes of every activation pass on a bandwidth-bound workload
-        # (ResNet-50 measured 15.8% MFU; conv outputs re-read and
-        # re-written at 4 bytes/elem for stats + normalize).
-        x = nn.BatchNorm(
-            use_running_average=not train,
+        # Fused-statistics BN (ops/batch_norm.py): the round-3 chip profile
+        # showed 48% of the ResNet-50 step in separate BN stats reduction
+        # passes under nn.BatchNorm + autodiff; the custom-VJP op computes
+        # both channel statistics per direction in ONE variadic-reduce
+        # pass over the bf16 activations (stats accumulate fp32).
+        x = FusedBatchNorm(
             momentum=0.9,
             epsilon=1e-5,
             dtype=self.dtype,
-        )(x)
+        )(x, use_running_average=not train)
         return nn.relu(x) if self.act else x
 
 

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from tensorflowonspark_tpu.ops.batch_norm import FusedBatchNorm
+
 
 @dataclasses.dataclass(frozen=True)
 class VGGConfig:
@@ -63,14 +65,13 @@ class VGG(nn.Module):
                     feats, (3, 3), padding="SAME", use_bias=False,
                     dtype=cfg.dtype,
                 )(x)
-                # normalize in the model dtype; stats stay fp32 (same
-                # bandwidth fix + rationale as models/resnet.py:_ConvBN)
-                x = nn.BatchNorm(
-                    use_running_average=not train,
+                # fused-statistics BN — same profile rationale as
+                # models/resnet.py:_ConvBN (ops/batch_norm.py)
+                x = FusedBatchNorm(
                     momentum=0.9,
                     epsilon=1e-5,
                     dtype=cfg.dtype,
-                )(x)
+                )(x, use_running_average=not train)
                 x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape(x.shape[0], -1)  # flatten the final grid (fc6 input)

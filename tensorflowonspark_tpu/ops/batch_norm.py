@@ -1,0 +1,180 @@
+"""Fused-statistics BatchNorm for bandwidth-bound TPU conv nets.
+
+Why this exists (measured, round 3): on the real v5e chip, 48% of the
+ResNet-50 train step is BatchNorm statistics reductions
+(`convert_reduce_fusion` — see BASELINE.md's profile analysis), because the
+autodiff-generated stats path makes several separate full passes over the
+activations: mean and mean-of-squares forward, then sum(dy) and
+sum(dy*xhat) backward, each its own HBM read of a (N,H,W,C) tensor, plus
+the normalized-activation recompute. The convolutions themselves are only
+~22% of the step (~76% MXU-efficient) — the stats traffic is the ceiling.
+
+This module computes each direction's TWO channel statistics in ONE
+variadic `lax.reduce` pass (XLA fuses the bf16→fp32 convert and the
+squaring/products into the reduce's input), and pins the pass structure
+with a `jax.custom_vjp` so autodiff cannot de-fuse it:
+
+- forward: one pass over x for (sum, sum_sq) → mean/var; one fused
+  normalize pass (read x, write y) in the model dtype.
+- backward: one pass over (dy, x) for (sum_dy, sum_dy_xhat) — xhat is
+  recomputed inline from the saved mean/invstd, never materialized — and
+  one pass producing dx.
+
+That is 2 reads + 1 write per direction beyond the convs' own traffic —
+the streaming minimum for exact batch statistics.
+
+Parity note: the reference delegated BN entirely to TF's library
+(SURVEY.md §1 — it has no compute code of its own); this is the rebuild's
+TPU-first equivalent of the cuDNN fused-BN kernels TF used on GPUs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _channel_stats(af: jax.Array, bf: jax.Array, reduce_dims: tuple[int, ...]):
+    """One-pass per-channel (sum_a, sum_b), accumulated in fp32.
+
+    Callers pass fp32 values built from the streamed tensor (convert
+    FIRST, then square/multiply — squaring in bf16 loses the low bits
+    that E[x²]−E[x]² cancellation needs). Two sibling reductions over
+    inputs sharing the same streamed operand: XLA's multi-output fusion
+    merges them into a single pass that reads the narrow tensor from HBM
+    once, with the converts and products fused into the reduce input. A
+    variadic ``lax.reduce`` would express the same thing explicitly, but
+    this environment's remote TPU compile helper wedges on it (same
+    class of quirk as the `remat_policy="dots"` note in BASELINE.md).
+    """
+    af = af.astype(jnp.float32)
+    bf = bf.astype(jnp.float32)
+    return jnp.sum(af, axis=reduce_dims), jnp.sum(bf, axis=reduce_dims)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_batch_norm(x, gamma, beta, eps):
+    y, _, _ = _fbn_fwd_impl(x, gamma, beta, eps)
+    return y
+
+
+def _fbn_fwd_impl(x, gamma, beta, eps):
+    mean, var = batch_norm_stats(x)
+    invstd = lax.rsqrt(var + eps)
+    # Normalize in the model dtype: scale/shift collapse to one fused
+    # multiply-add over the streamed tensor.
+    scale = (invstd * gamma.astype(jnp.float32)).astype(x.dtype)
+    shift = (
+        beta.astype(jnp.float32) - mean * invstd * gamma.astype(jnp.float32)
+    ).astype(x.dtype)
+    y = x * scale + shift
+    return y, mean, invstd
+
+
+def _fbn_fwd(x, gamma, beta, eps):
+    y, mean, invstd = _fbn_fwd_impl(x, gamma, beta, eps)
+    return y, (x, gamma, mean, invstd)
+
+
+def _fbn_bwd(eps, res, dy):
+    x, gamma, mean, invstd = res
+    reduce_dims = tuple(range(x.ndim - 1))
+    n = 1
+    for d in reduce_dims:
+        n *= x.shape[d]
+    # xhat recomputed inline in fp32 register math (the HBM stream is
+    # still the bf16 tensors; XLA fuses the converts); one pass reads
+    # (dy, x) and yields both sums.
+    xhat_f = (x.astype(jnp.float32) - mean) * invstd
+    dy_f = dy.astype(jnp.float32)
+    sum_dy, sum_dy_xhat = _channel_stats(dy_f, dy_f * xhat_f, reduce_dims)
+    xhat = xhat_f.astype(x.dtype)
+
+    gamma_f = gamma.astype(jnp.float32)
+    # dx = gamma*invstd * (dy - sum_dy/n - xhat * sum_dy_xhat/n)
+    a = (gamma_f * invstd).astype(x.dtype)
+    b = (gamma_f * invstd * sum_dy / n).astype(x.dtype)
+    c = (gamma_f * invstd * sum_dy_xhat / n).astype(x.dtype)
+    dx = dy * a - b - xhat * c
+    dgamma = sum_dy_xhat.astype(gamma.dtype)
+    dbeta = sum_dy.astype(gamma.dtype)
+    return dx, dgamma, dbeta
+
+
+fused_batch_norm.defvjp(_fbn_fwd, _fbn_bwd)
+
+
+def batch_norm_stats(x) -> tuple[jax.Array, jax.Array]:
+    """One-pass (mean, var) over all-but-last dims, fp32."""
+    reduce_dims = tuple(range(x.ndim - 1))
+    n = 1
+    for d in reduce_dims:
+        n *= x.shape[d]
+    xf = x.astype(jnp.float32)
+    s, s2 = _channel_stats(xf, xf * xf, reduce_dims)
+    mean = s / n
+    var = jnp.maximum(s2 / n - mean * mean, 0.0)
+    return mean, var
+
+
+class FusedBatchNorm(nn.Module):
+    """Drop-in for ``nn.BatchNorm`` on the conv-net train path.
+
+    Train (``use_running_average=False``): normalizes with exact batch
+    statistics via :func:`fused_batch_norm` (one stats pass per
+    direction) and updates fp32 running stats under the standard
+    ``batch_stats`` collection, with ``nn.BatchNorm``'s variable names
+    (``mean``/``var``/``scale``/``bias``) and momentum convention. Note
+    the flax auto-naming of the submodule differs (``FusedBatchNorm_N``
+    vs ``BatchNorm_N``), so trees checkpointed under one module class do
+    not restore under the other without a rename. Eval: normalizes with
+    the running stats — a pure elementwise chain XLA fuses on its own.
+    """
+
+    use_running_average: bool | None = None
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool | None = None):
+        use_avg = nn.merge_param(
+            "use_running_average",
+            self.use_running_average,
+            use_running_average,
+        )
+        features = x.shape[-1]
+        gamma = self.param("scale", nn.initializers.ones, (features,), jnp.float32)
+        beta = self.param("bias", nn.initializers.zeros, (features,), jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
+        )
+        dtype = self.dtype or x.dtype
+        x = x.astype(dtype)
+
+        if use_avg:
+            invstd = lax.rsqrt(ra_var.value + self.epsilon)
+            scale = (invstd * gamma).astype(dtype)
+            shift = (beta - ra_mean.value * invstd * gamma).astype(dtype)
+            return x * scale + shift
+
+        y = fused_batch_norm(x, gamma, beta, self.epsilon)
+        if not self.is_initializing():
+            # Running-stat update outside the custom_vjp (not part of the
+            # differentiated path); one extra stats pass would double the
+            # traffic, so reuse the forward's pass via stop_gradient-free
+            # recompute: XLA CSEs this reduce with the one inside
+            # fused_batch_norm's forward (identical subgraphs).
+            mean, var = batch_norm_stats(x)
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1.0 - m) * mean
+            ra_var.value = m * ra_var.value + (1.0 - m) * var
+        return y

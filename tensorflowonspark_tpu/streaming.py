@@ -334,21 +334,53 @@ class StreamingContext:
         one partition of text lines per file (the reference examples'
         HDFS-directory streaming pattern)."""
         seen: set[str] = set()
+        # A freshly listed file may still be mid-write; reading it
+        # immediately would deliver it truncated AND mark it seen —
+        # silently dropping the tail. Deliver only once its
+        # (size, mtime) is unchanged across consecutive ticks AND the
+        # mtime is at least one batch_interval old. A writer that stalls
+        # longer than a tick mid-write can still race any polling
+        # watcher — the airtight pattern is an atomic rename into the
+        # directory (dot-prefixed temp name, like saveAsTextFiles), which
+        # this watcher delivers on its first settled tick.
+        pending: dict[str, tuple[int, int]] = {}
 
         def poll() -> RDD | None:
             try:
                 names = sorted(os.listdir(directory))
             except FileNotFoundError:
                 return None
-            new = [n for n in names if n not in seen and not n.startswith(".")]
-            seen.update(new)
+            now_ns = time.time_ns()
+            settle_ns = int(self.batch_interval * 1e9)
             parts: RDD = []
-            for name in new:
-                path = os.path.join(directory, name)
-                if not os.path.isfile(path):
+            for name in names:
+                if name in seen or name.startswith("."):
                     continue
-                with open(path) as f:
-                    parts.append([line.rstrip("\n") for line in f])
+                path = os.path.join(directory, name)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    pending.pop(name, None)
+                    continue
+                if not os.path.isfile(path):
+                    seen.add(name)
+                    continue
+                sig = (st.st_size, st.st_mtime_ns)
+                if pending.get(name) == sig and now_ns - st.st_mtime_ns >= settle_ns:
+                    try:
+                        with open(path) as f:
+                            lines = [line.rstrip("\n") for line in f]
+                    except OSError:
+                        # Deleted/renamed between stat and open: a poll
+                        # exception would kill the whole scheduler, and
+                        # marking it seen would drop it if it reappears.
+                        pending.pop(name, None)
+                        continue
+                    seen.add(name)
+                    del pending[name]
+                    parts.append(lines)
+                else:
+                    pending[name] = sig
             return parts or None
 
         return self._add_source(poll)

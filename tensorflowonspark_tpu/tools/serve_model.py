@@ -175,6 +175,11 @@ class _GenBatcher:
         self._window = float(window)
         self._max_rows = int(max_rows)
         self._queue: "_q.Queue" = _q.Queue()
+        self._closed = False
+        # Orders submit()'s closed-check-then-put against close()'s
+        # set-flag-then-put-STOP, so no request can enqueue behind the
+        # STOP marker (it would hang unanswered once the worker exits).
+        self._submit_lock = threading.Lock()
         self.decode_calls = 0  # observability (asserted in tests)
         threading.Thread(
             target=self._worker, daemon=True, name="gen-batcher"
@@ -182,7 +187,10 @@ class _GenBatcher:
 
     def submit(self, prompts: list[list[int]]) -> list[list[int]]:
         slot: dict = {"event": threading.Event()}
-        self._queue.put((prompts, slot))
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("server shutting down")
+            self._queue.put((prompts, slot))
         slot["event"].wait()
         if "error" in slot:
             raise slot["error"]
@@ -190,8 +198,27 @@ class _GenBatcher:
 
     def close(self) -> None:
         """Release the worker thread (and, with it, the model params
-        its gen_fn closure pins) — the server calls this on shutdown."""
-        self._queue.put(self._STOP)
+        its gen_fn closure pins) — the server calls this on shutdown.
+        Requests racing the shutdown are failed, not left hanging: the
+        worker drains the queue behind the _STOP and errors every slot,
+        and submit() fails fast once the flag is up."""
+        with self._submit_lock:
+            self._closed = True
+            self._queue.put(self._STOP)
+
+    def _fail_pending(self) -> None:
+        import queue as _q
+
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except _q.Empty:
+                return
+            if item is self._STOP:
+                continue
+            _, slot = item
+            slot["error"] = RuntimeError("server shutting down")
+            slot["event"].set()
 
     def _decode(self, prompts):
         self.decode_calls += 1
@@ -206,6 +233,7 @@ class _GenBatcher:
             first = pending if pending is not None else self._queue.get()
             pending = None
             if first is self._STOP:
+                self._fail_pending()
                 return
             batch = [first]
             rows = len(first[0])

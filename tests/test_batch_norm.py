@@ -1,0 +1,133 @@
+"""FusedBatchNorm: value/grad parity with flax nn.BatchNorm.
+
+The op exists for bandwidth (one variadic-reduce pass per direction —
+see ops/batch_norm.py's profile rationale); these tests pin that the
+fused pass structure did not change the math.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.ops.batch_norm import (
+    FusedBatchNorm,
+    batch_norm_stats,
+    fused_batch_norm,
+)
+
+
+def _ref_apply(x, gamma, beta, eps):
+    mean = x.mean(axis=(0, 1, 2))
+    var = x.var(axis=(0, 1, 2))
+    return (x - mean) / np.sqrt(var + eps) * gamma + beta
+
+
+def test_fused_batch_norm_matches_reference_fp32():
+    rng = np.random.default_rng(0)
+    x = rng.normal(2.0, 3.0, (4, 5, 6, 16)).astype(np.float32)
+    gamma = rng.normal(1.0, 0.2, (16,)).astype(np.float32)
+    beta = rng.normal(0.0, 0.2, (16,)).astype(np.float32)
+    y = fused_batch_norm(jnp.asarray(x), jnp.asarray(gamma), jnp.asarray(beta), 1e-5)
+    np.testing.assert_allclose(y, _ref_apply(x, gamma, beta, 1e-5), atol=1e-4)
+
+
+def test_fused_batch_norm_grads_match_autodiff_reference():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(0.5, 2.0, (3, 4, 4, 8)).astype(np.float32))
+    gamma = jnp.asarray(rng.normal(1.0, 0.3, (8,)).astype(np.float32))
+    beta = jnp.asarray(rng.normal(size=(8,)).astype(np.float32))
+    t = jnp.asarray(rng.normal(size=x.shape).astype(np.float32))
+
+    def fused_loss(x, g, b):
+        return jnp.sum(fused_batch_norm(x, g, b, 1e-5) * t)
+
+    def ref_loss(x, g, b):
+        mean = x.mean(axis=(0, 1, 2))
+        var = x.var(axis=(0, 1, 2))
+        y = (x - mean) * jax.lax.rsqrt(var + 1e-5) * g + b
+        return jnp.sum(y * t)
+
+    gf = jax.grad(fused_loss, argnums=(0, 1, 2))(x, gamma, beta)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=2e-3, rtol=1e-4)
+
+
+def test_batch_norm_stats_one_pass_matches_numpy():
+    rng = np.random.default_rng(2)
+    x = rng.normal(-1.0, 4.0, (2, 3, 3, 4)).astype(np.float32)
+    mean, var = batch_norm_stats(jnp.asarray(x))
+    np.testing.assert_allclose(mean, x.mean(axis=(0, 1, 2)), atol=1e-5)
+    np.testing.assert_allclose(var, x.var(axis=(0, 1, 2)), atol=1e-4)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_module_parity_with_flax_batchnorm(dtype):
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(1.0, 2.0, (4, 6, 6, 12))).astype(dtype)
+
+    fused = FusedBatchNorm(momentum=0.9, epsilon=1e-5, dtype=dtype)
+    flaxbn = nn.BatchNorm(momentum=0.9, epsilon=1e-5, dtype=dtype)
+    vf = fused.init(jax.random.key(0), x, use_running_average=False)
+    vx = flaxbn.init(jax.random.key(0), x, use_running_average=False)
+
+    yf, mf = fused.apply(
+        vf, x, use_running_average=False, mutable=["batch_stats"]
+    )
+    yx, mx = flaxbn.apply(
+        vx, x, use_running_average=False, mutable=["batch_stats"]
+    )
+    tol = 1e-4 if dtype == jnp.float32 else 6e-2
+    np.testing.assert_allclose(
+        np.asarray(yf, np.float32), np.asarray(yx, np.float32), atol=tol
+    )
+    # Running stats: same variable names and momentum convention.
+    sf = mf["batch_stats"]
+    sx = mx["batch_stats"]
+    for k in ("mean", "var"):
+        np.testing.assert_allclose(
+            np.asarray(sf[k]), np.asarray(sx[k]), atol=tol
+        )
+
+    # Eval path uses the updated running stats identically.
+    vf2 = {"params": vf["params"], "batch_stats": mf["batch_stats"]}
+    vx2 = {"params": vx["params"], "batch_stats": mx["batch_stats"]}
+    ye_f = fused.apply(vf2, x, use_running_average=True)
+    ye_x = flaxbn.apply(vx2, x, use_running_average=True)
+    np.testing.assert_allclose(
+        np.asarray(ye_f, np.float32), np.asarray(ye_x, np.float32), atol=tol
+    )
+
+
+def test_grad_does_not_leak_through_running_stats():
+    # The running-stat update must not contribute cotangents to params:
+    # grads with the mutable stat update active must EQUAL grads from
+    # the pure normalize (update disabled via init-mode apply).
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(2, 3, 3, 4)), jnp.float32)
+    m = FusedBatchNorm()
+    v = m.init(jax.random.key(0), x, use_running_average=False)
+
+    def loss_with_update(params):
+        y, _ = m.apply(
+            {"params": params, "batch_stats": v["batch_stats"]},
+            x,
+            use_running_average=False,
+            mutable=["batch_stats"],
+        )
+        return jnp.sum(y * y)
+
+    def loss_pure(params):
+        y = fused_batch_norm(
+            x, params["scale"], params["bias"], m.epsilon
+        )
+        return jnp.sum(y * y)
+
+    g_upd = jax.grad(loss_with_update)(v["params"])
+    g_pure = jax.grad(loss_pure)(v["params"])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        g_upd,
+        g_pure,
+    )

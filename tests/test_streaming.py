@@ -77,6 +77,25 @@ def test_text_file_stream(tmp_path):
     assert len(out) == 2
 
 
+def test_text_file_stream_slow_writer_not_truncated(tmp_path):
+    """A file caught mid-write must not be delivered truncated (the
+    watcher settles a file's (size, mtime) across two ticks first)."""
+    ssc = StreamingContext(batch_interval=0.25)
+    stream = ssc.textFileStream(str(tmp_path))
+    out = _collect(ssc, stream)
+    with open(tmp_path / "slow.txt", "w") as f:
+        f.write("1\n")
+        f.flush()
+        time.sleep(0.1)  # ticks may observe the half-written file
+        f.write("2\n")
+        f.flush()
+    deadline = time.time() + 10
+    while not out and time.time() < deadline:
+        time.sleep(0.05)
+    ssc.stop()
+    assert out == [[["1", "2"]]]
+
+
 def test_scheduler_error_ferried_to_await():
     ssc = StreamingContext(batch_interval=0.05)
     stream = ssc.queueStream([[1]]).map(lambda x: 1 / 0)

@@ -1,0 +1,43 @@
+"""trace_summary: nesting-aware self-time over a synthetic Chrome trace."""
+
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "benchmarks"))
+
+import trace_summary  # noqa: E402
+
+
+def test_self_time_subtracts_nested_children(tmp_path, capsys):
+    # One device lane: module [0, 100) containing fusion [10, 40) which
+    # contains op [15, 20); a sibling fusion [50, 90). Self times:
+    #   module: 100 - 30 - 40 = 30; fusion: (30-5) + 40 = 65; op: 5
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "module", "ts": 0, "dur": 100},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion", "ts": 10, "dur": 30},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "op", "ts": 15, "dur": 5},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion", "ts": 50, "dur": 40},
+    ]
+    self_us = trace_summary.self_times(events)
+    assert self_us[(7, "module")] == 30
+    assert self_us[(7, "fusion")] == 65
+    assert self_us[(7, "op")] == 5
+
+    run = tmp_path / "plugins" / "profile" / "run1"
+    run.mkdir(parents=True)
+    with gzip.open(run / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+    rc = trace_summary.main([str(tmp_path), "--top", "5"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "/device:TPU:0" in out
+    assert "fusion" in out
+
+
+def test_no_trace_files_is_an_error(tmp_path):
+    assert trace_summary.main([str(tmp_path)]) == 1
