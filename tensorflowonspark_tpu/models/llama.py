@@ -689,6 +689,46 @@ def generate(
     return run(params, prompt, rng, lengths)
 
 
+def sample_logits(logits, key, temperature, top_k=None, top_p=None):
+    """Sample next tokens from (B, vocab) logits.
+
+    ``temperature == 0`` is greedy argmax (``key`` unused). Otherwise
+    sample from ``logits / temperature``, optionally truncated to the
+    ``top_k`` most likely tokens and/or the smallest nucleus with
+    cumulative probability ``top_p`` (top-k applies first, matching the
+    standard decoding stacks). Sampling params are trace-time constants
+    — callers bake them into their jitted program.
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    vocab = logits.shape[-1]
+    k_active = top_k is not None and top_k < vocab
+    p_active = top_p is not None and top_p < 1.0
+    if k_active:
+        # lax.top_k beats a full-vocab sort inside the scanned
+        # single-token decode loop; when top_p is also set, the
+        # nucleus scan then runs on k values instead of the vocab
+        sorted_desc = jax.lax.top_k(logits, top_k)[0]
+        logits = jnp.where(
+            logits < sorted_desc[..., -1, None], -jnp.inf, logits
+        )
+    elif p_active:
+        sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    if p_active:
+        cum = jnp.cumsum(jax.nn.softmax(sorted_desc, axis=-1), axis=-1)
+        # index of the last kept token: everything before the point
+        # where cumulative mass reaches top_p, and always >= 0 (the
+        # most likely token survives even when it alone exceeds p;
+        # an index == k clamps to the last top-k entry = keep all)
+        cutoff_index = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff_logit = jnp.take_along_axis(
+            sorted_desc, cutoff_index, axis=-1
+        )
+        logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
 @functools.lru_cache(maxsize=32)
 def _build_generate(
     model: "Llama",
@@ -728,34 +768,7 @@ def _build_generate(
         )
 
     def sample(logits, key):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / temperature
-        vocab = logits.shape[-1]
-        k_active = top_k is not None and top_k < vocab
-        p_active = top_p is not None and top_p < 1.0
-        if k_active:
-            # lax.top_k beats a full-vocab sort inside the scanned
-            # single-token decode loop; when top_p is also set, the
-            # nucleus scan then runs on k values instead of the vocab
-            sorted_desc = jax.lax.top_k(logits, top_k)[0]
-            logits = jnp.where(
-                logits < sorted_desc[..., -1, None], -jnp.inf, logits
-            )
-        elif p_active:
-            sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
-        if p_active:
-            cum = jnp.cumsum(jax.nn.softmax(sorted_desc, axis=-1), axis=-1)
-            # index of the last kept token: everything before the point
-            # where cumulative mass reaches top_p, and always >= 0 (the
-            # most likely token survives even when it alone exceeds p;
-            # an index == k clamps to the last top-k entry = keep all)
-            cutoff_index = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-            cutoff_logit = jnp.take_along_axis(
-                sorted_desc, cutoff_index, axis=-1
-            )
-            logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
-        return jax.random.categorical(key, logits).astype(jnp.int32)
+        return sample_logits(logits, key, temperature, top_k, top_p)
 
     @jax.jit
     def run(params, prompt, rng, lengths=None):

@@ -1,0 +1,13 @@
+"""TPU-native serving runtime.
+
+The reference had no serving story beyond per-executor batch inference
+(SURVEY.md §2.2 — its Scala L7 API scored frozen graphs over RDD
+partitions). This package is the rebuild's beyond-reference serving
+layer: :mod:`engine` provides slot-based continuous batching — requests
+join and leave a persistent batched decode loop at token granularity
+instead of waiting for fixed-batch windows.
+"""
+
+from tensorflowonspark_tpu.serving.engine import ContinuousBatcher
+
+__all__ = ["ContinuousBatcher"]

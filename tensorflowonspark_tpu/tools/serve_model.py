@@ -45,6 +45,10 @@ from tensorflowonspark_tpu.tools.run_model import _to_jsonable
 
 logger = logging.getLogger(__name__)
 
+# The server most recently started by main() — lets tooling and tests
+# reach a CLI-started server (e.g. its ephemeral port under --port 0).
+_last_server = None
+
 
 class _Handler(BaseHTTPRequestHandler):
     # set by make_server():
@@ -53,6 +57,8 @@ class _Handler(BaseHTTPRequestHandler):
     batch_size: int = 64
     gen_fn: Any = None  # prompts -> completions (checkpoint mode)
     gen_batcher: Any = None  # _GenBatcher when --gen-batch-window > 0
+    gen_engine: Any = None  # ContinuousBatcher (--gen-engine continuous)
+    gen_max_new: int = 64  # per-request decode budget in engine mode
     # per-server lock (set in make_server): serializes jax dispatch on
     # one model while the HTTP layer stays threaded, so health checks
     # never queue behind a big batch
@@ -113,7 +119,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, {"predictions": [_to_jsonable(p) for p in preds]})
 
     def _do_generate(self) -> None:
-        if self.gen_fn is None:
+        if self.gen_fn is None and self.gen_engine is None:
             self._reply(
                 400, {"error": "server was not started with "
                       "--llama-checkpoint; /generate unavailable"}
@@ -134,7 +140,17 @@ class _Handler(BaseHTTPRequestHandler):
         from tensorflowonspark_tpu.tools.generate_text import PromptError
 
         try:
-            if self.gen_batcher is not None:
+            if self.gen_engine is not None:
+                try:
+                    completions = self._engine_generate(prompts)
+                except ValueError as e:
+                    # the engine's submit-side prompt validation (width/
+                    # budget) — client fault, like PromptError below; a
+                    # ValueError from the OTHER paths stays a 500 (it
+                    # would be a server-side defect, not bad input)
+                    self._reply(400, {"error": str(e)})
+                    return
+            elif self.gen_batcher is not None:
                 # coalesced path: the batcher's worker serializes the
                 # decode (and takes predict_lock itself)
                 completions = self.gen_batcher.submit(prompts)
@@ -149,6 +165,37 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
             return
         self._reply(200, {"completions": completions})
+
+    def _engine_generate(self, prompts):
+        """Continuous-batching path: each prompt row is its own engine
+        request, so a multi-row request's rows decode concurrently and
+        rows from OTHER requests interleave freely — no convoying. The
+        handler thread fans out one thread per extra row and joins."""
+        eng, budget = self.gen_engine, self.gen_max_new
+        if len(prompts) == 1:
+            return [eng.submit(prompts[0], budget)]
+        results: list = [None] * len(prompts)
+        errors: list = [None] * len(prompts)
+
+        def one(i):
+            try:
+                results[i] = eng.submit(prompts[i], budget)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errors[i] = e
+
+        threads = [
+            threading.Thread(target=one, args=(i,))
+            for i in range(1, len(prompts))
+        ]
+        for t in threads:
+            t.start()
+        one(0)
+        for t in threads:
+            t.join()
+        for e in errors:
+            if e is not None:
+                raise e
+        return results
 
 
 class _GenBatcher:
@@ -287,6 +334,58 @@ class _GenBatcher:
                 slot["event"].set()
 
 
+def _build_engine(gen: dict):
+    """Build the continuous-batching engine for ``--gen-engine
+    continuous``: one persistent slot-based decode loop instead of the
+    fixed-batch gen_fn. Incompatible with the fixed-batch-only options
+    (coalescing window, speculative draft, mesh decode) — reject at
+    startup, not on the first request."""
+    from tensorflowonspark_tpu.models.llama import Llama
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+    from tensorflowonspark_tpu.tools.generate_text import (
+        _load_config,
+        _load_params,
+    )
+
+    for bad, flag in (
+        ("batch_window", "--gen-batch-window"),
+        ("draft_checkpoint", "--draft-checkpoint"),
+        ("mesh", "--gen-mesh"),
+    ):
+        if gen.get(bad):
+            raise ValueError(
+                f"--gen-engine continuous does not compose with {flag} "
+                "(the engine schedules per token; those options belong "
+                "to the fixed-batch path)"
+            )
+    cfg = _load_config(
+        argparse.Namespace(
+            model=gen["model"], config_overrides=gen.get("config_overrides")
+        )
+    )
+    model = Llama(cfg)
+    params = _load_params(gen["checkpoint"], cfg)
+    width = int(gen.get("width", 128))
+    max_new = int(gen.get("max_new_tokens", 64))
+    if width + max_new > cfg.max_seq_len:
+        raise ValueError(
+            f"--gen-width ({width}) + --max-new-tokens ({max_new}) "
+            f"exceeds max_seq_len ({cfg.max_seq_len})"
+        )
+    engine = ContinuousBatcher(
+        model,
+        params,
+        slots=int(gen.get("slots") or gen.get("batch_size", 8)),
+        prompt_widths=(width,),
+        temperature=float(gen.get("temperature", 0.0)),
+        top_k=gen.get("top_k"),
+        top_p=gen.get("top_p"),
+        eos_id=gen.get("eos_id"),
+        seed=int(gen.get("seed", 0)),
+    )
+    return engine, max_new
+
+
 def _build_gen_fn(gen: dict):
     """Build ``prompts -> completions`` over a Llama checkpoint with ONE
     static decode shape: (gen_batch_size, gen_width). Requests are padded
@@ -416,11 +515,14 @@ class _Server(ThreadingHTTPServer):
     worker thread (and the params its closure pins) on shutdown."""
 
     gen_batcher = None
+    gen_engine = None
 
     def shutdown(self) -> None:
         super().shutdown()
         if self.gen_batcher is not None:
             self.gen_batcher.close()
+        if self.gen_engine is not None:
+            self.gen_engine.close()
 
 
 def make_server(
@@ -441,7 +543,10 @@ def make_server(
 
         model = load_model(export_dir)
     gen_fn, gen_bsz = (None, 0)
-    if gen is not None:
+    engine, engine_max_new = (None, 64)
+    if gen is not None and gen.get("engine") == "continuous":
+        engine, engine_max_new = _build_engine(gen)
+    elif gen is not None:
         gen_fn, gen_bsz = _build_gen_fn(gen)
     lock = threading.Lock()  # per-server, not shared
     batcher = None
@@ -459,11 +564,14 @@ def make_server(
             # as a method and receive the handler as its first argument
             "gen_fn": staticmethod(gen_fn) if gen_fn is not None else None,
             "gen_batcher": batcher,
+            "gen_engine": engine,
+            "gen_max_new": engine_max_new,
             "predict_lock": lock,
         },
     )
     server = _Server((host, port), handler)
     server.gen_batcher = batcher
+    server.gen_engine = engine
     return server
 
 
@@ -525,6 +633,22 @@ def main(argv: list[str] | None = None) -> int:
         "'data'); --gen-batch-size must be divisible by the 'data' "
         "extent",
     )
+    p.add_argument(
+        "--gen-engine",
+        choices=("fixed", "continuous"),
+        default="fixed",
+        help="'continuous' = slot-based continuous batching: requests "
+        "join/leave a persistent decode loop at token granularity "
+        "(no convoying behind a batch window); incompatible with "
+        "--gen-batch-window/--draft-checkpoint/--gen-mesh",
+    )
+    p.add_argument(
+        "--gen-slots",
+        type=int,
+        default=None,
+        help="continuous engine KV-cache slots (default: "
+        "--gen-batch-size)",
+    )
     args = p.parse_args(argv)
     if args.export_dir is None and args.llama_checkpoint is None:
         p.error("need --export-dir and/or --llama-checkpoint")
@@ -549,10 +673,14 @@ def main(argv: list[str] | None = None) -> int:
             draft_model=args.draft_model,
             draft_config_overrides=args.draft_config_overrides,
             spec_k=args.spec_k,
+            engine=args.gen_engine,
+            slots=args.gen_slots,
         )
     server = make_server(
         args.export_dir, args.port, args.batch_size, host=args.host, gen=gen
     )
+    global _last_server  # drive/inspect a CLI-started server (tests,
+    _last_server = server  # operator tooling; the bound port for --port 0)
     logger.info(
         "serving %s on :%d",
         args.export_dir or args.llama_checkpoint,

@@ -337,6 +337,84 @@ def test_serve_model_generate_request_coalescing(tmp_path):
         server.shutdown()
 
 
+def test_serve_model_continuous_engine(tmp_path):
+    """--gen-engine continuous: /generate rides the slot-based engine.
+    Concurrent requests interleave in one decode loop; each completion
+    still matches its solo generate() output, and the fixed-path-only
+    options are rejected at startup."""
+    import threading
+
+    from tensorflowonspark_tpu.tools import serve_model
+
+    cfg, model, params, ckpt_dir = _tiny_checkpoint(tmp_path)
+    gen = dict(
+        checkpoint=ckpt_dir,
+        model="tiny",
+        config_overrides='{"remat": false, "dtype": "float32"}',
+        width=8,
+        batch_size=3,
+        max_new_tokens=5,
+        engine="continuous",
+    )
+    server = serve_model.make_server(None, port=0, gen=gen)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        prompts = [[1, 2, 3], [4, 5, 6, 7, 8], [9], [2, 4], [6]]
+        results: dict[int, tuple] = {}
+
+        def fire(i):
+            results[i] = _post(
+                port, "/generate", {"prompts": [prompts[i]]}
+            )
+
+        threads = [
+            threading.Thread(target=fire, args=(i,))
+            for i in range(len(prompts))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive()
+        for i, p in enumerate(prompts):
+            code, body = results[i]
+            assert code == 200, body
+            want = np.asarray(
+                generate(model, params, jnp.asarray([p], jnp.int32), 5)
+            )[0].tolist()
+            assert body["completions"] == [want], (i, body, want)
+        assert server.gen_engine.admitted == len(prompts)
+
+        # multi-row request fans out engine-side
+        code, body = _post(
+            port, "/generate", {"prompts": [[1, 2], [3, 4, 5]]}
+        )
+        assert code == 200
+        for row, p in zip(body["completions"], [[1, 2], [3, 4, 5]]):
+            want = np.asarray(
+                generate(model, params, jnp.asarray([p], jnp.int32), 5)
+            )[0].tolist()
+            assert row == want
+
+        # over-width prompt: engine validation surfaces as a 400
+        code, body = _post(port, "/generate", {"prompts": [[1] * 9]})
+        assert code == 400 and "width" in body["error"]
+    finally:
+        server.shutdown()
+
+    # fixed-path-only options are rejected at startup, not first request
+    import pytest as _pytest
+
+    for bad in (
+        dict(batch_window=0.2),
+        dict(draft_checkpoint=ckpt_dir),
+        dict(mesh="data=1,model=1"),
+    ):
+        with _pytest.raises(ValueError, match="does not compose"):
+            serve_model.make_server(None, port=0, gen={**gen, **bad})
+
+
 def test_serve_model_generate_endpoint(tmp_path):
     """POST /generate against a live ephemeral-port server in
     --llama-checkpoint mode; completions match the CLI/library decode."""
