@@ -348,20 +348,17 @@ def bench_llama1b(args):
     )
 
 
-def bench_llama1b_decode(args):
-    """KV-cache autoregressive decode: tokens/sec at batch 8."""
-    import jax
+def _llama1b_decode_setup(args, prompt_len: int = 128):
+    """Shared config/model/prompt build for the decode-side llama1b
+    benches — ``llama1b_decode`` and ``llama1b_engine`` are read as a
+    same-configuration pair (their delta is the engine's scheduling
+    tax), so they must not drift."""
     import jax.numpy as jnp
     import numpy as np
 
-    from tensorflowonspark_tpu.models.llama import (
-        Llama,
-        LlamaConfig,
-        generate,
-    )
+    from tensorflowonspark_tpu.models.llama import Llama, LlamaConfig
 
     b = args.batch_size or 8
-    prompt_len = 128
     new_tokens = args.new_tokens
     cfg = LlamaConfig(
         vocab_size=32000,
@@ -371,7 +368,7 @@ def bench_llama1b_decode(args):
         num_heads=16,
         num_kv_heads=16,
         # speculative verification scratches up to spec_k slots past
-        # the emitted text (spec_k re-read below, after model build)
+        # the emitted text
         max_seq_len=(
             prompt_len + new_tokens + (getattr(args, "spec_k", 0) or 0)
         ),
@@ -381,9 +378,22 @@ def bench_llama1b_decode(args):
     )
     model = Llama(cfg)
     rng = np.random.default_rng(0)
-    prompt = jnp.asarray(
-        rng.integers(0, cfg.vocab_size, size=(b, prompt_len)), jnp.int32
-    )
+    prompt_np = rng.integers(
+        0, cfg.vocab_size, size=(b, prompt_len)
+    ).astype(np.int32)
+    return b, new_tokens, cfg, model, prompt_np
+
+
+def bench_llama1b_decode(args):
+    """KV-cache autoregressive decode: tokens/sec at batch 8."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.models.llama import generate
+
+    b, new_tokens, cfg, model, prompt_np = _llama1b_decode_setup(args)
+    prompt = jnp.asarray(prompt_np)
     from tensorflowonspark_tpu.ops.quant import quantize_tree
 
     spec_k = getattr(args, "spec_k", 0) or 0
@@ -446,6 +456,71 @@ def bench_llama1b_decode(args):
     return dict(examples=b, dt=dt / new_tokens, loss=0.0)
 
 
+def bench_llama1b_engine(args):
+    """Continuous-batching engine throughput at full occupancy: the same
+    1B decode as ``llama1b_decode`` but scheduled by
+    ``serving.ContinuousBatcher`` (per-token host sync + slot
+    scheduling). The delta vs ``llama1b_decode`` at the same batch IS
+    the scheduling tax of token-granular admission; the win it buys —
+    no convoying, immediate slot reuse — doesn't show in a
+    full-occupancy steady-state number, so read the pair together."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+
+    b, new_tokens, cfg, model, prompts = _llama1b_decode_setup(args)
+    prompt_len = prompts.shape[1]
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.asarray(prompts[:2])
+    )["params"]
+    if getattr(args, "quantize", False):
+        from tensorflowonspark_tpu.ops.quant import quantize_tree
+
+        params = quantize_tree(params)
+    params = jax.tree.map(jax.device_put, params)
+    engine = ContinuousBatcher(
+        model, params, slots=b, prompt_widths=(prompt_len,)
+    )
+
+    def fire_all(n_tokens):
+        # Ferry worker-thread failures: a dead engine answers every
+        # submit instantly with an error, and a swallowed exception
+        # would let a microseconds-long round masquerade as a
+        # measurement in the teed artifact.
+        errors = [None] * b
+
+        def one(i):
+            try:
+                engine.submit(prompts[i].tolist(), n_tokens)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errors[i] = e
+
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(b)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for e in errors:
+            if e is not None:
+                raise e
+
+    fire_all(4)  # compile prefill + admit + step, warm the loop
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        fire_all(new_tokens)
+    dt = time.perf_counter() - t0
+    engine.close()
+    # Same reporting convention as llama1b_decode (dt rescaled by
+    # tokens-per-round): step_time_ms is one single-token engine step at
+    # full occupancy, examples_per_sec is tokens/sec across the batch.
+    return dict(examples=b, dt=dt / new_tokens, loss=0.0)
+
+
 V5E_PEAK_TFLOPS = 197.0  # per-chip bf16 peak (shared with bench.py)
 
 CONFIGS = {
@@ -455,6 +530,7 @@ CONFIGS = {
     "bert_base": bench_bert_base,
     "llama1b": bench_llama1b,
     "llama1b_decode": bench_llama1b_decode,
+    "llama1b_engine": bench_llama1b_engine,
 }
 
 
@@ -490,12 +566,13 @@ def main(argv=None):
         "--new-tokens",
         type=int,
         default=256,
-        help="decode length for llama1b_decode",
+        help="decode length for llama1b_decode/llama1b_engine",
     )
     p.add_argument(
         "--quantize",
         action="store_true",
-        help="llama1b_decode: int8 weight-only decode (ops/quant.py)",
+        help="llama1b_decode/llama1b_engine: int8 weight-only decode "
+        "(ops/quant.py)",
     )
     p.add_argument(
         "--spec-k",

@@ -52,4 +52,9 @@ run python benchmarks/real_chip.py --config llama1b --seq 4096 \
 run python benchmarks/real_chip.py --config llama1b --moments bf16 \
   --profile "${PROFILE_DIR_LLAMA:-/tmp/llama1b_profile}"
 
+# 5. Continuous-batching engine at full occupancy vs the plain batch
+#    decode (the same-batch delta is the token-granular scheduling tax)
+run python benchmarks/real_chip.py --config llama1b_engine --steps 3
+run python benchmarks/real_chip.py --config llama1b_engine --steps 3 --quantize
+
 echo "round-3b measurements attempted; results in $OUT" >&2

@@ -160,6 +160,19 @@ class ContinuousBatcher:
             raise p.error
         return p.result
 
+    def stats(self) -> dict:
+        """Scheduler observability (served at the HTTP ``/stats``
+        endpoint): slot occupancy, queue depth, lifetime counters."""
+        busy = sum(e is not None for e in self._live)
+        return {
+            "slots": self._slots,
+            "slots_busy": busy,
+            "queue_depth": self._queue.qsize(),
+            "steps": self.steps,
+            "admitted": self.admitted,
+            "closed": self._closed,
+        }
+
     def close(self) -> None:
         """Stop the loop; in-flight and queued requests are failed."""
         with self._submit_lock:

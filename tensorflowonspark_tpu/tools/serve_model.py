@@ -80,6 +80,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, {"status": "ok", "export_dir": self.export_dir})
         elif self.path == "/signature" and self.model is not None:
             self._reply(200, self.model.meta)
+        elif self.path == "/stats":
+            stats: dict = {"mode": "aot" if self.model is not None else ""}
+            if self.gen_engine is not None:
+                stats.update(self.gen_engine.stats(), mode="continuous")
+            elif self.gen_batcher is not None:
+                stats.update(
+                    mode="coalesced",
+                    decode_calls=self.gen_batcher.decode_calls,
+                )
+            elif self.gen_fn is not None:
+                stats["mode"] = "fixed"
+            self._reply(200, stats)
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 

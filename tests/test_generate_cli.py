@@ -400,6 +400,18 @@ def test_serve_model_continuous_engine(tmp_path):
         # over-width prompt: engine validation surfaces as a 400
         code, body = _post(port, "/generate", {"prompts": [[1] * 9]})
         assert code == 400 and "width" in body["error"]
+
+        # scheduler observability
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats"
+        ) as r:
+            stats = json.loads(r.read())
+        assert stats["mode"] == "continuous"
+        assert stats["slots"] == 3
+        assert stats["admitted"] == len(prompts) + 2
+        assert stats["steps"] > 0 and not stats["closed"]
     finally:
         server.shutdown()
 

@@ -134,6 +134,25 @@ def test_engine_loop_death_fails_waiters_not_hangs(tiny):
     eng.close()
 
 
+def test_engine_composes_with_int8_weights(tiny):
+    """A quantize_tree'd param tree rides the engine unchanged (QDense
+    consumes QuantTensor leaves natively) and matches generate() run on
+    the SAME quantized tree — the int8-serving composition."""
+    from tensorflowonspark_tpu.ops.quant import quantize_tree
+
+    cfg, model, params = tiny
+    qparams = quantize_tree(params, min_size=64)
+    eng = ContinuousBatcher(model, qparams, slots=2, prompt_widths=(8,))
+    try:
+        got = eng.submit([1, 2, 3], 5)
+        want = np.asarray(
+            generate(model, qparams, jnp.asarray([[1, 2, 3]], jnp.int32), 5)
+        )[0].tolist()
+        assert got == want
+    finally:
+        eng.close()
+
+
 def test_engine_sampled_mode_runs(tiny):
     cfg, model, params = tiny
     eng = ContinuousBatcher(
