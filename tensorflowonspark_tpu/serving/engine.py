@@ -59,6 +59,23 @@ class _Pending:
     event: threading.Event
     result: list[int] | None = None
     error: BaseException | None = None
+    # streaming: every emitted token is ALSO pushed here as it decodes,
+    # then True (done) or the error object as the terminal item
+    sink: "queue.Queue | None" = None
+
+    def emit(self, token: int) -> None:
+        if self.sink is not None:
+            self.sink.put(token)
+
+    def finish(self) -> None:
+        if self.sink is not None:
+            self.sink.put(True)
+
+    def fail(self, err: BaseException) -> None:
+        self.error = err
+        if self.sink is not None:
+            self.sink.put(err)
+        self.event.set()
 
 
 class ContinuousBatcher:
@@ -133,9 +150,9 @@ class ContinuousBatcher:
 
     # -- public API ----------------------------------------------------
 
-    def submit(
-        self, tokens: list[int], max_new_tokens: int
-    ) -> list[int]:
+    def _enqueue(
+        self, tokens: list[int], max_new_tokens: int, sink=None
+    ) -> _Pending:
         cfg = self._model.cfg
         if not tokens:
             raise ValueError("empty prompt")
@@ -150,15 +167,48 @@ class ContinuousBatcher:
                 f"({max_new_tokens}) exceeds max_seq_len "
                 f"({cfg.max_seq_len})"
             )
-        p = _Pending(list(tokens), int(max_new_tokens), threading.Event())
+        p = _Pending(
+            list(tokens), int(max_new_tokens), threading.Event(), sink=sink
+        )
         with self._submit_lock:
             if self._closed:
                 raise RuntimeError("engine shutting down")
             self._queue.put(p)
+        return p
+
+    def submit(
+        self, tokens: list[int], max_new_tokens: int
+    ) -> list[int]:
+        p = self._enqueue(tokens, max_new_tokens)
         p.event.wait()
         if p.error is not None:
             raise p.error
         return p.result
+
+    def stream(self, tokens: list[int], max_new_tokens: int):
+        """Yield completion tokens AS THEY DECODE (one engine step of
+        latency each) instead of blocking for the full result.
+
+        Validation and enqueue happen EAGERLY, at the call (a plain
+        wrapper around an inner generator) — callers like the HTTP
+        streaming path must see bad-prompt ValueErrors before they
+        commit a 200 status to the wire. The generator raises if the
+        request fails mid-decode; closing it early does not cancel the
+        slot (the row runs out its budget — token-level cancellation
+        would need a host→loop signal the scheduler checks per step,
+        not worth it at this granularity)."""
+        p = self._enqueue(tokens, max_new_tokens, sink=queue.Queue())
+
+        def drain():
+            while True:
+                item = p.sink.get()
+                if item is True:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+
+        return drain()
 
     def stats(self) -> dict:
         """Scheduler observability (served at the HTTP ``/stats``
@@ -323,6 +373,7 @@ class ContinuousBatcher:
         out = [first]
         self._live[row] = (p, out)
         self.admitted += 1
+        p.emit(first)
         if self._finished(p, out, first):
             self._retire(row)
         return cache, tok, pos
@@ -336,13 +387,13 @@ class ContinuousBatcher:
         p, out = self._live[row]
         self._live[row] = None
         p.result = out
+        p.finish()
         p.event.set()
 
     def _fail_all(self, err: BaseException) -> None:
         for row, entry in enumerate(self._live):
             if entry is not None:
-                entry[0].error = err
-                entry[0].event.set()
+                entry[0].fail(err)
                 self._live[row] = None
         while True:
             try:
@@ -351,8 +402,7 @@ class ContinuousBatcher:
                 return
             if item is self._STOP:
                 continue
-            item.error = RuntimeError("engine shutting down")
-            item.event.set()
+            item.fail(RuntimeError("engine shutting down"))
 
     def _loop(self) -> None:
         cache = tok = pos = None
@@ -401,6 +451,7 @@ class ContinuousBatcher:
                     p, out = entry
                     t = int(host_tok[row])
                     out.append(t)
+                    p.emit(t)
                     if self._finished(p, out, t):
                         self._retire(row)
         except BaseException as e:  # noqa: BLE001 - ferry to waiters
@@ -411,7 +462,6 @@ class ContinuousBatcher:
             with self._submit_lock:
                 self._closed = True
             if self._inflight is not None:
-                self._inflight.error = e
-                self._inflight.event.set()
+                self._inflight.fail(e)
                 self._inflight = None
             self._fail_all(e)

@@ -151,6 +151,21 @@ class _Handler(BaseHTTPRequestHandler):
             return
         from tensorflowonspark_tpu.tools.generate_text import PromptError
 
+        stream = bool(payload.get("stream"))
+        if stream and self.gen_engine is None:
+            self._reply(
+                400,
+                {"error": "streaming requires --gen-engine continuous"},
+            )
+            return
+        if stream and len(prompts) != 1:
+            self._reply(
+                400, {"error": "streaming supports exactly one prompt"}
+            )
+            return
+        if stream:
+            self._engine_stream(prompts[0])
+            return
         try:
             if self.gen_engine is not None:
                 try:
@@ -177,6 +192,48 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
             return
         self._reply(200, {"completions": completions})
+
+    def _engine_stream(self, prompt) -> None:
+        """Stream one completion as newline-delimited JSON: a
+        ``{"token": t}`` line per decoded token (one engine step of
+        latency each), then a ``{"done": true, "completion": [...]}``
+        trailer. The response is close-delimited (no Content-Length);
+        a mid-stream failure surfaces as an ``{"error": ...}`` line
+        since the 200 status is already on the wire."""
+        try:
+            gen = self.gen_engine.stream(prompt, self.gen_max_new)
+        except ValueError as e:  # submit-side prompt validation
+            self._reply(400, {"error": str(e)})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        out: list = []
+        try:
+            for t in gen:
+                out.append(t)
+                self.wfile.write(
+                    json.dumps({"token": t}).encode() + b"\n"
+                )
+                self.wfile.flush()
+            self.wfile.write(
+                json.dumps({"done": True, "completion": out}).encode()
+                + b"\n"
+            )
+        except (BrokenPipeError, ConnectionResetError):
+            logger.info("stream client disconnected")
+        except Exception as e:  # noqa: BLE001 - status already sent
+            logger.exception("stream failed mid-decode")
+            try:
+                self.wfile.write(
+                    json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}
+                    ).encode()
+                    + b"\n"
+                )
+            except OSError:
+                pass
 
     def _engine_generate(self, prompts):
         """Continuous-batching path: each prompt row is its own engine

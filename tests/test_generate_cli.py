@@ -412,6 +412,37 @@ def test_serve_model_continuous_engine(tmp_path):
         assert stats["slots"] == 3
         assert stats["admitted"] == len(prompts) + 2
         assert stats["steps"] > 0 and not stats["closed"]
+
+        # streaming: NDJSON token lines + a done trailer matching the
+        # non-streamed completion for the same prompt
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(
+                {"prompts": [[1, 2, 3]], "stream": True}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            assert r.headers["Content-Type"] == "application/x-ndjson"
+            lines = [json.loads(l) for l in r.read().splitlines()]
+        want = np.asarray(
+            generate(model, params, jnp.asarray([[1, 2, 3]], jnp.int32), 5)
+        )[0].tolist()
+        assert [l["token"] for l in lines[:-1]] == want
+        assert lines[-1] == {"done": True, "completion": want}
+
+        # streaming guardrails: multi-prompt body is a 400, and an
+        # over-width prompt 400s BEFORE the 200/NDJSON commits (the
+        # engine validates at stream() call time, not first iteration)
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[1], [2]], "stream": True},
+        )
+        assert code == 400 and "one prompt" in body["error"]
+        code, body = _post(
+            port, "/generate", {"prompts": [[1] * 9], "stream": True}
+        )
+        assert code == 400 and "width" in body["error"]
     finally:
         server.shutdown()
 

@@ -134,6 +134,30 @@ def test_engine_loop_death_fails_waiters_not_hangs(tiny):
     eng.close()
 
 
+def test_engine_stream_yields_incrementally_and_matches_submit(tiny):
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=2, prompt_widths=(8,))
+    try:
+        want = eng.submit([1, 2, 3], 6)
+        # The stream is fed per decode step (emit happens inside the
+        # loop, before retire); consuming it lazily must reproduce the
+        # blocking submit's tokens exactly.
+        assert list(eng.stream([1, 2, 3], 6)) == want
+    finally:
+        eng.close()
+
+
+def test_engine_stream_failure_raises_in_consumer(tiny):
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=1, prompt_widths=(8,))
+    eng._prefill_fn = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("boom")
+    )
+    with pytest.raises(RuntimeError, match="boom"):
+        list(eng.stream([1, 2], 4))
+    eng.close()
+
+
 def test_engine_composes_with_int8_weights(tiny):
     """A quantize_tree'd param tree rides the engine unchanged (QDense
     consumes QuantTensor leaves natively) and matches generate() run on
