@@ -40,6 +40,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import math
 import queue
 import threading
 
@@ -52,11 +53,27 @@ from tensorflowonspark_tpu.models.llama import Llama, sample_logits
 logger = logging.getLogger(__name__)
 
 
+def _sample_rows(logits, key, temps, top_k, top_p):
+    """Per-row-temperature sampling over (B, vocab) logits.
+
+    ``temps`` (B,) is a TRACED input — per-request temperature costs no
+    recompilation (unlike top_k/top_p, whose shapes are static and stay
+    engine-wide). A row with ``temps == 0`` is greedy; a sampled row
+    truncates by the engine's top_k/top_p on its temperature-scaled
+    distribution (nucleus-on-scaled, matching the standard stacks).
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = sample_logits(scaled, key, 1.0, top_k, top_p)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
 @dataclasses.dataclass
 class _Pending:
     tokens: list[int]
     max_new_tokens: int
     event: threading.Event
+    temperature: float | None = None  # None = the engine-wide default
     result: list[int] | None = None
     error: BaseException | None = None
     # streaming: every emitted token is ALSO pushed here as it decodes,
@@ -83,9 +100,11 @@ class ContinuousBatcher:
 
     ``submit(tokens, max_new_tokens)`` blocks the calling thread until
     that request's completion is ready (server handler threads call it
-    concurrently). Greedy by default; ``temperature``/``top_k``/
-    ``top_p`` apply engine-wide (they are trace-time constants of the
-    compiled step).
+    concurrently). Greedy by default. ``temperature`` is PER-REQUEST
+    (the constructor value is just the default): it rides the compiled
+    step as a traced per-row input, so mixing greedy and sampled rows
+    in one batch costs no recompilation. ``top_k``/``top_p`` stay
+    engine-wide — their truncation shapes are trace-time constants.
 
     ``prompt_widths``: prompts are right-padded to the smallest listed
     width (one prefill compilation each). A prompt longer than the
@@ -151,11 +170,24 @@ class ContinuousBatcher:
     # -- public API ----------------------------------------------------
 
     def _enqueue(
-        self, tokens: list[int], max_new_tokens: int, sink=None
+        self,
+        tokens: list[int],
+        max_new_tokens: int,
+        sink=None,
+        temperature: float | None = None,
     ) -> _Pending:
         cfg = self._model.cfg
         if not tokens:
             raise ValueError("empty prompt")
+        if temperature is not None and not (
+            math.isfinite(temperature) and temperature >= 0
+        ):
+            # NaN fails every comparison, so a plain `< 0` guard would
+            # accept it and then silently decode greedy (NaN > 0 is
+            # False in the sampling select)
+            raise ValueError(
+                f"temperature must be finite and >= 0, got {temperature}"
+            )
         if len(tokens) > self._widths[-1]:
             raise ValueError(
                 f"prompt length {len(tokens)} exceeds the largest "
@@ -168,7 +200,11 @@ class ContinuousBatcher:
                 f"({cfg.max_seq_len})"
             )
         p = _Pending(
-            list(tokens), int(max_new_tokens), threading.Event(), sink=sink
+            list(tokens),
+            int(max_new_tokens),
+            threading.Event(),
+            temperature=temperature,
+            sink=sink,
         )
         with self._submit_lock:
             if self._closed:
@@ -177,15 +213,26 @@ class ContinuousBatcher:
         return p
 
     def submit(
-        self, tokens: list[int], max_new_tokens: int
+        self,
+        tokens: list[int],
+        max_new_tokens: int,
+        temperature: float | None = None,
     ) -> list[int]:
-        p = self._enqueue(tokens, max_new_tokens)
+        """Blocking decode. ``temperature`` overrides the engine-wide
+        default FOR THIS REQUEST (a traced per-row input — no
+        recompilation; 0 = greedy). top_k/top_p stay engine-wide."""
+        p = self._enqueue(tokens, max_new_tokens, temperature=temperature)
         p.event.wait()
         if p.error is not None:
             raise p.error
         return p.result
 
-    def stream(self, tokens: list[int], max_new_tokens: int):
+    def stream(
+        self,
+        tokens: list[int],
+        max_new_tokens: int,
+        temperature: float | None = None,
+    ):
         """Yield completion tokens AS THEY DECODE (one engine step of
         latency each) instead of blocking for the full result.
 
@@ -197,7 +244,12 @@ class ContinuousBatcher:
         slot (the row runs out its budget — token-level cancellation
         would need a host→loop signal the scheduler checks per step,
         not worth it at this granularity)."""
-        p = self._enqueue(tokens, max_new_tokens, sink=queue.Queue())
+        p = self._enqueue(
+            tokens,
+            max_new_tokens,
+            sink=queue.Queue(),
+            temperature=temperature,
+        )
 
         def drain():
             while True:
@@ -236,15 +288,11 @@ class ContinuousBatcher:
 
     @functools.cached_property
     def _step_fn(self):
-        temperature, top_k, top_p = (
-            self._temperature,
-            self._top_k,
-            self._top_p,
-        )
+        top_k, top_p = self._top_k, self._top_p
         model = self._model
 
         @jax.jit
-        def step(params, cache, tok, pos, key):
+        def step(params, cache, tok, pos, temps, key):
             logits, updated = model.apply(
                 {"params": params, "cache": cache},
                 tok[:, None],
@@ -253,9 +301,7 @@ class ContinuousBatcher:
                 padded=True,
                 mutable=["cache"],
             )
-            nxt = sample_logits(
-                logits[:, -1], key, temperature, top_k, top_p
-            )
+            nxt = _sample_rows(logits[:, -1], key, temps, top_k, top_p)
             # Clamp so a retired-but-not-yet-reused row parked at the
             # cache edge never scatters out of bounds (its writes are
             # garbage either way; admission overwrites the whole row).
@@ -271,15 +317,11 @@ class ContinuousBatcher:
         cached = self._prefill_cache.get(width)
         if cached is not None:
             return cached
-        temperature, top_k, top_p = (
-            self._temperature,
-            self._top_k,
-            self._top_p,
-        )
+        top_k, top_p = self._top_k, self._top_p
         model = self._model
 
         @jax.jit
-        def prefill(params, prompt, length, key):
+        def prefill(params, prompt, length, temps, key):
             positions = jnp.arange(width, dtype=jnp.int32)[None, :]
             logits, state = model.apply(
                 {"params": params},
@@ -292,7 +334,7 @@ class ContinuousBatcher:
             last = jnp.take_along_axis(
                 logits, (length - 1)[:, None, None], axis=1
             )[:, 0]
-            tok = sample_logits(last, key, temperature, top_k, top_p)
+            tok = _sample_rows(last, key, temps, top_k, top_p)
             return state["cache"], tok, length
 
         self._prefill_cache[width] = prefill
@@ -301,7 +343,10 @@ class ContinuousBatcher:
     @functools.cached_property
     def _admit_fn(self):
         @jax.jit
-        def admit(cache_b, cache_1, row, tok_b, tok_1, pos_b, pos_1):
+        def admit(
+            cache_b, cache_1, row, tok_b, tok_1, pos_b, pos_1,
+            temps_b, temp_1,
+        ):
             def scatter(leaf_b, leaf_1):
                 if leaf_b.ndim == 0:  # per-layer scalar write index:
                     return leaf_b  # unused on the padded decode path
@@ -313,7 +358,8 @@ class ContinuousBatcher:
             cache = jax.tree.map(scatter, cache_b, cache_1)
             tok = jax.lax.dynamic_update_slice(tok_b, tok_1, (row,))
             pos = jax.lax.dynamic_update_slice(pos_b, pos_1, (row,))
-            return cache, tok, pos
+            temps = jax.lax.dynamic_update_slice(temps_b, temp_1, (row,))
+            return cache, tok, pos, temps
 
         return admit
 
@@ -344,7 +390,8 @@ class ContinuousBatcher:
         # their K/V writes stay inside their row and are overwritten on
         # admission.
         pos = jnp.zeros((b,), jnp.int32)
-        return cache, tok, pos
+        temps = jnp.zeros((b,), jnp.float32)
+        return cache, tok, pos, temps
 
     def _bucket(self, n: int) -> int:
         for w in self._widths:
@@ -356,18 +403,26 @@ class ContinuousBatcher:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _admit_one(self, p: _Pending, row: int, cache, tok, pos):
+    def _admit_one(self, p: _Pending, row: int, cache, tok, pos, temps):
         w = self._bucket(len(p.tokens))
         prompt = np.zeros((1, w), np.int32)
         prompt[0, : len(p.tokens)] = p.tokens
+        temp = (
+            self._temperature
+            if p.temperature is None
+            else float(p.temperature)
+        )
+        temp_1 = jnp.asarray([temp], jnp.float32)
         cache_1, tok_1, pos_1 = self._prefill_fn(w)(
             self._params,
             jnp.asarray(prompt),
             jnp.asarray([len(p.tokens)], jnp.int32),
+            temp_1,
             self._next_key(),
         )
-        cache, tok, pos = self._admit_fn(
-            cache, cache_1, jnp.int32(row), tok, tok_1, pos, pos_1
+        cache, tok, pos, temps = self._admit_fn(
+            cache, cache_1, jnp.int32(row), tok, tok_1, pos, pos_1,
+            temps, temp_1,
         )
         first = int(np.asarray(tok_1)[0])
         out = [first]
@@ -376,7 +431,7 @@ class ContinuousBatcher:
         p.emit(first)
         if self._finished(p, out, first):
             self._retire(row)
-        return cache, tok, pos
+        return cache, tok, pos, temps
 
     def _finished(self, p: _Pending, out: list[int], last: int) -> bool:
         return len(out) >= p.max_new_tokens or (
@@ -405,7 +460,7 @@ class ContinuousBatcher:
             item.fail(RuntimeError("engine shutting down"))
 
     def _loop(self) -> None:
-        cache = tok = pos = None
+        cache = tok = pos = temps = None
         try:
             while True:
                 idle = all(e is None for e in self._live)
@@ -430,9 +485,9 @@ class ContinuousBatcher:
                         return
                     self._inflight = item
                     if cache is None:
-                        cache, tok, pos = self._empty_state()
-                    cache, tok, pos = self._admit_one(
-                        item, free[0], cache, tok, pos
+                        cache, tok, pos, temps = self._empty_state()
+                    cache, tok, pos, temps = self._admit_one(
+                        item, free[0], cache, tok, pos, temps
                     )
                     self._inflight = None
                     idle = all(e is None for e in self._live)
@@ -441,7 +496,7 @@ class ContinuousBatcher:
                     continue  # retired on admission; go block again
 
                 cache, tok, pos = self._step_fn(
-                    self._params, cache, tok, pos, self._next_key()
+                    self._params, cache, tok, pos, temps, self._next_key()
                 )
                 self.steps += 1
                 host_tok = np.asarray(tok)

@@ -146,6 +146,15 @@ class _Handler(BaseHTTPRequestHandler):
             prompts = [[int(t) for t in p] for p in prompts]
             if any(not p for p in prompts):
                 raise ValueError("prompts must be non-empty token lists")
+            temperature = payload.get("temperature")
+            if temperature is not None:
+                temperature = float(temperature)
+                if self.gen_engine is None:
+                    raise ValueError(
+                        "per-request temperature requires --gen-engine "
+                        "continuous (the fixed path bakes sampling "
+                        "params at startup)"
+                    )
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             self._reply(400, {"error": str(e)})
             return
@@ -164,12 +173,14 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         if stream:
-            self._engine_stream(prompts[0])
+            self._engine_stream(prompts[0], temperature)
             return
         try:
             if self.gen_engine is not None:
                 try:
-                    completions = self._engine_generate(prompts)
+                    completions = self._engine_generate(
+                        prompts, temperature
+                    )
                 except ValueError as e:
                     # the engine's submit-side prompt validation (width/
                     # budget) — client fault, like PromptError below; a
@@ -193,7 +204,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._reply(200, {"completions": completions})
 
-    def _engine_stream(self, prompt) -> None:
+    def _engine_stream(self, prompt, temperature=None) -> None:
         """Stream one completion as newline-delimited JSON: a
         ``{"token": t}`` line per decoded token (one engine step of
         latency each), then a ``{"done": true, "completion": [...]}``
@@ -201,7 +212,9 @@ class _Handler(BaseHTTPRequestHandler):
         a mid-stream failure surfaces as an ``{"error": ...}`` line
         since the 200 status is already on the wire."""
         try:
-            gen = self.gen_engine.stream(prompt, self.gen_max_new)
+            gen = self.gen_engine.stream(
+                prompt, self.gen_max_new, temperature=temperature
+            )
         except ValueError as e:  # submit-side prompt validation
             self._reply(400, {"error": str(e)})
             return
@@ -235,20 +248,22 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass
 
-    def _engine_generate(self, prompts):
+    def _engine_generate(self, prompts, temperature=None):
         """Continuous-batching path: each prompt row is its own engine
         request, so a multi-row request's rows decode concurrently and
         rows from OTHER requests interleave freely — no convoying. The
         handler thread fans out one thread per extra row and joins."""
         eng, budget = self.gen_engine, self.gen_max_new
         if len(prompts) == 1:
-            return [eng.submit(prompts[0], budget)]
+            return [eng.submit(prompts[0], budget, temperature=temperature)]
         results: list = [None] * len(prompts)
         errors: list = [None] * len(prompts)
 
         def one(i):
             try:
-                results[i] = eng.submit(prompts[i], budget)
+                results[i] = eng.submit(
+                    prompts[i], budget, temperature=temperature
+                )
             except BaseException as e:  # noqa: BLE001 - re-raised below
                 errors[i] = e
 

@@ -177,6 +177,37 @@ def test_engine_composes_with_int8_weights(tiny):
         eng.close()
 
 
+def test_engine_per_request_temperature(tiny):
+    """temperature is per-request (a traced per-row input): a greedy
+    (temp=0) request decodes its exact generate() tokens even while a
+    sampled request shares the batch; a sampled request produces valid
+    tokens; invalid temperature is rejected."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=2, prompt_widths=(8,))
+    try:
+        results = {}
+
+        def greedy():
+            results["g"] = eng.submit([1, 2, 3], 8, temperature=0.0)
+
+        def sampled():
+            results["s"] = eng.submit([4, 5], 8, temperature=1.3)
+
+        tg, ts = threading.Thread(target=greedy), threading.Thread(
+            target=sampled
+        )
+        tg.start(), ts.start()
+        tg.join(120), ts.join(120)
+        want = _reference(model, params, [1, 2, 3], 8)
+        assert results["g"] == want
+        assert len(results["s"]) == 8
+        assert all(0 <= t < cfg.vocab_size for t in results["s"])
+        with pytest.raises(ValueError, match="temperature"):
+            eng.submit([1], 2, temperature=-0.5)
+    finally:
+        eng.close()
+
+
 def test_engine_sampled_mode_runs(tiny):
     cfg, model, params = tiny
     eng = ContinuousBatcher(
