@@ -43,6 +43,7 @@ import logging
 import math
 import queue
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -74,6 +75,9 @@ class _Pending:
     max_new_tokens: int
     event: threading.Event
     temperature: float | None = None  # None = the engine-wide default
+    eos_id: int | None = None  # None = the engine-wide default
+    submitted_at: float = 0.0  # time.monotonic() at enqueue
+    first_token_at: float | None = None  # set when token 0 emits
     result: list[int] | None = None
     error: BaseException | None = None
     # streaming: every emitted token is ALSO pushed here as it decodes,
@@ -81,6 +85,8 @@ class _Pending:
     sink: "queue.Queue | None" = None
 
     def emit(self, token: int) -> None:
+        if self.first_token_at is None:
+            self.first_token_at = time.monotonic()
         if self.sink is not None:
             self.sink.put(token)
 
@@ -161,6 +167,10 @@ class ContinuousBatcher:
         ] * self._slots
         self.steps = 0  # observability: engine decode steps taken
         self.admitted = 0
+        self.completed = 0
+        self.tokens_emitted = 0
+        self._ttft_sum = 0.0  # seconds, summed over completed requests
+        self._duration_sum = 0.0
 
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="continuous-batcher"
@@ -175,10 +185,15 @@ class ContinuousBatcher:
         max_new_tokens: int,
         sink=None,
         temperature: float | None = None,
+        eos_id: int | None = None,
     ) -> _Pending:
         cfg = self._model.cfg
         if not tokens:
             raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
         if temperature is not None and not (
             math.isfinite(temperature) and temperature >= 0
         ):
@@ -204,6 +219,8 @@ class ContinuousBatcher:
             int(max_new_tokens),
             threading.Event(),
             temperature=temperature,
+            eos_id=eos_id,
+            submitted_at=time.monotonic(),
             sink=sink,
         )
         with self._submit_lock:
@@ -217,11 +234,16 @@ class ContinuousBatcher:
         tokens: list[int],
         max_new_tokens: int,
         temperature: float | None = None,
+        eos_id: int | None = None,
     ) -> list[int]:
-        """Blocking decode. ``temperature`` overrides the engine-wide
-        default FOR THIS REQUEST (a traced per-row input — no
-        recompilation; 0 = greedy). top_k/top_p stay engine-wide."""
-        p = self._enqueue(tokens, max_new_tokens, temperature=temperature)
+        """Blocking decode. ``temperature`` and ``eos_id`` override the
+        engine-wide defaults FOR THIS REQUEST (temperature is a traced
+        per-row input — no recompilation; 0 = greedy; eos is host-side
+        retirement bookkeeping, a NEGATIVE value disables EOS stopping
+        entirely for this request). top_k/top_p stay engine-wide."""
+        p = self._enqueue(
+            tokens, max_new_tokens, temperature=temperature, eos_id=eos_id
+        )
         p.event.wait()
         if p.error is not None:
             raise p.error
@@ -232,6 +254,7 @@ class ContinuousBatcher:
         tokens: list[int],
         max_new_tokens: int,
         temperature: float | None = None,
+        eos_id: int | None = None,
     ):
         """Yield completion tokens AS THEY DECODE (one engine step of
         latency each) instead of blocking for the full result.
@@ -249,6 +272,7 @@ class ContinuousBatcher:
             max_new_tokens,
             sink=queue.Queue(),
             temperature=temperature,
+            eos_id=eos_id,
         )
 
         def drain():
@@ -266,12 +290,22 @@ class ContinuousBatcher:
         """Scheduler observability (served at the HTTP ``/stats``
         endpoint): slot occupancy, queue depth, lifetime counters."""
         busy = sum(e is not None for e in self._live)
+        done = self.completed
         return {
             "slots": self._slots,
             "slots_busy": busy,
             "queue_depth": self._queue.qsize(),
             "steps": self.steps,
             "admitted": self.admitted,
+            "completed": done,
+            "tokens_emitted": self.tokens_emitted,
+            # queue wait + prefill, averaged over completed requests
+            "ttft_avg_ms": round(self._ttft_sum / done * 1e3, 3)
+            if done
+            else None,
+            "request_avg_ms": round(self._duration_sum / done * 1e3, 3)
+            if done
+            else None,
             "closed": self._closed,
         }
 
@@ -434,13 +468,29 @@ class ContinuousBatcher:
         return cache, tok, pos, temps
 
     def _finished(self, p: _Pending, out: list[int], last: int) -> bool:
+        # Per-request eos: None = engine default; negative = DISABLED
+        # (run the full budget even when the engine has a default eos —
+        # None can't express that, it IS the use-the-default sentinel).
+        if p.eos_id is None:
+            eos = self._eos_id
+        else:
+            eos = None if p.eos_id < 0 else p.eos_id
         return len(out) >= p.max_new_tokens or (
-            self._eos_id is not None and last == self._eos_id
+            eos is not None and last == eos
         )
 
     def _retire(self, row: int) -> None:
         p, out = self._live[row]
         self._live[row] = None
+        now = time.monotonic()
+        self.tokens_emitted += len(out)
+        if p.first_token_at is not None:
+            self._ttft_sum += p.first_token_at - p.submitted_at
+        self._duration_sum += now - p.submitted_at
+        # Incremented LAST: stats() divides the sums by this count from
+        # another thread, and a count that runs ahead of its sums would
+        # fabricate zero/low latency averages.
+        self.completed += 1
         p.result = out
         p.finish()
         p.event.set()

@@ -147,14 +147,30 @@ class _Handler(BaseHTTPRequestHandler):
             if any(not p for p in prompts):
                 raise ValueError("prompts must be non-empty token lists")
             temperature = payload.get("temperature")
+            max_new = payload.get("max_new_tokens")
+            eos_id = payload.get("eos_id")
+            if (
+                temperature is not None
+                or max_new is not None
+                or eos_id is not None
+            ) and self.gen_engine is None:
+                raise ValueError(
+                    "per-request temperature/max_new_tokens/eos_id "
+                    "require --gen-engine continuous (the fixed path "
+                    "bakes decode params at startup)"
+                )
             if temperature is not None:
                 temperature = float(temperature)
-                if self.gen_engine is None:
+            if max_new is not None:
+                max_new = int(max_new)
+                if not 1 <= max_new <= self.gen_max_new:
                     raise ValueError(
-                        "per-request temperature requires --gen-engine "
-                        "continuous (the fixed path bakes sampling "
-                        "params at startup)"
+                        f"max_new_tokens must be in [1, "
+                        f"{self.gen_max_new}] (the server's configured "
+                        f"budget), got {max_new}"
                     )
+            if eos_id is not None:
+                eos_id = int(eos_id)
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             self._reply(400, {"error": str(e)})
             return
@@ -173,13 +189,13 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         if stream:
-            self._engine_stream(prompts[0], temperature)
+            self._engine_stream(prompts[0], temperature, max_new, eos_id)
             return
         try:
             if self.gen_engine is not None:
                 try:
                     completions = self._engine_generate(
-                        prompts, temperature
+                        prompts, temperature, max_new, eos_id
                     )
                 except ValueError as e:
                     # the engine's submit-side prompt validation (width/
@@ -204,7 +220,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._reply(200, {"completions": completions})
 
-    def _engine_stream(self, prompt, temperature=None) -> None:
+    def _engine_stream(
+        self, prompt, temperature=None, max_new=None, eos_id=None
+    ) -> None:
         """Stream one completion as newline-delimited JSON: a
         ``{"token": t}`` line per decoded token (one engine step of
         latency each), then a ``{"done": true, "completion": [...]}``
@@ -213,7 +231,10 @@ class _Handler(BaseHTTPRequestHandler):
         since the 200 status is already on the wire."""
         try:
             gen = self.gen_engine.stream(
-                prompt, self.gen_max_new, temperature=temperature
+                prompt,
+                max_new or self.gen_max_new,
+                temperature=temperature,
+                eos_id=eos_id,
             )
         except ValueError as e:  # submit-side prompt validation
             self._reply(400, {"error": str(e)})
@@ -248,21 +269,30 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass
 
-    def _engine_generate(self, prompts, temperature=None):
+    def _engine_generate(
+        self, prompts, temperature=None, max_new=None, eos_id=None
+    ):
         """Continuous-batching path: each prompt row is its own engine
         request, so a multi-row request's rows decode concurrently and
         rows from OTHER requests interleave freely — no convoying. The
         handler thread fans out one thread per extra row and joins."""
-        eng, budget = self.gen_engine, self.gen_max_new
+        eng = self.gen_engine
+        budget = max_new or self.gen_max_new
         if len(prompts) == 1:
-            return [eng.submit(prompts[0], budget, temperature=temperature)]
+            return [
+                eng.submit(
+                    prompts[0], budget,
+                    temperature=temperature, eos_id=eos_id,
+                )
+            ]
         results: list = [None] * len(prompts)
         errors: list = [None] * len(prompts)
 
         def one(i):
             try:
                 results[i] = eng.submit(
-                    prompts[i], budget, temperature=temperature
+                    prompts[i], budget,
+                    temperature=temperature, eos_id=eos_id,
                 )
             except BaseException as e:  # noqa: BLE001 - re-raised below
                 errors[i] = e

@@ -431,6 +431,22 @@ def test_serve_model_continuous_engine(tmp_path):
         assert [l["token"] for l in lines[:-1]] == want
         assert lines[-1] == {"done": True, "completion": want}
 
+        # per-request decode budget (capped by the server's config)
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[1, 2, 3]], "max_new_tokens": 2},
+        )
+        assert code == 200
+        want = np.asarray(
+            generate(model, params, jnp.asarray([[1, 2, 3]], jnp.int32), 2)
+        )[0].tolist()
+        assert body["completions"] == [want]
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[1]], "max_new_tokens": 99},
+        )
+        assert code == 400 and "budget" in body["error"]
+
         # streaming guardrails: multi-prompt body is a 400, and an
         # over-width prompt 400s BEFORE the 200/NDJSON commits (the
         # engine validates at stream() call time, not first iteration)

@@ -98,6 +98,10 @@ def test_engine_eos_retires_early(tiny):
     try:
         got = eng.submit([5, 6], 50)
         assert got == [ref[0]]
+        # a NEGATIVE per-request eos disables the engine default: the
+        # request runs out its full budget instead of stopping at token 0
+        full = eng.submit([5, 6], 4, eos_id=-1)
+        assert full == _reference(model, params, [5, 6], 4)
     finally:
         eng.close()
 
@@ -173,6 +177,37 @@ def test_engine_composes_with_int8_weights(tiny):
             generate(model, qparams, jnp.asarray([[1, 2, 3]], jnp.int32), 5)
         )[0].tolist()
         assert got == want
+    finally:
+        eng.close()
+
+
+def test_engine_per_request_eos_and_budget(tiny):
+    """eos_id and max_new_tokens are per-request (host-side retirement
+    bookkeeping): one request stops at ITS eos while another with no eos
+    runs out its own budget, in the same batch."""
+    cfg, model, params = tiny
+    ref = _reference(model, params, [5, 6], 8)
+    eng = ContinuousBatcher(model, params, slots=2, prompt_widths=(8,))
+    try:
+        results = {}
+        t1 = threading.Thread(
+            target=lambda: results.update(
+                a=eng.submit([5, 6], 8, eos_id=ref[2])
+            )
+        )
+        t2 = threading.Thread(
+            target=lambda: results.update(b=eng.submit([5, 6], 8))
+        )
+        t1.start(), t2.start()
+        t1.join(120), t2.join(120)
+        assert results["a"] == ref[:3]  # stopped at its own eos
+        assert results["b"] == ref  # full budget, no eos
+        s = eng.stats()
+        assert s["completed"] == 2
+        assert s["tokens_emitted"] == 3 + 8
+        assert s["ttft_avg_ms"] is not None and s["ttft_avg_ms"] > 0
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit([1], 0)
     finally:
         eng.close()
 
