@@ -478,19 +478,39 @@ def _build_engine(gen: dict):
         )
     )
     model = Llama(cfg)
-    params = _load_params(gen["checkpoint"], cfg)
-    width = int(gen.get("width", 128))
     max_new = int(gen.get("max_new_tokens", 64))
-    if width + max_new > cfg.max_seq_len:
+    raw_widths = gen.get("widths")
+    if raw_widths:
+        # --gen-widths replaces --gen-width entirely; validate at
+        # startup like every other shape parameter (a 0-width bucket
+        # would start fine and then reject every request).
+        try:
+            widths = tuple(int(w) for w in str(raw_widths).split(","))
+        except ValueError:
+            raise ValueError(
+                f"--gen-widths must be a CSV of integers, got "
+                f"{raw_widths!r}"
+            ) from None
+        if not widths or any(w < 1 for w in widths):
+            raise ValueError(
+                f"--gen-widths buckets must be >= 1, got {raw_widths!r}"
+            )
+    else:
+        widths = (int(gen.get("width", 128)),)
+    if max(widths) + max_new > cfg.max_seq_len:
         raise ValueError(
-            f"--gen-width ({width}) + --max-new-tokens ({max_new}) "
-            f"exceeds max_seq_len ({cfg.max_seq_len})"
+            f"largest prompt-width bucket ({max(widths)}) + "
+            f"--max-new-tokens ({max_new}) exceeds max_seq_len "
+            f"({cfg.max_seq_len})"
         )
+    # Cheap shape validation above happens BEFORE the (potentially
+    # multi-GB) checkpoint restore, same policy as the draft path.
+    params = _load_params(gen["checkpoint"], cfg)
     engine = ContinuousBatcher(
         model,
         params,
         slots=int(gen.get("slots") or gen.get("batch_size", 8)),
-        prompt_widths=(width,),
+        prompt_widths=widths,
         temperature=float(gen.get("temperature", 0.0)),
         top_k=gen.get("top_k"),
         top_p=gen.get("top_p"),
@@ -763,6 +783,14 @@ def main(argv: list[str] | None = None) -> int:
         help="continuous engine KV-cache slots (default: "
         "--gen-batch-size)",
     )
+    p.add_argument(
+        "--gen-widths",
+        default=None,
+        help="continuous engine prompt-width buckets as a CSV (e.g. "
+        "'32,128'): each prompt prefills at the smallest bucket that "
+        "fits, one compilation per bucket (default: one bucket of "
+        "--gen-width)",
+    )
     args = p.parse_args(argv)
     if args.export_dir is None and args.llama_checkpoint is None:
         p.error("need --export-dir and/or --llama-checkpoint")
@@ -789,6 +817,7 @@ def main(argv: list[str] | None = None) -> int:
             spec_k=args.spec_k,
             engine=args.gen_engine,
             slots=args.gen_slots,
+            widths=args.gen_widths,
         )
     server = make_server(
         args.export_dir, args.port, args.batch_size, host=args.host, gen=gen

@@ -106,6 +106,20 @@ def test_engine_eos_retires_early(tiny):
         eng.close()
 
 
+def test_engine_multi_width_buckets(tiny):
+    """Prompts prefill at the smallest bucket that fits; decode output
+    is bucket-invariant (the padding slots past the true length are
+    never attended before being overwritten)."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=2, prompt_widths=(4, 8))
+    try:
+        for p in ([1, 2, 3], [1, 2, 3, 4, 5, 6]):
+            assert eng.submit(p, 5) == _reference(model, params, p, 5)
+        assert set(eng._prefill_cache) == {4, 8}  # one compile each
+    finally:
+        eng.close()
+
+
 def test_engine_validates_and_shutdown(tiny):
     cfg, model, params = tiny
     eng = ContinuousBatcher(model, params, slots=1, prompt_widths=(4,))
