@@ -132,9 +132,59 @@ class ContinuousBatcher:
         top_p: float | None = None,
         eos_id: int | None = None,
         seed: int = 0,
+        mesh=None,
     ):
         cfg = model.cfg
         self._model = model
+        self._mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            from tensorflowonspark_tpu.models.llama import (
+                llama_param_shardings,
+            )
+
+            tp = mesh.shape.get("model", 1)
+            if cfg.num_heads % tp or cfg.num_kv_heads % tp:
+                raise ValueError(
+                    f"heads ({cfg.num_heads}/{cfg.num_kv_heads} kv) not "
+                    f"divisible by the mesh 'model' extent {tp}"
+                )
+            other = {
+                ax: n
+                for ax, n in mesh.shape.items()
+                if ax != "model" and n > 1
+            }
+            if other:
+                # Row-wise admission keeps the batch axis UNSHARDED, so
+                # non-'model' extents only replicate the computation —
+                # correct but wasted chips for a serving engine.
+                logger.warning(
+                    "continuous engine shards TP on 'model' only; mesh "
+                    "axes %s replicate work rather than adding "
+                    "throughput",
+                    other,
+                )
+
+            def keep(ax):
+                if isinstance(ax, (tuple, list)):  # multi-axis dim
+                    kept = tuple(a for a in ax if a == "model")
+                    return kept[0] if kept else None
+                return ax if ax == "model" else None
+
+            def tp_only(sh: NamedSharding) -> NamedSharding:
+                # Keep ONLY the 'model' (TP) placement; the training
+                # rules also shard on 'fsdp', which with a replicated
+                # batch would force a weight all-gather on every
+                # per-token decode step.
+                return NamedSharding(mesh, P(*(keep(ax) for ax in sh.spec)))
+
+            params = jax.device_put(
+                params,
+                jax.tree.map(
+                    tp_only, llama_param_shardings(params, mesh)
+                ),
+            )
         self._params = params
         self._slots = int(slots)
         self._widths = tuple(sorted(int(w) for w in prompt_widths))
@@ -320,10 +370,31 @@ class ContinuousBatcher:
 
     # -- compiled pieces ----------------------------------------------
 
+    def _constrain_cache(self, cache):
+        """Pin KV-cache leaves to the engine's TP sharding (heads on
+        'model', batch replicated) at every compiled-program boundary,
+        so sharding propagation can't drift to a layout whose per-step
+        all-gathers would swamp the HBM-bound decode. No-op without a
+        mesh."""
+        if self._mesh is None:
+            return cache
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def spec(x):
+            return P(None, None, "model", None) if x.ndim == 4 else P()
+
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(self._mesh, spec(x))
+            ),
+            cache,
+        )
+
     @functools.cached_property
     def _step_fn(self):
         top_k, top_p = self._top_k, self._top_p
         model = self._model
+        constrain = self._constrain_cache
 
         @jax.jit
         def step(params, cache, tok, pos, temps, key):
@@ -340,7 +411,7 @@ class ContinuousBatcher:
             # cache edge never scatters out of bounds (its writes are
             # garbage either way; admission overwrites the whole row).
             nxt_pos = jnp.minimum(pos + 1, model.cfg.max_seq_len - 1)
-            return updated["cache"], nxt, nxt_pos
+            return constrain(updated["cache"]), nxt, nxt_pos
 
         return step
 
@@ -353,6 +424,7 @@ class ContinuousBatcher:
             return cached
         top_k, top_p = self._top_k, self._top_p
         model = self._model
+        constrain = self._constrain_cache
 
         @jax.jit
         def prefill(params, prompt, length, temps, key):
@@ -369,13 +441,15 @@ class ContinuousBatcher:
                 logits, (length - 1)[:, None, None], axis=1
             )[:, 0]
             tok = _sample_rows(last, key, temps, top_k, top_p)
-            return state["cache"], tok, length
+            return constrain(state["cache"]), tok, length
 
         self._prefill_cache[width] = prefill
         return prefill
 
     @functools.cached_property
     def _admit_fn(self):
+        constrain = self._constrain_cache
+
         @jax.jit
         def admit(
             cache_b, cache_1, row, tok_b, tok_1, pos_b, pos_1,
@@ -389,7 +463,7 @@ class ContinuousBatcher:
                     leaf_b, leaf_1.astype(leaf_b.dtype), start
                 )
 
-            cache = jax.tree.map(scatter, cache_b, cache_1)
+            cache = constrain(jax.tree.map(scatter, cache_b, cache_1))
             tok = jax.lax.dynamic_update_slice(tok_b, tok_1, (row,))
             pos = jax.lax.dynamic_update_slice(pos_b, pos_1, (row,))
             temps = jax.lax.dynamic_update_slice(temps_b, temp_1, (row,))

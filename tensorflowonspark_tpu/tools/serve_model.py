@@ -448,6 +448,20 @@ class _GenBatcher:
                 slot["event"].set()
 
 
+def _parse_gen_mesh(gen: dict):
+    """Build the --gen-mesh device mesh (or None) — one parser for the
+    fixed-batch and continuous-engine paths so axis handling cannot
+    diverge between them."""
+    if not gen.get("mesh"):
+        return None
+    from tensorflowonspark_tpu.compute.mesh import (
+        make_mesh,
+        parse_axis_spec,
+    )
+
+    return make_mesh(parse_axis_spec(gen["mesh"]))
+
+
 def _build_engine(gen: dict):
     """Build the continuous-batching engine for ``--gen-engine
     continuous``: one persistent slot-based decode loop instead of the
@@ -464,7 +478,6 @@ def _build_engine(gen: dict):
     for bad, flag in (
         ("batch_window", "--gen-batch-window"),
         ("draft_checkpoint", "--draft-checkpoint"),
-        ("mesh", "--gen-mesh"),
     ):
         if gen.get(bad):
             raise ValueError(
@@ -503,6 +516,7 @@ def _build_engine(gen: dict):
             f"--max-new-tokens ({max_new}) exceeds max_seq_len "
             f"({cfg.max_seq_len})"
         )
+    mesh = _parse_gen_mesh(gen)
     # Cheap shape validation above happens BEFORE the (potentially
     # multi-GB) checkpoint restore, same policy as the draft path.
     params = _load_params(gen["checkpoint"], cfg)
@@ -516,6 +530,7 @@ def _build_engine(gen: dict):
         top_p=gen.get("top_p"),
         eos_id=gen.get("eos_id"),
         seed=int(gen.get("seed", 0)),
+        mesh=mesh,
     )
     return engine, max_new
 
@@ -590,14 +605,8 @@ def _build_gen_fn(gen: dict):
             Llama(dcfg),
             _load_params(gen["draft_checkpoint"], dcfg),
         )
-    mesh = None
-    if gen.get("mesh"):
-        from tensorflowonspark_tpu.compute.mesh import (
-            make_mesh,
-            parse_axis_spec,
-        )
-
-        mesh = make_mesh(parse_axis_spec(gen["mesh"]))
+    mesh = _parse_gen_mesh(gen)
+    if mesh is not None:
         if bsz % mesh.shape["data"]:
             raise ValueError(
                 f"--gen-batch-size ({bsz}) must be divisible by the "
@@ -773,8 +782,10 @@ def main(argv: list[str] | None = None) -> int:
         default="fixed",
         help="'continuous' = slot-based continuous batching: requests "
         "join/leave a persistent decode loop at token granularity "
-        "(no convoying behind a batch window); incompatible with "
-        "--gen-batch-window/--draft-checkpoint/--gen-mesh",
+        "(no convoying behind a batch window); composes with "
+        "--gen-mesh for TP serving (the 'model' axis; other axes only "
+        "replicate) but not with "
+        "--gen-batch-window/--draft-checkpoint",
     )
     p.add_argument(
         "--gen-slots",

@@ -468,7 +468,6 @@ def test_serve_model_continuous_engine(tmp_path):
     for bad in (
         dict(batch_window=0.2),
         dict(draft_checkpoint=ckpt_dir),
-        dict(mesh="data=1,model=1"),
     ):
         with _pytest.raises(ValueError, match="does not compose"):
             serve_model.make_server(None, port=0, gen={**gen, **bad})

@@ -106,6 +106,32 @@ def test_engine_eos_retires_early(tiny):
         eng.close()
 
 
+def test_engine_tp_mesh_token_identical(tiny):
+    """TP-sharded engine (weights on 'model', KV heads sharded, batch
+    replicated) on the 8-device virtual mesh decodes token-identically
+    to the unsharded engine — the 7B-serving composition (TP for HBM +
+    continuous batching) in miniature."""
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+
+    cfg, model, params = tiny
+    mesh = make_mesh({"data": 4, "model": 2})
+    plain = ContinuousBatcher(model, params, slots=2, prompt_widths=(8,))
+    tp = ContinuousBatcher(
+        model, params, slots=2, prompt_widths=(8,), mesh=mesh
+    )
+    try:
+        for p in ([1, 2, 3], [4, 5, 6, 7], [9]):
+            assert tp.submit(p, 5) == plain.submit(p, 5), p
+        # weights must be TP-only: any 'fsdp'/'data' placement would
+        # all-gather the weights on every per-token step
+        for leaf in jax.tree_util.tree_leaves(tp._params):
+            for ax in leaf.sharding.spec:
+                assert ax in (None, "model"), leaf.sharding.spec
+    finally:
+        plain.close()
+        tp.close()
+
+
 def test_engine_multi_width_buckets(tiny):
     """Prompts prefill at the smallest bucket that fits; decode output
     is bucket-invariant (the padding slots past the true length are
