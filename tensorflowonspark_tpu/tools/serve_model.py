@@ -465,9 +465,10 @@ def _parse_gen_mesh(gen: dict):
 def _build_engine(gen: dict):
     """Build the continuous-batching engine for ``--gen-engine
     continuous``: one persistent slot-based decode loop instead of the
-    fixed-batch gen_fn. Incompatible with the fixed-batch-only options
-    (coalescing window, speculative draft, mesh decode) — reject at
-    startup, not on the first request."""
+    fixed-batch gen_fn. Composes with ``--gen-mesh`` (TP on 'model';
+    other axes replicate). Incompatible with the fixed-batch-only
+    options (coalescing window, speculative draft) — reject at startup,
+    not on the first request."""
     from tensorflowonspark_tpu.models.llama import Llama
     from tensorflowonspark_tpu.serving import ContinuousBatcher
     from tensorflowonspark_tpu.tools.generate_text import (
@@ -517,6 +518,15 @@ def _build_engine(gen: dict):
             f"({cfg.max_seq_len})"
         )
     mesh = _parse_gen_mesh(gen)
+    if mesh is not None:
+        # Duplicates ContinuousBatcher.__init__'s check so it fires in
+        # milliseconds, BEFORE the (potentially multi-GB) restore below.
+        tp = mesh.shape.get("model", 1)
+        if cfg.num_heads % tp or cfg.num_kv_heads % tp:
+            raise ValueError(
+                f"heads ({cfg.num_heads}/{cfg.num_kv_heads} kv) not "
+                f"divisible by the mesh 'model' extent {tp}"
+            )
     # Cheap shape validation above happens BEFORE the (potentially
     # multi-GB) checkpoint restore, same policy as the draft path.
     params = _load_params(gen["checkpoint"], cfg)
