@@ -121,6 +121,9 @@ def main() -> int:
     for t in threads:
         t.join()
     for p, r in zip(payloads, results):
+        if r is None:  # its thread's HTTP error went to stderr
+            print(f"prompt={p['prompts'][0]} FAILED (see traceback)")
+            return 1
         print(f"prompt={p['prompts'][0]} temp={p['temperature']} "
               f"-> {r['completions'][0]}")
 

@@ -8,6 +8,9 @@ join and leave a persistent batched decode loop at token granularity
 instead of waiting for fixed-batch windows.
 """
 
-from tensorflowonspark_tpu.serving.engine import ContinuousBatcher
+from tensorflowonspark_tpu.serving.engine import (
+    ContinuousBatcher,
+    EngineOverloaded,
+)
 
-__all__ = ["ContinuousBatcher"]
+__all__ = ["ContinuousBatcher", "EngineOverloaded"]

@@ -54,6 +54,11 @@ from tensorflowonspark_tpu.models.llama import Llama, sample_logits
 logger = logging.getLogger(__name__)
 
 
+class EngineOverloaded(RuntimeError):
+    """Raised by submit()/stream() when the bounded request queue is
+    full — callers should shed load (HTTP 503), not block."""
+
+
 def _sample_rows(logits, key, temps, top_k, top_p):
     """Per-row-temperature sampling over (B, vocab) logits.
 
@@ -133,6 +138,7 @@ class ContinuousBatcher:
         eos_id: int | None = None,
         seed: int = 0,
         mesh=None,
+        max_queue: int | None = None,
     ):
         cfg = model.cfg
         self._model = model
@@ -199,6 +205,9 @@ class ContinuousBatcher:
         self._eos_id = None if eos_id is None else int(eos_id)
         self._key = jax.random.PRNGKey(seed)
 
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self._max_queue = max_queue
         self._queue: "queue.Queue" = queue.Queue()
         self._closed = False
         self._submit_lock = threading.Lock()
@@ -229,14 +238,12 @@ class ContinuousBatcher:
 
     # -- public API ----------------------------------------------------
 
-    def _enqueue(
+    def _validate(
         self,
         tokens: list[int],
         max_new_tokens: int,
-        sink=None,
-        temperature: float | None = None,
-        eos_id: int | None = None,
-    ) -> _Pending:
+        temperature: float | None,
+    ) -> None:
         cfg = self._model.cfg
         if not tokens:
             raise ValueError("empty prompt")
@@ -264,20 +271,59 @@ class ContinuousBatcher:
                 f"({max_new_tokens}) exceeds max_seq_len "
                 f"({cfg.max_seq_len})"
             )
-        p = _Pending(
-            list(tokens),
-            int(max_new_tokens),
-            threading.Event(),
-            temperature=temperature,
-            eos_id=eos_id,
-            submitted_at=time.monotonic(),
-            sink=sink,
-        )
+
+    def _enqueue_all(
+        self,
+        requests: list[tuple[list[int], "queue.Queue | None"]],
+        max_new_tokens: int,
+        temperature: float | None = None,
+        eos_id: int | None = None,
+    ) -> list[_Pending]:
+        """Validate then enqueue a group ATOMICALLY: either every row is
+        accepted or none is — a partially admitted multi-row request
+        would burn slots on work the client then discards on its 503."""
+        for tokens, _ in requests:
+            self._validate(tokens, max_new_tokens, temperature)
+        ps = [
+            _Pending(
+                list(tokens),
+                int(max_new_tokens),
+                threading.Event(),
+                temperature=temperature,
+                eos_id=eos_id,
+                submitted_at=time.monotonic(),
+                sink=sink,
+            )
+            for tokens, sink in requests
+        ]
         with self._submit_lock:
             if self._closed:
                 raise RuntimeError("engine shutting down")
-            self._queue.put(p)
-        return p
+            if (
+                self._max_queue is not None
+                and self._queue.qsize() + len(ps) > self._max_queue
+            ):
+                # Shed load instead of queueing unboundedly: a waiting
+                # client's budgeted latency is better spent retrying
+                # another replica than sitting behind a deep queue.
+                raise EngineOverloaded(
+                    f"request queue full ({self._max_queue} waiting)"
+                )
+            for p in ps:
+                self._queue.put(p)
+        return ps
+
+    def _enqueue(
+        self,
+        tokens: list[int],
+        max_new_tokens: int,
+        sink=None,
+        temperature: float | None = None,
+        eos_id: int | None = None,
+    ) -> _Pending:
+        return self._enqueue_all(
+            [(tokens, sink)], max_new_tokens, temperature, eos_id
+        )[0]
 
     def submit(
         self,
@@ -298,6 +344,30 @@ class ContinuousBatcher:
         if p.error is not None:
             raise p.error
         return p.result
+
+    def submit_many(
+        self,
+        prompts: list[list[int]],
+        max_new_tokens: int,
+        temperature: float | None = None,
+        eos_id: int | None = None,
+    ) -> list[list[int]]:
+        """Blocking decode of several prompts admitted ATOMICALLY (all
+        rows accepted or an EngineOverloaded/ValueError before any row
+        enters the queue) — the multi-row /generate path. Rows decode
+        concurrently, interleaved with other requests' rows."""
+        ps = self._enqueue_all(
+            [(p, None) for p in prompts],
+            max_new_tokens,
+            temperature,
+            eos_id,
+        )
+        for p in ps:
+            p.event.wait()
+        for p in ps:
+            if p.error is not None:
+                raise p.error
+        return [p.result for p in ps]
 
     def stream(
         self,

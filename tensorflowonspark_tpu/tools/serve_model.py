@@ -67,11 +67,15 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *fargs):  # route to logging, not stderr
         logger.info("%s " + fmt, self.client_address[0], *fargs)
 
-    def _reply(self, code: int, payload: dict) -> None:
+    def _reply(
+        self, code: int, payload: dict, headers: dict | None = None
+    ) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -191,12 +195,19 @@ class _Handler(BaseHTTPRequestHandler):
         if stream:
             self._engine_stream(prompts[0], temperature, max_new, eos_id)
             return
+        from tensorflowonspark_tpu.serving import EngineOverloaded
+
         try:
             if self.gen_engine is not None:
                 try:
                     completions = self._engine_generate(
                         prompts, temperature, max_new, eos_id
                     )
+                except EngineOverloaded as e:
+                    self._reply(
+                        503, {"error": str(e)}, {"Retry-After": "1"}
+                    )
+                    return
                 except ValueError as e:
                     # the engine's submit-side prompt validation (width/
                     # budget) — client fault, like PromptError below; a
@@ -229,6 +240,8 @@ class _Handler(BaseHTTPRequestHandler):
         trailer. The response is close-delimited (no Content-Length);
         a mid-stream failure surfaces as an ``{"error": ...}`` line
         since the 200 status is already on the wire."""
+        from tensorflowonspark_tpu.serving import EngineOverloaded
+
         try:
             gen = self.gen_engine.stream(
                 prompt,
@@ -236,6 +249,9 @@ class _Handler(BaseHTTPRequestHandler):
                 temperature=temperature,
                 eos_id=eos_id,
             )
+        except EngineOverloaded as e:
+            self._reply(503, {"error": str(e)}, {"Retry-After": "1"})
+            return
         except ValueError as e:  # submit-side prompt validation
             self._reply(400, {"error": str(e)})
             return
@@ -272,44 +288,17 @@ class _Handler(BaseHTTPRequestHandler):
     def _engine_generate(
         self, prompts, temperature=None, max_new=None, eos_id=None
     ):
-        """Continuous-batching path: each prompt row is its own engine
-        request, so a multi-row request's rows decode concurrently and
-        rows from OTHER requests interleave freely — no convoying. The
-        handler thread fans out one thread per extra row and joins."""
-        eng = self.gen_engine
-        budget = max_new or self.gen_max_new
-        if len(prompts) == 1:
-            return [
-                eng.submit(
-                    prompts[0], budget,
-                    temperature=temperature, eos_id=eos_id,
-                )
-            ]
-        results: list = [None] * len(prompts)
-        errors: list = [None] * len(prompts)
-
-        def one(i):
-            try:
-                results[i] = eng.submit(
-                    prompts[i], budget,
-                    temperature=temperature, eos_id=eos_id,
-                )
-            except BaseException as e:  # noqa: BLE001 - re-raised below
-                errors[i] = e
-
-        threads = [
-            threading.Thread(target=one, args=(i,))
-            for i in range(1, len(prompts))
-        ]
-        for t in threads:
-            t.start()
-        one(0)
-        for t in threads:
-            t.join()
-        for e in errors:
-            if e is not None:
-                raise e
-        return results
+        """Continuous-batching path: the request's rows are admitted
+        ATOMICALLY (all accepted, or a 400/503 before any decodes — a
+        partial admission would burn slots on work the erroring client
+        discards), then decode concurrently, interleaved with other
+        requests' rows — no convoying."""
+        return self.gen_engine.submit_many(
+            prompts,
+            max_new or self.gen_max_new,
+            temperature=temperature,
+            eos_id=eos_id,
+        )
 
 
 class _GenBatcher:
@@ -527,6 +516,11 @@ def _build_engine(gen: dict):
                 f"heads ({cfg.num_heads}/{cfg.num_kv_heads} kv) not "
                 f"divisible by the mesh 'model' extent {tp}"
             )
+    max_queue = gen.get("max_queue")
+    if max_queue is not None and int(max_queue) < 1:
+        raise ValueError(
+            f"--gen-max-queue must be >= 1, got {max_queue}"
+        )
     # Cheap shape validation above happens BEFORE the (potentially
     # multi-GB) checkpoint restore, same policy as the draft path.
     params = _load_params(gen["checkpoint"], cfg)
@@ -541,6 +535,7 @@ def _build_engine(gen: dict):
         eos_id=gen.get("eos_id"),
         seed=int(gen.get("seed", 0)),
         mesh=mesh,
+        max_queue=gen.get("max_queue"),
     )
     return engine, max_new
 
@@ -812,6 +807,13 @@ def main(argv: list[str] | None = None) -> int:
         "fits, one compilation per bucket (default: one bucket of "
         "--gen-width)",
     )
+    p.add_argument(
+        "--gen-max-queue",
+        type=int,
+        default=None,
+        help="continuous engine: shed load with HTTP 503 once this "
+        "many requests are waiting for a slot (default: unbounded)",
+    )
     args = p.parse_args(argv)
     if args.export_dir is None and args.llama_checkpoint is None:
         p.error("need --export-dir and/or --llama-checkpoint")
@@ -839,6 +841,7 @@ def main(argv: list[str] | None = None) -> int:
             engine=args.gen_engine,
             slots=args.gen_slots,
             widths=args.gen_widths,
+            max_queue=args.gen_max_queue,
         )
     server = make_server(
         args.export_dir, args.port, args.batch_size, host=args.host, gen=gen

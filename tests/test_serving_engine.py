@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from tensorflowonspark_tpu.models.llama import Llama, LlamaConfig, generate
-from tensorflowonspark_tpu.serving import ContinuousBatcher
+from tensorflowonspark_tpu.serving import ContinuousBatcher, EngineOverloaded
 
 
 @pytest.fixture(scope="module")
@@ -146,6 +146,41 @@ def test_engine_multi_width_buckets(tiny):
         eng.close()
 
 
+def test_engine_bounded_queue_sheds_load(tiny):
+    """With max_queue set, submits beyond the bound raise
+    EngineOverloaded instead of queueing unboundedly. The engine loop
+    is kept parked by never admitting (slot held by a long request)."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(
+        model, params, slots=1, prompt_widths=(8,), max_queue=2
+    )
+    try:
+        holder = threading.Thread(
+            target=lambda: eng.submit([1, 2], 40)
+        )
+        holder.start()
+        # wait until the holder occupies the single slot
+        deadline = time.time() + 60
+        while eng.stats()["slots_busy"] < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        waiters = [
+            threading.Thread(target=lambda: eng.submit([3], 2))
+            for _ in range(2)
+        ]
+        for w in waiters:
+            w.start()
+        while eng.stats()["queue_depth"] < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        with pytest.raises(EngineOverloaded, match="queue full"):
+            eng.submit([4], 2)
+        holder.join(timeout=120)
+        for w in waiters:
+            w.join(timeout=120)
+            assert not w.is_alive()
+    finally:
+        eng.close()
+
+
 def test_engine_validates_and_shutdown(tiny):
     cfg, model, params = tiny
     eng = ContinuousBatcher(model, params, slots=1, prompt_widths=(4,))
@@ -158,6 +193,48 @@ def test_engine_validates_and_shutdown(tiny):
     eng.close()
     with pytest.raises(RuntimeError, match="shutting down"):
         eng.submit([1], 2)
+
+
+@pytest.mark.slow
+def test_engine_scheduling_stress(tiny):
+    """Fuzz the scheduler: 24 greedy requests with random prompts,
+    budgets, and arrival jitter over 3 slots. Every completion must
+    equal its solo generate() reference — any slot-reuse, admission,
+    or retirement bug shows up as a token mismatch."""
+    import random
+
+    rnd = random.Random(7)
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=3, prompt_widths=(8,))
+    reqs = [
+        (
+            [rnd.randrange(1, cfg.vocab_size) for _ in range(rnd.randrange(1, 8))],
+            rnd.randrange(1, 10),
+        )
+        for _ in range(24)
+    ]
+    results: dict[int, list[int]] = {}
+
+    def fire(i):
+        time.sleep(rnd.random() * 0.2)
+        results[i] = eng.submit(*reqs[i])
+
+    try:
+        threads = [
+            threading.Thread(target=fire, args=(i,))
+            for i in range(len(reqs))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+            assert not t.is_alive()
+        for i, (prompt, budget) in enumerate(reqs):
+            want = _reference(model, params, prompt, budget)
+            assert results[i] == want, (i, prompt, budget)
+        assert eng.stats()["completed"] == len(reqs)
+    finally:
+        eng.close()
 
 
 def test_engine_loop_death_fails_waiters_not_hangs(tiny):
