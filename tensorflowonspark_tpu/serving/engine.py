@@ -296,6 +296,14 @@ class ContinuousBatcher:
             )
             for tokens, sink in requests
         ]
+        if self._max_queue is not None and len(ps) > self._max_queue:
+            # Permanently unsatisfiable, not transient overload: a 503 +
+            # Retry-After would send the client into an infinite retry
+            # loop for a request that can NEVER fit the bound.
+            raise ValueError(
+                f"request has {len(ps)} rows but the queue bound is "
+                f"{self._max_queue}; split the request"
+            )
         with self._submit_lock:
             if self._closed:
                 raise RuntimeError("engine shutting down")

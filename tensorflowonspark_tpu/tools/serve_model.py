@@ -21,6 +21,11 @@ Endpoints::
                                 params are fixed server-side at startup
                                 so the jitted decode compiles ONCE for
                                 one static (batch, width) shape)
+    POST /score              -> body {"sequences": [[token ids], ...]}
+                                -> {"logprobs": [[float, ...], ...]}
+                                (per-token next-token logprobs — the
+                                eval-harness surface; one static
+                                compile, same bucketing as /generate)
 
 Usage::
 
@@ -59,6 +64,7 @@ class _Handler(BaseHTTPRequestHandler):
     gen_batcher: Any = None  # _GenBatcher when --gen-batch-window > 0
     gen_engine: Any = None  # ContinuousBatcher (--gen-engine continuous)
     gen_max_new: int = 64  # per-request decode budget in engine mode
+    score_fn: Any = None  # sequences -> per-token logprobs (/score)
     # per-server lock (set in make_server): serializes jax dispatch on
     # one model while the HTTP layer stays threaded, so health checks
     # never queue behind a big batch
@@ -103,6 +109,9 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/generate":
             self._do_generate()
             return
+        if self.path == "/score":
+            self._do_score()
+            return
         if self.path != "/predict":
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
@@ -133,6 +142,37 @@ class _Handler(BaseHTTPRequestHandler):
         # outside the try: a client hanging up mid-response must not be
         # logged as a prediction failure nor answered with a second reply
         self._reply(200, {"predictions": [_to_jsonable(p) for p in preds]})
+
+    def _do_score(self) -> None:
+        if self.score_fn is None:
+            self._reply(
+                400, {"error": "server was not started with "
+                      "--llama-checkpoint; /score unavailable"}
+            )
+            return
+        from tensorflowonspark_tpu.tools.generate_text import PromptError
+
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            seqs = payload["sequences"]
+            if not isinstance(seqs, list):
+                raise ValueError("'sequences' must be a list")
+            seqs = [[int(t) for t in s] for s in seqs]
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        try:
+            with self.predict_lock:
+                logprobs = self.score_fn(seqs)
+        except PromptError as e:
+            self._reply(400, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - server-side; log + 500
+            logger.exception("scoring failed")
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._reply(200, {"logprobs": logprobs})
 
     def _do_generate(self) -> None:
         if self.gen_fn is None and self.gen_engine is None:
@@ -437,6 +477,63 @@ class _GenBatcher:
                 slot["event"].set()
 
 
+def _build_score_fn(model, params, width: int, bsz: int):
+    """Build ``sequences -> per-token logprobs`` over the served Llama —
+    the eval-harness surface (perplexity / sequence scoring). One static
+    (bsz, width) compile, rows right-padded, the same bucketing
+    discipline as /generate; a pure forward (no KV cache), so it serves
+    from either engine. ``width`` spans prompt+generation so anything
+    the server can emit can be scored back."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.tools.generate_text import PromptError
+
+    @jax.jit
+    def score(tokens):
+        logits = model.apply({"params": params}, tokens[:, :-1])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tgt = tokens[:, 1:]
+        return jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+
+    def score_rows(rows: list[list[int]]) -> list[list[float]]:
+        if not rows:
+            raise PromptError("'sequences' must be a non-empty list")
+        if len(rows) > bsz:
+            raise PromptError(
+                f"at most {bsz} sequences per request (the compiled "
+                f"batch shape)"
+            )
+        vocab = model.cfg.vocab_size
+        for r in rows:
+            if len(r) < 2:
+                raise PromptError(
+                    "each sequence needs >= 2 tokens (scores are "
+                    "next-token logprobs)"
+                )
+            if len(r) > width:
+                raise PromptError(
+                    f"sequence length {len(r)} exceeds the score "
+                    f"width {width}"
+                )
+            bad = [t for t in r if not 0 <= t < vocab]
+            if bad:
+                # XLA clamps out-of-range gathers, which would return a
+                # 200 with silently meaningless logprobs
+                raise PromptError(
+                    f"token ids {bad[:5]} outside the vocabulary "
+                    f"[0, {vocab})"
+                )
+        arr = np.zeros((bsz, width), np.int32)
+        for i, r in enumerate(rows):
+            arr[i, : len(r)] = r
+        lp = np.asarray(score(jnp.asarray(arr)))
+        return [lp[i, : len(r) - 1].tolist() for i, r in enumerate(rows)]
+
+    return score_rows
+
+
 def _parse_gen_mesh(gen: dict):
     """Build the --gen-mesh device mesh (or None) — one parser for the
     fixed-batch and continuous-engine paths so axis handling cannot
@@ -537,7 +634,7 @@ def _build_engine(gen: dict):
         mesh=mesh,
         max_queue=gen.get("max_queue"),
     )
-    return engine, max_new
+    return engine, max_new, model, engine._params
 
 
 def _build_gen_fn(gen: dict):
@@ -655,7 +752,7 @@ def _build_gen_fn(gen: dict):
         )
         return out
 
-    return gen_fn, bsz
+    return gen_fn, bsz, model, params
 
 
 class _Server(ThreadingHTTPServer):
@@ -692,10 +789,31 @@ def make_server(
         model = load_model(export_dir)
     gen_fn, gen_bsz = (None, 0)
     engine, engine_max_new = (None, 64)
+    score_fn = None
     if gen is not None and gen.get("engine") == "continuous":
-        engine, engine_max_new = _build_engine(gen)
+        engine, engine_max_new, lm, lm_params = _build_engine(gen)
     elif gen is not None:
-        gen_fn, gen_bsz = _build_gen_fn(gen)
+        gen_fn, gen_bsz, lm, lm_params = _build_gen_fn(gen)
+    if gen is not None:
+        # Score width must cover anything /generate can emit: the
+        # LARGEST prompt bucket + the decode budget, capped at the
+        # model's context (an over-long compile would score positions
+        # the model was never shaped for).
+        if gen.get("engine") == "continuous" and gen.get("widths"):
+            max_bucket = max(
+                int(w) for w in str(gen["widths"]).split(",")
+            )
+        else:
+            max_bucket = int(gen.get("width", 128))
+        score_fn = _build_score_fn(
+            lm,
+            lm_params,
+            width=min(
+                max_bucket + int(gen.get("max_new_tokens", 64)),
+                lm.cfg.max_seq_len,
+            ),
+            bsz=int(gen.get("batch_size", 8)),
+        )
     lock = threading.Lock()  # per-server, not shared
     batcher = None
     window = float(gen.get("batch_window", 0.0) or 0.0) if gen else 0.0
@@ -714,6 +832,9 @@ def make_server(
             "gen_batcher": batcher,
             "gen_engine": engine,
             "gen_max_new": engine_max_new,
+            "score_fn": staticmethod(score_fn)
+            if score_fn is not None
+            else None,
             "predict_lock": lock,
         },
     )

@@ -473,6 +473,65 @@ def test_serve_model_continuous_engine(tmp_path):
             serve_model.make_server(None, port=0, gen={**gen, **bad})
 
 
+def test_serve_model_score_endpoint(tmp_path):
+    """/score returns per-token next-token logprobs matching a direct
+    forward pass, in both fixed and continuous-engine modes."""
+    import threading
+
+    from tensorflowonspark_tpu.tools import serve_model
+
+    cfg, model, params, ckpt_dir = _tiny_checkpoint(tmp_path)
+    seqs = [[1, 2, 3, 4], [7, 5, 6]]
+
+    def ref_logprobs(seq):
+        import jax.numpy as jnp
+
+        logits = model.apply(
+            {"params": params}, jnp.asarray([seq[:-1]], jnp.int32)
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return [
+            float(logp[0, i, seq[i + 1]]) for i in range(len(seq) - 1)
+        ]
+
+    for engine_mode in (None, "continuous"):
+        gen = dict(
+            checkpoint=ckpt_dir,
+            model="tiny",
+            config_overrides='{"remat": false, "dtype": "float32"}',
+            width=8,
+            batch_size=2,
+            max_new_tokens=4,
+        )
+        if engine_mode:
+            gen["engine"] = engine_mode
+        server = serve_model.make_server(None, port=0, gen=gen)
+        port = server.server_address[1]
+        threading.Thread(
+            target=server.serve_forever, daemon=True
+        ).start()
+        try:
+            code, body = _post(port, "/score", {"sequences": seqs})
+            assert code == 200, body
+            for got, seq in zip(body["logprobs"], seqs):
+                want = ref_logprobs(seq)
+                np.testing.assert_allclose(got, want, atol=1e-4)
+            # validation: short row and over-long row are client faults
+            code, body = _post(port, "/score", {"sequences": [[1]]})
+            assert code == 400 and ">= 2 tokens" in body["error"]
+            code, body = _post(
+                port, "/score", {"sequences": [[1] * 99]}
+            )
+            assert code == 400 and "width" in body["error"]
+            code, body = _post(
+                port, "/score",
+                {"sequences": [[1, cfg.vocab_size + 3]]},
+            )
+            assert code == 400 and "vocabulary" in body["error"]
+        finally:
+            server.shutdown()
+
+
 def test_serve_model_generate_endpoint(tmp_path):
     """POST /generate against a live ephemeral-port server in
     --llama-checkpoint mode; completions match the CLI/library decode."""
