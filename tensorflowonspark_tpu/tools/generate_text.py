@@ -48,6 +48,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--prompts", required=True, help="JSONL: {'tokens': [...]}")
     p.add_argument("--output", required=True, help="output JSONL path ('-' = stdout)")
+    p.add_argument(
+        "--score",
+        action="store_true",
+        help="score instead of decode: each input row's per-token "
+        "next-token logprobs (+ summed total) as JSONL — the batch "
+        "eval/perplexity surface (decode flags are ignored)",
+    )
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--temperature", type=float, default=0.0)
@@ -283,6 +290,86 @@ def decode_batches(
     return out, rng
 
 
+def build_score_fn(model, params, width: int, bsz: int):
+    """Build ``sequences -> per-token logprobs`` over a Llama — the
+    eval-harness surface (perplexity / sequence scoring), shared by the
+    CLI's ``--score`` and serve_model's ``/score`` so the two cannot
+    diverge. One static (bsz, width) compile, rows right-padded; a pure
+    forward (no KV cache). If ``params`` are mesh-sharded (device_put
+    under ``llama_param_shardings``), the jitted forward runs SPMD
+    against those placements."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def score(tokens):
+        logits = model.apply({"params": params}, tokens[:, :-1])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tgt = tokens[:, 1:]
+        return jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+
+    def score_rows(rows: list[list[int]]) -> list[list[float]]:
+        if not rows:
+            raise PromptError("'sequences' must be a non-empty list")
+        if len(rows) > bsz:
+            raise PromptError(
+                f"at most {bsz} sequences per request (the compiled "
+                f"batch shape)"
+            )
+        vocab = model.cfg.vocab_size
+        for r in rows:
+            if len(r) < 2:
+                raise PromptError(
+                    "each sequence needs >= 2 tokens (scores are "
+                    "next-token logprobs)"
+                )
+            if len(r) > width:
+                raise PromptError(
+                    f"sequence length {len(r)} exceeds the score "
+                    f"width {width}"
+                )
+            bad = [t for t in r if not 0 <= t < vocab]
+            if bad:
+                # XLA clamps out-of-range gathers, which would return
+                # plausible-looking but meaningless logprobs
+                raise PromptError(
+                    f"token ids {bad[:5]} outside the vocabulary "
+                    f"[0, {vocab})"
+                )
+        arr = np.zeros((bsz, width), np.int32)
+        for i, r in enumerate(rows):
+            arr[i, : len(r)] = r
+        lp = np.asarray(score(jnp.asarray(arr)))
+        return [lp[i, : len(r) - 1].tolist() for i, r in enumerate(rows)]
+
+    return score_rows
+
+
+def _score_main(args, model, params, cfg, seqs) -> int:
+    """--score: emit per-token next-token logprobs (and the summed
+    sequence logprob) for each input row instead of decoding — the
+    batch eval surface, the CLI twin of serve_model's /score."""
+    width = min(max(len(s) for s in seqs), cfg.max_seq_len)
+    score_rows = build_score_fn(
+        model, params, width=width, bsz=args.batch_size
+    )
+    out = open(args.output, "w") if args.output != "-" else sys.stdout
+    try:
+        for i in range(0, len(seqs), args.batch_size):
+            for row in score_rows(seqs[i : i + args.batch_size]):
+                out.write(
+                    json.dumps(
+                        {"logprobs": row, "total": float(sum(row))}
+                    )
+                    + "\n"
+                )
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -301,8 +388,13 @@ def main(argv: list[str] | None = None) -> int:
     prompts = [list(map(int, r["tokens"])) for r in rows]
     if not prompts:
         raise ValueError(f"no prompts in {args.prompts}")
+    if args.score and args.draft_checkpoint:
+        raise SystemExit(
+            "--score is a plain forward; --draft-checkpoint "
+            "(speculative decoding) does not apply"
+        )
     width = max((len(p) for p in prompts), default=1)
-    if width + args.max_new_tokens > cfg.max_seq_len:
+    if not args.score and width + args.max_new_tokens > cfg.max_seq_len:
         raise ValueError(
             f"longest prompt ({width}) + max_new_tokens "
             f"({args.max_new_tokens}) exceeds max_seq_len "
@@ -320,6 +412,11 @@ def main(argv: list[str] | None = None) -> int:
         mesh = make_mesh(parse_axis_spec(args.mesh))
         # place the weights in their TP layout once, not per chunk
         params = jax.device_put(params, llama_param_shardings(params, mesh))
+
+    if args.score:
+        # after the mesh placement above: sharded params make the
+        # scoring forward SPMD (the 7B-doesn't-fit-one-chip case)
+        return _score_main(args, model, params, cfg, prompts)
 
     draft = None
     if args.draft_checkpoint:

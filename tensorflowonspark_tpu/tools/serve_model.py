@@ -477,63 +477,6 @@ class _GenBatcher:
                 slot["event"].set()
 
 
-def _build_score_fn(model, params, width: int, bsz: int):
-    """Build ``sequences -> per-token logprobs`` over the served Llama —
-    the eval-harness surface (perplexity / sequence scoring). One static
-    (bsz, width) compile, rows right-padded, the same bucketing
-    discipline as /generate; a pure forward (no KV cache), so it serves
-    from either engine. ``width`` spans prompt+generation so anything
-    the server can emit can be scored back."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from tensorflowonspark_tpu.tools.generate_text import PromptError
-
-    @jax.jit
-    def score(tokens):
-        logits = model.apply({"params": params}, tokens[:, :-1])
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        tgt = tokens[:, 1:]
-        return jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-
-    def score_rows(rows: list[list[int]]) -> list[list[float]]:
-        if not rows:
-            raise PromptError("'sequences' must be a non-empty list")
-        if len(rows) > bsz:
-            raise PromptError(
-                f"at most {bsz} sequences per request (the compiled "
-                f"batch shape)"
-            )
-        vocab = model.cfg.vocab_size
-        for r in rows:
-            if len(r) < 2:
-                raise PromptError(
-                    "each sequence needs >= 2 tokens (scores are "
-                    "next-token logprobs)"
-                )
-            if len(r) > width:
-                raise PromptError(
-                    f"sequence length {len(r)} exceeds the score "
-                    f"width {width}"
-                )
-            bad = [t for t in r if not 0 <= t < vocab]
-            if bad:
-                # XLA clamps out-of-range gathers, which would return a
-                # 200 with silently meaningless logprobs
-                raise PromptError(
-                    f"token ids {bad[:5]} outside the vocabulary "
-                    f"[0, {vocab})"
-                )
-        arr = np.zeros((bsz, width), np.int32)
-        for i, r in enumerate(rows):
-            arr[i, : len(r)] = r
-        lp = np.asarray(score(jnp.asarray(arr)))
-        return [lp[i, : len(r) - 1].tolist() for i, r in enumerate(rows)]
-
-    return score_rows
-
-
 def _parse_gen_mesh(gen: dict):
     """Build the --gen-mesh device mesh (or None) — one parser for the
     fixed-batch and continuous-engine paths so axis handling cannot
@@ -795,6 +738,10 @@ def make_server(
     elif gen is not None:
         gen_fn, gen_bsz, lm, lm_params = _build_gen_fn(gen)
     if gen is not None:
+        from tensorflowonspark_tpu.tools.generate_text import (
+            build_score_fn,
+        )
+
         # Score width must cover anything /generate can emit: the
         # LARGEST prompt bucket + the decode budget, capped at the
         # model's context (an over-long compile would score positions
@@ -805,7 +752,7 @@ def make_server(
             )
         else:
             max_bucket = int(gen.get("width", 128))
-        score_fn = _build_score_fn(
+        score_fn = build_score_fn(
             lm,
             lm_params,
             width=min(

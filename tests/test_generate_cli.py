@@ -473,6 +473,46 @@ def test_serve_model_continuous_engine(tmp_path):
             serve_model.make_server(None, port=0, gen={**gen, **bad})
 
 
+def test_cli_score_mode(tmp_path):
+    """--score emits per-token logprobs + totals matching a direct
+    forward pass."""
+    import jax.numpy as jnp
+
+    cfg, model, params, ckpt_dir = _tiny_checkpoint(tmp_path)
+    seqs = [[1, 2, 3, 4], [7, 5, 6]]
+    pfile = tmp_path / "seqs.jsonl"
+    pfile.write_text(
+        "".join(json.dumps({"tokens": s}) + "\n" for s in seqs)
+    )
+    ofile = tmp_path / "scores.jsonl"
+    rc = main(
+        [
+            "--checkpoint", ckpt_dir,
+            "--model", "tiny",
+            "--config-overrides", '{"remat": false, "dtype": "float32"}',
+            "--prompts", str(pfile),
+            "--output", str(ofile),
+            "--score",
+            "--batch-size", "2",
+        ]
+    )
+    assert rc == 0
+    got = [json.loads(l) for l in ofile.read_text().splitlines()]
+    assert len(got) == len(seqs)
+    for row, seq in zip(got, seqs):
+        logits = model.apply(
+            {"params": params}, jnp.asarray([seq[:-1]], jnp.int32)
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        want = [
+            float(logp[0, i, seq[i + 1]]) for i in range(len(seq) - 1)
+        ]
+        np.testing.assert_allclose(row["logprobs"], want, atol=1e-4)
+        np.testing.assert_allclose(
+            row["total"], sum(want), atol=1e-3
+        )
+
+
 def test_serve_model_score_endpoint(tmp_path):
     """/score returns per-token next-token logprobs matching a direct
     forward pass, in both fixed and continuous-engine modes."""
