@@ -10,7 +10,7 @@ record, the same files the reference's ``cifar10_input.py`` consumed).
 Usage::
 
     tpu-submit --num-executors 1 examples/cifar10/cifar10_train.py \
-        [--data-dir DIR] [--model resnet18|inception] [--steps 200]
+        [--data-dir DIR] [--model resnet18|inception|vit_b16|...] [--steps 200]
 
 Without ``--data-dir`` (no ``data_batch_*.bin`` around), synthetic
 CIFAR-shaped data is used so the example runs anywhere.
@@ -75,7 +75,7 @@ def main_fun(args, ctx):
         shardings_of = inception.inception_param_shardings
     else:
         # any image model from the zoo factory (the slim nets_factory
-        # surface): resnet18/34/50/101, vgg11/16, ...
+        # surface): resnet18/34/50/101, vgg11/16, vit_b16, ...
         entry = zoo.build(args.model, num_classes=10)
         if entry.kind != "image":
             raise ValueError(
@@ -84,6 +84,12 @@ def main_fun(args, ctx):
             )
         model = entry.model
         loss_fn = entry.make_loss()
+        if not entry.has_batch_stats:
+            # stats-less image models (ViT): lift the plain
+            # (params, batch) loss into the uniform stats-through
+            # signature so one step shape drives every image model
+            _plain = loss_fn
+            loss_fn = lambda p, bs, b: (_plain(p, b), bs)  # noqa: E731
         shardings_of = entry.param_shardings
     mesh = make_mesh({"data": -1, "fsdp": args.fsdp})
     rng = np.random.default_rng(ctx.executor_id)
@@ -127,7 +133,8 @@ def main_fun(args, ctx):
     variables = model.init(
         jax.random.PRNGKey(0), np.zeros((2, 32, 32, 3), np.float32)
     )
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
     psh = shardings_of(params, mesh)
     params = jax.tree.map(jax.device_put, params, psh)
     tx = optax.sgd(args.lr, momentum=0.9)
@@ -216,7 +223,7 @@ def parse_args(argv=None):
         "--model",
         default="resnet18",
         help="'inception' (CIFAR-size) or any image model from "
-        "models/zoo.py (resnet18/34/50/101, vgg11/16)",
+        "models/zoo.py (resnet18/34/50/101, vgg11/16, vit_b16)",
     )
     p.add_argument("--model-dir", default=None)
     p.add_argument("--steps", type=int, default=200)

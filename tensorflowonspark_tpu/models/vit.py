@@ -1,0 +1,191 @@
+"""Vision Transformer (ViT-B/16 family) image classifier.
+
+Parity note: the reference's image families were conv nets
+(inception/cifar10/slim — SURVEY.md §2.4); ViT extends the zoo with the
+transformer-era image model. From-scratch flax, not a port.
+
+TPU-first design notes:
+
+- Patchify as a strided conv (one MXU matmul per patch grid), tokens
+  thereafter — everything downstream is the same batched-matmul shape
+  the MXU likes. Encoder blocks are pre-LN with GELU MLPs, bf16 compute
+  and fp32 LayerNorm statistics.
+- Attention runs through ``ops.attention.dot_product_attention``
+  (non-causal full attention; the flash kernel and mesh paths apply at
+  long token counts, XLA einsum at ViT's 197-token scale).
+- NO BatchNorm: ViT's LayerNorm has no cross-batch statistics, so the
+  bandwidth-bound stats passes that cap the conv nets (see
+  ops/batch_norm.py) structurally don't exist here; the model is
+  matmul-dominated — the shape TPUs are best at.
+- ``vit_param_shardings``: 2D kernels shard over ('fsdp', 'model') like
+  the Llama rules; LN/bias/cls/pos replicated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensorflowonspark_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "auto"
+
+    @staticmethod
+    def b16(**overrides) -> "ViTConfig":
+        return ViTConfig(**overrides)
+
+    @staticmethod
+    def tiny(**overrides) -> "ViTConfig":
+        base = dict(
+            image_size=16,
+            patch_size=4,
+            hidden_size=32,
+            num_layers=2,
+            num_heads=4,
+            num_classes=10,
+            dtype=jnp.float32,
+        )
+        base.update(overrides)
+        return ViTConfig(**base)
+
+
+class _Block(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = cfg.hidden_size
+        head_dim = h // cfg.num_heads
+        b, n, _ = x.shape
+
+        y = nn.LayerNorm(dtype=cfg.dtype)(x)
+        q = nn.Dense(h, dtype=cfg.dtype, name="q_proj")(y)
+        k = nn.Dense(h, dtype=cfg.dtype, name="k_proj")(y)
+        v = nn.Dense(h, dtype=cfg.dtype, name="v_proj")(y)
+        q = q.reshape(b, n, cfg.num_heads, head_dim)
+        k = k.reshape(b, n, cfg.num_heads, head_dim)
+        v = v.reshape(b, n, cfg.num_heads, head_dim)
+        a = dot_product_attention(
+            q, k, v, causal=False, impl=cfg.attention_impl
+        )
+        a = a.reshape(b, n, h)
+        x = x + nn.Dense(h, dtype=cfg.dtype, name="o_proj")(a)
+
+        y = nn.LayerNorm(dtype=cfg.dtype)(x)
+        y = nn.Dense(h * cfg.mlp_ratio, dtype=cfg.dtype, name="up")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(h, dtype=cfg.dtype, name="down")(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.config
+        if cfg.image_size % cfg.patch_size:
+            raise ValueError(
+                f"image_size {cfg.image_size} not divisible by "
+                f"patch_size {cfg.patch_size}"
+            )
+        x = x.astype(cfg.dtype)
+        p = cfg.patch_size
+        x = nn.Conv(
+            cfg.hidden_size,
+            (p, p),
+            strides=(p, p),
+            padding="VALID",
+            dtype=cfg.dtype,
+            name="patch_embed",
+        )(x)
+        b = x.shape[0]
+        x = x.reshape(b, -1, cfg.hidden_size)
+        n = x.shape[1]
+        cls = self.param(
+            "cls",
+            nn.initializers.zeros,
+            (1, 1, cfg.hidden_size),
+            jnp.float32,
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, cfg.hidden_size)).astype(cfg.dtype), x],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, n + 1, cfg.hidden_size),
+            jnp.float32,
+        )
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = _Block(cfg, name=f"layer{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype)(x)
+        # Classifier head in fp32 for a stable softmax, from the CLS token.
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32)(x[:, 0])
+
+
+def vit_param_shardings(params, mesh: Mesh):
+    """2D kernels over ('fsdp','model'); everything else replicated.
+
+    Like the conv nets' rules, a dim that does not divide its mesh axis
+    falls back to replication for that dim (e.g. the (hidden, 10)
+    classifier head under model>1) rather than erroring at device_put.
+    """
+    fsdp = mesh.shape.get("fsdp", 1)
+    tp = mesh.shape.get("model", 1)
+
+    def axis(extent, size, name):
+        return name if size % extent == 0 and extent > 1 else None
+
+    def rule(path, leaf) -> NamedSharding:
+        if leaf.ndim == 2:
+            return NamedSharding(
+                mesh,
+                P(
+                    axis(fsdp, leaf.shape[0], "fsdp"),
+                    axis(tp, leaf.shape[1], "model"),
+                ),
+            )
+        if leaf.ndim == 4:  # patch-embed conv kernel
+            return NamedSharding(
+                mesh,
+                P(None, None, None, axis(tp, leaf.shape[3], "model")),
+            )
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def loss_fn(model: ViT):
+    """Stats-less image loss ``(params, batch) -> scalar`` (ViT has no
+    BatchNorm; zoo consumers branch on ``has_batch_stats`` for the
+    signature family, like the token models)."""
+    import optax
+
+    def loss(params, batch):
+        logits = model.apply(
+            {"params": params}, batch["image"], train=True
+        )
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+
+    return loss

@@ -108,6 +108,26 @@ def _build_vgg(variant, tiny, num_classes):
     )
 
 
+def _build_vit(tiny, num_classes):
+    from tensorflowonspark_tpu.models import vit
+
+    cfg = (
+        vit.ViTConfig.tiny(num_classes=num_classes)
+        if tiny
+        else vit.ViTConfig.b16(num_classes=num_classes)
+    )
+    entry = _image_entry(
+        "vit_b16",
+        vit.ViT(cfg),
+        vit.vit_param_shardings,
+        vit.loss_fn,
+        cfg.image_size,
+        num_classes,
+    )
+    # ViT has no BatchNorm; its loss passes the (empty) stats through
+    return dataclasses.replace(entry, has_batch_stats=False)
+
+
 def _build_unet(tiny, num_classes):
     from tensorflowonspark_tpu.models import unet
 
@@ -219,6 +239,7 @@ _BUILDERS: dict[str, Callable[..., ZooEntry]] = {
     "inception_v3": lambda tiny, nc: _build_inception(tiny, nc),
     "vgg11": lambda tiny, nc: _build_vgg("vgg11", tiny, nc),
     "vgg16": lambda tiny, nc: _build_vgg("vgg16", tiny, nc),
+    "vit_b16": lambda tiny, nc: _build_vit(tiny, nc),
     "unet": lambda tiny, nc: _build_unet(tiny, nc),
     "bert_base": lambda tiny, nc: _build_bert(tiny),
     "llama_1b": lambda tiny, nc: _build_llama("llama_1b", tiny),

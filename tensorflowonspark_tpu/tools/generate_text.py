@@ -18,6 +18,11 @@ Usage::
         --output out.jsonl [--max-new-tokens 64] [--eos-id N] \
         [--temperature 0.8 --top-k 40 --top-p 0.95] [--batch-size 8] \
         [--config-overrides '{"vocab_size": 1024}']
+
+``--score`` switches from decoding to scoring: each row's per-token
+next-token logprobs + summed total (the eval/perplexity surface; the
+same scorer backs serve_model's /score endpoint). Composes with
+``--mesh`` for models that need TP to fit.
 """
 
 from __future__ import annotations

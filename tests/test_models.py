@@ -421,6 +421,46 @@ def test_resnet_forward_and_train():
     assert float(l) < l0
 
 
+def test_vit_forward_and_train():
+    from tensorflowonspark_tpu.models.vit import (
+        ViT,
+        ViTConfig,
+        loss_fn as vit_loss_fn,
+    )
+
+    cfg = ViTConfig.tiny()
+    model = ViT(cfg)
+    img = jax.random.uniform(jax.random.PRNGKey(0), (2, 16, 16, 3))
+    params = model.init(jax.random.PRNGKey(1), img)["params"]
+    logits = model.apply({"params": params}, img)
+    assert logits.shape == (2, cfg.num_classes)
+    assert logits.dtype == jnp.float32
+    # token count: (16/4)^2 patches + CLS
+    assert params["pos_embed"].shape == (1, 17, cfg.hidden_size)
+
+    loss = vit_loss_fn(model)
+    batch = {"image": img, "label": jnp.array([1, 2])}
+    tx = optax.sgd(0.3)
+    opt_state = tx.init(params)
+    l0 = None
+    for _ in range(20):
+        l, g = jax.value_and_grad(loss)(params, batch)
+        if l0 is None:
+            l0 = float(l)
+        upd, opt_state = tx.update(g, opt_state)
+        params = optax.apply_updates(params, upd)
+    assert float(l) < l0, (float(l), l0)  # overfits 2 examples
+
+
+def test_vit_b16_config_scale():
+    from tensorflowonspark_tpu.models.vit import ViTConfig
+
+    cfg = ViTConfig.b16()
+    # canonical ViT-B/16: 196 patches, 12 layers, hidden 768
+    assert (cfg.image_size // cfg.patch_size) ** 2 == 196
+    assert cfg.num_layers == 12 and cfg.hidden_size == 768
+
+
 def test_resnet50_config_depth():
     from tensorflowonspark_tpu.models.resnet import ResNetConfig
 
