@@ -67,11 +67,19 @@ def _sample_rows(logits, key, temps, top_k, top_p):
     engine-wide). A row with ``temps == 0`` is greedy; a sampled row
     truncates by the engine's top_k/top_p on its temperature-scaled
     distribution (nucleus-on-scaled, matching the standard stacks).
+
+    Returns ``(tokens (B,) int32, logprobs (B,) fp32)`` — the logprob
+    of each chosen token under the RAW (unscaled) model distribution,
+    the same convention the /score surface reports, so sampled and
+    scored numbers compare directly.
     """
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
     sampled = sample_logits(scaled, key, 1.0, top_k, top_p)
-    return jnp.where(temps > 0, sampled, greedy)
+    tok = jnp.where(temps > 0, sampled, greedy)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    lp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
+    return tok, lp
 
 
 @dataclasses.dataclass
@@ -84,16 +92,17 @@ class _Pending:
     submitted_at: float = 0.0  # time.monotonic() at enqueue
     first_token_at: float | None = None  # set when token 0 emits
     result: list[int] | None = None
+    logprobs: list[float] | None = None  # filled at retirement
     error: BaseException | None = None
     # streaming: every emitted token is ALSO pushed here as it decodes,
     # then True (done) or the error object as the terminal item
     sink: "queue.Queue | None" = None
 
-    def emit(self, token: int) -> None:
+    def emit(self, token: int, logprob: float) -> None:
         if self.first_token_at is None:
             self.first_token_at = time.monotonic()
         if self.sink is not None:
-            self.sink.put(token)
+            self.sink.put((token, logprob))
 
     def finish(self) -> None:
         if self.sink is not None:
@@ -219,11 +228,11 @@ class ContinuousBatcher:
         # Device-resident engine state (built lazily on first request so
         # constructing an engine is cheap in tests/CLIs that never run).
         self._state = None
-        # Host-side per-slot bookkeeping: None = free, else the _Pending
-        # plus its accumulated output tokens.
-        self._live: list[tuple[_Pending, list[int]] | None] = [
-            None
-        ] * self._slots
+        # Host-side per-slot bookkeeping: None = free, else
+        # (_Pending, output tokens, output logprobs).
+        self._live: list[
+            tuple[_Pending, list[int], list[float]] | None
+        ] = [None] * self._slots
         self.steps = 0  # observability: engine decode steps taken
         self.admitted = 0
         self.completed = 0
@@ -339,18 +348,23 @@ class ContinuousBatcher:
         max_new_tokens: int,
         temperature: float | None = None,
         eos_id: int | None = None,
-    ) -> list[int]:
+        return_logprobs: bool = False,
+    ) -> "list[int] | tuple[list[int], list[float]]":
         """Blocking decode. ``temperature`` and ``eos_id`` override the
         engine-wide defaults FOR THIS REQUEST (temperature is a traced
         per-row input — no recompilation; 0 = greedy; eos is host-side
         retirement bookkeeping, a NEGATIVE value disables EOS stopping
-        entirely for this request). top_k/top_p stay engine-wide."""
+        entirely for this request). top_k/top_p stay engine-wide.
+        ``return_logprobs``: also return each emitted token's logprob
+        under the raw model distribution (the /score convention)."""
         p = self._enqueue(
             tokens, max_new_tokens, temperature=temperature, eos_id=eos_id
         )
         p.event.wait()
         if p.error is not None:
             raise p.error
+        if return_logprobs:
+            return p.result, p.logprobs
         return p.result
 
     def submit_many(
@@ -359,7 +373,8 @@ class ContinuousBatcher:
         max_new_tokens: int,
         temperature: float | None = None,
         eos_id: int | None = None,
-    ) -> list[list[int]]:
+        return_logprobs: bool = False,
+    ) -> "list[list[int]] | tuple[list[list[int]], list[list[float]]]":
         """Blocking decode of several prompts admitted ATOMICALLY (all
         rows accepted or an EngineOverloaded/ValueError before any row
         enters the queue) — the multi-row /generate path. Rows decode
@@ -375,6 +390,8 @@ class ContinuousBatcher:
         for p in ps:
             if p.error is not None:
                 raise p.error
+        if return_logprobs:
+            return [p.result for p in ps], [p.logprobs for p in ps]
         return [p.result for p in ps]
 
     def stream(
@@ -383,6 +400,7 @@ class ContinuousBatcher:
         max_new_tokens: int,
         temperature: float | None = None,
         eos_id: int | None = None,
+        yield_logprobs: bool = False,
     ):
         """Yield completion tokens AS THEY DECODE (one engine step of
         latency each) instead of blocking for the full result.
@@ -394,7 +412,8 @@ class ContinuousBatcher:
         request fails mid-decode; closing it early does not cancel the
         slot (the row runs out its budget — token-level cancellation
         would need a host→loop signal the scheduler checks per step,
-        not worth it at this granularity)."""
+        not worth it at this granularity). ``yield_logprobs``: yield
+        ``(token, logprob)`` pairs instead of bare tokens."""
         p = self._enqueue(
             tokens,
             max_new_tokens,
@@ -410,7 +429,8 @@ class ContinuousBatcher:
                     return
                 if isinstance(item, BaseException):
                     raise item
-                yield item
+                token, lp = item
+                yield (token, lp) if yield_logprobs else token
 
         return drain()
 
@@ -484,12 +504,20 @@ class ContinuousBatcher:
                 padded=True,
                 mutable=["cache"],
             )
-            nxt = _sample_rows(logits[:, -1], key, temps, top_k, top_p)
+            # The per-step logprob costs one (slots, vocab) fp32
+            # log_softmax (~1 MB at 8x32k ≈ a few µs of HBM time vs the
+            # ~GB of weight reads bounding the step) and a (slots,)
+            # host fetch that rides the existing token fetch — cheap
+            # enough to keep unconditional rather than doubling the
+            # compiled-variant count.
+            nxt, lp = _sample_rows(
+                logits[:, -1], key, temps, top_k, top_p
+            )
             # Clamp so a retired-but-not-yet-reused row parked at the
             # cache edge never scatters out of bounds (its writes are
             # garbage either way; admission overwrites the whole row).
             nxt_pos = jnp.minimum(pos + 1, model.cfg.max_seq_len - 1)
-            return constrain(updated["cache"]), nxt, nxt_pos
+            return constrain(updated["cache"]), nxt, nxt_pos, lp
 
         return step
 
@@ -518,8 +546,8 @@ class ContinuousBatcher:
             last = jnp.take_along_axis(
                 logits, (length - 1)[:, None, None], axis=1
             )[:, 0]
-            tok = _sample_rows(last, key, temps, top_k, top_p)
-            return constrain(state["cache"]), tok, length
+            tok, lp = _sample_rows(last, key, temps, top_k, top_p)
+            return constrain(state["cache"]), tok, length, lp
 
         self._prefill_cache[width] = prefill
         return prefill
@@ -599,7 +627,7 @@ class ContinuousBatcher:
             else float(p.temperature)
         )
         temp_1 = jnp.asarray([temp], jnp.float32)
-        cache_1, tok_1, pos_1 = self._prefill_fn(w)(
+        cache_1, tok_1, pos_1, lp_1 = self._prefill_fn(w)(
             self._params,
             jnp.asarray(prompt),
             jnp.asarray([len(p.tokens)], jnp.int32),
@@ -612,9 +640,10 @@ class ContinuousBatcher:
         )
         first = int(np.asarray(tok_1)[0])
         out = [first]
-        self._live[row] = (p, out)
+        lps = [float(np.asarray(lp_1)[0])]
+        self._live[row] = (p, out, lps)
         self.admitted += 1
-        p.emit(first)
+        p.emit(first, lps[0])
         if self._finished(p, out, first):
             self._retire(row)
         return cache, tok, pos, temps
@@ -632,7 +661,7 @@ class ContinuousBatcher:
         )
 
     def _retire(self, row: int) -> None:
-        p, out = self._live[row]
+        p, out, lps = self._live[row]
         self._live[row] = None
         now = time.monotonic()
         self.tokens_emitted += len(out)
@@ -644,6 +673,7 @@ class ContinuousBatcher:
         # fabricate zero/low latency averages.
         self.completed += 1
         p.result = out
+        p.logprobs = lps
         p.finish()
         p.event.set()
 
@@ -697,18 +727,20 @@ class ContinuousBatcher:
                 if all(e is None for e in self._live):
                     continue  # retired on admission; go block again
 
-                cache, tok, pos = self._step_fn(
+                cache, tok, pos, lp = self._step_fn(
                     self._params, cache, tok, pos, temps, self._next_key()
                 )
                 self.steps += 1
                 host_tok = np.asarray(tok)
+                host_lp = np.asarray(lp)
                 for row, entry in enumerate(self._live):
                     if entry is None:
                         continue
-                    p, out = entry
+                    p, out, lps = entry
                     t = int(host_tok[row])
                     out.append(t)
-                    p.emit(t)
+                    lps.append(float(host_lp[row]))
+                    p.emit(t, lps[-1])
                     if self._finished(p, out, t):
                         self._retire(row)
         except BaseException as e:  # noqa: BLE001 - ferry to waiters

@@ -193,15 +193,17 @@ class _Handler(BaseHTTPRequestHandler):
             temperature = payload.get("temperature")
             max_new = payload.get("max_new_tokens")
             eos_id = payload.get("eos_id")
+            want_logprobs = bool(payload.get("logprobs"))
             if (
                 temperature is not None
                 or max_new is not None
                 or eos_id is not None
+                or want_logprobs
             ) and self.gen_engine is None:
                 raise ValueError(
-                    "per-request temperature/max_new_tokens/eos_id "
-                    "require --gen-engine continuous (the fixed path "
-                    "bakes decode params at startup)"
+                    "per-request temperature/max_new_tokens/eos_id/"
+                    "logprobs require --gen-engine continuous (the "
+                    "fixed path bakes decode params at startup)"
                 )
             if temperature is not None:
                 temperature = float(temperature)
@@ -233,16 +235,22 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         if stream:
-            self._engine_stream(prompts[0], temperature, max_new, eos_id)
+            self._engine_stream(
+                prompts[0], temperature, max_new, eos_id, want_logprobs
+            )
             return
         from tensorflowonspark_tpu.serving import EngineOverloaded
 
+        logprobs = None
         try:
             if self.gen_engine is not None:
                 try:
                     completions = self._engine_generate(
-                        prompts, temperature, max_new, eos_id
+                        prompts, temperature, max_new, eos_id,
+                        want_logprobs,
                     )
+                    if want_logprobs:
+                        completions, logprobs = completions
                 except EngineOverloaded as e:
                     self._reply(
                         503, {"error": str(e)}, {"Retry-After": "1"}
@@ -269,10 +277,18 @@ class _Handler(BaseHTTPRequestHandler):
             logger.exception("generation failed")
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
             return
-        self._reply(200, {"completions": completions})
+        body = {"completions": completions}
+        if logprobs is not None:
+            body["logprobs"] = logprobs
+        self._reply(200, body)
 
     def _engine_stream(
-        self, prompt, temperature=None, max_new=None, eos_id=None
+        self,
+        prompt,
+        temperature=None,
+        max_new=None,
+        eos_id=None,
+        want_logprobs=False,
     ) -> None:
         """Stream one completion as newline-delimited JSON: a
         ``{"token": t}`` line per decoded token (one engine step of
@@ -288,6 +304,7 @@ class _Handler(BaseHTTPRequestHandler):
                 max_new or self.gen_max_new,
                 temperature=temperature,
                 eos_id=eos_id,
+                yield_logprobs=want_logprobs,
             )
         except EngineOverloaded as e:
             self._reply(503, {"error": str(e)}, {"Retry-After": "1"})
@@ -300,17 +317,23 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Connection", "close")
         self.end_headers()
         out: list = []
+        lps: list = []
         try:
-            for t in gen:
+            for item in gen:
+                if want_logprobs:
+                    t, lp = item
+                    lps.append(lp)
+                    line = {"token": t, "logprob": lp}
+                else:
+                    t = item
+                    line = {"token": t}
                 out.append(t)
-                self.wfile.write(
-                    json.dumps({"token": t}).encode() + b"\n"
-                )
+                self.wfile.write(json.dumps(line).encode() + b"\n")
                 self.wfile.flush()
-            self.wfile.write(
-                json.dumps({"done": True, "completion": out}).encode()
-                + b"\n"
-            )
+            trailer = {"done": True, "completion": out}
+            if want_logprobs:
+                trailer["logprobs"] = lps
+            self.wfile.write(json.dumps(trailer).encode() + b"\n")
         except (BrokenPipeError, ConnectionResetError):
             logger.info("stream client disconnected")
         except Exception as e:  # noqa: BLE001 - status already sent
@@ -326,7 +349,12 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
 
     def _engine_generate(
-        self, prompts, temperature=None, max_new=None, eos_id=None
+        self,
+        prompts,
+        temperature=None,
+        max_new=None,
+        eos_id=None,
+        want_logprobs=False,
     ):
         """Continuous-batching path: the request's rows are admitted
         ATOMICALLY (all accepted, or a 400/503 before any decodes — a
@@ -338,6 +366,7 @@ class _Handler(BaseHTTPRequestHandler):
             max_new or self.gen_max_new,
             temperature=temperature,
             eos_id=eos_id,
+            return_logprobs=want_logprobs,
         )
 
 

@@ -414,22 +414,44 @@ def test_serve_model_continuous_engine(tmp_path):
         assert stats["steps"] > 0 and not stats["closed"]
 
         # streaming: NDJSON token lines + a done trailer matching the
-        # non-streamed completion for the same prompt
-        req = urllib.request.Request(
-            f"http://127.0.0.1:{port}/generate",
-            data=json.dumps(
-                {"prompts": [[1, 2, 3]], "stream": True}
-            ).encode(),
-            headers={"Content-Type": "application/json"},
+        # non-streamed completion for the same prompt; with logprobs
+        # each line carries the token's raw-distribution logprob
+        for with_lp in (False, True):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps(
+                    {"prompts": [[1, 2, 3]], "stream": True,
+                     "logprobs": with_lp}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as r:
+                assert r.headers["Content-Type"] == "application/x-ndjson"
+                lines = [json.loads(l) for l in r.read().splitlines()]
+            want = np.asarray(
+                generate(
+                    model, params, jnp.asarray([[1, 2, 3]], jnp.int32), 5
+                )
+            )[0].tolist()
+            assert [l["token"] for l in lines[:-1]] == want
+            assert lines[-1]["done"] and lines[-1]["completion"] == want
+            if with_lp:
+                assert all("logprob" in l for l in lines[:-1])
+                assert lines[-1]["logprobs"] == [
+                    l["logprob"] for l in lines[:-1]
+                ]
+            else:
+                assert "logprobs" not in lines[-1]
+
+        # per-token logprobs ride along when asked (engine mode)
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[1, 2, 3]], "logprobs": True},
         )
-        with urllib.request.urlopen(req) as r:
-            assert r.headers["Content-Type"] == "application/x-ndjson"
-            lines = [json.loads(l) for l in r.read().splitlines()]
-        want = np.asarray(
-            generate(model, params, jnp.asarray([[1, 2, 3]], jnp.int32), 5)
-        )[0].tolist()
-        assert [l["token"] for l in lines[:-1]] == want
-        assert lines[-1] == {"done": True, "completion": want}
+        assert code == 200
+        assert len(body["logprobs"]) == 1
+        assert len(body["logprobs"][0]) == len(body["completions"][0])
+        assert all(lp <= 0.0 for lp in body["logprobs"][0])
 
         # per-request decode budget (capped by the server's config)
         code, body = _post(

@@ -132,6 +132,25 @@ def test_engine_tp_mesh_token_identical(tiny):
         tp.close()
 
 
+def test_engine_logprobs_match_score_surface(tiny):
+    """return_logprobs: each emitted token's logprob (raw-distribution
+    convention) must equal what the /score surface reports for the same
+    positions of prompt+completion — the two surfaces must agree."""
+    from tensorflowonspark_tpu.tools.generate_text import build_score_fn
+
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=1, prompt_widths=(8,))
+    try:
+        toks, lps = eng.submit([1, 2, 3], 5, return_logprobs=True)
+        assert len(lps) == len(toks) == 5
+        score = build_score_fn(model, params, width=16, bsz=1)
+        full = [1, 2, 3] + toks
+        slps = score([full])[0]
+        np.testing.assert_allclose(lps, slps[-len(toks):], atol=1e-4)
+    finally:
+        eng.close()
+
+
 def test_engine_multi_width_buckets(tiny):
     """Prompts prefill at the smallest bucket that fits; decode output
     is bucket-invariant (the padding slots past the true length are
