@@ -115,6 +115,19 @@ class _Pending:
         self.event.set()
 
 
+@dataclasses.dataclass
+class _PrefillJob:
+    """A chunked prefill in flight: one slot reserved, the single-row
+    cache accumulating chunk by chunk between decode steps."""
+
+    p: _Pending
+    row: int
+    cache_1: object
+    next_pos: int  # next chunk's start offset into the prompt
+    length: int
+    temp_1: object  # (1,) fp32
+
+
 class ContinuousBatcher:
     """Persistent B-slot decode engine over one Llama checkpoint.
 
@@ -148,6 +161,7 @@ class ContinuousBatcher:
         seed: int = 0,
         mesh=None,
         max_queue: int | None = None,
+        prefill_chunk: int | None = None,
     ):
         cfg = model.cfg
         self._model = model
@@ -217,6 +231,11 @@ class ContinuousBatcher:
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self._max_queue = max_queue
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
+        self._prefill_chunk = prefill_chunk
         self._queue: "queue.Queue" = queue.Queue()
         self._closed = False
         self._submit_lock = threading.Lock()
@@ -224,6 +243,10 @@ class ContinuousBatcher:
         # The request popped from the queue but not yet parked in a slot
         # — must be failed explicitly if the loop dies mid-admission.
         self._inflight: _Pending | None = None
+        # Chunked-prefill job in flight (loop thread only); its request
+        # is in neither _live nor the queue, so shutdown/death paths
+        # must fail it explicitly.
+        self._job: _PrefillJob | None = None
 
         # Device-resident engine state (built lazily on first request so
         # constructing an engine is cheap in tests/CLIs that never run).
@@ -269,7 +292,9 @@ class ContinuousBatcher:
             raise ValueError(
                 f"temperature must be finite and >= 0, got {temperature}"
             )
-        if len(tokens) > self._widths[-1]:
+        if self._prefill_chunk is None and len(tokens) > self._widths[-1]:
+            # chunked prefill never touches the width buckets — its only
+            # cap is the KV capacity checked below
             raise ValueError(
                 f"prompt length {len(tokens)} exceeds the largest "
                 f"prompt width {self._widths[-1]}"
@@ -437,7 +462,11 @@ class ContinuousBatcher:
     def stats(self) -> dict:
         """Scheduler observability (served at the HTTP ``/stats``
         endpoint): slot occupancy, queue depth, lifetime counters."""
-        busy = sum(e is not None for e in self._live)
+        # a chunked prefill holds a reserved slot that is not yet in
+        # _live — it IS load, so capacity math must see it
+        busy = sum(e is not None for e in self._live) + (
+            self._job is not None
+        )
         done = self.completed
         return {
             "slots": self._slots,
@@ -447,6 +476,7 @@ class ContinuousBatcher:
             "admitted": self.admitted,
             "completed": done,
             "tokens_emitted": self.tokens_emitted,
+            "prefill_in_progress": self._job is not None,
             # queue wait + prefill, averaged over completed requests
             "ttft_avg_ms": round(self._ttft_sum / done * 1e3, 3)
             if done
@@ -577,6 +607,132 @@ class ContinuousBatcher:
 
         return admit
 
+    @functools.cached_property
+    def _chunk_fn(self):
+        """One prompt chunk through the model against the single-row
+        cache — the unit a chunked prefill interleaves with decode
+        steps. One compile for (1, prefill_chunk)."""
+        model = self._model
+        constrain = self._constrain_cache
+
+        @jax.jit
+        def chunk(params, cache, tokens, positions):
+            logits, updated = model.apply(
+                {"params": params, "cache": cache},
+                tokens,
+                positions=positions,
+                decode=True,
+                padded=True,
+                mutable=["cache"],
+            )
+            return constrain(updated["cache"]), logits
+
+        return chunk
+
+    @functools.cached_property
+    def _sample1_fn(self):
+        top_k, top_p = self._top_k, self._top_p
+
+        @jax.jit
+        def sample1(logits_chunk, idx, temps, key):
+            last = jax.lax.dynamic_index_in_dim(
+                logits_chunk, idx, axis=1, keepdims=False
+            )  # (1, vocab): the prompt's true last position
+            return _sample_rows(last, key, temps, top_k, top_p)
+
+        return sample1
+
+    @functools.cached_property
+    def _single_row_cache_shapes(self):
+        # Shape derivation traces the whole model — a constant, NOT
+        # per-admission work on the scheduler thread (a per-request
+        # trace would stall live rows' step dispatch, exactly the
+        # latency chunked prefill exists to remove).
+        _, shapes = jax.eval_shape(
+            lambda p, t, pos: self._model.apply(
+                {"params": p},
+                t,
+                positions=pos,
+                decode=True,
+                padded=True,
+                mutable=["cache"],
+            ),
+            self._params,
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        )
+        return shapes["cache"]
+
+    def _single_row_cache(self):
+        return jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            self._single_row_cache_shapes,
+        )
+
+    def _start_job(self, p: _Pending, row: int) -> _PrefillJob:
+        temp = (
+            self._temperature
+            if p.temperature is None
+            else float(p.temperature)
+        )
+        return _PrefillJob(
+            p=p,
+            row=row,
+            cache_1=self._single_row_cache(),
+            next_pos=0,
+            length=len(p.tokens),
+            temp_1=jnp.asarray([temp], jnp.float32),
+        )
+
+    def _advance_job(self, cache, tok, pos, temps):
+        """Run ONE chunk of the in-flight prefill; on the final chunk,
+        sample the first token and scatter the row into the batch.
+        Chunks cover only the true prompt length — the padding region a
+        full-width prefill would burn compute on is never touched."""
+        job = self._job
+        c = self._prefill_chunk
+        start = job.next_pos
+        toks = np.zeros((1, c), np.int32)
+        piece = job.p.tokens[start : start + c]
+        toks[0, : len(piece)] = piece
+        positions = np.arange(start, start + c, dtype=np.int32)[None, :]
+        job.cache_1, logits = self._chunk_fn(
+            self._params,
+            job.cache_1,
+            jnp.asarray(toks),
+            jnp.asarray(positions),
+        )
+        job.next_pos += c
+        if job.next_pos < job.length:
+            return cache, tok, pos, temps
+        # final chunk: it contains the prompt's last true position
+        tok_1, lp_1 = self._sample1_fn(
+            logits,
+            jnp.int32(job.length - 1 - start),
+            job.temp_1,
+            self._next_key(),
+        )
+        cache, tok, pos, temps = self._admit_fn(
+            cache,
+            job.cache_1,
+            jnp.int32(job.row),
+            tok,
+            tok_1,
+            pos,
+            jnp.asarray([job.length], jnp.int32),
+            temps,
+            job.temp_1,
+        )
+        first = int(np.asarray(tok_1)[0])
+        lps = [float(np.asarray(lp_1)[0])]
+        self._live[job.row] = (job.p, [first], lps)
+        self.admitted += 1
+        job.p.emit(first, lps[0])
+        if self._finished(job.p, [first], first):
+            self._retire(job.row)
+        self._job = None
+        return cache, tok, pos, temps
+
     # -- engine loop ---------------------------------------------------
 
     def _empty_state(self):
@@ -695,15 +851,27 @@ class ContinuousBatcher:
         cache = tok = pos = temps = None
         try:
             while True:
-                idle = all(e is None for e in self._live)
-                # Admit as many queued requests as there are free slots;
-                # block only when fully idle.
+                idle = (
+                    all(e is None for e in self._live)
+                    and self._job is None
+                )
+                # Admit queued requests into free slots (chunked mode:
+                # start at most one prefill job, advanced one chunk per
+                # iteration below); block only when fully idle.
                 while True:
                     free = [
-                        i for i, e in enumerate(self._live) if e is None
+                        i
+                        for i, e in enumerate(self._live)
+                        if e is None
+                        and (self._job is None or self._job.row != i)
                     ]
                     if not free:
                         break
+                    if (
+                        self._prefill_chunk is not None
+                        and self._job is not None
+                    ):
+                        break  # one chunked prefill at a time
                     try:
                         item = (
                             self._queue.get()
@@ -713,19 +881,30 @@ class ContinuousBatcher:
                     except queue.Empty:
                         break
                     if item is self._STOP:
+                        # no live job possible here: the admit loop
+                        # breaks before queue.get while a job runs, so
+                        # a queued STOP is only reached after it ends
                         self._fail_all(RuntimeError("engine shutting down"))
                         return
                     self._inflight = item
                     if cache is None:
                         cache, tok, pos, temps = self._empty_state()
-                    cache, tok, pos, temps = self._admit_one(
-                        item, free[0], cache, tok, pos, temps
-                    )
+                    if self._prefill_chunk is None:
+                        cache, tok, pos, temps = self._admit_one(
+                            item, free[0], cache, tok, pos, temps
+                        )
+                    else:
+                        self._job = self._start_job(item, free[0])
                     self._inflight = None
-                    idle = all(e is None for e in self._live)
+                    idle = False
+
+                if self._job is not None:
+                    cache, tok, pos, temps = self._advance_job(
+                        cache, tok, pos, temps
+                    )
 
                 if all(e is None for e in self._live):
-                    continue  # retired on admission; go block again
+                    continue  # nothing decoding; admit/chunk again
 
                 cache, tok, pos, lp = self._step_fn(
                     self._params, cache, tok, pos, temps, self._next_key()
@@ -753,4 +932,7 @@ class ContinuousBatcher:
             if self._inflight is not None:
                 self._inflight.fail(e)
                 self._inflight = None
+            if self._job is not None:
+                self._job.p.fail(e)
+                self._job = None
             self._fail_all(e)

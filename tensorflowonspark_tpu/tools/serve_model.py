@@ -605,6 +605,7 @@ def _build_engine(gen: dict):
         seed=int(gen.get("seed", 0)),
         mesh=mesh,
         max_queue=gen.get("max_queue"),
+        prefill_chunk=gen.get("prefill_chunk"),
     )
     return engine, max_new, model, engine._params
 
@@ -911,6 +912,17 @@ def main(argv: list[str] | None = None) -> int:
         help="continuous engine: shed load with HTTP 503 once this "
         "many requests are waiting for a slot (default: unbounded)",
     )
+    p.add_argument(
+        "--gen-prefill-chunk",
+        type=int,
+        default=None,
+        help="continuous engine: prefill prompts in chunks of this "
+        "many tokens interleaved with decode steps, so a long "
+        "admission doesn't stall live requests for its whole prefill "
+        "(also skips the padding region: a short prompt costs "
+        "ceil(len/chunk) chunks, not the full width bucket); default: "
+        "whole-bucket prefill",
+    )
     args = p.parse_args(argv)
     if args.export_dir is None and args.llama_checkpoint is None:
         p.error("need --export-dir and/or --llama-checkpoint")
@@ -939,6 +951,7 @@ def main(argv: list[str] | None = None) -> int:
             slots=args.gen_slots,
             widths=args.gen_widths,
             max_queue=args.gen_max_queue,
+            prefill_chunk=args.gen_prefill_chunk,
         )
     server = make_server(
         args.export_dir, args.port, args.batch_size, host=args.host, gen=gen
