@@ -349,6 +349,26 @@ def test_engine_stream_failure_raises_in_consumer(tiny):
     eng.close()
 
 
+def test_engine_composes_with_moe():
+    """A routed-expert (MoE) Llama decodes through the engine and
+    matches generate() on the same tree — serving works for the MoE
+    family too, not just dense."""
+    cfg = LlamaConfig.tiny(
+        dtype=jnp.float32, remat=False, num_experts=4, moe_top_k=2
+    )
+    model = Llama(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    eng = ContinuousBatcher(model, params, slots=2, prompt_widths=(8,))
+    try:
+        assert eng.submit([1, 2, 3], 5) == _reference(
+            model, params, [1, 2, 3], 5
+        )
+    finally:
+        eng.close()
+
+
 def test_engine_composes_with_int8_weights(tiny):
     """A quantize_tree'd param tree rides the engine unchanged (QDense
     consumes QuantTensor leaves natively) and matches generate() run on
