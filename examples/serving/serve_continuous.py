@@ -83,6 +83,7 @@ def main() -> int:
         "--max-new-tokens", "12",
         "--gen-engine", "continuous",
         "--gen-slots", str(args.slots),
+        "--gen-prefill-chunk", "8",  # long admissions interleave
         "--port", "0",
     ]
     if args.gen_mesh:
@@ -127,11 +128,11 @@ def main() -> int:
         print(f"prompt={p['prompts'][0]} temp={p['temperature']} "
               f"-> {r['completions'][0]}")
 
-    # stream a completion token by token
+    # stream a completion token by token, with per-token logprobs
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/generate",
         data=json.dumps(
-            {"prompts": [[1, 2, 3]], "stream": True}
+            {"prompts": [[1, 2, 3]], "stream": True, "logprobs": True}
         ).encode(),
         headers={"Content-Type": "application/json"},
     )
@@ -140,9 +141,18 @@ def main() -> int:
         for line in r:
             msg = json.loads(line)
             if "token" in msg:
-                print(msg["token"], end=" ", flush=True)
+                print(
+                    f"{msg['token']}({msg['logprob']:.2f})",
+                    end=" ",
+                    flush=True,
+                )
             elif msg.get("done"):
                 print("(done)")
+            elif "error" in msg:
+                # mid-stream failure arrives as an error line (the 200
+                # status is already on the wire) — fail the demo
+                print(f"(stream failed: {msg['error']})")
+                return 1
 
     with urllib.request.urlopen(f"http://127.0.0.1:{port}/stats") as r:
         print("stats:", json.dumps(json.loads(r.read()), indent=2))

@@ -307,6 +307,33 @@ def test_engine_chunked_prefill_token_identical(tiny):
         chunked.close()
 
 
+def test_engine_chunked_tp_logprobs_compose(tiny):
+    """The kitchen sink: chunked prefill + TP mesh + logprobs in one
+    engine must still be token- and logprob-identical to the plain
+    single-device unchunked engine."""
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+
+    cfg, model, params = tiny
+    plain = ContinuousBatcher(model, params, slots=2, prompt_widths=(8,))
+    combo = ContinuousBatcher(
+        model,
+        params,
+        slots=2,
+        prompt_widths=(8,),
+        prefill_chunk=3,
+        mesh=make_mesh({"data": 4, "model": 2}),
+    )
+    try:
+        for p in ([1, 2, 3, 4, 5], [7, 7]):
+            want = plain.submit(p, 5, return_logprobs=True)
+            got = combo.submit(p, 5, return_logprobs=True)
+            assert got[0] == want[0], p
+            np.testing.assert_allclose(got[1], want[1], atol=1e-5)
+    finally:
+        plain.close()
+        combo.close()
+
+
 def test_engine_loop_death_fails_waiters_not_hangs(tiny):
     """If the loop dies mid-admission (e.g. a compile failure), the
     request being admitted and all later submits must FAIL, not block
