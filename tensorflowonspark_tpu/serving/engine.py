@@ -238,6 +238,7 @@ class ContinuousBatcher:
         self._prefill_chunk = prefill_chunk
         self._queue: "queue.Queue" = queue.Queue()
         self._closed = False
+        self._stop_now = threading.Event()
         self._submit_lock = threading.Lock()
         self._prefill_cache: dict = {}
         # The request popped from the queue but not yet parked in a slot
@@ -487,13 +488,38 @@ class ContinuousBatcher:
             "closed": self._closed,
         }
 
-    def close(self) -> None:
-        """Stop the loop; in-flight and queued requests are failed."""
+    def close(self, drain: bool = False, drain_timeout: float = 300.0) -> None:
+        """Stop the loop. Default: queued requests fail and live rows
+        are failed once the STOP marker is reached (abrupt shutdown).
+        ``drain=True``: refuse new submits immediately but let every
+        already-accepted request (queued, prefilling, decoding) run to
+        completion first — the production drain — up to
+        ``drain_timeout`` seconds before falling back to the abrupt
+        path."""
         with self._submit_lock:
             if self._closed:
                 return
             self._closed = True
+            if not drain:
+                self._queue.put(self._STOP)
+        if drain:
+            deadline = time.monotonic() + drain_timeout
+            while time.monotonic() < deadline:
+                busy = (
+                    any(e is not None for e in self._live)
+                    or self._job is not None
+                    or self._inflight is not None
+                    or not self._queue.empty()
+                )
+                if not busy:
+                    break
+                time.sleep(0.05)
             self._queue.put(self._STOP)
+        # The queued STOP only wakes a loop BLOCKED on the queue; a loop
+        # busy decoding full slots never pops it (the admit loop breaks
+        # first). The event makes the abrupt path reach that case too —
+        # checked at the top of every scheduler iteration.
+        self._stop_now.set()
         self._thread.join(timeout=60)
 
     # -- compiled pieces ----------------------------------------------
@@ -851,6 +877,13 @@ class ContinuousBatcher:
         cache = tok = pos = temps = None
         try:
             while True:
+                if self._stop_now.is_set():
+                    err = RuntimeError("engine shutting down")
+                    if self._job is not None:
+                        self._job.p.fail(err)
+                        self._job = None
+                    self._fail_all(err)
+                    return
                 idle = (
                     all(e is None for e in self._live)
                     and self._job is None

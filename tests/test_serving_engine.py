@@ -200,6 +200,38 @@ def test_engine_bounded_queue_sheds_load(tiny):
         eng.close()
 
 
+def test_engine_graceful_drain(tiny):
+    """close(drain=True): new submits are refused immediately, but
+    already-accepted requests complete with their full results instead
+    of being failed mid-decode — including with a free slot left over
+    (the STOP marker must not outrun still-draining rows)."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=3, prompt_widths=(8,))
+    eng.submit([9], 1)  # warm the compiles so timing is deterministic
+    results: dict = {}
+
+    def req(name, prompt, budget):
+        results[name] = eng.submit(prompt, budget)
+
+    threads = [
+        threading.Thread(target=req, args=("a", [1, 2], 12)),
+        threading.Thread(target=req, args=("b", [5], 9)),
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 60
+    while eng.stats()["slots_busy"] < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    eng.close(drain=True)
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive()
+    assert results["a"] == _reference(model, params, [1, 2], 12)
+    assert results["b"] == _reference(model, params, [5], 9)
+    with pytest.raises(RuntimeError, match="shutting down"):
+        eng.submit([3], 2)
+
+
 def test_engine_validates_and_shutdown(tiny):
     cfg, model, params = tiny
     eng = ContinuousBatcher(model, params, slots=1, prompt_widths=(4,))
