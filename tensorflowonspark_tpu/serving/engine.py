@@ -260,6 +260,15 @@ class ContinuousBatcher:
         self.steps = 0  # observability: engine decode steps taken
         self.admitted = 0
         self.completed = 0
+        # Accepted-but-not-yet-resolved accounting for the drain
+        # quiescence check: _accepted_total bumps under the submit lock
+        # at enqueue, and every request resolves as exactly one of
+        # completed or _failed_total. Sampling queue/_inflight/_live
+        # individually instead would race the scheduler's pop→park
+        # handoffs and let a drain declare "idle" around a request it
+        # promised to finish.
+        self._accepted_total = 0
+        self._failed_total = 0
         self.tokens_emitted = 0
         self._ttft_sum = 0.0  # seconds, summed over completed requests
         self._duration_sum = 0.0
@@ -352,6 +361,7 @@ class ContinuousBatcher:
                 raise EngineOverloaded(
                     f"request queue full ({self._max_queue} waiting)"
                 )
+            self._accepted_total += len(ps)
             for p in ps:
                 self._queue.put(p)
         return ps
@@ -505,13 +515,16 @@ class ContinuousBatcher:
         if drain:
             deadline = time.monotonic() + drain_timeout
             while time.monotonic() < deadline:
-                busy = (
-                    any(e is not None for e in self._live)
-                    or self._job is not None
-                    or self._inflight is not None
-                    or not self._queue.empty()
+                # Quiescence by ACCOUNTING, not structure-sampling:
+                # every accepted request resolves as exactly one of
+                # completed/failed, so this cannot race the scheduler's
+                # queue-pop → _inflight → slot handoffs (a structural
+                # check could observe the instant a request is in none
+                # of those places and wrongly declare idle).
+                unresolved = self._accepted_total - (
+                    self.completed + self._failed_total
                 )
-                if not busy:
+                if unresolved == 0:
                     break
                 time.sleep(0.05)
             self._queue.put(self._STOP)
@@ -859,10 +872,14 @@ class ContinuousBatcher:
         p.finish()
         p.event.set()
 
+    def _fail_one(self, p: _Pending, err: BaseException) -> None:
+        self._failed_total += 1
+        p.fail(err)
+
     def _fail_all(self, err: BaseException) -> None:
         for row, entry in enumerate(self._live):
             if entry is not None:
-                entry[0].fail(err)
+                self._fail_one(entry[0], err)
                 self._live[row] = None
         while True:
             try:
@@ -871,7 +888,7 @@ class ContinuousBatcher:
                 return
             if item is self._STOP:
                 continue
-            item.fail(RuntimeError("engine shutting down"))
+            self._fail_one(item, RuntimeError("engine shutting down"))
 
     def _loop(self) -> None:
         cache = tok = pos = temps = None
@@ -880,7 +897,7 @@ class ContinuousBatcher:
                 if self._stop_now.is_set():
                     err = RuntimeError("engine shutting down")
                     if self._job is not None:
-                        self._job.p.fail(err)
+                        self._fail_one(self._job.p, err)
                         self._job = None
                     self._fail_all(err)
                     return
@@ -963,9 +980,9 @@ class ContinuousBatcher:
             with self._submit_lock:
                 self._closed = True
             if self._inflight is not None:
-                self._inflight.fail(e)
+                self._fail_one(self._inflight, e)
                 self._inflight = None
             if self._job is not None:
-                self._job.p.fail(e)
+                self._fail_one(self._job.p, e)
                 self._job = None
             self._fail_all(e)

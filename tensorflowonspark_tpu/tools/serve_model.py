@@ -734,13 +734,16 @@ class _Server(ThreadingHTTPServer):
 
     gen_batcher = None
     gen_engine = None
+    drain_on_shutdown = False
 
     def shutdown(self) -> None:
         super().shutdown()
         if self.gen_batcher is not None:
             self.gen_batcher.close()
         if self.gen_engine is not None:
-            self.gen_engine.close()
+            # drain: accepted requests finish before the engine stops
+            # (--gen-drain-on-shutdown); default remains abrupt
+            self.gen_engine.close(drain=self.drain_on_shutdown)
 
 
 def make_server(
@@ -818,6 +821,9 @@ def make_server(
     server = _Server((host, port), handler)
     server.gen_batcher = batcher
     server.gen_engine = engine
+    server.drain_on_shutdown = bool(
+        gen.get("drain_on_shutdown") if gen else False
+    )
     return server
 
 
@@ -913,6 +919,12 @@ def main(argv: list[str] | None = None) -> int:
         "many requests are waiting for a slot (default: unbounded)",
     )
     p.add_argument(
+        "--gen-drain-on-shutdown",
+        action="store_true",
+        help="continuous engine: on server shutdown, finish accepted "
+        "requests before stopping instead of failing them",
+    )
+    p.add_argument(
         "--gen-prefill-chunk",
         type=int,
         default=None,
@@ -952,6 +964,7 @@ def main(argv: list[str] | None = None) -> int:
             widths=args.gen_widths,
             max_queue=args.gen_max_queue,
             prefill_chunk=args.gen_prefill_chunk,
+            drain_on_shutdown=args.gen_drain_on_shutdown,
         )
     server = make_server(
         args.export_dir, args.port, args.batch_size, host=args.host, gen=gen
