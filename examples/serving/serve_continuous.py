@@ -44,13 +44,15 @@ def ensure_checkpoint(path: str) -> None:
     from tensorflowonspark_tpu.compute.checkpoint import CheckpointManager
     from tensorflowonspark_tpu.models.llama import Llama, LlamaConfig
 
-    cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=False)
-    model = Llama(cfg)
-    params = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
-    )["params"]
-    state = TrainState.create(params, optax.sgd(0.1))
     with CheckpointManager(path, async_save=False) as mgr:
+        if mgr.latest_step() is not None:
+            return  # reuse the demo checkpoint from a previous run
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=False)
+        model = Llama(cfg)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        state = TrainState.create(params, optax.sgd(0.1))
         mgr.save(0, state, force=True)
 
 

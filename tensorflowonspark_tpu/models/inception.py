@@ -96,10 +96,13 @@ class _ConvBN(nn.Module):
         # the channel stats (fp32 accumulation over bf16 streams) — see
         # the chip-profile rationale in ops/batch_norm.py and the
         # measurement history in models/resnet.py.
+        # name= pins the pre-round-3 auto-name (nn.BatchNorm era) so
+        # checkpoints saved before the FusedBatchNorm swap restore as-is.
         x = FusedBatchNorm(
             momentum=0.9,
             epsilon=1e-3,
             dtype=self.dtype,
+            name="BatchNorm_0",
         )(x, use_running_average=not train)
         return nn.relu(x)
 

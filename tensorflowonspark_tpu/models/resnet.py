@@ -83,10 +83,13 @@ class _ConvBN(nn.Module):
         # passes under nn.BatchNorm + autodiff; the custom-VJP op computes
         # both channel statistics per direction in ONE variadic-reduce
         # pass over the bf16 activations (stats accumulate fp32).
+        # name= pins the pre-round-3 auto-name (nn.BatchNorm era) so
+        # checkpoints saved before the FusedBatchNorm swap restore as-is.
         x = FusedBatchNorm(
             momentum=0.9,
             epsilon=1e-5,
             dtype=self.dtype,
+            name="BatchNorm_0",
         )(x, use_running_average=not train)
         return nn.relu(x) if self.act else x
 

@@ -58,6 +58,7 @@ class VGG(nn.Module):
     def __call__(self, x, train: bool = False):
         cfg = self.config
         x = x.astype(cfg.dtype)
+        bn = 0  # running index pinning the pre-round-3 BatchNorm_N auto-names
         for stage, size in enumerate(cfg.stage_sizes):
             feats = cfg.width * 2 ** min(stage, 3)  # caps at 512 like the paper
             for _ in range(size):
@@ -71,7 +72,9 @@ class VGG(nn.Module):
                     momentum=0.9,
                     epsilon=1e-5,
                     dtype=cfg.dtype,
+                    name=f"BatchNorm_{bn}",
                 )(x, use_running_average=not train)
+                bn += 1
                 x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape(x.shape[0], -1)  # flatten the final grid (fc6 input)

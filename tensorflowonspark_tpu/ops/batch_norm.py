@@ -129,11 +129,15 @@ class FusedBatchNorm(nn.Module):
     statistics via :func:`fused_batch_norm` (one stats pass per
     direction) and updates fp32 running stats under the standard
     ``batch_stats`` collection, with ``nn.BatchNorm``'s variable names
-    (``mean``/``var``/``scale``/``bias``) and momentum convention. Note
-    the flax auto-naming of the submodule differs (``FusedBatchNorm_N``
-    vs ``BatchNorm_N``), so trees checkpointed under one module class do
-    not restore under the other without a rename. Eval: normalizes with
-    the running stats — a pure elementwise chain XLA fuses on its own.
+    (``mean``/``var``/``scale``/``bias``) and momentum convention. The
+    flax auto-name of this class differs from ``nn.BatchNorm``'s
+    (``FusedBatchNorm_N`` vs ``BatchNorm_N``), so the in-repo conv nets
+    pass an explicit ``name="BatchNorm_N"`` to keep their checkpoint
+    trees bit-compatible with the pre-swap era (see docs/SWITCHING.md
+    "BatchNorm checkpoint compatibility"); do the same in new models if
+    you need drop-in restore of ``nn.BatchNorm`` checkpoints. Eval:
+    normalizes with the running stats — a pure elementwise chain XLA
+    fuses on its own.
     """
 
     use_running_average: bool | None = None

@@ -216,11 +216,19 @@ class ContinuousBatcher:
             )
         self._params = params
         self._slots = int(slots)
+        if self._slots < 1:
+            # slots=0 would construct fine, then the scheduler thread
+            # busy-spins and every submit() waits forever on a free slot.
+            raise ValueError(f"slots must be >= 1, got {slots}")
         self._widths = tuple(sorted(int(w) for w in prompt_widths))
         if not self._widths or self._widths[-1] > cfg.max_seq_len:
             raise ValueError(
                 f"prompt_widths {prompt_widths} must be non-empty and "
                 f"<= max_seq_len ({cfg.max_seq_len})"
+            )
+        if self._widths[0] < 1:
+            raise ValueError(
+                f"prompt_widths must all be >= 1, got {prompt_widths}"
             )
         self._temperature = float(temperature)
         self._top_k = None if top_k is None else int(top_k)
@@ -231,9 +239,14 @@ class ContinuousBatcher:
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self._max_queue = max_queue
-        if prefill_chunk is not None and prefill_chunk < 1:
+        if prefill_chunk is not None and not (
+            1 <= prefill_chunk <= cfg.max_seq_len
+        ):
+            # The upper bound keeps _advance_job's window shift
+            # (start_w = min(start, max_seq_len - chunk)) non-negative.
             raise ValueError(
-                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+                f"prefill_chunk must be in [1, max_seq_len="
+                f"{cfg.max_seq_len}], got {prefill_chunk}"
             )
         self._prefill_chunk = prefill_chunk
         self._queue: "queue.Queue" = queue.Queue()
@@ -730,24 +743,32 @@ class ContinuousBatcher:
         full-width prefill would burn compute on is never touched."""
         job = self._job
         c = self._prefill_chunk
-        start = job.next_pos
+        # Shift the window back rather than letting positions run past
+        # max_seq_len: a final chunk starting at `start` would scatter
+        # rows at start+c-1 >= max_seq_len, which only works by JAX's
+        # silent out-of-bounds-scatter drop. Chunked prefill is
+        # causal-consistent, so re-processing the overlap start_w..start
+        # (already in the cache) recomputes identical K/V rows; every
+        # position stays in [0, max_seq_len) and distinct. __init__
+        # guarantees c <= max_seq_len, so start_w >= 0.
+        start_w = min(job.next_pos, self._model.cfg.max_seq_len - c)
         toks = np.zeros((1, c), np.int32)
-        piece = job.p.tokens[start : start + c]
+        piece = job.p.tokens[start_w : start_w + c]
         toks[0, : len(piece)] = piece
-        positions = np.arange(start, start + c, dtype=np.int32)[None, :]
+        positions = np.arange(start_w, start_w + c, dtype=np.int32)[None, :]
         job.cache_1, logits = self._chunk_fn(
             self._params,
             job.cache_1,
             jnp.asarray(toks),
             jnp.asarray(positions),
         )
-        job.next_pos += c
+        job.next_pos = start_w + c
         if job.next_pos < job.length:
             return cache, tok, pos, temps
         # final chunk: it contains the prompt's last true position
         tok_1, lp_1 = self._sample1_fn(
             logits,
-            jnp.int32(job.length - 1 - start),
+            jnp.int32(job.length - 1 - start_w),
             job.temp_1,
             self._next_key(),
         )

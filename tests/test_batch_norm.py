@@ -131,3 +131,33 @@ def test_grad_does_not_leak_through_running_stats():
         g_upd,
         g_pure,
     )
+
+
+def test_conv_nets_keep_batchnorm_checkpoint_names():
+    """The FusedBatchNorm swap pins explicit name="BatchNorm_N" at every
+    conv-net call site, so checkpoints saved in the nn.BatchNorm era (and
+    nn.BatchNorm-based ports of the same architectures) restore without a
+    tree rename — docs/SWITCHING.md "BatchNorm checkpoint compatibility"."""
+    import jax
+    from tensorflowonspark_tpu.models.inception import (
+        InceptionConfig,
+        InceptionV3,
+    )
+    from tensorflowonspark_tpu.models.resnet import ResNet, ResNetConfig
+    from tensorflowonspark_tpu.models.vgg import VGG, VGGConfig
+
+    x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    for model in (
+        ResNet(ResNetConfig.tiny()),
+        InceptionV3(InceptionConfig.tiny()),
+        VGG(VGGConfig.tiny()),
+    ):
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        flat = jax.tree_util.tree_flatten_with_path(variables)[0]
+        paths = {
+            "/".join(str(k) for k in path) for path, _ in flat
+        }
+        assert not any("FusedBatchNorm" in p for p in paths), sorted(
+            p for p in paths if "FusedBatchNorm" in p
+        )[:3]
+        assert any("BatchNorm_0" in p for p in paths), type(model).__name__

@@ -521,3 +521,46 @@ def test_engine_sampled_mode_runs(tiny):
         assert all(0 <= t < cfg.vocab_size for t in out)
     finally:
         eng.close()
+
+
+def test_engine_constructor_validation(tiny):
+    """Degenerate parameters fail at construction, not as a hang: slots=0
+    would busy-spin the scheduler with every submit() blocked forever;
+    width 0 and prefill_chunk > max_seq_len are likewise nonsense."""
+    cfg, model, params = tiny
+    with pytest.raises(ValueError, match="slots"):
+        ContinuousBatcher(model, params, slots=0, prompt_widths=(8,))
+    with pytest.raises(ValueError, match="slots"):
+        ContinuousBatcher(model, params, slots=-2, prompt_widths=(8,))
+    with pytest.raises(ValueError, match="prompt_widths"):
+        ContinuousBatcher(model, params, slots=1, prompt_widths=(0, 8))
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ContinuousBatcher(
+            model,
+            params,
+            slots=1,
+            prompt_widths=(8,),
+            prefill_chunk=cfg.max_seq_len + 1,
+        )
+
+
+def test_engine_chunked_prefill_at_seq_limit():
+    """A final prefill chunk whose naive window [start, start+C) runs past
+    max_seq_len must shift back (re-processing the causal-consistent
+    overlap), not scatter rows out of bounds. prompt 14 + budget 2 ==
+    max_seq_len 16 with C=6 hits the worst case: naive positions 12..17,
+    and the clipped-duplicate alternative would corrupt the last row."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=False, max_seq_len=16)
+    model = Llama(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    prompt = [(i * 7) % 11 + 1 for i in range(14)]
+    eng = ContinuousBatcher(
+        model, params, slots=1, prompt_widths=(16,), prefill_chunk=6
+    )
+    try:
+        got = eng.submit(prompt, 2)
+    finally:
+        eng.close()
+    assert got == _reference(model, params, prompt, 2)
