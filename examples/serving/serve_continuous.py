@@ -86,6 +86,7 @@ def main() -> int:
         "--gen-engine", "continuous",
         "--gen-slots", str(args.slots),
         "--gen-prefill-chunk", "8",  # long admissions interleave
+        "--gen-prefix-cache", "8",  # shared prefixes resume, not recompute
         "--port", "0",
     ]
     if args.gen_mesh:

@@ -126,6 +126,73 @@ class _PrefillJob:
     next_pos: int  # next chunk's start offset into the prompt
     length: int
     temp_1: object  # (1,) fp32
+    # next prompt depth at which to store a chunk-boundary prefix entry
+    # (doubles after each insert — see _advance_job)
+    next_insert_depth: int = 0
+    boundary_inserts: int = 0  # made so far, capped per request
+
+
+class _PrefixStore:
+    """LRU of prompt→single-row-KV-cache entries for prefix reuse.
+
+    A request whose prompt extends a stored prompt resumes prefill from
+    the stored cache instead of position 0 — the serving win for shared
+    system prompts. Entries are jax arrays (immutable), so "reuse" is a
+    reference: the continuation's functional cache updates never touch
+    the stored buffer, and no device copies happen at lookup or insert.
+
+    Cost model: each entry holds ONE full-length single-row KV cache
+    (layers × 2 × max_seq_len × kv_heads × head_dim in the cache dtype
+    — e.g. ~130 MB for the llama1b config at seq 4096 bf16), so
+    ``capacity`` is a real HBM budget knob, not just an entry count.
+    Accessed only from the scheduler loop thread — no locking.
+    """
+
+    def __init__(self, capacity: int):
+        from collections import OrderedDict
+
+        self.capacity = capacity
+        self._d: "OrderedDict[tuple, object]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.tokens_saved = 0
+
+    def lookup(self, tokens: list[int]):
+        """Longest stored prefix of ``tokens`` → (cache, resume_pos), or
+        (None, 0). resume_pos is capped at len(tokens)-1 so the chunk
+        path always re-processes at least the last prompt token — its
+        logits are where the first completion token samples from (the
+        overlap recompute writes back identical K/V rows)."""
+        best_key = None
+        best_len = 0
+        for k in self._d:
+            lk = len(k)
+            if (
+                best_len < lk <= len(tokens)
+                and tuple(tokens[:lk]) == k
+            ):
+                best_key, best_len = k, lk
+        resume = min(best_len, len(tokens) - 1)
+        if best_key is None or resume < 1:
+            self.misses += 1
+            return None, 0
+        self._d.move_to_end(best_key)
+        self.hits += 1
+        self.tokens_saved += resume
+        return self._d[best_key], resume
+
+    def insert(self, tokens: list[int], cache_1) -> None:
+        k = tuple(tokens)
+        self._d[k] = cache_1
+        self._d.move_to_end(k)
+        while len(self._d) > self.capacity:
+            self._d.popitem(last=False)
+
+    def clear(self) -> None:
+        self._d.clear()
+
+    def __len__(self) -> int:
+        return len(self._d)
 
 
 class ContinuousBatcher:
@@ -162,6 +229,7 @@ class ContinuousBatcher:
         mesh=None,
         max_queue: int | None = None,
         prefill_chunk: int | None = None,
+        prefix_cache: int | None = None,
     ):
         cfg = model.cfg
         self._model = model
@@ -249,6 +317,22 @@ class ContinuousBatcher:
                 f"{cfg.max_seq_len}], got {prefill_chunk}"
             )
         self._prefill_chunk = prefill_chunk
+        if prefix_cache is not None:
+            if prefix_cache < 1:
+                raise ValueError(
+                    f"prefix_cache must be >= 1 entries, got {prefix_cache}"
+                )
+            if prefill_chunk is None:
+                # Prefix reuse resumes prefill mid-prompt, which is what
+                # the chunk path does; the width-bucket prefill always
+                # starts from position 0.
+                raise ValueError(
+                    "prefix_cache requires prefill_chunk (prefix reuse "
+                    "resumes prefill through the chunked path)"
+                )
+            self._prefix_store = _PrefixStore(prefix_cache)
+        else:
+            self._prefix_store = None
         self._queue: "queue.Queue" = queue.Queue()
         self._closed = False
         self._stop_now = threading.Event()
@@ -509,6 +593,16 @@ class ContinuousBatcher:
             if done
             else None,
             "closed": self._closed,
+            **(
+                {
+                    "prefix_cache_entries": len(self._prefix_store),
+                    "prefix_hits": self._prefix_store.hits,
+                    "prefix_misses": self._prefix_store.misses,
+                    "prefix_tokens_saved": self._prefix_store.tokens_saved,
+                }
+                if self._prefix_store is not None
+                else {}
+            ),
         }
 
     def close(self, drain: bool = False, drain_timeout: float = 300.0) -> None:
@@ -547,6 +641,13 @@ class ContinuousBatcher:
         # checked at the top of every scheduler iteration.
         self._stop_now.set()
         self._thread.join(timeout=60)
+        if self._prefix_store is not None and not self._thread.is_alive():
+            # Drop the stored KV buffers (up to capacity × a full
+            # single-row cache of HBM) — a closed-but-still-referenced
+            # engine must not pin them against a replacement engine's
+            # budget. Only once the loop thread is truly gone: it reads
+            # the store without a lock.
+            self._prefix_store.clear()
 
     # -- compiled pieces ----------------------------------------------
 
@@ -727,13 +828,27 @@ class ContinuousBatcher:
             if p.temperature is None
             else float(p.temperature)
         )
+        cache_1, resume = None, 0
+        if self._prefix_store is not None:
+            # Longest stored prompt that prefixes this one: resume the
+            # chunked prefill from its end instead of position 0. The
+            # stored buffer's padding rows beyond its own prompt are
+            # overwritten by the first continuation chunk before any
+            # query position can attend them (keys > query pos are
+            # masked), so reuse needs no cleanup pass.
+            cache_1, resume = self._prefix_store.lookup(p.tokens)
+        if cache_1 is None:
+            cache_1 = self._single_row_cache()
         return _PrefillJob(
             p=p,
             row=row,
-            cache_1=self._single_row_cache(),
-            next_pos=0,
+            cache_1=cache_1,
+            next_pos=resume,
             length=len(p.tokens),
             temp_1=jnp.asarray([temp], jnp.float32),
+            # first boundary entry lands at the first chunk boundary
+            # past the resume point, then depths double
+            next_insert_depth=self._prefill_chunk or 0,
         )
 
     def _advance_job(self, cache, tok, pos, temps):
@@ -764,7 +879,34 @@ class ContinuousBatcher:
         )
         job.next_pos = start_w + c
         if job.next_pos < job.length:
+            if (
+                self._prefix_store is not None
+                and job.next_pos >= job.next_insert_depth
+                and job.boundary_inserts
+                < self._prefix_store.capacity // 2
+            ):
+                # Chunk-boundary prefix: the cache now covers exactly
+                # tokens[:next_pos] with no padding junk (only final
+                # chunks pad), so a later prompt sharing just the system
+                # prefix — not this whole prompt — can resume here.
+                # Storing the reference costs no device work or copies
+                # (jax arrays are immutable). Flood control, two layers:
+                # depths are exponentially spaced (the threshold doubles
+                # per insert — O(log L) coverage of the sharing scales),
+                # AND boundary inserts are capped at capacity//2 per
+                # request, shallowest first (shallow prefixes are the
+                # shareable ones), because log2(L/chunk) alone can still
+                # exceed a small LRU. Hot shared entries are refreshed
+                # on every hit, so one long prompt cannot flush them.
+                self._prefix_store.insert(
+                    job.p.tokens[: job.next_pos], job.cache_1
+                )
+                job.next_insert_depth = 2 * job.next_pos
+                job.boundary_inserts += 1
             return cache, tok, pos, temps
+        if self._prefix_store is not None:
+            # The completed single-row cache covers the whole prompt.
+            self._prefix_store.insert(job.p.tokens, job.cache_1)
         # final chunk: it contains the prompt's last true position
         tok_1, lp_1 = self._sample1_fn(
             logits,

@@ -606,6 +606,7 @@ def _build_engine(gen: dict):
         mesh=mesh,
         max_queue=gen.get("max_queue"),
         prefill_chunk=gen.get("prefill_chunk"),
+        prefix_cache=gen.get("prefix_cache"),
     )
     return engine, max_new, model, engine._params
 
@@ -925,6 +926,16 @@ def main(argv: list[str] | None = None) -> int:
         "requests before stopping instead of failing them",
     )
     p.add_argument(
+        "--gen-prefix-cache",
+        type=int,
+        default=None,
+        help="continuous engine: keep an LRU of this many prompt-prefix "
+        "KV caches so requests sharing a prefix (system prompts, "
+        "re-submits) resume prefill instead of recomputing it; each "
+        "entry holds one full-length single-row KV cache in HBM. "
+        "Requires --gen-prefill-chunk",
+    )
+    p.add_argument(
         "--gen-prefill-chunk",
         type=int,
         default=None,
@@ -964,6 +975,7 @@ def main(argv: list[str] | None = None) -> int:
             widths=args.gen_widths,
             max_queue=args.gen_max_queue,
             prefill_chunk=args.gen_prefill_chunk,
+            prefix_cache=args.gen_prefix_cache,
             drain_on_shutdown=args.gen_drain_on_shutdown,
         )
     server = make_server(

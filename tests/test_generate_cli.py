@@ -355,6 +355,8 @@ def test_serve_model_continuous_engine(tmp_path):
         batch_size=3,
         max_new_tokens=5,
         engine="continuous",
+        prefill_chunk=4,
+        prefix_cache=8,
     )
     server = serve_model.make_server(None, port=0, gen=gen)
     port = server.server_address[1]
@@ -397,9 +399,13 @@ def test_serve_model_continuous_engine(tmp_path):
             )[0].tolist()
             assert row == want
 
-        # over-width prompt: engine validation surfaces as a 400
+        # chunked mode isn't width-bucket-capped: a 9-token prompt
+        # (over the 8-wide bucket) decodes fine...
         code, body = _post(port, "/generate", {"prompts": [[1] * 9]})
-        assert code == 400 and "width" in body["error"]
+        assert code == 200, body
+        # ...but KV capacity still rejects as a 400
+        code, body = _post(port, "/generate", {"prompts": [[1] * 127]})
+        assert code == 400 and "max_seq_len" in body["error"]
 
         # scheduler observability
         import urllib.request
@@ -410,8 +416,11 @@ def test_serve_model_continuous_engine(tmp_path):
             stats = json.loads(r.read())
         assert stats["mode"] == "continuous"
         assert stats["slots"] == 3
-        assert stats["admitted"] == len(prompts) + 2
+        assert stats["admitted"] == len(prompts) + 3  # +2 multi-row, +1 over-width
         assert stats["steps"] > 0 and not stats["closed"]
+        # the CLI-wired prefix cache is live and accounted in /stats
+        assert stats["prefix_cache_entries"] > 0
+        assert stats["prefix_hits"] + stats["prefix_misses"] > 0
 
         # streaming: NDJSON token lines + a done trailer matching the
         # non-streamed completion for the same prompt; with logprobs
@@ -477,10 +486,12 @@ def test_serve_model_continuous_engine(tmp_path):
             {"prompts": [[1], [2]], "stream": True},
         )
         assert code == 400 and "one prompt" in body["error"]
+        # (chunked mode admits over-width prompts, so the eager-400
+        # guardrail is the KV-capacity check here)
         code, body = _post(
-            port, "/generate", {"prompts": [[1] * 9], "stream": True}
+            port, "/generate", {"prompts": [[1] * 127], "stream": True}
         )
-        assert code == 400 and "width" in body["error"]
+        assert code == 400 and "max_seq_len" in body["error"]
     finally:
         server.shutdown()
 

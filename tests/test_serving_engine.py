@@ -564,3 +564,142 @@ def test_engine_chunked_prefill_at_seq_limit():
     finally:
         eng.close()
     assert got == _reference(model, params, prompt, 2)
+
+
+def test_engine_prefix_cache_token_identical(tiny):
+    """Prefix reuse must be invisible in outputs: requests sharing a
+    system-prompt prefix produce tokens AND logprobs identical to a
+    cold engine, across hit shapes (extension, exact re-submit, partial
+    overlap, no overlap) and the stats must show the reuse."""
+    cfg, model, params = tiny
+    cold = ContinuousBatcher(
+        model, params, slots=2, prompt_widths=(8,), prefill_chunk=4
+    )
+    warm = ContinuousBatcher(
+        model,
+        params,
+        slots=2,
+        prompt_widths=(8,),
+        prefill_chunk=4,
+        prefix_cache=8,
+    )
+    try:
+        system = [11, 7, 3, 9, 2, 8, 5]  # shared 7-token "system prompt"
+        reqs = [
+            system + [1, 2],        # cold: seeds chunk-boundary entries
+            system + [4],           # shares only the system prefix —
+                                    # hits the [:4] chunk-boundary entry
+            system + [1, 2],        # exact re-submit (resumes at len-1)
+            system + [1, 2, 6, 6],  # extension of a stored full prompt
+            [9, 9, 1],              # unrelated: no overlap
+        ]
+        for r in reqs:
+            want = cold.submit(r, 4, return_logprobs=True)
+            got = warm.submit(r, 4, return_logprobs=True)
+            assert got[0] == want[0], r
+            np.testing.assert_allclose(got[1], want[1], atol=1e-5)
+        s = warm.stats()
+        assert s["prefix_hits"] == 3  # boundary-share, re-submit, extension
+        assert s["prefix_misses"] == 2
+        assert s["prefix_tokens_saved"] == 4 + 8 + 9
+        assert s["prefix_cache_entries"] >= 4
+    finally:
+        cold.close()
+        warm.close()
+
+
+def test_engine_prefix_cache_lru_eviction(tiny):
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(
+        model, params, slots=1, prompt_widths=(8,), prefill_chunk=4,
+        prefix_cache=2,
+    )
+    try:
+        a, b, c = [1, 2, 3, 4], [5, 6, 7, 8], [2, 4, 6, 8]
+        for p in (a, b, c):  # c's insert evicts a (capacity 2)
+            eng.submit(p, 2)
+        misses0 = eng.stats()["prefix_misses"]
+        assert eng.submit(a, 2) == _reference(model, params, a, 2)
+        assert eng.stats()["prefix_misses"] == misses0 + 1  # a was evicted
+        assert eng.stats()["prefix_cache_entries"] == 2
+    finally:
+        eng.close()
+
+
+def test_engine_prefix_cache_requires_chunked_prefill(tiny):
+    cfg, model, params = tiny
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ContinuousBatcher(
+            model, params, slots=1, prompt_widths=(8,), prefix_cache=4
+        )
+    with pytest.raises(ValueError, match="prefix_cache"):
+        ContinuousBatcher(
+            model, params, slots=1, prompt_widths=(8,),
+            prefill_chunk=4, prefix_cache=0,
+        )
+
+
+def test_engine_prefix_cache_near_seq_limit():
+    """Reuse composes with the final-chunk window shift: the stored
+    prompt's padding junk sits right at the cache edge and the
+    continuation must overwrite it, not trip on it."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=False, max_seq_len=16)
+    model = Llama(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    eng = ContinuousBatcher(
+        model, params, slots=1, prompt_widths=(16,), prefill_chunk=6,
+        prefix_cache=4,
+    )
+    try:
+        base = [(i * 5) % 9 + 1 for i in range(11)]
+        full = base + [3, 2, 4]  # 14 tokens; +2 budget == max_seq_len
+        eng.submit(base, 2)  # stores base's cache (junk rows 11..15)
+        got = eng.submit(full, 2)
+        assert eng.stats()["prefix_hits"] == 1
+    finally:
+        eng.close()
+    assert got == _reference(model, params, full, 2)
+
+
+def test_engine_prefix_cache_bounded_inserts_and_close_clears(tiny):
+    """(a) One long prompt stores O(log L) boundary entries, not L/chunk
+    — every boundary would let a single request flush the LRU's hot
+    shared-prefix entries. (b) close() drops the stored KV buffers so a
+    closed-but-referenced engine doesn't pin HBM."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(
+        model, params, slots=1, prompt_widths=(8,), prefill_chunk=2,
+        prefix_cache=64,
+    )
+    try:
+        long_p = [(i * 3) % 7 + 1 for i in range(40)]  # 20 chunks
+        eng.submit(long_p, 2)
+        entries = eng.stats()["prefix_cache_entries"]
+        # depths 2, 4, 8, 16, 32 + the full prompt = 6, far under 20
+        assert entries == 6, entries
+    finally:
+        eng.close()
+    assert len(eng._prefix_store) == 0  # buffers released on close
+
+
+def test_engine_prefix_cache_long_prompt_cannot_flush_shared_prefix(tiny):
+    """Per-request boundary inserts are capped at capacity//2, so one
+    long prompt leaves room for the shared-prefix entries a smaller LRU
+    holds (log2(L/chunk) alone can exceed a small capacity)."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(
+        model, params, slots=1, prompt_widths=(8,), prefill_chunk=4,
+        prefix_cache=8,
+    )
+    try:
+        system = [7, 3, 9, 2, 8, 5, 4, 6]  # one chunk boundary = [:8]
+        eng.submit(system + [1, 2], 2)  # 3 entries: [:4], [:8], full
+        long_p = [(i * 5) % 11 + 1 for i in range(64)]
+        eng.submit(long_p, 2)  # capped: 4 boundary + 1 full inserts
+        hits0 = eng.stats()["prefix_hits"]
+        eng.submit(system + [3], 2)  # [:8] == system must still be live
+        assert eng.stats()["prefix_hits"] == hits0 + 1
+    finally:
+        eng.close()
