@@ -78,3 +78,36 @@ def test_bench_relay_gate_fails_fast_when_relay_down():
     assert proc.returncode == 3
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert "relay_unreachable" in out["error"]
+
+
+def test_real_chip_prefix_bench_smoke():
+    """llama1b_prefix at --model-scale tiny: the full cold/prime/warm
+    flow must run on CPU and prove reuse (the config itself raises if
+    the warm loop misses the prefix cache)."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PALLAS_AXON_REMOTE_COMPILE="",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "benchmarks/real_chip.py",
+            "--config", "llama1b_prefix",
+            "--model-scale", "tiny",
+            "--steps", "3",
+            "--seq", "64",
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["config"] == "llama1b_prefix"
+    assert out["prefix_hits"] >= 3
+    assert out["prefix_tokens_saved"] > 0
+    assert out["ttft_cold_ms"] > 0 and out["step_time_ms"] > 0
