@@ -348,17 +348,21 @@ def bench_llama1b(args):
     )
 
 
-def _llama1b_decode_setup(args, prompt_len: int = 128):
+def _llama1b_decode_setup(args, prompt_len: int | None = None):
     """Shared config/model/prompt build for the decode-side llama1b
     benches — ``llama1b_decode`` and ``llama1b_engine`` are read as a
     same-configuration pair (their delta is the engine's scheduling
-    tax), so they must not drift."""
+    tax), so they must not drift. ``--seq`` overrides the prompt length
+    (the KV-traffic knob: at long prompts the per-step cache read
+    rivals the weight read, which is what ``--kv-quantize`` halves)."""
     import jax.numpy as jnp
     import numpy as np
 
     from tensorflowonspark_tpu.models.llama import Llama, LlamaConfig
 
     b = args.batch_size or 8
+    if prompt_len is None:
+        prompt_len = args.seq or 128
     new_tokens = args.new_tokens
     # speculative verification scratches up to spec_k slots past the
     # emitted text
@@ -370,6 +374,9 @@ def _llama1b_decode_setup(args, prompt_len: int = 128):
             max_seq_len=max_seq,
             remat=False,
             attention_impl="xla",
+            kv_cache_dtype=(
+                "int8" if getattr(args, "kv_quantize", False) else "model"
+            ),
         )
     else:
         cfg = LlamaConfig(
@@ -383,6 +390,9 @@ def _llama1b_decode_setup(args, prompt_len: int = 128):
             dtype=jnp.bfloat16,
             remat=False,
             attention_impl="xla",  # decode is single-token; flash n/a
+            kv_cache_dtype=(
+                "int8" if getattr(args, "kv_quantize", False) else "model"
+            ),
         )
     model = Llama(cfg)
     rng = np.random.default_rng(0)
@@ -672,6 +682,13 @@ def main(argv=None):
         action="store_true",
         help="llama1b_decode/llama1b_engine: int8 weight-only decode "
         "(ops/quant.py)",
+    )
+    p.add_argument(
+        "--kv-quantize",
+        action="store_true",
+        help="llama decode configs: int8 KV cache "
+        "(kv_cache_dtype='int8' — halves cache HBM footprint and "
+        "per-step cache reads; composes with --quantize)",
     )
     p.add_argument(
         "--spec-k",

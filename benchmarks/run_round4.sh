@@ -69,4 +69,12 @@ run python benchmarks/real_chip.py --config llama1b_engine --steps 3 --quantize
 #    of 512) vs cold full prefill
 run python benchmarks/real_chip.py --config llama1b_prefix --steps 16
 
+# 8. NEW round 4: int8 KV cache at long context — the per-step cache
+#    read rivals the weight read at prompt 2048, which is what
+#    kv_cache_dtype="int8" halves. A/B at the same shape, then composed
+#    with int8 weights (both halvings together).
+run python benchmarks/real_chip.py --config llama1b_decode --seq 2048 --new-tokens 64
+run python benchmarks/real_chip.py --config llama1b_decode --seq 2048 --new-tokens 64 --kv-quantize
+run python benchmarks/real_chip.py --config llama1b_decode --seq 2048 --new-tokens 64 --kv-quantize --quantize
+
 echo "round-4 measurements attempted; results in $OUT" >&2

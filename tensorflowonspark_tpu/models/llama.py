@@ -26,7 +26,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tensorflowonspark_tpu.ops.attention import dot_product_attention
-from tensorflowonspark_tpu.ops.lora import LoraTensor, lora_apply
+from tensorflowonspark_tpu.ops.lora import (
+    LoraTensor,
+    MultiLoraTensor,
+    lora_apply,
+    multi_lora_apply,
+)
 from tensorflowonspark_tpu.ops.quant import QuantTensor, quantized_dot
 
 
@@ -75,6 +80,13 @@ class LlamaConfig:
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
+    # KV-cache storage: "model" (= dtype, exact) or "int8" (per-token
+    # per-head max-abs quantization — halves the cache HBM footprint
+    # AND the per-step cache read traffic that bounds long-context
+    # decode; dequant folds into the attention einsums, so no bf16 copy
+    # of the cache ever exists). Decode-side only; training is
+    # unaffected (no cache).
+    kv_cache_dtype: str = "model"
 
     @property
     def head_dim(self) -> int:
@@ -190,7 +202,7 @@ class QDense(nn.Module):
     dtype: jnp.dtype
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapter_ids=None):
         kernel = self.param(
             "kernel",
             nn.initializers.normal(0.02),
@@ -201,6 +213,12 @@ class QDense(nn.Module):
             return quantized_dot(x, kernel)
         if isinstance(kernel, LoraTensor):
             return lora_apply(x, kernel)
+        if isinstance(kernel, MultiLoraTensor):
+            # Per-row adapter routing (the multi-tenant serving path);
+            # ids default to slot 0, the bank's zero adapter == base.
+            if adapter_ids is None:
+                adapter_ids = jnp.zeros((jnp.shape(x)[0],), jnp.int32)
+            return multi_lora_apply(x, kernel, adapter_ids)
         return x @ kernel.astype(self.dtype)
 
 
@@ -209,15 +227,16 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x, positions, segment_ids=None, decode=False, padded=False
+        self, x, positions, segment_ids=None, decode=False, padded=False,
+        adapter_ids=None,
     ):
         cfg = self.cfg
         dense = lambda feats, name: QDense(  # noqa: E731
             feats, cfg.dtype, name=name
         )
-        q = dense(cfg.num_heads * cfg.head_dim, "q_proj")(x)
-        k = dense(cfg.num_kv_heads * cfg.head_dim, "k_proj")(x)
-        v = dense(cfg.num_kv_heads * cfg.head_dim, "v_proj")(x)
+        q = dense(cfg.num_heads * cfg.head_dim, "q_proj")(x, adapter_ids)
+        k = dense(cfg.num_kv_heads * cfg.head_dim, "k_proj")(x, adapter_ids)
+        v = dense(cfg.num_kv_heads * cfg.head_dim, "v_proj")(x, adapter_ids)
         b, s, _ = x.shape
         q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
@@ -240,7 +259,7 @@ class Attention(nn.Module):
                 impl=cfg.attention_impl,
             )
         out = out.reshape(b, s, cfg.num_heads * cfg.head_dim)
-        return dense(cfg.hidden_size, "o_proj")(out)
+        return dense(cfg.hidden_size, "o_proj")(out, adapter_ids)
 
     def _cached_attention(
         self, q, k, v, positions, padded=False, segment_ids=None
@@ -276,14 +295,27 @@ class Attention(nn.Module):
         """
         cfg = self.cfg
         b, s = q.shape[:2]
+        int8_kv = cfg.kv_cache_dtype == "int8"
+        kv_store = jnp.int8 if int8_kv else cfg.dtype
         ck = self.variable(
             "cache", "k", jnp.zeros,
-            (b, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim), cfg.dtype,
+            (b, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim), kv_store,
         )
         cv = self.variable(
             "cache", "v", jnp.zeros,
-            (b, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim), cfg.dtype,
+            (b, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim), kv_store,
         )
+        if int8_kv:
+            # Per-token per-head max-abs scales. fp32: 4 bytes per
+            # head-token next to head_dim int8 bytes (~3% at d=128).
+            cks = self.variable(
+                "cache", "k_scale", jnp.zeros,
+                (b, cfg.max_seq_len, cfg.num_kv_heads), jnp.float32,
+            )
+            cvs = self.variable(
+                "cache", "v_scale", jnp.zeros,
+                (b, cfg.max_seq_len, cfg.num_kv_heads), jnp.float32,
+            )
         cs = self.variable(
             "cache", "seg", jnp.zeros, (b, cfg.max_seq_len), jnp.int32
         )
@@ -296,20 +328,47 @@ class Attention(nn.Module):
             if segment_ids is None
             else segment_ids.astype(jnp.int32)
         )
+
+        def store(x):
+            """What lands in the cache for new K/V rows: the model-dtype
+            values, or (int8, scale) with symmetric max-abs rounding."""
+            if not int8_kv:
+                return x.astype(cfg.dtype), None
+            xf = x.astype(jnp.float32)
+            scale = jnp.maximum(
+                jnp.max(jnp.abs(xf), axis=-1), 1e-8
+            ) * (1.0 / 127.0)
+            q8 = jnp.clip(
+                jnp.round(xf / scale[..., None]), -127, 127
+            ).astype(jnp.int8)
+            return q8, scale
+
+        k_new, ks_new = store(k)
+        v_new, vs_new = store(v)
         if padded:
             rows = jnp.arange(b)[:, None]
-            ck.value = ck.value.at[rows, positions].set(k.astype(cfg.dtype))
-            cv.value = cv.value.at[rows, positions].set(v.astype(cfg.dtype))
+            ck.value = ck.value.at[rows, positions].set(k_new)
+            cv.value = cv.value.at[rows, positions].set(v_new)
+            if int8_kv:
+                cks.value = cks.value.at[rows, positions].set(ks_new)
+                cvs.value = cvs.value.at[rows, positions].set(vs_new)
             # positions ARE the slots here (unpacked rows only; the
             # packed+padded combination is rejected in __call__)
             slot_q = positions
         else:
             ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype), (0, cur, 0, 0)
+                ck.value, k_new, (0, cur, 0, 0)
             )
             cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype), (0, cur, 0, 0)
+                cv.value, v_new, (0, cur, 0, 0)
             )
+            if int8_kv:
+                cks.value = jax.lax.dynamic_update_slice(
+                    cks.value, ks_new, (0, cur, 0)
+                )
+                cvs.value = jax.lax.dynamic_update_slice(
+                    cvs.value, vs_new, (0, cur, 0)
+                )
             cs.value = jax.lax.dynamic_update_slice(cs.value, seg, (0, cur))
             slot_q = jnp.broadcast_to(
                 (cur + jnp.arange(s, dtype=jnp.int32))[None, :], (b, s)
@@ -319,17 +378,27 @@ class Attention(nn.Module):
         # jnp.repeat of (b, max_seq_len, heads, d) K/V — plus an fp32 copy
         # — per layer per step would multiply exactly the HBM traffic that
         # bounds decode. Only the (b, h, q, k) logits live in fp32.
+        #
+        # int8 path: the HBM stream stays int8 (the astype below fuses
+        # into the einsum as an operand producer); the K scale factors
+        # OUT of the head_dim contraction and multiplies the fp32
+        # logits per key slot, and the V scale folds into the fp32
+        # probs before they narrow — dequantized K/V never exist as
+        # arrays.
         rep = cfg.num_heads // cfg.num_kv_heads
         qg = q.reshape(b, s, cfg.num_kv_heads, rep, cfg.head_dim)
         logits = (
             jnp.einsum(
                 "bqhrd,bkhd->bhrqk",
                 qg,
-                ck.value,
+                ck.value.astype(cfg.dtype),
                 preferred_element_type=jnp.float32,
             )
             * cfg.head_dim**-0.5
         )
+        if int8_kv:
+            # (b, S, h) -> (b, h, 1, 1, S) against logits (b, h, r, q, S)
+            logits = logits * cks.value.transpose(0, 2, 1)[:, :, None, None, :]
         key_pos = jnp.arange(cfg.max_seq_len)
         mask = (
             key_pos[None, None, None, None, :]
@@ -339,8 +408,11 @@ class Attention(nn.Module):
             cs.value[:, None, None, None, :] == seg[:, None, None, :, None]
         )
         logits = jnp.where(mask, logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, cv.value)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if int8_kv:
+            probs = probs * cvs.value.transpose(0, 2, 1)[:, :, None, None, :]
+        probs = probs.astype(cfg.dtype)
+        out = jnp.einsum("bhrqk,bkhd->bqhrd", probs, cv.value.astype(cfg.dtype))
         return out.reshape(b, s, cfg.num_heads, cfg.head_dim)
 
 
@@ -348,14 +420,16 @@ class MLP(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapter_ids=None):
         cfg = self.cfg
         dense = lambda feats, name: QDense(  # noqa: E731
             feats, cfg.dtype, name=name
         )
-        gate = dense(cfg.intermediate_size, "gate_proj")(x)
-        up = dense(cfg.intermediate_size, "up_proj")(x)
-        return dense(cfg.hidden_size, "down_proj")(nn.silu(gate) * up)
+        gate = dense(cfg.intermediate_size, "gate_proj")(x, adapter_ids)
+        up = dense(cfg.intermediate_size, "up_proj")(x, adapter_ids)
+        return dense(cfg.hidden_size, "down_proj")(
+            nn.silu(gate) * up, adapter_ids
+        )
 
 
 class Block(nn.Module):
@@ -363,7 +437,8 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x, positions, segment_ids=None, decode=False, padded=False
+        self, x, positions, segment_ids=None, decode=False, padded=False,
+        adapter_ids=None,
     ):
         cfg = self.cfg
         h = x + Attention(cfg, name="attn")(
@@ -372,6 +447,7 @@ class Block(nn.Module):
             segment_ids,
             decode,
             padded,
+            adapter_ids,
         )
         if cfg.num_experts > 0:
             from tensorflowonspark_tpu.parallel.moe import MoEConfig, MoEMLP
@@ -389,9 +465,10 @@ class Block(nn.Module):
             )
         else:
             mlp = MLP(cfg, name="mlp")
-        return h + mlp(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(h)
-        )
+        normed = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="mlp_norm")(h)
+        if cfg.num_experts > 0:
+            return h + mlp(normed)  # MoE routes by token, not adapter
+        return h + mlp(normed, adapter_ids)
 
 
 class Llama(nn.Module):
@@ -406,6 +483,7 @@ class Llama(nn.Module):
         decode=False,
         return_hidden=False,
         padded=False,
+        adapter_ids=None,
     ):
         """tokens (B, S) int32 -> logits (B, S, vocab).
 
@@ -426,6 +504,12 @@ class Llama(nn.Module):
         with the new tokens' positions) never attend across documents.
         Only the ``padded=True`` combination is rejected: per-row
         scatter slots conflict with packed rows' global slot indexing.
+
+        ``adapter_ids`` (B,) int32 routes each row through its slot of
+        any ``MultiLoraTensor`` adapter banks in the params
+        (``ops/lora.py:multi_lora_bank`` — multi-tenant serving); None
+        routes every row to slot 0, the bank's exact-base zero adapter.
+        Ignored when the params hold no banks.
 
         ``return_hidden=True`` returns ``(hidden, lm_head)`` instead of
         logits — the final-norm hidden states (B, S, H) and the untied
@@ -486,11 +570,15 @@ class Llama(nn.Module):
             )
             block = nn.remat(Block, static_argnums=(), policy=policy)
             for i in range(cfg.num_layers):
-                x = block(cfg, name=f"layer{i}")(x, positions, segment_ids)
+                # decode/padded stay at their (static) defaults — passing
+                # them positionally through remat would trace them
+                x = block(cfg, name=f"layer{i}")(
+                    x, positions, segment_ids, adapter_ids=adapter_ids
+                )
         else:
             for i in range(cfg.num_layers):
                 x = Block(cfg, name=f"layer{i}")(
-                    x, positions, segment_ids, decode, padded
+                    x, positions, segment_ids, decode, padded, adapter_ids
                 )
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="final_norm")(x)
         # untied output head
@@ -520,9 +608,13 @@ def llama_param_shardings(params, mesh: Mesh):
         ]
         joined = "/".join(names)
         ndim = leaf.ndim
+        attr = getattr(path[-1], "name", None)
         if ndim <= 1:
             return NamedSharding(mesh, P())
-        if ndim == 3:  # MoE expert banks (E, d, f) / (E, f, d)
+        if ndim == 3 and attr not in ("a", "b"):
+            # MoE expert banks (E, d, f) / (E, f, d); multi-LoRA
+            # adapter banks (K, in, r)/(K, r, out) are the OTHER ndim-3
+            # leaves and take the factor rules below instead
             from tensorflowonspark_tpu.parallel.moe import (
                 moe_expert_bank_spec,
             )
@@ -541,12 +633,15 @@ def llama_param_shardings(params, mesh: Mesh):
         # LoRA factors inside a wrapped kernel: the base shards like the
         # kernel it replaces; `a` (in, r) keeps the input half, `b`
         # (r, out) the output half — consistent with the TP math (the
-        # rank dim stays replicated; it is tiny by construction)
-        attr = getattr(path[-1], "name", None)
+        # rank dim stays replicated; it is tiny by construction). For a
+        # multi-LoRA BANK the same halves apply behind the leading K
+        # slots dim (replicated — every chip serves every adapter).
         if attr == "a":
-            return NamedSharding(mesh, P(pair[0], None))
+            spec = (pair[0], None) if ndim == 2 else (None, pair[0], None)
+            return NamedSharding(mesh, P(*spec))
         if attr == "b":
-            return NamedSharding(mesh, P(None, pair[1]))
+            spec = (None, pair[1]) if ndim == 2 else (None, None, pair[1])
+            return NamedSharding(mesh, P(*spec))
         return NamedSharding(mesh, P(*pair))
 
     return jax.tree_util.tree_map_with_path(rule, params)
@@ -556,10 +651,13 @@ def decode_cache_spec(x: jax.Array) -> P:
     """PartitionSpec for one KV-cache leaf under mesh-sharded decode:
     K/V (B, S, kv_heads, D) shard batch on 'data' and heads on 'model'
     (each TP shard holds only its heads' cache — the HBM split that
-    makes 7B-class serving fit), the segment-id plane (B, S) shards on
-    'data', the scalar write index replicates."""
+    makes 7B-class serving fit), int8-KV scale planes (B, S, kv_heads)
+    follow their heads, the segment-id plane (B, S) shards on 'data',
+    the scalar write index replicates."""
     if x.ndim == 4:
         return P("data", None, "model", None)
+    if x.ndim == 3:
+        return P("data", None, "model")
     if x.ndim == 2:
         return P("data", None)
     return P()

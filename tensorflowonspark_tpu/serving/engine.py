@@ -89,6 +89,7 @@ class _Pending:
     event: threading.Event
     temperature: float | None = None  # None = the engine-wide default
     eos_id: int | None = None  # None = the engine-wide default
+    adapter: int = 0  # MultiLoraTensor bank slot (0 = base model)
     submitted_at: float = 0.0  # time.monotonic() at enqueue
     first_token_at: float | None = None  # set when token 0 emits
     result: list[int] | None = None
@@ -126,6 +127,7 @@ class _PrefillJob:
     next_pos: int  # next chunk's start offset into the prompt
     length: int
     temp_1: object  # (1,) fp32
+    ad_1: object  # (1,) int32 adapter id
     # next prompt depth at which to store a chunk-boundary prefix entry
     # (doubles after each insert — see _advance_job)
     next_insert_depth: int = 0
@@ -157,21 +159,25 @@ class _PrefixStore:
         self.misses = 0
         self.tokens_saved = 0
 
-    def lookup(self, tokens: list[int]):
-        """Longest stored prefix of ``tokens`` → (cache, resume_pos), or
-        (None, 0). resume_pos is capped at len(tokens)-1 so the chunk
+    def lookup(self, tokens: list[int], adapter: int = 0):
+        """Longest stored prefix of ``tokens`` under the same adapter →
+        (cache, resume_pos), or (None, 0). A prefix computed under one
+        LoRA adapter is NOT valid under another (its K/V went through
+        that adapter's projections), so the adapter id is part of the
+        key. resume_pos is capped at len(tokens)-1 so the chunk
         path always re-processes at least the last prompt token — its
         logits are where the first completion token samples from (the
         overlap recompute writes back identical K/V rows)."""
         best_key = None
         best_len = 0
-        for k in self._d:
+        for ad, k in self._d:
             lk = len(k)
             if (
-                best_len < lk <= len(tokens)
+                ad == adapter
+                and best_len < lk <= len(tokens)
                 and tuple(tokens[:lk]) == k
             ):
-                best_key, best_len = k, lk
+                best_key, best_len = (ad, k), lk
         resume = min(best_len, len(tokens) - 1)
         if best_key is None or resume < 1:
             self.misses += 1
@@ -181,8 +187,8 @@ class _PrefixStore:
         self.tokens_saved += resume
         return self._d[best_key], resume
 
-    def insert(self, tokens: list[int], cache_1) -> None:
-        k = tuple(tokens)
+    def insert(self, tokens: list[int], cache_1, adapter: int = 0) -> None:
+        k = (adapter, tuple(tokens))
         self._d[k] = cache_1
         self._d.move_to_end(k)
         while len(self._d) > self.capacity:
@@ -283,6 +289,11 @@ class ContinuousBatcher:
                 ),
             )
         self._params = params
+        from tensorflowonspark_tpu.ops.lora import bank_size
+
+        # MultiLoraTensor banks in the params enable per-request adapter
+        # routing; 0 slots means "no bank" (adapter must be 0/None).
+        self._n_adapters = bank_size(params)
         self._slots = int(slots)
         if self._slots < 1:
             # slots=0 would construct fine, then the scheduler thread
@@ -382,6 +393,7 @@ class ContinuousBatcher:
         tokens: list[int],
         max_new_tokens: int,
         temperature: float | None,
+        adapter: int | None = None,
     ) -> None:
         cfg = self._model.cfg
         if not tokens:
@@ -399,6 +411,19 @@ class ContinuousBatcher:
             raise ValueError(
                 f"temperature must be finite and >= 0, got {temperature}"
             )
+        if adapter is not None and adapter != 0:
+            if self._n_adapters == 0:
+                raise ValueError(
+                    "this engine's params hold no MultiLoraTensor bank; "
+                    "only adapter 0/None (base model) is valid"
+                )
+            if not 0 <= adapter < self._n_adapters:
+                # jnp.take clamps out-of-range gathers silently — a bad
+                # id would serve the WRONG tenant's adapter, not error
+                raise ValueError(
+                    f"adapter {adapter} out of range [0, "
+                    f"{self._n_adapters})"
+                )
         if self._prefill_chunk is None and len(tokens) > self._widths[-1]:
             # chunked prefill never touches the width buckets — its only
             # cap is the KV capacity checked below
@@ -419,12 +444,13 @@ class ContinuousBatcher:
         max_new_tokens: int,
         temperature: float | None = None,
         eos_id: int | None = None,
+        adapter: int | None = None,
     ) -> list[_Pending]:
         """Validate then enqueue a group ATOMICALLY: either every row is
         accepted or none is — a partially admitted multi-row request
         would burn slots on work the client then discards on its 503."""
         for tokens, _ in requests:
-            self._validate(tokens, max_new_tokens, temperature)
+            self._validate(tokens, max_new_tokens, temperature, adapter)
         ps = [
             _Pending(
                 list(tokens),
@@ -432,6 +458,7 @@ class ContinuousBatcher:
                 threading.Event(),
                 temperature=temperature,
                 eos_id=eos_id,
+                adapter=int(adapter or 0),
                 submitted_at=time.monotonic(),
                 sink=sink,
             )
@@ -470,9 +497,10 @@ class ContinuousBatcher:
         sink=None,
         temperature: float | None = None,
         eos_id: int | None = None,
+        adapter: int | None = None,
     ) -> _Pending:
         return self._enqueue_all(
-            [(tokens, sink)], max_new_tokens, temperature, eos_id
+            [(tokens, sink)], max_new_tokens, temperature, eos_id, adapter
         )[0]
 
     def submit(
@@ -482,6 +510,7 @@ class ContinuousBatcher:
         temperature: float | None = None,
         eos_id: int | None = None,
         return_logprobs: bool = False,
+        adapter: int | None = None,
     ) -> "list[int] | tuple[list[int], list[float]]":
         """Blocking decode. ``temperature`` and ``eos_id`` override the
         engine-wide defaults FOR THIS REQUEST (temperature is a traced
@@ -489,9 +518,13 @@ class ContinuousBatcher:
         retirement bookkeeping, a NEGATIVE value disables EOS stopping
         entirely for this request). top_k/top_p stay engine-wide.
         ``return_logprobs``: also return each emitted token's logprob
-        under the raw model distribution (the /score convention)."""
+        under the raw model distribution (the /score convention).
+        ``adapter`` selects the row's MultiLoraTensor bank slot when the
+        params carry one (multi-tenant serving; 0/None = base model),
+        traced per-row — mixed-adapter batches cost no recompilation."""
         p = self._enqueue(
-            tokens, max_new_tokens, temperature=temperature, eos_id=eos_id
+            tokens, max_new_tokens, temperature=temperature,
+            eos_id=eos_id, adapter=adapter,
         )
         p.event.wait()
         if p.error is not None:
@@ -507,6 +540,7 @@ class ContinuousBatcher:
         temperature: float | None = None,
         eos_id: int | None = None,
         return_logprobs: bool = False,
+        adapter: int | None = None,
     ) -> "list[list[int]] | tuple[list[list[int]], list[list[float]]]":
         """Blocking decode of several prompts admitted ATOMICALLY (all
         rows accepted or an EngineOverloaded/ValueError before any row
@@ -517,6 +551,7 @@ class ContinuousBatcher:
             max_new_tokens,
             temperature,
             eos_id,
+            adapter,
         )
         for p in ps:
             p.event.wait()
@@ -534,6 +569,7 @@ class ContinuousBatcher:
         temperature: float | None = None,
         eos_id: int | None = None,
         yield_logprobs: bool = False,
+        adapter: int | None = None,
     ):
         """Yield completion tokens AS THEY DECODE (one engine step of
         latency each) instead of blocking for the full result.
@@ -553,6 +589,7 @@ class ContinuousBatcher:
             sink=queue.Queue(),
             temperature=temperature,
             eos_id=eos_id,
+            adapter=adapter,
         )
 
         def drain():
@@ -593,6 +630,11 @@ class ContinuousBatcher:
             if done
             else None,
             "closed": self._closed,
+            **(
+                {"adapters": self._n_adapters}
+                if self._n_adapters
+                else {}
+            ),
             **(
                 {
                     "prefix_cache_entries": len(self._prefix_store),
@@ -662,7 +704,11 @@ class ContinuousBatcher:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         def spec(x):
-            return P(None, None, "model", None) if x.ndim == 4 else P()
+            if x.ndim == 4:  # K/V: heads on 'model'
+                return P(None, None, "model", None)
+            if x.ndim == 3:  # int8-KV scale planes follow their heads
+                return P(None, None, "model")
+            return P()
 
         return jax.tree.map(
             lambda x: jax.lax.with_sharding_constraint(
@@ -678,13 +724,14 @@ class ContinuousBatcher:
         constrain = self._constrain_cache
 
         @jax.jit
-        def step(params, cache, tok, pos, temps, key):
+        def step(params, cache, tok, pos, temps, ads, key):
             logits, updated = model.apply(
                 {"params": params, "cache": cache},
                 tok[:, None],
                 positions=pos[:, None],
                 decode=True,
                 padded=True,
+                adapter_ids=ads,
                 mutable=["cache"],
             )
             # The per-step logprob costs one (slots, vocab) fp32
@@ -716,7 +763,7 @@ class ContinuousBatcher:
         constrain = self._constrain_cache
 
         @jax.jit
-        def prefill(params, prompt, length, temps, key):
+        def prefill(params, prompt, length, temps, ads, key):
             positions = jnp.arange(width, dtype=jnp.int32)[None, :]
             logits, state = model.apply(
                 {"params": params},
@@ -724,6 +771,7 @@ class ContinuousBatcher:
                 positions=positions,
                 decode=True,
                 padded=True,
+                adapter_ids=ads,
                 mutable=["cache"],
             )
             last = jnp.take_along_axis(
@@ -742,7 +790,7 @@ class ContinuousBatcher:
         @jax.jit
         def admit(
             cache_b, cache_1, row, tok_b, tok_1, pos_b, pos_1,
-            temps_b, temp_1,
+            temps_b, temp_1, ads_b, ad_1,
         ):
             def scatter(leaf_b, leaf_1):
                 if leaf_b.ndim == 0:  # per-layer scalar write index:
@@ -756,7 +804,8 @@ class ContinuousBatcher:
             tok = jax.lax.dynamic_update_slice(tok_b, tok_1, (row,))
             pos = jax.lax.dynamic_update_slice(pos_b, pos_1, (row,))
             temps = jax.lax.dynamic_update_slice(temps_b, temp_1, (row,))
-            return cache, tok, pos, temps
+            ads = jax.lax.dynamic_update_slice(ads_b, ad_1, (row,))
+            return cache, tok, pos, temps, ads
 
         return admit
 
@@ -769,13 +818,14 @@ class ContinuousBatcher:
         constrain = self._constrain_cache
 
         @jax.jit
-        def chunk(params, cache, tokens, positions):
+        def chunk(params, cache, tokens, positions, ads):
             logits, updated = model.apply(
                 {"params": params, "cache": cache},
                 tokens,
                 positions=positions,
                 decode=True,
                 padded=True,
+                adapter_ids=ads,
                 mutable=["cache"],
             )
             return constrain(updated["cache"]), logits
@@ -836,7 +886,9 @@ class ContinuousBatcher:
             # overwritten by the first continuation chunk before any
             # query position can attend them (keys > query pos are
             # masked), so reuse needs no cleanup pass.
-            cache_1, resume = self._prefix_store.lookup(p.tokens)
+            cache_1, resume = self._prefix_store.lookup(
+                p.tokens, p.adapter
+            )
         if cache_1 is None:
             cache_1 = self._single_row_cache()
         return _PrefillJob(
@@ -846,12 +898,13 @@ class ContinuousBatcher:
             next_pos=resume,
             length=len(p.tokens),
             temp_1=jnp.asarray([temp], jnp.float32),
+            ad_1=jnp.asarray([p.adapter], jnp.int32),
             # first boundary entry lands at the first chunk boundary
             # past the resume point, then depths double
             next_insert_depth=self._prefill_chunk or 0,
         )
 
-    def _advance_job(self, cache, tok, pos, temps):
+    def _advance_job(self, cache, tok, pos, temps, ads):
         """Run ONE chunk of the in-flight prefill; on the final chunk,
         sample the first token and scatter the row into the batch.
         Chunks cover only the true prompt length — the padding region a
@@ -876,6 +929,7 @@ class ContinuousBatcher:
             job.cache_1,
             jnp.asarray(toks),
             jnp.asarray(positions),
+            job.ad_1,
         )
         job.next_pos = start_w + c
         if job.next_pos < job.length:
@@ -899,14 +953,17 @@ class ContinuousBatcher:
                 # exceed a small LRU. Hot shared entries are refreshed
                 # on every hit, so one long prompt cannot flush them.
                 self._prefix_store.insert(
-                    job.p.tokens[: job.next_pos], job.cache_1
+                    job.p.tokens[: job.next_pos], job.cache_1,
+                    job.p.adapter,
                 )
                 job.next_insert_depth = 2 * job.next_pos
                 job.boundary_inserts += 1
-            return cache, tok, pos, temps
+            return cache, tok, pos, temps, ads
         if self._prefix_store is not None:
             # The completed single-row cache covers the whole prompt.
-            self._prefix_store.insert(job.p.tokens, job.cache_1)
+            self._prefix_store.insert(
+                job.p.tokens, job.cache_1, job.p.adapter
+            )
         # final chunk: it contains the prompt's last true position
         tok_1, lp_1 = self._sample1_fn(
             logits,
@@ -914,7 +971,7 @@ class ContinuousBatcher:
             job.temp_1,
             self._next_key(),
         )
-        cache, tok, pos, temps = self._admit_fn(
+        cache, tok, pos, temps, ads = self._admit_fn(
             cache,
             job.cache_1,
             jnp.int32(job.row),
@@ -924,6 +981,8 @@ class ContinuousBatcher:
             jnp.asarray([job.length], jnp.int32),
             temps,
             job.temp_1,
+            ads,
+            job.ad_1,
         )
         first = int(np.asarray(tok_1)[0])
         lps = [float(np.asarray(lp_1)[0])]
@@ -933,7 +992,7 @@ class ContinuousBatcher:
         if self._finished(job.p, [first], first):
             self._retire(job.row)
         self._job = None
-        return cache, tok, pos, temps
+        return cache, tok, pos, temps, ads
 
     # -- engine loop ---------------------------------------------------
 
@@ -963,7 +1022,8 @@ class ContinuousBatcher:
         # admission.
         pos = jnp.zeros((b,), jnp.int32)
         temps = jnp.zeros((b,), jnp.float32)
-        return cache, tok, pos, temps
+        ads = jnp.zeros((b,), jnp.int32)  # adapter slot 0 = base
+        return cache, tok, pos, temps, ads
 
     def _bucket(self, n: int) -> int:
         for w in self._widths:
@@ -975,7 +1035,9 @@ class ContinuousBatcher:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _admit_one(self, p: _Pending, row: int, cache, tok, pos, temps):
+    def _admit_one(
+        self, p: _Pending, row: int, cache, tok, pos, temps, ads
+    ):
         w = self._bucket(len(p.tokens))
         prompt = np.zeros((1, w), np.int32)
         prompt[0, : len(p.tokens)] = p.tokens
@@ -985,16 +1047,18 @@ class ContinuousBatcher:
             else float(p.temperature)
         )
         temp_1 = jnp.asarray([temp], jnp.float32)
+        ad_1 = jnp.asarray([p.adapter], jnp.int32)
         cache_1, tok_1, pos_1, lp_1 = self._prefill_fn(w)(
             self._params,
             jnp.asarray(prompt),
             jnp.asarray([len(p.tokens)], jnp.int32),
             temp_1,
+            ad_1,
             self._next_key(),
         )
-        cache, tok, pos, temps = self._admit_fn(
+        cache, tok, pos, temps, ads = self._admit_fn(
             cache, cache_1, jnp.int32(row), tok, tok_1, pos, pos_1,
-            temps, temp_1,
+            temps, temp_1, ads, ad_1,
         )
         first = int(np.asarray(tok_1)[0])
         out = [first]
@@ -1004,7 +1068,7 @@ class ContinuousBatcher:
         p.emit(first, lps[0])
         if self._finished(p, out, first):
             self._retire(row)
-        return cache, tok, pos, temps
+        return cache, tok, pos, temps, ads
 
     def _finished(self, p: _Pending, out: list[int], last: int) -> bool:
         # Per-request eos: None = engine default; negative = DISABLED
@@ -1054,7 +1118,7 @@ class ContinuousBatcher:
             self._fail_one(item, RuntimeError("engine shutting down"))
 
     def _loop(self) -> None:
-        cache = tok = pos = temps = None
+        cache = tok = pos = temps = ads = None
         try:
             while True:
                 if self._stop_now.is_set():
@@ -1101,10 +1165,10 @@ class ContinuousBatcher:
                         return
                     self._inflight = item
                     if cache is None:
-                        cache, tok, pos, temps = self._empty_state()
+                        cache, tok, pos, temps, ads = self._empty_state()
                     if self._prefill_chunk is None:
-                        cache, tok, pos, temps = self._admit_one(
-                            item, free[0], cache, tok, pos, temps
+                        cache, tok, pos, temps, ads = self._admit_one(
+                            item, free[0], cache, tok, pos, temps, ads
                         )
                     else:
                         self._job = self._start_job(item, free[0])
@@ -1112,15 +1176,16 @@ class ContinuousBatcher:
                     idle = False
 
                 if self._job is not None:
-                    cache, tok, pos, temps = self._advance_job(
-                        cache, tok, pos, temps
+                    cache, tok, pos, temps, ads = self._advance_job(
+                        cache, tok, pos, temps, ads
                     )
 
                 if all(e is None for e in self._live):
                     continue  # nothing decoding; admit/chunk again
 
                 cache, tok, pos, lp = self._step_fn(
-                    self._params, cache, tok, pos, temps, self._next_key()
+                    self._params, cache, tok, pos, temps, ads,
+                    self._next_key(),
                 )
                 self.steps += 1
                 host_tok = np.asarray(tok)

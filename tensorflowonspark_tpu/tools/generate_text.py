@@ -60,6 +60,14 @@ def build_parser() -> argparse.ArgumentParser:
         "next-token logprobs (+ summed total) as JSONL — the batch "
         "eval/perplexity surface (decode flags are ignored)",
     )
+    p.add_argument(
+        "--lora-scale",
+        type=float,
+        default=None,
+        help="LoRA checkpoints: alpha/rank scale to re-apply after "
+        "restore (the static scale field is not stored; default 1.0 "
+        "matches add_lora's default alpha=rank)",
+    )
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--temperature", type=float, default=0.0)
@@ -124,10 +132,13 @@ def _load_config(args):
     return base
 
 
-def _load_params(checkpoint: str, cfg):
+def _load_params(checkpoint: str, cfg, lora_scale: float = 1.0):
     """Restore params from either a CheckpointManager dir (latest step)
     or a bare save_checkpoint path; accept TrainState trees, {'state':
-    ...} wrappers, or bare param trees."""
+    ...} wrappers, or bare param trees. LoRA nodes (single adapters or
+    multi-adapter banks) restored as plain dicts are rewrapped so the
+    adapter paths route again (``ops/lora.py:rewrap_lora``);
+    ``lora_scale`` re-supplies the non-stored static scale."""
     import jax
     import jax.numpy as jnp
 
@@ -151,6 +162,9 @@ def _load_params(checkpoint: str, cfg):
             f"checkpoint {checkpoint} does not contain a Llama param tree "
             f"(top-level keys: {sorted(tree) if isinstance(tree, dict) else type(tree)})"
         )
+    from tensorflowonspark_tpu.ops.lora import rewrap_lora
+
+    tree = rewrap_lora(tree, lora_scale)
     # decode in the model's compute dtype
     return jax.tree.map(
         lambda x: x.astype(cfg.dtype)
@@ -386,7 +400,10 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit("--batch-size must be >= 1")
     cfg = _load_config(args)
     model = Llama(cfg)
-    params = _load_params(args.checkpoint, cfg)
+    params = _load_params(
+        args.checkpoint, cfg,
+        lora_scale=getattr(args, "lora_scale", None) or 1.0,
+    )
 
     with open(args.prompts) as f:
         rows = [json.loads(line) for line in f if line.strip()]

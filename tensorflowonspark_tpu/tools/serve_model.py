@@ -193,17 +193,19 @@ class _Handler(BaseHTTPRequestHandler):
             temperature = payload.get("temperature")
             max_new = payload.get("max_new_tokens")
             eos_id = payload.get("eos_id")
+            adapter = payload.get("adapter")
             want_logprobs = bool(payload.get("logprobs"))
             if (
                 temperature is not None
                 or max_new is not None
                 or eos_id is not None
+                or adapter is not None
                 or want_logprobs
             ) and self.gen_engine is None:
                 raise ValueError(
                     "per-request temperature/max_new_tokens/eos_id/"
-                    "logprobs require --gen-engine continuous (the "
-                    "fixed path bakes decode params at startup)"
+                    "adapter/logprobs require --gen-engine continuous "
+                    "(the fixed path bakes decode params at startup)"
                 )
             if temperature is not None:
                 temperature = float(temperature)
@@ -217,6 +219,8 @@ class _Handler(BaseHTTPRequestHandler):
                     )
             if eos_id is not None:
                 eos_id = int(eos_id)
+            if adapter is not None:
+                adapter = int(adapter)
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             self._reply(400, {"error": str(e)})
             return
@@ -236,7 +240,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if stream:
             self._engine_stream(
-                prompts[0], temperature, max_new, eos_id, want_logprobs
+                prompts[0], temperature, max_new, eos_id, want_logprobs,
+                adapter,
             )
             return
         from tensorflowonspark_tpu.serving import EngineOverloaded
@@ -247,7 +252,7 @@ class _Handler(BaseHTTPRequestHandler):
                 try:
                     completions = self._engine_generate(
                         prompts, temperature, max_new, eos_id,
-                        want_logprobs,
+                        want_logprobs, adapter,
                     )
                     if want_logprobs:
                         completions, logprobs = completions
@@ -289,6 +294,7 @@ class _Handler(BaseHTTPRequestHandler):
         max_new=None,
         eos_id=None,
         want_logprobs=False,
+        adapter=None,
     ) -> None:
         """Stream one completion as newline-delimited JSON: a
         ``{"token": t}`` line per decoded token (one engine step of
@@ -305,6 +311,7 @@ class _Handler(BaseHTTPRequestHandler):
                 temperature=temperature,
                 eos_id=eos_id,
                 yield_logprobs=want_logprobs,
+                adapter=adapter,
             )
         except EngineOverloaded as e:
             self._reply(503, {"error": str(e)}, {"Retry-After": "1"})
@@ -355,6 +362,7 @@ class _Handler(BaseHTTPRequestHandler):
         max_new=None,
         eos_id=None,
         want_logprobs=False,
+        adapter=None,
     ):
         """Continuous-batching path: the request's rows are admitted
         ATOMICALLY (all accepted, or a 400/503 before any decodes — a
@@ -367,6 +375,7 @@ class _Handler(BaseHTTPRequestHandler):
             temperature=temperature,
             eos_id=eos_id,
             return_logprobs=want_logprobs,
+            adapter=adapter,
         )
 
 
@@ -592,7 +601,9 @@ def _build_engine(gen: dict):
         )
     # Cheap shape validation above happens BEFORE the (potentially
     # multi-GB) checkpoint restore, same policy as the draft path.
-    params = _load_params(gen["checkpoint"], cfg)
+    params = _load_params(
+        gen["checkpoint"], cfg, lora_scale=gen.get("lora_scale") or 1.0
+    )
     engine = ContinuousBatcher(
         model,
         params,
@@ -635,7 +646,9 @@ def _build_gen_fn(gen: dict):
         )
     )
     model = Llama(cfg)
-    params = _load_params(gen["checkpoint"], cfg)
+    params = _load_params(
+        gen["checkpoint"], cfg, lora_scale=gen.get("lora_scale") or 1.0
+    )
     width = int(gen.get("width", 128))
     bsz = int(gen.get("batch_size", 8))
     max_new = int(gen.get("max_new_tokens", 64))
@@ -926,6 +939,14 @@ def main(argv: list[str] | None = None) -> int:
         "requests before stopping instead of failing them",
     )
     p.add_argument(
+        "--gen-lora-scale",
+        type=float,
+        default=None,
+        help="LoRA checkpoints: alpha/rank scale to re-apply after "
+        "restore (orbax does not store the static scale field; "
+        "default 1.0 matches add_lora's default alpha=rank)",
+    )
+    p.add_argument(
         "--gen-prefix-cache",
         type=int,
         default=None,
@@ -976,6 +997,7 @@ def main(argv: list[str] | None = None) -> int:
             max_queue=args.gen_max_queue,
             prefill_chunk=args.gen_prefill_chunk,
             prefix_cache=args.gen_prefix_cache,
+            lora_scale=args.gen_lora_scale,
             drain_on_shutdown=args.gen_drain_on_shutdown,
         )
     server = make_server(

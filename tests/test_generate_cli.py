@@ -659,3 +659,83 @@ def test_serve_model_generate_endpoint(tmp_path):
         assert code == 400
     finally:
         server.shutdown()
+
+
+def test_serve_model_multi_lora_bank_checkpoint(tmp_path):
+    """A saved multi-LoRA bank checkpoint serves per-request adapters
+    end-to-end: orbax restores the bank as plain dicts (static scale
+    and pytree classes are not stored), _load_params rewraps them, and
+    the HTTP "adapter" field routes each request — matching generate()
+    under that adapter's single-LoRA tree."""
+    import threading
+
+    import optax
+
+    from tensorflowonspark_tpu.compute import TrainState
+    from tensorflowonspark_tpu.compute.checkpoint import CheckpointManager
+    from tensorflowonspark_tpu.ops import lora
+    from tensorflowonspark_tpu.tools import serve_model
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=False)
+    model = Llama(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    def trained(seed):
+        tree = lora.add_lora(params, rank=4, rng=jax.random.PRNGKey(seed))
+        keys = iter(jax.random.split(jax.random.PRNGKey(seed + 50), 200))
+        return jax.tree.map(
+            lambda x: lora.LoraTensor(
+                base=x.base,
+                a=x.a,
+                b=0.02
+                * jax.random.normal(next(keys), x.b.shape, x.b.dtype),
+                scale=x.scale,
+            )
+            if isinstance(x, lora.LoraTensor)
+            else x,
+            tree,
+            is_leaf=lambda x: isinstance(x, lora.LoraTensor),
+        )
+
+    bank = lora.multi_lora_bank([trained(1), trained(2)])
+    ckpt_dir = str(tmp_path / "bank_ckpt")
+    with CheckpointManager(ckpt_dir, async_save=False) as mgr:
+        mgr.save(0, TrainState.create(bank, optax.sgd(0.1)), force=True)
+
+    gen = dict(
+        checkpoint=ckpt_dir,
+        model="tiny",
+        config_overrides='{"remat": false, "dtype": "float32"}',
+        width=8,
+        batch_size=2,
+        max_new_tokens=5,
+        engine="continuous",
+    )
+    server = serve_model.make_server(None, port=0, gen=gen)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        prompt = [5, 3, 1, 7]
+        for k in range(3):
+            want = np.asarray(
+                generate(
+                    model,
+                    lora.select_adapter(bank, k),
+                    jnp.asarray([prompt], jnp.int32),
+                    5,
+                )
+            )[0].tolist()
+            code, body = _post(
+                port, "/generate",
+                {"prompts": [prompt], "adapter": k},
+            )
+            assert code == 200, body
+            assert body["completions"] == [want], k
+        code, body = _post(
+            port, "/generate", {"prompts": [[1, 2]], "adapter": 9}
+        )
+        assert code == 400 and "out of range" in body["error"]
+    finally:
+        server.shutdown()
