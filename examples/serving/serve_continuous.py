@@ -4,12 +4,15 @@ No reference counterpart: the reference's serving story stopped at batch
 scoring over partitions (SURVEY.md §2.2); this demonstrates the
 rebuild's beyond-reference online path. The script
 
-1. creates (or reuses) a tiny Llama checkpoint,
+1. creates (or reuses) a tiny Llama MULTI-LORA BANK checkpoint (one
+   base model + two fake-trained adapters),
 2. starts `tools/serve_model` in-process with `--gen-engine continuous`,
 3. fires concurrent /generate requests — mixed greedy/sampled
-   temperatures, per-request budgets — that share the engine's slots,
+   temperatures, per-request budgets, per-request LoRA adapters — that
+   share the engine's slots,
 4. streams one completion token-by-token (NDJSON `stream: true`),
-5. prints /stats (slot occupancy, TTFT and latency averages).
+5. prints /stats (slot occupancy, TTFT and latency averages, prefix
+   cache and adapter counters).
 
 Run (CPU, ~1 min, most of it XLA compiles)::
 
@@ -36,6 +39,8 @@ sys.path.insert(
 
 
 def ensure_checkpoint(path: str) -> None:
+    """A base model + two 'fine-tuned' adapters stacked into one served
+    bank (slot 0 is always the exact base; slots 1-2 the adapters)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -43,6 +48,7 @@ def ensure_checkpoint(path: str) -> None:
     from tensorflowonspark_tpu.compute import TrainState
     from tensorflowonspark_tpu.compute.checkpoint import CheckpointManager
     from tensorflowonspark_tpu.models.llama import Llama, LlamaConfig
+    from tensorflowonspark_tpu.ops import lora
 
     with CheckpointManager(path, async_save=False) as mgr:
         if mgr.latest_step() is not None:
@@ -52,7 +58,30 @@ def ensure_checkpoint(path: str) -> None:
         params = model.init(
             jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
         )["params"]
-        state = TrainState.create(params, optax.sgd(0.1))
+
+        def fake_finetune(seed):
+            tree = lora.add_lora(
+                params, rank=4, rng=jax.random.PRNGKey(seed)
+            )
+            keys = iter(jax.random.split(jax.random.PRNGKey(seed), 999))
+            return jax.tree.map(
+                lambda x: lora.LoraTensor(
+                    base=x.base, a=x.a,
+                    b=0.02 * jax.random.normal(
+                        next(keys), x.b.shape, x.b.dtype
+                    ),
+                    scale=x.scale,
+                )
+                if isinstance(x, lora.LoraTensor)
+                else x,
+                tree,
+                is_leaf=lambda x: isinstance(x, lora.LoraTensor),
+            )
+
+        bank = lora.multi_lora_bank(
+            [fake_finetune(1), fake_finetune(2)]
+        )
+        state = TrainState.create(bank, optax.sgd(0.1))
         mgr.save(0, state, force=True)
 
 
@@ -68,7 +97,7 @@ def post(port: int, payload: dict) -> dict:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--checkpoint", default="/tmp/serving_demo_ckpt")
+    ap.add_argument("--checkpoint", default="/tmp/serving_demo_bank_ckpt")
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--gen-mesh", default=None)
     args = ap.parse_args()
@@ -110,6 +139,9 @@ def main() -> int:
         {"prompts": [[4, 5]], "temperature": 0.9, "max_new_tokens": 6},
         {"prompts": [[7, 8, 9, 10]], "temperature": 0.0,
          "max_new_tokens": 8},
+        # same prompt as the first request, but routed through LoRA
+        # adapter 1 — a different tenant's fine-tune on shared slots
+        {"prompts": [[1, 2, 3]], "temperature": 0.0, "adapter": 1},
     ]
     results = [None] * len(payloads)
     threads = [
@@ -128,7 +160,8 @@ def main() -> int:
         if r is None:  # its thread's HTTP error went to stderr
             print(f"prompt={p['prompts'][0]} FAILED (see traceback)")
             return 1
-        print(f"prompt={p['prompts'][0]} temp={p['temperature']} "
+        tag = f" adapter={p['adapter']}" if "adapter" in p else ""
+        print(f"prompt={p['prompts'][0]} temp={p['temperature']}{tag} "
               f"-> {r['completions'][0]}")
 
     # stream a completion token by token, with per-token logprobs

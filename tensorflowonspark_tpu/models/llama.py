@@ -80,6 +80,12 @@ class LlamaConfig:
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
+    # Sliding-window (Mistral-style local) attention: each query
+    # attends only the last `sliding_window` positions. None = full
+    # causal attention. Applies to training/prefill (xla + flash
+    # impls; the flash kernel skips blocks below the window edge) AND
+    # cached decode (window-masked reads of the full-length cache).
+    sliding_window: int | None = None
     # KV-cache storage: "model" (= dtype, exact) or "int8" (per-token
     # per-head max-abs quantization — halves the cache HBM footprint
     # AND the per-step cache read traffic that bounds long-context
@@ -108,6 +114,28 @@ class LlamaConfig:
             num_kv_heads=16,
             max_seq_len=1024,
             dtype=jnp.bfloat16,
+        )
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def mistral_7b(**overrides) -> "LlamaConfig":
+        """Mistral-7B-v0.1: Llama layout + GQA + sliding-window 4096
+        (import real weights with ``tools/import_hf_llama`` — the
+        converter accepts ``model_type: mistral``)."""
+        base = dict(
+            vocab_size=32000,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            # matches the checkpoint's max_position_embeddings (the
+            # importer produces the same value), NOT the 4096 window —
+            # context runs far past the window by design
+            max_seq_len=32768,
+            rope_theta=10000.0,
+            sliding_window=4096,
         )
         base.update(overrides)
         return LlamaConfig(**base)
@@ -256,7 +284,7 @@ class Attention(nn.Module):
         else:
             out = dot_product_attention(
                 q, k, v, causal=True, segment_ids=segment_ids,
-                impl=cfg.attention_impl,
+                impl=cfg.attention_impl, window=cfg.sliding_window,
             )
         out = out.reshape(b, s, cfg.num_heads * cfg.head_dim)
         return dense(cfg.hidden_size, "o_proj")(out, adapter_ids)
@@ -319,6 +347,14 @@ class Attention(nn.Module):
         cs = self.variable(
             "cache", "seg", jnp.zeros, (b, cfg.max_seq_len), jnp.int32
         )
+        if cfg.sliding_window is not None:
+            # Each slot's RoPE position: the window masks by POSITION
+            # distance, not slot distance — for packed rows continuing
+            # an earlier document, the two diverge (other documents'
+            # tokens occupy the slots between).
+            cp = self.variable(
+                "cache", "pos", jnp.zeros, (b, cfg.max_seq_len), jnp.int32
+            )
         ci = self.variable(
             "cache", "idx", lambda: jnp.zeros((), jnp.int32)
         )
@@ -352,6 +388,8 @@ class Attention(nn.Module):
             if int8_kv:
                 cks.value = cks.value.at[rows, positions].set(ks_new)
                 cvs.value = cvs.value.at[rows, positions].set(vs_new)
+            if cfg.sliding_window is not None:
+                cp.value = cp.value.at[rows, positions].set(positions)
             # positions ARE the slots here (unpacked rows only; the
             # packed+padded combination is rejected in __call__)
             slot_q = positions
@@ -370,6 +408,10 @@ class Attention(nn.Module):
                     cvs.value, vs_new, (0, cur, 0)
                 )
             cs.value = jax.lax.dynamic_update_slice(cs.value, seg, (0, cur))
+            if cfg.sliding_window is not None:
+                cp.value = jax.lax.dynamic_update_slice(
+                    cp.value, positions.astype(jnp.int32), (0, cur)
+                )
             slot_q = jnp.broadcast_to(
                 (cur + jnp.arange(s, dtype=jnp.int32))[None, :], (b, s)
             )
@@ -407,6 +449,13 @@ class Attention(nn.Module):
         mask = mask & (
             cs.value[:, None, None, None, :] == seg[:, None, None, :, None]
         )
+        if cfg.sliding_window is not None:
+            # sliding window by RoPE-position distance (slots already
+            # bounded above by slot_q): attend only the last W positions
+            mask = mask & (
+                cp.value[:, None, None, None, :]
+                > positions[:, None, None, :, None] - cfg.sliding_window
+            )
         logits = jnp.where(mask, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
         if int8_kv:

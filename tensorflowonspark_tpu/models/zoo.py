@@ -201,9 +201,13 @@ def _build_llama(variant, tiny):
     from tensorflowonspark_tpu.models import llama as L
 
     if tiny:
-        cfg = L.LlamaConfig.tiny()
+        cfg = L.LlamaConfig.tiny(
+            sliding_window=8 if variant == "mistral_7b" else None
+        )
     elif variant == "llama2_7b":
         cfg = L.LlamaConfig.llama2_7b()
+    elif variant == "mistral_7b":
+        cfg = L.LlamaConfig.mistral_7b()
     else:  # llama_1b (the BASELINE.md benchmark config)
         cfg = L.LlamaConfig.llama_1b()
     model = L.Llama(cfg)
@@ -244,6 +248,7 @@ _BUILDERS: dict[str, Callable[..., ZooEntry]] = {
     "bert_base": lambda tiny, nc: _build_bert(tiny),
     "llama_1b": lambda tiny, nc: _build_llama("llama_1b", tiny),
     "llama2_7b": lambda tiny, nc: _build_llama("llama2_7b", tiny),
+    "mistral_7b": lambda tiny, nc: _build_llama("mistral_7b", tiny),
 }
 
 

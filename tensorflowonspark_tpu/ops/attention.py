@@ -26,6 +26,7 @@ def _xla_attention(
     causal: bool = False,
     scale: float | None = None,
     segment_ids: jax.Array | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Reference attention: (B, Sq, H, D) x (B, Sk, H, D) -> (B, Sq, H, D).
 
@@ -43,6 +44,12 @@ def _xla_attention(
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        if window is not None:
+            # sliding window: query i (absolute i + sk - sq) attends only
+            # the last `window` keys — same end-aligned convention
+            q_pos = jnp.arange(sq)[:, None] + (sk - sq)
+            k_pos = jnp.arange(sk)[None, :]
+            mask = mask & (q_pos - k_pos < window)
         logits = jnp.where(mask[None, None], logits, jnp.finfo(logits.dtype).min)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
@@ -62,17 +69,34 @@ def dot_product_attention(
     scale: float | None = None,
     segment_ids: jax.Array | None = None,
     impl: str = "auto",
+    window: int | None = None,
 ) -> jax.Array:
     """Multi-head attention with optional causal masking and GQA.
 
     Shapes: q (B, Sq, Hq, D); k/v (B, Sk, Hkv, D); returns (B, Sq, Hq, D).
+
+    ``window`` restricts each query to the last ``window`` keys
+    (sliding-window / Mistral-style local attention; requires
+    ``causal=True``). Supported by the xla and flash impls; the
+    sequence-parallel impls reject it loudly (a windowed ring pass
+    skips most hops — a different schedule, not a mask).
 
     ``impl='ring'`` runs sequence-parallel ring attention over the ambient
     mesh's ``seq`` axis (set with ``parallel.use_mesh``); the mesh is a
     trace-time object, so this path is dispatched outside the jit cache —
     it is meant to be called from inside an outer jitted train step.
     """
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1"
+        )
     if impl in ("ring", "ulysses"):
+        if window is not None:
+            raise ValueError(
+                f"impl={impl!r} does not support sliding-window "
+                "attention yet; use impl='auto' (flash/xla), or shard "
+                "long windowed sequences with FSDP/TP instead of SP"
+            )
         from tensorflowonspark_tpu.parallel import current_mesh
 
         mesh = current_mesh()
@@ -102,12 +126,12 @@ def dot_product_attention(
         )
     return _jitted_attention(
         q, k, v, causal=causal, scale=scale,
-        segment_ids=segment_ids, impl=impl,
+        segment_ids=segment_ids, impl=impl, window=window,
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "scale", "impl")
+    jax.jit, static_argnames=("causal", "scale", "impl", "window")
 )
 def _jitted_attention(
     q: jax.Array,
@@ -118,6 +142,7 @@ def _jitted_attention(
     scale: float | None = None,
     segment_ids: jax.Array | None = None,
     impl: str = "auto",
+    window: int | None = None,
 ) -> jax.Array:
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
@@ -137,8 +162,9 @@ def _jitted_attention(
 
         # positional: custom_vjp functions reject keyword arguments
         return flash_attention(
-            q, k, v, causal, scale, None, None, segment_ids
+            q, k, v, causal, scale, None, None, window, segment_ids
         )
     return _xla_attention(
-        q, k, v, causal=causal, scale=scale, segment_ids=segment_ids
+        q, k, v, causal=causal, scale=scale, segment_ids=segment_ids,
+        window=window,
     )

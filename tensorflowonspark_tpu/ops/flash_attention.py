@@ -49,19 +49,64 @@ def _causal_live(qi, ki, block_q: int, block_k: int, offset: int):
     return ki * block_k <= (qi + 1) * block_q - 1 + offset
 
 
-def _tile_logits(q, k, qi, ki, block_q, block_k, offset, causal, scale):
-    """Scaled (block_q, block_k) logits with the causal mask applied."""
+def _window_live(qi, ki, block_q, block_k, offset, window):
+    """This block pair has keys inside the sliding window's lower edge
+    (query i attends j >= i + offset - window + 1)."""
+    return (ki + 1) * block_k - 1 >= qi * block_q + offset - (window - 1)
+
+
+def _window_grid_k(window, block_q, block_k, num_k_blocks):
+    """K-block grid extent per q block under a window: the live key span
+    of one q block is block_q + window - 1 elements, so this many blocks
+    always cover it (+1 for alignment slack). The grid — and therefore
+    the K/V block DMAs — shrinks with it: windowed cost is O(S·W) in
+    BOTH compute and HBM traffic, not just masked-out compute."""
+    if window is None:
+        return num_k_blocks
+    return min(num_k_blocks, (block_q + window - 2) // block_k + 2)
+
+
+def _first_k_block(qi, offset, window, block_q, block_k, nk, num_k_blocks):
+    """First k block of this q block's restricted span, clamped so the
+    nk-wide span stays inside [0, num_k_blocks). Blocks pulled in by the
+    clamp are dead and get masked by the live/window checks."""
+    first = (qi * block_q + offset - (window - 1)) // block_k
+    return jnp.clip(first, 0, num_k_blocks - nk)
+
+
+def _window_grid_q(window, block_q, block_k, num_q_blocks):
+    """Q-block grid extent per k block (the dkv kernel's restriction)."""
+    if window is None:
+        return num_q_blocks
+    return min(num_q_blocks, (block_k + window - 2) // block_q + 2)
+
+
+def _first_q_block(ki, offset, window, block_q, block_k, nq, num_q_blocks):
+    """First q block that can attend this k block (the causal lower edge
+    q >= k - offset), clamped like :func:`_first_k_block`."""
+    first = (ki * block_k - offset) // block_q
+    return jnp.clip(first, 0, num_q_blocks - nq)
+
+
+def _tile_logits(
+    q, k, qi, ki, block_q, block_k, offset, causal, scale, window=None
+):
+    """Scaled (block_q, block_k) logits with the causal (and optional
+    sliding-window) mask applied."""
     s = scale * jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    if causal:
+    if causal or window is not None:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+        if causal:
+            s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+        if window is not None:
+            s = jnp.where(q_pos + offset - k_pos < window, s, NEG_INF)
     return s
 
 
@@ -89,6 +134,7 @@ def _segment_masked(s, qseg_ref, kseg_ref, block_k: int):
 def _fwd_kernel(
     *refs, block_q: int, block_k: int, seq_q: int, seq_k: int,
     causal: bool, scale: float, num_k_blocks: int, has_segments: bool,
+    window: int | None = None,
 ):
     if has_segments:
         (q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
@@ -97,9 +143,17 @@ def _fwd_kernel(
         q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
         qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    kr = pl.program_id(2)  # restricted index: kr-th block of the window span
+    offset = seq_k - seq_q
+    nk = _window_grid_k(window, block_q, block_k, num_k_blocks)
+    if window is None:
+        ki = kr
+    else:
+        ki = kr + _first_k_block(
+            qi, offset, window, block_q, block_k, nk, num_k_blocks
+        )
 
-    @pl.when(ki == 0)
+    @pl.when(kr == 0)
     def _init():
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
@@ -107,17 +161,20 @@ def _fwd_kernel(
 
     # End-aligned causal semantics (matches the XLA path's tril(k=sk-sq)):
     # query i attends keys j <= i + (sk - sq).
-    offset = seq_k - seq_q
     live = (
         _causal_live(qi, ki, block_q, block_k, offset) if causal else ki >= 0
     )
+    if window is not None:
+        live = live & _window_live(qi, ki, block_q, block_k, offset, window)
 
     @pl.when(live)
     def _compute():
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
-        s = _tile_logits(q, k, qi, ki, block_q, block_k, offset, causal, scale)
+        s = _tile_logits(
+            q, k, qi, ki, block_q, block_k, offset, causal, scale, window
+        )
         s = _segment_masked(s, qseg_ref, kseg_ref, block_k)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -129,7 +186,7 @@ def _fwd_kernel(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(ki == num_k_blocks - 1)
+    @pl.when(kr == nk - 1)
     def _finalize():
         l = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
@@ -172,6 +229,7 @@ def _flash_forward(
     block_k: int = DEFAULT_BLOCK_K,
     return_lse: bool = False,
     segment_ids: jax.Array | None = None,
+    window: int | None = None,
 ):
     """(B, Sq, H, D) attention with GQA head broadcast, Pallas forward."""
     b, sq, hq, d = q.shape
@@ -197,11 +255,21 @@ def _flash_forward(
     vt = v.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
 
     num_k_blocks = sk // block_k
-    grid = (b * hq, sq // block_q, num_k_blocks)
+    nk_w = _window_grid_k(window, block_q, block_k, num_k_blocks)
+    grid = (b * hq, sq // block_q, nk_w)
 
-    def kv_row(h, qi, ki):
+    def k_block(qi, kr):
+        # restricted ki grid -> actual k block (windowed kernels DMA
+        # only the ~window-span K/V blocks per q block)
+        if window is None:
+            return kr
+        return kr + _first_k_block(
+            qi, sk - sq, window, block_q, block_k, nk_w, num_k_blocks
+        )
+
+    def kv_row(h, qi, kr):
         # grid row h = batch * hq + q_head; its KV row in the (b*hk) array
-        return (h // hq) * hk + (h % hq) // group, ki, 0
+        return (h // hq) * hk + (h % hq) // group, k_block(qi, kr), 0
 
     kernel = functools.partial(
         _fwd_kernel,
@@ -213,6 +281,7 @@ def _flash_forward(
         scale=scale,
         num_k_blocks=num_k_blocks,
         has_segments=segment_ids is not None,
+        window=window,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
@@ -223,10 +292,11 @@ def _flash_forward(
     if segment_ids is not None:
         in_specs += [
             pl.BlockSpec(
-                (1, block_q, NUM_LANES), lambda h, qi, ki: (h // hq, qi, 0)
+                (1, block_q, NUM_LANES), lambda h, qi, kr: (h // hq, qi, 0)
             ),
             pl.BlockSpec(
-                (1, NUM_SUBLANES, block_k), lambda h, qi, ki: (h // hq, 0, ki)
+                (1, NUM_SUBLANES, block_k),
+                lambda h, qi, kr: (h // hq, 0, k_block(qi, kr)),
             ),
         ]
         operands += list(_segment_operands(segment_ids, sq, sk))
@@ -273,6 +343,7 @@ def _probs(s, lse_col):
 def _dq_kernel(
     *refs, block_q: int, block_k: int, seq_q: int, seq_k: int,
     causal: bool, scale: float, num_k_blocks: int, has_segments: bool,
+    window: int | None = None,
 ):
     if has_segments:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -282,16 +353,25 @@ def _dq_kernel(
          dq_ref, dq_acc) = refs
         qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    kr = pl.program_id(2)
+    offset = seq_k - seq_q
+    nk = _window_grid_k(window, block_q, block_k, num_k_blocks)
+    if window is None:
+        ki = kr
+    else:
+        ki = kr + _first_k_block(
+            qi, offset, window, block_q, block_k, nk, num_k_blocks
+        )
 
-    @pl.when(ki == 0)
+    @pl.when(kr == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    offset = seq_k - seq_q
     live = (
         _causal_live(qi, ki, block_q, block_k, offset) if causal else ki >= 0
     )
+    if window is not None:
+        live = live & _window_live(qi, ki, block_q, block_k, offset, window)
 
     @pl.when(live)
     def _compute():
@@ -299,7 +379,9 @@ def _dq_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        s = _tile_logits(q, k, qi, ki, block_q, block_k, offset, causal, scale)
+        s = _tile_logits(
+            q, k, qi, ki, block_q, block_k, offset, causal, scale, window
+        )
         s = _segment_masked(s, qseg_ref, kseg_ref, block_k)
         p = _probs(s, lse_ref[0][:, :1])
         dp = jax.lax.dot_general(
@@ -310,7 +392,7 @@ def _dq_kernel(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(ki == num_k_blocks - 1)
+    @pl.when(kr == nk - 1)
     def _finalize():
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
@@ -318,6 +400,7 @@ def _dq_kernel(
 def _dkv_kernel(
     *refs, block_q: int, block_k: int, seq_q: int, seq_k: int,
     causal: bool, scale: float, num_q_blocks: int, has_segments: bool,
+    window: int | None = None,
 ):
     if has_segments:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -327,17 +410,26 @@ def _dkv_kernel(
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
         qseg_ref = kseg_ref = None
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    qr = pl.program_id(2)
+    offset = seq_k - seq_q
+    nq = _window_grid_q(window, block_q, block_k, num_q_blocks)
+    if window is None:
+        qi = qr
+    else:
+        qi = qr + _first_q_block(
+            ki, offset, window, block_q, block_k, nq, num_q_blocks
+        )
 
-    @pl.when(qi == 0)
+    @pl.when(qr == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    offset = seq_k - seq_q
     live = (
         _causal_live(qi, ki, block_q, block_k, offset) if causal else qi >= 0
     )
+    if window is not None:
+        live = live & _window_live(qi, ki, block_q, block_k, offset, window)
 
     @pl.when(live)
     def _compute():
@@ -345,7 +437,9 @@ def _dkv_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        s = _tile_logits(q, k, qi, ki, block_q, block_k, offset, causal, scale)
+        s = _tile_logits(
+            q, k, qi, ki, block_q, block_k, offset, causal, scale, window
+        )
         s = _segment_masked(s, qseg_ref, kseg_ref, block_k)
         p = _probs(s, lse_ref[0][:, :1])  # (block_q, block_k)
         dv_acc[...] += jax.lax.dot_general(
@@ -359,7 +453,7 @@ def _dkv_kernel(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(qi == num_q_blocks - 1)
+    @pl.when(qr == nq - 1)
     def _finalize():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
@@ -370,6 +464,7 @@ def _flash_backward(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     segment_ids: jax.Array | None = None,
+    window: int | None = None,
 ):
     b, sq, hq, d = q.shape
     _, sk, hk, _ = k.shape
@@ -397,9 +492,25 @@ def _flash_backward(
 
     num_q_blocks = sq // block_q
     num_k_blocks = sk // block_k
+    nk_w = _window_grid_k(window, block_q, block_k, num_k_blocks)
+    nq_w = _window_grid_q(window, block_q, block_k, num_q_blocks)
 
     def kv_row3(h, a, c):
         return (h // hq) * hk + (h % hq) // group
+
+    def k_block(qi, kr):
+        if window is None:
+            return kr
+        return kr + _first_k_block(
+            qi, sk - sq, window, block_q, block_k, nk_w, num_k_blocks
+        )
+
+    def q_block(ki, qr):
+        if window is None:
+            return qr
+        return qr + _first_q_block(
+            ki, sk - sq, window, block_q, block_k, nq_w, num_q_blocks
+        )
 
     common = dict(
         block_q=block_q,
@@ -408,24 +519,32 @@ def _flash_backward(
         seq_k=sk,
         causal=causal,
         scale=scale,
+        window=window,
     )
 
     has_segments = segment_ids is not None
     dq_in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda h, qi, ki: (kv_row3(h, qi, ki), ki, 0)),
-        pl.BlockSpec((1, block_k, d), lambda h, qi, ki: (kv_row3(h, qi, ki), ki, 0)),
-        pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
-        pl.BlockSpec((1, block_q, NUM_LANES), lambda h, qi, ki: (h, qi, 0)),
-        pl.BlockSpec((1, block_q, NUM_LANES), lambda h, qi, ki: (h, qi, 0)),
+        pl.BlockSpec((1, block_q, d), lambda h, qi, kr: (h, qi, 0)),
+        pl.BlockSpec(
+            (1, block_k, d),
+            lambda h, qi, kr: (kv_row3(h, qi, kr), k_block(qi, kr), 0),
+        ),
+        pl.BlockSpec(
+            (1, block_k, d),
+            lambda h, qi, kr: (kv_row3(h, qi, kr), k_block(qi, kr), 0),
+        ),
+        pl.BlockSpec((1, block_q, d), lambda h, qi, kr: (h, qi, 0)),
+        pl.BlockSpec((1, block_q, NUM_LANES), lambda h, qi, kr: (h, qi, 0)),
+        pl.BlockSpec((1, block_q, NUM_LANES), lambda h, qi, kr: (h, qi, 0)),
     ]
     if has_segments:
         dq_in_specs += [
             pl.BlockSpec(
-                (1, block_q, NUM_LANES), lambda h, qi, ki: (h // hq, qi, 0)
+                (1, block_q, NUM_LANES), lambda h, qi, kr: (h // hq, qi, 0)
             ),
             pl.BlockSpec(
-                (1, NUM_SUBLANES, block_k), lambda h, qi, ki: (h // hq, 0, ki)
+                (1, NUM_SUBLANES, block_k),
+                lambda h, qi, kr: (h // hq, 0, k_block(qi, kr)),
             ),
         ]
     dq = pl.pallas_call(
@@ -435,9 +554,9 @@ def _flash_backward(
             has_segments=has_segments,
             **common,
         ),
-        grid=(b * hq, num_q_blocks, num_k_blocks),
+        grid=(b * hq, num_q_blocks, nk_w),
         in_specs=dq_in_specs,
-        out_specs=pl.BlockSpec((1, block_q, d), lambda h, qi, ki: (h, qi, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, qi, kr: (h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=INTERPRET,
@@ -447,20 +566,35 @@ def _flash_backward(
     # and revisiting an output block from non-consecutive grid rows is not
     # allowed — group-sum afterwards instead.
     dkv_in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda h, ki, qi: (h, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (kv_row3(h, ki, qi), ki, 0)),
-        pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (kv_row3(h, ki, qi), ki, 0)),
-        pl.BlockSpec((1, block_q, d), lambda h, ki, qi: (h, qi, 0)),
-        pl.BlockSpec((1, block_q, NUM_LANES), lambda h, ki, qi: (h, qi, 0)),
-        pl.BlockSpec((1, block_q, NUM_LANES), lambda h, ki, qi: (h, qi, 0)),
+        pl.BlockSpec(
+            (1, block_q, d), lambda h, ki, qr: (h, q_block(ki, qr), 0)
+        ),
+        pl.BlockSpec(
+            (1, block_k, d), lambda h, ki, qr: (kv_row3(h, ki, qr), ki, 0)
+        ),
+        pl.BlockSpec(
+            (1, block_k, d), lambda h, ki, qr: (kv_row3(h, ki, qr), ki, 0)
+        ),
+        pl.BlockSpec(
+            (1, block_q, d), lambda h, ki, qr: (h, q_block(ki, qr), 0)
+        ),
+        pl.BlockSpec(
+            (1, block_q, NUM_LANES),
+            lambda h, ki, qr: (h, q_block(ki, qr), 0),
+        ),
+        pl.BlockSpec(
+            (1, block_q, NUM_LANES),
+            lambda h, ki, qr: (h, q_block(ki, qr), 0),
+        ),
     ]
     if has_segments:
         dkv_in_specs += [
             pl.BlockSpec(
-                (1, block_q, NUM_LANES), lambda h, ki, qi: (h // hq, qi, 0)
+                (1, block_q, NUM_LANES),
+                lambda h, ki, qr: (h // hq, q_block(ki, qr), 0),
             ),
             pl.BlockSpec(
-                (1, NUM_SUBLANES, block_k), lambda h, ki, qi: (h // hq, 0, ki)
+                (1, NUM_SUBLANES, block_k), lambda h, ki, qr: (h // hq, 0, ki)
             ),
         ]
     dk_q, dv_q = pl.pallas_call(
@@ -470,11 +604,11 @@ def _flash_backward(
             has_segments=has_segments,
             **common,
         ),
-        grid=(b * hq, num_k_blocks, num_q_blocks),
+        grid=(b * hq, num_k_blocks, nq_w),
         in_specs=dkv_in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (h, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, ki, qi: (h, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, ki, qr: (h, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, ki, qr: (h, ki, 0)),
         ],
         out_shape=[
             # f32: the group-sum below must accumulate in full precision —
@@ -520,7 +654,7 @@ def _default_blocks(sq: int, sk: int) -> tuple[int, int]:
     return pick(sq), pick(sk)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -529,32 +663,44 @@ def flash_attention(
     scale: float | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
+    window: int | None = None,
     segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Flash attention; ``segment_ids`` (B, S) masks cross-segment
-    attention for packed sequences (requires sq == sk)."""
+    attention for packed sequences (requires sq == sk). ``window``
+    restricts each query to the last ``window`` keys (sliding-window /
+    Mistral-style local attention; requires ``causal=True``) — blocks
+    entirely below the window edge are skipped, so cost is O(S·W)."""
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1"
+        )
     bq, bk = _default_blocks(q.shape[1], k.shape[1])
     return _flash_forward(
         q, k, v, causal, scale, block_q or bq, block_k or bk,
-        segment_ids=segment_ids,
+        segment_ids=segment_ids, window=window,
     )
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k, segment_ids):
+def _fwd(q, k, v, causal, scale, block_q, block_k, window, segment_ids):
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1"
+        )
     bq, bk = _default_blocks(q.shape[1], k.shape[1])
     out, lse = _flash_forward(
         q, k, v, causal, scale, block_q or bq, block_k or bk,
-        return_lse=True, segment_ids=segment_ids,
+        return_lse=True, segment_ids=segment_ids, window=window,
     )
     return out, (q, k, v, out, lse, segment_ids)
 
 
-def _bwd(causal, scale, block_q, block_k, res, g):
+def _bwd(causal, scale, block_q, block_k, window, res, g):
     q, k, v, out, lse, segment_ids = res
     bq, bk = _default_blocks(q.shape[1], k.shape[1])
     dq, dk, dv = _flash_backward(
         q, k, v, out, lse, g, causal, scale, block_q or bq, block_k or bk,
-        segment_ids=segment_ids,
+        segment_ids=segment_ids, window=window,
     )
     return dq, dk, dv, None
 

@@ -133,6 +133,13 @@ def hf_config_to_llama(hf_cfg: dict):
         rope_theta=float(hf_cfg.get("rope_theta", 10000.0)),
         rope_scaling=scaling,
         rms_norm_eps=float(hf_cfg.get("rms_norm_eps", 1e-5)),
+        # Mistral-family checkpoints: same tensor layout as Llama plus
+        # sliding-window local attention (null in v0.2+ configs)
+        sliding_window=(
+            int(hf_cfg["sliding_window"])
+            if hf_cfg.get("sliding_window") is not None
+            else None
+        ),
     )
 
 
@@ -219,10 +226,13 @@ def convert(hf_dir: str, output: str, dtype: str = "float32"):
     with open(os.path.join(hf_dir, "config.json")) as f:
         hf_cfg = json.load(f)
     model_type = hf_cfg.get("model_type", "llama")
-    if model_type != "llama":
+    if model_type not in ("llama", "mistral"):
+        # mistral shares the llama tensor layout exactly; its one
+        # architectural addition (sliding-window attention) maps to
+        # LlamaConfig.sliding_window
         raise ValueError(
-            f"model_type {model_type!r} is not 'llama'; this importer "
-            "covers the Llama family"
+            f"model_type {model_type!r} is not supported; this importer "
+            "covers the Llama family (llama, mistral)"
         )
     cfg = hf_config_to_llama(hf_cfg)
     state = load_hf_state_dict(hf_dir)

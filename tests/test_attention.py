@@ -182,7 +182,8 @@ def test_flash_segment_ids_match_xla(causal, monkeypatch):
 
     def loss_flash(q, k, v):
         return jnp.sum(
-            fa.flash_attention(q, k, v, causal, None, None, None, seg) ** 2
+            fa.flash_attention(q, k, v, causal, None, None, None, None, seg)
+            ** 2
         )
 
     def loss_ref(q, k, v):
@@ -190,7 +191,9 @@ def test_flash_segment_ids_match_xla(causal, monkeypatch):
             _xla_attention(q, k, v, causal=causal, segment_ids=seg) ** 2
         )
 
-    out_flash = fa.flash_attention(q, k, v, causal, None, None, None, seg)
+    out_flash = fa.flash_attention(
+        q, k, v, causal, None, None, None, None, seg
+    )
     out_ref = _xla_attention(q, k, v, causal=causal, segment_ids=seg)
     np.testing.assert_allclose(
         np.asarray(out_flash), np.asarray(out_ref), rtol=5e-3, atol=5e-3
@@ -236,3 +239,127 @@ def test_dispatcher_flash_segments_matches_xla(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(out_flash), np.asarray(out_ref), rtol=5e-3, atol=5e-3
     )
+
+
+# -- sliding-window (Mistral-style local) attention --------------------
+
+
+def _naive_window(q, k, v, window):
+    """O(S^2) reference: causal AND within the last `window` keys."""
+    b, s, h, d = q.shape
+    logits = np.einsum("bqhd,bkhd->bhqk", np.asarray(q), np.asarray(k))
+    logits *= d**-0.5
+    qp = np.arange(s)[:, None]
+    kp = np.arange(s)[None, :]
+    mask = (kp <= qp) & (qp - kp < window)
+    logits = np.where(mask[None, None], logits, -1e30)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", probs, np.asarray(v))
+
+
+@pytest.mark.parametrize("window", [1, 7, 16])
+def test_xla_window_matches_naive(window):
+    q, k, v = _qkv(sq=16, sk=16, d=8)
+    out = _xla_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(
+        np.asarray(out), _naive_window(q, k, v, window), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("window", [96, 128, 200, 256])
+def test_flash_window_matches_xla(window, monkeypatch):
+    """Window edges inside, at, and across block boundaries; both the
+    forward and all three gradients must match the XLA mask."""
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    q, k, v = _qkv()
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            fa.flash_attention(q, k, v, True, None, None, None, window) ** 2
+        )
+
+    def loss_xla(q, k, v):
+        return jnp.sum(
+            _xla_attention(q, k, v, causal=True, window=window) ** 2
+        )
+
+    out_flash = fa.flash_attention(q, k, v, True, None, None, None, window)
+    out_xla = _xla_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_xla), rtol=2e-5, atol=2e-5
+    )
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4
+        )
+
+
+def test_flash_window_composes_with_segments(monkeypatch):
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    q, k, v = _qkv(sq=256, sk=256)
+    seg = jnp.concatenate(
+        [jnp.zeros((2, 100), jnp.int32), jnp.ones((2, 156), jnp.int32)],
+        axis=1,
+    )
+    out_flash = fa.flash_attention(
+        q, k, v, True, None, None, None, 64, seg
+    )
+    out_xla = _xla_attention(
+        q, k, v, causal=True, window=64, segment_ids=seg
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_xla), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_window_validation():
+    q, k, v = _qkv(sq=16, sk=16, d=8)
+    with pytest.raises(ValueError, match="causal"):
+        dot_product_attention(q, k, v, causal=False, window=4)
+    with pytest.raises(ValueError, match="window"):
+        dot_product_attention(q, k, v, causal=True, window=0)
+    with pytest.raises(ValueError, match="sliding-window"):
+        dot_product_attention(q, k, v, causal=True, window=4, impl="ring")
+
+
+def test_window_grid_restriction_covers_all_live_blocks():
+    """The restricted grid must (a) actually shrink — windowed DMA cost
+    is O(S·W) — and (b) still cover every causally-live in-window block
+    for every q/k block, across awkward alignments."""
+    for sq, sk, bq, bk, w in [
+        (4096, 4096, 128, 128, 128),
+        (4096, 4096, 128, 256, 300),
+        (2048, 4096, 256, 128, 96),  # cross-attention offset
+        (1024, 1024, 128, 128, 1000),
+    ]:
+        nqb, nkb = sq // bq, sk // bk
+        off = sk - sq
+        nk = fa._window_grid_k(w, bq, bk, nkb)
+        nq = fa._window_grid_q(w, bq, bk, nqb)
+        if w * 4 < sk:
+            assert nk < nkb, (nk, nkb)  # the shrink is real
+        for qi in range(nqb):
+            first = int(fa._first_k_block(qi, off, w, bq, bk, nk, nkb))
+            live = [
+                ki
+                for ki in range(nkb)
+                if fa._causal_live(qi, ki, bq, bk, off)
+                and fa._window_live(qi, ki, bq, bk, off, w)
+            ]
+            assert all(first <= ki < first + nk for ki in live), (
+                qi, first, nk, live,
+            )
+        for ki in range(nkb):
+            firstq = int(fa._first_q_block(ki, off, w, bq, bk, nq, nqb))
+            liveq = [
+                qi
+                for qi in range(nqb)
+                if fa._causal_live(qi, ki, bq, bk, off)
+                and fa._window_live(qi, ki, bq, bk, off, w)
+            ]
+            assert all(firstq <= qi < firstq + nq for qi in liveq), (
+                ki, firstq, nq, liveq,
+            )

@@ -219,3 +219,47 @@ def test_hf_import_tied_embeddings(tmp_path):
     ours = Llama(dataclasses.replace(cfg, dtype=jnp.float32, remat=False))
     our_logits = np.asarray(ours.apply({"params": params}, jnp.asarray(tokens)))
     np.testing.assert_allclose(our_logits, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_import_mistral_sliding_window(tmp_path):
+    """Mistral-family checkpoints (Llama layout + sliding-window local
+    attention) convert logit-exactly: the window must actually bite
+    (seq > window) and match HF's eager sliding-window mask."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.llama import Llama
+    from tensorflowonspark_tpu.tools.import_hf_llama import convert
+
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=96,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        sliding_window=8,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(7)
+    model = transformers.MistralForCausalLM(hf_cfg).eval()
+    d = str(tmp_path / "mistral")
+    model.save_pretrained(d)
+    cfg, params = convert(d, str(tmp_path / "conv"))
+    assert cfg.sliding_window == 8
+
+    tokens = np.arange(40, dtype=np.int32)[None, :] % 96  # 40 >> window 8
+    with torch.no_grad():
+        hf_logits = (
+            model(torch.tensor(tokens, dtype=torch.long))
+            .logits.float()
+            .numpy()
+        )
+    ours = Llama(dataclasses.replace(cfg, dtype=jnp.float32, remat=False))
+    our_logits = np.asarray(
+        ours.apply({"params": params}, jnp.asarray(tokens))
+    )
+    np.testing.assert_allclose(our_logits, hf_logits, rtol=2e-4, atol=2e-4)
