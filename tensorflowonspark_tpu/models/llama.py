@@ -82,9 +82,11 @@ class LlamaConfig:
     moe_capacity_factor: float = 2.0
     # Sliding-window (Mistral-style local) attention: each query
     # attends only the last `sliding_window` positions. None = full
-    # causal attention. Applies to training/prefill (xla + flash
-    # impls; the flash kernel skips blocks below the window edge) AND
-    # cached decode (window-masked reads of the full-length cache).
+    # causal attention. Applies to training/prefill (xla + flash — the
+    # flash kernel restricts its grids to the window span — and the SP
+    # impls: ring shortens its rotation to the owners in reach, ulysses
+    # passes the window to each device's local attention) AND cached
+    # decode (position-plane-masked reads of the full-length cache).
     sliding_window: int | None = None
     # KV-cache storage: "model" (= dtype, exact) or "int8" (per-token
     # per-head max-abs quantization — halves the cache HBM footprint

@@ -77,9 +77,11 @@ def dot_product_attention(
 
     ``window`` restricts each query to the last ``window`` keys
     (sliding-window / Mistral-style local attention; requires
-    ``causal=True``). Supported by the xla and flash impls; the
-    sequence-parallel impls reject it loudly (a windowed ring pass
-    skips most hops — a different schedule, not a mask).
+    ``causal=True``). All impls support it: xla/flash mask (the flash
+    kernel also restricts its grids to the window span), ring shortens
+    the rotation to the owners in reach (``parallel.ring_attention.
+    ring_hops`` — O(window) ICI traffic per device), ulysses passes it
+    to the per-device full-sequence attention.
 
     ``impl='ring'`` runs sequence-parallel ring attention over the ambient
     mesh's ``seq`` axis (set with ``parallel.use_mesh``); the mesh is a
@@ -91,12 +93,6 @@ def dot_product_attention(
             f"window={window} requires causal=True and window >= 1"
         )
     if impl in ("ring", "ulysses"):
-        if window is not None:
-            raise ValueError(
-                f"impl={impl!r} does not support sliding-window "
-                "attention yet; use impl='auto' (flash/xla), or shard "
-                "long windowed sequences with FSDP/TP instead of SP"
-            )
         from tensorflowonspark_tpu.parallel import current_mesh
 
         mesh = current_mesh()
@@ -109,20 +105,22 @@ def dot_product_attention(
         if mesh.shape.get("seq", 1) == 1 and mesh.shape.get("model", 1) == 1:
             return _jitted_attention(
                 q, k, v, causal=causal, scale=scale,
-                segment_ids=segment_ids, impl="auto",
+                segment_ids=segment_ids, impl="auto", window=window,
             )
         if impl == "ring":
             from tensorflowonspark_tpu.parallel import mesh_ring_attention
 
+            # window ALSO shortens the ring: see ring_hops — a device
+            # stops rotating once no reachable owner can contribute
             return mesh_ring_attention(
                 q, k, v, mesh, causal=causal, scale=scale,
-                segment_ids=segment_ids,
+                segment_ids=segment_ids, window=window,
             )
         from tensorflowonspark_tpu.parallel import mesh_ulysses_attention
 
         return mesh_ulysses_attention(
             q, k, v, mesh, causal=causal, scale=scale,
-            segment_ids=segment_ids,
+            segment_ids=segment_ids, window=window,
         )
     return _jitted_attention(
         q, k, v, causal=causal, scale=scale,

@@ -38,6 +38,7 @@ def _ulysses_local(
     causal: bool,
     scale: float | None,
     impl: str,
+    window: int | None = None,
 ):
     """Per-device body; call under ``shard_map``.
 
@@ -66,7 +67,7 @@ def _ulysses_local(
         )
     out = dot_product_attention(
         qh, kh, vh, causal=causal, scale=scale, impl=impl,
-        segment_ids=seg_full,
+        segment_ids=seg_full, window=window,
     )
     # head-sharded -> seq-sharded: the inverse resharding.
     return lax.all_to_all(
@@ -85,6 +86,7 @@ def mesh_ulysses_attention(
     seq_axis: str = "seq",
     impl: str = "auto",
     segment_ids: jax.Array | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Global-view Ulysses attention: shard_map over the mesh ``seq`` axis.
 
@@ -114,6 +116,7 @@ def mesh_ulysses_attention(
         causal=causal,
         scale=scale,
         impl=impl,
+        window=window,
     )
     in_specs, args = sp_specs_and_args(spec, q, k, v, segment_ids)
     fn = jax.shard_map(

@@ -54,6 +54,58 @@ class TestRingAttention:
         for a, b in zip(g_ring, g_ref):
             np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
 
+    @pytest.mark.parametrize(
+        ("window", "expect_hops"),
+        # s_loc=16, n=4: windows chosen to run 0-, 1-, 2-, and 3-hop
+        # rings (2-hop starts at window=18: queries reach 17 back)
+        [(1, 0), (5, 1), (16, 1), (18, 2), (24, 2), (40, 3)],
+    )
+    def test_sliding_window_matches_xla(self, mesh_seq, window, expect_hops):
+        """Windowed ring: masking must match the single-device window
+        AND the ring must stop early — every hop-count regime from
+        diagonal-only through full rotation is exercised."""
+        from tensorflowonspark_tpu.parallel import mesh_ring_attention
+        from tensorflowonspark_tpu.parallel.ring_attention import ring_hops
+
+        q, k, v = self._rand()
+        ref = dot_product_attention(
+            q, k, v, causal=True, impl="xla", window=window
+        )
+        out = mesh_ring_attention(q, k, v, mesh_seq, window=window)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+        # concrete hop counts, not a restatement of the formula
+        assert ring_hops(window, 16, 4) == expect_hops
+
+    def test_sliding_window_grads_match_xla(self, mesh_seq):
+        from tensorflowonspark_tpu.parallel import mesh_ring_attention
+
+        q, k, v = self._rand()
+
+        def loss_ring(q, k, v):
+            return jnp.sum(
+                mesh_ring_attention(q, k, v, mesh_seq, window=12) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                dot_product_attention(
+                    q, k, v, causal=True, impl="xla", window=12
+                )
+                ** 2
+            )
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
+
+    def test_window_requires_causal(self, mesh_seq):
+        from tensorflowonspark_tpu.parallel import mesh_ring_attention
+
+        q, k, v = self._rand()
+        with pytest.raises(ValueError, match="causal"):
+            mesh_ring_attention(q, k, v, mesh_seq, causal=False, window=8)
+
     @pytest.mark.parametrize("causal", [True, False])
     def test_segment_ids_match_xla(self, mesh_seq, causal):
         """Packed sequences under sequence parallelism: the K-side ids
@@ -316,6 +368,16 @@ class TestUlyssesAttention:
         q, k, v = self._rand()
         ref = dot_product_attention(q, k, v, causal=causal, impl="xla")
         out = mesh_ulysses_attention(q, k, v, mesh_u, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_sliding_window_matches_xla(self, mesh_u):
+        from tensorflowonspark_tpu.parallel import mesh_ulysses_attention
+
+        q, k, v = self._rand()
+        ref = dot_product_attention(
+            q, k, v, causal=True, impl="xla", window=10
+        )
+        out = mesh_ulysses_attention(q, k, v, mesh_u, window=10)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
     @pytest.mark.parametrize("causal", [True, False])
