@@ -298,6 +298,7 @@ def bench_llama1b(args):
         remat=getattr(args, "remat", "full") != "none",
         remat_policy=getattr(args, "remat", "full"),
         attention_impl=args.attention,
+        sliding_window=getattr(args, "window", None),
     )
     model = Llama(cfg)
     rng = np.random.default_rng(0)
@@ -682,6 +683,14 @@ def main(argv=None):
         action="store_true",
         help="llama1b_decode/llama1b_engine: int8 weight-only decode "
         "(ops/quant.py)",
+    )
+    p.add_argument(
+        "--window",
+        type=int,
+        default=None,
+        help="llama1b: sliding-window attention width (the flash "
+        "kernel's window-restricted grids make the step O(S*W) — A/B "
+        "against full attention at --seq 4096)",
     )
     p.add_argument(
         "--kv-quantize",

@@ -77,4 +77,9 @@ run python benchmarks/real_chip.py --config llama1b_decode --seq 2048 --new-toke
 run python benchmarks/real_chip.py --config llama1b_decode --seq 2048 --new-tokens 64 --kv-quantize
 run python benchmarks/real_chip.py --config llama1b_decode --seq 2048 --new-tokens 64 --kv-quantize --quantize
 
+# 9. NEW round 4: sliding-window training at long seq — the flash
+#    kernel's window-restricted grids should make the windowed step
+#    approach (W/S)x the full-attention attention cost
+run python benchmarks/real_chip.py --config llama1b --seq 4096 --moments bf16 --window 1024
+
 echo "round-4 measurements attempted; results in $OUT" >&2
