@@ -88,6 +88,13 @@ class LlamaConfig:
     # passes the window to each device's local attention) AND cached
     # decode (position-plane-masked reads of the full-length cache).
     sliding_window: int | None = None
+    # Rolling KV cache: cache only this many slots (>= sliding_window
+    # + write width - 1) instead of max_seq_len, with slot = position %
+    # kv_cache_len. Requires sliding_window (full attention needs every
+    # position). THE long-context serving lever for windowed models:
+    # Mistral-7B at 32k context holds a 4.3 GB/row dense cache vs ~0.5
+    # GB rolling at window 4096. None = dense (max_seq_len slots).
+    kv_cache_len: int | None = None
     # KV-cache storage: "model" (= dtype, exact) or "int8" (per-token
     # per-head max-abs quantization — halves the cache HBM footprint
     # AND the per-step cache read traffic that bounds long-context
@@ -325,37 +332,69 @@ class Attention(nn.Module):
         """
         cfg = self.cfg
         b, s = q.shape[:2]
+        C = cfg.kv_cache_len or cfg.max_seq_len
+        rolling = C < cfg.max_seq_len
+        if rolling:
+            if cfg.sliding_window is None:
+                raise ValueError(
+                    f"kv_cache_len={C} < max_seq_len needs sliding_window "
+                    "(full attention reads every position)"
+                )
+            if segment_ids is not None:
+                # Packed rows restart positions per document, so
+                # position % C COLLIDES across documents (doc2's slot 0
+                # overwrites doc1's) — silently wrong, so refuse.
+                raise ValueError(
+                    "segment_ids (packed rows) are unsupported with a "
+                    "rolling kv_cache_len: per-document positions "
+                    "collide under slot = position % C"
+                )
+            if C < cfg.sliding_window + s - 1:
+                # a write of s positions may not wrap onto slots that
+                # queries in the SAME call still attend
+                raise ValueError(
+                    f"kv_cache_len={C} must be >= sliding_window "
+                    f"({cfg.sliding_window}) + write width ({s}) - 1; "
+                    "prefill in smaller chunks (the engine's "
+                    "prefill_chunk) or grow the cache"
+                )
         int8_kv = cfg.kv_cache_dtype == "int8"
         kv_store = jnp.int8 if int8_kv else cfg.dtype
         ck = self.variable(
             "cache", "k", jnp.zeros,
-            (b, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim), kv_store,
+            (b, C, cfg.num_kv_heads, cfg.head_dim), kv_store,
         )
         cv = self.variable(
             "cache", "v", jnp.zeros,
-            (b, cfg.max_seq_len, cfg.num_kv_heads, cfg.head_dim), kv_store,
+            (b, C, cfg.num_kv_heads, cfg.head_dim), kv_store,
         )
         if int8_kv:
             # Per-token per-head max-abs scales. fp32: 4 bytes per
             # head-token next to head_dim int8 bytes (~3% at d=128).
             cks = self.variable(
                 "cache", "k_scale", jnp.zeros,
-                (b, cfg.max_seq_len, cfg.num_kv_heads), jnp.float32,
+                (b, C, cfg.num_kv_heads), jnp.float32,
             )
             cvs = self.variable(
                 "cache", "v_scale", jnp.zeros,
-                (b, cfg.max_seq_len, cfg.num_kv_heads), jnp.float32,
+                (b, C, cfg.num_kv_heads), jnp.float32,
             )
         cs = self.variable(
-            "cache", "seg", jnp.zeros, (b, cfg.max_seq_len), jnp.int32
+            "cache", "seg", jnp.zeros, (b, C), jnp.int32
         )
         if cfg.sliding_window is not None:
             # Each slot's RoPE position: the window masks by POSITION
             # distance, not slot distance — for packed rows continuing
             # an earlier document, the two diverge (other documents'
-            # tokens occupy the slots between).
+            # tokens occupy the slots between). Rolling caches init to
+            # -1: slot 0's "position 0" would otherwise be
+            # indistinguishable from never-written for early queries.
+            # NOTE for cache consumers that build fresh rows outside
+            # flax (the serving engine): this is the ONE cache leaf
+            # whose init is non-zero under rolling — see init_cache().
             cp = self.variable(
-                "cache", "pos", jnp.zeros, (b, cfg.max_seq_len), jnp.int32
+                "cache", "pos",
+                lambda: jnp.full((b, C), -1 if rolling else 0, jnp.int32),
             )
         ci = self.variable(
             "cache", "idx", lambda: jnp.zeros((), jnp.int32)
@@ -383,7 +422,22 @@ class Attention(nn.Module):
 
         k_new, ks_new = store(k)
         v_new, vs_new = store(v)
-        if padded:
+        if rolling:
+            # slot = position % C for BOTH padded and uniform rows: the
+            # mask below is purely positional (via the pos plane), so
+            # the write-index bookkeeping of the dense branches is
+            # unnecessary here
+            rows = jnp.arange(b)[:, None]
+            slots = positions % C
+            ck.value = ck.value.at[rows, slots].set(k_new)
+            cv.value = cv.value.at[rows, slots].set(v_new)
+            if int8_kv:
+                cks.value = cks.value.at[rows, slots].set(ks_new)
+                cvs.value = cvs.value.at[rows, slots].set(vs_new)
+            cs.value = cs.value.at[rows, slots].set(seg)
+            cp.value = cp.value.at[rows, slots].set(positions)
+            slot_q = None  # unused: rolling masks by position only
+        elif padded:
             rows = jnp.arange(b)[:, None]
             ck.value = ck.value.at[rows, positions].set(k_new)
             cv.value = cv.value.at[rows, positions].set(v_new)
@@ -443,21 +497,42 @@ class Attention(nn.Module):
         if int8_kv:
             # (b, S, h) -> (b, h, 1, 1, S) against logits (b, h, r, q, S)
             logits = logits * cks.value.transpose(0, 2, 1)[:, :, None, None, :]
-        key_pos = jnp.arange(cfg.max_seq_len)
-        mask = (
-            key_pos[None, None, None, None, :]
-            <= slot_q[:, None, None, :, None]
-        )
-        mask = mask & (
-            cs.value[:, None, None, None, :] == seg[:, None, None, :, None]
-        )
-        if cfg.sliding_window is not None:
-            # sliding window by RoPE-position distance (slots already
-            # bounded above by slot_q): attend only the last W positions
-            mask = mask & (
-                cp.value[:, None, None, None, :]
-                > positions[:, None, None, :, None] - cfg.sliding_window
+        if rolling:
+            # Purely positional masking: a slot is attended iff its
+            # recorded position is real (>= 0; stale slots were
+            # overwritten, and their OLD positions are <= q - C <= q - W
+            # so the window term also kills any that survived), causal
+            # (<= q), and within the window (> q - W).
+            kplane = cp.value[:, None, None, None, :]
+            qcol = positions[:, None, None, :, None]
+            mask = (
+                (kplane >= 0)
+                & (kplane <= qcol)
+                & (kplane > qcol - cfg.sliding_window)
             )
+            mask = mask & (
+                cs.value[:, None, None, None, :]
+                == seg[:, None, None, :, None]
+            )
+        else:
+            key_pos = jnp.arange(C)
+            mask = (
+                key_pos[None, None, None, None, :]
+                <= slot_q[:, None, None, :, None]
+            )
+            mask = mask & (
+                cs.value[:, None, None, None, :]
+                == seg[:, None, None, :, None]
+            )
+            if cfg.sliding_window is not None:
+                # sliding window by RoPE-position distance (slots
+                # already bounded above by slot_q): attend only the
+                # last W positions
+                mask = mask & (
+                    cp.value[:, None, None, None, :]
+                    > positions[:, None, None, :, None]
+                    - cfg.sliding_window
+                )
         logits = jnp.where(mask, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
         if int8_kv:
@@ -696,6 +771,26 @@ def llama_param_shardings(params, mesh: Mesh):
         return NamedSharding(mesh, P(*pair))
 
     return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def init_cache(shapes):
+    """Fresh cache values for a tree of ShapeDtypeStructs (the serving
+    engine builds per-row caches from ``jax.eval_shape`` rather than a
+    real ``model.init`` — an init-valued apply would also WRITE its
+    dummy token into the cache). This is the single source of truth for
+    cache-leaf init values outside flax: everything zero-fills EXCEPT
+    the position plane, which is -1 ("never written") so a rolling
+    cache cannot mistake a stale slot for a valid position 0. Keep in
+    lockstep with the ``self.variable`` inits in ``_cached_attention``.
+    """
+
+    def init(path, s):
+        name = str(getattr(path[-1], "key", path[-1]))
+        if name == "pos":
+            return jnp.full(s.shape, -1, s.dtype)
+        return jnp.zeros(s.shape, s.dtype)
+
+    return jax.tree_util.tree_map_with_path(init, shapes)
 
 
 def decode_cache_spec(x: jax.Array) -> P:

@@ -867,10 +867,11 @@ class ContinuousBatcher:
         return shapes["cache"]
 
     def _single_row_cache(self):
-        return jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype),
-            self._single_row_cache_shapes,
-        )
+        from tensorflowonspark_tpu.models.llama import init_cache
+
+        # the model owns its cache-leaf init values (rolling caches
+        # init the position plane to -1, not 0)
+        return init_cache(self._single_row_cache_shapes)
 
     def _start_job(self, p: _Pending, row: int) -> _PrefillJob:
         temp = (
@@ -1013,9 +1014,9 @@ class ContinuousBatcher:
             jax.ShapeDtypeStruct((b, 1), jnp.int32),
             jax.ShapeDtypeStruct((b, 1), jnp.int32),
         )
-        cache = jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"]
-        )
+        from tensorflowonspark_tpu.models.llama import init_cache
+
+        cache = init_cache(shapes["cache"])
         tok = jnp.zeros((b,), jnp.int32)
         # Parked rows decode at position 0 against their own slot only;
         # their K/V writes stay inside their row and are overwritten on

@@ -1021,3 +1021,125 @@ def test_sliding_window_packed_prefill_matches_per_document(
             np.asarray(alone[0]),
             rtol=1e-5, atol=1e-6,
         )
+
+
+def test_rolling_kv_cache_matches_dense_windowed():
+    """kv_cache_len < max_seq_len: slots wrap (slot = pos % C) and the
+    positional mask reproduces dense windowed attention exactly, long
+    past the wrap point; the cache really is C slots, not max_seq_len."""
+    import dataclasses
+
+    cfg = LlamaConfig.tiny(
+        dtype=jnp.float32, remat=False, sliding_window=5, kv_cache_len=8
+    )
+    model = Llama(cfg)
+    dense = Llama(dataclasses.replace(cfg, kv_cache_len=None))
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    toks = jax.random.randint(
+        jax.random.PRNGKey(7), (2, 24), 0, cfg.vocab_size
+    )
+    want = np.asarray(dense.apply({"params": params}, toks))
+
+    # prefill in width-4 chunks (C - W + 1), then single-token steps —
+    # positions wrap the 8-slot cache three times over 24 tokens
+    got = []
+    cache = None
+    for start in range(0, 16, 4):
+        piece = toks[:, start : start + 4]
+        pos = (
+            jnp.arange(start, start + 4, dtype=jnp.int32)[None, :]
+            .repeat(2, axis=0)
+        )
+        variables = {"params": params}
+        if cache is not None:
+            variables["cache"] = cache
+        logits, state = model.apply(
+            variables, piece, positions=pos, decode=True, mutable=["cache"]
+        )
+        cache = state["cache"]
+        got.append(np.asarray(logits))
+    for i in range(16, 24):
+        logits, state = model.apply(
+            {"params": params, "cache": cache},
+            toks[:, i : i + 1],
+            positions=jnp.full((2, 1), i, jnp.int32),
+            decode=True,
+            mutable=["cache"],
+        )
+        cache = state["cache"]
+        got.append(np.asarray(logits))
+    np.testing.assert_allclose(
+        np.concatenate(got, axis=1), want, rtol=1e-5, atol=1e-6
+    )
+    for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        if leaf.ndim >= 2:
+            assert leaf.shape[1] == 8, (path, leaf.shape)  # C, not 128
+
+
+def test_rolling_kv_cache_engine_parity_and_int8():
+    """The serving composition: rolling cache + chunked prefill +
+    prefix cache + int8 KV in the continuous engine, token-identical
+    to generate() under the same config (short prompts keep generate's
+    whole-prompt prefill within the write-width bound)."""
+    import dataclasses
+
+    from tensorflowonspark_tpu.models.llama import generate
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+
+    cfg = LlamaConfig.tiny(
+        dtype=jnp.float32, remat=False, sliding_window=5, kv_cache_len=12,
+        kv_cache_dtype="int8",
+    )
+    model = Llama(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    eng = ContinuousBatcher(
+        model, params, slots=2, prompt_widths=(8,), prefill_chunk=4,
+        prefix_cache=4,
+    )
+    try:
+        for p in ([1, 2, 3], [7, 5, 2, 9], [1, 2, 3, 8]):
+            want = np.asarray(
+                generate(model, params, jnp.asarray([p], jnp.int32), 9)
+            )[0].tolist()
+            assert eng.submit(p, 9) == want, p
+    finally:
+        eng.close()
+
+
+def test_rolling_kv_cache_validation():
+    cfg = LlamaConfig.tiny(
+        dtype=jnp.float32, remat=False, kv_cache_len=16
+    )  # no sliding_window
+    model = Llama(cfg)
+    with pytest.raises(ValueError, match="sliding_window"):
+        model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32),
+            decode=True,
+        )
+    cfg2 = LlamaConfig.tiny(
+        dtype=jnp.float32, remat=False, sliding_window=8, kv_cache_len=10
+    )
+    model2 = Llama(cfg2)
+    with pytest.raises(ValueError, match="write width"):
+        # width-8 write into a 10-slot cache with window 8: 10 < 8+8-1
+        model2.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+            decode=True,
+        )
+
+
+def test_rolling_kv_cache_rejects_packed_rows():
+    cfg = LlamaConfig.tiny(
+        dtype=jnp.float32, remat=False, sliding_window=4, kv_cache_len=8
+    )
+    model = Llama(cfg)
+    seg = jnp.asarray([[1, 1, 2, 2]], jnp.int32)
+    with pytest.raises(ValueError, match="collide"):
+        model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32),
+            segment_ids=seg, decode=True,
+        )
