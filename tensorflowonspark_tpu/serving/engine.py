@@ -90,6 +90,10 @@ class _Pending:
     temperature: float | None = None  # None = the engine-wide default
     eos_id: int | None = None  # None = the engine-wide default
     adapter: int = 0  # MultiLoraTensor bank slot (0 = base model)
+    # set by the consumer side (stream close); the scheduler treats it
+    # as finished at the next step/admission — a plain bool is enough
+    # (single writer, benign race: at worst one extra token decodes)
+    cancelled: bool = False
     submitted_at: float = 0.0  # time.monotonic() at enqueue
     first_token_at: float | None = None  # set when token 0 emits
     result: list[int] | None = None
@@ -114,6 +118,37 @@ class _Pending:
         if self.sink is not None:
             self.sink.put(err)
         self.event.set()
+
+
+class _Stream:
+    """Iterator over a streaming request's tokens; ``close()`` (or GC)
+    before exhaustion CANCELS the request — the scheduler frees its
+    slot at the next step instead of running out the budget."""
+
+    def __init__(self, p: "_Pending", yield_logprobs: bool):
+        self._p = p
+        self._yield_logprobs = yield_logprobs
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._p.sink.get()
+        if item is True:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._done = True
+            raise item
+        token, lp = item
+        return (token, lp) if self._yield_logprobs else token
+
+    def close(self) -> None:
+        if not self._done:
+            self._p.cancelled = True
+
+    __del__ = close
 
 
 @dataclasses.dataclass
@@ -378,8 +413,13 @@ class ContinuousBatcher:
         self._accepted_total = 0
         self._failed_total = 0
         self.tokens_emitted = 0
+        self.cancelled = 0  # consumer-abandoned requests (stream close)
         self._ttft_sum = 0.0  # seconds, summed over completed requests
         self._duration_sum = 0.0
+        # Latency denominators track only requests that actually ran:
+        # unadmitted cancels complete (for drain accounting) with no
+        # tokens and ~zero duration, and would drag the averages down.
+        self._latency_n = 0
 
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="continuous-batcher"
@@ -574,15 +614,16 @@ class ContinuousBatcher:
         """Yield completion tokens AS THEY DECODE (one engine step of
         latency each) instead of blocking for the full result.
 
-        Validation and enqueue happen EAGERLY, at the call (a plain
-        wrapper around an inner generator) — callers like the HTTP
-        streaming path must see bad-prompt ValueErrors before they
-        commit a 200 status to the wire. The generator raises if the
-        request fails mid-decode; closing it early does not cancel the
-        slot (the row runs out its budget — token-level cancellation
-        would need a host→loop signal the scheduler checks per step,
-        not worth it at this granularity). ``yield_logprobs``: yield
-        ``(token, logprob)`` pairs instead of bare tokens."""
+        Validation and enqueue happen EAGERLY, at the call — callers
+        like the HTTP streaming path must see bad-prompt ValueErrors
+        before they commit a 200 status to the wire. The iterator
+        raises if the request fails mid-decode; closing it early (or
+        dropping it) CANCELS the request: a decoding row frees its slot
+        at the scheduler's next step and retires with its partial
+        output, a queued or mid-prefill request resolves empty without
+        ever taking a slot — an abandoned consumer never burns its
+        remaining budget. ``yield_logprobs``: yield ``(token,
+        logprob)`` pairs instead of bare tokens."""
         p = self._enqueue(
             tokens,
             max_new_tokens,
@@ -592,17 +633,12 @@ class ContinuousBatcher:
             adapter=adapter,
         )
 
-        def drain():
-            while True:
-                item = p.sink.get()
-                if item is True:
-                    return
-                if isinstance(item, BaseException):
-                    raise item
-                token, lp = item
-                yield (token, lp) if yield_logprobs else token
-
-        return drain()
+        # An explicit iterator, NOT a generator: close() on a
+        # never-started generator skips its finally block entirely, so
+        # a consumer that abandons the stream before the first next()
+        # would never cancel. This handle cancels from close()/GC
+        # regardless of iteration state.
+        return _Stream(p, yield_logprobs)
 
     def stats(self) -> dict:
         """Scheduler observability (served at the HTTP ``/stats``
@@ -612,14 +648,15 @@ class ContinuousBatcher:
         busy = sum(e is not None for e in self._live) + (
             self._job is not None
         )
-        done = self.completed
+        done = self._latency_n
         return {
             "slots": self._slots,
             "slots_busy": busy,
             "queue_depth": self._queue.qsize(),
             "steps": self.steps,
             "admitted": self.admitted,
-            "completed": done,
+            "completed": self.completed,
+            "cancelled": self.cancelled,
             "tokens_emitted": self.tokens_emitted,
             "prefill_in_progress": self._job is not None,
             # queue wait + prefill, averaged over completed requests
@@ -911,6 +948,10 @@ class ContinuousBatcher:
         Chunks cover only the true prompt length — the padding region a
         full-width prefill would burn compute on is never touched."""
         job = self._job
+        if job.p.cancelled:
+            self._resolve_unadmitted_cancel(job.p)
+            self._job = None
+            return cache, tok, pos, temps, ads
         c = self._prefill_chunk
         # Shift the window back rather than letting positions run past
         # max_seq_len: a final chunk starting at `start` would scatter
@@ -1072,6 +1113,8 @@ class ContinuousBatcher:
         return cache, tok, pos, temps, ads
 
     def _finished(self, p: _Pending, out: list[int], last: int) -> bool:
+        if p.cancelled:
+            return True  # consumer went away; free the slot now
         # Per-request eos: None = engine default; negative = DISABLED
         # (run the full budget even when the engine has a default eos —
         # None can't express that, it IS the use-the-default sentinel).
@@ -1088,15 +1131,30 @@ class ContinuousBatcher:
         self._live[row] = None
         now = time.monotonic()
         self.tokens_emitted += len(out)
+        if p.cancelled:
+            self.cancelled += 1
         if p.first_token_at is not None:
             self._ttft_sum += p.first_token_at - p.submitted_at
         self._duration_sum += now - p.submitted_at
+        self._latency_n += 1
         # Incremented LAST: stats() divides the sums by this count from
         # another thread, and a count that runs ahead of its sums would
         # fabricate zero/low latency averages.
         self.completed += 1
         p.result = out
         p.logprobs = lps
+        p.finish()
+        p.event.set()
+
+    def _resolve_unadmitted_cancel(self, p: _Pending) -> None:
+        """A request cancelled while still queued (or mid-prefill): no
+        slot to retire, no tokens; resolve as completed-empty so drain
+        accounting closes and nothing prefills for a dead consumer.
+        Excluded from the latency averages — it never ran."""
+        p.result = []
+        p.logprobs = []
+        self.cancelled += 1
+        self.completed += 1
         p.finish()
         p.event.set()
 
@@ -1164,6 +1222,9 @@ class ContinuousBatcher:
                         # a queued STOP is only reached after it ends
                         self._fail_all(RuntimeError("engine shutting down"))
                         return
+                    if item.cancelled:
+                        self._resolve_unadmitted_cancel(item)
+                        continue
                     self._inflight = item
                     if cache is None:
                         cache, tok, pos, temps, ads = self._empty_state()

@@ -354,6 +354,11 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             except OSError:
                 pass
+        finally:
+            # Deterministic cancel on client disconnect (the primary
+            # case this exists for) — don't lean on refcount GC of
+            # `gen` to free the slot for a dead consumer.
+            gen.close()
 
     def _engine_generate(
         self,

@@ -703,3 +703,59 @@ def test_engine_prefix_cache_long_prompt_cannot_flush_shared_prefix(tiny):
         assert eng.stats()["prefix_hits"] == hits0 + 1
     finally:
         eng.close()
+
+
+def test_engine_stream_close_cancels_decoding_row(tiny):
+    """Closing a stream mid-decode frees the slot at the next step
+    instead of running out the (huge) budget — and the partial request
+    still resolves cleanly for the drain accounting."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=1, prompt_widths=(8,))
+    try:
+        gen = eng.stream([1, 2, 3], 120)
+        got = [next(gen), next(gen)]
+        assert len(got) == 2
+        gen.close()
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            st = eng.stats()
+            if st["slots_busy"] == 0 and st["completed"] == 1:
+                break
+            time.sleep(0.05)
+        st = eng.stats()
+        assert st["slots_busy"] == 0 and st["completed"] == 1
+        assert st["cancelled"] == 1
+        assert st["tokens_emitted"] < 50  # nowhere near the 120 budget
+        # the engine is immediately reusable
+        assert eng.submit([4, 5], 3) == _reference(model, params, [4, 5], 3)
+    finally:
+        eng.close()
+
+
+def test_engine_stream_close_cancels_queued_request(tiny):
+    """A stream abandoned while still QUEUED resolves without ever
+    being admitted — no prefill for a dead consumer."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=1, prompt_widths=(8,))
+    try:
+        holder = threading.Thread(target=lambda: eng.submit([1, 2], 40))
+        holder.start()
+        deadline = time.time() + 60
+        while eng.stats()["slots_busy"] < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        gen = eng.stream([7, 8], 40)  # queued behind the holder
+        gen.close()
+        holder.join(timeout=120)
+        assert not holder.is_alive()
+        deadline = time.time() + 60
+        while eng.stats()["completed"] < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        st = eng.stats()
+        assert st["completed"] == 2  # holder + resolved-empty cancel
+        assert st["cancelled"] == 1
+        assert st["admitted"] == 1  # the cancelled one never prefilled
+        # the never-ran cancel must not dilute the latency averages:
+        # only the holder (40 tokens) is in the denominator
+        assert st["request_avg_ms"] > 50
+    finally:
+        eng.close()
