@@ -80,6 +80,9 @@ class LlamaConfig:
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
+    # Qwen2-family QKV bias: the q/k/v projections carry bias vectors
+    # (o_proj and the MLP stay bias-free, matching the architecture).
+    attention_bias: bool = False
     # Sliding-window (Mistral-style local) attention: each query
     # attends only the last `sliding_window` positions. None = full
     # causal attention. Applies to training/prefill (xla + flash — the
@@ -224,7 +227,8 @@ def rope(
 
 
 class QDense(nn.Module):
-    """Bias-free Dense that also accepts int8 ``QuantTensor`` kernels.
+    """Dense (bias-free by default) that also accepts int8
+    ``QuantTensor`` kernels.
 
     With a regular array kernel this is exactly ``nn.Dense(use_bias=
     False, dtype=...)``; with a quantized kernel (``ops/quant.py``,
@@ -233,10 +237,14 @@ class QDense(nn.Module):
     — weights stay int8 in HBM through the whole decode, which is the
     point (decode is weight-bandwidth-bound). A ``LoraTensor`` kernel
     (``ops/lora.py:add_lora``) runs base + low-rank adapter with the
-    base stop-gradiented — the parameter-efficient fine-tune path."""
+    base stop-gradiented — the parameter-efficient fine-tune path.
+    ``use_bias=True`` adds a bias vector AFTER whichever kernel path
+    ran (Qwen2-family QKV projections; the bias is tiny and composes
+    with quant/LoRA kernels untouched)."""
 
     features: int
     dtype: jnp.dtype
+    use_bias: bool = False
 
     @nn.compact
     def __call__(self, x, adapter_ids=None):
@@ -246,17 +254,24 @@ class QDense(nn.Module):
             (jnp.shape(x)[-1], self.features),
         )
         x = x.astype(self.dtype)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, (self.features,)
+            )
+            apply = lambda y: y + bias.astype(y.dtype)  # noqa: E731
+        else:
+            apply = lambda y: y  # noqa: E731
         if isinstance(kernel, QuantTensor):
-            return quantized_dot(x, kernel)
+            return apply(quantized_dot(x, kernel))
         if isinstance(kernel, LoraTensor):
-            return lora_apply(x, kernel)
+            return apply(lora_apply(x, kernel))
         if isinstance(kernel, MultiLoraTensor):
             # Per-row adapter routing (the multi-tenant serving path);
             # ids default to slot 0, the bank's zero adapter == base.
             if adapter_ids is None:
                 adapter_ids = jnp.zeros((jnp.shape(x)[0],), jnp.int32)
-            return multi_lora_apply(x, kernel, adapter_ids)
-        return x @ kernel.astype(self.dtype)
+            return apply(multi_lora_apply(x, kernel, adapter_ids))
+        return apply(x @ kernel.astype(self.dtype))
 
 
 class Attention(nn.Module):
@@ -268,12 +283,17 @@ class Attention(nn.Module):
         adapter_ids=None,
     ):
         cfg = self.cfg
-        dense = lambda feats, name: QDense(  # noqa: E731
-            feats, cfg.dtype, name=name
+        dense = lambda feats, name, b=False: QDense(  # noqa: E731
+            feats, cfg.dtype, use_bias=b, name=name
         )
-        q = dense(cfg.num_heads * cfg.head_dim, "q_proj")(x, adapter_ids)
-        k = dense(cfg.num_kv_heads * cfg.head_dim, "k_proj")(x, adapter_ids)
-        v = dense(cfg.num_kv_heads * cfg.head_dim, "v_proj")(x, adapter_ids)
+        ab = cfg.attention_bias
+        q = dense(cfg.num_heads * cfg.head_dim, "q_proj", ab)(x, adapter_ids)
+        k = dense(cfg.num_kv_heads * cfg.head_dim, "k_proj", ab)(
+            x, adapter_ids
+        )
+        v = dense(cfg.num_kv_heads * cfg.head_dim, "v_proj", ab)(
+            x, adapter_ids
+        )
         b, s, _ = x.shape
         q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)

@@ -81,10 +81,10 @@ def hf_config_to_llama(hf_cfg: dict):
 
     Features this framework's Llama doesn't implement are REJECTED, not
     silently dropped — a conversion that succeeds must be logit-exact:
-    - ``rope_scaling`` (Llama-3.1+ NTK/llama3 scaling) changes RoPE
-      frequencies;
-    - ``attention_bias``/``mlp_bias`` add bias vectors our bias-free
-      kernels have no slot for.
+    unknown ``rope_scaling`` kinds change RoPE frequencies, and
+    ``mlp_bias`` adds vectors the bias-free MLP has no slot for.
+    ``attention_bias`` (explicit, or implied by ``model_type: qwen2``)
+    maps to QKV bias vectors in :class:`QDense`.
     """
     from tensorflowonspark_tpu.models.llama import LlamaConfig, RopeScaling
 
@@ -113,13 +113,54 @@ def hf_config_to_llama(hf_cfg: dict):
                 "and linear are); converting anyway would silently "
                 "change the RoPE frequencies"
             )
-    for flag in ("attention_bias", "mlp_bias"):
-        if hf_cfg.get(flag):
-            raise ValueError(
-                f"{flag}=true checkpoints are not supported: the "
-                "native kernels are bias-free and dropping the biases "
-                "would silently change the logits"
+    if hf_cfg.get("mlp_bias"):
+        raise ValueError(
+            "mlp_bias=true checkpoints are not supported: the native "
+            "MLP kernels are bias-free and dropping the biases would "
+            "silently change the logits"
+        )
+    model_type = hf_cfg.get("model_type", "llama")
+    if hf_cfg.get("attention_bias") and model_type != "qwen2":
+        # HF Llama's attention_bias puts a bias on o_proj TOO (unlike
+        # Qwen2's QKV-only convention, which is what QDense models);
+        # converting would drop it and silently change the logits.
+        raise ValueError(
+            "attention_bias=true Llama-architecture checkpoints are "
+            "not supported (their o_proj bias has no slot here); only "
+            "Qwen2's QKV-only biases are"
+        )
+    # Qwen2 carries QKV biases unconditionally (its config has no
+    # usable attention_bias flag).
+    attention_bias = model_type == "qwen2"
+    # Qwen2 gates sliding_window behind use_sliding_window AND applies
+    # it per-layer: layers below max_window_layers run FULL attention
+    # (HF configuration_qwen2.py layer_types). A heterogeneous mix has
+    # no representation here — reject rather than silently diverge.
+    # CAUTION: config.json omits default-valued fields (to_diff_dict),
+    # so the fallbacks must match HF's QWEN2 defaults
+    # (use_sliding_window=False, max_window_layers=28) — a generic
+    # truthy/zero fallback would window models HF runs full, or
+    # globalize a per-layer mix it should reject.
+    use_sw = bool(
+        hf_cfg.get(
+            "use_sliding_window", model_type != "qwen2"
+        )
+    )
+    if use_sw and hf_cfg.get("sliding_window") is not None:
+        n_layers = int(hf_cfg["num_hidden_layers"])
+        mwl = int(
+            hf_cfg.get(
+                "max_window_layers", 28 if model_type == "qwen2" else 0
             )
+        )
+        if 0 < mwl < n_layers:
+            raise ValueError(
+                f"per-layer sliding window (max_window_layers={mwl} of "
+                f"{n_layers}) is not supported; converting with a "
+                "global window would silently change the logits"
+            )
+        if mwl >= n_layers:
+            use_sw = False  # every layer is below the threshold: full
     return LlamaConfig(
         vocab_size=int(hf_cfg["vocab_size"]),
         hidden_size=int(hf_cfg["hidden_size"]),
@@ -133,11 +174,15 @@ def hf_config_to_llama(hf_cfg: dict):
         rope_theta=float(hf_cfg.get("rope_theta", 10000.0)),
         rope_scaling=scaling,
         rms_norm_eps=float(hf_cfg.get("rms_norm_eps", 1e-5)),
+        attention_bias=attention_bias,
         # Mistral-family checkpoints: same tensor layout as Llama plus
-        # sliding-window local attention (null in v0.2+ configs)
+        # sliding-window local attention (null in v0.2+ configs).
+        # Qwen2 GATES its sliding_window field behind use_sliding_window
+        # (default False — the field is 4096 but INERT); honoring the
+        # raw field would silently window long contexts.
         sliding_window=(
             int(hf_cfg["sliding_window"])
-            if hf_cfg.get("sliding_window") is not None
+            if hf_cfg.get("sliding_window") is not None and use_sw
             else None
         ),
     )
@@ -196,7 +241,22 @@ def hf_state_to_params(state: dict, cfg, dtype="float32") -> dict:
                 )
             },
             "attn": {
-                ours: {"kernel": cast(take(f"{hf}.self_attn.{theirs}.weight").T)}
+                ours: {
+                    "kernel": cast(
+                        take(f"{hf}.self_attn.{theirs}.weight").T
+                    ),
+                    # Qwen2-family QKV bias (1-D, no transpose);
+                    # o_proj never carries one
+                    **(
+                        {
+                            "bias": cast(
+                                take(f"{hf}.self_attn.{theirs}.bias")
+                            )
+                        }
+                        if cfg.attention_bias and theirs != "o_proj"
+                        else {}
+                    ),
+                }
                 for theirs, ours in _PROJ.items()
             },
             "mlp": {
@@ -226,13 +286,13 @@ def convert(hf_dir: str, output: str, dtype: str = "float32"):
     with open(os.path.join(hf_dir, "config.json")) as f:
         hf_cfg = json.load(f)
     model_type = hf_cfg.get("model_type", "llama")
-    if model_type not in ("llama", "mistral"):
-        # mistral shares the llama tensor layout exactly; its one
-        # architectural addition (sliding-window attention) maps to
-        # LlamaConfig.sliding_window
+    if model_type not in ("llama", "mistral", "qwen2"):
+        # mistral shares the llama tensor layout exactly (sliding
+        # window -> LlamaConfig.sliding_window); qwen2 adds QKV bias
+        # vectors (-> attention_bias)
         raise ValueError(
             f"model_type {model_type!r} is not supported; this importer "
-            "covers the Llama family (llama, mistral)"
+            "covers the Llama family (llama, mistral, qwen2)"
         )
     cfg = hf_config_to_llama(hf_cfg)
     state = load_hf_state_dict(hf_dir)
@@ -257,6 +317,18 @@ def config_overrides_json(cfg) -> str:
             **(
                 {"rope_scaling": dataclasses.asdict(cfg.rope_scaling)}
                 if cfg.rope_scaling is not None
+                else {}
+            ),
+            # non-default architecture flags MUST ride along: a decode
+            # tool fed these overrides without them would build a model
+            # whose param tree (no bias slots) or masking (no window)
+            # doesn't match the converted checkpoint
+            **(
+                {"attention_bias": True} if cfg.attention_bias else {}
+            ),
+            **(
+                {"sliding_window": cfg.sliding_window}
+                if cfg.sliding_window is not None
                 else {}
             ),
         }

@@ -221,6 +221,38 @@ def test_hf_import_tied_embeddings(tmp_path):
     np.testing.assert_allclose(our_logits, hf_logits, rtol=2e-4, atol=2e-4)
 
 
+def _assert_cached_decode_matches_forward(cfg, params, tokens):
+    """Teacher-forced prefill + per-token cached decode must reproduce
+    the plain forward logits under the imported config."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.llama import Llama
+
+    m = dataclasses.replace(cfg, dtype=jnp.float32, remat=False)
+    toks = jnp.asarray(tokens[:, :10])
+    fwd = np.asarray(Llama(m).apply({"params": params}, toks))
+    logits_p, state = Llama(m).apply(
+        {"params": params}, toks[:, :6], decode=True, mutable=["cache"]
+    )
+    got = [np.asarray(logits_p)]
+    cache = state["cache"]
+    for i in range(6, 10):
+        step_logits, state = Llama(m).apply(
+            {"params": params, "cache": cache},
+            toks[:, i : i + 1],
+            positions=jnp.full((1, 1), i, jnp.int32),
+            decode=True,
+            mutable=["cache"],
+        )
+        cache = state["cache"]
+        got.append(np.asarray(step_logits))
+    np.testing.assert_allclose(
+        np.concatenate(got, axis=1), fwd, rtol=1e-5, atol=1e-5
+    )
+
+
 def test_hf_import_mistral_sliding_window(tmp_path):
     """Mistral-family checkpoints (Llama layout + sliding-window local
     attention) convert logit-exactly: the window must actually bite
@@ -263,3 +295,105 @@ def test_hf_import_mistral_sliding_window(tmp_path):
         ours.apply({"params": params}, jnp.asarray(tokens))
     )
     np.testing.assert_allclose(our_logits, hf_logits, rtol=2e-4, atol=2e-4)
+    # the cached-decode path applies the same WINDOW as the forward
+    # (mistral has no biases; this exercises the windowed KV cache)
+    _assert_cached_decode_matches_forward(cfg, params, tokens)
+
+
+def test_hf_import_qwen2_attention_bias(tmp_path):
+    """Qwen2-family checkpoints (Llama layout + QKV bias vectors, GQA,
+    tied embeddings in the small ones) convert logit-exactly."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.llama import Llama
+    from tensorflowonspark_tpu.tools.import_hf_llama import convert
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=96,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        tie_word_embeddings=True,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(11)
+    model = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    # the zero-init biases would make the bias path vacuous — randomize
+    with torch.no_grad():
+        for layer in model.model.layers:
+            for proj in ("q_proj", "k_proj", "v_proj"):
+                getattr(layer.self_attn, proj).bias.normal_(std=0.3)
+    d = str(tmp_path / "qwen2")
+    model.save_pretrained(d)
+    cfg, params = convert(d, str(tmp_path / "conv"))
+    assert cfg.attention_bias
+    # Qwen2Config ships sliding_window=4096 gated OFF by
+    # use_sliding_window=False — honoring the raw field would silently
+    # window long contexts
+    assert cfg.sliding_window is None
+    assert "bias" in params["layer0"]["attn"]["q_proj"]
+    assert "bias" not in params["layer0"]["attn"]["o_proj"]
+
+    tokens = np.arange(40, dtype=np.int32)[None, :] % 96
+    with torch.no_grad():
+        hf_logits = (
+            model(torch.tensor(tokens, dtype=torch.long))
+            .logits.float()
+            .numpy()
+        )
+    ours = Llama(dataclasses.replace(cfg, dtype=jnp.float32, remat=False))
+    our_logits = np.asarray(
+        ours.apply({"params": params}, jnp.asarray(tokens))
+    )
+    np.testing.assert_allclose(our_logits, hf_logits, rtol=2e-4, atol=2e-4)
+    # the cached-decode path carries the QKV biases too
+    _assert_cached_decode_matches_forward(cfg, params, tokens)
+
+
+def test_hf_import_qwen2_sliding_window_gating(tmp_path):
+    """Raw qwen2 config.json omits default-valued fields, so the
+    importer must fall back to HF's QWEN2 defaults: an absent
+    use_sliding_window means FALSE (no window), and an enabled window
+    with the default max_window_layers=28 < num_layers is a
+    heterogeneous per-layer mix that must be rejected."""
+    from tensorflowonspark_tpu.tools.import_hf_llama import (
+        hf_config_to_llama,
+    )
+
+    base = dict(
+        model_type="qwen2",
+        vocab_size=96,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=32,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        sliding_window=4096,  # present but INERT by default
+    )
+    assert hf_config_to_llama(dict(base)).sliding_window is None
+    # enabled + max_window_layers omitted -> HF default 28 of 32: mixed
+    with pytest.raises(ValueError, match="max_window_layers"):
+        hf_config_to_llama(dict(base, use_sliding_window=True))
+    # enabled + homogeneous (every layer windowed)
+    cfg = hf_config_to_llama(
+        dict(base, use_sliding_window=True, max_window_layers=0)
+    )
+    assert cfg.sliding_window == 4096
+    # enabled but threshold above the layer count: every layer FULL
+    cfg = hf_config_to_llama(
+        dict(base, use_sliding_window=True, max_window_layers=32)
+    )
+    assert cfg.sliding_window is None
+    # mistral default stays always-on
+    m = dict(base, model_type="mistral")
+    assert hf_config_to_llama(m).sliding_window == 4096
+    # explicit llama attention_bias is rejected (o_proj bias has no slot)
+    with pytest.raises(ValueError, match="o_proj"):
+        hf_config_to_llama(
+            dict(base, model_type="llama", attention_bias=True)
+        )
