@@ -153,6 +153,26 @@ class LlamaConfig:
         return LlamaConfig(**base)
 
     @staticmethod
+    def qwen2_7b(**overrides) -> "LlamaConfig":
+        """Qwen2-7B: Llama layout + QKV bias + GQA, 1M rope theta
+        (import real weights with ``tools/import_hf_llama`` — the
+        converter accepts ``model_type: qwen2``)."""
+        base = dict(
+            vocab_size=152064,
+            hidden_size=3584,
+            intermediate_size=18944,
+            num_layers=28,
+            num_heads=28,
+            num_kv_heads=4,
+            max_seq_len=32768,
+            rope_theta=1_000_000.0,
+            rms_norm_eps=1e-6,
+            attention_bias=True,
+        )
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+    @staticmethod
     def tiny(**overrides) -> "LlamaConfig":
         """Test-size config (also used by __graft_entry__ dry runs)."""
         base = dict(

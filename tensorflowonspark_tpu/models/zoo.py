@@ -202,12 +202,15 @@ def _build_llama(variant, tiny):
 
     if tiny:
         cfg = L.LlamaConfig.tiny(
-            sliding_window=8 if variant == "mistral_7b" else None
+            sliding_window=8 if variant == "mistral_7b" else None,
+            attention_bias=variant == "qwen2_7b",
         )
     elif variant == "llama2_7b":
         cfg = L.LlamaConfig.llama2_7b()
     elif variant == "mistral_7b":
         cfg = L.LlamaConfig.mistral_7b()
+    elif variant == "qwen2_7b":
+        cfg = L.LlamaConfig.qwen2_7b()
     else:  # llama_1b (the BASELINE.md benchmark config)
         cfg = L.LlamaConfig.llama_1b()
     model = L.Llama(cfg)
@@ -249,6 +252,7 @@ _BUILDERS: dict[str, Callable[..., ZooEntry]] = {
     "llama_1b": lambda tiny, nc: _build_llama("llama_1b", tiny),
     "llama2_7b": lambda tiny, nc: _build_llama("llama2_7b", tiny),
     "mistral_7b": lambda tiny, nc: _build_llama("mistral_7b", tiny),
+    "qwen2_7b": lambda tiny, nc: _build_llama("qwen2_7b", tiny),
 }
 
 
