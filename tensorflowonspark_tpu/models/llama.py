@@ -153,6 +153,31 @@ class LlamaConfig:
         return LlamaConfig(**base)
 
     @staticmethod
+    def llama3_8b(**overrides) -> "LlamaConfig":
+        """Llama-3.1-8B: GQA 32/8, 128k vocab, llama3 RoPE scaling
+        (the importer maps HF rope_scaling type 'llama3' to the same
+        :class:`RopeScaling`)."""
+        base = dict(
+            vocab_size=128256,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            max_seq_len=131072,
+            rope_theta=500000.0,
+            rope_scaling=RopeScaling(
+                kind="llama3",
+                factor=8.0,
+                low_freq_factor=1.0,
+                high_freq_factor=4.0,
+                original_max_seq_len=8192,
+            ),
+        )
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+    @staticmethod
     def qwen2_7b(**overrides) -> "LlamaConfig":
         """Qwen2-7B: Llama layout + QKV bias + GQA, 1M rope theta
         (import real weights with ``tools/import_hf_llama`` — the

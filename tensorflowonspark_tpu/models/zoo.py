@@ -207,6 +207,8 @@ def _build_llama(variant, tiny):
         )
     elif variant == "llama2_7b":
         cfg = L.LlamaConfig.llama2_7b()
+    elif variant == "llama3_8b":
+        cfg = L.LlamaConfig.llama3_8b()
     elif variant == "mistral_7b":
         cfg = L.LlamaConfig.mistral_7b()
     elif variant == "qwen2_7b":
@@ -251,6 +253,7 @@ _BUILDERS: dict[str, Callable[..., ZooEntry]] = {
     "bert_base": lambda tiny, nc: _build_bert(tiny),
     "llama_1b": lambda tiny, nc: _build_llama("llama_1b", tiny),
     "llama2_7b": lambda tiny, nc: _build_llama("llama2_7b", tiny),
+    "llama3_8b": lambda tiny, nc: _build_llama("llama3_8b", tiny),
     "mistral_7b": lambda tiny, nc: _build_llama("mistral_7b", tiny),
     "qwen2_7b": lambda tiny, nc: _build_llama("qwen2_7b", tiny),
 }
