@@ -640,6 +640,55 @@ class ContinuousBatcher:
         # regardless of iteration state.
         return _Stream(p, yield_logprobs)
 
+    def warmup(self) -> None:
+        """Pre-compile every program a request could hit (the decode
+        step, the admit scatter, each prompt-width prefill or the
+        chunk/sample pair) by running one thrown-away token through
+        each width bucket. Without this the FIRST real request pays
+        every XLA compile in its TTFT — seconds to minutes on TPU —
+        which is exactly when a load balancer health-checks a fresh
+        replica. Call after construction, before serving traffic
+        (``--gen-warmup``). Thread-safe via the ordinary submit path;
+        the throwaway requests are excluded from the latency averages
+        only insofar as they are real requests — warm up BEFORE
+        exposing /stats to dashboards if that matters."""
+        # Budget 2 with eos DISABLED on (at least) one request: a
+        # 1-token budget retires at admission and the decode _step_fn —
+        # the program every subsequent token runs — would never
+        # compile; and without eos_id=-1 a sampled first token equal to
+        # the engine's default eos could nondeterministically retire
+        # the row before a step runs.
+        max_seq = self._model.cfg.max_seq_len
+        if self._prefill_chunk is not None:
+            # chunk + sample1 + admit + step compile on any prompt;
+            # cover a multi-chunk prompt so the window-shift math runs
+            n = max(1, min(self._prefill_chunk + 1, max_seq - 2))
+            self.submit([0] * n, 2, eos_id=-1)
+        else:
+            step_warmed = False
+            prev = 0
+            for w in self._widths:
+                # the longest VALID prompt that still maps to this
+                # bucket compiles its prefill (a width at max_seq_len
+                # can only be reached by shorter prompts — budget >= 1)
+                n = min(w, max_seq - 1)
+                if n <= prev:
+                    continue  # no valid request can reach this bucket
+                if not step_warmed and n + 2 <= max_seq:
+                    self.submit([0] * n, 2, eos_id=-1)
+                    step_warmed = True
+                else:
+                    self.submit([0] * n, 1)
+                prev = w
+            if not step_warmed:
+                self.submit([0], 2, eos_id=-1)
+        if self._prefix_store is not None:
+            # drop the throwaway prompts' entries — each would pin a
+            # full single-row KV cache of HBM until evicted. Safe here:
+            # submit() returned, so the scheduler is blocked on the
+            # queue and not touching the store.
+            self._prefix_store.clear()
+
     def stats(self) -> dict:
         """Scheduler observability (served at the HTTP ``/stats``
         endpoint): slot occupancy, queue depth, lifetime counters."""

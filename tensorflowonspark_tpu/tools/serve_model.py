@@ -624,6 +624,13 @@ def _build_engine(gen: dict):
         prefill_chunk=gen.get("prefill_chunk"),
         prefix_cache=gen.get("prefix_cache"),
     )
+    if gen.get("warmup"):
+        t0 = time.monotonic()
+        engine.warmup()
+        logger.info(
+            "engine warmup compiled all programs in %.1fs",
+            time.monotonic() - t0,
+        )
     return engine, max_new, model, engine._params
 
 
@@ -952,6 +959,13 @@ def main(argv: list[str] | None = None) -> int:
         "default 1.0 matches add_lora's default alpha=rank)",
     )
     p.add_argument(
+        "--gen-warmup",
+        action="store_true",
+        help="continuous engine: pre-compile every decode/prefill "
+        "program at startup so the first real request's TTFT doesn't "
+        "pay the XLA compiles",
+    )
+    p.add_argument(
         "--gen-prefix-cache",
         type=int,
         default=None,
@@ -1002,6 +1016,7 @@ def main(argv: list[str] | None = None) -> int:
             max_queue=args.gen_max_queue,
             prefill_chunk=args.gen_prefill_chunk,
             prefix_cache=args.gen_prefix_cache,
+            warmup=args.gen_warmup,
             lora_scale=args.gen_lora_scale,
             drain_on_shutdown=args.gen_drain_on_shutdown,
         )

@@ -759,3 +759,37 @@ def test_engine_stream_close_cancels_queued_request(tiny):
         assert st["request_avg_ms"] > 50
     finally:
         eng.close()
+
+
+def test_engine_warmup_compiles_all_buckets(tiny):
+    """warmup(): every width bucket's prefill AND the decode step are
+    compiled before the first real request (chunked mode: the
+    chunk/sample pair) — so real traffic never pays a compile. A
+    width-at-max_seq_len bucket must not crash the warmup."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=2, prompt_widths=(4, 8))
+    try:
+        eng.warmup()
+        assert set(eng._prefill_cache) == {4, 8}
+        # the DECODE step compiled too (a budget-1-only warmup retires
+        # at admission and never runs it)
+        assert eng.steps > 0
+        t0 = time.monotonic()
+        out = eng.submit([1, 2, 3], 3)
+        dt = time.monotonic() - t0
+        assert out == _reference(model, params, [1, 2, 3], 3)
+        assert dt < 2.0, dt  # no compile in the request path
+    finally:
+        eng.close()
+    chunked = ContinuousBatcher(
+        model, params, slots=1, prompt_widths=(8,), prefill_chunk=3
+    )
+    try:
+        chunked.warmup()
+        assert chunked.stats()["completed"] == 1
+        assert chunked.steps > 0
+        assert chunked.submit([5, 6], 3) == _reference(
+            model, params, [5, 6], 3
+        )
+    finally:
+        chunked.close()
