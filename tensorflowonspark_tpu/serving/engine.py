@@ -90,6 +90,10 @@ class _Pending:
     temperature: float | None = None  # None = the engine-wide default
     eos_id: int | None = None  # None = the engine-wide default
     adapter: int = 0  # MultiLoraTensor bank slot (0 = base model)
+    # multi-token stop sequences (host-side tail match; the matched
+    # suffix is trimmed from the RESULT — streams necessarily saw its
+    # tokens already, since the match completes only on the last one)
+    stop: tuple = ()
     # set by the consumer side (stream close); the scheduler treats it
     # as finished at the next step/admission — a plain bool is enough
     # (single writer, benign race: at worst one extra token decodes)
@@ -143,6 +147,19 @@ class _Stream:
             raise item
         token, lp = item
         return (token, lp) if self._yield_logprobs else token
+
+    @property
+    def result(self):
+        """The request's FINAL (stop-trimmed) completion, available
+        once the stream is exhausted — the streamed tokens necessarily
+        include any matched stop suffix (the match completes on its
+        last token), so trailer-building consumers should prefer this
+        over re-assembling the yielded tokens."""
+        return self._p.result
+
+    @property
+    def logprobs(self):
+        return self._p.logprobs
 
     def close(self) -> None:
         if not self._done:
@@ -434,7 +451,29 @@ class ContinuousBatcher:
         max_new_tokens: int,
         temperature: float | None,
         adapter: int | None = None,
+        stop: "list[list[int]] | None" = None,
     ) -> None:
+        if stop:
+            if len(stop) > 16:
+                # the tail match runs per decoded token inside the
+                # SHARED scheduler loop — an unbounded stop list from
+                # one tenant would tax every concurrent request
+                raise ValueError(
+                    f"at most 16 stop sequences, got {len(stop)}"
+                )
+            for seq in stop:
+                if not seq or not all(
+                    isinstance(t, int) and 0 <= t for t in seq
+                ):
+                    raise ValueError(
+                        "stop sequences must be non-empty lists of "
+                        f"non-negative token ids, got {seq!r}"
+                    )
+                if len(seq) > 64:
+                    raise ValueError(
+                        f"stop sequences are capped at 64 tokens, got "
+                        f"{len(seq)}"
+                    )
         cfg = self._model.cfg
         if not tokens:
             raise ValueError("empty prompt")
@@ -485,12 +524,15 @@ class ContinuousBatcher:
         temperature: float | None = None,
         eos_id: int | None = None,
         adapter: int | None = None,
+        stop: "list[list[int]] | None" = None,
     ) -> list[_Pending]:
         """Validate then enqueue a group ATOMICALLY: either every row is
         accepted or none is — a partially admitted multi-row request
         would burn slots on work the client then discards on its 503."""
         for tokens, _ in requests:
-            self._validate(tokens, max_new_tokens, temperature, adapter)
+            self._validate(
+                tokens, max_new_tokens, temperature, adapter, stop
+            )
         ps = [
             _Pending(
                 list(tokens),
@@ -499,6 +541,7 @@ class ContinuousBatcher:
                 temperature=temperature,
                 eos_id=eos_id,
                 adapter=int(adapter or 0),
+                stop=tuple(tuple(q) for q in (stop or ())),
                 submitted_at=time.monotonic(),
                 sink=sink,
             )
@@ -538,9 +581,11 @@ class ContinuousBatcher:
         temperature: float | None = None,
         eos_id: int | None = None,
         adapter: int | None = None,
+        stop: "list[list[int]] | None" = None,
     ) -> _Pending:
         return self._enqueue_all(
-            [(tokens, sink)], max_new_tokens, temperature, eos_id, adapter
+            [(tokens, sink)], max_new_tokens, temperature, eos_id,
+            adapter, stop,
         )[0]
 
     def submit(
@@ -551,6 +596,7 @@ class ContinuousBatcher:
         eos_id: int | None = None,
         return_logprobs: bool = False,
         adapter: int | None = None,
+        stop: "list[list[int]] | None" = None,
     ) -> "list[int] | tuple[list[int], list[float]]":
         """Blocking decode. ``temperature`` and ``eos_id`` override the
         engine-wide defaults FOR THIS REQUEST (temperature is a traced
@@ -564,7 +610,7 @@ class ContinuousBatcher:
         traced per-row — mixed-adapter batches cost no recompilation."""
         p = self._enqueue(
             tokens, max_new_tokens, temperature=temperature,
-            eos_id=eos_id, adapter=adapter,
+            eos_id=eos_id, adapter=adapter, stop=stop,
         )
         p.event.wait()
         if p.error is not None:
@@ -581,6 +627,7 @@ class ContinuousBatcher:
         eos_id: int | None = None,
         return_logprobs: bool = False,
         adapter: int | None = None,
+        stop: "list[list[int]] | None" = None,
     ) -> "list[list[int]] | tuple[list[list[int]], list[list[float]]]":
         """Blocking decode of several prompts admitted ATOMICALLY (all
         rows accepted or an EngineOverloaded/ValueError before any row
@@ -592,6 +639,7 @@ class ContinuousBatcher:
             temperature,
             eos_id,
             adapter,
+            stop,
         )
         for p in ps:
             p.event.wait()
@@ -610,6 +658,7 @@ class ContinuousBatcher:
         eos_id: int | None = None,
         yield_logprobs: bool = False,
         adapter: int | None = None,
+        stop: "list[list[int]] | None" = None,
     ):
         """Yield completion tokens AS THEY DECODE (one engine step of
         latency each) instead of blocking for the full result.
@@ -631,6 +680,7 @@ class ContinuousBatcher:
             temperature=temperature,
             eos_id=eos_id,
             adapter=adapter,
+            stop=stop,
         )
 
         # An explicit iterator, NOT a generator: close() on a
@@ -1164,6 +1214,10 @@ class ContinuousBatcher:
     def _finished(self, p: _Pending, out: list[int], last: int) -> bool:
         if p.cancelled:
             return True  # consumer went away; free the slot now
+        for seq in p.stop:
+            # the match can only complete on the token just emitted
+            if last == seq[-1] and tuple(out[-len(seq):]) == seq:
+                return True
         # Per-request eos: None = engine default; negative = DISABLED
         # (run the full budget even when the engine has a default eos —
         # None can't express that, it IS the use-the-default sentinel).
@@ -1179,7 +1233,24 @@ class ContinuousBatcher:
         p, out, lps = self._live[row]
         self._live[row] = None
         now = time.monotonic()
-        self.tokens_emitted += len(out)
+        self.tokens_emitted += len(out)  # decoded count, pre-trim
+        matched = max(
+            (
+                seq
+                for seq in p.stop
+                if len(out) >= len(seq)
+                and tuple(out[-len(seq):]) == seq
+            ),
+            key=len,
+            default=None,
+        )
+        if matched is not None:
+            # standard stop-sequence semantics: the completion ends
+            # BEFORE the stop text (streams already saw the tokens; the
+            # blocking result is the trimmed one). LONGEST tail match,
+            # so [[b],[a,b]] and [[a,b],[b]] trim identically.
+            out = out[: len(out) - len(matched)]
+            lps = lps[: len(out)]
         if p.cancelled:
             self.cancelled += 1
         if p.first_token_at is not None:

@@ -194,18 +194,21 @@ class _Handler(BaseHTTPRequestHandler):
             max_new = payload.get("max_new_tokens")
             eos_id = payload.get("eos_id")
             adapter = payload.get("adapter")
+            stop = payload.get("stop")
             want_logprobs = bool(payload.get("logprobs"))
             if (
                 temperature is not None
                 or max_new is not None
                 or eos_id is not None
                 or adapter is not None
+                or stop is not None
                 or want_logprobs
             ) and self.gen_engine is None:
                 raise ValueError(
                     "per-request temperature/max_new_tokens/eos_id/"
-                    "adapter/logprobs require --gen-engine continuous "
-                    "(the fixed path bakes decode params at startup)"
+                    "adapter/stop/logprobs require --gen-engine "
+                    "continuous (the fixed path bakes decode params at "
+                    "startup)"
                 )
             if temperature is not None:
                 temperature = float(temperature)
@@ -221,6 +224,8 @@ class _Handler(BaseHTTPRequestHandler):
                 eos_id = int(eos_id)
             if adapter is not None:
                 adapter = int(adapter)
+            if stop is not None:
+                stop = [[int(t) for t in seq] for seq in stop]
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             self._reply(400, {"error": str(e)})
             return
@@ -241,7 +246,7 @@ class _Handler(BaseHTTPRequestHandler):
         if stream:
             self._engine_stream(
                 prompts[0], temperature, max_new, eos_id, want_logprobs,
-                adapter,
+                adapter, stop,
             )
             return
         from tensorflowonspark_tpu.serving import EngineOverloaded
@@ -252,7 +257,7 @@ class _Handler(BaseHTTPRequestHandler):
                 try:
                     completions = self._engine_generate(
                         prompts, temperature, max_new, eos_id,
-                        want_logprobs, adapter,
+                        want_logprobs, adapter, stop,
                     )
                     if want_logprobs:
                         completions, logprobs = completions
@@ -295,6 +300,7 @@ class _Handler(BaseHTTPRequestHandler):
         eos_id=None,
         want_logprobs=False,
         adapter=None,
+        stop=None,
     ) -> None:
         """Stream one completion as newline-delimited JSON: a
         ``{"token": t}`` line per decoded token (one engine step of
@@ -312,6 +318,7 @@ class _Handler(BaseHTTPRequestHandler):
                 eos_id=eos_id,
                 yield_logprobs=want_logprobs,
                 adapter=adapter,
+                stop=stop,
             )
         except EngineOverloaded as e:
             self._reply(503, {"error": str(e)}, {"Retry-After": "1"})
@@ -337,9 +344,15 @@ class _Handler(BaseHTTPRequestHandler):
                 out.append(t)
                 self.wfile.write(json.dumps(line).encode() + b"\n")
                 self.wfile.flush()
-            trailer = {"done": True, "completion": out}
+            # the engine's result is the stop-TRIMMED completion (the
+            # streamed tokens include any matched stop suffix); fall
+            # back to the raw tokens if the iterator wasn't exhausted
+            final = gen.result if gen.result is not None else out
+            trailer = {"done": True, "completion": final}
             if want_logprobs:
-                trailer["logprobs"] = lps
+                trailer["logprobs"] = (
+                    gen.logprobs if gen.result is not None else lps
+                )
             self.wfile.write(json.dumps(trailer).encode() + b"\n")
         except (BrokenPipeError, ConnectionResetError):
             logger.info("stream client disconnected")
@@ -368,6 +381,7 @@ class _Handler(BaseHTTPRequestHandler):
         eos_id=None,
         want_logprobs=False,
         adapter=None,
+        stop=None,
     ):
         """Continuous-batching path: the request's rows are admitted
         ATOMICALLY (all accepted, or a 400/503 before any decodes — a
@@ -381,6 +395,7 @@ class _Handler(BaseHTTPRequestHandler):
             eos_id=eos_id,
             return_logprobs=want_logprobs,
             adapter=adapter,
+            stop=stop,
         )
 
 

@@ -407,6 +407,17 @@ def test_serve_model_continuous_engine(tmp_path):
         code, body = _post(port, "/generate", {"prompts": [[1] * 127]})
         assert code == 400 and "max_seq_len" in body["error"]
 
+        # per-request stop sequences trim the completion
+        full = np.asarray(
+            generate(model, params, jnp.asarray([[2, 4]], jnp.int32), 5)
+        )[0].tolist()
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[2, 4]], "stop": [full[1:3]]},
+        )
+        assert code == 200
+        assert body["completions"] == [full[:1]]
+
         # scheduler observability
         import urllib.request
 
@@ -416,7 +427,8 @@ def test_serve_model_continuous_engine(tmp_path):
             stats = json.loads(r.read())
         assert stats["mode"] == "continuous"
         assert stats["slots"] == 3
-        assert stats["admitted"] == len(prompts) + 3  # +2 multi-row, +1 over-width
+        # +2 multi-row, +1 over-width, +1 stop-sequence request
+        assert stats["admitted"] == len(prompts) + 4
         assert stats["steps"] > 0 and not stats["closed"]
         # the CLI-wired prefix cache is live and accounted in /stats
         assert stats["prefix_cache_entries"] > 0

@@ -892,3 +892,61 @@ def test_engine_everything_on_composition_stress():
         assert st["prefix_hits"] >= 1  # the exact re-submit at minimum
     finally:
         eng.close()
+
+
+def test_engine_stop_sequences(tiny):
+    """Multi-token stop sequences: the row retires the step the tail
+    matches, the matched suffix is trimmed from the blocking result
+    (with its logprobs), single-token stops behave like eos, and a
+    non-occurring stop runs the full budget."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=2, prompt_widths=(8,))
+    try:
+        base = _reference(model, params, [1, 2, 3], 10)
+        # stop at the first two greedy tokens: result must be empty
+        got = eng.submit([1, 2, 3], 10, stop=[base[:2]])
+        assert got == []
+        # stop on an interior bigram
+        seq = base[3:5]
+        got, lps = eng.submit(
+            [1, 2, 3], 10, stop=[seq], return_logprobs=True
+        )
+        assert got == base[:3]
+        assert len(lps) == len(got)
+        # several sequences: the EARLIEST completed match wins
+        got = eng.submit([1, 2, 3], 10, stop=[base[6:8], [base[4]]])
+        assert got == base[:4]
+        # a stop that never matches: full budget
+        assert eng.submit([1, 2, 3], 6, stop=[[255, 255, 255]]) == base[:6]
+        # validation
+        with pytest.raises(ValueError, match="stop"):
+            eng.submit([1], 2, stop=[[]])
+    finally:
+        eng.close()
+
+
+def test_engine_stop_sequence_caps_and_longest_match(tiny):
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=1, prompt_widths=(8,))
+    try:
+        with pytest.raises(ValueError, match="16 stop"):
+            eng.submit([1], 2, stop=[[1]] * 17)
+        with pytest.raises(ValueError, match="64 tokens"):
+            eng.submit([1], 2, stop=[[1] * 65])
+        # order-independent trimming: the LONGEST tail match wins.
+        # base[4] is the first occurrence of its value, so the 1-token
+        # stop and the 2-token stop COMPLETE on the same step
+        base = _reference(model, params, [1, 2, 3], 6)
+        assert base[4] not in base[:4]  # construction precondition
+        a = eng.submit([1, 2, 3], 6, stop=[[base[4]], base[3:5]])
+        b = eng.submit([1, 2, 3], 6, stop=[base[3:5], [base[4]]])
+        assert a == b == base[:3]
+        # streaming: the yielded tokens include the matched stop suffix
+        # (the match completes on its last token), but the handle's
+        # .result is the TRIMMED completion — what HTTP trailers serve
+        stream = eng.stream([1, 2, 3], 6, stop=[base[3:5]])
+        seen = list(stream)
+        assert seen == base[:5]  # raw, includes the stop pair
+        assert stream.result == base[:3]  # trimmed
+    finally:
+        eng.close()
