@@ -10,24 +10,30 @@ is weight-read-bound, so stepping a partially full batch costs the same
 HBM traffic as a full one — utilization comes from keeping slots busy,
 which is exactly what per-step admission does.
 
-TPU-first mechanics (all shapes static, three compiled programs total):
+TPU-first mechanics: all shapes are static, so the engine runs a small
+FIXED set of compiled programs and admission never recompiles:
 
 - **step** (compiled once per engine): (B, 1) tokens through the model
   with ``decode=True, padded=True`` — each row writes K/V at its OWN
   position (the per-row scatter path of `models/llama.py`
   `Attention._decode_attention`), so rows at different depths coexist
-  in one batch.
+  in one batch. Per-request temperature and LoRA-adapter ids ride it
+  as traced per-row inputs.
 - **prefill** (compiled once per prompt-width bucket): a (1, W) padded
   prefill builds a fresh single-row cache and samples the row's first
-  token from its true last position.
+  token from its true last position. In chunked mode the bucket
+  prefills are replaced by ONE (1, C) **chunk** program plus a tiny
+  **sample** program, reused for every prompt length.
 - **admit** (compiled once): scatters the single-row cache into slot
   ``r`` of the engine cache with `lax.dynamic_update_slice` — no
   host-side cache reads, no recompilation.
 
-The host loop owns scheduling only: admit-then-step, retire rows on EOS
-or budget, hand tokens to waiters. One engine step per host iteration
-keeps admission latency at one token; the device work per step is the
-same einsum the plain `generate` loop runs.
+``warmup()`` pre-compiles all of them before real traffic. The host
+loop owns scheduling only: admit-then-step, retire rows on EOS, budget,
+stop-sequence match, or cancellation, hand tokens to waiters. One
+engine step per host iteration keeps admission latency at one token;
+the device work per step is the same einsum the plain `generate` loop
+runs.
 
 Reference parity note: nothing in the reference corresponds to this
 (its serving was batch scoring over Spark partitions); this is the
