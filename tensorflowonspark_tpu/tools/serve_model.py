@@ -195,6 +195,7 @@ class _Handler(BaseHTTPRequestHandler):
             eos_id = payload.get("eos_id")
             adapter = payload.get("adapter")
             stop = payload.get("stop")
+            n_samples = payload.get("n")
             want_logprobs = bool(payload.get("logprobs"))
             if (
                 temperature is not None
@@ -202,11 +203,12 @@ class _Handler(BaseHTTPRequestHandler):
                 or eos_id is not None
                 or adapter is not None
                 or stop is not None
+                or n_samples is not None
                 or want_logprobs
             ) and self.gen_engine is None:
                 raise ValueError(
                     "per-request temperature/max_new_tokens/eos_id/"
-                    "adapter/stop/logprobs require --gen-engine "
+                    "adapter/stop/n/logprobs require --gen-engine "
                     "continuous (the fixed path bakes decode params at "
                     "startup)"
                 )
@@ -226,6 +228,27 @@ class _Handler(BaseHTTPRequestHandler):
                 adapter = int(adapter)
             if stop is not None:
                 stop = [[int(t) for t in seq] for seq in stop]
+            if n_samples is not None:
+                n_samples = int(n_samples)
+                if not 1 <= n_samples <= 16:
+                    raise ValueError(
+                        f"n must be in [1, 16], got {n_samples}"
+                    )
+                # EFFECTIVE temperature: the request value, else the
+                # engine-wide default (--temperature); the engine
+                # decodes any temp <= 0 greedily (_sample_rows selects
+                # on temps > 0), which would return n identical rows
+                eff_temp = (
+                    temperature
+                    if temperature is not None
+                    else getattr(self.gen_engine, "_temperature", 0.0)
+                )
+                if n_samples > 1 and eff_temp <= 0:
+                    raise ValueError(
+                        "n > 1 with greedy decoding (effective "
+                        "temperature <= 0) would return n identical "
+                        "completions; set a temperature"
+                    )
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             self._reply(400, {"error": str(e)})
             return
@@ -243,6 +266,13 @@ class _Handler(BaseHTTPRequestHandler):
                 400, {"error": "streaming supports exactly one prompt"}
             )
             return
+        if stream and (n_samples or 1) > 1:
+            self._reply(
+                400,
+                {"error": "streaming supports exactly one completion "
+                          "(n must be 1)"},
+            )
+            return
         if stream:
             self._engine_stream(
                 prompts[0], temperature, max_new, eos_id, want_logprobs,
@@ -255,12 +285,26 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if self.gen_engine is not None:
                 try:
+                    n = n_samples or 1
+                    fan = [p for p in prompts for _ in range(n)]
                     completions = self._engine_generate(
-                        prompts, temperature, max_new, eos_id,
+                        fan, temperature, max_new, eos_id,
                         want_logprobs, adapter, stop,
                     )
                     if want_logprobs:
                         completions, logprobs = completions
+                    if n > 1:
+                        # regroup: completions[i] becomes the LIST of n
+                        # samples for prompt i (documented shape change)
+                        completions = [
+                            completions[i * n : (i + 1) * n]
+                            for i in range(len(prompts))
+                        ]
+                        if logprobs is not None:
+                            logprobs = [
+                                logprobs[i * n : (i + 1) * n]
+                                for i in range(len(prompts))
+                            ]
                 except EngineOverloaded as e:
                     self._reply(
                         503, {"error": str(e)}, {"Retry-After": "1"}

@@ -751,3 +751,83 @@ def test_serve_model_multi_lora_bank_checkpoint(tmp_path):
         assert code == 400 and "out of range" in body["error"]
     finally:
         server.shutdown()
+
+
+def test_serve_model_n_samples(tmp_path):
+    """The "n" field fans one prompt into n independently-sampled
+    completions (regrouped per prompt); greedy n>1 and streaming n>1
+    are rejected as meaningless."""
+    import threading
+
+    from tensorflowonspark_tpu.tools import serve_model
+
+    cfg, model, params, ckpt_dir = _tiny_checkpoint(tmp_path)
+    gen = dict(
+        checkpoint=ckpt_dir,
+        model="tiny",
+        config_overrides='{"remat": false, "dtype": "float32"}',
+        width=8,
+        batch_size=4,
+        max_new_tokens=8,
+        engine="continuous",
+        seed=7,
+    )
+    server = serve_model.make_server(None, port=0, gen=gen)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[1, 2], [5, 6, 7]], "n": 3,
+             "temperature": 0.9, "max_new_tokens": 6},
+        )
+        assert code == 200, body
+        assert len(body["completions"]) == 2
+        for group in body["completions"]:
+            assert len(group) == 3
+            assert all(len(c) == 6 for c in group)
+        # sampled fan-out should produce some diversity across 3 draws
+        assert any(
+            len({tuple(c) for c in group}) > 1
+            for group in body["completions"]
+        )
+        code, body = _post(
+            port, "/generate", {"prompts": [[1, 2]], "n": 3}
+        )
+        assert code == 400 and "temperature" in body["error"]
+        # negative temperature is greedy too (engine selects temps > 0)
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[1, 2]], "n": 3, "temperature": -1},
+        )
+        assert code == 400 and "temperature" in body["error"]
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[1, 2]], "n": 3, "temperature": 0.9,
+             "stream": True},
+        )
+        assert code == 400 and "n must be 1" in body["error"]
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[1, 2]], "n": 99, "temperature": 0.9},
+        )
+        assert code == 400 and "[1, 16]" in body["error"]
+    finally:
+        server.shutdown()
+
+    # a server with a SAMPLED default temperature accepts n without a
+    # per-request temperature (the guard checks the EFFECTIVE temp)
+    server2 = serve_model.make_server(
+        None, port=0, gen={**gen, "temperature": 0.8}
+    )
+    port2 = server2.server_address[1]
+    threading.Thread(target=server2.serve_forever, daemon=True).start()
+    try:
+        code, body = _post(
+            port2, "/generate",
+            {"prompts": [[1, 2]], "n": 2, "max_new_tokens": 4},
+        )
+        assert code == 200, body
+        assert len(body["completions"][0]) == 2
+    finally:
+        server2.shutdown()
