@@ -1,4 +1,6 @@
 #!/bin/bash
+# SUPERSEDED by run_round4.sh — it batches every pending
+# measurement (including these) for one relay window; run that instead.
 # The round-2 pending real-chip measurements (BASELINE.md / docs/PARITY.md
 # known-gaps list), batched so one relay window covers them all.
 #

@@ -1,4 +1,6 @@
 #!/bin/bash
+# SUPERSEDED by run_round4.sh — it batches every pending
+# measurement (including these) for one relay window; run that instead.
 # Round-3 second-window measurements: the fused-statistics BatchNorm
 # A/Bs and the clean seq-4096 comparison (the first window's chunked-CE
 # number shared the host with a CPU test suite — re-measure idle).
