@@ -275,3 +275,42 @@ def test_speculative_validations(target_and_draft):
             target, t_params, draft, d_params, prompt,
             target.cfg.max_seq_len, k=4,
         )
+
+
+def test_speculative_composes_with_window_and_int8_kv():
+    """Speculative decode under a sliding-window target with an int8 KV
+    cache must still be token-identical to that target's plain greedy
+    decode (the draft changes speed, never output — including through
+    the round-4 cache features)."""
+    cfg = LlamaConfig.tiny(
+        dtype=jnp.float32,
+        remat=False,
+        sliding_window=5,
+        kv_cache_dtype="int8",
+    )
+    target = Llama(cfg)
+    t_params = target.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 16), jnp.int32)
+    )["params"]
+    dcfg = LlamaConfig.tiny(
+        dtype=jnp.float32,
+        remat=False,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=1,
+        num_heads=2,
+        num_kv_heads=1,
+        sliding_window=5,
+    )
+    draft = Llama(dcfg)
+    d_params = draft.init(
+        jax.random.PRNGKey(1), jnp.zeros((2, 16), jnp.int32)
+    )["params"]
+    prompt = jnp.asarray([[1, 2, 3, 4], [9, 8, 7, 6]], jnp.int32)
+    want = np.asarray(generate(target, t_params, prompt, 12))
+    got = np.asarray(
+        speculative_generate(
+            target, t_params, draft, d_params, prompt, 12, k=3
+        )
+    )
+    np.testing.assert_array_equal(got, want)
