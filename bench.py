@@ -259,7 +259,13 @@ def main() -> None:
                     f"listening on 127.0.0.1:{RELAY_PORTS.start}-"
                     f"{RELAY_PORTS.stop - 1}; backend init would wedge. "
                     "Driver-format capture from round 3's relay window: "
-                    "57.0% MFU (benchmarks/results/round3_window1.jsonl).",
+                    "57.0% MFU (benchmarks/results/round3_window1.jsonl). "
+                    "The relay stayed down through ALL of round 4; "
+                    "benchmarks/run_round4.sh batches this headline plus "
+                    "every pending measurement (fused-BN conv nets, "
+                    "seq-4096 A/B, profiles, engine tax, prefix TTFT, "
+                    "int8-KV and windowed A/Bs) for the first window "
+                    "that opens.",
                     **_partial,
                 }
             )
