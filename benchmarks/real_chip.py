@@ -47,7 +47,9 @@ def _maybe_trace(run_steps) -> None:
 
     with jax.profiler.trace(_PROFILE_DIR):
         run_steps(5)
-    print(f"profile trace written to {_PROFILE_DIR}", flush=True)
+    # stderr: stdout is the machine-readable JSONL stream (tee'd into
+    # benchmarks/results/ artifacts by the relay-window scripts).
+    print(f"profile trace written to {_PROFILE_DIR}", file=_sys.stderr, flush=True)
 
 
 def _bench_step(step, state, make_batch, steps: int, warmup: int = 3):

@@ -1,0 +1,75 @@
+#!/bin/bash
+# Resume of run_round4.sh after the 2026-08-01 window wedge (items 1-2
+# were captured; inception timed out and wedged the session). Ordering
+# is now risk-based: programs that have compiled on this chip before run
+# first; brand-new compiles (prefix caching, kv-quantize, windowed
+# flash, the Pallas-BN conv nets) run LAST, because a first-time compile
+# can wedge the remote helper (verify skill: "Remote-compile quirks")
+# and a wedge kills every subsequent dial in the window.
+#
+# Discipline (BASELINE.md / verify skill): ONE dialer at a time; nothing
+# else may even START a bare python while this runs (interpreter boot
+# dials the relay — blank PALLAS_AXON_POOL_IPS for any concurrent
+# tooling); idle host; SIGTERM only. On ANY timeout (rc=124) this script
+# STOPS — the session is assumed wedged and further dials would hang.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-benchmarks/results/round4_window1.jsonl}
+
+if ! ss -tln | grep -qE ':(808[2-9]|809[0-9]|810[0-9]|811[0-7]) '; then
+  echo "TPU relay ports 8082-8117 not listening; aborting before any dial" >&2
+  exit 1
+fi
+
+run() {
+  local t="$1"; shift
+  echo "=== $* ===" >&2
+  timeout "$t" "$@" | tee -a "$OUT"
+  local rc=${PIPESTATUS[0]}
+  if [ "$rc" = 124 ]; then
+    echo "TIMED OUT after ${t}s: $* — session likely wedged; stopping" >&2
+    exit 124
+  fi
+  echo >&2
+}
+
+# -- known-compiled programs (ran in a previous window) --
+# 4. seq-4096 A/B on an idle host: unchunked vs chunked CE, same
+#    bf16-moment optimizer
+run 900 python benchmarks/real_chip.py --config llama1b --seq 4096 --moments bf16
+run 900 python benchmarks/real_chip.py --config llama1b --seq 4096 \
+  --logit-chunk 512 --moments bf16
+
+# 5. Profile the headline config: where do the non-MXU 43% go?
+run 900 python benchmarks/real_chip.py --config llama1b --moments bf16 \
+  --profile "${PROFILE_DIR_LLAMA:-/tmp/llama1b_profile}"
+
+# 6. Continuous-batching engine vs plain batch decode
+run 900 python benchmarks/real_chip.py --config llama1b_engine --steps 3
+run 900 python benchmarks/real_chip.py --config llama1b_engine --steps 3 --quantize
+
+# 8a. int8-KV A/B baseline leg (plain decode compiled before)
+run 900 python benchmarks/real_chip.py --config llama1b_decode --seq 2048 --new-tokens 64
+
+# -- new programs (first-ever chip compile; each may wedge) --
+# 7. prefix-caching TTFT
+run 900 python benchmarks/real_chip.py --config llama1b_prefix --steps 16
+
+# 8b/c. int8 KV cache, then composed with int8 weights
+run 900 python benchmarks/real_chip.py --config llama1b_decode --seq 2048 --new-tokens 64 --kv-quantize
+run 900 python benchmarks/real_chip.py --config llama1b_decode --seq 2048 --new-tokens 64 --kv-quantize --quantize
+
+# 9. sliding-window training at long seq
+run 900 python benchmarks/real_chip.py --config llama1b --seq 4096 --moments bf16 --window 1024
+
+# 2'. ResNet-50 with the round-4 Pallas-streamed BN stats kernels
+#     (16.1% flax BN, 15.8% custom-VJP XLA stats — the A/B this kernel
+#     exists for), plus a trace to confirm the reduce time moved.
+run 1200 python benchmarks/real_chip.py --config resnet50 \
+  --profile "${PROFILE_DIR:-/tmp/resnet50_pallasbn_profile}"
+
+# 3'. Inception-v3 with Pallas-BN. LAST: its fused-BN compile is the
+#     suspected wedge of both the round-3 and round-4 windows.
+run 1800 python benchmarks/real_chip.py --config inception_v3
+
+echo "round-4 resume attempted; results in $OUT" >&2

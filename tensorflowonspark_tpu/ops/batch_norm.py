@@ -3,25 +3,29 @@
 Why this exists (measured, round 3): on the real v5e chip, 48% of the
 ResNet-50 train step is BatchNorm statistics reductions
 (`convert_reduce_fusion` — see BASELINE.md's profile analysis), because the
-autodiff-generated stats path makes several separate full passes over the
-activations: mean and mean-of-squares forward, then sum(dy) and
-sum(dy*xhat) backward, each its own HBM read of a (N,H,W,C) tensor, plus
-the normalized-activation recompute. The convolutions themselves are only
-~22% of the step (~76% MXU-efficient) — the stats traffic is the ceiling.
+stats path makes several full passes over the activations: mean and
+mean-of-squares forward, then sum(dy) and sum(dy*xhat) backward, each an
+HBM read of a (N,H,W,C) tensor. The convolutions themselves are only ~22%
+of the step (~76% MXU-efficient) — the stats traffic is the ceiling.
 
-This module computes each direction's TWO channel statistics in ONE
-variadic `lax.reduce` pass (XLA fuses the bf16→fp32 convert and the
-squaring/products into the reduce's input), and pins the pass structure
-with a `jax.custom_vjp` so autodiff cannot de-fuse it:
+Round-4 finding (profiled A/B on the chip): XLA already merges the sibling
+reductions into ~2 fused passes per layer — but runs them at ~20-30% of
+HBM streaming rate. So the win is not in *pass structure* (the round-3
+custom-VJP re-derivation measured 15.8% MFU vs flax BN's 16.1%) but in
+*pass rate*: `ops/bn_kernels.py` provides Pallas streaming kernels for the
+two stats passes, used here on TPU backends:
 
-- forward: one pass over x for (sum, sum_sq) → mean/var; one fused
-  normalize pass (read x, write y) in the model dtype.
-- backward: one pass over (dy, x) for (sum_dy, sum_dy_xhat) — xhat is
-  recomputed inline from the saved mean/invstd, never materialized — and
-  one pass producing dx.
+- forward: ONE kernel pass over x for per-channel (sum, sum_sq) → mean/var
+  (fp32 accumulation over the bf16 stream); one fused normalize pass
+  (read x, write y) in the model dtype, left to XLA.
+- backward: ONE kernel pass over (dy, x) for (sum_dy, sum_dy_x) — xhat is
+  never materialized; sum(dy·x̂) = invstd·(sum(dy·x) − mean·sum(dy)) in
+  fp32 — and one XLA elementwise pass producing dx.
 
-That is 2 reads + 1 write per direction beyond the convs' own traffic —
-the streaming minimum for exact batch statistics.
+The statistics are computed exactly once per layer: `bn_train`'s custom
+VJP computes them inside the op and returns them alongside the
+normalized output, so the module reuses the same values for the
+running-average update rather than recomputing and hoping for CSE.
 
 Parity note: the reference delegated BN entirely to TF's library
 (SURVEY.md §1 — it has no compute code of its own); this is the rebuild's
@@ -38,62 +42,97 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tensorflowonspark_tpu.ops import bn_kernels
+
 
 def _channel_stats(af: jax.Array, bf: jax.Array, reduce_dims: tuple[int, ...]):
-    """One-pass per-channel (sum_a, sum_b), accumulated in fp32.
+    """XLA-path per-channel (sum_a, sum_b), accumulated in fp32.
 
     Callers pass fp32 values built from the streamed tensor (convert
     FIRST, then square/multiply — squaring in bf16 loses the low bits
     that E[x²]−E[x]² cancellation needs). Two sibling reductions over
-    inputs sharing the same streamed operand: XLA's multi-output fusion
-    merges them into a single pass that reads the narrow tensor from HBM
-    once, with the converts and products fused into the reduce input. A
-    variadic ``lax.reduce`` would express the same thing explicitly, but
-    this environment's remote TPU compile helper wedges on it (same
-    class of quirk as the `remat_policy="dots"` note in BASELINE.md).
+    inputs sharing the same streamed operand: XLA merges them into one
+    multi-output reduce fusion. A variadic ``lax.reduce`` would express
+    the same thing explicitly, but this environment's remote TPU compile
+    helper wedges on it (same class of quirk as the `remat_policy="dots"`
+    note in BASELINE.md).
     """
     af = af.astype(jnp.float32)
     bf = bf.astype(jnp.float32)
     return jnp.sum(af, axis=reduce_dims), jnp.sum(bf, axis=reduce_dims)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def fused_batch_norm(x, gamma, beta, eps):
-    y, _, _ = _fbn_fwd_impl(x, gamma, beta, eps)
-    return y
+def _reduce_extent(x: jax.Array) -> int:
+    n = 1
+    for d in x.shape[:-1]:
+        n *= d
+    return n
 
 
-def _fbn_fwd_impl(x, gamma, beta, eps):
-    mean, var = batch_norm_stats(x)
+def batch_norm_stats(x, impl: str = "auto") -> tuple[jax.Array, jax.Array]:
+    """One-pass per-channel (mean, var) over all-but-last dims, fp32."""
+    n = _reduce_extent(x)
+    if bn_kernels.use_pallas(impl):
+        s, s2 = bn_kernels.pair_stats(x)
+    else:
+        xf = x.astype(jnp.float32)
+        s, s2 = _channel_stats(xf, xf * xf, tuple(range(x.ndim - 1)))
+    mean = s / n
+    var = jnp.maximum(s2 / n - mean * mean, 0.0)
+    return mean, var
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def bn_train(x, gamma, beta, eps, impl="auto"):
+    """Train-mode BatchNorm: ``(y, mean, var)`` with exact batch stats.
+
+    One streamed stats pass and one fused normalize pass forward; one
+    streamed stats pass and one elementwise pass backward — the custom
+    VJP implements the FULL BatchNorm gradient (including the terms from
+    the statistics' dependence on ``x``) and pins the pass structure so
+    autodiff cannot de-fuse it. The returned ``mean``/``var`` are for the
+    running-average update; cotangents flowing into them are IGNORED
+    (their contribution to the normalize is already inside the dx
+    formula — that is train-mode BN's semantics, not an approximation).
+    """
+    y, mean, var, _ = _bn_train_fwd_impl(x, gamma, beta, eps, impl)
+    return y, mean, var
+
+
+def _bn_train_fwd_impl(x, gamma, beta, eps, impl):
+    mean, var = batch_norm_stats(x, impl)
     invstd = lax.rsqrt(var + eps)
+    gamma_f = gamma.astype(jnp.float32)
     # Normalize in the model dtype: scale/shift collapse to one fused
     # multiply-add over the streamed tensor.
-    scale = (invstd * gamma.astype(jnp.float32)).astype(x.dtype)
-    shift = (
-        beta.astype(jnp.float32) - mean * invstd * gamma.astype(jnp.float32)
-    ).astype(x.dtype)
+    scale = (invstd * gamma_f).astype(x.dtype)
+    shift = (beta.astype(jnp.float32) - mean * invstd * gamma_f).astype(x.dtype)
     y = x * scale + shift
-    return y, mean, invstd
+    return y, mean, var, invstd
 
 
-def _fbn_fwd(x, gamma, beta, eps):
-    y, mean, invstd = _fbn_fwd_impl(x, gamma, beta, eps)
-    return y, (x, gamma, mean, invstd)
+def _bn_train_fwd(x, gamma, beta, eps, impl):
+    y, mean, var, invstd = _bn_train_fwd_impl(x, gamma, beta, eps, impl)
+    return (y, mean, var), (x, gamma, mean, invstd)
 
 
-def _fbn_bwd(eps, res, dy):
+def _bn_train_bwd(eps, impl, res, cts):
+    dy, _dmean, _dvar = cts  # stats cotangents ignored — see bn_train.
     x, gamma, mean, invstd = res
-    reduce_dims = tuple(range(x.ndim - 1))
-    n = 1
-    for d in reduce_dims:
-        n *= x.shape[d]
-    # xhat recomputed inline in fp32 register math (the HBM stream is
-    # still the bf16 tensors; XLA fuses the converts); one pass reads
-    # (dy, x) and yields both sums.
-    xhat_f = (x.astype(jnp.float32) - mean) * invstd
-    dy_f = dy.astype(jnp.float32)
-    sum_dy, sum_dy_xhat = _channel_stats(dy_f, dy_f * xhat_f, reduce_dims)
-    xhat = xhat_f.astype(x.dtype)
+    n = _reduce_extent(x)
+    if bn_kernels.use_pallas(impl):
+        sum_dy, sum_dy_x = bn_kernels.cross_stats(dy, x)
+        sum_dy_xhat = invstd * (sum_dy_x - mean * sum_dy)
+        xhat = ((x.astype(jnp.float32) - mean) * invstd).astype(x.dtype)
+    else:
+        # xhat recomputed inline in fp32 register math (the HBM stream is
+        # still the bf16 tensors; XLA fuses the converts); one pass reads
+        # (dy, x) and yields both sums.
+        reduce_dims = tuple(range(x.ndim - 1))
+        xhat_f = (x.astype(jnp.float32) - mean) * invstd
+        dy_f = dy.astype(jnp.float32)
+        sum_dy, sum_dy_xhat = _channel_stats(dy_f, dy_f * xhat_f, reduce_dims)
+        xhat = xhat_f.astype(x.dtype)
 
     gamma_f = gamma.astype(jnp.float32)
     # dx = gamma*invstd * (dy - sum_dy/n - xhat * sum_dy_xhat/n)
@@ -106,44 +145,43 @@ def _fbn_bwd(eps, res, dy):
     return dx, dgamma, dbeta
 
 
-fused_batch_norm.defvjp(_fbn_fwd, _fbn_bwd)
+bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
 
 
-def batch_norm_stats(x) -> tuple[jax.Array, jax.Array]:
-    """One-pass (mean, var) over all-but-last dims, fp32."""
-    reduce_dims = tuple(range(x.ndim - 1))
-    n = 1
-    for d in reduce_dims:
-        n *= x.shape[d]
-    xf = x.astype(jnp.float32)
-    s, s2 = _channel_stats(xf, xf * xf, reduce_dims)
-    mean = s / n
-    var = jnp.maximum(s2 / n - mean * mean, 0.0)
-    return mean, var
+def fused_batch_norm(x, gamma, beta, eps, impl: str = "auto"):
+    """Batch-normalize with exact batch statistics (train-mode BN).
+
+    Stats in one streamed pass, normalize in one fused elementwise pass;
+    gradient via :func:`bn_train`'s custom VJP (one streamed stats pass +
+    one elementwise pass).
+    """
+    y, _, _ = bn_train(x, gamma, beta, eps, impl)
+    return y
 
 
 class FusedBatchNorm(nn.Module):
     """Drop-in for ``nn.BatchNorm`` on the conv-net train path.
 
     Train (``use_running_average=False``): normalizes with exact batch
-    statistics via :func:`fused_batch_norm` (one stats pass per
-    direction) and updates fp32 running stats under the standard
-    ``batch_stats`` collection, with ``nn.BatchNorm``'s variable names
-    (``mean``/``var``/``scale``/``bias``) and momentum convention. The
-    flax auto-name of this class differs from ``nn.BatchNorm``'s
-    (``FusedBatchNorm_N`` vs ``BatchNorm_N``), so the in-repo conv nets
-    pass an explicit ``name="BatchNorm_N"`` to keep their checkpoint
-    trees bit-compatible with the pre-swap era (see docs/SWITCHING.md
-    "BatchNorm checkpoint compatibility"); do the same in new models if
-    you need drop-in restore of ``nn.BatchNorm`` checkpoints. Eval:
-    normalizes with the running stats — a pure elementwise chain XLA
-    fuses on its own.
+    statistics (one stats pass per direction — Pallas-streamed on TPU,
+    multi-output reduce fusion elsewhere) and updates fp32 running stats
+    under the standard ``batch_stats`` collection, with ``nn.BatchNorm``'s
+    variable names (``mean``/``var``/``scale``/``bias``) and momentum
+    convention. The flax auto-name of this class differs from
+    ``nn.BatchNorm``'s (``FusedBatchNorm_N`` vs ``BatchNorm_N``), so the
+    in-repo conv nets pass an explicit ``name="BatchNorm_N"`` to keep
+    their checkpoint trees bit-compatible with the pre-swap era (see
+    docs/SWITCHING.md "BatchNorm checkpoint compatibility"); do the same
+    in new models if you need drop-in restore of ``nn.BatchNorm``
+    checkpoints. Eval: normalizes with the running stats — a pure
+    elementwise chain XLA fuses on its own.
     """
 
     use_running_average: bool | None = None
     momentum: float = 0.9
     epsilon: float = 1e-5
     dtype: Any = None
+    impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, use_running_average: bool | None = None):
@@ -170,15 +208,12 @@ class FusedBatchNorm(nn.Module):
             shift = (beta - ra_mean.value * invstd * gamma).astype(dtype)
             return x * scale + shift
 
-        y = fused_batch_norm(x, gamma, beta, self.epsilon)
+        # Stats computed exactly ONCE inside the custom-VJP op: shared by
+        # the normalize and the running-average update — explicitly, not
+        # via CSE of a recompute.
+        y, mean, var = bn_train(x, gamma, beta, self.epsilon, self.impl)
         if not self.is_initializing():
-            # Running-stat update outside the custom_vjp (not part of the
-            # differentiated path); one extra stats pass would double the
-            # traffic, so reuse the forward's pass via stop_gradient-free
-            # recompute: XLA CSEs this reduce with the one inside
-            # fused_batch_norm's forward (identical subgraphs).
-            mean, var = batch_norm_stats(x)
             m = self.momentum
-            ra_mean.value = m * ra_mean.value + (1.0 - m) * mean
-            ra_var.value = m * ra_var.value + (1.0 - m) * var
+            ra_mean.value = m * ra_mean.value + (1.0 - m) * lax.stop_gradient(mean)
+            ra_var.value = m * ra_var.value + (1.0 - m) * lax.stop_gradient(var)
         return y

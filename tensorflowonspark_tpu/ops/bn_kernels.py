@@ -1,0 +1,150 @@
+"""Pallas TPU kernels for BatchNorm channel statistics.
+
+Why (measured, rounds 3-4, real v5e chip): the ResNet-50 train step spends
+~45% of its time in XLA's `convert_reduce_fusion` ops — the BN statistics
+reductions. The op *count* (~2 fused passes per BN layer) shows XLA already
+merges the sibling reductions; the *rate* is the problem: the 97 reduce
+fusions move ~9-14 GB of activations but take 44.5 ms/step, i.e. ~20-30%
+of the chip's HBM streaming bandwidth (`benchmarks/results/` traces,
+BASELINE.md analysis). These kernels pin the streaming loop explicitly —
+one DMA'd (block_rows x block_cols) bf16 tile per grid step, fp32
+accumulation in registers, per-channel partial sums revisiting a
+VMEM-resident output block — so the stats passes run at the DMA rate the
+flash-attention kernel in this package already demonstrates.
+
+Two kernels, both reducing over all rows of a (rows, channels) view:
+
+- ``pair_stats(x)``      -> (sum(x), sum(x*x))     : the forward pass
+- ``cross_stats(dy, x)`` -> (sum(dy), sum(dy*x))   : the backward pass
+
+The backward pass deliberately computes raw ``sum(dy*x)`` rather than
+``sum(dy*xhat)`` so the kernel needs no per-channel scalar inputs; the
+caller derives ``sum(dy*xhat) = invstd * (sum(dy*x) - mean*sum(dy))`` in
+fp32 (same cancellation class as the one-pass variance, accepted and
+documented in ops/batch_norm.py).
+
+Parity note: the reference delegated BN to TF's cuDNN fused kernels
+(SURVEY.md §1 — no compute code of its own); this is the TPU-native
+equivalent of that fused-statistics path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+# Test hook: run the kernels in the Pallas interpreter (works on CPU).
+INTERPRET = False
+
+_OUT_SUBLANES = 8  # output blocks are (8, block_c): Mosaic's min f32 tile
+
+
+def _choose_blocks(rows: int, cols: int) -> tuple[int, int]:
+    """Tile choice: wide-ish lanes, ~1 MB bf16 input tiles.
+
+    Channels in conv nets are multiples of 64; a 512-lane block keeps the
+    DMA large while letting C=2048 layers partition cleanly. Rows default
+    to 1024 (so a (1024, 512) bf16 tile is 1 MB — big enough to hit DMA
+    streaming rate, small enough to double-buffer in VMEM).
+    """
+    block_c = min(cols, 512)
+    block_r = min(rows, 1024)
+    return block_r, block_c
+
+
+def _accumulate(ref, value):
+    ri = pl.program_id(1)
+
+    @pl.when(ri == 0)
+    def _():
+        ref[...] = value
+
+    @pl.when(ri > 0)
+    def _():
+        ref[...] += value
+
+
+def _masked_rows(xf: jax.Array, rows: int, block_r: int) -> jax.Array:
+    """Zero out rows past the array's true extent in the final partial
+    block (zeros are exact identities for every statistic computed here)."""
+    if rows % block_r == 0:
+        return xf
+    ri = pl.program_id(1)
+    valid = rows - ri * block_r
+    rid = lax.broadcasted_iota(jnp.int32, xf.shape, 0)
+    return jnp.where(rid < valid, xf, 0.0)
+
+
+def _pair_kernel(x_ref, sum_ref, sq_ref, *, rows: int, block_r: int):
+    xf = _masked_rows(x_ref[...].astype(jnp.float32), rows, block_r)
+    s = jnp.sum(xf, axis=0, keepdims=True)
+    q = jnp.sum(xf * xf, axis=0, keepdims=True)
+    _accumulate(sum_ref, jnp.broadcast_to(s, sum_ref.shape))
+    _accumulate(sq_ref, jnp.broadcast_to(q, sq_ref.shape))
+
+
+def _cross_kernel(dy_ref, x_ref, sdy_ref, sdyx_ref, *, rows: int, block_r: int):
+    # Mask BOTH streams: a masked dy of 0 times a padded-garbage x (which
+    # may be NaN) would still be NaN.
+    dyf = _masked_rows(dy_ref[...].astype(jnp.float32), rows, block_r)
+    xf = _masked_rows(x_ref[...].astype(jnp.float32), rows, block_r)
+    s = jnp.sum(dyf, axis=0, keepdims=True)
+    q = jnp.sum(dyf * xf, axis=0, keepdims=True)
+    _accumulate(sdy_ref, jnp.broadcast_to(s, sdy_ref.shape))
+    _accumulate(sdyx_ref, jnp.broadcast_to(q, sdyx_ref.shape))
+
+
+def _stats_call(kernel, arrays, rows: int, cols: int):
+    block_r, block_c = _choose_blocks(rows, cols)
+    grid = (pl.cdiv(cols, block_c), pl.cdiv(rows, block_r))
+    in_spec = pl.BlockSpec((block_r, block_c), lambda ci, ri: (ri, ci))
+    # Output blocks revisit index (0, ci) across the (minor) row grid dim:
+    # the accumulator stays VMEM-resident and flushes once per column block.
+    out_spec = pl.BlockSpec((_OUT_SUBLANES, block_c), lambda ci, ri: (0, ci))
+    out_shape = jax.ShapeDtypeStruct((_OUT_SUBLANES, cols), jnp.float32)
+    a, b = pl.pallas_call(
+        functools.partial(kernel, rows=rows, block_r=block_r),
+        grid=grid,
+        in_specs=[in_spec] * len(arrays),
+        out_specs=[out_spec, out_spec],
+        out_shape=[out_shape, out_shape],
+        interpret=INTERPRET,
+    )(*arrays)
+    return a[0], b[0]
+
+
+def _as_2d(x: jax.Array) -> jax.Array:
+    return x.reshape(-1, x.shape[-1])
+
+
+def pair_stats(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """One streamed pass over ``x`` viewed as (rows, C):
+    per-channel ``(sum(x), sum(x*x))`` in fp32."""
+    x2 = _as_2d(x)
+    return _stats_call(_pair_kernel, (x2,), x2.shape[0], x2.shape[1])
+
+
+def cross_stats(dy: jax.Array, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """One streamed pass over ``(dy, x)`` viewed as (rows, C):
+    per-channel ``(sum(dy), sum(dy*x))`` in fp32."""
+    dy2, x2 = _as_2d(dy), _as_2d(x)
+    assert dy2.shape == x2.shape, (dy2.shape, x2.shape)
+    return _stats_call(_cross_kernel, (dy2, x2), x2.shape[0], x2.shape[1])
+
+
+def use_pallas(impl: str = "auto") -> bool:
+    """'pallas' | 'xla' | 'auto' (pallas on TPU backends)."""
+    if impl == "pallas":
+        return True
+    if impl == "xla":
+        return False
+    if impl != "auto":
+        raise ValueError(f"impl must be pallas|xla|auto, got {impl!r}")
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:  # pragma: no cover - no backend at all
+        return False

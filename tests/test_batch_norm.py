@@ -11,6 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tensorflowonspark_tpu.ops import bn_kernels
 from tensorflowonspark_tpu.ops.batch_norm import (
     FusedBatchNorm,
     batch_norm_stats,
@@ -131,6 +132,108 @@ def test_grad_does_not_leak_through_running_stats():
         g_upd,
         g_pure,
     )
+
+
+@pytest.fixture
+def pallas_interpret(monkeypatch):
+    """Run the Pallas stats kernels in the interpreter (CPU CI)."""
+    monkeypatch.setattr(bn_kernels, "INTERPRET", True)
+
+
+@pytest.mark.parametrize(
+    "shape",
+    [
+        (7, 4),  # smaller than one block in both dims
+        (1030, 65),  # partial final row block + sub-lane channel count
+        (2050, 600),  # multiple column blocks, partial in both dims
+    ],
+)
+def test_pair_stats_pallas_matches_numpy(pallas_interpret, shape):
+    rng = np.random.default_rng(10)
+    x = rng.normal(0.5, 2.0, shape).astype(np.float32)
+    s, q = bn_kernels.pair_stats(jnp.asarray(x))
+    assert s.dtype == jnp.float32 and q.dtype == jnp.float32
+    np.testing.assert_allclose(s, x.sum(0), rtol=1e-5, atol=1e-3)
+    np.testing.assert_allclose(q, (x * x).sum(0), rtol=1e-5, atol=1e-3)
+
+
+def test_cross_stats_pallas_matches_numpy(pallas_interpret):
+    rng = np.random.default_rng(11)
+    dy = rng.normal(0.0, 1.0, (1030, 130)).astype(np.float32)
+    x = rng.normal(1.0, 2.0, (1030, 130)).astype(np.float32)
+    sdy, sdyx = bn_kernels.cross_stats(jnp.asarray(dy), jnp.asarray(x))
+    np.testing.assert_allclose(sdy, dy.sum(0), rtol=1e-5, atol=1e-3)
+    np.testing.assert_allclose(sdyx, (dy * x).sum(0), rtol=1e-5, atol=1e-3)
+
+
+def test_pair_stats_pallas_bf16_stream_fp32_accumulate(pallas_interpret):
+    rng = np.random.default_rng(12)
+    x = rng.normal(2.0, 3.0, (520, 64)).astype(np.float32)
+    xb = jnp.asarray(x).astype(jnp.bfloat16)
+    s, q = bn_kernels.pair_stats(xb)
+    ref_s = np.asarray(xb, np.float32).sum(0)
+    ref_q = (np.asarray(xb, np.float32) ** 2).sum(0)
+    np.testing.assert_allclose(s, ref_s, rtol=1e-4, atol=1e-2)
+    np.testing.assert_allclose(q, ref_q, rtol=1e-4, atol=1e-1)
+
+
+def test_fused_batch_norm_pallas_matches_xla_path(pallas_interpret):
+    """Values AND the full custom-VJP gradient must agree between the
+    Pallas-streamed stats path and the XLA reduce path (the backward
+    derives sum(dy·x̂) from raw sums in the Pallas path — different
+    rounding order, same math)."""
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.normal(0.5, 2.0, (3, 5, 5, 24)).astype(np.float32))
+    gamma = jnp.asarray(rng.normal(1.0, 0.3, (24,)).astype(np.float32))
+    beta = jnp.asarray(rng.normal(size=(24,)).astype(np.float32))
+    t = jnp.asarray(rng.normal(size=x.shape).astype(np.float32))
+
+    def loss(impl, x, g, b):
+        return jnp.sum(fused_batch_norm(x, g, b, 1e-5, impl=impl) * t)
+
+    y_p = fused_batch_norm(x, gamma, beta, 1e-5, impl="pallas")
+    y_x = fused_batch_norm(x, gamma, beta, 1e-5, impl="xla")
+    np.testing.assert_allclose(np.asarray(y_p), np.asarray(y_x), atol=1e-5)
+
+    g_p = jax.grad(lambda *a: loss("pallas", *a), argnums=(0, 1, 2))(x, gamma, beta)
+    g_x = jax.grad(lambda *a: loss("xla", *a), argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b in zip(g_p, g_x):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4, rtol=1e-4)
+
+
+def test_module_stats_computed_once_not_via_cse():
+    """The module passes one set of stats to both the normalize and the
+    running-average update; the HLO of a train-mode apply must contain
+    exactly ONE forward stats reduction over the activation (two sums —
+    sum and sum-of-squares — but of one streamed pass), not a second
+    recompute for the running stats."""
+    x = jnp.ones((4, 8, 8, 16), jnp.bfloat16)
+    m = FusedBatchNorm(dtype=jnp.bfloat16, impl="xla")
+    v = m.init(jax.random.key(0), x, use_running_average=False)
+
+    def apply(vars_, x):
+        y, upd = m.apply(vars_, x, use_running_average=False, mutable=["batch_stats"])
+        return jnp.sum(y), upd
+
+    text = jax.jit(apply).lower(v, x).as_text()
+    # StableHLO: reductions print as 'stablehlo.reduce' over
+    # 'tensor<4x8x8x16xf32>' operands. Sanity-check the predicate finds
+    # SOMETHING (guards against dialect drift re-vacuating this test),
+    # then bound the count: one streamed pass = one fused reduce region
+    # with two init values (sum + sum-of-squares) — at most 2 reduce ops
+    # mentioning the full activation, not 4 (a recompute for the
+    # running-average update would double it).
+    reduce_lines = [
+        line
+        for line in text.splitlines()
+        if "stablehlo.reduce" in line
+        and "tensor<4x8x8x16xf32>" in line
+        # channel stats reduce over all-but-channel dims; the harness's
+        # own jnp.sum(y) loss reduces over [0, 1, 2, 3] and must not count
+        and "dimensions = [0, 1, 2]" in line
+    ]
+    assert reduce_lines, "predicate matched nothing - dialect drift?"
+    assert len(reduce_lines) <= 2, "\n".join(reduce_lines)
 
 
 def test_conv_nets_keep_batchnorm_checkpoint_names():
