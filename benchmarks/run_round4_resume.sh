@@ -41,8 +41,10 @@ run 900 python benchmarks/real_chip.py --config llama1b --seq 4096 \
   --logit-chunk 512 --moments bf16
 
 # 5. Profile the headline config: where do the non-MXU 43% go?
+#    (--remat none: bench.py's 57.5% headline config, NOT the 45% full-
+#    remat default)
 run 900 python benchmarks/real_chip.py --config llama1b --moments bf16 \
-  --profile "${PROFILE_DIR_LLAMA:-/tmp/llama1b_profile}"
+  --remat none --profile "${PROFILE_DIR_LLAMA:-/tmp/llama1b_profile}"
 
 # 6. Continuous-batching engine vs plain batch decode
 run 900 python benchmarks/real_chip.py --config llama1b_engine --steps 3
@@ -61,6 +63,12 @@ run 900 python benchmarks/real_chip.py --config llama1b_decode --seq 2048 --new-
 
 # 9. sliding-window training at long seq
 run 900 python benchmarks/real_chip.py --config llama1b --seq 4096 --moments bf16 --window 1024
+
+# 0'. Pallas-BN smoke first: a 30 s standalone compile of the new
+#     kernels + an XLA-vs-Pallas reduce-rate A/B on ResNet-shaped
+#     activations. If the kernels wedge the helper, we learn it here,
+#     not via a 15-min ResNet timeout.
+run 600 python benchmarks/pallas_bn_smoke.py
 
 # 2'. ResNet-50 with the round-4 Pallas-streamed BN stats kernels
 #     (16.1% flax BN, 15.8% custom-VJP XLA stats — the A/B this kernel
