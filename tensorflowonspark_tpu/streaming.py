@@ -39,6 +39,12 @@ logger = logging.getLogger(__name__)
 # An "RDD" in this model: a list of partitions, each a list of records.
 RDD = list
 
+# textFileStream settle: an mtime this much in the past is trusted to
+# mean "the writer is done" even on coarse-granularity filesystems
+# (ext3/exFAT/network mounts report 1-2 s resolution, so a fresher
+# "old-looking" mtime could belong to an actively-growing file).
+_MTIME_TRUST_NS = 2_000_000_000
+
 
 class DStream:
     """A discretized stream: per-tick RDDs flowing through a
@@ -336,13 +342,23 @@ class StreamingContext:
         seen: set[str] = set()
         # A freshly listed file may still be mid-write; reading it
         # immediately would deliver it truncated AND mark it seen —
-        # silently dropping the tail. Deliver only once its
-        # (size, mtime) is unchanged across consecutive ticks AND the
-        # mtime is at least one batch_interval old. A writer that stalls
-        # longer than a tick mid-write can still race any polling
-        # watcher — the airtight pattern is an atomic rename into the
-        # directory (dot-prefixed temp name, like saveAsTextFiles), which
-        # this watcher delivers on its first settled tick.
+        # silently dropping the tail. Two settle rules, either suffices:
+        #
+        # 1. First-sighting by age: mtime at least one batch_interval old
+        #    AND older than _MTIME_TRUST_NS. The trust floor matters on
+        #    coarse-mtime filesystems (1-2 s granularity on ext3/exFAT/
+        #    some network mounts): a sub-second interval alone would read
+        #    an actively-growing file whose truncated mtime merely LOOKS
+        #    old. An atomically renamed-in file (the airtight producer
+        #    pattern — dot-prefixed temp name then rename, like
+        #    saveAsTextFiles) whose writes finished more than ~2 s ago is
+        #    delivered on the FIRST tick that sees it.
+        # 2. Two-tick signature: (size, mtime_ns) unchanged across
+        #    consecutive ticks AND mtime one interval old — catches fresh
+        #    files without waiting for the trust floor.
+        #
+        # A writer that stalls longer than a tick mid-write can still
+        # race any polling watcher; only the rename pattern is airtight.
         pending: dict[str, tuple[int, int]] = {}
 
         def poll() -> RDD | None:
@@ -365,22 +381,26 @@ class StreamingContext:
                 if not os.path.isfile(path):
                     seen.add(name)
                     continue
+                age_ns = now_ns - st.st_mtime_ns
                 sig = (st.st_size, st.st_mtime_ns)
-                if pending.get(name) == sig and now_ns - st.st_mtime_ns >= settle_ns:
-                    try:
-                        with open(path) as f:
-                            lines = [line.rstrip("\n") for line in f]
-                    except OSError:
-                        # Deleted/renamed between stat and open: a poll
-                        # exception would kill the whole scheduler, and
-                        # marking it seen would drop it if it reappears.
-                        pending.pop(name, None)
-                        continue
-                    seen.add(name)
-                    del pending[name]
-                    parts.append(lines)
-                else:
+                settled = age_ns >= max(settle_ns, _MTIME_TRUST_NS) or (
+                    pending.get(name) == sig and age_ns >= settle_ns
+                )
+                if not settled:
                     pending[name] = sig
+                    continue
+                try:
+                    with open(path) as f:
+                        lines = [line.rstrip("\n") for line in f]
+                except OSError:
+                    # Deleted/renamed between stat and open: a poll
+                    # exception would kill the whole scheduler, and
+                    # marking it seen would drop it if it reappears.
+                    pending.pop(name, None)
+                    continue
+                seen.add(name)
+                pending.pop(name, None)
+                parts.append(lines)
             return parts or None
 
         return self._add_source(poll)

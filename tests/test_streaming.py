@@ -78,8 +78,9 @@ def test_text_file_stream(tmp_path):
 
 
 def test_text_file_stream_slow_writer_not_truncated(tmp_path):
-    """A file caught mid-write must not be delivered truncated (the
-    watcher settles a file's (size, mtime) across two ticks first)."""
+    """A file caught mid-write must not be delivered truncated (a fresh
+    file is delivered only after its (size, mtime) signature holds
+    across consecutive ticks with the mtime a full interval old)."""
     ssc = StreamingContext(batch_interval=0.25)
     stream = ssc.textFileStream(str(tmp_path))
     out = _collect(ssc, stream)
@@ -94,6 +95,32 @@ def test_text_file_stream_slow_writer_not_truncated(tmp_path):
         time.sleep(0.05)
     ssc.stop()
     assert out == [[["1", "2"]]]
+
+
+def test_text_file_stream_settled_file_delivered_first_sighting(tmp_path):
+    """An atomically renamed-in file whose mtime is already old (the
+    documented airtight pattern) is delivered on the FIRST tick that
+    sees it — no extra settle-tick latency (round-3 advisor finding)."""
+    path = tmp_path / "renamed_in.txt"
+    path.write_text("x\n")
+    old = time.time() - 10
+    os.utime(path, (old, old))
+
+    ssc = StreamingContext(batch_interval=1.0)
+    stream = ssc.textFileStream(str(tmp_path))
+    out = _collect(ssc, stream)
+    t0 = time.time()
+    deadline = t0 + 10
+    while not out and time.time() < deadline:
+        time.sleep(0.02)
+    dt = time.time() - t0
+    ssc.stop()
+    assert out == [[["x"]]]
+    # The scheduler polls immediately on start (tick at ~0, then ~1.0
+    # with batch_interval=1.0), so first-sighting delivery lands at
+    # dt~0; a two-tick settle would deliver on the SECOND tick at
+    # dt~1.0. The bound must sit below that to discriminate.
+    assert dt < 0.9, f"delivered after {dt:.2f}s - settle added a tick?"
 
 
 def test_scheduler_error_ferried_to_await():
