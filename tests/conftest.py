@@ -30,6 +30,25 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite is compile-dominated (hundreds
+# of jit programs, most identical across runs), so cache XLA executables
+# on disk keyed by HLO hash. First run pays full compile; repeat runs —
+# the local iteration loop this exists for — skip it. Safe across code
+# changes (key = hash of the lowered program, not the Python source).
+# Subprocess nodes inherit the env var and share the cache.
+# Per-user path: a fixed /tmp name would break (or be poisonable) for
+# every user but the first on a shared machine.
+_cache_dir = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "tensorflowonspark_tpu",
+        "jax_test_compile_cache",
+    ),
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import pytest  # noqa: E402
 
 
