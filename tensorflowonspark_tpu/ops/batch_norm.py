@@ -82,7 +82,6 @@ def batch_norm_stats(x, impl: str = "auto") -> tuple[jax.Array, jax.Array]:
     return mean, var
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def bn_train(x, gamma, beta, eps, impl="auto"):
     """Train-mode BatchNorm: ``(y, mean, var)`` with exact batch stats.
 
@@ -94,7 +93,19 @@ def bn_train(x, gamma, beta, eps, impl="auto"):
     running-average update; cotangents flowing into them are IGNORED
     (their contribution to the normalize is already inside the dx
     formula — that is train-mode BN's semantics, not an approximation).
+
+    ``impl='auto'`` is resolved HERE, at forward-trace time, and the
+    resolved literal is what the custom-VJP rules see — so a backward
+    traced later (e.g. a ``jax.vjp`` callback after ambient state
+    changed) can never pair a Pallas forward with an XLA backward or
+    vice versa.
     """
+    resolved = "pallas" if bn_kernels.use_pallas(impl) else "xla"
+    return _bn_train(x, gamma, beta, eps, resolved)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(x, gamma, beta, eps, impl):
     y, mean, var, _ = _bn_train_fwd_impl(x, gamma, beta, eps, impl)
     return y, mean, var
 
@@ -117,6 +128,7 @@ def _bn_train_fwd(x, gamma, beta, eps, impl):
 
 
 def _bn_train_bwd(eps, impl, res, cts):
+    # impl is the literal bn_train resolved at forward-trace time.
     dy, _dmean, _dvar = cts  # stats cotangents ignored — see bn_train.
     x, gamma, mean, invstd = res
     n = _reduce_extent(x)
@@ -145,7 +157,7 @@ def _bn_train_bwd(eps, impl, res, cts):
     return dx, dgamma, dbeta
 
 
-bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
 
 
 def fused_batch_norm(x, gamma, beta, eps, impl: str = "auto"):

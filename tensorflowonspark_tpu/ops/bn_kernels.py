@@ -137,7 +137,18 @@ def cross_stats(dy: jax.Array, x: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 
 def use_pallas(impl: str = "auto") -> bool:
-    """'pallas' | 'xla' | 'auto' (pallas on TPU backends)."""
+    """'pallas' | 'xla' | 'auto'.
+
+    'auto' picks Pallas only on a SINGLE-device TPU process: with more
+    than one device visible, activations may be GSPMD-sharded (the
+    repo's conv-net train path shards the batch via NamedSharding with
+    no ambient-mesh marker to key on), and GSPMD cannot partition a
+    pallas_call — it would replicate the operands, all-gathering the
+    full activation per BN layer. The sibling ``jnp.sum`` reduces
+    partition into per-shard sums + psum for free, so multi-device
+    'auto' takes that path. Explicit impl='pallas' overrides — callers
+    doing their own shard_map placement know the operands are local.
+    """
     if impl == "pallas":
         return True
     if impl == "xla":
@@ -145,6 +156,6 @@ def use_pallas(impl: str = "auto") -> bool:
     if impl != "auto":
         raise ValueError(f"impl must be pallas|xla|auto, got {impl!r}")
     try:
-        return jax.default_backend() == "tpu"
+        return jax.default_backend() == "tpu" and len(jax.devices()) == 1
     except RuntimeError:  # pragma: no cover - no backend at all
         return False

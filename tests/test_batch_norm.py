@@ -201,6 +201,23 @@ def test_fused_batch_norm_pallas_matches_xla_path(pallas_interpret):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4, rtol=1e-4)
 
 
+def test_use_pallas_auto_requires_single_device_tpu(monkeypatch):
+    """'auto' must fall back to the XLA reduces whenever more than one
+    device is visible: the conv-net train path shards the batch via
+    NamedSharding (no ambient-mesh marker), and GSPMD cannot partition a
+    pallas_call over sharded activations (it would all-gather them),
+    while sibling jnp.sums partition into shard sums + psum for free."""
+    monkeypatch.setattr(bn_kernels.jax, "default_backend", lambda: "tpu")
+    # This suite runs with 8 virtual devices -> activations may be sharded.
+    assert len(bn_kernels.jax.devices()) > 1
+    assert bn_kernels.use_pallas("auto") is False
+    assert bn_kernels.use_pallas("pallas") is True  # explicit overrides
+
+    monkeypatch.setattr(bn_kernels.jax, "devices", lambda: [object()])
+    assert bn_kernels.use_pallas("auto") is True  # single-device TPU
+    assert bn_kernels.use_pallas("xla") is False
+
+
 def test_module_stats_computed_once_not_via_cse():
     """The module passes one set of stats to both the normalize and the
     running-average update; the HLO of a train-mode apply must contain
