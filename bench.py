@@ -38,12 +38,25 @@ MFU_TARGET = 0.40  # BASELINE.md acceptance threshold
 
 # The tunneled TPU backend in this environment dials a loopback relay on
 # these ports; when the relay is down, jax backend init blocks forever in
-# epoll. Probing /proc/net/tcp for LISTEN sockets is purely passive (the
-# relay tolerates exactly one dialer, so never probe by connecting, and
-# never probe via a jax process), costs milliseconds, and lets a red run
-# fail fast and diagnosably instead of burning the whole watchdog budget.
+# epoll. Two-stage gate: (1) a purely passive /proc/net/tcp LISTEN scan
+# (milliseconds) catches a DOWN relay; (2) since a WEDGED session keeps
+# its ports listening while every dial hangs, a single short-lived
+# subprocess dial (_relay_dial_probe) then distinguishes healthy from
+# wedged. The relay tolerates exactly ONE dialer at a time — the probe
+# is safe because it runs sequentially and exits before the main process
+# dials (the same one-after-another pattern the relay-window scripts
+# use); CONCURRENT dials are what wedge a session.
 RELAY_PORTS = range(8082, 8118)
 RELAY_MARKER = "/root/.relay.py"  # present only in the tunneled-TPU image
+
+# Where to send the driver when this run can't measure: the banked
+# relay-window captures and the script that re-runs everything pending.
+BANKED_POINTER = (
+    "Driver-format capture from the round-4 window: 57.5% MFU "
+    "(benchmarks/results/round4_window1.jsonl; round-3 window concurred "
+    "at 57.0%). benchmarks/run_round4_resume.sh batches every "
+    "still-pending measurement for the next healthy window."
+)
 
 
 def _relay_ports_listening() -> int:
@@ -102,9 +115,7 @@ def _watchdog():
                 "error": f"watchdog: incomplete after {WATCHDOG_SECS}s "
                 "(backend init or compile wedged? a relay whose ports "
                 "listen but whose remote orchestrator is down wedges "
-                "the first backend touch). Driver-format capture from "
-                "round 3's relay window: 57.0% MFU "
-                "(benchmarks/results/round3_window1.jsonl, line 1).",
+                "the first backend touch). " + BANKED_POINTER,
                 **{k: v for k, v in _partial.items() if k != "mfu_pct"},
             }
         )
@@ -236,15 +247,59 @@ def _bench_mnist_feed(steps: int = 40) -> None:
     )
 
 
+def _relay_dial_probe(timeout: float = 180.0) -> tuple[bool, str]:
+    """One short-lived subprocess dial: (ok, detail). ok=True iff jax
+    backend init completes. Distinguishes a HEALTHY relay from a
+    listening-but-WEDGED session (ports stay open while every dial hangs
+    in epoll — the state a killed/timed-out dialer leaves behind;
+    observed in the round-3 and round-4 windows). Sequential clean dials
+    are safe — the relay-window scripts run one interpreter after
+    another this way; the probe exits before the main process dials.
+
+    On timeout the child gets SIGTERM + a grace period (not SIGKILL) so
+    a merely-slow dialer can close its connection cleanly; if the
+    session was healthy-but-slow this minimizes the chance the probe
+    itself leaves the wedge it is testing for. Healthy init completes in
+    seconds (round-4 window: full bench incl. compile in ~2 min), so the
+    timeout has a wide margin, and the probe's cost fits the ~300s of
+    watchdog budget the benchmark run leaves unused.
+    """
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+    )
+    try:
+        _, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+        return False, f"probe dial hung for {timeout:.0f}s"
+    if proc.returncode == 0:
+        return True, ""
+    tail = (err or b"").decode(errors="replace").strip().splitlines()[-3:]
+    return False, (
+        f"probe dial exited rc={proc.returncode}: " + " | ".join(tail)
+    )
+
+
 def main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
 
-    # Fail fast and diagnosably when the TPU relay is down: in that state
-    # the first backend touch (jax.devices()) wedges forever in epoll and
-    # the only output would be the watchdog's opaque "incomplete" 510s
-    # later. Pure-CPU images (no relay marker) proceed — there is no
-    # backend that can wedge there. BENCH_ALLOW_CPU=1 overrides for
-    # debugging on a relay-equipped image without touching the chip.
+    # Fail fast and diagnosably when the TPU relay is down or wedged: in
+    # either state the first backend touch (jax.devices()) blocks forever
+    # in epoll and the only output would be the watchdog's opaque
+    # "incomplete" 510s later. Pure-CPU images (no relay marker) proceed —
+    # there is no backend that can wedge there. BENCH_ALLOW_CPU=1
+    # overrides for debugging on a relay-equipped image without touching
+    # the chip.
     if os.path.exists(RELAY_MARKER) and not os.environ.get("BENCH_ALLOW_CPU"):
         ports = _relay_ports_listening()
         _partial["relay_ports_listening"] = ports
@@ -258,14 +313,24 @@ def main() -> None:
                     "error": "relay_unreachable: no TPU relay ports "
                     f"listening on 127.0.0.1:{RELAY_PORTS.start}-"
                     f"{RELAY_PORTS.stop - 1}; backend init would wedge. "
-                    "Driver-format capture from round 3's relay window: "
-                    "57.0% MFU (benchmarks/results/round3_window1.jsonl). "
-                    "The relay stayed down through ALL of round 4; "
-                    "benchmarks/run_round4.sh batches this headline plus "
-                    "every pending measurement (fused-BN conv nets, "
-                    "seq-4096 A/B, profiles, engine tax, prefix TTFT, "
-                    "int8-KV and windowed A/Bs) for the first window "
-                    "that opens.",
+                    + BANKED_POINTER,
+                    **_partial,
+                }
+            )
+            raise SystemExit(3)
+        ok, detail = _relay_dial_probe()
+        if not ok:
+            _emit(
+                {
+                    "metric": "llama1b_train_mfu",
+                    "value": 0,
+                    "unit": "%",
+                    "vs_baseline": 0.0,
+                    "error": f"relay_wedged: ports are listening but the "
+                    f"dial probe failed ({detail}) — typically a "
+                    "previously killed/timed-out dialer's grant that has "
+                    "not expired, so backend init would block forever. "
+                    + BANKED_POINTER,
                     **_partial,
                 }
             )
