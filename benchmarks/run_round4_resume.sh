@@ -39,6 +39,11 @@ run() {
 run 900 python benchmarks/real_chip.py --config llama1b --seq 4096 --moments bf16
 run 900 python benchmarks/real_chip.py --config llama1b --seq 4096 \
   --logit-chunk 512 --moments bf16
+# coarser chunk: round 3 saw chunk=512 COST ~2 MFU points (the scan
+# serializes the logits matmul); 1024 halves the serialization while
+# still bounding logits memory at 1/4 of the full (B,S,V) tensor
+run 900 python benchmarks/real_chip.py --config llama1b --seq 4096 \
+  --logit-chunk 1024 --moments bf16
 
 # 5. Profile the headline config: where do the non-MXU 43% go?
 #    (--remat none: bench.py's 57.5% headline config, NOT the 45% full-
