@@ -111,5 +111,9 @@ if __name__ == "__main__":
     model.export_fn = export_fn
     model.args.input_mapping = {"image": "x"}
     model.args.output_mapping = {"prediction": "pred"}
-    preds = model.transform(records[:16])
+    # Transform consumes feature-only records: the mapping must name
+    # every tuple field in order (feed/datafeed.py's column contract),
+    # so strip the labels rather than mapping a 2-field record with one
+    # column.
+    preds = model.transform([(image,) for image, _label in records[:16]])
     print("sample predictions:", [int(r["pred"]) for r in preds])
