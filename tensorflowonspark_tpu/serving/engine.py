@@ -55,7 +55,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tensorflowonspark_tpu.models.llama import Llama, sample_logits
+from tensorflowonspark_tpu.models.llama import Llama
 
 logger = logging.getLogger(__name__)
 
@@ -65,23 +65,55 @@ class EngineOverloaded(RuntimeError):
     full — callers should shed load (HTTP 503), not block."""
 
 
-def _sample_rows(logits, key, temps, top_k, top_p):
-    """Per-row-temperature sampling over (B, vocab) logits.
+def _sample_rows(logits, key, temps, kps):
+    """Per-row sampling over (B, vocab) logits.
 
-    ``temps`` (B,) is a TRACED input — per-request temperature costs no
-    recompilation (unlike top_k/top_p, whose shapes are static and stay
-    engine-wide). A row with ``temps == 0`` is greedy; a sampled row
-    truncates by the engine's top_k/top_p on its temperature-scaled
-    distribution (nucleus-on-scaled, matching the standard stacks).
+    ``temps`` (B,) and ``kps`` (B, 2) are TRACED inputs — per-request
+    temperature, top_k (``kps[:, 0]``) and top_p (``kps[:, 1]``) cost no
+    recompilation. The truncation shapes don't depend on the VALUES
+    (top-k compares sorted rank against k; top-p thresholds a cumsum),
+    so one compiled program serves every mix. A row with ``temps == 0``
+    is greedy; a sampled row truncates on its temperature-scaled
+    distribution (nucleus-on-scaled, matching the standard stacks),
+    top-k first, then top-p renormalized over the k survivors. Rows
+    encode "disabled" as ``k = vocab`` / ``p = 1.0`` (the resolver in
+    the engine maps None through the engine-wide defaults to these).
+
+    The mask runs under ``lax.cond`` on "any row truncates": greedy and
+    plain-temperature batches — the benchmarked configs — skip the
+    full-vocab sort entirely, so supporting per-request truncation
+    costs them nothing.
 
     Returns ``(tokens (B,) int32, logprobs (B,) fp32)`` — the logprob
     of each chosen token under the RAW (unscaled) model distribution,
     the same convention the /score surface reports, so sampled and
     scored numbers compare directly.
     """
+    vocab = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    sampled = sample_logits(scaled, key, 1.0, top_k, top_p)
+    ks, ps = kps[:, 0], kps[:, 1]
+
+    def _truncate(lg):
+        sorted_desc = jnp.flip(jnp.sort(lg, axis=-1), axis=-1)
+        rank = jnp.arange(vocab, dtype=jnp.float32)[None, :]
+        kept = jnp.where(rank < ks[:, None], sorted_desc, -jnp.inf)
+        cum = jnp.cumsum(jax.nn.softmax(kept, axis=-1), axis=-1)
+        # Last kept rank: everything before cumulative mass reaches
+        # top_p, always >= 0 (the most likely token survives even when
+        # it alone exceeds p) and always < k (a p of ~1.0 must not walk
+        # into the -inf tail, whose cumsum plateaus just under 1.0 in
+        # floating point, and then keep MORE than k tokens).
+        cutoff_index = jnp.sum(cum < ps[:, None], axis=-1, keepdims=True)
+        cutoff_index = jnp.minimum(
+            cutoff_index, (ks[:, None] - 1).astype(jnp.int32)
+        )
+        cutoff = jnp.take_along_axis(kept, cutoff_index, axis=-1)
+        return jnp.where(lg < cutoff, -jnp.inf, lg)
+
+    need = jnp.any((ks < vocab) | (ps < 1.0))
+    trunc = jax.lax.cond(need, _truncate, lambda lg: lg, scaled)
+    sampled = jax.random.categorical(key, trunc).astype(jnp.int32)
     tok = jnp.where(temps > 0, sampled, greedy)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     lp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
@@ -94,6 +126,8 @@ class _Pending:
     max_new_tokens: int
     event: threading.Event
     temperature: float | None = None  # None = the engine-wide default
+    top_k: int | None = None  # None = the engine-wide default
+    top_p: float | None = None  # None = the engine-wide default
     eos_id: int | None = None  # None = the engine-wide default
     adapter: int = 0  # MultiLoraTensor bank slot (0 = base model)
     # multi-token stop sequences (host-side tail match; the matched
@@ -185,6 +219,7 @@ class _PrefillJob:
     next_pos: int  # next chunk's start offset into the prompt
     length: int
     temp_1: object  # (1,) fp32
+    kp_1: object  # (1, 2) fp32 resolved [top_k, top_p]
     ad_1: object  # (1,) int32 adapter id
     # next prompt depth at which to store a chunk-boundary prefix entry
     # (doubles after each insert — see _advance_job)
@@ -264,11 +299,12 @@ class ContinuousBatcher:
 
     ``submit(tokens, max_new_tokens)`` blocks the calling thread until
     that request's completion is ready (server handler threads call it
-    concurrently). Greedy by default. ``temperature`` is PER-REQUEST
-    (the constructor value is just the default): it rides the compiled
-    step as a traced per-row input, so mixing greedy and sampled rows
-    in one batch costs no recompilation. ``top_k``/``top_p`` stay
-    engine-wide — their truncation shapes are trace-time constants.
+    concurrently). Greedy by default. ``temperature``, ``top_k`` and
+    ``top_p`` are PER-REQUEST (the constructor values are just the
+    defaults): they ride the compiled step as traced per-row inputs, so
+    mixing greedy, sampled, and differently-truncated rows in one batch
+    costs no recompilation (see ``_sample_rows`` — batches with no
+    truncation active skip the sort entirely).
 
     ``prompt_widths``: prompts are right-padded to the smallest listed
     width (one prefill compilation each). A prompt longer than the
@@ -370,6 +406,18 @@ class ContinuousBatcher:
         self._temperature = float(temperature)
         self._top_k = None if top_k is None else int(top_k)
         self._top_p = None if top_p is None else float(top_p)
+        # The engine-wide defaults feed _resolve_kp exactly like request
+        # values do, so they get the same validity check — a top_k=0
+        # default would otherwise silently DISABLE truncation (rank < 0
+        # keeps nothing; the cutoff clamp then keeps everything).
+        if self._top_k is not None and self._top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if self._top_p is not None and not (
+            math.isfinite(self._top_p) and 0 < self._top_p <= 1
+        ):
+            raise ValueError(
+                f"top_p must be finite and in (0, 1], got {top_p}"
+            )
         self._eos_id = None if eos_id is None else int(eos_id)
         self._key = jax.random.PRNGKey(seed)
 
@@ -458,7 +506,21 @@ class ContinuousBatcher:
         temperature: float | None,
         adapter: int | None = None,
         stop: "list[list[int]] | None" = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
     ) -> None:
+        if top_k is not None and (not isinstance(top_k, int) or top_k < 1):
+            raise ValueError(f"top_k must be an int >= 1, got {top_k!r}")
+        if top_p is not None and not (
+            isinstance(top_p, (int, float))
+            and math.isfinite(top_p)
+            and 0 < top_p <= 1
+        ):
+            # NaN fails every comparison; an explicit finite-and-in-range
+            # check rejects it instead of silently disabling truncation
+            raise ValueError(
+                f"top_p must be finite and in (0, 1], got {top_p!r}"
+            )
         if stop:
             if len(stop) > 16:
                 # the tail match runs per decoded token inside the
@@ -531,13 +593,16 @@ class ContinuousBatcher:
         eos_id: int | None = None,
         adapter: int | None = None,
         stop: "list[list[int]] | None" = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
     ) -> list[_Pending]:
         """Validate then enqueue a group ATOMICALLY: either every row is
         accepted or none is — a partially admitted multi-row request
         would burn slots on work the client then discards on its 503."""
         for tokens, _ in requests:
             self._validate(
-                tokens, max_new_tokens, temperature, adapter, stop
+                tokens, max_new_tokens, temperature, adapter, stop,
+                top_k, top_p,
             )
         ps = [
             _Pending(
@@ -545,6 +610,8 @@ class ContinuousBatcher:
                 int(max_new_tokens),
                 threading.Event(),
                 temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
                 eos_id=eos_id,
                 adapter=int(adapter or 0),
                 stop=tuple(tuple(q) for q in (stop or ())),
@@ -588,10 +655,12 @@ class ContinuousBatcher:
         eos_id: int | None = None,
         adapter: int | None = None,
         stop: "list[list[int]] | None" = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
     ) -> _Pending:
         return self._enqueue_all(
             [(tokens, sink)], max_new_tokens, temperature, eos_id,
-            adapter, stop,
+            adapter, stop, top_k, top_p,
         )[0]
 
     def submit(
@@ -603,12 +672,15 @@ class ContinuousBatcher:
         return_logprobs: bool = False,
         adapter: int | None = None,
         stop: "list[list[int]] | None" = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
     ) -> "list[int] | tuple[list[int], list[float]]":
-        """Blocking decode. ``temperature`` and ``eos_id`` override the
-        engine-wide defaults FOR THIS REQUEST (temperature is a traced
-        per-row input — no recompilation; 0 = greedy; eos is host-side
+        """Blocking decode. ``temperature``, ``top_k``, ``top_p`` and
+        ``eos_id`` override the engine-wide defaults FOR THIS REQUEST
+        (the sampling knobs are traced per-row inputs — no
+        recompilation; temperature 0 = greedy; eos is host-side
         retirement bookkeeping, a NEGATIVE value disables EOS stopping
-        entirely for this request). top_k/top_p stay engine-wide.
+        entirely for this request).
         ``return_logprobs``: also return each emitted token's logprob
         under the raw model distribution (the /score convention).
         ``adapter`` selects the row's MultiLoraTensor bank slot when the
@@ -617,6 +689,7 @@ class ContinuousBatcher:
         p = self._enqueue(
             tokens, max_new_tokens, temperature=temperature,
             eos_id=eos_id, adapter=adapter, stop=stop,
+            top_k=top_k, top_p=top_p,
         )
         p.event.wait()
         if p.error is not None:
@@ -634,6 +707,8 @@ class ContinuousBatcher:
         return_logprobs: bool = False,
         adapter: int | None = None,
         stop: "list[list[int]] | None" = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
     ) -> "list[list[int]] | tuple[list[list[int]], list[list[float]]]":
         """Blocking decode of several prompts admitted ATOMICALLY (all
         rows accepted or an EngineOverloaded/ValueError before any row
@@ -646,6 +721,8 @@ class ContinuousBatcher:
             eos_id,
             adapter,
             stop,
+            top_k,
+            top_p,
         )
         for p in ps:
             p.event.wait()
@@ -665,6 +742,8 @@ class ContinuousBatcher:
         yield_logprobs: bool = False,
         adapter: int | None = None,
         stop: "list[list[int]] | None" = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
     ):
         """Yield completion tokens AS THEY DECODE (one engine step of
         latency each) instead of blocking for the full result.
@@ -687,6 +766,8 @@ class ContinuousBatcher:
             eos_id=eos_id,
             adapter=adapter,
             stop=stop,
+            top_k=top_k,
+            top_p=top_p,
         )
 
         # An explicit iterator, NOT a generator: close() on a
@@ -861,12 +942,11 @@ class ContinuousBatcher:
 
     @functools.cached_property
     def _step_fn(self):
-        top_k, top_p = self._top_k, self._top_p
         model = self._model
         constrain = self._constrain_cache
 
         @jax.jit
-        def step(params, cache, tok, pos, temps, ads, key):
+        def step(params, cache, tok, pos, temps, ads, kps, key):
             logits, updated = model.apply(
                 {"params": params, "cache": cache},
                 tok[:, None],
@@ -882,9 +962,7 @@ class ContinuousBatcher:
             # host fetch that rides the existing token fetch — cheap
             # enough to keep unconditional rather than doubling the
             # compiled-variant count.
-            nxt, lp = _sample_rows(
-                logits[:, -1], key, temps, top_k, top_p
-            )
+            nxt, lp = _sample_rows(logits[:, -1], key, temps, kps)
             # Clamp so a retired-but-not-yet-reused row parked at the
             # cache edge never scatters out of bounds (its writes are
             # garbage either way; admission overwrites the whole row).
@@ -900,12 +978,11 @@ class ContinuousBatcher:
         cached = self._prefill_cache.get(width)
         if cached is not None:
             return cached
-        top_k, top_p = self._top_k, self._top_p
         model = self._model
         constrain = self._constrain_cache
 
         @jax.jit
-        def prefill(params, prompt, length, temps, ads, key):
+        def prefill(params, prompt, length, temps, ads, kps, key):
             positions = jnp.arange(width, dtype=jnp.int32)[None, :]
             logits, state = model.apply(
                 {"params": params},
@@ -919,7 +996,7 @@ class ContinuousBatcher:
             last = jnp.take_along_axis(
                 logits, (length - 1)[:, None, None], axis=1
             )[:, 0]
-            tok, lp = _sample_rows(last, key, temps, top_k, top_p)
+            tok, lp = _sample_rows(last, key, temps, kps)
             return constrain(state["cache"]), tok, length, lp
 
         self._prefill_cache[width] = prefill
@@ -932,7 +1009,7 @@ class ContinuousBatcher:
         @jax.jit
         def admit(
             cache_b, cache_1, row, tok_b, tok_1, pos_b, pos_1,
-            temps_b, temp_1, ads_b, ad_1,
+            temps_b, temp_1, ads_b, ad_1, kps_b, kp_1,
         ):
             def scatter(leaf_b, leaf_1):
                 if leaf_b.ndim == 0:  # per-layer scalar write index:
@@ -947,7 +1024,8 @@ class ContinuousBatcher:
             pos = jax.lax.dynamic_update_slice(pos_b, pos_1, (row,))
             temps = jax.lax.dynamic_update_slice(temps_b, temp_1, (row,))
             ads = jax.lax.dynamic_update_slice(ads_b, ad_1, (row,))
-            return cache, tok, pos, temps, ads
+            kps = jax.lax.dynamic_update_slice(kps_b, kp_1, (row, 0))
+            return cache, tok, pos, temps, ads, kps
 
         return admit
 
@@ -976,14 +1054,12 @@ class ContinuousBatcher:
 
     @functools.cached_property
     def _sample1_fn(self):
-        top_k, top_p = self._top_k, self._top_p
-
         @jax.jit
-        def sample1(logits_chunk, idx, temps, key):
+        def sample1(logits_chunk, idx, temps, kps, key):
             last = jax.lax.dynamic_index_in_dim(
                 logits_chunk, idx, axis=1, keepdims=False
             )  # (1, vocab): the prompt's true last position
-            return _sample_rows(last, key, temps, top_k, top_p)
+            return _sample_rows(last, key, temps, kps)
 
         return sample1
 
@@ -1041,13 +1117,14 @@ class ContinuousBatcher:
             next_pos=resume,
             length=len(p.tokens),
             temp_1=jnp.asarray([temp], jnp.float32),
+            kp_1=self._resolve_kp(p),
             ad_1=jnp.asarray([p.adapter], jnp.int32),
             # first boundary entry lands at the first chunk boundary
             # past the resume point, then depths double
             next_insert_depth=self._prefill_chunk or 0,
         )
 
-    def _advance_job(self, cache, tok, pos, temps, ads):
+    def _advance_job(self, cache, tok, pos, temps, ads, kps):
         """Run ONE chunk of the in-flight prefill; on the final chunk,
         sample the first token and scatter the row into the batch.
         Chunks cover only the true prompt length — the padding region a
@@ -1056,7 +1133,7 @@ class ContinuousBatcher:
         if job.p.cancelled:
             self._resolve_unadmitted_cancel(job.p)
             self._job = None
-            return cache, tok, pos, temps, ads
+            return cache, tok, pos, temps, ads, kps
         c = self._prefill_chunk
         # Shift the window back rather than letting positions run past
         # max_seq_len: a final chunk starting at `start` would scatter
@@ -1105,7 +1182,7 @@ class ContinuousBatcher:
                 )
                 job.next_insert_depth = 2 * job.next_pos
                 job.boundary_inserts += 1
-            return cache, tok, pos, temps, ads
+            return cache, tok, pos, temps, ads, kps
         if self._prefix_store is not None:
             # The completed single-row cache covers the whole prompt.
             self._prefix_store.insert(
@@ -1116,9 +1193,10 @@ class ContinuousBatcher:
             logits,
             jnp.int32(job.length - 1 - start_w),
             job.temp_1,
+            job.kp_1,
             self._next_key(),
         )
-        cache, tok, pos, temps, ads = self._admit_fn(
+        cache, tok, pos, temps, ads, kps = self._admit_fn(
             cache,
             job.cache_1,
             jnp.int32(job.row),
@@ -1130,6 +1208,8 @@ class ContinuousBatcher:
             job.temp_1,
             ads,
             job.ad_1,
+            kps,
+            job.kp_1,
         )
         first = int(np.asarray(tok_1)[0])
         lps = [float(np.asarray(lp_1)[0])]
@@ -1139,7 +1219,7 @@ class ContinuousBatcher:
         if self._finished(job.p, [first], first):
             self._retire(job.row)
         self._job = None
-        return cache, tok, pos, temps, ads
+        return cache, tok, pos, temps, ads, kps
 
     # -- engine loop ---------------------------------------------------
 
@@ -1170,7 +1250,37 @@ class ContinuousBatcher:
         pos = jnp.zeros((b,), jnp.int32)
         temps = jnp.zeros((b,), jnp.float32)
         ads = jnp.zeros((b,), jnp.int32)  # adapter slot 0 = base
-        return cache, tok, pos, temps, ads
+        # per-row [top_k, top_p], truncation disabled (k=vocab, p=1):
+        # parked rows must not flip _sample_rows' any-row-truncates cond
+        kps = jnp.tile(
+            jnp.asarray(
+                [[float(self._model.cfg.vocab_size), 1.0]], jnp.float32
+            ),
+            (b, 1),
+        )
+        return cache, tok, pos, temps, ads, kps
+
+    def _resolve_kp(self, p: _Pending):
+        """(1, 2) fp32 resolved [top_k, top_p] for one request: the
+        request value, else the engine-wide default, else disabled
+        (k = vocab / p = 1.0 — the identity values in _sample_rows).
+
+        A row whose EFFECTIVE temperature is 0 decodes greedily —
+        _sample_rows discards its sampled token — so it resolves to
+        disabled outright: otherwise an all-greedy batch on an engine
+        with default truncation would flip the any-row-truncates cond
+        and pay the full-vocab sort for nothing."""
+        vocab = self._model.cfg.vocab_size
+        temp = (
+            self._temperature if p.temperature is None else p.temperature
+        )
+        if temp <= 0:
+            return jnp.asarray([[float(vocab), 1.0]], jnp.float32)
+        k = p.top_k if p.top_k is not None else self._top_k
+        k = vocab if k is None else min(int(k), vocab)
+        q = p.top_p if p.top_p is not None else self._top_p
+        q = 1.0 if q is None else float(q)
+        return jnp.asarray([[float(k), q]], jnp.float32)
 
     def _bucket(self, n: int) -> int:
         for w in self._widths:
@@ -1183,7 +1293,7 @@ class ContinuousBatcher:
         return sub
 
     def _admit_one(
-        self, p: _Pending, row: int, cache, tok, pos, temps, ads
+        self, p: _Pending, row: int, cache, tok, pos, temps, ads, kps
     ):
         w = self._bucket(len(p.tokens))
         prompt = np.zeros((1, w), np.int32)
@@ -1194,6 +1304,7 @@ class ContinuousBatcher:
             else float(p.temperature)
         )
         temp_1 = jnp.asarray([temp], jnp.float32)
+        kp_1 = self._resolve_kp(p)
         ad_1 = jnp.asarray([p.adapter], jnp.int32)
         cache_1, tok_1, pos_1, lp_1 = self._prefill_fn(w)(
             self._params,
@@ -1201,11 +1312,12 @@ class ContinuousBatcher:
             jnp.asarray([len(p.tokens)], jnp.int32),
             temp_1,
             ad_1,
+            kp_1,
             self._next_key(),
         )
-        cache, tok, pos, temps, ads = self._admit_fn(
+        cache, tok, pos, temps, ads, kps = self._admit_fn(
             cache, cache_1, jnp.int32(row), tok, tok_1, pos, pos_1,
-            temps, temp_1, ads, ad_1,
+            temps, temp_1, ads, ad_1, kps, kp_1,
         )
         first = int(np.asarray(tok_1)[0])
         out = [first]
@@ -1215,7 +1327,7 @@ class ContinuousBatcher:
         p.emit(first, lps[0])
         if self._finished(p, out, first):
             self._retire(row)
-        return cache, tok, pos, temps, ads
+        return cache, tok, pos, temps, ads, kps
 
     def _finished(self, p: _Pending, out: list[int], last: int) -> bool:
         if p.cancelled:
@@ -1303,7 +1415,7 @@ class ContinuousBatcher:
             self._fail_one(item, RuntimeError("engine shutting down"))
 
     def _loop(self) -> None:
-        cache = tok = pos = temps = ads = None
+        cache = tok = pos = temps = ads = kps = None
         try:
             while True:
                 if self._stop_now.is_set():
@@ -1353,10 +1465,15 @@ class ContinuousBatcher:
                         continue
                     self._inflight = item
                     if cache is None:
-                        cache, tok, pos, temps, ads = self._empty_state()
+                        (
+                            cache, tok, pos, temps, ads, kps,
+                        ) = self._empty_state()
                     if self._prefill_chunk is None:
-                        cache, tok, pos, temps, ads = self._admit_one(
-                            item, free[0], cache, tok, pos, temps, ads
+                        (
+                            cache, tok, pos, temps, ads, kps,
+                        ) = self._admit_one(
+                            item, free[0], cache, tok, pos, temps, ads,
+                            kps,
                         )
                     else:
                         self._job = self._start_job(item, free[0])
@@ -1364,15 +1481,17 @@ class ContinuousBatcher:
                     idle = False
 
                 if self._job is not None:
-                    cache, tok, pos, temps, ads = self._advance_job(
-                        cache, tok, pos, temps, ads
+                    (
+                        cache, tok, pos, temps, ads, kps,
+                    ) = self._advance_job(
+                        cache, tok, pos, temps, ads, kps
                     )
 
                 if all(e is None for e in self._live):
                     continue  # nothing decoding; admit/chunk again
 
                 cache, tok, pos, lp = self._step_fn(
-                    self._params, cache, tok, pos, temps, ads,
+                    self._params, cache, tok, pos, temps, ads, kps,
                     self._next_key(),
                 )
                 self.steps += 1

@@ -196,6 +196,8 @@ class _Handler(BaseHTTPRequestHandler):
             adapter = payload.get("adapter")
             stop = payload.get("stop")
             n_samples = payload.get("n")
+            req_top_k = payload.get("top_k")
+            req_top_p = payload.get("top_p")
             want_logprobs = bool(payload.get("logprobs"))
             if (
                 temperature is not None
@@ -204,13 +206,15 @@ class _Handler(BaseHTTPRequestHandler):
                 or adapter is not None
                 or stop is not None
                 or n_samples is not None
+                or req_top_k is not None
+                or req_top_p is not None
                 or want_logprobs
             ) and self.gen_engine is None:
                 raise ValueError(
                     "per-request temperature/max_new_tokens/eos_id/"
-                    "adapter/stop/n/logprobs require --gen-engine "
-                    "continuous (the fixed path bakes decode params at "
-                    "startup)"
+                    "adapter/stop/n/top_k/top_p/logprobs require "
+                    "--gen-engine continuous (the fixed path bakes "
+                    "decode params at startup)"
                 )
             if temperature is not None:
                 temperature = float(temperature)
@@ -228,6 +232,10 @@ class _Handler(BaseHTTPRequestHandler):
                 adapter = int(adapter)
             if stop is not None:
                 stop = [[int(t) for t in seq] for seq in stop]
+            if req_top_k is not None:
+                req_top_k = int(req_top_k)
+            if req_top_p is not None:
+                req_top_p = float(req_top_p)
             if n_samples is not None:
                 n_samples = int(n_samples)
                 if not 1 <= n_samples <= 16:
@@ -276,7 +284,7 @@ class _Handler(BaseHTTPRequestHandler):
         if stream:
             self._engine_stream(
                 prompts[0], temperature, max_new, eos_id, want_logprobs,
-                adapter, stop,
+                adapter, stop, req_top_k, req_top_p,
             )
             return
         from tensorflowonspark_tpu.serving import EngineOverloaded
@@ -289,7 +297,8 @@ class _Handler(BaseHTTPRequestHandler):
                     fan = [p for p in prompts for _ in range(n)]
                     completions = self._engine_generate(
                         fan, temperature, max_new, eos_id,
-                        want_logprobs, adapter, stop,
+                        want_logprobs, adapter, stop, req_top_k,
+                        req_top_p,
                     )
                     if want_logprobs:
                         completions, logprobs = completions
@@ -345,6 +354,8 @@ class _Handler(BaseHTTPRequestHandler):
         want_logprobs=False,
         adapter=None,
         stop=None,
+        top_k=None,
+        top_p=None,
     ) -> None:
         """Stream one completion as newline-delimited JSON: a
         ``{"token": t}`` line per decoded token (one engine step of
@@ -363,6 +374,8 @@ class _Handler(BaseHTTPRequestHandler):
                 yield_logprobs=want_logprobs,
                 adapter=adapter,
                 stop=stop,
+                top_k=top_k,
+                top_p=top_p,
             )
         except EngineOverloaded as e:
             self._reply(503, {"error": str(e)}, {"Retry-After": "1"})
@@ -426,6 +439,8 @@ class _Handler(BaseHTTPRequestHandler):
         want_logprobs=False,
         adapter=None,
         stop=None,
+        top_k=None,
+        top_p=None,
     ):
         """Continuous-batching path: the request's rows are admitted
         ATOMICALLY (all accepted, or a 400/503 before any decodes — a
@@ -440,6 +455,8 @@ class _Handler(BaseHTTPRequestHandler):
             return_logprobs=want_logprobs,
             adapter=adapter,
             stop=stop,
+            top_k=top_k,
+            top_p=top_p,
         )
 
 

@@ -418,6 +418,20 @@ def test_serve_model_continuous_engine(tmp_path):
         assert code == 200
         assert body["completions"] == [full[:1]]
 
+        # per-request sampling truncation: top_k=1 is argmax at every
+        # step, so even at temperature 0.9 it matches the greedy decode
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[2, 4]], "temperature": 0.9, "top_k": 1},
+        )
+        assert code == 200, body
+        assert body["completions"] == [full]
+        # invalid truncation params are a 400, engine-validated
+        code, body = _post(
+            port, "/generate", {"prompts": [[2, 4]], "top_p": 0}
+        )
+        assert code == 400 and "top_p" in body["error"]
+
         # scheduler observability
         import urllib.request
 
@@ -427,8 +441,9 @@ def test_serve_model_continuous_engine(tmp_path):
             stats = json.loads(r.read())
         assert stats["mode"] == "continuous"
         assert stats["slots"] == 3
-        # +2 multi-row, +1 over-width, +1 stop-sequence request
-        assert stats["admitted"] == len(prompts) + 4
+        # +2 multi-row, +1 over-width, +1 stop-sequence, +1 top_k=1
+        # request (the rejected top_p never admits)
+        assert stats["admitted"] == len(prompts) + 5
         assert stats["steps"] > 0 and not stats["closed"]
         # the CLI-wired prefix cache is live and accounted in /stats
         assert stats["prefix_cache_entries"] > 0

@@ -523,6 +523,157 @@ def test_engine_sampled_mode_runs(tiny):
         eng.close()
 
 
+def test_sample_rows_per_row_matches_static_sample_logits():
+    """The per-row traced (top_k, top_p) mask must reproduce the static
+    sample_logits truncation exactly: with every row carrying the same
+    (k, p) as the static call, the masked distributions are identical,
+    so the same key draws the same tokens."""
+    from tensorflowonspark_tpu.models.llama import sample_logits
+    from tensorflowonspark_tpu.serving.engine import _sample_rows
+
+    rng = np.random.default_rng(0)
+    vocab, b = 50, 4
+    logits = jnp.asarray(rng.normal(0, 3, (b, vocab)), jnp.float32)
+    key = jax.random.PRNGKey(7)
+    temps = jnp.full((b,), 0.8, jnp.float32)
+    scaled = logits / 0.8
+
+    for k, p in [(5, None), (None, 0.7), (8, 0.9), (1, None), (None, 1e-6)]:
+        kk = float(k if k is not None else vocab)
+        pp = float(p if p is not None else 1.0)
+        kps = jnp.tile(jnp.asarray([[kk, pp]], jnp.float32), (b, 1))
+        tok, _ = _sample_rows(logits, key, temps, kps)
+        want = sample_logits(scaled, key, 1.0, k, p)
+        assert np.array_equal(np.asarray(tok), np.asarray(want)), (k, p)
+
+    # disabled rows (k=vocab, p=1) take the no-truncation fast path and
+    # match plain sampling
+    kps = jnp.tile(jnp.asarray([[float(vocab), 1.0]], jnp.float32), (b, 1))
+    tok, _ = _sample_rows(logits, key, temps, kps)
+    want = sample_logits(scaled, key, 1.0, None, None)
+    assert np.array_equal(np.asarray(tok), np.asarray(want))
+
+
+def test_sample_rows_mixed_rows_respect_own_truncation():
+    """Rows with different (k, p) in ONE batch each follow their own
+    truncation: a k=1 row is argmax; a p~0 row is argmax; an untruncated
+    row samples freely."""
+    from tensorflowonspark_tpu.serving.engine import _sample_rows
+
+    rng = np.random.default_rng(1)
+    vocab = 40
+    logits = jnp.asarray(rng.normal(0, 2, (3, vocab)), jnp.float32)
+    temps = jnp.full((3,), 1.0, jnp.float32)
+    kps = jnp.asarray(
+        [[1.0, 1.0], [float(vocab), 1e-6], [float(vocab), 1.0]],
+        jnp.float32,
+    )
+    greedy = np.asarray(jnp.argmax(logits, -1))
+    for seed in range(5):
+        tok, _ = _sample_rows(
+            logits, jax.random.PRNGKey(seed), temps, kps
+        )
+        tok = np.asarray(tok)
+        assert tok[0] == greedy[0]  # top_k=1
+        assert tok[1] == greedy[1]  # top_p -> nucleus of one
+
+
+def test_engine_per_request_top_k_and_top_p(tiny):
+    """Per-request sampling truncation: a top_k=1 request decodes
+    greedily even on a sampling engine, regardless of what other rows in
+    the batch do, and per-request values override the engine defaults."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(
+        model, params, slots=3, prompt_widths=(8,),
+        temperature=0.9, top_k=8, seed=11,
+    )
+    try:
+        greedy_want = eng.submit([1, 2, 3], 6, temperature=0.0)
+        # k=1 truncates to the argmax at every step -> identical to the
+        # greedy decode even though this row samples at temperature 0.9
+        got_k1 = eng.submit([1, 2, 3], 6, top_k=1)
+        assert got_k1 == greedy_want
+        # p ~ 0 keeps only the most likely token -> greedy as well
+        got_p0 = eng.submit([1, 2, 3], 6, top_p=1e-9)
+        assert got_p0 == greedy_want
+        # concurrent mixed batch: the k=1 row stays greedy while free
+        # rows sample around it
+        results = {}
+
+        def fire(name, **kw):
+            results[name] = eng.submit([1, 2, 3], 6, **kw)
+
+        ts = [
+            threading.Thread(target=fire, args=("k1",), kwargs={"top_k": 1}),
+            threading.Thread(target=fire, args=("free",)),
+            threading.Thread(target=fire, args=("p0",), kwargs={"top_p": 1e-9}),
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert results["k1"] == greedy_want
+        assert results["p0"] == greedy_want
+    finally:
+        eng.close()
+
+
+def test_engine_per_request_sampling_validation(tiny):
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=1, prompt_widths=(8,))
+    try:
+        with pytest.raises(ValueError, match="top_k"):
+            eng.submit([1], 2, top_k=0)
+        with pytest.raises(ValueError, match="top_p"):
+            eng.submit([1], 2, top_p=0.0)
+        with pytest.raises(ValueError, match="top_p"):
+            eng.submit([1], 2, top_p=float("nan"))
+        with pytest.raises(ValueError, match="top_p"):
+            eng.submit([1], 2, top_p=1.5)
+        # a top_k beyond the vocab clamps (= disabled) rather than erroring
+        out = eng.submit([1, 2], 3, top_k=10**6)
+        assert len(out) == 3
+    finally:
+        eng.close()
+    # engine-wide defaults feed the same resolver -> same validity bar
+    with pytest.raises(ValueError, match="top_k"):
+        ContinuousBatcher(model, params, slots=1, prompt_widths=(8,), top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        ContinuousBatcher(
+            model, params, slots=1, prompt_widths=(8,), top_p=0.0
+        )
+
+
+def test_resolve_kp_greedy_rows_disable_truncation(tiny):
+    """A greedy row (effective temperature 0) must resolve to the
+    disabled [vocab, 1.0] even on an engine with default top_k/top_p —
+    otherwise an all-greedy batch flips _sample_rows' any-row-truncates
+    cond and pays the full-vocab sort for output it discards."""
+    from tensorflowonspark_tpu.serving.engine import _Pending
+    import threading as _threading
+
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(
+        model, params, slots=1, prompt_widths=(8,),
+        temperature=0.0, top_k=8, top_p=0.9,
+    )
+    try:
+        vocab = float(cfg.vocab_size)
+        mk = lambda **kw: _Pending([1], 1, _threading.Event(), **kw)
+        # engine default temperature is 0 -> disabled
+        assert np.asarray(eng._resolve_kp(mk())).tolist() == [[vocab, 1.0]]
+        # explicit greedy request likewise
+        assert np.asarray(
+            eng._resolve_kp(mk(temperature=0.0, top_k=4))
+        ).tolist() == [[vocab, 1.0]]
+        # a sampled request gets the engine defaults
+        assert np.asarray(
+            eng._resolve_kp(mk(temperature=0.7))
+        ).tolist() == [[8.0, pytest.approx(0.9)]]
+    finally:
+        eng.close()
+
+
 def test_engine_constructor_validation(tiny):
     """Degenerate parameters fail at construction, not as a hang: slots=0
     would busy-spin the scheduler with every submit() blocked forever;
