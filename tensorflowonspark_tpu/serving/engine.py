@@ -65,24 +65,48 @@ class EngineOverloaded(RuntimeError):
     full — callers should shed load (HTTP 503), not block."""
 
 
-def _sample_rows(logits, key, temps, kps):
+def _row_truncate(scaled, ks, ps):
+    """Per-row top-k/top-p mask over (B, vocab) temperature-scaled
+    logits: top-k first, then top-p renormalized over the k survivors
+    (the standard stacks' composition). ``ks``/``ps`` (B,) are traced —
+    the shapes don't depend on the values (top-k compares sorted rank
+    against k; top-p thresholds a cumsum). Disabled rows pass
+    ``k = vocab`` / ``p = 1.0``."""
+    vocab = scaled.shape[-1]
+    sorted_desc = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
+    rank = jnp.arange(vocab, dtype=jnp.float32)[None, :]
+    kept = jnp.where(rank < ks[:, None], sorted_desc, -jnp.inf)
+    cum = jnp.cumsum(jax.nn.softmax(kept, axis=-1), axis=-1)
+    # Last kept rank: everything before cumulative mass reaches top_p,
+    # always >= 0 (the most likely token survives even when it alone
+    # exceeds p) and always < k (a p of ~1.0 must not walk into the
+    # -inf tail, whose cumsum plateaus just under 1.0 in floating
+    # point, and then keep MORE than k tokens).
+    cutoff_index = jnp.sum(cum < ps[:, None], axis=-1, keepdims=True)
+    cutoff_index = jnp.minimum(
+        cutoff_index, (ks[:, None] - 1).astype(jnp.int32)
+    )
+    cutoff = jnp.take_along_axis(kept, cutoff_index, axis=-1)
+    return jnp.where(scaled < cutoff, -jnp.inf, scaled)
+
+
+def _sample_rows(logits, temps, kps, seeds, counters):
     """Per-row sampling over (B, vocab) logits.
 
-    ``temps`` (B,) and ``kps`` (B, 2) are TRACED inputs — per-request
-    temperature, top_k (``kps[:, 0]``) and top_p (``kps[:, 1]``) cost no
-    recompilation. The truncation shapes don't depend on the VALUES
-    (top-k compares sorted rank against k; top-p thresholds a cumsum),
-    so one compiled program serves every mix. A row with ``temps == 0``
-    is greedy; a sampled row truncates on its temperature-scaled
-    distribution (nucleus-on-scaled, matching the standard stacks),
-    top-k first, then top-p renormalized over the k survivors. Rows
-    encode "disabled" as ``k = vocab`` / ``p = 1.0`` (the resolver in
-    the engine maps None through the engine-wide defaults to these).
+    Every sampling input is a TRACED per-row value — no recompilation
+    for any mix: ``temps`` (B,) temperature (0 = greedy), ``kps``
+    (B, 2) resolved [top_k, top_p] (see :func:`_row_truncate`),
+    ``seeds`` (B,) uint32 and ``counters`` (B,) int32. Each row's draw
+    uses its OWN key, ``fold_in(fold_in(base, seed), counter)`` with
+    the counter = the sampled token's sequence position — so a seeded
+    request's completion is a pure function of (params, prompt, seed),
+    REPRODUCIBLE regardless of how its row interleaves with other
+    traffic in the continuous batch (the global-key design it replaces
+    made every sample depend on the engine-lifetime step count).
 
-    The mask runs under ``lax.cond`` on "any row truncates": greedy and
-    plain-temperature batches — the benchmarked configs — skip the
-    full-vocab sort entirely, so supporting per-request truncation
-    costs them nothing.
+    The truncation mask runs under ``lax.cond`` on "any row truncates":
+    greedy and plain-temperature batches — the benchmarked configs —
+    skip the full-vocab sort entirely.
 
     Returns ``(tokens (B,) int32, logprobs (B,) fp32)`` — the logprob
     of each chosen token under the RAW (unscaled) model distribution,
@@ -94,26 +118,17 @@ def _sample_rows(logits, key, temps, kps):
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
     ks, ps = kps[:, 0], kps[:, 1]
 
-    def _truncate(lg):
-        sorted_desc = jnp.flip(jnp.sort(lg, axis=-1), axis=-1)
-        rank = jnp.arange(vocab, dtype=jnp.float32)[None, :]
-        kept = jnp.where(rank < ks[:, None], sorted_desc, -jnp.inf)
-        cum = jnp.cumsum(jax.nn.softmax(kept, axis=-1), axis=-1)
-        # Last kept rank: everything before cumulative mass reaches
-        # top_p, always >= 0 (the most likely token survives even when
-        # it alone exceeds p) and always < k (a p of ~1.0 must not walk
-        # into the -inf tail, whose cumsum plateaus just under 1.0 in
-        # floating point, and then keep MORE than k tokens).
-        cutoff_index = jnp.sum(cum < ps[:, None], axis=-1, keepdims=True)
-        cutoff_index = jnp.minimum(
-            cutoff_index, (ks[:, None] - 1).astype(jnp.int32)
-        )
-        cutoff = jnp.take_along_axis(kept, cutoff_index, axis=-1)
-        return jnp.where(lg < cutoff, -jnp.inf, lg)
-
     need = jnp.any((ks < vocab) | (ps < 1.0))
-    trunc = jax.lax.cond(need, _truncate, lambda lg: lg, scaled)
-    sampled = jax.random.categorical(key, trunc).astype(jnp.int32)
+    trunc = jax.lax.cond(
+        need, lambda lg: _row_truncate(lg, ks, ps), lambda lg: lg, scaled
+    )
+    base = jax.random.PRNGKey(0)
+    keys = jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.fold_in(base, s), c)
+    )(seeds, counters)
+    sampled = jax.vmap(jax.random.categorical)(keys, trunc).astype(
+        jnp.int32
+    )
     tok = jnp.where(temps > 0, sampled, greedy)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     lp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
@@ -128,6 +143,9 @@ class _Pending:
     temperature: float | None = None  # None = the engine-wide default
     top_k: int | None = None  # None = the engine-wide default
     top_p: float | None = None  # None = the engine-wide default
+    # None = engine-drawn (independent, nondeterministic across
+    # submissions); set = reproducible completion for this request
+    seed: int | None = None
     eos_id: int | None = None  # None = the engine-wide default
     adapter: int = 0  # MultiLoraTensor bank slot (0 = base model)
     # multi-token stop sequences (host-side tail match; the matched
@@ -220,6 +238,7 @@ class _PrefillJob:
     length: int
     temp_1: object  # (1,) fp32
     kp_1: object  # (1, 2) fp32 resolved [top_k, top_p]
+    seed_1: object  # (1,) uint32 resolved sampling seed
     ad_1: object  # (1,) int32 adapter id
     # next prompt depth at which to store a chunk-boundary prefix entry
     # (doubles after each insert — see _advance_job)
@@ -419,7 +438,15 @@ class ContinuousBatcher:
                 f"top_p must be finite and in (0, 1], got {top_p}"
             )
         self._eos_id = None if eos_id is None else int(eos_id)
-        self._key = jax.random.PRNGKey(seed)
+        # Per-request sampling seeds: explicit request seeds pass
+        # through; unseeded requests draw one here at enqueue — making
+        # each independent, and the whole engine reproducible given its
+        # constructor seed and request order.
+        # (mod 2**64: PCG64 rejects negative seeds, which PRNGKey-era
+        # configs may legitimately pass)
+        self._seed_rng = np.random.Generator(
+            np.random.PCG64(int(seed) % 2**64)
+        )
 
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
@@ -508,7 +535,10 @@ class ContinuousBatcher:
         stop: "list[list[int]] | None" = None,
         top_k: int | None = None,
         top_p: float | None = None,
+        seed: int | None = None,
     ) -> None:
+        if seed is not None and not isinstance(seed, int):
+            raise ValueError(f"seed must be an int, got {seed!r}")
         if top_k is not None and (not isinstance(top_k, int) or top_k < 1):
             raise ValueError(f"top_k must be an int >= 1, got {top_k!r}")
         if top_p is not None and not (
@@ -595,14 +625,37 @@ class ContinuousBatcher:
         stop: "list[list[int]] | None" = None,
         top_k: int | None = None,
         top_p: float | None = None,
+        seed: "int | list[int] | None" = None,
     ) -> list[_Pending]:
         """Validate then enqueue a group ATOMICALLY: either every row is
         accepted or none is — a partially admitted multi-row request
-        would burn slots on work the client then discards on its 503."""
-        for tokens, _ in requests:
+        would burn slots on work the client then discards on its 503.
+
+        ``seed``: None = each row draws an engine seed (independent);
+        an int seeds row i as ``seed + i`` (rows stay distinct — n
+        identical fanned prompts with one seed must not return n
+        identical completions — while the whole call stays
+        reproducible); a list gives each row its exact seed."""
+        if isinstance(seed, list):
+            if len(seed) != len(requests):
+                raise ValueError(
+                    f"seed list has {len(seed)} entries for "
+                    f"{len(requests)} rows"
+                )
+            row_seeds = seed
+        elif seed is None:
+            row_seeds = [None] * len(requests)
+        elif not isinstance(seed, int):
+            # type-check BEFORE the seed+i derivation below: a str seed
+            # must be the documented ValueError (the client-fault class
+            # serve_model maps to HTTP 400), not a TypeError from `+`
+            raise ValueError(f"seed must be an int, got {seed!r}")
+        else:
+            row_seeds = [seed + i for i in range(len(requests))]
+        for (tokens, _), rs in zip(requests, row_seeds):
             self._validate(
                 tokens, max_new_tokens, temperature, adapter, stop,
-                top_k, top_p,
+                top_k, top_p, rs,
             )
         ps = [
             _Pending(
@@ -612,13 +665,14 @@ class ContinuousBatcher:
                 temperature=temperature,
                 top_k=top_k,
                 top_p=top_p,
+                seed=rs,
                 eos_id=eos_id,
                 adapter=int(adapter or 0),
                 stop=tuple(tuple(q) for q in (stop or ())),
                 submitted_at=time.monotonic(),
                 sink=sink,
             )
-            for tokens, sink in requests
+            for (tokens, sink), rs in zip(requests, row_seeds)
         ]
         if self._max_queue is not None and len(ps) > self._max_queue:
             # Permanently unsatisfiable, not transient overload: a 503 +
@@ -657,10 +711,11 @@ class ContinuousBatcher:
         stop: "list[list[int]] | None" = None,
         top_k: int | None = None,
         top_p: float | None = None,
+        seed: int | None = None,
     ) -> _Pending:
         return self._enqueue_all(
             [(tokens, sink)], max_new_tokens, temperature, eos_id,
-            adapter, stop, top_k, top_p,
+            adapter, stop, top_k, top_p, seed,
         )[0]
 
     def submit(
@@ -674,6 +729,7 @@ class ContinuousBatcher:
         stop: "list[list[int]] | None" = None,
         top_k: int | None = None,
         top_p: float | None = None,
+        seed: int | None = None,
     ) -> "list[int] | tuple[list[int], list[float]]":
         """Blocking decode. ``temperature``, ``top_k``, ``top_p`` and
         ``eos_id`` override the engine-wide defaults FOR THIS REQUEST
@@ -689,7 +745,7 @@ class ContinuousBatcher:
         p = self._enqueue(
             tokens, max_new_tokens, temperature=temperature,
             eos_id=eos_id, adapter=adapter, stop=stop,
-            top_k=top_k, top_p=top_p,
+            top_k=top_k, top_p=top_p, seed=seed,
         )
         p.event.wait()
         if p.error is not None:
@@ -709,6 +765,7 @@ class ContinuousBatcher:
         stop: "list[list[int]] | None" = None,
         top_k: int | None = None,
         top_p: float | None = None,
+        seed: "int | list[int] | None" = None,
     ) -> "list[list[int]] | tuple[list[list[int]], list[list[float]]]":
         """Blocking decode of several prompts admitted ATOMICALLY (all
         rows accepted or an EngineOverloaded/ValueError before any row
@@ -723,6 +780,7 @@ class ContinuousBatcher:
             stop,
             top_k,
             top_p,
+            seed,
         )
         for p in ps:
             p.event.wait()
@@ -744,6 +802,7 @@ class ContinuousBatcher:
         stop: "list[list[int]] | None" = None,
         top_k: int | None = None,
         top_p: float | None = None,
+        seed: int | None = None,
     ):
         """Yield completion tokens AS THEY DECODE (one engine step of
         latency each) instead of blocking for the full result.
@@ -768,6 +827,7 @@ class ContinuousBatcher:
             stop=stop,
             top_k=top_k,
             top_p=top_p,
+            seed=seed,
         )
 
         # An explicit iterator, NOT a generator: close() on a
@@ -946,7 +1006,7 @@ class ContinuousBatcher:
         constrain = self._constrain_cache
 
         @jax.jit
-        def step(params, cache, tok, pos, temps, ads, kps, key):
+        def step(params, cache, tok, pos, temps, ads, kps, seeds):
             logits, updated = model.apply(
                 {"params": params, "cache": cache},
                 tok[:, None],
@@ -962,7 +1022,11 @@ class ContinuousBatcher:
             # host fetch that rides the existing token fetch — cheap
             # enough to keep unconditional rather than doubling the
             # compiled-variant count.
-            nxt, lp = _sample_rows(logits[:, -1], key, temps, kps)
+            # the sampled token will occupy position pos+1 (unclamped:
+            # the cache-write clamp below must not alias two counters)
+            nxt, lp = _sample_rows(
+                logits[:, -1], temps, kps, seeds, pos + 1
+            )
             # Clamp so a retired-but-not-yet-reused row parked at the
             # cache edge never scatters out of bounds (its writes are
             # garbage either way; admission overwrites the whole row).
@@ -982,7 +1046,7 @@ class ContinuousBatcher:
         constrain = self._constrain_cache
 
         @jax.jit
-        def prefill(params, prompt, length, temps, ads, kps, key):
+        def prefill(params, prompt, length, temps, ads, kps, seed_1):
             positions = jnp.arange(width, dtype=jnp.int32)[None, :]
             logits, state = model.apply(
                 {"params": params},
@@ -996,7 +1060,8 @@ class ContinuousBatcher:
             last = jnp.take_along_axis(
                 logits, (length - 1)[:, None, None], axis=1
             )[:, 0]
-            tok, lp = _sample_rows(last, key, temps, kps)
+            # the first sampled token occupies position `length`
+            tok, lp = _sample_rows(last, temps, kps, seed_1, length)
             return constrain(state["cache"]), tok, length, lp
 
         self._prefill_cache[width] = prefill
@@ -1009,7 +1074,7 @@ class ContinuousBatcher:
         @jax.jit
         def admit(
             cache_b, cache_1, row, tok_b, tok_1, pos_b, pos_1,
-            temps_b, temp_1, ads_b, ad_1, kps_b, kp_1,
+            temps_b, temp_1, ads_b, ad_1, kps_b, kp_1, seeds_b, seed_1,
         ):
             def scatter(leaf_b, leaf_1):
                 if leaf_b.ndim == 0:  # per-layer scalar write index:
@@ -1025,7 +1090,8 @@ class ContinuousBatcher:
             temps = jax.lax.dynamic_update_slice(temps_b, temp_1, (row,))
             ads = jax.lax.dynamic_update_slice(ads_b, ad_1, (row,))
             kps = jax.lax.dynamic_update_slice(kps_b, kp_1, (row, 0))
-            return cache, tok, pos, temps, ads, kps
+            seeds = jax.lax.dynamic_update_slice(seeds_b, seed_1, (row,))
+            return cache, tok, pos, temps, ads, kps, seeds
 
         return admit
 
@@ -1055,11 +1121,12 @@ class ContinuousBatcher:
     @functools.cached_property
     def _sample1_fn(self):
         @jax.jit
-        def sample1(logits_chunk, idx, temps, kps, key):
+        def sample1(logits_chunk, idx, temps, kps, seed_1, length_1):
             last = jax.lax.dynamic_index_in_dim(
                 logits_chunk, idx, axis=1, keepdims=False
             )  # (1, vocab): the prompt's true last position
-            return _sample_rows(last, key, temps, kps)
+            # the first sampled token occupies position `length`
+            return _sample_rows(last, temps, kps, seed_1, length_1)
 
         return sample1
 
@@ -1118,13 +1185,14 @@ class ContinuousBatcher:
             length=len(p.tokens),
             temp_1=jnp.asarray([temp], jnp.float32),
             kp_1=self._resolve_kp(p),
+            seed_1=self._resolve_seed(p),
             ad_1=jnp.asarray([p.adapter], jnp.int32),
             # first boundary entry lands at the first chunk boundary
             # past the resume point, then depths double
             next_insert_depth=self._prefill_chunk or 0,
         )
 
-    def _advance_job(self, cache, tok, pos, temps, ads, kps):
+    def _advance_job(self, cache, tok, pos, temps, ads, kps, seeds):
         """Run ONE chunk of the in-flight prefill; on the final chunk,
         sample the first token and scatter the row into the batch.
         Chunks cover only the true prompt length — the padding region a
@@ -1133,7 +1201,7 @@ class ContinuousBatcher:
         if job.p.cancelled:
             self._resolve_unadmitted_cancel(job.p)
             self._job = None
-            return cache, tok, pos, temps, ads, kps
+            return cache, tok, pos, temps, ads, kps, seeds
         c = self._prefill_chunk
         # Shift the window back rather than letting positions run past
         # max_seq_len: a final chunk starting at `start` would scatter
@@ -1182,7 +1250,7 @@ class ContinuousBatcher:
                 )
                 job.next_insert_depth = 2 * job.next_pos
                 job.boundary_inserts += 1
-            return cache, tok, pos, temps, ads, kps
+            return cache, tok, pos, temps, ads, kps, seeds
         if self._prefix_store is not None:
             # The completed single-row cache covers the whole prompt.
             self._prefix_store.insert(
@@ -1194,9 +1262,10 @@ class ContinuousBatcher:
             jnp.int32(job.length - 1 - start_w),
             job.temp_1,
             job.kp_1,
-            self._next_key(),
+            job.seed_1,
+            jnp.asarray([job.length], jnp.int32),
         )
-        cache, tok, pos, temps, ads, kps = self._admit_fn(
+        cache, tok, pos, temps, ads, kps, seeds = self._admit_fn(
             cache,
             job.cache_1,
             jnp.int32(job.row),
@@ -1210,6 +1279,8 @@ class ContinuousBatcher:
             job.ad_1,
             kps,
             job.kp_1,
+            seeds,
+            job.seed_1,
         )
         first = int(np.asarray(tok_1)[0])
         lps = [float(np.asarray(lp_1)[0])]
@@ -1219,7 +1290,7 @@ class ContinuousBatcher:
         if self._finished(job.p, [first], first):
             self._retire(job.row)
         self._job = None
-        return cache, tok, pos, temps, ads, kps
+        return cache, tok, pos, temps, ads, kps, seeds
 
     # -- engine loop ---------------------------------------------------
 
@@ -1258,7 +1329,8 @@ class ContinuousBatcher:
             ),
             (b, 1),
         )
-        return cache, tok, pos, temps, ads, kps
+        seeds = jnp.zeros((b,), jnp.uint32)
+        return cache, tok, pos, temps, ads, kps, seeds
 
     def _resolve_kp(self, p: _Pending):
         """(1, 2) fp32 resolved [top_k, top_p] for one request: the
@@ -1282,18 +1354,25 @@ class ContinuousBatcher:
         q = 1.0 if q is None else float(q)
         return jnp.asarray([[float(k), q]], jnp.float32)
 
+    def _resolve_seed(self, p: _Pending):
+        """(1,) uint32 sampling seed: the request's, else one drawn from
+        the engine's stream at admission (rows stay independent; the
+        engine stays reproducible given its constructor seed)."""
+        if p.seed is not None:
+            val = int(p.seed) % (2**32)
+        else:
+            val = int(self._seed_rng.integers(2**32, dtype=np.uint32))
+        return jnp.asarray([val], jnp.uint32)
+
     def _bucket(self, n: int) -> int:
         for w in self._widths:
             if n <= w:
                 return w
         raise AssertionError  # submit() validated against widths[-1]
 
-    def _next_key(self):
-        self._key, sub = jax.random.split(self._key)
-        return sub
-
     def _admit_one(
-        self, p: _Pending, row: int, cache, tok, pos, temps, ads, kps
+        self, p: _Pending, row: int, cache, tok, pos, temps, ads, kps,
+        seeds,
     ):
         w = self._bucket(len(p.tokens))
         prompt = np.zeros((1, w), np.int32)
@@ -1305,6 +1384,7 @@ class ContinuousBatcher:
         )
         temp_1 = jnp.asarray([temp], jnp.float32)
         kp_1 = self._resolve_kp(p)
+        seed_1 = self._resolve_seed(p)
         ad_1 = jnp.asarray([p.adapter], jnp.int32)
         cache_1, tok_1, pos_1, lp_1 = self._prefill_fn(w)(
             self._params,
@@ -1313,11 +1393,11 @@ class ContinuousBatcher:
             temp_1,
             ad_1,
             kp_1,
-            self._next_key(),
+            seed_1,
         )
-        cache, tok, pos, temps, ads, kps = self._admit_fn(
+        cache, tok, pos, temps, ads, kps, seeds = self._admit_fn(
             cache, cache_1, jnp.int32(row), tok, tok_1, pos, pos_1,
-            temps, temp_1, ads, ad_1, kps, kp_1,
+            temps, temp_1, ads, ad_1, kps, kp_1, seeds, seed_1,
         )
         first = int(np.asarray(tok_1)[0])
         out = [first]
@@ -1327,7 +1407,7 @@ class ContinuousBatcher:
         p.emit(first, lps[0])
         if self._finished(p, out, first):
             self._retire(row)
-        return cache, tok, pos, temps, ads, kps
+        return cache, tok, pos, temps, ads, kps, seeds
 
     def _finished(self, p: _Pending, out: list[int], last: int) -> bool:
         if p.cancelled:
@@ -1415,7 +1495,7 @@ class ContinuousBatcher:
             self._fail_one(item, RuntimeError("engine shutting down"))
 
     def _loop(self) -> None:
-        cache = tok = pos = temps = ads = kps = None
+        cache = tok = pos = temps = ads = kps = seeds = None
         try:
             while True:
                 if self._stop_now.is_set():
@@ -1466,14 +1546,14 @@ class ContinuousBatcher:
                     self._inflight = item
                     if cache is None:
                         (
-                            cache, tok, pos, temps, ads, kps,
+                            cache, tok, pos, temps, ads, kps, seeds,
                         ) = self._empty_state()
                     if self._prefill_chunk is None:
                         (
-                            cache, tok, pos, temps, ads, kps,
+                            cache, tok, pos, temps, ads, kps, seeds,
                         ) = self._admit_one(
                             item, free[0], cache, tok, pos, temps, ads,
-                            kps,
+                            kps, seeds,
                         )
                     else:
                         self._job = self._start_job(item, free[0])
@@ -1482,9 +1562,9 @@ class ContinuousBatcher:
 
                 if self._job is not None:
                     (
-                        cache, tok, pos, temps, ads, kps,
+                        cache, tok, pos, temps, ads, kps, seeds,
                     ) = self._advance_job(
-                        cache, tok, pos, temps, ads, kps
+                        cache, tok, pos, temps, ads, kps, seeds
                     )
 
                 if all(e is None for e in self._live):
@@ -1492,7 +1572,7 @@ class ContinuousBatcher:
 
                 cache, tok, pos, lp = self._step_fn(
                     self._params, cache, tok, pos, temps, ads, kps,
-                    self._next_key(),
+                    seeds,
                 )
                 self.steps += 1
                 host_tok = np.asarray(tok)

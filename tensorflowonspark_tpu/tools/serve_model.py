@@ -198,6 +198,7 @@ class _Handler(BaseHTTPRequestHandler):
             n_samples = payload.get("n")
             req_top_k = payload.get("top_k")
             req_top_p = payload.get("top_p")
+            req_seed = payload.get("seed")
             want_logprobs = bool(payload.get("logprobs"))
             if (
                 temperature is not None
@@ -208,11 +209,12 @@ class _Handler(BaseHTTPRequestHandler):
                 or n_samples is not None
                 or req_top_k is not None
                 or req_top_p is not None
+                or req_seed is not None
                 or want_logprobs
             ) and self.gen_engine is None:
                 raise ValueError(
                     "per-request temperature/max_new_tokens/eos_id/"
-                    "adapter/stop/n/top_k/top_p/logprobs require "
+                    "adapter/stop/n/top_k/top_p/seed/logprobs require "
                     "--gen-engine continuous (the fixed path bakes "
                     "decode params at startup)"
                 )
@@ -236,6 +238,8 @@ class _Handler(BaseHTTPRequestHandler):
                 req_top_k = int(req_top_k)
             if req_top_p is not None:
                 req_top_p = float(req_top_p)
+            if req_seed is not None:
+                req_seed = int(req_seed)
             if n_samples is not None:
                 n_samples = int(n_samples)
                 if not 1 <= n_samples <= 16:
@@ -284,7 +288,7 @@ class _Handler(BaseHTTPRequestHandler):
         if stream:
             self._engine_stream(
                 prompts[0], temperature, max_new, eos_id, want_logprobs,
-                adapter, stop, req_top_k, req_top_p,
+                adapter, stop, req_top_k, req_top_p, req_seed,
             )
             return
         from tensorflowonspark_tpu.serving import EngineOverloaded
@@ -298,7 +302,7 @@ class _Handler(BaseHTTPRequestHandler):
                     completions = self._engine_generate(
                         fan, temperature, max_new, eos_id,
                         want_logprobs, adapter, stop, req_top_k,
-                        req_top_p,
+                        req_top_p, req_seed,
                     )
                     if want_logprobs:
                         completions, logprobs = completions
@@ -356,6 +360,7 @@ class _Handler(BaseHTTPRequestHandler):
         stop=None,
         top_k=None,
         top_p=None,
+        seed=None,
     ) -> None:
         """Stream one completion as newline-delimited JSON: a
         ``{"token": t}`` line per decoded token (one engine step of
@@ -376,6 +381,7 @@ class _Handler(BaseHTTPRequestHandler):
                 stop=stop,
                 top_k=top_k,
                 top_p=top_p,
+                seed=seed,
             )
         except EngineOverloaded as e:
             self._reply(503, {"error": str(e)}, {"Retry-After": "1"})
@@ -441,6 +447,7 @@ class _Handler(BaseHTTPRequestHandler):
         stop=None,
         top_k=None,
         top_p=None,
+        seed=None,
     ):
         """Continuous-batching path: the request's rows are admitted
         ATOMICALLY (all accepted, or a 400/503 before any decodes — a
@@ -457,6 +464,7 @@ class _Handler(BaseHTTPRequestHandler):
             stop=stop,
             top_k=top_k,
             top_p=top_p,
+            seed=seed,
         )
 
 

@@ -449,6 +449,19 @@ def test_serve_model_continuous_engine(tmp_path):
         assert stats["prefix_cache_entries"] > 0
         assert stats["prefix_hits"] + stats["prefix_misses"] > 0
 
+        # seeded sampling: "seed" makes an n>1 sampled request fully
+        # reproducible (rows derive seed+i -> distinct completions),
+        # independent of everything already decoded on this engine
+        req_body = {
+            "prompts": [[1, 2]], "temperature": 0.9, "n": 2, "seed": 42,
+        }
+        code, body1 = _post(port, "/generate", req_body)
+        assert code == 200, body1
+        code, body2 = _post(port, "/generate", req_body)
+        assert code == 200
+        assert body1["completions"] == body2["completions"]
+        assert body1["completions"][0][0] != body1["completions"][0][1]
+
         # streaming: NDJSON token lines + a done trailer matching the
         # non-streamed completion for the same prompt; with logprobs
         # each line carries the token's raw-distribution logprob
