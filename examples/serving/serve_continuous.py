@@ -164,6 +164,22 @@ def main() -> int:
         print(f"prompt={p['prompts'][0]} temp={p['temperature']}{tag} "
               f"-> {r['completions'][0]}")
 
+    # reproducible sampling: a seeded request returns the same
+    # completion on every submission, regardless of what else the
+    # engine decoded in between (per-(seed, position) keys); top_p
+    # truncates this row's nucleus without recompiling anything
+    seeded = {
+        "prompts": [[4, 5]], "temperature": 0.9, "top_p": 0.9,
+        "seed": 42, "max_new_tokens": 6,
+    }
+    first = post(port, seeded)
+    second = post(port, seeded)
+    if first["completions"] != second["completions"]:
+        print(f"seeded request NOT reproducible: {first} vs {second}")
+        return 1
+    print(f"seeded(42, top_p=0.9) -> {first['completions'][0]} (x2, "
+          "identical)")
+
     # stream a completion token by token, with per-token logprobs
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/generate",
