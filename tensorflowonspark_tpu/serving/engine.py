@@ -95,7 +95,9 @@ def _sample_rows(logits, temps, kps, seeds, counters):
 
     Every sampling input is a TRACED per-row value — no recompilation
     for any mix: ``temps`` (B,) temperature (0 = greedy), ``kps``
-    (B, 2) resolved [top_k, top_p] (see :func:`_row_truncate`),
+    (B, 3) resolved [top_k, top_p, min_p] (see :func:`_row_truncate`;
+    min_p keeps tokens whose probability is at least min_p times the
+    most likely token's — an elementwise log-space compare, no sort),
     ``seeds`` (B,) uint32 and ``counters`` (B,) int32. Each row's draw
     uses its OWN key, ``fold_in(fold_in(base, seed), counter)`` with
     the counter = the sampled token's sequence position — so a seeded
@@ -116,11 +118,29 @@ def _sample_rows(logits, temps, kps, seeds, counters):
     vocab = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    ks, ps = kps[:, 0], kps[:, 1]
+    ks, ps, ms = kps[:, 0], kps[:, 1], kps[:, 2]
 
-    need = jnp.any((ks < vocab) | (ps < 1.0))
+    # two independent conds: k/p need the full-vocab sort, min_p is a
+    # row-max compare — each batch pays only for what its rows use
+    need_sort = jnp.any((ks < vocab) | (ps < 1.0))
     trunc = jax.lax.cond(
-        need, lambda lg: _row_truncate(lg, ks, ps), lambda lg: lg, scaled
+        need_sort,
+        lambda lg: _row_truncate(lg, ks, ps),
+        lambda lg: lg,
+        scaled,
+    )
+
+    def _min_p(lg):
+        # keep where prob >= min_p * prob_max, i.e. (in log space)
+        # scaled >= row_max + log(min_p); computed on the UNtruncated
+        # scaled logits so min_p composes with k/p by mask intersection
+        floor = jnp.max(scaled, axis=-1, keepdims=True) + jnp.log(
+            jnp.maximum(ms, 1e-38)
+        )[:, None]
+        return jnp.where(scaled < floor, -jnp.inf, lg)
+
+    trunc = jax.lax.cond(
+        jnp.any(ms > 0.0), _min_p, lambda lg: lg, trunc
     )
     base = jax.random.PRNGKey(0)
     keys = jax.vmap(
@@ -143,6 +163,7 @@ class _Pending:
     temperature: float | None = None  # None = the engine-wide default
     top_k: int | None = None  # None = the engine-wide default
     top_p: float | None = None  # None = the engine-wide default
+    min_p: float | None = None  # None = the engine-wide default
     # None = engine-drawn (independent, nondeterministic across
     # submissions); set = reproducible completion for this request
     seed: int | None = None
@@ -237,7 +258,7 @@ class _PrefillJob:
     next_pos: int  # next chunk's start offset into the prompt
     length: int
     temp_1: object  # (1,) fp32
-    kp_1: object  # (1, 2) fp32 resolved [top_k, top_p]
+    kp_1: object  # (1, 3) fp32 resolved [top_k, top_p, min_p]
     seed_1: object  # (1,) uint32 resolved sampling seed
     ad_1: object  # (1,) int32 adapter id
     # next prompt depth at which to store a chunk-boundary prefix entry
@@ -343,6 +364,7 @@ class ContinuousBatcher:
         temperature: float = 0.0,
         top_k: int | None = None,
         top_p: float | None = None,
+        min_p: float | None = None,
         eos_id: int | None = None,
         seed: int = 0,
         mesh=None,
@@ -425,6 +447,7 @@ class ContinuousBatcher:
         self._temperature = float(temperature)
         self._top_k = None if top_k is None else int(top_k)
         self._top_p = None if top_p is None else float(top_p)
+        self._min_p = None if min_p is None else float(min_p)
         # The engine-wide defaults feed _resolve_kp exactly like request
         # values do, so they get the same validity check — a top_k=0
         # default would otherwise silently DISABLE truncation (rank < 0
@@ -436,6 +459,12 @@ class ContinuousBatcher:
         ):
             raise ValueError(
                 f"top_p must be finite and in (0, 1], got {top_p}"
+            )
+        if self._min_p is not None and not (
+            math.isfinite(self._min_p) and 0 <= self._min_p <= 1
+        ):
+            raise ValueError(
+                f"min_p must be finite and in [0, 1], got {min_p}"
             )
         self._eos_id = None if eos_id is None else int(eos_id)
         # Per-request sampling seeds: explicit request seeds pass
@@ -536,9 +565,18 @@ class ContinuousBatcher:
         top_k: int | None = None,
         top_p: float | None = None,
         seed: int | None = None,
+        min_p: float | None = None,
     ) -> None:
         if seed is not None and not isinstance(seed, int):
             raise ValueError(f"seed must be an int, got {seed!r}")
+        if min_p is not None and not (
+            isinstance(min_p, (int, float))
+            and math.isfinite(min_p)
+            and 0 <= min_p <= 1
+        ):
+            raise ValueError(
+                f"min_p must be finite and in [0, 1], got {min_p!r}"
+            )
         if top_k is not None and (not isinstance(top_k, int) or top_k < 1):
             raise ValueError(f"top_k must be an int >= 1, got {top_k!r}")
         if top_p is not None and not (
@@ -626,6 +664,7 @@ class ContinuousBatcher:
         top_k: int | None = None,
         top_p: float | None = None,
         seed: "int | list[int] | None" = None,
+        min_p: float | None = None,
     ) -> list[_Pending]:
         """Validate then enqueue a group ATOMICALLY: either every row is
         accepted or none is — a partially admitted multi-row request
@@ -655,7 +694,7 @@ class ContinuousBatcher:
         for (tokens, _), rs in zip(requests, row_seeds):
             self._validate(
                 tokens, max_new_tokens, temperature, adapter, stop,
-                top_k, top_p, rs,
+                top_k, top_p, rs, min_p,
             )
         ps = [
             _Pending(
@@ -665,6 +704,7 @@ class ContinuousBatcher:
                 temperature=temperature,
                 top_k=top_k,
                 top_p=top_p,
+                min_p=min_p,
                 seed=rs,
                 eos_id=eos_id,
                 adapter=int(adapter or 0),
@@ -712,10 +752,11 @@ class ContinuousBatcher:
         top_k: int | None = None,
         top_p: float | None = None,
         seed: int | None = None,
+        min_p: float | None = None,
     ) -> _Pending:
         return self._enqueue_all(
             [(tokens, sink)], max_new_tokens, temperature, eos_id,
-            adapter, stop, top_k, top_p, seed,
+            adapter, stop, top_k, top_p, seed, min_p,
         )[0]
 
     def submit(
@@ -730,6 +771,7 @@ class ContinuousBatcher:
         top_k: int | None = None,
         top_p: float | None = None,
         seed: int | None = None,
+        min_p: float | None = None,
     ) -> "list[int] | tuple[list[int], list[float]]":
         """Blocking decode. ``temperature``, ``top_k``, ``top_p`` and
         ``eos_id`` override the engine-wide defaults FOR THIS REQUEST
@@ -745,7 +787,7 @@ class ContinuousBatcher:
         p = self._enqueue(
             tokens, max_new_tokens, temperature=temperature,
             eos_id=eos_id, adapter=adapter, stop=stop,
-            top_k=top_k, top_p=top_p, seed=seed,
+            top_k=top_k, top_p=top_p, seed=seed, min_p=min_p,
         )
         p.event.wait()
         if p.error is not None:
@@ -766,6 +808,7 @@ class ContinuousBatcher:
         top_k: int | None = None,
         top_p: float | None = None,
         seed: "int | list[int] | None" = None,
+        min_p: float | None = None,
     ) -> "list[list[int]] | tuple[list[list[int]], list[list[float]]]":
         """Blocking decode of several prompts admitted ATOMICALLY (all
         rows accepted or an EngineOverloaded/ValueError before any row
@@ -781,6 +824,7 @@ class ContinuousBatcher:
             top_k,
             top_p,
             seed,
+            min_p,
         )
         for p in ps:
             p.event.wait()
@@ -803,6 +847,7 @@ class ContinuousBatcher:
         top_k: int | None = None,
         top_p: float | None = None,
         seed: int | None = None,
+        min_p: float | None = None,
     ):
         """Yield completion tokens AS THEY DECODE (one engine step of
         latency each) instead of blocking for the full result.
@@ -828,6 +873,7 @@ class ContinuousBatcher:
             top_k=top_k,
             top_p=top_p,
             seed=seed,
+            min_p=min_p,
         )
 
         # An explicit iterator, NOT a generator: close() on a
@@ -1325,7 +1371,8 @@ class ContinuousBatcher:
         # parked rows must not flip _sample_rows' any-row-truncates cond
         kps = jnp.tile(
             jnp.asarray(
-                [[float(self._model.cfg.vocab_size), 1.0]], jnp.float32
+                [[float(self._model.cfg.vocab_size), 1.0, 0.0]],
+                jnp.float32,
             ),
             (b, 1),
         )
@@ -1347,12 +1394,14 @@ class ContinuousBatcher:
             self._temperature if p.temperature is None else p.temperature
         )
         if temp <= 0:
-            return jnp.asarray([[float(vocab), 1.0]], jnp.float32)
+            return jnp.asarray([[float(vocab), 1.0, 0.0]], jnp.float32)
         k = p.top_k if p.top_k is not None else self._top_k
         k = vocab if k is None else min(int(k), vocab)
         q = p.top_p if p.top_p is not None else self._top_p
         q = 1.0 if q is None else float(q)
-        return jnp.asarray([[float(k), q]], jnp.float32)
+        m = p.min_p if p.min_p is not None else self._min_p
+        m = 0.0 if m is None else float(m)
+        return jnp.asarray([[float(k), q, m]], jnp.float32)
 
     def _resolve_seed(self, p: _Pending):
         """(1,) uint32 sampling seed: the request's, else one drawn from

@@ -199,6 +199,7 @@ class _Handler(BaseHTTPRequestHandler):
             req_top_k = payload.get("top_k")
             req_top_p = payload.get("top_p")
             req_seed = payload.get("seed")
+            req_min_p = payload.get("min_p")
             want_logprobs = bool(payload.get("logprobs"))
             if (
                 temperature is not None
@@ -210,13 +211,14 @@ class _Handler(BaseHTTPRequestHandler):
                 or req_top_k is not None
                 or req_top_p is not None
                 or req_seed is not None
+                or req_min_p is not None
                 or want_logprobs
             ) and self.gen_engine is None:
                 raise ValueError(
                     "per-request temperature/max_new_tokens/eos_id/"
-                    "adapter/stop/n/top_k/top_p/seed/logprobs require "
-                    "--gen-engine continuous (the fixed path bakes "
-                    "decode params at startup)"
+                    "adapter/stop/n/top_k/top_p/min_p/seed/logprobs "
+                    "require --gen-engine continuous (the fixed path "
+                    "bakes decode params at startup)"
                 )
             if temperature is not None:
                 temperature = float(temperature)
@@ -240,6 +242,8 @@ class _Handler(BaseHTTPRequestHandler):
                 req_top_p = float(req_top_p)
             if req_seed is not None:
                 req_seed = int(req_seed)
+            if req_min_p is not None:
+                req_min_p = float(req_min_p)
             if n_samples is not None:
                 n_samples = int(n_samples)
                 if not 1 <= n_samples <= 16:
@@ -289,6 +293,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._engine_stream(
                 prompts[0], temperature, max_new, eos_id, want_logprobs,
                 adapter, stop, req_top_k, req_top_p, req_seed,
+                req_min_p,
             )
             return
         from tensorflowonspark_tpu.serving import EngineOverloaded
@@ -302,7 +307,7 @@ class _Handler(BaseHTTPRequestHandler):
                     completions = self._engine_generate(
                         fan, temperature, max_new, eos_id,
                         want_logprobs, adapter, stop, req_top_k,
-                        req_top_p, req_seed,
+                        req_top_p, req_seed, req_min_p,
                     )
                     if want_logprobs:
                         completions, logprobs = completions
@@ -361,6 +366,7 @@ class _Handler(BaseHTTPRequestHandler):
         top_k=None,
         top_p=None,
         seed=None,
+        min_p=None,
     ) -> None:
         """Stream one completion as newline-delimited JSON: a
         ``{"token": t}`` line per decoded token (one engine step of
@@ -382,6 +388,7 @@ class _Handler(BaseHTTPRequestHandler):
                 top_k=top_k,
                 top_p=top_p,
                 seed=seed,
+                min_p=min_p,
             )
         except EngineOverloaded as e:
             self._reply(503, {"error": str(e)}, {"Retry-After": "1"})
@@ -448,6 +455,7 @@ class _Handler(BaseHTTPRequestHandler):
         top_k=None,
         top_p=None,
         seed=None,
+        min_p=None,
     ):
         """Continuous-batching path: the request's rows are admitted
         ATOMICALLY (all accepted, or a 400/503 before any decodes — a
@@ -465,6 +473,7 @@ class _Handler(BaseHTTPRequestHandler):
             top_k=top_k,
             top_p=top_p,
             seed=seed,
+            min_p=min_p,
         )
 
 
@@ -701,6 +710,7 @@ def _build_engine(gen: dict):
         temperature=float(gen.get("temperature", 0.0)),
         top_k=gen.get("top_k"),
         top_p=gen.get("top_p"),
+        min_p=gen.get("min_p"),
         eos_id=gen.get("eos_id"),
         seed=int(gen.get("seed", 0)),
         mesh=mesh,
@@ -736,6 +746,10 @@ def _build_gen_fn(gen: dict):
         decode_batches,
     )
 
+    if gen.get("min_p") is not None:
+        # fail at startup, not by silently serving without the filter:
+        # the fixed path's generate() has no min_p
+        raise ValueError("--min-p requires --gen-engine continuous")
     cfg = _load_config(
         argparse.Namespace(
             model=gen["model"], config_overrides=gen.get("config_overrides")
@@ -961,6 +975,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-k", type=int, default=None)
     p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--min-p", type=float, default=None)
     p.add_argument("--eos-id", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
@@ -1086,6 +1101,7 @@ def main(argv: list[str] | None = None) -> int:
             temperature=args.temperature,
             top_k=args.top_k,
             top_p=args.top_p,
+            min_p=args.min_p,
             eos_id=args.eos_id,
             seed=args.seed,
             mesh=args.gen_mesh,

@@ -426,11 +426,22 @@ def test_serve_model_continuous_engine(tmp_path):
         )
         assert code == 200, body
         assert body["completions"] == [full]
+        # min_p ~ 1 keeps only the most likely token -> greedy as well
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[2, 4]], "temperature": 0.9, "min_p": 0.9999},
+        )
+        assert code == 200, body
+        assert body["completions"] == [full]
         # invalid truncation params are a 400, engine-validated
         code, body = _post(
             port, "/generate", {"prompts": [[2, 4]], "top_p": 0}
         )
         assert code == 400 and "top_p" in body["error"]
+        code, body = _post(
+            port, "/generate", {"prompts": [[2, 4]], "min_p": 1.5}
+        )
+        assert code == 400 and "min_p" in body["error"]
 
         # scheduler observability
         import urllib.request
@@ -441,9 +452,9 @@ def test_serve_model_continuous_engine(tmp_path):
             stats = json.loads(r.read())
         assert stats["mode"] == "continuous"
         assert stats["slots"] == 3
-        # +2 multi-row, +1 over-width, +1 stop-sequence, +1 top_k=1
-        # request (the rejected top_p never admits)
-        assert stats["admitted"] == len(prompts) + 5
+        # +2 multi-row, +1 over-width, +1 stop-sequence, +1 top_k=1,
+        # +1 min_p request (the rejected top_p/min_p never admit)
+        assert stats["admitted"] == len(prompts) + 6
         assert stats["steps"] > 0 and not stats["closed"]
         # the CLI-wired prefix cache is live and accounted in /stats
         assert stats["prefix_cache_entries"] > 0

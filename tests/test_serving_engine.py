@@ -556,7 +556,11 @@ def test_sample_rows_mixed_rows_respect_own_truncation():
     logits = jnp.asarray(rng.normal(0, 2, (3, vocab)), jnp.float32)
     temps = jnp.full((3,), 1.0, jnp.float32)
     kps = jnp.asarray(
-        [[1.0, 1.0], [float(vocab), 1e-6], [float(vocab), 1.0]],
+        [
+            [1.0, 1.0, 0.0],
+            [float(vocab), 1e-6, 0.0],
+            [float(vocab), 1.0, 0.0],
+        ],
         jnp.float32,
     )
     counters = jnp.asarray([4, 4, 4], jnp.int32)
@@ -579,7 +583,9 @@ def test_sample_rows_keys_are_per_row_seed_and_counter():
     vocab = 64
     logits = jnp.asarray(np.tile(rng.normal(0, 1, (1, vocab)), (3, 1)))
     temps = jnp.full((3,), 5.0, jnp.float32)  # near-uniform sampling
-    kps = jnp.tile(jnp.asarray([[float(vocab), 1.0]], jnp.float32), (3, 1))
+    kps = jnp.tile(
+        jnp.asarray([[float(vocab), 1.0, 0.0]], jnp.float32), (3, 1)
+    )
 
     # rows 0 and 1 share (seed, counter): identical draws; row 2 differs
     seeds = jnp.asarray([9, 9, 10], jnp.uint32)
@@ -695,15 +701,72 @@ def test_resolve_kp_greedy_rows_disable_truncation(tiny):
         vocab = float(cfg.vocab_size)
         mk = lambda **kw: _Pending([1], 1, _threading.Event(), **kw)
         # engine default temperature is 0 -> disabled
-        assert np.asarray(eng._resolve_kp(mk())).tolist() == [[vocab, 1.0]]
+        assert np.asarray(eng._resolve_kp(mk())).tolist() == [
+            [vocab, 1.0, 0.0]
+        ]
         # explicit greedy request likewise
         assert np.asarray(
             eng._resolve_kp(mk(temperature=0.0, top_k=4))
-        ).tolist() == [[vocab, 1.0]]
+        ).tolist() == [[vocab, 1.0, 0.0]]
         # a sampled request gets the engine defaults
         assert np.asarray(
             eng._resolve_kp(mk(temperature=0.7))
-        ).tolist() == [[8.0, pytest.approx(0.9)]]
+        ).tolist() == [[8.0, pytest.approx(0.9), 0.0]]
+    finally:
+        eng.close()
+
+
+def test_sample_rows_min_p_keeps_near_max_tokens_only():
+    """min_p keeps tokens with prob >= min_p * prob_max on the scaled
+    distribution: min_p ~ 1 reduces to argmax; a moderate min_p's mask
+    matches the numpy reference; min_p = 0 rows are untouched."""
+    from tensorflowonspark_tpu.serving.engine import _sample_rows
+
+    rng = np.random.default_rng(3)
+    vocab = 48
+    logits = jnp.asarray(rng.normal(0, 2, (2, vocab)), jnp.float32)
+    temps = jnp.full((2,), 1.0, jnp.float32)
+    counters = jnp.asarray([5, 5], jnp.int32)
+    greedy = np.asarray(jnp.argmax(logits, -1))
+
+    # min_p ~ 1 -> only the max survives
+    kps = jnp.asarray(
+        [[float(vocab), 1.0, 0.999], [float(vocab), 1.0, 0.0]],
+        jnp.float32,
+    )
+    for seed in range(5):
+        seeds = jnp.full((2,), seed, jnp.uint32)
+        tok, _ = _sample_rows(logits, temps, kps, seeds, counters)
+        assert np.asarray(tok)[0] == greedy[0]
+
+    # moderate min_p: every sampled token is in the reference keep-set
+    probs = np.asarray(jax.nn.softmax(logits, -1))
+    keep = probs >= 0.3 * probs.max(-1, keepdims=True)
+    kps = jnp.asarray(
+        [[float(vocab), 1.0, 0.3], [float(vocab), 1.0, 0.3]], jnp.float32
+    )
+    for seed in range(20):
+        seeds = jnp.full((2,), seed, jnp.uint32)
+        tok, _ = _sample_rows(logits, temps, kps, seeds, counters)
+        t = np.asarray(tok)
+        assert keep[0, t[0]] and keep[1, t[1]], (seed, t)
+
+
+def test_engine_per_request_min_p(tiny):
+    """Per-request min_p rides the same traced path: min_p ~ 1 decodes
+    greedily on a sampling engine; invalid values are rejected."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(
+        model, params, slots=2, prompt_widths=(8,), temperature=0.9,
+    )
+    try:
+        greedy_want = eng.submit([1, 2, 3], 6, temperature=0.0)
+        got = eng.submit([1, 2, 3], 6, min_p=0.9999)
+        assert got == greedy_want
+        with pytest.raises(ValueError, match="min_p"):
+            eng.submit([1], 2, min_p=1.5)
+        with pytest.raises(ValueError, match="min_p"):
+            eng.submit([1], 2, min_p=float("nan"))
     finally:
         eng.close()
 
