@@ -882,6 +882,7 @@ def generate(
     temperature: float = 0.0,
     top_k: int | None = None,
     top_p: float | None = None,
+    min_p: float | None = None,
     rng: jax.Array | None = None,
     eos_id: int | None = None,
     prompt_lengths: jax.Array | None = None,
@@ -895,7 +896,10 @@ def generate(
     ``temperature=0`` is greedy argmax; otherwise tokens are sampled from
     ``logits / temperature``, optionally truncated to the ``top_k`` most
     likely tokens and/or the smallest nucleus with cumulative probability
-    ``top_p`` (top-k applies first, like the standard decoding stacks).
+    ``top_p`` (top-k applies first, like the standard decoding stacks)
+    and/or ``min_p`` (keep tokens whose probability is at least
+    ``min_p`` times the most likely token's; composes with k/p by mask
+    intersection).
 
     Mixed-length prompts: RIGHT-pad ``prompt`` and pass
     ``prompt_lengths`` (B,) true lengths. Each row samples its first
@@ -934,10 +938,14 @@ def generate(
         raise ValueError("top_k must be >= 1")
     if top_p is not None and not (0.0 < top_p <= 1.0):
         raise ValueError("top_p must be in (0, 1]")
-    if temperature == 0.0 and (top_k is not None or top_p is not None):
+    if min_p is not None and not (0.0 <= min_p <= 1.0):
+        raise ValueError("min_p must be in [0, 1]")
+    if temperature == 0.0 and (
+        top_k is not None or top_p is not None or min_p is not None
+    ):
         raise ValueError(
-            "top_k/top_p require temperature > 0 (temperature=0 is greedy "
-            "argmax, which would silently ignore them)"
+            "top_k/top_p/min_p require temperature > 0 (temperature=0 is "
+            "greedy argmax, which would silently ignore them)"
         )
     rng = jax.random.PRNGKey(0) if rng is None else rng
     if mesh is not None:
@@ -975,6 +983,7 @@ def generate(
         None if eos_id is None else int(eos_id),
         padded=prompt_lengths is not None,
         mesh=mesh,
+        min_p=None if min_p is None else float(min_p),
     )
     if prompt_lengths is None:
         return run(params, prompt, rng)
@@ -998,15 +1007,20 @@ def generate(
     return run(params, prompt, rng, lengths)
 
 
-def sample_logits(logits, key, temperature, top_k=None, top_p=None):
+def sample_logits(
+    logits, key, temperature, top_k=None, top_p=None, min_p=None
+):
     """Sample next tokens from (B, vocab) logits.
 
     ``temperature == 0`` is greedy argmax (``key`` unused). Otherwise
     sample from ``logits / temperature``, optionally truncated to the
     ``top_k`` most likely tokens and/or the smallest nucleus with
     cumulative probability ``top_p`` (top-k applies first, matching the
-    standard decoding stacks). Sampling params are trace-time constants
-    — callers bake them into their jitted program.
+    standard decoding stacks), and/or ``min_p`` (keep tokens whose
+    probability is at least ``min_p`` times the most likely token's —
+    an elementwise row-max compare on the scaled distribution,
+    composing with k/p by mask intersection). Sampling params are
+    trace-time constants — callers bake them into their jitted program.
     """
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -1035,6 +1049,15 @@ def sample_logits(logits, key, temperature, top_k=None, top_p=None):
             sorted_desc, cutoff_index, axis=-1
         )
         logits = jnp.where(logits < cutoff_logit, -jnp.inf, logits)
+    if min_p is not None and min_p > 0.0:
+        # log-space: prob >= min_p * prob_max  <=>  logit >= max + log(m),
+        # on the temperature-scaled distribution. The row max survives
+        # any k/p mask above (the most likely token is never truncated),
+        # and already-masked entries stay -inf, so this intersects.
+        floor = jnp.max(logits, axis=-1, keepdims=True) + jnp.log(
+            jnp.float32(min_p)
+        )
+        logits = jnp.where(logits < floor, -jnp.inf, logits)
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
@@ -1050,6 +1073,7 @@ def _build_generate(
     eos_id: int | None = None,
     padded: bool = False,
     mesh: Mesh | None = None,
+    min_p: float | None = None,
 ):
     """Compile-once generate body per (model config, shapes, sampling
     params).
@@ -1077,7 +1101,9 @@ def _build_generate(
         )
 
     def sample(logits, key):
-        return sample_logits(logits, key, temperature, top_k, top_p)
+        return sample_logits(
+            logits, key, temperature, top_k, top_p, min_p
+        )
 
     @jax.jit
     def run(params, prompt, rng, lengths=None):

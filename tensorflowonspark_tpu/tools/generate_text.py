@@ -73,6 +73,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-k", type=int, default=None)
     p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--min-p", type=float, default=None)
     p.add_argument("--eos-id", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
@@ -192,6 +193,7 @@ def decode_batches(
     temperature: float = 0.0,
     top_k: int | None = None,
     top_p: float | None = None,
+    min_p: float | None = None,
     eos_id: int | None = None,
     uniform: bool = False,
     pad_to_batch: bool = False,
@@ -236,12 +238,14 @@ def decode_batches(
 
     if batch_size < 1:
         raise ValueError(f"batch_size must be >= 1, got {batch_size}")
-    if draft is not None and (top_k is not None or top_p is not None):
+    if draft is not None and (
+        top_k is not None or top_p is not None or min_p is not None
+    ):
         raise ValueError(
             "speculative decoding supports greedy (temperature 0) and "
-            "plain-temperature sampling, not top_k/top_p truncation "
-            "(truncation would change the distribution the rejection "
-            "rule preserves)"
+            "plain-temperature sampling, not top_k/top_p/min_p "
+            "truncation (truncation would change the distribution the "
+            "rejection rule preserves)"
         )
     if not prompts:
         raise PromptError("no prompts given")
@@ -295,6 +299,7 @@ def decode_batches(
                     temperature=temperature,
                     top_k=top_k,
                     top_p=top_p,
+                    min_p=min_p,
                     rng=key,
                     eos_id=eos_id,
                     prompt_lengths=None if uniform else lengths,
@@ -469,6 +474,7 @@ def main(argv: list[str] | None = None) -> int:
         temperature=args.temperature,
         top_k=args.top_k,
         top_p=args.top_p,
+        min_p=args.min_p,
         eos_id=args.eos_id,
         # uniform corpora skip the padded path's scatter writes
         uniform=all(len(p) == width for p in prompts),

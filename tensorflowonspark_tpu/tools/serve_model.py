@@ -746,10 +746,16 @@ def _build_gen_fn(gen: dict):
         decode_batches,
     )
 
-    if gen.get("min_p") is not None:
-        # fail at startup, not by silently serving without the filter:
-        # the fixed path's generate() has no min_p
-        raise ValueError("--min-p requires --gen-engine continuous")
+    if float(gen.get("temperature", 0.0)) == 0.0 and any(
+        gen.get(k) is not None for k in ("top_k", "top_p", "min_p")
+    ):
+        # generate() raises the same error per call; surface it at
+        # startup, BEFORE the (potentially multi-GB) checkpoint restore
+        raise ValueError(
+            "--top-k/--top-p/--min-p require --temperature > 0 "
+            "(temperature 0 is greedy argmax, which would silently "
+            "ignore them)"
+        )
     cfg = _load_config(
         argparse.Namespace(
             model=gen["model"], config_overrides=gen.get("config_overrides")
@@ -777,10 +783,14 @@ def _build_gen_fn(gen: dict):
         spec_k = int(gen.get("spec_k", 4))
         if spec_k < 1:
             raise ValueError(f"--spec-k must be >= 1, got {spec_k}")
-        if gen.get("top_k") is not None or gen.get("top_p") is not None:
+        if (
+            gen.get("top_k") is not None
+            or gen.get("top_p") is not None
+            or gen.get("min_p") is not None
+        ):
             raise ValueError(
                 "--draft-checkpoint supports greedy and plain-"
-                "temperature sampling; drop --top-k/--top-p "
+                "temperature sampling; drop --top-k/--top-p/--min-p "
                 "(truncation would change the distribution the "
                 "rejection rule preserves)"
             )
@@ -845,6 +855,7 @@ def _build_gen_fn(gen: dict):
             temperature=float(gen.get("temperature", 0.0)),
             top_k=gen.get("top_k"),
             top_p=gen.get("top_p"),
+            min_p=gen.get("min_p"),
             eos_id=gen.get("eos_id"),
         )
         return out

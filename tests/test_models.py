@@ -233,10 +233,23 @@ def test_llama_generate_topk_topp(tiny_llama):
     np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
     assert int(np.asarray(s1).min()) >= 0
     assert int(np.asarray(s1).max()) < cfg.vocab_size
+    # min_p ~ 1 keeps only the most likely token -> greedy again; it
+    # composes with k/p by mask intersection (the static twin of the
+    # engine's per-row filter)
+    m1 = generate(
+        model, params, prompt, 6, temperature=1.0, min_p=0.9999
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(m1))
+    m2 = generate(
+        model, params, prompt, 6, temperature=1.0, top_k=5, min_p=0.9999
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(m2))
     with pytest.raises(ValueError, match="top_k"):
         generate(model, params, prompt, 2, top_k=0)
     with pytest.raises(ValueError, match="top_p"):
         generate(model, params, prompt, 2, top_p=1.5)
+    with pytest.raises(ValueError, match="min_p"):
+        generate(model, params, prompt, 2, temperature=1.0, min_p=1.5)
     with pytest.raises(ValueError, match="temperature"):
         generate(model, params, prompt, 2, top_k=5)  # greedy + top_k
 
