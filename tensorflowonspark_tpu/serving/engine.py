@@ -90,7 +90,7 @@ def _row_truncate(scaled, ks, ps):
     return jnp.where(scaled < cutoff, -jnp.inf, scaled)
 
 
-def _sample_rows(logits, temps, kps, seeds, counters):
+def _sample_rows(logits, temps, kps, seeds, counters, pens=None, counts=None):
     """Per-row sampling over (B, vocab) logits.
 
     Every sampling input is a TRACED per-row value — no recompilation
@@ -110,12 +110,31 @@ def _sample_rows(logits, temps, kps, seeds, counters):
     greedy and plain-temperature batches — the benchmarked configs —
     skip the full-vocab sort entirely.
 
+    ``pens`` (B, 2) [frequency_penalty, presence_penalty] with
+    ``counts`` (B, vocab) per-row generated-token counts applies the
+    OpenAI-convention repetition penalties BEFORE temperature scaling
+    (and before the greedy argmax — penalties shape greedy rows too):
+    ``logit - freq*count - pres*(count > 0)``. Cond-gated: batches with
+    all-zero penalties never touch the count plane.
+
     Returns ``(tokens (B,) int32, logprobs (B,) fp32)`` — the logprob
-    of each chosen token under the RAW (unscaled) model distribution,
-    the same convention the /score surface reports, so sampled and
-    scored numbers compare directly.
+    of each chosen token under the RAW (unscaled, unpenalized) model
+    distribution, the same convention the /score surface reports, so
+    sampled and scored numbers compare directly.
     """
     vocab = logits.shape[-1]
+    raw = logits
+    if pens is not None:
+        def _penalize(lg):
+            return (
+                lg.astype(jnp.float32)
+                - pens[:, :1] * counts
+                - pens[:, 1:] * (counts > 0)
+            ).astype(lg.dtype)
+
+        logits = jax.lax.cond(
+            jnp.any(pens != 0.0), _penalize, lambda lg: lg, logits
+        )
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
     ks, ps, ms = kps[:, 0], kps[:, 1], kps[:, 2]
@@ -150,7 +169,7 @@ def _sample_rows(logits, temps, kps, seeds, counters):
         jnp.int32
     )
     tok = jnp.where(temps > 0, sampled, greedy)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    logp = jax.nn.log_softmax(raw.astype(jnp.float32), axis=-1)
     lp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
     return tok, lp
 
@@ -164,6 +183,8 @@ class _Pending:
     top_k: int | None = None  # None = the engine-wide default
     top_p: float | None = None  # None = the engine-wide default
     min_p: float | None = None  # None = the engine-wide default
+    frequency_penalty: float | None = None  # None/0 = disabled
+    presence_penalty: float | None = None  # None/0 = disabled
     # None = engine-drawn (independent, nondeterministic across
     # submissions); set = reproducible completion for this request
     seed: int | None = None
@@ -260,6 +281,7 @@ class _PrefillJob:
     temp_1: object  # (1,) fp32
     kp_1: object  # (1, 3) fp32 resolved [top_k, top_p, min_p]
     seed_1: object  # (1,) uint32 resolved sampling seed
+    pen_1: object  # (1, 2) fp32 [frequency_penalty, presence_penalty]
     ad_1: object  # (1,) int32 adapter id
     # next prompt depth at which to store a chunk-boundary prefix entry
     # (doubles after each insert — see _advance_job)
@@ -566,9 +588,24 @@ class ContinuousBatcher:
         top_p: float | None = None,
         seed: int | None = None,
         min_p: float | None = None,
+        frequency_penalty: float | None = None,
+        presence_penalty: float | None = None,
     ) -> None:
         if seed is not None and not isinstance(seed, int):
             raise ValueError(f"seed must be an int, got {seed!r}")
+        for nm, v in (
+            ("frequency_penalty", frequency_penalty),
+            ("presence_penalty", presence_penalty),
+        ):
+            # OpenAI's documented range; NaN fails the bounds check
+            if v is not None and not (
+                isinstance(v, (int, float))
+                and math.isfinite(v)
+                and -2.0 <= v <= 2.0
+            ):
+                raise ValueError(
+                    f"{nm} must be finite and in [-2, 2], got {v!r}"
+                )
         if min_p is not None and not (
             isinstance(min_p, (int, float))
             and math.isfinite(min_p)
@@ -665,6 +702,8 @@ class ContinuousBatcher:
         top_p: float | None = None,
         seed: "int | list[int] | None" = None,
         min_p: float | None = None,
+        frequency_penalty: float | None = None,
+        presence_penalty: float | None = None,
     ) -> list[_Pending]:
         """Validate then enqueue a group ATOMICALLY: either every row is
         accepted or none is — a partially admitted multi-row request
@@ -694,7 +733,8 @@ class ContinuousBatcher:
         for (tokens, _), rs in zip(requests, row_seeds):
             self._validate(
                 tokens, max_new_tokens, temperature, adapter, stop,
-                top_k, top_p, rs, min_p,
+                top_k, top_p, rs, min_p, frequency_penalty,
+                presence_penalty,
             )
         ps = [
             _Pending(
@@ -705,6 +745,8 @@ class ContinuousBatcher:
                 top_k=top_k,
                 top_p=top_p,
                 min_p=min_p,
+                frequency_penalty=frequency_penalty,
+                presence_penalty=presence_penalty,
                 seed=rs,
                 eos_id=eos_id,
                 adapter=int(adapter or 0),
@@ -753,10 +795,13 @@ class ContinuousBatcher:
         top_p: float | None = None,
         seed: int | None = None,
         min_p: float | None = None,
+        frequency_penalty: float | None = None,
+        presence_penalty: float | None = None,
     ) -> _Pending:
         return self._enqueue_all(
             [(tokens, sink)], max_new_tokens, temperature, eos_id,
             adapter, stop, top_k, top_p, seed, min_p,
+            frequency_penalty, presence_penalty,
         )[0]
 
     def submit(
@@ -772,6 +817,8 @@ class ContinuousBatcher:
         top_p: float | None = None,
         seed: int | None = None,
         min_p: float | None = None,
+        frequency_penalty: float | None = None,
+        presence_penalty: float | None = None,
     ) -> "list[int] | tuple[list[int], list[float]]":
         """Blocking decode. ``temperature``, ``top_k``, ``top_p`` and
         ``eos_id`` override the engine-wide defaults FOR THIS REQUEST
@@ -788,6 +835,8 @@ class ContinuousBatcher:
             tokens, max_new_tokens, temperature=temperature,
             eos_id=eos_id, adapter=adapter, stop=stop,
             top_k=top_k, top_p=top_p, seed=seed, min_p=min_p,
+            frequency_penalty=frequency_penalty,
+            presence_penalty=presence_penalty,
         )
         p.event.wait()
         if p.error is not None:
@@ -809,6 +858,8 @@ class ContinuousBatcher:
         top_p: float | None = None,
         seed: "int | list[int] | None" = None,
         min_p: float | None = None,
+        frequency_penalty: float | None = None,
+        presence_penalty: float | None = None,
     ) -> "list[list[int]] | tuple[list[list[int]], list[list[float]]]":
         """Blocking decode of several prompts admitted ATOMICALLY (all
         rows accepted or an EngineOverloaded/ValueError before any row
@@ -825,6 +876,8 @@ class ContinuousBatcher:
             top_p,
             seed,
             min_p,
+            frequency_penalty,
+            presence_penalty,
         )
         for p in ps:
             p.event.wait()
@@ -848,6 +901,8 @@ class ContinuousBatcher:
         top_p: float | None = None,
         seed: int | None = None,
         min_p: float | None = None,
+        frequency_penalty: float | None = None,
+        presence_penalty: float | None = None,
     ):
         """Yield completion tokens AS THEY DECODE (one engine step of
         latency each) instead of blocking for the full result.
@@ -874,6 +929,8 @@ class ContinuousBatcher:
             top_p=top_p,
             seed=seed,
             min_p=min_p,
+            frequency_penalty=frequency_penalty,
+            presence_penalty=presence_penalty,
         )
 
         # An explicit iterator, NOT a generator: close() on a
@@ -1052,7 +1109,7 @@ class ContinuousBatcher:
         constrain = self._constrain_cache
 
         @jax.jit
-        def step(params, cache, tok, pos, temps, ads, kps, seeds):
+        def step(params, cache, tok, pos, temps, ads, kps, seeds, pens, counts):
             logits, updated = model.apply(
                 {"params": params, "cache": cache},
                 tok[:, None],
@@ -1071,13 +1128,23 @@ class ContinuousBatcher:
             # the sampled token will occupy position pos+1 (unclamped:
             # the cache-write clamp below must not alias two counters)
             nxt, lp = _sample_rows(
-                logits[:, -1], temps, kps, seeds, pos + 1
+                logits[:, -1], temps, kps, seeds, pos + 1, pens, counts
+            )
+            # the emitted token enters its row's generated-token counts
+            # (cond: all-unpenalized batches never write the plane)
+            counts = jax.lax.cond(
+                jnp.any(pens != 0.0),
+                lambda c: c + jax.nn.one_hot(
+                    nxt, c.shape[-1], dtype=c.dtype
+                ),
+                lambda c: c,
+                counts,
             )
             # Clamp so a retired-but-not-yet-reused row parked at the
             # cache edge never scatters out of bounds (its writes are
             # garbage either way; admission overwrites the whole row).
             nxt_pos = jnp.minimum(pos + 1, model.cfg.max_seq_len - 1)
-            return constrain(updated["cache"]), nxt, nxt_pos, lp
+            return constrain(updated["cache"]), nxt, nxt_pos, lp, counts
 
         return step
 
@@ -1121,6 +1188,7 @@ class ContinuousBatcher:
         def admit(
             cache_b, cache_1, row, tok_b, tok_1, pos_b, pos_1,
             temps_b, temp_1, ads_b, ad_1, kps_b, kp_1, seeds_b, seed_1,
+            pens_b, pen_1, counts_b,
         ):
             def scatter(leaf_b, leaf_1):
                 if leaf_b.ndim == 0:  # per-layer scalar write index:
@@ -1137,7 +1205,17 @@ class ContinuousBatcher:
             ads = jax.lax.dynamic_update_slice(ads_b, ad_1, (row,))
             kps = jax.lax.dynamic_update_slice(kps_b, kp_1, (row, 0))
             seeds = jax.lax.dynamic_update_slice(seeds_b, seed_1, (row,))
-            return cache, tok, pos, temps, ads, kps, seeds
+            pens = jax.lax.dynamic_update_slice(pens_b, pen_1, (row, 0))
+            # the row's generated-token counts restart at ONE for the
+            # prefill-sampled first token (penalties count generated
+            # tokens; the prompt is not penalized - documented)
+            counts_1 = jax.nn.one_hot(
+                tok_1[:1], counts_b.shape[-1], dtype=counts_b.dtype
+            )
+            counts = jax.lax.dynamic_update_slice(
+                counts_b, counts_1, (row, 0)
+            )
+            return cache, tok, pos, temps, ads, kps, seeds, pens, counts
 
         return admit
 
@@ -1232,13 +1310,16 @@ class ContinuousBatcher:
             temp_1=jnp.asarray([temp], jnp.float32),
             kp_1=self._resolve_kp(p),
             seed_1=self._resolve_seed(p),
+            pen_1=self._resolve_pen(p),
             ad_1=jnp.asarray([p.adapter], jnp.int32),
             # first boundary entry lands at the first chunk boundary
             # past the resume point, then depths double
             next_insert_depth=self._prefill_chunk or 0,
         )
 
-    def _advance_job(self, cache, tok, pos, temps, ads, kps, seeds):
+    def _advance_job(
+        self, cache, tok, pos, temps, ads, kps, seeds, pens, counts
+    ):
         """Run ONE chunk of the in-flight prefill; on the final chunk,
         sample the first token and scatter the row into the batch.
         Chunks cover only the true prompt length — the padding region a
@@ -1247,7 +1328,7 @@ class ContinuousBatcher:
         if job.p.cancelled:
             self._resolve_unadmitted_cancel(job.p)
             self._job = None
-            return cache, tok, pos, temps, ads, kps, seeds
+            return cache, tok, pos, temps, ads, kps, seeds, pens, counts
         c = self._prefill_chunk
         # Shift the window back rather than letting positions run past
         # max_seq_len: a final chunk starting at `start` would scatter
@@ -1296,7 +1377,7 @@ class ContinuousBatcher:
                 )
                 job.next_insert_depth = 2 * job.next_pos
                 job.boundary_inserts += 1
-            return cache, tok, pos, temps, ads, kps, seeds
+            return cache, tok, pos, temps, ads, kps, seeds, pens, counts
         if self._prefix_store is not None:
             # The completed single-row cache covers the whole prompt.
             self._prefix_store.insert(
@@ -1311,7 +1392,9 @@ class ContinuousBatcher:
             job.seed_1,
             jnp.asarray([job.length], jnp.int32),
         )
-        cache, tok, pos, temps, ads, kps, seeds = self._admit_fn(
+        (
+            cache, tok, pos, temps, ads, kps, seeds, pens, counts,
+        ) = self._admit_fn(
             cache,
             job.cache_1,
             jnp.int32(job.row),
@@ -1327,6 +1410,9 @@ class ContinuousBatcher:
             job.kp_1,
             seeds,
             job.seed_1,
+            pens,
+            job.pen_1,
+            counts,
         )
         first = int(np.asarray(tok_1)[0])
         lps = [float(np.asarray(lp_1)[0])]
@@ -1336,7 +1422,7 @@ class ContinuousBatcher:
         if self._finished(job.p, [first], first):
             self._retire(job.row)
         self._job = None
-        return cache, tok, pos, temps, ads, kps, seeds
+        return cache, tok, pos, temps, ads, kps, seeds, pens, counts
 
     # -- engine loop ---------------------------------------------------
 
@@ -1377,7 +1463,9 @@ class ContinuousBatcher:
             (b, 1),
         )
         seeds = jnp.zeros((b,), jnp.uint32)
-        return cache, tok, pos, temps, ads, kps, seeds
+        pens = jnp.zeros((b, 2), jnp.float32)
+        counts = jnp.zeros((b, self._model.cfg.vocab_size), jnp.float32)
+        return cache, tok, pos, temps, ads, kps, seeds, pens, counts
 
     def _resolve_kp(self, p: _Pending):
         """(1, 2) fp32 resolved [top_k, top_p] for one request: the
@@ -1403,6 +1491,18 @@ class ContinuousBatcher:
         m = 0.0 if m is None else float(m)
         return jnp.asarray([[float(k), q, m]], jnp.float32)
 
+    def _resolve_pen(self, p: _Pending):
+        """(1, 2) fp32 [frequency_penalty, presence_penalty]; 0 =
+        disabled (no engine-wide default - penalties are a per-request
+        behavior, not a serving policy)."""
+        return jnp.asarray(
+            [[
+                float(p.frequency_penalty or 0.0),
+                float(p.presence_penalty or 0.0),
+            ]],
+            jnp.float32,
+        )
+
     def _resolve_seed(self, p: _Pending):
         """(1,) uint32 sampling seed: the request's, else one drawn from
         the engine's stream at admission (rows stay independent; the
@@ -1421,7 +1521,7 @@ class ContinuousBatcher:
 
     def _admit_one(
         self, p: _Pending, row: int, cache, tok, pos, temps, ads, kps,
-        seeds,
+        seeds, pens, counts,
     ):
         w = self._bucket(len(p.tokens))
         prompt = np.zeros((1, w), np.int32)
@@ -1444,9 +1544,12 @@ class ContinuousBatcher:
             kp_1,
             seed_1,
         )
-        cache, tok, pos, temps, ads, kps, seeds = self._admit_fn(
+        (
+            cache, tok, pos, temps, ads, kps, seeds, pens, counts,
+        ) = self._admit_fn(
             cache, cache_1, jnp.int32(row), tok, tok_1, pos, pos_1,
             temps, temp_1, ads, ad_1, kps, kp_1, seeds, seed_1,
+            pens, self._resolve_pen(p), counts,
         )
         first = int(np.asarray(tok_1)[0])
         out = [first]
@@ -1456,7 +1559,7 @@ class ContinuousBatcher:
         p.emit(first, lps[0])
         if self._finished(p, out, first):
             self._retire(row)
-        return cache, tok, pos, temps, ads, kps, seeds
+        return cache, tok, pos, temps, ads, kps, seeds, pens, counts
 
     def _finished(self, p: _Pending, out: list[int], last: int) -> bool:
         if p.cancelled:
@@ -1545,6 +1648,7 @@ class ContinuousBatcher:
 
     def _loop(self) -> None:
         cache = tok = pos = temps = ads = kps = seeds = None
+        pens = counts = None
         try:
             while True:
                 if self._stop_now.is_set():
@@ -1596,13 +1700,15 @@ class ContinuousBatcher:
                     if cache is None:
                         (
                             cache, tok, pos, temps, ads, kps, seeds,
+                            pens, counts,
                         ) = self._empty_state()
                     if self._prefill_chunk is None:
                         (
                             cache, tok, pos, temps, ads, kps, seeds,
+                            pens, counts,
                         ) = self._admit_one(
                             item, free[0], cache, tok, pos, temps, ads,
-                            kps, seeds,
+                            kps, seeds, pens, counts,
                         )
                     else:
                         self._job = self._start_job(item, free[0])
@@ -1612,16 +1718,18 @@ class ContinuousBatcher:
                 if self._job is not None:
                     (
                         cache, tok, pos, temps, ads, kps, seeds,
+                        pens, counts,
                     ) = self._advance_job(
-                        cache, tok, pos, temps, ads, kps, seeds
+                        cache, tok, pos, temps, ads, kps, seeds, pens,
+                        counts,
                     )
 
                 if all(e is None for e in self._live):
                     continue  # nothing decoding; admit/chunk again
 
-                cache, tok, pos, lp = self._step_fn(
+                cache, tok, pos, lp, counts = self._step_fn(
                     self._params, cache, tok, pos, temps, ads, kps,
-                    seeds,
+                    seeds, pens, counts,
                 )
                 self.steps += 1
                 host_tok = np.asarray(tok)

@@ -200,6 +200,8 @@ class _Handler(BaseHTTPRequestHandler):
             req_top_p = payload.get("top_p")
             req_seed = payload.get("seed")
             req_min_p = payload.get("min_p")
+            req_fpen = payload.get("frequency_penalty")
+            req_ppen = payload.get("presence_penalty")
             want_logprobs = bool(payload.get("logprobs"))
             if (
                 temperature is not None
@@ -212,13 +214,15 @@ class _Handler(BaseHTTPRequestHandler):
                 or req_top_p is not None
                 or req_seed is not None
                 or req_min_p is not None
+                or req_fpen is not None
+                or req_ppen is not None
                 or want_logprobs
             ) and self.gen_engine is None:
                 raise ValueError(
                     "per-request temperature/max_new_tokens/eos_id/"
-                    "adapter/stop/n/top_k/top_p/min_p/seed/logprobs "
-                    "require --gen-engine continuous (the fixed path "
-                    "bakes decode params at startup)"
+                    "adapter/stop/n/top_k/top_p/min_p/seed/penalties/"
+                    "logprobs require --gen-engine continuous (the "
+                    "fixed path bakes decode params at startup)"
                 )
             if temperature is not None:
                 temperature = float(temperature)
@@ -244,6 +248,10 @@ class _Handler(BaseHTTPRequestHandler):
                 req_seed = int(req_seed)
             if req_min_p is not None:
                 req_min_p = float(req_min_p)
+            if req_fpen is not None:
+                req_fpen = float(req_fpen)
+            if req_ppen is not None:
+                req_ppen = float(req_ppen)
             if n_samples is not None:
                 n_samples = int(n_samples)
                 if not 1 <= n_samples <= 16:
@@ -293,7 +301,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._engine_stream(
                 prompts[0], temperature, max_new, eos_id, want_logprobs,
                 adapter, stop, req_top_k, req_top_p, req_seed,
-                req_min_p,
+                req_min_p, req_fpen, req_ppen,
             )
             return
         from tensorflowonspark_tpu.serving import EngineOverloaded
@@ -307,7 +315,8 @@ class _Handler(BaseHTTPRequestHandler):
                     completions = self._engine_generate(
                         fan, temperature, max_new, eos_id,
                         want_logprobs, adapter, stop, req_top_k,
-                        req_top_p, req_seed, req_min_p,
+                        req_top_p, req_seed, req_min_p, req_fpen,
+                        req_ppen,
                     )
                     if want_logprobs:
                         completions, logprobs = completions
@@ -367,6 +376,8 @@ class _Handler(BaseHTTPRequestHandler):
         top_p=None,
         seed=None,
         min_p=None,
+        frequency_penalty=None,
+        presence_penalty=None,
     ) -> None:
         """Stream one completion as newline-delimited JSON: a
         ``{"token": t}`` line per decoded token (one engine step of
@@ -389,6 +400,8 @@ class _Handler(BaseHTTPRequestHandler):
                 top_p=top_p,
                 seed=seed,
                 min_p=min_p,
+                frequency_penalty=frequency_penalty,
+                presence_penalty=presence_penalty,
             )
         except EngineOverloaded as e:
             self._reply(503, {"error": str(e)}, {"Retry-After": "1"})
@@ -456,6 +469,8 @@ class _Handler(BaseHTTPRequestHandler):
         top_p=None,
         seed=None,
         min_p=None,
+        frequency_penalty=None,
+        presence_penalty=None,
     ):
         """Continuous-batching path: the request's rows are admitted
         ATOMICALLY (all accepted, or a 400/503 before any decodes — a
@@ -474,6 +489,8 @@ class _Handler(BaseHTTPRequestHandler):
             top_p=top_p,
             seed=seed,
             min_p=min_p,
+            frequency_penalty=frequency_penalty,
+            presence_penalty=presence_penalty,
         )
 
 

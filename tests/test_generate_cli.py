@@ -473,6 +473,22 @@ def test_serve_model_continuous_engine(tmp_path):
         assert body1["completions"] == body2["completions"]
         assert body1["completions"][0][0] != body1["completions"][0][1]
 
+        # repetition penalties ride per-request too: a strong
+        # frequency_penalty yields a repeat-free completion; bad values
+        # are a 400
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[1, 2]], "frequency_penalty": 2.0},
+        )
+        assert code == 200, body
+        toks = body["completions"][0]
+        assert len(set(toks)) == len(toks), toks
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[1, 2]], "presence_penalty": 9.0},
+        )
+        assert code == 400 and "presence_penalty" in body["error"]
+
         # streaming: NDJSON token lines + a done trailer matching the
         # non-streamed completion for the same prompt; with logprobs
         # each line carries the token's raw-distribution logprob
