@@ -59,6 +59,11 @@ from tensorflowonspark_tpu.models.llama import Llama
 
 logger = logging.getLogger(__name__)
 
+# Per-request logit_bias entries are capped so the (B, K) traced bias
+# arrays stay a fixed compiled shape; 16 matches the typical ban/force
+# use cases (OpenAI allows 300, but those maps thrash any static shape).
+_BIAS_SLOTS = 16
+
 
 class EngineOverloaded(RuntimeError):
     """Raised by submit()/stream() when the bounded request queue is
@@ -90,7 +95,17 @@ def _row_truncate(scaled, ks, ps):
     return jnp.where(scaled < cutoff, -jnp.inf, scaled)
 
 
-def _sample_rows(logits, temps, kps, seeds, counters, pens=None, counts=None):
+def _sample_rows(
+    logits,
+    temps,
+    kps,
+    seeds,
+    counters,
+    pens=None,
+    counts=None,
+    bias_ids=None,
+    bias_vals=None,
+):
     """Per-row sampling over (B, vocab) logits.
 
     Every sampling input is a TRACED per-row value — no recompilation
@@ -124,6 +139,24 @@ def _sample_rows(logits, temps, kps, seeds, counters, pens=None, counts=None):
     """
     vocab = logits.shape[-1]
     raw = logits
+    if bias_ids is not None:
+        # per-request logit_bias (OpenAI convention: applied straight to
+        # the logits, so it shapes greedy rows and bans/forces tokens).
+        # ids are (B, K) with -1 = inactive slot; duplicate ids in one
+        # request accumulate. Cond-gated like the other knobs.
+        def _bias(lg):
+            safe = jnp.maximum(bias_ids, 0)
+            vals = jnp.where(bias_ids >= 0, bias_vals, 0.0)
+            add = jax.vmap(
+                lambda ids, v: jnp.zeros((vocab,), jnp.float32)
+                .at[ids]
+                .add(v)
+            )(safe, vals)
+            return (lg.astype(jnp.float32) + add).astype(lg.dtype)
+
+        logits = jax.lax.cond(
+            jnp.any(bias_ids >= 0), _bias, lambda lg: lg, logits
+        )
     if pens is not None:
         def _penalize(lg):
             return (
@@ -185,6 +218,9 @@ class _Pending:
     min_p: float | None = None  # None = the engine-wide default
     frequency_penalty: float | None = None  # None/0 = disabled
     presence_penalty: float | None = None  # None/0 = disabled
+    # {token_id: bias}; at most _BIAS_SLOTS entries, biases clamp the
+    # OpenAI [-100, 100] convention
+    logit_bias: "dict[int, float] | None" = None
     # None = engine-drawn (independent, nondeterministic across
     # submissions); set = reproducible completion for this request
     seed: int | None = None
@@ -282,6 +318,7 @@ class _PrefillJob:
     kp_1: object  # (1, 3) fp32 resolved [top_k, top_p, min_p]
     seed_1: object  # (1,) uint32 resolved sampling seed
     pen_1: object  # (1, 2) fp32 [frequency_penalty, presence_penalty]
+    bias_1: object  # ((1, K) int32 ids, (1, K) fp32 values)
     ad_1: object  # (1,) int32 adapter id
     # next prompt depth at which to store a chunk-boundary prefix entry
     # (doubles after each insert — see _advance_job)
@@ -590,7 +627,32 @@ class ContinuousBatcher:
         min_p: float | None = None,
         frequency_penalty: float | None = None,
         presence_penalty: float | None = None,
+        logit_bias: "dict[int, float] | None" = None,
     ) -> None:
+        if logit_bias is not None:
+            if not isinstance(logit_bias, dict) or len(logit_bias) > _BIAS_SLOTS:
+                raise ValueError(
+                    f"logit_bias must be a dict of at most {_BIAS_SLOTS} "
+                    f"token->bias entries, got {logit_bias!r}"
+                )
+            for t, v in logit_bias.items():
+                if not (
+                    isinstance(t, int)
+                    and 0 <= t < self._model.cfg.vocab_size
+                ):
+                    raise ValueError(
+                        f"logit_bias token id {t!r} outside "
+                        f"[0, {self._model.cfg.vocab_size})"
+                    )
+                if not (
+                    isinstance(v, (int, float))
+                    and math.isfinite(v)
+                    and -100.0 <= v <= 100.0
+                ):
+                    raise ValueError(
+                        f"logit_bias value for {t} must be finite and "
+                        f"in [-100, 100], got {v!r}"
+                    )
         if seed is not None and not isinstance(seed, int):
             raise ValueError(f"seed must be an int, got {seed!r}")
         for nm, v in (
@@ -704,6 +766,7 @@ class ContinuousBatcher:
         min_p: float | None = None,
         frequency_penalty: float | None = None,
         presence_penalty: float | None = None,
+        logit_bias: "dict[int, float] | None" = None,
     ) -> list[_Pending]:
         """Validate then enqueue a group ATOMICALLY: either every row is
         accepted or none is — a partially admitted multi-row request
@@ -734,7 +797,7 @@ class ContinuousBatcher:
             self._validate(
                 tokens, max_new_tokens, temperature, adapter, stop,
                 top_k, top_p, rs, min_p, frequency_penalty,
-                presence_penalty,
+                presence_penalty, logit_bias,
             )
         ps = [
             _Pending(
@@ -747,6 +810,7 @@ class ContinuousBatcher:
                 min_p=min_p,
                 frequency_penalty=frequency_penalty,
                 presence_penalty=presence_penalty,
+                logit_bias=dict(logit_bias) if logit_bias else None,
                 seed=rs,
                 eos_id=eos_id,
                 adapter=int(adapter or 0),
@@ -797,11 +861,12 @@ class ContinuousBatcher:
         min_p: float | None = None,
         frequency_penalty: float | None = None,
         presence_penalty: float | None = None,
+        logit_bias: "dict[int, float] | None" = None,
     ) -> _Pending:
         return self._enqueue_all(
             [(tokens, sink)], max_new_tokens, temperature, eos_id,
             adapter, stop, top_k, top_p, seed, min_p,
-            frequency_penalty, presence_penalty,
+            frequency_penalty, presence_penalty, logit_bias,
         )[0]
 
     def submit(
@@ -819,6 +884,7 @@ class ContinuousBatcher:
         min_p: float | None = None,
         frequency_penalty: float | None = None,
         presence_penalty: float | None = None,
+        logit_bias: "dict[int, float] | None" = None,
     ) -> "list[int] | tuple[list[int], list[float]]":
         """Blocking decode. ``temperature``, ``top_k``, ``top_p`` and
         ``eos_id`` override the engine-wide defaults FOR THIS REQUEST
@@ -837,6 +903,7 @@ class ContinuousBatcher:
             top_k=top_k, top_p=top_p, seed=seed, min_p=min_p,
             frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty,
+            logit_bias=logit_bias,
         )
         p.event.wait()
         if p.error is not None:
@@ -860,6 +927,7 @@ class ContinuousBatcher:
         min_p: float | None = None,
         frequency_penalty: float | None = None,
         presence_penalty: float | None = None,
+        logit_bias: "dict[int, float] | None" = None,
     ) -> "list[list[int]] | tuple[list[list[int]], list[list[float]]]":
         """Blocking decode of several prompts admitted ATOMICALLY (all
         rows accepted or an EngineOverloaded/ValueError before any row
@@ -878,6 +946,7 @@ class ContinuousBatcher:
             min_p,
             frequency_penalty,
             presence_penalty,
+            logit_bias,
         )
         for p in ps:
             p.event.wait()
@@ -903,6 +972,7 @@ class ContinuousBatcher:
         min_p: float | None = None,
         frequency_penalty: float | None = None,
         presence_penalty: float | None = None,
+        logit_bias: "dict[int, float] | None" = None,
     ):
         """Yield completion tokens AS THEY DECODE (one engine step of
         latency each) instead of blocking for the full result.
@@ -931,6 +1001,7 @@ class ContinuousBatcher:
             min_p=min_p,
             frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty,
+            logit_bias=logit_bias,
         )
 
         # An explicit iterator, NOT a generator: close() on a
@@ -1109,7 +1180,10 @@ class ContinuousBatcher:
         constrain = self._constrain_cache
 
         @jax.jit
-        def step(params, cache, tok, pos, temps, ads, kps, seeds, pens, counts):
+        def step(
+            params, cache, tok, pos, temps, ads, kps, seeds, pens,
+            counts, bias_ids, bias_vals,
+        ):
             logits, updated = model.apply(
                 {"params": params, "cache": cache},
                 tok[:, None],
@@ -1128,7 +1202,8 @@ class ContinuousBatcher:
             # the sampled token will occupy position pos+1 (unclamped:
             # the cache-write clamp below must not alias two counters)
             nxt, lp = _sample_rows(
-                logits[:, -1], temps, kps, seeds, pos + 1, pens, counts
+                logits[:, -1], temps, kps, seeds, pos + 1, pens, counts,
+                bias_ids, bias_vals,
             )
             # the emitted token enters its row's generated-token counts
             # (cond: all-unpenalized batches never write the plane)
@@ -1159,7 +1234,10 @@ class ContinuousBatcher:
         constrain = self._constrain_cache
 
         @jax.jit
-        def prefill(params, prompt, length, temps, ads, kps, seed_1):
+        def prefill(
+            params, prompt, length, temps, ads, kps, seed_1, bid_1,
+            bval_1,
+        ):
             positions = jnp.arange(width, dtype=jnp.int32)[None, :]
             logits, state = model.apply(
                 {"params": params},
@@ -1173,8 +1251,12 @@ class ContinuousBatcher:
             last = jnp.take_along_axis(
                 logits, (length - 1)[:, None, None], axis=1
             )[:, 0]
-            # the first sampled token occupies position `length`
-            tok, lp = _sample_rows(last, temps, kps, seed_1, length)
+            # the first sampled token occupies position `length`;
+            # logit_bias shapes it too (penalties don't - zero counts)
+            tok, lp = _sample_rows(
+                last, temps, kps, seed_1, length,
+                bias_ids=bid_1, bias_vals=bval_1,
+            )
             return constrain(state["cache"]), tok, length, lp
 
         self._prefill_cache[width] = prefill
@@ -1188,7 +1270,7 @@ class ContinuousBatcher:
         def admit(
             cache_b, cache_1, row, tok_b, tok_1, pos_b, pos_1,
             temps_b, temp_1, ads_b, ad_1, kps_b, kp_1, seeds_b, seed_1,
-            pens_b, pen_1, counts_b,
+            pens_b, pen_1, counts_b, bids_b, bid_1, bvals_b, bval_1,
         ):
             def scatter(leaf_b, leaf_1):
                 if leaf_b.ndim == 0:  # per-layer scalar write index:
@@ -1215,7 +1297,14 @@ class ContinuousBatcher:
             counts = jax.lax.dynamic_update_slice(
                 counts_b, counts_1, (row, 0)
             )
-            return cache, tok, pos, temps, ads, kps, seeds, pens, counts
+            bids = jax.lax.dynamic_update_slice(bids_b, bid_1, (row, 0))
+            bvals = jax.lax.dynamic_update_slice(
+                bvals_b, bval_1, (row, 0)
+            )
+            return (
+                cache, tok, pos, temps, ads, kps, seeds, pens, counts,
+                bids, bvals,
+            )
 
         return admit
 
@@ -1245,12 +1334,19 @@ class ContinuousBatcher:
     @functools.cached_property
     def _sample1_fn(self):
         @jax.jit
-        def sample1(logits_chunk, idx, temps, kps, seed_1, length_1):
+        def sample1(
+            logits_chunk, idx, temps, kps, seed_1, length_1, bid_1,
+            bval_1,
+        ):
             last = jax.lax.dynamic_index_in_dim(
                 logits_chunk, idx, axis=1, keepdims=False
             )  # (1, vocab): the prompt's true last position
-            # the first sampled token occupies position `length`
-            return _sample_rows(last, temps, kps, seed_1, length_1)
+            # the first sampled token occupies position `length`;
+            # logit_bias shapes it too (penalties don't - zero counts)
+            return _sample_rows(
+                last, temps, kps, seed_1, length_1,
+                bias_ids=bid_1, bias_vals=bval_1,
+            )
 
         return sample1
 
@@ -1311,6 +1407,7 @@ class ContinuousBatcher:
             kp_1=self._resolve_kp(p),
             seed_1=self._resolve_seed(p),
             pen_1=self._resolve_pen(p),
+            bias_1=self._resolve_bias(p),
             ad_1=jnp.asarray([p.adapter], jnp.int32),
             # first boundary entry lands at the first chunk boundary
             # past the resume point, then depths double
@@ -1318,7 +1415,8 @@ class ContinuousBatcher:
         )
 
     def _advance_job(
-        self, cache, tok, pos, temps, ads, kps, seeds, pens, counts
+        self, cache, tok, pos, temps, ads, kps, seeds, pens, counts,
+        bids, bvals,
     ):
         """Run ONE chunk of the in-flight prefill; on the final chunk,
         sample the first token and scatter the row into the batch.
@@ -1328,7 +1426,10 @@ class ContinuousBatcher:
         if job.p.cancelled:
             self._resolve_unadmitted_cancel(job.p)
             self._job = None
-            return cache, tok, pos, temps, ads, kps, seeds, pens, counts
+            return (
+                cache, tok, pos, temps, ads, kps, seeds, pens, counts,
+                bids, bvals,
+            )
         c = self._prefill_chunk
         # Shift the window back rather than letting positions run past
         # max_seq_len: a final chunk starting at `start` would scatter
@@ -1377,7 +1478,10 @@ class ContinuousBatcher:
                 )
                 job.next_insert_depth = 2 * job.next_pos
                 job.boundary_inserts += 1
-            return cache, tok, pos, temps, ads, kps, seeds, pens, counts
+            return (
+                cache, tok, pos, temps, ads, kps, seeds, pens, counts,
+                bids, bvals,
+            )
         if self._prefix_store is not None:
             # The completed single-row cache covers the whole prompt.
             self._prefix_store.insert(
@@ -1391,9 +1495,11 @@ class ContinuousBatcher:
             job.kp_1,
             job.seed_1,
             jnp.asarray([job.length], jnp.int32),
+            *job.bias_1,
         )
         (
             cache, tok, pos, temps, ads, kps, seeds, pens, counts,
+            bids, bvals,
         ) = self._admit_fn(
             cache,
             job.cache_1,
@@ -1413,6 +1519,10 @@ class ContinuousBatcher:
             pens,
             job.pen_1,
             counts,
+            bids,
+            job.bias_1[0],
+            bvals,
+            job.bias_1[1],
         )
         first = int(np.asarray(tok_1)[0])
         lps = [float(np.asarray(lp_1)[0])]
@@ -1422,7 +1532,10 @@ class ContinuousBatcher:
         if self._finished(job.p, [first], first):
             self._retire(job.row)
         self._job = None
-        return cache, tok, pos, temps, ads, kps, seeds, pens, counts
+        return (
+            cache, tok, pos, temps, ads, kps, seeds, pens, counts,
+            bids, bvals,
+        )
 
     # -- engine loop ---------------------------------------------------
 
@@ -1465,7 +1578,12 @@ class ContinuousBatcher:
         seeds = jnp.zeros((b,), jnp.uint32)
         pens = jnp.zeros((b, 2), jnp.float32)
         counts = jnp.zeros((b, self._model.cfg.vocab_size), jnp.float32)
-        return cache, tok, pos, temps, ads, kps, seeds, pens, counts
+        bids = jnp.full((b, _BIAS_SLOTS), -1, jnp.int32)
+        bvals = jnp.zeros((b, _BIAS_SLOTS), jnp.float32)
+        return (
+            cache, tok, pos, temps, ads, kps, seeds, pens, counts,
+            bids, bvals,
+        )
 
     def _resolve_kp(self, p: _Pending):
         """(1, 2) fp32 resolved [top_k, top_p] for one request: the
@@ -1503,6 +1621,15 @@ class ContinuousBatcher:
             jnp.float32,
         )
 
+    def _resolve_bias(self, p: _Pending):
+        """((1, K) int32 ids, (1, K) fp32 values); unused slots id=-1."""
+        ids = np.full((1, _BIAS_SLOTS), -1, np.int32)
+        vals = np.zeros((1, _BIAS_SLOTS), np.float32)
+        for i, (t, v) in enumerate((p.logit_bias or {}).items()):
+            ids[0, i] = t
+            vals[0, i] = v
+        return jnp.asarray(ids), jnp.asarray(vals)
+
     def _resolve_seed(self, p: _Pending):
         """(1,) uint32 sampling seed: the request's, else one drawn from
         the engine's stream at admission (rows stay independent; the
@@ -1521,7 +1648,7 @@ class ContinuousBatcher:
 
     def _admit_one(
         self, p: _Pending, row: int, cache, tok, pos, temps, ads, kps,
-        seeds, pens, counts,
+        seeds, pens, counts, bids, bvals,
     ):
         w = self._bucket(len(p.tokens))
         prompt = np.zeros((1, w), np.int32)
@@ -1534,6 +1661,7 @@ class ContinuousBatcher:
         temp_1 = jnp.asarray([temp], jnp.float32)
         kp_1 = self._resolve_kp(p)
         seed_1 = self._resolve_seed(p)
+        bid_1, bval_1 = self._resolve_bias(p)
         ad_1 = jnp.asarray([p.adapter], jnp.int32)
         cache_1, tok_1, pos_1, lp_1 = self._prefill_fn(w)(
             self._params,
@@ -1543,13 +1671,17 @@ class ContinuousBatcher:
             ad_1,
             kp_1,
             seed_1,
+            bid_1,
+            bval_1,
         )
         (
             cache, tok, pos, temps, ads, kps, seeds, pens, counts,
+            bids, bvals,
         ) = self._admit_fn(
             cache, cache_1, jnp.int32(row), tok, tok_1, pos, pos_1,
             temps, temp_1, ads, ad_1, kps, kp_1, seeds, seed_1,
-            pens, self._resolve_pen(p), counts,
+            pens, self._resolve_pen(p), counts, bids, bid_1, bvals,
+            bval_1,
         )
         first = int(np.asarray(tok_1)[0])
         out = [first]
@@ -1559,7 +1691,10 @@ class ContinuousBatcher:
         p.emit(first, lps[0])
         if self._finished(p, out, first):
             self._retire(row)
-        return cache, tok, pos, temps, ads, kps, seeds, pens, counts
+        return (
+            cache, tok, pos, temps, ads, kps, seeds, pens, counts,
+            bids, bvals,
+        )
 
     def _finished(self, p: _Pending, out: list[int], last: int) -> bool:
         if p.cancelled:
@@ -1648,7 +1783,7 @@ class ContinuousBatcher:
 
     def _loop(self) -> None:
         cache = tok = pos = temps = ads = kps = seeds = None
-        pens = counts = None
+        pens = counts = bids = bvals = None
         try:
             while True:
                 if self._stop_now.is_set():
@@ -1700,15 +1835,15 @@ class ContinuousBatcher:
                     if cache is None:
                         (
                             cache, tok, pos, temps, ads, kps, seeds,
-                            pens, counts,
+                            pens, counts, bids, bvals,
                         ) = self._empty_state()
                     if self._prefill_chunk is None:
                         (
                             cache, tok, pos, temps, ads, kps, seeds,
-                            pens, counts,
+                            pens, counts, bids, bvals,
                         ) = self._admit_one(
                             item, free[0], cache, tok, pos, temps, ads,
-                            kps, seeds, pens, counts,
+                            kps, seeds, pens, counts, bids, bvals,
                         )
                     else:
                         self._job = self._start_job(item, free[0])
@@ -1718,10 +1853,10 @@ class ContinuousBatcher:
                 if self._job is not None:
                     (
                         cache, tok, pos, temps, ads, kps, seeds,
-                        pens, counts,
+                        pens, counts, bids, bvals,
                     ) = self._advance_job(
                         cache, tok, pos, temps, ads, kps, seeds, pens,
-                        counts,
+                        counts, bids, bvals,
                     )
 
                 if all(e is None for e in self._live):
@@ -1729,7 +1864,7 @@ class ContinuousBatcher:
 
                 cache, tok, pos, lp, counts = self._step_fn(
                     self._params, cache, tok, pos, temps, ads, kps,
-                    seeds, pens, counts,
+                    seeds, pens, counts, bids, bvals,
                 )
                 self.steps += 1
                 host_tok = np.asarray(tok)

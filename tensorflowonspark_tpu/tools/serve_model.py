@@ -202,6 +202,7 @@ class _Handler(BaseHTTPRequestHandler):
             req_min_p = payload.get("min_p")
             req_fpen = payload.get("frequency_penalty")
             req_ppen = payload.get("presence_penalty")
+            req_bias = payload.get("logit_bias")
             want_logprobs = bool(payload.get("logprobs"))
             if (
                 temperature is not None
@@ -216,6 +217,7 @@ class _Handler(BaseHTTPRequestHandler):
                 or req_min_p is not None
                 or req_fpen is not None
                 or req_ppen is not None
+                or req_bias is not None
                 or want_logprobs
             ) and self.gen_engine is None:
                 raise ValueError(
@@ -252,6 +254,11 @@ class _Handler(BaseHTTPRequestHandler):
                 req_fpen = float(req_fpen)
             if req_ppen is not None:
                 req_ppen = float(req_ppen)
+            if req_bias is not None:
+                # OpenAI wire format: JSON object keys are strings
+                req_bias = {
+                    int(t): float(v) for t, v in dict(req_bias).items()
+                }
             if n_samples is not None:
                 n_samples = int(n_samples)
                 if not 1 <= n_samples <= 16:
@@ -301,7 +308,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._engine_stream(
                 prompts[0], temperature, max_new, eos_id, want_logprobs,
                 adapter, stop, req_top_k, req_top_p, req_seed,
-                req_min_p, req_fpen, req_ppen,
+                req_min_p, req_fpen, req_ppen, req_bias,
             )
             return
         from tensorflowonspark_tpu.serving import EngineOverloaded
@@ -316,7 +323,7 @@ class _Handler(BaseHTTPRequestHandler):
                         fan, temperature, max_new, eos_id,
                         want_logprobs, adapter, stop, req_top_k,
                         req_top_p, req_seed, req_min_p, req_fpen,
-                        req_ppen,
+                        req_ppen, req_bias,
                     )
                     if want_logprobs:
                         completions, logprobs = completions
@@ -378,6 +385,7 @@ class _Handler(BaseHTTPRequestHandler):
         min_p=None,
         frequency_penalty=None,
         presence_penalty=None,
+        logit_bias=None,
     ) -> None:
         """Stream one completion as newline-delimited JSON: a
         ``{"token": t}`` line per decoded token (one engine step of
@@ -402,6 +410,7 @@ class _Handler(BaseHTTPRequestHandler):
                 min_p=min_p,
                 frequency_penalty=frequency_penalty,
                 presence_penalty=presence_penalty,
+                logit_bias=logit_bias,
             )
         except EngineOverloaded as e:
             self._reply(503, {"error": str(e)}, {"Retry-After": "1"})
@@ -471,6 +480,7 @@ class _Handler(BaseHTTPRequestHandler):
         min_p=None,
         frequency_penalty=None,
         presence_penalty=None,
+        logit_bias=None,
     ):
         """Continuous-batching path: the request's rows are admitted
         ATOMICALLY (all accepted, or a 400/503 before any decodes — a
@@ -491,6 +501,7 @@ class _Handler(BaseHTTPRequestHandler):
             min_p=min_p,
             frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty,
+            logit_bias=logit_bias,
         )
 
 

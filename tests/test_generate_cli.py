@@ -489,6 +489,20 @@ def test_serve_model_continuous_engine(tmp_path):
         )
         assert code == 400 and "presence_penalty" in body["error"]
 
+        # logit_bias in the OpenAI wire format (string keys): +100
+        # forces the token at every step incl. the first
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[1, 2]], "logit_bias": {"5": 100.0}},
+        )
+        assert code == 200, body
+        assert body["completions"][0] == [5] * 5
+        code, body = _post(
+            port, "/generate",
+            {"prompts": [[1, 2]], "logit_bias": {"5": 200.0}},
+        )
+        assert code == 400 and "logit_bias" in body["error"]
+
         # streaming: NDJSON token lines + a done trailer matching the
         # non-streamed completion for the same prompt; with logprobs
         # each line carries the token's raw-distribution logprob

@@ -811,6 +811,50 @@ def test_engine_penalty_validation(tiny):
         eng.close()
 
 
+def test_engine_logit_bias_forces_and_bans(tiny):
+    """logit_bias applies straight to the logits, first token included
+    (the prefill samplers carry it): +100 forces a token at every step,
+    and banning greedy's first choice changes the decode."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=2, prompt_widths=(8,))
+    try:
+        plain = eng.submit([1, 2, 3], 6)
+        forced = eng.submit([1, 2, 3], 6, logit_bias={5: 100.0})
+        assert forced == [5] * 6, forced
+        banned = eng.submit([1, 2, 3], 6, logit_bias={plain[0]: -100.0})
+        assert banned[0] != plain[0]
+        assert plain[0] not in banned, (plain, banned)
+        # an empty / absent bias leaves the decode untouched
+        assert eng.submit([1, 2, 3], 6, logit_bias={}) == plain
+    finally:
+        eng.close()
+    # chunked prefill reaches the first token through the sample1
+    # program instead of the bucket prefill - bias must ride it too
+    chunked = ContinuousBatcher(
+        model, params, slots=2, prompt_widths=(8,), prefill_chunk=2,
+    )
+    try:
+        assert chunked.submit([1, 2, 3], 6, logit_bias={5: 100.0}) == [5] * 6
+    finally:
+        chunked.close()
+
+
+def test_engine_logit_bias_validation(tiny):
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=1, prompt_widths=(8,))
+    try:
+        with pytest.raises(ValueError, match="logit_bias"):
+            eng.submit([1], 2, logit_bias={i: 1.0 for i in range(17)})
+        with pytest.raises(ValueError, match="logit_bias"):
+            eng.submit([1], 2, logit_bias={cfg.vocab_size: 1.0})
+        with pytest.raises(ValueError, match="logit_bias"):
+            eng.submit([1], 2, logit_bias={3: 101.0})
+        with pytest.raises(ValueError, match="logit_bias"):
+            eng.submit([1], 2, logit_bias={3: float("nan")})
+    finally:
+        eng.close()
+
+
 def test_engine_seeded_request_reproducible_under_concurrency(tiny):
     """A seeded sampled request is a pure function of (params, prompt,
     seed): the same request returns the SAME completion whether it runs
