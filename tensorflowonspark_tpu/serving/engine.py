@@ -105,6 +105,7 @@ def _sample_rows(
     counts=None,
     bias_ids=None,
     bias_vals=None,
+    gates=None,
 ):
     """Per-row sampling over (B, vocab) logits.
 
@@ -155,7 +156,10 @@ def _sample_rows(
             return (lg.astype(jnp.float32) + add).astype(lg.dtype)
 
         logits = jax.lax.cond(
-            jnp.any(bias_ids >= 0), _bias, lambda lg: lg, logits
+            gates[3] if gates is not None else jnp.any(bias_ids >= 0),
+            _bias,
+            lambda lg: lg,
+            logits,
         )
     if pens is not None:
         def _penalize(lg):
@@ -166,15 +170,28 @@ def _sample_rows(
             ).astype(lg.dtype)
 
         logits = jax.lax.cond(
-            jnp.any(pens != 0.0), _penalize, lambda lg: lg, logits
+            gates[2] if gates is not None else jnp.any(pens != 0.0),
+            _penalize,
+            lambda lg: lg,
+            logits,
         )
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
     ks, ps, ms = kps[:, 0], kps[:, 1], kps[:, 2]
 
     # two independent conds: k/p need the full-vocab sort, min_p is a
-    # row-max compare — each batch pays only for what its rows use
-    need_sort = jnp.any((ks < vocab) | (ps < 1.0))
+    # row-max compare — each batch pays only for what its rows use.
+    # ``gates`` ((4,) bool [sort, min_p, penalties, bias], traced) lets
+    # the SCHEDULER decide from its live-row bookkeeping: device-side
+    # any() over the state arrays would keep firing on a retired row's
+    # stale values until the slot is reused, taxing every remaining
+    # greedy row with the full-vocab sort. Single-row prefill callers
+    # omit gates — the device derivation is exact there.
+    need_sort = (
+        gates[0]
+        if gates is not None
+        else jnp.any((ks < vocab) | (ps < 1.0))
+    )
     trunc = jax.lax.cond(
         need_sort,
         lambda lg: _row_truncate(lg, ks, ps),
@@ -192,7 +209,10 @@ def _sample_rows(
         return jnp.where(scaled < floor, -jnp.inf, lg)
 
     trunc = jax.lax.cond(
-        jnp.any(ms > 0.0), _min_p, lambda lg: lg, trunc
+        gates[1] if gates is not None else jnp.any(ms > 0.0),
+        _min_p,
+        lambda lg: lg,
+        trunc,
     )
     base = jax.random.PRNGKey(0)
     keys = jax.vmap(
@@ -1182,7 +1202,7 @@ class ContinuousBatcher:
         @jax.jit
         def step(
             params, cache, tok, pos, temps, ads, kps, seeds, pens,
-            counts, bias_ids, bias_vals,
+            counts, bias_ids, bias_vals, gates,
         ):
             logits, updated = model.apply(
                 {"params": params, "cache": cache},
@@ -1203,12 +1223,12 @@ class ContinuousBatcher:
             # the cache-write clamp below must not alias two counters)
             nxt, lp = _sample_rows(
                 logits[:, -1], temps, kps, seeds, pos + 1, pens, counts,
-                bias_ids, bias_vals,
+                bias_ids, bias_vals, gates,
             )
             # the emitted token enters its row's generated-token counts
             # (cond: all-unpenalized batches never write the plane)
             counts = jax.lax.cond(
-                jnp.any(pens != 0.0),
+                gates[2],
                 lambda c: c + jax.nn.one_hot(
                     nxt, c.shape[-1], dtype=c.dtype
                 ),
@@ -1350,12 +1370,11 @@ class ContinuousBatcher:
 
         return sample1
 
-    @functools.cached_property
-    def _single_row_cache_shapes(self):
-        # Shape derivation traces the whole model — a constant, NOT
-        # per-admission work on the scheduler thread (a per-request
-        # trace would stall live rows' step dispatch, exactly the
-        # latency chunked prefill exists to remove).
+    def _cache_shapes(self, batch: int):
+        """Cache-tree ShapeDtypeStructs for a ``batch``-row decode —
+        one eval_shape (traces the whole model, no compile/device work)
+        shared by the per-row and engine-batch cache builders so the
+        two can never drift structurally."""
         _, shapes = jax.eval_shape(
             lambda p, t, pos: self._model.apply(
                 {"params": p},
@@ -1366,10 +1385,17 @@ class ContinuousBatcher:
                 mutable=["cache"],
             ),
             self._params,
-            jax.ShapeDtypeStruct((1, 1), jnp.int32),
-            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            jax.ShapeDtypeStruct((batch, 1), jnp.int32),
+            jax.ShapeDtypeStruct((batch, 1), jnp.int32),
         )
         return shapes["cache"]
+
+    @functools.cached_property
+    def _single_row_cache_shapes(self):
+        # A constant, NOT per-admission work on the scheduler thread (a
+        # per-request trace would stall live rows' step dispatch,
+        # exactly the latency chunked prefill exists to remove).
+        return self._cache_shapes(1)
 
     def _single_row_cache(self):
         from tensorflowonspark_tpu.models.llama import init_cache
@@ -1541,24 +1567,9 @@ class ContinuousBatcher:
 
     def _empty_state(self):
         b = self._slots
-        # The cache tree's exact structure (per-layer k/v/seg/idx) via a
-        # trace-only eval_shape — no compile, no device work.
-        _, shapes = jax.eval_shape(
-            lambda p, t, pos: self._model.apply(
-                {"params": p},
-                t,
-                positions=pos,
-                decode=True,
-                padded=True,
-                mutable=["cache"],
-            ),
-            self._params,
-            jax.ShapeDtypeStruct((b, 1), jnp.int32),
-            jax.ShapeDtypeStruct((b, 1), jnp.int32),
-        )
         from tensorflowonspark_tpu.models.llama import init_cache
 
-        cache = init_cache(shapes["cache"])
+        cache = init_cache(self._cache_shapes(b))
         tok = jnp.zeros((b,), jnp.int32)
         # Parked rows decode at position 0 against their own slot only;
         # their K/V writes stay inside their row and are overwritten on
@@ -1566,8 +1577,8 @@ class ContinuousBatcher:
         pos = jnp.zeros((b,), jnp.int32)
         temps = jnp.zeros((b,), jnp.float32)
         ads = jnp.zeros((b,), jnp.int32)  # adapter slot 0 = base
-        # per-row [top_k, top_p], truncation disabled (k=vocab, p=1):
-        # parked rows must not flip _sample_rows' any-row-truncates cond
+        # per-row [top_k, top_p, min_p], truncation disabled (k=vocab,
+        # p=1, m=0): parked rows must not flip the truncation conds
         kps = jnp.tile(
             jnp.asarray(
                 [[float(self._model.cfg.vocab_size), 1.0, 0.0]],
@@ -1585,29 +1596,36 @@ class ContinuousBatcher:
             bids, bvals,
         )
 
-    def _resolve_kp(self, p: _Pending):
-        """(1, 2) fp32 resolved [top_k, top_p] for one request: the
-        request value, else the engine-wide default, else disabled
-        (k = vocab / p = 1.0 — the identity values in _sample_rows).
+    def _effective_knobs(self, p: _Pending):
+        """Resolved (top_k, top_p, min_p) for one request — the request
+        value, else the engine-wide default, else disabled (k = vocab /
+        p = 1.0 / m = 0.0, the identity values in _sample_rows).
 
         A row whose EFFECTIVE temperature is 0 decodes greedily —
-        _sample_rows discards its sampled token — so it resolves to
-        disabled outright: otherwise an all-greedy batch on an engine
-        with default truncation would flip the any-row-truncates cond
-        and pay the full-vocab sort for nothing."""
+        _sample_rows discards its sampled token — so k/p/min_p resolve
+        to disabled outright: otherwise an all-greedy batch on an
+        engine with default truncation would flip the truncation conds
+        and pay the full-vocab sort for nothing. THE single source for
+        both the device kps rows (_resolve_kp) and the host cond gates
+        (_step_gates): sharing it is what guarantees a gate can never
+        read False while a live row's kps are active."""
         vocab = self._model.cfg.vocab_size
         temp = (
             self._temperature if p.temperature is None else p.temperature
         )
         if temp <= 0:
-            return jnp.asarray([[float(vocab), 1.0, 0.0]], jnp.float32)
+            return float(vocab), 1.0, 0.0
         k = p.top_k if p.top_k is not None else self._top_k
         k = vocab if k is None else min(int(k), vocab)
         q = p.top_p if p.top_p is not None else self._top_p
         q = 1.0 if q is None else float(q)
         m = p.min_p if p.min_p is not None else self._min_p
         m = 0.0 if m is None else float(m)
-        return jnp.asarray([[float(k), q, m]], jnp.float32)
+        return float(k), q, m
+
+    def _resolve_kp(self, p: _Pending):
+        """(1, 3) fp32 [top_k, top_p, min_p] via _effective_knobs."""
+        return jnp.asarray([list(self._effective_knobs(p))], jnp.float32)
 
     def _resolve_pen(self, p: _Pending):
         """(1, 2) fp32 [frequency_penalty, presence_penalty]; 0 =
@@ -1639,6 +1657,29 @@ class ContinuousBatcher:
         else:
             val = int(self._seed_rng.integers(2**32, dtype=np.uint32))
         return jnp.asarray([val], jnp.uint32)
+
+    def _step_gates(self):
+        """(4,) bool [sort, min_p, penalties, bias] from the LIVE rows'
+        resolved knobs — the host's bookkeeping, not the device arrays,
+        so a retired row's stale state can't keep a cond (and its
+        full-vocab sort / count-plane update) firing for the rest of
+        the batch."""
+        vocab = self._model.cfg.vocab_size
+        sort = minp = pen = bias = False
+        for e in self._live:
+            if e is None:
+                continue
+            p = e[0]
+            if p.logit_bias:
+                bias = True
+            if p.frequency_penalty or p.presence_penalty:
+                pen = True  # penalties shape greedy rows too
+            k, q, m = self._effective_knobs(p)  # same resolver as kps
+            if k < vocab or q < 1.0:
+                sort = True
+            if m > 0.0:
+                minp = True
+        return jnp.asarray([sort, minp, pen, bias])
 
     def _bucket(self, n: int) -> int:
         for w in self._widths:
@@ -1865,6 +1906,7 @@ class ContinuousBatcher:
                 cache, tok, pos, lp, counts = self._step_fn(
                     self._params, cache, tok, pos, temps, ads, kps,
                     seeds, pens, counts, bids, bvals,
+                    self._step_gates(),
                 )
                 self.steps += 1
                 host_tok = np.asarray(tok)

@@ -133,13 +133,16 @@ def _load_config(args):
     return base
 
 
-def _load_params(checkpoint: str, cfg, lora_scale: float = 1.0):
+def _load_params(checkpoint: str, cfg, lora_scale: "float | None" = None):
     """Restore params from either a CheckpointManager dir (latest step)
     or a bare save_checkpoint path; accept TrainState trees, {'state':
     ...} wrappers, or bare param trees. LoRA nodes (single adapters or
     multi-adapter banks) restored as plain dicts are rewrapped so the
     adapter paths route again (``ops/lora.py:rewrap_lora``);
-    ``lora_scale`` re-supplies the non-stored static scale."""
+    ``lora_scale`` re-supplies the non-stored static scale — None means
+    the 1.0 default, resolved HERE so no caller can reintroduce the
+    `or 1.0` falsy-zero bug (an explicit 0.0 disables the adapters)."""
+    lora_scale = 1.0 if lora_scale is None else float(lora_scale)
     import jax
     import jax.numpy as jnp
 
@@ -407,7 +410,7 @@ def main(argv: list[str] | None = None) -> int:
     model = Llama(cfg)
     params = _load_params(
         args.checkpoint, cfg,
-        lora_scale=getattr(args, "lora_scale", None) or 1.0,
+        lora_scale=getattr(args, "lora_scale", None),
     )
 
     with open(args.prompts) as f:

@@ -728,7 +728,7 @@ def _build_engine(gen: dict):
     # Cheap shape validation above happens BEFORE the (potentially
     # multi-GB) checkpoint restore, same policy as the draft path.
     params = _load_params(
-        gen["checkpoint"], cfg, lora_scale=gen.get("lora_scale") or 1.0
+        gen["checkpoint"], cfg, lora_scale=gen.get("lora_scale")
     )
     engine = ContinuousBatcher(
         model,
@@ -791,7 +791,7 @@ def _build_gen_fn(gen: dict):
     )
     model = Llama(cfg)
     params = _load_params(
-        gen["checkpoint"], cfg, lora_scale=gen.get("lora_scale") or 1.0
+        gen["checkpoint"], cfg, lora_scale=gen.get("lora_scale")
     )
     width = int(gen.get("width", 128))
     bsz = int(gen.get("batch_size", 8))

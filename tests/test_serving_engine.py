@@ -811,6 +811,44 @@ def test_engine_penalty_validation(tiny):
         eng.close()
 
 
+def test_step_gates_track_live_rows_not_device_state(tiny):
+    """The cond gates come from the scheduler's live-row bookkeeping:
+    when a truncated/penalized/biased row retires, the gates drop back
+    to False even though its stale values still sit in the device
+    arrays — the remaining greedy rows must not keep paying the
+    full-vocab sort or the count-plane update."""
+    from tensorflowonspark_tpu.serving.engine import _Pending
+    import threading as _threading
+
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=3, prompt_widths=(8,))
+    try:
+        mk = lambda **kw: _Pending([1], 4, _threading.Event(), **kw)
+        assert np.asarray(eng._step_gates()).tolist() == [False] * 4
+
+        eng._live[0] = (mk(temperature=0.9, top_p=0.9), [1], [0.0])
+        eng._live[1] = (mk(frequency_penalty=1.0), [2], [0.0])
+        assert np.asarray(eng._step_gates()).tolist() == [
+            True, False, True, False,
+        ]
+        eng._live[2] = (mk(temperature=0.5, min_p=0.1), [3], [0.0])
+        assert np.asarray(eng._step_gates()).tolist() == [
+            True, True, True, False,
+        ]
+        # the truncated/penalized rows retire; a biased greedy row stays
+        eng._live[0] = eng._live[1] = eng._live[2] = None
+        eng._live[0] = (mk(logit_bias={3: -5.0}), [4], [0.0])
+        assert np.asarray(eng._step_gates()).tolist() == [
+            False, False, False, True,
+        ]
+        # greedy rows with k/p/min_p resolve to disabled -> no sort gate
+        eng._live[0] = (mk(temperature=0.0, top_k=4), [5], [0.0])
+        assert np.asarray(eng._step_gates()).tolist() == [False] * 4
+        eng._live[0] = None
+    finally:
+        eng.close()
+
+
 def test_engine_logit_bias_forces_and_bans(tiny):
     """logit_bias applies straight to the logits, first token included
     (the prefill samplers carry it): +100 forces a token at every step,
