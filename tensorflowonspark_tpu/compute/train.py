@@ -235,7 +235,15 @@ def build_train_step(
         return loss_sum * inv, jax.tree.map(lambda g: g * inv, grad_sum)
 
     def step(state: TrainState, batch):
-        loss, grads = grads_of(state, batch)
+        # Publish the mesh for the duration of the trace: model code deep
+        # inside loss_fn keys mesh-aware dispatch on the ambient mesh
+        # (ops.attention's auto -> mesh_flash_attention shard_map route,
+        # impl='ring'/'ulysses') and must see it without the caller
+        # remembering to wrap every train call in parallel.use_mesh.
+        from tensorflowonspark_tpu.parallel import use_mesh
+
+        with use_mesh(mesh):
+            loss, grads = grads_of(state, batch)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         return (
@@ -272,8 +280,17 @@ def build_eval_step(
     metric_fn: Callable[[Any, Any], Any], mesh: Mesh
 ) -> Callable[[Any, Any], Any]:
     """Compile ``(params, batch) -> metrics`` with batch sharded on the mesh."""
+
+    def traced(params, batch):
+        # same ambient-mesh publication as build_train_step: eval-path
+        # model code keys mesh-aware dispatch on it too
+        from tensorflowonspark_tpu.parallel import use_mesh
+
+        with use_mesh(mesh):
+            return metric_fn(params, batch)
+
     return jax.jit(
-        metric_fn,
+        traced,
         in_shardings=(None, batch_sharding(mesh)),
         out_shardings=replicated(mesh),
     )

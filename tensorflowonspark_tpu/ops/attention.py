@@ -7,7 +7,11 @@
 - ``impl='flash'`` — the Pallas TPU flash-attention kernel from
   :mod:`tensorflowonspark_tpu.ops.flash_attention` (blockwise online
   softmax in VMEM; O(seq) memory).
-- ``impl='auto'`` — flash on TPU when shapes allow, else xla.
+- ``impl='auto'`` — flash on a single-device TPU when shapes allow; on a
+  multi-device TPU with an ambient mesh (``parallel.use_mesh`` — the
+  train-step builder publishes it during tracing), flash per-shard under
+  ``shard_map`` with batch/head sharding (:func:`mesh_flash_attention`);
+  otherwise xla, which GSPMD partitions fine.
 """
 
 from __future__ import annotations
@@ -16,6 +20,28 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+# Test hook: lets CI exercise the TPU-only dispatch decisions (the
+# mesh-flash route below) on the 8-device virtual CPU mesh with the
+# Pallas interpreter. Read only in the un-jitted dispatcher, never inside
+# a jitted function, so flipping it cannot leave stale traces behind.
+TREAT_AS_TPU = False
+
+
+def _on_tpu() -> bool:
+    return TREAT_AS_TPU or jax.default_backend() == "tpu"
+
+
+def _flash_shapes_ok(q, k, segment_ids) -> bool:
+    """Shapes the Pallas flash kernel accepts (whole-array view)."""
+    return (
+        q.shape[1] >= 128
+        and q.shape[1] % 128 == 0
+        and k.shape[1] % 128 == 0
+        and q.shape[3] >= 64
+        # segment masking needs square attention (one id per position)
+        and (segment_ids is None or q.shape[1] == k.shape[1])
+    )
 
 
 def _xla_attention(
@@ -103,7 +129,10 @@ def dot_product_attention(
                 "tensorflowonspark_tpu.parallel.use_mesh"
             )
         if mesh.shape.get("seq", 1) == 1 and mesh.shape.get("model", 1) == 1:
-            return _jitted_attention(
+            # re-enter the auto dispatcher (not _jitted_attention
+            # directly) so degenerate ring/ulysses configs still get the
+            # mesh-flash shard_map route on a multi-device batch mesh
+            return dot_product_attention(
                 q, k, v, causal=causal, scale=scale,
                 segment_ids=segment_ids, impl="auto", window=window,
             )
@@ -122,6 +151,14 @@ def dot_product_attention(
             q, k, v, mesh, causal=causal, scale=scale,
             segment_ids=segment_ids, window=window,
         )
+    if impl == "auto":
+        mesh = _flash_mesh(q, k, segment_ids)
+        if mesh is not None:
+            return mesh_flash_attention(
+                q, k, v, mesh, causal=causal, scale=scale,
+                segment_ids=segment_ids, window=window,
+            )
+        impl = _local_auto_impl(q, k, segment_ids)
     return _jitted_attention(
         q, k, v, causal=causal, scale=scale,
         segment_ids=segment_ids, impl=impl, window=window,
@@ -143,16 +180,15 @@ def _jitted_attention(
     window: int | None = None,
 ) -> jax.Array:
     if impl == "auto":
-        on_tpu = jax.default_backend() == "tpu"
-        shapes_ok = (
-            q.shape[1] >= 128
-            and q.shape[1] % 128 == 0
-            and k.shape[1] % 128 == 0
-            and q.shape[3] >= 64
-            # segment masking needs square attention (one id per position)
-            and (segment_ids is None or q.shape[1] == k.shape[1])
+        # 'auto' is resolved by the dispatcher (dot_product_attention:
+        # _flash_mesh for the shard_map route, _local_auto_impl
+        # otherwise) BEFORE this jitted function is entered — resolving
+        # it here would fork the gate logic and bake trace-time ambient
+        # state into the jit cache.
+        raise ValueError(
+            "impl='auto' must be resolved before _jitted_attention; "
+            "call dot_product_attention instead"
         )
-        impl = "flash" if (on_tpu and shapes_ok) else "xla"
     if impl == "flash":
         from tensorflowonspark_tpu.ops.flash_attention import (
             flash_attention,
@@ -166,3 +202,101 @@ def _jitted_attention(
         q, k, v, causal=causal, scale=scale, segment_ids=segment_ids,
         window=window,
     )
+
+
+def _local_auto_impl(q, k, segment_ids) -> str:
+    """``auto`` for operands known to be shard-LOCAL: on a single-device
+    process trivially, or inside a shard_map body (e.g. a ulysses or
+    gpipe stage), where each device holds its own block — the raw flash
+    kernel is safe there on any device count; the multi-device gate only
+    guards GSPMD-sharded whole arrays."""
+    try:
+        local = len(jax.devices()) == 1
+    except RuntimeError:  # pragma: no cover - no backend at all
+        return "xla"
+    if not local:
+        try:
+            local = jax.core.nonempty_axis_env_DO_NOT_USE()
+        except AttributeError:  # pragma: no cover - future jax rename
+            local = False
+    return (
+        "flash"
+        if (_on_tpu() and local and _flash_shapes_ok(q, k, segment_ids))
+        else "xla"
+    )
+
+
+def _flash_mesh(q, k, segment_ids):
+    """The ambient mesh, iff ``auto`` should take the shard_map flash
+    route: multi-device TPU, a published mesh whose only sharded axes are
+    batch/head-like, and shapes the kernel accepts both globally and
+    per-shard. Returns None for "resolve locally instead"."""
+    from tensorflowonspark_tpu.parallel.context import dispatch_mesh
+
+    # Only batch/head sharding: a sharded sequence wants ring/ulysses
+    # (impl='ring'|'ulysses'), and pipe/expert bodies already run inside
+    # a shard_map — nesting another would need a sub-mesh we don't have.
+    mesh = dispatch_mesh(
+        _on_tpu, q.shape[0], forbidden_axes=("pipe", "expert", "seq")
+    )
+    if mesh is None:
+        return None
+    tp = mesh.shape.get("model", 1)
+    if q.shape[2] % tp or k.shape[2] % tp:
+        return None
+    if not _flash_shapes_ok(q, k, segment_ids):
+        return None
+    return mesh
+
+
+def mesh_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    segment_ids: jax.Array | None = None,
+    window: int | None = None,
+) -> jax.Array:
+    """Flash attention on a multi-device mesh via ``shard_map``.
+
+    GSPMD cannot partition a ``pallas_call`` (the same limitation
+    documented at :func:`bn_kernels.use_pallas`): left inside a plain
+    ``jit`` over a sharded mesh, the kernel's operands would be
+    all-gathered onto every chip. Attention is embarrassingly parallel
+    over batch and heads, so this wrapper places the kernel per-shard —
+    batch over ``(data, fsdp)``, heads over ``model`` (K/V heads shard
+    the same way, so GQA grouping stays intact per shard), sequence
+    replicated (a sharded sequence wants ring/ulysses instead). No
+    collectives run inside the body; the backward pass is the flash
+    custom-VJP per shard, transposed by shard_map for free.
+
+    Inputs are global arrays (B, S, H, D); B must divide the
+    ``(data, fsdp)`` extent and both head counts the ``model`` extent
+    (checked by the ``auto`` gate in :func:`_flash_mesh`; direct callers
+    get shard_map's own divisibility errors).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from tensorflowonspark_tpu.ops.flash_attention import flash_attention
+    from tensorflowonspark_tpu.parallel.context import sp_specs_and_args
+
+    spec = P(("data", "fsdp"), None, "model", None)
+
+    def body(q, k, v, segment_ids=None):
+        # positional: custom_vjp functions reject keyword arguments
+        return flash_attention(
+            q, k, v, causal, scale, None, None, window, segment_ids
+        )
+
+    in_specs, args = sp_specs_and_args(spec, q, k, v, segment_ids)
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(*args)

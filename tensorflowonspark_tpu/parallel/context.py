@@ -36,6 +36,43 @@ def use_mesh(mesh: Mesh):
         _state.mesh = prev
 
 
+def dispatch_mesh(on_tpu, batch_extent: int, forbidden_axes=()):
+    """Shared trace-time gate for routing an op to a shard_map placement
+    of a Pallas kernel (GSPMD cannot partition a ``pallas_call``; the
+    multi-device fast path is explicit per-shard placement).
+
+    Returns the ambient mesh iff ALL hold — multi-device TPU process
+    (``on_tpu`` is the caller's backend predicate, usually carrying a
+    module-level TREAT_AS_TPU test hook), not already inside a shard_map
+    body (nesting over the same mesh is a trace error), a mesh published
+    via :func:`use_mesh`, none of ``forbidden_axes`` sharded, and
+    ``batch_extent`` divisible over the mesh's ``(data, fsdp)`` extent.
+    Callers layer their own op-specific checks (head divisibility,
+    kernel shape minima) on top. None means "use a local/GSPMD path".
+    """
+    import jax
+
+    try:
+        if not on_tpu() or len(jax.devices()) == 1:
+            return None
+    except RuntimeError:  # pragma: no cover - no backend at all
+        return None
+    try:
+        if jax.core.nonempty_axis_env_DO_NOT_USE():
+            return None
+    except AttributeError:  # pragma: no cover - future jax renames it
+        pass
+    mesh = current_mesh()
+    if mesh is None:
+        return None
+    if any(mesh.shape.get(ax, 1) != 1 for ax in forbidden_axes):
+        return None
+    dp = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    if batch_extent % dp:
+        return None
+    return mesh
+
+
 def sp_specs_and_args(base_spec, q, k, v, segment_ids=None):
     """Assemble shard_map ``(in_specs, args)`` for a sequence-parallel
     attention call with an optional ``(B, S)`` segment-id operand (its

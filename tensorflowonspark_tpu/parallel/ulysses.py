@@ -65,6 +65,10 @@ def _ulysses_local(
         seg_full = lax.all_gather(
             segment_ids, axis_name, axis=1, tiled=True
         )
+    # impl='auto' stays correct here: the dispatcher detects the
+    # enclosing shard_map (nonempty axis env), skips its mesh route, and
+    # resolves via _local_auto_impl — flash on TPU when shapes allow,
+    # exactly because these operands are shard-local.
     out = dot_product_attention(
         qh, kh, vh, causal=causal, scale=scale, impl=impl,
         segment_ids=seg_full, window=window,

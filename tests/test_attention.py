@@ -365,3 +365,195 @@ def test_window_grid_restriction_covers_all_live_blocks():
             assert all(firstq <= qi < firstq + nq for qi in liveq), (
                 ki, firstq, nq, liveq,
             )
+
+
+# ---------------------------------------------------------------------------
+# Mesh-safe flash: the shard_map route for multi-device TPU processes.
+# GSPMD can't partition a pallas_call, so `auto` on a multi-device backend
+# must either place the kernel per-shard (ambient mesh published) or fall
+# back to XLA — never hand sharded operands to the raw kernel.
+# ---------------------------------------------------------------------------
+
+import tensorflowonspark_tpu.ops.attention as attn_mod
+from tensorflowonspark_tpu.ops.attention import (
+    _flash_mesh,
+    mesh_flash_attention,
+)
+from tensorflowonspark_tpu.parallel import use_mesh
+
+
+def _tp_mesh():
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+
+    return make_mesh({"data": 2, "fsdp": 2, "model": 2})
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_mesh_flash_matches_xla(causal, monkeypatch):
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    mesh = _tp_mesh()
+    q, k, v = _qkv(b=4, sq=128, sk=128, hq=4, hk=2, d=64)
+    out = mesh_flash_attention(q, k, v, mesh, causal=causal)
+    ref = _xla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_mesh_flash_segments_match_xla(monkeypatch):
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    mesh = _tp_mesh()
+    q, k, v = _qkv(b=4, sq=128, sk=128, hq=4, hk=2, d=64)
+    seg = jnp.concatenate(
+        [jnp.zeros((4, 64), jnp.int32), jnp.ones((4, 64), jnp.int32)],
+        axis=1,
+    )
+    out = mesh_flash_attention(
+        q, k, v, mesh, causal=True, segment_ids=seg
+    )
+    ref = _xla_attention(q, k, v, causal=True, segment_ids=seg)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_mesh_flash_grad_matches_xla(monkeypatch):
+    """The flash custom-VJP must transpose cleanly through shard_map:
+    per-shard backward kernels, no collectives, sharded cotangents."""
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    mesh = _tp_mesh()
+    q, k, v = _qkv(b=4, sq=128, sk=128, hq=4, hk=2, d=64)
+
+    def loss_mesh(q, k, v):
+        return jnp.sum(mesh_flash_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, causal=True) ** 2)
+
+    g_mesh = jax.grad(loss_mesh, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gm, gr in zip(g_mesh, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(gm), np.asarray(gr), rtol=5e-3, atol=5e-3
+        )
+
+
+def test_auto_routes_to_mesh_flash(monkeypatch):
+    """`auto` + multi-device 'TPU' + ambient mesh -> the shard_map route,
+    with numerics matching XLA."""
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    monkeypatch.setattr(attn_mod, "TREAT_AS_TPU", True)
+    calls = []
+    real = attn_mod.mesh_flash_attention
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(attn_mod, "mesh_flash_attention", spy)
+    q, k, v = _qkv(b=4, sq=128, sk=128, hq=4, hk=2, d=64)
+    with use_mesh(_tp_mesh()):
+        out = dot_product_attention(q, k, v, causal=True, impl="auto")
+    assert calls, "auto did not take the mesh flash route"
+    ref = _xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_auto_multidevice_without_mesh_falls_back(monkeypatch):
+    """No ambient mesh on a multi-device backend: auto must NOT reach any
+    pallas path (non-interpret pallas would crash on CPU; GSPMD would
+    all-gather on TPU) — it falls back to XLA and stays correct."""
+    monkeypatch.setattr(attn_mod, "TREAT_AS_TPU", True)
+    q, k, v = _qkv(b=4, sq=128, sk=128, hq=4, hk=2, d=64)
+    out = dot_product_attention(q, k, v, causal=True, impl="auto")
+    ref = _xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_flash_mesh_gate(monkeypatch):
+    """The route gate: shapes/divisibility failures and sharded
+    seq/pipe/expert axes all veto the mesh route (-> None)."""
+    monkeypatch.setattr(attn_mod, "TREAT_AS_TPU", True)
+    mesh = _tp_mesh()
+    q, k, v = _qkv(b=4, sq=128, sk=128, hq=4, hk=2, d=64)
+    with use_mesh(mesh):
+        assert _flash_mesh(q, k, None) is mesh
+        # batch not divisible by (data, fsdp) extent
+        q3, k3, v3 = _qkv(b=3, sq=128, sk=128, hq=4, hk=2, d=64)
+        assert _flash_mesh(q3, k3, None) is None
+        # kv heads not divisible by model extent
+        qh, kh, vh = _qkv(b=4, sq=128, sk=128, hq=4, hk=1, d=64)
+        assert _flash_mesh(qh, kh, None) is None
+        # seq not a multiple of 128
+        qs, ks_, vs = _qkv(b=4, sq=64, sk=64, hq=4, hk=2, d=64)
+        assert _flash_mesh(qs, ks_, None) is None
+    # no ambient mesh
+    assert _flash_mesh(q, k, None) is None
+    # sequence-sharded mesh wants ring/ulysses, not the flash route
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+
+    with use_mesh(make_mesh({"data": 2, "seq": 4})):
+        assert _flash_mesh(q, k, None) is None
+    # not TPU: route closed even with a mesh
+    monkeypatch.setattr(attn_mod, "TREAT_AS_TPU", False)
+    with use_mesh(mesh):
+        assert _flash_mesh(q, k, None) is None
+
+
+def test_ulysses_inner_auto_uses_flash_per_shard(monkeypatch):
+    """Inside the ulysses shard_map body the operands are shard-LOCAL:
+    auto must resolve to the flash kernel there (not the dispatcher's
+    multi-device XLA downgrade, and never a nested shard_map)."""
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    monkeypatch.setattr(attn_mod, "TREAT_AS_TPU", True)
+    seen = []
+    real = attn_mod._flash_shapes_ok
+
+    def spy(q, k, seg):
+        ok = real(q, k, seg)
+        seen.append(ok)
+        return ok
+
+    monkeypatch.setattr(attn_mod, "_flash_shapes_ok", spy)
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    q, k, v = _qkv(b=4, sq=256, sk=256, hq=4, hk=4, d=64)
+    with use_mesh(mesh):
+        out = dot_product_attention(q, k, v, causal=True, impl="ulysses")
+    assert any(seen), "per-shard auto resolution never saw flash-ok shapes"
+    ref = _xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_ring_degenerate_mesh_reenters_auto_dispatch(monkeypatch):
+    """impl='ring' on a mesh with seq==1 falls through to the auto
+    dispatcher — which must still find the mesh-flash route on a
+    batch-sharded multi-device mesh."""
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    monkeypatch.setattr(attn_mod, "TREAT_AS_TPU", True)
+    calls = []
+    real = attn_mod.mesh_flash_attention
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(attn_mod, "mesh_flash_attention", spy)
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    q, k, v = _qkv(b=8, sq=128, sk=128, hq=4, hk=2, d=64)
+    with use_mesh(mesh):
+        out = dot_product_attention(q, k, v, causal=True, impl="ring")
+    assert calls, "degenerate ring did not re-enter the mesh-flash route"
+    ref = _xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )
