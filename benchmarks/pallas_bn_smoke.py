@@ -59,13 +59,26 @@ def main() -> int:
     rng = np.random.default_rng(0)
 
     # ResNet-50 b=256 layer shapes: early (big spatial, narrow C), late
-    # (small spatial, wide C) — the two extremes the reduce must handle.
-    shapes = [(256 * 56 * 56, 256), (256 * 14 * 14, 1024)]
+    # (small spatial, wide C) — the two extremes the reduce must handle —
+    # PLUS the narrow/non-128-aligned channel counts the adopting models
+    # actually have (Inception-v3 BN at C=32/48/80, ResNet stem C=64):
+    # sub-128-lane column blocks are where Mosaic tiling constraints
+    # bite, so de-risk them here on a 30 s program, not in the conv-net
+    # compile that burns the relay window.
+    shapes = [
+        (256 * 56 * 56, 256),
+        (256 * 14 * 14, 1024),
+        (256 * 112 * 112, 32),  # Inception stem
+        (256 * 56 * 56, 48),  # Inception narrow branch
+        (256 * 28 * 28, 80),  # Inception 5b input
+        (256 * 56 * 56, 64),  # ResNet stem
+    ]
     if backend != "tpu":
         # CPU flow-check only: interpreter-mode kernels on tiny shapes
-        # (rates are meaningless off-chip).
+        # (rates are meaningless off-chip); keep a narrow-lane and a
+        # non-aligned case in the flow-check too.
         bn_kernels.INTERPRET = True
-        shapes = [(1030, 65)]
+        shapes = [(1030, 65), (515, 48)]
     for rows, cols in shapes:
         x = jnp.asarray(rng.standard_normal((rows, cols), np.float32), jnp.bfloat16)
         dy = jnp.asarray(rng.standard_normal((rows, cols), np.float32), jnp.bfloat16)

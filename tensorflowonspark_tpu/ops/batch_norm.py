@@ -69,10 +69,30 @@ def _reduce_extent(x: jax.Array) -> int:
     return n
 
 
-def batch_norm_stats(x, impl: str = "auto") -> tuple[jax.Array, jax.Array]:
+def _resolve_impl(impl, x):
+    """Resolve ``impl`` against the ambient state at trace time.
+
+    Returns ``'pallas'`` | ``'xla'`` | ``('mesh_pallas', mesh)`` — the
+    third is the multi-device route: per-shard Pallas partial sums +
+    psum under shard_map (:func:`bn_kernels.stats_mesh` gates it). An
+    already-resolved value (tuple, or explicit literal) passes through,
+    so the custom-VJP backward re-resolving can never flip routes.
+    """
+    if isinstance(impl, tuple):
+        return impl
+    mesh = bn_kernels.stats_mesh(impl, x.shape[0])
+    if mesh is not None:
+        return ("mesh_pallas", mesh)
+    return "pallas" if bn_kernels.use_pallas(impl) else "xla"
+
+
+def batch_norm_stats(x, impl="auto") -> tuple[jax.Array, jax.Array]:
     """One-pass per-channel (mean, var) over all-but-last dims, fp32."""
     n = _reduce_extent(x)
-    if bn_kernels.use_pallas(impl):
+    resolved = _resolve_impl(impl, x)
+    if isinstance(resolved, tuple):
+        s, s2 = bn_kernels.mesh_pair_stats(x, resolved[1])
+    elif resolved == "pallas":
         s, s2 = bn_kernels.pair_stats(x)
     else:
         xf = x.astype(jnp.float32)
@@ -100,7 +120,7 @@ def bn_train(x, gamma, beta, eps, impl="auto"):
     changed) can never pair a Pallas forward with an XLA backward or
     vice versa.
     """
-    resolved = "pallas" if bn_kernels.use_pallas(impl) else "xla"
+    resolved = _resolve_impl(impl, x)
     return _bn_train(x, gamma, beta, eps, resolved)
 
 
@@ -132,8 +152,11 @@ def _bn_train_bwd(eps, impl, res, cts):
     dy, _dmean, _dvar = cts  # stats cotangents ignored — see bn_train.
     x, gamma, mean, invstd = res
     n = _reduce_extent(x)
-    if bn_kernels.use_pallas(impl):
-        sum_dy, sum_dy_x = bn_kernels.cross_stats(dy, x)
+    if isinstance(impl, tuple) or bn_kernels.use_pallas(impl):
+        if isinstance(impl, tuple):
+            sum_dy, sum_dy_x = bn_kernels.mesh_cross_stats(dy, x, impl[1])
+        else:
+            sum_dy, sum_dy_x = bn_kernels.cross_stats(dy, x)
         sum_dy_xhat = invstd * (sum_dy_x - mean * sum_dy)
         xhat = ((x.astype(jnp.float32) - mean) * invstd).astype(x.dtype)
     else:

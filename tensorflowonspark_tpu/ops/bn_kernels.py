@@ -46,10 +46,16 @@ _OUT_SUBLANES = 8  # output blocks are (8, block_c): Mosaic's min f32 tile
 def _choose_blocks(rows: int, cols: int) -> tuple[int, int]:
     """Tile choice: wide-ish lanes, ~1 MB bf16 input tiles.
 
-    Channels in conv nets are multiples of 64; a 512-lane block keeps the
-    DMA large while letting C=2048 layers partition cleanly. Rows default
-    to 1024 (so a (1024, 512) bf16 tile is 1 MB — big enough to hit DMA
-    streaming rate, small enough to double-buffer in VMEM).
+    A 512-lane block keeps the DMA large while letting C=2048 layers
+    partition cleanly. Narrow layers are real, not hypothetical —
+    Inception-v3 BN sits at C=32/48/80/96 and the ResNet stem at C=64
+    (models/inception.py, models/resnet.py) — so ``min(cols, 512)``
+    passes sub-128-lane and non-128-aligned column blocks straight to
+    Mosaic, which pads the lane dimension internally; those shapes are in
+    ``benchmarks/pallas_bn_smoke.py``'s TPU list so a real-chip lowering
+    failure shows up in the cheap smoke, not the conv-net compile. Rows
+    default to 1024 (so a (1024, 512) bf16 tile is 1 MB — big enough to
+    hit DMA streaming rate, small enough to double-buffer in VMEM).
     """
     block_c = min(cols, 512)
     block_r = min(rows, 1024)
@@ -139,14 +145,16 @@ def cross_stats(dy: jax.Array, x: jax.Array) -> tuple[jax.Array, jax.Array]:
 def use_pallas(impl: str = "auto") -> bool:
     """'pallas' | 'xla' | 'auto'.
 
-    'auto' picks Pallas only on a SINGLE-device TPU process: with more
-    than one device visible, activations may be GSPMD-sharded (the
-    repo's conv-net train path shards the batch via NamedSharding with
-    no ambient-mesh marker to key on), and GSPMD cannot partition a
+    'auto' picks the raw (single-array) Pallas path only on a
+    SINGLE-device TPU process: with more than one device visible,
+    activations may be GSPMD-sharded, and GSPMD cannot partition a
     pallas_call — it would replicate the operands, all-gathering the
-    full activation per BN layer. The sibling ``jnp.sum`` reduces
-    partition into per-shard sums + psum for free, so multi-device
-    'auto' takes that path. Explicit impl='pallas' overrides — callers
+    full activation per BN layer. The multi-device fast path is
+    :func:`stats_mesh` + the ``mesh_*_stats`` shard_map wrappers
+    (per-shard partial sums + psum), keyed on the ambient mesh the
+    train/eval-step builders publish; with no mesh, multi-device 'auto'
+    falls back to the sibling ``jnp.sum`` reduces, which GSPMD
+    partitions for free. Explicit impl='pallas' overrides — callers
     doing their own shard_map placement know the operands are local.
     """
     if impl == "pallas":
@@ -156,6 +164,83 @@ def use_pallas(impl: str = "auto") -> bool:
     if impl != "auto":
         raise ValueError(f"impl must be pallas|xla|auto, got {impl!r}")
     try:
-        return jax.default_backend() == "tpu" and len(jax.devices()) == 1
+        return _on_tpu() and len(jax.devices()) == 1
     except RuntimeError:  # pragma: no cover - no backend at all
         return False
+
+
+# Test hook, mirroring ops.attention.TREAT_AS_TPU: lets CI exercise the
+# TPU-only dispatch decisions on the virtual CPU mesh with the Pallas
+# interpreter. Read only at trace time in un-jitted resolvers.
+TREAT_AS_TPU = False
+
+
+def _on_tpu() -> bool:
+    return TREAT_AS_TPU or jax.default_backend() == "tpu"
+
+
+def stats_mesh(impl: str, batch_extent: int):
+    """The ambient mesh, iff multi-device ``auto`` should take the
+    shard_map route: per-shard Pallas partial sums + a psum over the
+    batch axes. Returns None for "use use_pallas()'s answer".
+
+    Conditions: auto on a multi-device TPU, an ambient mesh published
+    (``parallel.use_mesh`` — the train/eval-step builders do this during
+    tracing), only batch-like axes sharded (conv activations shard the
+    leading dim over ``(data, fsdp)``; a model/seq-sharded mesh means
+    someone else owns the layout), not already inside a shard_map body,
+    and the batch extent divisible over the mesh's batch axes.
+    """
+    if impl != "auto":
+        return None
+    from tensorflowonspark_tpu.parallel.context import dispatch_mesh
+
+    mesh = dispatch_mesh(
+        _on_tpu,
+        batch_extent,
+        forbidden_axes=("pipe", "expert", "model", "seq"),
+    )
+    if mesh is None:
+        return None
+    # a trivial batch extent means the shard_map adds nothing over the
+    # single-array path (and may strand the array on one device)
+    if mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1) <= 1:
+        return None
+    return mesh
+
+
+def _mesh_stats(stats_fn, arrays, mesh):
+    """Place ``stats_fn`` (pair_stats/cross_stats) per-shard with
+    shard_map — batch over ``(data, fsdp)``, everything else replicated —
+    and psum the per-shard partial sums. Sums are exact identities under
+    this split (each row lands in exactly one shard), so the result
+    equals the single-device kernel up to fp32 summation order."""
+    from jax.sharding import PartitionSpec as P
+
+    axes = ("data", "fsdp")
+    spec = P(axes, *([None] * (arrays[0].ndim - 1)))
+
+    def body(*arrs):
+        a, b = stats_fn(*arrs)
+        return lax.psum(a, axes), lax.psum(b, axes)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec,) * len(arrays),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return fn(*arrays)
+
+
+def mesh_pair_stats(x: jax.Array, mesh) -> tuple[jax.Array, jax.Array]:
+    """:func:`pair_stats` on a batch-sharded multi-device mesh."""
+    return _mesh_stats(pair_stats, (x,), mesh)
+
+
+def mesh_cross_stats(
+    dy: jax.Array, x: jax.Array, mesh
+) -> tuple[jax.Array, jax.Array]:
+    """:func:`cross_stats` on a batch-sharded multi-device mesh."""
+    return _mesh_stats(cross_stats, (dy, x), mesh)

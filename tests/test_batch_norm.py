@@ -321,3 +321,99 @@ def test_conv_nets_keep_batchnorm_checkpoint_names():
             p for p in paths if "FusedBatchNorm" in p
         )[:3]
         assert any("BatchNorm_0" in p for p in paths), type(model).__name__
+
+
+# ---------------------------------------------------------------------------
+# Pod-safe Pallas BN: the shard_map route for multi-device TPU processes —
+# per-shard Pallas partial sums + psum over the batch axes, gated on the
+# ambient mesh the train/eval-step builders publish.
+# ---------------------------------------------------------------------------
+
+
+def _batch_mesh():
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+
+    return make_mesh({"data": 2, "fsdp": 4})
+
+
+def test_stats_mesh_gate(monkeypatch):
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+    from tensorflowonspark_tpu.parallel import use_mesh
+
+    monkeypatch.setattr(bn_kernels, "TREAT_AS_TPU", True)
+    mesh = _batch_mesh()
+    with use_mesh(mesh):
+        assert bn_kernels.stats_mesh("auto", 16) is mesh
+        assert bn_kernels.stats_mesh("auto", 9) is None  # indivisible
+        # explicit impls never take the mesh route
+        assert bn_kernels.stats_mesh("pallas", 16) is None
+        assert bn_kernels.stats_mesh("xla", 16) is None
+    assert bn_kernels.stats_mesh("auto", 16) is None  # no ambient mesh
+    with use_mesh(make_mesh({"data": 4, "model": 2})):
+        # a model-sharded mesh means someone else owns the layout
+        assert bn_kernels.stats_mesh("auto", 16) is None
+    monkeypatch.setattr(bn_kernels, "TREAT_AS_TPU", False)
+    with use_mesh(mesh):
+        assert bn_kernels.stats_mesh("auto", 16) is None  # CPU backend
+
+
+def test_mesh_stats_match_single_device(pallas_interpret):
+    """Per-shard partial sums + psum must equal the single-device kernel
+    (exact identities under the batch split; fp32 order differs)."""
+    rng = np.random.default_rng(21)
+    mesh = _batch_mesh()
+    x = jnp.asarray(rng.normal(0.5, 2.0, (16, 5, 5, 48)).astype(np.float32))
+    dy = jnp.asarray(rng.normal(size=(16, 5, 5, 48)).astype(np.float32))
+    s_m, q_m = bn_kernels.mesh_pair_stats(x, mesh)
+    s_1, q_1 = bn_kernels.pair_stats(x)
+    np.testing.assert_allclose(np.asarray(s_m), np.asarray(s_1), rtol=1e-6, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(q_m), np.asarray(q_1), rtol=1e-6, atol=1e-4)
+    sd_m, sx_m = bn_kernels.mesh_cross_stats(dy, x, mesh)
+    sd_1, sx_1 = bn_kernels.cross_stats(dy, x)
+    np.testing.assert_allclose(np.asarray(sd_m), np.asarray(sd_1), rtol=1e-6, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(sx_m), np.asarray(sx_1), rtol=1e-6, atol=1e-4)
+
+
+def test_bn_train_mesh_route_matches_xla(pallas_interpret, monkeypatch):
+    """'auto' on a multi-device 'TPU' with an ambient batch mesh resolves
+    to the shard_map route (forward AND custom-VJP backward), with values
+    and gradients matching the XLA reduce path."""
+    from tensorflowonspark_tpu.parallel import use_mesh
+
+    monkeypatch.setattr(bn_kernels, "TREAT_AS_TPU", True)
+    pair_calls, cross_calls = [], []
+    real_pair, real_cross = bn_kernels.mesh_pair_stats, bn_kernels.mesh_cross_stats
+    monkeypatch.setattr(
+        bn_kernels, "mesh_pair_stats",
+        lambda *a: (pair_calls.append(1), real_pair(*a))[1],
+    )
+    monkeypatch.setattr(
+        bn_kernels, "mesh_cross_stats",
+        lambda *a: (cross_calls.append(1), real_cross(*a))[1],
+    )
+    rng = np.random.default_rng(22)
+    x = jnp.asarray(rng.normal(0.5, 2.0, (16, 5, 5, 24)).astype(np.float32))
+    gamma = jnp.asarray(rng.normal(1.0, 0.3, (24,)).astype(np.float32))
+    beta = jnp.asarray(rng.normal(size=(24,)).astype(np.float32))
+    t = jnp.asarray(rng.normal(size=x.shape).astype(np.float32))
+    mesh = _batch_mesh()
+
+    def loss(impl, x, g, b):
+        return jnp.sum(fused_batch_norm(x, g, b, 1e-5, impl=impl) * t)
+
+    with use_mesh(mesh):
+        y_m = fused_batch_norm(x, gamma, beta, 1e-5, impl="auto")
+        g_m = jax.grad(lambda *a: loss("auto", *a), argnums=(0, 1, 2))(
+            x, gamma, beta
+        )
+    assert pair_calls, "forward did not take the mesh route"
+    assert cross_calls, "backward did not take the mesh route"
+    y_x = fused_batch_norm(x, gamma, beta, 1e-5, impl="xla")
+    g_x = jax.grad(lambda *a: loss("xla", *a), argnums=(0, 1, 2))(
+        x, gamma, beta
+    )
+    np.testing.assert_allclose(np.asarray(y_m), np.asarray(y_x), atol=1e-5)
+    for a, b in zip(g_m, g_x):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=1e-4
+        )
