@@ -81,15 +81,27 @@ def _row_truncate(scaled, ks, ps):
     sorted_desc = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
     rank = jnp.arange(vocab, dtype=jnp.float32)[None, :]
     kept = jnp.where(rank < ks[:, None], sorted_desc, -jnp.inf)
-    cum = jnp.cumsum(jax.nn.softmax(kept, axis=-1), axis=-1)
-    # Last kept rank: everything before cumulative mass reaches top_p,
-    # always >= 0 (the most likely token survives even when it alone
-    # exceeds p) and always < k (a p of ~1.0 must not walk into the
-    # -inf tail, whose cumsum plateaus just under 1.0 in floating
-    # point, and then keep MORE than k tokens).
-    cutoff_index = jnp.sum(cum < ps[:, None], axis=-1, keepdims=True)
-    cutoff_index = jnp.minimum(
-        cutoff_index, (ks[:, None] - 1).astype(jnp.int32)
+    probs = jax.nn.softmax(kept, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Last kept rank, via the EXCLUSIVE prefix (cum - probs): rank i
+    # survives iff the mass strictly before it is < p. The inclusive
+    # compare (cum < p) would let fp32 cumsum error bite disabled rows
+    # (k=vocab, p=1.0) routed through the sort because a co-batched row
+    # truncates: the cumsum can saturate at exactly 1.0 several ranks
+    # early (~1e-5 of accumulated error), silently masking tail tokens
+    # and making a seeded plain-temperature row's distribution depend on
+    # its batchmates. With the exclusive form, any rank whose own prob
+    # is representable keeps (1.0 - prob < 1.0); only prob==0 underflow
+    # ranks — unsampleable anyway — fall off. Clamps: >= 0 (the most
+    # likely token survives even when it alone exceeds p) and < k (a p
+    # of ~1.0 must not walk into the -inf tail, whose exclusive prefix
+    # plateaus just under 1.0 in floating point, and then keep MORE
+    # than k tokens).
+    cutoff_index = (
+        jnp.sum(cum - probs < ps[:, None], axis=-1, keepdims=True) - 1
+    )
+    cutoff_index = jnp.clip(
+        cutoff_index, 0, (ks[:, None] - 1).astype(jnp.int32)
     )
     cutoff = jnp.take_along_axis(kept, cutoff_index, axis=-1)
     return jnp.where(scaled < cutoff, -jnp.inf, scaled)
