@@ -26,6 +26,24 @@ Endpoints::
                                 (per-token next-token logprobs — the
                                 eval-harness surface; one static
                                 compile, same bucketing as /generate)
+    POST /v1/completions     -> OpenAI-completions-shaped alias over the
+                                same engine (``--gen-engine continuous``
+                                required: the translation always sets
+                                max_tokens). Token ids only — ``prompt``
+                                is [ids] or [[ids], ...]; text prompts
+                                and string stops are a 400 (tokenizers
+                                are corpus-specific, out of framework
+                                scope). Response: the standard
+                                text_completion envelope with
+                                ``choices[].tokens`` carrying the ids
+                                (``text`` is empty — no tokenizer),
+                                per-token sampled logprobs under
+                                ``choices[].logprobs.token_logprobs``
+                                when ``logprobs`` >= 1, finish_reason
+                                stop|length, and usage counts. Errors
+                                keep this server's ``{"error": str}``
+                                shape.
+    GET  /v1/models          -> single-model list (``--served-model-name``)
 
 Usage::
 
@@ -65,6 +83,7 @@ class _Handler(BaseHTTPRequestHandler):
     gen_engine: Any = None  # ContinuousBatcher (--gen-engine continuous)
     gen_max_new: int = 64  # per-request decode budget in engine mode
     score_fn: Any = None  # sequences -> per-token logprobs (/score)
+    model_name: str = "default"  # /v1/models id + completion envelopes
     # per-server lock (set in make_server): serializes jax dispatch on
     # one model while the HTTP layer stays threaded, so health checks
     # never queue behind a big batch
@@ -72,6 +91,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def log_message(self, fmt, *fargs):  # route to logging, not stderr
         logger.info("%s " + fmt, self.client_address[0], *fargs)
+
+    def _read_json_body(self):
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length) or b"{}")
 
     def _reply(
         self, code: int, payload: dict, headers: dict | None = None
@@ -90,6 +113,23 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, {"status": "ok", "export_dir": self.export_dir})
         elif self.path == "/signature" and self.model is not None:
             self._reply(200, self.model.meta)
+        elif self.path == "/v1/models":
+            # the OpenAI SDK's client.models.list() handshake — some
+            # eval harnesses refuse to start without it
+            self._reply(
+                200,
+                {
+                    "object": "list",
+                    "data": [
+                        {
+                            "id": self.model_name,
+                            "object": "model",
+                            "created": 0,
+                            "owned_by": "tensorflowonspark_tpu",
+                        }
+                    ],
+                },
+            )
         elif self.path == "/stats":
             stats: dict = {"mode": "aot" if self.model is not None else ""}
             if self.gen_engine is not None:
@@ -109,6 +149,9 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/generate":
             self._do_generate()
             return
+        if self.path == "/v1/completions":
+            self._do_v1_completions()
+            return
         if self.path == "/score":
             self._do_score()
             return
@@ -122,8 +165,7 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(length) or b"{}")
+            payload = self._read_json_body()
             rows = payload["rows"]
             if not isinstance(rows, list) or not rows:
                 raise ValueError("'rows' must be a non-empty list")
@@ -153,8 +195,7 @@ class _Handler(BaseHTTPRequestHandler):
         from tensorflowonspark_tpu.tools.generate_text import PromptError
 
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(length) or b"{}")
+            payload = self._read_json_body()
             seqs = payload["sequences"]
             if not isinstance(seqs, list):
                 raise ValueError("'sequences' must be a list")
@@ -174,7 +215,19 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._reply(200, {"logprobs": logprobs})
 
-    def _do_generate(self) -> None:
+    def _do_v1_completions(self) -> None:
+        """OpenAI /v1/completions alias: translate the request into the
+        native /generate schema and run the shared path, then wrap the
+        result in the text_completion envelope."""
+        try:
+            raw = self._read_json_body()
+            payload, meta = _openai_to_generate(raw, self.gen_max_new)
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        self._do_generate(payload=payload, v1_meta=meta)
+
+    def _do_generate(self, payload=None, v1_meta=None) -> None:
         if self.gen_fn is None and self.gen_engine is None:
             self._reply(
                 400, {"error": "server was not started with "
@@ -182,8 +235,8 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(length) or b"{}")
+            if payload is None:
+                payload = self._read_json_body()
             prompts = payload["prompts"]
             if not isinstance(prompts, list) or not prompts:
                 raise ValueError("'prompts' must be a non-empty list")
@@ -327,9 +380,11 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                     if want_logprobs:
                         completions, logprobs = completions
-                    if n > 1:
+                    if n > 1 and v1_meta is None:
                         # regroup: completions[i] becomes the LIST of n
-                        # samples for prompt i (documented shape change)
+                        # samples for prompt i (documented shape change;
+                        # the OpenAI envelope keeps the flat order —
+                        # prompt 0's n samples, then prompt 1's, ...)
                         completions = [
                             completions[i * n : (i + 1) * n]
                             for i in range(len(prompts))
@@ -364,6 +419,52 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001 - server-side; log + 500
             logger.exception("generation failed")
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        if v1_meta is not None:
+            eff_max = (
+                max_new if max_new is not None else self.gen_max_new
+            )
+            choices = []
+            for i, comp in enumerate(completions):
+                ch = {
+                    "index": i,
+                    # token-id server: no tokenizer to render text with;
+                    # the ids ride in "tokens" (clients detokenize)
+                    "text": "",
+                    "tokens": comp,
+                    "logprobs": None,
+                    "finish_reason": (
+                        "stop" if len(comp) < eff_max else "length"
+                    ),
+                }
+                if logprobs is not None:
+                    ch["logprobs"] = {
+                        "tokens": comp,
+                        "token_logprobs": logprobs[i],
+                        "top_logprobs": None,
+                        "text_offset": None,
+                    }
+                choices.append(ch)
+            import uuid
+
+            self._reply(
+                200,
+                {
+                    "id": f"cmpl-{uuid.uuid4().hex}",
+                    "object": "text_completion",
+                    "created": int(time.time()),
+                    "model": v1_meta["model"] or self.model_name,
+                    "choices": choices,
+                    "usage": {
+                        "prompt_tokens": sum(len(p) for p in prompts),
+                        "completion_tokens": sum(
+                            len(c) for c in completions
+                        ),
+                        "total_tokens": sum(len(p) for p in prompts)
+                        + sum(len(c) for c in completions),
+                    },
+                },
+            )
             return
         body = {"completions": completions}
         if logprobs is not None:
@@ -503,6 +604,90 @@ class _Handler(BaseHTTPRequestHandler):
             presence_penalty=presence_penalty,
             logit_bias=logit_bias,
         )
+
+
+def _openai_to_generate(raw: Any, budget: int) -> tuple[dict, dict]:
+    """Translate an OpenAI /v1/completions body into the native
+    /generate schema (+ envelope metadata). Raises ValueError on
+    malformed or unsupported fields; the caller replies 400.
+
+    Token ids only: ``prompt`` is [ids] or [[ids], ...] and ``stop`` is
+    [ids] or [[ids], ...] — text forms are rejected with an explanation
+    (tokenizers are corpus-specific, out of framework scope; pipe
+    through one client-side). ``max_tokens`` defaults to the OpenAI 16
+    clamped to the server's decode ``budget`` (a request that omitted
+    every optional field must not 400 on a small-budget server; an
+    EXPLICIT over-budget or zero value still rides the existing [1, N]
+    validation); ``temperature`` defaults to the OpenAI 1.0 (NOT the
+    engine's startup default, which is typically greedy — a client that
+    sent nothing must get OpenAI semantics). ``logprobs: N`` maps to
+    the sampled token's logprob for any non-null N including 0 (top-N
+    alternatives are not offered). ``echo``, ``suffix``, ``best_of``
+    (beyond n) and ``stream`` are unsupported.
+    """
+    if not isinstance(raw, dict):
+        raise ValueError("body must be a JSON object")
+    if raw.get("echo"):
+        raise ValueError("'echo' is not supported; POST /score for "
+                         "prompt logprobs")
+    if raw.get("suffix"):
+        raise ValueError("'suffix' (insertion) is not supported")
+    if raw.get("stream"):
+        raise ValueError("'stream' is not supported on /v1/completions;"
+                         " POST /generate with stream=true instead")
+    n = raw.get("n")
+    best_of = raw.get("best_of")
+    if best_of is not None and best_of != (n or 1):
+        raise ValueError("'best_of' beyond 'n' is not supported")
+
+    def _token_rows(value, what):
+        if isinstance(value, str) or (
+            isinstance(value, list)
+            and any(isinstance(v, str) for v in value)
+        ):
+            raise ValueError(
+                f"text {what} need a tokenizer, which is corpus-"
+                f"specific and out of framework scope; send token ids "
+                f"([[int, ...]]) and detokenize client-side"
+            )
+        if not isinstance(value, list) or not value:
+            raise ValueError(
+                f"'{what}' must be a non-empty token-id list or a "
+                f"list of them"
+            )
+        return (
+            [list(r) for r in value]
+            if isinstance(value[0], list)
+            else [list(value)]
+        )
+
+    payload: dict = {"prompts": _token_rows(raw.get("prompt"), "prompts")}
+    max_tokens = raw.get("max_tokens")
+    payload["max_new_tokens"] = (
+        min(16, budget) if max_tokens is None else int(max_tokens)
+    )
+    temp = raw.get("temperature")
+    payload["temperature"] = 1.0 if temp is None else float(temp)
+    for key in (
+        "top_p",
+        "seed",
+        "frequency_penalty",
+        "presence_penalty",
+        "logit_bias",
+        "n",
+        # extensions shared with /generate (not OpenAI, but harmless)
+        "eos_id",
+        "adapter",
+        "top_k",
+        "min_p",
+    ):
+        if raw.get(key) is not None:
+            payload[key] = raw[key]
+    if raw.get("stop") is not None:
+        payload["stop"] = _token_rows(raw["stop"], "stop sequences")
+    if raw.get("logprobs") is not None:  # 0 is valid: sampled-token lp
+        payload["logprobs"] = True
+    return payload, {"model": raw.get("model")}
 
 
 class _GenBatcher:
@@ -978,6 +1163,11 @@ def make_server(
             "score_fn": staticmethod(score_fn)
             if score_fn is not None
             else None,
+            "model_name": (
+                str(gen.get("served_model_name") or "default")
+                if gen
+                else "default"
+            ),
             "predict_lock": lock,
         },
     )
@@ -1089,6 +1279,12 @@ def main(argv: list[str] | None = None) -> int:
         "requests before stopping instead of failing them",
     )
     p.add_argument(
+        "--served-model-name",
+        default="default",
+        help="model id reported by GET /v1/models and echoed in "
+        "/v1/completions envelopes (OpenAI-compatible clients key on it)",
+    )
+    p.add_argument(
         "--gen-lora-scale",
         type=float,
         default=None,
@@ -1158,6 +1354,7 @@ def main(argv: list[str] | None = None) -> int:
             warmup=args.gen_warmup,
             lora_scale=args.gen_lora_scale,
             drain_on_shutdown=args.gen_drain_on_shutdown,
+            served_model_name=args.served_model_name,
         )
     server = make_server(
         args.export_dir, args.port, args.batch_size, host=args.host, gen=gen

@@ -900,3 +900,150 @@ def test_serve_model_n_samples(tmp_path):
         assert len(body["completions"][0]) == 2
     finally:
         server2.shutdown()
+
+
+def test_serve_model_openai_completions(tmp_path):
+    """/v1/completions is an OpenAI-shaped alias over the continuous
+    engine: token-id prompts in, text_completion envelope out (ids in
+    choices[].tokens — no tokenizer in scope), with the OpenAI defaults
+    (max_tokens 16, temperature 1.0) rather than the engine's, and
+    clear 400s for the text-in/text-out fields this server cannot
+    honor. GET /v1/models serves the SDK handshake."""
+    import threading
+    import urllib.request
+
+    from tensorflowonspark_tpu.tools import serve_model
+
+    cfg, model, params, ckpt_dir = _tiny_checkpoint(tmp_path)
+    gen = dict(
+        checkpoint=ckpt_dir,
+        model="tiny",
+        config_overrides='{"remat": false, "dtype": "float32"}',
+        width=8,
+        batch_size=4,
+        max_new_tokens=8,
+        engine="continuous",
+        served_model_name="tiny-fp32",
+    )
+    server = serve_model.make_server(None, port=0, gen=gen)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/models"
+        ) as r:
+            models = json.loads(r.read())
+        assert models["object"] == "list"
+        assert models["data"][0]["id"] == "tiny-fp32"
+
+        # greedy (temperature 0) matches the library decode exactly
+        want = np.asarray(
+            generate(model, params, jnp.asarray([[2, 4]], jnp.int32), 5)
+        )[0].tolist()
+        code, body = _post(
+            port, "/v1/completions",
+            {"prompt": [2, 4], "max_tokens": 5, "temperature": 0},
+        )
+        assert code == 200, body
+        assert body["object"] == "text_completion"
+        assert body["model"] == "tiny-fp32"
+        assert body["id"].startswith("cmpl-")
+        (choice,) = body["choices"]
+        assert choice["tokens"] == want
+        assert choice["text"] == ""  # token-id server
+        assert choice["finish_reason"] == "length"
+        assert body["usage"] == {
+            "prompt_tokens": 2,
+            "completion_tokens": 5,
+            "total_tokens": 7,
+        }
+
+        # multiple prompts + n: flat choice order, prompt 0's samples
+        # first; logprobs -> per-token sampled logprobs
+        code, body = _post(
+            port, "/v1/completions",
+            {"prompt": [[1, 2], [5, 6, 7]], "n": 2, "max_tokens": 4,
+             "temperature": 0.9, "seed": 11, "logprobs": 1},
+        )
+        assert code == 200, body
+        assert [c["index"] for c in body["choices"]] == [0, 1, 2, 3]
+        for c in body["choices"]:
+            assert len(c["tokens"]) == 4
+            lp = c["logprobs"]["token_logprobs"]
+            assert len(lp) == 4 and all(v <= 0.0 for v in lp)
+        assert body["usage"]["prompt_tokens"] == 5
+        assert body["usage"]["completion_tokens"] == 16
+
+        # seeded requests reproduce through the OpenAI surface too
+        code2, body2 = _post(
+            port, "/v1/completions",
+            {"prompt": [[1, 2], [5, 6, 7]], "n": 2, "max_tokens": 4,
+             "temperature": 0.9, "seed": 11, "logprobs": 1},
+        )
+        assert code2 == 200
+        assert [c["tokens"] for c in body2["choices"]] == [
+            c["tokens"] for c in body["choices"]
+        ]
+
+        # a hit stop sequence reports finish_reason "stop"
+        code, body = _post(
+            port, "/v1/completions",
+            {"prompt": [2, 4], "max_tokens": 5, "temperature": 0,
+             "stop": want[1:3]},
+        )
+        assert code == 200, body
+        assert body["choices"][0]["tokens"] == want[:1]
+        assert body["choices"][0]["finish_reason"] == "stop"
+
+        # text-world fields are explained, not mis-served
+        code, body = _post(
+            port, "/v1/completions",
+            {"prompt": "Once upon a time", "max_tokens": 4},
+        )
+        assert code == 400 and "tokenizer" in body["error"]
+        code, body = _post(
+            port, "/v1/completions",
+            {"prompt": [2, 4], "stop": ["\n"]},
+        )
+        assert code == 400 and "tokenizer" in body["error"]
+        code, body = _post(
+            port, "/v1/completions",
+            {"prompt": [2, 4], "echo": True},
+        )
+        assert code == 400 and "echo" in body["error"]
+        code, body = _post(
+            port, "/v1/completions",
+            {"prompt": [2, 4], "stream": True},
+        )
+        assert code == 400 and "stream" in body["error"]
+        # over-budget max_tokens rides the existing validation
+        code, body = _post(
+            port, "/v1/completions",
+            {"prompt": [2, 4], "max_tokens": 999},
+        )
+        assert code == 400 and "max_new_tokens" in body["error"]
+        # ...as does an explicit 0 (OpenAI allows it; we say why not)
+        code, body = _post(
+            port, "/v1/completions",
+            {"prompt": [2, 4], "max_tokens": 0},
+        )
+        assert code == 400 and "max_new_tokens" in body["error"]
+        # the all-defaults request must NOT 400 on a small-budget
+        # server: the OpenAI default 16 clamps to the budget (8 here)
+        code, body = _post(
+            port, "/v1/completions",
+            {"prompt": [2, 4], "temperature": 0},
+        )
+        assert code == 200, body
+        assert len(body["choices"][0]["tokens"]) == 8
+        # logprobs: 0 is valid OpenAI (sampled-token logprobs, no
+        # top-alternatives) — not a falsy "omit"
+        code, body = _post(
+            port, "/v1/completions",
+            {"prompt": [2, 4], "max_tokens": 3, "temperature": 0,
+             "logprobs": 0},
+        )
+        assert code == 200, body
+        assert len(body["choices"][0]["logprobs"]["token_logprobs"]) == 3
+    finally:
+        server.shutdown()
