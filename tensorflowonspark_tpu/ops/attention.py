@@ -263,7 +263,8 @@ def mesh_flash_attention(
     """Flash attention on a multi-device mesh via ``shard_map``.
 
     GSPMD cannot partition a ``pallas_call`` (the same limitation
-    documented at :func:`bn_kernels.use_pallas`): left inside a plain
+    documented at :func:`bn_kernels.stats_mesh` and
+    :func:`parallel.context.dispatch_mesh`): left inside a plain
     ``jit`` over a sharded mesh, the kernel's operands would be
     all-gathered onto every chip. Attention is embarrassingly parallel
     over batch and heads, so this wrapper places the kernel per-shard —

@@ -10,10 +10,19 @@ of the step (~76% MXU-efficient) — the stats traffic is the ceiling.
 
 Round-4 finding (profiled A/B on the chip): XLA already merges the sibling
 reductions into ~2 fused passes per layer — but runs them at ~20-30% of
-HBM streaming rate. So the win is not in *pass structure* (the round-3
-custom-VJP re-derivation measured 15.8% MFU vs flax BN's 16.1%) but in
-*pass rate*: `ops/bn_kernels.py` provides Pallas streaming kernels for the
-two stats passes, used here on TPU backends:
+HBM streaming rate. So the win looked like *pass rate*, not *pass
+structure* (the round-3 custom-VJP re-derivation measured 15.8% MFU vs
+flax BN's 16.1%), and `ops/bn_kernels.py` answered with Pallas streaming
+kernels for the two stats passes.
+
+Round-5 finding (the kernels' own chip A/B): the Pallas path REGRESSED
+in-context — ResNet-50 8.9% vs 16.1%, Inception-v3 13.7% vs 18.2%. The
+"slow" reduce fusions were amortized: fused with neighboring elementwise
+work over inputs still resident from the producing conv. An opaque
+``pallas_call`` severs that, forcing extra materialized activation
+round-trips that cost more than the streamed reduce saves. ``impl='auto'``
+therefore resolves to the XLA reduces everywhere; the kernels stay for
+explicit ``impl='pallas'`` standalone-stats callers:
 
 - forward: ONE kernel pass over x for per-channel (sum, sum_sq) → mean/var
   (fp32 accumulation over the bf16 stream); one fused normalize pass
@@ -198,8 +207,9 @@ class FusedBatchNorm(nn.Module):
     """Drop-in for ``nn.BatchNorm`` on the conv-net train path.
 
     Train (``use_running_average=False``): normalizes with exact batch
-    statistics (one stats pass per direction — Pallas-streamed on TPU,
-    multi-output reduce fusion elsewhere) and updates fp32 running stats
+    statistics (one stats pass per direction — XLA multi-output reduce
+    fusion by default; explicit ``impl='pallas'`` opts into the
+    streaming kernels, see the module header) and updates fp32 running stats
     under the standard ``batch_stats`` collection, with ``nn.BatchNorm``'s
     variable names (``mean``/``var``/``scale``/``bias``) and momentum
     convention. The flax auto-name of this class differs from

@@ -23,6 +23,17 @@ caller derives ``sum(dy*xhat) = invstd * (sum(dy*x) - mean*sum(dy))`` in
 fp32 (same cancellation class as the one-pass variance, accepted and
 documented in ops/batch_norm.py).
 
+Round-5 status (measured, real v5e chip): IN-CONTEXT these kernels
+REGRESS — ResNet-50 8.9% MFU vs 16.1% through the XLA reduces,
+Inception-v3 13.7% vs 18.2%. The "slow" reduce fusions were amortized:
+fused with neighboring elementwise work over conv outputs still resident
+in the fusion; an opaque ``pallas_call`` severs that and forces extra
+materialized activation round-trips that outweigh the streamed reduce's
+rate win (full post-mortem in BASELINE.md). ``impl='auto'`` therefore
+never picks these kernels; they remain for explicit standalone-stats
+callers, where ``cross_stats`` measured ~2x the XLA reduce rate in
+isolation.
+
 Parity note: the reference delegated BN to TF's cuDNN fused kernels
 (SURVEY.md §1 — no compute code of its own); this is the TPU-native
 equivalent of that fused-statistics path.
@@ -145,17 +156,17 @@ def cross_stats(dy: jax.Array, x: jax.Array) -> tuple[jax.Array, jax.Array]:
 def use_pallas(impl: str = "auto") -> bool:
     """'pallas' | 'xla' | 'auto'.
 
-    'auto' picks the raw (single-array) Pallas path only on a
-    SINGLE-device TPU process: with more than one device visible,
-    activations may be GSPMD-sharded, and GSPMD cannot partition a
-    pallas_call — it would replicate the operands, all-gathering the
-    full activation per BN layer. The multi-device fast path is
-    :func:`stats_mesh` + the ``mesh_*_stats`` shard_map wrappers
-    (per-shard partial sums + psum), keyed on the ambient mesh the
-    train/eval-step builders publish; with no mesh, multi-device 'auto'
-    falls back to the sibling ``jnp.sum`` reduces, which GSPMD
-    partitions for free. Explicit impl='pallas' overrides — callers
-    doing their own shard_map placement know the operands are local.
+    'auto' now ALWAYS resolves to the XLA sibling reduces. The round-5
+    chip A/B falsified the kernels' in-context premise: ResNet-50
+    measured 8.9% MFU through these kernels vs 16.1% through the XLA
+    stats path (Inception-v3: 13.7% vs 18.2%) — an opaque
+    ``pallas_call`` severs XLA's producer/consumer fusion around each
+    BN layer, and the extra materialized activation round-trips cost
+    more than the streamed reduce saves (BASELINE.md, "Where the
+    ResNet-50 step goes"). The kernels remain for explicit
+    ``impl='pallas'`` callers that use the stats standalone (the bwd
+    ``cross_stats`` pair measured ~2× the XLA reduce rate in
+    isolation) — where there is no surrounding fusion to sever.
     """
     if impl == "pallas":
         return True
@@ -163,10 +174,7 @@ def use_pallas(impl: str = "auto") -> bool:
         return False
     if impl != "auto":
         raise ValueError(f"impl must be pallas|xla|auto, got {impl!r}")
-    try:
-        return _on_tpu() and len(jax.devices()) == 1
-    except RuntimeError:  # pragma: no cover - no backend at all
-        return False
+    return False
 
 
 # Test hook, mirroring ops.attention.TREAT_AS_TPU: lets CI exercise the
@@ -180,18 +188,24 @@ def _on_tpu() -> bool:
 
 
 def stats_mesh(impl: str, batch_extent: int):
-    """The ambient mesh, iff multi-device ``auto`` should take the
+    """The ambient mesh, iff EXPLICIT ``impl='pallas'`` should take the
     shard_map route: per-shard Pallas partial sums + a psum over the
     batch axes. Returns None for "use use_pallas()'s answer".
 
-    Conditions: auto on a multi-device TPU, an ambient mesh published
-    (``parallel.use_mesh`` — the train/eval-step builders do this during
-    tracing), only batch-like axes sharded (conv activations shard the
-    leading dim over ``(data, fsdp)``; a model/seq-sharded mesh means
-    someone else owns the layout), not already inside a shard_map body,
-    and the batch extent divisible over the mesh's batch axes.
+    Keyed on explicit 'pallas' (not 'auto' — 'auto' always resolves to
+    the XLA reduces since the round-5 regression measure, see
+    :func:`use_pallas`): an explicit caller inside a jitted,
+    GSPMD-sharded train step would otherwise hand a sharded operand to
+    a raw ``pallas_call``, which GSPMD replicates — the shard_map route
+    keeps the kernel's operands shard-local. Conditions: multi-device
+    TPU, an ambient mesh published (``parallel.use_mesh`` — the
+    train/eval-step builders do this during tracing), only batch-like
+    axes sharded (conv activations shard the leading dim over
+    ``(data, fsdp)``; a model/seq-sharded mesh means someone else owns
+    the layout), not already inside a shard_map body, and the batch
+    extent divisible over the mesh's batch axes.
     """
-    if impl != "auto":
+    if impl != "pallas":
         return None
     from tensorflowonspark_tpu.parallel.context import dispatch_mesh
 
