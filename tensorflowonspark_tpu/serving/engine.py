@@ -441,6 +441,16 @@ class ContinuousBatcher:
     width (one prefill compilation each). A prompt longer than the
     largest width is rejected, as is prompt+budget beyond the model's
     ``max_seq_len`` (the KV cache cannot hold it).
+
+    ``decode_block``: steady-state decode runs as one ``lax.scan`` of
+    this many steps per host iteration (one dispatch + one fetch per
+    block instead of per token), dropping to single steps only while a
+    queued request could actually be admitted into a free slot (or a
+    chunked prefill is in flight). Rows finishing mid-block — budget,
+    stop, or eos — retire at their finish point; surplus block tokens
+    are discarded, never emitted. Kept tokens are bit-identical to
+    single stepping; set ``decode_block=1`` to disable (e.g. to
+    minimize admission latency jitter under bursty traffic).
     """
 
     _STOP = object()
@@ -462,6 +472,7 @@ class ContinuousBatcher:
         max_queue: int | None = None,
         prefill_chunk: int | None = None,
         prefix_cache: int | None = None,
+        decode_block: int = 8,
     ):
         cfg = model.cfg
         self._model = model
@@ -602,6 +613,23 @@ class ContinuousBatcher:
         self._stop_now = threading.Event()
         self._submit_lock = threading.Lock()
         self._prefill_cache: dict = {}
+        # Block decode (round 5): in steady state the loop runs ONE
+        # lax.scan of decode_block steps per host iteration instead of
+        # decode_block jit calls — collapsing the per-token host
+        # round-trips (gates upload, dispatch, token fetch, waiter
+        # hand-off) that measured 152 ms/token of the 154.9 ms engine
+        # step through this environment's tunneled relay (BASELINE.md,
+        # engine A/B row). Kept tokens are bit-identical to single
+        # stepping (sampling is (seed, position)-keyed); a row that
+        # finishes mid-block — budget, stop, or eos — wastes its
+        # remaining block steps: the surplus tokens are discarded
+        # host-side, never emitted.
+        self._decode_block = max(1, int(decode_block))
+        self._block_cache: dict = {}
+        # Device-resident (4,) gates array, rebuilt only when the live
+        # set changes (admit/retire), not per step: the per-step
+        # jnp.asarray was a host->device upload on the decode hot path.
+        self._gates_arr = None
         # The request popped from the queue but not yet parked in a slot
         # — must be failed explicitly if the loop dies mid-admission.
         self._inflight: _Pending | None = None
@@ -1056,7 +1084,7 @@ class ContinuousBatcher:
         only insofar as they are real requests — warm up BEFORE
         exposing /stats to dashboards if that matters."""
         # Budget 2 with eos DISABLED on (at least) one request: a
-        # 1-token budget retires at admission and the decode _step_fn —
+        # 1-token budget retires at admission and the decode step —
         # the program every subsequent token runs — would never
         # compile; and without eos_id=-1 a sampled first token equal to
         # the engine's default eos could nondeterministically retire
@@ -1085,6 +1113,20 @@ class ContinuousBatcher:
                 prev = w
             if not step_warmed:
                 self.submit([0], 2, eos_id=-1)
+        if self._decode_block > 1:
+            # The k=1 program still runs whenever an admission or chunk
+            # job is pending, but every warmup submit above was a lone
+            # request (empty queue) and so compiled only the k-block
+            # scan. Pin the block to 1 for one throwaway request so
+            # saturated traffic doesn't pay the single-step compile.
+            # Safe: submit() blocks until completion and the loop
+            # thread reads _decode_block afresh each iteration.
+            blk = self._decode_block
+            self._decode_block = 1
+            try:
+                self.submit([0], 2, eos_id=-1)
+            finally:
+                self._decode_block = blk
         if self._prefix_store is not None:
             # drop the throwaway prompts' entries — each would pin a
             # full single-row KV cache of HBM until evicted. Safe here:
@@ -1106,6 +1148,7 @@ class ContinuousBatcher:
             "slots_busy": busy,
             "queue_depth": self._queue.qsize(),
             "steps": self.steps,
+            "decode_block": self._decode_block,
             "admitted": self.admitted,
             "completed": self.completed,
             "cancelled": self.cancelled,
@@ -1206,13 +1249,14 @@ class ContinuousBatcher:
             cache,
         )
 
-    @functools.cached_property
-    def _step_fn(self):
+    def _decode_body(self):
+        """One decode step — the body shared by every k in
+        :meth:`_block_fn` (k=1 is the old per-token program; k>1 wraps
+        it in a ``lax.scan``)."""
         model = self._model
         constrain = self._constrain_cache
 
-        @jax.jit
-        def step(
+        def body(
             params, cache, tok, pos, temps, ads, kps, seeds, pens,
             counts, bias_ids, bias_vals, gates,
         ):
@@ -1238,7 +1282,10 @@ class ContinuousBatcher:
                 bias_ids, bias_vals, gates,
             )
             # the emitted token enters its row's generated-token counts
-            # (cond: all-unpenalized batches never write the plane)
+            # (cond: all-unpenalized batches never write the plane).
+            # Inside a block this runs per scan iteration, so penalties
+            # see every token of the block as it lands — identical to
+            # single stepping.
             counts = jax.lax.cond(
                 gates[2],
                 lambda c: c + jax.nn.one_hot(
@@ -1253,7 +1300,44 @@ class ContinuousBatcher:
             nxt_pos = jnp.minimum(pos + 1, model.cfg.max_seq_len - 1)
             return constrain(updated["cache"]), nxt, nxt_pos, lp, counts
 
-        return step
+        return body
+
+    def _block_fn(self, k: int):
+        """Jitted k-step decode block. Per-instance memo like
+        :meth:`_prefill_fn` (a class-level cache would pin closed
+        engines). Returns ``(cache, tok, pos, packed, counts)`` where
+        ``packed`` is ONE (2, k, slots) fp32 array — row 0 the sampled
+        int32 tokens bitcast to f32, row 1 their logprobs — so the host
+        retires a whole block with a single device fetch instead of
+        2·k transfers."""
+        cached = self._block_cache.get(k)
+        if cached is not None:
+            return cached
+        body = self._decode_body()
+
+        @jax.jit
+        def block(
+            params, cache, tok, pos, temps, ads, kps, seeds, pens,
+            counts, bias_ids, bias_vals, gates,
+        ):
+            def scan_body(carry, _):
+                cache, tok, pos, counts = carry
+                cache, nxt, nxt_pos, lp, counts = body(
+                    params, cache, tok, pos, temps, ads, kps, seeds,
+                    pens, counts, bias_ids, bias_vals, gates,
+                )
+                return (cache, nxt, nxt_pos, counts), (nxt, lp)
+
+            (cache, tok, pos, counts), (toks, lps) = jax.lax.scan(
+                scan_body, (cache, tok, pos, counts), None, length=k
+            )
+            packed = jnp.stack(
+                [jax.lax.bitcast_convert_type(toks, jnp.float32), lps]
+            )
+            return cache, tok, pos, packed, counts
+
+        self._block_cache[k] = block
+        return block
 
     def _prefill_fn(self, width: int):
         # Per-instance memo (NOT functools.lru_cache on the method: a
@@ -1565,6 +1649,7 @@ class ContinuousBatcher:
         first = int(np.asarray(tok_1)[0])
         lps = [float(np.asarray(lp_1)[0])]
         self._live[job.row] = (job.p, [first], lps)
+        self._gates_arr = None
         self.admitted += 1
         job.p.emit(first, lps[0])
         if self._finished(job.p, [first], first):
@@ -1670,6 +1755,16 @@ class ContinuousBatcher:
             val = int(self._seed_rng.integers(2**32, dtype=np.uint32))
         return jnp.asarray([val], jnp.uint32)
 
+    def _gates_dev(self):
+        """The (4,) gates array for the decode step, cached across
+        steps: the live set (and with it every resolved knob) only
+        changes at admission/retire, so rebuilding per token — a
+        host→device upload on the hot path — was pure overhead. Every
+        ``_live`` mutation site clears ``_gates_arr``."""
+        if self._gates_arr is None:
+            self._gates_arr = self._step_gates()
+        return self._gates_arr
+
     def _step_gates(self):
         """(4,) bool [sort, min_p, penalties, bias] from the LIVE rows'
         resolved knobs — the host's bookkeeping, not the device arrays,
@@ -1740,6 +1835,7 @@ class ContinuousBatcher:
         out = [first]
         lps = [float(np.asarray(lp_1)[0])]
         self._live[row] = (p, out, lps)
+        self._gates_arr = None
         self.admitted += 1
         p.emit(first, lps[0])
         if self._finished(p, out, first):
@@ -1770,6 +1866,7 @@ class ContinuousBatcher:
     def _retire(self, row: int) -> None:
         p, out, lps = self._live[row]
         self._live[row] = None
+        self._gates_arr = None
         now = time.monotonic()
         self.tokens_emitted += len(out)  # decoded count, pre-trim
         matched = max(
@@ -1825,6 +1922,7 @@ class ContinuousBatcher:
             if entry is not None:
                 self._fail_one(entry[0], err)
                 self._live[row] = None
+        self._gates_arr = None
         while True:
             try:
                 item = self._queue.get_nowait()
@@ -1915,24 +2013,52 @@ class ContinuousBatcher:
                 if all(e is None for e in self._live):
                     continue  # nothing decoding; admit/chunk again
 
-                cache, tok, pos, lp, counts = self._step_fn(
+                # Block size for this iteration: the full decode_block
+                # unless an admission could actually proceed right now —
+                # a queued request with a FREE slot (all-slots-busy
+                # backlog keeps blocking: dropping to k=1 then would
+                # reinstate the per-token host round-trips for the whole
+                # saturated period while admitting nothing), or a
+                # chunked-prefill job in flight (it advances one chunk
+                # per loop iteration, so a block would starve it).
+                # Rows that finish mid-block — budget, stop, or eos —
+                # retire at their finish point in the host sweep below;
+                # their surplus block tokens are discarded, never
+                # emitted (the device-side waste is bounded by k-1
+                # ~ms-scale steps per retire, vs the ~100 ms-scale
+                # per-token host round-trips a whole-batch k=1
+                # fallback would reinstate), their garbage cache
+                # writes are position-clamped and overwritten by the
+                # next admission.
+                k = self._decode_block
+                if k > 1 and (
+                    self._job is not None
+                    or (
+                        not self._queue.empty()
+                        and any(e is None for e in self._live)
+                    )
+                ):
+                    k = 1
+                cache, tok, pos, packed, counts = self._block_fn(k)(
                     self._params, cache, tok, pos, temps, ads, kps,
                     seeds, pens, counts, bids, bvals,
-                    self._step_gates(),
+                    self._gates_dev(),
                 )
-                self.steps += 1
-                host_tok = np.asarray(tok)
-                host_lp = np.asarray(lp)
-                for row, entry in enumerate(self._live):
-                    if entry is None:
-                        continue
-                    p, out, lps = entry
-                    t = int(host_tok[row])
-                    out.append(t)
-                    lps.append(float(host_lp[row]))
-                    p.emit(t, lps[-1])
-                    if self._finished(p, out, t):
-                        self._retire(row)
+                self.steps += k
+                host = np.asarray(packed)  # ONE fetch: (2, k, slots)
+                host_tok = host[0].view(np.int32)
+                host_lp = host[1]
+                for j in range(k):
+                    for row, entry in enumerate(self._live):
+                        if entry is None:
+                            continue  # free, or finished earlier in block
+                        p, out, lps = entry
+                        t = int(host_tok[j, row])
+                        out.append(t)
+                        lps.append(float(host_lp[j, row]))
+                        p.emit(t, lps[-1])
+                        if self._finished(p, out, t):
+                            self._retire(row)
         except BaseException as e:  # noqa: BLE001 - ferry to waiters
             logger.exception("continuous-batcher loop died")
             # Refuse new submits FIRST (a dead loop never answers), then

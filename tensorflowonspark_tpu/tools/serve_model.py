@@ -930,6 +930,7 @@ def _build_engine(gen: dict):
         max_queue=gen.get("max_queue"),
         prefill_chunk=gen.get("prefill_chunk"),
         prefix_cache=gen.get("prefix_cache"),
+        decode_block=int(gen.get("decode_block") or 8),
     )
     if gen.get("warmup"):
         t0 = time.monotonic()
@@ -1310,6 +1311,15 @@ def main(argv: list[str] | None = None) -> int:
         "Requires --gen-prefill-chunk",
     )
     p.add_argument(
+        "--gen-decode-block",
+        type=int,
+        default=8,
+        help="continuous engine: decode this many tokens per host "
+        "scheduling iteration as one on-device lax.scan (fewer "
+        "host round-trips per token); 1 = per-token scheduling "
+        "(minimum admission-latency jitter)",
+    )
+    p.add_argument(
         "--gen-prefill-chunk",
         type=int,
         default=None,
@@ -1351,6 +1361,7 @@ def main(argv: list[str] | None = None) -> int:
             max_queue=args.gen_max_queue,
             prefill_chunk=args.gen_prefill_chunk,
             prefix_cache=args.gen_prefix_cache,
+            decode_block=args.gen_decode_block,
             warmup=args.gen_warmup,
             lora_scale=args.gen_lora_scale,
             drain_on_shutdown=args.gen_drain_on_shutdown,

@@ -1228,7 +1228,10 @@ def test_engine_warmup_compiles_all_buckets(tiny):
     )
     try:
         chunked.warmup()
-        assert chunked.stats()["completed"] == 1
+        # two warmup requests: the chunk/sample/admit leg + the
+        # decode_block leg (chunked engines block-decode in steady
+        # state too, so the scan variant must compile here as well)
+        assert chunked.stats()["completed"] == 2
         assert chunked.steps > 0
         assert chunked.submit([5, 6], 3) == _reference(
             model, params, [5, 6], 3
@@ -1390,5 +1393,91 @@ def test_engine_stop_sequence_caps_and_longest_match(tiny):
         seen = list(stream)
         assert seen == base[:5]  # raw, includes the stop pair
         assert stream.result == base[:3]  # trimmed
+    finally:
+        eng.close()
+
+
+def test_block_decode_matches_single_step(tiny):
+    """decode_block > 1 must be invisible in outputs: the same seeded
+    sampled + greedy requests through a block engine and a
+    block-disabled engine produce identical tokens and logprobs —
+    sampling is (seed, position)-keyed, so block boundaries cannot
+    shift the stream. Also asserts the block program actually ran (the
+    gate could silently fall back to k=1 forever and this test would
+    still 'pass' on outputs alone)."""
+    cfg, model, params = tiny
+    reqs = [
+        dict(tokens=[1, 2, 3], temperature=0.9, seed=7),
+        dict(tokens=[5], temperature=0.7, top_k=5, seed=3),
+        dict(tokens=[9, 4], ),  # greedy rider
+    ]
+    outs = {}
+    for block in (1, 4):
+        eng = ContinuousBatcher(
+            model, params, slots=3, prompt_widths=(8,),
+            decode_block=block,
+        )
+        ks = []
+        orig = eng._block_fn
+        eng._block_fn = lambda k: (ks.append(k), orig(k))[1]
+        try:
+            outs[block] = [
+                eng.submit(
+                    r["tokens"], 12, return_logprobs=True,
+                    **{k: v for k, v in r.items() if k != "tokens"},
+                )
+                for r in reqs
+            ]
+        finally:
+            eng.close()
+        if block > 1:
+            assert block in ks, "block program never dispatched"
+        else:
+            assert set(ks) <= {1}
+    assert outs[1] == outs[4]
+
+
+def test_block_decode_stop_sequence_discards_surplus(tiny):
+    """A stop sequence completing mid-block retires the row there: the
+    block's surplus tokens are never emitted, and the result is trimmed
+    before the stop text exactly like the single-step path."""
+    cfg, model, params = tiny
+    want_full = _reference(model, params, [1, 2, 3], 12)
+    # the stop must FIRST occur mid-block (index 1..6): greedy tiny
+    # models repeat, so pick the first token that hasn't appeared before
+    j = next(
+        i for i in range(1, 7) if want_full[i] not in want_full[:i]
+    )
+    stop_tok = want_full[j]
+    eng = ContinuousBatcher(
+        model, params, slots=1, prompt_widths=(8,), decode_block=8
+    )
+    try:
+        got = eng.submit([1, 2, 3], 12, stop=[[stop_tok]])
+        assert got == want_full[:j]
+        # budget accounting ignores the discarded surplus: exactly the
+        # emitted tokens were recorded (kept + the matched stop token)
+        assert eng.tokens_emitted == j + 1
+    finally:
+        eng.close()
+
+
+def test_block_decode_budget_overrun_discarded(tiny):
+    """A row reaching max_new_tokens mid-block retires there: the
+    block's surplus tokens are discarded (never emitted), the result is
+    exactly the budget's worth, and the block program still ran (the
+    batch never collapses to single steps for a short-budget row)."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(
+        model, params, slots=1, prompt_widths=(8,), decode_block=8
+    )
+    ks = []
+    orig = eng._block_fn
+    eng._block_fn = lambda k: (ks.append(k), orig(k))[1]
+    try:
+        got = eng.submit([1, 2, 3], 5)  # budget 5 < block 8
+        assert got == _reference(model, params, [1, 2, 3], 5)
+        assert 8 in ks, ks
+        assert eng.tokens_emitted == 5  # surplus never recorded
     finally:
         eng.close()
