@@ -22,6 +22,16 @@ fetches of a scalar.
 A watchdog prints whatever has been measured so far (plus an error
 marker) and exits if the run wedges — this environment's TPU relay is
 fragile, and a partial line beats silence.
+
+``--trace`` (DEFAULT ON for real-chip runs): after the timed llama
+loop, a few extra steps run under ``jax.profiler.trace`` and the trace
+is distilled into ``benchmarks/results/*_trace_report.json`` via
+``tensorflowonspark_tpu.obs.trace_report`` — per-lane self-time plus
+the MXU/vector/copy/infeed/host attribution table — so every scored
+run commits the evidence for its own MFU number instead of leaving the
+trace unread in /tmp (the round-5 failure mode). On CPU backends this
+degrades to a no-op warning (no MXU to attribute; set
+``BENCH_TRACE_CPU=1`` to force a host-lane capture anyway).
 """
 
 from __future__ import annotations
@@ -302,7 +312,72 @@ def _relay_dial_probe(timeout: float = 180.0) -> tuple[bool, str]:
     )
 
 
-def main() -> None:
+def _setup_trace(backend: str) -> str | None:
+    """Point real_chip's post-timing profile hook at a scratch dir;
+    returns the dir, or None (with a stderr warning) when tracing is
+    unavailable on this backend."""
+    import sys
+    import tempfile
+
+    if backend != "tpu" and not os.environ.get("BENCH_TRACE_CPU"):
+        print(
+            f"bench: --trace is a no-op on the {backend!r} backend "
+            "(no device timeline to attribute); set BENCH_TRACE_CPU=1 "
+            "to capture host lanes anyway",
+            file=sys.stderr,
+            flush=True,
+        )
+        return None
+    from benchmarks import real_chip
+
+    trace_dir = tempfile.mkdtemp(prefix="bench_trace_")
+    real_chip._PROFILE_DIR = trace_dir
+    return trace_dir
+
+
+def _emit_trace_report(trace_dir: str, backend: str, smoke: bool) -> None:
+    """Distill the captured trace into a committed artifact; failures
+    annotate the JSON line rather than sinking the scored run. A smoke
+    run writes a DISTINCT filename so it can never clobber the evidence
+    artifact of the last real scored run."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(
+        repo,
+        "benchmarks",
+        "results",
+        f"llama1b_{backend}{'_smoke' if smoke else ''}_trace_report.json",
+    )
+    try:
+        from tensorflowonspark_tpu.obs import trace_report
+
+        report = trace_report.write_report(trace_dir, out)
+        att = report["attribution"]
+        _partial["trace_report"] = os.path.relpath(out, repo)
+        _partial["trace_mxu_fraction"] = att["mxu_fraction"]
+        _partial["trace_device_ms"] = round(
+            att["device_total_us"] / 1e3, 1
+        )
+        _partial["trace_host_ms"] = round(att["host_total_us"] / 1e3, 1)
+    except Exception as e:  # noqa: BLE001 - the headline must still print
+        _partial["trace_error"] = f"{type(e).__name__}: {e}"
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(prog="bench")
+    ap.add_argument(
+        "--trace",
+        dest="trace",
+        action="store_true",
+        default=None,
+        help="capture a jax.profiler trace after the timed loop and "
+        "commit a benchmarks/results/*_trace_report.json attribution "
+        "artifact (default: on; a no-op warning on CPU backends)",
+    )
+    ap.add_argument(
+        "--no-trace", dest="trace", action="store_false",
+        help="skip the trace capture",
+    )
+    args = ap.parse_args(argv)
     threading.Thread(target=_watchdog, daemon=True).start()
 
     # Fail fast and diagnosably when the TPU relay is down or wedged: in
@@ -357,7 +432,14 @@ def main() -> None:
     _partial["chips"] = len(jax.devices())
 
     smoke = bool(os.environ.get("BENCH_SMOKE"))
+    trace_dir = None
+    # default-on applies to REAL runs only; a smoke run traces just when
+    # asked (its tiny-model attribution is not scoring evidence)
+    if args.trace is True or (args.trace is None and not smoke):
+        trace_dir = _setup_trace(jax.default_backend())
     _bench_llama(smoke=smoke)  # headline first; a late wedge still reports
+    if trace_dir is not None:
+        _emit_trace_report(trace_dir, jax.default_backend(), smoke)
     _bench_mnist_feed(steps=5 if smoke else 40)
 
     mfu = _partial.pop("mfu_pct", None)

@@ -1,125 +1,47 @@
-"""Summarize a jax.profiler trace directory into a top-ops cost table.
+"""Shim: relocated to :mod:`tensorflowonspark_tpu.obs.trace_report`.
 
-The profiler (`benchmarks/real_chip.py --profile DIR`) writes a
-TensorBoard-readable run under ``DIR/plugins/profile/<run>/`` containing a
-Chrome-trace export ``*.trace.json.gz``. TensorBoard isn't part of this
-environment's loop, so this tool answers the question the trace was
-captured for — "where does the step time go?" — directly in the terminal:
+The trace summarizer grew an op classifier and a JSON report artifact
+and moved into the package proper (the ``obs/`` observability layer) so
+``bench.py`` and the serving/runtime code can import it; this file
+keeps the old entry point and import path working::
 
-    python benchmarks/trace_summary.py /tmp/resnet50_profile [--top 30]
+    python benchmarks/trace_summary.py /tmp/profile [--top 30]
 
-It aggregates complete events (`ph == "X"`) by name within each process
-lane ("pid"), reporting per-lane totals and the top ops by summed
-duration. Device lanes (TPU/XLA op activity) are what matters for MFU
-analysis; host lanes show dispatch/infeed overhead. Events that overlap
-hierarchically within one thread (XLA module > fusion > op) would
-double-count if summed naively, so per-(tid) self-time is computed by
-subtracting child durations nested inside a parent event.
+New code should use ``python -m tensorflowonspark_tpu.tools.trace_report``.
 """
 
-from __future__ import annotations
-
-import argparse
-import collections
-import glob
-import gzip
-import json
-import os
-import sys
-
-
-def find_trace_files(root: str) -> list[str]:
-    pats = [
-        os.path.join(root, "**", "*.trace.json.gz"),
-        os.path.join(root, "**", "*.trace.json"),
-    ]
-    out: list[str] = []
-    for p in pats:
-        out.extend(glob.glob(p, recursive=True))
-    return sorted(out)
-
-
-def load_events(path: str) -> dict:
-    op = gzip.open if path.endswith(".gz") else open
-    with op(path, "rt") as f:
-        return json.load(f)
-
-
-def self_times(events: list[dict]) -> "collections.Counter[tuple]":
-    """Per-(pid, tid) nesting-aware self time, keyed by (pid, name).
-
-    Chrome-trace complete events within one thread nest like a call stack.
-    Sort by (start, -dur); maintain a stack of open intervals; an event's
-    self time is its duration minus the durations of its direct children.
-    """
-    per_thread: dict = collections.defaultdict(list)
-    for e in events:
-        if e.get("ph") != "X" or "dur" not in e:
-            continue
-        per_thread[(e.get("pid"), e.get("tid"))].append(e)
-
-    self_us: "collections.Counter[tuple]" = collections.Counter()
-    for (pid, _tid), evs in per_thread.items():
-        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
-        stack: list[dict] = []  # open events, each with _child_us accumulator
-        for e in evs:
-            ts, dur = e["ts"], e["dur"]
-            while stack and ts >= stack[-1]["ts"] + stack[-1]["dur"]:
-                done = stack.pop()
-                self_us[(pid, done["name"])] += done["dur"] - done["_child_us"]
-            if stack:
-                stack[-1]["_child_us"] += dur
-            e = dict(e, _child_us=0)
-            stack.append(e)
-        while stack:
-            done = stack.pop()
-            self_us[(pid, done["name"])] += done["dur"] - done["_child_us"]
-    return self_us
-
-
-def main(argv: list[str] | None = None) -> int:
-    ap = argparse.ArgumentParser(prog="trace_summary")
-    ap.add_argument("trace_dir", help="directory passed to --profile")
-    ap.add_argument("--top", type=int, default=30)
-    ap.add_argument(
-        "--lane",
-        default=None,
-        help="only lanes whose name contains this substring (e.g. 'TPU')",
+try:
+    from tensorflowonspark_tpu.obs.trace_report import (  # noqa: F401
+        attribution,
+        build_report,
+        classify_op,
+        find_trace_files,
+        load_events,
+        main,
+        self_times,
+        write_report,
     )
-    args = ap.parse_args(argv)
+except ImportError:
+    # Direct script/benchmarks-dir use where the repo root is not yet
+    # importable; only THEN mutate sys.path (an unconditional insert
+    # would reorder resolution for every process that merely imports
+    # this shim).
+    import os as _os
+    import sys as _sys
 
-    files = find_trace_files(args.trace_dir)
-    if not files:
-        print(f"no *.trace.json[.gz] under {args.trace_dir}", file=sys.stderr)
-        return 1
-
-    for path in files:
-        data = load_events(path)
-        events = data.get("traceEvents", [])
-        pid_names: dict = {}
-        for e in events:
-            if e.get("ph") == "M" and e.get("name") == "process_name":
-                pid_names[e.get("pid")] = e.get("args", {}).get("name", "")
-
-        self_us = self_times(events)
-
-        lane_total: "collections.Counter" = collections.Counter()
-        for (pid, _name), us in self_us.items():
-            lane_total[pid] += us
-
-        print(f"== {os.path.relpath(path, args.trace_dir)}")
-        for pid, total in lane_total.most_common():
-            lname = pid_names.get(pid, str(pid))
-            if args.lane and args.lane.lower() not in lname.lower():
-                continue
-            print(f"\n-- lane pid={pid} {lname!r}: total self-time {total/1e3:.2f} ms")
-            ops = [(n, us) for (p, n), us in self_us.items() if p == pid]
-            ops.sort(key=lambda kv: -kv[1])
-            for name, us in ops[: args.top]:
-                pct = 100.0 * us / total if total else 0.0
-                print(f"  {us/1e3:10.3f} ms  {pct:5.1f}%  {name[:120]}")
-    return 0
-
+    _sys.path.insert(
+        0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), ".."))
+    )
+    from tensorflowonspark_tpu.obs.trace_report import (  # noqa: E402,F401
+        attribution,
+        build_report,
+        classify_op,
+        find_trace_files,
+        load_events,
+        main,
+        self_times,
+        write_report,
+    )
 
 if __name__ == "__main__":
     raise SystemExit(main())

@@ -124,6 +124,15 @@ def run_node(
     if cluster_meta.get("profiler"):
         prof_port = _maybe_start_profiler_server()
 
+    # 3c. per-node Prometheus endpoint: GET /metrics renders the
+    #     process-global obs registry (MetricsWriter mirrors, feed/train
+    #     instrumentation) so a scraper — or a curl-ing operator — can
+    #     read any node's counters without TensorBoard. Advertised in
+    #     the reservation roster as metrics_port.
+    metrics_port = None
+    if cluster_meta.get("metrics", True):
+        metrics_port = _maybe_start_metrics_server(host)
+
     # 4. register + roster barrier
     client = reservation.Client(cluster_meta["server_addr"])
     client.register(
@@ -138,6 +147,7 @@ def run_node(
             "tb_port": tb_port,
             "tb_pid": tb_pid,
             "prof_port": prof_port,
+            "metrics_port": metrics_port,
             "pid": os.getpid(),
             "shm_ring": ring_name,
         }
@@ -291,6 +301,46 @@ def _node_ring(node: dict[str, Any] | None):
                 return None
             _ring_cache[name] = ring
         return ring
+
+
+def _maybe_start_metrics_server(host: str) -> int | None:
+    """Serve the process-global obs registry at ``GET /metrics``
+    (Prometheus text format) on a free port; returns the port, or None
+    when the server cannot bind. Runs in a daemon thread; the endpoint
+    is read-only and allocation-free per scrape beyond the rendered
+    text."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from tensorflowonspark_tpu.obs.registry import (
+        CONTENT_TYPE,
+        default_registry,
+    )
+
+    class _MetricsHandler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *fargs):  # scrapes are not news
+            logger.debug("%s " + fmt, self.client_address[0], *fargs)
+
+        def do_GET(self):  # noqa: N802 - http.server API
+            if self.path not in ("/metrics", "/"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = default_registry().render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    try:
+        server = ThreadingHTTPServer((host, 0), _MetricsHandler)
+    except OSError as e:
+        logger.warning("metrics endpoint unavailable (%s)", e)
+        return None
+    threading.Thread(
+        target=server.serve_forever, daemon=True, name="metrics-http"
+    ).start()
+    return server.server_address[1]
 
 
 # The profiler server object must outlive this module scope: jax tears the

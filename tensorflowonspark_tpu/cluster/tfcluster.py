@@ -91,6 +91,19 @@ class TFCluster:
             if n.get("prof_port")
         }
 
+    def metrics_urls(self) -> dict[int, str]:
+        """Per-node Prometheus ``/metrics`` endpoints, by executor id —
+        each node runtime serves its process-global obs registry
+        (``tensorflowonspark_tpu.obs``); point a scraper at all of
+        them, or curl one mid-run."""
+        return {
+            n["executor_id"]: (
+                f"http://{n['host']}:{n['metrics_port']}/metrics"
+            )
+            for n in self.cluster_info
+            if n.get("metrics_port")
+        }
+
     # ------------------------------------------------------------------
     def train(
         self,
@@ -643,6 +656,7 @@ def run(
     num_ps: int = 0,
     tensorboard: bool = False,
     profiler: bool = False,
+    metrics: bool = True,
     input_mode: int = InputMode.SPARK,
     log_dir: str | None = None,
     master_node: str | None = None,
@@ -709,6 +723,10 @@ def run(
         "working_dir": working_dir or "",
         "tensorboard": tensorboard,
         "profiler": profiler,
+        # per-node Prometheus /metrics endpoint (an unauthenticated
+        # read-only listener on the node host; metrics=False for
+        # deployments with strict port policies — see metrics_urls())
+        "metrics": metrics,
         "log_dir": log_dir,
         "reservation_timeout": reservation_timeout,
         "distributed": distributed,

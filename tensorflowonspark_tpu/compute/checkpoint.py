@@ -14,6 +14,8 @@ from typing import Any
 
 import orbax.checkpoint as ocp
 
+from tensorflowonspark_tpu.obs import spans as obs_spans
+
 
 def _abs(path: str) -> str:
     if "://" in path:
@@ -24,8 +26,9 @@ def _abs(path: str) -> str:
 def save_checkpoint(path: str, state: Any, force: bool = True) -> str:
     """Synchronously write ``state`` (any pytree) to ``path``."""
     path = _abs(path)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, state, force=force)
+    with obs_spans.span("train.checkpoint"):
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, state, force=force)
     return path
 
 
@@ -92,10 +95,14 @@ class CheckpointManager:
     ) -> bool:
         """``force=True`` bypasses the save-interval policy (use for the
         end-of-training save, which must land regardless of interval)."""
-        return self._mgr.save(
-            step, args=ocp.args.StandardSave(state), metrics=metrics,
-            force=force,
-        )
+        # The span measures the BLOCKING portion only: with async_save
+        # the actual I/O overlaps subsequent steps, and the interesting
+        # host cost is exactly how long the training loop stalled here.
+        with obs_spans.span("train.checkpoint", step=step):
+            return self._mgr.save(
+                step, args=ocp.args.StandardSave(state), metrics=metrics,
+                force=force,
+            )
 
     def restore(self, step: int | None = None, target: Any | None = None) -> Any:
         step = self.latest_step() if step is None else step

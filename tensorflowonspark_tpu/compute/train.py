@@ -26,6 +26,7 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tensorflowonspark_tpu.compute.mesh import batch_sharding, replicated
+from tensorflowonspark_tpu.obs import spans as obs_spans
 
 
 @struct.dataclass
@@ -271,7 +272,16 @@ def build_train_step(
                 else jax.tree.map(lambda _: replicated(mesh), state.params)
             )
             compiled["fn"] = jit_with(state_shardings(state, mesh, psh))
-        return compiled["fn"](state, batch)
+        # Host-side step span (obs/): measures DISPATCH time — jit
+        # returns as soon as the computation is enqueued, so the
+        # data-wait vs step split reads as "host blocked here" only
+        # when the caller's fetch forces it. StepTraceAnnotation makes
+        # an active jax.profiler device trace group this step's XLA
+        # ops under the same step number. A host-side call counter, not
+        # state.step: fetching the device scalar per step would sync.
+        n = compiled["n"] = compiled.get("n", 0) + 1
+        with obs_spans.get_tracer().step_span("train.step", step_num=n):
+            return compiled["fn"](state, batch)
 
     return wrapped
 

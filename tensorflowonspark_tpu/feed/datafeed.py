@@ -21,6 +21,7 @@ from typing import Any, Sequence
 import numpy as np
 
 from tensorflowonspark_tpu.cluster.marker import EndOfFeed, EndPartition, Marker
+from tensorflowonspark_tpu.obs import spans as obs_spans
 
 logger = logging.getLogger(__name__)
 
@@ -121,7 +122,11 @@ class DataFeed:
                 continue
             if self.done_feeding:
                 break
-            item = self._queue_in.get()
+            # queue wait: time spent blocked on the push plane (the
+            # feeder side of data-wait; feed.data_wait in prefetch.py
+            # is the consumer side)
+            with obs_spans.span("feed.queue_get"):
+                item = self._queue_in.get()
             self._queue_in.task_done()
             if isinstance(item, Marker) or item is None:
                 if isinstance(item, EndPartition):

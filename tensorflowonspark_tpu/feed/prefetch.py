@@ -26,6 +26,7 @@ import threading
 from typing import Any, Callable, Iterable, Iterator
 
 from tensorflowonspark_tpu.compute.mesh import shard_batch
+from tensorflowonspark_tpu.obs import spans as obs_spans
 
 _DONE = object()
 
@@ -67,7 +68,11 @@ class DevicePrefetcher:
             for batch in it:
                 if self._stop.is_set():
                     return
-                item = (self._transform(batch), None)
+                # host->device transfer time, on the producer thread —
+                # beside feed.data_wait it answers "is the input plane
+                # keeping up or is the consumer starving"
+                with obs_spans.span("feed.transfer"):
+                    item = (self._transform(batch), None)
                 while not self._stop.is_set():
                     try:
                         self._queue.put(item, timeout=0.2)
@@ -92,7 +97,11 @@ class DevicePrefetcher:
     def __next__(self) -> Any:
         if self._stop.is_set():  # exhausted or closed: stay stopped
             raise StopIteration
-        batch, err = self._queue.get()
+        # data-wait: how long the training loop sat here is THE
+        # input-bound-vs-compute-bound discriminator (tf.data's
+        # bottleneck analysis asks exactly this question)
+        with obs_spans.span("feed.data_wait"):
+            batch, err = self._queue.get()
         if batch is _DONE:
             self._stop.set()
             if err is not None:

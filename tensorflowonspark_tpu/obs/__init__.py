@@ -1,0 +1,49 @@
+"""Unified observability: spans, metrics, trace attribution.
+
+Three pillars, one package (round-5 verdict: the stack could build fast
+paths but not *see* them):
+
+- :mod:`~tensorflowonspark_tpu.obs.spans` — host-side span tracer
+  (ring buffer, Chrome-trace export, percentile summaries) that bridges
+  into ``jax.profiler`` annotations so host phases and XLA ops share a
+  timeline. Wired into the serving engine's request phases and the
+  train/feed hot paths.
+- :mod:`~tensorflowonspark_tpu.obs.registry` — counters/gauges/
+  histograms with a Prometheus text exporter, served at ``/metrics``
+  by the HTTP server and each node runtime;
+  ``utils.metrics.MetricsWriter`` is a sink of the registry
+  (``Registry.publish``), not a parallel system.
+- :mod:`~tensorflowonspark_tpu.obs.trace_report` — nesting-aware
+  self-time over captured profiler traces plus an op classifier
+  (MXU / vector / copy / infeed / collective / host), emitted as a
+  JSON artifact by ``bench.py --trace`` and readable via
+  ``python -m tensorflowonspark_tpu.tools.trace_report``.
+"""
+
+from tensorflowonspark_tpu.obs.registry import (
+    CONTENT_TYPE,
+    Registry,
+    default_registry,
+    sanitize_name,
+)
+from tensorflowonspark_tpu.obs.spans import (
+    SpanTracer,
+    get_tracer,
+    record,
+    span,
+    step_span,
+    traced,
+)
+
+__all__ = [
+    "CONTENT_TYPE",
+    "Registry",
+    "SpanTracer",
+    "default_registry",
+    "get_tracer",
+    "record",
+    "sanitize_name",
+    "span",
+    "step_span",
+    "traced",
+]

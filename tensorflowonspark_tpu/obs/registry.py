@@ -1,0 +1,346 @@
+"""Metrics registry: counters/gauges/histograms + Prometheus text export.
+
+The reference had no metrics pipeline of its own (SURVEY.md §5.5) and
+the rebuild's ``utils.metrics.MetricsWriter`` only pushed step scalars
+to TensorBoard/JSONL. This module is the missing pull side: components
+register named metrics once, mutate them cheaply from any thread, and
+any HTTP surface (the serving engine's ``/metrics``, each node
+runtime's metrics port) renders the registry in Prometheus text
+exposition format 0.0.4 on demand — no scrape, no dependency, ~200
+lines of stdlib.
+
+Design points:
+
+- **Label support** is per-observation keyword args
+  (``c.inc(phase="fetch")``); each distinct label set is one time
+  series, rendered sorted so output is deterministic (golden-testable).
+- **Collectors**: a component whose values live elsewhere (engine slot
+  occupancy, queue depth) registers a callback that refreshes its
+  gauges at render time instead of on every mutation.
+- **One system, not two**: ``utils.metrics.MetricsWriter`` is a *sink*
+  of this registry — :meth:`Registry.publish` snapshots every series
+  into ``writer.scalar`` calls (TensorBoard/JSONL), and legacy
+  ``writer.scalar`` calls mirror into the registry as gauges, so the
+  push (TB) and pull (Prometheus) views can never diverge.
+- A process-global :func:`default_registry` serves the common case;
+  components needing isolation (several engines in one test process)
+  construct their own :class:`Registry` and render both.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Any, Callable, Iterable
+
+__all__ = [
+    "CONTENT_TYPE",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "default_registry",
+    "sanitize_name",
+]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# Default histogram buckets, in seconds — spans the ~ms device steps to
+# the multi-second tail a wedged host path produces.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_name(name: str) -> str:
+    """Coerce an arbitrary scalar name (``loss/train``, ``lr.decay``)
+    into a valid Prometheus metric name."""
+    name = _BAD_CHARS.sub("_", name)
+    if not name or not _NAME_RE.match(name):
+        name = "_" + name
+    return name
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _label_str(labels: tuple[tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="' + v.replace("\\", r"\\").replace('"', r"\"")
+        .replace("\n", r"\n") + '"'
+        for k, v in labels
+    )
+    return "{" + inner + "}"
+
+
+def _label_key(labels: dict[str, Any]) -> tuple[tuple[str, str], ...]:
+    for k in labels:
+        if not _LABEL_RE.match(k):
+            raise ValueError(f"invalid label name {k!r}")
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series: dict[tuple, Any] = {}
+
+    def _render_series(self) -> "Iterable[str]":  # pragma: no cover
+        raise NotImplementedError
+
+    def render(self) -> list[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        lines.extend(self._render_series())
+        return lines
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (requests, tokens, errors)."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1, **labels: Any) -> None:
+        if amount < 0:
+            raise ValueError(f"counters only go up, got {amount}")
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return self._series.get(_label_key(labels), 0.0)
+
+    def _render_series(self):
+        with self._lock:
+            items = sorted(self._series.items())
+        return [
+            f"{self.name}{_label_str(k)} {_fmt(v)}" for k, v in items
+        ] or [f"{self.name} 0"]
+
+
+class Gauge(_Metric):
+    """A value that goes up and down (queue depth, slots busy, loss)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1, **labels: Any) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1, **labels: Any) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return self._series.get(_label_key(labels), 0.0)
+
+    def _render_series(self):
+        with self._lock:
+            items = sorted(self._series.items())
+        return [
+            f"{self.name}{_label_str(k)} {_fmt(v)}" for k, v in items
+        ] or [f"{self.name} 0"]
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (the Prometheus convention: each
+    ``_bucket{le=...}`` counts observations <= its bound, ``+Inf``
+    equals ``_count``). Percentiles are the scraper's job; in-process
+    percentile views come from ``obs.spans`` instead."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs or any(
+            not math.isfinite(b) for b in bs
+        ):
+            raise ValueError(f"invalid histogram buckets {buckets!r}")
+        self.buckets = bs
+
+    def observe(self, value: float, **labels: Any) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = self._series[key] = {
+                    "counts": [0] * len(self.buckets),
+                    "sum": 0.0,
+                    "count": 0,
+                }
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    series["counts"][i] += 1
+            series["sum"] += value
+            series["count"] += 1
+
+    def value(self, **labels: Any) -> dict | None:
+        """The raw ``{counts, sum, count}`` for one label set."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            return None if s is None else {
+                "counts": list(s["counts"]),
+                "sum": s["sum"],
+                "count": s["count"],
+            }
+
+    def _render_series(self):
+        with self._lock:
+            items = sorted(
+                (k, dict(v, counts=list(v["counts"])))
+                for k, v in self._series.items()
+            )
+        lines = []
+        for key, s in items:
+            for b, c in zip(self.buckets, s["counts"]):
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{_label_str(key + (('le', _fmt(b)),))} {c}"
+                )
+            lines.append(
+                f"{self.name}_bucket"
+                f"{_label_str(key + (('le', '+Inf'),))} {s['count']}"
+            )
+            lines.append(
+                f"{self.name}_sum{_label_str(key)} {_fmt(s['sum'])}"
+            )
+            lines.append(
+                f"{self.name}_count{_label_str(key)} {s['count']}"
+            )
+        return lines
+
+
+class Registry:
+    """Named metrics + render-time collectors; get-or-create semantics
+    so call sites don't coordinate registration order."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+        self._collectors: list[Callable[[], None]] = []
+
+    def _get_or_create(self, cls, name: str, help: str, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, **kw)
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def add_collector(self, fn: Callable[[], None]) -> None:
+        """Register a callback run at the top of every :meth:`render`
+        (refresh gauges whose truth lives elsewhere). Exceptions are
+        swallowed — a broken collector must not take down the scrape."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def metrics(self) -> list[_Metric]:
+        with self._lock:
+            return [m for _, m in sorted(self._metrics.items())]
+
+    def render(self) -> str:
+        """Prometheus text exposition (0.0.4); deterministic ordering
+        (metrics by name, series by sorted label sets)."""
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                pass
+        lines: list[str] = []
+        for m in self.metrics():
+            lines.extend(m.render())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def publish(self, writer, step: int) -> None:
+        """Snapshot every series into ``writer.scalar(name, value,
+        step)`` — the bridge that makes ``MetricsWriter`` (TensorBoard /
+        JSONL) a *sink* of this registry. Counters and gauges publish
+        their value per label set (labels suffixed ``name{k=v}``);
+        histograms publish ``_count`` and ``_sum``. ``mirror=False``
+        stops the writer echoing the scalars back into a registry."""
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                pass
+        for m in self.metrics():
+            if isinstance(m, (Counter, Gauge)):
+                with m._lock:
+                    items = sorted(m._series.items())
+                for key, v in items:
+                    writer.scalar(
+                        m.name + _label_str(key), v, step, mirror=False
+                    )
+            elif isinstance(m, Histogram):
+                with m._lock:
+                    items = sorted(m._series.items())
+                for key, s in items:
+                    base = m.name + _label_str(key)
+                    writer.scalar(
+                        base + "_count", s["count"], step, mirror=False
+                    )
+                    writer.scalar(
+                        base + "_sum", s["sum"], step, mirror=False
+                    )
+
+
+_default = Registry()
+
+
+def default_registry() -> Registry:
+    return _default

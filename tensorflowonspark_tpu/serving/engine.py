@@ -43,6 +43,7 @@ on the same static-shape KV cache the rest of the stack uses.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import logging
@@ -56,6 +57,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from tensorflowonspark_tpu.models.llama import Llama
+from tensorflowonspark_tpu.obs import registry as obs_registry
+from tensorflowonspark_tpu.obs import spans as obs_spans
 
 logger = logging.getLogger(__name__)
 
@@ -266,6 +269,10 @@ class _Pending:
     # as finished at the next step/admission — a plain bool is enough
     # (single writer, benign race: at worst one extra token decodes)
     cancelled: bool = False
+    # While this request is LIVE, the scheduler caps its decode-block
+    # size at this value (warmup rides it to compile the k=1 program
+    # without mutating the shared engine knob under live traffic).
+    decode_block_pin: int | None = None
     submitted_at: float = 0.0  # time.monotonic() at enqueue
     first_token_at: float | None = None  # set when token 0 emits
     result: list[int] | None = None
@@ -667,6 +674,60 @@ class ContinuousBatcher:
         # tokens and ~zero duration, and would drag the averages down.
         self._latency_n = 0
 
+        # Observability (obs/): a PER-ENGINE span tracer (so /stats
+        # percentiles describe this engine, not every engine in the
+        # process) and a per-engine metrics registry rendered at the
+        # server's /metrics. Phase spans cover the scheduler's hot
+        # path: queue wait, prefill/batch formation, device dispatch,
+        # block fetch.
+        self._tracer = obs_spans.SpanTracer(capacity=4096)
+        self.metrics = obs_registry.Registry()
+        self._m_accepted = self.metrics.counter(
+            "engine_requests_total", "requests accepted into the queue"
+        )
+        self._m_completed = self.metrics.counter(
+            "engine_requests_completed_total", "requests resolved"
+        )
+        self._m_failed = self.metrics.counter(
+            "engine_requests_failed_total", "requests failed"
+        )
+        self._m_tokens = self.metrics.counter(
+            "engine_tokens_emitted_total", "completion tokens decoded"
+        )
+        self._m_steps = self.metrics.counter(
+            "engine_decode_steps_total", "device decode steps taken"
+        )
+        self._m_phase = self.metrics.histogram(
+            "engine_request_phase_seconds",
+            "scheduler phase latency (queue/prefill per request; "
+            "dispatch/fetch per k-step decode block shared by all "
+            "live slots)",
+        )
+        self._m_ttft = self.metrics.histogram(
+            "engine_ttft_seconds", "time to first token"
+        )
+        g_busy = self.metrics.gauge(
+            "engine_slots_busy", "KV-cache slots currently occupied"
+        )
+        g_depth = self.metrics.gauge(
+            "engine_queue_depth", "requests waiting for a slot"
+        )
+        g_slots = self.metrics.gauge(
+            "engine_slots", "configured KV-cache slots"
+        )
+
+        def _collect(busy=g_busy, depth=g_depth, slots=g_slots):
+            # render-time refresh: these values' truth lives in the
+            # scheduler's bookkeeping, not in a mutation stream
+            busy.set(
+                sum(e is not None for e in self._live)
+                + (self._job is not None)
+            )
+            depth.set(self._queue.qsize())
+            slots.set(self._slots)
+
+        self.metrics.add_collector(_collect)
+
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="continuous-batcher"
         )
@@ -827,6 +888,7 @@ class ContinuousBatcher:
         frequency_penalty: float | None = None,
         presence_penalty: float | None = None,
         logit_bias: "dict[int, float] | None" = None,
+        decode_block_pin: int | None = None,
     ) -> list[_Pending]:
         """Validate then enqueue a group ATOMICALLY: either every row is
         accepted or none is — a partially admitted multi-row request
@@ -875,6 +937,7 @@ class ContinuousBatcher:
                 eos_id=eos_id,
                 adapter=int(adapter or 0),
                 stop=tuple(tuple(q) for q in (stop or ())),
+                decode_block_pin=decode_block_pin,
                 submitted_at=time.monotonic(),
                 sink=sink,
             )
@@ -902,6 +965,7 @@ class ContinuousBatcher:
                     f"request queue full ({self._max_queue} waiting)"
                 )
             self._accepted_total += len(ps)
+            self._m_accepted.inc(len(ps))
             for p in ps:
                 self._queue.put(p)
         return ps
@@ -922,11 +986,13 @@ class ContinuousBatcher:
         frequency_penalty: float | None = None,
         presence_penalty: float | None = None,
         logit_bias: "dict[int, float] | None" = None,
+        decode_block_pin: int | None = None,
     ) -> _Pending:
         return self._enqueue_all(
             [(tokens, sink)], max_new_tokens, temperature, eos_id,
             adapter, stop, top_k, top_p, seed, min_p,
             frequency_penalty, presence_penalty, logit_bias,
+            decode_block_pin,
         )[0]
 
     def submit(
@@ -1117,22 +1183,38 @@ class ContinuousBatcher:
             # The k=1 program still runs whenever an admission or chunk
             # job is pending, but every warmup submit above was a lone
             # request (empty queue) and so compiled only the k-block
-            # scan. Pin the block to 1 for one throwaway request so
-            # saturated traffic doesn't pay the single-step compile.
-            # Safe: submit() blocks until completion and the loop
-            # thread reads _decode_block afresh each iteration.
-            blk = self._decode_block
-            self._decode_block = 1
-            try:
-                self.submit([0], 2, eos_id=-1)
-            finally:
-                self._decode_block = blk
+            # scan. Pin the block to 1 THROUGH the warmup request
+            # itself (decode_block_pin rides the _Pending; the
+            # scheduler caps k at any live row's pin) so one throwaway
+            # request compiles the single-step program WITHOUT mutating
+            # the shared self._decode_block from the caller thread —
+            # concurrent live traffic keeps its full block, and /stats
+            # never transiently reports decode_block=1.
+            p = self._enqueue([0], 2, eos_id=-1, decode_block_pin=1)
+            p.event.wait()
+            if p.error is not None:
+                raise p.error
         if self._prefix_store is not None:
             # drop the throwaway prompts' entries — each would pin a
             # full single-row KV cache of HBM until evicted. Safe here:
             # submit() returned, so the scheduler is blocked on the
             # queue and not touching the store.
             self._prefix_store.clear()
+
+    @contextlib.contextmanager
+    def _phase(self, phase: str):
+        """Measure one scheduler phase into both surfaces: the span
+        ring (``/stats`` percentiles, Chrome-trace export, XLA-timeline
+        bridge) and the Prometheus phase histogram."""
+        t0 = time.monotonic()
+        with self._tracer.span("engine." + phase):
+            yield
+        self._m_phase.observe(time.monotonic() - t0, phase=phase)
+
+    def _observe_queue_wait(self, p: _Pending) -> None:
+        dur = time.monotonic() - p.submitted_at
+        self._tracer.record("engine.queue", dur)
+        self._m_phase.observe(dur, phase="queue")
 
     def stats(self) -> dict:
         """Scheduler observability (served at the HTTP ``/stats``
@@ -1161,6 +1243,18 @@ class ContinuousBatcher:
             "request_avg_ms": round(self._duration_sum / done * 1e3, 3)
             if done
             else None,
+            # Per-phase latency percentiles over the span ring's
+            # sliding window. UNITS DIFFER BY PHASE: queue and prefill
+            # are per REQUEST (one observation each); dispatch and
+            # fetch are per scheduler ITERATION — one k-step decode
+            # block shared by every live slot — so comparing them to
+            # the per-request phases requires dividing by k×occupancy.
+            "phase_ms": {
+                name.split(".", 1)[1]: v
+                for name, v in self._tracer.summary(
+                    prefix="engine."
+                ).items()
+            },
             "closed": self._closed,
             **(
                 {"adapters": self._n_adapters}
@@ -1306,10 +1400,13 @@ class ContinuousBatcher:
         """Jitted k-step decode block. Per-instance memo like
         :meth:`_prefill_fn` (a class-level cache would pin closed
         engines). Returns ``(cache, tok, pos, packed, counts)`` where
-        ``packed`` is ONE (2, k, slots) fp32 array — row 0 the sampled
-        int32 tokens bitcast to f32, row 1 their logprobs — so the host
-        retires a whole block with a single device fetch instead of
-        2·k transfers."""
+        ``packed`` is ONE (2, k, slots) int32 array — row 0 the sampled
+        tokens, row 1 their fp32 logprobs bitcast to int32 — so the
+        host retires a whole block with a single device fetch instead
+        of 2·k transfers. Packing INTO int32 (not tokens into f32) is
+        deliberate: token ids bitcast to f32 land in the denormal
+        range, where a flushing/canonicalizing copy path would silently
+        zero them; integer copies are never flushed."""
         cached = self._block_cache.get(k)
         if cached is not None:
             return cached
@@ -1332,7 +1429,7 @@ class ContinuousBatcher:
                 scan_body, (cache, tok, pos, counts), None, length=k
             )
             packed = jnp.stack(
-                [jax.lax.bitcast_convert_type(toks, jnp.float32), lps]
+                [toks, jax.lax.bitcast_convert_type(lps, jnp.int32)]
             )
             return cache, tok, pos, packed, counts
 
@@ -1869,6 +1966,9 @@ class ContinuousBatcher:
         self._gates_arr = None
         now = time.monotonic()
         self.tokens_emitted += len(out)  # decoded count, pre-trim
+        # same pre-trim count: /stats and /metrics must agree on what
+        # "tokens emitted" means (decoded device work, stop tail incl.)
+        self._m_tokens.inc(len(out))
         matched = max(
             (
                 seq
@@ -1890,6 +1990,8 @@ class ContinuousBatcher:
             self.cancelled += 1
         if p.first_token_at is not None:
             self._ttft_sum += p.first_token_at - p.submitted_at
+            self._m_ttft.observe(p.first_token_at - p.submitted_at)
+        self._m_completed.inc()
         self._duration_sum += now - p.submitted_at
         self._latency_n += 1
         # Incremented LAST: stats() divides the sums by this count from
@@ -1910,11 +2012,13 @@ class ContinuousBatcher:
         p.logprobs = []
         self.cancelled += 1
         self.completed += 1
+        self._m_completed.inc()
         p.finish()
         p.event.set()
 
     def _fail_one(self, p: _Pending, err: BaseException) -> None:
         self._failed_total += 1
+        self._m_failed.inc()
         p.fail(err)
 
     def _fail_all(self, err: BaseException) -> None:
@@ -1982,6 +2086,7 @@ class ContinuousBatcher:
                     if item.cancelled:
                         self._resolve_unadmitted_cancel(item)
                         continue
+                    self._observe_queue_wait(item)
                     self._inflight = item
                     if cache is None:
                         (
@@ -1989,26 +2094,29 @@ class ContinuousBatcher:
                             pens, counts, bids, bvals,
                         ) = self._empty_state()
                     if self._prefill_chunk is None:
-                        (
-                            cache, tok, pos, temps, ads, kps, seeds,
-                            pens, counts, bids, bvals,
-                        ) = self._admit_one(
-                            item, free[0], cache, tok, pos, temps, ads,
-                            kps, seeds, pens, counts, bids, bvals,
-                        )
+                        with self._phase("prefill"):
+                            (
+                                cache, tok, pos, temps, ads, kps, seeds,
+                                pens, counts, bids, bvals,
+                            ) = self._admit_one(
+                                item, free[0], cache, tok, pos, temps,
+                                ads, kps, seeds, pens, counts, bids,
+                                bvals,
+                            )
                     else:
                         self._job = self._start_job(item, free[0])
                     self._inflight = None
                     idle = False
 
                 if self._job is not None:
-                    (
-                        cache, tok, pos, temps, ads, kps, seeds,
-                        pens, counts, bids, bvals,
-                    ) = self._advance_job(
-                        cache, tok, pos, temps, ads, kps, seeds, pens,
-                        counts, bids, bvals,
-                    )
+                    with self._phase("prefill"):
+                        (
+                            cache, tok, pos, temps, ads, kps, seeds,
+                            pens, counts, bids, bvals,
+                        ) = self._advance_job(
+                            cache, tok, pos, temps, ads, kps, seeds,
+                            pens, counts, bids, bvals,
+                        )
 
                 if all(e is None for e in self._live):
                     continue  # nothing decoding; admit/chunk again
@@ -2039,15 +2147,26 @@ class ContinuousBatcher:
                     )
                 ):
                     k = 1
-                cache, tok, pos, packed, counts = self._block_fn(k)(
-                    self._params, cache, tok, pos, temps, ads, kps,
-                    seeds, pens, counts, bids, bvals,
-                    self._gates_dev(),
-                )
+                # A live row's decode_block_pin caps the block while it
+                # is in flight (warmup's k=1 compile rides this instead
+                # of mutating the shared knob under live traffic).
+                for e in self._live:
+                    if e is not None and e[0].decode_block_pin:
+                        k = min(k, max(1, int(e[0].decode_block_pin)))
+                with self._phase("dispatch"):
+                    cache, tok, pos, packed, counts = self._block_fn(k)(
+                        self._params, cache, tok, pos, temps, ads, kps,
+                        seeds, pens, counts, bids, bvals,
+                        self._gates_dev(),
+                    )
                 self.steps += k
-                host = np.asarray(packed)  # ONE fetch: (2, k, slots)
-                host_tok = host[0].view(np.int32)
-                host_lp = host[1]
+                self._m_steps.inc(k)
+                with self._phase("fetch"):
+                    # ONE fetch: (2, k, slots) int32; row 1 carries the
+                    # fp32 logprob bits (see _block_fn)
+                    host = np.asarray(packed)
+                host_tok = host[0]
+                host_lp = host[1].view(np.float32)
                 for j in range(k):
                     for row, entry in enumerate(self._live):
                         if entry is None:

@@ -10,6 +10,12 @@ to invoke).
 Endpoints::
 
     GET  /healthz            -> {"status": "ok", "export_dir": ...}
+    GET  /metrics            -> Prometheus text-format metrics (the
+                                process registry + the continuous
+                                engine's counters/gauges/histograms)
+    GET  /stats              -> scheduler JSON incl. per-phase request
+                                latency percentiles (queue/prefill/
+                                dispatch/fetch) backed by obs spans
     GET  /signature          -> the artifact's signature metadata
     POST /predict            -> body {"rows": [<row>, ...]}
                                 (rows as dicts per input_mapping, or raw
@@ -99,9 +105,20 @@ class _Handler(BaseHTTPRequestHandler):
     def _reply(
         self, code: int, payload: dict, headers: dict | None = None
     ) -> None:
-        body = json.dumps(payload).encode()
+        self._reply_text(
+            code, json.dumps(payload), "application/json", headers
+        )
+
+    def _reply_text(
+        self,
+        code: int,
+        text: str,
+        content_type: str,
+        headers: dict | None = None,
+    ) -> None:
+        body = text.encode()
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         for k, v in (headers or {}).items():
             self.send_header(k, v)
@@ -130,6 +147,16 @@ class _Handler(BaseHTTPRequestHandler):
                     ],
                 },
             )
+        elif self.path == "/metrics":
+            # Prometheus text exposition: the process-global registry
+            # (MetricsWriter mirrors, feed/train instrumentation) plus
+            # the engine's per-instance registry when one is serving.
+            from tensorflowonspark_tpu.obs import registry as obs_reg
+
+            text = obs_reg.default_registry().render()
+            if self.gen_engine is not None:
+                text += self.gen_engine.metrics.render()
+            self._reply_text(200, text, obs_reg.CONTENT_TYPE)
         elif self.path == "/stats":
             stats: dict = {"mode": "aot" if self.model is not None else ""}
             if self.gen_engine is not None:
@@ -930,7 +957,13 @@ def _build_engine(gen: dict):
         max_queue=gen.get("max_queue"),
         prefill_chunk=gen.get("prefill_chunk"),
         prefix_cache=gen.get("prefix_cache"),
-        decode_block=int(gen.get("decode_block") or 8),
+        # `or 8` would map an EXPLICIT 0 to 8; only None (unset) takes
+        # the default — explicit values pass through to the engine's
+        # own max(1, ...) clamp, consistent with direct construction.
+        decode_block=(
+            8 if gen.get("decode_block") is None
+            else int(gen["decode_block"])
+        ),
     )
     if gen.get("warmup"):
         t0 = time.monotonic()

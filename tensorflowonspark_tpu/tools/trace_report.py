@@ -1,0 +1,14 @@
+"""CLI over :mod:`tensorflowonspark_tpu.obs.trace_report`.
+
+Summarize a ``jax.profiler`` trace directory in the terminal — per-lane
+nesting-aware self-time tables plus the MXU/vector/copy/infeed/host
+attribution breakdown — and optionally write the full report JSON::
+
+    python -m tensorflowonspark_tpu.tools.trace_report /tmp/profile \
+        [--top 30] [--lane TPU] [--json report.json]
+"""
+
+from tensorflowonspark_tpu.obs.trace_report import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -10,6 +10,14 @@ extra plumbing.
 
 Backend: ``tf.summary`` event files when TensorFlow is importable (so plain
 TensorBoard reads them), else a JSONL fallback with the same API.
+
+One metrics system, not two (``obs/``): the writer is a *sink* of the
+:mod:`tensorflowonspark_tpu.obs.registry` —
+``registry.publish(writer, step)`` snapshots every counter/gauge/
+histogram series into scalar writes — and every direct ``scalar()``
+call mirrors its value into the registry as a gauge (name sanitized to
+Prometheus rules), so the node runtime's ``/metrics`` endpoint and the
+chief's TensorBoard can never tell different stories.
 """
 
 from __future__ import annotations
@@ -28,7 +36,19 @@ __all__ = ["MetricsWriter"]
 class MetricsWriter:
     """Write scalar step metrics; TB event files or JSONL fallback."""
 
-    def __init__(self, log_dir: str, use_tensorboard: bool | None = None):
+    def __init__(
+        self,
+        log_dir: str,
+        use_tensorboard: bool | None = None,
+        registry=None,
+    ):
+        """``registry``: the obs registry scalars mirror into (default:
+        the process-global one; pass ``False`` to disable mirroring)."""
+        if registry is None:
+            from tensorflowonspark_tpu.obs.registry import default_registry
+
+            registry = default_registry()
+        self._registry = registry or None
         self.log_dir = log_dir
         remote = "://" in log_dir  # gs://, hdfs://, ... — TF filesystems
         if not remote:
@@ -62,7 +82,24 @@ class MetricsWriter:
                 os.path.join(log_dir, "metrics.jsonl"), "a", buffering=1
             )
 
-    def scalar(self, name: str, value: Any, step: int) -> None:
+    def scalar(
+        self, name: str, value: Any, step: int, mirror: bool = True
+    ) -> None:
+        if mirror and self._registry is not None:
+            # keep the pull side (Prometheus /metrics) in sync with the
+            # push side; Registry.publish passes mirror=False so the
+            # bridge cannot echo registry-born series back as gauges
+            from tensorflowonspark_tpu.obs.registry import sanitize_name
+
+            try:
+                self._registry.gauge(
+                    sanitize_name(name), "mirrored from MetricsWriter"
+                ).set(float(value))
+            except ValueError:
+                # a non-gauge metric already owns the sanitized name;
+                # the mirror is best-effort observability, the write
+                # itself must proceed
+                pass
         if self._tb is not None:
             import tensorflow as tf
 
