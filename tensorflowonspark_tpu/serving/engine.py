@@ -30,10 +30,31 @@ FIXED set of compiled programs and admission never recompiles:
 
 ``warmup()`` pre-compiles all of them before real traffic. The host
 loop owns scheduling only: admit-then-step, retire rows on EOS, budget,
-stop-sequence match, or cancellation, hand tokens to waiters. One
-engine step per host iteration keeps admission latency at one token;
-the device work per step is the same einsum the plain `generate` loop
+stop-sequence match, or cancellation, hand tokens to waiters. The
+device work per step is the same einsum the plain `generate` loop
 runs.
+
+**Overlapped pipeline** (``pipeline_depth``, default 2): the scheduler
+keeps up to that many k-step decode blocks IN FLIGHT at once. Block
+N+1 dispatches straight from the device-resident functional state
+(cache/tok/pos are jax arrays — it never needs host data), THEN block
+N is fetched and swept, so the host sweep (emit, stop-match, retire,
+stream hand-off) hides behind device compute instead of serializing
+with it — the tf.data overlap discipline applied to decode. The window
+drains (fetch + sweep every in-flight block, oldest first) only when
+host state must change under it: a request admission or a chunked
+prefill's final-chunk admit, both of which scatter into the shared
+batch state and must see the true free-slot set. Rows that finish
+mid-window follow the same bounded discard semantics mid-block retire
+already has — surplus tokens (at most ``decode_block × pipeline_depth``
+per retire) are decoded and thrown away host-side, never emitted.
+``pipeline_depth=1`` reproduces the strictly serial
+dispatch→fetch→sweep loop exactly. Prefill/admission is asynchronous
+too: the prefill and admit programs are dispatched without a device
+sync and the first token's fetch is deferred into the normal fetch
+path, so back-to-back admissions batch into one drain instead of
+paying two scalar round-trips each. Stream deliveries (``sink.put``)
+run on a dedicated emitter thread, off the scheduler's critical path.
 
 Reference parity note: nothing in the reference corresponds to this
 (its serving was batch scoring over Spark partitions); this is the
@@ -43,6 +64,7 @@ on the same static-shape KV cache the rest of the stack uses.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import functools
@@ -279,24 +301,11 @@ class _Pending:
     logprobs: list[float] | None = None  # filled at retirement
     error: BaseException | None = None
     # streaming: every emitted token is ALSO pushed here as it decodes,
-    # then True (done) or the error object as the terminal item
+    # then True (done) or the error object as the terminal item.
+    # Deliveries go through the engine's _EmitWorker thread (see
+    # ContinuousBatcher._emit) so consumer-side work never runs on the
+    # scheduler's critical path.
     sink: "queue.Queue | None" = None
-
-    def emit(self, token: int, logprob: float) -> None:
-        if self.first_token_at is None:
-            self.first_token_at = time.monotonic()
-        if self.sink is not None:
-            self.sink.put((token, logprob))
-
-    def finish(self) -> None:
-        if self.sink is not None:
-            self.sink.put(True)
-
-    def fail(self, err: BaseException) -> None:
-        self.error = err
-        if self.sink is not None:
-            self.sink.put(err)
-        self.event.set()
 
 
 class _Stream:
@@ -343,6 +352,49 @@ class _Stream:
     __del__ = close
 
 
+class _EmitWorker:
+    """Dedicated delivery thread for stream sinks.
+
+    The scheduler loop hands every sink item — per-token ``(token,
+    logprob)`` tuples and the terminal ``True``/exception markers — to
+    this thread instead of pushing them inline, so per-token consumer
+    hand-off cost never sits on the decode critical path (and a sink
+    subclass with a slow/blocking ``put`` cannot stall every other
+    request's decode). One FIFO queue preserves per-request item order;
+    the single producer is the scheduler thread, so cross-request order
+    matches the scheduler's emit order too. ``stop()`` is a sentinel:
+    everything enqueued before it is delivered first, then the thread
+    exits — the engine calls it as the scheduler loop winds down."""
+
+    _STOP = object()
+
+    def __init__(self) -> None:
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="engine-emitter"
+        )
+        self._thread.start()
+
+    def deliver(self, sink: "queue.Queue", item) -> None:
+        self._q.put((sink, item))
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._q.put(self._STOP)
+        self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is self._STOP:
+                return
+            sink, payload = item
+            try:
+                sink.put(payload)
+            except Exception:  # noqa: BLE001 - one bad sink must not
+                # take down delivery for every other stream
+                logger.exception("stream sink delivery failed")
+
+
 @dataclasses.dataclass
 class _PrefillJob:
     """A chunked prefill in flight: one slot reserved, the single-row
@@ -386,6 +438,12 @@ class _PrefixStore:
 
         self.capacity = capacity
         self._d: "OrderedDict[tuple, object]" = OrderedDict()
+        # adapter -> {key_length -> set of stored key tuples}: lookup
+        # hashes the PROMPT's prefix at each stored length (longest
+        # first, early exit) instead of comparing every stored key —
+        # the old scan was O(entries × prompt_len) per admission, so a
+        # large warm cache taxed every cold-store admission too.
+        self._by_adapter: "dict[int, dict[int, set]]" = {}
         self.hits = 0
         self.misses = 0
         self.tokens_saved = 0
@@ -394,22 +452,29 @@ class _PrefixStore:
         """Longest stored prefix of ``tokens`` under the same adapter →
         (cache, resume_pos), or (None, 0). A prefix computed under one
         LoRA adapter is NOT valid under another (its K/V went through
-        that adapter's projections), so the adapter id is part of the
-        key. resume_pos is capped at len(tokens)-1 so the chunk
-        path always re-processes at least the last prompt token — its
-        logits are where the first completion token samples from (the
-        overlap recompute writes back identical K/V rows)."""
+        that adapter's projections), so entries are bucketed per
+        adapter and other adapters' caches cost nothing here. Within
+        the bucket, stored key lengths are probed longest-first — one
+        prefix-tuple hash per distinct length, stopping at the first
+        hit (two distinct same-length keys cannot both prefix one
+        prompt, so the first hit IS the longest match). resume_pos is
+        capped at len(tokens)-1 so the chunk path always re-processes
+        at least the last prompt token — its logits are where the first
+        completion token samples from (the overlap recompute writes
+        back identical K/V rows)."""
+        n = len(tokens)
         best_key = None
         best_len = 0
-        for ad, k in self._d:
-            lk = len(k)
-            if (
-                ad == adapter
-                and best_len < lk <= len(tokens)
-                and tuple(tokens[:lk]) == k
-            ):
-                best_key, best_len = (ad, k), lk
-        resume = min(best_len, len(tokens) - 1)
+        by_len = self._by_adapter.get(adapter)
+        if by_len:
+            for lk in sorted(by_len, reverse=True):
+                if lk > n:
+                    continue
+                cand = tuple(tokens[:lk])
+                if cand in by_len[lk]:
+                    best_key, best_len = (adapter, cand), lk
+                    break
+        resume = min(best_len, n - 1)
         if best_key is None or resume < 1:
             self.misses += 1
             return None, 0
@@ -419,14 +484,30 @@ class _PrefixStore:
         return self._d[best_key], resume
 
     def insert(self, tokens: list[int], cache_1, adapter: int = 0) -> None:
-        k = (adapter, tuple(tokens))
+        key = tuple(tokens)
+        k = (adapter, key)
+        if k not in self._d:
+            self._by_adapter.setdefault(adapter, {}).setdefault(
+                len(key), set()
+            ).add(key)
         self._d[k] = cache_1
         self._d.move_to_end(k)
         while len(self._d) > self.capacity:
-            self._d.popitem(last=False)
+            (ad, old), _ = self._d.popitem(last=False)
+            self._unindex(ad, old)
+
+    def _unindex(self, adapter: int, key: tuple) -> None:
+        by_len = self._by_adapter[adapter]
+        bucket = by_len[len(key)]
+        bucket.discard(key)
+        if not bucket:
+            del by_len[len(key)]
+            if not by_len:
+                del self._by_adapter[adapter]
 
     def clear(self) -> None:
         self._d.clear()
+        self._by_adapter.clear()
 
     def __len__(self) -> int:
         return len(self._d)
@@ -458,6 +539,15 @@ class ContinuousBatcher:
     are discarded, never emitted. Kept tokens are bit-identical to
     single stepping; set ``decode_block=1`` to disable (e.g. to
     minimize admission latency jitter under bursty traffic).
+
+    ``pipeline_depth``: how many decode blocks the scheduler keeps in
+    flight at once (dispatch-ahead; see the module docstring's
+    overlapped-pipeline section). Depth 2 hides the host sweep behind
+    device compute; depth 1 is the strictly serial loop. Output tokens
+    and logprobs are identical at every depth — the device computation
+    chain does not depend on when the host fetches it — only latency
+    bounds change: a cancel or mid-window retire can decode (and
+    discard) up to ``decode_block × pipeline_depth`` surplus tokens.
     """
 
     _STOP = object()
@@ -480,6 +570,7 @@ class ContinuousBatcher:
         prefill_chunk: int | None = None,
         prefix_cache: int | None = None,
         decode_block: int = 8,
+        pipeline_depth: int = 2,
     ):
         cfg = model.cfg
         self._model = model
@@ -633,6 +724,24 @@ class ContinuousBatcher:
         # host-side, never emitted.
         self._decode_block = max(1, int(decode_block))
         self._block_cache: dict = {}
+        if pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {pipeline_depth}"
+            )
+        # Overlapped pipeline: up to pipeline_depth dispatched-but-not-
+        # fetched decode blocks. Each window entry is (k, packed) — the
+        # block length and its device-resident (2, k, slots) result.
+        # Scheduler-thread-only, like _live.
+        self._pipeline_depth = int(pipeline_depth)
+        self._window: "collections.deque[tuple[int, object]]" = (
+            collections.deque()
+        )
+        # Async admissions whose first token is still device-resident:
+        # (row, tok_1, lp_1). Resolved before any sweep can touch the
+        # row (see _resolve_first_tokens).
+        self._pending_first: list[tuple[int, object, object]] = []
+        self._drain_stalls = 0  # forced drains of a non-empty window
+        self._overlap_hidden_s = 0.0  # host sweep time hidden by flight
         # Device-resident (4,) gates array, rebuilt only when the live
         # set changes (admit/retire), not per step: the per-step
         # jnp.asarray was a host->device upload on the decode hot path.
@@ -700,11 +809,21 @@ class ContinuousBatcher:
         self._m_phase = self.metrics.histogram(
             "engine_request_phase_seconds",
             "scheduler phase latency (queue/prefill per request; "
-            "dispatch/fetch per k-step decode block shared by all "
-            "live slots)",
+            "dispatch/fetch/sweep per k-step decode block shared by "
+            "all live slots)",
         )
         self._m_ttft = self.metrics.histogram(
             "engine_ttft_seconds", "time to first token"
+        )
+        self._m_drains = self.metrics.counter(
+            "engine_drain_stalls_total",
+            "forced drains of a non-empty in-flight block window "
+            "(admission or prefill-admit state changes)",
+        )
+        self._m_overlap = self.metrics.histogram(
+            "engine_overlap_hidden_seconds",
+            "host sweep time that ran while >=1 decode block was "
+            "still in flight (hidden behind device compute)",
         )
         g_busy = self.metrics.gauge(
             "engine_slots_busy", "KV-cache slots currently occupied"
@@ -715,8 +834,15 @@ class ContinuousBatcher:
         g_slots = self.metrics.gauge(
             "engine_slots", "configured KV-cache slots"
         )
+        g_inflight = self.metrics.gauge(
+            "engine_inflight_depth",
+            "decode blocks dispatched but not yet fetched",
+        )
 
-        def _collect(busy=g_busy, depth=g_depth, slots=g_slots):
+        def _collect(
+            busy=g_busy, depth=g_depth, slots=g_slots,
+            inflight=g_inflight,
+        ):
             # render-time refresh: these values' truth lives in the
             # scheduler's bookkeeping, not in a mutation stream
             busy.set(
@@ -725,9 +851,11 @@ class ContinuousBatcher:
             )
             depth.set(self._queue.qsize())
             slots.set(self._slots)
+            inflight.set(len(self._window))
 
         self.metrics.add_collector(_collect)
 
+        self._emitter = _EmitWorker()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="continuous-batcher"
         )
@@ -1231,6 +1359,17 @@ class ContinuousBatcher:
             "queue_depth": self._queue.qsize(),
             "steps": self.steps,
             "decode_block": self._decode_block,
+            "pipeline_depth": self._pipeline_depth,
+            # dispatched-but-unfetched decode blocks right now (the
+            # overlap window); sampled without a lock — a point-in-time
+            # observability read, like slots_busy
+            "inflight_depth": len(self._window),
+            # forced window drains (admission / final-chunk prefill
+            # admit under a non-empty window)
+            "drain_stalls": self._drain_stalls,
+            # host sweep time that ran while >=1 block was in flight —
+            # scheduler cost the pipeline hid behind device compute
+            "overlap_hidden_ms": round(self._overlap_hidden_s * 1e3, 3),
             "admitted": self.admitted,
             "completed": self.completed,
             "cancelled": self.cancelled,
@@ -1245,10 +1384,13 @@ class ContinuousBatcher:
             else None,
             # Per-phase latency percentiles over the span ring's
             # sliding window. UNITS DIFFER BY PHASE: queue and prefill
-            # are per REQUEST (one observation each); dispatch and
-            # fetch are per scheduler ITERATION — one k-step decode
-            # block shared by every live slot — so comparing them to
-            # the per-request phases requires dividing by k×occupancy.
+            # are per REQUEST (one observation each); dispatch, fetch
+            # and sweep are per k-step decode BLOCK shared by every
+            # live slot — so comparing them to the per-request phases
+            # requires dividing by k×occupancy. With pipeline_depth>1,
+            # fetch measures the wait for the OLDEST in-flight block
+            # while younger blocks keep the device busy — it shrinks
+            # as overlap hides host work, which is the point.
             "phase_ms": {
                 name.split(".", 1)[1]: v
                 for name, v in self._tracer.summary(
@@ -1743,14 +1885,14 @@ class ContinuousBatcher:
             bvals,
             job.bias_1[1],
         )
-        first = int(np.asarray(tok_1)[0])
-        lps = [float(np.asarray(lp_1)[0])]
-        self._live[job.row] = (job.p, [first], lps)
+        # Deferred first-token fetch, same as _admit_one: the sample and
+        # admit are dispatched; the host value resolves on the fetch path.
+        self._live[job.row] = (job.p, [], [])
         self._gates_arr = None
         self.admitted += 1
-        job.p.emit(first, lps[0])
-        if self._finished(job.p, [first], first):
-            self._retire(job.row)
+        self._pending_first.append((job.row, tok_1, lp_1))
+        if self._pipeline_depth == 1:
+            self._resolve_first_tokens()
         self._job = None
         return (
             cache, tok, pos, temps, ads, kps, seeds, pens, counts,
@@ -1928,19 +2070,128 @@ class ContinuousBatcher:
             pens, self._resolve_pen(p), counts, bids, bid_1, bvals,
             bval_1,
         )
-        first = int(np.asarray(tok_1)[0])
-        out = [first]
-        lps = [float(np.asarray(lp_1)[0])]
-        self._live[row] = (p, out, lps)
+        # Async admission: prefill + admit are DISPATCHED (jax enqueues
+        # without a device sync); the first token's fetch is deferred to
+        # _resolve_first_tokens on the normal fetch path, so a burst of
+        # admissions batches into back-to-back dispatches instead of
+        # paying two scalar round-trips each.
+        self._live[row] = (p, [], [])
         self._gates_arr = None
         self.admitted += 1
-        p.emit(first, lps[0])
-        if self._finished(p, out, first):
-            self._retire(row)
+        self._pending_first.append((row, tok_1, lp_1))
+        if self._pipeline_depth == 1:
+            # serial mode: resolve immediately — today's exact behavior
+            self._resolve_first_tokens()
         return (
             cache, tok, pos, temps, ads, kps, seeds, pens, counts,
             bids, bvals,
         )
+
+    def _emit(self, p: _Pending, token: int, logprob: float) -> None:
+        """Emit one decoded token: bookkeeping (TTFT stamp) stays on the
+        scheduler thread; the sink delivery itself runs on the emitter
+        thread so stream consumers are off the decode critical path."""
+        if p.first_token_at is None:
+            p.first_token_at = time.monotonic()
+        if p.sink is not None:
+            self._emitter.deliver(p.sink, (token, logprob))
+
+    def _resolve_first_tokens(self) -> None:
+        """Fetch the deferred first tokens of async admissions, emit
+        them, and retire rows that are already finished (budget 1, eos,
+        stop, or cancel at token 0). MUST run before any sweep that
+        could touch these rows — the scheduler guarantees it by
+        resolving right after each dispatch phase and at every drain,
+        and by only dispatching blocks AFTER the admissions they
+        cover."""
+        if not self._pending_first:
+            return
+        for row, tok_1, lp_1 in self._pending_first:
+            p, out, lps = self._live[row]
+            first = int(np.asarray(tok_1)[0])
+            lp = float(np.asarray(lp_1)[0])
+            out.append(first)
+            lps.append(lp)
+            self._emit(p, first, lp)
+            if self._finished(p, out, first):
+                self._retire(row)
+        self._pending_first.clear()
+
+    @staticmethod
+    def _block_ready(packed) -> bool:
+        """True when a dispatched block's result is already on host-
+        fetchable memory — the non-blocking readiness probe behind the
+        opportunistic early fetch. Arrays without ``is_ready`` (older
+        jax) report ready, degrading to the blocking fetch."""
+        try:
+            return bool(packed.is_ready())
+        except AttributeError:
+            return True
+
+    def _fetch_packed(self, packed) -> np.ndarray:
+        """Materialize one block's packed (2, k, slots) result on host.
+        ``jax.device_get`` blocks only until THIS block is done — with
+        dispatch-ahead the next block keeps the device busy while the
+        host sweeps this one."""
+        return np.asarray(jax.device_get(packed))
+
+    def _sweep_block(self, k: int, host: np.ndarray) -> None:
+        """Host sweep of one fetched block: append tokens/logprobs,
+        emit to streams, retire finished rows. Time spent here while
+        another block is still in flight is overlap the pipeline hid —
+        tracked in overlap_hidden (the serial loop paid it on the
+        critical path)."""
+        host_tok = host[0]
+        host_lp = host[1].view(np.float32)
+        t0 = time.monotonic()
+        with self._phase("sweep"):
+            for j in range(k):
+                for row, entry in enumerate(self._live):
+                    if entry is None:
+                        continue  # free, or finished earlier in block
+                    p, out, lps = entry
+                    t = int(host_tok[j, row])
+                    out.append(t)
+                    lps.append(float(host_lp[j, row]))
+                    self._emit(p, t, lps[-1])
+                    if self._finished(p, out, t):
+                        self._retire(row)
+        if self._window:
+            dur = time.monotonic() - t0
+            self._overlap_hidden_s += dur
+            self._m_overlap.observe(dur)
+
+    def _drain_window(self, reason: str) -> None:
+        """Fetch + sweep every in-flight block, oldest first — the
+        pipeline's synchronization point, required before any mutation
+        of the shared batch state (admission, final-chunk prefill
+        admit): an unswept block's retires haven't freed slots yet, and
+        admitting into a slot whose garbage tokens are still in flight
+        would credit them to the new request. Counted as a drain stall
+        only when the window actually held work.
+
+        An empty window needs NO first-token resolution here (there is
+        nothing to sweep), and skipping it is what lets back-to-back
+        admissions inside one admit loop stay sync-free."""
+        if not self._window:
+            return
+        # Invariant guard: first tokens resolve before any sweep. In
+        # practice pending_first is always empty when blocks are in
+        # flight (blocks dispatch after admissions and resolution
+        # follows the dispatch phase), so this is a no-op.
+        self._resolve_first_tokens()
+        if all(e is None for e in self._live):
+            # every row already retired: the in-flight blocks hold only
+            # discards — drop the references without fetching
+            self._window.clear()
+            return
+        self._drain_stalls += 1
+        self._m_drains.inc(reason=reason)
+        while self._window:
+            k0, packed = self._window.popleft()
+            with self._phase("fetch"):
+                host = self._fetch_packed(packed)
+            self._sweep_block(k0, host)
 
     def _finished(self, p: _Pending, out: list[int], last: int) -> bool:
         if p.cancelled:
@@ -2000,7 +2251,11 @@ class ContinuousBatcher:
         self.completed += 1
         p.result = out
         p.logprobs = lps
-        p.finish()
+        # result/logprobs are set BEFORE the terminal marker is queued:
+        # a stream consumer that sees the emitter-delivered True and
+        # reads .result gets the final value.
+        if p.sink is not None:
+            self._emitter.deliver(p.sink, True)
         p.event.set()
 
     def _resolve_unadmitted_cancel(self, p: _Pending) -> None:
@@ -2013,13 +2268,17 @@ class ContinuousBatcher:
         self.cancelled += 1
         self.completed += 1
         self._m_completed.inc()
-        p.finish()
+        if p.sink is not None:
+            self._emitter.deliver(p.sink, True)
         p.event.set()
 
     def _fail_one(self, p: _Pending, err: BaseException) -> None:
         self._failed_total += 1
         self._m_failed.inc()
-        p.fail(err)
+        p.error = err
+        if p.sink is not None:
+            self._emitter.deliver(p.sink, err)
+        p.event.set()
 
     def _fail_all(self, err: BaseException) -> None:
         for row, entry in enumerate(self._live):
@@ -2039,22 +2298,38 @@ class ContinuousBatcher:
     def _loop(self) -> None:
         cache = tok = pos = temps = ads = kps = seeds = None
         pens = counts = bids = bvals = None
+        depth = self._pipeline_depth
         try:
             while True:
                 if self._stop_now.is_set():
                     err = RuntimeError("engine shutting down")
+                    # abrupt shutdown: in-flight device work and
+                    # unresolved first tokens are dropped unfetched —
+                    # every owning request fails below anyway
+                    self._window.clear()
+                    self._pending_first.clear()
                     if self._job is not None:
                         self._fail_one(self._job.p, err)
                         self._job = None
                     self._fail_all(err)
                     return
+                if self._window and all(e is None for e in self._live):
+                    # every row retired mid-window: the remaining
+                    # in-flight blocks hold only discards — drop them
+                    # without fetching (nothing to sweep)
+                    self._window.clear()
                 idle = (
                     all(e is None for e in self._live)
                     and self._job is None
+                    and not self._window
                 )
                 # Admit queued requests into free slots (chunked mode:
                 # start at most one prefill job, advanced one chunk per
-                # iteration below); block only when fully idle.
+                # iteration below); block only when fully idle. The
+                # FIRST admissible pop drains the in-flight window (a
+                # state change under unswept blocks would corrupt slot
+                # accounting); subsequent pops in the same sweep see an
+                # empty window and batch their admissions sync-free.
                 while True:
                     free = [
                         i
@@ -2081,6 +2356,8 @@ class ContinuousBatcher:
                         # no live job possible here: the admit loop
                         # breaks before queue.get while a job runs, so
                         # a queued STOP is only reached after it ends
+                        self._drain_window("shutdown")
+                        self._pending_first.clear()
                         self._fail_all(RuntimeError("engine shutting down"))
                         return
                     if item.cancelled:
@@ -2088,6 +2365,15 @@ class ContinuousBatcher:
                         continue
                     self._observe_queue_wait(item)
                     self._inflight = item
+                    self._drain_window("admit")
+                    # the drain may have retired rows — recompute the
+                    # target slot from the freshest free set
+                    free = [
+                        i
+                        for i, e in enumerate(self._live)
+                        if e is None
+                        and (self._job is None or self._job.row != i)
+                    ]
                     if cache is None:
                         (
                             cache, tok, pos, temps, ads, kps, seeds,
@@ -2109,6 +2395,18 @@ class ContinuousBatcher:
                     idle = False
 
                 if self._job is not None:
+                    c = self._prefill_chunk
+                    if (
+                        not self._job.p.cancelled
+                        and self._job.next_pos + c >= self._job.length
+                    ):
+                        # this chunk is the FINAL one: it samples the
+                        # first token and scatters the row into the
+                        # shared batch state — same drain rule as
+                        # admission. Intermediate chunks touch only the
+                        # job's private single-row cache and overlap
+                        # freely with in-flight decode blocks.
+                        self._drain_window("prefill_admit")
                     with self._phase("prefill"):
                         (
                             cache, tok, pos, temps, ads, kps, seeds,
@@ -2130,14 +2428,14 @@ class ContinuousBatcher:
                 # chunked-prefill job in flight (it advances one chunk
                 # per loop iteration, so a block would starve it).
                 # Rows that finish mid-block — budget, stop, or eos —
-                # retire at their finish point in the host sweep below;
+                # retire at their finish point in the host sweep;
                 # their surplus block tokens are discarded, never
-                # emitted (the device-side waste is bounded by k-1
-                # ~ms-scale steps per retire, vs the ~100 ms-scale
-                # per-token host round-trips a whole-batch k=1
-                # fallback would reinstate), their garbage cache
-                # writes are position-clamped and overwritten by the
-                # next admission.
+                # emitted (the device-side waste is bounded by
+                # k·pipeline_depth ~ms-scale steps per retire, vs the
+                # ~100 ms-scale per-token host round-trips a
+                # whole-batch k=1 fallback would reinstate), their
+                # garbage cache writes are position-clamped and
+                # overwritten by the next admission.
                 k = self._decode_block
                 if k > 1 and (
                     self._job is not None
@@ -2153,31 +2451,42 @@ class ContinuousBatcher:
                 for e in self._live:
                     if e is not None and e[0].decode_block_pin:
                         k = min(k, max(1, int(e[0].decode_block_pin)))
+                # Dispatch-ahead: refill the in-flight window from the
+                # device-resident functional state — block N+1 needs no
+                # host data, so it enqueues before block N is fetched
+                # and the device never waits on the host sweep.
                 with self._phase("dispatch"):
-                    cache, tok, pos, packed, counts = self._block_fn(k)(
-                        self._params, cache, tok, pos, temps, ads, kps,
-                        seeds, pens, counts, bids, bvals,
-                        self._gates_dev(),
-                    )
-                self.steps += k
-                self._m_steps.inc(k)
-                with self._phase("fetch"):
-                    # ONE fetch: (2, k, slots) int32; row 1 carries the
-                    # fp32 logprob bits (see _block_fn)
-                    host = np.asarray(packed)
-                host_tok = host[0]
-                host_lp = host[1].view(np.float32)
-                for j in range(k):
-                    for row, entry in enumerate(self._live):
-                        if entry is None:
-                            continue  # free, or finished earlier in block
-                        p, out, lps = entry
-                        t = int(host_tok[j, row])
-                        out.append(t)
-                        lps.append(float(host_lp[j, row]))
-                        p.emit(t, lps[-1])
-                        if self._finished(p, out, t):
-                            self._retire(row)
+                    while len(self._window) < depth:
+                        (
+                            cache, tok, pos, packed, counts,
+                        ) = self._block_fn(k)(
+                            self._params, cache, tok, pos, temps, ads,
+                            kps, seeds, pens, counts, bids, bvals,
+                            self._gates_dev(),
+                        )
+                        self.steps += k
+                        self._m_steps.inc(k)
+                        self._window.append((k, packed))
+                # Deferred admission first tokens resolve AFTER the
+                # dispatch above, so their device_get overlaps the
+                # freshly enqueued block — and BEFORE any sweep below
+                # can touch their rows (stream order: first token, then
+                # block tokens).
+                self._resolve_first_tokens()
+                # Fetch the oldest block: blocking once the window is
+                # full (steady state — its compute is hidden by the
+                # younger in-flight blocks), opportunistically early
+                # when the device has already finished it.
+                if self._window and (
+                    len(self._window) >= depth
+                    or self._block_ready(self._window[0][1])
+                ):
+                    k0, packed = self._window.popleft()
+                    with self._phase("fetch"):
+                        # ONE fetch: (2, k, slots) int32; row 1 carries
+                        # the fp32 logprob bits (see _block_fn)
+                        host = self._fetch_packed(packed)
+                    self._sweep_block(k0, host)
         except BaseException as e:  # noqa: BLE001 - ferry to waiters
             logger.exception("continuous-batcher loop died")
             # Refuse new submits FIRST (a dead loop never answers), then
@@ -2185,6 +2494,8 @@ class ContinuousBatcher:
             # nor the queue) and everything parked or queued.
             with self._submit_lock:
                 self._closed = True
+            self._window.clear()
+            self._pending_first.clear()
             if self._inflight is not None:
                 self._fail_one(self._inflight, e)
                 self._inflight = None
@@ -2192,3 +2503,9 @@ class ContinuousBatcher:
                 self._fail_one(self._job.p, e)
                 self._job = None
             self._fail_all(e)
+        finally:
+            # Wind down the delivery thread once the scheduler is done:
+            # everything enqueued above (tokens, terminals, errors)
+            # flushes before the sentinel, so close() callers see fully
+            # delivered sinks once the loop thread joins.
+            self._emitter.stop()

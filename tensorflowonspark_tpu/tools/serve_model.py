@@ -15,7 +15,10 @@ Endpoints::
                                 engine's counters/gauges/histograms)
     GET  /stats              -> scheduler JSON incl. per-phase request
                                 latency percentiles (queue/prefill/
-                                dispatch/fetch) backed by obs spans
+                                dispatch/fetch/sweep) backed by obs
+                                spans, plus the overlap pipeline's
+                                pipeline_depth / inflight_depth /
+                                drain_stalls / overlap_hidden_ms
     GET  /signature          -> the artifact's signature metadata
     POST /predict            -> body {"rows": [<row>, ...]}
                                 (rows as dicts per input_mapping, or raw
@@ -964,6 +967,10 @@ def _build_engine(gen: dict):
             8 if gen.get("decode_block") is None
             else int(gen["decode_block"])
         ),
+        pipeline_depth=(
+            2 if gen.get("pipeline_depth") is None
+            else int(gen["pipeline_depth"])
+        ),
     )
     if gen.get("warmup"):
         t0 = time.monotonic()
@@ -1353,6 +1360,16 @@ def main(argv: list[str] | None = None) -> int:
         "(minimum admission-latency jitter)",
     )
     p.add_argument(
+        "--gen-pipeline-depth",
+        type=int,
+        default=2,
+        help="continuous engine: keep this many decode blocks in "
+        "flight (dispatch-ahead software pipelining) so the host "
+        "sweep/emit/stream cost hides behind device compute; 1 = the "
+        "strictly serial dispatch->fetch->sweep loop (identical "
+        "tokens either way; only latency/drain behavior differs)",
+    )
+    p.add_argument(
         "--gen-prefill-chunk",
         type=int,
         default=None,
@@ -1395,6 +1412,7 @@ def main(argv: list[str] | None = None) -> int:
             prefill_chunk=args.gen_prefill_chunk,
             prefix_cache=args.gen_prefix_cache,
             decode_block=args.gen_decode_block,
+            pipeline_depth=args.gen_pipeline_depth,
             warmup=args.gen_warmup,
             lora_scale=args.gen_lora_scale,
             drain_on_shutdown=args.gen_drain_on_shutdown,

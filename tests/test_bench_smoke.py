@@ -48,6 +48,45 @@ def test_bench_smoke_emits_complete_json():
     assert out["mnist_final_loss"] > 0
 
 
+def test_bench_serve_smoke_emits_engine_tax():
+    """bench.py --serve end-to-end on the tiny model: the serving-tax
+    measurement (engine tokens/sec at pipeline_depth 1 and 2 vs raw
+    single-stream generate) must emit a finite engine_tax JSON line and
+    commit the span-based trace-report artifact."""
+    import math
+
+    env = dict(
+        os.environ,
+        BENCH_SMOKE="1",
+        BENCH_ALLOW_CPU="1",
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PALLAS_AXON_REMOTE_COMPILE="",
+    )
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--serve"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "serve_engine_tax"
+    assert out["smoke"] is True
+    assert math.isfinite(out["value"]) and out["value"] > 0
+    assert out["raw_single_stream_tokens_per_sec"] > 0
+    for leg in ("engine_depth1", "engine_depth2"):
+        assert out[leg]["tokens_per_sec"] > 0
+        assert out[leg]["dispatch_fetch_ms_per_token"] >= 0
+    # the depth-2 engine overlapped SOMETHING (sweeps ran while blocks
+    # were in flight) — the gauge the whole PR exists to move
+    assert out["engine_depth2"]["overlap_hidden_ms"] > 0
+    # the host-residual evidence artifact was committed
+    assert os.path.exists(os.path.join(REPO, out["trace_report"]))
+
+
 def test_bench_relay_gate_fails_fast_when_relay_down():
     """With the relay marker present and no ports listening, bench must
     emit a distinct relay_unreachable line in seconds, exit 3."""

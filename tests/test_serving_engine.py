@@ -1358,9 +1358,14 @@ def test_engine_stop_sequences(tiny):
         )
         assert got == base[:3]
         assert len(lps) == len(got)
-        # several sequences: the EARLIEST completed match wins
-        got = eng.submit([1, 2, 3], 10, stop=[base[6:8], [base[4]]])
-        assert got == base[:4]
+        # several sequences: the EARLIEST completed match wins. The
+        # single-token stop must be a token whose FIRST occurrence is
+        # interior — greedy tails can re-emit an earlier token (this
+        # environment's weights repeat base[0] at index 4), which would
+        # complete the match at that earlier position instead.
+        fi = next(i for i in range(1, 6) if base[i] not in base[:i])
+        got = eng.submit([1, 2, 3], 10, stop=[base[6:8], [base[fi]]])
+        assert got == base[:fi]
         # a stop that never matches: full budget
         assert eng.submit([1, 2, 3], 6, stop=[[255, 255, 255]]) == base[:6]
         # validation
@@ -1379,20 +1384,26 @@ def test_engine_stop_sequence_caps_and_longest_match(tiny):
         with pytest.raises(ValueError, match="64 tokens"):
             eng.submit([1], 2, stop=[[1] * 65])
         # order-independent trimming: the LONGEST tail match wins.
-        # base[4] is the first occurrence of its value, so the 1-token
-        # stop and the 2-token stop COMPLETE on the same step
+        # Pick an index whose token value FIRST occurs there (greedy
+        # tails can re-emit earlier tokens — this environment's weights
+        # repeat base[0] at index 4), so the 1-token stop and the
+        # 2-token stop COMPLETE on the same step.
         base = _reference(model, params, [1, 2, 3], 6)
-        assert base[4] not in base[:4]  # construction precondition
-        a = eng.submit([1, 2, 3], 6, stop=[[base[4]], base[3:5]])
-        b = eng.submit([1, 2, 3], 6, stop=[base[3:5], [base[4]]])
-        assert a == b == base[:3]
+        fi = next(i for i in range(1, 5) if base[i] not in base[:i])
+        a = eng.submit(
+            [1, 2, 3], 6, stop=[[base[fi]], base[fi - 1 : fi + 1]]
+        )
+        b = eng.submit(
+            [1, 2, 3], 6, stop=[base[fi - 1 : fi + 1], [base[fi]]]
+        )
+        assert a == b == base[: fi - 1]
         # streaming: the yielded tokens include the matched stop suffix
         # (the match completes on its last token), but the handle's
         # .result is the TRIMMED completion — what HTTP trailers serve
-        stream = eng.stream([1, 2, 3], 6, stop=[base[3:5]])
+        stream = eng.stream([1, 2, 3], 6, stop=[base[fi - 1 : fi + 1]])
         seen = list(stream)
-        assert seen == base[:5]  # raw, includes the stop pair
-        assert stream.result == base[:3]  # trimmed
+        assert seen == base[: fi + 1]  # raw, includes the stop pair
+        assert stream.result == base[: fi - 1]  # trimmed
     finally:
         eng.close()
 
