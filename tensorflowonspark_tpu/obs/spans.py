@@ -83,7 +83,10 @@ def _profiler():
 
 class Span(tuple):
     """One recorded interval: ``(name, ts, dur, tid, thread_name, args)``
-    with ``ts``/``dur`` in seconds on the tracer's monotonic clock."""
+    with ``ts``/``dur`` in seconds on the tracer's monotonic clock.
+    ``tid`` is the recording thread's ident for call-stack spans, or a
+    synthetic ``"interval:<name>"`` lane id for :meth:`SpanTracer.record`
+    intervals (which don't nest with any thread's call stack)."""
 
     __slots__ = ()
     name = property(lambda s: s[0])
@@ -171,9 +174,24 @@ class SpanTracer:
                **args: Any) -> None:
         """Record an already-measured interval of ``dur`` seconds ending
         now (or starting at monotonic ``ts``) — for durations measured
-        elsewhere, e.g. a request's queue wait stamped at enqueue."""
+        elsewhere, e.g. a request's queue wait stamped at enqueue.
+
+        The interval lands on a synthetic per-name lane
+        (``tid="interval:<name>"``), NOT the calling thread's lane: a
+        backdated interval (a ~1s queue wait recorded at admission time)
+        would otherwise span real call-stack spans the same thread
+        recorded in the meantime without properly nesting them, and
+        nesting-aware consumers (``obs.trace_report.self_times``) would
+        subtract those spans from it — producing negative self time.
+        ``summary()`` percentiles key on name only and are identical
+        either way.
+        """
         t_start = (_CLOCK() - dur) if ts is None else ts
-        self._append(name, t_start, dur, args)
+        self._append(
+            name, t_start, dur, args,
+            tid=f"interval:{name}",
+            thread_name=f"intervals: {name}",
+        )
 
     def traced(self, name: str | None = None) -> Callable:
         """Decorator: run the function body under a span (default name:
@@ -191,9 +209,12 @@ class SpanTracer:
 
         return deco
 
-    def _append(self, name: str, ts: float, dur: float, args: dict) -> None:
-        t = threading.current_thread()
-        s = Span((name, ts, dur, t.ident, t.name, args or None))
+    def _append(self, name: str, ts: float, dur: float, args: dict,
+                tid: Any = None, thread_name: str | None = None) -> None:
+        if tid is None:
+            t = threading.current_thread()
+            tid, thread_name = t.ident, t.name
+        s = Span((name, ts, dur, tid, thread_name, args or None))
         with self._lock:
             self._buf.append(s)
             self.recorded += 1

@@ -38,6 +38,7 @@ import json
 import os
 import re
 import sys
+import warnings
 
 __all__ = [
     "find_trace_files",
@@ -118,9 +119,22 @@ def load_events(path: str) -> dict:
 def self_times(events: list[dict]) -> "collections.Counter[tuple]":
     """Per-(pid, tid) nesting-aware self time, keyed by (pid, name).
 
-    Chrome-trace complete events within one thread nest like a call stack.
-    Sort by (start, -dur); maintain a stack of open intervals; an event's
-    self time is its duration minus the durations of its direct children.
+    Chrome-trace complete events within one thread nest like a call
+    stack. Sort by (start, -dur); maintain a stack of open intervals; an
+    event's self time is its duration minus the portions of its direct
+    children that fall INSIDE it.
+
+    Real call stacks nest strictly. Events that only PARTIALLY overlap
+    violate that model; naively subtracting each child's full duration
+    then yields negative self time, which a summed report silently
+    launders into plausible-looking wrong totals. So: a child only
+    charges its parent for the overlapping portion, per-event self time
+    is clamped at zero, and detection of non-nested overlap raises a
+    ``RuntimeWarning`` — the trace is malformed and its attribution is
+    approximate. Lanes holding externally-measured intervals
+    (``tid="interval:<name>"``, from ``obs.spans`` ``record()``) are
+    not call stacks at all: they skip nesting attribution and each
+    event simply owns its full duration.
     """
     per_thread: dict = collections.defaultdict(list)
     for e in events:
@@ -129,21 +143,47 @@ def self_times(events: list[dict]) -> "collections.Counter[tuple]":
         per_thread[(e.get("pid"), e.get("tid"))].append(e)
 
     self_us: "collections.Counter[tuple]" = collections.Counter()
-    for (pid, _tid), evs in per_thread.items():
+    non_nested = 0
+    for (pid, tid), evs in per_thread.items():
+        if isinstance(tid, str) and tid.startswith("interval:"):
+            # externally-measured intervals (``SpanTracer.record``):
+            # independent durations, not a call stack — concurrent
+            # requests' queue waits overlap freely and each owns its
+            # full duration; nesting attribution does not apply
+            for e in evs:
+                self_us[(pid, e["name"])] += e["dur"]
+            continue
         evs.sort(key=lambda e: (e["ts"], -e["dur"]))
         stack: list[dict] = []  # open events, each with _child_us accumulator
         for e in evs:
             ts, dur = e["ts"], e["dur"]
-            while stack and ts >= stack[-1]["ts"] + stack[-1]["dur"]:
+            while stack and ts >= stack[-1]["_end"]:
                 done = stack.pop()
-                self_us[(pid, done["name"])] += done["dur"] - done["_child_us"]
+                self_us[(pid, done["name"])] += max(
+                    0, done["dur"] - done["_child_us"]
+                )
             if stack:
-                stack[-1]["_child_us"] += dur
-            e = dict(e, _child_us=0)
+                inside = min(ts + dur, stack[-1]["_end"]) - ts
+                if inside < dur:
+                    non_nested += 1
+                stack[-1]["_child_us"] += max(0, inside)
+            e = dict(e, _child_us=0, _end=ts + dur)
             stack.append(e)
         while stack:
             done = stack.pop()
-            self_us[(pid, done["name"])] += done["dur"] - done["_child_us"]
+            self_us[(pid, done["name"])] += max(
+                0, done["dur"] - done["_child_us"]
+            )
+    if non_nested:
+        warnings.warn(
+            f"{non_nested} trace event(s) overlap a same-lane event "
+            "without nesting inside it (call-stack events must nest "
+            "strictly); self-time attribution clamped the overlap — "
+            "treat per-op self times on the affected lanes as "
+            "approximate",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     return self_us
 
 
@@ -291,6 +331,7 @@ def write_report(
     os.makedirs(parent, exist_ok=True)
     with open(out_path, "w") as f:
         json.dump(report, f, indent=1)
+        f.write("\n")
     return report
 
 

@@ -6,6 +6,7 @@ import json
 import threading
 import time
 import urllib.request
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -100,6 +101,50 @@ def test_span_record_and_decorator_summary():
     assert sm["engine.queue"]["p50_ms"] >= 100
     with pytest.raises(ValueError):
         obs_spans.SpanTracer(capacity=0)
+
+
+def test_record_interval_lands_on_synthetic_lane(tmp_path):
+    """A backdated record() interval must never interleave with the
+    recording thread's call-stack spans: a queue wait recorded at
+    admission time covers the prefill/dispatch spans the scheduler
+    thread recorded DURING the wait without nesting them, which used to
+    drive trace_report self-times negative in the committed serve
+    artifact."""
+    tr = obs_spans.SpanTracer(capacity=64)
+    t_wait0 = time.perf_counter()
+    # real call-stack work on this thread during the "wait"
+    with tr.span("engine.prefill"):
+        time.sleep(0.03)
+    with tr.span("engine.dispatch"):
+        time.sleep(0.03)
+    # the externally-measured wait, stamped only now — its interval
+    # covers both spans above
+    tr.record("engine.queue", time.perf_counter() - t_wait0)
+
+    by_span = {s.name: s for s in tr.spans()}
+    assert by_span["engine.prefill"].tid == threading.get_ident()
+    assert by_span["engine.queue"].tid == "interval:engine.queue"
+    assert by_span["engine.queue"].thread_name == "intervals: engine.queue"
+
+    run = tmp_path / "plugins" / "profile" / "run0"
+    run.mkdir(parents=True)
+    tr.write_chrome_trace(str(run / "host.trace.json.gz"), "host")
+    events = trace_report.load_events(
+        str(run / "host.trace.json.gz")
+    )["traceEvents"]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # separate lanes: nothing overlaps
+        self_us = trace_report.self_times(events)
+    assert all(us >= 0 for us in self_us.values())
+    by = {n: us for (_pid, n), us in self_us.items()}
+    # the interval keeps its FULL duration (nothing nests inside it on
+    # its synthetic lane) and the call-stack spans keep theirs
+    assert by["engine.queue"] == pytest.approx(
+        by_span["engine.queue"].dur * 1e6, rel=0.01
+    )
+    assert by["engine.prefill"] == pytest.approx(
+        by_span["engine.prefill"].dur * 1e6, rel=0.01
+    )
 
 
 # -- registry ----------------------------------------------------------
@@ -241,6 +286,57 @@ def test_attribution_table_from_synthetic_trace():
     assert att["mxu_fraction"] == 0.3
 
 
+def test_self_times_partial_overlap_clamps_and_warns():
+    """Non-nested overlap on one lane (the corrupt-trace shape) must
+    clamp at zero and warn instead of silently reporting negative
+    self time: only the portion of an event that falls INSIDE the
+    enclosing event charges it."""
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "host"}},
+        # prefill overlaps queue and extends past its end; the old code
+        # charged queue prefill's FULL 150us: self = 100 - 150 = -50
+        {"ph": "X", "pid": 1, "tid": 1, "name": "queue",
+         "ts": 0, "dur": 100},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "prefill",
+         "ts": 10, "dur": 150},
+    ]
+    with pytest.warns(RuntimeWarning, match="without nesting"):
+        self_us = trace_report.self_times(events)
+    by = {n: us for (_pid, n), us in self_us.items()}
+    assert by["queue"] == 10  # 100 minus prefill's in-queue 90us
+    assert by["prefill"] == 150
+    assert all(us >= 0 for us in self_us.values())
+
+    # strictly nested events stay warning-free and exact
+    nested = [
+        {"ph": "X", "pid": 1, "tid": 1, "name": "outer",
+         "ts": 0, "dur": 100},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "inner",
+         "ts": 10, "dur": 50},
+    ]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        clean = trace_report.self_times(nested)
+    assert {n: us for (_p, n), us in clean.items()} == {
+        "outer": 50, "inner": 50,
+    }
+
+    # interval lanes (SpanTracer.record) are NOT call stacks:
+    # concurrent requests' queue waits overlap freely, each keeps its
+    # full duration, and no malformed-trace warning fires
+    iv = [
+        {"ph": "X", "pid": 1, "tid": "interval:queue", "name": "queue",
+         "ts": 0, "dur": 100},
+        {"ph": "X", "pid": 1, "tid": "interval:queue", "name": "queue",
+         "ts": 50, "dur": 100},
+    ]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        ivs = trace_report.self_times(iv)
+    assert ivs[(1, "queue")] == 200
+
+
 def test_build_report_and_cli(tmp_path, capsys):
     run = tmp_path / "plugins" / "profile" / "run1"
     run.mkdir(parents=True)
@@ -266,7 +362,9 @@ def test_build_report_and_cli(tmp_path, capsys):
     printed = capsys.readouterr().out
     assert "/device:TPU:0" in printed
     assert "attribution" in printed and "mxu" in printed
-    on_disk = json.loads(out_json.read_text())
+    on_disk_text = out_json.read_text()
+    assert on_disk_text.endswith("\n")  # clean diffs on regeneration
+    on_disk = json.loads(on_disk_text)
     assert on_disk["attribution"]["mxu_fraction"] == 0.3
 
     with pytest.raises(FileNotFoundError):
