@@ -1,0 +1,46 @@
+"""tfoslint: repo-native static analysis for the failure classes this
+stack actually has.
+
+Large distributed ML systems catch host/device-coordination and
+concurrency bugs with build-time validation, not code review (the
+TensorFlow system paper's reliability story; tf.data's account of feed
+path concurrency). This package is that layer for tensorflowonspark_tpu,
+three AST analyzers over the whole package, run in CI against a
+checked-in baseline so any NEW violation fails the build:
+
+- **LK (lock discipline)** — shared mutable attributes are annotated
+  ``# guarded-by: self._lock`` at their assignment site; every other
+  read/write of that attribute must sit lexically inside a
+  ``with <that lock>:`` block (or a function marked ``# lint:
+  holds-lock``). Catches the unsynchronized-shared-state races the
+  advisor rounds kept finding (e.g. the ``warmup()`` shared-knob
+  mutation class).
+- **JX (jax API hygiene)** — ``jax._src`` / ``jax.interpreters`` are
+  hard errors anywhere; version-moved symbols (``shard_map``) must be
+  imported from the guarded shims in ``utils/compat.py``. Catches the
+  AttributeError-at-collection env drift the ring/ulysses/mesh-flash
+  paths shipped with.
+- **HS/TL (host sync + tracer leaks)** — implicit device→host syncs
+  (``.item()``, ``float()``/``int()`` on device values, ``np.asarray``
+  on jax values) flagged inside functions reachable from the serving
+  engine ``_loop`` and ``train.step`` hot paths; storing values on
+  ``self`` or module globals inside ``jit``-decorated functions flagged
+  everywhere (a traced value outliving its trace is a leak).
+
+Run it::
+
+    python tools/tfoslint.py tensorflowonspark_tpu/
+
+Configuration lives in ``pyproject.toml`` under ``[tool.tfoslint]``;
+known-and-justified findings live in the baseline file
+(``tools/tfoslint_baseline.json``). See ``docs/STATIC_ANALYSIS.md``.
+"""
+
+from tensorflowonspark_tpu.analysis.core import (  # noqa: F401
+    Config,
+    Finding,
+    Package,
+    load_config,
+    main,
+    run_lint,
+)

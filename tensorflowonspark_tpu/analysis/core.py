@@ -1,0 +1,419 @@
+"""Lint driver: config, file discovery, baseline compare, reporting.
+
+The analyzers themselves live in :mod:`.locks`, :mod:`.jaxapi` and
+:mod:`.hostsync`; this module parses every file ONCE into a
+:class:`Package` (source + AST + comment map per module) and hands that
+to each analyzer, so a whole-package run costs one parse pass plus three
+tree walks — well inside the tier-1 <30 s budget.
+
+Baseline semantics (ratchet, not allowlist): findings are keyed by
+``(rule, path, message)`` — deliberately NOT by line number, so an
+unrelated edit shifting lines doesn't invalidate the baseline — and
+compared as multisets. A finding over the baselined count for its key
+fails the run; a baselined key with fewer current findings is reported
+as stale so the baseline only ever shrinks.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from typing import Any, Iterable
+
+__all__ = [
+    "Config",
+    "Finding",
+    "Module",
+    "Package",
+    "load_config",
+    "main",
+    "run_lint",
+]
+
+DEFAULT_RULES = ("LK", "JX", "HS", "TL")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclasses.dataclass
+class Config:
+    paths: tuple = ("tensorflowonspark_tpu",)
+    baseline: str | None = "tools/tfoslint_baseline.json"
+    rules: tuple = DEFAULT_RULES
+    # LK/JX/HS knobs (see each analyzer module)
+    compat_module: str = "tensorflowonspark_tpu/utils/compat.py"
+    moved_jax_symbols: tuple = ("shard_map", "lax.axis_size")
+    hot_roots: tuple = (
+        "tensorflowonspark_tpu/serving/engine.py::ContinuousBatcher._loop",
+        "tensorflowonspark_tpu/compute/train.py::build_train_step",
+    )
+    exclude: tuple = ()
+
+
+_SECTION_RE = re.compile(r"^\s*\[(?P<name>[^]]+)\]\s*$")
+
+
+def _parse_toml_value(text: str) -> Any:
+    """Parse the value subset [tool.tfoslint] uses: strings, booleans,
+    ints, and (possibly multiline, already-joined) string arrays."""
+    text = text.strip()
+    if text.startswith("["):
+        inner = text[1:-1] if text.endswith("]") else text[1:]
+        items = []
+        for part in inner.split(","):
+            part = part.strip()
+            if part:
+                items.append(_parse_toml_value(part))
+        return items
+    if text.startswith(('"', "'")):
+        return text[1:-1]
+    if text in ("true", "false"):
+        return text == "true"
+    try:
+        return int(text)
+    except ValueError:
+        return text
+
+
+def _read_tool_section(pyproject_path: str) -> dict:
+    """Read ``[tool.tfoslint]`` from pyproject.toml.
+
+    Uses :mod:`tomllib` when available (3.11+); this environment runs
+    3.10, so a fallback parser handles the flat key/value + string-array
+    subset the section actually uses.
+    """
+    try:
+        with open(pyproject_path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return {}
+    try:
+        import tomllib  # noqa: PLC0415 - py311+
+
+        return (
+            tomllib.loads(raw.decode("utf-8"))
+            .get("tool", {})
+            .get("tfoslint", {})
+        )
+    except ImportError:
+        pass
+    out: dict = {}
+    in_section = False
+    pending_key = None
+    pending_val = ""
+    for line in raw.decode("utf-8").splitlines():
+        m = _SECTION_RE.match(line)
+        if m:
+            in_section = m.group("name").strip() == "tool.tfoslint"
+            pending_key = None
+            continue
+        if not in_section:
+            continue
+        stripped = line.split("#", 1)[0].rstrip()
+        if not stripped.strip():
+            continue
+        if pending_key is not None:
+            pending_val += " " + stripped.strip()
+            if stripped.rstrip().endswith("]"):
+                out[pending_key] = _parse_toml_value(pending_val)
+                pending_key = None
+            continue
+        if "=" not in stripped:
+            continue
+        key, _, val = stripped.partition("=")
+        key, val = key.strip(), val.strip()
+        if val.startswith("[") and not val.endswith("]"):
+            pending_key, pending_val = key, val  # multiline array
+            continue
+        out[key] = _parse_toml_value(val)
+    return out
+
+
+def load_config(root: str, pyproject: str | None = None) -> Config:
+    """Build a :class:`Config` from ``[tool.tfoslint]`` (defaults where
+    the section or a key is absent). ``root`` is the repo root every
+    relative path in the section resolves against."""
+    section = _read_tool_section(
+        pyproject or os.path.join(root, "pyproject.toml")
+    )
+    cfg = Config()
+    if "paths" in section:
+        cfg.paths = tuple(section["paths"])
+    if "baseline" in section:
+        cfg.baseline = section["baseline"] or None
+    if "rules" in section:
+        cfg.rules = tuple(section["rules"])
+    if "compat_module" in section:
+        cfg.compat_module = section["compat_module"]
+    if "moved_jax_symbols" in section:
+        cfg.moved_jax_symbols = tuple(section["moved_jax_symbols"])
+    if "hot_roots" in section:
+        cfg.hot_roots = tuple(section["hot_roots"])
+    if "exclude" in section:
+        cfg.exclude = tuple(section["exclude"])
+    return cfg
+
+
+@dataclasses.dataclass
+class Module:
+    path: str  # absolute
+    relpath: str  # repo-relative, forward slashes
+    name: str  # dotted module name when under a package, else stem
+    tree: ast.AST
+    source: str
+    comments: dict  # {line: comment text}
+
+
+class Package:
+    """Every parsed module of one lint run, plus the repo root they are
+    relative to. Analyzers share this so each file parses once."""
+
+    def __init__(self, root: str, modules: list[Module]):
+        self.root = root
+        self.modules = modules
+        self.by_relpath = {m.relpath: m for m in modules}
+
+
+def _comment_map(source: str) -> dict:
+    out: dict = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError):
+        pass
+    return out
+
+
+def _module_name(relpath: str) -> str:
+    parts = relpath[:-3].split("/")  # strip .py
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _discover(root: str, paths: Iterable[str], exclude: Iterable[str]) -> list:
+    files = []
+    exclude = tuple(exclude)
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            files.append(full)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [
+                d for d in sorted(dirnames) if d != "__pycache__"
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    files.append(os.path.join(dirpath, fn))
+    out = []
+    for f in files:
+        rel = os.path.relpath(f, root).replace(os.sep, "/")
+        if any(rel.startswith(e.rstrip("/")) for e in exclude):
+            continue
+        out.append((f, rel))
+    return out
+
+
+def parse_package(root: str, cfg: Config) -> tuple:
+    """Parse every file under ``cfg.paths`` → (Package, parse-error
+    findings). A file that does not parse is itself a finding (rule
+    ``E000``), not a crash — the lint must degrade per-file."""
+    modules: list[Module] = []
+    errors: list[Finding] = []
+    for path, rel in _discover(root, cfg.paths, cfg.exclude):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            line = getattr(e, "lineno", 0) or 0
+            errors.append(
+                Finding("E000", rel, line, 0, f"file does not parse: {e}")
+            )
+            continue
+        modules.append(
+            Module(path, rel, _module_name(rel), tree, src, _comment_map(src))
+        )
+    return Package(root, modules), errors
+
+
+def run_lint(root: str, cfg: Config) -> list:
+    """Run every enabled analyzer over the package; findings sorted by
+    (path, line, rule)."""
+    from tensorflowonspark_tpu.analysis import hostsync, jaxapi, locks
+
+    pkg, findings = parse_package(root, cfg)
+    enabled = set(cfg.rules)
+    if "LK" in enabled:
+        findings.extend(locks.check(pkg))
+    if "JX" in enabled:
+        findings.extend(jaxapi.check(pkg, cfg))
+    if {"HS", "TL"} & enabled:
+        findings.extend(
+            hostsync.check(
+                pkg,
+                cfg,
+                host_sync="HS" in enabled,
+                tracer_leak="TL" in enabled,
+            )
+        )
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+def load_baseline(path: str) -> dict:
+    """{key: count} from a baseline file; missing file = empty baseline."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return {}
+    out: dict = {}
+    for e in data.get("entries", []):
+        key = (e["rule"], e["path"], e["message"])
+        out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def write_baseline(path: str, findings: list) -> None:
+    counts: dict = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    entries = [
+        {
+            "rule": rule,
+            "path": p,
+            "message": msg,
+            "count": n,
+            "justification": "",
+        }
+        for (rule, p, msg), n in sorted(counts.items())
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=2)
+        f.write("\n")
+
+
+def apply_baseline(findings: list, baseline: dict) -> tuple:
+    """Split findings into (new, suppressed) against {key: count}, and
+    report stale baseline keys (allowed more than observed)."""
+    remaining = dict(baseline)
+    new, suppressed = [], []
+    for f in findings:
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            suppressed.append(f)
+        else:
+            new.append(f)
+    stale = sorted(
+        (k, n) for k, n in remaining.items() if n > 0
+    )
+    return new, suppressed, stale
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def main(argv: list | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="tfoslint",
+        description="repo-native static analysis: lock discipline, "
+        "jax API hygiene, host-sync/tracer leaks",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint "
+                    "(default: [tool.tfoslint] paths)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: cwd, or the pyproject.toml "
+                    "directory walking up from it)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: [tool.tfoslint] baseline)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings to the baseline file "
+                    "and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule families to run "
+                    "(default: [tool.tfoslint] rules)")
+    args = ap.parse_args(argv)
+
+    root = args.root or os.getcwd()
+    probe = root
+    while not os.path.exists(os.path.join(probe, "pyproject.toml")):
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            break
+        probe = parent
+    if os.path.exists(os.path.join(probe, "pyproject.toml")):
+        root = probe
+
+    cfg = load_config(root)
+    if args.paths:
+        cfg.paths = tuple(args.paths)
+    if args.rules:
+        cfg.rules = tuple(
+            r.strip().upper() for r in args.rules.split(",") if r.strip()
+        )
+    baseline_path = args.baseline or cfg.baseline
+    if baseline_path and not os.path.isabs(baseline_path):
+        baseline_path = os.path.join(root, baseline_path)
+
+    findings = run_lint(root, cfg)
+
+    if args.write_baseline:
+        if not baseline_path:
+            print("tfoslint: no baseline path configured", file=sys.stderr)
+            return 2
+        write_baseline(baseline_path, findings)
+        print(
+            f"tfoslint: wrote {len(findings)} finding(s) to "
+            f"{os.path.relpath(baseline_path, root)}"
+        )
+        return 0
+
+    baseline = (
+        {}
+        if (args.no_baseline or not baseline_path)
+        else load_baseline(baseline_path)
+    )
+    new, suppressed, stale = apply_baseline(findings, baseline)
+    for f in new:
+        print(f.render())
+    if suppressed:
+        print(f"tfoslint: {len(suppressed)} baselined finding(s) suppressed")
+    for (rule, path, msg), n in stale:
+        print(
+            f"tfoslint: stale baseline entry ({n} unused): "
+            f"{rule} {path}: {msg}"
+        )
+    if new:
+        print(f"tfoslint: {len(new)} new violation(s)")
+        return 1
+    print(f"tfoslint: clean ({len(findings)} finding(s), all baselined)")
+    return 0

@@ -1,0 +1,425 @@
+"""HS: implicit device→host syncs on hot paths; TL: tracer leaks.
+
+**Hot paths.** A conservative package-wide call graph is built from the
+ASTs (edges: same-module calls by name, ``self.method()`` within the
+lexically enclosing class, and ``alias.func()`` through intra-package
+imports) and walked from the configured roots — by default the serving
+engine scheduler loop (``ContinuousBatcher._loop``) and the training
+step builder (``build_train_step``). Everything reachable is "hot":
+an implicit device sync there stalls the device pipeline the PR-2
+scheduler exists to keep full.
+
+**Device-value tracking** is a per-function, statement-ordered
+approximation: a name assigned from a ``jnp.*``/``jax.*`` expression
+(except the EXPLICIT fetch ``jax.device_get``) is device-resident; a
+name re-assigned from ``np.*`` or ``jax.device_get`` becomes host. Only
+expressions that provably mention a device value are flagged — unknown
+names (parameters, loop targets) are NOT flagged, trading recall for a
+near-zero false-positive rate, which is what keeps the baseline honest.
+
+Rules:
+
+- **HS001** — ``.item()`` anywhere in a hot function. ``.item()`` is a
+  per-scalar blocking round-trip on jax arrays and a hidden scalar copy
+  even on numpy; hot paths fetch in bulk (``jax.device_get``) instead.
+- **HS002** — ``np.asarray``/``np.array`` over a device value in a hot
+  function (an implicit transfer; spell it ``jax.device_get``).
+- **HS003** — ``float()``/``int()``/``bool()`` over a device value in a
+  hot function (implicit scalar sync).
+- **TL001** — assignment to ``self.<attr>`` inside a ``jit``-decorated
+  function: the traced value outlives its trace (the classic leaked-
+  tracer bug; on recompile it poisons unrelated calls).
+- **TL002** — assignment to a ``global``-declared name inside a
+  ``jit``-decorated function, same failure mode.
+
+``# lint: sync-ok`` on a ``def`` line suppresses HS rules for that
+function — the annotation for DELIBERATE fetch points (the engine's
+block fetch), kept next to the code they justify.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tensorflowonspark_tpu.analysis.core import Config, Finding, Module, Package
+from tensorflowonspark_tpu.analysis.locks import _def_has_marker
+
+SYNC_OK_RE = re.compile(r"#\s*lint:\s*sync-ok\b")
+_JIT_RE = re.compile(r"(?:^|[^\w.])jit\b|\.jit\b")
+
+__all__ = ["check"]
+
+
+# -- function index + call graph -------------------------------------------
+
+
+class _FuncInfo:
+    __slots__ = ("key", "mod", "node", "cls")
+
+    def __init__(self, key, mod, node, cls):
+        self.key = key  # (relpath, qualname)
+        self.mod = mod
+        self.node = node
+        self.cls = cls  # enclosing class name or None
+
+
+def _index_module(mod: Module):
+    """(functions, import_aliases, from_imports) for one module.
+
+    functions: {qualname: _FuncInfo} where a nested def's qualname is
+    ``outer.inner`` — calls inside nested defs are attributed to the
+    OUTERMOST enclosing def so reachability flows through closures the
+    way execution does (a hot function's local helper is hot).
+    """
+    funcs: dict = {}
+    aliases: dict = {}  # local alias -> dotted module
+    from_imports: dict = {}  # local name -> (module, attr)
+
+    def walk(node, prefix, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                funcs[q] = _FuncInfo((mod.relpath, q), mod, child, cls)
+                walk(child, q, cls)
+            elif isinstance(child, ast.ClassDef):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                walk(child, q, child.name)
+            elif isinstance(child, ast.Import):
+                for a in child.names:
+                    aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(child, ast.ImportFrom):
+                if child.module and child.level == 0:
+                    for a in child.names:
+                        from_imports[a.asname or a.name] = (
+                            child.module,
+                            a.name,
+                        )
+            else:
+                walk(child, prefix, cls)
+
+    walk(mod.tree, "", None)
+    return funcs, aliases, from_imports
+
+
+def _build_graph(pkg: Package):
+    """functions-by-key plus call edges {key: set(key)}."""
+    per_mod = {m.relpath: _index_module(m) for m in pkg.modules}
+    # module name -> relpath, for resolving intra-package imports
+    mod_by_name = {m.name: m.relpath for m in pkg.modules}
+    all_funcs: dict = {}
+    for rel, (funcs, _, _) in per_mod.items():
+        for q, info in funcs.items():
+            all_funcs[(rel, q)] = info
+
+    def module_funcs(relpath):
+        return per_mod[relpath][0] if relpath in per_mod else {}
+
+    edges: dict = {}
+    for rel, (funcs, aliases, from_imports) in per_mod.items():
+        for q, info in funcs.items():
+            targets = edges.setdefault(info.key, set())
+            for call in ast.walk(info.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                f = call.func
+                if isinstance(f, ast.Name):
+                    name = f.id
+                    # same-module function (top-level name)
+                    if name in funcs and "." not in name:
+                        targets.add(funcs[name].key)
+                    elif name in from_imports:
+                        m, attr = from_imports[name]
+                        trel = mod_by_name.get(m)
+                        if trel and attr in module_funcs(trel):
+                            targets.add((trel, attr))
+                elif isinstance(f, ast.Attribute) and isinstance(
+                    f.value, ast.Name
+                ):
+                    base, attr = f.value.id, f.attr
+                    if base == "self" and info.cls:
+                        mq = f"{info.cls}.{attr}"
+                        # method of the lexically enclosing class,
+                        # whatever nesting prefix it carries
+                        for cq, cinfo in funcs.items():
+                            if cq == mq or cq.endswith("." + mq):
+                                targets.add(cinfo.key)
+                    elif base in aliases:
+                        trel = mod_by_name.get(aliases[base])
+                        if trel and attr in module_funcs(trel):
+                            targets.add((trel, attr))
+                    elif base in from_imports:
+                        m, a = from_imports[base]
+                        trel = mod_by_name.get(f"{m}.{a}" if a else m)
+                        if trel and attr in module_funcs(trel):
+                            targets.add((trel, attr))
+    return all_funcs, edges
+
+
+def _hot_set(pkg: Package, cfg: Config, all_funcs, edges):
+    roots = []
+    for spec in cfg.hot_roots:
+        rel, _, q = spec.partition("::")
+        if (rel, q) in all_funcs:
+            roots.append((rel, q))
+    seen = set(roots)
+    stack = list(roots)
+    while stack:
+        key = stack.pop()
+        for t in edges.get(key, ()):
+            if t not in seen:
+                seen.add(t)
+                stack.append(t)
+    # nested defs of a hot function are lexically inside it and already
+    # scanned with it; add them so the ownership check below is exact
+    hot = set(seen)
+    for rel, q in seen:
+        for (orel, oq), _info in all_funcs.items():
+            if orel == rel and oq.startswith(q + "."):
+                hot.add((orel, oq))
+    return hot
+
+
+# -- device-value tracking --------------------------------------------------
+
+_DEVICE_ROOTS = {"jnp", "jax", "lax"}
+# Calls that PRODUCE host values: the explicit fetch, plus numpy
+# materializations (flagged as HS002 where they convert a device value,
+# but their RESULT is a plain numpy array — downstream float()/int()
+# over it must not cascade into more findings).
+_HOST_CALLS = {
+    "jax.device_get",
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+}
+
+
+def _call_root(node: ast.Call) -> str | None:
+    parts: list = []
+    f = node.func
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _DeviceTracker:
+    """Statement-ordered scan of one function: which local names
+    provably hold device (jax) values right now."""
+
+    def __init__(self):
+        self.device: set = set()
+
+    def expr_is_device(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                root = _call_root(sub)
+                if root in _HOST_CALLS:
+                    return False
+                if root and root.split(".")[0] in _DEVICE_ROOTS:
+                    return True
+            elif (
+                isinstance(sub, ast.Name)
+                and isinstance(sub.ctx, ast.Load)
+                and sub.id in self.device
+            ):
+                return True
+        return False
+
+    def note_assign(self, targets, value) -> None:
+        names = [
+            t.id for t in targets if isinstance(t, ast.Name)
+        ]
+        if not names:
+            return
+        if self.expr_is_device(value):
+            self.device.update(names)
+        else:
+            self.device.difference_update(names)
+
+
+def _scan_hot_function(info: _FuncInfo) -> list:
+    mod = info.mod
+    findings: list = []
+    tracker = _DeviceTracker()
+
+    def flag(rule, node, msg):
+        findings.append(
+            Finding(rule, mod.relpath, node.lineno, node.col_offset, msg)
+        )
+
+    def scan_expr(node):
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "item"
+                and not sub.args
+                and not sub.keywords
+            ):
+                flag(
+                    "HS001",
+                    sub,
+                    "'.item()' in a hot-path function is a blocking "
+                    "per-scalar device sync; fetch in bulk with "
+                    "jax.device_get",
+                )
+                continue
+            root = _call_root(sub)
+            if root in ("np.asarray", "np.array", "numpy.asarray",
+                        "numpy.array"):
+                if sub.args and tracker.expr_is_device(sub.args[0]):
+                    flag(
+                        "HS002",
+                        sub,
+                        f"'{root}' over a device value in a hot-path "
+                        "function is an implicit transfer; use "
+                        "jax.device_get at a deliberate fetch point",
+                    )
+            elif root in ("float", "int", "bool"):
+                if sub.args and tracker.expr_is_device(sub.args[0]):
+                    flag(
+                        "HS003",
+                        sub,
+                        f"'{root}()' over a device value in a hot-path "
+                        "function is an implicit scalar sync",
+                    )
+
+    def scan_block(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not _def_has_marker(mod, stmt, SYNC_OK_RE):
+                    scan_block(stmt.body)
+                continue
+            if isinstance(stmt, ast.Assign):
+                scan_expr(stmt.value)
+                tracker.note_assign(stmt.targets, stmt.value)
+                continue
+            if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                if stmt.value is not None:
+                    scan_expr(stmt.value)
+                    tracker.note_assign([stmt.target], stmt.value)
+                continue
+            # compound statements: scan their expressions, then recurse
+            # (Expr/Return are covered by the 'value' field)
+            for field in ("test", "iter", "value", "exc"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, ast.AST):
+                    scan_expr(sub)
+            if isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    scan_expr(item.context_expr)
+            if isinstance(stmt, ast.Match):
+                scan_expr(stmt.subject)
+                for case in stmt.cases:
+                    scan_block(case.body)
+            for block in ("body", "orelse", "finalbody"):
+                inner = getattr(stmt, block, None)
+                if inner:
+                    scan_block(inner)
+            for handler in getattr(stmt, "handlers", ()):
+                scan_block(handler.body)
+
+    if _def_has_marker(mod, info.node, SYNC_OK_RE):
+        return findings
+    scan_block(info.node.body)
+    return findings
+
+
+# -- tracer leaks -----------------------------------------------------------
+
+
+def _is_jit_decorated(fn) -> bool:
+    for dec in fn.decorator_list:
+        try:
+            if _JIT_RE.search(ast.unparse(dec)):
+                return True
+        except Exception:  # pragma: no cover
+            continue
+    return False
+
+
+def _scan_tracer_leaks(mod: Module) -> list:
+    findings: list = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _is_jit_decorated(node):
+            continue
+        globals_declared: set = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Global):
+                globals_declared.update(sub.names)
+        for sub in ast.walk(node):
+            targets = []
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+            elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+                targets = [sub.target]
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    findings.append(
+                        Finding(
+                            "TL001",
+                            mod.relpath,
+                            t.lineno,
+                            t.col_offset,
+                            f"store to 'self.{t.attr}' inside "
+                            f"jit-decorated '{node.name}' leaks a "
+                            "traced value past its trace",
+                        )
+                    )
+                elif isinstance(t, ast.Name) and t.id in globals_declared:
+                    findings.append(
+                        Finding(
+                            "TL002",
+                            mod.relpath,
+                            t.lineno,
+                            t.col_offset,
+                            f"store to global '{t.id}' inside "
+                            f"jit-decorated '{node.name}' leaks a "
+                            "traced value past its trace",
+                        )
+                    )
+    return findings
+
+
+# -- entry ------------------------------------------------------------------
+
+
+def check(
+    pkg: Package,
+    cfg: Config,
+    host_sync: bool = True,
+    tracer_leak: bool = True,
+) -> list:
+    findings: list = []
+    if host_sync:
+        all_funcs, edges = _build_graph(pkg)
+        hot = _hot_set(pkg, cfg, all_funcs, edges)
+        # scan only OUTERMOST hot functions: nested hot defs are scanned
+        # as part of their parent (scan_block recurses), so scanning
+        # them again would duplicate findings
+        for key in sorted(hot):
+            rel, q = key
+            parent = q.rsplit(".", 1)[0] if "." in q else None
+            if parent and (rel, parent) in hot:
+                continue
+            findings.extend(_scan_hot_function(all_funcs[key]))
+    if tracer_leak:
+        for mod in pkg.modules:
+            findings.extend(_scan_tracer_leaks(mod))
+    return findings

@@ -1,0 +1,164 @@
+"""JX001/JX002: jax private and version-moved API gate.
+
+- **JX001** — any import of (or attribute reach into) ``jax._src`` or
+  ``jax.interpreters``. These are private namespaces with no stability
+  contract; every jax upgrade this repo has lived through broke at least
+  one of them (the ring/ulysses/mesh-flash collection errors at seed).
+  Hard error everywhere, including the compat module: the shims wrap
+  MOVED public symbols, they do not launder private ones.
+- **JX002** — direct use of a version-moved symbol (configured in
+  ``[tool.tfoslint] moved_jax_symbols``; today: ``shard_map``, which is
+  top-level ``jax.shard_map`` on new jax and
+  ``jax.experimental.shard_map.shard_map`` on 0.4.x). Either spelling
+  outside ``utils/compat.py`` is an error — call sites must import the
+  guarded shim so one module owns the version probe and the fallback.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tensorflowonspark_tpu.analysis.core import Config, Finding, Module, Package
+
+__all__ = ["check"]
+
+_PRIVATE_PREFIXES = ("jax._src", "jax.interpreters")
+
+
+def _is_private(module: str) -> bool:
+    return any(
+        module == p or module.startswith(p + ".")
+        for p in _PRIVATE_PREFIXES
+    )
+
+
+def _attr_chain(node: ast.AST) -> str | None:
+    """Dotted name for ``a.b.c`` attribute chains rooted at a Name."""
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _moved_paths(sym: str) -> set:
+    """Every dotted spelling of a moved symbol we refuse outside compat.
+
+    ``sym`` is jax-relative: ``shard_map`` covers top-level
+    ``jax.shard_map`` plus the legacy ``jax.experimental.shard_map``
+    module (and its re-exported function); a dotted ``lax.axis_size``
+    covers ``jax.lax.axis_size``.
+    """
+    paths = {f"jax.{sym}"}
+    if "." not in sym:
+        paths.add(f"jax.experimental.{sym}")
+        paths.add(f"jax.experimental.{sym}.{sym}")
+    return paths
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, mod: Module, cfg: Config, is_compat: bool):
+        self.mod = mod
+        self.moved = {
+            sym: _moved_paths(sym) for sym in cfg.moved_jax_symbols
+        }
+        self.is_compat = is_compat
+        self.findings: list = []
+
+    def _flag(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(
+            Finding(rule, self.mod.relpath, node.lineno, node.col_offset, msg)
+        )
+
+    def _check_module_path(self, node: ast.AST, module: str) -> None:
+        if _is_private(module):
+            self._flag(
+                "JX001",
+                node,
+                f"import of private jax namespace '{module}' (no "
+                "stability contract; route through utils/compat.py "
+                "public-API shims)",
+            )
+        elif not self.is_compat:
+            for sym, paths in self.moved.items():
+                if module in paths:
+                    self._flag(
+                        "JX002",
+                        node,
+                        f"version-moved jax symbol '{sym}' imported "
+                        "directly; import it from "
+                        "tensorflowonspark_tpu.utils.compat",
+                    )
+                    return
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            self._check_module_path(node, alias.name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.module and node.level == 0:
+            for alias in node.names:
+                full = f"{node.module}.{alias.name}"
+                if _is_private(full):
+                    self._flag(
+                        "JX001",
+                        node,
+                        f"import of private jax namespace '{full}' (no "
+                        "stability contract; route through "
+                        "utils/compat.py public-API shims)",
+                    )
+                    return
+                if not self.is_compat:
+                    for sym, paths in self.moved.items():
+                        if full in paths:
+                            self._flag(
+                                "JX002",
+                                node,
+                                f"version-moved jax symbol '{sym}' "
+                                "imported directly; import it from "
+                                "tensorflowonspark_tpu.utils.compat",
+                            )
+                            return
+            self._check_module_path(node, node.module)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        chain = _attr_chain(node)
+        if chain:
+            if _is_private(chain):
+                self._flag(
+                    "JX001",
+                    node,
+                    f"attribute reach into private jax namespace "
+                    f"'{chain}'",
+                )
+                return  # one finding per chain, not per sub-attribute
+            if not self.is_compat:
+                for sym, paths in self.moved.items():
+                    # `lax.axis_size` (a dotted sym used through
+                    # `from jax import lax`) matches with or without
+                    # the leading `jax.`
+                    if chain in paths or ("." in sym and chain == sym):
+                        self._flag(
+                            "JX002",
+                            node,
+                            f"version-moved jax symbol '{chain}' used "
+                            "directly; use "
+                            "tensorflowonspark_tpu.utils.compat."
+                            f"{sym.rsplit('.', 1)[-1]}",
+                        )
+                        return
+        self.generic_visit(node)
+
+
+def check(pkg: Package, cfg: Config) -> list:
+    findings: list = []
+    for mod in pkg.modules:
+        checker = _Checker(mod, cfg, mod.relpath == cfg.compat_module)
+        checker.visit(mod.tree)
+        findings.extend(checker.findings)
+    return findings

@@ -1,0 +1,247 @@
+"""LK001: guarded attributes must be accessed under their lock.
+
+Convention (docs/STATIC_ANALYSIS.md): the assignment that INTRODUCES a
+piece of shared mutable state carries a trailing comment naming the lock
+that guards it::
+
+    self._closed = False  # guarded-by: self._submit_lock
+    _ring_cache: dict = {}  # guarded-by: _ring_cache_lock
+
+From then on, every lexical read or write of that attribute anywhere in
+the module must sit inside a ``with <that lock>:`` block. The check is
+LEXICAL (the ISSUE's commit-time bar), deliberately so: it cannot prove
+the lock is the right one, but it catches the overwhelmingly common race
+shape — a new call site touching shared state without taking the lock —
+at parse time, with zero runtime cost.
+
+Escapes, in order of preference:
+
+- fix the call site (take the lock);
+- ``# lint: holds-lock`` on the ``def`` line of a function whose CALLERS
+  always hold the lock (callee of a locked region);
+- a baseline entry with a justification (reserved for the serving
+  engine's deliberate lock-free hot-path reads).
+
+Scoping rules: the function containing the annotation (normally
+``__init__``, where the object is not yet published) is exempt, as is
+module top-level code for module-global guards (imports are
+single-threaded). Guarded attributes are matched by NAME within their
+module, and the lock requirement follows the accessing expression's
+base: ``self._series`` needs ``with self._lock``, ``m._series`` needs
+``with m._lock`` — so cross-object access in the same module (the
+registry render path) checks correctly.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tensorflowonspark_tpu.analysis.core import Finding, Module, Package
+
+GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+HOLDS_RE = re.compile(r"#\s*lint:\s*holds-lock\b")
+
+__all__ = ["check", "GUARD_RE", "HOLDS_RE"]
+
+
+def _stmt_comment(mod: Module, node: ast.stmt, pattern: re.Pattern):
+    """First match of ``pattern`` in a comment on any line the statement
+    spans (trailing same-line comments are the convention; a multiline
+    assignment may carry it on any of its lines)."""
+    end = getattr(node, "end_lineno", node.lineno) or node.lineno
+    for line in range(node.lineno, end + 1):
+        c = mod.comments.get(line)
+        if c:
+            m = pattern.search(c)
+            if m:
+                return m
+    return None
+
+
+def _def_has_marker(mod: Module, fn: ast.AST, pattern: re.Pattern) -> bool:
+    """Marker comment anywhere between the ``def`` line and the first
+    body statement (covers multi-line signatures)."""
+    stop = fn.body[0].lineno if fn.body else fn.lineno
+    for line in range(fn.lineno, stop + 1):
+        c = mod.comments.get(line)
+        if c and pattern.search(c):
+            return True
+    return False
+
+
+class _GuardCollector(ast.NodeVisitor):
+    """Pass 1: find ``# guarded-by`` annotations.
+
+    attr_guards: {attr_name: (lock_text, annotating_function_node)}
+    global_guards: {name: lock_text} (module top-level assignments)
+    """
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.attr_guards: dict = {}
+        self.global_guards: dict = {}
+        self.findings: list = []
+        self._func_stack: list = []
+
+    def visit_FunctionDef(self, node):
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _targets(self, node):
+        if isinstance(node, (ast.Assign,)):
+            return node.targets
+        return [node.target]  # AnnAssign / AugAssign
+
+    def _handle(self, node):
+        m = _stmt_comment(self.mod, node, GUARD_RE)
+        if not m:
+            return self.generic_visit(node)
+        lock = m.group(1)
+        annotated = False
+        for t in self._targets(node):
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                self.attr_guards[t.attr] = (
+                    lock,
+                    self._func_stack[-1] if self._func_stack else None,
+                )
+                annotated = True
+            elif isinstance(t, ast.Name) and not self._func_stack:
+                self.global_guards[t.id] = lock
+                annotated = True
+        if not annotated:
+            self.findings.append(
+                Finding(
+                    "LK002",
+                    self.mod.relpath,
+                    node.lineno,
+                    node.col_offset,
+                    "guarded-by annotation must sit on a 'self.<attr> = "
+                    "...' or module-level 'name = ...' assignment",
+                )
+            )
+        self.generic_visit(node)
+
+    visit_Assign = _handle
+    visit_AnnAssign = _handle
+    visit_AugAssign = _handle
+
+
+class _AccessChecker(ast.NodeVisitor):
+    """Pass 2: walk with a lexical stack of held locks; flag guarded
+    accesses with no matching ``with`` in scope."""
+
+    def __init__(self, mod: Module, collector: _GuardCollector):
+        self.mod = mod
+        self.c = collector
+        self.findings: list = []
+        self._locks: list = []  # unparsed lock exprs currently held
+        self._exempt_depth = 0  # inside annotating fn or holds-lock fn
+        self._in_function = 0
+
+    # -- scope handling -----------------------------------------------
+
+    def _visit_fn(self, node):
+        exempt = _def_has_marker(self.mod, node, HOLDS_RE) or any(
+            node is fn for _, fn in self.c.attr_guards.values()
+        )
+        self._exempt_depth += exempt
+        self._in_function += 1
+        # A nested def/lambda does NOT inherit the enclosing with-blocks:
+        # its body runs when the function is CALLED, by which time the
+        # lock is long released — the register-a-callback-under-lock
+        # shape is exactly the deferred race this checker exists for.
+        held, self._locks = self._locks, []
+        self.generic_visit(node)
+        self._locks = held
+        self._in_function -= 1
+        self._exempt_depth -= exempt
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Lambda(self, node):
+        held, self._locks = self._locks, []
+        self._in_function += 1
+        self.generic_visit(node)
+        self._in_function -= 1
+        self._locks = held
+
+    def visit_With(self, node):
+        held = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+            try:
+                held.append(ast.unparse(item.context_expr))
+            except Exception:  # pragma: no cover - unparse is total
+                pass
+        self._locks.extend(held)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self._locks[len(self._locks) - len(held):]
+
+    visit_AsyncWith = visit_With
+
+    # -- accesses ------------------------------------------------------
+
+    def _flag(self, node, attr, required):
+        self.findings.append(
+            Finding(
+                "LK001",
+                self.mod.relpath,
+                node.lineno,
+                node.col_offset,
+                f"access of '{attr}' (guarded-by {required}) outside "
+                f"'with {required}:'",
+            )
+        )
+
+    def visit_Attribute(self, node):
+        guard = self.c.attr_guards.get(node.attr)
+        if guard is not None and self._exempt_depth == 0:
+            lock, _fn = guard
+            base = ast.unparse(node.value)
+            required = (
+                f"{base}.{lock.split('.', 1)[1]}"
+                if lock.startswith("self.")
+                else lock
+            )
+            if required not in self._locks:
+                self._flag(node, f"{base}.{node.attr}", required)
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        lock = self.c.global_guards.get(node.id)
+        if (
+            lock is not None
+            and self._exempt_depth == 0
+            and self._in_function  # module top level is import-time
+            and lock not in self._locks
+        ):
+            self._flag(node, node.id, lock)
+        self.generic_visit(node)
+
+
+def check(pkg: Package) -> list:
+    findings: list = []
+    for mod in pkg.modules:
+        collector = _GuardCollector(mod)
+        collector.visit(mod.tree)
+        findings.extend(collector.findings)
+        if not collector.attr_guards and not collector.global_guards:
+            continue
+        checker = _AccessChecker(mod, collector)
+        # skip the annotation statements themselves for global guards:
+        # handled by exempting module top-level Name accesses.
+        checker.visit(mod.tree)
+        findings.extend(checker.findings)
+    return findings

@@ -277,7 +277,7 @@ def _start_ring_drain(
 
 # Producer-side cache: one ring handle per advertised name, shared by all
 # driver threads so pushes are serialized by the handle's lock.
-_ring_cache: dict[str, Any] = {}
+_ring_cache: dict[str, Any] = {}  # guarded-by: _ring_cache_lock
 _ring_cache_lock = threading.Lock()
 
 
@@ -527,7 +527,8 @@ def _push_end_of_feed(
     ``must_deliver=True`` raises on a push timeout: a dropped marker means
     the consumer never sees end-of-stream and blocks forever.
     """
-    ring = _ring_cache.get(node.get("shm_ring") or "")
+    with _ring_cache_lock:
+        ring = _ring_cache.get(node.get("shm_ring") or "")
     for qname in qnames:
         try:
             if ring is not None:

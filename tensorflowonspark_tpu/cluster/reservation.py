@@ -38,7 +38,7 @@ class Reservations:
     def __init__(self, required: int):
         self.required = required
         self._lock = threading.RLock()
-        self._reservations: list[dict[str, Any]] = []
+        self._reservations: list[dict[str, Any]] = []  # guarded-by: self._lock
 
     def add(self, meta: dict[str, Any]) -> None:
         with self._lock:

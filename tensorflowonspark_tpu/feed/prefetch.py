@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator
 
 from tensorflowonspark_tpu.compute.mesh import shard_batch
@@ -58,10 +59,26 @@ class DevicePrefetcher:
         self._transform = transform
         self._queue: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
+        # Cross-thread stats: the producer thread writes, consumers read
+        # via stats() — the "is the input plane keeping up" numbers next
+        # to the feed.transfer/feed.data_wait spans.
+        self._lock = threading.Lock()
+        self._transferred = 0  # guarded-by: self._lock
+        self._transfer_s = 0.0  # guarded-by: self._lock
         self._thread = threading.Thread(
             target=self._run, args=(iter(host_batches),), daemon=True
         )
         self._thread.start()
+
+    def stats(self) -> dict:
+        """Producer-side counters: batches transferred to device and
+        total transfer seconds (divide for the mean transfer cost this
+        prefetcher is hiding). Safe from any thread."""
+        with self._lock:
+            return {
+                "transferred": self._transferred,
+                "transfer_s": self._transfer_s,
+            }
 
     def _run(self, it: Iterator[Any]) -> None:
         try:
@@ -71,8 +88,12 @@ class DevicePrefetcher:
                 # host->device transfer time, on the producer thread —
                 # beside feed.data_wait it answers "is the input plane
                 # keeping up or is the consumer starving"
+                t0 = time.perf_counter()
                 with obs_spans.span("feed.transfer"):
                     item = (self._transform(batch), None)
+                with self._lock:
+                    self._transferred += 1
+                    self._transfer_s += time.perf_counter() - t0
                 while not self._stop.is_set():
                     try:
                         self._queue.put(item, timeout=0.2)

@@ -105,7 +105,7 @@ class _Metric:
         self.name = name
         self.help = help
         self._lock = threading.Lock()
-        self._series: dict[tuple, Any] = {}
+        self._series: dict[tuple, Any] = {}  # guarded-by: self._lock
 
     def _render_series(self) -> "Iterable[str]":  # pragma: no cover
         raise NotImplementedError
@@ -249,8 +249,8 @@ class Registry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: dict[str, _Metric] = {}
-        self._collectors: list[Callable[[], None]] = []
+        self._metrics: dict[str, _Metric] = {}  # guarded-by: self._lock
+        self._collectors: list[Callable[[], None]] = []  # guarded-by: self._lock
 
     def _get_or_create(self, cls, name: str, help: str, **kw):
         with self._lock:

@@ -152,10 +152,11 @@ class SpanTracer:
     def __init__(self, capacity: int = 8192):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
-        self._buf: "deque[Span]" = deque(maxlen=int(capacity))
+        self._buf: "deque[Span]" = deque(maxlen=int(capacity))  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._epoch = _CLOCK()
-        self.recorded = 0  # lifetime spans, including dropped ones
+        # lifetime spans, including dropped ones
+        self.recorded = 0  # guarded-by: self._lock
 
     # -- recording -----------------------------------------------------
 

@@ -21,6 +21,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from tensorflowonspark_tpu.utils import compat
+
 # Test hook: lets CI exercise the TPU-only dispatch decisions (the
 # mesh-flash route below) on the 8-device virtual CPU mesh with the
 # Pallas interpreter. Read only in the un-jitted dispatcher, never inside
@@ -293,7 +295,7 @@ def mesh_flash_attention(
         )
 
     in_specs, args = sp_specs_and_args(spec, q, k, v, segment_ids)
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         body,
         mesh=mesh,
         in_specs=in_specs,

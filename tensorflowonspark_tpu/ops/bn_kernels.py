@@ -48,6 +48,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from tensorflowonspark_tpu.utils import compat
+
 # Test hook: run the kernels in the Pallas interpreter (works on CPU).
 INTERPRET = False
 
@@ -238,7 +240,7 @@ def _mesh_stats(stats_fn, arrays, mesh):
         a, b = stats_fn(*arrs)
         return lax.psum(a, axes), lax.psum(b, axes)
 
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         body,
         mesh=mesh,
         in_specs=(spec,) * len(arrays),

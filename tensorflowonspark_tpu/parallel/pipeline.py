@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tensorflowonspark_tpu.utils import compat
+
 
 def _gpipe_local(
     stage_params: Any,
@@ -45,7 +47,7 @@ def _gpipe_local(
     replicated along the pipe axis. Returns (num_micro, mb, ...) outputs,
     summed-broadcast from the last stage so ``out_specs`` can replicate.
     """
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     params = jax.tree.map(lambda x: x[0], stage_params)
     num_micro = microbatches.shape[0]
@@ -97,7 +99,7 @@ def gpipe(
     """
     param_specs = jax.tree.map(lambda _: P(pipe_axis), stage_params)
     mb_spec = P(None, batch_axes)
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         functools.partial(
             _gpipe_local, stage_fn=stage_fn, axis_name=pipe_axis
         ),

@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tensorflowonspark_tpu.utils import compat
+
 NEG_INF = -1e30
 
 
@@ -135,7 +137,7 @@ def ring_attention(
     scale = (d**-0.5) if scale is None else scale
     qg = q.reshape(b, s_loc, hk, group, d)
 
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     local_pos = jnp.arange(s_loc, dtype=jnp.int32)
     q_pos = idx * s_loc + local_pos
@@ -221,7 +223,7 @@ def mesh_ring_attention(
         window=window,
     )
     in_specs, args = sp_specs_and_args(qspec, q, k, v, segment_ids)
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         body,
         mesh=mesh,
         in_specs=in_specs,

@@ -27,6 +27,8 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tensorflowonspark_tpu.utils import compat
+
 
 def _ulysses_local(
     q: jax.Array,
@@ -123,7 +125,7 @@ def mesh_ulysses_attention(
         window=window,
     )
     in_specs, args = sp_specs_and_args(spec, q, k, v, segment_ids)
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         body,
         mesh=mesh,
         in_specs=in_specs,

@@ -707,7 +707,7 @@ class ContinuousBatcher:
         else:
             self._prefix_store = None
         self._queue: "queue.Queue" = queue.Queue()
-        self._closed = False
+        self._closed = False  # guarded-by: self._submit_lock
         self._stop_now = threading.Event()
         self._submit_lock = threading.Lock()
         self._prefill_cache: dict = {}
@@ -772,7 +772,11 @@ class ContinuousBatcher:
         # individually instead would race the scheduler's pop→park
         # handoffs and let a drain declare "idle" around a request it
         # promised to finish.
-        self._accepted_total = 0
+        self._accepted_total = 0  # guarded-by: self._submit_lock
+        # _failed_total is scheduler-thread-owned (bumped only in
+        # _fail_one on the loop thread); the drain loop in close() reads
+        # it racily by design, like `completed` — deliberately NOT
+        # lock-annotated.
         self._failed_total = 0
         self.tokens_emitted = 0
         self.cancelled = 0  # consumer-abandoned requests (stream close)

@@ -1,0 +1,48 @@
+"""Fixture: seeded HS violations in a function reachable from a hot root.
+
+The test configures ``hot_roots`` to point at :func:`serve_loop`;
+:func:`fetch_scalar` is reachable from it through one call edge, so the
+syncs inside it must be flagged. :func:`cold` is NOT reachable and its
+identical syncs must not be.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def fetch_scalar(logits):
+    probs = jnp.exp(logits)
+    top = probs.max()
+    host = np.asarray(probs)  # SEEDED VIOLATION: HS002 implicit transfer
+    first = int(host[0])  # NOT a violation: host is numpy after asarray
+    return float(top), host, first  # SEEDED VIOLATION: HS003 scalar sync
+
+
+def pick(mode, x):
+    match mode:
+        case "sum":
+            return float(jnp.sum(x))  # SEEDED VIOLATION: HS003 in match arm
+        case _:
+            return 0.0
+
+
+def deliberate(logits):  # lint: sync-ok
+    y = jnp.exp(logits)
+    return float(y.sum())  # suppressed: annotated deliberate fetch point
+
+
+def serve_loop(batches):
+    out = []
+    for b in batches:
+        s, _, _ = fetch_scalar(b)
+        out.append(s)
+        out.append(b.item())  # SEEDED VIOLATION: HS001 .item() in hot path
+        out.append(deliberate(b))
+        out.append(pick("sum", b))
+    return out
+
+
+def cold(logits):
+    y = jnp.exp(logits)
+    return float(y), np.asarray(y), jax.device_get(y)  # not hot: no findings

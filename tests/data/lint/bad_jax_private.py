@@ -1,0 +1,15 @@
+"""Fixture: seeded JX001 (private namespace) and JX002 (moved symbol)."""
+
+from jax._src import core  # SEEDED VIOLATION: private namespace
+
+from jax.experimental.shard_map import shard_map  # SEEDED VIOLATION: moved
+
+
+def use(f, mesh, spec):
+    return shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+
+
+def reach(x):
+    import jax
+
+    return jax.interpreters.ad.f(x)  # SEEDED VIOLATION: private reach

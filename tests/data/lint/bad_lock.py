@@ -1,0 +1,27 @@
+"""Fixture: one seeded LK001 violation (guarded attr outside lock)."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0  # guarded-by: self._lock
+
+    def bump(self) -> None:
+        with self._lock:
+            self._count += 1
+
+    def peek(self) -> int:
+        return self._count  # SEEDED VIOLATION: read outside the lock
+
+    def register(self):
+        with self._lock:
+            def cb():
+                # SEEDED VIOLATION: deferred callback — defined under
+                # the lock but RUNS after it is released
+                self._count += 2
+            return cb
+
+    def holds(self) -> int:  # lint: holds-lock
+        return self._count  # allowlisted: caller holds the lock

@@ -1,0 +1,23 @@
+"""Fixture: seeded TL001/TL002 (traced value stored past its trace)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_last_hidden = None
+
+
+class Model:
+    @functools.partial(jax.jit, static_argnums=0)
+    def forward(self, x):
+        h = jnp.tanh(x)
+        self.hidden = h  # SEEDED VIOLATION: TL001 tracer stored on self
+        return h
+
+    @jax.jit
+    def forward2(x):
+        global _last_hidden
+        h = jnp.tanh(x)
+        _last_hidden = h  # SEEDED VIOLATION: TL002 tracer stored on global
+        return h

@@ -1,0 +1,59 @@
+"""Fixture: exercises every rule's NEIGHBORHOOD without violating any —
+the false-positive regression file. Each construct here is one a naive
+version of the matching rule would flag."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflowonspark_tpu.utils import compat
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: self._lock
+        self._free = 0  # unguarded on purpose: single-thread attr
+
+    def add(self, x) -> None:
+        with self._lock:
+            self._items.append(x)
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._items)
+
+    def bump(self) -> int:
+        self._free += 1  # not annotated, not flagged
+        return self._free
+
+
+def cross_object(a: Guarded, b: Guarded) -> None:
+    # base-aware: each access under ITS object's lock
+    with a._lock:
+        a._items.append(0)
+    with b._lock:
+        b._items.append(1)
+
+
+def uses_compat(f, mesh, spec):
+    # the sanctioned spelling of a moved symbol
+    return compat.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+
+
+def hot_but_clean(batch):
+    # hot root (the test points hot_roots here): explicit fetch + host
+    # math only — no implicit syncs
+    y = jnp.dot(batch, batch)
+    host = jax.device_get(y)  # explicit, not flagged
+    total = float(np.asarray([1.0, 2.0]).sum())  # host values: fine
+    return int(host[0]) + total  # host after device_get: fine
+
+
+@jax.jit
+def pure_step(x):
+    h = jnp.tanh(x)
+    scale = 2.0  # plain local store inside jit: fine
+    return h * scale

@@ -1,0 +1,254 @@
+"""tfoslint: the analyzers themselves, and the whole-package CI gate.
+
+Two layers:
+
+- **Seeded-violation fixtures** (``tests/data/lint/``): one file per
+  rule family with a deliberately planted violation, asserting each is
+  reported with the right rule id AND the right file:line — plus a
+  clean fixture that exercises every rule's neighborhood (locked
+  accesses, compat-shim usage, explicit device_get, plain locals in
+  jit) and must produce ZERO findings.
+- **The package gate** (tier-1, not slow-marked): ``run_lint`` over the
+  real package against the committed baseline must come back with no
+  new violations, inside a 30 s budget — the test the build fails on
+  when someone adds a raw ``jax._src`` import or an unlocked access to
+  a guarded attribute.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tensorflowonspark_tpu.analysis import (
+    Config,
+    load_config,
+    run_lint,
+)
+from tensorflowonspark_tpu.analysis.core import (
+    apply_baseline,
+    load_baseline,
+)
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+FIXTURES = "tests/data/lint"
+
+
+def fixture_cfg(**kw) -> Config:
+    base = dict(
+        paths=(FIXTURES,),
+        baseline=None,
+        hot_roots=(
+            f"{FIXTURES}/bad_hot_sync.py::serve_loop",
+            f"{FIXTURES}/clean.py::hot_but_clean",
+        ),
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    return run_lint(ROOT, fixture_cfg())
+
+
+def _line_of(relfile: str, needle: str) -> int:
+    with open(os.path.join(ROOT, FIXTURES, relfile)) as f:
+        for i, line in enumerate(f, 1):
+            if needle in line:
+                return i
+    raise AssertionError(f"{needle!r} not found in {relfile}")
+
+
+def by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# -- each rule reports its seeded violation with file:line ------------------
+
+
+def test_lock_rule_reports_seeded_violation(fixture_findings):
+    hits = by_rule(fixture_findings, "LK001")
+    assert len(hits) == 2, [f.render() for f in hits]
+    assert all(f.path == f"{FIXTURES}/bad_lock.py" for f in hits)
+    assert {f.line for f in hits} == {
+        # plain read outside the lock
+        _line_of("bad_lock.py", "SEEDED VIOLATION"),
+        # deferred callback: defined under the lock, RUNS without it
+        _line_of("bad_lock.py", "self._count += 2"),
+    }
+    assert all(
+        "_count" in f.message and "self._lock" in f.message for f in hits
+    )
+
+
+def test_jax_private_rule_reports_import_and_reach(fixture_findings):
+    hits = by_rule(fixture_findings, "JX001")
+    paths = {(f.path, f.line) for f in hits}
+    rel = f"{FIXTURES}/bad_jax_private.py"
+    assert (rel, _line_of("bad_jax_private.py", "from jax._src")) in paths
+    assert (
+        rel,
+        _line_of("bad_jax_private.py", "jax.interpreters.ad"),
+    ) in paths
+
+
+def test_jax_moved_symbol_rule(fixture_findings):
+    hits = by_rule(fixture_findings, "JX002")
+    assert hits, "moved-symbol import not flagged"
+    assert all(f.path == f"{FIXTURES}/bad_jax_private.py" for f in hits)
+    assert {f.line for f in hits} == {
+        _line_of("bad_jax_private.py", "from jax.experimental.shard_map")
+    }
+    assert "compat" in hits[0].message
+
+
+def test_hot_sync_rules_report_item_transfer_scalar(fixture_findings):
+    rel = f"{FIXTURES}/bad_hot_sync.py"
+    for rule, needles in [
+        ("HS001", [".item()"]),
+        ("HS002", ["np.asarray(probs)"]),
+        # float(top) on a device value; float(jnp.sum(x)) in a match arm
+        ("HS003", ["float(top)", "float(jnp.sum(x))"]),
+    ]:
+        hits = by_rule(fixture_findings, rule)
+        assert all(f.path == rel for f in hits), [f.render() for f in hits]
+        assert {f.line for f in hits} == {
+            _line_of("bad_hot_sync.py", n) for n in needles
+        }, (rule, [f.render() for f in hits])
+
+
+def test_numpy_result_does_not_cascade(fixture_findings):
+    """np.asarray(device) flags once (HS002); float()/int() over the
+    RESULTING numpy value must not produce follow-on findings."""
+    line = _line_of("bad_hot_sync.py", "int(host[0])")
+    assert not [f for f in fixture_findings if f.line == line]
+
+
+def test_cold_function_not_flagged(fixture_findings):
+    cold_line = _line_of("bad_hot_sync.py", "def cold")
+    assert not [
+        f
+        for f in fixture_findings
+        if f.path.endswith("bad_hot_sync.py") and f.line > cold_line
+    ], "unreachable function's syncs must not be flagged"
+
+
+def test_sync_ok_suppression(fixture_findings):
+    line = _line_of("bad_hot_sync.py", "float(y.sum())")
+    assert not [f for f in fixture_findings if f.line == line]
+
+
+def test_tracer_leak_rules(fixture_findings):
+    rel = f"{FIXTURES}/bad_tracer_leak.py"
+    (tl1,) = by_rule(fixture_findings, "TL001")
+    assert (tl1.path, tl1.line) == (
+        rel,
+        _line_of("bad_tracer_leak.py", "self.hidden = h"),
+    )
+    (tl2,) = by_rule(fixture_findings, "TL002")
+    assert (tl2.path, tl2.line) == (
+        rel,
+        _line_of("bad_tracer_leak.py", "_last_hidden = h"),
+    )
+
+
+def test_clean_fixture_zero_false_positives(fixture_findings):
+    noise = [f for f in fixture_findings if f.path.endswith("clean.py")]
+    assert not noise, [f.render() for f in noise]
+
+
+def test_holds_lock_allowlist(fixture_findings):
+    line = _line_of("bad_lock.py", "allowlisted")
+    assert not [f for f in fixture_findings if f.line == line]
+
+
+# -- rule toggles + baseline mechanics --------------------------------------
+
+
+def test_rule_toggle_disables_family():
+    findings = run_lint(ROOT, fixture_cfg(rules=("JX",)))
+    assert findings and all(f.rule.startswith("JX") for f in findings)
+
+
+def test_baseline_roundtrip(tmp_path, fixture_findings):
+    from tensorflowonspark_tpu.analysis.core import write_baseline
+
+    path = str(tmp_path / "baseline.json")
+    write_baseline(path, fixture_findings)
+    new, suppressed, stale = apply_baseline(
+        fixture_findings, load_baseline(path)
+    )
+    assert not new and not stale
+    assert len(suppressed) == len(fixture_findings)
+    # one extra finding of a baselined kind must NOT be absorbed
+    extra = fixture_findings + [fixture_findings[0]]
+    new, _, _ = apply_baseline(extra, load_baseline(path))
+    assert len(new) == 1
+
+
+# -- the package gate (the actual CI check) ---------------------------------
+
+
+def test_package_lint_clean_against_baseline():
+    t0 = time.monotonic()
+    cfg = load_config(ROOT)
+    findings = run_lint(ROOT, cfg)
+    baseline = load_baseline(os.path.join(ROOT, cfg.baseline))
+    new, _suppressed, stale = apply_baseline(findings, baseline)
+    elapsed = time.monotonic() - t0
+    assert not new, (
+        "NEW lint violations (fix them or, for a serving hot-path read "
+        "with a justification, baseline them):\n"
+        + "\n".join(f.render() for f in new)
+    )
+    assert not stale, f"stale baseline entries (shrink the baseline): {stale}"
+    assert elapsed < 30, f"lint run took {elapsed:.1f}s (budget 30s)"
+
+
+def test_engine_baseline_entries_are_justified():
+    """Dogfood rule: baseline entries are allowed only for serving-
+    engine hot-path reads, and each must carry a justification."""
+    cfg = load_config(ROOT)
+    with open(os.path.join(ROOT, cfg.baseline)) as f:
+        entries = json.load(f)["entries"]
+    for e in entries:
+        assert e["path"] == "tensorflowonspark_tpu/serving/engine.py", e
+        assert e["rule"].startswith("LK"), e
+        assert e.get("justification", "").strip(), (
+            f"baseline entry without justification: {e}"
+        )
+
+
+def test_cli_entrypoint_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tfoslint.py"),
+         "tensorflowonspark_tpu/"],
+        cwd=ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "clean" in proc.stdout
+
+
+def test_cli_flags_seeded_violation_with_location(tmp_path):
+    bad = tmp_path / "fresh_violation.py"
+    bad.write_text(
+        "from jax._src import core\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tfoslint.py"),
+         "--no-baseline", str(bad)],
+        cwd=ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+    assert "JX001" in proc.stdout
+    assert ":1:" in proc.stdout  # file:line in the report

@@ -1,0 +1,230 @@
+"""ASan/UBSan tier for the C++ feed path, beside the TSAN tier
+(``test_native_tsan.py``): TSAN owns data races; this tier owns memory
+errors (heap overflow/use-after-free in the ring's wraparound arithmetic
+and the record codec's header handling) and undefined behaviour
+(misaligned/overflowing size math — exactly where a length-prefixed
+binary format goes wrong).
+
+Same mechanics as the TSAN tier: build a sanitized copy of the native
+sources, LD_PRELOAD the runtimes (the sanitizer must own the process
+from exec), drive through ctypes in a subprocess, and fail on any
+sanitizer report. ``detect_leaks=0`` because CPython itself holds
+allocations to exit — leak checking a python process is all noise.
+
+The stress driver targets the two spots the sanitizers can actually
+bite:
+
+- **shmring wraparound**: a deliberately small ring with mixed-size
+  payloads (including ring-capacity-straddling ones) so the ring wraps
+  hundreds of times mid-record, while a consumer pops concurrently.
+- **tfrecord parsing**: write/readback of thousands of records with
+  adversarial sizes (0-length, 1-byte, header-multiple, large), then an
+  index scan, then parsing a TRUNCATED copy — the error path where a
+  stale length field could drive an out-of-bounds read.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.e2e, pytest.mark.slow]
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "tensorflowonspark_tpu", "native"
+)
+
+DRIVER = r"""
+import ctypes, os, sys, threading
+
+lib = ctypes.CDLL(sys.argv[1])
+workdir = sys.argv[2]
+c = ctypes
+
+# -- shmring bindings ------------------------------------------------------
+lib.shmring_create.restype = c.c_void_p
+lib.shmring_create.argtypes = [c.c_char_p, c.c_uint64]
+lib.shmring_open.restype = c.c_void_p
+lib.shmring_open.argtypes = [c.c_char_p]
+lib.shmring_push.restype = c.c_int
+lib.shmring_push.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64, c.c_int64]
+lib.shmring_pop.restype = c.c_int64
+lib.shmring_pop.argtypes = [c.c_void_p, c.POINTER(c.c_uint8), c.c_uint64]
+lib.shmring_peek_len.restype = c.c_int64
+lib.shmring_peek_len.argtypes = [c.c_void_p, c.c_int64]
+lib.shmring_close_write.restype = None
+lib.shmring_close_write.argtypes = [c.c_void_p]
+lib.shmring_detach.restype = None
+lib.shmring_detach.argtypes = [c.c_void_p]
+lib.shmring_unlink.restype = c.c_int
+lib.shmring_unlink.argtypes = [c.c_char_p]
+
+NAME = b"/tfos_asan_test"
+N = 1500
+lib.shmring_unlink(NAME)
+cons = lib.shmring_create(NAME, 1 << 14)  # 16 KiB: wrap constantly
+assert cons
+prod = lib.shmring_open(NAME)
+assert prod
+
+# mixed sizes, several close to the ring capacity so records straddle
+# the wrap point in every alignment
+sizes = [1, 7, 64, 1000, 4093, 9001, 15000]
+
+def produce():
+    for i in range(N):
+        payload = bytes([i % 251]) * sizes[i % len(sizes)]
+        rc = lib.shmring_push(prod, payload, len(payload), 20_000)
+        assert rc == 0, rc
+    lib.shmring_close_write(prod)
+
+t = threading.Thread(target=produce)
+t.start()
+got = 0
+while True:
+    n = lib.shmring_peek_len(cons, 20_000)
+    if n == -2:  # closed and drained
+        break
+    assert n > 0, n
+    buf = (c.c_uint8 * n)()
+    m = lib.shmring_pop(cons, buf, n)
+    assert m == n, (m, n)
+    expect = (got % 251)
+    assert buf[0] == expect and buf[n - 1] == expect, (got, n)
+    got += 1
+t.join()
+assert got == N, (got, N)
+lib.shmring_detach(prod)
+lib.shmring_detach(cons)
+lib.shmring_unlink(NAME)
+
+# -- tfrecord bindings -----------------------------------------------------
+lib.tfr_writer_open.restype = c.c_void_p
+lib.tfr_writer_open.argtypes = [c.c_char_p]
+lib.tfr_writer_append.restype = c.c_int
+lib.tfr_writer_append.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+lib.tfr_writer_close.restype = c.c_int
+lib.tfr_writer_close.argtypes = [c.c_void_p]
+lib.tfr_reader_open.restype = c.c_void_p
+lib.tfr_reader_open.argtypes = [c.c_char_p]
+lib.tfr_reader_next.restype = c.c_int64
+lib.tfr_reader_next.argtypes = [
+    c.c_void_p, c.POINTER(c.POINTER(c.c_uint8)), c.POINTER(c.c_int)
+]
+lib.tfr_reader_close.restype = None
+lib.tfr_reader_close.argtypes = [c.c_void_p]
+lib.tfr_index_file.restype = c.c_int64
+lib.tfr_index_file.argtypes = [c.c_char_p, c.POINTER(c.POINTER(c.c_uint64))]
+lib.tfr_index_free.restype = None
+lib.tfr_index_free.argtypes = [c.POINTER(c.c_uint64)]
+
+path = os.path.join(workdir, "stress.tfrecord").encode()
+w = lib.tfr_writer_open(path)
+assert w
+rec_sizes = [0, 1, 11, 12, 4096, 70000]
+M = 3000
+for i in range(M):
+    payload = bytes([i % 250]) * rec_sizes[i % len(rec_sizes)]
+    rc = lib.tfr_writer_append(w, payload, len(payload))
+    assert rc == 0, rc
+assert lib.tfr_writer_close(w) == 0
+
+r = lib.tfr_reader_open(path)
+assert r
+out = c.POINTER(c.c_uint8)()
+ok = c.c_int()
+seen = 0
+while True:
+    n = lib.tfr_reader_next(r, c.byref(out), c.byref(ok))
+    if not ok.value:
+        assert n == 0, n  # clean EOF
+        break
+    expect_len = rec_sizes[seen % len(rec_sizes)]
+    assert n == expect_len, (seen, n, expect_len)
+    if n:
+        assert out[0] == seen % 250 and out[n - 1] == seen % 250
+    seen += 1
+assert seen == M, (seen, M)
+lib.tfr_reader_close(r)
+
+idx = c.POINTER(c.c_uint64)()
+cnt = lib.tfr_index_file(path, c.byref(idx))
+assert cnt == M, cnt
+total = sum(rec_sizes[i % len(rec_sizes)] for i in range(M))
+assert sum(idx[2 * i + 1] for i in range(M)) == total
+lib.tfr_index_free(idx)
+
+# truncated-file error path: a stale length header must produce an
+# error code, not an out-of-bounds read
+data = open(path, "rb").read()
+trunc = os.path.join(workdir, "trunc.tfrecord").encode()
+open(trunc, "wb").write(data[: len(data) - 7])
+r = lib.tfr_reader_open(trunc)
+assert r
+while True:
+    n = lib.tfr_reader_next(r, c.byref(out), c.byref(ok))
+    if not ok.value:
+        assert n in (0, -4), n  # clean EOF or truncated-record error
+        break
+lib.tfr_reader_close(r)
+
+print("ASAN_DRIVER_OK")
+"""
+
+
+def _runtime(name: str):
+    try:
+        out = subprocess.run(
+            ["g++", f"-print-file-name={name}"],
+            capture_output=True,
+            text=True,
+            check=True,
+        ).stdout.strip()
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    # g++ echoes the bare name back when the runtime is not installed
+    return out if os.path.isabs(out) and os.path.exists(out) else None
+
+
+@pytest.fixture(scope="module")
+def asan_lib(tmp_path_factory):
+    if _runtime("libasan.so") is None or _runtime("libubsan.so") is None:
+        pytest.skip("libasan/libubsan not available")
+    lib_path = str(tmp_path_factory.mktemp("asan") / "libtfos_asan.so")
+    srcs = [
+        os.path.join(NATIVE_DIR, s) for s in ("tfrecord.cc", "shmring.cc")
+    ]
+    subprocess.run(
+        ["g++", "-O1", "-g", "-std=c++17", "-shared", "-fPIC",
+         "-fsanitize=address,undefined",
+         "-fno-sanitize-recover=undefined",
+         *srcs, "-o", lib_path, "-lrt", "-pthread"],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+    return lib_path
+
+
+def test_shmring_wraparound_and_tfrecord_parse_asan_clean(
+    asan_lib, tmp_path
+):
+    driver = tmp_path / "driver.py"
+    driver.write_text(DRIVER)
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = f"{_runtime('libasan.so')} {_runtime('libubsan.so')}"
+    # leak detection off: CPython exits with live allocations by design
+    env["ASAN_OPTIONS"] = "detect_leaks=0:abort_on_error=1:exitcode=66"
+    env["UBSAN_OPTIONS"] = "print_stacktrace=1:halt_on_error=1"
+    proc = subprocess.run(
+        [sys.executable, str(driver), asan_lib, str(tmp_path)],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=300,
+    )
+    assert "ASAN_DRIVER_OK" in proc.stdout, (proc.stdout, proc.stderr[-3000:])
+    assert "ERROR: AddressSanitizer" not in proc.stderr, proc.stderr[-5000:]
+    assert "runtime error:" not in proc.stderr, proc.stderr[-5000:]
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-3000:])

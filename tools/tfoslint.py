@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""tfoslint CLI — run the repo's static-analysis pass.
+
+Usage (from the repo root)::
+
+    python tools/tfoslint.py tensorflowonspark_tpu/
+    python tools/tfoslint.py --write-baseline        # refresh baseline
+    python tools/tfoslint.py --no-baseline path.py   # see everything
+
+Exit codes: 0 clean (or only baselined findings), 1 new violations,
+2 usage error. Configuration: ``[tool.tfoslint]`` in pyproject.toml;
+conventions: docs/STATIC_ANALYSIS.md.
+"""
+
+import os
+import sys
+import types
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# The analyzers are stdlib-only, but importing them the normal way would
+# execute tensorflowonspark_tpu/__init__.py — ~8 s of jax/flax imports a
+# lint run never uses. Register a stub parent package (just a __path__)
+# so `tensorflowonspark_tpu.analysis` resolves without the heavy
+# top-level import; the CLI stays sub-second.
+if "tensorflowonspark_tpu" not in sys.modules:
+    _stub = types.ModuleType("tensorflowonspark_tpu")
+    _stub.__path__ = [os.path.join(_REPO_ROOT, "tensorflowonspark_tpu")]
+    sys.modules["tensorflowonspark_tpu"] = _stub
+
+from tensorflowonspark_tpu.analysis import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
