@@ -36,7 +36,7 @@ __all__ = [
     "run_lint",
 ]
 
-DEFAULT_RULES = ("LK", "JX", "HS", "TL")
+DEFAULT_RULES = ("LK", "JX", "HS", "TL", "FP")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,6 +61,7 @@ class Config:
     rules: tuple = DEFAULT_RULES
     # LK/JX/HS knobs (see each analyzer module)
     compat_module: str = "tensorflowonspark_tpu/utils/compat.py"
+    failpoints_module: str = "tensorflowonspark_tpu/utils/failpoints.py"
     moved_jax_symbols: tuple = ("shard_map", "lax.axis_size")
     hot_roots: tuple = (
         "tensorflowonspark_tpu/serving/engine.py::ContinuousBatcher._loop",
@@ -164,6 +165,8 @@ def load_config(root: str, pyproject: str | None = None) -> Config:
         cfg.rules = tuple(section["rules"])
     if "compat_module" in section:
         cfg.compat_module = section["compat_module"]
+    if "failpoints_module" in section:
+        cfg.failpoints_module = section["failpoints_module"]
     if "moved_jax_symbols" in section:
         cfg.moved_jax_symbols = tuple(section["moved_jax_symbols"])
     if "hot_roots" in section:
@@ -261,7 +264,12 @@ def parse_package(root: str, cfg: Config) -> tuple:
 def run_lint(root: str, cfg: Config) -> list:
     """Run every enabled analyzer over the package; findings sorted by
     (path, line, rule)."""
-    from tensorflowonspark_tpu.analysis import hostsync, jaxapi, locks
+    from tensorflowonspark_tpu.analysis import (
+        failpoints as fp_rule,
+        hostsync,
+        jaxapi,
+        locks,
+    )
 
     pkg, findings = parse_package(root, cfg)
     enabled = set(cfg.rules)
@@ -269,6 +277,8 @@ def run_lint(root: str, cfg: Config) -> list:
         findings.extend(locks.check(pkg))
     if "JX" in enabled:
         findings.extend(jaxapi.check(pkg, cfg))
+    if "FP" in enabled:
+        findings.extend(fp_rule.check(pkg, cfg))
     if {"HS", "TL"} & enabled:
         findings.extend(
             hostsync.check(

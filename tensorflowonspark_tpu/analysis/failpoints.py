@@ -1,0 +1,143 @@
+"""FP001: failpoint sites must be registered string literals.
+
+The failpoint registry (``utils/failpoints.py``) is only trustworthy if
+every ``failpoint("...")`` call site names a site that actually exists
+in :data:`SITES`: ``TFOS_FAILPOINTS=resevration.register=raise`` armed
+against a typo'd call site would silently no-op — the chaos run reports
+green while injecting nothing. ``arm()`` validates the arming side at
+runtime; this rule validates the CALL side at build time:
+
+- a ``failpoint(...)`` call whose first argument is not a plain string
+  literal (f-strings, variables, concatenation) is flagged — dynamic
+  names defeat both this check and grep;
+- a literal name missing from the registry's ``SITES`` set is flagged.
+
+The registry is read from ``cfg.failpoints_module`` (parsed standalone
+from disk, so fixture runs that lint only a test directory still
+validate against the real registry).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tensorflowonspark_tpu.analysis.core import Config, Finding, Module, Package
+
+__all__ = ["check"]
+
+_FP_MODULE = "tensorflowonspark_tpu.utils.failpoints"
+
+
+def _registered_sites(root: str, cfg: Config) -> set | None:
+    """The SITES literal from the registry module, or None when it
+    cannot be read (the rule then only enforces literalness)."""
+    path = os.path.join(root, cfg.failpoints_module)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "SITES"
+            for t in node.targets
+        ):
+            continue
+        consts = {
+            n.value
+            for n in ast.walk(node.value)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)
+        }
+        if consts:
+            return consts
+    return None
+
+
+class _Checker(ast.NodeVisitor):
+    """Flags bad ``failpoint(...)`` calls. Which names/attributes count
+    as "the failpoint function" is resolved from this module's imports,
+    so a user-defined helper that happens to be called ``failpoint``
+    in unrelated code is not flagged."""
+
+    def __init__(self, mod: Module, sites: set | None):
+        self.mod = mod
+        self.sites = sites
+        self.fn_names: set = set()  # local names bound to the function
+        self.mod_names: set = set()  # local names bound to the module
+        self.findings: list = []
+
+    def _flag(self, node: ast.AST, msg: str) -> None:
+        self.findings.append(
+            Finding(
+                "FP001", self.mod.relpath, node.lineno, node.col_offset, msg
+            )
+        )
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            if alias.name == _FP_MODULE:
+                # `import pkg.utils.failpoints` binds the ROOT package
+                # name; calls then spell the full dotted chain, which
+                # the Attribute branch below resolves
+                self.mod_names.add(alias.asname or _FP_MODULE)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.level == 0 and node.module == _FP_MODULE:
+            for alias in node.names:
+                if alias.name == "failpoint":
+                    self.fn_names.add(alias.asname or alias.name)
+        elif node.level == 0 and node.module == _FP_MODULE.rsplit(".", 1)[0]:
+            for alias in node.names:
+                if alias.name == "failpoints":
+                    self.mod_names.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    def _is_failpoint_call(self, func: ast.AST) -> bool:
+        if isinstance(func, ast.Name):
+            return func.id in self.fn_names
+        if isinstance(func, ast.Attribute) and func.attr == "failpoint":
+            parts: list = []
+            base = func.value
+            while isinstance(base, ast.Attribute):
+                parts.append(base.attr)
+                base = base.value
+            if isinstance(base, ast.Name):
+                parts.append(base.id)
+                dotted = ".".join(reversed(parts))
+                return dotted in self.mod_names or dotted == _FP_MODULE
+        return False
+
+    def visit_Call(self, node):
+        if self._is_failpoint_call(node.func):
+            arg = node.args[0] if node.args else None
+            if not (
+                isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+            ):
+                self._flag(
+                    node,
+                    "failpoint site must be a string literal (dynamic "
+                    "names defeat the registered-site check and make "
+                    "TFOS_FAILPOINTS un-greppable)",
+                )
+            elif self.sites is not None and arg.value not in self.sites:
+                self._flag(
+                    node,
+                    f"failpoint site '{arg.value}' is not registered in "
+                    "utils/failpoints.py SITES — an armed spec for it "
+                    "would silently no-op",
+                )
+        self.generic_visit(node)
+
+
+def check(pkg: Package, cfg: Config) -> list:
+    sites = _registered_sites(pkg.root, cfg)
+    findings: list = []
+    for mod in pkg.modules:
+        checker = _Checker(mod, sites)
+        checker.visit(mod.tree)
+        findings.extend(checker.findings)
+    return findings

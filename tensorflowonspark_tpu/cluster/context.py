@@ -70,9 +70,20 @@ class TFNodeContext:
         qname_in: str = "input",
         qname_out: str = "output",
         input_mapping: dict[str, str] | None = None,
+        feed_timeout: float | None = None,
     ) -> DataFeed:
-        """Reference: ``TFNodeContext.get_data_feed``."""
-        return DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+        """Reference: ``TFNodeContext.get_data_feed``. ``feed_timeout``
+        overrides the driver-published pull-loop policy (see
+        ``DataFeed.feed_timeout``)."""
+        return DataFeed(
+            self.mgr,
+            train_mode,
+            qname_in,
+            qname_out,
+            input_mapping,
+            feed_timeout=feed_timeout,
+            worker_index=self.executor_id,
+        )
 
     # --- paths ----------------------------------------------------------
     def absolute_path(self, path: str) -> str:

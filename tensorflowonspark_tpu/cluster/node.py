@@ -30,6 +30,8 @@ from tensorflowonspark_tpu.cluster import reservation
 from tensorflowonspark_tpu.cluster.context import TFNodeContext
 from tensorflowonspark_tpu.cluster.marker import EndOfFeed, EndPartition
 from tensorflowonspark_tpu.utils import util
+from tensorflowonspark_tpu.utils.failpoints import failpoint
+from tensorflowonspark_tpu.utils.retry import RetryPolicy
 
 logger = logging.getLogger(__name__)
 
@@ -70,6 +72,8 @@ def run_node(
     # share a cwd, so the reference's write_executor_id pinning would
     # clobber itself here. util.write/read_executor_id remain for remote
     # launchers whose retries do land in a per-node working dir.
+
+    failpoint("node.startup")
 
     job_name, task_index = _assign_role(
         executor_id, cluster_meta["cluster_template"]
@@ -152,6 +156,19 @@ def run_node(
             "shm_ring": ring_name,
         }
     )
+    # 4b. liveness plane: a background beat refreshes this node's
+    #     last-seen stamp on the driver so a SIGKILL here is detected
+    #     within the heartbeat grace, not a feed/shutdown timeout.
+    #     Started BEFORE the roster barrier: a straggler can hold the
+    #     barrier for minutes, and a node whose only stamp were its
+    #     registration would look grace-expired the moment the barrier
+    #     completed.
+    hb_interval = float(cluster_meta.get("heartbeat_interval", 2.0) or 0)
+    if hb_interval > 0:
+        _start_heartbeater(
+            cluster_meta["server_addr"], executor_id, hb_interval
+        )
+
     cluster_info = client.await_reservations(
         timeout=cluster_meta.get("reservation_timeout", 600)
     )
@@ -199,6 +216,36 @@ def run_node(
     # 6. linger until the driver collected results and posted STOP, so the
     #    output queue (which lives in this process) survives until drained
     _await_stop(mgr, timeout=cluster_meta.get("linger_secs", 1800))
+
+
+def _start_heartbeater(
+    server_addr, executor_id: int, interval: float
+) -> threading.Thread:
+    """Daemon thread beating HEARTBEAT every ``interval`` seconds.
+
+    Deliberately fail-fast (no RPC retries): the beat IS the liveness
+    signal, so a missed beat should age this node's last-seen stamp,
+    not hide inside a backoff loop. Any error just skips the beat;
+    the thread exits when the server acks with its stop flag set or
+    becomes permanently unreachable after the cluster stops (process
+    exit kills the daemon thread anyway).
+    """
+    client = reservation.Client(
+        server_addr, retry=RetryPolicy(max_attempts=1)
+    )
+
+    def beat() -> None:
+        while True:
+            try:
+                if client.heartbeat(executor_id).get("stop"):
+                    return  # cluster kill: no point beating on
+            except Exception as e:  # noqa: BLE001 - a missed beat is the signal
+                logger.debug("heartbeat skipped: %s", e)
+            time.sleep(interval)
+
+    t = threading.Thread(target=beat, daemon=True, name="heartbeater")
+    t.start()
+    return t
 
 
 def _await_stop(mgr, timeout: float) -> None:
@@ -531,6 +578,11 @@ def _push_end_of_feed(
         ring = _ring_cache.get(node.get("shm_ring") or "")
     for qname in qnames:
         try:
+            if failpoint("node.close_feed") == "drop":
+                # Chaos: simulate a lost end-of-feed marker — the
+                # must_deliver contract below is exactly what a real
+                # drop would violate, so surface it as the timeout.
+                raise TimeoutError("failpoint dropped EndOfFeed")
             if ring is not None:
                 ring.push(
                     pickle.dumps(

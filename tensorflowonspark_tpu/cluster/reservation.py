@@ -23,26 +23,81 @@ import threading
 import time
 from typing import Any
 
+from tensorflowonspark_tpu.utils.failpoints import failpoint
+from tensorflowonspark_tpu.utils.retry import RetryPolicy
+
 logger = logging.getLogger(__name__)
+
+# Client-side default: absorb transient connect flaps (a driver mid-GC,
+# a SYN dropped during coordinator restart) without failing the node.
+DEFAULT_CLIENT_RETRY = RetryPolicy(
+    max_attempts=5, base_delay=0.1, max_delay=2.0, deadline_s=30.0
+)
 
 _LEN = struct.Struct(">I")
 _MAX_MSG = 64 * 1024 * 1024
 
 
 class Reservations:
-    """Thread-safe roster of registered nodes.
+    """Thread-safe roster of registered nodes, plus per-node liveness.
 
     Reference: ``reservation.py:Reservations`` (add/done/remaining).
+    Liveness is new surface: registration stamps ``last_seen`` for the
+    node's ``executor_id`` and every ``HEARTBEAT`` refreshes it, so the
+    driver can ask :meth:`dead_nodes` — "which registered nodes have
+    been silent longer than the grace window" — instead of inferring
+    death from a wedged feed timeout.
     """
 
     def __init__(self, required: int):
         self.required = required
         self._lock = threading.RLock()
         self._reservations: list[dict[str, Any]] = []  # guarded-by: self._lock
+        self._last_seen: dict[int, float] = {}  # guarded-by: self._lock
 
     def add(self, meta: dict[str, Any]) -> None:
+        # Idempotent per executor_id: Client._call retries the REG when
+        # the ack is lost, and the replay must update the roster entry,
+        # not duplicate it (a duplicate would complete the barrier with
+        # a node missing).
         with self._lock:
-            self._reservations.append(meta)
+            eid = meta.get("executor_id")
+            if eid is not None:
+                for i, existing in enumerate(self._reservations):
+                    if existing.get("executor_id") == eid:
+                        self._reservations[i] = meta
+                        break
+                else:
+                    self._reservations.append(meta)
+                self._last_seen[int(eid)] = time.monotonic()
+            else:
+                self._reservations.append(meta)
+
+    def heartbeat(self, executor_id: int) -> None:
+        with self._lock:
+            self._last_seen[int(executor_id)] = time.monotonic()
+
+    def last_seen(self) -> dict[int, float]:
+        """{executor_id: seconds since the last heartbeat/registration}."""
+        now = time.monotonic()
+        with self._lock:
+            return {eid: now - ts for eid, ts in self._last_seen.items()}
+
+    def dead_nodes(self, grace: float) -> list[int]:
+        """Executor ids silent for longer than ``grace`` seconds.
+
+        Registration counts as the first heartbeat, so a node is never
+        "dead" before it ever existed; a node that exited after a clean
+        shutdown is the caller's business (stop polling once the
+        cluster is being torn down).
+        """
+        now = time.monotonic()
+        with self._lock:
+            return sorted(
+                eid
+                for eid, ts in self._last_seen.items()
+                if now - ts > grace
+            )
 
     def done(self) -> bool:
         with self._lock:
@@ -98,6 +153,10 @@ class Server:
     - ``QUERY`` → {done: bool} — is the roster complete?
     - ``QINFO`` → {cluster_info: [...]} — the full roster (valid once done)
     - ``QNUM``  → {remaining: int}
+    - ``HEARTBEAT`` {executor_id} → {stop: bool}; refreshes the node's
+      last-seen stamp (the liveness plane — see ``Reservations.dead_nodes``)
+      and piggybacks the out-of-band stop flag so heartbeaters learn of a
+      cluster kill within one beat
     - ``STOP``  → ack; raises the stop flag that `Client.await_stop` and
       node watchdogs observe (out-of-band cluster kill)
     """
@@ -112,6 +171,10 @@ class Server:
     @property
     def stopped(self) -> bool:
         return self._stop.is_set()
+
+    def dead_nodes(self, grace: float) -> list[int]:
+        """Registered nodes whose last heartbeat is older than ``grace``."""
+        return self.reservations.dead_nodes(grace)
 
     def start(self, host: str = "", port: int = 0) -> tuple[str, int]:
         """Bind, spawn the listener thread, return the advertised address."""
@@ -173,6 +236,11 @@ class Server:
                         conn,
                         {"type": "OK", "remaining": self.reservations.remaining()},
                     )
+                elif mtype == "HEARTBEAT":
+                    self.reservations.heartbeat(msg["executor_id"])
+                    MessageSocket.send(
+                        conn, {"type": "OK", "stop": self._stop.is_set()}
+                    )
                 elif mtype == "STOP":
                     self._stop.set()
                     MessageSocket.send(conn, {"type": "OK"})
@@ -220,21 +288,56 @@ class Client:
 
     Reference: ``reservation.py:Client`` (register, get_reservations,
     await_reservations with a 1 s poll loop, request_stop).
+
+    Every RPC (one connect + send + receive) runs under ``retry`` —
+    exponential backoff with full jitter — so a transient connect flap
+    (driver mid-GC, listen backlog burst at cluster boot) is absorbed
+    instead of failing the whole node. Pass ``retry=RetryPolicy(
+    max_attempts=1)`` for the old fail-fast behavior (heartbeaters do:
+    a missed beat just ages the node's last-seen stamp, and a retry
+    loop inside the beat thread would mask the very signal liveness
+    detection reads).
     """
 
-    def __init__(self, server_addr: tuple[str, int] | list):
+    def __init__(
+        self,
+        server_addr: tuple[str, int] | list,
+        retry: RetryPolicy | None = None,
+    ):
         self.server_addr = (server_addr[0], int(server_addr[1]))
+        self.retry = DEFAULT_CLIENT_RETRY if retry is None else retry
 
     def _call(self, msg: dict[str, Any], timeout: float = 60.0) -> dict[str, Any]:
-        with socket.create_connection(self.server_addr, timeout=timeout) as sock:
-            MessageSocket.send(sock, msg)
-            reply = MessageSocket.receive(sock)
+        def roundtrip() -> dict[str, Any]:
+            failpoint("reservation.call")
+            with socket.create_connection(
+                self.server_addr, timeout=timeout
+            ) as sock:
+                MessageSocket.send(sock, msg)
+                return MessageSocket.receive(sock)
+
+        from tensorflowonspark_tpu.utils.failpoints import FailpointError
+
+        reply = self.retry.call(
+            roundtrip,
+            retry_on=(ConnectionError, TimeoutError, OSError, FailpointError),
+            site="reservation.call",
+        )
         if reply.get("type") == "ERR":
             raise RuntimeError(f"reservation server error: {reply.get('error')}")
         return reply
 
     def register(self, node_meta: dict[str, Any]) -> None:
+        failpoint("reservation.register")
         self._call({"type": "REG", "node": node_meta})
+
+    def heartbeat(self, executor_id: int) -> dict[str, Any]:
+        """One liveness beat; the reply carries the server's stop flag."""
+        failpoint("reservation.heartbeat")
+        return self._call(
+            {"type": "HEARTBEAT", "executor_id": int(executor_id)},
+            timeout=10.0,
+        )
 
     def get_reservations(self) -> list[dict[str, Any]]:
         return self._call({"type": "QINFO"})["cluster_info"]

@@ -58,8 +58,59 @@ class TFCluster:
         self.cluster_meta = cluster_meta
         self.input_mode = input_mode
         self.queues = queues
+        self.heartbeat_interval = float(
+            cluster_meta.get("heartbeat_interval", 0) or 0
+        )
+        self.heartbeat_grace = float(cluster_meta.get("heartbeat_grace", 0) or 0)
         self._shutdown_done = False
         self._dstream_bridge: tuple | None = None
+
+    # ------------------------------------------------------------------
+    # liveness plane
+    def dead_nodes(self, grace: float | None = None) -> list[int]:
+        """Executor ids whose heartbeats have been silent longer than
+        the grace window ([] when heartbeats are disabled). This is the
+        fast failure detector: a SIGKILLed or wedged node shows up here
+        within ``heartbeat_grace`` seconds instead of only at a feed or
+        shutdown timeout."""
+        if self.heartbeat_interval <= 0 or self._shutdown_done:
+            return []
+        grace = self.heartbeat_grace if grace is None else grace
+        if grace <= 0:
+            return []
+        silent = self.server.reservations.dead_nodes(grace)
+        if not silent:
+            return []
+        # A node that FINISHED and exited 0 stops heartbeating too —
+        # silence plus a clean exit is completion, not death (supervise
+        # and shutdown would otherwise tear down healthy runs with
+        # skewed finish times).
+        exit_codes = self.launcher.exitcodes()
+        return [
+            eid
+            for eid in silent
+            if not (eid < len(exit_codes) and exit_codes[eid] == 0)
+        ]
+
+    def _dead_error(self, dead: list[int], detail: str = "") -> RuntimeError:
+        """THE presumed-dead diagnostic — one builder so every surface
+        (liveness check, stream polls) reports identically."""
+        return RuntimeError(
+            f"node(s) {dead} missed heartbeats for more than "
+            f"{self.heartbeat_grace}s — presumed dead{detail}"
+        )
+
+    def _check_liveness(self) -> None:
+        """Raise if any node is presumed dead; prefer its ferried
+        traceback (or process exit) over the bare liveness message when
+        one exists."""
+        dead = self.dead_nodes()
+        if not dead:
+            return
+        self._check_errors()  # a real traceback beats "missed heartbeats"
+        failed = self.launcher.poll_failed()
+        detail = f" (process(es) {failed} exited nonzero)" if failed else ""
+        raise self._dead_error(dead, detail)
 
     # ------------------------------------------------------------------
     @property
@@ -161,6 +212,10 @@ class TFCluster:
         def feed_worker(widx: int) -> None:
             try:
                 mgr = tfnode_runtime.connect_manager(workers[widx])
+                # publish the feed policy to the node: DataFeed pull
+                # loops bound their queue waits by the same timeout the
+                # driver feeds under (see DataFeed._next_raw/FeedTimeout)
+                mgr.set("feed_timeout", feed_timeout)
                 for part in assignments[widx]:
                     tfnode_runtime.feed_partition(
                         mgr,
@@ -182,12 +237,29 @@ class TFCluster:
         ]
         for t in threads:
             t.start()
-        for t in threads:
-            t.join()
+        self._join_feeders(threads)
         if errors:
             self._check_errors()
             raise errors[0]
         self._check_errors()
+
+    def _join_feeders(
+        self, threads: list[threading.Thread], poll: float = 2.0
+    ) -> None:
+        """Join feeder threads while watching node liveness: a feeder
+        blocked pushing to a SIGKILLed node would otherwise sit out the
+        whole ``feed_timeout`` before anyone noticed the death. On a
+        liveness failure the (daemon) feeders are abandoned and the
+        error raises within the heartbeat grace."""
+        last_check = time.monotonic()
+        while True:
+            alive = [t for t in threads if t.is_alive()]
+            if not alive:
+                return
+            alive[0].join(min(1.0, poll))
+            if time.monotonic() - last_check >= poll:
+                self._check_liveness()
+                last_check = time.monotonic()
 
     def _train_dstream(self, dstream, feed_timeout: float, qname: str) -> None:
         """Bridge a DStream into :meth:`train_stream`: ``foreachRDD``
@@ -311,6 +383,9 @@ class TFCluster:
                 pump_done.set()
 
         def feed_worker(widx: int) -> None:
+            # NOTE: deliberately no feed_timeout KV publish here (unlike
+            # train): a stream is allowed to be quiet for arbitrary
+            # stretches, so the consumer pull must stay unbounded.
             try:
                 mgr = tfnode_runtime.connect_manager(workers[widx])
                 while True:
@@ -343,6 +418,15 @@ class TFCluster:
             # Worker-initiated termination (DataFeed.terminate) only flips
             # terminated[i] when a feed attempt observes it; on a quiet
             # stream no feed happens, so poll manager state directly.
+            # Liveness first: a SIGKILLed node's manager port may refuse
+            # (indistinguishable from clean termination below), but its
+            # missed heartbeats are an unambiguous death signal that must
+            # RAISE, not silently early-stop the stream.
+            dead = set(self.dead_nodes())
+            for i, w in enumerate(workers):
+                if not terminated[i] and w["executor_id"] in dead:
+                    errors.append(self._dead_error([w["executor_id"]]))
+                    terminated[i] = True
             for i, w in enumerate(workers):
                 if not terminated[i]:
                     try:
@@ -389,14 +473,22 @@ class TFCluster:
             for q, t in zip(work_qs, feeders):
                 # A dead feeder no longer drains its (bounded) queue, so an
                 # unconditional put could block forever — poll instead.
-                while t.is_alive():
+                # After an error (including a liveness failure) the
+                # poison-put and join are BOUNDED: a feeder blocked
+                # mid-push to a wedged node would otherwise hang this
+                # cleanup forever, exactly the wait the liveness plane
+                # exists to cut short (the feeders are daemons).
+                give_up = (
+                    time.monotonic() + 2.0 if errors else float("inf")
+                )
+                while t.is_alive() and time.monotonic() < give_up:
                     try:
                         q.put(None, timeout=1.0)
                         break
                     except _stdqueue.Full:
                         continue
             for t in feeders:
-                t.join()
+                t.join(2.0 if errors else None)
         if errors:
             self._check_errors()
             raise errors[0]
@@ -477,6 +569,11 @@ class TFCluster:
                 return item
 
         def run_worker(widx: int) -> None:
+            # no feed_timeout KV publish: inference_stream throttles
+            # workers when the RESULT consumer lags, so the node's input
+            # queue legitimately goes quiet for as long as the consumer
+            # pleases — a consumer-side pull bound would misread that
+            # backpressure as producer death.
             try:
                 mgr = tfnode_runtime.connect_manager(workers[widx])
                 while True:
@@ -528,6 +625,9 @@ class TFCluster:
                         and finished[0] < len(threads)
                     ):
                         cond.wait(1.0)
+                        dead = self.dead_nodes()
+                        if dead:
+                            errors.append(self._dead_error(dead))
                     if errors:
                         break
                     if head in results:
@@ -547,11 +647,72 @@ class TFCluster:
                 state["stop"] = True
                 cond.notify_all()
             for t in threads:
-                t.join()
+                # After an error (including a liveness failure) the
+                # (daemon) workers may be mid-push to a dead node —
+                # abandon them instead of riding out feed_timeout.
+                t.join(2.0 if errors else None)
         if errors:
             self._check_errors()
             raise errors[0]
         self._check_errors()
+
+    # ------------------------------------------------------------------
+    def supervise(self, poll: float = 2.0) -> None:
+        """Block until every node reaches a terminal state, failing FAST
+        on a dead node.
+
+        The watch loop ``run_with_restarts`` runs between startup and
+        teardown: it raises RuntimeError within ~``poll`` seconds of a
+        node process exiting nonzero, and within ``heartbeat_grace`` of
+        a node going silent (SIGKILL, kernel OOM, network partition —
+        cases where the process table can't tell the driver anything).
+        Without it, a dead node surfaced only when ``shutdown``'s
+        watchdog expired — ``shutdown_timeout`` defaults to days.
+        Returns once every node is ``finished``/``error`` (or exited
+        cleanly), at which point :meth:`shutdown` completes promptly.
+        """
+        # Terminal states are cached: a node observed finished/error
+        # never needs another manager RPC. Non-terminal nodes are
+        # probed IN PARALLEL on a slower cadence than the (cheap)
+        # process/liveness checks — one shared probe window per round,
+        # so a single wedged node cannot serialize the loop, and far
+        # fewer probe threads over a long run.
+        terminal: dict[int, str] = {}
+        state_poll = max(poll, 5.0)
+        next_state_probe = 0.0
+        while True:
+            failed = self.launcher.poll_failed()
+            if failed:
+                raise RuntimeError(
+                    f"node process(es) {failed} died mid-run "
+                    "(exited nonzero)"
+                )
+            self._check_liveness()
+            exit_codes = self.launcher.exitcodes()
+            pending = [
+                n
+                for n in self.cluster_info
+                if n["executor_id"] not in terminal
+                and not (
+                    n["executor_id"] < len(exit_codes)
+                    and exit_codes[n["executor_id"]] == 0
+                )
+            ]
+            if not pending:
+                return
+            if time.monotonic() >= next_state_probe:
+                next_state_probe = time.monotonic() + state_poll
+                for n, state in zip(
+                    pending, _probe_node_states(pending, timeout=10.0)
+                ):
+                    # "hung" (no answer in the window: a wedging node —
+                    # liveness passes judgment next poll) and
+                    # "unreachable" (manager gone but process not
+                    # failed: about to exit cleanly or to miss
+                    # heartbeats) both stay pending.
+                    if state in ("finished", "error"):
+                        terminal[n["executor_id"]] = state
+            time.sleep(poll)
 
     # ------------------------------------------------------------------
     def shutdown(
@@ -584,7 +745,16 @@ class TFCluster:
         if grace_secs:
             time.sleep(grace_secs)
 
-        node_errors = self._collect_errors()
+        # Dead (wedged) nodes are excluded from every manager RPC below:
+        # their kernels may still accept the connect and then hang the
+        # handshake; the launcher watchdog force-terminates them instead.
+        dead = set(self.dead_nodes())
+        if dead:
+            logger.warning(
+                "shutdown: skipping manager RPCs to dead node(s) %s",
+                sorted(dead),
+            )
+        node_errors = self._collect_errors(skip=dead)
         feed_queues = (
             [q for q in self.queues if q not in ("output", "error", "control")]
             if self.input_mode == InputMode.SPARK
@@ -593,6 +763,8 @@ class TFCluster:
         for node_meta in self.cluster_info:
             # Every node gets the control STOP; feed-queue end markers only
             # go where feeders did (evaluator sidecars have no feed).
+            if node_meta["executor_id"] in dead:
+                continue
             is_worker = node_meta["job_name"] != "evaluator"
             try:
                 tfnode_runtime.shutdown_node(
@@ -629,9 +801,13 @@ class TFCluster:
                 "InputMode.TENSORFLOW nodes read data themselves"
             )
 
-    def _collect_errors(self) -> list[dict[str, Any]]:
+    def _collect_errors(
+        self, skip: "set[int] | frozenset" = frozenset()
+    ) -> list[dict[str, Any]]:
         errors: list[dict[str, Any]] = []
         for node_meta in self.cluster_info:
+            if node_meta["executor_id"] in skip:
+                continue
             try:
                 errors.extend(tfnode_runtime.drain_errors(node_meta))
             except (ConnectionError, OSError, EOFError):
@@ -639,7 +815,12 @@ class TFCluster:
         return errors
 
     def _check_errors(self) -> None:
-        errs = self._collect_errors()
+        # Never open a manager connection to a node the liveness plane
+        # already declared dead: a WEDGED (e.g. SIGSTOPped) process's
+        # kernel still accepts the TCP connect, and the authkey
+        # handshake then blocks forever — the exact hang heartbeats
+        # exist to cut short.
+        errs = self._collect_errors(skip=set(self.dead_nodes()))
         if errs:
             tracebacks = "\n".join(e["traceback"] for e in errs)
             try:
@@ -671,6 +852,8 @@ def run(
     env: dict[str, str] | None = None,
     use_shm_ring: bool = True,
     shm_ring_mb: int = 64,
+    heartbeat_interval: float = 2.0,
+    heartbeat_grace: float = 60.0,
 ) -> TFCluster:
     """Start a cluster and return its handle.
 
@@ -729,6 +912,12 @@ def run(
         "metrics": metrics,
         "log_dir": log_dir,
         "reservation_timeout": reservation_timeout,
+        # Liveness plane: every node heartbeats the reservation server
+        # at this interval (<= 0 disables); the driver treats a node
+        # silent for heartbeat_grace seconds as dead (TFCluster.
+        # dead_nodes / supervise and the feed-plane checks).
+        "heartbeat_interval": heartbeat_interval,
+        "heartbeat_grace": heartbeat_grace,
         "distributed": distributed,
         "queue_maxsize": queue_maxsize,
         "manager_mode": "remote",
@@ -847,7 +1036,24 @@ def run_with_restarts(
                 launcher=launcher_factory() if launcher_factory else None,
                 **run_kwargs,
             )
+            # Supervised wait: liveness + process exits, so a node that
+            # is SIGKILLed (or wedges past the heartbeat grace) mid-run
+            # triggers the relaunch within seconds instead of after
+            # shutdown_timeout. On failure, kill the survivors so the
+            # shutdown below reaps the whole attempt promptly.
+            supervise_error: RuntimeError | None = None
+            try:
+                cluster.supervise()
+            except RuntimeError as e:
+                supervise_error = e
+                logger.warning("supervision detected failure: %s", e)
+                cluster.launcher.terminate()
             cluster.shutdown(timeout=shutdown_timeout)
+            if supervise_error is not None:
+                # shutdown absorbed the damage (e.g. every process was
+                # terminated back to exit 0 somehow): the supervision
+                # verdict still stands — this attempt failed.
+                raise supervise_error
             return restarts
         except RuntimeError as e:
             restarts += 1
@@ -860,6 +1066,39 @@ def run_with_restarts(
                 restarts,
                 max_restarts,
             )
+
+
+def _probe_node_states(
+    nodes: list[dict[str, Any]], timeout: float
+) -> list[str]:
+    """Each node's manager KV ``state``, probed in parallel bounded
+    daemon threads sharing ONE ``timeout`` window.
+
+    Manager RPCs have no client-side timeout, and a WEDGED node's kernel
+    happily accepts the TCP connect and then hangs the handshake —
+    exactly what supervision must not do. Per node, returns the state
+    string, ``"unreachable"`` (connect refused/reset: the process is
+    gone or going), or ``"hung"`` (no answer inside the window; that
+    probe thread is daemon and abandoned)."""
+    results: list[list[str]] = [[] for _ in nodes]
+
+    def probe(i: int, node_meta: dict[str, Any]) -> None:
+        try:
+            mgr = tfnode_runtime.connect_manager(node_meta)
+            results[i].append(str(mgr.get("state")))
+        except (ConnectionError, OSError, EOFError):
+            results[i].append("unreachable")
+
+    threads = [
+        threading.Thread(target=probe, args=(i, n), daemon=True)
+        for i, n in enumerate(nodes)
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + timeout
+    for t in threads:
+        t.join(max(0.0, deadline - time.monotonic()))
+    return [r[0] if r else "hung" for r in results]
 
 
 def _abort_if_node_died(launcher, remaining: int) -> None:

@@ -15,6 +15,15 @@ from typing import Any
 import orbax.checkpoint as ocp
 
 from tensorflowonspark_tpu.obs import spans as obs_spans
+from tensorflowonspark_tpu.utils.failpoints import FailpointError, failpoint
+from tensorflowonspark_tpu.utils.retry import RetryPolicy
+
+# Orbax IO rides shared filesystems (GCS/NFS) whose transient errors are
+# routine at pod scale; retry them with backoff rather than failing a
+# multi-hour training step. Injected FailpointErrors are retryable here
+# so chaos runs can exercise exactly this path.
+_IO_RETRY = RetryPolicy(max_attempts=3, base_delay=0.2, max_delay=5.0)
+_IO_RETRYABLE = (OSError, ConnectionError, TimeoutError, FailpointError)
 
 
 def _abs(path: str) -> str:
@@ -23,12 +32,36 @@ def _abs(path: str) -> str:
     return os.path.abspath(path)
 
 
+def _canonicalize_leaves(state: Any) -> Any:
+    """Version shim (the ``utils/compat.py`` pattern): current orbax's
+    StandardSave validator rejects numpy *scalar* leaves (``np.float32``,
+    ``np.int64``, ``np.bool_`` — the types a host-side metrics dict or a
+    ``jax.device_get`` of a 0-d array naturally produces) while accepting
+    0-d ``np.ndarray``s of the same dtype. Canonicalize scalars to 0-d
+    arrays at every save boundary; dtype and value round-trip, and orbax
+    versions that accepted scalars store the identical array."""
+    import jax
+    import numpy as np
+
+    return jax.tree.map(
+        lambda x: np.asarray(x) if isinstance(x, np.generic) else x, state
+    )
+
+
 def save_checkpoint(path: str, state: Any, force: bool = True) -> str:
     """Synchronously write ``state`` (any pytree) to ``path``."""
     path = _abs(path)
+    state = _canonicalize_leaves(state)
     with obs_spans.span("train.checkpoint"):
         with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(path, state, force=force)
+
+            def do_save():
+                failpoint("checkpoint.save")
+                ckptr.save(path, state, force=force)
+
+            _IO_RETRY.call(
+                do_save, retry_on=_IO_RETRYABLE, site="checkpoint.save"
+            )
     return path
 
 
@@ -39,11 +72,22 @@ def restore_checkpoint(path: str, target: Any | None = None) -> Any:
     path = _abs(path)
     with ocp.StandardCheckpointer() as ckptr:
         if target is None:
-            return ckptr.restore(path)
-        import jax
+            def do_restore():
+                failpoint("checkpoint.restore")
+                return ckptr.restore(path)
 
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
-        return ckptr.restore(path, abstract)
+        else:
+            import jax
+
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+
+            def do_restore():
+                failpoint("checkpoint.restore")
+                return ckptr.restore(path, abstract)
+
+        return _IO_RETRY.call(
+            do_restore, retry_on=_IO_RETRYABLE, site="checkpoint.restore"
+        )
 
 
 class CheckpointManager:
@@ -98,10 +142,20 @@ class CheckpointManager:
         # The span measures the BLOCKING portion only: with async_save
         # the actual I/O overlaps subsequent steps, and the interesting
         # host cost is exactly how long the training loop stalled here.
+        state = _canonicalize_leaves(state)
         with obs_spans.span("train.checkpoint", step=step):
-            return self._mgr.save(
-                step, args=ocp.args.StandardSave(state), metrics=metrics,
-                force=force,
+
+            def do_save():
+                failpoint("checkpoint.save")
+                return self._mgr.save(
+                    step,
+                    args=ocp.args.StandardSave(state),
+                    metrics=metrics,
+                    force=force,
+                )
+
+            return _IO_RETRY.call(
+                do_save, retry_on=_IO_RETRYABLE, site="checkpoint.save"
             )
 
     def restore(self, step: int | None = None, target: Any | None = None) -> Any:
@@ -112,10 +166,35 @@ class CheckpointManager:
             import jax
 
             abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
-            return self._mgr.restore(
-                step, args=ocp.args.StandardRestore(abstract)
-            )
-        return self._mgr.restore(step)
+
+            def do_restore():
+                failpoint("checkpoint.restore")
+                return self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(abstract)
+                )
+
+        else:
+
+            def do_restore():
+                failpoint("checkpoint.restore")
+                try:
+                    return self._mgr.restore(step)
+                except KeyError:
+                    # Layout drift shim (the utils/compat.py probe
+                    # pattern): a CheckpointManager-written step stores
+                    # its tree under the composite item name "default",
+                    # and current orbax refuses an args-less restore on
+                    # a manager that has not saved in this process ("no
+                    # handler registered for item 'default'"). Naming
+                    # the handler explicitly restores the same tree on
+                    # every orbax version that has StandardRestore.
+                    return self._mgr.restore(
+                        step, args=ocp.args.StandardRestore()
+                    )
+
+        return _IO_RETRY.call(
+            do_restore, retry_on=_IO_RETRYABLE, site="checkpoint.restore"
+        )
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

@@ -16,14 +16,26 @@ from __future__ import annotations
 
 import logging
 import queue as _queue
+import time
 from typing import Any, Sequence
 
 import numpy as np
 
 from tensorflowonspark_tpu.cluster.marker import EndOfFeed, EndPartition, Marker
 from tensorflowonspark_tpu.obs import spans as obs_spans
+from tensorflowonspark_tpu.utils.failpoints import failpoint
 
 logger = logging.getLogger(__name__)
+
+class FeedTimeout(TimeoutError):
+    """The input queue produced nothing for the whole feed-timeout
+    window: the producer (driver feeder thread) stalled or died. Raised
+    from the consumer pull loop instead of blocking forever — the
+    consumer-side mirror of the driver's "timeout while feeding
+    partition". Only armed when a policy exists (constructor value, or
+    the KV ``TFCluster.train`` publishes): stream feeds are legitimately
+    quiet for arbitrary stretches, so without a policy the pull blocks
+    indefinitely, as before."""
 
 
 def columnize_rows(
@@ -82,12 +94,24 @@ class DataFeed:
         qname_in: str = "input",
         qname_out: str = "output",
         input_mapping: dict[str, str] | None = None,
+        feed_timeout: float | None = None,
+        worker_index: int | None = None,
     ):
         self.mgr = mgr
         self.train_mode = train_mode
         self.qname_in = qname_in
         self.qname_out = qname_out
         self.input_mapping = input_mapping
+        # Pull-loop policy: explicit ctor value wins; otherwise resolved
+        # lazily from the manager KV that TFCluster.train publishes at
+        # feed start (re-probed until it appears — map_fun typically
+        # constructs its DataFeed before the driver's first feed thread
+        # has connected, and latching a fallback then would silently
+        # discard the user's value). None = unbounded (stream feeds).
+        self._feed_timeout = feed_timeout
+        # Names this consumer in FeedTimeout messages (ctx.get_data_feed
+        # passes the node's executor id).
+        self.worker_index = worker_index
         # reference-parity public surface (TFNode.py DataFeed exposed it);
         # derived, not used internally
         self.input_tensors = (
@@ -124,9 +148,11 @@ class DataFeed:
                 break
             # queue wait: time spent blocked on the push plane (the
             # feeder side of data-wait; feed.data_wait in prefetch.py
-            # is the consumer side)
+            # is the consumer side). Bounded by the feed-timeout policy
+            # — a producer that stalled or died surfaces as a
+            # descriptive FeedTimeout, not an eternal block.
             with obs_spans.span("feed.queue_get"):
-                item = self._queue_in.get()
+                item = self._pull()
             self._queue_in.task_done()
             if isinstance(item, Marker) or item is None:
                 if isinstance(item, EndPartition):
@@ -141,6 +167,52 @@ class DataFeed:
             else:  # single record (legacy per-item producers)
                 batch.append(item)
         return batch
+
+    @property
+    def feed_timeout(self) -> float | None:
+        """The resolved pull-loop bound in seconds, or None (unbounded)
+        while no policy exists. The constructor value wins; otherwise
+        the driver-published manager KV (``TFCluster.train(
+        feed_timeout=...)``) is probed each call until it appears —
+        never latched as a default, so a publish that lands after the
+        first pull still takes effect."""
+        if self._feed_timeout is None:
+            published = self.mgr.get("feed_timeout")
+            if published is not None:
+                self._feed_timeout = float(published)
+        return self._feed_timeout
+
+    def _pull(self):
+        """One blocking pull off the input queue, bounded by the feed
+        policy when one exists.
+
+        Waits in short slices (so a policy published mid-wait is
+        honored); once a policy is known, ``feed_timeout`` seconds of
+        silence raise :class:`FeedTimeout` naming the queue and worker.
+        With no policy (stream feeds, bare DataFeeds) the pull blocks
+        indefinitely — quiet is not death there."""
+        failpoint("datafeed.get")
+        deadline = None
+        while True:
+            timeout = self.feed_timeout
+            if deadline is None and timeout is not None:
+                deadline = time.monotonic() + timeout
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise FeedTimeout(
+                        f"no data on queue {self.qname_in!r} for worker "
+                        f"{self.worker_index if self.worker_index is not None else '?'} "
+                        f"within feed_timeout={timeout}s (producer "
+                        "stalled or died)"
+                    )
+                wait = min(remaining, 5.0)
+            else:
+                wait = 5.0
+            try:
+                return self._queue_in.get(block=True, timeout=wait)
+            except _queue.Empty:
+                continue
 
     def _columnize(self, batch: Sequence[Any]) -> dict[str, np.ndarray]:
         return columnize_rows(batch, self.input_mapping)
@@ -185,6 +257,7 @@ class DataFeed:
         Contract (reference ``_inference`` equal-count rule): over a whole
         feed, exactly one result per input record, in order.
         """
+        failpoint("datafeed.put_results")
         self._queue_out.put(list(results))
 
     def terminate(self) -> None:
@@ -197,10 +270,16 @@ class DataFeed:
         """
         logger.info("DataFeed terminating; draining input queue")
         self.mgr.set("state", "terminating")
+        # Idle window for "the queue is drained": policy-driven (bounded
+        # by the feed timeout when one exists) rather than a hardcoded
+        # constant, but still short — this is a quiet-period detector,
+        # not a wait for more data.
+        ft = self.feed_timeout
+        idle = 3.0 if ft is None else min(3.0, ft)
         done = False
         while not done:
             try:
-                item = self._queue_in.get(block=True, timeout=3)
+                item = self._queue_in.get(block=True, timeout=idle)
                 self._queue_in.task_done()
                 if isinstance(item, EndOfFeed) or item is None:
                     self.done_feeding = True

@@ -28,6 +28,7 @@ from typing import Any, Callable, Iterable, Iterator
 
 from tensorflowonspark_tpu.compute.mesh import shard_batch
 from tensorflowonspark_tpu.obs import spans as obs_spans
+from tensorflowonspark_tpu.utils.failpoints import failpoint
 
 _DONE = object()
 
@@ -85,6 +86,9 @@ class DevicePrefetcher:
             for batch in it:
                 if self._stop.is_set():
                     return
+                # chaos: a producer raise here must ferry to the
+                # consumer's next __next__, like any real transfer error
+                failpoint("prefetch.producer")
                 # host->device transfer time, on the producer thread —
                 # beside feed.data_wait it answers "is the input plane
                 # keeping up or is the consumer starving"

@@ -10,7 +10,14 @@ instead of waiting for fixed-batch windows.
 
 from tensorflowonspark_tpu.serving.engine import (
     ContinuousBatcher,
+    DeadlineExceeded,
     EngineOverloaded,
+    EngineWedged,
 )
 
-__all__ = ["ContinuousBatcher", "EngineOverloaded"]
+__all__ = [
+    "ContinuousBatcher",
+    "DeadlineExceeded",
+    "EngineOverloaded",
+    "EngineWedged",
+]

@@ -81,6 +81,7 @@ import numpy as np
 from tensorflowonspark_tpu.models.llama import Llama
 from tensorflowonspark_tpu.obs import registry as obs_registry
 from tensorflowonspark_tpu.obs import spans as obs_spans
+from tensorflowonspark_tpu.utils.failpoints import failpoint
 
 logger = logging.getLogger(__name__)
 
@@ -93,6 +94,24 @@ _BIAS_SLOTS = 16
 class EngineOverloaded(RuntimeError):
     """Raised by submit()/stream() when the bounded request queue is
     full — callers should shed load (HTTP 503), not block."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """Terminal per-request error: the request's ``deadline_s`` budget
+    expired before it finished decoding. The scheduler retires the row
+    at the next block boundary — an expired request never decodes past
+    its deadline by more than one in-flight block window — and the
+    caller should map this to a timeout status (HTTP 504), not retry
+    blindly."""
+
+
+class EngineWedged(RuntimeError):
+    """Terminal per-request error from the scheduler watchdog: the
+    dispatch/fetch loop made no observable progress for the configured
+    window while work was in flight (a wedged device transfer, a hung
+    runtime callback). In-flight requests are aborted with this error so
+    their callers unblock; the scheduler itself is left to recover and
+    keep serving — see ``ContinuousBatcher(watchdog_s=...)``."""
 
 
 def _row_truncate(scaled, ks, ps):
@@ -295,8 +314,17 @@ class _Pending:
     # size at this value (warmup rides it to compile the k=1 program
     # without mutating the shared engine knob under live traffic).
     decode_block_pin: int | None = None
+    # wall-clock budget from enqueue; None = unbounded. Expiry is a
+    # TERMINAL DeadlineExceeded, checked at queue pop and every
+    # scheduler iteration (see _expire_deadlines).
+    deadline_s: float | None = None
     submitted_at: float = 0.0  # time.monotonic() at enqueue
     first_token_at: float | None = None  # set when token 0 emits
+    # resolve-once latch (guarded by the engine's _resolve_lock): a
+    # request resolves as EXACTLY one of completed/failed even when the
+    # watchdog thread races the scheduler — whoever flips this delivers
+    # the terminal; the loser only frees bookkeeping.
+    resolved: bool = False
     result: list[int] | None = None
     logprobs: list[float] | None = None  # filled at retirement
     error: BaseException | None = None
@@ -378,9 +406,12 @@ class _EmitWorker:
     def deliver(self, sink: "queue.Queue", item) -> None:
         self._q.put((sink, item))
 
-    def stop(self, timeout: float = 30.0) -> None:
+    def stop(self, timeout: float = 30.0) -> bool:
+        """Flush + stop; False when the thread outlived the join (a
+        sink ``put`` blocking forever — callers log it loudly)."""
         self._q.put(self._STOP)
         self._thread.join(timeout=timeout)
+        return not self._thread.is_alive()
 
     def _run(self) -> None:
         while True:
@@ -571,6 +602,7 @@ class ContinuousBatcher:
         prefix_cache: int | None = None,
         decode_block: int = 8,
         pipeline_depth: int = 2,
+        watchdog_s: float | None = None,
     ):
         cfg = model.cfg
         self._model = model
@@ -780,6 +812,27 @@ class ContinuousBatcher:
         self._failed_total = 0
         self.tokens_emitted = 0
         self.cancelled = 0  # consumer-abandoned requests (stream close)
+        # Degradation surface: deadline expiries and watchdog fires are
+        # failures (every one resolves its request via _fail_one).
+        self.deadline_expired = 0  # scheduler-thread-owned, like steps
+        self.watchdog_fires = 0  # watchdog-thread-owned
+        # None until close() runs, then whether the scheduler (and the
+        # emitter) actually wound down inside the join timeout.
+        self._stopped_cleanly: bool | None = None
+        # _fail_one may now run on the watchdog thread concurrently with
+        # the scheduler's retire path; this lock backs the resolve-once
+        # latch on _Pending and the _failed_total count.
+        self._resolve_lock = threading.Lock()
+        # Watchdog plumbing: the scheduler stamps _progress_ts at every
+        # observable step; _current_phase names where it currently is
+        # (racy single-writer reads — diagnostics, not control flow).
+        self._progress_ts = time.monotonic()
+        self._current_phase: str | None = None
+        self._watchdog_abort = threading.Event()
+        self._watchdog_suspended = False  # warmup compiles under it
+        if watchdog_s is not None and watchdog_s <= 0:
+            raise ValueError(f"watchdog_s must be > 0, got {watchdog_s}")
+        self._watchdog_s = watchdog_s
         self._ttft_sum = 0.0  # seconds, summed over completed requests
         self._duration_sum = 0.0
         # Latency denominators track only requests that actually ran:
@@ -824,6 +877,15 @@ class ContinuousBatcher:
             "forced drains of a non-empty in-flight block window "
             "(admission or prefill-admit state changes)",
         )
+        self._m_deadline = self.metrics.counter(
+            "engine_deadline_expired_total",
+            "requests retired with a terminal DeadlineExceeded",
+        )
+        self._m_watchdog = self.metrics.counter(
+            "engine_watchdog_fires_total",
+            "scheduler watchdog fires (no loop progress with work in "
+            "flight; in-flight requests aborted)",
+        )
         self._m_overlap = self.metrics.histogram(
             "engine_overlap_hidden_seconds",
             "host sweep time that ran while >=1 decode block was "
@@ -864,6 +926,12 @@ class ContinuousBatcher:
             target=self._loop, daemon=True, name="continuous-batcher"
         )
         self._thread.start()
+        if self._watchdog_s is not None:
+            threading.Thread(
+                target=self._watchdog_loop,
+                daemon=True,
+                name="engine-watchdog",
+            ).start()
 
     # -- public API ----------------------------------------------------
 
@@ -881,7 +949,16 @@ class ContinuousBatcher:
         frequency_penalty: float | None = None,
         presence_penalty: float | None = None,
         logit_bias: "dict[int, float] | None" = None,
+        deadline_s: float | None = None,
     ) -> None:
+        if deadline_s is not None and not (
+            isinstance(deadline_s, (int, float))
+            and math.isfinite(deadline_s)
+            and deadline_s > 0
+        ):
+            raise ValueError(
+                f"deadline_s must be finite and > 0, got {deadline_s!r}"
+            )
         if logit_bias is not None:
             if not isinstance(logit_bias, dict) or len(logit_bias) > _BIAS_SLOTS:
                 raise ValueError(
@@ -1021,6 +1098,7 @@ class ContinuousBatcher:
         presence_penalty: float | None = None,
         logit_bias: "dict[int, float] | None" = None,
         decode_block_pin: int | None = None,
+        deadline_s: float | None = None,
     ) -> list[_Pending]:
         """Validate then enqueue a group ATOMICALLY: either every row is
         accepted or none is — a partially admitted multi-row request
@@ -1031,6 +1109,7 @@ class ContinuousBatcher:
         identical fanned prompts with one seed must not return n
         identical completions — while the whole call stays
         reproducible); a list gives each row its exact seed."""
+        failpoint("engine.submit")
         if isinstance(seed, list):
             if len(seed) != len(requests):
                 raise ValueError(
@@ -1051,7 +1130,7 @@ class ContinuousBatcher:
             self._validate(
                 tokens, max_new_tokens, temperature, adapter, stop,
                 top_k, top_p, rs, min_p, frequency_penalty,
-                presence_penalty, logit_bias,
+                presence_penalty, logit_bias, deadline_s,
             )
         ps = [
             _Pending(
@@ -1070,6 +1149,9 @@ class ContinuousBatcher:
                 adapter=int(adapter or 0),
                 stop=tuple(tuple(q) for q in (stop or ())),
                 decode_block_pin=decode_block_pin,
+                deadline_s=(
+                    None if deadline_s is None else float(deadline_s)
+                ),
                 submitted_at=time.monotonic(),
                 sink=sink,
             )
@@ -1119,12 +1201,13 @@ class ContinuousBatcher:
         presence_penalty: float | None = None,
         logit_bias: "dict[int, float] | None" = None,
         decode_block_pin: int | None = None,
+        deadline_s: float | None = None,
     ) -> _Pending:
         return self._enqueue_all(
             [(tokens, sink)], max_new_tokens, temperature, eos_id,
             adapter, stop, top_k, top_p, seed, min_p,
             frequency_penalty, presence_penalty, logit_bias,
-            decode_block_pin,
+            decode_block_pin, deadline_s,
         )[0]
 
     def submit(
@@ -1143,6 +1226,7 @@ class ContinuousBatcher:
         frequency_penalty: float | None = None,
         presence_penalty: float | None = None,
         logit_bias: "dict[int, float] | None" = None,
+        deadline_s: float | None = None,
     ) -> "list[int] | tuple[list[int], list[float]]":
         """Blocking decode. ``temperature``, ``top_k``, ``top_p`` and
         ``eos_id`` override the engine-wide defaults FOR THIS REQUEST
@@ -1154,7 +1238,10 @@ class ContinuousBatcher:
         under the raw model distribution (the /score convention).
         ``adapter`` selects the row's MultiLoraTensor bank slot when the
         params carry one (multi-tenant serving; 0/None = base model),
-        traced per-row — mixed-adapter batches cost no recompilation."""
+        traced per-row — mixed-adapter batches cost no recompilation.
+        ``deadline_s``: wall-clock budget from submission; on expiry the
+        request fails with a terminal :class:`DeadlineExceeded` instead
+        of decoding on for a caller that stopped waiting."""
         p = self._enqueue(
             tokens, max_new_tokens, temperature=temperature,
             eos_id=eos_id, adapter=adapter, stop=stop,
@@ -1162,6 +1249,7 @@ class ContinuousBatcher:
             frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty,
             logit_bias=logit_bias,
+            deadline_s=deadline_s,
         )
         p.event.wait()
         if p.error is not None:
@@ -1186,6 +1274,7 @@ class ContinuousBatcher:
         frequency_penalty: float | None = None,
         presence_penalty: float | None = None,
         logit_bias: "dict[int, float] | None" = None,
+        deadline_s: float | None = None,
     ) -> "list[list[int]] | tuple[list[list[int]], list[list[float]]]":
         """Blocking decode of several prompts admitted ATOMICALLY (all
         rows accepted or an EngineOverloaded/ValueError before any row
@@ -1205,6 +1294,8 @@ class ContinuousBatcher:
             frequency_penalty,
             presence_penalty,
             logit_bias,
+            None,
+            deadline_s,
         )
         for p in ps:
             p.event.wait()
@@ -1231,6 +1322,7 @@ class ContinuousBatcher:
         frequency_penalty: float | None = None,
         presence_penalty: float | None = None,
         logit_bias: "dict[int, float] | None" = None,
+        deadline_s: float | None = None,
     ):
         """Yield completion tokens AS THEY DECODE (one engine step of
         latency each) instead of blocking for the full result.
@@ -1260,6 +1352,7 @@ class ContinuousBatcher:
             frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty,
             logit_bias=logit_bias,
+            deadline_s=deadline_s,
         )
 
         # An explicit iterator, NOT a generator: close() on a
@@ -1287,6 +1380,16 @@ class ContinuousBatcher:
         # compile; and without eos_id=-1 a sampled first token equal to
         # the engine's default eos could nondeterministically retire
         # the row before a step runs.
+        # Watchdog suspended for the duration: first-compile stalls are
+        # indistinguishable from the wedges it hunts, and warmup exists
+        # precisely to take them before traffic.
+        self._watchdog_suspended = True
+        try:
+            self._warmup_requests()
+        finally:
+            self._watchdog_suspended = False
+
+    def _warmup_requests(self) -> None:
         max_seq = self._model.cfg.max_seq_len
         if self._prefill_chunk is not None:
             # chunk + sample1 + admit + step compile on any prompt;
@@ -1337,10 +1440,15 @@ class ContinuousBatcher:
     def _phase(self, phase: str):
         """Measure one scheduler phase into both surfaces: the span
         ring (``/stats`` percentiles, Chrome-trace export, XLA-timeline
-        bridge) and the Prometheus phase histogram."""
+        bridge) and the Prometheus phase histogram. Also names the
+        phase for the watchdog/close diagnostics ("stuck in fetch")."""
         t0 = time.monotonic()
-        with self._tracer.span("engine." + phase):
-            yield
+        self._current_phase = phase
+        try:
+            with self._tracer.span("engine." + phase):
+                yield
+        finally:
+            self._current_phase = None
         self._m_phase.observe(time.monotonic() - t0, phase=phase)
 
     def _observe_queue_wait(self, p: _Pending) -> None:
@@ -1378,6 +1486,12 @@ class ContinuousBatcher:
             "completed": self.completed,
             "cancelled": self.cancelled,
             "tokens_emitted": self.tokens_emitted,
+            # degradation surface: terminal deadline expiries, watchdog
+            # fires, and (after close()) whether the scheduler actually
+            # wound down inside its join timeout — None while running
+            "deadline_expired": self.deadline_expired,
+            "watchdog_fires": self.watchdog_fires,
+            "stopped_cleanly": self._stopped_cleanly,
             "prefill_in_progress": self._job is not None,
             # queue wait + prefill, averaged over completed requests
             "ttft_avg_ms": round(self._ttft_sum / done * 1e3, 3)
@@ -1455,6 +1569,18 @@ class ContinuousBatcher:
         # checked at the top of every scheduler iteration.
         self._stop_now.set()
         self._thread.join(timeout=60)
+        if self._thread.is_alive():
+            # Don't proceed silently past a wedged scheduler: name where
+            # it is stuck (span-phase tracking) and surface the fact in
+            # /stats via stopped_cleanly.
+            logger.warning(
+                "engine scheduler did not stop within 60s "
+                "(stuck in %s); resources may leak until process exit",
+                self._current_phase or "between phases",
+            )
+            self._stopped_cleanly = False
+        else:
+            self._stopped_cleanly = True
         if self._prefix_store is not None and not self._thread.is_alive():
             # Drop the stored KV buffers (up to capacity × a full
             # single-row cache of HBM) — a closed-but-still-referenced
@@ -2112,6 +2238,10 @@ class ContinuousBatcher:
             return
         for row, tok_1, lp_1 in self._pending_first:
             p, out, lps = self._live[row]
+            if p.resolved:  # failed (watchdog/deadline) before token 0
+                self._live[row] = None
+                self._gates_arr = None
+                continue
             first = int(np.asarray(tok_1)[0])
             lp = float(np.asarray(lp_1)[0])
             out.append(first)
@@ -2137,7 +2267,12 @@ class ContinuousBatcher:
         ``jax.device_get`` blocks only until THIS block is done — with
         dispatch-ahead the next block keeps the device busy while the
         host sweeps this one."""
-        return np.asarray(jax.device_get(packed))
+        # chaos: a delay armed here models a wedged device transfer —
+        # the exact stall the scheduler watchdog exists to detect
+        failpoint("engine.fetch")
+        host = np.asarray(jax.device_get(packed))
+        self._progress_ts = time.monotonic()
+        return host
 
     def _sweep_block(self, k: int, host: np.ndarray) -> None:
         """Host sweep of one fetched block: append tokens/logprobs,
@@ -2154,6 +2289,13 @@ class ContinuousBatcher:
                     if entry is None:
                         continue  # free, or finished earlier in block
                     p, out, lps = entry
+                    if p.resolved:
+                        # failed off-thread (watchdog) mid-flight: the
+                        # terminal already went out — free the slot and
+                        # discard the block's tokens for this row
+                        self._live[row] = None
+                        self._gates_arr = None
+                        continue
                     t = int(host_tok[j, row])
                     out.append(t)
                     lps.append(float(host_lp[j, row]))
@@ -2219,6 +2361,10 @@ class ContinuousBatcher:
         p, out, lps = self._live[row]
         self._live[row] = None
         self._gates_arr = None
+        if not self._try_resolve(p):
+            # the watchdog (or a deadline expiry) already failed this
+            # request and delivered its terminal — only free the slot
+            return
         now = time.monotonic()
         self.tokens_emitted += len(out)  # decoded count, pre-trim
         # same pre-trim count: /stats and /metrics must agree on what
@@ -2267,6 +2413,8 @@ class ContinuousBatcher:
         slot to retire, no tokens; resolve as completed-empty so drain
         accounting closes and nothing prefills for a dead consumer.
         Excluded from the latency averages — it never ran."""
+        if not self._try_resolve(p):
+            return
         p.result = []
         p.logprobs = []
         self.cancelled += 1
@@ -2276,13 +2424,35 @@ class ContinuousBatcher:
             self._emitter.deliver(p.sink, True)
         p.event.set()
 
-    def _fail_one(self, p: _Pending, err: BaseException) -> None:
-        self._failed_total += 1
+    def _try_resolve(self, p: _Pending) -> bool:
+        """Flip the request's resolve-once latch; True means the caller
+        owns delivering the terminal (result or error). Exists because
+        the watchdog thread can fail a request the scheduler is about
+        to retire — exactly one side may win."""
+        with self._resolve_lock:
+            if p.resolved:
+                return False
+            p.resolved = True
+            return True
+
+    def _fail_one(self, p: _Pending, err: BaseException) -> bool:
+        """Fail a request; False when something else (watchdog vs
+        scheduler race) already resolved it — callers must not count a
+        terminal they didn't deliver."""
+        if not self._try_resolve(p):
+            return False
+        with self._resolve_lock:
+            # under the same lock as the latch: close()'s drain
+            # accounting reads completed+_failed_total against
+            # _accepted_total and must never see a resolved request
+            # counted zero times
+            self._failed_total += 1
         self._m_failed.inc()
         p.error = err
         if p.sink is not None:
             self._emitter.deliver(p.sink, err)
         p.event.set()
+        return True
 
     def _fail_all(self, err: BaseException) -> None:
         for row, entry in enumerate(self._live):
@@ -2299,12 +2469,137 @@ class ContinuousBatcher:
                 continue
             self._fail_one(item, RuntimeError("engine shutting down"))
 
+    # -- degradation: watchdog + deadlines ----------------------------
+
+    def _watchdog_loop(self) -> None:
+        """Sidecar thread: fire when the scheduler has made no progress
+        for ``watchdog_s`` seconds WHILE work was in flight. Idle
+        blocking on the request queue is progress-free by design and
+        never fires; warmup suspends the check (first compiles look
+        exactly like stalls)."""
+        poll = max(0.05, min(1.0, self._watchdog_s / 4.0))
+        while self._thread.is_alive():
+            time.sleep(poll)
+            if self._watchdog_suspended or self._watchdog_abort.is_set():
+                continue
+            busy = (
+                bool(self._window)
+                or self._job is not None
+                or self._inflight is not None
+                or any(e is not None for e in self._live)
+            )
+            if not busy:
+                continue
+            stuck = time.monotonic() - self._progress_ts
+            if stuck > self._watchdog_s:
+                self._watchdog_fire(stuck)
+
+    def _watchdog_fire(self, stuck_for: float) -> None:
+        """Abort every in-flight request with a terminal EngineWedged so
+        their callers unblock NOW, then flag the scheduler to reset its
+        window/slots when (if) it unwedges — the loop itself stays
+        alive and keeps serving whatever arrives next. Queued requests
+        are left queued: they admit normally after recovery."""
+        phase = self._current_phase or "between phases"
+        self.watchdog_fires += 1
+        self._m_watchdog.inc()
+        err = EngineWedged(
+            f"engine scheduler made no progress for {stuck_for:.1f}s "
+            f"(stuck in {phase}); request aborted by watchdog"
+        )
+        logger.error(
+            "engine watchdog fired: no scheduler progress for %.1fs "
+            "(stuck in %s); aborting in-flight requests",
+            stuck_for,
+            phase,
+        )
+        # Racy snapshot reads are fine: entries are immutable tuples and
+        # _fail_one's resolve-once latch makes double-resolution
+        # impossible whichever thread wins.
+        for entry in list(self._live):
+            if entry is not None:
+                self._fail_one(entry[0], err)
+        job = self._job
+        if job is not None:
+            self._fail_one(job.p, err)
+        inflight = self._inflight
+        if inflight is not None:
+            self._fail_one(inflight, err)
+        self._watchdog_abort.set()
+
+    def _recover_from_watchdog(self) -> None:
+        """Scheduler-side cleanup after a watchdog fire: drop in-flight
+        device blocks unfetched (their rows' requests already failed),
+        free every slot whose request the watchdog resolved, and keep
+        going."""
+        self._window.clear()
+        self._pending_first.clear()
+        for row, entry in enumerate(self._live):
+            if entry is not None and entry[0].resolved:
+                self._live[row] = None
+        if self._job is not None and self._job.p.resolved:
+            self._job = None
+        if self._inflight is not None and self._inflight.resolved:
+            self._inflight = None
+        self._gates_arr = None
+        self._watchdog_abort.clear()
+        logger.warning(
+            "engine scheduler recovered after watchdog fire; resuming"
+        )
+
+    def _expired(self, p: _Pending, now: float) -> bool:
+        return (
+            p.deadline_s is not None
+            and now - p.submitted_at > p.deadline_s
+        )
+
+    def _expire_one(self, p: _Pending, detail: str) -> None:
+        delivered = self._fail_one(
+            p,
+            DeadlineExceeded(
+                f"request exceeded deadline_s={p.deadline_s} {detail}"
+            ),
+        )
+        if delivered:
+            # count only terminals actually delivered: the watchdog may
+            # have resolved this request a beat earlier, and a
+            # DeadlineExceeded that never reached the caller must not
+            # appear in /stats
+            self.deadline_expired += 1
+            self._m_deadline.inc()
+
+    def _expire_deadlines(self) -> None:
+        """Retire every live/prefilling request whose wall-clock budget
+        expired — terminal DeadlineExceeded, never a silent truncation.
+        Runs once per scheduler iteration, so an expired request decodes
+        at most one in-flight block window past its deadline."""
+        now = time.monotonic()
+        for row, entry in enumerate(self._live):
+            if entry is None:
+                continue
+            p = entry[0]
+            if self._expired(p, now):
+                self._expire_one(
+                    p, f"({len(entry[1])} token(s) decoded)"
+                )
+                self._live[row] = None
+                self._gates_arr = None
+        if self._job is not None and self._expired(self._job.p, now):
+            self._expire_one(self._job.p, "(mid-prefill)")
+            self._job = None
+
+    # -- engine loop (continued) --------------------------------------
+
     def _loop(self) -> None:
         cache = tok = pos = temps = ads = kps = seeds = None
         pens = counts = bids = bvals = None
         depth = self._pipeline_depth
         try:
             while True:
+                self._progress_ts = time.monotonic()
+                if self._watchdog_abort.is_set():
+                    self._recover_from_watchdog()
+                self._expire_deadlines()
                 if self._stop_now.is_set():
                     err = RuntimeError("engine shutting down")
                     # abrupt shutdown: in-flight device work and
@@ -2366,6 +2661,12 @@ class ContinuousBatcher:
                         return
                     if item.cancelled:
                         self._resolve_unadmitted_cancel(item)
+                        continue
+                    if self._expired(item, time.monotonic()):
+                        # expired while queued: fail WITHOUT burning a
+                        # prefill on a request whose caller's budget is
+                        # already gone
+                        self._expire_one(item, "(while queued)")
                         continue
                     self._observe_queue_wait(item)
                     self._inflight = item
@@ -2461,6 +2762,7 @@ class ContinuousBatcher:
                 # and the device never waits on the host sweep.
                 with self._phase("dispatch"):
                     while len(self._window) < depth:
+                        failpoint("engine.dispatch")
                         (
                             cache, tok, pos, packed, counts,
                         ) = self._block_fn(k)(
@@ -2471,6 +2773,7 @@ class ContinuousBatcher:
                         self.steps += k
                         self._m_steps.inc(k)
                         self._window.append((k, packed))
+                        self._progress_ts = time.monotonic()
                 # Deferred admission first tokens resolve AFTER the
                 # dispatch above, so their device_get overlaps the
                 # freshly enqueued block — and BEFORE any sweep below
@@ -2512,4 +2815,9 @@ class ContinuousBatcher:
             # everything enqueued above (tokens, terminals, errors)
             # flushes before the sentinel, so close() callers see fully
             # delivered sinks once the loop thread joins.
-            self._emitter.stop()
+            if not self._emitter.stop():
+                logger.warning(
+                    "engine emitter did not flush within its stop "
+                    "timeout (a stream sink put() is blocking); "
+                    "undelivered stream items dropped"
+                )

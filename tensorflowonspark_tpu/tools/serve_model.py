@@ -29,7 +29,11 @@ Endpoints::
                                 (``--llama-checkpoint`` mode; decode
                                 params are fixed server-side at startup
                                 so the jitted decode compiles ONCE for
-                                one static (batch, width) shape)
+                                one static (batch, width) shape).
+                                Continuous engine adds per-request
+                                ``deadline_s``: budget expiry answers
+                                504; a watchdog abort answers 503 +
+                                Retry-After (docs/ROBUSTNESS.md)
     POST /score              -> body {"sequences": [[token ids], ...]}
                                 -> {"logprobs": [[float, ...], ...]}
                                 (per-token next-token logprobs — the
@@ -286,6 +290,7 @@ class _Handler(BaseHTTPRequestHandler):
             req_fpen = payload.get("frequency_penalty")
             req_ppen = payload.get("presence_penalty")
             req_bias = payload.get("logit_bias")
+            req_deadline = payload.get("deadline_s")
             want_logprobs = bool(payload.get("logprobs"))
             if (
                 temperature is not None
@@ -301,13 +306,15 @@ class _Handler(BaseHTTPRequestHandler):
                 or req_fpen is not None
                 or req_ppen is not None
                 or req_bias is not None
+                or req_deadline is not None
                 or want_logprobs
             ) and self.gen_engine is None:
                 raise ValueError(
                     "per-request temperature/max_new_tokens/eos_id/"
                     "adapter/stop/n/top_k/top_p/min_p/seed/penalties/"
-                    "logprobs require --gen-engine continuous (the "
-                    "fixed path bakes decode params at startup)"
+                    "logprobs/deadline_s require --gen-engine "
+                    "continuous (the fixed path bakes decode params "
+                    "at startup)"
                 )
             if temperature is not None:
                 temperature = float(temperature)
@@ -342,6 +349,8 @@ class _Handler(BaseHTTPRequestHandler):
                 req_bias = {
                     int(t): float(v) for t, v in dict(req_bias).items()
                 }
+            if req_deadline is not None:
+                req_deadline = float(req_deadline)
             if n_samples is not None:
                 n_samples = int(n_samples)
                 if not 1 <= n_samples <= 16:
@@ -391,10 +400,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._engine_stream(
                 prompts[0], temperature, max_new, eos_id, want_logprobs,
                 adapter, stop, req_top_k, req_top_p, req_seed,
-                req_min_p, req_fpen, req_ppen, req_bias,
+                req_min_p, req_fpen, req_ppen, req_bias, req_deadline,
             )
             return
-        from tensorflowonspark_tpu.serving import EngineOverloaded
+        from tensorflowonspark_tpu.serving import (
+            DeadlineExceeded,
+            EngineOverloaded,
+            EngineWedged,
+        )
 
         logprobs = None
         try:
@@ -406,7 +419,7 @@ class _Handler(BaseHTTPRequestHandler):
                         fan, temperature, max_new, eos_id,
                         want_logprobs, adapter, stop, req_top_k,
                         req_top_p, req_seed, req_min_p, req_fpen,
-                        req_ppen, req_bias,
+                        req_ppen, req_bias, req_deadline,
                     )
                     if want_logprobs:
                         completions, logprobs = completions
@@ -425,6 +438,20 @@ class _Handler(BaseHTTPRequestHandler):
                                 for i in range(len(prompts))
                             ]
                 except EngineOverloaded as e:
+                    self._reply(
+                        503, {"error": str(e)}, {"Retry-After": "1"}
+                    )
+                    return
+                except DeadlineExceeded as e:
+                    # the documented degradation contract: an expired
+                    # per-request budget is a gateway-timeout class
+                    # outcome, not a server defect
+                    self._reply(504, {"error": str(e)})
+                    return
+                except EngineWedged as e:
+                    # the watchdog aborted in-flight work and the engine
+                    # keeps serving — a retryable unavailability, not a
+                    # generic 500
                     self._reply(
                         503, {"error": str(e)}, {"Retry-After": "1"}
                     )
@@ -517,6 +544,7 @@ class _Handler(BaseHTTPRequestHandler):
         frequency_penalty=None,
         presence_penalty=None,
         logit_bias=None,
+        deadline_s=None,
     ) -> None:
         """Stream one completion as newline-delimited JSON: a
         ``{"token": t}`` line per decoded token (one engine step of
@@ -542,6 +570,7 @@ class _Handler(BaseHTTPRequestHandler):
                 frequency_penalty=frequency_penalty,
                 presence_penalty=presence_penalty,
                 logit_bias=logit_bias,
+                deadline_s=deadline_s,
             )
         except EngineOverloaded as e:
             self._reply(503, {"error": str(e)}, {"Retry-After": "1"})
@@ -612,6 +641,7 @@ class _Handler(BaseHTTPRequestHandler):
         frequency_penalty=None,
         presence_penalty=None,
         logit_bias=None,
+        deadline_s=None,
     ):
         """Continuous-batching path: the request's rows are admitted
         ATOMICALLY (all accepted, or a 400/503 before any decodes — a
@@ -633,6 +663,7 @@ class _Handler(BaseHTTPRequestHandler):
             frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty,
             logit_bias=logit_bias,
+            deadline_s=deadline_s,
         )
 
 
@@ -970,6 +1001,10 @@ def _build_engine(gen: dict):
         pipeline_depth=(
             2 if gen.get("pipeline_depth") is None
             else int(gen["pipeline_depth"])
+        ),
+        watchdog_s=(
+            None if gen.get("watchdog_s") is None
+            else float(gen["watchdog_s"])
         ),
     )
     if gen.get("warmup"):
@@ -1380,6 +1415,17 @@ def main(argv: list[str] | None = None) -> int:
         "ceil(len/chunk) chunks, not the full width bucket); default: "
         "whole-bucket prefill",
     )
+    p.add_argument(
+        "--gen-watchdog",
+        type=float,
+        default=None,
+        help="continuous engine: abort in-flight requests (terminal "
+        "EngineWedged) and keep serving when the scheduler makes no "
+        "progress for this many seconds with work in flight — a "
+        "wedged device transfer must not hang every caller forever. "
+        "Use with --gen-warmup (first compiles look like stalls; "
+        "warmup itself is exempt). Default: disabled",
+    )
     args = p.parse_args(argv)
     if args.export_dir is None and args.llama_checkpoint is None:
         p.error("need --export-dir and/or --llama-checkpoint")
@@ -1413,6 +1459,7 @@ def main(argv: list[str] | None = None) -> int:
             prefix_cache=args.gen_prefix_cache,
             decode_block=args.gen_decode_block,
             pipeline_depth=args.gen_pipeline_depth,
+            watchdog_s=args.gen_watchdog,
             warmup=args.gen_warmup,
             lora_scale=args.gen_lora_scale,
             drain_on_shutdown=args.gen_drain_on_shutdown,

@@ -1,0 +1,303 @@
+"""Failpoint registry: deterministic fault injection at named sites.
+
+The recovery story (liveness plane, retry discipline, engine watchdog —
+see ``docs/ROBUSTNESS.md``) is only trustworthy if it can be *exercised*:
+a fault path that has never fired is a fault path that does not work.
+This module gives every load-bearing failure site a NAME, and lets tests
+(or an operator reproducing an incident) arm that site to raise, delay,
+or drop — count- or probability-gated, with a seeded RNG so chaos runs
+are reproducible.
+
+Design constraints, in order:
+
+1. **Zero cost disarmed.** ``failpoint("x")`` with nothing armed is one
+   global truthiness check (sub-µs; asserted by a tier-1 micro-bench in
+   ``tests/test_chaos.py``) — it is threaded through hot paths
+   (engine dispatch/fetch, feed pulls) and must stay invisible there.
+2. **Registered literal names only.** Sites are declared in :data:`SITES`
+   and call sites must pass a literal from it (``tools/tfoslint.py``
+   rule FP001 enforces this), so ``TFOS_FAILPOINTS=resrvation.register=…``
+   cannot silently no-op on a typo: :func:`arm` rejects unknown names.
+3. **Deterministic.** ``count`` gates trip exactly-N-times semantics;
+   ``probability`` draws from a per-arm ``random.Random(seed)``.
+
+Arming::
+
+    failpoints.arm("reservation.call", "raise", exc=ConnectionError,
+                   count=2)                      # first 2 hits raise
+    failpoints.arm("engine.fetch", "delay", delay_s=1.5, count=1)
+    failpoints.arm("node.close_feed", "drop")    # site-defined skip
+
+or from the environment (parsed once at import, same grammar per spec,
+``;``-separated)::
+
+    TFOS_FAILPOINTS="reservation.call=raise:ConnectionError*2;engine.fetch=delay:1.5*1"
+
+Spec grammar: ``site=kind[:param][*count][~probability][@seed]`` where
+``param`` is the exception class name for ``raise`` (default
+:class:`FailpointError`) or the sleep seconds for ``delay``.
+
+Call sites::
+
+    failpoints.failpoint("reservation.register")        # raise/delay
+    if failpoints.failpoint("node.close_feed") == "drop":
+        return                                          # drop-aware site
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "SITES",
+    "FailpointError",
+    "arm",
+    "arm_from_spec",
+    "armed",
+    "disarm",
+    "disarm_all",
+    "failpoint",
+]
+
+# The registered failure sites. Adding a site means: add the literal
+# here, thread ``failpoint("<name>")`` through the code path, and
+# document it in docs/ROBUSTNESS.md. tfoslint rule FP001 fails the
+# build on a call site whose name is not in this set.
+SITES = frozenset(
+    {
+        # control plane
+        "reservation.register",  # Client.register, before the RPC
+        "reservation.call",  # every Client._call connect+roundtrip
+        "reservation.heartbeat",  # Client.heartbeat, before the RPC
+        "node.startup",  # run_node, before manager/reservation
+        "node.close_feed",  # _push_end_of_feed per queue ("drop" aware)
+        # data plane
+        "datafeed.get",  # DataFeed._next_raw queue pull
+        "datafeed.put_results",  # DataFeed.batch_results push
+        "prefetch.producer",  # DevicePrefetcher producer thread
+        # serving plane
+        "engine.submit",  # ContinuousBatcher enqueue (caller thread)
+        "engine.dispatch",  # scheduler, before a decode-block dispatch
+        "engine.fetch",  # scheduler, before a block fetch
+        # checkpoint plane
+        "checkpoint.save",  # orbax save (inside the retry)
+        "checkpoint.restore",  # orbax restore (inside the retry)
+    }
+)
+
+# Exceptions an env spec may name (a curated map, not eval()).
+_EXC_BY_NAME: dict[str, type[BaseException]] = {
+    "ConnectionError": ConnectionError,
+    "IOError": IOError,
+    "OSError": OSError,
+    "RuntimeError": RuntimeError,
+    "TimeoutError": TimeoutError,
+    "ValueError": ValueError,
+}
+
+
+class FailpointError(RuntimeError):
+    """Default exception an armed ``raise`` site throws."""
+
+
+class _Arm:
+    __slots__ = ("site", "kind", "exc", "delay_s", "count", "probability", "rng")
+
+    def __init__(
+        self,
+        site: str,
+        kind: str,
+        exc: type[BaseException] | BaseException | None,
+        delay_s: float,
+        count: int | None,
+        probability: float | None,
+        seed: int | None,
+    ):
+        self.site = site
+        self.kind = kind
+        self.exc = exc
+        self.delay_s = delay_s
+        self.count = count  # remaining trips; None = unlimited
+        self.probability = probability
+        self.rng = random.Random(seed if seed is not None else 0)
+
+
+_armed: dict[str, _Arm] = {}  # guarded-by: _lock
+_lock = threading.Lock()
+# The disarmed fast path reads ONLY this flag — deliberately without
+# the lock (a stale read is benign: at worst one hit right at arm time
+# misses, and hits after the arm's memory settles always see it). Kept
+# separate from _armed so the dict itself stays strictly lock-guarded.
+_any_armed: bool = False
+
+
+def failpoint(name: str) -> str | None:
+    """Hit a failpoint site. Disarmed (the overwhelmingly common case):
+    one global truthiness check, no locking, returns None. Armed: apply
+    the site's action — raise its exception, sleep its delay, or return
+    ``"drop"`` for the call site to interpret."""
+    if not _any_armed:
+        return None
+    return _trip(name)
+
+
+def _trip(name: str) -> str | None:
+    global _any_armed
+    with _lock:
+        a = _armed.get(name)
+        if a is None:
+            return None
+        if a.probability is not None and a.rng.random() >= a.probability:
+            return None
+        if a.count is not None:
+            a.count -= 1
+            if a.count <= 0:
+                del _armed[name]
+                _any_armed = bool(_armed)
+        kind, exc, delay_s = a.kind, a.exc, a.delay_s
+    _trips_counter().inc(site=name, action=kind)
+    logger.warning("failpoint %r tripped (%s)", name, kind)
+    if kind == "raise":
+        if exc is None:
+            raise FailpointError(f"failpoint {name!r} armed")
+        if isinstance(exc, BaseException):
+            raise exc
+        raise exc(f"failpoint {name!r} armed")
+    if kind == "delay":
+        time.sleep(delay_s)
+        return None
+    return "drop"
+
+
+def arm(
+    name: str,
+    action: str = "raise",
+    *,
+    exc: type[BaseException] | BaseException | None = None,
+    delay_s: float = 0.0,
+    count: int | None = None,
+    probability: float | None = None,
+    seed: int | None = None,
+) -> None:
+    """Arm a registered site. ``count``: trip at most N times then
+    auto-disarm. ``probability``: trip each hit with this probability
+    (seeded — pass ``seed`` for a reproducible sequence). Unknown site
+    names are a loud error, never a silent no-op."""
+    if name not in SITES:
+        raise ValueError(
+            f"unknown failpoint site {name!r}; registered sites: "
+            f"{sorted(SITES)}"
+        )
+    if action not in ("raise", "delay", "drop"):
+        raise ValueError(f"unknown failpoint action {action!r}")
+    if count is not None and count < 1:
+        raise ValueError(f"count must be >= 1, got {count}")
+    if probability is not None and not 0.0 < probability <= 1.0:
+        raise ValueError(f"probability must be in (0, 1], got {probability}")
+    if action == "delay" and delay_s < 0:
+        raise ValueError(f"delay_s must be >= 0, got {delay_s}")
+    global _any_armed
+    with _lock:
+        _armed[name] = _Arm(name, action, exc, delay_s, count, probability, seed)
+        _any_armed = True
+
+
+def disarm(name: str) -> None:
+    global _any_armed
+    with _lock:
+        _armed.pop(name, None)
+        _any_armed = bool(_armed)
+
+
+def disarm_all() -> None:
+    global _any_armed
+    with _lock:
+        _armed.clear()
+        _any_armed = False
+
+
+def armed() -> list[str]:
+    """Currently armed site names (for /stats-style surfaces and tests)."""
+    with _lock:
+        return sorted(_armed)
+
+
+def arm_from_spec(spec: str) -> list[str]:
+    """Arm sites from a ``TFOS_FAILPOINTS``-grammar string; returns the
+    site names armed. Grammar per ``;``-separated entry:
+    ``site=kind[:param][*count][~probability][@seed]``."""
+    armed_now: list[str] = []
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, _, rest = entry.partition("=")
+        site = site.strip()
+        if not rest:
+            raise ValueError(f"failpoint spec {entry!r} missing '=action'")
+        seed = None
+        if "@" in rest:
+            rest, _, s = rest.rpartition("@")
+            seed = int(s)
+        probability = None
+        if "~" in rest:
+            rest, _, p = rest.rpartition("~")
+            probability = float(p)
+        count = None
+        if "*" in rest:
+            rest, _, c = rest.rpartition("*")
+            count = int(c)
+        kind, _, param = rest.partition(":")
+        kind = kind.strip()
+        exc: type[BaseException] | None = None
+        delay_s = 0.0
+        if kind == "raise" and param:
+            try:
+                exc = _EXC_BY_NAME[param]
+            except KeyError:
+                raise ValueError(
+                    f"failpoint spec {entry!r}: unknown exception "
+                    f"{param!r} (one of {sorted(_EXC_BY_NAME)})"
+                ) from None
+        elif kind == "delay":
+            delay_s = float(param) if param else 0.0
+        arm(
+            site,
+            kind,
+            exc=exc,
+            delay_s=delay_s,
+            count=count,
+            probability=probability,
+            seed=seed,
+        )
+        armed_now.append(site)
+    return armed_now
+
+
+def _trips_counter():
+    """The obs-registry trip counter, resolved lazily so importing this
+    module never drags in the obs package on the disarmed path."""
+    from tensorflowonspark_tpu.obs.registry import default_registry
+
+    return default_registry().counter(
+        "failpoint_trips_total", "armed failpoint trips, by site and action"
+    )
+
+
+_env_spec = os.environ.get("TFOS_FAILPOINTS", "")
+if _env_spec:
+    try:
+        logger.warning(
+            "TFOS_FAILPOINTS armed: %s", arm_from_spec(_env_spec)
+        )
+    except ValueError:
+        # A typo'd env spec must fail the process loudly, not no-op:
+        # an operator who armed chaos wants chaos, not a healthy run.
+        raise

@@ -1,0 +1,141 @@
+"""Retry discipline: exponential backoff with full jitter, deadline-aware.
+
+Before this module every transient-failure site in the repo either died
+on the first error (reservation connects during a coordinator restart,
+orbax IO against a flaky shared filesystem) or hand-rolled its own
+``while``/``sleep`` loop. :class:`RetryPolicy` centralizes the policy —
+the AWS-style *full jitter* schedule (``uniform(0, min(cap, base·mult^i))``,
+which de-synchronizes retry herds better than equal or decorrelated
+jitter for the same worst-case delay) plus an overall deadline so a
+retry loop can never outlive the budget its caller is accountable to.
+
+Retries are observable: every sleep increments
+``retry_attempts_total{site=...}`` in the process-global obs registry,
+so a cluster quietly riding through connect flaps shows up on the node
+``/metrics`` endpoints instead of only in debug logs.
+
+Seeded (``seed=``) the jitter sequence is deterministic — chaos tests
+assert exact schedules instead of sleeping through real backoff.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Any, Callable, Iterator
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["RetryPolicy", "DEFAULT_RETRYABLE"]
+
+# The transient-failure classes network/IO sites retry by default.
+# FailpointError is deliberately NOT here: a site opts into retrying
+# injected faults by naming it in retry_on (chaos tests rely on that).
+DEFAULT_RETRYABLE: tuple[type[BaseException], ...] = (
+    ConnectionError,
+    TimeoutError,
+    OSError,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Immutable retry schedule; share one instance across call sites.
+
+    ``max_attempts`` counts *calls* (1 = no retries). ``deadline_s``
+    bounds the whole :meth:`call` — elapsed time plus the next planned
+    sleep must fit inside it, so a policy can never sleep through its
+    budget and then fail anyway. ``seed`` pins the jitter RNG (tests);
+    None draws system entropy per :meth:`call`.
+    """
+
+    max_attempts: int = 5
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    multiplier: float = 2.0
+    deadline_s: float | None = None
+    seed: int | None = None
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {self.max_attempts}")
+        if self.base_delay < 0 or self.max_delay < 0 or self.multiplier < 1:
+            raise ValueError(
+                f"invalid backoff shape (base={self.base_delay}, "
+                f"max={self.max_delay}, multiplier={self.multiplier})"
+            )
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {self.deadline_s}")
+
+    def delays(self, rng: random.Random | None = None) -> Iterator[float]:
+        """The jittered backoff schedule: one delay per retry (so
+        ``max_attempts - 1`` values). Full jitter — each delay is
+        uniform over ``[0, min(max_delay, base·multiplier^i)]``."""
+        rng = rng if rng is not None else random.Random(self.seed)
+        for i in range(self.max_attempts - 1):
+            cap = min(self.max_delay, self.base_delay * self.multiplier**i)
+            yield rng.uniform(0.0, cap)
+
+    def call(
+        self,
+        fn: Callable[[], Any],
+        *,
+        retry_on: tuple[type[BaseException], ...] = DEFAULT_RETRYABLE,
+        site: str = "",
+        sleep: Callable[[float], None] = time.sleep,
+        on_retry: Callable[[int, BaseException, float], None] | None = None,
+    ) -> Any:
+        """Run ``fn()`` under this policy.
+
+        Retries only on ``retry_on`` exceptions; anything else (and the
+        last retryable failure once attempts or deadline are exhausted)
+        propagates unchanged so callers keep their original error
+        classes. ``site`` labels the ``retry_attempts_total`` series and
+        the warning log; ``on_retry(attempt, exc, delay)`` is a test
+        hook. Deadline clipping: a sleep is trimmed to the remaining
+        budget, and once the budget is spent the failure propagates
+        immediately — no retry fires at or past the deadline.
+        """
+        rng = random.Random(self.seed)
+        deadline = (
+            None if self.deadline_s is None else time.monotonic() + self.deadline_s
+        )
+        schedule = self.delays(rng)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return fn()
+            except retry_on as e:
+                delay = next(schedule, None)
+                if delay is None:  # attempts exhausted
+                    raise
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise
+                    delay = min(delay, remaining)
+                _retry_counter().inc(site=site or "unlabeled")
+                logger.warning(
+                    "retry %d/%d%s after %s: %s (backoff %.3fs)",
+                    attempt,
+                    self.max_attempts,
+                    f" [{site}]" if site else "",
+                    type(e).__name__,
+                    e,
+                    delay,
+                )
+                if on_retry is not None:
+                    on_retry(attempt, e, delay)
+                sleep(delay)
+
+
+def _retry_counter():
+    from tensorflowonspark_tpu.obs.registry import default_registry
+
+    return default_registry().counter(
+        "retry_attempts_total",
+        "transient-failure retries taken, by call site",
+    )

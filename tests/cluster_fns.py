@@ -387,6 +387,15 @@ def always_crash_fn(args, ctx):
     os._exit(7)
 
 
+def sleepy_fn(args, ctx):
+    """TENSORFLOW-mode map_fun that just sleeps — the SIGKILL target for
+    the liveness-plane chaos tests (a killed node must be detected by
+    missed heartbeats, not by a feed/shutdown timeout)."""
+    import time
+
+    time.sleep(float(args.get("sleep", 120)))
+
+
 def _tiny_llama_fsdp_setup(logit_chunk=None):
     """Shared recipe for the multi-controller FSDP Llama tests: a tiny
     fp32 Llama with params + bf16-moment Adam state sharded over ALL

@@ -1,0 +1,15 @@
+"""Fixture: seeded FP001 violations — a dynamic failpoint site name and
+an unregistered literal (the typo that would make TFOS_FAILPOINTS
+silently no-op)."""
+
+from tensorflowonspark_tpu.utils.failpoints import failpoint
+
+SITE = "reservation.register"
+
+
+def dynamic_site():
+    failpoint(SITE)  # SEEDED VIOLATION FP001: non-literal site name
+
+
+def typo_site():
+    failpoint("reservation.regster")  # SEEDED VIOLATION FP001: unregistered

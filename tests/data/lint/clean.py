@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tensorflowonspark_tpu.utils import compat
+from tensorflowonspark_tpu.utils.failpoints import failpoint
 
 
 class Guarded:
@@ -41,6 +42,17 @@ def cross_object(a: Guarded, b: Guarded) -> None:
 def uses_compat(f, mesh, spec):
     # the sanctioned spelling of a moved symbol
     return compat.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+
+
+def registered_failpoint_site():
+    # a literal name present in utils/failpoints.py SITES: not flagged
+    failpoint("reservation.register")
+
+
+def unrelated_failpoint_helper(failpoint_map, key):
+    # same spelling, different function: a method named failpoint on an
+    # unrelated object must not be import-confused into FP001
+    return failpoint_map.failpoint(key)
 
 
 def hot_but_clean(batch):

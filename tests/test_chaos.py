@@ -1,0 +1,608 @@
+"""Chaos harness: seeded failpoints + retry policy + liveness plane +
+serving degradation, end to end.
+
+Tier-1 scope (fast, deterministic): the failpoint registry's semantics
+and disarmed cost, RetryPolicy's jitter/deadline math, heartbeat-based
+dead-node detection against an in-process reservation server,
+feed-plane FeedTimeout, producer fault ferrying, checkpoint IO retries,
+and the engine's watchdog/deadline degradation under injected stalls.
+
+Slow/e2e scope: a REAL node process SIGKILLed mid-run must be detected
+within the heartbeat grace — from both the SPARK-mode feed path
+(``TFCluster.train``) and the supervised TENSORFLOW-mode path
+(``TFCluster.supervise``) — mirroring test_tfcluster's hard-crash
+pattern.
+"""
+
+import os
+import queue as _stdqueue
+import random
+import signal
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu.utils import failpoints as fp
+from tensorflowonspark_tpu.utils.failpoints import FailpointError, failpoint
+from tensorflowonspark_tpu.utils.retry import RetryPolicy
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.disarm_all()
+    yield
+    fp.disarm_all()
+
+
+# -- failpoint registry -----------------------------------------------------
+
+
+def test_failpoint_unknown_site_rejected():
+    with pytest.raises(ValueError, match="unknown failpoint site"):
+        fp.arm("reservation.regster")  # the typo FP001 also catches
+
+
+def test_failpoint_raise_count_gated():
+    fp.arm("reservation.register", "raise", count=2)
+    for _ in range(2):
+        with pytest.raises(FailpointError):
+            failpoint("reservation.register")
+    # auto-disarmed after the budgeted trips
+    assert failpoint("reservation.register") is None
+    assert fp.armed() == []
+
+
+def test_failpoint_probability_seeded_deterministic():
+    fp.arm("datafeed.get", "raise", probability=0.5, seed=7)
+    got = []
+    for _ in range(12):
+        try:
+            failpoint("datafeed.get")
+            got.append(False)
+        except FailpointError:
+            got.append(True)
+    rng = random.Random(7)
+    want = [rng.random() < 0.5 for _ in range(12)]
+    assert got == want
+    assert any(got) and not all(got)
+
+
+def test_failpoint_drop_and_delay_actions():
+    fp.arm("node.close_feed", "drop")
+    assert failpoint("node.close_feed") == "drop"
+    fp.disarm("node.close_feed")
+    fp.arm("engine.dispatch", "delay", delay_s=0.05, count=1)
+    t0 = time.monotonic()
+    assert failpoint("engine.dispatch") is None
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_failpoint_env_spec_grammar():
+    armed = fp.arm_from_spec(
+        "engine.fetch=delay:0.25*2; reservation.call=raise:ConnectionError~0.5@7"
+    )
+    assert armed == ["engine.fetch", "reservation.call"]
+    assert fp.armed() == ["engine.fetch", "reservation.call"]
+    with pytest.raises(ConnectionError):
+        while True:  # probability-gated: loop until the seeded trip
+            failpoint("reservation.call")
+    fp.disarm_all()
+    with pytest.raises(ValueError, match="unknown failpoint site"):
+        fp.arm_from_spec("not.a.site=raise")
+    with pytest.raises(ValueError, match="unknown exception"):
+        fp.arm_from_spec("engine.fetch=raise:SystemExit")
+
+
+def test_failpoint_disarmed_overhead_under_a_microsecond():
+    """Acceptance: a disarmed failpoint() is one global check — budget
+    ~1 µs/call so threading sites through hot paths costs nothing."""
+    assert fp.armed() == []
+    n = 200_000
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            failpoint("engine.fetch")
+        best = min(best, (time.perf_counter() - t0) / n)
+    # ~100 ns in practice; 1.5 µs bound absorbs shared-host noise while
+    # still failing loudly if someone adds locking/lookup to the fast
+    # path
+    assert best < 1.5e-6, f"disarmed failpoint costs {best * 1e9:.0f}ns/call"
+
+
+# -- retry policy -----------------------------------------------------------
+
+
+def test_retry_jitter_bounds_and_seeding():
+    pol = RetryPolicy(
+        max_attempts=6, base_delay=0.1, max_delay=0.4, multiplier=2.0, seed=42
+    )
+    delays = list(pol.delays())
+    assert len(delays) == 5  # one per retry
+    for i, d in enumerate(delays):
+        cap = min(0.4, 0.1 * 2.0**i)
+        assert 0.0 <= d <= cap, (i, d, cap)
+    # seeded → reproducible; different seed → different schedule
+    assert delays == list(pol.delays())
+    other = RetryPolicy(
+        max_attempts=6, base_delay=0.1, max_delay=0.4, multiplier=2.0, seed=43
+    )
+    assert delays != list(other.delays())
+
+
+def test_retry_call_retries_then_succeeds_and_counts():
+    from tensorflowonspark_tpu.obs.registry import default_registry
+
+    counter = default_registry().counter("retry_attempts_total")
+    before = counter.value(site="chaos.test")
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("flap")
+        return "ok"
+
+    slept = []
+    pol = RetryPolicy(max_attempts=5, base_delay=0.01, seed=0)
+    assert (
+        pol.call(flaky, site="chaos.test", sleep=slept.append) == "ok"
+    )
+    assert calls["n"] == 3 and len(slept) == 2
+    assert counter.value(site="chaos.test") == before + 2
+
+
+def test_retry_non_retryable_propagates_immediately():
+    pol = RetryPolicy(max_attempts=5, base_delay=0.01, seed=0)
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        pol.call(bad, sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_retry_deadline_clips_sleeps_and_stops():
+    """Deadline-aware: sleeps never exceed the remaining budget and no
+    retry fires once the budget is spent — the original error class
+    propagates."""
+    pol = RetryPolicy(
+        max_attempts=10,
+        base_delay=5.0,
+        max_delay=5.0,
+        deadline_s=0.3,
+        seed=1,
+    )
+    slept = []
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        pol.call(
+            lambda: (_ for _ in ()).throw(ConnectionError("down")),
+            sleep=lambda s: (slept.append(s), time.sleep(s)),
+        )
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.0, f"deadline did not clip ({elapsed:.2f}s)"
+    assert slept, "expected at least one clipped retry sleep"
+    assert all(s <= 0.3 + 1e-6 for s in slept), slept
+    assert len(slept) < 9, "deadline must stop the schedule early"
+
+
+# -- liveness plane (in-process reservation server) -------------------------
+
+
+def test_heartbeat_dead_node_detection():
+    from tensorflowonspark_tpu.cluster import reservation
+
+    srv = reservation.Server(2)
+    addr = srv.start()
+    try:
+        client = reservation.Client(
+            addr, retry=RetryPolicy(max_attempts=2, base_delay=0.01)
+        )
+        client.register({"executor_id": 0, "host": "a"})
+        client.register({"executor_id": 1, "host": "b"})
+        assert srv.dead_nodes(grace=5.0) == []
+        time.sleep(0.45)
+        client.heartbeat(0)  # node 0 beats; node 1 goes silent
+        assert srv.dead_nodes(grace=0.4) == [1]
+        assert srv.dead_nodes(grace=30.0) == []
+        # a late beat resurrects: liveness is last-seen, not a latch
+        client.heartbeat(1)
+        assert srv.dead_nodes(grace=0.4) == []
+    finally:
+        srv.stop()
+
+
+def test_heartbeater_thread_keeps_node_alive():
+    from tensorflowonspark_tpu.cluster import node as tfnode
+    from tensorflowonspark_tpu.cluster import reservation
+
+    srv = reservation.Server(1)
+    addr = srv.start()
+    try:
+        client = reservation.Client(addr)
+        client.register({"executor_id": 0, "host": "a"})
+        tfnode._start_heartbeater(addr, 0, interval=0.1)
+        time.sleep(0.6)
+        # beats every 0.1s → never silent for 0.3s
+        assert srv.dead_nodes(grace=0.3) == []
+    finally:
+        srv.stop()
+
+
+def test_reservation_connect_flap_absorbed_by_retry():
+    """Acceptance: connect flaps are absorbed by backoff — the client
+    RPC succeeds after injected ConnectionErrors, with the retries
+    visible on the obs counter."""
+    from tensorflowonspark_tpu.cluster import reservation
+    from tensorflowonspark_tpu.obs.registry import default_registry
+
+    counter = default_registry().counter("retry_attempts_total")
+    before = counter.value(site="reservation.call")
+    srv = reservation.Server(1)
+    addr = srv.start()
+    try:
+        client = reservation.Client(
+            addr, retry=RetryPolicy(max_attempts=4, base_delay=0.01, seed=3)
+        )
+        client.register({"executor_id": 0, "host": "a"})
+        fp.arm(
+            "reservation.call", "raise", exc=ConnectionError, count=2
+        )
+        roster = client.get_reservations()
+        assert [n["executor_id"] for n in roster] == [0]
+        assert counter.value(site="reservation.call") == before + 2
+    finally:
+        srv.stop()
+
+
+def test_reservation_register_idempotent_on_replay():
+    """A retried REG whose first attempt landed must update, not
+    duplicate — otherwise the replay completes the barrier with a node
+    missing."""
+    from tensorflowonspark_tpu.cluster import reservation
+
+    res = reservation.Reservations(2)
+    res.add({"executor_id": 0, "host": "a"})
+    res.add({"executor_id": 0, "host": "a", "port": 99})  # the replay
+    assert not res.done()
+    assert res.get() == [{"executor_id": 0, "host": "a", "port": 99}]
+
+
+# -- feed plane -------------------------------------------------------------
+
+
+class _FakeMgr:
+    """Just enough of ManagerHandle for DataFeed: named queues + KV."""
+
+    def __init__(self):
+        self._qs = {"input": _stdqueue.Queue(), "output": _stdqueue.Queue()}
+        self._kv = {}
+
+    def get_queue(self, qname):
+        return self._qs[qname]
+
+    def get(self, key):
+        return self._kv.get(key)
+
+    def set(self, key, value):
+        self._kv[key] = value
+
+
+def test_feed_timeout_names_queue_and_worker():
+    from tensorflowonspark_tpu.feed.datafeed import DataFeed, FeedTimeout
+
+    feed = DataFeed(_FakeMgr(), feed_timeout=0.3, worker_index=3)
+    t0 = time.monotonic()
+    with pytest.raises(FeedTimeout, match=r"'input'.*worker 3"):
+        feed.next_batch(4)
+    assert 0.2 < time.monotonic() - t0 < 5.0
+
+
+def test_feed_timeout_policy_from_manager_kv():
+    """The driver publishes feed_timeout into the manager KV
+    (TFCluster.train does this per worker); an unpinned DataFeed reads
+    it instead of the 600 s default."""
+    from tensorflowonspark_tpu.feed.datafeed import DataFeed, FeedTimeout
+
+    mgr = _FakeMgr()
+    mgr.set("feed_timeout", 0.2)
+    feed = DataFeed(mgr)
+    assert feed.feed_timeout == 0.2
+    with pytest.raises(FeedTimeout):
+        feed.next_batch(1)
+
+
+def test_feed_pull_failpoint_raises_into_consumer():
+    from tensorflowonspark_tpu.feed.datafeed import DataFeed
+
+    feed = DataFeed(_FakeMgr(), feed_timeout=5.0)
+    fp.arm("datafeed.get", "raise", count=1)
+    with pytest.raises(FailpointError):
+        feed.next_batch(2)
+
+
+def test_prefetch_producer_fault_ferries_to_consumer():
+    from tensorflowonspark_tpu.feed.prefetch import DevicePrefetcher
+
+    fp.arm("prefetch.producer", "raise", count=1)
+    pf = DevicePrefetcher(iter([1, 2, 3]), transform=lambda b: b)
+    try:
+        with pytest.raises(FailpointError):
+            next(pf)
+    finally:
+        pf.close()
+    # a fresh (disarmed) prefetcher over the same source works
+    with DevicePrefetcher(iter([4, 5]), transform=lambda b: b) as pf2:
+        assert list(pf2) == [4, 5]
+
+
+# -- checkpoint plane -------------------------------------------------------
+
+
+def test_checkpoint_save_retry_absorbs_injected_fault(tmp_path):
+    from tensorflowonspark_tpu.compute import checkpoint as ck
+    from tensorflowonspark_tpu.obs.registry import default_registry
+
+    counter = default_registry().counter("retry_attempts_total")
+    before = counter.value(site="checkpoint.save")
+    fp.arm("checkpoint.save", "raise", exc=OSError, count=1)
+    import numpy as np
+
+    path = ck.save_checkpoint(
+        str(tmp_path / "s1"), {"a": np.arange(3, dtype=np.float32)}
+    )
+    assert counter.value(site="checkpoint.save") == before + 1
+    restored = ck.restore_checkpoint(path)
+    assert restored["a"].tolist() == [0.0, 1.0, 2.0]
+
+
+def test_checkpoint_numpy_scalar_leaves_roundtrip(tmp_path):
+    """The orbax env-drift fix: np scalar leaves (np.float32 metrics
+    values etc.) canonicalize to 0-d arrays at save instead of tripping
+    StandardSave's type validator."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.compute import checkpoint as ck
+
+    state = {
+        "w": np.arange(4, dtype=np.float32),
+        "lr": np.float32(-1.0),
+        "step": np.int64(7),
+        "flag": np.bool_(True),
+        "plain": 2.5,
+    }
+    path = ck.save_checkpoint(str(tmp_path / "scalars"), state)
+    out = ck.restore_checkpoint(path)
+    assert float(out["lr"]) == -1.0 and int(out["step"]) == 7
+    assert bool(out["flag"]) is True and out["plain"] == 2.5
+
+
+def test_checkpoint_manager_restore_fresh_process_shim(tmp_path):
+    """The KeyError-'default' drift: an args-less restore on a manager
+    that never saved in this process must still return the tree (the
+    StandardRestore compat shim)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.compute import checkpoint as ck
+
+    with ck.CheckpointManager(str(tmp_path), async_save=False) as mgr:
+        assert mgr.save(3, {"a": np.arange(2, dtype=np.float32)}, force=True)
+    fresh = ck.CheckpointManager(str(tmp_path), async_save=False)
+    try:
+        out = fresh.restore(3)
+        assert out["a"].tolist() == [0.0, 1.0]
+    finally:
+        fresh.close()
+
+
+# -- serving degradation ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=False)
+    model = Llama(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, model, params
+
+
+def test_engine_fetch_stall_fires_watchdog_then_recovers(tiny):
+    """Acceptance: an armed engine-fetch stall fires the watchdog —
+    the in-flight request fails with a terminal EngineWedged well
+    before the stall ends — and the engine keeps serving afterwards."""
+    from tensorflowonspark_tpu.serving import ContinuousBatcher, EngineWedged
+
+    _, model, params = tiny
+    eng = ContinuousBatcher(
+        model, params, slots=2, prompt_widths=(8,), decode_block=2,
+        watchdog_s=0.4,
+    )
+    try:
+        eng.warmup()  # compiles exempt from the watchdog by design
+        baseline = eng.submit([1, 2, 3], 5)
+        fp.arm("engine.fetch", "delay", delay_s=2.0, count=1)
+        t0 = time.monotonic()
+        with pytest.raises(EngineWedged, match="no progress"):
+            eng.submit([1, 2, 3], 6)
+        detect = time.monotonic() - t0
+        assert detect < 1.5, f"watchdog took {detect:.2f}s (stall was 2s)"
+        assert eng.watchdog_fires == 1
+        assert (
+            eng.metrics.counter("engine_watchdog_fires_total").value() == 1
+        )
+        # the loop survived: same prompt, same tokens as before the fire
+        assert eng.submit([1, 2, 3], 5) == baseline
+        stats = eng.stats()
+        assert stats["watchdog_fires"] == 1
+        assert stats["closed"] is False
+    finally:
+        fp.disarm_all()
+        eng.close()
+    assert eng.stats()["stopped_cleanly"] is True
+
+
+def test_engine_deadline_expires_terminally(tiny):
+    from tensorflowonspark_tpu.serving import (
+        ContinuousBatcher,
+        DeadlineExceeded,
+    )
+
+    _, model, params = tiny
+    eng = ContinuousBatcher(
+        model, params, slots=2, prompt_widths=(8,), decode_block=2
+    )
+    try:
+        eng.warmup()
+        # slow every scheduler iteration a little so a 120-token budget
+        # cannot finish inside the 0.2 s deadline
+        fp.arm("engine.dispatch", "delay", delay_s=0.15, count=10)
+        with pytest.raises(DeadlineExceeded, match="deadline_s=0.2"):
+            eng.submit([4, 5], 120, deadline_s=0.2)
+        fp.disarm_all()
+        assert eng.stats()["deadline_expired"] == 1
+        assert (
+            eng.metrics.counter("engine_deadline_expired_total").value()
+            == 1
+        )
+        # engine healthy; unbounded requests unaffected
+        assert len(eng.submit([1, 2, 3], 4)) == 4
+        with pytest.raises(ValueError, match="deadline_s"):
+            eng.submit([1], 2, deadline_s=-1.0)
+    finally:
+        fp.disarm_all()
+        eng.close()
+
+
+def test_engine_submit_failpoint_rejects_cleanly(tiny):
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+
+    _, model, params = tiny
+    eng = ContinuousBatcher(model, params, slots=2, prompt_widths=(8,))
+    try:
+        fp.arm("engine.submit", "raise", count=1)
+        with pytest.raises(FailpointError):
+            eng.submit([1, 2], 2)
+        # nothing was accepted: drain accounting stays balanced
+        assert eng.stats()["queue_depth"] == 0
+        assert len(eng.submit([1, 2], 2)) == 2
+    finally:
+        fp.disarm_all()
+        eng.close()
+
+
+# -- kill a real node (slow) ------------------------------------------------
+
+from tensorflowonspark_tpu.utils.util import cpu_only_env  # noqa: E402
+
+NODE_ENV = cpu_only_env()
+
+
+def _node_pid(cluster, executor_id: int) -> int:
+    return next(
+        n["pid"]
+        for n in cluster.cluster_info
+        if n["executor_id"] == executor_id
+    )
+
+
+def _signal_after(pid: int, sig, delay: float) -> threading.Thread:
+    def fire():
+        time.sleep(delay)
+        os.kill(pid, sig)
+
+    t = threading.Thread(target=fire, daemon=True)
+    t.start()
+    return t
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+def test_wedged_node_detected_within_grace_mid_train(tmp_path):
+    """Acceptance: a dead-but-not-disconnected node mid-train surfaces
+    within the heartbeat grace (seconds), NOT the 600 s feed_timeout
+    the feeder thread is blocked under. SIGSTOP is the sharpest version
+    of this: the process is wedged, its TCP sockets stay open (so the
+    feed plane CANNOT notice — a SIGKILL would fail the feeder fast via
+    connection reset), and only missed heartbeats tell the truth."""
+    from tensorflowonspark_tpu.cluster import tfcluster
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+    from tests import cluster_fns
+
+    cluster = tfcluster.run(
+        cluster_fns.stalling_consumer_fn,
+        {},
+        num_executors=1,
+        input_mode=InputMode.SPARK,
+        reservation_timeout=120,
+        queue_maxsize=2,
+        use_shm_ring=False,
+        heartbeat_interval=0.5,
+        heartbeat_grace=3.0,
+        env=NODE_ENV,
+    )
+    pid = _node_pid(cluster, 0)
+    _signal_after(pid, signal.SIGSTOP, delay=2.0)
+    try:
+        partitions = [[(i,) for i in range(4096)]]
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="missed heartbeats"):
+            cluster.train(partitions, feed_timeout=600)
+        detect = time.monotonic() - t0
+        assert detect < 30, f"death detected after {detect:.0f}s (grace 3s)"
+    finally:
+        os.kill(pid, signal.SIGKILL)
+        cluster.launcher.terminate()
+        cluster.server.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+def test_supervise_detects_sigkill_within_grace(tmp_path):
+    """TENSORFLOW-mode supervision (the run_with_restarts watch loop):
+    dead_nodes() flips within the grace and supervise() raises, instead
+    of wedging until shutdown_timeout."""
+    from tensorflowonspark_tpu.cluster import tfcluster
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+    from tests import cluster_fns
+
+    cluster = tfcluster.run(
+        cluster_fns.sleepy_fn,
+        {"sleep": 120},
+        num_executors=2,
+        input_mode=InputMode.TENSORFLOW,
+        reservation_timeout=120,
+        heartbeat_interval=0.5,
+        heartbeat_grace=3.0,
+        env=NODE_ENV,
+    )
+    try:
+        pid = next(
+            n["pid"]
+            for n in cluster.cluster_info
+            if n["executor_id"] == 1
+        )
+        os.kill(pid, signal.SIGKILL)
+        t0 = time.monotonic()
+        # the heartbeat plane itself: dead within grace + margin
+        while not cluster.dead_nodes():
+            assert time.monotonic() - t0 < 15, "dead_nodes never flipped"
+            time.sleep(0.2)
+        assert cluster.dead_nodes() == [1]
+        with pytest.raises(RuntimeError, match="died mid-run|missed heartbeats"):
+            cluster.supervise(poll=0.5)
+        assert time.monotonic() - t0 < 30
+    finally:
+        cluster.launcher.terminate()
+        cluster.server.stop()

@@ -156,6 +156,30 @@ def test_tracer_leak_rules(fixture_findings):
     )
 
 
+def test_failpoint_rule_reports_seeded_violations(fixture_findings):
+    rel = f"{FIXTURES}/bad_failpoint.py"
+    hits = by_rule(fixture_findings, "FP001")
+    assert all(f.path == rel for f in hits), [f.render() for f in hits]
+    assert {f.line for f in hits} == {
+        _line_of("bad_failpoint.py", "failpoint(SITE)"),
+        _line_of("bad_failpoint.py", "reservation.regster"),
+    }, [f.render() for f in hits]
+    dynamic = [f for f in hits if "string literal" in f.message]
+    unregistered = [f for f in hits if "not registered" in f.message]
+    assert len(dynamic) == 1 and len(unregistered) == 1
+
+
+def test_failpoint_registry_matches_rule_view():
+    """The sites the FP rule validates against are exactly the runtime
+    registry — a drift here would let the rule pass names arm() then
+    rejects."""
+    from tensorflowonspark_tpu.analysis import failpoints as fp_rule
+    from tensorflowonspark_tpu.utils.failpoints import SITES
+
+    sites = fp_rule._registered_sites(ROOT, Config())
+    assert sites == set(SITES)
+
+
 def test_clean_fixture_zero_false_positives(fixture_findings):
     noise = [f for f in fixture_findings if f.path.endswith("clean.py")]
     assert not noise, [f.render() for f in noise]
