@@ -7,10 +7,14 @@ HDFS locality, so its driver shipped closures, not bytes. This bench
 quantifies that design's ceiling so DESIGN.md can state when to switch to
 pull mode (InputMode.TENSORFLOW + grain/tf.data sharding).
 
-What it measures, per (node count, path): wall time from the start of
-``cluster.train(close_feed=True)`` until ``shutdown()`` returns — i.e.
-until every node has DRAINED its feed, not merely until the driver
-buffered it into rings — for a fixed payload of pickled byte records.
+What it measures, per (node count, path, wire): wall time from the
+start of ``cluster.train(close_feed=True)`` until ``shutdown()``
+returns — i.e. until every node has DRAINED its feed into
+``{tensor: ndarray}`` batches through an ``input_mapping`` (the shape a
+train step consumes), not merely until the driver buffered it into
+rings — for a fixed payload of DISTINCT uint8-array records. (Distinct
+matters: identical record objects would let pickle's memoizer collapse
+a whole chunk to a few bytes and the row leg would measure nothing.)
 
 Paths:
 - ``shm``: the co-located fast path (``native/shmring.cc``).
@@ -22,10 +26,18 @@ Paths:
   locally; driver traffic is O(files), so this path's number is the
   node-local read rate, not a driver ceiling.
 
+Wires (ISSUE 5): ``columnar`` ships each chunk as one CRC-framed
+column frame (``feed/columnar.py``; scatter-pushed zero-copy on shm,
+one bytes payload on tcp, 64-aligned frame files on manifest);
+``row`` pins the legacy row-pickle wire (``columnar=False`` /
+lines-format manifests) — the before/after pair the results artifact
+records.
+
 Usage::
 
     python benchmarks/feed_plane.py [--nodes 1,2,4,8] [--mb-per-node 64]
-        [--record-kb 64] [--paths shm,tcp] [--json out.jsonl]
+        [--record-kb 64] [--paths shm,tcp] [--wire columnar,row]
+        [--json out.jsonl]
 
 Prints one JSON line per configuration.
 """
@@ -39,21 +51,34 @@ import time
 
 
 def drain_fn(args, ctx):
-    """Consume the feed as fast as possible; count records."""
-    feed = ctx.get_data_feed()
+    """Consume the feed into mapped column batches as fast as possible;
+    count records. The mapping is the point: the row wire pays
+    ``columnize_rows`` (np.stack) per batch here, the columnar wire
+    slices zero-copy views. (The lines-format manifest leg drains raw
+    rows — text lines have no column mapping.)"""
+    batch = int(args["batch"])
+    n = 0
     if args.get("manifest"):
         from tensorflowonspark_tpu.feed.manifest import ManifestFeed
 
-        feed = ManifestFeed(feed)
-    n = 0
-    while not feed.should_stop():
-        rows = feed.next_batch(int(args["batch"]))
-        n += len(rows)
+        feed = ManifestFeed(ctx.get_data_feed())
+        if args.get("columnar"):
+            for cols in feed.batch_stream(batch, 1, input_mapping={"x": "x"}):
+                n += len(cols["x"])
+        else:
+            while not feed.should_stop():
+                n += len(feed.next_batch(batch))
+    else:
+        feed = ctx.get_data_feed(input_mapping={"x": "x"})
+        while not feed.should_stop():
+            cols = feed.next_batch(batch)
+            if cols:
+                n += len(cols["x"])
     print(f"node {ctx.worker_num}: drained {n} records", flush=True)
 
 
 def _run_config(n_nodes: int, path: str, mb_per_node: int, record_kb: int,
-                batch: int) -> dict:
+                batch: int, wire: str = "columnar") -> dict:
     from tensorflowonspark_tpu.cluster import node as tfnode_runtime
     from tensorflowonspark_tpu.cluster import tfcluster
     from tensorflowonspark_tpu.cluster.tfcluster import InputMode
@@ -61,27 +86,52 @@ def _run_config(n_nodes: int, path: str, mb_per_node: int, record_kb: int,
 
     import tempfile
 
-    record = b"x" * (record_kb * 1024)
-    per_node = (mb_per_node * 1024 * 1024) // len(record)
+    import numpy as np
+
+    columnar = wire == "columnar"
+    record_len = record_kb * 1024
+    per_node = (mb_per_node * 1024 * 1024) // record_len
     tmpdir = None
     if path == "manifest":
         # Node-side feeders: the driver ships ONE FileManifest per node;
         # each node streams its file locally (feed/manifest.py). File
-        # creation is setup, not part of the timed window.
+        # creation is setup, not part of the timed window. The columnar
+        # wire reads 64-aligned frame files zero-copy over one mmap; the
+        # row wire streams text lines.
         from tensorflowonspark_tpu.feed.manifest import FileManifest
 
         tmpdir = tempfile.TemporaryDirectory(prefix="feed_plane_")
-        line = "x" * (record_kb * 1024 - 1)
         partitions = []
         for i in range(n_nodes):
-            fp = f"{tmpdir.name}/node{i}.txt"
-            with open(fp, "w") as f:
-                for _ in range(per_node):
-                    f.write(line + "\n")
-            partitions.append([FileManifest(fp, format="lines")])
+            if columnar:
+                from tensorflowonspark_tpu.feed.columnar import write_frames
+
+                fp = f"{tmpdir.name}/node{i}.colf"
+                arr = np.full((per_node, record_len), 120, np.uint8)
+                write_frames(
+                    fp,
+                    ((row,) for row in arr),
+                    records_per_frame=512,
+                )
+                partitions.append([FileManifest(fp, format="columnar")])
+            else:
+                fp = f"{tmpdir.name}/node{i}.txt"
+                line = "x" * (record_len - 1)
+                with open(fp, "w") as f:
+                    for _ in range(per_node):
+                        f.write(line + "\n")
+                partitions.append([FileManifest(fp, format="lines")])
     else:
-        partitions = [[record] * per_node for _ in range(n_nodes)]
-    total_mb = n_nodes * per_node * len(record) / 1e6
+        # DISTINCT per-record arrays (views over one allocation): pickle
+        # must move every byte, as it would for real data
+        partitions = [
+            [
+                (row,)
+                for row in np.full((per_node, record_len), 120, np.uint8)
+            ]
+            for _ in range(n_nodes)
+        ]
+    total_mb = n_nodes * per_node * record_len / 1e6
 
     real_node_ring = tfnode_runtime._node_ring
     if path == "tcp":
@@ -91,11 +141,16 @@ def _run_config(n_nodes: int, path: str, mb_per_node: int, record_kb: int,
     try:
         cluster = tfcluster.run(
             drain_fn,
-            {"batch": batch, "manifest": path == "manifest"},
+            {
+                "batch": batch,
+                "manifest": path == "manifest",
+                "columnar": columnar,
+            },
             num_executors=n_nodes,
             input_mode=InputMode.SPARK,
             reservation_timeout=120,
             env=cpu_only_env(),
+            columnar=columnar,
         )
         t0 = time.perf_counter()
         cluster.train(partitions, close_feed=True)
@@ -109,6 +164,7 @@ def _run_config(n_nodes: int, path: str, mb_per_node: int, record_kb: int,
         "bench": "feed_plane",
         "nodes": n_nodes,
         "path": path,
+        "wire": wire,
         "record_kb": record_kb,
         "mb_total": round(total_mb, 1),
         "secs": round(secs, 3),
@@ -124,6 +180,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--record-kb", type=int, default=64)
     p.add_argument("--batch", type=int, default=32)
     p.add_argument("--paths", default="shm,tcp")
+    p.add_argument("--wire", default="columnar,row",
+                   help="comma list of wire formats: columnar,row")
     p.add_argument("--json", default=None, help="also append JSONL here")
     args = p.parse_args(argv)
 
@@ -131,13 +189,15 @@ def main(argv: list[str] | None = None) -> int:
     try:
         for n in [int(x) for x in args.nodes.split(",") if x.strip()]:
             for path in [x.strip() for x in args.paths.split(",") if x.strip()]:
-                row = _run_config(
-                    n, path, args.mb_per_node, args.record_kb, args.batch
-                )
-                line = json.dumps(row)
-                print(line, flush=True)
-                if out:
-                    out.write(line + "\n")
+                for wire in [w.strip() for w in args.wire.split(",") if w.strip()]:
+                    row = _run_config(
+                        n, path, args.mb_per_node, args.record_kb,
+                        args.batch, wire,
+                    )
+                    line = json.dumps(row)
+                    print(line, flush=True)
+                    if out:
+                        out.write(line + "\n")
     finally:
         if out:
             out.close()

@@ -58,7 +58,8 @@ def train_fn(args, ctx):
     import optax
 
     from tensorflowonspark_tpu.compute import TrainState, build_train_step
-    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+    from tensorflowonspark_tpu.feed.prefetch import DevicePrefetcher
     from tensorflowonspark_tpu.models.bert import (
         BertForClassification,
         bert_param_shardings,
@@ -82,19 +83,22 @@ def train_fn(args, ctx):
         classification_loss_fn(model), tx, mesh, param_shardings=psh
     )
 
-    bs = int(args["batch_size"])
-    dc = jax.device_count()
-    loss = None
-    while not feed.should_stop():
-        cols = feed.next_batch(bs)
-        n = len(cols["label"]) - len(cols["label"]) % dc
-        if n == 0:
-            continue
-        batch = {
-            "tokens": np.asarray(cols["tokens"], np.int32)[:n],
-            "label": np.asarray(cols["label"], np.int32)[:n],
+    def prepare(cols):
+        return {
+            "tokens": np.asarray(cols["tokens"], np.int32),
+            "label": np.asarray(cols["label"], np.int32),
         }
-        state, loss = step(state, shard_batch(mesh, batch))
+
+    loss = None
+    with DevicePrefetcher.from_feed(
+        feed,
+        int(args["batch_size"]),
+        mesh,
+        multiple_of=jax.device_count(),
+        prepare=prepare,
+    ) as pf:
+        for batch in pf:
+            state, loss = step(state, batch)
     print(f"node{ctx.executor_id} final loss {float(loss):.4f}")
     ctx.export_saved_model(jax.device_get(state.params), args["export_dir"])
 

@@ -26,7 +26,8 @@ def train_fn(args, ctx):
     import optax
 
     from tensorflowonspark_tpu.compute import TrainState, build_train_step
-    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+    from tensorflowonspark_tpu.feed.prefetch import DevicePrefetcher
     from tensorflowonspark_tpu.models import mnist
 
     model = mnist.MLP(hidden=128)
@@ -41,18 +42,21 @@ def train_fn(args, ctx):
     state = TrainState.create(params, tx)
     step = build_train_step(mnist.loss_fn(model.apply), tx, mesh)
 
-    bs = int(args["batch_size"])
-    while not feed.should_stop():
-        cols = feed.next_batch(bs)
-        n = len(cols["label"])
-        n -= n % jax.device_count()
-        if n == 0:
-            continue
-        batch = {
-            "image": np.asarray(cols["image"], np.float32)[:n] / 255.0,
-            "label": np.asarray(cols["label"], np.int32)[:n],
+    def prepare(cols):
+        return {
+            "image": np.asarray(cols["image"], np.float32) / 255.0,
+            "label": np.asarray(cols["label"], np.int32),
         }
-        state, _ = step(state, shard_batch(mesh, batch))
+
+    with DevicePrefetcher.from_feed(
+        feed,
+        int(args["batch_size"]),
+        mesh,
+        multiple_of=jax.device_count(),
+        prepare=prepare,
+    ) as pf:
+        for batch in pf:
+            state, _ = step(state, batch)
 
     ctx.export_saved_model(jax.device_get(state.params), args["export_dir"])
 

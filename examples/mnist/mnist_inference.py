@@ -38,19 +38,30 @@ def infer_fun(args, ctx):
         logits = model.apply({"params": params}, images)
         return jax.numpy.argmax(logits, -1)
 
+    from tensorflowonspark_tpu.feed.prefetch import DevicePrefetcher
+
     feed = ctx.get_data_feed(train_mode=False)
-    while not feed.should_stop():
-        batch = feed.next_batch(args.batch_size)
-        if not batch:
-            continue
+
+    def host_batches():
+        while not feed.should_stop():
+            batch = feed.next_batch(args.batch_size)
+            if batch:
+                yield batch
+
+    def to_device(batch):
         images = (
             np.stack([np.asarray(r[0], np.float32) for r in batch]).reshape(
                 -1, 28, 28, 1
             )
             / 255.0
         )
-        preds = np.asarray(predict(images))
-        feed.batch_results([int(p) for p in preds])
+        return jax.device_put(images)
+
+    # stack + H2D of batch N+1 overlaps predict(batch N) on the device
+    with DevicePrefetcher(host_batches(), transform=to_device) as pf:
+        for images in pf:
+            preds = np.asarray(predict(images))
+            feed.batch_results([int(p) for p in preds])
 
 
 def parse_args(argv=None):

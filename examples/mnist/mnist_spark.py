@@ -30,7 +30,8 @@ def main_fun(args, ctx):
     import optax
 
     from tensorflowonspark_tpu.compute import TrainState, build_train_step
-    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+    from tensorflowonspark_tpu.feed.prefetch import DevicePrefetcher
     from tensorflowonspark_tpu.models import mnist
 
     model = mnist.CNN()
@@ -48,18 +49,29 @@ def main_fun(args, ctx):
 
     # batch_stream re-buffers EndPartition partials into steady jit shapes;
     # the tail is trimmed to a device-count multiple so it still shards.
-    steps = 0
-    for cols in feed.batch_stream(args.batch_size, multiple_of=jax.device_count()):
+    # DevicePrefetcher runs prepare + shard/device_put on its producer
+    # thread, so batch N+1's columnize+H2D hides behind step N's compute.
+    def prepare(cols):
         n = len(cols["label"])
-        batch = {
+        return {
             "image": np.asarray(cols["image"], np.float32).reshape(n, 28, 28, 1)
             / 255.0,
             "label": np.asarray(cols["label"], np.int32),
         }
-        state, loss = step(state, shard_batch(mesh, batch))
-        steps += 1
-        if steps % 20 == 0:
-            print(f"node{ctx.executor_id} step {steps} loss {float(loss):.4f}")
+
+    steps = 0
+    with DevicePrefetcher.from_feed(
+        feed,
+        args.batch_size,
+        mesh,
+        multiple_of=jax.device_count(),
+        prepare=prepare,
+    ) as pf:
+        for batch in pf:
+            state, loss = step(state, batch)
+            steps += 1
+            if steps % 20 == 0:
+                print(f"node{ctx.executor_id} step {steps} loss {float(loss):.4f}")
 
     if args.model_dir and ctx.is_chief:
         ctx.export_saved_model(

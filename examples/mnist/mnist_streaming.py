@@ -29,7 +29,8 @@ def main_fun(args, ctx):
     import optax
 
     from tensorflowonspark_tpu.compute import TrainState, build_train_step
-    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+    from tensorflowonspark_tpu.feed.prefetch import DevicePrefetcher
     from tensorflowonspark_tpu.models import mnist
 
     model = mnist.CNN()
@@ -44,29 +45,37 @@ def main_fun(args, ctx):
     state = TrainState.create(params, tx)
     step = build_train_step(mnist.loss_fn(model.apply), tx, mesh)
 
-    steps = 0
-    for cols in feed.batch_stream(
-        args.batch_size, multiple_of=jax.device_count()
-    ):
+    def prepare(cols):
         n = len(cols["label"])
-        batch = {
+        return {
             "image": np.asarray(cols["image"], np.float32).reshape(
                 n, 28, 28, 1
             )
             / 255.0,
             "label": np.asarray(cols["label"], np.int32),
         }
-        state, loss = step(state, shard_batch(mesh, batch))
-        steps += 1
-        if steps % 10 == 0:
-            print(
-                f"node{ctx.executor_id} step {steps} loss {float(loss):.4f}"
-            )
-        if steps >= args.target_steps:
-            # Early stop: train_stream sees 'terminating' and returns even
-            # if the stream is still producing.
-            feed.terminate()
-            break
+
+    steps = 0
+    with DevicePrefetcher.from_feed(
+        feed,
+        args.batch_size,
+        mesh,
+        multiple_of=jax.device_count(),
+        prepare=prepare,
+    ) as pf:
+        for batch in pf:
+            state, loss = step(state, batch)
+            steps += 1
+            if steps % 10 == 0:
+                print(
+                    f"node{ctx.executor_id} step {steps} loss {float(loss):.4f}"
+                )
+            if steps >= args.target_steps:
+                # Early stop: train_stream sees 'terminating' and returns
+                # even if the stream is still producing (the prefetcher's
+                # close() unblocks its producer thread).
+                feed.terminate()
+                break
     print(f"node{ctx.executor_id}: trained {steps} streamed steps")
 
 

@@ -36,7 +36,7 @@ __all__ = [
     "run_lint",
 ]
 
-DEFAULT_RULES = ("LK", "JX", "HS", "TL", "FP")
+DEFAULT_RULES = ("LK", "JX", "HS", "TL", "FP", "PF")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -269,6 +269,7 @@ def run_lint(root: str, cfg: Config) -> list:
         hostsync,
         jaxapi,
         locks,
+        prefetchrule,
     )
 
     pkg, findings = parse_package(root, cfg)
@@ -279,6 +280,8 @@ def run_lint(root: str, cfg: Config) -> list:
         findings.extend(jaxapi.check(pkg, cfg))
     if "FP" in enabled:
         findings.extend(fp_rule.check(pkg, cfg))
+    if "PF" in enabled:
+        findings.extend(prefetchrule.check(pkg, cfg))
     if {"HS", "TL"} & enabled:
         findings.extend(
             hostsync.check(

@@ -414,15 +414,47 @@ class TFModel(_HasParams):
                 TFModel._replicated_key = rkey
             else:
                 state = TFModel._singleton[1]
-        for chunk in _chunked(data, batch_size):
-            n = len(chunk)
-            if shard and n % dc:
-                chunk = list(chunk) + [chunk[-1]] * (dc - n % dc)
-            batch = self._columnize(chunk)
-            if shard:
-                batch = shard_batch(mesh, batch)
-            result = apply_fn(state, batch)
-            yield from self._rowize(result, n)
+        from tensorflowonspark_tpu.feed.prefetch import DevicePrefetcher
+
+        def host_batches():
+            for chunk in _chunked(data, batch_size):
+                n = len(chunk)
+                if shard and n % dc:
+                    chunk = list(chunk) + [chunk[-1]] * (dc - n % dc)
+                yield self._columnize(chunk), n
+
+        if shard:
+            transfer = lambda b: shard_batch(mesh, b)  # noqa: E731
+        else:
+            transfer = _jax.device_put
+        batches = host_batches()
+        first = next(batches, None)
+        if first is None:
+            return
+        second = next(batches, None)
+        if second is None:
+            # Single chunk (the per-fed-batch _transform_node_fn hot
+            # path): there is no chunk N+1 to prefetch, so skip the
+            # producer thread + queue round-trip and transfer inline.
+            cols, n = first
+            yield from self._rowize(apply_fn(state, transfer(cols)), n)
+            return
+        import itertools as _it
+
+        # Columnize + H2D of chunk N+1 runs on the prefetcher's producer
+        # thread while apply_fn(chunk N) computes — the transfer fully
+        # hides behind step compute instead of serializing with it.
+        pf = DevicePrefetcher(
+            _it.chain([first, second], batches),
+            depth=2,
+            transform=lambda item: (transfer(item[0]), item[1]),
+        )
+        try:
+            for batch, n in pf:
+                result = apply_fn(state, batch)
+                yield from self._rowize(result, n)
+        finally:
+            pf.close()
 
     def _transform_distributed_iter(self, data: Iterable, launcher, env):
         """Scale-out transform over a cluster of per-node model singletons."""
@@ -491,6 +523,10 @@ def _transform_node_fn(args, ctx):
     model = TFModel(args, export_fn=export_fn)
     feed = ctx.get_data_feed(train_mode=False)
     batch_size = int(args.batch_size)
+    # Per fed batch, lock-step: inference_stream's backpressure window
+    # assumes a node emits results for batch N before pulling far past
+    # it, so the whole-feed prefetcher look-ahead of transform_iter
+    # (fine for local data) must NOT wrap the feed here.
     while not feed.should_stop():
         batch = feed.next_batch(batch_size)
         if batch:

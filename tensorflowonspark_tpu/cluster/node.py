@@ -25,6 +25,8 @@ import time
 import traceback
 from typing import Any, Callable
 
+import numpy as np
+
 from tensorflowonspark_tpu.cluster import manager as tf_manager
 from tensorflowonspark_tpu.cluster import reservation
 from tensorflowonspark_tpu.cluster.context import TFNodeContext
@@ -268,11 +270,15 @@ def _start_ring_drain(
 ) -> str | None:
     """Create this node's shm ring and start the drain thread.
 
-    Ring records are pickled ``(qname, payload)`` tuples; the drain thread
-    forwards each payload into the named in-process queue (bounded, so
-    queue backpressure propagates to the ring and from there to the
-    producer's ``push`` timeout). Returns the ring name to advertise in
-    the reservation roster, or None when native support is unavailable.
+    Ring records are either COLUMNAR FRAMES (``feed/columnar.py``; the
+    drain decodes them into zero-copy column views over the ring memory
+    — the refcounted frame keeps the slot alive until the batch is
+    consumed or transferred) or pickled ``(qname, payload)`` tuples (the
+    row-pickle fallback and all markers). Either way the drain forwards
+    into the named in-process queue (bounded, so queue backpressure
+    propagates to the ring and from there to the producer's ``push``
+    timeout). Returns the ring name to advertise in the reservation
+    roster, or None when native support is unavailable.
     """
     try:
         from tensorflowonspark_tpu.native.shmring import ShmRing, available
@@ -293,14 +299,45 @@ def _start_ring_drain(
     atexit.register(ring.close)
 
     def drain() -> None:
+        from tensorflowonspark_tpu.feed import columnar
+
         try:
+            data = chunk = None
             while True:
+                # drop the previous frame's refs BEFORE blocking: a view
+                # held across the wait would pin its ring slot and
+                # deadlock a producer waiting for that space
+                data = chunk = None
                 try:
-                    data = ring.pop(timeout=1.0)
+                    data = ring.pop_frame(timeout=1.0)
                 except TimeoutError:
                     continue
                 if data is None:  # producer closed and ring drained
                     return
+                if columnar.is_frame(data):
+                    if failpoint("columnar.frame") == "drop":
+                        # chaos: frame lost mid-stream — the consumer's
+                        # per-stream sequence check surfaces the gap
+                        continue
+                    chunk = columnar.decode_frame(data, path="shm")
+                    zero_copy = isinstance(data, np.ndarray)
+                    nbytes = data.nbytes if zero_copy else len(data)
+                    data = None
+                    if zero_copy and (
+                        nbytes > ring.capacity // 4
+                        or ring.outstanding_bytes() > ring.capacity // 2
+                    ):
+                        # liveness guard: a consumer assembling one
+                        # batch pins the views of ALL its frames while
+                        # blocking for the next, so pinned views nearing
+                        # ring capacity (a batch bigger than the ring,
+                        # or one unsplittable over-quarter frame) would
+                        # starve the producer of push space forever.
+                        # Copy out — releases the slot now; costs one
+                        # memcpy only under backlog.
+                        chunk = chunk.materialize()
+                    mgr.get_queue(chunk.qname or "input").put(chunk)
+                    continue
                 qname, payload = pickle.loads(data)
                 mgr.get_queue(qname).put(payload)
         except Exception:
@@ -458,17 +495,27 @@ def feed_partition(
     qname: str = "input",
     chunk: int = FEED_CHUNK,
     node: dict[str, Any] | None = None,
+    columnar: bool = True,
 ) -> int | None:
     """Push one data partition into a node's input queue, chunked.
 
     Pass the node's roster entry via ``node`` to enable the shared-memory
     fast path when the feeder is co-located with the node; otherwise (or
     when native support is missing) chunks go through the TCP manager
-    proxy. Returns the number of records fed, or ``None`` if the node is
-    terminating and the partition was skipped (distinct from feeding an
-    empty partition, which returns 0). Raises TimeoutError if the consumer
-    stopped pulling (reference: "Timeout while feeding partition").
+    proxy. With ``columnar=True`` (the default) each chunk is columnized
+    ONCE here — per-field contiguous buffers, CRC-framed
+    (``feed/columnar.py``) — and ships as a single frame: scatter-pushed
+    straight from numpy memory on the ring path, one bytes payload on the
+    TCP path. Chunks that cannot columnize (ragged/object records) fall
+    back to the versioned row-pickle wire, chunk by chunk. Returns the
+    number of records fed, or ``None`` if the node is terminating and the
+    partition was skipped (distinct from feeding an empty partition,
+    which returns 0). Raises TimeoutError if the consumer stopped pulling
+    (reference: "Timeout while feeding partition").
     """
+    from tensorflowonspark_tpu.feed import columnar as col
+    from tensorflowonspark_tpu.obs import spans as obs_spans
+
     if str(mgr.get("state")) in ("terminating", "finished", "error"):
         # Early-stop path: consume and discard remaining partitions
         # (reference: the state check at the top of ``_train``; 'finished'
@@ -496,17 +543,61 @@ def feed_partition(
     else:
         q = mgr.get_queue(qname)
         put = lambda obj: q.put(obj, timeout=feed_timeout)  # noqa: E731
+
+    seq = 0
+    stream = os.urandom(8).hex() if columnar else None
+
+    def put_columnar(ck, buf) -> None:
+        """Ship one columnar chunk as frame ``seq`` of this partition's
+        stream; recurses into halves when a frame outgrows a QUARTER of
+        the ring. The quarter cap is a liveness requirement, not tuning:
+        consumers hold zero-copy views of frame N while blocking for
+        frame N+1, so a frame sized near the whole ring deadlocks the
+        plane (producer waits on space only the consumer's next pull
+        would free). At cap/4 several frames coexist in flight."""
+        nonlocal seq
+        if ring is not None:
+            # crc=False: same-host shm — the ring's length framing +
+            # always-verified header CRC cover truncation, and skipping
+            # the payload checksum keeps both sides single-pass
+            parts = col.encode_parts(
+                ck, qname=qname, stream=stream, seq=seq, crc=False
+            )
+            if col.parts_nbytes(parts) + 4 > ring.capacity // 4 and len(buf) > 1:
+                mid = len(buf) // 2
+                put_columnar(ck.view(0, mid), buf[:mid])
+                put_columnar(ck.view(mid, len(buf)), buf[mid:])
+                return
+            ring.push_parts(parts, timeout=feed_timeout)
+        else:
+            put(
+                col.ColumnarFrame(
+                    col.frame_bytes(ck, qname=qname, stream=stream, seq=seq)
+                )
+            )
+        seq += 1
+
+    def send(buf: list) -> None:
+        if columnar:
+            with obs_spans.span("feed.columnize", records=len(buf)):
+                ck = col.columnize_records(buf)
+            if ck is not None:
+                put_columnar(ck, buf)
+                return
+            col.metrics()["fallback"].inc(reason="not_columnizable")
+        put(buf)
+
     count = 0
     buf: list[Any] = []
     try:
         for item in partition:
             buf.append(item)
             if len(buf) >= chunk:
-                put(buf)
+                send(buf)
                 count += len(buf)
                 buf = []
         if buf:
-            put(buf)
+            send(buf)
             count += len(buf)
         put(EndPartition())
     except (_queue.Full, TimeoutError):

@@ -18,6 +18,7 @@ TPU-native differences:
 from __future__ import annotations
 
 import logging
+import os
 import queue as _stdqueue
 import secrets
 import threading
@@ -62,6 +63,9 @@ class TFCluster:
             cluster_meta.get("heartbeat_interval", 0) or 0
         )
         self.heartbeat_grace = float(cluster_meta.get("heartbeat_grace", 0) or 0)
+        # Chunk-columnar wire format on the feed plane (feed/columnar.py);
+        # False pins the legacy row-pickle wire end-to-end.
+        self.columnar = bool(cluster_meta.get("columnar", True))
         self._shutdown_done = False
         self._dstream_bridge: tuple | None = None
 
@@ -223,6 +227,7 @@ class TFCluster:
                         feed_timeout=feed_timeout,
                         qname=qname,
                         node=workers[widx],
+                        columnar=self.columnar,
                     )
                 if close_feed:
                     tfnode_runtime.close_feed(
@@ -398,6 +403,7 @@ class TFCluster:
                         feed_timeout=feed_timeout,
                         qname=qname,
                         node=workers[widx],
+                        columnar=self.columnar,
                     )
                     if fed is None:  # node terminating; partition skipped
                         terminated[widx] = True
@@ -588,6 +594,7 @@ class TFCluster:
                         feed_timeout=feed_timeout,
                         qname=qname,
                         node=workers[widx],
+                        columnar=self.columnar,
                     )
                     if fed is None:  # node terminating; partition skipped
                         with cond:
@@ -854,6 +861,7 @@ def run(
     shm_ring_mb: int = 64,
     heartbeat_interval: float = 2.0,
     heartbeat_grace: float = 60.0,
+    columnar: bool = True,
 ) -> TFCluster:
     """Start a cluster and return its handle.
 
@@ -924,6 +932,11 @@ def run(
         # Ring only pays off when a feeder will attach, i.e. SPARK mode.
         "use_shm_ring": use_shm_ring and input_mode == InputMode.SPARK,
         "shm_ring_mb": shm_ring_mb,
+        # Chunk-columnar wire format (feed/columnar.py): driver feeders
+        # columnize each chunk once and nodes slice zero-copy column
+        # views; False = legacy row-pickle wire. TFOS_COLUMNAR=0 in the
+        # driver environment forces it off too (operator escape hatch).
+        "columnar": columnar and os.environ.get("TFOS_COLUMNAR", "1") != "0",
     }
     logger.info(
         "starting cluster %s: %d nodes, template %s",

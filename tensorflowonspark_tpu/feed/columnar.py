@@ -1,0 +1,720 @@
+"""Chunk-columnar wire format for the push feed plane.
+
+SURVEY.md §3.2 names the per-item pickle-proxy tax as the reference's
+feed bottleneck, and the PR-2-era plane still shipped every partition
+chunk as a pickled *list of rows* that the node re-assembled with
+``columnize_rows``/``np.stack`` per batch. tf.data (PAPERS.md,
+arXiv:2101.12127) wins the same fight by moving input pipelines onto
+contiguous columnar buffers; this module is that move for our wire:
+
+- The DRIVER columnizes each partition chunk ONCE
+  (:func:`columnize_records`): per-field contiguous ndarray buffers plus
+  a small dtype/shape header, CRC-framed (:func:`encode_parts` /
+  :func:`frame_bytes`).
+- The NODE reconstructs columns as **zero-copy views** over the received
+  buffer (:func:`decode_frame`): ``np.frombuffer`` slices, no per-row
+  object churn. Over the shm ring the buffer IS the ring memory
+  (refcounted frames — see ``native/shmring.py``); over TCP it is the
+  one bytes object the manager proxy delivered; for node-local files it
+  is an ``mmap`` (:func:`read_frames`).
+- Batches are assembled by SLICING column views
+  (:class:`ColumnAssembler` / :func:`column_batches`) instead of
+  stacking rows: a batch that lands inside one chunk costs zero copies.
+
+Anything non-columnizable (ragged shapes, object dtypes, mixed records,
+bytes with trailing NULs — which numpy's ``S`` dtype would silently
+trim) falls back to the versioned row-pickle path, chunk by chunk; the
+two formats interleave freely on the same queue.
+
+Frame layout (one logical wire record)::
+
+    [0:4)    magic  b"TFC\\x01"           (3-byte tag + format version)
+    [4:8)    u32 header_len
+    [8:12)   u32 header_crc               (crc32 of the header bytes)
+    [12:+hl) header                       (pickled dict, see below)
+    ...      zero pad to 64-byte alignment
+    ...      column payloads, each 64-aligned relative to payload start
+
+Header dict: ``{"v": 1, "qname", "kind": dict|tuple|flat, "n",
+"cols": [(key, dtype_str, shape, offset, nbytes)], "payload_crc",
+"stream", "seq"}``. ``offset`` is relative to the (aligned) payload
+start, so header size and payload layout are independent. ``stream`` /
+``seq`` let the consumer detect a frame dropped mid-stream
+(``DataFeed`` raises on a sequence gap — see the ``columnar.frame``
+failpoint).
+
+``payload_crc`` is the running crc32 over the column buffers (pads
+excluded). The shm-ring producer skips it (``encode_parts(crc=False)``
+→ ``payload_crc: None``): the transport is same-host memory whose
+length framing + always-verified header CRC already catch truncation,
+and the verify pass would force a full read of memory the consumer
+otherwise only views. TCP- and file-borne frames carry and verify it;
+``TFOS_COLUMNAR_CRC=0`` disables verification globally for trusted
+transports.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import struct
+import threading
+from collections import deque
+from typing import Any, Iterable, Iterator, Sequence
+from zlib import crc32
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ALIGN",
+    "MAGIC",
+    "ColumnAssembler",
+    "ColumnChunk",
+    "ColumnarFrame",
+    "column_batches",
+    "columnize_records",
+    "decode_frame",
+    "encode_parts",
+    "frame_bytes",
+    "is_frame",
+    "read_frames",
+    "write_frames",
+]
+
+MAGIC = b"TFC\x01"
+_PREFIX = struct.Struct("<4sII")  # magic+version, header_len, header_crc
+ALIGN = 64
+
+# Payload CRC verification on decode (header CRC is always verified).
+_VERIFY_PAYLOAD = os.environ.get("TFOS_COLUMNAR_CRC", "1") != "0"
+
+
+def _align(n: int) -> int:
+    return (n + ALIGN - 1) & ~(ALIGN - 1)
+
+
+# -- obs ---------------------------------------------------------------------
+
+_metrics_lock = threading.Lock()
+_metrics: dict[str, Any] | None = None
+
+
+def metrics() -> dict[str, Any]:
+    """Feed-plane columnar counters in the process-global obs registry:
+    frames/bytes/records per path (shm|tcp|manifest) plus the fallback
+    counter (chunks that could not columnize, by reason)."""
+    global _metrics
+    if _metrics is None:
+        with _metrics_lock:
+            if _metrics is None:
+                from tensorflowonspark_tpu.obs.registry import default_registry
+
+                r = default_registry()
+                _metrics = {
+                    "frames": r.counter(
+                        "feed_columnar_frames_total",
+                        "columnar frames decoded, by transport path",
+                    ),
+                    "bytes": r.counter(
+                        "feed_columnar_bytes_total",
+                        "columnar payload bytes decoded, by transport path",
+                    ),
+                    "records": r.counter(
+                        "feed_columnar_records_total",
+                        "records carried by columnar frames, by path",
+                    ),
+                    "fallback": r.counter(
+                        "feed_columnar_fallback_total",
+                        "chunks that fell back to row-pickle, by reason",
+                    ),
+                }
+    return _metrics
+
+
+def _count_decode(chunk: "ColumnChunk", nbytes: int, path: str) -> None:
+    m = metrics()
+    m["frames"].inc(path=path)
+    m["bytes"].inc(nbytes, path=path)
+    m["records"].inc(chunk.n, path=path)
+
+
+# -- chunk model -------------------------------------------------------------
+
+
+class ColumnChunk:
+    """One columnar chunk: per-field contiguous arrays over shared wire
+    memory (or driver-built, pre-encode).
+
+    ``kind`` records how the original rows were shaped so ``rows()`` can
+    reconstruct them: ``"dict"`` (keys are field names), ``"tuple"``
+    (keys are positions), ``"flat"`` (one anonymous column). Slicing
+    (:meth:`view`) produces numpy views — the underlying frame buffer
+    stays alive through the views' base chain, which is exactly the
+    refcount that lets a ring slot outlive its pop.
+    """
+
+    __slots__ = ("kind", "keys", "arrays", "n", "qname", "stream", "seq")
+
+    def __init__(
+        self,
+        kind: str,
+        keys: Sequence[Any],
+        arrays: Sequence[np.ndarray],
+        qname: str | None = None,
+        stream: str | None = None,
+        seq: int = 0,
+    ):
+        self.kind = kind
+        self.keys = tuple(keys)
+        self.arrays = tuple(arrays)
+        self.n = int(self.arrays[0].shape[0]) if self.arrays else 0
+        self.qname = qname
+        self.stream = stream
+        self.seq = seq
+
+    def __len__(self) -> int:
+        return self.n
+
+    def view(self, start: int, stop: int) -> "ColumnChunk":
+        """Record-range slice as views (zero-copy)."""
+        return ColumnChunk(
+            self.kind,
+            self.keys,
+            tuple(a[start:stop] for a in self.arrays),
+            qname=self.qname,
+            stream=self.stream,
+            seq=self.seq,
+        )
+
+    def materialize(self) -> "ColumnChunk":
+        """Copy the columns out of their wire buffer, dropping the view
+        base chain — releases the underlying ring slot / mmap NOW
+        instead of when the views die (the drain's backpressure guard)."""
+        return ColumnChunk(
+            self.kind,
+            self.keys,
+            tuple(a.copy() for a in self.arrays),
+            qname=self.qname,
+            stream=self.stream,
+            seq=self.seq,
+        )
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes for a in self.arrays)
+
+    @property
+    def is_view(self) -> bool:
+        """Any column still backed by wire memory (ring slot / received
+        bytes / mmap) — i.e. holding this chunk pins that buffer."""
+        return any(a.base is not None for a in self.arrays)
+
+    def columns(self) -> dict[Any, np.ndarray]:
+        return dict(zip(self.keys, self.arrays))
+
+    def by_mapping(self, input_mapping: dict[str, str]) -> dict[str, np.ndarray]:
+        """{tensor_name: column} per the feed's ``input_mapping`` —
+        the sliced-column replacement for ``columnize_rows``. Field
+        resolution mirrors it: dict records by field name (loud on a
+        missing field), tuple records by position with an arity check."""
+        if self.kind == "dict":
+            cols = self.columns()
+            out: dict[str, np.ndarray] = {}
+            for field, tensor in input_mapping.items():
+                if field not in cols:
+                    raise KeyError(
+                        f"input_mapping field {field!r} not present in a "
+                        f"dict record (record keys: "
+                        f"{sorted(map(str, self.keys))}); "
+                        f"mapping={input_mapping}"
+                    )
+                out[tensor] = cols[field]
+            return out
+        if self.kind == "tuple":
+            names = list(input_mapping)
+            if len(self.keys) != len(names):
+                raise ValueError(
+                    f"input_mapping has {len(names)} columns {names} but "
+                    f"records have {len(self.keys)} fields; for tuple "
+                    "records the mapping must name every field, in order"
+                )
+            return dict(zip(input_mapping.values(), self.arrays))
+        # flat records: only an unambiguous single-tensor mapping works
+        if len(input_mapping) == 1:
+            (tensor,) = input_mapping.values()
+            return {tensor: self.arrays[0]}
+        raise ValueError(
+            "flat (scalar/array) records cannot satisfy a multi-field "
+            f"input_mapping {input_mapping}"
+        )
+
+    def rows(self) -> list[Any]:
+        """Materialize back to the original record shapes (row views for
+        array fields, numpy scalars for scalar fields) — the path for
+        mapping-less consumers that want plain record lists."""
+        if self.kind == "flat":
+            return list(self.arrays[0])
+        if self.kind == "tuple":
+            return list(zip(*self.arrays))
+        return [
+            {k: a[i] for k, a in zip(self.keys, self.arrays)}
+            for i in range(self.n)
+        ]
+
+
+class ColumnarFrame:
+    """An encoded frame riding a pickle transport (the TCP manager
+    proxy): pickles as one bytes payload — no per-row object churn —
+    and is decoded into zero-copy views on the consumer side."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        self.data = data
+
+    def __reduce__(self):
+        return (ColumnarFrame, (self.data,))
+
+
+# -- columnization -----------------------------------------------------------
+
+
+def _scalar_kinds(v: Any) -> bool:
+    return isinstance(v, (bool, int, float, complex, np.generic))
+
+
+def _scalar_class(v: Any) -> str | None:
+    """Dtype-kind bucket for the lossless-only scalar gate: mixing
+    buckets (bool+int, int+float, ...) would let ``np.asarray`` coerce
+    — silently lossy — so mixed chunks must fall back to row-pickle.
+    Order matters: ``bool`` subclasses ``int``, ``np.float64``
+    subclasses ``float``."""
+    if isinstance(v, np.generic):
+        return v.dtype.kind  # b,i,u,f,c
+    if isinstance(v, bool):
+        return "b"
+    if isinstance(v, int):
+        return "i"
+    if isinstance(v, float):
+        return "f"
+    if isinstance(v, complex):
+        return "c"
+    return None
+
+
+def _column(values: list[Any]) -> np.ndarray | None:
+    """One contiguous column from per-row field values, or None when the
+    field is not columnizable (ragged/object/mixed)."""
+    v0 = values[0]
+    if isinstance(v0, np.ndarray):
+        if v0.dtype.hasobject or v0.dtype.names:
+            return None
+        shape, dtype = v0.shape, v0.dtype
+        for v in values[1:]:
+            if (
+                not isinstance(v, np.ndarray)
+                or v.shape != shape
+                or v.dtype != dtype
+            ):
+                return None
+        out = np.empty((len(values),) + shape, dtype)
+        for i, v in enumerate(values):
+            out[i] = v
+        return out
+    if isinstance(v0, (bytes, bytearray)):
+        ln = len(v0)
+        for v in values:
+            if not isinstance(v, (bytes, bytearray)) or len(v) != ln:
+                return None
+            # numpy S-dtype trims trailing NULs on read — silently lossy
+            if v[-1:] == b"\x00":
+                return None
+        return np.array(values, dtype=f"S{max(ln, 1)}")
+    if isinstance(v0, str):
+        ln = len(v0)
+        for v in values:
+            if not isinstance(v, str) or len(v) != ln or v[-1:] == "\x00":
+                return None
+        return np.array(values, dtype=f"U{max(ln, 1)}")
+    if _scalar_kinds(v0):
+        cls = _scalar_class(v0)
+        if any(_scalar_class(v) != cls for v in values[1:]):
+            return None  # mixed kinds: asarray would coerce (lossy)
+        try:
+            arr = np.asarray(values)
+        except (ValueError, OverflowError):
+            return None
+        if arr.dtype.hasobject or arr.shape != (len(values),):
+            return None
+        return arr
+    return None
+
+
+def columnize_records(records: Sequence[Any]) -> ColumnChunk | None:
+    """Columnize one chunk of rows ONCE, driver-side. Returns None when
+    the chunk must ride the row-pickle fallback (the caller counts the
+    fallback and ships the original list)."""
+    if not records:
+        return None
+    first = records[0]
+    if isinstance(first, dict):
+        keys = tuple(first.keys())
+        keyset = set(keys)
+        for r in records[1:]:
+            if not isinstance(r, dict) or set(r.keys()) != keyset:
+                return None
+        arrays = []
+        for k in keys:
+            col = _column([r[k] for r in records])
+            if col is None:
+                return None
+            arrays.append(col)
+        return ColumnChunk("dict", keys, arrays)
+    if isinstance(first, (tuple, list)):
+        arity = len(first)
+        for r in records[1:]:
+            if not isinstance(r, (tuple, list)) or len(r) != arity:
+                return None
+        arrays = []
+        for i in range(arity):
+            col = _column([r[i] for r in records])
+            if col is None:
+                return None
+            arrays.append(col)
+        return ColumnChunk("tuple", tuple(range(arity)), arrays)
+    col = _column(list(records))
+    if col is None:
+        return None
+    return ColumnChunk("flat", (None,), (col,))
+
+
+# -- encode ------------------------------------------------------------------
+
+_PAD = b"\x00" * ALIGN
+
+
+def encode_parts(
+    chunk: ColumnChunk,
+    qname: str | None = None,
+    stream: str | None = None,
+    seq: int = 0,
+    crc: bool = True,
+) -> list[Any]:
+    """Encode to a scatter list ``[bytes | ndarray, ...]`` whose
+    concatenation is the frame — the shm ring pushes these straight from
+    numpy memory (``ShmRing.push_parts``) with no assembly copy.
+
+    ``crc=False`` skips the payload checksum (``payload_crc: None``) —
+    the same-host ring path, where the extra full pass over the buffers
+    costs more than the memory transport can ever corrupt."""
+    arrays = [np.ascontiguousarray(a) for a in chunk.arrays]
+    cols = []
+    off = 0
+    payload_crc: int | None = 0 if crc else None
+    for k, a in zip(chunk.keys, arrays):
+        nb = a.nbytes
+        cols.append((k, a.dtype.str, a.shape, off, nb))
+        if crc:
+            payload_crc = crc32(a, payload_crc)
+        off = _align(off + nb)
+    header = pickle.dumps(
+        {
+            "v": 1,
+            "qname": qname,
+            "kind": chunk.kind,
+            "n": chunk.n,
+            "cols": cols,
+            "payload_crc": payload_crc,
+            "stream": stream,
+            "seq": seq,
+        },
+        protocol=pickle.HIGHEST_PROTOCOL,
+    )
+    head = _PREFIX.pack(MAGIC, len(header), crc32(header)) + header
+    parts: list[Any] = [head + _PAD[: _align(len(head)) - len(head)]]
+    for (_, _, _, coff, nb), a in zip(cols, arrays):
+        parts.append(a)
+        pad = _align(nb) - nb
+        if pad:
+            parts.append(_PAD[:pad])
+    return parts
+
+
+def frame_bytes(
+    chunk: ColumnChunk,
+    qname: str | None = None,
+    stream: str | None = None,
+    seq: int = 0,
+    crc: bool = True,
+) -> bytes:
+    """The frame as one bytes object (TCP / file transports)."""
+    return b"".join(
+        p.tobytes() if isinstance(p, np.ndarray) else p
+        for p in encode_parts(
+            chunk, qname=qname, stream=stream, seq=seq, crc=crc
+        )
+    )
+
+
+def parts_nbytes(parts: list[Any]) -> int:
+    return sum(
+        p.nbytes if isinstance(p, np.ndarray) else len(p) for p in parts
+    )
+
+
+# -- decode ------------------------------------------------------------------
+
+
+def is_frame(buf) -> bool:
+    """True when ``buf`` starts with the columnar frame magic."""
+    try:
+        mv = memoryview(buf)
+    except TypeError:
+        return False
+    return len(mv) >= _PREFIX.size and bytes(mv[:4]) == MAGIC
+
+
+def decode_frame(buf, path: str | None = None) -> ColumnChunk:
+    """Decode a frame into column views over ``buf`` (zero-copy: the
+    views' base chain keeps ``buf`` — ring slot, bytes object, or mmap —
+    alive until the batch is consumed or transferred). Raises
+    ValueError on magic/version/CRC mismatch."""
+    mv = memoryview(buf)
+    if bytes(mv[:3]) != MAGIC[:3]:
+        raise ValueError("not a columnar frame (bad magic)")
+    if mv[3] != MAGIC[3]:
+        raise ValueError(
+            f"unsupported columnar frame version {mv[3]} (have {MAGIC[3]})"
+        )
+    _, hlen, hcrc = _PREFIX.unpack_from(mv, 0)
+    header_bytes = bytes(mv[_PREFIX.size : _PREFIX.size + hlen])
+    if len(header_bytes) != hlen or crc32(header_bytes) != hcrc:
+        raise ValueError("columnar frame header CRC mismatch (corrupt frame)")
+    h = pickle.loads(header_bytes)
+    payload_start = _align(_PREFIX.size + hlen)
+    verify = _VERIFY_PAYLOAD and h.get("payload_crc") is not None
+    keys, arrays = [], []
+    crc = 0
+    for k, dt, shape, off, nb in h["cols"]:
+        dtype = np.dtype(dt)
+        a = np.frombuffer(
+            mv, dtype=dtype, count=nb // dtype.itemsize if dtype.itemsize else 0,
+            offset=payload_start + off,
+        ).reshape(shape)
+        if verify:
+            crc = crc32(a, crc)
+        keys.append(k)
+        arrays.append(a)
+    if verify and crc != h["payload_crc"]:
+        raise ValueError("columnar frame payload CRC mismatch (corrupt frame)")
+    chunk = ColumnChunk(
+        h["kind"],
+        keys,
+        arrays,
+        qname=h.get("qname"),
+        stream=h.get("stream"),
+        seq=int(h.get("seq", 0)),
+    )
+    if path is not None:
+        _count_decode(chunk, len(mv), path)
+    return chunk
+
+
+def frame_span(buf, offset: int = 0) -> int:
+    """Total byte length of the frame starting at ``offset`` in ``buf``
+    (header + aligned payload) — the file reader's framing step."""
+    mv = memoryview(buf)
+    _, hlen, _ = _PREFIX.unpack_from(mv, offset)
+    header_bytes = bytes(
+        mv[offset + _PREFIX.size : offset + _PREFIX.size + hlen]
+    )
+    h = pickle.loads(header_bytes)
+    payload = 0
+    for _, _, _, off, nb in h["cols"]:
+        payload = max(payload, _align(off + nb))
+    return _align(_PREFIX.size + hlen) + payload
+
+
+# -- framed files (manifest path) --------------------------------------------
+
+
+def write_frames(
+    path: str,
+    records: Iterable[Any],
+    records_per_frame: int = 1024,
+    stream: str | None = None,
+) -> int:
+    """Write records to ``path`` as a sequence of 64-aligned columnar
+    frames (the node-local file format ``FileManifest(format=
+    "columnar")`` reads back zero-copy via mmap). Records must be
+    columnizable — ragged/object data should stay on tfrecord/lines.
+    Returns the record count."""
+    n = 0
+    seq = 0
+    with open(path, "wb") as f:
+        batch: list[Any] = []
+
+        def flush():
+            nonlocal seq
+            if not batch:
+                return
+            chunk = columnize_records(batch)
+            if chunk is None:
+                raise ValueError(
+                    "records are not columnizable (ragged/object data); "
+                    "use tfrecord or lines manifests instead"
+                )
+            data = frame_bytes(chunk, stream=stream, seq=seq)
+            f.write(data)
+            f.write(_PAD[: _align(len(data)) - len(data)])
+            seq += 1
+
+        for r in records:
+            batch.append(r)
+            n += 1
+            if len(batch) >= records_per_frame:
+                flush()
+                batch = []
+        flush()
+    return n
+
+
+def read_frames(path: str) -> Iterator[ColumnChunk]:
+    """Yield the ColumnChunks of a framed file as zero-copy views over
+    one shared mmap (kept alive by the views' base chain)."""
+    import mmap as _mmap
+
+    with open(path, "rb") as f:
+        size = os.fstat(f.fileno()).st_size
+        if size == 0:
+            return
+        mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+    mv = memoryview(mm)
+    off = 0
+    while off + _PREFIX.size <= size:
+        span = frame_span(mv, off)
+        yield decode_frame(mv[off : off + span], path="manifest")
+        off += _align(span)
+
+
+# -- batch assembly ----------------------------------------------------------
+
+
+class ColumnAssembler:
+    """Accumulates pieces — row lists or :class:`ColumnChunk` — and
+    assembles column batches by SLICING. A batch that lands inside one
+    chunk is pure views (zero-copy); one that crosses pieces pays a
+    single per-column concatenate; a row-list piece pays the legacy
+    ``columnize_rows`` for exactly its records."""
+
+    #: Cap on wire-view bytes the assembler may pin across a blocking
+    #: pull. A batch assembled from ring-backed views freezes the shm
+    #: tail at its oldest frame until the batch completes; a single
+    #: batch bigger than the ring would therefore starve the producer of
+    #: push space forever (the drain's per-frame guard cannot see
+    #: consumer-side accumulation). Past this cap every held view piece
+    #: is copied out — the slots release, the tail advances, the feed
+    #: keeps flowing; only outsized batches pay the copy.
+    MATERIALIZE_HELD_BYTES = 16 << 20
+
+    def __init__(self, input_mapping: dict[str, str]):
+        self.mapping = input_mapping
+        self._pieces: deque = deque()
+        self._count = 0
+
+    def __len__(self) -> int:
+        return self._count
+
+    def push(self, piece) -> None:
+        n = len(piece)
+        if not n:
+            return
+        self._pieces.append(piece)
+        self._count += n
+        held = sum(
+            p.nbytes
+            for p in self._pieces
+            if isinstance(p, ColumnChunk) and p.is_view
+        )
+        if held > self.MATERIALIZE_HELD_BYTES:
+            self._pieces = deque(
+                p.materialize()
+                if isinstance(p, ColumnChunk) and p.is_view
+                else p
+                for p in self._pieces
+            )
+
+    def drain_pieces(self) -> Iterator[Any]:
+        """Hand the buffered pieces back unassembled (``batch_stream``
+        taking over a feed that ``next_batch`` partially consumed)."""
+        while self._pieces:
+            piece = self._pieces.popleft()
+            self._count -= len(piece)
+            yield piece
+
+    def take(self, k: int) -> dict[str, np.ndarray]:
+        """Assemble exactly ``min(k, len(self))`` records."""
+        from tensorflowonspark_tpu.feed.datafeed import columnize_rows
+
+        k = min(k, self._count)
+        mapped: list[dict[str, np.ndarray]] = []
+        need = k
+        while need:
+            head = self._pieces[0]
+            n = len(head)
+            take = min(need, n)
+            if isinstance(head, ColumnChunk):
+                part = head if take == n else head.view(0, take)
+                mapped.append(part.by_mapping(self.mapping))
+            else:
+                mapped.append(columnize_rows(list(head[:take]), self.mapping))
+            if take == n:
+                self._pieces.popleft()
+            elif isinstance(head, ColumnChunk):
+                self._pieces[0] = head.view(take, n)
+            else:
+                self._pieces[0] = head[take:]
+            need -= take
+        self._count -= k
+        if not mapped:
+            return columnize_rows([], self.mapping)
+        if len(mapped) == 1:
+            return mapped[0]
+        return {
+            key: np.concatenate([m[key] for m in mapped])
+            for key in mapped[0]
+        }
+
+
+def column_batches(
+    pieces: Iterable[Any],
+    batch_size: int,
+    multiple_of: int,
+    input_mapping: dict[str, str],
+) -> Iterator[dict[str, np.ndarray]]:
+    """Fixed-size column batches from a stream of pieces (row lists /
+    chunks) — ``utils.batching.fixed_size_batches`` semantics (steady
+    shapes, tail trimmed to ``multiple_of``, sub-multiple remainder
+    dropped loudly) via slicing instead of per-record stacking."""
+    batch_size -= batch_size % multiple_of
+    if batch_size == 0:
+        raise ValueError(
+            f"batch_size < multiple_of ({multiple_of}); nothing to yield"
+        )
+    asm = ColumnAssembler(input_mapping)
+    for piece in pieces:
+        asm.push(piece)
+        while len(asm) >= batch_size:
+            yield asm.take(batch_size)
+    tail = len(asm) - len(asm) % multiple_of
+    if len(asm) % multiple_of:
+        logger.warning(
+            "dropping %d tail records (not a multiple of %d)",
+            len(asm) % multiple_of,
+            multiple_of,
+        )
+    if tail:
+        yield asm.take(tail)

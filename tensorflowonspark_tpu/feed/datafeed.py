@@ -22,10 +22,22 @@ from typing import Any, Sequence
 import numpy as np
 
 from tensorflowonspark_tpu.cluster.marker import EndOfFeed, EndPartition, Marker
+from tensorflowonspark_tpu.feed.columnar import (
+    ColumnAssembler,
+    ColumnChunk,
+    ColumnarFrame,
+    column_batches,
+    decode_frame,
+)
 from tensorflowonspark_tpu.obs import spans as obs_spans
 from tensorflowonspark_tpu.utils.failpoints import failpoint
 
 logger = logging.getLogger(__name__)
+
+# Sentinel for a chunk discarded by the armed ``columnar.frame`` drop
+# failpoint: the pull loop skips it (the NEXT frame's sequence check is
+# what surfaces the loss).
+_DROPPED = object()
 
 class FeedTimeout(TimeoutError):
     """The input queue produced nothing for the whole feed-timeout
@@ -121,6 +133,14 @@ class DataFeed:
         self._queue_in = mgr.get_queue(qname_in)
         self._queue_out = mgr.get_queue(qname_out)
         self._buffer: list[Any] = []  # records from a partially-consumed chunk
+        # Columnar consumption state: pending pieces (ColumnChunk views /
+        # row lists) assembled by SLICING when an input_mapping is set,
+        # and per-stream frame sequence tracking (a dropped frame must
+        # fail loudly, not silently lose records).
+        self._assembler = (
+            ColumnAssembler(input_mapping) if input_mapping else None
+        )
+        self._seq_state: dict[str, int] = {}
 
     def next_batch(self, batch_size: int) -> list | dict[str, np.ndarray]:
         """Return up to ``batch_size`` records.
@@ -129,11 +149,57 @@ class DataFeed:
         :class:`EndPartition` marker is hit (partition boundary) and an
         empty/partial batch with ``should_stop() == True`` once
         :class:`EndOfFeed` is seen. Reference: ``TFNode.py:DataFeed.next_batch``.
+
+        With an ``input_mapping``, the returned ``{tensor: array}``
+        columns are SLICED from columnar wire chunks when the producer
+        shipped them (zero-copy views while a batch lands inside one
+        chunk); row-pickle chunks pay the legacy per-batch stacking.
         """
-        batch = self._next_raw(batch_size)
         if self.input_mapping is None:
-            return batch
-        return self._columnize(batch)
+            return self._next_raw(batch_size)
+        if self._assembler is None:
+            # degenerate empty mapping: no columns to slice — keep the
+            # pre-columnar contract (stack rows, here into an empty dict)
+            return columnize_rows(self._next_raw(batch_size), self.input_mapping)
+        return self._next_columns(batch_size)
+
+    def _check_seq(self, chunk: ColumnChunk) -> None:
+        """Frame-drop detection: frames of one producer stream carry a
+        monotonic ``seq``; a gap means a frame was lost mid-stream
+        (see the ``columnar.frame`` failpoint) and records silently
+        vanished — raise instead of training on a hole."""
+        if chunk.stream is None:
+            return
+        last = self._seq_state.get(chunk.stream)
+        expected = 0 if last is None else last + 1
+        if chunk.seq != expected:
+            raise RuntimeError(
+                f"columnar frame sequence gap on queue {self.qname_in!r} "
+                f"stream {chunk.stream}: expected frame {expected}, got "
+                f"{chunk.seq} — a frame was dropped mid-stream"
+            )
+        self._seq_state[chunk.stream] = chunk.seq
+
+    def _ingest(self, item: Any) -> Any:
+        """Normalize a queue item: decode TCP-borne frames (zero-copy
+        views over the received bytes) and run the sequence check on
+        every columnar chunk."""
+        if isinstance(item, ColumnarFrame):
+            item = decode_frame(item.data, path="tcp")
+        if isinstance(item, ColumnChunk):
+            if failpoint("columnar.frame") == "drop":
+                return _DROPPED
+            self._check_seq(item)
+        elif isinstance(item, EndPartition):
+            # Stream ids are per-partition (feed_partition mints one per
+            # call), so the finished partition's seq entry is dead — a
+            # long-running streaming job (one feed_partition per
+            # micro-batch) would otherwise grow this dict forever. A
+            # frame dropped at the very END of a stream is inherently
+            # undetectable by seq-gap (there is no successor frame),
+            # with or without this clear.
+            self._seq_state.clear()
+        return item
 
     def _next_raw(self, batch_size: int) -> list:
         """``next_batch`` core: up to ``batch_size`` raw records, no mapping."""
@@ -154,6 +220,9 @@ class DataFeed:
             with obs_spans.span("feed.queue_get"):
                 item = self._pull()
             self._queue_in.task_done()
+            item = self._ingest(item)
+            if item is _DROPPED:
+                continue
             if isinstance(item, Marker) or item is None:
                 if isinstance(item, EndPartition):
                     if batch:
@@ -162,11 +231,44 @@ class DataFeed:
                 # EndOfFeed / legacy None terminal marker
                 self.done_feeding = True
                 break
+            elif isinstance(item, ColumnChunk):
+                # mapping-less consumers want record lists: materialize
+                self._buffer.extend(item.rows())
             elif isinstance(item, list):
                 self._buffer.extend(item)
             else:  # single record (legacy per-item producers)
                 batch.append(item)
+            # drop the local before the next blocking pull: a ColumnChunk
+            # held here would pin its ring slot and stall the producer
+            item = None
         return batch
+
+    def _next_columns(self, batch_size: int) -> dict[str, np.ndarray]:
+        """``next_batch`` core for mapped feeds: accumulate pieces and
+        assemble by slicing column views (zero-copy within one chunk)."""
+        asm = self._assembler
+        while len(asm) < batch_size:
+            if self.done_feeding:
+                break
+            with obs_spans.span("feed.queue_get"):
+                item = self._pull()
+            self._queue_in.task_done()
+            item = self._ingest(item)
+            if item is _DROPPED:
+                continue
+            if isinstance(item, Marker) or item is None:
+                if isinstance(item, EndPartition):
+                    if len(asm):
+                        break  # partial batch at partition boundary
+                    continue
+                self.done_feeding = True
+                break
+            elif isinstance(item, (ColumnChunk, list)):
+                asm.push(item)
+            else:  # single record (legacy per-item producers)
+                asm.push([item])
+            item = None  # see _next_raw: never hold a chunk across a pull
+        return asm.take(batch_size)
 
     @property
     def feed_timeout(self) -> float | None:
@@ -214,9 +316,6 @@ class DataFeed:
             except _queue.Empty:
                 continue
 
-    def _columnize(self, batch: Sequence[Any]) -> dict[str, np.ndarray]:
-        return columnize_rows(batch, self.input_mapping)
-
     def batch_stream(self, batch_size: int, multiple_of: int = 1):
         """Yield fixed-size batches, buffering across partition boundaries.
 
@@ -233,6 +332,13 @@ class DataFeed:
         from tensorflowonspark_tpu.utils.batching import fixed_size_batches
 
         mapping = self.input_mapping
+        if mapping:
+            # Columnar fast path: stream pieces (chunks / row lists) into
+            # the slicing assembler; same fixed-size + tail-trim contract.
+            yield from column_batches(
+                self._pieces(batch_size), batch_size, multiple_of, mapping
+            )
+            return
 
         def records():
             while not self.should_stop():
@@ -242,10 +348,32 @@ class DataFeed:
             records(),
             batch_size,
             multiple_of,
-            assemble=(
-                self._columnize if mapping else lambda rows: list(rows)
-            ),
+            assemble=lambda rows: list(rows),
         )
+
+    def _pieces(self, batch_hint: int):
+        """Pieces (ColumnChunk views / row lists) until feed end,
+        ignoring partition boundaries (``batch_stream`` fills across
+        them); leftovers buffered by ``next_batch`` drain first."""
+        asm = self._assembler
+        if len(asm):
+            yield from asm.drain_pieces()  # next_batch leftovers first
+        while not self.done_feeding:
+            with obs_spans.span("feed.queue_get"):
+                item = self._pull()
+            self._queue_in.task_done()
+            item = self._ingest(item)
+            if item is _DROPPED or isinstance(item, EndPartition):
+                continue
+            if isinstance(item, Marker) or item is None:
+                self.done_feeding = True
+                return
+            if isinstance(item, (ColumnChunk, list)):
+                piece, item = item, None
+                yield piece
+                del piece  # see _next_raw: no chunk ref across a pull
+            else:
+                yield [item]
 
     def should_stop(self) -> bool:
         """True once the feed is exhausted. Reference: ``DataFeed.should_stop``."""

@@ -35,7 +35,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Iterator
 
-__all__ = ["FileManifest", "ManifestFeed", "read_manifest"]
+__all__ = ["FileManifest", "ManifestFeed", "read_manifest", "read_manifest_chunks"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,10 +43,14 @@ class FileManifest:
     """One node-readable unit of input: a file (or a record range of one).
 
     ``format``: ``'tfrecord'`` (rows decoded via the native codec +
-    ``dfutil.fromTFExample``) or ``'lines'`` (text lines, stripped).
-    Custom formats: pass a ``reader`` callable to :class:`ManifestFeed`
-    instead. ``start``/``stop`` bound the record index range (Python
-    slice semantics), so one large file can be split across nodes.
+    ``dfutil.fromTFExample``), ``'lines'`` (text lines, stripped), or
+    ``'columnar'`` (a file of 64-aligned columnar frames written by
+    ``feed.columnar.write_frames`` — read back as zero-copy column
+    views over one shared mmap; ``ManifestFeed.batch_stream`` slices
+    batches straight out of the chunks). Custom formats: pass a
+    ``reader`` callable to :class:`ManifestFeed` instead.
+    ``start``/``stop`` bound the record index range (Python slice
+    semantics), so one large file can be split across nodes.
     """
 
     path: str
@@ -75,11 +79,32 @@ def read_manifest(
     elif m.format == "lines":
         with open(m.path) as f:
             yield from _sliced((line.rstrip("\n") for line in f), m)
+    elif m.format == "columnar":
+        for chunk in read_manifest_chunks(m):
+            yield from chunk.rows()
     else:
         raise ValueError(
             f"unknown manifest format {m.format!r}; use 'tfrecord', "
-            "'lines', or pass reader= to ManifestFeed"
+            "'lines', 'columnar', or pass reader= to ManifestFeed"
         )
+
+
+def read_manifest_chunks(m: FileManifest):
+    """ColumnChunks of a ``'columnar'`` manifest, honoring its
+    ``start``/``stop`` record range by chunk-slicing (views — the mmap
+    stays shared)."""
+    from tensorflowonspark_tpu.feed.columnar import read_frames
+
+    pos = 0
+    for chunk in read_frames(m.path):
+        lo = max(m.start - pos, 0)
+        hi = len(chunk) if m.stop is None else min(m.stop - pos, len(chunk))
+        pos += len(chunk)
+        if hi <= lo:
+            if m.stop is not None and pos >= m.stop:
+                return
+            continue
+        yield chunk if (lo, hi) == (0, len(chunk)) else chunk.view(lo, hi)
 
 
 def _sliced(rows: Iterator[Any], m: FileManifest) -> Iterator[Any]:
@@ -147,21 +172,59 @@ class ManifestFeed:
         Manifest records are rows, so an ``input_mapping`` for column
         assembly is taken here rather than from the underlying feed
         (whose records are manifests, not rows)."""
-        from tensorflowonspark_tpu.feed.datafeed import columnize_rows
         from tensorflowonspark_tpu.utils.batching import fixed_size_batches
+
+        if input_mapping is not None:
+            from tensorflowonspark_tpu.feed.columnar import column_batches
+
+            # Columnar manifests contribute whole chunks (batches are
+            # then SLICED column views); other formats contribute row
+            # lists that pay columnize_rows per batch, as before.
+            yield from column_batches(
+                self._pieces(batch_size),
+                batch_size,
+                multiple_of,
+                input_mapping,
+            )
+            return
 
         def records():
             while not self.should_stop():
                 yield from self.next_batch(batch_size)
 
-        assemble = (
-            (lambda rows: columnize_rows(list(rows), input_mapping))
-            if input_mapping is not None
-            else (lambda rows: list(rows))
-        )
         yield from fixed_size_batches(
-            records(), batch_size, multiple_of, assemble=assemble
+            records(), batch_size, multiple_of, assemble=lambda rows: list(rows)
         )
+
+    def _pieces(self, batch_hint: int):
+        """Pieces (ColumnChunk / row lists) across the fed manifests —
+        starting with the remainder of a manifest a prior ``next_batch``
+        call partially consumed (``self._iter``)."""
+        import itertools
+
+        def row_pieces(it):
+            while True:
+                rows = list(itertools.islice(it, max(batch_hint, 1)))
+                if not rows:
+                    return
+                yield rows
+
+        if self._iter is not None:
+            leftover, self._iter = self._iter, None
+            yield from row_pieces(leftover)
+        while True:
+            got = self.feed.next_batch(1)
+            if not got:
+                return
+            m = got[0]
+            if (
+                self.reader is None
+                and isinstance(m, FileManifest)
+                and m.format == "columnar"
+            ):
+                yield from read_manifest_chunks(m)
+                continue
+            yield from row_pieces(read_manifest(m, self.reader))
 
     def terminate(self) -> None:
         self.feed.terminate()

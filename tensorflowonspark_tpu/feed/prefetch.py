@@ -21,16 +21,85 @@ Usage::
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 from typing import Any, Callable, Iterable, Iterator
 
+import jax
+import numpy as np
+
 from tensorflowonspark_tpu.compute.mesh import shard_batch
 from tensorflowonspark_tpu.obs import spans as obs_spans
 from tensorflowonspark_tpu.utils.failpoints import failpoint
 
+logger = logging.getLogger(__name__)
+
 _DONE = object()
+
+
+class _StagingPool:
+    """Rotating host staging buffers for the producer thread.
+
+    Columnar batches arrive as views over wire memory (ring slots, TCP
+    bytes, mmaps); copying them into a small pool of REUSED contiguous
+    host buffers right before ``device_put`` (a) releases the underlying
+    ring frame the moment the batch is staged — the "consumed or
+    transferred" end of the zero-copy lifetime — and (b) stops the
+    steady-state loop from allocating fresh host arrays per batch. The
+    pool holds ``depth + 2`` slots so a buffer is never rewritten while
+    its batch can still be in flight (queue depth + the consumer's
+    current batch + the one being staged) — and, because the Python-side
+    window cannot bound XLA's async H2D copy, ``stage`` additionally
+    blocks on the slot's PREVIOUS device transfer before rewriting it
+    (``commit`` records each transfer result against its slot). Without
+    that, an input-bound loop on TPU/GPU could overwrite host memory a
+    still-running DMA is reading from."""
+
+    def __init__(self, slots: int):
+        self._slots: list[dict | None] = [None] * max(1, slots)
+        self._inflight: list[Any] = [None] * max(1, slots)
+        self._i = 0
+        self._staged_i: int | None = None
+
+    def stage(self, batch):
+        if not isinstance(batch, dict):
+            self._staged_i = None
+            return batch  # row-list batches pass through untouched
+        i = self._i
+        prev = self._inflight[i]
+        if prev is not None:
+            jax.block_until_ready(prev)
+            self._inflight[i] = None
+        slot = self._slots[i]
+        if (
+            slot is None
+            or len(slot) != len(batch)
+            or any(
+                k not in slot
+                or slot[k].shape != v.shape
+                or slot[k].dtype != v.dtype
+                for k, v in batch.items()
+            )
+        ):
+            slot = {
+                k: np.empty(v.shape, v.dtype) for k, v in batch.items()
+            }
+            self._slots[i] = slot
+        for k, v in batch.items():
+            np.copyto(slot[k], v)
+        self._staged_i = i
+        self._i = (i + 1) % len(self._slots)
+        return slot
+
+    def commit(self, transferred) -> None:
+        """Tie the device-side result of the just-staged batch to its
+        slot, so the next ``stage`` of that slot can wait out the
+        transfer before rewriting the host buffer."""
+        if self._staged_i is not None:
+            self._inflight[self._staged_i] = transferred
+            self._staged_i = None
 
 
 class DevicePrefetcher:
@@ -70,6 +139,60 @@ class DevicePrefetcher:
             target=self._run, args=(iter(host_batches),), daemon=True
         )
         self._thread.start()
+
+    @classmethod
+    def from_feed(
+        cls,
+        feed,
+        batch_size: int,
+        mesh=None,
+        depth: int = 2,
+        multiple_of: int = 1,
+        prepare: Callable[[Any], Any] | None = None,
+        transform: Callable[[Any], Any] | None = None,
+        input_mapping: dict[str, str] | None = None,
+    ) -> "DevicePrefetcher":
+        """THE default training-loop input: device batches straight off a
+        :class:`~tensorflowonspark_tpu.feed.datafeed.DataFeed` (or
+        ``ManifestFeed``).
+
+        The producer thread pulls ``feed.batch_stream(batch_size,
+        multiple_of)`` — columnar wire chunks are batch-sliced as
+        zero-copy views there — runs ``prepare`` (optional host-side
+        transform: dtype casts, normalization), stages the batch into a
+        reused host buffer (releasing the underlying ring frame), and
+        issues ``shard_batch``/``device_put`` — so columnize + H2D fully
+        hide behind step compute::
+
+            feed = ctx.get_data_feed(input_mapping={...})
+            with DevicePrefetcher.from_feed(
+                feed, bs, mesh, multiple_of=jax.device_count()
+            ) as pf:
+                for batch in pf:
+                    state, loss = step(state, batch)
+        """
+        staging = _StagingPool(depth + 2)
+        if transform is None:
+            if mesh is None:
+                raise ValueError("need a mesh or an explicit transform")
+            transform = lambda b: shard_batch(mesh, b)  # noqa: E731
+
+        # ManifestFeed takes the column mapping at batch_stream (its feed
+        # records are manifests, not rows); DataFeed holds it from the ctor.
+        kwargs = {} if input_mapping is None else {"input_mapping": input_mapping}
+
+        def host_batches():
+            for cols in feed.batch_stream(batch_size, multiple_of, **kwargs):
+                yield cols
+
+        def stage_and_transfer(cols):
+            if prepare is not None:
+                cols = prepare(cols)
+            out = transform(staging.stage(cols))
+            staging.commit(out)
+            return out
+
+        return cls(host_batches(), depth=depth, transform=stage_and_transfer)
 
     def stats(self) -> dict:
         """Producer-side counters: batches transferred to device and
@@ -134,15 +257,44 @@ class DevicePrefetcher:
             raise StopIteration
         return batch
 
-    def close(self) -> None:
+    def close(self) -> bool:
+        """Stop the producer and drain the queue; returns whether the
+        producer thread actually joined (mirrors ``EmitWorker.stop``:
+        ``False`` means it is wedged mid-transfer and was abandoned)."""
         self._stop.set()
-        # drain so the producer's blocked put can observe the stop flag
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
+
+        # drain so the producer's blocked put can observe the stop flag;
+        # a ferried terminal error found here would otherwise vanish
+        # silently with the queue
+        def _drain() -> BaseException | None:
+            found: BaseException | None = None
+            try:
+                while True:
+                    batch, err = self._queue.get_nowait()
+                    if batch is _DONE and err is not None:
+                        found = err
+            except queue.Empty:
+                return found
+
+        swallowed = _drain()
         self._thread.join(timeout=5)
+        joined = not self._thread.is_alive()
+        # re-drain after the join: _put_final checks the stop flag only
+        # BETWEEN put attempts, so an in-flight put can land the ferried
+        # (_DONE, err) just after the first drain emptied the queue
+        swallowed = _drain() or swallowed
+        if swallowed is not None:
+            logger.warning(
+                "DevicePrefetcher.close: discarding ferried producer "
+                "error (never observed by the consumer): %r",
+                swallowed,
+            )
+        if not joined:
+            logger.warning(
+                "DevicePrefetcher.close: producer thread did not join "
+                "within 5s (stuck in transform/transfer); abandoning it"
+            )
+        return joined
 
     def __enter__(self) -> "DevicePrefetcher":
         return self

@@ -155,6 +155,25 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.shmring_detach.argtypes = [c.c_void_p]
     lib.shmring_unlink.restype = c.c_int
     lib.shmring_unlink.argtypes = [c.c_char_p]
+    # shmring columnar zero-copy extensions
+    lib.shmring_avail.restype = i64
+    lib.shmring_avail.argtypes = [c.c_void_p, u64, i64]
+    lib.shmring_payload_ptr.restype = c.c_void_p
+    lib.shmring_payload_ptr.argtypes = [c.c_void_p, u64, u64]
+    lib.shmring_read_at.restype = None
+    lib.shmring_read_at.argtypes = [c.c_void_p, u64, u8p, u64]
+    lib.shmring_tail.restype = u64
+    lib.shmring_tail.argtypes = [c.c_void_p]
+    lib.shmring_set_tail.restype = None
+    lib.shmring_set_tail.argtypes = [c.c_void_p, u64]
+    lib.shmring_pushv.restype = c.c_int
+    lib.shmring_pushv.argtypes = [
+        c.c_void_p,
+        c.POINTER(c.c_void_p),
+        c.POINTER(u64),
+        u64,
+        i64,
+    ]
 
 
 def available() -> bool:

@@ -200,6 +200,98 @@ int64_t shmring_pop(void* handle, uint8_t* out, uint64_t out_cap) {
   return static_cast<int64_t>(len32);
 }
 
+// ---- columnar zero-copy extensions ----------------------------------------
+//
+// The columnar feed path consumes records as VIEWS over the ring memory
+// instead of copying them out: the Python side keeps a consumer-local
+// virtual cursor (monotonic byte offset, >= tail) and releases slots by
+// advancing the shared tail only once all views over them have died
+// (refcounted frames). These entry points are offset-addressed so the
+// cursor can run ahead of the tail; the SPSC contract is unchanged.
+
+// Payload length of the record at byte-offset `off` (a consumer-side
+// cursor), waiting up to timeout_ms for one to arrive. kTimeout, or
+// kClosed once the producer closed AND everything up to `off` is
+// consumed.
+int64_t shmring_avail(void* handle, uint64_t off, int64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(handle);
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  int iter = 0;
+  while (r->hdr->head.load(std::memory_order_acquire) - off < 4) {
+    if (r->hdr->closed.load(std::memory_order_acquire) &&
+        r->hdr->head.load(std::memory_order_acquire) == off)
+      return kClosed;
+    if (deadline >= 0 && now_ms() > deadline) return kTimeout;
+    backoff(iter++);
+  }
+  uint8_t lenbuf[4];
+  ring_read(r, off, lenbuf, 4);
+  uint32_t len32;
+  std::memcpy(&len32, lenbuf, 4);
+  return static_cast<int64_t>(len32);
+}
+
+// Pointer to the payload of the record at `off` when it lies contiguous
+// in the mapping; NULL when it wraps the ring end (the caller copies it
+// out via shmring_read_at instead). The pointer stays valid until the
+// tail is advanced past the record.
+const uint8_t* shmring_payload_ptr(void* handle, uint64_t off, uint64_t len) {
+  Ring* r = static_cast<Ring*>(handle);
+  uint64_t cap = r->hdr->capacity;
+  uint64_t pos = (off + 4) % cap;
+  if (pos + len > cap) return nullptr;
+  return r->data + pos;
+}
+
+// Modular copy of n bytes starting at byte-offset `off` (absolute, not
+// payload-relative: callers pass off+4 to skip the length prefix).
+void shmring_read_at(void* handle, uint64_t off, uint8_t* dst, uint64_t n) {
+  ring_read(static_cast<Ring*>(handle), off, dst, n);
+}
+
+uint64_t shmring_tail(void* handle) {
+  return static_cast<Ring*>(handle)->hdr->tail.load(std::memory_order_acquire);
+}
+
+// Release consumed bytes: advance the shared tail to `new_tail`
+// (monotonic; the Python frame bookkeeping guarantees FIFO release).
+void shmring_set_tail(void* handle, uint64_t new_tail) {
+  static_cast<Ring*>(handle)->hdr->tail.store(new_tail,
+                                              std::memory_order_release);
+}
+
+// Scatter push: ONE record whose payload is the concatenation of
+// `nparts` buffers — the columnar frame path appends header + column
+// buffers straight from numpy memory, no assembly copy on the producer.
+int shmring_pushv(void* handle, const uint8_t* const* parts,
+                  const uint64_t* lens, uint64_t nparts, int64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(handle);
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < nparts; i++) total += lens[i];
+  uint64_t need = 4 + total;
+  uint64_t cap = r->hdr->capacity;
+  if (need > cap || total > UINT32_MAX - 4) return kTooBig;
+  int64_t deadline = timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+  uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+  int iter = 0;
+  while (cap - (head - r->hdr->tail.load(std::memory_order_acquire)) < need) {
+    if (r->hdr->closed.load(std::memory_order_relaxed)) return kClosed;
+    if (deadline >= 0 && now_ms() > deadline) return kTimeout;
+    backoff(iter++);
+  }
+  uint32_t len32 = static_cast<uint32_t>(total);
+  uint8_t lenbuf[4];
+  std::memcpy(lenbuf, &len32, 4);
+  ring_write(r, head, lenbuf, 4);
+  uint64_t off = head + 4;
+  for (uint64_t i = 0; i < nparts; i++) {
+    ring_write(r, off, parts[i], lens[i]);
+    off += lens[i];
+  }
+  r->hdr->head.store(head + need, std::memory_order_release);
+  return kOk;
+}
+
 void shmring_close_write(void* handle) {
   static_cast<Ring*>(handle)->hdr->closed.store(1, std::memory_order_release);
 }

@@ -1,20 +1,38 @@
 """ctypes bindings for the shared-memory ring buffer (``shmring.cc``).
 
 The feed plane's same-host fast path: a co-located producer streams
-pickled record chunks through POSIX shm instead of the TCP manager proxy
-(the reference's per-item proxied ``queue.put`` — SURVEY.md §3.2).
+record chunks through POSIX shm instead of the TCP manager proxy (the
+reference's per-item proxied ``queue.put`` — SURVEY.md §3.2).
 
 Ownership: the CONSUMER side (node process) creates the segment and
 advertises its name in the reservation roster; producers attach by name.
 One producer and one consumer at a time (per-handle locks serialize
 threads within a process; the cluster feed plane already guarantees one
 feeder per node).
+
+Zero-copy consumption (the columnar feed path): :meth:`ShmRing.pop_frame`
+returns the next record as a ``np.uint8`` VIEW over the ring memory when
+the record lies contiguous in the mapping (it wraps the ring end only
+once per ~capacity bytes, where a copy fallback kicks in). Each view is
+backed by a refcounted ring frame: the consumer keeps a virtual cursor
+ahead of the shared ``tail``, and the slot is released — tail advanced,
+producer space reclaimed — only when the LAST view over it is garbage
+collected (``weakref.finalize`` on the buffer owner at the base of every
+view chain), in FIFO order. A consumer that holds decoded column views
+therefore backpressures the producer through the ring itself, and a view
+can never be overwritten while alive. :meth:`ShmRing.push_parts`
+complements it on the producer side: one record scatter-gathered from
+header + column buffers straight out of numpy memory, no assembly copy.
 """
 
 from __future__ import annotations
 
 import ctypes
 import threading
+import weakref
+from collections import deque
+
+import numpy as np
 
 from tensorflowonspark_tpu.native import load_library
 
@@ -28,6 +46,26 @@ def available() -> bool:
     return load_library() is not None
 
 
+class _RingFrame:
+    """One outstanding zero-copy slot: ``end`` is the ring offset just
+    past the record. ``release`` is idempotent and safe from any thread
+    (GC runs it via ``weakref.finalize`` when the last view dies)."""
+
+    __slots__ = ("_ring", "end", "released")
+
+    def __init__(self, ring: "ShmRing", end: int):
+        self._ring = ring
+        self.end = end
+        self.released = False
+
+    def release(self) -> None:
+        ring = self._ring
+        if ring is None:
+            return
+        self._ring = None
+        ring._release_frame(self)
+
+
 class ShmRing:
     """One endpoint of a shared-memory ring (see module docstring)."""
 
@@ -37,6 +75,18 @@ class ShmRing:
         self._h = handle
         self._owner = owner
         self._lock = threading.Lock()
+        # Consumer-side virtual cursor: next unread ring offset. Starts
+        # at the shared tail (0 for a fresh segment); runs ahead of the
+        # tail while zero-copy frames are outstanding.
+        self._cursor = int(self._lib.shmring_tail(handle)) if handle else 0
+        # Outstanding zero-copy frames, FIFO by end offset. RLock, not
+        # Lock: frame release runs from weakref.finalize, which GC can
+        # invoke DURING an allocation made while this lock is held (e.g.
+        # _RingFrame() in _retire) — on the same thread, so a plain lock
+        # would self-deadlock the drain.
+        self._frames: deque[_RingFrame] = deque()  # guarded-by: self._frame_lock
+        self._frame_lock = threading.RLock()
+        self._close_pending = False  # guarded-by: self._frame_lock
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -61,13 +111,28 @@ class ShmRing:
         return cls(name, handle=h, owner=False)
 
     def close(self) -> None:
+        """Detach (and unlink, as owner). With zero-copy views still
+        alive the detach is DEFERRED until the last frame releases —
+        unmapping under a live view would turn it into a dangling
+        pointer; the views' GC completes the close."""
         with self._lock:
             if self._h is None:
                 return
-            self._lib.shmring_detach(self._h)
-            self._h = None
-            if self._owner:
-                self._lib.shmring_unlink(self.name.encode())
+            with self._frame_lock:
+                if self._frames:
+                    self._close_pending = True
+                    return
+                self._detach_locked()
+
+    def _detach_locked(self) -> None:
+        """Actual detach; caller holds ``_frame_lock`` (and there are no
+        outstanding frames)."""
+        if self._h is None:
+            return
+        self._lib.shmring_detach(self._h)
+        self._h = None
+        if self._owner:
+            self._lib.shmring_unlink(self.name.encode())
 
     def __del__(self):  # best-effort cleanup of the shm segment
         try:
@@ -85,6 +150,38 @@ class ShmRing:
             if self._h is None:
                 raise BrokenPipeError("shmring detached")
             rc = self._lib.shmring_push(self._h, record, len(record), ms)
+        self._check_push_rc(rc, len(record), timeout)
+
+    def push_parts(self, parts: list, timeout: float | None = None) -> None:
+        """Scatter-push ONE record whose payload is the concatenation of
+        ``parts`` (bytes or C-contiguous ndarrays) — the columnar frame
+        path appends header + column buffers straight from numpy memory,
+        skipping the single-buffer assembly copy."""
+        ms = -1 if timeout is None else int(timeout * 1000)
+        n = len(parts)
+        ptrs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_uint64 * n)()
+        keep = []  # pin every part's buffer for the duration of the call
+        total = 0
+        for i, p in enumerate(parts):
+            if isinstance(p, np.ndarray):
+                p = np.ascontiguousarray(p)
+                ptrs[i] = p.ctypes.data
+                lens[i] = p.nbytes
+                total += p.nbytes
+            else:
+                ptrs[i] = ctypes.cast(ctypes.c_char_p(p), ctypes.c_void_p)
+                lens[i] = len(p)
+                total += len(p)
+            keep.append(p)
+        with self._lock:
+            if self._h is None:
+                raise BrokenPipeError("shmring detached")
+            rc = self._lib.shmring_pushv(self._h, ptrs, lens, n, ms)
+        del keep
+        self._check_push_rc(rc, total, timeout)
+
+    def _check_push_rc(self, rc: int, nbytes: int, timeout) -> None:
         if rc == 0:
             return
         if rc == _TIMEOUT:
@@ -92,7 +189,7 @@ class ShmRing:
         if rc == _CLOSED:
             raise BrokenPipeError("shmring closed")
         if rc == _TOO_BIG:
-            raise ValueError(f"record of {len(record)}B exceeds ring capacity")
+            raise ValueError(f"record of {nbytes}B exceeds ring capacity")
         raise OSError(f"shmring_push failed: {rc}")
 
     def close_write(self) -> None:
@@ -103,25 +200,115 @@ class ShmRing:
 
     # -- consumer ------------------------------------------------------------
 
-    def pop(self, timeout: float | None = None) -> bytes | None:
-        """Next record; None when the producer closed and the ring drained;
-        TimeoutError on timeout."""
+    def _avail(self, timeout: float | None) -> int | None:
+        """Length of the record at the cursor; None when closed+drained.
+        Caller holds ``_lock``."""
         ms = -1 if timeout is None else int(timeout * 1000)
+        n = self._lib.shmring_avail(self._h, self._cursor, ms)
+        if n == _CLOSED:
+            return None
+        if n == _TIMEOUT:
+            raise TimeoutError(f"shmring pop timed out after {timeout}s")
+        if n < 0:
+            raise OSError(f"shmring_avail failed: {n}")
+        return int(n)
+
+    def pop(self, timeout: float | None = None) -> bytes | None:
+        """Next record, copied out; None when the producer closed and the
+        ring drained; TimeoutError on timeout."""
         with self._lock:
-            if self._h is None:
+            with self._frame_lock:
+                if self._h is None or self._close_pending:
+                    return None
+            n = self._avail(timeout)
+            if n is None:
                 return None
-            n = self._lib.shmring_peek_len(self._h, ms)
-            if n == _CLOSED:
-                return None
-            if n == _TIMEOUT:
-                raise TimeoutError(f"shmring pop timed out after {timeout}s")
-            if n < 0:
-                raise OSError(f"shmring_peek_len failed: {n}")
             buf = (ctypes.c_uint8 * n)()
-            got = self._lib.shmring_pop(self._h, buf, n)
-            if got != n:
-                raise OSError(f"shmring_pop failed: {got}")
+            self._lib.shmring_read_at(self._h, self._cursor + 4, buf, n)
+            end = self._cursor + 4 + n
+            self._cursor = end
+            self._retire(end)
             return bytes(buf)
+
+    def pop_frame(self, timeout: float | None = None):
+        """Next record as a ``np.uint8`` VIEW over the ring memory when
+        it lies contiguous (zero-copy; the slot is released when the
+        last derived view is garbage collected), else a copied ``bytes``
+        (the record wraps the ring end). None when closed and drained."""
+        with self._lock:
+            with self._frame_lock:
+                if self._h is None or self._close_pending:
+                    return None
+            n = self._avail(timeout)
+            if n is None:
+                return None
+            end = self._cursor + 4 + n
+            ptr = self._lib.shmring_payload_ptr(self._h, self._cursor, n)
+            if not ptr or n == 0:
+                # wrapped (or empty) payload: copy fallback
+                buf = (ctypes.c_uint8 * n)()
+                self._lib.shmring_read_at(self._h, self._cursor + 4, buf, n)
+                self._cursor = end
+                self._retire(end)
+                return bytes(buf)
+            carr = (ctypes.c_ubyte * n).from_address(ptr)
+            frame = _RingFrame(self, end)
+            with self._frame_lock:
+                self._frames.append(frame)
+            # the ctypes array sits at the base of every numpy view chain
+            # over this slot: its collection == no views left == release
+            weakref.finalize(carr, frame.release)
+            self._cursor = end
+            return np.frombuffer(carr, dtype=np.uint8)
+
+    def _retire(self, end: int) -> None:
+        """A copied (non-view) record up to ``end`` is consumed: release
+        immediately, honoring FIFO order behind outstanding frames.
+        Caller holds ``_lock``."""
+        with self._frame_lock:
+            if not self._frames:
+                if self._h is not None:
+                    self._lib.shmring_set_tail(self._h, end)
+                return
+            f = _RingFrame(self, end)
+            f.released = True
+            f._ring = None
+            self._frames.append(f)
+            self._advance_locked()
+
+    def _release_frame(self, frame: _RingFrame) -> None:
+        """Frame refcount hit zero (last view GC'd): advance the shared
+        tail through the released FIFO prefix; complete a deferred close
+        when the last frame goes."""
+        with self._frame_lock:
+            frame.released = True
+            self._advance_locked()
+            if self._close_pending and not self._frames:
+                self._close_pending = False
+                self._detach_locked()
+
+    def _advance_locked(self) -> None:  # lint: holds-lock
+        """Caller holds ``_frame_lock``."""
+        new_tail = None
+        while self._frames and self._frames[0].released:
+            new_tail = self._frames.popleft().end
+        if new_tail is not None and self._h is not None:
+            self._lib.shmring_set_tail(self._h, new_tail)
+
+    def outstanding_frames(self) -> int:
+        with self._frame_lock:
+            return len(self._frames)
+
+    def outstanding_bytes(self) -> int:
+        """Ring bytes still pinned by outstanding zero-copy frames
+        (newest frame end − shared tail) — the drain's backpressure
+        signal for copying frames out instead of viewing them."""
+        with self._frame_lock:
+            if not self._frames or self._h is None:
+                return 0
+            return int(
+                self._frames[-1].end - self._lib.shmring_tail(self._h)
+            )
 
     def size(self) -> int:
         with self._lock:

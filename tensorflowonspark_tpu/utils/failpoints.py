@@ -81,6 +81,8 @@ SITES = frozenset(
         # data plane
         "datafeed.get",  # DataFeed._next_raw queue pull
         "datafeed.put_results",  # DataFeed.batch_results push
+        "columnar.frame",  # columnar frame decode points ("drop" aware:
+        # a dropped frame is surfaced by the consumer's seq-gap check)
         "prefetch.producer",  # DevicePrefetcher producer thread
         # serving plane
         "engine.submit",  # ContinuousBatcher enqueue (caller thread)

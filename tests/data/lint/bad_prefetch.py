@@ -1,0 +1,38 @@
+"""Seeded PF001 violation: raw next_batch into a jitted step in a loop."""
+
+import jax
+
+
+def loss(state, batch):
+    return state
+
+
+step = jax.jit(loss)
+
+
+def train(feed, state):
+    while not feed.should_stop():
+        batch = feed.next_batch(64)  # PF001: serial pull + H2D per step
+        state = step(state, batch)
+    return state
+
+
+def train_factory(feed, state, tx, mesh):
+    from tensorflowonspark_tpu.compute import build_train_step
+
+    train_step = build_train_step(loss, tx, mesh)
+    for _ in range(10):
+        cols = feed.next_batch(32)  # PF001 via the jit-returning factory
+        state, _ = train_step(state, cols)
+    return state
+
+
+def ok_prefetched(feed, state, pf):
+    # the FIX: producer generator pulls; the loop consumes device batches
+    def host_batches():
+        while not feed.should_stop():
+            yield feed.next_batch(64)
+
+    for batch in pf:
+        state = step(state, batch)
+    return state

@@ -45,6 +45,7 @@ def test_bench_smoke_emits_complete_json():
     assert out["tokens_per_sec_per_chip"] > 0
     assert out["final_loss"] > 0
     assert out["mnist_examples_per_sec"] > 0
+    assert out["mnist_feed_mb_s"] > 0
     assert out["mnist_final_loss"] > 0
 
 

@@ -1,0 +1,604 @@
+"""Chunk-columnar wire format tests (``feed/columnar.py``).
+
+Covers the ISSUE-5 acceptance surface:
+
+- codec round-trips for every supported dtype and record kind, with the
+  ragged/object/mixed fallbacks that keep non-columnizable data on the
+  row-pickle wire;
+- CRC/magic/version rejection of corrupt frames;
+- zero-copy decode (views over the wire buffer, no payload copies) and
+  the refcounted ring-frame lifetime, including under wraparound and a
+  deferred close;
+- exact batch parity between the columnar and row paths through
+  ``DataFeed`` (next_batch + batch_stream) and ``DevicePrefetcher``;
+- frame-drop detection: the ``columnar.frame`` failpoint drops a frame
+  mid-stream and the consumer's sequence check raises instead of
+  silently losing records;
+- the framed node-local file format behind ``FileManifest(format=
+  "columnar")``.
+"""
+
+import gc
+import secrets
+import threading
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.cluster import manager
+from tensorflowonspark_tpu.cluster.marker import EndOfFeed, EndPartition
+from tensorflowonspark_tpu.feed import columnar as col
+from tensorflowonspark_tpu.feed.datafeed import DataFeed
+from tensorflowonspark_tpu.utils import failpoints
+
+
+@pytest.fixture()
+def mgr():
+    h = manager.start(
+        secrets.token_bytes(16),
+        queues=("input", "output", "row", "colr", "rag"),
+        mode="local",
+    )
+    yield h
+    h.stop()
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    failpoints.disarm_all()
+
+
+# -- codec round-trips -------------------------------------------------------
+
+DTYPES = [
+    np.bool_,
+    np.int8,
+    np.uint8,
+    np.int16,
+    np.uint16,
+    np.int32,
+    np.uint32,
+    np.int64,
+    np.uint64,
+    np.float16,
+    np.float32,
+    np.float64,
+    np.complex64,
+    np.complex128,
+]
+
+
+@pytest.mark.parametrize("dtype", DTYPES, ids=[np.dtype(d).name for d in DTYPES])
+def test_roundtrip_every_dtype(dtype):
+    rng = np.random.default_rng(0)
+    base = (rng.random((7, 2, 3)) * 100).astype(dtype)
+    records = [{"a": base[i], "b": dtype(base[i].flat[0])} for i in range(7)]
+    chunk = col.columnize_records(records)
+    assert chunk is not None and chunk.kind == "dict"
+    out = col.decode_frame(col.frame_bytes(chunk, qname="input"))
+    np.testing.assert_array_equal(out.columns()["a"], base)
+    assert out.columns()["a"].dtype == dtype
+    np.testing.assert_array_equal(out.columns()["b"], base[:, 0, 0].astype(dtype))
+
+
+def test_roundtrip_bytes_str_and_kinds():
+    # dict with fixed-width bytes + str columns
+    records = [{"k": b"ab%d" % i, "s": "s%02d" % i} for i in range(5)]
+    out = col.decode_frame(col.frame_bytes(col.columnize_records(records)))
+    assert [r["k"] for r in out.rows()] == [r["k"] for r in records]
+    assert [str(r["s"]) for r in out.rows()] == [r["s"] for r in records]
+    # tuple records keep positional order
+    tuples = [(i, np.float32(i) / 2) for i in range(4)]
+    out = col.decode_frame(col.frame_bytes(col.columnize_records(tuples)))
+    assert out.kind == "tuple"
+    assert [
+        (int(a), float(b)) for a, b in out.rows()
+    ] == [(i, i / 2) for i in range(4)]
+    # flat scalar records
+    out = col.decode_frame(col.frame_bytes(col.columnize_records([1, 2, 3])))
+    assert out.kind == "flat" and [int(v) for v in out.rows()] == [1, 2, 3]
+
+
+@pytest.mark.parametrize(
+    "records",
+    [
+        [np.zeros(3), np.zeros(4)],  # ragged shapes
+        [np.array([object()], dtype=object)],  # object dtype
+        [{"a": 1}, {"b": 1}],  # key mismatch
+        [(1, 2), (1, 2, 3)],  # arity mismatch
+        [{"a": 1}, {"a": "x"}],  # mixed scalar kinds in one column
+        [b"a\x00", b"b\x00"],  # trailing NUL (numpy S-dtype trims it)
+        ["ab", "abc"],  # variable-width strings
+        [{"a": 1}, (1,)],  # mixed record shapes
+    ],
+)
+def test_fallback_to_row_pickle(records):
+    assert col.columnize_records(records) is None
+
+
+def test_corrupt_frames_rejected():
+    chunk = col.columnize_records([{"a": np.arange(8)}] * 2)
+    data = bytearray(col.frame_bytes(chunk))
+    bad_payload = data.copy()
+    bad_payload[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="payload CRC"):
+        col.decode_frame(bytes(bad_payload))
+    bad_header = data.copy()
+    bad_header[16] ^= 0xFF
+    with pytest.raises(ValueError, match="header CRC"):
+        col.decode_frame(bytes(bad_header))
+    with pytest.raises(ValueError, match="magic"):
+        col.decode_frame(b"NOPE" + bytes(data[4:]))
+    bad_version = data.copy()
+    bad_version[3] = 9
+    with pytest.raises(ValueError, match="version"):
+        col.decode_frame(bytes(bad_version))
+
+
+def test_encode_parts_layout():
+    """The scatter list concatenates to the one-buffer frame, and every
+    column lands 64-aligned relative to the payload start (what lets the
+    shm ring serve aligned zero-copy views)."""
+    chunk = col.columnize_records(
+        [{"a": np.arange(5, dtype=np.int8), "b": 1.5} for _ in range(3)]
+    )
+    parts = col.encode_parts(chunk, qname="q")
+    joined = b"".join(
+        p.tobytes() if isinstance(p, np.ndarray) else bytes(p) for p in parts
+    )
+    assert joined == col.frame_bytes(chunk, qname="q")
+    assert col.parts_nbytes(parts) == len(joined)
+    decoded = col.decode_frame(joined)
+    base = np.frombuffer(joined, np.uint8).__array_interface__["data"][0]
+    for arr in decoded.arrays:
+        addr = arr.__array_interface__["data"][0]
+        assert (addr - base) % col.ALIGN == 0
+
+
+def test_decode_is_zero_copy():
+    chunk = col.columnize_records([{"a": np.arange(64, dtype=np.int64)}] * 4)
+    buf = col.frame_bytes(chunk)
+    base = np.frombuffer(buf, dtype=np.uint8)
+    lo = base.__array_interface__["data"][0]
+    out = col.decode_frame(buf)
+    for arr in out.arrays:
+        addr = arr.__array_interface__["data"][0]
+        assert lo <= addr < lo + len(buf), "decoded column was copied"
+
+
+# -- batch assembly ----------------------------------------------------------
+
+
+def test_assembler_slices_within_chunk_zero_copy():
+    chunk = col.columnize_records(
+        [{"x": np.arange(4, dtype=np.float32) + i, "y": i} for i in range(10)]
+    )
+    asm = col.ColumnAssembler({"x": "x", "y": "y"})
+    asm.push(chunk)
+    batch = asm.take(4)
+    assert batch["x"].shape == (4, 4)
+    assert np.shares_memory(batch["x"], chunk.arrays[0])
+    batch2 = asm.take(6)
+    assert np.shares_memory(batch2["x"], chunk.arrays[0])
+    np.testing.assert_array_equal(batch2["y"], np.arange(4, 10))
+
+
+def test_assembler_mixes_chunks_and_row_lists():
+    rows = [(np.full(3, i, np.float32), i) for i in range(6)]
+    chunk = col.columnize_records(rows[:4])
+    asm = col.ColumnAssembler({"a": "img", "b": "lbl"})
+    asm.push(chunk)
+    asm.push(rows[4:])  # legacy row-pickle piece
+    batch = asm.take(6)
+    np.testing.assert_array_equal(batch["lbl"], np.arange(6))
+    np.testing.assert_array_equal(
+        batch["img"], np.stack([r[0] for r in rows])
+    )
+
+
+def test_assembler_caps_pinned_view_bytes(monkeypatch):
+    """Held view-backed pieces past MATERIALIZE_HELD_BYTES are copied
+    out (liveness rule 3: one batch bigger than the ring must not pin
+    the shm tail forever); owned driver-built pieces never are."""
+    monkeypatch.setattr(col.ColumnAssembler, "MATERIALIZE_HELD_BYTES", 4000)
+    asm = col.ColumnAssembler({"x": "x"})
+    make = lambda: col.columnize_records(
+        [{"x": np.arange(512, dtype=np.float32)}] * 2  # 4 KB per piece
+    )
+    for _ in range(3):
+        view = col.decode_frame(col.frame_bytes(make()))
+        assert view.is_view
+        asm.push(view)  # each piece alone exceeds the 4000 B cap
+    assert all(not p.is_view for p in asm._pieces), "cap did not materialize"
+    batch = asm.take(6)
+    np.testing.assert_array_equal(
+        batch["x"], np.tile(np.arange(512, dtype=np.float32), (6, 1))
+    )
+    owned = make()
+    asm.push(owned)
+    assert asm._pieces[0] is owned, "owned piece was needlessly copied"
+
+
+def test_column_batches_fixed_size_and_tail():
+    pieces = [
+        col.columnize_records([(i, 2 * i) for i in range(7)]),
+        [(j, 2 * j) for j in range(7, 11)],  # row list piece
+    ]
+    out = list(col.column_batches(iter(pieces), 4, 2, {"a": "a", "b": "b"}))
+    # 11 records -> 4, 4, tail 3 trimmed to 2 (multiple_of), 1 dropped
+    assert [len(b["a"]) for b in out] == [4, 4, 2]
+    np.testing.assert_array_equal(
+        np.concatenate([b["b"] for b in out]), 2 * np.arange(10)
+    )
+
+
+# -- DataFeed parity: columnar vs row ---------------------------------------
+
+
+def _records(n=23):
+    rng = np.random.default_rng(7)
+    return [
+        (rng.integers(0, 255, size=8).astype(np.int64), int(i % 10))
+        for i in range(n)
+    ]
+
+
+def _put_row_wire(q, records, chunk=6):
+    for i in range(0, len(records), chunk):
+        q.put(records[i : i + chunk])
+
+
+def _put_columnar_wire(q, records, chunk=6, stream="s0"):
+    seq = 0
+    for i in range(0, len(records), chunk):
+        ck = col.columnize_records(records[i : i + chunk])
+        assert ck is not None
+        q.put(
+            col.ColumnarFrame(
+                col.frame_bytes(ck, qname="input", stream=stream, seq=seq)
+            )
+        )
+        seq += 1
+
+
+MAPPING = {"image": "image", "label": "label"}
+
+
+def test_datafeed_next_batch_parity(mgr):
+    records = _records()
+    q_row, q_colr = mgr.get_queue("row"), mgr.get_queue("colr")
+    _put_row_wire(q_row, records)
+    q_row.put(EndOfFeed())
+    _put_columnar_wire(q_colr, records)
+    q_colr.put(EndOfFeed())
+
+    feed_row = DataFeed(mgr, qname_in="row", input_mapping=MAPPING)
+    feed_col = DataFeed(mgr, qname_in="colr", input_mapping=MAPPING)
+    while True:
+        b_row = feed_row.next_batch(5)
+        b_col = feed_col.next_batch(5)
+        assert set(b_row) == set(b_col) == {"image", "label"}
+        for k in b_row:
+            assert b_row[k].dtype == b_col[k].dtype
+            np.testing.assert_array_equal(b_row[k], b_col[k])
+        if feed_row.should_stop():
+            assert feed_col.should_stop()
+            break
+
+
+def test_datafeed_batch_stream_parity(mgr):
+    records = _records(31)
+    q_row, q_colr = mgr.get_queue("row"), mgr.get_queue("colr")
+    _put_row_wire(q_row, records, chunk=9)
+    q_row.put(EndPartition())
+    q_row.put(EndOfFeed())
+    _put_columnar_wire(q_colr, records, chunk=9)
+    q_colr.put(EndPartition())
+    q_colr.put(EndOfFeed())
+
+    rows = list(
+        DataFeed(mgr, qname_in="row", input_mapping=MAPPING).batch_stream(8, 2)
+    )
+    cols = list(
+        DataFeed(mgr, qname_in="colr", input_mapping=MAPPING).batch_stream(8, 2)
+    )
+    assert len(rows) == len(cols)
+    for br, bc in zip(rows, cols):
+        for k in br:
+            assert br[k].dtype == bc[k].dtype
+            np.testing.assert_array_equal(br[k], bc[k])
+
+
+def test_datafeed_batch_stream_after_next_batch_leftover(mgr):
+    """batch_stream must drain pieces a prior next_batch call buffered —
+    as PIECES, not pre-assembled columns — and preserve record order."""
+    records = _records(20)
+    q = mgr.get_queue("input")
+    _put_columnar_wire(q, records, chunk=7)
+    q.put(EndOfFeed())
+    feed = DataFeed(mgr, input_mapping=MAPPING)
+    head = feed.next_batch(3)  # buffers 4 leftover records of frame 0
+    batches = list(feed.batch_stream(4, 2))
+    got_imgs = np.concatenate(
+        [head["image"]] + [b["image"] for b in batches]
+    )
+    want = np.stack([r[0] for r in records])
+    np.testing.assert_array_equal(got_imgs, want[: len(got_imgs)])
+    assert len(got_imgs) == 3 + (20 - 3) // 4 * 4
+
+
+def test_datafeed_mapping_less_columnar_rows(mgr):
+    """Mapping-less consumers get plain record lists back even when the
+    wire shipped columns."""
+    records = _records(9)
+    q = mgr.get_queue("input")
+    _put_columnar_wire(q, records, chunk=4)
+    q.put(EndOfFeed())
+    feed = DataFeed(mgr)
+    got = []
+    while not feed.should_stop():
+        got.extend(feed.next_batch(50))
+    assert len(got) == 9
+    for (img, lbl), (rimg, rlbl) in zip(got, records):
+        np.testing.assert_array_equal(img, rimg)
+        assert int(lbl) == rlbl
+
+
+def test_datafeed_empty_mapping_legacy_contract(mgr):
+    """input_mapping={} is degenerate but must keep the pre-columnar
+    ``columnize_rows`` contract (empty column dict per batch for dict
+    records, loud arity error for tuple records) — not a TypeError off
+    a missing assembler."""
+    records = [{"a": i} for i in range(5)]
+    q = mgr.get_queue("input")
+    _put_columnar_wire(q, records, chunk=4)
+    q.put(EndOfFeed())
+    feed = DataFeed(mgr, input_mapping={})
+    while not feed.should_stop():
+        assert feed.next_batch(8) == {}
+
+    q2 = mgr.get_queue("row")
+    _put_columnar_wire(q2, _records(4), chunk=4)
+    q2.put(EndOfFeed())
+    feed2 = DataFeed(mgr, qname_in="row", input_mapping={})
+    with pytest.raises(ValueError, match="mapping must name every field"):
+        feed2.next_batch(8)
+
+
+def test_datafeed_seq_gap_raises(mgr):
+    """A frame dropped mid-stream (armed ``columnar.frame`` drop) must
+    surface as a loud sequence-gap error, not silently lost records."""
+    records = _records(18)
+    q = mgr.get_queue("input")
+    _put_columnar_wire(q, records, chunk=6)  # 3 frames, seq 0..2
+    q.put(EndOfFeed())
+    failpoints.arm("columnar.frame", "drop", count=1)
+    feed = DataFeed(mgr, input_mapping=MAPPING)
+    with pytest.raises(RuntimeError, match="sequence gap"):
+        for _ in range(4):
+            feed.next_batch(6)
+
+
+def test_feed_partition_wire_switch(mgr):
+    """columnar=True ships ColumnarFrame chunks; columnar=False pins the
+    legacy row-pickle wire (lists) — the operator escape hatch."""
+    from tensorflowonspark_tpu.cluster.node import feed_partition
+
+    mgr.set("state", "running")
+    records = _records(8)
+    fed = feed_partition(mgr, records, qname="colr", chunk=4, columnar=True)
+    assert fed == 8
+    q = mgr.get_queue("colr")
+    first = q.get_nowait()
+    assert isinstance(first, col.ColumnarFrame)
+
+    fed = feed_partition(mgr, records, qname="row", chunk=4, columnar=False)
+    assert fed == 8
+    q = mgr.get_queue("row")
+    first = q.get_nowait()
+    assert isinstance(first, list) and len(first) == 4
+
+    # non-columnizable records fall back chunk-by-chunk on the same queue
+    ragged = [np.zeros(3), np.zeros(4), np.zeros(5), np.zeros(6)]
+    fed = feed_partition(mgr, ragged, qname="rag", chunk=4, columnar=True)
+    assert fed == 4
+    first = mgr.get_queue("rag").get_nowait()
+    assert isinstance(first, list) and len(first) == 4
+
+
+# -- DevicePrefetcher parity -------------------------------------------------
+
+
+def test_prefetcher_parity_columnar_vs_row(mgr):
+    from tensorflowonspark_tpu.feed.prefetch import DevicePrefetcher
+
+    records = _records(26)
+    q_row, q_colr = mgr.get_queue("row"), mgr.get_queue("colr")
+    _put_row_wire(q_row, records, chunk=7)
+    q_row.put(EndOfFeed())
+    _put_columnar_wire(q_colr, records, chunk=7)
+    q_colr.put(EndOfFeed())
+
+    out = {}
+    for qname in ("row", "colr"):
+        feed = DataFeed(mgr, qname_in=qname, input_mapping=MAPPING)
+        with DevicePrefetcher.from_feed(
+            feed, 8, depth=2, multiple_of=2, transform=lambda b: b
+        ) as pf:
+            out[qname] = [dict(b) for b in pf]
+    assert len(out["row"]) == len(out["colr"])
+    for br, bc in zip(out["row"], out["colr"]):
+        for k in br:
+            assert br[k].dtype == bc[k].dtype
+            np.testing.assert_array_equal(br[k], bc[k])
+
+
+# -- ring zero-copy lifetime -------------------------------------------------
+
+shmring = pytest.importorskip("tensorflowonspark_tpu.native.shmring")
+needs_native = pytest.mark.skipif(
+    not shmring.available(), reason="native shmring unavailable"
+)
+
+
+def _ring_pair(capacity=1 << 14):
+    name = f"/tfos_colr_{secrets.token_hex(4)}"
+    consumer = shmring.ShmRing.create(name, capacity)
+    producer = shmring.ShmRing.open(name)
+    return consumer, producer
+
+
+@needs_native
+def test_ring_views_survive_wraparound():
+    """Zero-copy views stay intact while the producer wraps the ring
+    several times: a held frame pins the tail (backpressure, not
+    overwrite), so views are held in a bounded sliding window and each
+    is re-verified right before release — any slot reuse under a live
+    view would corrupt it."""
+    consumer, producer = _ring_pair(1 << 14)  # 16 KiB ring
+    frames = 24  # ~1 KiB payload each → ~2 full wraps
+    payload = [
+        np.arange(128, dtype=np.int64) + 1000 * i for i in range(frames)
+    ]
+
+    def produce():
+        for i in range(frames):
+            ck = col.columnize_records([{"v": payload[i]}])
+            producer.push_parts(
+                col.encode_parts(ck, stream="w", seq=i), timeout=60
+            )
+        producer.close_write()
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    held: list = []  # sliding window of live (chunk, seq) views
+    n = 0
+    while True:
+        buf = consumer.pop_frame(timeout=60)
+        if buf is None:
+            break
+        held.append((col.decode_frame(buf), n))
+        del buf
+        n += 1
+        if len(held) > 4:
+            chunk, seq = held.pop(0)
+            # verify JUST before releasing: it lived through the pushes
+            np.testing.assert_array_equal(
+                chunk.columns()["v"][0], payload[seq]
+            )
+            del chunk
+    t.join(timeout=60)
+    assert n == frames
+    assert consumer.outstanding_frames() >= 1
+    for chunk, seq in held:
+        np.testing.assert_array_equal(chunk.columns()["v"][0], payload[seq])
+    del held, chunk
+    gc.collect()
+    assert consumer.outstanding_frames() == 0
+    consumer.close()
+    producer.close()
+
+
+@needs_native
+def test_ring_close_deferred_until_views_die():
+    consumer, producer = _ring_pair()
+    ck = col.columnize_records([{"v": np.arange(32)}])
+    producer.push_parts(col.encode_parts(ck), timeout=10)
+    buf = consumer.pop_frame(timeout=10)
+    assert isinstance(buf, np.ndarray)
+    chunk = col.decode_frame(buf)
+    consumer.close()  # deferred: a live view pins the mapping
+    np.testing.assert_array_equal(chunk.columns()["v"][0], np.arange(32))
+    del buf, chunk
+    gc.collect()
+    assert consumer.outstanding_frames() == 0
+    producer.close()
+
+
+@needs_native
+def test_ring_pop_and_pop_frame_interleave_fifo():
+    """Copied pops behind an outstanding zero-copy frame must not advance
+    the tail past the held slot (FIFO release)."""
+    consumer, producer = _ring_pair()
+    for i in range(3):
+        ck = col.columnize_records([{"v": np.full(16, i, np.int32)}])
+        producer.push_parts(col.encode_parts(ck, seq=i), timeout=10)
+    producer.close_write()
+    first = consumer.pop_frame(timeout=10)  # held view
+    chunk0 = col.decode_frame(first)
+    assert consumer.pop(timeout=10) is not None  # copied: retires behind
+    assert consumer.pop_frame(timeout=10) is not None
+    np.testing.assert_array_equal(
+        chunk0.columns()["v"][0], np.zeros(16, np.int32)
+    )
+    del first, chunk0
+    gc.collect()
+    assert consumer.outstanding_frames() == 0
+    consumer.close()
+    producer.close()
+
+
+# -- framed files (manifest path) -------------------------------------------
+
+
+def test_write_read_frames_roundtrip(tmp_path):
+    path = str(tmp_path / "data.colf")
+    records = [
+        {"x": np.arange(6, dtype=np.float32) * i, "y": i} for i in range(10)
+    ]
+    assert col.write_frames(path, records, records_per_frame=4) == 10
+    chunks = list(col.read_frames(path))
+    assert [len(c) for c in chunks] == [4, 4, 2]
+    got = [r for c in chunks for r in c.rows()]
+    for g, r in zip(got, records):
+        np.testing.assert_array_equal(g["x"], r["x"])
+        assert int(g["y"]) == r["y"]
+
+
+def test_write_frames_rejects_ragged(tmp_path):
+    with pytest.raises(ValueError, match="not columnizable"):
+        col.write_frames(
+            str(tmp_path / "bad.colf"), [np.zeros(3), np.zeros(4)]
+        )
+
+
+def test_manifest_columnar_range_and_stream(tmp_path):
+    from tensorflowonspark_tpu.feed.manifest import (
+        FileManifest,
+        read_manifest,
+        read_manifest_chunks,
+    )
+
+    path = str(tmp_path / "data.colf")
+    records = [(np.full(4, i, np.int16), i) for i in range(12)]
+    col.write_frames(path, records, records_per_frame=5)
+    # record-range slicing across frame boundaries (views, shared mmap)
+    m = FileManifest(path, format="columnar", start=3, stop=9)
+    got = [int(r[1]) for r in read_manifest(m)]
+    assert got == list(range(3, 9))
+    assert sum(len(c) for c in read_manifest_chunks(m)) == 6
+    # whole file through the row reader
+    assert [int(r[1]) for r in read_manifest(FileManifest(path, format="columnar"))] == list(range(12))
+
+
+def test_manifest_feed_batch_stream_columnar(mgr, tmp_path):
+    from tensorflowonspark_tpu.feed.manifest import FileManifest, ManifestFeed
+
+    path = str(tmp_path / "data.colf")
+    records = [(np.arange(4, dtype=np.float64) + i, i) for i in range(20)]
+    col.write_frames(path, records, records_per_frame=6)
+    q = mgr.get_queue("input")
+    q.put([FileManifest(path, format="columnar")])
+    q.put(EndOfFeed())
+    feed = ManifestFeed(DataFeed(mgr))
+    batches = list(
+        feed.batch_stream(8, multiple_of=2, input_mapping={"a": "x", "b": "y"})
+    )
+    assert [len(b["y"]) for b in batches] == [8, 8, 4]
+    np.testing.assert_array_equal(
+        np.concatenate([b["y"] for b in batches]), np.arange(20)
+    )
+    np.testing.assert_array_equal(
+        batches[0]["x"][3], np.arange(4, dtype=np.float64) + 3
+    )

@@ -1,6 +1,9 @@
 """benchmarks/feed_plane.py smoke: the push-plane throughput bench's
-full path (cluster up, shm + forced-TCP feed, drain-timed JSON rows)
-must run at tiny sizes. The real numbers live in BASELINE.md."""
+full path (cluster up, shm + forced-TCP feed, columnar + row wires,
+drain-timed JSON rows) must run at tiny sizes — and the columnar wire
+must never lose to row-pickle on the shm path (the ISSUE-5 acceptance
+gate at smoke scale; the real numbers live in BASELINE.md and
+benchmarks/results/feed_plane_columnar.jsonl)."""
 
 import json
 import os
@@ -27,9 +30,10 @@ def test_feed_plane_bench_smoke():
             sys.executable,
             os.path.join(REPO, "benchmarks", "feed_plane.py"),
             "--nodes", "2",
-            "--mb-per-node", "4",
+            "--mb-per-node", "8",
             "--record-kb", "16",
             "--paths", "shm,tcp",
+            "--wire", "columnar,row",
         ],
         cwd=REPO,
         env=env,
@@ -43,8 +47,25 @@ def test_feed_plane_bench_smoke():
         for line in proc.stdout.splitlines()
         if line.startswith("{")
     ]
-    assert [r["path"] for r in rows] == ["shm", "tcp"]
+    assert [(r["path"], r["wire"]) for r in rows] == [
+        ("shm", "columnar"),
+        ("shm", "row"),
+        ("tcp", "columnar"),
+        ("tcp", "row"),
+    ]
+    by_leg = {(r["path"], r["wire"]): r for r in rows}
     for r in rows:
         assert r["nodes"] == 2
         assert r["mb_per_s"] > 0
         assert r["secs"] > 0
+    # The point of the columnar wire: even at smoke scale (where fixed
+    # cluster startup/teardown overhead dilutes the gap — the committed
+    # artifact shows >=3x at real payloads) it must not LOSE to the
+    # row-pickle wire on the shm path. 0.9: at 8 MB/node both legs are
+    # startup-dominated and land within a few percent of each other, so
+    # an exact >= flakes on shared-host timing noise; a real regression
+    # (columnar slower than row) shows up far below this.
+    assert (
+        by_leg[("shm", "columnar")]["mb_per_s"]
+        >= 0.9 * by_leg[("shm", "row")]["mb_per_s"]
+    ), rows

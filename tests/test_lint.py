@@ -180,6 +180,24 @@ def test_failpoint_registry_matches_rule_view():
     assert sites == set(SITES)
 
 
+def test_prefetch_rule_reports_seeded_violations(fixture_findings):
+    rel = f"{FIXTURES}/bad_prefetch.py"
+    hits = by_rule(fixture_findings, "PF001")
+    assert all(f.path == rel for f in hits), [f.render() for f in hits]
+    assert {f.line for f in hits} == {
+        _line_of("bad_prefetch.py", "feed.next_batch(64)  # PF001"),
+        _line_of("bad_prefetch.py", "feed.next_batch(32)  # PF001"),
+    }, [f.render() for f in hits]
+    assert all("DevicePrefetcher" in f.message for f in hits)
+
+
+def test_prefetch_rule_ignores_producer_generator(fixture_findings):
+    """next_batch inside a nested producer def (the prefetcher FIX) and
+    a jitted step consuming prefetched batches must not flag."""
+    line = _line_of("bad_prefetch.py", "yield feed.next_batch(64)")
+    assert not [f for f in fixture_findings if f.line == line]
+
+
 def test_clean_fixture_zero_false_positives(fixture_findings):
     noise = [f for f in fixture_findings if f.path.endswith("clean.py")]
     assert not noise, [f.render() for f in noise]

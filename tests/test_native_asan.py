@@ -99,6 +99,114 @@ lib.shmring_detach(prod)
 lib.shmring_detach(cons)
 lib.shmring_unlink(NAME)
 
+# -- columnar zero-copy extensions -----------------------------------------
+# Offset-addressed consumption (the refcounted-frame path): a virtual
+# cursor runs ahead of the shared tail, payloads are read through
+# shmring_payload_ptr when contiguous (with shmring_read_at as the wrap
+# fallback), and the tail is released K frames late — simulating held
+# views — so slot reuse under a deferred tail is exercised in every
+# wrap alignment. Scatter pushes (shmring_pushv) straddle the ring
+# capacity with multi-part frames.
+lib.shmring_avail.restype = c.c_int64
+lib.shmring_avail.argtypes = [c.c_void_p, c.c_uint64, c.c_int64]
+lib.shmring_payload_ptr.restype = c.c_void_p
+lib.shmring_payload_ptr.argtypes = [c.c_void_p, c.c_uint64, c.c_uint64]
+lib.shmring_read_at.restype = None
+lib.shmring_read_at.argtypes = [
+    c.c_void_p, c.c_uint64, c.POINTER(c.c_uint8), c.c_uint64
+]
+lib.shmring_tail.restype = c.c_uint64
+lib.shmring_tail.argtypes = [c.c_void_p]
+lib.shmring_set_tail.restype = None
+lib.shmring_set_tail.argtypes = [c.c_void_p, c.c_uint64]
+lib.shmring_pushv.restype = c.c_int
+lib.shmring_pushv.argtypes = [
+    c.c_void_p, c.POINTER(c.c_void_p), c.POINTER(c.c_uint64),
+    c.c_uint64, c.c_int64
+]
+
+NAME2 = b"/tfos_asan_colr"
+NV = 800
+lib.shmring_unlink(NAME2)
+cons = lib.shmring_create(NAME2, 1 << 14)
+assert cons
+prod = lib.shmring_open(NAME2)
+assert prod
+
+# part-size patterns: total frame sizes from tiny to capacity-straddling
+part_plans = [
+    [64],
+    [64, 1000],
+    [4093],
+    [64, 4093, 9000],
+    [15000],
+    [1, 1, 1],
+]
+
+def produce_v():
+    for i in range(NV):
+        plan = part_plans[i % len(part_plans)]
+        bufs = [bytes([(i + j) % 251]) * ln for j, ln in enumerate(plan)]
+        ptrs = (c.c_void_p * len(bufs))(
+            *[c.cast(c.c_char_p(b), c.c_void_p) for b in bufs]
+        )
+        lens = (c.c_uint64 * len(bufs))(*[len(b) for b in bufs])
+        rc = lib.shmring_pushv(prod, ptrs, lens, len(bufs), 60_000)
+        assert rc == 0, (i, rc)
+    lib.shmring_close_write(prod)
+
+t = threading.Thread(target=produce_v)
+t.start()
+cursor = lib.shmring_tail(cons)
+pending = []  # (end,) offsets released K frames late
+got = 0
+while True:
+    n = lib.shmring_avail(cons, cursor, 200)
+    if n == -2:
+        break
+    if n == -1:
+        # producer stalled on deferred tail space: release the oldest
+        # held "view" (what frame GC does in the Python wrapper)
+        if pending:
+            lib.shmring_set_tail(cons, pending.pop(0))
+            continue
+        n = lib.shmring_avail(cons, cursor, 60_000)
+        if n == -2:
+            break
+    assert n >= 0, n
+    plan = part_plans[got % len(part_plans)]
+    assert n == sum(plan), (got, n, plan)
+    ptr = lib.shmring_payload_ptr(cons, cursor, n)
+    buf = (c.c_uint8 * n)()
+    if ptr:
+        c.memmove(buf, ptr, n)
+    else:  # wrapped: modular copy fallback
+        lib.shmring_read_at(cons, cursor + 4, buf, n)
+    off = 0
+    for j, ln in enumerate(plan):
+        expect = (got + j) % 251
+        assert buf[off] == expect and buf[off + ln - 1] == expect, (got, j)
+        off += ln
+    cursor += 4 + n
+    pending.append(cursor)
+    if len(pending) > 3:  # deferred FIFO release (held views)
+        lib.shmring_set_tail(cons, pending.pop(0))
+    got += 1
+if pending:
+    lib.shmring_set_tail(cons, pending[-1])
+t.join()
+assert got == NV, (got, NV)
+
+# too-big scatter push must be rejected, not clobber the ring
+big = bytes(20000)
+ptrs = (c.c_void_p * 1)(c.cast(c.c_char_p(big), c.c_void_p))
+lens = (c.c_uint64 * 1)(len(big))
+assert lib.shmring_pushv(prod, ptrs, lens, 1, 0) == -3
+
+lib.shmring_detach(prod)
+lib.shmring_detach(cons)
+lib.shmring_unlink(NAME2)
+
 # -- tfrecord bindings -----------------------------------------------------
 lib.tfr_writer_open.restype = c.c_void_p
 lib.tfr_writer_open.argtypes = [c.c_char_p]

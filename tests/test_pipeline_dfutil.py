@@ -204,10 +204,11 @@ class _CountingIter:
 
 
 def test_transform_streams_local(tmp_path):
-    """transform_iter must pull input batch-by-batch, interleaved with
+    """transform_iter must pull input incrementally, interleaved with
     model calls — never list(data) (VERDICT round-2 weak #4). Verified
-    with a counting iterator: when the first result comes out, only the
-    first batch (not the dataset) has been consumed."""
+    with a counting iterator: when the first result comes out, at most
+    the prefetch window (depth-2 DevicePrefetcher: queue + in-flight +
+    staging ≈ 4 batches), not the dataset, has been consumed."""
     from tensorflowonspark_tpu.compute.checkpoint import save_checkpoint
 
     export_dir = str(tmp_path / "export")
@@ -222,7 +223,7 @@ def test_transform_streams_local(tmp_path):
     )
     stream = model.transform_iter(src)
     first = next(stream)
-    assert src.pulled <= 8, f"materialized {src.pulled} records up front"
+    assert src.pulled <= 8 * 4, f"materialized {src.pulled} records up front"
     rest = list(stream)
     assert src.pulled == 64
     preds = [float(p) for p in [first, *rest]]
