@@ -228,6 +228,22 @@ def _bench_mnist_feed(steps: int = 40) -> None:
     # what one record costs on the wire: the uint8 image + int32 label
     record_bytes = images[0].nbytes + labels[:1].nbytes
 
+    # Aggregator overhead leg: scrape this process's /metrics on the
+    # production cadence WHILE training (the driver would, via
+    # TFCluster.cluster_stats) and report scrape wall-time as a % of
+    # train wall-time — the obs plane must cost < 1% of train.step.
+    from tensorflowonspark_tpu.cluster import node as tf_node
+    from tensorflowonspark_tpu.obs import cluster as obs_cluster
+
+    agg = None
+    metrics_port = tf_node._maybe_start_metrics_server("127.0.0.1")
+    if metrics_port:
+        agg = obs_cluster.MetricsAggregator(
+            lambda: {0: f"http://127.0.0.1:{metrics_port}/metrics"},
+            interval=2.0,
+        )
+        agg.start()
+
     def produce():
         # the production wire shape: each chunk columnized ONCE into a
         # CRC-framed ColumnarFrame (feed/columnar.py), no row pickles
@@ -266,6 +282,24 @@ def _bench_mnist_feed(steps: int = 40) -> None:
         mnist_feed_mb_s=round(timed * batch_size * record_bytes / dt / 1e6, 1),
         mnist_final_loss=round(final, 4),
     )
+    if agg is not None:
+        agg.stop()
+        rounds = max(
+            1, int(agg.registry.counter("cluster_scrape_total").value())
+        )
+        if agg.total_scrape_cpu_s == 0.0:
+            # run shorter than one cadence: measure one round and
+            # amortize it over the production interval
+            agg.scrape_once()
+            denom = agg.interval
+        else:
+            denom = max(dt, rounds * agg.interval)
+        # CPU seconds the scrape thread consumed, NOT its wall time —
+        # on a saturated host wall is mostly GIL/IO waits that steal
+        # nothing from train.step
+        _partial["mnist_aggregator_overhead_pct"] = round(
+            100.0 * agg.total_scrape_cpu_s / denom, 4
+        )
 
 
 def _bench_serve(smoke: bool) -> None:

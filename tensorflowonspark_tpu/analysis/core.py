@@ -36,7 +36,7 @@ __all__ = [
     "run_lint",
 ]
 
-DEFAULT_RULES = ("LK", "JX", "HS", "TL", "FP", "PF")
+DEFAULT_RULES = ("LK", "JX", "HS", "TL", "FP", "PF", "OB")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -269,6 +269,7 @@ def run_lint(root: str, cfg: Config) -> list:
         hostsync,
         jaxapi,
         locks,
+        obsmetrics,
         prefetchrule,
     )
 
@@ -282,6 +283,8 @@ def run_lint(root: str, cfg: Config) -> list:
         findings.extend(fp_rule.check(pkg, cfg))
     if "PF" in enabled:
         findings.extend(prefetchrule.check(pkg, cfg))
+    if "OB" in enabled:
+        findings.extend(obsmetrics.check(pkg, cfg))
     if {"HS", "TL"} & enabled:
         findings.extend(
             hostsync.check(

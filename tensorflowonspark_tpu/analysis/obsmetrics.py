@@ -1,0 +1,129 @@
+"""OB001: obs metric names must be literal, snake_case, unit-suffixed.
+
+The observability plane is only queryable if metric names are static
+and consistent: a dashboard, the driver aggregator's merge, and the
+future autotuner all key on exact names. Three failure modes this rule
+blocks at build time:
+
+- **Dynamic names** (f-strings, variables): un-greppable, and the
+  cardinality is unbounded — a per-request name leaks series forever.
+  (Dynamic DIMENSIONS belong in labels, which are per-observation.)
+- **Case/format drift** (``CamelCase``, dots): Prometheus convention
+  is snake_case; ``sanitize_name`` exists for *mirrored* foreign names,
+  not hand-registered ones.
+- **Missing unit suffixes**: ``engine_ttft`` alone is ambiguous
+  (seconds? ms?); promtool's convention is the suffix IS the unit —
+  counters end ``_total``, histograms end in their unit
+  (``_seconds`` / ``_bytes``). Gauges are often dimensionless (queue
+  depth, slots busy) so only literalness + snake_case is enforced.
+
+Scope: calls to ``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)``
+in modules that import :mod:`tensorflowonspark_tpu.obs` (or its
+``registry``) — the only modules where those method names mean the obs
+registry. ``# lint: metric-name-ok`` on the call line suppresses (the
+one legitimate dynamic name: ``MetricsWriter``'s mirror of arbitrary
+scalar names, which sanitizes instead).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tensorflowonspark_tpu.analysis.core import Config, Finding, Module, Package
+
+__all__ = ["check"]
+
+_OBS_MODULES = (
+    "tensorflowonspark_tpu.obs",
+    "tensorflowonspark_tpu.obs.registry",
+)
+_METHODS = {"counter", "gauge", "histogram"}
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+_SUFFIXES = {
+    "counter": ("_total",),
+    "histogram": ("_seconds", "_bytes"),
+}
+_SUPPRESS = "lint: metric-name-ok"
+
+
+def _imports_obs(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.startswith(_OBS_MODULES[0]) for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            mod = node.module or ""
+            if mod.startswith(_OBS_MODULES[0]):
+                return True
+            if mod == "tensorflowonspark_tpu" and any(
+                a.name == "obs" for a in node.names
+            ):
+                return True
+    return False
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.findings: list[Finding] = []
+
+    def _flag(self, node: ast.AST, msg: str) -> None:
+        self.findings.append(
+            Finding(
+                "OB001", self.mod.relpath, node.lineno, node.col_offset, msg
+            )
+        )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in _METHODS
+            and _SUPPRESS not in self.mod.comments.get(node.lineno, "")
+        ):
+            kind = func.attr
+            arg = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    arg = kw.value
+            if arg is None:
+                pass  # not a registration call shape; leave it alone
+            elif not (
+                isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+            ):
+                self._flag(
+                    node,
+                    f"obs {kind} name must be a string literal (dynamic "
+                    "names are un-greppable and risk unbounded series "
+                    "cardinality; put dynamic dimensions in labels)",
+                )
+            else:
+                name = arg.value
+                if not _SNAKE.match(name):
+                    self._flag(
+                        node,
+                        f"obs metric name {name!r} must be snake_case "
+                        "([a-z][a-z0-9_]*)",
+                    )
+                elif kind in _SUFFIXES and not name.endswith(
+                    _SUFFIXES[kind]
+                ):
+                    want = "/".join(_SUFFIXES[kind])
+                    self._flag(
+                        node,
+                        f"obs {kind} name {name!r} must end with its "
+                        f"unit suffix ({want})",
+                    )
+        self.generic_visit(node)
+
+
+def check(pkg: Package, cfg: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in pkg.modules:
+        if not _imports_obs(mod.tree):
+            continue
+        checker = _Checker(mod)
+        checker.visit(mod.tree)
+        findings.extend(checker.findings)
+    return findings

@@ -77,6 +77,18 @@ def run_node(
 
     failpoint("node.startup")
 
+    # Cross-process trace context: the cluster id is the run's trace_id
+    # (stamped into every SpanTracer export on this node), so driver-
+    # and node-side spans of one run stitch into one timeline
+    # (obs.cluster / tools/trace_merge.py).
+    from tensorflowonspark_tpu.obs import cluster as obs_cluster
+    from tensorflowonspark_tpu.obs import flightrec
+
+    obs_cluster.set_trace_context(
+        str(cluster_meta.get("trace_id") or cluster_meta.get("id", "")),
+        node=f"node{executor_id}",
+    )
+
     job_name, task_index = _assign_role(
         executor_id, cluster_meta["cluster_template"]
     )
@@ -138,6 +150,28 @@ def run_node(
     metrics_port = None
     if cluster_meta.get("metrics", True):
         metrics_port = _maybe_start_metrics_server(host)
+
+    # 3d. failure flight recorder: a rolling atomic snapshot of this
+    #     process's recent spans/metrics/events on the heartbeat
+    #     cadence, so even a SIGKILL (no goodbye possible) leaves the
+    #     last interval at logs/flightrec-node<id>.json for the
+    #     postmortem (obs.flightrec; docs/OBSERVABILITY.md).
+    fr_dir = cluster_meta.get("flightrec_dir")
+    if fr_dir:
+        fr_dir = util.resolve_path(
+            fr_dir,
+            cluster_meta.get("default_fs", ""),
+            cluster_meta.get("working_dir", ""),
+        )
+        rec = flightrec.install(
+            os.path.join(fr_dir, f"flightrec-node{executor_id}.json"),
+            process=f"node{executor_id}",
+            interval=max(
+                1.0, float(cluster_meta.get("heartbeat_interval", 2.0) or 2.0)
+            ),
+        )
+        rec.note("node_start", executor_id=executor_id, host=host)
+        rec.start()
 
     # 4. register + roster barrier
     client = reservation.Client(cluster_meta["server_addr"])
@@ -203,9 +237,11 @@ def run_node(
             ctx.initialize_distributed()
         map_fun(tf_args, ctx)
         mgr.set("state", "finished")
-    except Exception:
+    except Exception as map_err:
         tb = traceback.format_exc()
         logger.error("map_fun failed:\n%s", tb)
+        flightrec.note("map_fun_error", error=repr(map_err))
+        flightrec.dump_now("map_fun_error")
         mgr.set("state", "error")
         try:
             mgr.get_queue("error").put(
@@ -235,11 +271,24 @@ def _start_heartbeater(
     client = reservation.Client(
         server_addr, retry=RetryPolicy(max_attempts=1)
     )
+    from tensorflowonspark_tpu.obs import cluster as obs_cluster
 
     def beat() -> None:
         while True:
             try:
-                if client.heartbeat(executor_id).get("stop"):
+                t0 = time.time()
+                reply = client.heartbeat(executor_id)
+                t1 = time.time()
+                # NTP-style clock sample off the beat we already pay
+                # for: offset = driver wall clock minus the round-trip
+                # midpoint; obs.cluster keeps the minimum-RTT sample
+                # (tightest error bound) for trace alignment.
+                server_unix = reply.get("server_unix")
+                if server_unix is not None:
+                    obs_cluster.note_clock_sync(
+                        float(server_unix) - (t0 + t1) / 2.0, t1 - t0
+                    )
+                if reply.get("stop"):
                     return  # cluster kill: no point beating on
             except Exception as e:  # noqa: BLE001 - a missed beat is the signal
                 logger.debug("heartbeat skipped: %s", e)
@@ -392,39 +441,15 @@ def _maybe_start_metrics_server(host: str) -> int | None:
     (Prometheus text format) on a free port; returns the port, or None
     when the server cannot bind. Runs in a daemon thread; the endpoint
     is read-only and allocation-free per scrape beyond the rendered
-    text."""
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    text. This is what the driver's MetricsAggregator scrapes on the
+    heartbeat cadence (``TFCluster.cluster_stats()``)."""
+    from tensorflowonspark_tpu.obs.cluster import serve_text
+    from tensorflowonspark_tpu.obs.registry import default_registry
 
-    from tensorflowonspark_tpu.obs.registry import (
-        CONTENT_TYPE,
-        default_registry,
+    _server, port = serve_text(
+        lambda: default_registry().render(), host=host
     )
-
-    class _MetricsHandler(BaseHTTPRequestHandler):
-        def log_message(self, fmt, *fargs):  # scrapes are not news
-            logger.debug("%s " + fmt, self.client_address[0], *fargs)
-
-        def do_GET(self):  # noqa: N802 - http.server API
-            if self.path not in ("/metrics", "/"):
-                self.send_response(404)
-                self.end_headers()
-                return
-            body = default_registry().render().encode()
-            self.send_response(200)
-            self.send_header("Content-Type", CONTENT_TYPE)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-    try:
-        server = ThreadingHTTPServer((host, 0), _MetricsHandler)
-    except OSError as e:
-        logger.warning("metrics endpoint unavailable (%s)", e)
-        return None
-    threading.Thread(
-        target=server.serve_forever, daemon=True, name="metrics-http"
-    ).start()
-    return server.server_address[1]
+    return port
 
 
 # The profiler server object must outlive this module scope: jax tears the
@@ -568,18 +593,31 @@ def feed_partition(
                 put_columnar(ck.view(0, mid), buf[:mid])
                 put_columnar(ck.view(mid, len(buf)), buf[mid:])
                 return
-            ring.push_parts(parts, timeout=feed_timeout)
+            # stream/seq args mirror the frame header: the consumer's
+            # feed.queue_get span carries the same pair, so
+            # tools/trace_merge.py links producer->consumer per frame
+            with obs_spans.span(
+                "feed.send", stream=stream, seq=seq, path="shm"
+            ):
+                ring.push_parts(parts, timeout=feed_timeout)
         else:
-            put(
-                col.ColumnarFrame(
-                    col.frame_bytes(ck, qname=qname, stream=stream, seq=seq)
+            with obs_spans.span(
+                "feed.send", stream=stream, seq=seq, path="tcp"
+            ):
+                put(
+                    col.ColumnarFrame(
+                        col.frame_bytes(
+                            ck, qname=qname, stream=stream, seq=seq
+                        )
+                    )
                 )
-            )
         seq += 1
 
     def send(buf: list) -> None:
         if columnar:
-            with obs_spans.span("feed.columnize", records=len(buf)):
+            with obs_spans.span(
+                "feed.columnize", records=len(buf), stream=stream
+            ):
                 ck = col.columnize_records(buf)
             if ck is not None:
                 put_columnar(ck, buf)

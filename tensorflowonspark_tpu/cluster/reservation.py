@@ -153,10 +153,15 @@ class Server:
     - ``QUERY`` → {done: bool} — is the roster complete?
     - ``QINFO`` → {cluster_info: [...]} — the full roster (valid once done)
     - ``QNUM``  → {remaining: int}
-    - ``HEARTBEAT`` {executor_id} → {stop: bool}; refreshes the node's
-      last-seen stamp (the liveness plane — see ``Reservations.dead_nodes``)
-      and piggybacks the out-of-band stop flag so heartbeaters learn of a
-      cluster kill within one beat
+    - ``HEARTBEAT`` {executor_id} → {stop: bool, server_unix: float};
+      refreshes the node's last-seen stamp (the liveness plane — see
+      ``Reservations.dead_nodes``) and piggybacks the out-of-band stop
+      flag so heartbeaters learn of a cluster kill within one beat.
+      ``server_unix`` is the driver's wall clock at reply time: the
+      node heartbeater turns (send time, reply time, server_unix) into
+      an NTP-style clock-offset estimate (``obs.cluster.
+      note_clock_sync``) that ``tools/trace_merge.py`` uses to align
+      per-node trace timelines
     - ``STOP``  → ack; raises the stop flag that `Client.await_stop` and
       node watchdogs observe (out-of-band cluster kill)
     """
@@ -239,7 +244,12 @@ class Server:
                 elif mtype == "HEARTBEAT":
                     self.reservations.heartbeat(msg["executor_id"])
                     MessageSocket.send(
-                        conn, {"type": "OK", "stop": self._stop.is_set()}
+                        conn,
+                        {
+                            "type": "OK",
+                            "stop": self._stop.is_set(),
+                            "server_unix": time.time(),
+                        },
                     )
                 elif mtype == "STOP":
                     self._stop.set()

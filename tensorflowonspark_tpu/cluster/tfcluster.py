@@ -28,6 +28,9 @@ from typing import Any, Callable, Iterable, Iterator, Sequence
 from tensorflowonspark_tpu.cluster import node as tfnode_runtime
 from tensorflowonspark_tpu.cluster import reservation
 from tensorflowonspark_tpu.cluster.launchers import LocalLauncher
+from tensorflowonspark_tpu.obs import cluster as obs_cluster
+from tensorflowonspark_tpu.obs import flightrec
+from tensorflowonspark_tpu.obs.registry import default_registry
 
 logger = logging.getLogger(__name__)
 
@@ -68,6 +71,50 @@ class TFCluster:
         self.columnar = bool(cluster_meta.get("columnar", True))
         self._shutdown_done = False
         self._dstream_bridge: tuple | None = None
+        # -- cluster observability plane (obs.cluster; docs/OBSERVABILITY.md)
+        # Liveness surfaced in the registry: per-executor heartbeat age
+        # as a render-time collector (PR 4's plane was invisible to
+        # /metrics), and a counter that ticks once per node DEATH
+        # transition (dead_nodes()).
+        reg = default_registry()
+        self._m_dead = reg.counter(
+            "cluster_dead_nodes_total",
+            "nodes declared dead by the liveness plane (transitions)",
+        )
+        self._counted_dead: set[int] = set()  # guarded-by: self._dead_lock
+        self._dead_lock = threading.Lock()
+        hb_gauge = reg.gauge(
+            "node_heartbeat_age_seconds",
+            "seconds since each executor's last heartbeat, by node",
+        )
+
+        def _liveness_collector(
+            _g=hb_gauge, _res=server.reservations
+        ) -> None:
+            for eid, age in _res.last_seen().items():
+                _g.set(age, node=str(eid))
+
+        self._liveness_collector = _liveness_collector
+        reg.add_collector(_liveness_collector)
+        # Driver-side aggregation: scrape every node's /metrics on the
+        # liveness cadence, merge, and re-serve at a driver /metrics
+        # endpoint (every sample labelled node="<eid>"; the driver's
+        # own registry under node="driver").
+        self.aggregator: obs_cluster.MetricsAggregator | None = None
+        self._driver_metrics_server = None
+        self._driver_metrics_port: int | None = None
+        if cluster_meta.get("metrics", True) and self.metrics_urls():
+            self.aggregator = obs_cluster.MetricsAggregator(
+                self.metrics_urls,
+                interval=max(self.heartbeat_interval, 1.0)
+                if self.heartbeat_interval > 0
+                else 2.0,
+            )
+            self.aggregator.start()
+            (
+                self._driver_metrics_server,
+                self._driver_metrics_port,
+            ) = obs_cluster.serve_text(self.aggregator.render)
 
     # ------------------------------------------------------------------
     # liveness plane
@@ -90,11 +137,29 @@ class TFCluster:
         # and shutdown would otherwise tear down healthy runs with
         # skewed finish times).
         exit_codes = self.launcher.exitcodes()
-        return [
+        dead = [
             eid
             for eid in silent
             if not (eid < len(exit_codes) and exit_codes[eid] == 0)
         ]
+        self._note_dead(dead)
+        return dead
+
+    def _note_dead(self, dead: list[int]) -> None:
+        """Once per death TRANSITION (not per poll): tick the
+        cluster_dead_nodes_total counter and drop a driver-side flight
+        record — the postmortem's first artifact, written the moment
+        the liveness plane passes judgment."""
+        if not dead:
+            return
+        with self._dead_lock:
+            new = [eid for eid in dead if eid not in self._counted_dead]
+            self._counted_dead.update(new)
+        if new:
+            self._m_dead.inc(len(new))
+            for eid in new:
+                flightrec.note("dead_node", executor_id=eid)
+            flightrec.dump_now("dead_node")
 
     def _dead_error(self, dead: list[int], detail: str = "") -> RuntimeError:
         """THE presumed-dead diagnostic — one builder so every surface
@@ -158,6 +223,25 @@ class TFCluster:
             for n in self.cluster_info
             if n.get("metrics_port")
         }
+
+    def cluster_stats(self, fresh: bool = True) -> dict[str, Any]:
+        """Typed cluster-level series scraped from every node's
+        ``/metrics`` plus the driver's own registry: ``{"nodes":
+        {key: health}, "series": {name: {"type", "per_node", "sum",
+        "max"}}}`` (obs.cluster.MetricsAggregator.cluster_stats).
+        ``fresh=False`` reuses the background loop's last round
+        instead of scraping now. ``{}`` when metrics are disabled."""
+        if self.aggregator is None:
+            return {}
+        return self.aggregator.cluster_stats(fresh=fresh)
+
+    def driver_metrics_url(self) -> str | None:
+        """The driver's aggregated ``/metrics`` endpoint (every node's
+        samples re-labelled ``node="<eid>"``), or None when metrics
+        are disabled — point ONE scraper here instead of N."""
+        if self._driver_metrics_port is None:
+            return None
+        return f"http://127.0.0.1:{self._driver_metrics_port}/metrics"
 
     # ------------------------------------------------------------------
     def train(
@@ -787,6 +871,15 @@ class TFCluster:
             self.launcher.terminate()
         self.server.stop()
         self._shutdown_done = True
+        # Detach the observability plane: the scrape loop and the
+        # registry collector both reference this (now torn down)
+        # cluster and would keep refreshing stale series forever.
+        if self.aggregator is not None:
+            self.aggregator.stop()
+        if self._driver_metrics_server is not None:
+            self._driver_metrics_server.shutdown()
+            self._driver_metrics_server = None
+        default_registry().remove_collector(self._liveness_collector)
 
         exitcodes = self.launcher.exitcodes()
         bad = [
@@ -862,6 +955,7 @@ def run(
     heartbeat_interval: float = 2.0,
     heartbeat_grace: float = 60.0,
     columnar: bool = True,
+    flightrec_dir: str | None = "logs",
 ) -> TFCluster:
     """Start a cluster and return its handle.
 
@@ -937,13 +1031,34 @@ def run(
         # views; False = legacy row-pickle wire. TFOS_COLUMNAR=0 in the
         # driver environment forces it off too (operator escape hatch).
         "columnar": columnar and os.environ.get("TFOS_COLUMNAR", "1") != "0",
+        # Run-scoped trace id: every process stamps it into its span
+        # exports so driver + node timelines stitch (obs.cluster /
+        # tools/trace_merge.py). The cluster id IS the trace id.
+        "trace_id": None,  # filled below from "id"
+        # Flight-recorder directory (None disables): each node keeps a
+        # rolling logs/flightrec-node<id>.json snapshot so a SIGKILL
+        # still leaves a postmortem (obs.flightrec).
+        "flightrec_dir": flightrec_dir,
     }
+    cluster_meta["trace_id"] = cluster_meta["id"]
     logger.info(
         "starting cluster %s: %d nodes, template %s",
         cluster_meta["id"],
         num_executors,
         cluster_template,
     )
+
+    # Driver-side trace context + flight recorder (event-triggered: the
+    # driver dumps on dead-node detection and supervised relaunches —
+    # it is alive to do so; nodes roll periodic snapshots instead).
+    obs_cluster.set_trace_context(cluster_meta["trace_id"], node="driver")
+    if flightrec_dir:
+        fr_dir = flightrec_dir
+        if not os.path.isabs(fr_dir):
+            fr_dir = os.path.join(working_dir or os.getcwd(), fr_dir)
+        flightrec.install(
+            os.path.join(fr_dir, "flightrec-driver.json"), process="driver"
+        )
 
     if launcher is None:
         launcher = LocalLauncher()
@@ -1060,6 +1175,9 @@ def run_with_restarts(
             except RuntimeError as e:
                 supervise_error = e
                 logger.warning("supervision detected failure: %s", e)
+                # postmortem artifact before the relaunch erases state
+                flightrec.note("supervise_restart", error=str(e))
+                flightrec.dump_now("supervise_restart")
                 cluster.launcher.terminate()
             cluster.shutdown(timeout=shutdown_timeout)
             if supervise_error is not None:

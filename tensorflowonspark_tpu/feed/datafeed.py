@@ -180,13 +180,19 @@ class DataFeed:
             )
         self._seq_state[chunk.stream] = chunk.seq
 
-    def _ingest(self, item: Any) -> Any:
+    def _ingest(self, item: Any, sp=None) -> Any:
         """Normalize a queue item: decode TCP-borne frames (zero-copy
         views over the received bytes) and run the sequence check on
-        every columnar chunk."""
+        every columnar chunk. ``sp`` (the enclosing ``feed.queue_get``
+        span) gets the frame's ``stream``/``seq`` as args — the
+        consumer-side half of the per-frame span link the driver's
+        ``feed.send`` carries, which ``tools/trace_merge.py`` stitches
+        across processes."""
         if isinstance(item, ColumnarFrame):
             item = decode_frame(item.data, path="tcp")
         if isinstance(item, ColumnChunk):
+            if sp is not None and item.stream is not None:
+                sp.set(stream=item.stream, seq=item.seq)
             if failpoint("columnar.frame") == "drop":
                 return _DROPPED
             self._check_seq(item)
@@ -217,10 +223,10 @@ class DataFeed:
             # is the consumer side). Bounded by the feed-timeout policy
             # — a producer that stalled or died surfaces as a
             # descriptive FeedTimeout, not an eternal block.
-            with obs_spans.span("feed.queue_get"):
+            with obs_spans.span("feed.queue_get") as sp:
                 item = self._pull()
-            self._queue_in.task_done()
-            item = self._ingest(item)
+                self._queue_in.task_done()
+                item = self._ingest(item, sp)
             if item is _DROPPED:
                 continue
             if isinstance(item, Marker) or item is None:
@@ -250,10 +256,10 @@ class DataFeed:
         while len(asm) < batch_size:
             if self.done_feeding:
                 break
-            with obs_spans.span("feed.queue_get"):
+            with obs_spans.span("feed.queue_get") as sp:
                 item = self._pull()
-            self._queue_in.task_done()
-            item = self._ingest(item)
+                self._queue_in.task_done()
+                item = self._ingest(item, sp)
             if item is _DROPPED:
                 continue
             if isinstance(item, Marker) or item is None:
@@ -359,10 +365,10 @@ class DataFeed:
         if len(asm):
             yield from asm.drain_pieces()  # next_batch leftovers first
         while not self.done_feeding:
-            with obs_spans.span("feed.queue_get"):
+            with obs_spans.span("feed.queue_get") as sp:
                 item = self._pull()
-            self._queue_in.task_done()
-            item = self._ingest(item)
+                self._queue_in.task_done()
+                item = self._ingest(item, sp)
             if item is _DROPPED or isinstance(item, EndPartition):
                 continue
             if isinstance(item, Marker) or item is None:

@@ -18,6 +18,17 @@ paths but not *see* them):
   (MXU / vector / copy / infeed / collective / host), emitted as a
   JSON artifact by ``bench.py --trace`` and readable via
   ``python -m tensorflowonspark_tpu.tools.trace_report``.
+
+Plus the cluster-wide plane (docs/OBSERVABILITY.md):
+
+- :mod:`~tensorflowonspark_tpu.obs.cluster` — run-scoped trace
+  context, heartbeat clock sync, Prometheus text parsing, and the
+  driver-side :class:`MetricsAggregator` behind
+  ``TFCluster.cluster_stats()`` and the driver ``/metrics`` endpoint.
+- :mod:`~tensorflowonspark_tpu.obs.flightrec` — per-process failure
+  flight recorder (rolling snapshots + event-triggered dumps).
+- :mod:`~tensorflowonspark_tpu.obs.trace_merge` — clock-aligned merge
+  of driver + node traces into one timeline (``tools/trace_merge.py``).
 """
 
 from tensorflowonspark_tpu.obs.registry import (

@@ -192,6 +192,10 @@ class Histogram(_Metric):
         self.buckets = bs
 
     def observe(self, value: float, **labels: Any) -> None:
+        if "le" in labels:
+            raise ValueError(
+                "histogram label 'le' is reserved for bucket bounds"
+            )
         key = _label_key(labels)
         with self._lock:
             series = self._series.get(key)
@@ -247,21 +251,58 @@ class Registry:
     """Named metrics + render-time collectors; get-or-create semantics
     so call sites don't coordinate registration order."""
 
+    #: Series suffixes a histogram family owns in the exposition. A
+    #: plain metric named ``foo_bucket`` beside a histogram ``foo``
+    #: would render two samples of the same name — promtool rejects
+    #: that, and a scraper silently keeps whichever it parsed last.
+    _HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: dict[str, _Metric] = {}  # guarded-by: self._lock
         self._collectors: list[Callable[[], None]] = []  # guarded-by: self._lock
+        # previous window() snapshot, keyed (name, label key)
+        self._window_prev: dict[tuple, Any] = {}  # guarded-by: self._lock
+
+    def _check_collision(self, name: str, cls) -> None:  # lint: holds-lock
+        # callers (_get_or_create) hold self._lock
+        if cls is Histogram:
+            for suf in self._HISTOGRAM_SUFFIXES:
+                if name + suf in self._metrics:
+                    raise ValueError(
+                        f"histogram {name!r} would collide with existing "
+                        f"metric {name + suf!r} (histograms own the "
+                        f"_bucket/_sum/_count series names)"
+                    )
+        for suf in self._HISTOGRAM_SUFFIXES:
+            if name.endswith(suf):
+                base = name[: -len(suf)]
+                if isinstance(self._metrics.get(base), Histogram):
+                    raise ValueError(
+                        f"metric {name!r} collides with histogram "
+                        f"{base!r}'s {suf} series"
+                    )
 
     def _get_or_create(self, cls, name: str, help: str, **kw):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
+                self._check_collision(name, cls)
                 m = self._metrics[name] = cls(name, help, **kw)
             elif not isinstance(m, cls):
                 raise ValueError(
                     f"metric {name!r} already registered as "
                     f"{type(m).__name__}, not {cls.__name__}"
                 )
+            elif cls is Histogram and "buckets" in kw:
+                want = tuple(sorted(float(b) for b in kw["buckets"]))
+                if want != m.buckets:
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"buckets {m.buckets}, not {want} — two call "
+                        "sites disagreeing would silently share one "
+                        "bucket layout"
+                    )
             return m
 
     def counter(self, name: str, help: str = "") -> Counter:
@@ -284,6 +325,17 @@ class Registry:
         swallowed — a broken collector must not take down the scrape."""
         with self._lock:
             self._collectors.append(fn)
+
+    def remove_collector(self, fn: Callable[[], None]) -> None:
+        """Unregister a collector added with :meth:`add_collector` (a
+        no-op when absent) — components with a bounded lifetime (a
+        cluster handle on the process-global registry) must detach on
+        shutdown or every render keeps refreshing stale gauges."""
+        with self._lock:
+            try:
+                self._collectors.remove(fn)
+            except ValueError:
+                pass
 
     def metrics(self) -> list[_Metric]:
         with self._lock:
@@ -337,6 +389,59 @@ class Registry:
                     writer.scalar(
                         base + "_sum", s["sum"], step, mirror=False
                     )
+
+    def window(self) -> dict[str, dict[str, Any]]:
+        """Windowed read API: every series' current value plus its
+        change since the PREVIOUS ``window()`` call — the shape a
+        feedback controller wants ("how much feed.data_wait accrued
+        this window"), without the controller keeping its own
+        per-series bookkeeping.
+
+        Returns ``{name: {"kind": ..., "series": {label_str: entry}}}``
+        where ``label_str`` is the rendered ``{k="v",...}`` label set
+        (``""`` for the unlabeled series). Counter/gauge entries are
+        ``{"value", "delta"}``; histogram entries are ``{"count",
+        "sum", "delta_count", "delta_sum"}`` (windowed mean latency =
+        ``delta_sum / delta_count``). The first call's deltas equal the
+        values (window start = registry birth). Collectors run first,
+        like :meth:`render`.
+        """
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                pass
+        out: dict[str, dict[str, Any]] = {}
+        with self._lock:
+            for name, m in sorted(self._metrics.items()):
+                series: dict[str, Any] = {}
+                with m._lock:
+                    items = [
+                        (k, (v["count"], v["sum"]))
+                        if isinstance(m, Histogram)
+                        else (k, v)
+                        for k, v in sorted(m._series.items())
+                    ]
+                for key, v in items:
+                    wkey = (name, key)
+                    if isinstance(m, Histogram):
+                        prev = self._window_prev.get(wkey, (0, 0.0))
+                        entry = {
+                            "count": v[0],
+                            "sum": v[1],
+                            "delta_count": v[0] - prev[0],
+                            "delta_sum": v[1] - prev[1],
+                        }
+                        self._window_prev[wkey] = v
+                    else:
+                        prev_v = self._window_prev.get(wkey, 0.0)
+                        entry = {"value": v, "delta": v - prev_v}
+                        self._window_prev[wkey] = v
+                    series[_label_str(key)] = entry
+                out[name] = {"kind": m.kind, "series": series}
+        return out
 
 
 _default = Registry()

@@ -112,6 +112,13 @@ class _SpanCtx:
         self._step_num = step_num
         self._ann = None
 
+    def set(self, **args: Any) -> None:
+        """Attach args discovered DURING the span body (the consumer
+        pull learns the frame's ``stream``/``seq`` only after the
+        blocking get returns) — they land on the recorded span like
+        ctor args, so cross-process trace stitching can key on them."""
+        self._args.update(args)
+
     def __enter__(self) -> "_SpanCtx":
         prof = _profiler()
         if prof is not None:
@@ -262,6 +269,20 @@ class SpanTracer:
         ``ts``/``dur`` in microseconds relative to the tracer epoch) —
         the format ``obs.trace_report`` and chrome://tracing read."""
         pid = os.getpid()
+        # Cross-process alignment metadata: the wall-clock time of this
+        # tracer's epoch (event ts are relative to it), plus the run's
+        # trace id / node name / clock-offset estimate when the cluster
+        # trace context is set (obs.cluster) — what tools/trace_merge.py
+        # keys on to put N processes' spans on ONE timeline.
+        ctx_args: dict[str, Any] = {
+            "epoch_unix": time.time() - (_CLOCK() - self._epoch),
+        }
+        try:
+            from tensorflowonspark_tpu.obs import cluster as _obs_cluster
+
+            ctx_args.update(_obs_cluster.export_meta())
+        except Exception:  # trace context is best-effort metadata
+            pass
         events: list[dict] = [
             {
                 "ph": "M",
@@ -270,7 +291,13 @@ class SpanTracer:
                 "args": {
                     "name": process_name or f"host: pid {pid}"
                 },
-            }
+            },
+            {
+                "ph": "M",
+                "name": "trace_context",
+                "pid": pid,
+                "args": ctx_args,
+            },
         ]
         seen_tids: set = set()
         for s in self.spans():

@@ -1,0 +1,261 @@
+"""Merge driver + N node Chrome traces into ONE cluster timeline.
+
+Each process's :meth:`SpanTracer.export` stamps a ``trace_context``
+metadata event: the run's ``trace_id``, the process's node name, the
+wall-clock time of the tracer epoch (``epoch_unix``), and — on nodes —
+the clock-offset estimate from heartbeat RTT midpoints
+(``obs.cluster.note_clock_sync``). That is exactly enough to rebase
+every event onto the DRIVER's wall clock::
+
+    driver_time = epoch_unix + ts/1e6 + clock_offset_s
+
+so a feed frame's ``feed.send`` span on the driver and its
+``feed.queue_get`` span on the node line up causally, within the
+heartbeat RTT error bound (offset estimation caveat:
+docs/OBSERVABILITY.md). Inputs may be plain Chrome-trace JSON
+(optionally gzipped) or flight-recorder dumps (``obs.flightrec``),
+whose embedded span export is used.
+
+Pids are remapped per source (Chrome traces key lanes on pid, and two
+single-host processes can collide), process names gain the node
+prefix, and spans carrying ``{stream, seq}`` args — the columnar frame
+identity that rides the wire header — get Chrome flow arrows linking
+producer to consumer across processes.
+
+CLI (also at ``tools/trace_merge.py``)::
+
+    python -m tensorflowonspark_tpu.obs.trace_merge \
+        -o merged.json driver.trace.json logs/flightrec-node*.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import sys
+from typing import Any, Sequence
+
+__all__ = ["load_trace", "merge_traces", "main", "trace_context_of"]
+
+
+def load_trace(path: str) -> dict:
+    """A Chrome-trace dict from ``path`` — plain/gzipped trace JSON, or
+    a flight-recorder dump (its ``spans`` export)."""
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as f:
+        data = json.load(f)
+    if "traceEvents" not in data and isinstance(data.get("spans"), dict):
+        data = data["spans"]  # flightrec dump
+    if "traceEvents" not in data:
+        raise ValueError(f"{path}: neither a Chrome trace nor a flightrec dump")
+    return data
+
+
+def trace_context_of(events: Sequence[dict]) -> dict[str, Any]:
+    """The first ``trace_context`` metadata event's args ({} if the
+    trace predates trace-context export)."""
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "trace_context":
+            return dict(e.get("args") or {})
+    return {}
+
+
+def _process_names(events: Sequence[dict]) -> dict:
+    return {
+        e.get("pid"): (e.get("args") or {}).get("name", "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+
+
+def merge_traces(paths: Sequence[str]) -> dict:
+    """One merged ``{"traceEvents": [...], "metadata": {...}}`` over
+    ``paths``. Events are rebased to a common zero (the earliest event
+    across all sources, on the driver clock); sources without
+    ``epoch_unix`` cannot be aligned and are rebased to zero with
+    ``aligned: false`` in their metadata entry. ``metadata.trace_ids``
+    lists every distinct trace id seen — more than one means the
+    inputs span different runs, which the CLI warns about."""
+    if not paths:
+        raise ValueError("no trace files to merge")
+    sources: list[dict[str, Any]] = []
+    for i, path in enumerate(paths):
+        events = load_trace(path).get("traceEvents", [])
+        ctx = trace_context_of(events)
+        offset = float(ctx.get("clock_offset_s") or 0.0)
+        epoch_unix = ctx.get("epoch_unix")
+        sources.append(
+            {
+                "file": path,
+                "index": i,
+                "events": events,
+                "ctx": ctx,
+                "node": ctx.get("node") or f"proc{i}",
+                "trace_id": ctx.get("trace_id"),
+                "clock_offset_s": offset,
+                "clock_rtt_s": ctx.get("clock_rtt_s"),
+                "epoch_unix": (
+                    float(epoch_unix) if epoch_unix is not None else None
+                ),
+                "aligned": epoch_unix is not None,
+            }
+        )
+
+    # Common zero: the earliest aligned event start, driver clock.
+    base_unix: float | None = None
+    for src in sources:
+        if not src["aligned"]:
+            continue
+        for e in src["events"]:
+            if e.get("ph") != "X" or "ts" not in e:
+                continue
+            t = src["epoch_unix"] + e["ts"] / 1e6 + src["clock_offset_s"]
+            base_unix = t if base_unix is None else min(base_unix, t)
+    if base_unix is None:
+        base_unix = 0.0
+
+    merged: list[dict] = []
+    # flow linking: (stream, seq) -> list of (abs_ts_us, pid, tid, name)
+    frame_sites: dict[tuple, list[tuple]] = {}
+    for src in sources:
+        pid_map: dict[Any, int] = {}
+        names = _process_names(src["events"])
+
+        def remap_pid(pid, src=src, pid_map=pid_map):
+            if pid not in pid_map:
+                pid_map[pid] = src["index"] * 1000 + len(pid_map)
+            return pid_map[pid]
+
+        if src["aligned"]:
+            shift_us = (
+                src["epoch_unix"] + src["clock_offset_s"] - base_unix
+            ) * 1e6
+        else:
+            shift_us = 0.0
+        for e in src["events"]:
+            e = dict(e)
+            pid = remap_pid(e.get("pid"))
+            e["pid"] = pid
+            if e.get("ph") == "M":
+                if e.get("name") == "process_name":
+                    orig = (e.get("args") or {}).get("name", "")
+                    e["args"] = {"name": f"{src['node']}: {orig}"}
+                elif e.get("name") == "trace_context":
+                    continue  # superseded by metadata.sources below
+                merged.append(e)
+                continue
+            if "ts" in e:
+                e["ts"] = round(e["ts"] + shift_us, 3)
+            merged.append(e)
+            args = e.get("args") or {}
+            if (
+                e.get("ph") == "X"
+                and src["aligned"]
+                and args.get("stream") is not None
+                and args.get("seq") is not None
+            ):
+                frame_sites.setdefault(
+                    (str(args["stream"]), int(args["seq"])), []
+                ).append((e["ts"], pid, e.get("tid"), e.get("name")))
+        src["pids"] = {
+            pid_map.get(p): f"{src['node']}: {n}" for p, n in names.items()
+        }
+        del src["events"]
+
+    # Chrome flow arrows between consecutive sites of one frame
+    # (driver feed.send -> node feed.queue_get -> ...): same id + cat.
+    flow_id = 0
+    for (stream, seq), sites in sorted(frame_sites.items()):
+        if len(sites) < 2:
+            continue
+        sites.sort(key=lambda s: s[0])  # ts only: tids mix int/str
+        flow_id += 1
+        for j, (ts, pid, tid, name) in enumerate(sites):
+            merged.append(
+                {
+                    "ph": "s" if j == 0 else ("f" if j == len(sites) - 1 else "t"),
+                    "cat": "feed_frame",
+                    "id": flow_id,
+                    "name": f"frame {stream}/{seq}",
+                    "pid": pid,
+                    "tid": tid,
+                    "ts": ts,
+                    **({"bp": "e"} if j == len(sites) - 1 else {}),
+                }
+            )
+
+    trace_ids = sorted(
+        {s["trace_id"] for s in sources if s["trace_id"] is not None}
+    )
+    return {
+        "traceEvents": sorted(
+            merged, key=lambda e: (e.get("ph") != "M", e.get("ts", 0))
+        ),
+        "metadata": {
+            "base_unix": base_unix,
+            "trace_ids": trace_ids,
+            "sources": [
+                {
+                    k: s[k]
+                    for k in (
+                        "file",
+                        "node",
+                        "trace_id",
+                        "clock_offset_s",
+                        "clock_rtt_s",
+                        "epoch_unix",
+                        "aligned",
+                        "pids",
+                    )
+                }
+                for s in sources
+            ],
+        },
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_merge",
+        description="merge driver + node Chrome traces / flightrec "
+        "dumps into one clock-aligned cluster timeline",
+    )
+    ap.add_argument("traces", nargs="+", help="trace files or flightrec dumps")
+    ap.add_argument(
+        "-o", "--out", required=True, help="merged Chrome-trace JSON path"
+    )
+    args = ap.parse_args(argv)
+    try:
+        merged = merge_traces(args.traces)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"trace_merge: {e}", file=sys.stderr)
+        return 1
+    meta = merged["metadata"]
+    if len(meta["trace_ids"]) > 1:
+        print(
+            f"trace_merge: WARNING: inputs span {len(meta['trace_ids'])} "
+            f"different trace ids {meta['trace_ids']} — these are "
+            "different runs",
+            file=sys.stderr,
+        )
+    unaligned = [s["file"] for s in meta["sources"] if not s["aligned"]]
+    if unaligned:
+        print(
+            f"trace_merge: WARNING: no epoch_unix in {unaligned}; those "
+            "sources are rebased to 0, not clock-aligned",
+            file=sys.stderr,
+        )
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+        f.write("\n")
+    n_ev = len(merged["traceEvents"])
+    print(
+        f"trace_merge: {len(meta['sources'])} source(s), {n_ev} events "
+        f"-> {args.out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -103,6 +103,8 @@ def find_trace_files(root: str) -> list[str]:
     pats = [
         os.path.join(root, "**", "*.trace.json.gz"),
         os.path.join(root, "**", "*.trace.json"),
+        # flight-recorder dumps (obs.flightrec) embed a trace export
+        os.path.join(root, "**", "flightrec-*.json"),
     ]
     out: list[str] = []
     for p in pats:
@@ -110,10 +112,31 @@ def find_trace_files(root: str) -> list[str]:
     return sorted(out)
 
 
+def resolve_inputs(paths) -> list[str]:
+    """Expand a path — or a list of paths — into trace files: a
+    directory contributes every trace/flightrec file under it, a file
+    is taken as-is. Order is deterministic (input order, dirs sorted
+    within)."""
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(find_trace_files(p))
+        else:
+            out.append(p)
+    return out
+
+
 def load_events(path: str) -> dict:
     op = gzip.open if path.endswith(".gz") else open
     with op(path, "rt") as f:
-        return json.load(f)
+        data = json.load(f)
+    if "traceEvents" not in data and isinstance(data.get("spans"), dict):
+        # flight-recorder dump (obs.flightrec): the span export is the
+        # trace — per-node postmortems read like any captured profile
+        data = data["spans"]
+    return data
 
 
 def self_times(events: list[dict]) -> "collections.Counter[tuple]":
@@ -247,16 +270,24 @@ def attribution(
     }
 
 
-def build_report(trace_dir: str, top: int = 30) -> dict:
-    """Aggregate every trace file under ``trace_dir`` into one report
-    dict: per-file lanes + top ops by self time, and a combined
-    attribution table. Raises FileNotFoundError when the directory
-    holds no trace files (callers decide whether that's fatal)."""
-    files = find_trace_files(trace_dir)
+def build_report(trace_dir, top: int = 30) -> dict:
+    """Aggregate trace inputs into one report dict: per-file lanes +
+    top ops by self time, and a combined attribution table.
+
+    ``trace_dir`` is a directory (every trace/flightrec file under it),
+    a single file, or a LIST of directories/files — one merged report
+    over a driver trace plus N per-node flight-recorder dumps is
+    ``build_report(["driver.trace.json", *glob("logs/flightrec-*")])``.
+    Raises FileNotFoundError when no input resolves to a trace file
+    (callers decide whether that's fatal)."""
+    inputs = trace_dir if isinstance(trace_dir, (list, tuple)) else [trace_dir]
+    files = resolve_inputs(inputs)
     if not files:
         raise FileNotFoundError(
-            f"no *.trace.json[.gz] under {trace_dir}"
+            f"no *.trace.json[.gz] / flightrec-*.json under {inputs}"
         )
+    first = str(inputs[0])
+    rel_root = first if os.path.isdir(first) else os.path.dirname(first)
     combined: "collections.Counter[tuple]" = collections.Counter()
     combined_names: dict = {}
     file_reports = []
@@ -306,14 +337,20 @@ def build_report(trace_dir: str, top: int = 30) -> dict:
                     ],
                 }
             )
+        under_root = os.path.abspath(path).startswith(
+            os.path.abspath(rel_root) + os.sep
+        )
         file_reports.append(
             {
-                "file": os.path.relpath(path, trace_dir),
+                "file": (
+                    os.path.relpath(path, rel_root) if under_root else path
+                ),
                 "lanes": lanes,
             }
         )
     return {
-        "trace_dir": os.path.abspath(trace_dir),
+        "trace_dir": os.path.abspath(first),
+        "inputs": [str(p) for p in inputs],
         "files": file_reports,
         "attribution": attribution(combined, combined_names),
     }
@@ -352,7 +389,12 @@ def _print_attribution(att: dict, out) -> None:
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="trace_report")
-    ap.add_argument("trace_dir", help="directory passed to --profile")
+    ap.add_argument(
+        "trace_dir",
+        nargs="+",
+        help="profile directory, trace file(s), and/or flight-recorder "
+        "dump(s) — multiple inputs merge into one report",
+    )
     ap.add_argument("--top", type=int, default=30)
     ap.add_argument(
         "--lane",

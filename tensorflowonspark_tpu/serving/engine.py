@@ -2513,6 +2513,15 @@ class ContinuousBatcher:
             stuck_for,
             phase,
         )
+        # Postmortem: persist the flight record (recent spans, metrics,
+        # events) NOW — a wedge that escalates to a kill leaves no
+        # later chance (no-op when the process installed no recorder).
+        from tensorflowonspark_tpu.obs import flightrec
+
+        flightrec.note(
+            "engine_watchdog", stuck_for=round(stuck_for, 3), phase=phase
+        )
+        flightrec.dump_now("engine_watchdog")
         # Racy snapshot reads are fine: entries are immutable tuples and
         # _fail_one's resolve-once latch makes double-resolution
         # impossible whichever thread wins.

@@ -92,7 +92,7 @@ class MetricsWriter:
             from tensorflowonspark_tpu.obs.registry import sanitize_name
 
             try:
-                self._registry.gauge(
+                self._registry.gauge(  # lint: metric-name-ok (mirror of arbitrary scalar names)
                     sanitize_name(name), "mirrored from MetricsWriter"
                 ).set(float(value))
             except ValueError:

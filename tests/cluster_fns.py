@@ -387,6 +387,53 @@ def always_crash_fn(args, ctx):
     os._exit(7)
 
 
+def obs_train_fn(args, ctx):
+    """Mapped fed train loop for the cluster-observability e2e: runs a
+    tiny jitted step over sliced column batches (recording train.step /
+    feed.queue_get spans + registry counters), then writes this node's
+    Chrome trace — with its trace_context metadata — so the driver can
+    merge it against its own timeline (tools/trace_merge.py)."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.obs import spans as obs_spans
+
+    feed = ctx.get_data_feed(
+        train_mode=True, input_mapping={"x": "x", "y": "y"}
+    )
+
+    @jax.jit
+    def step(params, x, y):
+        def loss_fn(p):
+            return jnp.mean((p["w"] * x + p["b"] - y) ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        return {k: params[k] - 0.1 * g[k] for k in params}, loss
+
+    params = {"w": jnp.zeros(()), "b": jnp.zeros(())}
+    steps = 0
+    for cols in feed.batch_stream(8):
+        with obs_spans.step_span("train.step", steps):
+            params, loss = step(
+                params,
+                jnp.asarray(np.asarray(cols["x"], np.float32)),
+                jnp.asarray(np.asarray(cols["y"], np.float32)),
+            )
+        steps += 1
+    out_dir = args["out_dir"]
+    obs_spans.get_tracer().write_chrome_trace(
+        os.path.join(out_dir, f"node{ctx.executor_id}.trace.json"),
+        process_name=f"node{ctx.executor_id} host",
+    )
+    with open(
+        os.path.join(out_dir, f"node{ctx.executor_id}.json"), "w"
+    ) as f:
+        json.dump({"steps": steps, "loss": float(loss)}, f)
+
+
 def sleepy_fn(args, ctx):
     """TENSORFLOW-mode map_fun that just sleeps — the SIGKILL target for
     the liveness-plane chaos tests (a killed node must be detected by
@@ -394,6 +441,23 @@ def sleepy_fn(args, ctx):
     import time
 
     time.sleep(float(args.get("sleep", 120)))
+
+
+def busy_span_fn(args, ctx):
+    """TENSORFLOW-mode map_fun recording work spans forever — the
+    SIGKILL target for the flight-recorder e2e: the node's rolling
+    flightrec snapshot must carry these final spans to disk even
+    though the process never gets to say goodbye."""
+    import time
+
+    from tensorflowonspark_tpu.obs import spans as obs_spans
+
+    deadline = time.monotonic() + float(args.get("sleep", 120))
+    i = 0
+    while time.monotonic() < deadline:
+        with obs_spans.span("work.tick", i=i):
+            time.sleep(0.05)
+        i += 1
 
 
 def _tiny_llama_fsdp_setup(logit_chunk=None):

@@ -1,0 +1,29 @@
+"""OB001 fixture: every way a metric name can go wrong (plus clean
+registrations the rule must NOT flag)."""
+
+from tensorflowonspark_tpu.obs.registry import Registry, default_registry
+
+r = default_registry()
+
+DYNAMIC = "requests" + "_total"
+r.counter(DYNAMIC)  # OB001: not a literal
+
+r.counter(f"requests_{1}_total")  # OB001: f-string is dynamic
+
+r.counter("EngineRequests_total")  # OB001: not snake_case
+
+r.counter("requests")  # OB001: counter must end _total
+
+reg = Registry()
+reg.histogram("ttft_ms")  # OB001: histogram unit must be _seconds/_bytes
+
+reg.gauge("queue.depth")  # OB001: not snake_case (dot)
+
+# clean: literal snake_case, right suffixes; gauges need no unit
+reg.counter("requests_total")
+reg.histogram("ttft_seconds")
+reg.histogram("frame_bytes")
+reg.gauge("queue_depth")
+reg.gauge(  # lint: metric-name-ok (suppression honored)
+    DYNAMIC
+)

@@ -125,7 +125,11 @@ def test_numpy_result_does_not_cascade(fixture_findings):
     """np.asarray(device) flags once (HS002); float()/int() over the
     RESULTING numpy value must not produce follow-on findings."""
     line = _line_of("bad_hot_sync.py", "int(host[0])")
-    assert not [f for f in fixture_findings if f.line == line]
+    assert not [
+        f
+        for f in fixture_findings
+        if f.line == line and f.path == f"{FIXTURES}/bad_hot_sync.py"
+    ]
 
 
 def test_cold_function_not_flagged(fixture_findings):
@@ -167,6 +171,27 @@ def test_failpoint_rule_reports_seeded_violations(fixture_findings):
     dynamic = [f for f in hits if "string literal" in f.message]
     unregistered = [f for f in hits if "not registered" in f.message]
     assert len(dynamic) == 1 and len(unregistered) == 1
+
+
+def test_obs_metric_rule_reports_seeded_violations(fixture_findings):
+    """OB001: literal, snake_case, unit-suffixed obs metric names —
+    one finding per seeded violation, clean registrations untouched,
+    suppression comment honored."""
+    rel = f"{FIXTURES}/bad_obsmetric.py"
+    hits = by_rule(fixture_findings, "OB001")
+    assert all(f.path == rel for f in hits), [f.render() for f in hits]
+    assert {f.line for f in hits} == {
+        _line_of("bad_obsmetric.py", "r.counter(DYNAMIC)"),
+        _line_of("bad_obsmetric.py", "f-string is dynamic"),
+        _line_of("bad_obsmetric.py", "EngineRequests_total"),
+        _line_of("bad_obsmetric.py", 'r.counter("requests")  #'),
+        _line_of("bad_obsmetric.py", "ttft_ms"),
+        _line_of("bad_obsmetric.py", "queue.depth"),
+    }, [f.render() for f in hits]
+    dynamic = [f for f in hits if "string literal" in f.message]
+    snake = [f for f in hits if "snake_case" in f.message]
+    suffix = [f for f in hits if "unit suffix" in f.message]
+    assert len(dynamic) == 2 and len(snake) == 2 and len(suffix) == 2
 
 
 def test_failpoint_registry_matches_rule_view():

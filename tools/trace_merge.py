@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""trace_merge CLI — merge driver + node traces into one timeline.
+
+Usage (from the repo root)::
+
+    python tools/trace_merge.py -o merged.json \
+        driver.trace.json logs/flightrec-node*.json
+
+Inputs are Chrome-trace JSON (plain or .gz) and/or flight-recorder
+dumps (``obs.flightrec``). Per-node clocks are aligned using the
+heartbeat RTT-midpoint offsets each trace's ``trace_context`` metadata
+carries; open the output in chrome://tracing or Perfetto. Details:
+docs/OBSERVABILITY.md.
+"""
+
+import os
+import sys
+import types
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# Stub parent package (tfoslint.py pattern): obs.trace_merge is
+# stdlib-only, and the real tensorflowonspark_tpu/__init__ costs ~8 s
+# of jax/flax imports a merge never uses.
+if "tensorflowonspark_tpu" not in sys.modules:
+    _stub = types.ModuleType("tensorflowonspark_tpu")
+    _stub.__path__ = [os.path.join(_REPO_ROOT, "tensorflowonspark_tpu")]
+    sys.modules["tensorflowonspark_tpu"] = _stub
+
+from tensorflowonspark_tpu.obs.trace_merge import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
