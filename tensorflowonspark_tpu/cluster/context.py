@@ -128,6 +128,68 @@ class TFNodeContext:
     def start_cluster_server(self, *_args, **_kwargs) -> None:
         self.initialize_distributed()
 
+    # --- elastic membership ----------------------------------------------
+    def membership(self) -> tuple[int, list[dict[str, Any]]]:
+        """(membership epoch, active roster) as last published by the
+        driver (``compute/elastic.py`` watcher, fed by the heartbeater).
+        Before any reconfigure: ``(0, cluster_info)`` — the startup
+        barrier roster IS epoch 0."""
+        from tensorflowonspark_tpu.compute import elastic
+
+        epoch, roster = elastic.membership()
+        return epoch, (self.cluster_info if roster is None else roster)
+
+    def reinitialize_distributed(
+        self, roster: list[dict[str, Any]]
+    ) -> None:
+        """Rebind this process to a reconfigured cluster (elastic plane).
+
+        Updates the context's roster bookkeeping (``cluster_info``,
+        ``num_workers``, ``coordinator_address``) and — in
+        multi-controller mode — leaves the old ``jax.distributed``
+        collective and re-initializes against the new topology: the
+        lowest surviving executor hosts the coordinator, and process
+        ids are the roster order (``jax.distributed`` requires a dense
+        0..n−1 id space, which executor ids no longer are after a
+        departure). Single-controller-per-node runs (``distributed=
+        False``) only update the bookkeeping — their local runtime
+        never spanned the dead peer.
+        """
+        roster = sorted(roster, key=lambda n: n["executor_id"])
+        if not roster:
+            raise ValueError("cannot reconfigure to an empty roster")
+        ids = [n["executor_id"] for n in roster]
+        if self.executor_id not in ids:
+            # A node the driver removed (false-positive death verdict,
+            # voluntary leave) must not rebind as if it were a member —
+            # and must get a clear diagnosis, not a StopIteration.
+            raise RuntimeError(
+                f"executor {self.executor_id} is not in the new "
+                f"membership {ids}; this node was removed — rejoin via "
+                "registration, do not reconfigure"
+            )
+        self.cluster_info = roster
+        self.num_workers = len(roster)
+        chief = roster[0]
+        self.coordinator_address = f"{chief['host']}:{chief['port']}"
+        if not self.distributed:
+            return
+        import jax
+
+        process_id = ids.index(self.executor_id)
+        jax.distributed.shutdown()
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator_address,
+            num_processes=len(roster),
+            process_id=process_id,
+        )
+        logger.info(
+            "jax.distributed re-initialized: process %d/%d, coordinator %s",
+            process_id,
+            len(roster),
+            self.coordinator_address,
+        )
+
     def mesh(self, axis_shapes: dict[str, int] | None = None):
         """Build the device mesh for this cluster (all global devices).
 

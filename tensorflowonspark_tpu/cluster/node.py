@@ -267,13 +267,38 @@ def _start_heartbeater(
     the thread exits when the server acks with its stop flag set or
     becomes permanently unreachable after the cluster stops (process
     exit kills the daemon thread anyway).
+
+    Elastic plane: the beat reply piggybacks the driver's membership
+    epoch. When it moves, this thread refetches the active roster
+    (``QEPOCH``) and publishes both to the process-local watcher
+    (``compute.elastic.notify_membership``) — the training loop's
+    ``ElasticTrainer.changed()`` flips within one beat of a
+    reconfigure.
     """
     client = reservation.Client(
         server_addr, retry=RetryPolicy(max_attempts=1)
     )
     from tensorflowonspark_tpu.obs import cluster as obs_cluster
 
+    def note_epoch(reply: dict) -> int | None:
+        epoch = reply.get("epoch")
+        if epoch is None:
+            return None
+        epoch = int(epoch)
+        try:
+            info = client.membership()
+            # Lazy: compute.elastic stays unimported on the (common)
+            # epoch-0-forever path.
+            from tensorflowonspark_tpu.compute import elastic
+
+            elastic.notify_membership(info["epoch"], info["roster"])
+        except Exception as e:  # noqa: BLE001 - next beat retries
+            logger.warning("membership refetch failed: %s", e)
+            return None
+        return epoch
+
     def beat() -> None:
+        last_epoch = 0
         while True:
             try:
                 t0 = time.time()
@@ -288,6 +313,10 @@ def _start_heartbeater(
                     obs_cluster.note_clock_sync(
                         float(server_unix) - (t0 + t1) / 2.0, t1 - t0
                     )
+                if int(reply.get("epoch") or 0) > last_epoch:
+                    got = note_epoch(reply)
+                    if got is not None:
+                        last_epoch = got
                 if reply.get("stop"):
                     return  # cluster kill: no point beating on
             except Exception as e:  # noqa: BLE001 - a missed beat is the signal
@@ -521,6 +550,7 @@ def feed_partition(
     chunk: int = FEED_CHUNK,
     node: dict[str, Any] | None = None,
     columnar: bool = True,
+    stream: str | None = None,
 ) -> int | None:
     """Push one data partition into a node's input queue, chunked.
 
@@ -537,6 +567,16 @@ def feed_partition(
     partition was skipped (distinct from feeding an empty partition,
     which returns 0). Raises TimeoutError if the consumer stopped pulling
     (reference: "Timeout while feeding partition").
+
+    ``stream`` names the columnar stream explicitly (default: a fresh
+    random id per call, so independent partitions can never collide in
+    the consumer's sequence tracking). An elastic RE-FEED of a
+    partition a consumer partially consumed must pass the SAME stream
+    id — and the same ``chunk`` size, so the frame boundaries line up —
+    as the original feed: the consumer's replay cursor
+    (``DataFeed.cursor``/``seed_cursor``) then recognizes the
+    already-consumed prefix as duplicates and drops it, giving
+    exactly-once consumption through the replay.
     """
     from tensorflowonspark_tpu.feed import columnar as col
     from tensorflowonspark_tpu.obs import spans as obs_spans
@@ -570,7 +610,10 @@ def feed_partition(
         put = lambda obj: q.put(obj, timeout=feed_timeout)  # noqa: E731
 
     seq = 0
-    stream = os.urandom(8).hex() if columnar else None
+    if not columnar:
+        stream = None
+    elif stream is None:
+        stream = os.urandom(8).hex()
 
     def put_columnar(ck, buf) -> None:
         """Ship one columnar chunk as frame ``seq`` of this partition's

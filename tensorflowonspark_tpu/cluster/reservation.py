@@ -47,6 +47,15 @@ class Reservations:
     driver can ask :meth:`dead_nodes` — "which registered nodes have
     been silent longer than the grace window" — instead of inferring
     death from a wedged feed timeout.
+
+    Membership is also new surface (the elastic plane): the roster has a
+    monotonic *membership epoch*. Epoch 0 is the startup barrier roster
+    (:meth:`seal`); every reconfigure — a node declared dead and removed
+    (:meth:`remove`), or a joiner registering mid-run — is published by
+    :meth:`bump_epoch`, which re-derives the ACTIVE roster and
+    increments the epoch. Heartbeat replies piggyback the epoch, so
+    every surviving node learns of a membership change within one beat
+    and can reshard instead of the driver restarting the world.
     """
 
     def __init__(self, required: int):
@@ -54,6 +63,10 @@ class Reservations:
         self._lock = threading.RLock()
         self._reservations: list[dict[str, Any]] = []  # guarded-by: self._lock
         self._last_seen: dict[int, float] = {}  # guarded-by: self._lock
+        self._epoch = 0  # guarded-by: self._lock
+        # Active membership (executor ids). None until seal(): before the
+        # startup barrier completes, "membership" is just the roster.
+        self._active_ids: list[int] | None = None  # guarded-by: self._lock
 
     def add(self, meta: dict[str, Any]) -> None:
         # Idempotent per executor_id: Client._call retries the REG when
@@ -98,6 +111,106 @@ class Reservations:
                 for eid, ts in self._last_seen.items()
                 if now - ts > grace
             )
+
+    # -- membership epoch (elastic plane) ------------------------------
+
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def seal(self) -> None:
+        """Freeze the startup-barrier roster as epoch-0 membership.
+
+        Called once the barrier completes; until then every registered
+        node IS a member. Idempotent — a second seal is a no-op so a
+        reconstructed driver handle cannot reset membership."""
+        with self._lock:
+            if self._active_ids is None:
+                self._active_ids = sorted(
+                    int(m["executor_id"])
+                    for m in self._reservations
+                    if m.get("executor_id") is not None
+                )
+
+    def active(self) -> list[dict[str, Any]]:
+        """The CURRENT membership roster (executor-id order). Before
+        :meth:`seal`, every reservation; after, only sealed/bumped-in
+        members — a mid-run registration (a joiner) stays pending until
+        the driver publishes it via :meth:`bump_epoch`."""
+        with self._lock:
+            if self._active_ids is None:
+                return list(self._reservations)
+            ids = set(self._active_ids)
+            return sorted(
+                (
+                    m
+                    for m in self._reservations
+                    if m.get("executor_id") in ids
+                ),
+                key=lambda m: m["executor_id"],
+            )
+
+    def pending_joins(self) -> list[dict[str, Any]]:
+        """Registrations that are not (or no longer) members — a
+        replacement node re-registering after its predecessor was
+        removed, or a brand-new voluntary joiner. The driver's elastic
+        supervision turns these into an epoch bump."""
+        with self._lock:
+            if self._active_ids is None:
+                return []
+            ids = set(self._active_ids)
+            return sorted(
+                (
+                    m
+                    for m in self._reservations
+                    if m.get("executor_id") is not None
+                    and m["executor_id"] not in ids
+                ),
+                key=lambda m: m["executor_id"],
+            )
+
+    def remove(self, executor_id: int) -> None:
+        """Drop a (dead or departing) node from the roster AND the
+        liveness table — a removed node must stop tripping
+        :meth:`dead_nodes` forever, and its stale roster entry must not
+        shadow a replacement's re-registration."""
+        with self._lock:
+            eid = int(executor_id)
+            self._reservations = [
+                m
+                for m in self._reservations
+                if m.get("executor_id") != eid
+            ]
+            self._last_seen.pop(eid, None)
+            if self._active_ids is not None:
+                self._active_ids = [i for i in self._active_ids if i != eid]
+
+    def bump_epoch(self, active_ids: list[int] | None = None) -> int:
+        """Publish a new membership epoch.
+
+        ``active_ids`` pins the new membership explicitly; None means
+        "every currently registered node" (removals already happened via
+        :meth:`remove`, joins via their registration). Returns the new
+        epoch — strictly monotonic, so consumers can order reconfigures
+        even across driver log gaps."""
+        with self._lock:
+            if active_ids is None:
+                self._active_ids = sorted(
+                    int(m["executor_id"])
+                    for m in self._reservations
+                    if m.get("executor_id") is not None
+                )
+            else:
+                self._active_ids = sorted(int(i) for i in active_ids)
+            self._epoch += 1
+            return self._epoch
+
+    def membership(self) -> dict[str, Any]:
+        """{"epoch": int, "roster": active roster} in one locked read —
+        the QEPOCH payload (an epoch and someone ELSE's roster would
+        tear)."""
+        with self._lock:
+            return {"epoch": self._epoch, "roster": self.active()}
 
     def done(self) -> bool:
         with self._lock:
@@ -153,7 +266,11 @@ class Server:
     - ``QUERY`` → {done: bool} — is the roster complete?
     - ``QINFO`` → {cluster_info: [...]} — the full roster (valid once done)
     - ``QNUM``  → {remaining: int}
-    - ``HEARTBEAT`` {executor_id} → {stop: bool, server_unix: float};
+    - ``QEPOCH`` → {epoch: int, roster: [...]} — the current membership
+      epoch and ACTIVE roster (the elastic plane: nodes refetch this
+      when a heartbeat reply shows the epoch moved)
+    - ``HEARTBEAT`` {executor_id} → {stop: bool, epoch: int,
+      server_unix: float};
       refreshes the node's last-seen stamp (the liveness plane — see
       ``Reservations.dead_nodes``) and piggybacks the out-of-band stop
       flag so heartbeaters learn of a cluster kill within one beat.
@@ -241,6 +358,11 @@ class Server:
                         conn,
                         {"type": "OK", "remaining": self.reservations.remaining()},
                     )
+                elif mtype == "QEPOCH":
+                    MessageSocket.send(
+                        conn,
+                        {"type": "OK", **self.reservations.membership()},
+                    )
                 elif mtype == "HEARTBEAT":
                     self.reservations.heartbeat(msg["executor_id"])
                     MessageSocket.send(
@@ -248,6 +370,9 @@ class Server:
                         {
                             "type": "OK",
                             "stop": self._stop.is_set(),
+                            # elastic plane: the beat a node already pays
+                            # for is how it learns membership moved
+                            "epoch": self.reservations.epoch(),
                             "server_unix": time.time(),
                         },
                     )
@@ -351,6 +476,15 @@ class Client:
 
     def get_reservations(self) -> list[dict[str, Any]]:
         return self._call({"type": "QINFO"})["cluster_info"]
+
+    def membership(self) -> dict[str, Any]:
+        """Current membership: ``{"epoch": int, "roster": [...]}`` —
+        fetched by node heartbeaters when a beat reply's epoch moves."""
+        reply = self._call({"type": "QEPOCH"}, timeout=10.0)
+        return {
+            "epoch": int(reply.get("epoch", 0)),
+            "roster": reply.get("roster", []),
+        }
 
     def await_reservations(
         self, timeout: float = 600.0, poll_interval: float = 1.0

@@ -71,6 +71,23 @@ class TFCluster:
         self.columnar = bool(cluster_meta.get("columnar", True))
         self._shutdown_done = False
         self._dstream_bridge: tuple | None = None
+        # -- elastic plane (compute/elastic.py; docs/ROBUSTNESS.md) --------
+        # With elastic=True, supervise() answers a membership change with
+        # a reconfigure (remove/admit + epoch bump) instead of raising.
+        self.elastic = bool(cluster_meta.get("elastic", False))
+        self.elastic_min_nodes = int(cluster_meta.get("elastic_min_nodes", 1))
+        # The startup barrier roster is epoch-0 membership.
+        server.reservations.seal()
+        # Executors that elastically LEFT (death or voluntary): their
+        # nonzero exits are expected, not failures, and no manager RPC
+        # may ever target them again.
+        self._departed: set[int] = set()  # guarded-by: self._dead_lock
+        # Launchers spawned for replacement nodes (launch_replacement);
+        # shutdown waits on / terminates these alongside the primary.
+        self._replacement_launchers: list[Any] = []
+        # The env run() launched nodes with — replacements must boot
+        # with the same one (run() fills this in).
+        self._node_env: dict[str, str] = {}
         # -- cluster observability plane (obs.cluster; docs/OBSERVABILITY.md)
         # Liveness surfaced in the registry: per-executor heartbeat age
         # as a render-time collector (PR 4's plane was invisible to
@@ -748,19 +765,155 @@ class TFCluster:
         self._check_errors()
 
     # ------------------------------------------------------------------
+    def membership_epoch(self) -> int:
+        """The current membership epoch (0 = the startup roster; bumped
+        once per reconfigure — see :meth:`supervise` elastic mode)."""
+        return self.server.reservations.epoch()
+
+    def launch_replacement(self, executor_id: int, map_fun, tf_args) -> None:
+        """Spawn a replacement node process for a departed executor id
+        (local-launcher path). The process registers with the running
+        reservation server like any node; elastic :meth:`supervise`
+        notices the pending registration and admits it with an epoch
+        bump. The replacement's ``map_fun`` typically hydrates via
+        ``ElasticTrainer.hydrate()`` before training."""
+        if executor_id not in self._snapshot_departed():
+            raise ValueError(
+                f"executor {executor_id} has not departed; replacements "
+                "are for elastically-removed members only"
+            )
+        launcher = LocalLauncher(env=self._node_env)
+        launcher._replaces = executor_id
+        launcher.launch(
+            1,
+            tfnode_runtime.run_node,
+            lambda _i: (executor_id, map_fun, tf_args, self.cluster_meta),
+        )
+        self._replacement_launchers.append(launcher)
+
+    def _snapshot_departed(self) -> set[int]:
+        with self._dead_lock:
+            return set(self._departed)
+
+    def _reconfigure(
+        self,
+        departed: list[int],
+        joined: list[dict[str, Any]],
+    ) -> int:
+        """Drive one membership change: remove the departed, admit the
+        joiners, bump the epoch (published to every survivor via the
+        next heartbeat reply), and leave the audit trail — flight
+        record + ``cluster_membership_epoch`` gauge."""
+        from tensorflowonspark_tpu.utils.failpoints import failpoint
+
+        failpoint("elastic.epoch_bump")
+        res = self.server.reservations
+        for eid in departed:
+            res.remove(eid)
+        with self._dead_lock:
+            self._departed.update(departed)
+            for m in joined:
+                # A readmitted executor id is a full member again: its
+                # exit codes count, and a second death must re-count.
+                self._departed.discard(m["executor_id"])
+                self._counted_dead.discard(m["executor_id"])
+        epoch = res.bump_epoch()
+        self.cluster_info = res.active()
+        reg = default_registry()
+        reg.gauge(
+            "cluster_membership_epoch",
+            "current membership epoch (bumped on every reconfigure)",
+        ).set(epoch)
+        flightrec.note(
+            "elastic_epoch_bump",
+            epoch=epoch,
+            departed=sorted(departed),
+            joined=sorted(m["executor_id"] for m in joined),
+            nodes=sorted(n["executor_id"] for n in self.cluster_info),
+        )
+        flightrec.dump_now("elastic_epoch_bump")
+        logger.warning(
+            "elastic: membership epoch %d — departed %s, joined %s, "
+            "%d node(s) remain",
+            epoch,
+            sorted(departed),
+            sorted(m["executor_id"] for m in joined),
+            len(self.cluster_info),
+        )
+        return epoch
+
+    def _elastic_scan(self) -> bool:
+        """One elastic supervision round: detect departures (process
+        exits + liveness) and pending joins; reconfigure when membership
+        moved. Returns True if a reconfigure happened. Raises when the
+        surviving membership would fall below ``elastic_min_nodes`` —
+        at that point restart (the PR-4 path) is the only recovery."""
+        active_ids = {n["executor_id"] for n in self.cluster_info}
+        exit_codes = self.launcher.exitcodes()
+        departed = set()
+        for eid in active_ids:
+            if (
+                eid < len(exit_codes)
+                and exit_codes[eid] is not None
+                and exit_codes[eid] != 0
+                and not self._is_replacement(eid)
+            ):
+                departed.add(eid)
+        departed.update(
+            eid for eid in self.dead_nodes() if eid in active_ids
+        )
+        joined = self.server.reservations.pending_joins()
+        if not departed and not joined:
+            return False
+        survivors = len(active_ids) - len(departed) + len(joined)
+        if survivors < self.elastic_min_nodes:
+            raise RuntimeError(
+                f"elastic supervision: {sorted(departed)} departed, "
+                f"leaving {survivors} node(s) — below elastic_min_nodes="
+                f"{self.elastic_min_nodes}; restart is the only recovery"
+            )
+        self._note_dead(sorted(departed))
+        self._reconfigure(sorted(departed), joined)
+        return True
+
+    def _is_replacement(self, executor_id: int) -> bool:
+        """True when a replacement process owns this executor id (alive,
+        or exited cleanly) — the primary launcher's dead exit code for
+        that slot is then history, not a departure/pending signal. Only
+        the LATEST replacement for the id counts: its predecessors'
+        fates are already-handled membership history."""
+        for launcher in reversed(self._replacement_launchers):
+            if getattr(launcher, "_replaces", None) != executor_id:
+                continue
+            # launch_replacement launches exactly one process per
+            # launcher; alive or exited-0 means the id is owned.
+            codes = launcher.exitcodes()
+            return bool(codes) and (codes[0] is None or codes[0] == 0)
+        return False
+
     def supervise(self, poll: float = 2.0) -> None:
         """Block until every node reaches a terminal state, failing FAST
-        on a dead node.
+        on a dead node — or, in **elastic** mode (``run(elastic=True)``),
+        answering membership changes with a reconfigure instead of a
+        failure.
 
-        The watch loop ``run_with_restarts`` runs between startup and
-        teardown: it raises RuntimeError within ~``poll`` seconds of a
-        node process exiting nonzero, and within ``heartbeat_grace`` of
-        a node going silent (SIGKILL, kernel OOM, network partition —
-        cases where the process table can't tell the driver anything).
-        Without it, a dead node surfaced only when ``shutdown``'s
-        watchdog expired — ``shutdown_timeout`` defaults to days.
-        Returns once every node is ``finished``/``error`` (or exited
-        cleanly), at which point :meth:`shutdown` completes promptly.
+        Non-elastic (the default): the watch loop ``run_with_restarts``
+        runs between startup and teardown — it raises RuntimeError
+        within ~``poll`` seconds of a node process exiting nonzero, and
+        within ``heartbeat_grace`` of a node going silent (SIGKILL,
+        kernel OOM, network partition — cases where the process table
+        can't tell the driver anything). Without it, a dead node
+        surfaced only when ``shutdown``'s watchdog expired.
+
+        Elastic: a departed node (process exit or missed heartbeats) is
+        REMOVED from membership and the epoch bumps; a pending mid-run
+        registration (a replacement or voluntary joiner) is ADMITTED,
+        bumping the epoch again. Survivors learn each bump within one
+        heartbeat and reshard in place (``compute/elastic.py``).
+        Raises only when membership would fall below
+        ``elastic_min_nodes``. Returns once every ACTIVE node is
+        ``finished``/``error`` (or exited cleanly), at which point
+        :meth:`shutdown` completes promptly.
         """
         # Terminal states are cached: a node observed finished/error
         # never needs another manager RPC. Non-terminal nodes are
@@ -772,13 +925,22 @@ class TFCluster:
         state_poll = max(poll, 5.0)
         next_state_probe = 0.0
         while True:
-            failed = self.launcher.poll_failed()
-            if failed:
-                raise RuntimeError(
-                    f"node process(es) {failed} died mid-run "
-                    "(exited nonzero)"
-                )
-            self._check_liveness()
+            if self.elastic:
+                if self._elastic_scan():
+                    # Membership moved: stale terminal cache entries for
+                    # readmitted ids must not mask a fresh process.
+                    active = {n["executor_id"] for n in self.cluster_info}
+                    terminal = {
+                        k: v for k, v in terminal.items() if k in active
+                    }
+            else:
+                failed = self.launcher.poll_failed()
+                if failed:
+                    raise RuntimeError(
+                        f"node process(es) {failed} died mid-run "
+                        "(exited nonzero)"
+                    )
+                self._check_liveness()
             exit_codes = self.launcher.exitcodes()
             pending = [
                 n
@@ -787,6 +949,7 @@ class TFCluster:
                 and not (
                     n["executor_id"] < len(exit_codes)
                     and exit_codes[n["executor_id"]] == 0
+                    and not self._is_replacement(n["executor_id"])
                 )
             ]
             if not pending:
@@ -869,6 +1032,12 @@ class TFCluster:
         if not self.launcher.wait(timeout=timeout):
             logger.error("shutdown watchdog fired after %ss; terminating", timeout)
             self.launcher.terminate()
+        # Replacement nodes got the same STOP as everyone else; a short
+        # bounded wait here — the primary wait above already burned the
+        # caller's budget.
+        for launcher in self._replacement_launchers:
+            if not launcher.wait(timeout=min(timeout, 60.0)):
+                launcher.terminate()
         self.server.stop()
         self._shutdown_done = True
         # Detach the observability plane: the scrape loop and the
@@ -881,10 +1050,34 @@ class TFCluster:
             self._driver_metrics_server = None
         default_registry().remove_collector(self._liveness_collector)
 
+        # Elastically-departed executors died by design (their nonzero
+        # exits ARE the membership change); a replaced slot's primary
+        # exit code is history too — judge the replacement's instead.
+        departed = self._snapshot_departed()
         exitcodes = self.launcher.exitcodes()
         bad = [
-            (i, c) for i, c in enumerate(exitcodes) if c is not None and c != 0
+            (i, c)
+            for i, c in enumerate(exitcodes)
+            if c is not None
+            and c != 0
+            and i not in departed
+            and not self._is_replacement(i)
         ]
+        # Only the LAST replacement per executor id is judged: an
+        # earlier replacement that crashed triggered its own departure
+        # + readmission cycle — that exit IS membership history, and
+        # counting it would fail a fully recovered run.
+        last_replacement: dict[Any, Any] = {}
+        for launcher in self._replacement_launchers:
+            last_replacement[getattr(launcher, "_replaces", None)] = launcher
+        for eid, launcher in last_replacement.items():
+            if eid in departed:
+                continue  # the replacement itself departed later
+            bad.extend(
+                (eid, c)
+                for c in launcher.exitcodes()
+                if c is not None and c != 0
+            )
         if node_errors:
             tracebacks = "\n".join(e["traceback"] for e in node_errors)
             raise RuntimeError(f"cluster node(s) failed:\n{tracebacks}")
@@ -956,6 +1149,8 @@ def run(
     heartbeat_grace: float = 60.0,
     columnar: bool = True,
     flightrec_dir: str | None = "logs",
+    elastic: bool = False,
+    elastic_min_nodes: int = 1,
 ) -> TFCluster:
     """Start a cluster and return its handle.
 
@@ -973,6 +1168,22 @@ def run(
         )
     if num_executors < 1:
         raise ValueError("num_executors must be >= 1")
+    if elastic:
+        # Elastic reconfigure replays data from (epoch, step) — nodes
+        # must own their readers. A push feed's consumed partitions
+        # cannot be reassigned by the driver (same constraint as
+        # run_with_restarts).
+        if input_mode != InputMode.TENSORFLOW:
+            raise ValueError(
+                "elastic=True requires input_mode=InputMode.TENSORFLOW "
+                "(push-fed partitions cannot be replayed on reconfigure)"
+            )
+        if heartbeat_interval <= 0:
+            raise ValueError(
+                "elastic=True requires heartbeats (heartbeat_interval "
+                "> 0): membership changes are detected and published "
+                "through the liveness plane"
+            )
 
     # Role template (reference: TFCluster.py:run role map). All roles are
     # mesh-symmetric workers on TPU; 'chief' marks process 0 (checkpoint
@@ -1020,6 +1231,12 @@ def run(
         # dead_nodes / supervise and the feed-plane checks).
         "heartbeat_interval": heartbeat_interval,
         "heartbeat_grace": heartbeat_grace,
+        # Elastic plane: supervise() reconfigures (epoch bump + reshard)
+        # on membership change instead of failing; below
+        # elastic_min_nodes survivors it gives up and raises (restart —
+        # run_with_restarts — is then the only recovery).
+        "elastic": elastic,
+        "elastic_min_nodes": elastic_min_nodes,
         "distributed": distributed,
         "queue_maxsize": queue_maxsize,
         "manager_mode": "remote",
@@ -1102,9 +1319,11 @@ def run(
         server.stop()
         raise
     logger.info("cluster %s up: %s", cluster_meta["id"], cluster_info)
-    return TFCluster(
+    cluster = TFCluster(
         launcher, server, server_addr, cluster_info, cluster_meta, input_mode, queues
     )
+    cluster._node_env = dict(env or {})
+    return cluster
 
 
 # Reference-compat: the reference exposes `TFCluster.run(...)` as a module

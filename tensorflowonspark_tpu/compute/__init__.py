@@ -7,8 +7,14 @@ and FSDP training are expressed as ``jax.jit`` over a ``Mesh`` with
 all-reduce performed in the reference.
 """
 
+from tensorflowonspark_tpu.compute.elastic import (
+    ElasticTrainer,
+    host_snapshot,
+    reshard_state,
+)
 from tensorflowonspark_tpu.compute.mesh import (
     MESH_AXES,
+    fit_axis_shapes,
     make_mesh,
     batch_sharding,
     replicated,
@@ -28,6 +34,10 @@ from tensorflowonspark_tpu.compute.train import (
 
 __all__ = [
     "MESH_AXES",
+    "ElasticTrainer",
+    "host_snapshot",
+    "reshard_state",
+    "fit_axis_shapes",
     "make_mesh",
     "batch_sharding",
     "replicated",

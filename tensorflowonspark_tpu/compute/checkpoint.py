@@ -255,6 +255,25 @@ def _stored_top_level_keys(ckpt: CheckpointManager, step: int):
         return None
 
 
+def hydration_restore(directory: str, target: Any):
+    """Elastic-rejoin fallback: restore the newest checkpoint under
+    ``directory`` into ``target``'s structure. Returns ``(step,
+    state)`` or ``(None, None)`` when the directory holds no
+    checkpoints (including a directory that does not exist yet — a
+    joiner probing an optional fallback must not crash on it).
+
+    This is the "checkpoint restore is the fallback, not the recovery
+    path" half of the elastic contract (compute/elastic.py): peers'
+    in-memory state is tried first; only when that is impossible does
+    the joiner pay a full checkpoint read.
+    """
+    with CheckpointManager(directory) as ckpt:
+        step, state = restore_latest(ckpt, target)
+        if step is None:
+            return None, None
+        return step, state
+
+
 def saves_on_this_process(is_chief: bool) -> bool:
     """Which processes must call ``save`` (and ``wait``):
 

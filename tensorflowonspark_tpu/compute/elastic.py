@@ -1,0 +1,586 @@
+"""Elastic training: survive a membership change mid-run — reshard, don't restart.
+
+PR 4's liveness plane made node death *detectable* in seconds, but the
+recovery was still "tear the whole cluster down and relaunch"
+(``run_with_restarts``). This module is the next step, the TF-Replicator
+recipe (PAPERS.md, arXiv 1902.00465) composed with deterministic
+cross-replica state sharding (arXiv 2004.13336): when membership changes,
+the surviving processes *reconfigure* —
+
+1. the driver bumps a monotonic **membership epoch** and publishes the new
+   roster (``cluster/reservation.py``); every node learns of it within one
+   heartbeat (the beat reply piggybacks the epoch);
+2. survivors gather their state to an **in-memory host snapshot**
+   (:func:`host_snapshot`), re-init ``jax.distributed`` against the new
+   topology (``TFNodeContext.reinitialize_distributed``), re-form the mesh
+   (:func:`fit_axis_shapes <tensorflowonspark_tpu.compute.mesh.fit_axis_shapes>`
+   + ``make_mesh``), and deterministically commit params + optimizer state
+   onto the new shardings (:func:`reshard_state`) — byte-identical values,
+   new placement;
+3. a **joining** node hydrates its state from a peer's published in-memory
+   snapshot (:meth:`ElasticTrainer.hydrate`), falling back to the latest
+   orbax checkpoint only when in-memory recovery is impossible — the
+   checkpoint is the fallback, not the recovery path.
+
+Every decision is failpoint-injectable (``elastic.epoch_bump``,
+``elastic.reshard_gather``, ``elastic.rejoin_init``) and recorded as obs
+events + flight-recorder entries, so chaos runs are auditable end to end:
+``cluster_membership_epoch`` (gauge), ``elastic_reshard_seconds``
+(histogram), ``elastic_recoveries_total{outcome=}`` (counter).
+
+The driver-side half lives in ``TFCluster.supervise()`` (elastic mode):
+instead of raising on a dead node, it removes the node, bumps the epoch,
+and keeps supervising the survivors.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+from tensorflowonspark_tpu.obs import flightrec
+from tensorflowonspark_tpu.obs import spans as obs_spans
+from tensorflowonspark_tpu.obs.registry import default_registry
+from tensorflowonspark_tpu.utils.failpoints import failpoint
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ElasticTrainer",
+    "InMemoryRecoveryUnavailable",
+    "MembershipWatcher",
+    "host_snapshot",
+    "membership",
+    "notify_membership",
+    "reshard_state",
+    "wait_for_epoch",
+]
+
+# Default manager-KV key a survivor publishes its host snapshot under
+# (what a joiner's peer hydration reads).
+STATE_KEY = "elastic:state"
+
+
+class InMemoryRecoveryUnavailable(RuntimeError):
+    """A state leaf is not fully addressable from this process (its
+    shards live on departed peers' devices), so the in-memory recovery
+    path cannot produce a complete snapshot — fall back to the latest
+    checkpoint."""
+
+
+def _metrics():
+    reg = default_registry()
+    return (
+        reg.gauge(
+            "cluster_membership_epoch",
+            "current membership epoch (bumped on every reconfigure)",
+        ),
+        reg.histogram(
+            "elastic_reshard_seconds",
+            "wall seconds spent resharding state on a membership change",
+        ),
+        reg.counter(
+            "elastic_recoveries_total",
+            "elastic recovery attempts, by outcome",
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# membership watcher (node side)
+# ---------------------------------------------------------------------------
+
+
+class MembershipWatcher:
+    """Process-local view of the cluster membership epoch.
+
+    The node heartbeater calls :meth:`notify` when a beat reply shows
+    the epoch moved (after refetching the roster via ``QEPOCH``);
+    training loops poll :meth:`current` / ``ElasticTrainer.changed()``
+    — one integer compare per step — and tests block on
+    :meth:`wait_for_epoch`. Epochs only move forward; a stale notify
+    (reordered beat replies) is ignored.
+    """
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._epoch = 0  # guarded-by: self._cond
+        self._roster: list[dict[str, Any]] | None = None  # guarded-by: self._cond
+
+    def notify(self, epoch: int, roster: list[dict[str, Any]]) -> bool:
+        """Record a membership change; returns False for stale epochs."""
+        epoch = int(epoch)
+        with self._cond:
+            if epoch <= self._epoch and self._roster is not None:
+                return False
+            self._epoch = max(self._epoch, epoch)
+            self._roster = list(roster)
+            self._cond.notify_all()
+        _metrics()[0].set(epoch)
+        flightrec.note(
+            "membership_epoch",
+            epoch=epoch,
+            nodes=[n.get("executor_id") for n in roster],
+        )
+        return True
+
+    def current(self) -> tuple[int, list[dict[str, Any]] | None]:
+        with self._cond:
+            return self._epoch, (
+                None if self._roster is None else list(self._roster)
+            )
+
+    def wait_for_epoch(self, min_epoch: int, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._epoch < min_epoch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 1.0))
+            return True
+
+    def reset(self) -> None:
+        """Back to the never-notified state (tests; a fresh cluster in
+        the same process)."""
+        with self._cond:
+            self._epoch = 0
+            self._roster = None
+            self._cond.notify_all()
+
+
+_watcher = MembershipWatcher()
+
+
+def notify_membership(epoch: int, roster: list[dict[str, Any]]) -> bool:
+    """Entry point for the heartbeater: publish a membership change to
+    this process's training loop."""
+    return _watcher.notify(epoch, roster)
+
+
+def membership() -> tuple[int, list[dict[str, Any]] | None]:
+    """(epoch, roster) as last notified; roster None before any notify."""
+    return _watcher.current()
+
+
+def wait_for_epoch(min_epoch: int, timeout: float = 30.0) -> bool:
+    return _watcher.wait_for_epoch(min_epoch, timeout)
+
+
+# ---------------------------------------------------------------------------
+# deterministic resharding
+# ---------------------------------------------------------------------------
+
+
+def host_snapshot(state: Any) -> Any:
+    """In-memory host copy of ``state``: same pytree, numpy leaves.
+
+    THE recovery artifact of the elastic plane — byte-exact (device_get
+    round-trips bitwise), so a reshard built from it is byte-identical
+    to the pre-change state. Raises :class:`InMemoryRecoveryUnavailable`
+    when a leaf is not fully addressable from this process (its shards
+    lived on departed peers): that is the precise condition under which
+    the checkpoint fallback is the only honest recovery.
+    """
+    import jax
+
+    def pull(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            raise InMemoryRecoveryUnavailable(
+                "state leaf is not fully addressable from this process; "
+                "in-memory recovery needs every shard locally — falling "
+                "back to the latest checkpoint is the supported path"
+            )
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree.map(pull, state)
+
+
+def reshard_state(state: Any, shardings: Any) -> Any:
+    """Deterministically commit ``state`` onto ``shardings`` through
+    host memory: ``device_get`` each leaf (a no-op for an existing
+    :func:`host_snapshot`) then ``device_put`` to its target sharding.
+    Values are untouched — an N→N−1→N round trip is byte-identical
+    (proven by ``tests/test_elastic.py``)."""
+    import jax
+
+    host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), state)
+    return jax.tree.map(jax.device_put, host, shardings)
+
+
+def default_shardings_fn(state: Any, mesh) -> Any:
+    """Shardings for a (re-formed) mesh: FSDP over params via
+    :func:`~tensorflowonspark_tpu.compute.train.fsdp_shardings`, the
+    optimizer tree mirrored, scalars replicated — the same axis rules
+    training started with, re-derived for the new device count."""
+    from tensorflowonspark_tpu.compute.train import (
+        fsdp_shardings,
+        state_shardings,
+    )
+
+    if hasattr(state, "params"):
+        psh = fsdp_shardings(state.params, mesh)
+        return state_shardings(state, mesh, psh)
+    return fsdp_shardings(state, mesh)
+
+
+# ---------------------------------------------------------------------------
+# the node-side state machine
+# ---------------------------------------------------------------------------
+
+
+class ElasticTrainer:
+    """Node-side reshard/epoch state machine.
+
+    Usage (the shape ``tests/cluster_fns.elastic_train_fn`` follows)::
+
+        trainer = ElasticTrainer(ctx, axis_shapes={"data": -1})
+        mesh = trainer.mesh()
+        step_fn = build_train_step(loss_fn, tx, mesh)
+        step = start
+        while step < total:
+            if trainer.changed():                      # one int compare
+                state, mesh = trainer.reconfigure(state)
+                step_fn = build_train_step(loss_fn, tx, mesh)
+                if trainer.resume_step is not None:    # ckpt fallback:
+                    step = trainer.resume_step         # rewind + replay
+            state, loss = step_fn(state, batch_for(step))
+            trainer.publish(state, step + 1)           # peers can hydrate
+            step += 1
+
+    ``axis_shapes`` follows ``make_mesh`` (the elastic axis absorbs
+    device-count changes — :func:`fit_axis_shapes`); ``shardings_fn(state,
+    mesh)`` derives the new placement (default: FSDP params + mirrored
+    optimizer tree); ``checkpoint_dir`` arms the fallback;
+    ``publish_steps`` throttles peer-hydration snapshots;
+    ``devices_fn(roster)`` overrides device discovery (tests shrink a
+    local device set with it — production uses the post-reinit global
+    device list).
+    """
+
+    def __init__(
+        self,
+        ctx,
+        axis_shapes: Mapping[str, int] | None = None,
+        elastic_axis: str = "fsdp",
+        shardings_fn: Callable[[Any, Any], Any] | None = None,
+        checkpoint_dir: str | None = None,
+        publish_steps: int = 1,
+        state_key: str = STATE_KEY,
+        devices_fn: Callable[[list[dict[str, Any]]], list] | None = None,
+    ):
+        self._ctx = ctx
+        self._axis_shapes = dict(axis_shapes) if axis_shapes else None
+        self._elastic_axis = elastic_axis
+        self._shardings_fn = shardings_fn or default_shardings_fn
+        self._checkpoint_dir = checkpoint_dir
+        self._publish_steps = max(1, int(publish_steps))
+        self._state_key = state_key
+        self._devices_fn = devices_fn
+        self._last_published: int | None = None
+        epoch, roster = membership()
+        self._cur_epoch = epoch
+        self._cur_roster = (
+            roster
+            if roster is not None
+            else list(getattr(ctx, "cluster_info", None) or [])
+        )
+        self._mesh = None
+        # Set by reconfigure: None after an in-memory reshard (resume
+        # where you were), or the restored checkpoint step after a
+        # checkpoint_fallback — the training loop MUST rewind its step
+        # counter to it (replaying the same data order) or it silently
+        # skips the steps between the checkpoint and the failure.
+        self.resume_step: int | None = None
+
+    # -- cheap per-step surface ---------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        return self._cur_epoch
+
+    @property
+    def roster(self) -> list[dict[str, Any]]:
+        return list(self._cur_roster)
+
+    def _is_member(self, roster: list[dict[str, Any]]) -> bool:
+        eid = getattr(self._ctx, "executor_id", None)
+        return any(n.get("executor_id") == eid for n in roster)
+
+    def changed(self) -> bool:
+        """True when the cluster membership moved past the epoch this
+        trainer last reconfigured for — one integer compare on the hot
+        path, safe to call every step.
+
+        One refinement for joiners: a freshly-registered node may see a
+        bump it is in NEITHER side of (the departure bump published
+        just before its own admission). Reconfiguring onto a roster
+        that excludes it would be wrong either way, so such bumps are
+        not "changes" — its own admission bump follows within a poll."""
+        epoch, roster = _watcher.current()
+        if epoch <= self._cur_epoch:
+            return False
+        if (
+            roster is not None
+            and not self._is_member(roster)
+            and not self._is_member(self._cur_roster)
+        ):
+            return False  # registered but not yet admitted
+        return True
+
+    def mesh(self):
+        """The device mesh for the current epoch (cached until the next
+        :meth:`reconfigure`)."""
+        if self._mesh is None:
+            from tensorflowonspark_tpu.compute.mesh import (
+                fit_axis_shapes,
+                make_mesh,
+            )
+
+            devices = self._devices()
+            shapes = fit_axis_shapes(
+                self._axis_shapes, len(devices), self._elastic_axis
+            )
+            self._mesh = make_mesh(shapes, devices=devices)
+        return self._mesh
+
+    def _devices(self) -> list:
+        import jax
+
+        if self._devices_fn is not None:
+            return list(self._devices_fn(self._cur_roster))
+        # Multi-controller: the global device set (post-reinit it spans
+        # exactly the surviving processes). Single-controller-per-node:
+        # membership does not change this node's local devices.
+        if getattr(self._ctx, "distributed", False):
+            return list(jax.devices())
+        return list(jax.local_devices())
+
+    # -- the reconfigure ----------------------------------------------
+
+    def reconfigure(self, state: Any) -> tuple[Any, Any]:
+        """Drive one membership reconfigure; returns ``(state, mesh)``.
+
+        Order matters: (1) gather the in-memory snapshot while the OLD
+        arrays are still healthy, (2) re-init the distributed runtime
+        against the new roster, (3) re-form the mesh, (4) commit the
+        snapshot onto the new shardings. A failed gather (shards on
+        departed peers; an armed ``elastic.reshard_gather``) falls back
+        to the latest checkpoint — outcome ``checkpoint_fallback``,
+        with :attr:`resume_step` set to the restored step so the
+        training loop rewinds to it (replaying the same data order)
+        instead of silently skipping the steps between the checkpoint
+        and the failure — and with no ``checkpoint_dir`` the
+        reconfigure fails loudly (outcome ``failed``): training on
+        silently-stale state is the one unacceptable result.
+        """
+        gauge, hist, recoveries = _metrics()
+        epoch, roster = membership()
+        if roster is None:
+            roster = self._cur_roster
+        if not self._is_member(roster):
+            # The driver removed THIS node (a false-positive death
+            # verdict — e.g. a GC pause outliving the grace — or a
+            # voluntary leave). Continuing to train outside membership
+            # is zombie work; rejoining goes through registration, not
+            # reconfigure.
+            raise RuntimeError(
+                f"executor {getattr(self._ctx, 'executor_id', '?')} is "
+                f"not in membership epoch {epoch} "
+                f"({[n.get('executor_id') for n in roster]}): this node "
+                "was removed — re-register to rejoin instead of "
+                "reconfiguring"
+            )
+        t0 = time.monotonic()
+        outcome = "resharded"
+        restored_step: int | None = None
+        with obs_spans.span(
+            "elastic.reshard", epoch=epoch, nodes=len(roster)
+        ):
+            snapshot = None
+            gather_err: BaseException | None = None
+            try:
+                failpoint("elastic.reshard_gather")
+                snapshot = host_snapshot(state)
+            except BaseException as e:  # noqa: BLE001 - fallback decides
+                gather_err = e
+                logger.warning(
+                    "elastic: in-memory gather failed (%s); trying the "
+                    "checkpoint fallback",
+                    e,
+                )
+            reinit = getattr(self._ctx, "reinitialize_distributed", None)
+            if reinit is not None:
+                reinit(roster)
+            self._cur_epoch, self._cur_roster, self._mesh = epoch, roster, None
+            mesh = self.mesh()
+            if snapshot is None:
+                snapshot, outcome, restored_step = self._fallback_snapshot(
+                    state, gather_err
+                )
+            shardings = self._shardings_fn(snapshot, mesh)
+            state = reshard_state(snapshot, shardings)
+        self.resume_step = restored_step
+        dt = time.monotonic() - t0
+        hist.observe(dt)
+        recoveries.inc(outcome=outcome)
+        gauge.set(epoch)
+        flightrec.note(
+            "elastic_reconfigure",
+            epoch=epoch,
+            outcome=outcome,
+            nodes=len(roster),
+            resume_step=restored_step,
+            seconds=round(dt, 3),
+        )
+        logger.info(
+            "elastic: reconfigured to epoch %d (%d node(s), %s, %.3fs)",
+            epoch,
+            len(roster),
+            outcome,
+            dt,
+        )
+        # The snapshot published for joiners must reflect the new epoch
+        # — and, after a fallback, the step it was actually rewound to.
+        self.publish(
+            state,
+            restored_step
+            if restored_step is not None
+            else (self._last_published or 0),
+            force=True,
+        )
+        return state, mesh
+
+    def _fallback_snapshot(
+        self, state: Any, gather_err: BaseException | None
+    ) -> tuple[Any, str, int]:
+        if self._checkpoint_dir is None:
+            _metrics()[2].inc(outcome="failed")
+            flightrec.note(
+                "elastic_reconfigure_failed", error=repr(gather_err)
+            )
+            flightrec.dump_now("elastic_reconfigure_failed")
+            raise RuntimeError(
+                "elastic reconfigure: in-memory recovery impossible and "
+                "no checkpoint_dir configured"
+            ) from gather_err
+        from tensorflowonspark_tpu.compute import checkpoint as ckpt
+
+        step, restored = ckpt.hydration_restore(
+            self._checkpoint_dir, target=state
+        )
+        if restored is None:
+            _metrics()[2].inc(outcome="failed")
+            flightrec.note(
+                "elastic_reconfigure_failed",
+                error=repr(gather_err),
+                checkpoint_dir=self._checkpoint_dir,
+            )
+            flightrec.dump_now("elastic_reconfigure_failed")
+            raise RuntimeError(
+                f"elastic reconfigure: in-memory recovery impossible and "
+                f"no checkpoint found under {self._checkpoint_dir!r}"
+            ) from gather_err
+        logger.warning(
+            "elastic: recovered from checkpoint step %s (in-memory "
+            "snapshot unavailable); the training loop must rewind to it",
+            step,
+        )
+        return host_snapshot(restored), "checkpoint_fallback", int(step)
+
+    # -- peer hydration (the joiner path) ------------------------------
+
+    def publish(self, state: Any, step: int, force: bool = False) -> None:
+        """Publish this node's host snapshot to its manager KV so a
+        joiner can hydrate from in-memory state instead of a checkpoint.
+        Throttled to every ``publish_steps`` steps; best-effort (a
+        failed publish degrades the joiner to the checkpoint fallback,
+        it never fails training)."""
+        mgr = getattr(self._ctx, "mgr", None)
+        if mgr is None:
+            return
+        if (
+            not force
+            and self._last_published is not None
+            and step - self._last_published < self._publish_steps
+        ):
+            return
+        try:
+            blob = pickle.dumps(
+                (self._cur_epoch, int(step), host_snapshot(state)),
+                protocol=pickle.HIGHEST_PROTOCOL,
+            )
+            mgr.set(self._state_key, blob)
+            self._last_published = int(step)
+        except Exception as e:  # noqa: BLE001 - best-effort by contract
+            logger.debug("elastic publish skipped: %s", e)
+
+    def hydrate(self, default: Any = None) -> tuple[int | None, Any]:
+        """Joining-node recovery: ``(step, state)`` from the freshest
+        peer-published in-memory snapshot, else the latest checkpoint
+        (outcome ``checkpoint_fallback``), else ``(None, default)``
+        (outcome ``fresh_init`` — a genuinely new cluster). The
+        returned state is committed onto this node's current mesh via
+        ``shardings_fn``. Peer snapshots ride the authkey-authenticated
+        manager channel the data plane already trusts."""
+        failpoint("elastic.rejoin_init")
+        from tensorflowonspark_tpu.cluster.node import connect_manager
+
+        recoveries = _metrics()[2]
+        best: tuple[int, Any] | None = None
+        for node in sorted(
+            self._cur_roster, key=lambda n: n.get("executor_id", -1)
+        ):
+            if node.get("executor_id") == getattr(
+                self._ctx, "executor_id", None
+            ):
+                continue
+            try:
+                blob = connect_manager(node).get(self._state_key)
+                if not blob:
+                    continue
+                _ep, step, snap = pickle.loads(blob)
+            except Exception as e:  # noqa: BLE001 - peers may be dying
+                logger.debug(
+                    "elastic hydrate: peer %s unavailable (%s)",
+                    node.get("executor_id"),
+                    e,
+                )
+                continue
+            if best is None or int(step) > best[0]:
+                best = (int(step), snap)
+        outcome = "peer_hydrate"
+        if best is None:
+            step_snap = self._checkpoint_hydrate(default)
+            if step_snap is None:
+                recoveries.inc(outcome="fresh_init")
+                flightrec.note("elastic_hydrate", outcome="fresh_init")
+                return None, default
+            best, outcome = step_snap, "checkpoint_fallback"
+        step, snap = best
+        state = reshard_state(
+            snap, self._shardings_fn(snap, self.mesh())
+        )
+        recoveries.inc(outcome=outcome)
+        flightrec.note("elastic_hydrate", outcome=outcome, step=step)
+        logger.info(
+            "elastic: hydrated at step %d via %s", step, outcome
+        )
+        return step, state
+
+    def _checkpoint_hydrate(self, default: Any) -> tuple[int, Any] | None:
+        if self._checkpoint_dir is None:
+            return None
+        from tensorflowonspark_tpu.compute import checkpoint as ckpt
+
+        step, restored = ckpt.hydration_restore(
+            self._checkpoint_dir, target=default
+        )
+        if restored is None:
+            return None
+        return int(step), host_snapshot(restored)

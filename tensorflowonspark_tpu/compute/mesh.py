@@ -76,6 +76,40 @@ def make_mesh(
     return Mesh(dev_array, MESH_AXES)
 
 
+def fit_axis_shapes(
+    axis_shapes: Mapping[str, int] | None,
+    n_devices: int,
+    elastic_axis: str = "fsdp",
+) -> dict[str, int]:
+    """Deterministically re-fit an axis spec to a changed device count.
+
+    The elastic plane re-forms the mesh after a membership change, and
+    every process must derive the SAME shape from (spec, device count)
+    alone — no negotiation. Rule: a spec that already defers an axis
+    (``-1``) keeps its own inference; otherwise the ``elastic_axis``
+    absorbs the change (its pinned size is replaced by ``-1``). Either
+    way the non-inferred axes must divide ``n_devices`` — an impossible
+    fit raises rather than silently padding, because a mesh the caller
+    did not ask for is exactly the nondeterminism resharding cannot
+    survive.
+    """
+    shapes = dict(axis_shapes) if axis_shapes else {elastic_axis: -1}
+    if not any(s == -1 for s in shapes.values()):
+        if elastic_axis not in MESH_AXES:
+            raise ValueError(
+                f"unknown elastic axis {elastic_axis!r}; expected one "
+                f"of {MESH_AXES}"
+            )
+        shapes[elastic_axis] = -1
+    known = math.prod(s for s in shapes.values() if s != -1)
+    if known <= 0 or n_devices % known:
+        raise ValueError(
+            f"axis spec {dict(shapes)} cannot fit {n_devices} devices: "
+            f"fixed axes multiply to {known}"
+        )
+    return shapes
+
+
 def parse_axis_spec(spec: str) -> dict[str, int]:
     """Parse a CLI mesh spec ``'data=2,model=4'`` into the axis-shape
     mapping :func:`make_mesh` takes (``-1`` = infer, like make_mesh).

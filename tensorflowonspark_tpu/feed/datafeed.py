@@ -35,9 +35,19 @@ from tensorflowonspark_tpu.utils.failpoints import failpoint
 logger = logging.getLogger(__name__)
 
 # Sentinel for a chunk discarded by the armed ``columnar.frame`` drop
-# failpoint: the pull loop skips it (the NEXT frame's sequence check is
-# what surfaces the loss).
+# failpoint — or recognized as a replayed duplicate by the seq cursor:
+# the pull loop skips it (the NEXT frame's sequence check is what
+# surfaces a real loss).
 _DROPPED = object()
+
+
+def _replay_counter():
+    from tensorflowonspark_tpu.obs.registry import default_registry
+
+    return default_registry().counter(
+        "feed_replay_skipped_total",
+        "replayed duplicate frames dropped by the seq cursor, by queue",
+    )
 
 class FeedTimeout(TimeoutError):
     """The input queue produced nothing for the whole feed-timeout
@@ -163,22 +173,50 @@ class DataFeed:
             return columnize_rows(self._next_raw(batch_size), self.input_mapping)
         return self._next_columns(batch_size)
 
-    def _check_seq(self, chunk: ColumnChunk) -> None:
-        """Frame-drop detection: frames of one producer stream carry a
-        monotonic ``seq``; a gap means a frame was lost mid-stream
-        (see the ``columnar.frame`` failpoint) and records silently
-        vanished — raise instead of training on a hole."""
+    def _check_seq(self, chunk: ColumnChunk) -> bool:
+        """Frame-drop detection AND replay dedupe — the per-stream
+        seq protocol doubles as the elastic plane's replay cursor.
+
+        Frames of one producer stream carry a monotonic ``seq``. Three
+        cases: the expected seq advances the cursor (accept); a seq
+        BEHIND the cursor is a replayed duplicate — an elastic
+        reconfigure re-feeding a stream a consumer partially saw, or a
+        rejoiner seeded via :meth:`seed_cursor` — and is dropped
+        (counted in ``feed_replay_skipped_total``), giving exactly-once
+        consumption through a re-feed; a seq AHEAD of the cursor means
+        a frame was lost mid-stream (see the ``columnar.frame``
+        failpoint) and records silently vanished — raise instead of
+        training on a hole."""
         if chunk.stream is None:
-            return
+            return True
         last = self._seq_state.get(chunk.stream)
         expected = 0 if last is None else last + 1
-        if chunk.seq != expected:
-            raise RuntimeError(
-                f"columnar frame sequence gap on queue {self.qname_in!r} "
-                f"stream {chunk.stream}: expected frame {expected}, got "
-                f"{chunk.seq} — a frame was dropped mid-stream"
-            )
-        self._seq_state[chunk.stream] = chunk.seq
+        if chunk.seq == expected:
+            self._seq_state[chunk.stream] = chunk.seq
+            return True
+        if chunk.seq < expected:
+            _replay_counter().inc(queue=self.qname_in)
+            return False
+        raise RuntimeError(
+            f"columnar frame sequence gap on queue {self.qname_in!r} "
+            f"stream {chunk.stream}: expected frame {expected}, got "
+            f"{chunk.seq} — a frame was dropped mid-stream"
+        )
+
+    def cursor(self) -> dict[str, int]:
+        """The replay cursor: last consumed frame ``seq`` per live
+        stream. An elastic consumer snapshots this alongside its train
+        state; after a reconfigure re-feeds the stream, seeding a fresh
+        feed with :meth:`seed_cursor` makes the already-consumed prefix
+        drop silently (exactly-once, same data order)."""
+        return dict(self._seq_state)
+
+    def seed_cursor(self, cursor: dict[str, int]) -> None:
+        """Adopt a replay cursor (see :meth:`cursor`): frames at or
+        below each stream's seeded seq are treated as replayed
+        duplicates and dropped instead of raising a gap."""
+        for stream, seq in cursor.items():
+            self._seq_state[str(stream)] = int(seq)
 
     def _ingest(self, item: Any, sp=None) -> Any:
         """Normalize a queue item: decode TCP-borne frames (zero-copy
@@ -195,7 +233,8 @@ class DataFeed:
                 sp.set(stream=item.stream, seq=item.seq)
             if failpoint("columnar.frame") == "drop":
                 return _DROPPED
-            self._check_seq(item)
+            if not self._check_seq(item):
+                return _DROPPED  # replayed duplicate (elastic re-feed)
         elif isinstance(item, EndPartition):
             # Stream ids are per-partition (feed_partition mints one per
             # call), so the finished partition's seq entry is dead — a

@@ -91,6 +91,10 @@ SITES = frozenset(
         # checkpoint plane
         "checkpoint.save",  # orbax save (inside the retry)
         "checkpoint.restore",  # orbax restore (inside the retry)
+        # elastic plane (compute/elastic.py + TFCluster supervise)
+        "elastic.epoch_bump",  # driver, before publishing a new epoch
+        "elastic.reshard_gather",  # node, gathering state to host memory
+        "elastic.rejoin_init",  # joining node, before peer/ckpt hydration
     }
 )
 

@@ -626,6 +626,132 @@ def distributed_llama_ckpt_fn(args, ctx):
         json.dump(out, f)
 
 
+def _elastic_recipe():
+    """Shared pieces of the elastic chaos tests: a tiny linear model
+    whose data order is a pure function of the step index (the replay
+    cursor contract — any process at step i computes the same batch),
+    trained with momentum-SGD so the optimizer state is a real pytree
+    that must survive resharding. Returns (loss_fn, tx, make_batch)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    def loss_fn(params, batch):
+        pred = batch["x"] * params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def make_batch(i):
+        rng = np.random.default_rng(1000 + i)
+        x = rng.normal(size=8).astype(np.float32)
+        return {"x": x, "y": 3.0 * x + 1.5}
+
+    return loss_fn, optax.sgd(0.1, momentum=0.9), make_batch
+
+
+def elastic_reference_params(steps: int) -> dict[str, str]:
+    """The uninterrupted run at the same data order: the byte-identity
+    oracle the elastic chaos test compares final params against."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.compute import TrainState, build_train_step
+    from tensorflowonspark_tpu.compute.mesh import make_mesh, shard_batch
+
+    loss_fn, tx, make_batch = _elastic_recipe()
+    mesh = make_mesh({"data": -1})
+    state = TrainState.create({"w": jnp.zeros(()), "b": jnp.zeros(())}, tx)
+    step_fn = build_train_step(loss_fn, tx, mesh)
+    for i in range(steps):
+        state, _ = step_fn(state, shard_batch(mesh, make_batch(i)))
+    return {
+        k: np.asarray(v).tobytes().hex() for k, v in state.params.items()
+    }
+
+
+def elastic_train_fn(args, ctx):
+    """TENSORFLOW-mode elastic training loop (compute/elastic.py).
+
+    Deterministic per-step batches, an ElasticTrainer reconfigure
+    whenever the membership epoch moves, per-step peer-hydration
+    snapshots, and — with ``rejoin=True`` — hydration from a surviving
+    peer's in-memory state before training. Writes losses / epochs /
+    wall times plus the final params as hex bytes, so the chaos tests
+    can assert the loss curve continued across a SIGKILL and the final
+    params are byte-identical to an uninterrupted run at the same data
+    order."""
+    import json
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.compute import (
+        ElasticTrainer,
+        TrainState,
+        build_train_step,
+    )
+    from tensorflowonspark_tpu.compute.mesh import shard_batch
+
+    loss_fn, tx, make_batch = _elastic_recipe()
+    trainer = ElasticTrainer(
+        ctx,
+        axis_shapes={"data": -1},
+        checkpoint_dir=args.get("model_dir"),
+    )
+    mesh = trainer.mesh()
+
+    start, hydrated_via = 0, "fresh"
+    state = None
+    if args.get("rejoin"):
+        step0, state = trainer.hydrate()
+        if state is not None:
+            start, hydrated_via = int(step0), "peer_or_checkpoint"
+    if state is None:
+        state = TrainState.create(
+            {"w": jnp.zeros(()), "b": jnp.zeros(())}, tx
+        )
+    step_fn = build_train_step(loss_fn, tx, mesh)
+
+    total = int(args["steps"])
+    losses, epochs, times = [], [], []
+    i = start
+    while i < total:
+        if trainer.changed():
+            state, mesh = trainer.reconfigure(state)
+            step_fn = build_train_step(loss_fn, tx, mesh)
+            if trainer.resume_step is not None:
+                # checkpoint fallback: rewind and replay the same data
+                # order from the restored step
+                i = trainer.resume_step
+        state, loss = step_fn(state, shard_batch(mesh, make_batch(i)))
+        losses.append(float(loss))
+        epochs.append(trainer.epoch)
+        times.append(time.time())
+        trainer.publish(state, i + 1)
+        if args.get("step_sleep"):
+            time.sleep(float(args["step_sleep"]))
+        i += 1
+
+    out = {
+        "start": start,
+        "hydrated_via": hydrated_via,
+        "losses": losses,
+        "epochs": epochs,
+        "t": times,
+        "final_epoch": trainer.epoch,
+        "roster_size": len(trainer.roster),
+        "mesh_devices": int(trainer.mesh().devices.size),
+        "params_hex": {
+            k: np.asarray(v).tobytes().hex()
+            for k, v in state.params.items()
+        },
+    }
+    with open(
+        os.path.join(args["out_dir"], f"node{ctx.executor_id}.json"), "w"
+    ) as f:
+        json.dump(out, f)
+
+
 def distributed_flaky_llama_fn(args, ctx):
     """Multi-controller FSDP under the restart supervisor: attempt 1
     trains 2 steps, saves COLLECTIVELY (every process writes its shards),

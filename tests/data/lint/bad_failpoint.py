@@ -1,6 +1,7 @@
 """Fixture: seeded FP001 violations — a dynamic failpoint site name and
-an unregistered literal (the typo that would make TFOS_FAILPOINTS
-silently no-op)."""
+unregistered literals (the typos that would make TFOS_FAILPOINTS
+silently no-op), including an elastic-plane typo; plus CLEAN registered
+elastic sites proving the rule's registry view includes them."""
 
 from tensorflowonspark_tpu.utils.failpoints import failpoint
 
@@ -13,3 +14,14 @@ def dynamic_site():
 
 def typo_site():
     failpoint("reservation.regster")  # SEEDED VIOLATION FP001: unregistered
+
+
+def elastic_typo_site():
+    failpoint("elastic.epoch_bmp")  # SEEDED VIOLATION FP001: unregistered
+
+
+def elastic_clean_sites():
+    # registered elastic sites: must NOT be flagged
+    failpoint("elastic.epoch_bump")
+    failpoint("elastic.reshard_gather")
+    failpoint("elastic.rejoin_init")

@@ -567,6 +567,176 @@ def test_wedged_node_detected_within_grace_mid_train(tmp_path):
         cluster.server.stop()
 
 
+def _wait_for(pred, timeout, what):
+    t0 = time.monotonic()
+    while not pred():
+        assert time.monotonic() - t0 < timeout, f"timed out waiting: {what}"
+        time.sleep(0.2)
+    return time.monotonic() - t0
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+def test_elastic_sigkill_reshards_without_restart(tmp_path):
+    """THE elastic acceptance (ISSUE 7): SIGKILL one node mid-train
+    under supervise() -> the survivor's loss curve continues within one
+    heartbeat grace window WITHOUT a full job restart (supervise
+    returns cleanly, the survivor's step sequence has no gap and no
+    checkpoint rewind), and its final params are byte-identical to an
+    uninterrupted run at the same data order."""
+    import json
+
+    from tensorflowonspark_tpu.cluster import tfcluster
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+    from tests import cluster_fns
+
+    steps = 150
+    args = {
+        "out_dir": str(tmp_path),
+        "steps": steps,
+        "step_sleep": 0.08,
+    }
+    cluster = tfcluster.run(
+        cluster_fns.elastic_train_fn,
+        args,
+        num_executors=2,
+        input_mode=InputMode.TENSORFLOW,
+        elastic=True,
+        reservation_timeout=120,
+        heartbeat_interval=0.5,
+        heartbeat_grace=3.0,
+        env=NODE_ENV,
+        flightrec_dir=str(tmp_path / "logs"),
+    )
+    try:
+        pid = _node_pid(cluster, 1)
+        kill_at = [0.0]
+
+        def kill():
+            time.sleep(2.0)
+            kill_at[0] = time.time()
+            os.kill(pid, signal.SIGKILL)
+
+        threading.Thread(target=kill, daemon=True).start()
+        # supervise() must RECONFIGURE, not raise — and return once the
+        # survivor finishes
+        cluster.supervise(poll=0.5)
+        assert cluster.membership_epoch() == 1
+        cluster.shutdown(timeout=120)
+    finally:
+        cluster.launcher.terminate()
+        cluster.server.stop()
+
+    out = json.load(open(tmp_path / "node0.json"))
+    # loss curve continued: every step ran exactly once, no restart gap
+    assert len(out["losses"]) == steps
+    assert out["start"] == 0
+    # the survivor actually resharded mid-run (epoch 0 -> 1), within
+    # one grace window (+ a beat + margin) of the kill
+    assert out["epochs"][0] == 0 and out["final_epoch"] == 1
+    first_e1 = next(i for i, e in enumerate(out["epochs"]) if e == 1)
+    assert out["t"][first_e1] - kill_at[0] < 20.0, (
+        "reshard landed too long after the kill"
+    )
+    # no stall beyond the grace window around the reconfigure
+    gaps = [b - a for a, b in zip(out["t"], out["t"][1:])]
+    assert max(gaps) < 15.0
+    # byte-identical final params vs the uninterrupted run at the same
+    # data order
+    assert out["params_hex"] == cluster_fns.elastic_reference_params(steps)
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+def test_elastic_shrink_then_grow_rejoins_and_reshards(tmp_path):
+    """Shrink-then-grow acceptance: after a SIGKILL departure, a
+    replacement node rejoins mid-run — hydrating from a surviving
+    peer's in-memory state, NOT a checkpoint — the mesh returns to its
+    original shape, cluster_membership_epoch reflects exactly two
+    bumps, and both reshards are visible in the driver's flight
+    recorder."""
+    import json
+
+    from tensorflowonspark_tpu.cluster import tfcluster
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+    from tests import cluster_fns
+
+    # Node 0 must still be mid-run when the replacement finishes booting
+    # (~10 s of interpreter + jax import on this host): ~25 s of steps.
+    steps = 250
+    args = {
+        "out_dir": str(tmp_path),
+        "steps": steps,
+        "step_sleep": 0.1,
+    }
+    cluster = tfcluster.run(
+        cluster_fns.elastic_train_fn,
+        args,
+        num_executors=2,
+        input_mode=InputMode.TENSORFLOW,
+        elastic=True,
+        reservation_timeout=120,
+        heartbeat_interval=0.5,
+        heartbeat_grace=3.0,
+        env=NODE_ENV,
+        flightrec_dir=str(tmp_path / "logs"),
+    )
+    sup_err: list[BaseException] = []
+
+    def supervise():
+        try:
+            cluster.supervise(poll=0.5)
+        except BaseException as e:  # noqa: BLE001 - asserted below
+            sup_err.append(e)
+
+    sup = threading.Thread(target=supervise, daemon=True)
+    sup.start()
+    try:
+        pid = _node_pid(cluster, 1)
+        time.sleep(2.0)
+        os.kill(pid, signal.SIGKILL)
+        _wait_for(
+            lambda: cluster.membership_epoch() >= 1, 25, "departure bump"
+        )
+        # a replacement for executor 1 rejoins the RUNNING cluster
+        cluster.launch_replacement(
+            1, cluster_fns.elastic_train_fn, {**args, "rejoin": True}
+        )
+        _wait_for(
+            lambda: cluster.membership_epoch() >= 2, 45, "join bump"
+        )
+        sup.join(timeout=180)
+        assert not sup.is_alive(), "supervise never returned"
+        assert not sup_err, sup_err
+        # exactly two bumps: one departure, one admission
+        assert cluster.membership_epoch() == 2
+        cluster.shutdown(timeout=120)
+    finally:
+        cluster.launcher.terminate()
+        for launcher in cluster._replacement_launchers:
+            launcher.terminate()
+        cluster.server.stop()
+
+    survivor = json.load(open(tmp_path / "node0.json"))
+    rejoined = json.load(open(tmp_path / "node1.json"))
+    # the replacement hydrated mid-run from the peer's in-memory state
+    assert rejoined["hydrated_via"] == "peer_or_checkpoint"
+    assert rejoined["start"] > 0
+    # the mesh returned to its original shape on both members
+    assert rejoined["mesh_devices"] == survivor["mesh_devices"]
+    assert rejoined["roster_size"] == 2
+    assert survivor["final_epoch"] == 2
+    # peer hydration + identical data order -> identical final params
+    assert rejoined["params_hex"] == survivor["params_hex"]
+    # both reshard decisions are in the driver flight recorder
+    fr = json.load(open(tmp_path / "logs" / "flightrec-driver.json"))
+    bumps = [
+        e for e in fr["events"] if e.get("kind") == "elastic_epoch_bump"
+    ]
+    assert [b["epoch"] for b in bumps] == [1, 2]
+    assert bumps[0]["departed"] == [1] and bumps[1]["joined"] == [1]
+
+
 @pytest.mark.slow
 @pytest.mark.e2e
 def test_supervise_detects_sigkill_within_grace(tmp_path):

@@ -1,0 +1,654 @@
+"""Elastic training plane: deterministic resharding, the membership
+epoch protocol, and the node-side reshard/epoch state machine.
+
+Tier-1 scope (fast, in-process): byte-identical N→N−1→N reshard round
+trips across dict/tuple pytrees and the FSDP/expert axis specs, the
+reservation server's epoch bump / remove / QEPOCH surface, elastic
+supervision's reconfigure decisions against a fake launcher, the
+membership watcher, ElasticTrainer reconfigure outcomes (resharded /
+checkpoint_fallback / failed), peer hydration, and the DataFeed replay
+cursor. The kill-a-real-node acceptance runs live in
+``tests/test_chaos.py`` (slow tier).
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensorflowonspark_tpu.cluster import manager as tf_manager
+from tensorflowonspark_tpu.cluster import reservation
+from tensorflowonspark_tpu.compute import elastic
+from tensorflowonspark_tpu.compute.elastic import (
+    ElasticTrainer,
+    host_snapshot,
+    reshard_state,
+)
+from tensorflowonspark_tpu.compute.mesh import fit_axis_shapes, make_mesh
+from tensorflowonspark_tpu.compute.train import (
+    TrainState,
+    fsdp_shardings,
+    state_shardings,
+)
+from tensorflowonspark_tpu.utils import failpoints as fp
+
+
+@pytest.fixture(autouse=True)
+def _clean_elastic_state():
+    elastic._watcher.reset()
+    fp.disarm_all()
+    yield
+    elastic._watcher.reset()
+    fp.disarm_all()
+
+
+def _leaf_hex(tree):
+    return [
+        np.asarray(x).tobytes().hex()
+        for x in jax.tree.leaves(jax.device_get(tree))
+    ]
+
+
+def _fsdp_state(params, mesh, tx):
+    psh = fsdp_shardings(params, mesh, min_shard_elements=1)
+    state = TrainState.create(params, tx)
+    shardings = state_shardings(state, mesh, psh)
+    return jax.tree.map(jax.device_put, state, shardings), shardings
+
+
+# ---------------------------------------------------------------------------
+# deterministic resharding: N -> N-1 -> N byte-identity
+# ---------------------------------------------------------------------------
+
+
+def _shardings1(state, mesh):
+    """default_shardings_fn with tiny-tensor sharding forced on (the
+    test tensors are far below the production min_shard_elements)."""
+    return state_shardings(
+        state, mesh, fsdp_shardings(state.params, mesh, min_shard_elements=1)
+    )
+
+
+def _roundtrip_states(params, tx, n_big=4, n_small=2):
+    """state on an n_big-device fsdp mesh -> reshard to n_small -> back;
+    returns (original, shrunk, restored, shrunk_mesh)."""
+    devices = jax.devices()
+    mesh_big = make_mesh({"fsdp": n_big}, devices=devices[:n_big])
+    mesh_small = make_mesh({"fsdp": n_small}, devices=devices[:n_small])
+    state, _ = _fsdp_state(params, mesh_big, tx)
+    shrunk = reshard_state(state, _shardings1(state, mesh_small))
+    restored = reshard_state(shrunk, _shardings1(shrunk, mesh_big))
+    return state, shrunk, restored, mesh_small
+
+
+def test_reshard_roundtrip_dict_pytree_byte_identical():
+    params = {
+        "w": jnp.arange(8 * 16, dtype=jnp.float32).reshape(8, 16),
+        "b": jnp.arange(16, dtype=jnp.float32),
+    }
+    state, shrunk, restored, mesh_small = _roundtrip_states(
+        params, optax.adamw(1e-2)
+    )
+    # params AND the full optimizer tree (Adam moments, counts): every
+    # leaf byte-identical after the shrink-grow round trip
+    assert _leaf_hex(state) == _leaf_hex(shrunk) == _leaf_hex(restored)
+    # and the shrunk state is GENUINELY resharded, not replicated: the
+    # big weight's sharded dim carries the fsdp axis on the small mesh
+    spec = shrunk.params["w"].sharding.spec
+    assert "fsdp" in [
+        ax for e in spec for ax in (e if isinstance(e, tuple) else (e,))
+    ]
+    assert shrunk.params["w"].sharding.mesh.shape["fsdp"] == 2
+
+
+def test_reshard_roundtrip_tuple_pytree_byte_identical():
+    params = (
+        jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4),
+        jnp.arange(32, dtype=jnp.bfloat16),
+    )
+    state, shrunk, restored, _ = _roundtrip_states(
+        params, optax.sgd(0.1, momentum=0.9)
+    )
+    assert _leaf_hex(state) == _leaf_hex(shrunk) == _leaf_hex(restored)
+
+
+def test_reshard_to_indivisible_count_falls_back_replicated():
+    """N→N−1 where N−1 divides nothing: fsdp_shardings' replication
+    fallback engages and the values still round-trip byte-identically
+    (reshard correctness must not depend on a friendly device count)."""
+    params = {"w": jnp.arange(8 * 16, dtype=jnp.float32).reshape(8, 16)}
+    state, shrunk, restored, _ = _roundtrip_states(
+        params, optax.adamw(1e-2), n_big=4, n_small=3
+    )
+    assert _leaf_hex(state) == _leaf_hex(shrunk) == _leaf_hex(restored)
+    assert shrunk.params["w"].sharding.is_fully_replicated
+
+
+def test_reshard_roundtrip_expert_axis_specs():
+    """The parallel/ axis specs survive resharding too: an MoE expert
+    bank sharded on the expert axis, shrunk and regrown."""
+    from tensorflowonspark_tpu.parallel import moe_param_shardings
+
+    devices = jax.devices()
+    mesh4 = make_mesh({"expert": 4}, devices=devices[:4])
+    mesh2 = make_mesh({"expert": 2}, devices=devices[:2])
+    params = {
+        "experts": {
+            "wi": jnp.arange(4 * 8 * 16, dtype=jnp.float32).reshape(
+                4, 8, 16
+            ),
+            "wo": jnp.arange(4 * 16 * 8, dtype=jnp.float32).reshape(
+                4, 16, 8
+            ),
+        },
+        "router": {"w": jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)},
+    }
+    placed = jax.tree.map(
+        jax.device_put, params, moe_param_shardings(params, mesh4)
+    )
+    shrunk = reshard_state(placed, moe_param_shardings(params, mesh2))
+    regrown = reshard_state(shrunk, moe_param_shardings(params, mesh4))
+    assert _leaf_hex(placed) == _leaf_hex(shrunk) == _leaf_hex(regrown)
+
+
+def test_fit_axis_shapes_rules():
+    # pinned specs: the elastic axis absorbs the change
+    assert fit_axis_shapes({"data": 2, "fsdp": 4}, 4) == {
+        "data": 2,
+        "fsdp": -1,
+    }
+    # a spec already deferring an axis keeps its own inference
+    assert fit_axis_shapes({"data": -1, "model": 2}, 8) == {
+        "data": -1,
+        "model": 2,
+    }
+    # default: everything on the elastic axis
+    assert fit_axis_shapes(None, 8) == {"fsdp": -1}
+    # impossible fits fail loudly, never pad
+    with pytest.raises(ValueError, match="cannot fit"):
+        fit_axis_shapes({"data": 3, "fsdp": 2}, 8)
+    with pytest.raises(ValueError, match="unknown elastic axis"):
+        fit_axis_shapes({"data": 2}, 8, elastic_axis="bogus")
+
+
+# ---------------------------------------------------------------------------
+# membership watcher
+# ---------------------------------------------------------------------------
+
+
+def test_membership_watcher_monotonic_and_waitable():
+    assert elastic.membership() == (0, None)
+    roster1 = [{"executor_id": 0}]
+    assert elastic.notify_membership(1, roster1)
+    assert elastic.membership() == (1, roster1)
+    # stale epochs are ignored once a roster exists
+    assert not elastic.notify_membership(1, [{"executor_id": 9}])
+    assert elastic.membership()[1] == roster1
+
+    waited = []
+    t = threading.Thread(
+        target=lambda: waited.append(elastic.wait_for_epoch(2, timeout=10)),
+        daemon=True,
+    )
+    t.start()
+    elastic.notify_membership(2, [{"executor_id": 0}, {"executor_id": 1}])
+    t.join(10)
+    assert waited == [True]
+    assert not elastic.wait_for_epoch(99, timeout=0.05)
+    # the epoch gauge tracks the watcher
+    from tensorflowonspark_tpu.obs.registry import default_registry
+
+    assert "cluster_membership_epoch 2" in default_registry().render()
+
+
+# ---------------------------------------------------------------------------
+# reservation epoch protocol (real sockets, no node processes)
+# ---------------------------------------------------------------------------
+
+
+def _meta(eid, port=1):
+    return {
+        "executor_id": eid,
+        "host": "127.0.0.1",
+        "port": port,
+        "job_name": "chief" if eid == 0 else "worker",
+        "task_index": max(0, eid - 1),
+        "addr": ["127.0.0.1", port],
+        "authkey": "00",
+    }
+
+
+def test_reservation_epoch_bump_remove_and_qepoch():
+    server = reservation.Server(2)
+    addr = server.start()
+    try:
+        client = reservation.Client(addr)
+        client.register(_meta(0))
+        client.register(_meta(1))
+        res = server.reservations
+        res.seal()
+        assert res.epoch() == 0
+        assert [m["executor_id"] for m in res.active()] == [0, 1]
+        assert res.pending_joins() == []
+        # heartbeat replies carry the epoch
+        assert client.heartbeat(0).get("epoch") == 0
+
+        # departure: remove + bump; the dead node leaves the liveness
+        # table too (it must not trip dead_nodes forever)
+        res.remove(1)
+        assert res.bump_epoch() == 1
+        assert [m["executor_id"] for m in res.active()] == [0]
+        assert 1 not in res.last_seen()
+        assert client.heartbeat(0).get("epoch") == 1
+
+        # a replacement re-registers mid-run: pending until admitted
+        client.register(_meta(1, port=2))
+        assert [m["executor_id"] for m in res.pending_joins()] == [1]
+        assert [m["executor_id"] for m in res.active()] == [0]
+        assert res.bump_epoch() == 2
+        info = client.membership()
+        assert info["epoch"] == 2
+        assert [m["executor_id"] for m in info["roster"]] == [0, 1]
+        # the readmitted entry is the NEW registration
+        assert info["roster"][1]["port"] == 2
+    finally:
+        server.stop()
+
+
+class _FakeLauncher:
+    """Process-table stand-in for driver-side supervision tests."""
+
+    def __init__(self, codes):
+        self.codes = list(codes)
+
+    def poll_failed(self):
+        return [
+            i for i, c in enumerate(self.codes) if c is not None and c != 0
+        ]
+
+    def exitcodes(self):
+        return list(self.codes)
+
+    def wait(self, timeout=None):
+        return True
+
+    def terminate(self):
+        pass
+
+
+def _elastic_cluster(server, addr, codes, min_nodes=1, grace=0.6):
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode, TFCluster
+
+    return TFCluster(
+        _FakeLauncher(codes),
+        server,
+        addr,
+        server.reservations.get(),
+        {
+            "heartbeat_interval": 0.2,
+            "heartbeat_grace": grace,
+            "elastic": True,
+            "elastic_min_nodes": min_nodes,
+            "metrics": False,
+        },
+        InputMode.TENSORFLOW,
+        ("input", "output", "error", "control"),
+    )
+
+
+def test_elastic_supervision_scan_departure_then_rejoin():
+    server = reservation.Server(2)
+    addr = server.start()
+    try:
+        client = reservation.Client(addr)
+        client.register(_meta(0))
+        client.register(_meta(1))
+        cluster = _elastic_cluster(server, addr, codes=[None, None])
+        res = server.reservations
+
+        # both beating: no membership change
+        res.heartbeat(0), res.heartbeat(1)
+        assert cluster._elastic_scan() is False
+        assert cluster.membership_epoch() == 0
+
+        # node 1 goes silent past the grace -> departure, epoch 1
+        deadline = time.monotonic() + 10
+        while cluster.membership_epoch() == 0:
+            res.heartbeat(0)
+            cluster._elastic_scan()
+            assert time.monotonic() < deadline, "no epoch bump"
+            time.sleep(0.1)
+        assert cluster.membership_epoch() == 1
+        assert [n["executor_id"] for n in cluster.cluster_info] == [0]
+        assert cluster._snapshot_departed() == {1}
+
+        # a replacement registers -> admitted, epoch 2
+        client.register(_meta(1, port=2))
+        res.heartbeat(1)
+        assert cluster._elastic_scan() is True
+        assert cluster.membership_epoch() == 2
+        assert [n["executor_id"] for n in cluster.cluster_info] == [0, 1]
+        assert cluster._snapshot_departed() == set()
+        # heartbeat replies now advertise epoch 2 to every node
+        assert client.heartbeat(0).get("epoch") == 2
+    finally:
+        server.stop()
+
+
+def test_elastic_supervision_min_nodes_gives_up():
+    server = reservation.Server(2)
+    addr = server.start()
+    try:
+        client = reservation.Client(addr)
+        client.register(_meta(0))
+        client.register(_meta(1))
+        cluster = _elastic_cluster(
+            server, addr, codes=[None, 137], min_nodes=2
+        )
+        server.reservations.heartbeat(0)
+        with pytest.raises(RuntimeError, match="elastic_min_nodes"):
+            cluster._elastic_scan()
+    finally:
+        server.stop()
+
+
+def test_launch_replacement_rejects_live_executor():
+    server = reservation.Server(1)
+    addr = server.start()
+    try:
+        reservation.Client(addr).register(_meta(0))
+        cluster = _elastic_cluster(server, addr, codes=[None])
+        with pytest.raises(ValueError, match="has not departed"):
+            cluster.launch_replacement(0, lambda a, c: None, {})
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# ElasticTrainer: reconfigure outcomes + hydration
+# ---------------------------------------------------------------------------
+
+
+class _FakeCtx:
+    distributed = False
+
+    def __init__(self, mgr=None, executor_id=0, cluster_info=()):
+        self.mgr = mgr
+        self.executor_id = executor_id
+        self.cluster_info = list(cluster_info)
+        self.reinit_calls = []
+
+    def reinitialize_distributed(self, roster):
+        self.reinit_calls.append(list(roster))
+
+
+def _recovery_count(outcome):
+    from tensorflowonspark_tpu.obs.registry import default_registry
+
+    for line in default_registry().render().splitlines():
+        if line.startswith("elastic_recoveries_total") and outcome in line:
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def _trainer_state(trainer, tx):
+    params = {
+        "w": jnp.arange(8 * 16, dtype=jnp.float32).reshape(8, 16),
+        "b": jnp.arange(16, dtype=jnp.float32),
+    }
+    mesh = trainer.mesh()
+    state = TrainState.create(params, tx)
+    return reshard_state(state, elastic.default_shardings_fn(state, mesh))
+
+
+def test_elastic_trainer_reconfigure_reshards_byte_identically():
+    roster2 = [_meta(0), _meta(1)]
+    ctx = _FakeCtx(cluster_info=roster2)
+    trainer = ElasticTrainer(
+        ctx,
+        axis_shapes={"fsdp": -1},
+        shardings_fn=lambda s, m: state_shardings(
+            s, m, fsdp_shardings(s.params, m, min_shard_elements=1)
+        ),
+        devices_fn=lambda roster: jax.devices()[: 2 * len(roster)],
+    )
+    tx = optax.adamw(1e-2)
+    state = _trainer_state(trainer, tx)
+    before = _leaf_hex(state)
+    assert trainer.mesh().devices.size == 4
+    assert not trainer.changed()
+
+    base = _recovery_count("resharded")
+    elastic.notify_membership(1, [_meta(0)])  # membership shrank
+    assert trainer.changed()
+    state, mesh = trainer.reconfigure(state)
+    assert trainer.epoch == 1
+    assert trainer.resume_step is None  # in-memory path: no rewind
+    assert mesh.devices.size == 2
+    assert ctx.reinit_calls and [
+        n["executor_id"] for n in ctx.reinit_calls[-1]
+    ] == [0]
+    assert _leaf_hex(state) == before
+    assert _recovery_count("resharded") == base + 1
+
+    # grow back: the mesh returns to its original shape, still identical
+    elastic.notify_membership(2, roster2)
+    state, mesh = trainer.reconfigure(state)
+    assert mesh.devices.size == 4
+    assert _leaf_hex(state) == before
+    # the reshard histogram saw both reconfigure rounds
+    from tensorflowonspark_tpu.obs.registry import default_registry
+
+    assert "elastic_reshard_seconds" in default_registry().render()
+
+
+def test_elastic_trainer_gather_failure_falls_back_to_checkpoint(tmp_path):
+    from tensorflowonspark_tpu.compute.checkpoint import CheckpointManager
+
+    ctx = _FakeCtx(cluster_info=[_meta(0)])
+    trainer = ElasticTrainer(
+        ctx,
+        axis_shapes={"fsdp": -1},
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        devices_fn=lambda roster: jax.devices()[:2],
+    )
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = _trainer_state(trainer, tx)
+    with CheckpointManager(str(tmp_path / "ckpt"), async_save=False) as ck:
+        ck.save(7, state, force=True)
+    before = _leaf_hex(state)
+
+    base = _recovery_count("checkpoint_fallback")
+    fp.arm("elastic.reshard_gather", "raise", count=1)
+    elastic.notify_membership(1, [_meta(0)])
+    state, _mesh = trainer.reconfigure(state)
+    assert _leaf_hex(state) == before  # restored the step-7 checkpoint
+    # the rewind contract: the loop must replay from the restored step
+    assert trainer.resume_step == 7
+    assert _recovery_count("checkpoint_fallback") == base + 1
+
+
+def test_elastic_trainer_removed_node_refuses_to_reconfigure():
+    """A survivor the driver (wrongly or deliberately) removed must not
+    keep training as a zombie: reconfigure onto a roster that excludes
+    it is a loud error — rejoin goes through registration."""
+    ctx = _FakeCtx(
+        executor_id=1, cluster_info=[_meta(0), _meta(1)]
+    )
+    trainer = ElasticTrainer(ctx, devices_fn=lambda r: jax.devices()[:2])
+    state = _trainer_state(trainer, optax.sgd(0.1))
+    elastic.notify_membership(1, [_meta(0)])  # roster without node 1
+    assert trainer.changed()  # it WAS a member: the bump concerns it
+    with pytest.raises(RuntimeError, match="was removed"):
+        trainer.reconfigure(state)
+
+
+def test_elastic_trainer_preadmission_bump_is_not_a_change():
+    """A freshly-registered joiner seeing the DEPARTURE bump (published
+    just before its own admission) must not reconfigure onto a roster
+    it is in neither side of — its admission bump follows."""
+    ctx = _FakeCtx(executor_id=2, cluster_info=[_meta(0)])
+    trainer = ElasticTrainer(ctx, devices_fn=lambda r: jax.devices()[:2])
+    elastic.notify_membership(1, [_meta(0)])  # joiner not in it
+    assert not trainer.changed()
+    # admission: now it's a change
+    elastic.notify_membership(2, [_meta(0), _meta(2)])
+    assert trainer.changed()
+
+
+def test_elastic_trainer_gather_failure_without_checkpoint_is_loud():
+    ctx = _FakeCtx(cluster_info=[_meta(0)])
+    trainer = ElasticTrainer(
+        ctx, devices_fn=lambda roster: jax.devices()[:2]
+    )
+    state = _trainer_state(trainer, optax.sgd(0.1))
+    fp.arm("elastic.reshard_gather", "raise", count=1)
+    elastic.notify_membership(1, [_meta(0)])
+    with pytest.raises(RuntimeError, match="no checkpoint_dir"):
+        trainer.reconfigure(state)
+    assert _recovery_count("failed") >= 1
+
+
+def test_elastic_hydrate_from_peer_and_fallbacks(tmp_path):
+    # peer node 0: a real (remote-mode) manager a joiner can dial
+    authkey = b"\x01" * 16
+    peer_mgr = tf_manager.start(authkey, mode="remote")
+    try:
+        peer_meta = {
+            **_meta(0),
+            "addr": list(peer_mgr.address),
+            "authkey": authkey.hex(),
+        }
+        peer_ctx = _FakeCtx(
+            mgr=peer_mgr, executor_id=0, cluster_info=[peer_meta]
+        )
+        publisher = ElasticTrainer(
+            peer_ctx, devices_fn=lambda r: jax.devices()[:2]
+        )
+        state = _trainer_state(publisher, optax.sgd(0.1, momentum=0.9))
+        publisher.publish(state, 42)
+
+        joiner = ElasticTrainer(
+            _FakeCtx(executor_id=1, cluster_info=[peer_meta]),
+            devices_fn=lambda r: jax.devices()[:2],
+        )
+        step, hydrated = joiner.hydrate()
+        assert step == 42
+        assert _leaf_hex(hydrated) == _leaf_hex(state)
+
+        # no peers reachable + no checkpoint -> fresh init
+        lonely = ElasticTrainer(
+            _FakeCtx(executor_id=1, cluster_info=[]),
+            devices_fn=lambda r: jax.devices()[:2],
+        )
+        assert lonely.hydrate(default="sentinel") == (None, "sentinel")
+
+        # no peers + a checkpoint -> checkpoint fallback
+        from tensorflowonspark_tpu.compute.checkpoint import (
+            CheckpointManager,
+        )
+
+        with CheckpointManager(
+            str(tmp_path / "ckpt"), async_save=False
+        ) as ck:
+            ck.save(5, host_snapshot(state), force=True)
+        fallback = ElasticTrainer(
+            _FakeCtx(executor_id=1, cluster_info=[]),
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            devices_fn=lambda r: jax.devices()[:2],
+        )
+        # the default pins the restore target's structure (a TrainState,
+        # not orbax's raw dict view)
+        step, hydrated = fallback.hydrate(default=host_snapshot(state))
+        assert step == 5
+        assert _leaf_hex(hydrated) == _leaf_hex(state)
+
+        # rejoin failpoint is armable (chaos surface)
+        fp.arm("elastic.rejoin_init", "raise", count=1)
+        with pytest.raises(fp.FailpointError):
+            joiner.hydrate()
+    finally:
+        peer_mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# DataFeed replay cursor (the PR-5 seq protocol as elastic replay)
+# ---------------------------------------------------------------------------
+
+
+def _feed_with_queue():
+    from tensorflowonspark_tpu.feed.datafeed import DataFeed
+
+    mgr = tf_manager.start(b"\x02" * 16, mode="local")
+    feed = DataFeed(mgr, input_mapping={"x": "x"})
+    return mgr, feed
+
+
+def _frame_chunk(stream, seq, values):
+    from tensorflowonspark_tpu.feed import columnar as col
+
+    ck = col.columnize_records([{"x": float(v)} for v in values])
+    data = col.frame_bytes(ck, qname="input", stream=stream, seq=seq)
+    return col.decode_frame(data, path="tcp")
+
+
+def test_datafeed_replay_duplicates_dropped_exactly_once():
+    mgr, feed = _feed_with_queue()
+    q = mgr.get_queue("input")
+    q.put(_frame_chunk("s1", 0, [0, 1]))
+    q.put(_frame_chunk("s1", 1, [2, 3]))
+    batch = feed.next_batch(4)
+    assert batch["x"].tolist() == [0.0, 1.0, 2.0, 3.0]
+    assert feed.cursor() == {"s1": 1}
+
+    # an elastic re-feed replays frame 1 then continues with 2: the
+    # duplicate drops silently; no gap error, no double-trained records
+    q.put(_frame_chunk("s1", 1, [2, 3]))
+    q.put(_frame_chunk("s1", 2, [4, 5]))
+    batch = feed.next_batch(2)
+    assert batch["x"].tolist() == [4.0, 5.0]
+    assert feed.cursor() == {"s1": 2}
+
+    # a FORWARD gap is still a hard error (a frame genuinely vanished)
+    q.put(_frame_chunk("s2", 0, [6, 6]))
+    q.put(_frame_chunk("s2", 2, [7, 7]))
+    with pytest.raises(RuntimeError, match="sequence gap"):
+        feed.next_batch(4)
+
+
+def test_feed_partition_refeed_same_stream_exactly_once():
+    """The end-to-end replay contract: a driver re-feeding a partition
+    a consumer PARTIALLY saw (its first feed attempt died mid-stream)
+    passes the original stream id + chunk size to feed_partition, and
+    the consumer's cursor drops the already-consumed prefix — every
+    record trains exactly once."""
+    from tensorflowonspark_tpu.cluster.node import feed_partition
+
+    mgr, feed = _feed_with_queue()
+    q = mgr.get_queue("input")
+    part = [{"x": float(i)} for i in range(6)]
+    # first attempt dies after shipping frames 0 and 1 (no EndPartition)
+    q.put(_frame_chunk("p0", 0, [0, 1]))
+    q.put(_frame_chunk("p0", 1, [2, 3]))
+    assert feed.next_batch(4)["x"].tolist() == [0.0, 1.0, 2.0, 3.0]
+    assert feed.cursor() == {"p0": 1}
+
+    # the re-feed replays the WHOLE partition under the same stream id
+    # and chunking: frames 0/1 drop as duplicates, frame 2 is new
+    fed = feed_partition(mgr, part, qname="input", chunk=2, stream="p0")
+    assert fed == 6
+    assert feed.next_batch(6)["x"].tolist() == [4.0, 5.0]
+
+
+def test_datafeed_seed_cursor_skips_consumed_prefix():
+    mgr, feed = _feed_with_queue()
+    feed.seed_cursor({"s1": 1})  # a rejoiner resuming past frame 1
+    q = mgr.get_queue("input")
+    for seq, vals in ((0, [0, 1]), (1, [2, 3]), (2, [4, 5])):
+        q.put(_frame_chunk("s1", seq, vals))
+    batch = feed.next_batch(2)
+    assert batch["x"].tolist() == [4.0, 5.0]

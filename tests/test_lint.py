@@ -167,10 +167,19 @@ def test_failpoint_rule_reports_seeded_violations(fixture_findings):
     assert {f.line for f in hits} == {
         _line_of("bad_failpoint.py", "failpoint(SITE)"),
         _line_of("bad_failpoint.py", "reservation.regster"),
+        _line_of("bad_failpoint.py", "elastic.epoch_bmp"),
     }, [f.render() for f in hits]
     dynamic = [f for f in hits if "string literal" in f.message]
     unregistered = [f for f in hits if "not registered" in f.message]
-    assert len(dynamic) == 1 and len(unregistered) == 1
+    assert len(dynamic) == 1 and len(unregistered) == 2
+    # the REGISTERED elastic sites are in the rule's registry view:
+    # the fixture's clean elastic.* literals produced no findings
+    clean_lines = {
+        _line_of("bad_failpoint.py", '"elastic.epoch_bump"'),
+        _line_of("bad_failpoint.py", '"elastic.reshard_gather"'),
+        _line_of("bad_failpoint.py", '"elastic.rejoin_init"'),
+    }
+    assert not clean_lines & {f.line for f in hits}
 
 
 def test_obs_metric_rule_reports_seeded_violations(fixture_findings):

@@ -18,10 +18,16 @@ Usage (from the repo root)::
     python tools/run_tier1.py                   # full tier-1, ~15-25 min
     python tools/run_tier1.py tests/test_obs.py tests/test_columnar.py
     python tools/run_tier1.py --write-baseline  # refresh the baseline
+    python tools/run_tier1.py --slow            # the slow tier (below)
 
 Flags mirror the ROADMAP command: ``-m 'not slow'``,
 ``--continue-on-collection-errors``, cache/xdist/randomly plugins off,
 ``JAX_PLATFORMS=cpu`` in the child env.
+
+``--slow`` runs the slow tier instead: the ``-m slow`` tests of the
+chaos/elastic e2e suites plus the ASan/TSAN native stress suites
+(:data:`SLOW_SUITES`), per-suite process isolation as above. The slow
+tier has no baseline — any failure fails the run.
 """
 
 from __future__ import annotations
@@ -38,6 +44,18 @@ import time
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = os.path.join("tools", "tier1_baseline.json")
 DEFAULT_TIMEOUT = 420.0  # per suite; the slowest tier-1 suite is ~3 min
+
+# The slow tier: suites carrying @pytest.mark.slow tests worth a
+# scheduled (not per-commit) run — chaos/elastic kill-a-real-node e2e
+# alongside the native sanitizer stress suites.
+SLOW_SUITES = [
+    "tests/test_chaos.py",
+    "tests/test_elastic.py",
+    "tests/test_engine_pipeline.py",
+    "tests/test_native_asan.py",
+    "tests/test_native_tsan.py",
+]
+SLOW_TIMEOUT = 900.0
 
 _FAIL_RE = re.compile(r"^(?:FAILED|ERROR)\s+(\S+)")
 
@@ -60,7 +78,7 @@ def parse_failures(output: str) -> list[str]:
     return sorted(set(out))
 
 
-def run_suite(path: str, timeout: float) -> dict:
+def run_suite(path: str, timeout: float, marker: str = "not slow") -> dict:
     """One suite in its own pytest process. A timeout (or a crashed
     interpreter with unparsable output) fails the WHOLE suite under a
     synthetic ``<path>::<marker>`` id so the diff stays set-shaped."""
@@ -73,7 +91,7 @@ def run_suite(path: str, timeout: float) -> dict:
         "-rf",
         "--tb=line",
         "-m",
-        "not slow",
+        marker,
         "--continue-on-collection-errors",
         "-p",
         "no:cacheprovider",
@@ -161,11 +179,23 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="record the current failure set as the baseline and exit 0",
     )
+    ap.add_argument(
+        "--slow",
+        action="store_true",
+        help="run the slow tier (-m slow over SLOW_SUITES; no baseline)",
+    )
     args = ap.parse_args(argv)
+
+    if args.slow and args.write_baseline:
+        ap.error("--slow has no baseline to write")
 
     suites = [
         s.replace(os.sep, "/") for s in args.suites
-    ] or discover(os.path.join(REPO_ROOT, "tests"))
+    ] or (
+        list(SLOW_SUITES)
+        if args.slow
+        else discover(os.path.join(REPO_ROOT, "tests"))
+    )
     if not suites:
         print("run_tier1: no suites found", file=sys.stderr)
         return 2
@@ -176,10 +206,16 @@ def main(argv: list[str] | None = None) -> int:
         else os.path.join(REPO_ROOT, args.baseline)
     )
 
+    marker = "slow" if args.slow else "not slow"
+    timeout = (
+        args.timeout
+        if args.timeout != DEFAULT_TIMEOUT or not args.slow
+        else SLOW_TIMEOUT
+    )
     all_failed: set[str] = set()
     t0 = time.monotonic()
     for i, suite in enumerate(suites, 1):
-        res = run_suite(suite, args.timeout)
+        res = run_suite(suite, timeout, marker=marker)
         status = (
             "TIMEOUT"
             if res["timed_out"]
@@ -202,6 +238,17 @@ def main(argv: list[str] | None = None) -> int:
             f"{os.path.relpath(baseline_path, REPO_ROOT)}"
         )
         return 0
+
+    if args.slow:
+        # No baseline in the slow tier: it runs scheduled, not
+        # per-commit, and every failure is actionable.
+        print(
+            f"\nrun_tier1 --slow: {len(suites)} suite(s) in {total_s}s — "
+            f"{len(all_failed)} failure(s)"
+        )
+        for f in sorted(all_failed):
+            print(f"  FAIL  {f}")
+        return 1 if all_failed else 0
 
     baseline = load_baseline(baseline_path)
     if args.suites:
