@@ -85,6 +85,36 @@ class TFNodeContext:
             worker_index=self.executor_id,
         )
 
+    def get_ingest_feed(
+        self,
+        input_mapping: dict[str, str] | None = None,
+        reader=None,
+        timeout: float = 600.0,
+        **kwargs,
+    ):
+        """The pull plane's feed (``InputMode.TENSORFLOW`` default):
+        block for this node's driver-published shard plan
+        (``TFCluster.assign_shards``) and return an
+        :class:`~tensorflowonspark_tpu.feed.ingest.IngestFeed` reading
+        the shard executor-locally — same ``next_batch``/
+        ``batch_stream``/``DevicePrefetcher.from_feed`` surface as
+        :meth:`get_data_feed`, no driver in the data loop. ``reader``
+        overrides manifest expansion (custom formats); extra kwargs
+        reach the ``IngestFeed`` constructor (``records_per_chunk``,
+        ``retry``)."""
+        from tensorflowonspark_tpu.cluster.node import fetch_ingest_plan
+        from tensorflowonspark_tpu.feed.ingest import IngestFeed
+
+        plan = fetch_ingest_plan(self.mgr, timeout=timeout)
+        return IngestFeed(
+            plan["manifests"],
+            input_mapping=input_mapping,
+            reader=reader,
+            plan_epoch=int(plan.get("epoch", 0)),
+            worker_index=self.executor_id,
+            **kwargs,
+        )
+
     # --- paths ----------------------------------------------------------
     def absolute_path(self, path: str) -> str:
         """Resolve a user path against default_fs / working_dir.

@@ -542,6 +542,64 @@ def connect_manager(node: dict[str, Any]) -> tf_manager.ManagerHandle:
     return tf_manager.connect(node["addr"], bytes.fromhex(node["authkey"]))
 
 
+# Manager KV key carrying a node's pull-plane shard assignment
+# (TFCluster.assign_shards publishes it; fetch_ingest_plan probes it).
+INGEST_PLAN_KEY = "ingest_plan"
+
+
+def publish_ingest_plan(
+    mgr: tf_manager.ManagerHandle,
+    manifests,
+    epoch: int = 0,
+    shard_index: int = 0,
+    num_shards: int = 1,
+    plan_id: str | None = None,
+) -> None:
+    """Driver side of the pull-plane handshake: publish one node's
+    shard plan to its manager KV. THE owner of the plan's wire shape —
+    `TFCluster._publish_ingest_plan` and the feed-plane bench's
+    staggered mode both go through here, so the dict
+    :func:`fetch_ingest_plan` returns cannot fork between producers."""
+    mgr.set(
+        INGEST_PLAN_KEY,
+        {
+            "epoch": int(epoch),
+            "plan_id": plan_id,
+            "shard_index": int(shard_index),
+            "num_shards": int(num_shards),
+            "manifests": list(manifests),
+        },
+    )
+
+
+def fetch_ingest_plan(
+    mgr: tf_manager.ManagerHandle, timeout: float = 600.0, poll: float = 0.25
+) -> dict[str, Any]:
+    """Node side of the pull plane's control handshake: block until the
+    driver publishes this node's shard plan (``TFCluster.assign_shards``
+    — a dict of manifests + epoch, O(files) bytes, the ONLY thing that
+    crosses the driver on the pull plane) and return it.
+
+    Probed rather than pushed: ``map_fun`` typically asks for its feed
+    before the driver has planned shards, exactly like the feed-timeout
+    KV. Raises TimeoutError after ``timeout`` seconds — an ingest
+    consumer on a cluster whose driver never planned shards is a
+    programming error that must not block forever.
+    """
+    failpoint("ingest.manifest_fetch")
+    deadline = time.monotonic() + timeout
+    while True:
+        plan = mgr.get(INGEST_PLAN_KEY)
+        if plan is not None:
+            return plan
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"no ingest plan published within {timeout}s — did the "
+                "driver call TFCluster.assign_shards()?"
+            )
+        time.sleep(poll)
+
+
 def feed_partition(
     mgr: tf_manager.ManagerHandle,
     partition,

@@ -88,6 +88,10 @@ class TFCluster:
         # The env run() launched nodes with — replacements must boot
         # with the same one (run() fills this in).
         self._node_env: dict[str, str] = {}
+        # Pull-plane shard map (assign_shards): executor id -> manifest
+        # list, STABLE after assignment so an elastic reconfigure can
+        # re-publish without ever moving a shard between nodes.
+        self._ingest_shards: dict[int, list[Any]] | None = None
         # -- cluster observability plane (obs.cluster; docs/OBSERVABILITY.md)
         # Liveness surfaced in the registry: per-executor heartbeat age
         # as a render-time collector (PR 4's plane was invisible to
@@ -765,6 +769,86 @@ class TFCluster:
         self._check_errors()
 
     # ------------------------------------------------------------------
+    # pull plane (driverless sharded ingestion — feed/ingest.py)
+    def assign_shards(self, manifests: Iterable[Any]) -> None:
+        """Plan and publish the pull plane's shard assignment
+        (``InputMode.TENSORFLOW`` only): ``manifests`` (typically
+        :class:`~tensorflowonspark_tpu.feed.manifest.FileManifest`
+        records — a path and a format, O(files) driver bytes) are
+        round-robin split across the workers
+        (``feed.manifest.plan_manifests``) and each worker's shard is
+        published to its manager KV. Nodes consume via
+        ``ctx.get_ingest_feed()`` — the driver never touches the data
+        again. Use ``feed.manifest.split_manifest`` first when one
+        large file must feed many nodes.
+
+        Assignment is computed ONCE, over the workers at assign time,
+        and is then **stable per executor id**: an elastic reconfigure
+        re-publishes each active executor's ORIGINAL shard — a
+        replacement for executor *k* (``launch_replacement`` reuses the
+        id) fetches *k*'s shard and seeds its predecessor's persisted
+        replay cursor (``IngestFeed.seed_cursor``) for an exactly-once
+        handover. Shards are never re-split between live nodes, so a
+        survivor mid-drain and a rejoiner can never hold overlapping
+        records. A shard whose executor id has no active owner is
+        logged loudly as UNREAD — a permanent shrink needs a fresh
+        ``assign_shards`` (new streams, new cursors), not a silent
+        re-plan under running consumers.
+        """
+        if self.input_mode != InputMode.TENSORFLOW:
+            raise RuntimeError(
+                "assign_shards() requires InputMode.TENSORFLOW — in "
+                "InputMode.SPARK the driver pushes records itself "
+                "(use train(), or ManifestFeed for node-local reads)"
+            )
+        from tensorflowonspark_tpu.feed.manifest import plan_manifests
+
+        workers = self.workers
+        shards = plan_manifests(list(manifests), len(workers))
+        self._ingest_shards = {
+            w["executor_id"]: shard for w, shard in zip(workers, shards)
+        }
+        self._publish_ingest_plan()
+
+    def _publish_ingest_plan(self) -> None:
+        workers = self.workers
+        epoch = self.membership_epoch()
+        for w in workers:
+            eid = w["executor_id"]
+            tfnode_runtime.publish_ingest_plan(
+                tfnode_runtime.connect_manager(w),
+                self._ingest_shards.get(eid, []),
+                epoch=epoch,
+                shard_index=eid,
+                num_shards=len(self._ingest_shards),
+                plan_id=self.cluster_meta.get("id"),
+            )
+        unowned = sorted(
+            set(self._ingest_shards)
+            - {w["executor_id"] for w in workers}
+        )
+        if unowned:
+            logger.warning(
+                "ingest: shard(s) of departed executor(s) %s have no "
+                "active owner — their manifests are UNREAD until a "
+                "replacement with the same id rejoins",
+                unowned,
+            )
+        flightrec.note(
+            "ingest_plan",
+            epoch=epoch,
+            shards={k: len(v) for k, v in self._ingest_shards.items()},
+            unowned=unowned,
+        )
+        logger.info(
+            "ingest plan published: %d shard(s) over %d worker(s) "
+            "(epoch %d)",
+            len(self._ingest_shards),
+            len(workers),
+            epoch,
+        )
+
+    # ------------------------------------------------------------------
     def membership_epoch(self) -> int:
         """The current membership epoch (0 = the startup roster; bumped
         once per reconfigure — see :meth:`supervise` elastic mode)."""
@@ -840,6 +924,22 @@ class TFCluster:
             sorted(m["executor_id"] for m in joined),
             len(self.cluster_info),
         )
+        # Re-publish the pull plane's (stable, per-executor-id) shard
+        # plans: survivors' plans are unchanged by construction, and a
+        # just-admitted replacement's fresh manager gets its
+        # predecessor's shard. Best-effort — a mid-loop failure is
+        # harmless because no plan CONTENT ever changes, only the
+        # epoch stamp.
+        if self._ingest_shards is not None:
+            try:
+                self._publish_ingest_plan()
+            except (ConnectionError, OSError, EOFError) as e:
+                logger.warning(
+                    "elastic: ingest plan re-publish failed (%s); "
+                    "a rejoining node must wait for the next "
+                    "reconfigure to fetch its shard",
+                    e,
+                )
         return epoch
 
     def _elastic_scan(self) -> bool:

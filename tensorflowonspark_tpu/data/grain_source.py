@@ -148,6 +148,106 @@ class TFRecordDataSource:
                 pass
 
 
+class ColumnarFrameDataSource:
+    """grain ``RandomAccessDataSource`` over columnar frame files (the
+    pull plane's on-disk wire format, ``feed.columnar.write_frames``).
+
+    The index is one header-only scan per file (``columnar.scan_frames``
+    — payload bytes untouched), mapping every record to its owning
+    frame; ``__getitem__`` decodes that frame lazily into zero-copy
+    views over a shared per-file mmap (a tiny LRU of decoded frames
+    absorbs a sampler's locality) and returns the record in its row
+    shape. This is the random-access tier of executor-local ingestion:
+    grain's samplers own sharding/shuffling/resume, while sequential
+    shard drains go through ``feed.ingest.IngestFeed``.
+    """
+
+    _CACHE_FRAMES = 4
+
+    def __init__(self, paths: "str | Sequence[str]"):
+        import glob
+
+        if isinstance(paths, str):
+            if os.path.isdir(paths):
+                files = sorted(glob.glob(os.path.join(paths, "*")))
+            else:
+                files = [paths]
+        else:
+            files = list(paths)
+        if not files:
+            raise ValueError(f"no columnar frame files under {paths!r}")
+        from tensorflowonspark_tpu.feed.columnar import scan_frames
+
+        self._files = files
+        # (file_idx, byte_offset, first_record_index) per frame; the
+        # parallel _starts list serves bisect.
+        self._frames: list[tuple[int, int, int]] = []
+        self._starts: list[int] = []
+        total = 0
+        for fi, path in enumerate(files):
+            for off, _span, n in scan_frames(path):
+                if n == 0:
+                    continue
+                self._frames.append((fi, off, total))
+                self._starts.append(total)
+                total += n
+        self._total = total
+        self._mmaps: dict[int, Any] = {}
+        self._cache: dict[tuple[int, int], Any] = {}  # (fi, off) -> chunk
+
+    def __getstate__(self):
+        # grain worker processes pickle the source: mmaps and decoded
+        # views are process-local, workers re-open lazily.
+        state = self.__dict__.copy()
+        state["_mmaps"] = {}
+        state["_cache"] = {}
+        return state
+
+    def __len__(self) -> int:
+        return self._total
+
+    def _mmap(self, fi: int):
+        mm = self._mmaps.get(fi)
+        if mm is None:
+            import mmap as _mmap
+
+            with open(self._files[fi], "rb") as f:
+                new = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+            # racing first-touchers must keep exactly one mapping
+            mm = self._mmaps.setdefault(fi, new)
+            if mm is not new:
+                new.close()
+        return mm
+
+    def _chunk(self, fi: int, off: int):
+        key = (fi, off)
+        chunk = self._cache.get(key)
+        if chunk is None:
+            from tensorflowonspark_tpu.feed.columnar import decode_frame
+
+            chunk = decode_frame(memoryview(self._mmap(fi))[off:])
+            if len(self._cache) >= self._CACHE_FRAMES:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[key] = chunk
+        return chunk
+
+    def __getitem__(self, index: int):
+        import bisect
+
+        if not 0 <= index < self._total:
+            raise IndexError(index)
+        fidx = bisect.bisect_right(self._starts, index) - 1
+        fi, off, start = self._frames[fidx]
+        return self._chunk(fi, off).view(index - start, index - start + 1).rows()[0]
+
+    def __del__(self):  # pragma: no cover - best-effort cleanup
+        for mm in getattr(self, "_mmaps", {}).values():
+            try:
+                mm.close()
+            except (BufferError, OSError):
+                pass  # live views pin the mapping; GC releases it later
+
+
 def grain_loader(
     input_dir: str,
     *,

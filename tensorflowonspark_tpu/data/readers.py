@@ -17,7 +17,14 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["sharded_rows", "shuffled", "repeated", "column_batches"]
+__all__ = [
+    "columnar_pieces",
+    "sharded_chunks",
+    "sharded_rows",
+    "shuffled",
+    "repeated",
+    "column_batches",
+]
 
 
 def sharded_rows(
@@ -43,6 +50,62 @@ def sharded_rows(
             if i % num_shards == shard_index:
                 yield dfutil.fromTFExample(serialized, binary_features)
             i += 1
+
+
+def columnar_pieces(
+    rows: Iterable[Any], records_per_chunk: int = 1024
+) -> Iterator[Any]:
+    """Group a row stream into ``ColumnChunk`` pieces — the
+    executor-local half of the driver feeder's per-chunk columnization
+    (``cluster/node.py feed_partition``), run where the data lives.
+
+    Each block of ``records_per_chunk`` rows is columnized ONCE into
+    per-field contiguous buffers; blocks that cannot columnize
+    losslessly (ragged/object/mixed records — the same matrix as the
+    push wire) are yielded as plain row lists, so downstream assembly
+    (``ColumnAssembler``) handles both shapes exactly as it does wire
+    pieces. Block boundaries are deterministic for a given
+    ``records_per_chunk``: the pull plane's replay cursor counts these
+    blocks, and a restarted reader must re-derive identical ordinals.
+    """
+    from tensorflowonspark_tpu.feed.columnar import columnize_records
+
+    if records_per_chunk < 1:
+        raise ValueError(
+            f"records_per_chunk must be >= 1, got {records_per_chunk}"
+        )
+
+    def flush(buf: list[Any]):
+        chunk = columnize_records(buf)
+        return buf if chunk is None else chunk
+
+    buf: list[Any] = []
+    for row in rows:
+        buf.append(row)
+        if len(buf) >= records_per_chunk:
+            yield flush(buf)
+            buf = []
+    if buf:
+        yield flush(buf)
+
+
+def sharded_chunks(
+    input_dir: str,
+    shard_index: int = 0,
+    num_shards: int = 1,
+    records_per_chunk: int = 1024,
+    binary_features: Sequence[str] = (),
+) -> Iterator[Any]:
+    """This shard's records of a TFRecord directory as ``ColumnChunk``
+    pieces — :func:`sharded_rows` (serialized-index sharding, no decode
+    of unowned records) composed with :func:`columnar_pieces`, so an
+    ``InputMode.TENSORFLOW`` node feeds the slice-not-stack batch
+    assembly (``ColumnAssembler`` / ``DevicePrefetcher.from_feed``)
+    directly from local TFRecord shards with no driver in the loop."""
+    yield from columnar_pieces(
+        sharded_rows(input_dir, shard_index, num_shards, binary_features),
+        records_per_chunk,
+    )
 
 
 def shuffled(

@@ -1,13 +1,23 @@
-"""The push data plane: host-side queues into the training loop.
+"""The data planes: host-side queues (push) and executor-local sharded
+readers (pull) into the training loop.
 
 Reference parity: the ``DataFeed`` class of ``tensorflowonspark/TFNode.py``
 plus the queue sentinels of ``marker.py``. ``DevicePrefetcher`` extends
 the plane one hop further than the reference could: host batch ->
-device, overlapped with the training step.
+device, overlapped with the training step. ``IngestFeed`` restores the
+reference's executor-local-feed property for ``InputMode.TENSORFLOW``:
+the driver ships manifests, nodes read their own shards (``ingest.py``).
 """
 
 from tensorflowonspark_tpu.feed.datafeed import DataFeed
+from tensorflowonspark_tpu.feed.ingest import IngestFeed
 from tensorflowonspark_tpu.feed.manifest import FileManifest, ManifestFeed
 from tensorflowonspark_tpu.feed.prefetch import DevicePrefetcher
 
-__all__ = ["DataFeed", "DevicePrefetcher", "FileManifest", "ManifestFeed"]
+__all__ = [
+    "DataFeed",
+    "DevicePrefetcher",
+    "FileManifest",
+    "IngestFeed",
+    "ManifestFeed",
+]

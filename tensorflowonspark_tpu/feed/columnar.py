@@ -81,6 +81,7 @@ __all__ = [
     "frame_bytes",
     "is_frame",
     "read_frames",
+    "scan_frames",
     "write_frames",
 ]
 
@@ -523,10 +524,10 @@ def decode_frame(buf, path: str | None = None) -> ColumnChunk:
     return chunk
 
 
-def frame_span(buf, offset: int = 0) -> int:
-    """Total byte length of the frame starting at ``offset`` in ``buf``
-    (header + aligned payload) — the file reader's framing step."""
-    mv = memoryview(buf)
+def _frame_header(mv, offset: int = 0) -> tuple[dict, int]:
+    """(header dict, frame span) at ``offset`` — header bytes only, no
+    payload read. Shared by the file reader's framing step and the
+    header-only scans below."""
     _, hlen, _ = _PREFIX.unpack_from(mv, offset)
     header_bytes = bytes(
         mv[offset + _PREFIX.size : offset + _PREFIX.size + hlen]
@@ -535,7 +536,36 @@ def frame_span(buf, offset: int = 0) -> int:
     payload = 0
     for _, _, _, off, nb in h["cols"]:
         payload = max(payload, _align(off + nb))
-    return _align(_PREFIX.size + hlen) + payload
+    return h, _align(_PREFIX.size + hlen) + payload
+
+
+def frame_span(buf, offset: int = 0) -> int:
+    """Total byte length of the frame starting at ``offset`` in ``buf``
+    (header + aligned payload) — the file reader's framing step."""
+    return _frame_header(memoryview(buf), offset)[1]
+
+
+def scan_frames(path: str) -> Iterator[tuple[int, int, int]]:
+    """``(byte_offset, span, record_count)`` of each frame in a framed
+    file, via header-only reads — payload bytes are never touched. This
+    is the cheap size probe behind manifest planning
+    (``feed.manifest.manifest_records`` / ``split_manifest``) and the
+    random-access frame index (``data.grain_source``): splitting a
+    multi-GB shard file across nodes costs one metadata pass, not a
+    full read."""
+    import mmap as _mmap
+
+    with open(path, "rb") as f:
+        size = os.fstat(f.fileno()).st_size
+        if size == 0:
+            return
+        mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+    mv = memoryview(mm)
+    off = 0
+    while off + _PREFIX.size <= size:
+        h, span = _frame_header(mv, off)
+        yield off, span, int(h.get("n", 0))
+        off += _align(span)
 
 
 # -- framed files (manifest path) --------------------------------------------

@@ -49,6 +49,68 @@ def _replay_counter():
         "replayed duplicate frames dropped by the seq cursor, by queue",
     )
 
+class ReplayCursor:
+    """Per-stream frame/chunk sequence cursor — THE exactly-once and
+    ordering primitive both data planes share.
+
+    Producers stamp every columnar piece of one logical stream with a
+    monotonic ``seq`` (the push wire's frame header, the pull plane's
+    block ordinal). :meth:`check` resolves each arriving ``(stream,
+    seq)`` into one of three verdicts: the expected seq advances the
+    cursor (accept); a seq *behind* the cursor is a replayed duplicate
+    — an elastic re-feed, a restarted executor-local reader, a
+    retried shard read — and is dropped (``on_drop`` hook fires),
+    giving exactly-once consumption through any replay; a seq *ahead*
+    of the cursor means a piece was lost mid-stream and records
+    silently vanished — raise instead of training on a hole.
+
+    :meth:`snapshot`/:meth:`seed` make the cursor durable: a consumer
+    checkpoints it beside its train state, and a successor (restart,
+    relaunch, elastic rejoin) seeds a fresh cursor so the
+    already-consumed prefix drops silently on replay.
+    """
+
+    __slots__ = ("name", "_state", "_on_drop")
+
+    def __init__(self, name: str = "", on_drop=None):
+        self.name = name
+        self._state: dict[str, int] = {}
+        self._on_drop = on_drop
+
+    def check(self, stream: str | None, seq: int) -> bool:
+        """True to accept, False to drop a replayed duplicate; raises
+        RuntimeError on a forward gap (a lost piece)."""
+        if stream is None:
+            return True
+        last = self._state.get(stream)
+        expected = 0 if last is None else last + 1
+        if seq == expected:
+            self._state[stream] = seq
+            return True
+        if seq < expected:
+            if self._on_drop is not None:
+                self._on_drop(stream)
+            return False
+        raise RuntimeError(
+            f"columnar frame sequence gap on {self.name or 'stream'} "
+            f"stream {stream}: expected frame {expected}, got "
+            f"{seq} — a frame was dropped mid-stream"
+        )
+
+    def snapshot(self) -> dict[str, int]:
+        """Last accepted ``seq`` per live stream."""
+        return dict(self._state)
+
+    def seed(self, cursor: dict[str, int]) -> None:
+        """Adopt a snapshot: pieces at or below each stream's seeded
+        seq are treated as replayed duplicates, not gaps."""
+        for stream, seq in cursor.items():
+            self._state[str(stream)] = int(seq)
+
+    def clear(self) -> None:
+        self._state.clear()
+
+
 class FeedTimeout(TimeoutError):
     """The input queue produced nothing for the whole feed-timeout
     window: the producer (driver feeder thread) stalled or died. Raised
@@ -150,7 +212,10 @@ class DataFeed:
         self._assembler = (
             ColumnAssembler(input_mapping) if input_mapping else None
         )
-        self._seq_state: dict[str, int] = {}
+        self._seq = ReplayCursor(
+            name=f"queue {qname_in!r}",
+            on_drop=lambda _stream: _replay_counter().inc(queue=qname_in),
+        )
 
     def next_batch(self, batch_size: int) -> list | dict[str, np.ndarray]:
         """Return up to ``batch_size`` records.
@@ -175,33 +240,15 @@ class DataFeed:
 
     def _check_seq(self, chunk: ColumnChunk) -> bool:
         """Frame-drop detection AND replay dedupe — the per-stream
-        seq protocol doubles as the elastic plane's replay cursor.
-
-        Frames of one producer stream carry a monotonic ``seq``. Three
-        cases: the expected seq advances the cursor (accept); a seq
-        BEHIND the cursor is a replayed duplicate — an elastic
-        reconfigure re-feeding a stream a consumer partially saw, or a
-        rejoiner seeded via :meth:`seed_cursor` — and is dropped
-        (counted in ``feed_replay_skipped_total``), giving exactly-once
-        consumption through a re-feed; a seq AHEAD of the cursor means
-        a frame was lost mid-stream (see the ``columnar.frame``
-        failpoint) and records silently vanished — raise instead of
-        training on a hole."""
-        if chunk.stream is None:
-            return True
-        last = self._seq_state.get(chunk.stream)
-        expected = 0 if last is None else last + 1
-        if chunk.seq == expected:
-            self._seq_state[chunk.stream] = chunk.seq
-            return True
-        if chunk.seq < expected:
-            _replay_counter().inc(queue=self.qname_in)
-            return False
-        raise RuntimeError(
-            f"columnar frame sequence gap on queue {self.qname_in!r} "
-            f"stream {chunk.stream}: expected frame {expected}, got "
-            f"{chunk.seq} — a frame was dropped mid-stream"
-        )
+        seq protocol (:class:`ReplayCursor`, shared with the pull
+        plane's ``IngestFeed``) doubles as the elastic plane's replay
+        cursor: duplicates (an elastic reconfigure re-feeding a stream
+        a consumer partially saw, or a rejoiner seeded via
+        :meth:`seed_cursor`) drop — counted in
+        ``feed_replay_skipped_total`` — and forward gaps (a frame lost
+        mid-stream, see the ``columnar.frame`` failpoint) raise instead
+        of training on a hole."""
+        return self._seq.check(chunk.stream, chunk.seq)
 
     def cursor(self) -> dict[str, int]:
         """The replay cursor: last consumed frame ``seq`` per live
@@ -209,14 +256,13 @@ class DataFeed:
         state; after a reconfigure re-feeds the stream, seeding a fresh
         feed with :meth:`seed_cursor` makes the already-consumed prefix
         drop silently (exactly-once, same data order)."""
-        return dict(self._seq_state)
+        return self._seq.snapshot()
 
     def seed_cursor(self, cursor: dict[str, int]) -> None:
         """Adopt a replay cursor (see :meth:`cursor`): frames at or
         below each stream's seeded seq are treated as replayed
         duplicates and dropped instead of raising a gap."""
-        for stream, seq in cursor.items():
-            self._seq_state[str(stream)] = int(seq)
+        self._seq.seed(cursor)
 
     def _ingest(self, item: Any, sp=None) -> Any:
         """Normalize a queue item: decode TCP-borne frames (zero-copy
@@ -243,7 +289,7 @@ class DataFeed:
             # frame dropped at the very END of a stream is inherently
             # undetectable by seq-gap (there is no successor frame),
             # with or without this clear.
-            self._seq_state.clear()
+            self._seq.clear()
         return item
 
     def _next_raw(self, batch_size: int) -> list:

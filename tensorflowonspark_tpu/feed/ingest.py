@@ -1,0 +1,632 @@
+"""Driverless pull ingestion — executor-local sharded columnar readers.
+
+BASELINE.md's push-plane ceiling shows why this module exists: every
+byte of ``InputMode.SPARK`` crosses the single driver process, and the
+measured aggregate *collapses* as the cluster grows (661 MB/s at 4
+nodes → 344 at 8). The reference never had the problem because its feed
+tasks ran on the executors with HDFS locality — the driver shipped
+closures, not bytes (SURVEY.md §3.2); tf.data (arXiv:2101.12127) makes
+the same move with source sharding + per-host pipelines, and the
+TensorFlow system paper (arXiv:1605.08695) argues for keeping the
+coordinator off the data path entirely.
+
+This module is that shape for ``InputMode.TENSORFLOW``: the driver
+ships only partition *manifests* (``TFCluster.assign_shards`` →
+``feed.manifest.plan_manifests`` → one tiny plan per node over the
+manager KV), and each node opens, reads, and columnizes its own shard
+locally:
+
+- :class:`ShardReader` iterates a shard's pieces. ``'columnar'``
+  manifests (the CRC-framed files from ``feed/columnar.py`` — the
+  ready-made on-disk wire format) decode to **zero-copy column views
+  over one shared mmap**; other formats stream rows through
+  ``data.readers.columnar_pieces`` (block columnization where the data
+  lives, with the same row-list fallback matrix as the push wire).
+- :class:`IngestFeed` is the ``DataFeed``-shaped consumer: the same
+  slice-not-stack batch assembly (``ColumnAssembler``), the same
+  ``batch_stream`` contract, and therefore the same
+  ``DevicePrefetcher.from_feed`` staging — a training loop moves from
+  push to pull by swapping ``ctx.get_data_feed()`` for
+  ``ctx.get_ingest_feed()``.
+
+**Exactly-once + ordering.** Every piece of one shard stream carries a
+deterministic ``(stream, seq)`` — the stream id is a pure function of
+what is read (:func:`stream_id`: path + record range), the seq is the
+block ordinal — checked by the same :class:`~tensorflowonspark_tpu.
+feed.datafeed.ReplayCursor` protocol as the push wire: duplicates
+(a retried shard read, a restarted node re-reading its shard, an
+elastic re-plan) drop silently, forward gaps (a lost block — see the
+``ingest.read_block`` failpoint) raise. ``IngestFeed.cursor()``
+returns only FULLY-consumed blocks (pieces still buffered in the
+assembler are excluded), so a consumer that checkpoints the cursor
+beside its train state and later seeds a fresh feed
+(:meth:`IngestFeed.seed_cursor`) replays with zero duplicates and zero
+holes, mid-shard.
+
+Transient read failures retry in place (``RetryPolicy`` backoff; the
+replay cursor makes the re-read idempotent); non-retryable failures
+propagate and the node relaunch path (``run_with_restarts`` / elastic
+supervise) takes over — the successor seeds its cursor and resumes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Iterator, Sequence
+
+from tensorflowonspark_tpu.feed.columnar import ColumnAssembler, ColumnChunk
+from tensorflowonspark_tpu.feed.datafeed import ReplayCursor, columnize_rows
+from tensorflowonspark_tpu.feed.manifest import (
+    FileManifest,
+    read_manifest,
+    read_manifest_chunks,
+)
+from tensorflowonspark_tpu.obs import spans as obs_spans
+from tensorflowonspark_tpu.utils.failpoints import FailpointError, failpoint
+from tensorflowonspark_tpu.utils.retry import DEFAULT_RETRYABLE, RetryPolicy
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["IngestFeed", "RowPiece", "ShardReader", "metrics", "stream_id"]
+
+# Read faults a shard read retries in place. FailpointError is included
+# deliberately: the ``ingest.open_shard`` / ``ingest.read_block`` chaos
+# sites exercise exactly this loop (docs/ROBUSTNESS.md failpoint
+# conventions — a site opts into retrying injected faults).
+_RETRYABLE = DEFAULT_RETRYABLE + (FailpointError,)
+
+
+# -- obs ---------------------------------------------------------------------
+
+_metrics_lock = threading.Lock()
+_metrics: dict[str, Any] | None = None
+
+
+def metrics() -> dict[str, Any]:
+    """Pull-plane ingest counters in the process-global obs registry:
+    shard files opened, column-payload bytes and records delivered by
+    THIS node's executor-local readers. The driver-side
+    ``MetricsAggregator`` differentiates ``feed_ingest_bytes_total``
+    between scrapes into the per-node ``cluster_node_ingest_bytes_per_s``
+    gauge — the scaling bench's "per-node throughput flat" criterion,
+    readable straight off the registry."""
+    global _metrics
+    if _metrics is None:
+        with _metrics_lock:
+            if _metrics is None:
+                from tensorflowonspark_tpu.obs.registry import default_registry
+
+                r = default_registry()
+                _metrics = {
+                    "files": r.counter(
+                        "feed_ingest_files_total",
+                        "shard files opened by executor-local readers, "
+                        "by format",
+                    ),
+                    "bytes": r.counter(
+                        "feed_ingest_bytes_total",
+                        "column-payload bytes ingested by executor-local "
+                        "readers",
+                    ),
+                    "records": r.counter(
+                        "feed_ingest_records_total",
+                        "records ingested by executor-local readers",
+                    ),
+                }
+    return _metrics
+
+
+# -- stream identity ---------------------------------------------------------
+
+
+def stream_id(m: Any) -> str:
+    """Deterministic replay-stream id for one manifest: a pure function
+    of WHAT is read (path + record range), never of when or by whom —
+    a restarted reader, a relaunched node, or an elastic re-plan
+    re-derives the same id, which is what lets a seeded
+    :class:`ReplayCursor` recognize the already-consumed prefix."""
+    if isinstance(m, FileManifest):
+        stop = "" if m.stop is None else int(m.stop)
+        return f"{m.path}@{int(m.start)}:{stop}"
+    return f"manifest:{m!r}"
+
+
+class RowPiece(list):
+    """A row-list piece (the non-columnizable fallback) stamped with
+    its ``(stream, seq)`` so the consumed-cursor bookkeeping survives
+    the fallback path; slicing preserves the stamp (the assembler
+    splits head pieces across batches)."""
+
+    __slots__ = ("stream", "seq")
+
+    def __init__(self, rows: Sequence[Any], stream: str | None = None, seq: int = 0):
+        super().__init__(rows)
+        self.stream = stream
+        self.seq = seq
+
+    def __getitem__(self, i):
+        out = super().__getitem__(i)
+        if isinstance(i, slice):
+            return RowPiece(out, self.stream, self.seq)
+        return out
+
+
+# -- executor-local reading --------------------------------------------------
+
+
+class ShardReader:
+    """Reads one node's shard — a list of manifests — locally, yielding
+    stamped pieces (``ColumnChunk`` views / :class:`RowPiece` lists).
+
+    Manifests are read sequentially (ordering is part of the replay
+    contract); each manifest is one replay stream whose blocks carry
+    ordinal ``seq``. A transient failure (``_RETRYABLE``) mid-manifest
+    restarts that manifest's read under the jittered ``retry`` policy —
+    the caller's :class:`ReplayCursor` drops the re-read prefix, so a
+    retry can neither duplicate nor skip records (the ``ingest.
+    open_shard`` / ``ingest.read_block`` failpoints exercise this).
+    """
+
+    def __init__(
+        self,
+        manifests: Sequence[Any],
+        reader: Callable[[Any], Iterator[Any]] | None = None,
+        records_per_chunk: int = 1024,
+        retry: RetryPolicy | None = None,
+    ):
+        self.manifests = list(manifests)
+        self.reader = reader
+        self.records_per_chunk = int(records_per_chunk)
+        self.retry = (
+            retry
+            if retry is not None
+            else RetryPolicy(max_attempts=3, deadline_s=120.0)
+        )
+
+    def pieces(self, cursor: ReplayCursor) -> Iterator[Any]:
+        """All pieces of this shard, in manifest order, deduped/ordered
+        through ``cursor``."""
+        for m in self.manifests:
+            yield from self._manifest_pieces(m, cursor)
+
+    def _manifest_pieces(self, m: Any, cursor: ReplayCursor) -> Iterator[Any]:
+        # Hand-rolled rather than RetryPolicy.call: the body is a
+        # GENERATOR (pieces stream out between faults), which a
+        # callable-wrapping retry cannot express. The policy's
+        # invariants are preserved: its jittered schedule, its counter,
+        # and its deadline — a sleep never starts at or past the
+        # deadline, and never overshoots it.
+        from tensorflowonspark_tpu.utils.retry import _retry_counter
+
+        delays = self.retry.delays()
+        deadline = (
+            None
+            if self.retry.deadline_s is None
+            else time.monotonic() + self.retry.deadline_s
+        )
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                yield from self._read_once(m, cursor)
+                return
+            except _RETRYABLE as e:
+                delay = next(delays, None)
+                if delay is None:
+                    raise
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise
+                    delay = min(delay, remaining)
+                _retry_counter().inc(site="ingest.shard")
+                logger.warning(
+                    "ingest: shard %s read failed (%s: %s); retrying "
+                    "(attempt %d/%d) — the replay cursor drops re-read "
+                    "blocks",
+                    getattr(m, "path", m),
+                    type(e).__name__,
+                    e,
+                    attempt,
+                    self.retry.max_attempts,
+                )
+                time.sleep(delay)
+
+    def _raw_pieces(self, m: Any) -> Iterator[Any]:
+        if (
+            self.reader is None
+            and isinstance(m, FileManifest)
+            and m.format == "columnar"
+        ):
+            # the on-disk wire format: zero-copy views over one mmap,
+            # payload-CRC-verified per frame
+            yield from read_manifest_chunks(m)
+            return
+        from tensorflowonspark_tpu.data.readers import columnar_pieces
+
+        yield from columnar_pieces(
+            read_manifest(m, self.reader), self.records_per_chunk
+        )
+
+    def _read_once(self, m: Any, cursor: ReplayCursor) -> Iterator[Any]:
+        met = metrics()
+        sid = stream_id(m)
+        fmt = m.format if isinstance(m, FileManifest) else "custom"
+        failpoint("ingest.open_shard")
+        met["files"].inc(format=fmt)
+        # ingest.read is an externally-measured interval (spans.record's
+        # synthetic lane), accumulated around the read steps only: a
+        # call-stack span held open across yields would swallow the
+        # consumer's compute between pulls into "read" time.
+        read_s = 0.0
+        n_records = 0
+        raw = self._raw_pieces(m)
+        seq = -1
+        try:
+            while True:
+                t0 = time.perf_counter()
+                piece = next(raw, None)
+                read_s += time.perf_counter() - t0
+                if piece is None:
+                    return
+                seq += 1
+                if failpoint("ingest.read_block") == "drop":
+                    # chaos: block lost mid-shard — the cursor's gap
+                    # check on the NEXT block surfaces it loudly
+                    continue
+                if not cursor.check(sid, seq):
+                    continue  # replayed duplicate (retry/restart/re-plan)
+                if isinstance(piece, ColumnChunk):
+                    piece = ColumnChunk(
+                        piece.kind,
+                        piece.keys,
+                        piece.arrays,
+                        qname=piece.qname,
+                        stream=sid,
+                        seq=seq,
+                    )
+                    met["bytes"].inc(piece.nbytes)
+                else:
+                    piece = RowPiece(piece, sid, seq)
+                met["records"].inc(len(piece))
+                n_records += len(piece)
+                yield piece
+                # no piece reference held across the next read — the
+                # same liveness rule as the wire pull loops (mmap
+                # pinning is milder than ring slots, but uniform rules
+                # are checkable rules)
+                piece = None
+        finally:
+            try:
+                obs_spans.record(
+                    "ingest.read",
+                    read_s,
+                    path=str(getattr(m, "path", m)),
+                    format=fmt,
+                    records=n_records,
+                )
+            except Exception:  # pragma: no cover - interpreter teardown
+                pass  # an abandoned reader GC'd at exit must stay quiet
+
+
+# -- the DataFeed-shaped consumer --------------------------------------------
+
+
+class IngestFeed:
+    """The pull plane's in-node consumer: ``DataFeed``'s surface
+    (``next_batch`` / ``should_stop`` / ``batch_stream`` / ``cursor`` /
+    ``seed_cursor`` / ``terminate``) over an executor-local
+    :class:`ShardReader` — no queue, no driver, no bytes over the
+    control plane.
+
+    Construct directly from manifests, or via ``ctx.get_ingest_feed()``
+    which fetches this node's shard from the driver-published plan
+    (``TFCluster.assign_shards``). With an ``input_mapping`` batches
+    are ``{tensor: ndarray}`` columns SLICED from the shard's chunks
+    (zero-copy within one chunk); without one, plain record lists.
+    Like ``ManifestFeed``, batches fill across file boundaries — steady
+    jit shapes are the point of the plane.
+    """
+
+    def __init__(
+        self,
+        manifests: Sequence[Any],
+        input_mapping: dict[str, str] | None = None,
+        reader: Callable[[Any], Iterator[Any]] | None = None,
+        records_per_chunk: int = 1024,
+        retry: RetryPolicy | None = None,
+        plan_epoch: int = 0,
+        worker_index: int | None = None,
+    ):
+        self.input_mapping = input_mapping
+        self.plan_epoch = int(plan_epoch)
+        self.worker_index = worker_index
+        self._reader = ShardReader(
+            manifests,
+            reader=reader,
+            records_per_chunk=records_per_chunk,
+            retry=retry,
+        )
+        from tensorflowonspark_tpu.feed.datafeed import _replay_counter
+
+        self._seq = ReplayCursor(
+            name=f"ingest shard (worker "
+            f"{worker_index if worker_index is not None else '?'})",
+            on_drop=lambda _s: _replay_counter().inc(queue="ingest"),
+        )
+        self._assembler = (
+            ColumnAssembler(input_mapping) if input_mapping else None
+        )
+        self._buffer: list[Any] = []  # rows of a partially-consumed piece
+        self._iter: Iterator[Any] | None = None
+        self._exhausted = False
+        # Exactly-once bookkeeping. Pieces enter assembly in FIFO order
+        # and records leave it in the same order, so one cumulative
+        # consumption count maps back to (fully-consumed blocks, record
+        # offset into the in-progress block) — the record-exact cursor.
+        self._delivered: deque = deque()  # (stream, seq, length, base)
+        self._head_consumed = 0  # records consumed from _delivered[0]
+        # stream -> consumed state: int (last fully consumed seq) or
+        # [seq, skip] (seeded mid-block state not yet superseded by
+        # this feed's own progress)
+        self._done: dict[str, Any] = {}
+        self._pending_skip: dict[str, tuple[int, int]] = {}  # seeded offsets
+
+    # -- replay cursor -------------------------------------------------
+    def cursor(self) -> dict[str, Any]:
+        """Record-exact consumption snapshot, per stream: ``seq`` when
+        block ``seq`` is the last FULLY consumed one, or ``[seq, skip]``
+        when additionally the first ``skip`` records of block
+        ``seq + 1`` have left in batches. Records still buffered inside
+        the feed (read but never batched out) are NOT counted — a
+        successor seeded with this snapshot (:meth:`seed_cursor`)
+        re-reads them: zero duplicates, zero holes, mid-shard and even
+        mid-block. Checkpoint it beside the train state."""
+        out: dict[str, Any] = dict(self._done)
+        if self._delivered and self._head_consumed:
+            s, q, _ln, base = self._delivered[0]
+            if s is not None:
+                out[s] = [q - 1, base + self._head_consumed]
+        return out
+
+    def seed_cursor(self, cursor: dict[str, Any]) -> None:
+        """Adopt a :meth:`cursor` snapshot BEFORE consuming. Whole
+        blocks at or below each stream's seeded seq drop as replayed
+        duplicates on the re-read; a ``[seq, skip]`` entry additionally
+        trims the first ``skip`` records off block ``seq + 1``. Plain
+        ``{stream: seq}`` cursors (the push plane's ``DataFeed``
+        format) are accepted unchanged.
+
+        Seeded state is itself part of :meth:`cursor`'s output until
+        this feed makes further progress on the stream: a successor
+        that crashes before touching an already-consumed stream must
+        still hand ITS successor the full consumed prefix — otherwise
+        the third incarnation would replay whole streams (duplicates).
+        """
+        seed: dict[str, int] = {}
+        for s, v in cursor.items():
+            s = str(s)
+            if isinstance(v, (list, tuple)):
+                seq0, skip = int(v[0]), int(v[1])
+            else:
+                seq0, skip = int(v), 0
+            if seq0 >= 0:
+                seed[s] = seq0
+            if skip > 0:
+                self._pending_skip[s] = (seq0 + 1, skip)
+                self._done[s] = [seq0, skip]
+            elif seq0 >= 0:
+                self._done[s] = seq0
+        self._seq.seed(seed)
+
+    # -- iteration core ------------------------------------------------
+    def _pieces_iter(self) -> Iterator[Any]:
+        if self._iter is None:
+            self._iter = self._reader.pieces(self._seq)
+        return self._iter
+
+    def _pull_piece(self) -> Any | None:
+        """Next piece off the reader, seeded-skip applied and delivery
+        recorded for the consumed-cursor bookkeeping."""
+        while not self._exhausted:
+            piece = next(self._pieces_iter(), None)
+            if piece is None:
+                self._exhausted = True
+                return None
+            stream = getattr(piece, "stream", None)
+            seq = int(getattr(piece, "seq", 0))
+            base = 0
+            if stream is not None:
+                sk = self._pending_skip.get(stream)
+                if sk is not None and sk[0] == seq:
+                    del self._pending_skip[stream]
+                    base = min(int(sk[1]), len(piece))
+                    if base:
+                        piece = (
+                            piece.view(base, len(piece))
+                            if isinstance(piece, ColumnChunk)
+                            else RowPiece(list(piece)[base:], stream, seq)
+                        )
+            if len(piece):
+                self._delivered.append((stream, seq, len(piece), base))
+                return piece
+        return None
+
+    def _advance_consumed(self, n: int) -> None:
+        """Records left the feed in a batch (or were dropped at the
+        tail): pop fully-consumed pieces off the delivery FIFO and
+        advance the per-stream done cursor."""
+        self._head_consumed += int(n)
+        while self._delivered:
+            s, q, ln, _base = self._delivered[0]
+            if self._head_consumed < ln:
+                break
+            self._delivered.popleft()
+            self._head_consumed -= ln
+            if s is not None:
+                self._done[s] = q
+
+    def should_stop(self) -> bool:
+        """True once the shard is exhausted AND every buffered record
+        has left in a batch (``DataFeed.should_stop`` contract)."""
+        return (
+            self._exhausted
+            and not self._buffer
+            and (self._assembler is None or len(self._assembler) == 0)
+        )
+
+    def next_batch(self, batch_size: int) -> list | dict[str, Any]:
+        """Up to ``batch_size`` records; partial only at shard end.
+        Mapped feeds return sliced ``{tensor: column}`` dicts, mapping-
+        less feeds record lists (``ColumnChunk.rows`` semantics, as on
+        the push wire)."""
+        if self._assembler is None:
+            if self.input_mapping is not None:
+                # degenerate empty mapping: legacy stacking contract
+                return columnize_rows(
+                    self._next_raw(batch_size), self.input_mapping
+                )
+            return self._next_raw(batch_size)
+        asm = self._assembler
+        while len(asm) < batch_size:
+            piece = self._pull_piece()
+            if piece is None:
+                break
+            asm.push(piece)
+        n = min(batch_size, len(asm))
+        out = asm.take(batch_size)
+        self._advance_consumed(n)
+        return out
+
+    def _next_raw(self, batch_size: int, account: bool = True) -> list:
+        """Up to ``batch_size`` raw records. ``account=False`` defers
+        the consumed-cursor advance to the caller — rows handed to an
+        intermediate buffer (``fixed_size_batches``) have NOT left the
+        feed yet, and counting them consumed would punch resume holes."""
+        batch: list[Any] = []
+        while len(batch) < batch_size:
+            take = batch_size - len(batch)
+            if self._buffer:
+                batch.extend(self._buffer[:take])
+                del self._buffer[:take]
+                continue
+            piece = self._pull_piece()
+            if piece is None:
+                break
+            if isinstance(piece, ColumnChunk):
+                self._buffer.extend(piece.rows())
+            else:
+                self._buffer.extend(piece)
+            piece = None
+        if account:
+            self._advance_consumed(len(batch))
+        return batch
+
+    def batch_stream(
+        self,
+        batch_size: int,
+        multiple_of: int = 1,
+        input_mapping: dict[str, str] | None = None,
+    ):
+        """Fixed-size batches with the ``DataFeed.batch_stream``
+        contract: every yield has exactly ``batch_size`` records
+        (rounded down to ``multiple_of``) until the shard tail, which
+        trims to the largest multiple (sub-multiple remainder dropped
+        with a log line). The mapping may come from the constructor
+        (``DataFeed`` style) or here (``ManifestFeed`` style) — either
+        way ``DevicePrefetcher.from_feed`` drives it unchanged."""
+        mapping = (
+            input_mapping if input_mapping is not None else self.input_mapping
+        )
+        if not mapping:
+            from tensorflowonspark_tpu.utils.batching import fixed_size_batches
+
+            # consumption is advanced per EMITTED batch, never when rows
+            # merely enter fixed_size_batches' pending buffer — those
+            # rows have not left the feed, and counting them consumed
+            # would make a checkpointed cursor skip them on resume
+            pulled = 0
+
+            def records():
+                nonlocal pulled
+                while not self.should_stop():
+                    rows = self._next_raw(batch_size, account=False)
+                    if not rows:
+                        return
+                    pulled += len(rows)
+                    yield from rows
+
+            emitted = 0
+            for batch in fixed_size_batches(
+                records(),
+                batch_size,
+                multiple_of,
+                assemble=lambda rows: list(rows),
+            ):
+                emitted += len(batch)
+                self._advance_consumed(len(batch))
+                yield batch
+            # normal exhaustion: the sub-multiple remainder was DROPPED
+            # (drop-remainder semantics) — dropped counts as consumed.
+            # Unreached on an early generator close, where the pending
+            # rows were never delivered and must replay.
+            self._advance_consumed(pulled - emitted)
+            return
+        if self._assembler is None or self._assembler.mapping != mapping:
+            old = self._assembler
+            self._assembler = ColumnAssembler(dict(mapping))
+            # FIFO order is the cursor's correctness invariant: oldest
+            # unconsumed records (a prior mapping-less next_batch's row
+            # buffer) re-enter assembly first.
+            if self._buffer:
+                self._assembler.push(list(self._buffer))
+                self._buffer = []
+            if old is not None:
+                for piece in old.drain_pieces():
+                    self._assembler.push(piece)
+        bs = batch_size - batch_size % multiple_of
+        if bs == 0:
+            raise ValueError(
+                f"batch_size < multiple_of ({multiple_of}); nothing to yield"
+            )
+        asm = self._assembler
+        while True:
+            while len(asm) < bs:
+                piece = self._pull_piece()
+                if piece is None:
+                    break
+                asm.push(piece)
+            if len(asm) < bs:
+                break
+            batch = asm.take(bs)
+            self._advance_consumed(bs)
+            yield batch
+        tail = len(asm) - len(asm) % multiple_of
+        rem = len(asm) % multiple_of
+        if rem:
+            logger.warning(
+                "dropping %d tail records (not a multiple of %d)",
+                rem,
+                multiple_of,
+            )
+        if tail:
+            batch = asm.take(tail)
+            self._advance_consumed(tail)
+            yield batch
+        if len(asm):
+            # discard the sub-multiple remainder (drop-remainder
+            # semantics, same as the push wire's column_batches) —
+            # dropped counts as consumed: a resume must not replay it
+            asm.take(len(asm))
+            self._advance_consumed(rem)
+
+    def terminate(self) -> None:
+        """Stop reading (early stop). Purely local — there is no
+        producer to signal on the pull plane."""
+        self._exhausted = True
+        it, self._iter = self._iter, None
+        if it is not None and hasattr(it, "close"):
+            it.close()

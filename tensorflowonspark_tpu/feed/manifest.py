@@ -33,9 +33,17 @@ to match file placement — the driver controls assignment either way.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Iterator
+from typing import Any, Callable, Iterator, Sequence
 
-__all__ = ["FileManifest", "ManifestFeed", "read_manifest", "read_manifest_chunks"]
+__all__ = [
+    "FileManifest",
+    "ManifestFeed",
+    "manifest_records",
+    "plan_manifests",
+    "read_manifest",
+    "read_manifest_chunks",
+    "split_manifest",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,6 +113,70 @@ def read_manifest_chunks(m: FileManifest):
                 return
             continue
         yield chunk if (lo, hi) == (0, len(chunk)) else chunk.view(lo, hi)
+
+
+def plan_manifests(
+    manifests: Sequence[FileManifest], num_shards: int
+) -> list[list[FileManifest]]:
+    """Deterministic round-robin shard assignment — the driver side of
+    the pull plane's manifest planning (``TFCluster.assign_shards``).
+
+    Round-robin (like ``TFCluster.train``'s partition assignment) keeps
+    per-shard record statistics close to the input distribution when
+    file sizes vary. Determinism is a replay requirement, not a
+    nicety: an elastic reconfigure re-plans over the surviving roster,
+    and a restarted driver must hand every node the same shard it held
+    before, or the seeded replay cursors point at the wrong streams.
+    Shards may be empty when ``len(manifests) < num_shards`` — a node
+    with an empty shard sees an immediately-exhausted feed, not an
+    error (skewed file counts are normal at small scale).
+    """
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    ms = list(manifests)
+    return [ms[i::num_shards] for i in range(num_shards)]
+
+
+def manifest_records(
+    m: FileManifest,
+    reader: Callable[[FileManifest], Iterator[Any]] | None = None,
+) -> int:
+    """Record count a manifest names. For ``'columnar'`` manifests this
+    is a header-only frame scan (payload bytes untouched — splitting a
+    multi-GB file costs one metadata pass); other formats pay a full
+    read."""
+    if reader is None and m.format == "columnar":
+        from tensorflowonspark_tpu.feed.columnar import scan_frames
+
+        total = sum(n for _, _, n in scan_frames(m.path))
+        stop = total if m.stop is None else min(m.stop, total)
+        return max(0, stop - min(m.start, stop))
+    return sum(1 for _ in read_manifest(m, reader))
+
+
+def split_manifest(
+    m: FileManifest,
+    n: int,
+    reader: Callable[[FileManifest], Iterator[Any]] | None = None,
+) -> list[FileManifest]:
+    """Split one manifest into at most ``n`` contiguous record-range
+    manifests (sizes differ by at most one; empties dropped) so a
+    single large file can feed many nodes. Contiguous ranges keep each
+    shard a sequential read of its region."""
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    total = manifest_records(m, reader)
+    k, rem = divmod(total, n)
+    out: list[FileManifest] = []
+    lo = 0
+    for i in range(n):
+        hi = lo + k + (1 if i < rem else 0)
+        if hi > lo:
+            out.append(
+                dataclasses.replace(m, start=m.start + lo, stop=m.start + hi)
+            )
+        lo = hi
+    return out
 
 
 def _sliced(rows: Iterator[Any], m: FileManifest) -> Iterator[Any]:

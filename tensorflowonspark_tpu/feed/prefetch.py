@@ -153,8 +153,12 @@ class DevicePrefetcher:
         input_mapping: dict[str, str] | None = None,
     ) -> "DevicePrefetcher":
         """THE default training-loop input: device batches straight off a
-        :class:`~tensorflowonspark_tpu.feed.datafeed.DataFeed` (or
-        ``ManifestFeed``).
+        :class:`~tensorflowonspark_tpu.feed.datafeed.DataFeed` — or any
+        feed with its ``batch_stream`` contract: ``ManifestFeed``
+        (manifest records expanded node-locally inside SPARK mode) and
+        ``IngestFeed`` (the pull plane's executor-local shard readers)
+        plug in unchanged, so both planes end at the same staging +
+        H2D/compute overlap.
 
         The producer thread pulls ``feed.batch_stream(batch_size,
         multiple_of)`` — columnar wire chunks are batch-sliced as

@@ -298,6 +298,17 @@ class MetricsAggregator:
         self._m_seconds = self.registry.histogram(
             "cluster_scrape_seconds", "wall time of one scrape round"
         )
+        # Pull-plane rate derivation: feed_ingest_bytes_total is a
+        # per-node counter; differencing it between scrape rounds gives
+        # the per-node ingest rate as a driver-side gauge — the scaling
+        # acceptance ("per-node throughput flat") readable straight off
+        # the driver registry / aggregated /metrics endpoint.
+        self._prev_ingest: dict[Any, tuple[float, float]] = {}  # guarded-by: self._lock
+        self._g_ingest = self.registry.gauge(
+            "cluster_node_ingest_bytes_per_s",
+            "per-node executor-local ingest rate "
+            "(feed_ingest_bytes_total differenced between scrapes)",
+        )
 
     # -- scraping ------------------------------------------------------
 
@@ -343,11 +354,46 @@ class MetricsAggregator:
         dt = time.perf_counter() - t0
         dt_cpu = time.thread_time() - c0
         self._m_seconds.observe(dt)
+        self._note_ingest_rates(results)
         with self._lock:
             self._last = results
             self.total_scrape_s += dt
             self.total_scrape_cpu_s += dt_cpu
         return results
+
+    def _note_ingest_rates(self, results: dict[Any, dict[str, Any]]) -> None:
+        """Difference each node's ``feed_ingest_bytes_total`` against
+        the previous round into ``cluster_node_ingest_bytes_per_s``.
+        Keys absent from this round (departed/elastically-removed
+        nodes) are dropped from both the bookkeeping and the gauge —
+        a ghost node must not report its last rate forever.
+
+        Runs under ``self._lock`` like every other shared-state write:
+        the background loop and a manual ``scrape_once()`` may race,
+        and an unguarded read-modify-write of ``_prev_ingest`` would
+        difference two rounds over a near-zero interval (an inflated
+        rate sample)."""
+        with self._lock:
+            for key in list(self._prev_ingest):
+                if key not in results:
+                    del self._prev_ingest[key]
+                    self._g_ingest.remove(node=str(key))
+            for key, entry in results.items():
+                if not entry.get("ok"):
+                    continue
+                fam = entry["families"].get("feed_ingest_bytes_total")
+                if fam is None:
+                    continue
+                total = sum(fam["samples"].values())
+                t = float(entry.get("scraped_at") or 0.0)
+                prev = self._prev_ingest.get(key)
+                self._prev_ingest[key] = (t, total)
+                if prev is not None and t > prev[0]:
+                    # max(0, ·): a node restart resets its counter
+                    self._g_ingest.set(
+                        max(0.0, (total - prev[1]) / (t - prev[0])),
+                        node=str(key),
+                    )
 
     def start(self) -> None:
         """Background scraping on the heartbeat cadence (daemon)."""

@@ -161,6 +161,12 @@ class Gauge(_Metric):
     def dec(self, amount: float = 1, **labels: Any) -> None:
         self.inc(-amount, **labels)
 
+    def remove(self, **labels: Any) -> None:
+        """Drop one labelled series — a departed cluster member's gauge
+        must not keep reporting its last value forever."""
+        with self._lock:
+            self._series.pop(_label_key(labels), None)
+
     def value(self, **labels: Any) -> float:
         with self._lock:
             return self._series.get(_label_key(labels), 0.0)

@@ -84,6 +84,11 @@ SITES = frozenset(
         "columnar.frame",  # columnar frame decode points ("drop" aware:
         # a dropped frame is surfaced by the consumer's seq-gap check)
         "prefetch.producer",  # DevicePrefetcher producer thread
+        # pull plane (feed/ingest.py executor-local sharded readers)
+        "ingest.manifest_fetch",  # node, fetching the driver-published plan
+        "ingest.open_shard",  # ShardReader, before opening one shard
+        "ingest.read_block",  # ShardReader, per block read ("drop" aware:
+        # a dropped block is surfaced by the replay cursor's gap check)
         # serving plane
         "engine.submit",  # ContinuousBatcher enqueue (caller thread)
         "engine.dispatch",  # scheduler, before a decode-block dispatch

@@ -626,6 +626,73 @@ def distributed_llama_ckpt_fn(args, ctx):
         json.dump(out, f)
 
 
+def ingest_drain_fn(args, ctx):
+    """Pull-plane map_fun: drain this node's driver-published shard
+    (ctx.get_ingest_feed) into mapped column batches; write the
+    consumed values + the final replay cursor so the e2e can assert
+    exact coverage with no driver in the data loop."""
+    import json
+
+    import numpy as np
+
+    feed = ctx.get_ingest_feed(
+        input_mapping={"x": "x"}, timeout=float(args.get("timeout", 120))
+    )
+    values = []
+    for cols in feed.batch_stream(int(args.get("batch", 8))):
+        values.extend(np.ravel(cols["x"]).tolist())
+    out = {
+        "values": values,
+        "cursor": feed.cursor(),
+        "plan_epoch": feed.plan_epoch,
+    }
+    with open(
+        os.path.join(args["out_dir"], f"node{ctx.executor_id}.json"), "w"
+    ) as f:
+        json.dump(out, f)
+
+
+def ingest_restart_fn(args, ctx):
+    """Pull-plane restart map_fun (run_with_restarts): consumes the
+    shard in args['manifests'] batch by batch, persisting the replay
+    cursor + consumed values after every batch; attempt 1 crashes hard
+    mid-shard, the relaunched attempt seeds the persisted cursor and
+    finishes — the consumed union must be exactly-once."""
+    import json
+
+    import numpy as np
+
+    from tensorflowonspark_tpu.feed.ingest import IngestFeed
+
+    d = args["dir"]
+    state_path = os.path.join(d, f"state{ctx.executor_id}.json")
+    state = {"values": [], "cursor": {}, "attempts": 0}
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            state = json.load(f)
+    state["attempts"] += 1
+    feed = IngestFeed(args["manifests"], input_mapping={"x": "x"})
+    feed.seed_cursor(state["cursor"])
+    n_batches = 0
+    for cols in feed.batch_stream(int(args.get("batch", 4))):
+        state["values"].extend(np.ravel(cols["x"]).tolist())
+        state["cursor"] = feed.cursor()
+        # persist atomically: the crash below must never half-write
+        tmp = state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, state_path)
+        n_batches += 1
+        if (
+            state["attempts"] == 1
+            and ctx.executor_id == 0
+            and n_batches >= int(args.get("crash_after", 3))
+        ):
+            os._exit(5)  # mid-shard crash; no cleanup, like a real one
+    with open(os.path.join(d, f"done{ctx.executor_id}"), "w") as f:
+        f.write("ok")
+
+
 def _elastic_recipe():
     """Shared pieces of the elastic chaos tests: a tiny linear model
     whose data order is a pure function of the step index (the replay

@@ -25,3 +25,14 @@ def elastic_clean_sites():
     failpoint("elastic.epoch_bump")
     failpoint("elastic.reshard_gather")
     failpoint("elastic.rejoin_init")
+
+
+def ingest_typo_site():
+    failpoint("ingest.read_blck")  # SEEDED VIOLATION FP001: unregistered
+
+
+def ingest_clean_sites():
+    # registered pull-plane sites: must NOT be flagged
+    failpoint("ingest.manifest_fetch")
+    failpoint("ingest.open_shard")
+    failpoint("ingest.read_block")

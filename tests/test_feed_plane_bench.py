@@ -69,3 +69,49 @@ def test_feed_plane_bench_smoke():
         by_leg[("shm", "columnar")]["mb_per_s"]
         >= 0.9 * by_leg[("shm", "row")]["mb_per_s"]
     ), rows
+
+
+def test_feed_plane_pull_leg_smoke():
+    """The ISSUE-8 pull-sharded leg end-to-end at tiny sizes: both
+    modes emit rows with per-node self-timed rates; per-node rates are
+    positive and the staggered aggregate is their sum."""
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PALLAS_AXON_REMOTE_COMPILE="",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "benchmarks", "feed_plane.py"),
+            "--nodes", "2",
+            "--mb-per-node", "8",
+            "--record-kb", "16",
+            "--paths", "pull",
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    assert [(r["leg"], r["mode"]) for r in rows] == [
+        ("pull-sharded", "coscheduled"),
+        ("pull-sharded", "staggered"),
+    ]
+    for r in rows:
+        assert r["nodes"] == 2
+        assert len(r["per_node_mb_per_s"]) == 2
+        assert all(v > 0 for v in r["per_node_mb_per_s"]), r
+    staggered = rows[1]
+    assert staggered["mb_per_s"] == pytest.approx(
+        sum(staggered["per_node_mb_per_s"]), rel=0.01
+    )

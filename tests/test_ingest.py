@@ -1,0 +1,740 @@
+"""Driverless pull ingestion (``feed/ingest.py`` — ISSUE 8).
+
+Covers the acceptance surface:
+
+- manifest planning: deterministic round-robin shards, header-only
+  record counting, record-range splits of one large file;
+- executor-local reading: shard-boundary chunk slicing, empty/short
+  shards, TFRecord block columnization (``data.readers``), the grain
+  random-access tier;
+- byte-identical batch parity between the push-columnar wire
+  (``DataFeed``) and the pull-sharded plane (``IngestFeed``) on the
+  same records — including after a mid-stream restart from a seeded
+  cursor (zero duplicates, zero gaps, record-exact mid-block);
+- chaos: the ``ingest.open_shard`` / ``ingest.read_block`` failpoints
+  trip in-place retry (replay cursor proves exactly-once) or, for a
+  dropped block, a loud sequence-gap error; non-retryable faults
+  propagate to the relaunch path, and the slow tier proves a node
+  crash mid-shard resumes exactly-once under ``run_with_restarts``;
+- obs: ``feed_ingest_*`` counters, the ``ingest.read`` span, and the
+  driver-side ``cluster_node_ingest_bytes_per_s`` gauge derivation.
+"""
+
+import json
+import os
+import secrets
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.feed import columnar as col
+from tensorflowonspark_tpu.feed.ingest import IngestFeed, RowPiece, ShardReader
+from tensorflowonspark_tpu.feed.manifest import (
+    FileManifest,
+    manifest_records,
+    plan_manifests,
+    split_manifest,
+)
+from tensorflowonspark_tpu.utils import failpoints
+from tensorflowonspark_tpu.utils.retry import RetryPolicy
+
+MAPPING = {"x": "x", "y": "y"}
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    failpoints.disarm_all()
+
+
+def _records(n, dim=3):
+    return [
+        {
+            "x": (np.arange(dim, dtype=np.float32) + i),
+            "y": np.int64(i),
+        }
+        for i in range(n)
+    ]
+
+
+def _frame_file(tmp_path, n=40, records_per_frame=5, name="a.colf"):
+    p = str(tmp_path / name)
+    col.write_frames(p, _records(n), records_per_frame=records_per_frame)
+    return p
+
+
+def _drain(feed, batch, multiple_of=1):
+    return list(feed.batch_stream(batch, multiple_of))
+
+
+def _concat(batches, key="y"):
+    return np.concatenate([np.ravel(b[key]) for b in batches])
+
+
+# -- planning ----------------------------------------------------------------
+
+
+def test_plan_manifests_round_robin_and_empty_shards():
+    ms = [FileManifest(f"f{i}") for i in range(5)]
+    shards = plan_manifests(ms, 2)
+    assert shards == [ms[0::2], ms[1::2]]
+    # determinism: same input -> same plan (the elastic re-plan contract)
+    assert plan_manifests(ms, 2) == shards
+    # more shards than manifests: trailing shards are empty, not errors
+    shards = plan_manifests(ms[:2], 4)
+    assert [len(s) for s in shards] == [1, 1, 0, 0]
+    with pytest.raises(ValueError, match="num_shards"):
+        plan_manifests(ms, 0)
+
+
+def test_manifest_records_header_only_and_ranges(tmp_path):
+    p = _frame_file(tmp_path, n=23, records_per_frame=4)
+    m = FileManifest(p, format="columnar")
+    assert manifest_records(m) == 23
+    assert manifest_records(FileManifest(p, format="columnar", start=5)) == 18
+    assert (
+        manifest_records(FileManifest(p, format="columnar", start=5, stop=9))
+        == 4
+    )
+    # stop past EOF clips; start past EOF is empty
+    assert (
+        manifest_records(FileManifest(p, format="columnar", stop=99)) == 23
+    )
+    assert (
+        manifest_records(FileManifest(p, format="columnar", start=99)) == 0
+    )
+
+
+def test_scan_frames_matches_read_frames(tmp_path):
+    p = _frame_file(tmp_path, n=23, records_per_frame=4)
+    scanned = list(col.scan_frames(p))
+    chunks = list(col.read_frames(p))
+    assert [n for _, _, n in scanned] == [len(c) for c in chunks]
+    # offsets are strictly increasing and 64-aligned
+    offs = [o for o, _, _ in scanned]
+    assert offs == sorted(offs) and all(o % col.ALIGN == 0 for o in offs)
+
+
+def test_split_manifest_covers_exactly(tmp_path):
+    p = _frame_file(tmp_path, n=23, records_per_frame=4)
+    parts = split_manifest(FileManifest(p, format="columnar"), 4)
+    assert [manifest_records(m) for m in parts] == [6, 6, 6, 5]
+    # splitting an already-ranged manifest stays inside its range
+    sub = split_manifest(
+        FileManifest(p, format="columnar", start=3, stop=11), 3
+    )
+    assert [(m.start, m.stop) for m in sub] == [(3, 6), (6, 9), (9, 11)]
+    got = []
+    for m in sub:
+        feed = IngestFeed([m])
+        while not feed.should_stop():
+            got.extend(int(r["y"]) for r in feed.next_batch(16))
+    assert got == list(range(3, 11))
+
+
+# -- shard boundaries, empty/short shards ------------------------------------
+
+
+def test_shard_boundary_chunk_slicing(tmp_path):
+    """Record-range manifests slice chunks at arbitrary (mid-frame)
+    boundaries; together the shards cover the file exactly once."""
+    p = _frame_file(tmp_path, n=41, records_per_frame=7)
+    parts = split_manifest(FileManifest(p, format="columnar"), 5)
+    seen = []
+    for m in parts:
+        feed = IngestFeed([m], input_mapping=MAPPING)
+        for b in _drain(feed, 4):
+            seen.extend(np.ravel(b["y"]).tolist())
+        assert feed.should_stop()
+    assert sorted(seen) == list(range(41))
+
+
+def test_empty_and_short_shards(tmp_path):
+    # empty manifest list: immediately-exhausted feed
+    feed = IngestFeed([], input_mapping=MAPPING)
+    assert _drain(feed, 8) == []
+    assert feed.should_stop()
+    # empty frame FILE (zero records)
+    p_empty = str(tmp_path / "empty.colf")
+    col.write_frames(p_empty, [], records_per_frame=8)
+    feed = IngestFeed(
+        [FileManifest(p_empty, format="columnar")], input_mapping=MAPPING
+    )
+    assert _drain(feed, 8) == []
+    # shard shorter than one batch: one trimmed tail batch
+    p = _frame_file(tmp_path, n=5, records_per_frame=2, name="short.colf")
+    feed = IngestFeed(
+        [FileManifest(p, format="columnar")], input_mapping=MAPPING
+    )
+    batches = _drain(feed, 8, multiple_of=2)
+    assert [len(b["y"]) for b in batches] == [4]  # 5 -> tail trim to 4
+    # zero-length record range inside a real file
+    feed = IngestFeed(
+        [FileManifest(p, format="columnar", start=2, stop=2)],
+        input_mapping=MAPPING,
+    )
+    assert _drain(feed, 8) == []
+
+
+def test_next_batch_and_mapping_less_rows(tmp_path):
+    p = _frame_file(tmp_path, n=10, records_per_frame=4)
+    feed = IngestFeed([FileManifest(p, format="columnar")])
+    rows = []
+    while not feed.should_stop():
+        rows.extend(feed.next_batch(3))
+    assert [int(r["y"]) for r in rows] == list(range(10))
+    np.testing.assert_array_equal(
+        rows[2]["x"], np.arange(3, dtype=np.float32) + 2
+    )
+
+
+# -- parity with the push wire ----------------------------------------------
+
+
+def _push_feed(records, mapping, chunk=6):
+    """The push-columnar reference path: frames through a local manager
+    queue into a DataFeed, exactly as feed_partition ships them."""
+    from tensorflowonspark_tpu.cluster import manager
+    from tensorflowonspark_tpu.cluster.marker import EndOfFeed
+    from tensorflowonspark_tpu.feed.datafeed import DataFeed
+
+    mgr = manager.start(
+        secrets.token_bytes(16), queues=("input", "output"), mode="local"
+    )
+    q = mgr.get_queue("input")
+    stream = "push"
+    for seq, lo in enumerate(range(0, len(records), chunk)):
+        ck = col.columnize_records(records[lo : lo + chunk])
+        assert ck is not None
+        q.put(
+            col.ColumnarFrame(
+                col.frame_bytes(ck, qname="input", stream=stream, seq=seq)
+            )
+        )
+    q.put(EndOfFeed())
+    return DataFeed(mgr, input_mapping=mapping), mgr
+
+
+def test_push_pull_batch_parity_byte_identical(tmp_path):
+    """The acceptance bar: the same records through the push-columnar
+    wire and the pull-sharded plane produce byte-identical batches —
+    same values, dtypes, shapes, bytes — regardless of differing chunk
+    (wire frame) boundaries."""
+    records = _records(50)
+    p = str(tmp_path / "parity.colf")
+    col.write_frames(p, records, records_per_frame=7)  # != push chunk of 6
+
+    push, mgr = _push_feed(records, MAPPING)
+    push_batches = list(push.batch_stream(8, 2))
+    mgr.stop()
+    pull = IngestFeed(
+        [FileManifest(p, format="columnar")], input_mapping=MAPPING
+    )
+    pull_batches = _drain(pull, 8, 2)
+
+    assert len(push_batches) == len(pull_batches)
+    for pb, qb in zip(push_batches, pull_batches):
+        assert pb.keys() == qb.keys()
+        for k in pb:
+            assert pb[k].dtype == qb[k].dtype and pb[k].shape == qb[k].shape
+            assert pb[k].tobytes() == qb[k].tobytes()
+
+
+def test_parity_after_mid_stream_restart(tmp_path):
+    """Byte-identical parity INCLUDING after a mid-stream restart: pull
+    consumes part of the shard, a successor seeds the cursor and takes
+    over — the concatenation equals the uninterrupted push batches
+    (zero duplicates, zero gaps), even when the cut lands mid-block."""
+    records = _records(50)
+    p = str(tmp_path / "restart.colf")
+    col.write_frames(p, records, records_per_frame=7)
+    push, mgr = _push_feed(records, MAPPING)
+    push_batches = list(push.batch_stream(8, 2))
+    mgr.stop()
+
+    m = [FileManifest(p, format="columnar")]
+    first = IngestFeed(m, input_mapping=MAPPING)
+    it = first.batch_stream(8, 2)
+    got = [next(it) for _ in range(3)]  # 24 records: mid-block (24 % 7 != 0)
+    cur = first.cursor()
+    first.terminate()
+    assert isinstance(next(iter(cur.values())), list)  # [seq, skip] form
+    successor = IngestFeed(m, input_mapping=MAPPING)
+    successor.seed_cursor(cur)
+    got += list(successor.batch_stream(8, 2))
+
+    assert len(got) == len(push_batches)
+    for pb, qb in zip(push_batches, got):
+        for k in pb:
+            assert pb[k].tobytes() == qb[k].tobytes()
+
+
+def test_cursor_accepts_push_plane_int_format(tmp_path):
+    """A plain {stream: seq} cursor (DataFeed's format) seeds whole-
+    block resume — blocks 0..seq drop as duplicates."""
+    from tensorflowonspark_tpu.feed.ingest import stream_id
+
+    p = _frame_file(tmp_path, n=20, records_per_frame=5)
+    m = [FileManifest(p, format="columnar")]
+    sid = stream_id(m[0])
+    feed = IngestFeed(m, input_mapping=MAPPING)
+    feed.seed_cursor({sid: 1})  # blocks 0,1 (records 0..9) already consumed
+    got = _concat(_drain(feed, 5))
+    np.testing.assert_array_equal(got, np.arange(10, 20))
+
+
+def test_seeded_cursor_survives_into_successor_cursor(tmp_path):
+    """Review regression: a successor that crashes before touching an
+    already-consumed stream must still hand ITS successor the full
+    consumed prefix — seeded state is part of cursor()'s output until
+    superseded by real progress."""
+    pa = _frame_file(tmp_path, n=20, records_per_frame=5, name="sa.colf")
+    pb = _frame_file(tmp_path, n=20, records_per_frame=5, name="sb.colf")
+    m = [
+        FileManifest(pa, format="columnar"),
+        FileManifest(pb, format="columnar"),
+    ]
+    f1 = IngestFeed(m, input_mapping=MAPPING)
+    it = f1.batch_stream(4)
+    first = [next(it) for _ in range(6)]  # all of A + 4 of B (mid-block)
+    cur1 = f1.cursor()
+    f1.terminate()
+    # incarnation 2 seeds and "crashes" IMMEDIATELY (zero progress):
+    # its snapshot must equal what it was seeded with, A included
+    f2 = IngestFeed(m, input_mapping=MAPPING)
+    f2.seed_cursor(json.loads(json.dumps(cur1)))  # via a checkpoint file
+    assert f2.cursor() == cur1
+    # ... and after one batch it must still cover stream A
+    it2 = f2.batch_stream(4)
+    mid = [next(it2)]
+    cur2 = f2.cursor()
+    f2.terminate()
+    from tensorflowonspark_tpu.feed.ingest import stream_id
+
+    assert cur2[stream_id(m[0])] == 3  # A stays fully consumed
+    f3 = IngestFeed(m, input_mapping=MAPPING)
+    f3.seed_cursor(cur2)
+    rest = list(f3.batch_stream(4))
+    got = _concat(first + mid + rest)
+    np.testing.assert_array_equal(got, np.concatenate([np.arange(20)] * 2))
+
+
+def test_mapping_less_batch_stream_cursor_is_record_exact(tmp_path):
+    """Review regression: rows sitting in fixed_size_batches' pending
+    buffer are NOT consumed — a cursor checkpointed after one emitted
+    batch must replay them (no holes), and a full run must still mark
+    the dropped sub-multiple tail consumed."""
+    p = _frame_file(tmp_path, n=20, records_per_frame=5)
+    m = [FileManifest(p, format="columnar")]
+    f1 = IngestFeed(m)
+    it = f1.batch_stream(10, multiple_of=8)
+    first = next(it)  # 8 records emitted; 2 pulled rows still pending
+    cur = f1.cursor()
+    f1.terminate()
+    f2 = IngestFeed(m)
+    f2.seed_cursor(cur)
+    rest = list(f2.batch_stream(10, multiple_of=8))
+    got = [int(r["y"]) for r in first] + [
+        int(r["y"]) for b in rest for r in b
+    ]
+    # uninterrupted run emits [0..7], [8..15]; tail 4 dropped — the
+    # resumed run must emit exactly the same set: no hole at 8..9
+    assert got == list(range(16))
+    # dropped tail counts as consumed at normal exhaustion
+    assert f2.cursor() == {list(cur)[0]: 3}
+
+
+def test_retry_honors_deadline(tmp_path):
+    """Review regression: RetryPolicy.deadline_s bounds the in-place
+    retry loop — a persistently failing shard must propagate within
+    the budget, not sleep out 99 backoffs."""
+    import time as _time
+
+    p = _frame_file(tmp_path, n=5, records_per_frame=5)
+    failpoints.arm("ingest.open_shard", "raise", count=999)
+    feed = IngestFeed(
+        [FileManifest(p, format="columnar")],
+        input_mapping=MAPPING,
+        retry=RetryPolicy(
+            max_attempts=99, base_delay=30.0, max_delay=30.0, deadline_s=0.3
+        ),
+    )
+    t0 = _time.monotonic()
+    with pytest.raises(failpoints.FailpointError):
+        _drain(feed, 4)
+    assert _time.monotonic() - t0 < 5.0
+
+
+def test_assign_shards_stable_per_executor_id(monkeypatch):
+    """Review regression: shard assignment never moves between nodes —
+    a reconfigured roster re-publishes each surviving id's ORIGINAL
+    shard (replacements included), never a re-split."""
+    from types import SimpleNamespace
+
+    from tensorflowonspark_tpu.cluster import node as tfnode_runtime
+    from tensorflowonspark_tpu.cluster import tfcluster as tfc
+
+    published = {}
+
+    class _KV:
+        def __init__(self, eid):
+            self.eid = eid
+
+        def set(self, key, value):
+            published[self.eid] = value
+
+    monkeypatch.setattr(
+        tfnode_runtime,
+        "connect_manager",
+        lambda w: _KV(w["executor_id"]),
+    )
+    c = object.__new__(tfc.TFCluster)
+    c.input_mode = tfc.InputMode.TENSORFLOW
+    c.cluster_info = [
+        {"executor_id": i, "job_name": "worker"} for i in range(3)
+    ]
+    c.cluster_meta = {"id": "t"}
+    c.server = SimpleNamespace(
+        reservations=SimpleNamespace(epoch=lambda: 0)
+    )
+    c._ingest_shards = None
+    ms = [FileManifest(f"f{i}") for i in range(7)]
+    c.assign_shards(ms)
+    original = {k: v["manifests"] for k, v in published.items()}
+    assert original == {0: ms[0::3], 1: ms[1::3], 2: ms[2::3]}
+    # executor 1 departs; re-publish over the shrunk roster: survivors
+    # keep their exact shards, nothing is re-split, shard 1 is unowned
+    published.clear()
+    c.cluster_info = [c.cluster_info[0], c.cluster_info[2]]
+    c._publish_ingest_plan()
+    assert {k: v["manifests"] for k, v in published.items()} == {
+        0: original[0],
+        2: original[2],
+    }
+    # executor 1's replacement rejoins: it gets the ORIGINAL shard 1
+    published.clear()
+    c.cluster_info.append({"executor_id": 1, "job_name": "worker"})
+    c._publish_ingest_plan()
+    assert published[1]["manifests"] == original[1]
+
+
+# -- chaos: retry / drop / relaunch ------------------------------------------
+
+
+def _fast_retry():
+    return RetryPolicy(max_attempts=3, base_delay=0.001, max_delay=0.01, seed=0)
+
+
+def test_read_block_failpoint_retries_exactly_once(tmp_path):
+    p = _frame_file(tmp_path)
+    failpoints.arm("ingest.read_block", "raise", count=1)
+    feed = IngestFeed(
+        [FileManifest(p, format="columnar")],
+        input_mapping=MAPPING,
+        retry=_fast_retry(),
+    )
+    got = _concat(_drain(feed, 4))
+    np.testing.assert_array_equal(got, np.arange(40))  # no dup, no skip
+
+
+def test_open_shard_failpoint_retries(tmp_path):
+    p = _frame_file(tmp_path)
+    failpoints.arm("ingest.open_shard", "raise", count=1)
+    feed = IngestFeed(
+        [FileManifest(p, format="columnar")],
+        input_mapping=MAPPING,
+        retry=_fast_retry(),
+    )
+    np.testing.assert_array_equal(_concat(_drain(feed, 4)), np.arange(40))
+
+
+def test_read_block_mid_shard_retry_is_exactly_once(tmp_path):
+    """The fault lands MID-shard (4 blocks already consumed): the retry
+    re-reads the shard from the top and the seq cursor must drop
+    exactly the already-delivered prefix."""
+    p = _frame_file(tmp_path)  # 8 blocks of 5
+    feed = IngestFeed(
+        [FileManifest(p, format="columnar")],
+        input_mapping=MAPPING,
+        retry=_fast_retry(),
+    )
+    it = feed.batch_stream(5)
+    batches = [next(it) for _ in range(4)]  # blocks 0-3 delivered
+    failpoints.arm("ingest.read_block", "raise", count=1)
+    batches += list(it)  # the fault hits mid-iteration; retried in place
+    np.testing.assert_array_equal(_concat(batches), np.arange(40))
+
+
+def test_dropped_block_raises_sequence_gap(tmp_path):
+    p = _frame_file(tmp_path)
+    failpoints.arm("ingest.read_block", "drop", count=1)
+    feed = IngestFeed(
+        [FileManifest(p, format="columnar")], input_mapping=MAPPING
+    )
+    with pytest.raises(RuntimeError, match="sequence gap"):
+        _drain(feed, 4)
+
+
+def test_non_retryable_fault_propagates(tmp_path):
+    """A ValueError (e.g. a corrupt frame) must NOT be retried in
+    place: it propagates so the relaunch path takes over."""
+    p = _frame_file(tmp_path)
+    failpoints.arm("ingest.read_block", "raise", exc=ValueError, count=1)
+    feed = IngestFeed(
+        [FileManifest(p, format="columnar")],
+        input_mapping=MAPPING,
+        retry=_fast_retry(),
+    )
+    with pytest.raises(ValueError):
+        _drain(feed, 4)
+
+
+def test_retries_exhausted_propagates(tmp_path):
+    p = _frame_file(tmp_path)
+    failpoints.arm("ingest.open_shard", "raise", count=99)
+    feed = IngestFeed(
+        [FileManifest(p, format="columnar")],
+        input_mapping=MAPPING,
+        retry=_fast_retry(),
+    )
+    with pytest.raises(failpoints.FailpointError):
+        _drain(feed, 4)
+
+
+# -- row-fallback (non-columnizable) shards ----------------------------------
+
+
+def test_row_fallback_pieces_and_resume(tmp_path):
+    """Ragged records fall back to RowPiece lists (same matrix as the
+    push wire); the cursor stays record-exact through the fallback."""
+    p = str(tmp_path / "ragged.txt")
+    lines = ["v" * (i % 5 + 1) + str(i) for i in range(30)]  # ragged strs
+    with open(p, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    m = [FileManifest(p, format="lines")]
+    feed = IngestFeed(m, records_per_chunk=7)
+    first = feed.next_batch(10)
+    cur = feed.cursor()
+    feed.terminate()
+    successor = IngestFeed(m, records_per_chunk=7)
+    successor.seed_cursor(cur)
+    rest = []
+    while not successor.should_stop():
+        rest.extend(successor.next_batch(10))
+    assert first + rest == lines
+    # the reader really did take the fallback path
+    reader = ShardReader(m, records_per_chunk=7)
+    from tensorflowonspark_tpu.feed.datafeed import ReplayCursor
+
+    pieces = list(reader.pieces(ReplayCursor()))
+    assert all(isinstance(pc, RowPiece) for pc in pieces)
+    assert [pc.seq for pc in pieces] == [0, 1, 2, 3, 4]
+
+
+# -- executor-local readers over TFRecord ------------------------------------
+
+
+def test_sharded_chunks_tfrecord(tmp_path):
+    from tensorflowonspark_tpu.data import dfutil
+    from tensorflowonspark_tpu.data.readers import sharded_chunks
+
+    rows = [{"v": float(i), "i": i} for i in range(23)]
+    dfutil.saveAsTFRecords(rows, str(tmp_path / "rec"))
+    seen = []
+    for shard in range(2):
+        for piece in sharded_chunks(
+            str(tmp_path / "rec"), shard, 2, records_per_chunk=4
+        ):
+            seen.extend(
+                int(np.ravel(r["i"])[0])
+                for r in (piece.rows() if isinstance(piece, col.ColumnChunk) else piece)
+            )
+    assert sorted(seen) == list(range(23))
+
+
+def test_columnar_frame_data_source(tmp_path):
+    import pickle
+
+    from tensorflowonspark_tpu.data.grain_source import (
+        ColumnarFrameDataSource,
+    )
+
+    p1 = _frame_file(tmp_path, n=11, records_per_frame=4, name="s1.colf")
+    p2 = _frame_file(tmp_path, n=7, records_per_frame=3, name="s2.colf")
+    src = ColumnarFrameDataSource([p1, p2])
+    assert len(src) == 18
+    r = src[5]
+    assert int(r["y"]) == 5
+    np.testing.assert_array_equal(r["x"], np.arange(3, dtype=np.float32) + 5)
+    assert int(src[12]["y"]) == 1  # second file, index 12-11
+    # pickle round-trip (grain worker processes) reopens lazily
+    src2 = pickle.loads(pickle.dumps(src))
+    assert int(src2[17]["y"]) == 6
+    with pytest.raises(IndexError):
+        src[18]
+
+
+# -- obs ---------------------------------------------------------------------
+
+
+def test_ingest_counters_and_span(tmp_path):
+    from tensorflowonspark_tpu.feed.ingest import metrics
+    from tensorflowonspark_tpu.obs import spans as obs_spans
+
+    met = metrics()
+    files0 = met["files"].value(format="columnar")
+    records0 = met["records"].value()
+    bytes0 = met["bytes"].value()
+    p = _frame_file(tmp_path, n=20, records_per_frame=5)
+    feed = IngestFeed(
+        [FileManifest(p, format="columnar")], input_mapping=MAPPING
+    )
+    batches = _drain(feed, 4)
+    assert met["files"].value(format="columnar") == files0 + 1
+    assert met["records"].value() == records0 + 20
+    # 20 records x (3 f32 + 1 i64) = 20 * 20 bytes
+    assert met["bytes"].value() == bytes0 + 20 * 20
+    names = {s.name for s in obs_spans.get_tracer().spans()}
+    assert "ingest.read" in names
+    assert len(batches) == 5
+
+
+def test_aggregator_derives_per_node_ingest_rate():
+    from tensorflowonspark_tpu.obs.cluster import MetricsAggregator
+    from tensorflowonspark_tpu.obs.registry import Registry
+
+    reg = Registry()
+    agg = MetricsAggregator(lambda: {}, registry=reg)
+
+    def entry(total, t):
+        return {
+            "ok": True,
+            "scraped_at": t,
+            "families": {
+                "feed_ingest_bytes_total": {
+                    "type": "counter",
+                    "samples": {("feed_ingest_bytes_total", ()): total},
+                }
+            },
+        }
+
+    agg._note_ingest_rates({1: entry(100.0, 10.0)})
+    agg._note_ingest_rates({1: entry(300.0, 12.0)})
+    assert 'cluster_node_ingest_bytes_per_s{node="1"} 100' in reg.render()
+    # a counter reset (node restart) clamps to 0, not negative
+    agg._note_ingest_rates({1: entry(0.0, 14.0)})
+    assert 'cluster_node_ingest_bytes_per_s{node="1"} 0' in reg.render()
+    # a departed node's series is dropped, not frozen at its last rate
+    agg._note_ingest_rates({2: entry(50.0, 16.0)})
+    assert 'node="1"' not in reg.render()
+    assert 1 not in agg._prev_ingest
+
+
+# -- cluster plumbing ---------------------------------------------------------
+
+
+def test_assign_shards_requires_tensorflow_mode(tmp_path):
+    """Mode misuse raises without a cluster round-trip (unit-level: a
+    minimal TFCluster stand-in carrying input_mode)."""
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode, TFCluster
+
+    c = object.__new__(TFCluster)
+    c.input_mode = InputMode.SPARK
+    with pytest.raises(RuntimeError, match="TENSORFLOW"):
+        c.assign_shards([FileManifest("x")])
+
+
+def test_fetch_ingest_plan_times_out_and_failpoint():
+    from tensorflowonspark_tpu.cluster.node import fetch_ingest_plan
+
+    class _KV:
+        def get(self, key):
+            return None
+
+    with pytest.raises(TimeoutError, match="assign_shards"):
+        fetch_ingest_plan(_KV(), timeout=0.2, poll=0.05)
+    failpoints.arm("ingest.manifest_fetch", "raise", count=1)
+    with pytest.raises(failpoints.FailpointError):
+        fetch_ingest_plan(_KV(), timeout=0.2)
+
+
+@pytest.mark.e2e
+def test_pull_plane_cluster_e2e(tmp_path):
+    """The tentpole shape end-to-end: the driver publishes record-range
+    manifests of ONE columnar file (O(files) driver bytes); every node
+    drains its shard executor-locally into mapped batches; together
+    they cover the dataset exactly once."""
+    from tensorflowonspark_tpu.cluster import tfcluster
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+    from tensorflowonspark_tpu.utils.util import cpu_only_env
+
+    from tests import cluster_fns
+
+    p = str(tmp_path / "data.colf")
+    col.write_frames(
+        p,
+        [{"x": np.float32(i)} for i in range(100)],
+        records_per_frame=8,
+    )
+    manifests = split_manifest(FileManifest(p, format="columnar"), 4)
+    cluster = tfcluster.run(
+        cluster_fns.ingest_drain_fn,
+        {"out_dir": str(tmp_path), "batch": 8},
+        num_executors=2,
+        input_mode=InputMode.TENSORFLOW,
+        reservation_timeout=120,
+        env=cpu_only_env(),
+    )
+    cluster.assign_shards(manifests)
+    cluster.shutdown(timeout=240)
+    got = []
+    for i in range(2):
+        with open(tmp_path / f"node{i}.json") as f:
+            out = json.load(f)
+        assert out["plan_epoch"] == 0
+        assert len(out["cursor"]) == 2  # two record-range streams each
+        got.extend(out["values"])
+    assert sorted(got) == [float(i) for i in range(100)]
+
+
+@pytest.mark.e2e
+@pytest.mark.slow
+def test_pull_restart_resumes_exactly_once(tmp_path):
+    """Acceptance: a node crash MID-SHARD under run_with_restarts
+    relaunches the cluster; the successor seeds the persisted replay
+    cursor and finishes — the consumed union has zero duplicates and
+    zero gaps (record-exact, the crash lands mid-block)."""
+    from tensorflowonspark_tpu.cluster import tfcluster
+    from tensorflowonspark_tpu.cluster.tfcluster import InputMode
+    from tensorflowonspark_tpu.utils.util import cpu_only_env
+
+    from tests import cluster_fns
+
+    p = str(tmp_path / "data.colf")
+    col.write_frames(
+        p,
+        [{"x": np.float32(i)} for i in range(60)],
+        records_per_frame=7,  # batch 4 cuts mid-block
+    )
+    shards = split_manifest(FileManifest(p, format="columnar"), 2)
+    restarts = tfcluster.run_with_restarts(
+        cluster_fns.ingest_restart_fn,
+        {
+            "dir": str(tmp_path),
+            "manifests": shards,  # the single node owns both ranges
+            "batch": 4,
+            "crash_after": 3,
+        },
+        num_executors=1,
+        max_restarts=2,
+        input_mode=InputMode.TENSORFLOW,
+        env=cpu_only_env(),
+        heartbeat_interval=1.0,
+        heartbeat_grace=30.0,
+    )
+    assert restarts == 1
+    with open(tmp_path / "state0.json") as f:
+        state = json.load(f)
+    assert state["attempts"] == 2
+    assert state["values"] == [float(i) for i in range(60)]
+    assert os.path.exists(tmp_path / "done0")

@@ -168,16 +168,20 @@ def test_failpoint_rule_reports_seeded_violations(fixture_findings):
         _line_of("bad_failpoint.py", "failpoint(SITE)"),
         _line_of("bad_failpoint.py", "reservation.regster"),
         _line_of("bad_failpoint.py", "elastic.epoch_bmp"),
+        _line_of("bad_failpoint.py", "ingest.read_blck"),
     }, [f.render() for f in hits]
     dynamic = [f for f in hits if "string literal" in f.message]
     unregistered = [f for f in hits if "not registered" in f.message]
-    assert len(dynamic) == 1 and len(unregistered) == 2
-    # the REGISTERED elastic sites are in the rule's registry view:
-    # the fixture's clean elastic.* literals produced no findings
+    assert len(dynamic) == 1 and len(unregistered) == 3
+    # the REGISTERED elastic + pull-plane sites are in the rule's
+    # registry view: the fixture's clean literals produced no findings
     clean_lines = {
         _line_of("bad_failpoint.py", '"elastic.epoch_bump"'),
         _line_of("bad_failpoint.py", '"elastic.reshard_gather"'),
         _line_of("bad_failpoint.py", '"elastic.rejoin_init"'),
+        _line_of("bad_failpoint.py", '"ingest.manifest_fetch"'),
+        _line_of("bad_failpoint.py", '"ingest.open_shard"'),
+        _line_of("bad_failpoint.py", '"ingest.read_block"'),
     }
     assert not clean_lines & {f.line for f in hits}
 

@@ -52,6 +52,7 @@ SLOW_SUITES = [
     "tests/test_chaos.py",
     "tests/test_elastic.py",
     "tests/test_engine_pipeline.py",
+    "tests/test_ingest.py",  # crash-mid-shard restart e2e (exactly-once)
     "tests/test_native_asan.py",
     "tests/test_native_tsan.py",
 ]
