@@ -25,6 +25,11 @@ Public surface mirrors the reference so users can switch:
 
 __version__ = "0.1.0"
 
+# utils first: its __init__ hosts the TFOS_TFSAN=1 lock-witness import
+# hook, which must patch threading BEFORE any package module's
+# module-level/ctor lock creation runs (utils/lockwitness.py).
+import tensorflowonspark_tpu.utils  # noqa: E402,F401
+
 from tensorflowonspark_tpu.cluster.tfcluster import InputMode, TFCluster  # noqa: E402
 from tensorflowonspark_tpu.feed.datafeed import DataFeed  # noqa: E402
 from tensorflowonspark_tpu.cluster.context import TFNodeContext  # noqa: E402

@@ -26,6 +26,13 @@ checked-in baseline so any NEW violation fails the build:
   engine ``_loop`` and ``train.step`` hot paths; storing values on
   ``self`` or module globals inside ``jit``-decorated functions flagged
   everywhere (a traced value outliving its trace is a leak).
+- **tfsan static head (LK003/BL001/TH001)** — lock-acquisition-order
+  cycles inferred from nested ``with lock:`` scopes across the package
+  call graph (potential ABBA deadlocks), provably-blocking calls made
+  under a lock or while a columnar frame view is live (the DESIGN.md
+  liveness rules, mechanized), and non-daemon threads never
+  ``join(timeout=)``-ed. ``tools/tfsan.py`` runs exactly these; the
+  matching RUNTIME head is ``utils/lockwitness.py`` (``TFOS_TFSAN=1``).
 
 Run it::
 

@@ -1,0 +1,309 @@
+"""BL001: provably-blocking calls under a lock or a live frame view.
+
+The other half of the tfsan static head (see :mod:`.lockorder`). The
+shm-ring feed plane's deadlock class (docs/DESIGN.md §2, liveness rules
+1–2) has one mechanical shape: an *unbounded* wait executed while this
+thread pins a resource another thread needs to make progress — a held
+lock, or a refcounted columnar frame view whose ring slot the producer
+is waiting to reuse. This rule mechanizes that review checklist.
+
+A call is *provably blocking* when it has no way to time out:
+
+- ``<queueish>.get(...)`` with no ``timeout`` (base name mentions a
+  queue role: ``queue``/``_q``/``q``; ``dict.get(k)`` never matches);
+- zero-argument ``.join()`` with no ``timeout`` (thread/process/queue
+  join — ``str.join`` always takes an argument);
+- ``.recv(...)`` / ``.recv_bytes(...)`` (sockets, multiprocessing
+  ``Connection`` — no timeout parameter exists);
+- ``.pop_frame(...)`` with no ``timeout`` (``ShmRing`` consumer pop);
+- ``.accept()`` (listening sockets).
+
+Flagged when such a call executes:
+
+1. **while a lock is lexically held** (``with <lock>:`` in scope) —
+   directly, or through the package call graph (a function that blocks,
+   called from under a lock, blocks under that lock);
+2. **while a columnar frame view is live in scope** — a local assigned
+   from ``pop_frame``/``decode_frame`` that has not been reassigned,
+   ``del``-ed or cleared to ``None`` before the blocking call. A live
+   view pins its ring slot; blocking for frame N+1 while pinning frame N
+   deadlocks the plane once frames approach the ring capacity.
+
+``# lint: blocking-ok`` on the call's line (or the enclosing ``def``
+line) suppresses the rule — for sites whose boundedness lives elsewhere
+(a peer guaranteed to close the socket, a drained queue).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tensorflowonspark_tpu.analysis.core import Config, Finding, Module, Package
+from tensorflowonspark_tpu.analysis.locks import _def_has_marker
+from tensorflowonspark_tpu.analysis.lockorder import (
+    _transitive_acquires,
+    lock_key,
+    scan_functions,
+)
+
+BLOCKING_OK_RE = re.compile(r"#\s*lint:\s*blocking-ok\b")
+_QUEUEISH_RE = re.compile(r"(?:^|_)q(?:ueue)?s?(?:_in|_out)?$|queue")
+_VIEW_CALLS = ("pop_frame", "decode_frame")
+
+__all__ = ["check"]
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return any(k.arg == "timeout" for k in call.keywords)
+
+
+def _base_name(expr: ast.AST) -> str:
+    """Final name component of the receiver expression."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def blocking_reason(call: ast.Call) -> str | None:
+    """Why this call provably blocks, or None."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    name = f.attr
+    if name == "get":
+        if (
+            not _has_timeout(call)
+            and not call.args
+            and not call.keywords
+            and _QUEUEISH_RE.search(_base_name(f.value))
+        ):
+            return "queue get() without timeout"
+        if (
+            not _has_timeout(call)
+            and call.args
+            and all(
+                isinstance(a, ast.Constant) and a.value is True
+                for a in call.args[:1]
+            )
+            and len(call.args) == 1
+            and _QUEUEISH_RE.search(_base_name(f.value))
+        ):
+            return "queue get(block=True) without timeout"
+        return None
+    if name == "join":
+        if not call.args and not _has_timeout(call):
+            return "join() without timeout"
+        return None
+    if name in ("recv", "recv_bytes"):
+        return f"{name}() (no timeout exists)"
+    if name == "pop_frame":
+        if not _has_timeout(call):
+            return "ShmRing.pop_frame() without timeout"
+        return None
+    if name == "accept" and not call.args:
+        return "socket accept()"
+    return None
+
+
+class _BlockScan(ast.NodeVisitor):
+    """Statement-ordered scan of one function: blocking calls, the lock
+    stack, and live frame-view locals at each call site."""
+
+    def __init__(self, mod: Module, cls: str | None):
+        self.mod = mod
+        self.cls = cls
+        # (node, reason, tuple(held), tuple(live_views))
+        self.blocking: list = []
+        self._held: list = []
+        self._views: dict = {}  # name -> assignment line
+
+    # nested defs are separate functions (see lockorder._FnScan)
+    def _skip(self, node):
+        pass
+
+    visit_FunctionDef = _skip
+    visit_AsyncFunctionDef = _skip
+    visit_Lambda = _skip
+
+    def _exempt(self, node) -> bool:
+        end = getattr(node, "end_lineno", node.lineno) or node.lineno
+        for line in range(node.lineno, end + 1):
+            c = self.mod.comments.get(line)
+            if c and BLOCKING_OK_RE.search(c):
+                return True
+        return False
+
+    def visit_With(self, node):
+        pushed = 0
+        for item in node.items:
+            self.visit(item.context_expr)
+            key = lock_key(self.mod, self.cls, item.context_expr)
+            if key is not None:
+                self._held.append(key)
+                pushed += 1
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        if pushed:
+            del self._held[-pushed:]
+
+    visit_AsyncWith = visit_With
+
+    def _note_views(self, targets, value) -> None:
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names:
+            return
+        is_view = False
+        if isinstance(value, ast.Call):
+            f = value.func
+            fname = (
+                f.attr
+                if isinstance(f, ast.Attribute)
+                else (f.id if isinstance(f, ast.Name) else "")
+            )
+            is_view = fname in _VIEW_CALLS
+        for n in names:
+            if is_view:
+                self._views[n] = value.lineno
+            else:
+                self._views.pop(n, None)
+
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        self._note_views(node.targets, node.value)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self.visit(node.value)
+            self._note_views([node.target], node.value)
+
+    def visit_Delete(self, node):
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                self._views.pop(t.id, None)
+
+    def visit_Call(self, node):
+        reason = blocking_reason(node)
+        if reason is not None and not self._exempt(node):
+            self.blocking.append(
+                (
+                    node,
+                    reason,
+                    tuple(self._held),
+                    tuple(sorted(self._views)),
+                )
+            )
+        self.generic_visit(node)
+
+
+def _transitive_blockers(call_edges: dict, direct: dict) -> dict:
+    """{func_key: (reason, relpath, line) | None} — the first blocking
+    call reachable from each function (its own, or a callee's)."""
+    out = dict(direct)
+    changed = True
+    while changed:
+        changed = False
+        for key, targets in call_edges.items():
+            if out.get(key) is not None:
+                continue
+            for t in sorted(targets):
+                found = out.get(t)
+                if found is not None:
+                    out[key] = found
+                    changed = True
+                    break
+    return out
+
+
+def check(pkg: Package, cfg: Config, shared=None) -> list:
+    all_funcs, call_edges, lock_scans = shared or scan_functions(pkg)
+    findings: list = []
+    direct: dict = {}  # func_key -> (reason, relpath, line) | None
+    scans: dict = {}
+
+    for key, info in all_funcs.items():
+        if _def_has_marker(info.mod, info.node, BLOCKING_OK_RE):
+            direct[key] = None
+            scans[key] = None
+            continue
+        scan = _BlockScan(info.mod, info.cls)
+        for stmt in info.node.body:
+            scan.visit(stmt)
+        scans[key] = scan
+        direct[key] = None
+        for node, reason, _held, _views in scan.blocking:
+            direct[key] = (reason, info.mod.relpath, node.lineno)
+            break
+
+    def short(lock):
+        return lock.split("::", 1)[1] if "::" in lock else lock
+
+    # direct findings: blocking under a lexically-held lock / live view
+    for key, scan in scans.items():
+        if scan is None:
+            continue
+        for node, reason, held, views in scan.blocking:
+            if held:
+                findings.append(
+                    Finding(
+                        "BL001",
+                        scan.mod.relpath,
+                        node.lineno,
+                        node.col_offset,
+                        f"provably-blocking call ({reason}) while "
+                        f"holding {', '.join(short(h) for h in held)} — "
+                        "an unbounded wait under a lock wedges every "
+                        "contender (DESIGN.md liveness rules)",
+                    )
+                )
+            elif views:
+                findings.append(
+                    Finding(
+                        "BL001",
+                        scan.mod.relpath,
+                        node.lineno,
+                        node.col_offset,
+                        f"provably-blocking call ({reason}) while frame "
+                        f"view(s) {', '.join(views)} are live in scope — "
+                        "a pinned ring slot starves the producer; clear "
+                        "the view before blocking (DESIGN.md liveness "
+                        "rule 2)",
+                    )
+                )
+
+    # call-graph findings: calling a (transitively) blocking function
+    # while lexically holding a lock
+    blockers = _transitive_blockers(call_edges, direct)
+    from tensorflowonspark_tpu.analysis.lockorder import _call_targets
+
+    for key, lscan in lock_scans.items():
+        if scans.get(key) is None:
+            continue  # function itself is blocking-ok
+        for call, held in lscan.held_calls:
+            if blocking_reason(call) is not None:
+                continue  # already reported as a direct finding
+            for target in _call_targets(call, call_edges, key):
+                found = blockers.get(target)
+                if found is None:
+                    continue
+                reason, rel, line = found
+                bscan = scans.get(key)
+                if bscan is not None and bscan._exempt(call):
+                    continue
+                findings.append(
+                    Finding(
+                        "BL001",
+                        lscan.mod.relpath,
+                        call.lineno,
+                        call.col_offset,
+                        f"call to '{target[1]}' — which blocks "
+                        f"({reason} at {rel}:{line}) — while holding "
+                        f"{', '.join(short(h) for h in held)}",
+                    )
+                )
+                break
+    return findings

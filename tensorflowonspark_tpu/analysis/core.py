@@ -36,7 +36,7 @@ __all__ = [
     "run_lint",
 ]
 
-DEFAULT_RULES = ("LK", "JX", "HS", "TL", "FP", "PF", "OB")
+DEFAULT_RULES = ("LK", "JX", "HS", "TL", "FP", "PF", "OB", "BL", "TH")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -265,9 +265,11 @@ def run_lint(root: str, cfg: Config) -> list:
     """Run every enabled analyzer over the package; findings sorted by
     (path, line, rule)."""
     from tensorflowonspark_tpu.analysis import (
+        blocking,
         failpoints as fp_rule,
         hostsync,
         jaxapi,
+        lockorder,
         locks,
         obsmetrics,
         prefetchrule,
@@ -275,8 +277,19 @@ def run_lint(root: str, cfg: Config) -> list:
 
     pkg, findings = parse_package(root, cfg)
     enabled = set(cfg.rules)
+    # the tfsan static head (LK003 + BL001) shares one package walk
+    shared = (
+        lockorder.scan_functions(pkg)
+        if {"LK", "BL"} & enabled
+        else None
+    )
     if "LK" in enabled:
         findings.extend(locks.check(pkg))
+        findings.extend(lockorder.check_lock_order(pkg, shared))
+    if "BL" in enabled:
+        findings.extend(blocking.check(pkg, cfg, shared))
+    if "TH" in enabled:
+        findings.extend(lockorder.check_threads(pkg))
     if "JX" in enabled:
         findings.extend(jaxapi.check(pkg, cfg))
     if "FP" in enabled:

@@ -1,0 +1,528 @@
+"""LK003: lock-acquisition-order cycles; TH001: unjoinable threads.
+
+Half of the tfsan static head (``tools/tfsan.py``; the other half is
+:mod:`.blocking`). Every catastrophic concurrency bug this repo has hit
+— the wedged-node authkey hang, the shm-ring view-pinned-while-blocking
+deadlocks, the unlocked ``_ring_cache`` read — lived in pure-Python
+threading code that LK001's per-attribute discipline cannot see, because
+the defect is not *which* lock guards state but the *order* locks are
+taken in and what runs while they are held.
+
+**LK003 (lock-order cycles).** Nested ``with <lock>:`` scopes define
+acquisition-order edges: acquiring B while holding A asserts "A before
+B". Edges are collected lexically per function AND across the package
+call graph (reusing :mod:`.hostsync`'s walker: a function that acquires
+B — directly or transitively — called from under A adds the same A→B
+edge). A cycle in the resulting directed graph is a potential ABBA
+deadlock: two threads entering the cycle from different nodes can each
+hold what the other needs, forever. Self-edges (re-acquiring the lock
+you hold) are flagged only when the lock is provably a non-reentrant
+``threading.Lock`` — ``with self._lock:`` nested under itself via an
+``RLock`` is legal reentrance.
+
+Lock identity is the *annotation-grade* name, not the object: within a
+class, ``self._lock`` keys as ``<module>::<Class>._lock``; module
+globals as ``<module>::<name>``; other bases textually. Distinct
+instances of one class share a key deliberately — the checker reasons
+about lock *roles* (every ``Registry._lock``), the same aggregation the
+kernel's lockdep uses, because an order inversion between two instances
+of the same role is exactly the two-object ABBA shape.
+
+**TH001 (unjoinable threads).** A non-daemon ``threading.Thread`` that
+is never ``join(timeout=...)``-ed can hang process exit forever (the
+PR-4 wedged-node class: the interpreter waits on a thread blocked on a
+dead peer). Every non-daemon thread must either be joined *with a
+timeout* somewhere in its module, or be daemonized. A bare ``join()``
+does not count: an unbounded join IS the hang.
+
+Escapes (trailing comment on the acquisition / constructor line):
+
+- ``# lint: lock-order-ok`` — this acquisition's edges are exempt
+  (a documented hierarchy violation with its own synchronization).
+- ``# lint: thread-ok`` — the thread is joined indirectly (a helper
+  owns the join) or its liveness is bounded elsewhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tensorflowonspark_tpu.analysis.core import Finding, Module, Package
+from tensorflowonspark_tpu.analysis.hostsync import _build_graph
+
+ORDER_OK_RE = re.compile(r"#\s*lint:\s*lock-order-ok\b")
+THREAD_OK_RE = re.compile(r"#\s*lint:\s*thread-ok\b")
+
+# A with-context expression is lock-like when its final name component
+# looks like a lock/condition role name. Matches the repo's actual
+# conventions (_lock, _submit_lock, _metrics_lock, _cond, _cv); a
+# factory call (`with open(...)`) is never lock-like.
+LOCKISH_RE = re.compile(r"(?:^|_)(?:lock|locks|mutex|mu)$|(?:^|_)(?:cond|cv)$")
+
+__all__ = ["check_lock_order", "check_threads", "lock_key", "LOCKISH_RE"]
+
+
+def _line_has(mod: Module, node: ast.AST, pattern: re.Pattern) -> bool:
+    end = getattr(node, "end_lineno", node.lineno) or node.lineno
+    # for compound statements the escape must sit on the HEADER lines,
+    # not anywhere in the body (a with-block's end_lineno spans it all)
+    body = getattr(node, "body", None)
+    if isinstance(body, list) and body and hasattr(body[0], "lineno"):
+        end = min(end, body[0].lineno - 1)
+    end = max(end, node.lineno)
+    for line in range(node.lineno, end + 1):
+        c = mod.comments.get(line)
+        if c and pattern.search(c):
+            return True
+    return False
+
+
+def lock_key(mod: Module, cls: str | None, expr: ast.AST) -> str | None:
+    """Stable role name for a lock-valued with-context expression, or
+    None when the expression is not lock-like."""
+    if isinstance(expr, ast.Name):
+        if LOCKISH_RE.search(expr.id):
+            return f"{mod.relpath}::{expr.id}"
+        return None
+    if isinstance(expr, ast.Attribute):
+        if not LOCKISH_RE.search(expr.attr):
+            return None
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            owner = cls or "?"
+            return f"{mod.relpath}::{owner}.{expr.attr}"
+        try:
+            base = ast.unparse(expr.value)
+        except Exception:  # pragma: no cover - unparse is total
+            return None
+        return f"{mod.relpath}::{base}.{expr.attr}"
+    return None
+
+
+def _lock_kinds(pkg: Package) -> dict:
+    """{lock_key: 'Lock'|'RLock'|'Condition'} from creation sites
+    (``<target> = threading.Lock()`` and friends). Unlisted keys have
+    unknown kind — self-edges on them are not judged."""
+    kinds: dict = {}
+
+    def note(mod, cls, target, call):
+        root = None
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id in ("threading", "_thread"):
+                root = f.attr
+        elif isinstance(f, ast.Name):
+            if f.id in ("Lock", "RLock", "Condition"):
+                root = f.id
+        if root not in ("Lock", "RLock", "Condition"):
+            return
+        key = lock_key(mod, cls, target)
+        if key is not None:
+            kinds[key] = root
+
+    for mod in pkg.modules:
+
+        def walk(node, cls):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, child.name)
+                    continue
+                if isinstance(child, ast.Assign) and isinstance(
+                    child.value, ast.Call
+                ):
+                    for t in child.targets:
+                        note(mod, cls, t, child.value)
+                elif isinstance(child, ast.AnnAssign) and isinstance(
+                    child.value, ast.Call
+                ):
+                    note(mod, cls, child.target, child.value)
+                walk(child, cls)
+
+        walk(mod.tree, None)
+    return kinds
+
+
+class _FnScan(ast.NodeVisitor):
+    """One function's lock behavior: direct acquisition-order edges,
+    the set of locks acquired anywhere in it, and every call made while
+    at least one lock is lexically held."""
+
+    def __init__(self, mod: Module, cls: str | None):
+        self.mod = mod
+        self.cls = cls
+        self.edges: list = []  # (held_key, acquired_key, line, col)
+        self.acquired: dict = {}  # key -> first (line, col)
+        self.held_calls: list = []  # (call_node, tuple(held_keys))
+        self.self_edges: list = []  # (key, line, col) Lock-reacquire shape
+        self._held: list = []
+
+    def _visit_fn(self, node):
+        # Nested defs run later, without the enclosing with-blocks held
+        # — and they are indexed as their own functions (hostsync
+        # qualnames), so they are scanned separately. Recursing here
+        # would double-count their edges AND wrongly attribute a
+        # deferred callback's acquisitions to this function's
+        # transitive-acquire set (the deferred-race shape).
+        pass
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+    visit_Lambda = _visit_fn
+
+    def visit_With(self, node):
+        exempt = _line_has(self.mod, node, ORDER_OK_RE)
+        pushed = 0
+        for item in node.items:
+            self.visit(item.context_expr)
+            key = lock_key(self.mod, self.cls, item.context_expr)
+            if key is None or exempt:
+                continue
+            if key not in self.acquired:
+                self.acquired[key] = (node.lineno, node.col_offset)
+            for held in self._held:
+                if held == key:
+                    self.self_edges.append(
+                        (key, node.lineno, node.col_offset)
+                    )
+                else:
+                    self.edges.append(
+                        (held, key, node.lineno, node.col_offset)
+                    )
+            self._held.append(key)
+            pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if pushed:
+            del self._held[-pushed:]
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):
+        if self._held:
+            self.held_calls.append((node, tuple(self._held)))
+        self.generic_visit(node)
+
+
+def scan_functions(pkg: Package):
+    """{func_key: _FnScan} over every indexed function, plus the call
+    graph — shared between this module and :mod:`.blocking` so the
+    package is walked once per tfsan pass."""
+    all_funcs, call_edges = _build_graph(pkg)
+    scans: dict = {}
+    for key, info in all_funcs.items():
+        scan = _FnScan(info.mod, info.cls)
+        # scan only the function's own body; nested defs are their own
+        # entries (visit_FunctionDef resets held state anyway)
+        for stmt in info.node.body:
+            scan.visit(stmt)
+        scans[key] = scan
+    return all_funcs, call_edges, scans
+
+
+def _transitive_acquires(call_edges: dict, scans: dict) -> dict:
+    """Fixpoint: locks acquired by a function or anything it calls."""
+    acq = {k: set(s.acquired) for k, s in scans.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, targets in call_edges.items():
+            mine = acq.setdefault(key, set())
+            before = len(mine)
+            for t in targets:
+                mine |= acq.get(t, set())
+            if len(mine) != before:
+                changed = True
+    return acq
+
+
+def _call_targets(call, call_edges, key):
+    """Resolved callee keys for one call node — the subset of this
+    function's call-graph edges the call expression can name."""
+    names = set()
+    f = call.func
+    if isinstance(f, ast.Name):
+        names.add(f.id)
+    elif isinstance(f, ast.Attribute):
+        names.add(f.attr)
+    out = []
+    for t in call_edges.get(key, ()):
+        if t[1].rsplit(".", 1)[-1] in names:
+            out.append(t)
+    return out
+
+
+def _find_cycles(graph: dict) -> list:
+    """Elementary cycles grouped per SCC (Tarjan), each reported once:
+    the cycle is rotated to start at its smallest node so the finding
+    message — the baseline key — is deterministic."""
+    index: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    sccs: list = []
+    counter = [0]
+
+    def strongconnect(v):
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    cycles = []
+    for comp in sccs:
+        # one representative cycle through the SCC: walk from the
+        # smallest node along in-SCC edges until it closes
+        start = comp[0]
+        comp_set = set(comp)
+        path = [start]
+        seen = {start}
+        node = start
+        while True:
+            nxt = None
+            for w in sorted(graph.get(node, ())):
+                if w in comp_set:
+                    nxt = w
+                    break
+            if nxt is None or nxt == start:
+                break
+            if nxt in seen:
+                # trim to the sub-cycle through nxt
+                path = path[path.index(nxt):]
+                break
+            path.append(nxt)
+            seen.add(nxt)
+            node = nxt
+        cycles.append(path)
+    return cycles
+
+
+def check_lock_order(pkg: Package, shared=None) -> list:
+    """LK003 over the whole package. ``shared`` is the optional
+    ``(all_funcs, call_edges, scans)`` triple from :func:`scan_functions`
+    so one walk serves both tfsan static rules."""
+    all_funcs, call_edges, scans = shared or scan_functions(pkg)
+    kinds = _lock_kinds(pkg)
+
+    graph: dict = {}
+    sites: dict = {}  # (a, b) -> (relpath, line, col)
+
+    def add_edge(a, b, rel, line, col):
+        graph.setdefault(a, set()).add(b)
+        key = (a, b)
+        if key not in sites or (rel, line) < sites[key][:2]:
+            sites[key] = (rel, line, col)
+
+    for key, scan in scans.items():
+        for a, b, line, col in scan.edges:
+            add_edge(a, b, scan.mod.relpath, line, col)
+
+    # call-graph propagation: a call under held locks H reaching a
+    # callee that (transitively) acquires B adds every H→B edge
+    acq = _transitive_acquires(call_edges, scans)
+    for key, scan in scans.items():
+        for call, held in scan.held_calls:
+            for target in _call_targets(call, call_edges, key):
+                for b in acq.get(target, ()):
+                    for a in held:
+                        if a != b:
+                            add_edge(
+                                a, b, scan.mod.relpath,
+                                call.lineno, call.col_offset,
+                            )
+
+    findings: list = []
+
+    def short(key):
+        return key.split("::", 1)[1] if "::" in key else key
+
+    for cycle in _find_cycles(graph):
+        ring = cycle + [cycle[0]]
+        edge_bits = []
+        for a, b in zip(ring, ring[1:]):
+            rel, line, _col = sites.get((a, b), ("?", 0, 0))
+            edge_bits.append(f"{short(a)}->{short(b)} at {rel}:{line}")
+        anchor = sites.get((ring[0], ring[1]), ("?", 0, 0))
+        findings.append(
+            Finding(
+                "LK003",
+                anchor[0],
+                anchor[1],
+                anchor[2],
+                "lock-order cycle (potential ABBA deadlock): "
+                + " -> ".join(short(k) for k in ring)
+                + "; " + "; ".join(edge_bits),
+            )
+        )
+
+    # non-reentrant self-acquisition: with self._lock: ... with
+    # self._lock: — an instant self-deadlock when the lock is a plain
+    # threading.Lock (RLock/Condition reentrance is legal)
+    for key, scan in scans.items():
+        for lkey, line, col in scan.self_edges:
+            if kinds.get(lkey) == "Lock":
+                findings.append(
+                    Finding(
+                        "LK003",
+                        scan.mod.relpath,
+                        line,
+                        col,
+                        f"re-acquisition of non-reentrant lock "
+                        f"'{short(lkey)}' already held in this scope "
+                        "(self-deadlock; use an RLock or restructure)",
+                    )
+                )
+    return findings
+
+
+# -- TH001 -------------------------------------------------------------------
+
+
+def _is_true(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+def _thread_ctor(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread":
+        return isinstance(f.value, ast.Name) and f.value.id == "threading"
+    return isinstance(f, ast.Name) and f.id == "Thread"
+
+
+def check_threads(pkg: Package) -> list:
+    """TH001: every non-daemon ``threading.Thread`` construction must
+    have a module-visible ``<target>.join(<timeout>)`` — daemonize it or
+    bound its join."""
+    findings: list = []
+    for mod in pkg.modules:
+        # pass 1: names (attr or local) with a timeout-bounded join, and
+        # names daemonized after construction (t.daemon = True)
+        joined: set = set()
+        daemonized: set = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "join"
+                    and (
+                        node.args
+                        or any(k.arg == "timeout" for k in node.keywords)
+                    )
+                    and isinstance(f.value, (ast.Name, ast.Attribute))
+                ):
+                    tgt = f.value
+                    joined.add(
+                        tgt.attr if isinstance(tgt, ast.Attribute) else tgt.id
+                    )
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and t.attr == "daemon"
+                        and _is_true(node.value)
+                        and isinstance(t.value, (ast.Name, ast.Attribute))
+                    ):
+                        base = t.value
+                        daemonized.add(
+                            base.attr
+                            if isinstance(base, ast.Attribute)
+                            else base.id
+                        )
+        # pass 2: judge each construction
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                ctor = None
+                if isinstance(node, ast.Expr) and isinstance(
+                    node.value, ast.Call
+                ):
+                    # unassigned: threading.Thread(...).start() chains
+                    inner = node.value
+                    while (
+                        isinstance(inner, ast.Call)
+                        and isinstance(inner.func, ast.Attribute)
+                        and isinstance(inner.func.value, ast.Call)
+                    ):
+                        inner = inner.func.value
+                    if isinstance(inner, ast.Call) and _thread_ctor(inner):
+                        ctor = inner
+                if ctor is None:
+                    continue
+                targets = []
+            else:
+                if not (
+                    isinstance(node.value, ast.Call)
+                    and _thread_ctor(node.value)
+                ):
+                    continue
+                ctor = node.value
+                targets = node.targets
+            daemon_kw = next(
+                (k.value for k in ctor.keywords if k.arg == "daemon"), None
+            )
+            if daemon_kw is not None:
+                if _is_true(daemon_kw) or not isinstance(
+                    daemon_kw, ast.Constant
+                ):
+                    # daemon=True, or daemon=<expr> (trusted: possibly
+                    # True at runtime — zero-FP bias)
+                    continue
+            anchor = node if targets else ctor
+            if _line_has(mod, anchor, THREAD_OK_RE):
+                continue
+            names = []
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    names.append(t.attr)
+                elif isinstance(t, ast.Name):
+                    names.append(t.id)
+            if any(n in joined or n in daemonized for n in names):
+                continue
+            label = names[0] if names else "<unassigned>"
+            findings.append(
+                Finding(
+                    "TH001",
+                    mod.relpath,
+                    anchor.lineno,
+                    anchor.col_offset,
+                    f"non-daemon thread '{label}' is never "
+                    "join(timeout=...)-ed in this module: a wedged peer "
+                    "hangs process exit forever — daemonize it or bound "
+                    "the join",
+                )
+            )
+    return findings

@@ -263,6 +263,13 @@ class ElasticTrainer:
     device list).
     """
 
+    # Max wall-clock a rejoiner waits for its own admission bump while
+    # excluded from the roster (see changed()); admission normally
+    # lands within one driver supervise poll (seconds). On expiry the
+    # exclusion is treated as a real removal — the loud error, never a
+    # silent wedge.
+    ADMISSION_GRACE_S = 120.0
+
     def __init__(
         self,
         ctx,
@@ -291,6 +298,19 @@ class ElasticTrainer:
             else list(getattr(ctx, "cluster_info", None) or [])
         )
         self._mesh = None
+        # True between hydrate() (the rejoin path) and this node's own
+        # admission bump landing: a replacement's _cur_roster can
+        # contain its executor id only because its dead PREDECESSOR was
+        # in it, which defeated changed()'s not-yet-admitted guard — a
+        # stale departure bump arriving before the admit bump made the
+        # rejoiner reconfigure onto a roster excluding itself and die
+        # loudly (race exposed by the tfsan-era instrumented chaos
+        # runs under host load). The wait is BOUNDED (one excluded
+        # epoch, one grace window) so a rejoiner that really was
+        # removed still fails loudly instead of wedging silently.
+        self._awaiting_admission = False
+        self._await_excluded_epoch: int | None = None
+        self._await_since: float | None = None
         # Set by reconfigure: None after an in-memory reshard (resume
         # where you were), or the restored checkpoint step after a
         # checkpoint_fallback — the training loop MUST rewind its step
@@ -321,16 +341,37 @@ class ElasticTrainer:
         bump it is in NEITHER side of (the departure bump published
         just before its own admission). Reconfiguring onto a roster
         that excludes it would be wrong either way, so such bumps are
-        not "changes" — its own admission bump follows within a poll."""
+        not "changes" — its own admission bump follows within a poll.
+        A REPLACEMENT needs the explicit ``_awaiting_admission`` flag
+        for this (set by :meth:`hydrate`): its ``_cur_roster`` is the
+        original cluster roster, which contains its executor id via
+        the dead predecessor, so roster membership alone cannot tell
+        "was admitted" from "inherited the dead node's seat". The wait
+        is bounded two ways — the driver folds concurrent removals and
+        admissions into one bump per supervise poll, so a SECOND
+        distinct epoch that still excludes this node means the admit
+        bump is not coming (return True; reconfigure raises the loud
+        "was removed"); and ADMISSION_GRACE_S caps the wall-clock wait
+        against a wedged driver, so a genuinely-removed rejoiner can
+        never wedge silently on a stale mesh."""
         epoch, roster = _watcher.current()
         if epoch <= self._cur_epoch:
             return False
-        if (
-            roster is not None
-            and not self._is_member(roster)
-            and not self._is_member(self._cur_roster)
-        ):
-            return False  # registered but not yet admitted
+        if roster is not None and not self._is_member(roster):
+            if self._awaiting_admission:
+                if self._await_excluded_epoch is None:
+                    self._await_excluded_epoch = epoch
+                waited = time.monotonic() - (
+                    self._await_since or time.monotonic()
+                )
+                if (
+                    epoch == self._await_excluded_epoch
+                    and waited < self.ADMISSION_GRACE_S
+                ):
+                    return False  # the predecessor's departure bump
+                return True  # excluded again/too long: really removed
+            if not self._is_member(self._cur_roster):
+                return False  # registered but not yet admitted
         return True
 
     def mesh(self):
@@ -396,6 +437,11 @@ class ElasticTrainer:
                 "was removed — re-register to rejoin instead of "
                 "reconfiguring"
             )
+        # admitted: this roster includes us — future exclusions are
+        # real removals again, not a pending admission
+        self._awaiting_admission = False
+        self._await_excluded_epoch = None
+        self._await_since = None
         t0 = time.monotonic()
         outcome = "resharded"
         restored_step: int | None = None
@@ -527,7 +573,16 @@ class ElasticTrainer:
         (outcome ``fresh_init`` — a genuinely new cluster). The
         returned state is committed onto this node's current mesh via
         ``shardings_fn``. Peer snapshots ride the authkey-authenticated
-        manager channel the data plane already trusts."""
+        manager channel the data plane already trusts.
+
+        Calling this marks the trainer as awaiting its own admission
+        bump: membership bumps whose roster excludes this node are not
+        "changes" until the driver has admitted it (see
+        :meth:`changed`) — the stale departure bump of the seat it is
+        replacing must not trigger a reconfigure."""
+        self._awaiting_admission = True
+        self._await_excluded_epoch = None
+        self._await_since = time.monotonic()
         failpoint("elastic.rejoin_init")
         from tensorflowonspark_tpu.cluster.node import connect_manager
 

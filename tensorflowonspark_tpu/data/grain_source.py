@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 from typing import Any, Sequence
 
 _LEN = struct.Struct("<Q")
@@ -192,16 +193,29 @@ class ColumnarFrameDataSource:
                 self._starts.append(total)
                 total += n
         self._total = total
+        # _mmaps is deliberately lock-free: racing first-touchers keep
+        # exactly one mapping via setdefault (see _mmap)
         self._mmaps: dict[int, Any] = {}
-        self._cache: dict[tuple[int, int], Any] = {}  # (fi, off) -> chunk
+        # grain samplers fan __getitem__ out across threads; the decoded-
+        # frame LRU is shared mutable state (tfsan dogfood — an unlocked
+        # dict pop/insert race here corrupts the eviction order or drops
+        # a racing insert mid-rehash)
+        self._cache_lock = threading.Lock()
+        self._cache: dict[tuple[int, int], Any] = {}  # (fi, off) -> chunk  # guarded-by: self._cache_lock
 
     def __getstate__(self):
-        # grain worker processes pickle the source: mmaps and decoded
-        # views are process-local, workers re-open lazily.
+        # grain worker processes pickle the source: mmaps, decoded
+        # views and the cache lock are process-local, workers re-open
+        # lazily.
         state = self.__dict__.copy()
         state["_mmaps"] = {}
         state["_cache"] = {}
+        del state["_cache_lock"]  # unpicklable; recreated in __setstate__
         return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._cache_lock = threading.Lock()
 
     def __len__(self) -> int:
         return self._total
@@ -221,14 +235,19 @@ class ColumnarFrameDataSource:
 
     def _chunk(self, fi: int, off: int):
         key = (fi, off)
-        chunk = self._cache.get(key)
+        with self._cache_lock:
+            chunk = self._cache.get(key)
         if chunk is None:
             from tensorflowonspark_tpu.feed.columnar import decode_frame
 
+            # decode outside the lock (it is the expensive part; a
+            # racing double-decode of one frame is benign — last insert
+            # wins and both views are valid)
             chunk = decode_frame(memoryview(self._mmap(fi))[off:])
-            if len(self._cache) >= self._CACHE_FRAMES:
-                self._cache.pop(next(iter(self._cache)))
-            self._cache[key] = chunk
+            with self._cache_lock:
+                if len(self._cache) >= self._CACHE_FRAMES:
+                    self._cache.pop(next(iter(self._cache)))
+                self._cache[key] = chunk
         return chunk
 
     def __getitem__(self, index: int):

@@ -367,13 +367,18 @@ class IngestFeed:
         # and records leave it in the same order, so one cumulative
         # consumption count maps back to (fully-consumed blocks, record
         # offset into the in-progress block) — the record-exact cursor.
-        self._delivered: deque = deque()  # (stream, seq, length, base)
-        self._head_consumed = 0  # records consumed from _delivered[0]
+        # cursor() runs on the training/checkpoint thread while the
+        # DevicePrefetcher producer thread advances consumption, so the
+        # bookkeeping is lock-guarded (tfsan dogfood; a torn deque/dict
+        # read here would checkpoint a cursor with holes).
+        self._cursor_lock = threading.Lock()
+        self._delivered: deque = deque()  # (stream, seq, length, base)  # guarded-by: self._cursor_lock
+        self._head_consumed = 0  # records consumed from _delivered[0]  # guarded-by: self._cursor_lock
         # stream -> consumed state: int (last fully consumed seq) or
         # [seq, skip] (seeded mid-block state not yet superseded by
         # this feed's own progress)
-        self._done: dict[str, Any] = {}
-        self._pending_skip: dict[str, tuple[int, int]] = {}  # seeded offsets
+        self._done: dict[str, Any] = {}  # guarded-by: self._cursor_lock
+        self._pending_skip: dict[str, tuple[int, int]] = {}  # seeded offsets  # guarded-by: self._cursor_lock
 
     # -- replay cursor -------------------------------------------------
     def cursor(self) -> dict[str, Any]:
@@ -384,13 +389,15 @@ class IngestFeed:
         the feed (read but never batched out) are NOT counted — a
         successor seeded with this snapshot (:meth:`seed_cursor`)
         re-reads them: zero duplicates, zero holes, mid-shard and even
-        mid-block. Checkpoint it beside the train state."""
-        out: dict[str, Any] = dict(self._done)
-        if self._delivered and self._head_consumed:
-            s, q, _ln, base = self._delivered[0]
-            if s is not None:
-                out[s] = [q - 1, base + self._head_consumed]
-        return out
+        mid-block. Checkpoint it beside the train state. Safe to call
+        from any thread while the feed is being consumed."""
+        with self._cursor_lock:
+            out: dict[str, Any] = dict(self._done)
+            if self._delivered and self._head_consumed:
+                s, q, _ln, base = self._delivered[0]
+                if s is not None:
+                    out[s] = [q - 1, base + self._head_consumed]
+            return out
 
     def seed_cursor(self, cursor: dict[str, Any]) -> None:
         """Adopt a :meth:`cursor` snapshot BEFORE consuming. Whole
@@ -407,19 +414,20 @@ class IngestFeed:
         the third incarnation would replay whole streams (duplicates).
         """
         seed: dict[str, int] = {}
-        for s, v in cursor.items():
-            s = str(s)
-            if isinstance(v, (list, tuple)):
-                seq0, skip = int(v[0]), int(v[1])
-            else:
-                seq0, skip = int(v), 0
-            if seq0 >= 0:
-                seed[s] = seq0
-            if skip > 0:
-                self._pending_skip[s] = (seq0 + 1, skip)
-                self._done[s] = [seq0, skip]
-            elif seq0 >= 0:
-                self._done[s] = seq0
+        with self._cursor_lock:
+            for s, v in cursor.items():
+                s = str(s)
+                if isinstance(v, (list, tuple)):
+                    seq0, skip = int(v[0]), int(v[1])
+                else:
+                    seq0, skip = int(v), 0
+                if seq0 >= 0:
+                    seed[s] = seq0
+                if skip > 0:
+                    self._pending_skip[s] = (seq0 + 1, skip)
+                    self._done[s] = [seq0, skip]
+                elif seq0 >= 0:
+                    self._done[s] = seq0
         self._seq.seed(seed)
 
     # -- iteration core ------------------------------------------------
@@ -440,9 +448,12 @@ class IngestFeed:
             seq = int(getattr(piece, "seq", 0))
             base = 0
             if stream is not None:
-                sk = self._pending_skip.get(stream)
-                if sk is not None and sk[0] == seq:
-                    del self._pending_skip[stream]
+                with self._cursor_lock:
+                    sk = self._pending_skip.get(stream)
+                    matched = sk is not None and sk[0] == seq
+                    if matched:
+                        del self._pending_skip[stream]
+                if matched:
                     base = min(int(sk[1]), len(piece))
                     if base:
                         piece = (
@@ -451,7 +462,8 @@ class IngestFeed:
                             else RowPiece(list(piece)[base:], stream, seq)
                         )
             if len(piece):
-                self._delivered.append((stream, seq, len(piece), base))
+                with self._cursor_lock:
+                    self._delivered.append((stream, seq, len(piece), base))
                 return piece
         return None
 
@@ -459,15 +471,16 @@ class IngestFeed:
         """Records left the feed in a batch (or were dropped at the
         tail): pop fully-consumed pieces off the delivery FIFO and
         advance the per-stream done cursor."""
-        self._head_consumed += int(n)
-        while self._delivered:
-            s, q, ln, _base = self._delivered[0]
-            if self._head_consumed < ln:
-                break
-            self._delivered.popleft()
-            self._head_consumed -= ln
-            if s is not None:
-                self._done[s] = q
+        with self._cursor_lock:
+            self._head_consumed += int(n)
+            while self._delivered:
+                s, q, ln, _base = self._delivered[0]
+                if self._head_consumed < ln:
+                    break
+                self._delivered.popleft()
+                self._head_consumed -= ln
+                if s is not None:
+                    self._done[s] = q
 
     def should_stop(self) -> bool:
         """True once the shard is exhausted AND every buffered record

@@ -1515,7 +1515,7 @@ class ContinuousBatcher:
                     prefix="engine."
                 ).items()
             },
-            "closed": self._closed,
+            "closed": self._closed,  # lint: lockfree-read: advisory /stats snapshot; a torn one-bool read is benign and the submit lock must not be taken per scrape
             **(
                 {"adapters": self._n_adapters}
                 if self._n_adapters
@@ -1556,7 +1556,7 @@ class ContinuousBatcher:
                 # queue-pop → _inflight → slot handoffs (a structural
                 # check could observe the instant a request is in none
                 # of those places and wrongly declare idle).
-                unresolved = self._accepted_total - (
+                unresolved = self._accepted_total - (  # lint: lockfree-read: drain quiescence poll; monotonic counter, a stale read only delays one 50ms iteration and taking the submit lock would contend with live submits
                     self.completed + self._failed_total
                 )
                 if unresolved == 0:

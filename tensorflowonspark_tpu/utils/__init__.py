@@ -1,5 +1,18 @@
 """Utility helpers (reference: ``tensorflowonspark/util.py``, ``compat.py``)."""
 
+import os as _os
+
+# tfsan import hook: with TFOS_TFSAN=1 in the environment, the lock
+# witness patches threading.Lock/RLock BEFORE any package module
+# constructs its locks (every package module imports utils early —
+# failpoints, retry, metrics all live here). Opt-in only; the disabled
+# path never patches anything. See utils/lockwitness.py and
+# docs/STATIC_ANALYSIS.md "Concurrency sanitizer".
+if _os.environ.get("TFOS_TFSAN") == "1":
+    from tensorflowonspark_tpu.utils import lockwitness as _lockwitness
+
+    _lockwitness.install()
+
 from tensorflowonspark_tpu.utils.util import (
     get_ip_address,
     find_in_path,

@@ -1,0 +1,726 @@
+"""tfsan runtime head: the lock witness.
+
+The static head (``analysis/lockorder.py`` + ``analysis/blocking.py``)
+reasons about code; this module watches the *process*. Under
+``TFOS_TFSAN=1`` (the import hook in ``utils/__init__``), every
+``threading.Lock()`` / ``threading.RLock()`` created from package code
+returns a :class:`WitnessLock` — a drop-in wrapper (``with``,
+``acquire``/``release``, Condition-compatible) that additionally:
+
+- **records the lock-order graph**: acquiring B while holding A adds an
+  A→B edge keyed by *creation site* (the same role aggregation the
+  kernel's lockdep uses — every ``Registry._lock`` is one node). A new
+  edge that closes a cycle is reported immediately as a potential ABBA
+  deadlock, with the acquisition stacks of both directions — the moment
+  the *second* order is first exercised, long before two threads happen
+  to interleave into the actual hang;
+- **detects real deadlocks online instead of hanging**: an unbounded
+  ``acquire`` degrades to a probe loop; while blocked, the
+  waits-for chain (thread → lock → owner → lock …) is checked, and a
+  cycle raises :class:`LockWitnessDeadlock` in one participant — the
+  witness report IS the test failure, not a 900 s suite timeout;
+- **cross-validates ``# guarded-by:`` annotations** (:func:`watch`):
+  the PR-3 static rule checks the *lexical* discipline; the witness
+  checks it is *true* — a watched object's guarded attribute touched by
+  a thread that does not hold the declared lock is a finding, with the
+  touching site. Reads on lines carrying the ``# lint: lockfree-read:``
+  escape are exempt, mirroring the static rule.
+
+Findings accumulate in-process (:func:`findings`), mirror into the obs
+flight recorder (event kind ``tfsan``), and dump as a JSON report
+(:func:`dump_json`) gated by ``tools/tfsan.py --gate`` against the
+multiset baseline ``tools/tfsan_baseline.json`` — the tfoslint ratchet
+pattern applied to runtime evidence. ``tests/plugins/tfsan.py`` wires
+dump+gate into instrumented pytest runs (``tools/run_tier1.py --slow``
+runs the chaos/elastic suites this way).
+
+Cost model (the failpoint bar): with the witness **disabled**, the
+factories are one flag check over the real constructor (<1.5 µs,
+micro-benched in ``tests/test_tfsan.py``) and nothing is patched unless
+the import hook ran. Instrumented acquires cost one small-dict
+bookkeeping under an internal lock — witness runs are a scheduled CI
+tier, not the production path.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "LockWitnessDeadlock",
+    "WitnessLock",
+    "disable",
+    "dump_json",
+    "enable",
+    "enabled",
+    "findings",
+    "guarded_attrs",
+    "install",
+    "installed",
+    "new_lock",
+    "new_rlock",
+    "reset",
+    "uninstall",
+    "watch",
+]
+
+# the REAL factories, captured before any patching
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_THIS_FILE = os.path.abspath(__file__)
+_PROBE_S = 0.05  # unbounded-acquire probe slice (deadlock check cadence)
+
+# -- witness state (all guarded by _WITNESS_LOCK unless noted) ---------------
+_WITNESS_LOCK = _REAL_LOCK()
+_enabled = False  # read lock-free on every fast path (one flag check)
+_installed = False
+_graph: dict[str, set[str]] = {}  # site -> sites acquired while held
+_held: dict[int, list["WitnessLock"]] = {}  # thread id -> held stack
+_waiting: dict[int, "WitnessLock"] = {}  # thread id -> blocked-on lock
+_findings: list[dict[str, Any]] = []
+_reported: set = set()  # dedup keys
+_locks_created = 0
+
+
+class LockWitnessDeadlock(RuntimeError):
+    """Raised out of a blocked ``acquire`` whose waits-for chain closed
+    into a cycle — the witnessed alternative to hanging forever."""
+
+
+def _site_parts(site: str) -> tuple[str, int]:
+    if ":" in site:
+        path, _, line = site.rpartition(":")
+        try:
+            return path, int(line)
+        except ValueError:
+            pass
+    return site, 0
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def reset() -> None:
+    """Clear the order graph and findings (tests; a fresh witness run).
+    Held-lock bookkeeping of live locks is preserved."""
+    with _WITNESS_LOCK:
+        _graph.clear()
+        _findings.clear()
+        _reported.clear()
+
+
+def findings() -> list[dict[str, Any]]:
+    with _WITNESS_LOCK:
+        return [dict(f) for f in _findings]
+
+
+def locks_created() -> int:
+    """Witness-wrapped locks constructed so far (coverage assertion for
+    instrumented runs: zero means the hook never fired)."""
+    return _locks_created
+
+
+def _caller_site(skip_threading: bool = True) -> str:
+    """creation/access site of the nearest frame outside this module
+    (and outside threading.py, so ``threading.Condition()``'s internal
+    ``RLock()`` is attributed to the Condition's creator)."""
+    f = sys._getframe(2)
+    threading_file = getattr(threading, "__file__", "")
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != _THIS_FILE and (not skip_threading or fn != threading_file):
+            rel = fn
+            if rel.startswith(_PKG_ROOT):
+                rel = os.path.relpath(fn, os.path.dirname(_PKG_ROOT))
+            return f"{rel}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _caller_frame_outside_witness():
+    f = sys._getframe(2)
+    while f is not None and f.f_code.co_filename == _THIS_FILE:
+        f = f.f_back
+    return f
+
+
+def _stack_text(limit: int = 8) -> str:
+    import traceback
+
+    frames = traceback.extract_stack()[:-2]
+    keep = [
+        fr for fr in frames if os.path.abspath(fr.filename) != _THIS_FILE
+    ][-limit:]
+    return "".join(traceback.format_list(keep))
+
+
+def _report(rule: str, message: str, dedup: Any, **details: Any) -> None:
+    """Record one finding (idempotent per ``dedup`` key), mirror it to
+    the log and — best effort — the obs flight recorder."""
+    with _WITNESS_LOCK:
+        if dedup in _reported:
+            return
+        _reported.add(dedup)
+        finding = {
+            "rule": rule,
+            "message": message,
+            "path": details.get("path", "runtime"),
+            "line": int(details.get("line", 0)),
+            "details": {
+                k: v for k, v in details.items() if k not in ("path", "line")
+            },
+        }
+        _findings.append(finding)
+    logger.error("tfsan: %s %s", rule, message)
+    # obs mirrors are optional wiring, never a dependency: findings ride
+    # the flight recorder (a SIGKILLed instrumented node's witness
+    # events persist in its rolling flightrec dump) and the metrics
+    # registry (node /metrics → the driver-side aggregator sees a child
+    # process's findings without reading its report file).
+    try:
+        from tensorflowonspark_tpu.obs import flightrec
+
+        flightrec.note("tfsan", rule=rule, message=message)
+    except Exception:  # pragma: no cover - obs must never break witness
+        pass
+    try:
+        from tensorflowonspark_tpu.obs.registry import default_registry
+
+        default_registry().counter(
+            "tfsan_findings_total",
+            "lock-witness findings reported by this process, by rule",
+        ).inc(rule=rule)
+    except Exception:  # pragma: no cover - obs must never break witness
+        pass
+
+
+# -- the instrumented lock ---------------------------------------------------
+
+
+class WitnessLock:
+    """Witness-instrumented ``threading.Lock``/``RLock`` stand-in.
+
+    Owner/reentrance bookkeeping lives here (a plain Lock has no owner
+    concept — the witness adds one) so the guarded-by validator can ask
+    "does the current thread hold this?" for either kind, and the
+    deadlock probe can walk owner chains. Condition-protocol methods
+    (``_release_save``/``_acquire_restore``/``_is_owned``) are provided
+    so ``threading.Condition(witness_lock)`` — including the implicit
+    RLock a bare ``Condition()`` creates under the import hook — works
+    unchanged."""
+
+    def __init__(self, kind: str, site: str):
+        self._real = _REAL_LOCK() if kind == "lock" else _REAL_RLOCK()
+        self.kind = kind
+        self.site = site
+        # racy-by-design reads (diagnostics + guard checks): a stale
+        # owner read can only miss a report, never corrupt the lock
+        self._owner: int | None = None
+        self._count = 0
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        state = f"owner={self._owner}" if self._owner else "unlocked"
+        return f"<WitnessLock {self.kind} {self.site} {state}>"
+
+    # -- order graph / deadlock machinery ------------------------------
+
+    def _note_order(self, tid: int) -> None:
+        held = _held.get(tid)
+        if not held:
+            return
+        for h in list(held):
+            a, b = h.site, self.site
+            if a == b:
+                continue  # same role (two instances of one site): skip
+            with _WITNESS_LOCK:
+                targets = _graph.setdefault(a, set())
+                new_edge = b not in targets
+                if new_edge:
+                    targets.add(b)
+                cycle = _find_path(b, a) if new_edge else None
+            if cycle is not None:
+                # _report takes the witness lock itself: call it OUTSIDE.
+                # The stack is captured only here — on the cycle-closing
+                # edge — so ordinary edge recording never pays a
+                # traceback walk. cycle is the path b..a; drop its
+                # trailing a — the ring closes back onto it when
+                # rendered.
+                ring = [a] + cycle[:-1]
+                lo = ring.index(min(ring))
+                canonical = ring[lo:] + ring[:lo]
+                path, line = _site_parts(self.site)
+                _report(
+                    "TFSAN-ORDER",
+                    "lock-order cycle (potential ABBA deadlock): "
+                    + " -> ".join(canonical + [canonical[0]]),
+                    ("order", tuple(sorted(set(ring)))),
+                    path=path,
+                    line=line,
+                    closing_stack=_stack_text(),
+                    reverse_edge=f"{b}->...->{a}",
+                )
+
+    def _deadlock_chain(self, tid: int) -> list[str] | None:
+        """waits-for cycle through this blocked acquire, or None."""
+        with _WITNESS_LOCK:
+            chain = [self.site]
+            lock = self
+            seen = set()
+            while True:
+                owner = lock._owner
+                if owner is None:
+                    return None
+                if owner == tid:
+                    return chain
+                if owner in seen:
+                    return None
+                seen.add(owner)
+                nxt = _waiting.get(owner)
+                if nxt is None:
+                    return None
+                chain.append(nxt.site)
+                lock = nxt
+
+    # -- lock protocol --------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if not _enabled:
+            return self._real.acquire(blocking, timeout)
+        tid = threading.get_ident()
+        reentrant = self._owner == tid
+        if reentrant and self.kind == "lock" and blocking and timeout < 0:
+            # guaranteed self-deadlock on a plain Lock: report and
+            # refuse to hang
+            path, line = _site_parts(self.site)
+            _report(
+                "TFSAN-DEADLOCK",
+                f"self-deadlock: non-reentrant lock {self.site} "
+                "re-acquired by its owner",
+                ("self", self.site, "reacquire"),
+                path=path,
+                line=line,
+                stack=_stack_text(),
+            )
+            raise LockWitnessDeadlock(
+                f"non-reentrant lock {self.site} re-acquired by its "
+                "owning thread (witnessed self-deadlock)"
+            )
+        if not reentrant:
+            self._note_order(tid)
+        # the acquisition itself
+        if not blocking:
+            ok = self._real.acquire(False)
+        elif timeout is not None and timeout >= 0:
+            ok = self._real.acquire(True, timeout)
+        else:
+            ok = self._real.acquire(True, _PROBE_S)
+            if not ok:
+                with _WITNESS_LOCK:
+                    _waiting[tid] = self
+                try:
+                    while True:
+                        chain = self._deadlock_chain(tid)
+                        if chain is not None:
+                            path, line = _site_parts(self.site)
+                            _report(
+                                "TFSAN-DEADLOCK",
+                                "deadlock: waits-for cycle "
+                                + " -> ".join(chain + [chain[0]]),
+                                ("deadlock", tuple(sorted(set(chain)))),
+                                path=path,
+                                line=line,
+                                stack=_stack_text(),
+                            )
+                            raise LockWitnessDeadlock(
+                                "witnessed waits-for cycle: "
+                                + " -> ".join(chain + [chain[0]])
+                            )
+                        ok = self._real.acquire(True, _PROBE_S)
+                        if ok:
+                            break
+                finally:
+                    with _WITNESS_LOCK:
+                        _waiting.pop(tid, None)
+        if ok:
+            with _WITNESS_LOCK:
+                if reentrant:
+                    self._count += 1
+                else:
+                    self._owner = tid
+                    self._count = 1
+                    _held.setdefault(tid, []).append(self)
+        return ok
+
+    def release(self) -> None:
+        if not _enabled:
+            # still clear any bookkeeping from when the witness WAS
+            # enabled: a stale _owner surviving a disable-while-held
+            # would later masquerade as a self-deadlock on a perfectly
+            # legal re-acquire after re-enable
+            if self._owner is not None:
+                with _WITNESS_LOCK:
+                    owner = self._owner
+                    self._owner = None
+                    self._count = 0
+                    if owner is not None:
+                        stack = _held.get(owner)
+                        if stack and self in stack:
+                            stack.remove(self)
+            return self._real.release()
+        tid = threading.get_ident()
+        with _WITNESS_LOCK:
+            if self._owner == tid:
+                self._count -= 1
+                if self._count <= 0:
+                    self._owner = None
+                    self._count = 0
+                    stack = _held.get(tid)
+                    if stack and self in stack:
+                        stack.remove(self)
+            else:
+                # released by a non-owner thread (Lock-as-semaphore):
+                # clear bookkeeping wherever it lives
+                self._count = 0
+                owner = self._owner
+                self._owner = None
+                if owner is not None:
+                    stack = _held.get(owner)
+                    if stack and self in stack:
+                        stack.remove(self)
+        self._real.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    # -- Condition protocol ---------------------------------------------
+
+    def _is_owned(self) -> bool:
+        if self.kind == "rlock":
+            return self._real._is_owned()
+        return self._owner == threading.get_ident()
+
+    def _release_save(self):
+        if self.kind != "rlock":
+            self.release()
+            return None
+        tid = threading.get_ident()
+        with _WITNESS_LOCK:
+            count = self._count
+            self._count = 0
+            self._owner = None
+            stack = _held.get(tid)
+            if stack and self in stack:
+                stack.remove(self)
+        return (self._real._release_save(), count)
+
+    def _acquire_restore(self, state) -> None:
+        if state is None:
+            self.acquire()
+            return
+        real_state, count = state
+        self._real._acquire_restore(real_state)
+        tid = threading.get_ident()
+        with _WITNESS_LOCK:
+            self._owner = tid
+            self._count = count
+            _held.setdefault(tid, []).append(self)
+
+    def _at_fork_reinit(self) -> None:  # pragma: no cover - fork safety
+        self._real._at_fork_reinit()
+        self._owner = None
+        self._count = 0
+
+
+def _find_path(src: str, dst: str) -> list[str] | None:
+    """DFS in the order graph; caller holds ``_WITNESS_LOCK``. Returns
+    the node path src..dst when dst is reachable."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in sorted(_graph.get(node, ())):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+# -- factories + the import hook ---------------------------------------------
+
+
+def _from_package() -> bool:
+    f = _caller_frame_outside_witness()
+    threading_file = getattr(threading, "__file__", "")
+    while f is not None and f.f_code.co_filename == threading_file:
+        f = f.f_back
+    return f is not None and os.path.abspath(
+        f.f_code.co_filename
+    ).startswith(_PKG_ROOT)
+
+
+def new_lock():
+    """``threading.Lock`` replacement: one flag check when the witness
+    is disabled (micro-benched <1.5 µs); a :class:`WitnessLock` for
+    package-code creators when enabled."""
+    if not _enabled:
+        return _REAL_LOCK()
+    if not _from_package():
+        return _REAL_LOCK()
+    global _locks_created
+    _locks_created += 1
+    return WitnessLock("lock", _caller_site())
+
+
+def new_rlock():
+    if not _enabled:
+        return _REAL_RLOCK()
+    if not _from_package():
+        return _REAL_RLOCK()
+    global _locks_created
+    _locks_created += 1
+    return WitnessLock("rlock", _caller_site())
+
+
+def installed() -> bool:
+    return _installed
+
+
+def install() -> None:
+    """Patch ``threading.Lock``/``RLock`` with the witness factories and
+    enable recording — the ``TFOS_TFSAN=1`` entry point. Only locks
+    created by package code after this call are instrumented; stdlib
+    internals (queue, multiprocessing) keep real locks."""
+    global _installed
+    if _installed:
+        enable()
+        return
+    threading.Lock = new_lock
+    threading.RLock = new_rlock
+    _installed = True
+    enable()
+    logger.warning(
+        "tfsan lock witness installed (TFOS_TFSAN); package locks are "
+        "instrumented — scheduled-tier cost, not for production serving"
+    )
+
+
+def uninstall() -> None:
+    global _installed
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _installed = False
+    disable()
+
+
+# -- guarded-by dynamic validation -------------------------------------------
+
+
+_guard_cache: dict[type, tuple[dict, set] | None] = {}
+_watched_cache: dict[type, type] = {}
+
+
+def guarded_attrs(cls: type) -> dict[str, str]:
+    """``{attr: lock_attr}`` parsed from the ``# guarded-by:``
+    annotations in ``cls``'s source module (the PR-3 static
+    convention), restricted to ``self.<lock>`` guards resolvable on an
+    instance."""
+    info = _guard_info(cls)
+    return dict(info[0]) if info else {}
+
+
+def _guard_info(cls: type) -> tuple[dict, set] | None:
+    """(attr→lock map, exempt (file,line) set) or None when the class's
+    module carries no usable annotations."""
+    if cls in _guard_cache:
+        return _guard_cache[cls]
+    result = None
+    try:
+        import inspect
+
+        from tensorflowonspark_tpu.analysis.core import Module, _comment_map
+        from tensorflowonspark_tpu.analysis.locks import (
+            LOCKFREE_RE,
+            _GuardCollector,
+        )
+
+        path = os.path.abspath(inspect.getsourcefile(cls))
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        tree = ast.parse(src, filename=path)
+        comments = _comment_map(src)
+        mod = Module(path, path, cls.__module__, tree, src, comments)
+        collector = _GuardCollector(mod)
+        collector.visit(tree)
+        guards = {
+            attr: lock.split(".", 1)[1]
+            for attr, (lock, _fn) in collector.attr_guards.items()
+            if lock.startswith("self.")
+        }
+        exempt = {
+            (path, line)
+            for line, c in comments.items()
+            if LOCKFREE_RE.search(c) and LOCKFREE_RE.search(c).group(1).strip()
+        }
+        if guards:
+            result = (guards, exempt)
+    except Exception:  # pragma: no cover - source unavailable (REPL)
+        result = None
+    _guard_cache[cls] = result
+    return result
+
+
+def _holds(lock: Any) -> bool:
+    """Does the current thread hold ``lock``? Exact for WitnessLock and
+    RLock/Condition; a plain raw Lock degrades to ``locked()`` (no
+    owner concept — a held-by-someone-else false negative is accepted
+    over a false report)."""
+    if isinstance(lock, WitnessLock):
+        return lock._owner == threading.get_ident()
+    if isinstance(lock, threading.Condition):
+        return _holds(lock._lock)
+    is_owned = getattr(lock, "_is_owned", None)
+    if is_owned is not None:
+        try:
+            return bool(is_owned())
+        except Exception:  # pragma: no cover
+            pass
+    locked = getattr(lock, "locked", None)
+    return bool(locked()) if locked is not None else True
+
+
+def _check_guard(obj: Any, attr: str, guards: dict, exempt: set) -> None:
+    lock_attr = guards[attr]
+    try:
+        lock = object.__getattribute__(obj, lock_attr)
+    except AttributeError:
+        return  # mid-construction: the lock does not exist yet
+    if _holds(lock):
+        return
+    frame = _caller_frame_outside_witness()
+    site_file = os.path.abspath(frame.f_code.co_filename) if frame else "?"
+    site_line = frame.f_lineno if frame else 0
+    if (site_file, site_line) in exempt:
+        return  # justified '# lint: lockfree-read:' site
+    cls_name = type(obj).__name__.replace("TFSanWatched_", "", 1)
+    rel = site_file
+    if rel.startswith(_PKG_ROOT):
+        rel = os.path.relpath(rel, os.path.dirname(_PKG_ROOT))
+    _report(
+        "TFSAN-GUARD",
+        f"guarded attribute {cls_name}.{attr} touched without its "
+        f"declared lock self.{lock_attr} at {rel}:{site_line}",
+        ("guard", cls_name, attr, rel, site_line),
+        path=rel,
+        line=site_line,
+        thread=threading.current_thread().name,
+    )
+
+
+def watch(obj: Any) -> Any:
+    """Swap ``obj``'s class for a witness subclass that validates every
+    guarded-attribute access against its declared lock at runtime.
+    Returns ``obj`` (unchanged when its module has no annotations).
+    Apply AFTER construction — ``__init__`` is exempt by convention
+    (the object is not yet published)."""
+    cls = type(obj)
+    if cls.__name__.startswith("TFSanWatched_"):
+        return obj
+    info = _guard_info(cls)
+    if not info:
+        return obj
+    guards, exempt = info
+    watched = _watched_cache.get(cls)
+    if watched is None:
+        names = frozenset(guards)
+
+        def __getattribute__(self, name):  # noqa: N807
+            if name in names and _enabled:
+                _check_guard(self, name, guards, exempt)
+            return object.__getattribute__(self, name)
+
+        def __setattr__(self, name, value):  # noqa: N807
+            if name in names and _enabled:
+                # writes get NO lockfree-read exemption: the escape
+                # only argues a stale READ is benign (static rule's
+                # asymmetry, mirrored here)
+                _check_guard(self, name, guards, frozenset())
+            object.__setattr__(self, name, value)
+
+        watched = type(
+            f"TFSanWatched_{cls.__name__}",
+            (cls,),
+            {
+                # empty __slots__ keeps the instance layout identical to
+                # the base (slotted or not), so __class__ assignment is
+                # legal either way
+                "__slots__": (),
+                "__getattribute__": __getattribute__,
+                "__setattr__": __setattr__,
+                "__tfsan_guards__": dict(guards),
+            },
+        )
+        _watched_cache[cls] = watched
+    obj.__class__ = watched
+    return obj
+
+
+def unwatch(obj: Any) -> Any:
+    cls = type(obj)
+    if cls.__name__.startswith("TFSanWatched_"):
+        obj.__class__ = cls.__mro__[1]
+    return obj
+
+
+# -- report dump --------------------------------------------------------------
+
+
+def dump_json(path: str) -> str:
+    """Write the witness findings as the tfsan report format
+    ``tools/tfsan.py --gate`` consumes; returns the path."""
+    data = {
+        "version": 1,
+        "kind": "tfsan-witness",
+        "pid": os.getpid(),
+        "time": time.time(),
+        "locks_created": _locks_created,
+        "findings": findings(),
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path

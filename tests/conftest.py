@@ -51,6 +51,19 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 
+# tfsan witness lifecycle (no-op unless TFOS_TFSAN=1): thin delegating
+# hooks, because pytest honors `pytest_plugins` only in the rootdir
+# conftest and this one lives under tests/.
+from tests.plugins import tfsan as _tfsan_plugin  # noqa: E402
+
+
+def pytest_configure(config):
+    _tfsan_plugin.configure(config)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    _tfsan_plugin.sessionfinish(session, exitstatus)
+
 
 @pytest.fixture(scope="session")
 def mesh8():

@@ -1,0 +1,56 @@
+"""Fixture: seeded BL001 violations — provably-blocking calls under a
+held lock, through the call graph, and with a live frame view."""
+
+import queue
+import threading
+
+
+class Consumer:
+    def __init__(self, ring, conn):
+        self._lock = threading.Lock()
+        self._queue = queue.Queue()
+        self._ring = ring
+        self._conn = conn
+        self.last = None
+
+    def drain_one(self):
+        with self._lock:
+            item = self._queue.get()  # SEEDED BL001: get() under the lock
+        return item
+
+    def wire_read(self):
+        with self._lock:
+            return self._conn.recv()  # SEEDED BL001: recv() under the lock
+
+    def _blocking_helper(self):
+        return self._queue.get()  # blocks (flagged via drain_via_helper)
+
+    def drain_via_helper(self):
+        with self._lock:
+            return self._blocking_helper()  # SEEDED BL001: call-graph block
+
+    def pinned_view_pull(self):
+        frame = self._ring.pop_frame()
+        self.last = frame.nbytes
+        return self._ring.pop_frame()  # SEEDED BL001: frame view still live
+
+    def bounded_ok(self):
+        # timeouts everywhere: none of these may flag
+        with self._lock:
+            try:
+                item = self._queue.get(timeout=1.0)
+            except queue.Empty:
+                item = None
+        frame = self._ring.pop_frame(timeout=0.5)
+        frame = None
+        return item, self._queue.get(timeout=2.0), frame
+
+    def cleared_view_ok(self):
+        frame = self._ring.pop_frame()
+        size = frame.nbytes
+        frame = None  # view cleared before the next blocking pull: clean
+        return size, self._ring.pop_frame()
+
+    def suppressed(self):
+        with self._lock:
+            return self._queue.get()  # lint: blocking-ok

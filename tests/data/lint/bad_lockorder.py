@@ -1,0 +1,51 @@
+"""Fixture: seeded LK003 violations — an ABBA lock-order cycle (direct
+and through a call), plus a non-reentrant self-re-acquisition."""
+
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._src_lock = threading.Lock()
+        self._dst_lock = threading.Lock()
+        self._plain_lock = threading.Lock()
+        self._items = []
+
+    def push(self) -> None:
+        with self._src_lock:
+            with self._dst_lock:  # SEEDED LK003: src -> dst edge
+                self._items.append(1)
+
+    def pull(self) -> None:
+        with self._dst_lock:
+            with self._src_lock:  # SEEDED LK003: dst -> src closes the cycle
+                self._items.pop()
+
+    def reenter(self) -> None:
+        with self._plain_lock:
+            with self._plain_lock:  # SEEDED LK003: non-reentrant self-deadlock
+                pass
+
+
+class CallGraphAbba:
+    """The same ABBA shape laundered through a helper call: ``outer``
+    holds ``_a_lock`` and calls ``_grab_b``; ``inverted`` nests them
+    the other way around."""
+
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self.n = 0
+
+    def _grab_b(self) -> None:
+        with self._b_lock:
+            self.n += 1
+
+    def outer(self) -> None:
+        with self._a_lock:
+            self._grab_b()  # SEEDED LK003: a -> b via the call graph
+
+    def inverted(self) -> None:
+        with self._b_lock:
+            with self._a_lock:  # the b -> a edge closing the call-graph cycle
+                self.n -= 1

@@ -1,0 +1,30 @@
+"""Fixture: seeded TH001 violations — non-daemon threads with no
+timeout-bounded join anywhere in the module."""
+
+import threading
+
+
+class Workers:
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run)  # SEEDED TH001
+        self._joined = threading.Thread(target=self._run)
+        self._daemonized = threading.Thread(target=self._run)
+        self._daemonized.daemon = True
+        self._reaper = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self) -> None:
+        pass
+
+    def close(self) -> None:
+        self._joined.join(timeout=5.0)
+
+
+def fire_and_forget() -> None:
+    threading.Thread(target=print).start()  # SEEDED TH001: unassigned
+
+    unbounded = threading.Thread(target=print)  # SEEDED TH001: bare join
+    unbounded.start()
+    unbounded.join()  # no timeout: an unbounded join IS the hang
+
+    allowed = threading.Thread(target=print)  # lint: thread-ok
+    allowed.start()

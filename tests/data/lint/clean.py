@@ -69,3 +69,47 @@ def pure_step(x):
     h = jnp.tanh(x)
     scale = 2.0  # plain local store inside jit: fine
     return h * scale
+
+
+class OrderedLocks:
+    """tfsan neighborhoods: everything here is one a naive LK003/BL001/
+    TH001 would flag."""
+
+    def __init__(self):
+        self._outer_lock = threading.Lock()
+        self._inner_lock = threading.Lock()
+        self._rentrant_lock = threading.RLock()
+        self._jobs_queue = None  # queue-ish name, bounded gets only
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._pump = threading.Thread(target=self._run)  # joined below
+        self.count = 0
+
+    def _run(self) -> None:
+        pass
+
+    def consistent_one(self) -> None:
+        # the same nesting order everywhere: a DAG, not a cycle
+        with self._outer_lock:
+            with self._inner_lock:
+                self.count += 1
+
+    def consistent_two(self) -> None:
+        with self._outer_lock:
+            with self._inner_lock:
+                self.count -= 1
+
+    def reentrant(self) -> None:
+        # RLock self-nesting is legal reentrance, not a self-deadlock
+        with self._rentrant_lock:
+            with self._rentrant_lock:
+                self.count += 1
+
+    def bounded_wait(self) -> float:
+        # blocking-with-timeout under a lock: bounded, not flagged
+        with self._outer_lock:
+            item = self._jobs_queue.get(timeout=1.0)
+        options = {"retries": 3}
+        return item, options.get("retries")  # dict.get is never queue.get
+
+    def stop(self) -> None:
+        self._pump.join(timeout=10.0)  # bounded join satisfies TH001

@@ -1,0 +1,63 @@
+"""tfsan pytest plugin: witness lifecycle for instrumented suite runs.
+
+Active only under ``TFOS_TFSAN=1`` (otherwise every hook is a no-op and
+the suite pays nothing). For an instrumented run it:
+
+- ensures the lock witness is installed and starts the session with a
+  clean finding set (``pytest_configure``);
+- at session end dumps the witness report JSON — path from
+  ``TFOS_TFSAN_REPORT``, default ``logs/tfsan-report-<pid>.json`` — and
+  prints a loud summary of any findings (``pytest_sessionfinish``).
+
+Enforcement is the separate gate, by design: ``tools/tfsan.py --gate
+<report>`` diffs the report against the multiset baseline
+``tools/tfsan_baseline.json`` and exits nonzero on unbaselined
+findings. ``tools/run_tier1.py --slow`` runs the chaos/elastic suites
+with ``TFOS_TFSAN=1`` and then the gate, so a witness finding fails the
+tier even when every test assertion passed — a deadlock that *almost*
+happened is a failure worth a red build.
+
+Wired from ``tests/conftest.py`` (thin delegating hooks — pytest only
+honors ``pytest_plugins`` in the rootdir conftest).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _active() -> bool:
+    return os.environ.get("TFOS_TFSAN") == "1"
+
+
+def report_path() -> str:
+    return os.environ.get(
+        "TFOS_TFSAN_REPORT",
+        os.path.join("logs", f"tfsan-report-{os.getpid()}.json"),
+    )
+
+
+def configure(config) -> None:
+    if not _active():
+        return
+    from tensorflowonspark_tpu.utils import lockwitness
+
+    lockwitness.install()  # idempotent; the utils import hook usually won
+    lockwitness.reset()
+
+
+def sessionfinish(session, exitstatus) -> None:
+    if not _active():
+        return
+    from tensorflowonspark_tpu.utils import lockwitness
+
+    path = lockwitness.dump_json(report_path())
+    found = lockwitness.findings()
+    print(
+        f"\ntfsan: witness report -> {path} "
+        f"({len(found)} finding(s), {lockwitness.locks_created()} "
+        "instrumented lock(s)); gate with: "
+        f"python tools/tfsan.py --gate {path}"
+    )
+    for f in found:
+        print(f"tfsan:   {f['rule']} {f['message']}")

@@ -474,8 +474,12 @@ def test_cluster_stats_and_merged_timeline_e2e(tmp_path):
         float(by_src[f"node{i}"]["clock_rtt_s"] or 0) for i in (0, 1)
     )
     # every stream that reached a node: its driver-side send spans and
-    # node-side queue_get spans coexist, and receive is not (beyond
-    # clock error) before the first send of that stream
+    # node-side queue_get spans coexist, and a receive never COMPLETES
+    # (beyond clock error) before the first send of that stream began.
+    # Completion (ts + dur), not span start: the queue_get span opens
+    # when the consumer starts WAITING, which on a fast-starting node
+    # can be well before the driver's first send — pure scheduling
+    # luck, not a causality violation.
     sends: dict = {}
     gets: dict = {}
     for e in merged["traceEvents"]:
@@ -486,7 +490,7 @@ def test_cluster_stats_and_merged_timeline_e2e(tmp_path):
         if e["name"] == "feed.send":
             sends.setdefault(key, []).append(e["ts"])
         elif e["name"] == "feed.queue_get":
-            gets.setdefault(key, []).append(e["ts"])
+            gets.setdefault(key, []).append(e["ts"] + e.get("dur", 0))
     linked = set(sends) & {k for k in gets if k[1] is not None}
     assert linked, (list(sends)[:5], list(gets)[:5])
     slack_us = (rtt_bound + 0.25) * 1e6

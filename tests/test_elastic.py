@@ -500,6 +500,72 @@ def test_elastic_trainer_preadmission_bump_is_not_a_change():
     assert trainer.changed()
 
 
+def test_elastic_replacement_ignores_predecessors_departure_bump():
+    """The replacement-seat race (caught by the tfsan-era instrumented
+    chaos runs under load): a replacement for executor 1 starts with
+    the ORIGINAL roster — which contains id 1 via its dead predecessor
+    — so roster membership alone cannot gate the stale departure bump,
+    and pre-fix the replacement reconfigured onto epoch 1's
+    [0]-only roster and died with "was removed". hydrate() now marks
+    the trainer as awaiting admission until a roster includes it."""
+    ctx = _FakeCtx(executor_id=1, cluster_info=[_meta(0), _meta(1)])
+    trainer = ElasticTrainer(ctx, devices_fn=lambda r: jax.devices()[:2])
+    # the rejoin path: no reachable peers/checkpoint → fresh_init, but
+    # the trainer is now awaiting its own admission bump
+    step, state = trainer.hydrate()
+    assert state is None
+    # the stale departure bump (predecessor removed) lands FIRST: not a
+    # change for this node — pre-fix this asserted True and the node
+    # reconfigured straight into the "was removed" error
+    elastic.notify_membership(1, [_meta(0)])
+    assert not trainer.changed()
+    # its own admission bump follows: now it reconfigures
+    elastic.notify_membership(2, [_meta(0), _meta(1)])
+    assert trainer.changed()
+    st = _trainer_state(trainer, optax.sgd(0.1))
+    st2, mesh = trainer.reconfigure(st)
+    assert trainer.epoch == 2
+    # admission clears the flag: a LATER exclusion is a real removal
+    elastic.notify_membership(3, [_meta(0)])
+    assert trainer.changed()
+    with pytest.raises(RuntimeError, match="was removed"):
+        trainer.reconfigure(st2)
+
+
+def test_elastic_replacement_admission_wait_is_bounded():
+    """The awaiting-admission suppression must not wedge a rejoiner
+    that really was removed: a SECOND distinct epoch still excluding
+    it (the driver folds concurrent removals+admits into one bump per
+    poll, so the admit bump would have been the next one), or the
+    admission grace expiring, flips changed() back to True — and
+    reconfigure raises the loud removal error."""
+    ctx = _FakeCtx(executor_id=1, cluster_info=[_meta(0), _meta(1)])
+    trainer = ElasticTrainer(ctx, devices_fn=lambda r: jax.devices()[:2])
+    trainer.hydrate()
+    # first excluded bump: suppressed (could be the predecessor's)
+    elastic.notify_membership(1, [_meta(0)])
+    assert not trainer.changed()
+    # admitted-then-removed between polls: the watcher only shows the
+    # second excluded epoch — no longer explainable as pre-admission
+    elastic.notify_membership(3, [_meta(0)])
+    assert trainer.changed()
+    st = _trainer_state(trainer, optax.sgd(0.1))
+    with pytest.raises(RuntimeError, match="was removed"):
+        trainer.reconfigure(st)
+
+    # and the wall-clock bound alone also lifts the suppression
+    elastic._watcher.reset()
+    trainer2 = ElasticTrainer(
+        _FakeCtx(executor_id=1, cluster_info=[_meta(0), _meta(1)]),
+        devices_fn=lambda r: jax.devices()[:2],
+    )
+    trainer2.hydrate()
+    elastic.notify_membership(1, [_meta(0)])
+    assert not trainer2.changed()
+    trainer2._await_since -= trainer2.ADMISSION_GRACE_S + 1
+    assert trainer2.changed()
+
+
 def test_elastic_trainer_gather_failure_without_checkpoint_is_loud():
     ctx = _FakeCtx(cluster_info=[_meta(0)])
     trainer = ElasticTrainer(

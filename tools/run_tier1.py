@@ -47,7 +47,15 @@ DEFAULT_TIMEOUT = 420.0  # per suite; the slowest tier-1 suite is ~3 min
 
 # The slow tier: suites carrying @pytest.mark.slow tests worth a
 # scheduled (not per-commit) run — chaos/elastic kill-a-real-node e2e
-# alongside the native sanitizer stress suites.
+# alongside the native sanitizer stress suites. An entry is either a
+# path, or (path, extra_env) — the concurrency-heavy suites run a
+# SECOND time under the tfsan lock witness (TFOS_TFSAN=1): every
+# package lock instrumented, findings dumped to a report that the
+# tools/tfsan.py gate diffs against tools/tfsan_baseline.json after
+# the suite — a witnessed near-deadlock fails the tier even when every
+# test assertion passed (docs/STATIC_ANALYSIS.md "Concurrency
+# sanitizer").
+TFSAN_ENV = {"TFOS_TFSAN": "1"}
 SLOW_SUITES = [
     "tests/test_chaos.py",
     "tests/test_elastic.py",
@@ -55,6 +63,8 @@ SLOW_SUITES = [
     "tests/test_ingest.py",  # crash-mid-shard restart e2e (exactly-once)
     "tests/test_native_asan.py",
     "tests/test_native_tsan.py",
+    ("tests/test_chaos.py", TFSAN_ENV),
+    ("tests/test_elastic.py", TFSAN_ENV),
 ]
 SLOW_TIMEOUT = 900.0
 
@@ -79,10 +89,20 @@ def parse_failures(output: str) -> list[str]:
     return sorted(set(out))
 
 
-def run_suite(path: str, timeout: float, marker: str = "not slow") -> dict:
+def run_suite(
+    path: str,
+    timeout: float,
+    marker: str = "not slow",
+    extra_env: dict | None = None,
+) -> dict:
     """One suite in its own pytest process. A timeout (or a crashed
     interpreter with unparsable output) fails the WHOLE suite under a
-    synthetic ``<path>::<marker>`` id so the diff stays set-shaped."""
+    synthetic ``<path>::<marker>`` id so the diff stays set-shaped.
+
+    With ``extra_env`` containing ``TFOS_TFSAN=1`` the child runs
+    witness-instrumented: a report path is injected and the
+    ``tools/tfsan.py`` gate runs after the suite — unbaselined witness
+    findings fail the suite under ``<path>::TFSAN_GATE``."""
     cmd = [
         sys.executable,
         "-m",
@@ -100,6 +120,23 @@ def run_suite(path: str, timeout: float, marker: str = "not slow") -> dict:
         "no:randomly",
     ]
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    tfsan_report = None
+    if extra_env:
+        env.update(extra_env)
+        if extra_env.get("TFOS_TFSAN") == "1":
+            tfsan_report = os.path.join(
+                REPO_ROOT,
+                "logs",
+                f"tfsan-{os.path.basename(path).replace('.py', '')}.json",
+            )
+            env.setdefault("TFOS_TFSAN_REPORT", tfsan_report)
+            tfsan_report = env["TFOS_TFSAN_REPORT"]
+            # a stale report from an earlier run must not gate a
+            # crashed child green
+            try:
+                os.remove(tfsan_report)
+            except OSError:
+                pass
     t0 = time.monotonic()
     try:
         proc = subprocess.run(
@@ -126,13 +163,41 @@ def run_suite(path: str, timeout: float, marker: str = "not slow") -> dict:
     # negative = signal. Unparsable nonzero exits must not pass silently.
     if proc.returncode not in (0, 1, 5) and not failed:
         failed = [f"{path}::EXIT{proc.returncode}"]
+    gate_tail = ""
+    if tfsan_report is not None:
+        try:
+            gate = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(REPO_ROOT, "tools", "tfsan.py"),
+                    "--gate",
+                    tfsan_report,
+                ],
+                cwd=REPO_ROOT,
+                capture_output=True,
+                text=True,
+                timeout=60,
+            )
+            # stderr matters: a missing report (crashed child) reports
+            # its cause there, not on stdout
+            gate_rc = gate.returncode
+            gate_out = gate.stdout + (
+                ("\n" + gate.stderr) if gate.stderr else ""
+            )
+        except subprocess.TimeoutExpired as e:
+            # a hung gate fails THIS suite, not the whole tier run
+            gate_rc = -1
+            gate_out = f"gate timed out after 60s: {e}"
+        if gate_rc != 0:
+            failed = sorted(set(failed) | {f"{path}::TFSAN_GATE"})
+            gate_tail = "\n[tfsan gate]\n" + gate_out[-1500:]
     return {
         "path": path,
         "rc": proc.returncode,
         "timed_out": False,
         "duration_s": round(time.monotonic() - t0, 1),
         "failed": failed,
-        "output_tail": proc.stdout[-2000:],
+        "output_tail": proc.stdout[-2000:] + gate_tail,
     }
 
 
@@ -197,6 +262,8 @@ def main(argv: list[str] | None = None) -> int:
         if args.slow
         else discover(os.path.join(REPO_ROOT, "tests"))
     )
+    # normalize: plain path, or (path, extra_env) for instrumented runs
+    suites = [s if isinstance(s, tuple) else (s, None) for s in suites]
     if not suites:
         print("run_tier1: no suites found", file=sys.stderr)
         return 2
@@ -215,15 +282,16 @@ def main(argv: list[str] | None = None) -> int:
     )
     all_failed: set[str] = set()
     t0 = time.monotonic()
-    for i, suite in enumerate(suites, 1):
-        res = run_suite(suite, timeout, marker=marker)
+    for i, (suite, extra_env) in enumerate(suites, 1):
+        res = run_suite(suite, timeout, marker=marker, extra_env=extra_env)
         status = (
             "TIMEOUT"
             if res["timed_out"]
             else ("ok" if not res["failed"] else f"{len(res['failed'])} failed")
         )
+        label = suite + (" [tfsan]" if extra_env else "")
         print(
-            f"[{i}/{len(suites)}] {suite}: {status} "
+            f"[{i}/{len(suites)}] {label}: {status} "
             f"({res['duration_s']}s)",
             flush=True,
         )
@@ -255,7 +323,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.suites:
         # partial run: only baseline entries belonging to the suites
         # that actually ran can be judged fixed/expected
-        ran = set(suites)
+        ran = {p for p, _env in suites}
         baseline = {
             f for f in baseline if f.split("::", 1)[0] in ran
         }

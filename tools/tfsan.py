@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""tfsan CLI — the concurrency sanitizer's two heads in one gate.
+
+**Static head** (default): run the tfsan lint rules — LK003 lock-order
+cycles, BL001 provably-blocking calls under a lock / live frame view,
+TH001 unjoinable non-daemon threads — over the whole package, judged
+against the committed tfoslint baseline (the tfsan rules share it; it
+is empty). Completes in seconds (one parse pass, docs/STATIC_ANALYSIS.md).
+
+**Runtime gate** (``--gate <report.json>``): diff a lock-witness report
+(produced by an instrumented run: ``TFOS_TFSAN=1``, dumped by
+``tests/plugins/tfsan.py`` or ``utils.lockwitness.dump_json``) against
+the multiset baseline ``tools/tfsan_baseline.json`` — the tfoslint
+ratchet applied to runtime findings. Unbaselined findings exit 1;
+stale baseline entries are reported so the baseline only shrinks.
+
+Usage (from the repo root)::
+
+    python tools/tfsan.py                       # static head, whole package
+    python tools/tfsan.py --gate logs/tfsan-report-1234.json
+    python tools/tfsan.py --gate r.json --write-baseline   # accept findings
+
+Exit codes: 0 clean, 1 new findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import types
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# Stub parent package (same trick as tools/tfoslint.py): the analyzers
+# are stdlib-only and must not pay the ~8 s jax import of the real
+# package __init__.
+if "tensorflowonspark_tpu" not in sys.modules:
+    _stub = types.ModuleType("tensorflowonspark_tpu")
+    _stub.__path__ = [os.path.join(_REPO_ROOT, "tensorflowonspark_tpu")]
+    sys.modules["tensorflowonspark_tpu"] = _stub
+
+TFSAN_RULES = frozenset({"LK003", "BL001", "TH001"})
+DEFAULT_RUNTIME_BASELINE = os.path.join("tools", "tfsan_baseline.json")
+
+
+def run_static(root: str) -> int:
+    from tensorflowonspark_tpu.analysis.core import (
+        apply_baseline,
+        load_baseline,
+        load_config,
+        run_lint,
+    )
+
+    cfg = load_config(root)
+    findings = [
+        f for f in run_lint(root, cfg) if f.rule in TFSAN_RULES
+    ]
+    baseline = {}
+    if cfg.baseline:
+        baseline = {
+            k: n
+            for k, n in load_baseline(
+                os.path.join(root, cfg.baseline)
+            ).items()
+            if k[0] in TFSAN_RULES
+        }
+    new, suppressed, stale = apply_baseline(findings, baseline)
+    for f in new:
+        print(f.render())
+    if suppressed:
+        print(f"tfsan: {len(suppressed)} baselined finding(s) suppressed")
+    for (rule, path, msg), n in stale:
+        print(f"tfsan: stale baseline entry ({n} unused): {rule} {path}: {msg}")
+    if new:
+        print(f"tfsan: {len(new)} new static violation(s)")
+        return 1
+    print(
+        f"tfsan: static head clean "
+        f"({len(findings)} finding(s), all baselined)"
+    )
+    return 0
+
+
+def _load_report_findings(path: str) -> list:
+    from tensorflowonspark_tpu.analysis.core import Finding
+
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out = []
+    for e in data.get("findings", []):
+        out.append(
+            Finding(
+                str(e.get("rule", "TFSAN")),
+                str(e.get("path", "runtime")),
+                int(e.get("line", 0)),
+                0,
+                str(e.get("message", "")),
+            )
+        )
+    return out
+
+
+def run_gate(root: str, report: str, baseline_path: str, write: bool) -> int:
+    from tensorflowonspark_tpu.analysis.core import (
+        apply_baseline,
+        load_baseline,
+        write_baseline,
+    )
+
+    try:
+        findings = _load_report_findings(report)
+    except (OSError, ValueError) as e:
+        print(f"tfsan: cannot read report {report!r}: {e}", file=sys.stderr)
+        return 2
+    if write:
+        write_baseline(baseline_path, findings)
+        print(
+            f"tfsan: wrote {len(findings)} finding(s) to "
+            f"{os.path.relpath(baseline_path, root)} — every entry needs "
+            "a justification before CI will hold"
+        )
+        return 0
+    new, suppressed, stale = apply_baseline(
+        findings, load_baseline(baseline_path)
+    )
+    for f in new:
+        print(f"{f.path}:{f.line}: {f.rule} {f.message}")
+    if suppressed:
+        print(f"tfsan: {len(suppressed)} baselined finding(s) suppressed")
+    for (rule, path, msg), n in stale:
+        print(f"tfsan: stale baseline entry ({n} unused): {rule} {path}: {msg}")
+    if new:
+        print(f"tfsan: {len(new)} unbaselined witness finding(s)")
+        return 1
+    print(f"tfsan: witness report clean ({len(findings)} finding(s))")
+    return 0
+
+
+def main(argv: list | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tfsan",
+        description="concurrency sanitizer: static lock-order/blocking "
+        "lint + runtime lock-witness gate",
+    )
+    ap.add_argument("--root", default=_REPO_ROOT)
+    ap.add_argument(
+        "--gate",
+        metavar="REPORT",
+        default=None,
+        help="gate a runtime witness report JSON instead of the static head",
+    )
+    ap.add_argument(
+        "--baseline",
+        default=None,
+        help=f"runtime baseline (default {DEFAULT_RUNTIME_BASELINE})",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="with --gate: accept the report's findings into the baseline",
+    )
+    args = ap.parse_args(argv)
+    root = args.root
+    if args.gate is None:
+        if args.write_baseline:
+            ap.error("--write-baseline requires --gate (the static head "
+                     "shares the tfoslint baseline; use tools/tfoslint.py)")
+        return run_static(root)
+    baseline = args.baseline or DEFAULT_RUNTIME_BASELINE
+    if not os.path.isabs(baseline):
+        baseline = os.path.join(root, baseline)
+    return run_gate(root, args.gate, baseline, args.write_baseline)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
