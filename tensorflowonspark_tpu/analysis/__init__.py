@@ -26,6 +26,15 @@ checked-in baseline so any NEW violation fails the build:
   engine ``_loop`` and ``train.step`` hot paths; storing values on
   ``self`` or module globals inside ``jit``-decorated functions flagged
   everywhere (a traced value outliving its trace is a leak).
+- **SH (sharding/layout — shardcheck static head)** — every
+  ``PartitionSpec``/``NamedSharding`` must come from the declarative
+  layout table (``compute/layout.py``; escape ``# lint: layout-ok:
+  <why>``), spec axis names must be declared in ``MESH_AXES``, jits on
+  the hot call graph must carry ``in_shardings``/donation for large
+  array params, and literal ``with_sharding_constraint`` specs must
+  match a table rule. The matching TRACE head is
+  ``analysis/shardcheck.py`` + ``tools/shardcheck.py`` (collective
+  census of the lowered train step vs a committed baseline).
 - **tfsan static head (LK003/BL001/TH001)** — lock-acquisition-order
   cycles inferred from nested ``with lock:`` scopes across the package
   call graph (potential ABBA deadlocks), provably-blocking calls made

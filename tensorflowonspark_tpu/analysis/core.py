@@ -36,7 +36,9 @@ __all__ = [
     "run_lint",
 ]
 
-DEFAULT_RULES = ("LK", "JX", "HS", "TL", "FP", "PF", "OB", "BL", "TH")
+DEFAULT_RULES = (
+    "LK", "JX", "HS", "TL", "FP", "PF", "OB", "BL", "TH", "SH",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,6 +64,8 @@ class Config:
     # LK/JX/HS knobs (see each analyzer module)
     compat_module: str = "tensorflowonspark_tpu/utils/compat.py"
     failpoints_module: str = "tensorflowonspark_tpu/utils/failpoints.py"
+    # the declarative layout table the SH rules enforce (analysis/sharding.py)
+    layout_module: str = "tensorflowonspark_tpu/compute/layout.py"
     moved_jax_symbols: tuple = ("shard_map", "lax.axis_size")
     hot_roots: tuple = (
         "tensorflowonspark_tpu/serving/engine.py::ContinuousBatcher._loop",
@@ -167,6 +171,8 @@ def load_config(root: str, pyproject: str | None = None) -> Config:
         cfg.compat_module = section["compat_module"]
     if "failpoints_module" in section:
         cfg.failpoints_module = section["failpoints_module"]
+    if "layout_module" in section:
+        cfg.layout_module = section["layout_module"]
     if "moved_jax_symbols" in section:
         cfg.moved_jax_symbols = tuple(section["moved_jax_symbols"])
     if "hot_roots" in section:
@@ -273,6 +279,7 @@ def run_lint(root: str, cfg: Config) -> list:
         locks,
         obsmetrics,
         prefetchrule,
+        sharding as sharding_rule,
     )
 
     pkg, findings = parse_package(root, cfg)
@@ -292,6 +299,8 @@ def run_lint(root: str, cfg: Config) -> list:
         findings.extend(lockorder.check_threads(pkg))
     if "JX" in enabled:
         findings.extend(jaxapi.check(pkg, cfg))
+    if "SH" in enabled:
+        findings.extend(sharding_rule.check(pkg, cfg))
     if "FP" in enabled:
         findings.extend(fp_rule.check(pkg, cfg))
     if "PF" in enabled:

@@ -105,7 +105,12 @@ def _index_module(mod: Module):
 
 
 def _build_graph(pkg: Package):
-    """functions-by-key plus call edges {key: set(key)}."""
+    """functions-by-key plus call edges {key: set(key)} — built once
+    per lint run (memoized on the Package: the HS and SH analyzers both
+    walk the same graph)."""
+    cached = getattr(pkg, "_call_graph", None)
+    if cached is not None:
+        return cached
     per_mod = {m.relpath: _index_module(m) for m in pkg.modules}
     # module name -> relpath, for resolving intra-package imports
     mod_by_name = {m.name: m.relpath for m in pkg.modules}
@@ -155,6 +160,7 @@ def _build_graph(pkg: Package):
                         trel = mod_by_name.get(f"{m}.{a}" if a else m)
                         if trel and attr in module_funcs(trel):
                             targets.add((trel, attr))
+    pkg._call_graph = (all_funcs, edges)
     return all_funcs, edges
 
 

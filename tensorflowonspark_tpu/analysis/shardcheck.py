@@ -1,0 +1,243 @@
+"""shardcheck trace head: collective census of a jitted program.
+
+The layout table (``compute/layout.py``) declares what the sharding
+SHOULD be; the SH lint rules prove the code consumes the table. This
+module proves what the table actually BUYS: it lowers a program
+abstractly (no parameter memory is ever allocated — ``ShapeDtypeStruct``
+leaves all the way down) and counts the collective/reshard operations
+in two places:
+
+- **jaxpr head** (:func:`jaxpr_census`) — explicit collectives the
+  program itself contains (``psum``/``all_gather``/``all_to_all``/… from
+  shard_map'd kernels: ring attention, Ulysses, MoE dispatch, the BN
+  cross-shard stats). Each count carries *parameter provenance*: a
+  forward dataflow walk maps every collective's operands back to the
+  top-level inputs that feed them, so a census line reads
+  ``psum[params/layer0/attn/q_proj/kernel]``, not just ``psum: 3``.
+- **HLO head** (:func:`hlo_census`) — collectives *XLA's SPMD
+  partitioner inserts* to satisfy the shardings (the GSPMD pass runs at
+  compile time, so jaxprs never show these). This is where a layout
+  edit's hidden all-gather lives: drop the fsdp axis from one param
+  rule and the weight suddenly all-gathers every step — invisible in
+  the jaxpr, a count diff here.
+
+``tools/shardcheck.py`` drives this against the real train step
+(:func:`compute.train.make_step_fn`) on faux CPU devices and gates the
+result against a committed per-model baseline
+(``tools/shardcheck_baseline.json``): an unintended collective becomes
+a tier-1 diff, not a silent MFU regression.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Any, Mapping
+
+__all__ = [
+    "COLLECTIVE_PRIMITIVES",
+    "HLO_COLLECTIVES",
+    "census",
+    "diff_census",
+    "hlo_census",
+    "jaxpr_census",
+]
+
+# jaxpr-level collective/reshard primitives worth counting. axis_index
+# and friends are cheap/local; these move data across devices.
+COLLECTIVE_PRIMITIVES = frozenset(
+    {
+        "all_gather",
+        "all_gather_invariant",
+        "all_to_all",
+        "pbroadcast",
+        "pgather",
+        "ppermute",
+        "psum",
+        "psum2",  # shard_map's rewritten psum on jax 0.4.x
+        "psum_invariant",
+        "psum_scatter",
+        "reduce_scatter",
+    }
+)
+
+# post-SPMD HLO collective opcodes (async '-start' forms count once;
+# their '-done' halves do not).
+HLO_COLLECTIVES = (
+    "all-gather",
+    "all-reduce",
+    "all-to-all",
+    "collective-broadcast",
+    "collective-permute",
+    "reduce-scatter",
+)
+
+_HLO_RE = re.compile(
+    r"=\s+(?:\([^)]*\)|(?P<shape>[a-z0-9]+\[[0-9,]*\]))\S*\s+"
+    r"(?P<op>" + "|".join(HLO_COLLECTIVES) + r")(?:-start)?\("
+)
+
+_MAX_PROVENANCE_LABELS = 3
+
+
+def _leaf_labels(args: tuple, arg_names: tuple | None = None) -> list:
+    """Flattened '/'-joined path label per leaf of ``args``, prefixed
+    by the argument's name (matching the order jax flattens tracing
+    inputs: per-arg pytree order)."""
+    import jax
+
+    from tensorflowonspark_tpu.compute.layout import _path_name
+
+    labels: list = []
+    for i, arg in enumerate(args):
+        prefix = (
+            arg_names[i]
+            if arg_names and i < len(arg_names)
+            else f"arg{i}"
+        )
+        leaves, _ = jax.tree_util.tree_flatten_with_path(arg)
+        for path, _leaf in leaves:
+            name = _path_name(path)
+            labels.append(f"{prefix}/{name}" if name else prefix)
+    return labels
+
+
+def _provenance_key(prim: str, labels: frozenset) -> str:
+    if not labels:
+        return prim
+    ordered = sorted(labels)
+    if len(ordered) > _MAX_PROVENANCE_LABELS:
+        ordered = ordered[:_MAX_PROVENANCE_LABELS] + [
+            f"+{len(labels) - _MAX_PROVENANCE_LABELS}"
+        ]
+    return f"{prim}[{';'.join(ordered)}]"
+
+
+def _sub_jaxprs(params: Mapping[str, Any]):
+    """Every (Closed)Jaxpr hiding in an eqn's params (pjit 'jaxpr',
+    scan/while bodies, cond 'branches', remat, custom_vjp, …)."""
+    for value in params.values():
+        stack = [value]
+        while stack:
+            v = stack.pop()
+            if isinstance(v, (tuple, list)):
+                stack.extend(v)
+            elif hasattr(v, "jaxpr") and hasattr(v, "consts"):
+                yield v.jaxpr  # ClosedJaxpr
+            elif hasattr(v, "eqns") and hasattr(v, "invars"):
+                yield v  # raw Jaxpr
+
+
+def _walk_jaxpr(jaxpr, env: dict, counts: Counter) -> None:
+    """Forward dataflow over one jaxpr: ``env`` maps vars to frozensets
+    of root labels; collectives record (primitive, provenance)."""
+
+    def read(v) -> frozenset:
+        if hasattr(v, "val"):  # Literal
+            return frozenset()
+        return env.get(v, frozenset())
+
+    for eqn in jaxpr.eqns:
+        in_labels = frozenset()
+        for v in eqn.invars:
+            in_labels |= read(v)
+        prim = eqn.primitive.name
+        if prim in COLLECTIVE_PRIMITIVES:
+            counts[_provenance_key(prim, in_labels)] += 1
+        for sub in _sub_jaxprs(eqn.params):
+            sub_env: dict = {}
+            # positional best-effort: pjit/call line up 1:1; scan/while
+            # prepend consts — close enough for provenance, and the
+            # fallback (empty label set) is safe
+            for outer, inner in zip(eqn.invars, sub.invars):
+                sub_env[inner] = read(outer)
+            _walk_jaxpr(sub, sub_env, counts)
+        for v in eqn.outvars:
+            env[v] = in_labels
+
+
+def jaxpr_census(fn, args: tuple, arg_names: tuple | None = None) -> dict:
+    """{'<prim>[<roots>]': count} for explicit collectives in ``fn``
+    traced at ``args`` (arrays or ShapeDtypeStructs)."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    labels = _leaf_labels(args, arg_names)
+    jaxpr = closed.jaxpr
+    env = {
+        var: frozenset({label})
+        for var, label in zip(jaxpr.invars, labels)
+    }
+    counts: Counter = Counter()
+    _walk_jaxpr(jaxpr, env, counts)
+    return dict(sorted(counts.items()))
+
+
+def hlo_census(
+    fn,
+    args: tuple,
+    in_shardings: Any = None,
+    out_shardings: Any = None,
+    donate_argnums: tuple = (),
+) -> dict:
+    """{'<op> <shape>': count} of collectives in the SPMD-partitioned,
+    compiled HLO — the GSPMD-inserted traffic the jaxpr cannot show.
+    AOT: no buffers are allocated, only compiled."""
+    import jax
+
+    kwargs: dict = {"donate_argnums": donate_argnums}
+    if in_shardings is not None:
+        kwargs["in_shardings"] = in_shardings
+    if out_shardings is not None:
+        kwargs["out_shardings"] = out_shardings
+    lowered = jax.jit(fn, **kwargs).lower(*args)
+    text = lowered.compile().as_text()
+    counts: Counter = Counter()
+    for m in _HLO_RE.finditer(text):
+        shape = m.group("shape") or "tuple"
+        counts[f"{m.group('op')} {shape}"] += 1
+    return dict(sorted(counts.items()))
+
+
+def census(
+    fn,
+    args: tuple,
+    in_shardings: Any = None,
+    out_shardings: Any = None,
+    donate_argnums: tuple = (),
+    meta: Mapping[str, Any] | None = None,
+    arg_names: tuple | None = None,
+) -> dict:
+    """Both heads plus metadata. ``meta`` records HOW the census was
+    taken (model, mesh, shapes, jax version); the gate compares only
+    the census dicts, so environment drift is visible but not load-
+    bearing."""
+    import jax
+
+    full_meta = {"jax_version": jax.__version__}
+    full_meta.update(meta or {})
+    return {
+        "meta": full_meta,
+        "jaxpr": jaxpr_census(fn, args, arg_names),
+        "hlo": hlo_census(
+            fn,
+            args,
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+            donate_argnums=donate_argnums,
+        ),
+    }
+
+
+def diff_census(baseline: Mapping[str, Any], current: Mapping[str, Any]):
+    """Human-readable diff lines between two census dicts ('' == equal).
+    Compares the 'jaxpr' and 'hlo' heads only — meta is informational."""
+    lines: list = []
+    for head in ("jaxpr", "hlo"):
+        base = dict(baseline.get(head, {}))
+        cur = dict(current.get(head, {}))
+        for key in sorted(set(base) | set(cur)):
+            b, c = base.get(key, 0), cur.get(key, 0)
+            if b != c:
+                lines.append(f"{head}: {key}: baseline {b} != current {c}")
+    return lines

@@ -1,0 +1,552 @@
+"""SH: the sharding/layout static head — shardcheck's lint half.
+
+The layout table (``compute/layout.py``) is only a single source of
+truth while nothing constructs specs behind its back. These rules make
+that structural:
+
+- **SH001** — raw ``PartitionSpec(`` / ``NamedSharding(`` constructed
+  outside the layout module. Every spec must come from the declarative
+  table (``layout.param_shardings``, the role helpers) so a layout
+  change is a table edit with a machine-checked blast radius. Escape
+  for a deliberate exception: ``# lint: layout-ok: <why>`` on the
+  construction line — the justification is mandatory (an empty one
+  does not suppress).
+- **SH002** — a string axis name in a ``PartitionSpec(...)`` literal
+  (or in the layout module's own table entries) that the active layout
+  does not declare in ``MESH_AXES``. Catches the ``P("fdsp")`` typo
+  class at parse time instead of as a runtime mesh KeyError — or
+  worse, a silently-replicated dim.
+- **SH003** — a jit site on the hot call graph (the same
+  walker/roots as the HS rules: ``build_train_step``,
+  ``ContinuousBatcher._loop``) whose wrapped function takes large
+  array params (by name convention: ``params``/``state``/``cache``/…)
+  but passes neither ``in_shardings`` nor ``donate_argnums``. On the
+  hot path, an unconstrained jit recompiles per placement drift and
+  silently double-buffers donated-able state. Same escape comment.
+- **SH004** — a literal ``with_sharding_constraint`` spec that cannot
+  match any rule the layout table declares. Constraints are pins of
+  table-declared layouts at program boundaries; a constraint the table
+  cannot produce either fights the table (hidden reshard — exactly the
+  all-gather class ``tools/shardcheck.py`` censuses) or is a typo.
+
+The layout module's tables are **pure literals** precisely so this
+analyzer can read them by AST without importing jax; see
+``compute/layout.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tensorflowonspark_tpu.analysis.core import Config, Finding, Module, Package
+
+__all__ = ["check"]
+
+LAYOUT_OK_RE = re.compile(r"#\s*lint:\s*layout-ok:\s*\S")
+
+# Parameter names that hold large device arrays by repo convention —
+# the static stand-in for "large array params" (sizes are a runtime
+# property; names are what an AST can see).
+_LARGE_PARAM_NAMES = {
+    "params", "state", "opt_state", "cache", "caches", "weights",
+    "draft_params",
+}
+
+_JIT_ROOTS = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_SHARDING_KWARGS = {
+    "in_shardings", "donate_argnums", "donate_argnames",
+}
+
+
+def _attr_chain(node: ast.AST) -> str | None:
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _span_has_escape(mod: Module, start: int, end: int) -> bool:
+    for line in range(start, end + 1):
+        c = mod.comments.get(line)
+        if c and LAYOUT_OK_RE.search(c):
+            return True
+    return False
+
+
+def _has_escape(mod: Module, node: ast.AST) -> bool:
+    """``# lint: layout-ok: <why>`` on any line of the node's span, or
+    on the line directly above (the opening line of a wrapping
+    multi-line expression)."""
+    end = getattr(node, "end_lineno", node.lineno) or node.lineno
+    return _span_has_escape(mod, max(1, node.lineno - 1), end)
+
+
+# ---------------------------------------------------------------------------
+# the declared layout, read from the layout module WITHOUT importing it
+# ---------------------------------------------------------------------------
+
+
+class DeclaredLayout:
+    """Axis names + normalized spec tuples parsed from the layout
+    module's literal tables."""
+
+    def __init__(self, axes: set, specs: set, parsed: bool):
+        self.axes = axes
+        self.specs = specs  # set of normalized spec tuples
+        self.parsed = parsed
+
+    @staticmethod
+    def _normalize(spec: tuple) -> tuple:
+        out = [
+            tuple(e) if isinstance(e, (tuple, list)) else e for e in spec
+        ]
+        while out and out[-1] is None:
+            out.pop()
+        return tuple(out)
+
+    def declares_spec(self, spec: tuple) -> bool:
+        """True when ``spec`` matches a declared rule, allowing axes the
+        caller dropped to None (a constraint may pin a WEAKER layout
+        than the table's rule, never a different one)."""
+        norm = self._normalize(spec)
+        if norm in self.specs:
+            return True
+        for decl in self.specs:
+            if len(norm) > len(decl):
+                continue
+            padded = decl + (None,) * (len(norm) - len(decl))
+            if all(
+                e is None or e == padded[d] for d, e in enumerate(norm)
+            ):
+                return True
+        return False
+
+
+def _spec_entries(node: ast.AST):
+    """Literal spec entries of one table 'spec' value / activation spec
+    tuple: axis-name strings, None, nested tuples. Returns None when
+    the literal shape is unexpected (computed specs are not checked)."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            sub = _spec_entries(el)
+            if sub is None:
+                return None
+            out.append(sub if not isinstance(el, ast.Constant) else sub[0])
+        return tuple(out)
+    if isinstance(node, ast.Constant):
+        if node.value is None or isinstance(node.value, (str, int)):
+            return (node.value,)
+        return None
+    return None
+
+
+def load_declared_layout(pkg: Package, cfg: Config) -> DeclaredLayout:
+    mod = pkg.by_relpath.get(cfg.layout_module)
+    tree = mod.tree if mod is not None else None
+    if tree is None:
+        path = os.path.join(pkg.root, cfg.layout_module)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            return DeclaredLayout(set(), set(), parsed=False)
+
+    axes: set = set()
+    specs: set = {()}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        name = target.id
+        try:
+            value = ast.literal_eval(node.value)
+        except (ValueError, SyntaxError):
+            continue
+        if name == "MESH_AXES":
+            axes.update(value)
+        elif name == "BATCH_AXES":
+            specs.add((tuple(value),))
+        elif name == "LAYOUT_TABLES":
+            for rules in value.values():
+                for rule in rules:
+                    spec = tuple(
+                        tuple(e) if isinstance(e, list) else e
+                        for e in rule.get("spec", ())
+                    )
+                    specs.add(DeclaredLayout._normalize(spec))
+        elif name in (
+            "ACTIVATION_SPECS", "DECODE_CACHE_SPECS", "SERVE_CACHE_SPECS"
+        ):
+            for spec in value.values():
+                spec = tuple(
+                    tuple(e) if isinstance(e, list) else e for e in spec
+                )
+                specs.add(DeclaredLayout._normalize(spec))
+    return DeclaredLayout(axes, specs, parsed=bool(axes))
+
+
+# ---------------------------------------------------------------------------
+# per-module constructor binding resolution
+# ---------------------------------------------------------------------------
+
+
+class _Bindings:
+    """Local names under which PartitionSpec/NamedSharding are
+    reachable in one module."""
+
+    def __init__(self, mod: Module):
+        self.ctor_names: dict = {}  # local name -> 'PartitionSpec'|'NamedSharding'
+        self.sharding_mods: set = set()  # aliases of jax.sharding
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module in (
+                "jax.sharding", "jax.interpreters.pxla"
+            ):
+                for a in node.names:
+                    if a.name in ("PartitionSpec", "NamedSharding"):
+                        self.ctor_names[a.asname or a.name] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for a in node.names:
+                    if a.name == "sharding":
+                        self.sharding_mods.add(a.asname or "sharding")
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax.sharding":
+                        self.sharding_mods.add(a.asname or "jax.sharding")
+                    elif a.name == "jax":
+                        self.sharding_mods.add(
+                            (a.asname or "jax") + ".sharding"
+                        )
+
+    def ctor_of(self, call: ast.Call) -> str | None:
+        """'PartitionSpec' / 'NamedSharding' when this call constructs
+        one, else None."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            return self.ctor_names.get(f.id)
+        chain = _attr_chain(f)
+        if not chain:
+            return None
+        base, _, leaf = chain.rpartition(".")
+        if leaf in ("PartitionSpec", "NamedSharding") and (
+            base in self.sharding_mods or base == "jax.sharding"
+        ):
+            return leaf
+        return None
+
+
+# ---------------------------------------------------------------------------
+# SH001 / SH002 / SH004
+# ---------------------------------------------------------------------------
+
+
+def _literal_axis_names(call: ast.Call):
+    """(node, axis-name) for every string literal in a PartitionSpec
+    call's args — including inside tuple args (multi-axis dims)."""
+    for arg in call.args:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                yield sub, sub.value
+
+
+def _literal_spec(call: ast.Call) -> tuple | None:
+    """The spec tuple of an all-literal PartitionSpec call, else None."""
+    out = []
+    for arg in call.args:
+        got = _spec_entries(arg)
+        if got is None:
+            return None
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            out.append(got)
+        else:
+            out.append(got[0])
+    return tuple(out)
+
+
+def _scan_constructors(
+    mod: Module, cfg: Config, declared: DeclaredLayout, findings: list
+) -> None:
+    is_layout = mod.relpath == cfg.layout_module
+    b = _Bindings(mod)
+    constraint_spec_nodes: set = set()
+
+    # collect P-literals that sit inside with_sharding_constraint calls
+    # first, so SH004 fires on them (SH002 still applies to their axis
+    # names; SH001 does too when outside the layout module)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func) or (
+            node.func.id if isinstance(node.func, ast.Name) else ""
+        )
+        if chain and chain.rpartition(".")[2] == "with_sharding_constraint":
+            for arg in node.args[1:] + [k.value for k in node.keywords]:
+                for sub in ast.walk(arg):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and b.ctor_of(sub) == "PartitionSpec"
+                    ):
+                        constraint_spec_nodes.add(sub)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        ctor = b.ctor_of(node)
+        if ctor is None:
+            continue
+        if not is_layout and not _has_escape(mod, node):
+            findings.append(
+                Finding(
+                    "SH001",
+                    mod.relpath,
+                    node.lineno,
+                    node.col_offset,
+                    f"raw {ctor}(...) constructed outside the layout "
+                    f"table ({cfg.layout_module}); consume "
+                    "compute.layout helpers/tables instead, or escape "
+                    "with '# lint: layout-ok: <why>'",
+                )
+            )
+        if ctor == "PartitionSpec" and declared.parsed:
+            for sub, axis in _literal_axis_names(node):
+                if axis not in declared.axes:
+                    findings.append(
+                        Finding(
+                            "SH002",
+                            mod.relpath,
+                            sub.lineno,
+                            sub.col_offset,
+                            f"spec axis {axis!r} is not declared by the "
+                            "active layout (MESH_AXES: "
+                            f"{sorted(declared.axes)})",
+                        )
+                    )
+            if node in constraint_spec_nodes:
+                spec = _literal_spec(node)
+                if spec is not None and not declared.declares_spec(spec):
+                    findings.append(
+                        Finding(
+                            "SH004",
+                            mod.relpath,
+                            node.lineno,
+                            node.col_offset,
+                            f"with_sharding_constraint spec {spec!r} "
+                            "matches no rule in the layout table — it "
+                            "either fights the table (hidden reshard) "
+                            "or is a typo; declare it or use a layout "
+                            "helper",
+                        )
+                    )
+
+
+def _scan_layout_tables(
+    mod: Module, declared: DeclaredLayout, findings: list
+) -> None:
+    """SH002 inside the layout module itself: every axis string in a
+    table 'spec' entry (or activation/cache spec) must be declared."""
+
+    def check_spec_node(node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Constant)
+                and isinstance(sub.value, str)
+                and sub.value not in declared.axes
+            ):
+                findings.append(
+                    Finding(
+                        "SH002",
+                        mod.relpath,
+                        sub.lineno,
+                        sub.col_offset,
+                        f"layout table declares spec axis {sub.value!r} "
+                        "which MESH_AXES does not declare",
+                    )
+                )
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        if target.id == "LAYOUT_TABLES":
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Dict):
+                    for k, v in zip(sub.keys, sub.values):
+                        if (
+                            isinstance(k, ast.Constant)
+                            and k.value == "spec"
+                        ):
+                            check_spec_node(v)
+        elif target.id in (
+            "ACTIVATION_SPECS",
+            "DECODE_CACHE_SPECS",
+            "SERVE_CACHE_SPECS",
+            "BATCH_AXES",
+        ):
+            if isinstance(node.value, ast.Dict):
+                # keys are role names, not axes — check values only
+                for v in node.value.values:
+                    check_spec_node(v)
+            else:
+                check_spec_node(node.value)
+
+
+# ---------------------------------------------------------------------------
+# SH003 — unconstrained hot-path jit of large-array params
+# ---------------------------------------------------------------------------
+
+
+def _jit_kwargs(call: ast.Call) -> set:
+    return {k.arg for k in call.keywords if k.arg}
+
+
+def _fn_param_names(fn) -> set:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    return set(names)
+
+
+def _scan_hot_jits(pkg: Package, cfg: Config, findings: list) -> None:
+    from tensorflowonspark_tpu.analysis.hostsync import (
+        _build_graph,
+        _hot_set,
+        _index_module,
+    )
+
+    all_funcs, edges = _build_graph(pkg)
+    hot = _hot_set(pkg, cfg, all_funcs, edges)
+    if not hot:
+        return
+    # same-module top-level function defs, for resolving jit(fn) args
+    mod_defs = {
+        m.relpath: _index_module(m)[0] for m in pkg.modules
+    }
+
+    def flag(mod: Module, node: ast.AST, what: str) -> None:
+        findings.append(
+            Finding(
+                "SH003",
+                mod.relpath,
+                node.lineno,
+                node.col_offset,
+                f"hot-path jit of {what} passes neither in_shardings "
+                "nor donate_argnums: placement drifts silently and "
+                "state double-buffers; take shardings from the layout "
+                "table (or '# lint: layout-ok: <why>')",
+            )
+        )
+
+    seen: set = set()
+    for key in sorted(hot):
+        info = all_funcs[key]
+        mod = info.mod
+        for node in ast.walk(info.node):
+            # decorated defs: @jax.jit / @partial(jax.jit, ...)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    dec_call = dec if isinstance(dec, ast.Call) else None
+                    root = dec_call.func if dec_call else dec
+                    chain = _attr_chain(root) or (
+                        root.id if isinstance(root, ast.Name) else ""
+                    )
+                    kwargs: set = set()
+                    if chain == "partial" or chain == "functools.partial":
+                        if dec_call and dec_call.args:
+                            inner = dec_call.args[0]
+                            chain = _attr_chain(inner) or (
+                                inner.id
+                                if isinstance(inner, ast.Name)
+                                else ""
+                            )
+                            kwargs = _jit_kwargs(dec_call)
+                    elif dec_call is not None:
+                        kwargs = _jit_kwargs(dec_call)
+                    if chain not in _JIT_ROOTS:
+                        continue
+                    mark = (mod.relpath, node.lineno, node.col_offset)
+                    if mark in seen:
+                        continue
+                    seen.add(mark)
+                    if kwargs & _SHARDING_KWARGS:
+                        continue
+                    large = _fn_param_names(node) & _LARGE_PARAM_NAMES
+                    if not large:
+                        continue
+                    # escape scope: decorator line through the def's
+                    # first body line — NOT the whole function body
+                    if _span_has_escape(
+                        mod,
+                        dec.lineno,
+                        node.body[0].lineno if node.body else node.lineno,
+                    ):
+                        continue
+                    flag(mod, dec, f"'{node.name}({', '.join(sorted(large))})'")
+                continue
+            # call form: jax.jit(fn, ...)
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func) or (
+                node.func.id if isinstance(node.func, ast.Name) else ""
+            )
+            if chain not in _JIT_ROOTS or not node.args:
+                continue
+            mark = (mod.relpath, node.lineno, node.col_offset)
+            if mark in seen:
+                continue
+            seen.add(mark)
+            if _jit_kwargs(node) & _SHARDING_KWARGS:
+                continue
+            target = node.args[0]
+            large: set = set()
+            name = None
+            if isinstance(target, ast.Lambda):
+                large = _fn_param_names(target) & _LARGE_PARAM_NAMES
+                name = "<lambda>"
+            elif isinstance(target, ast.Name):
+                fn_info = mod_defs.get(mod.relpath, {}).get(target.id)
+                if fn_info is None:
+                    # maybe nested within the hot function itself
+                    for sub in ast.walk(info.node):
+                        if (
+                            isinstance(
+                                sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                            )
+                            and sub.name == target.id
+                        ):
+                            fn_info = type(
+                                "X", (), {"node": sub}
+                            )  # lightweight holder
+                            break
+                if fn_info is not None:
+                    large = (
+                        _fn_param_names(fn_info.node) & _LARGE_PARAM_NAMES
+                    )
+                    name = target.id
+            if not large or _has_escape(mod, node):
+                continue
+            flag(mod, node, f"'{name}({', '.join(sorted(large))})'")
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+
+def check(pkg: Package, cfg: Config) -> list:
+    findings: list = []
+    declared = load_declared_layout(pkg, cfg)
+    for mod in pkg.modules:
+        _scan_constructors(mod, cfg, declared, findings)
+        if mod.relpath == cfg.layout_module and declared.parsed:
+            _scan_layout_tables(mod, declared, findings)
+    _scan_hot_jits(pkg, cfg, findings)
+    return findings

@@ -12,6 +12,12 @@ from tensorflowonspark_tpu.compute.elastic import (
     host_snapshot,
     reshard_state,
 )
+from tensorflowonspark_tpu.compute.layout import (
+    LAYOUT_TABLES,
+    SpecLayout,
+    get_layout,
+    param_shardings,
+)
 from tensorflowonspark_tpu.compute.mesh import (
     MESH_AXES,
     fit_axis_shapes,
@@ -33,7 +39,11 @@ from tensorflowonspark_tpu.compute.train import (
 )
 
 __all__ = [
+    "LAYOUT_TABLES",
     "MESH_AXES",
+    "SpecLayout",
+    "get_layout",
+    "param_shardings",
     "ElasticTrainer",
     "host_snapshot",
     "reshard_state",

@@ -215,9 +215,14 @@ def reshard_state(state: Any, shardings: Any) -> Any:
 
 def default_shardings_fn(state: Any, mesh) -> Any:
     """Shardings for a (re-formed) mesh: FSDP over params via
-    :func:`~tensorflowonspark_tpu.compute.train.fsdp_shardings`, the
-    optimizer tree mirrored, scalars replicated — the same axis rules
-    training started with, re-derived for the new device count."""
+    :func:`~tensorflowonspark_tpu.compute.train.fsdp_shardings` (the
+    layout table's generic shape-driven rule), the optimizer tree
+    mirrored, scalars replicated — the same axis rules training started
+    with, re-derived for the new device count. Model-table consumers
+    pass ``shardings_fn=lambda s, m: state_shardings(s, m,
+    layout.param_shardings(s.params, m, "<table>"))`` instead; either
+    way the reshard round-trip is byte-identical and its shardcheck
+    collective census is stable (tests/test_layout.py)."""
     from tensorflowonspark_tpu.compute.train import (
         fsdp_shardings,
         state_shardings,

@@ -1,0 +1,521 @@
+"""The declarative sharding layout table — THE source of truth for specs.
+
+Before this module, PartitionSpecs were hand-built in 18 sites across
+compute/, parallel/, models/, serving/ and tools/, so nothing could
+prove a layout change was consistent or that a jitted step wasn't
+paying hidden all-gathers (the 57–58 % MFU plateau of ROADMAP item 2).
+TF-Replicator's argument (PAPERS.md, arXiv 1902.00465) applies
+structurally: replica placement/layout must be a *declared, checkable
+artifact*, not a convention scattered through model code. This module
+is that artifact, in three parts:
+
+- **Declarative tables** (:data:`LAYOUT_TABLES`, :data:`ACTIVATION_SPECS`,
+  :data:`DECODE_CACHE_SPECS`, :data:`SERVE_CACHE_SPECS`) — *pure
+  literals*, deliberately: the ``analysis/sharding.py`` static head
+  (SH001–SH004) reads them by AST parse without importing jax, so a
+  layout edit and its lint gate can never drift apart. Every axis name
+  used anywhere in the package must be declared in :data:`MESH_AXES`
+  (SH002), and every ``with_sharding_constraint`` literal must match a
+  declared rule (SH004).
+- **The rule engine** (:class:`SpecLayout`, :func:`param_shardings`) —
+  first-match-wins name-pattern → PartitionSpec evaluation with
+  per-table divisibility semantics, replacing each model's hand-rolled
+  ``*_param_shardings``.
+- **Role helpers** (:func:`batch_sharding`, :func:`replicated`,
+  :func:`decode_cache_sharding`, :func:`tp_only`, …) — the only
+  functions in the package allowed to construct ``PartitionSpec`` /
+  ``NamedSharding`` (SH001 flags raw construction anywhere else;
+  escape: ``# lint: layout-ok: <why>``).
+
+``tools/shardcheck.py`` closes the loop dynamically: it lowers the
+train step against these tables and diffs the collective census
+against a committed baseline, so an unintended all-gather introduced
+by a table edit becomes a tier-1 diff, not a silent MFU regression.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Mapping
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "ACTIVATION_SPECS",
+    "BATCH_AXES",
+    "DECODE_CACHE_SPECS",
+    "LAYOUT_TABLES",
+    "MESH_AXES",
+    "SERVE_CACHE_SPECS",
+    "SpecLayout",
+    "activation_sharding",
+    "activation_spec",
+    "batch_sharding",
+    "batch_spec",
+    "decode_cache_sharding",
+    "decode_cache_spec",
+    "expert_bank_spec",
+    "fsdp_leaf_sharding",
+    "fsdp_leaf_spec",
+    "get_layout",
+    "param_shardings",
+    "replicated",
+    "serve_cache_sharding",
+    "serve_cache_spec",
+    "sharding",
+    "tp_only",
+]
+
+# ---------------------------------------------------------------------------
+# Declared axes (SURVEY.md §7 step 3). SH002 rejects any spec axis name
+# not listed here. Keep these literals — the analyzer ast-parses them.
+# ---------------------------------------------------------------------------
+
+# - ``data``  — pure data parallel (replicated params, sharded batch)
+# - ``fsdp``  — data parallel with sharded params/optimizer state
+# - ``pipe``  — pipeline parallel (parallel/pipeline.py)
+# - ``expert`` — expert parallel (parallel/moe.py)
+# - ``model`` — tensor parallel (Megatron column/row shardings)
+# - ``seq``   — sequence/context parallel (parallel/ring_attention.py)
+MESH_AXES = ("data", "fsdp", "pipe", "expert", "model", "seq")
+
+# Batch dimension shards over every data-like axis.
+BATCH_AXES = ("data", "fsdp")
+
+# ---------------------------------------------------------------------------
+# Name-pattern → PartitionSpec tables (pure literals; analyzer-readable).
+#
+# Rule keys:
+#   pattern    — regex, re.search()ed against the '/'-joined param path
+#                (dict keys AND dataclass-leaf attr names, so a LoRA
+#                factor inside a wrapped kernel reads
+#                'layer0/attn/q_proj/kernel/a')
+#   spec       — per-dim axis assignment: an axis name, None, or a
+#                tuple of axis names (multi-axis dim)
+#   ndim       — rule applies only to leaves of exactly this rank
+#   max_ndim   — … of at most this rank
+#   divisible  — divisibility semantics for named dims:
+#                  "strict"  (default) spec applies as-is; divisibility
+#                            is the caller's contract (llama raises at
+#                            device_put, by design)
+#                  "require" rule matches only if every named dim
+#                            divides its axis extent, else fall through
+#                            to the next rule (bert/resnet/unet)
+#                  "drop_or_unit" keep the rule but null out any axis
+#                            whose extent is 1 or does not divide the
+#                            dim (vit)
+#
+# First match wins; every table MUST end in a catch-all. Editing a rule
+# here is the whole blast radius of a layout change — the SH static
+# head checks consistency, tools/shardcheck.py diffs the resulting
+# collective census against its committed baseline.
+# ---------------------------------------------------------------------------
+
+LAYOUT_TABLES = {
+    # Megatron layout on ('fsdp', 'model'); biases/norms replicated.
+    # With mesh model=1 this degrades to pure FSDP (the Llama-2-7B
+    # baseline config); with fsdp=1 to pure TP. LoRA factors inside a
+    # wrapped kernel: the base shards like the kernel it replaces; 'a'
+    # (in, r) keeps the input half of the base pair, 'b' (r, out) the
+    # output half — consistent with the TP math (the rank dim stays
+    # replicated; it is tiny by construction). For a multi-LoRA BANK
+    # the same halves apply behind the leading K slots dim (replicated
+    # — every chip serves every adapter).
+    "llama": (
+        {"pattern": r".*", "max_ndim": 1, "spec": ()},
+        # LoRA 'a' factors: input half of the enclosing kernel's pair
+        {"pattern": r".*(o_proj|down_proj).*/a$", "ndim": 2,
+         "spec": ("model", None)},
+        {"pattern": r".*/a$", "ndim": 2, "spec": ("fsdp", None)},
+        {"pattern": r".*(o_proj|down_proj).*/a$", "ndim": 3,
+         "spec": (None, "model", None)},
+        {"pattern": r".*/a$", "ndim": 3, "spec": (None, "fsdp", None)},
+        # LoRA 'b' factors: output half
+        {"pattern":
+         r".*(embed|lm_head|q_proj|k_proj|v_proj|gate_proj|up_proj).*/b$",
+         "ndim": 2, "spec": (None, "model")},
+        {"pattern": r".*(o_proj|down_proj).*/b$", "ndim": 2,
+         "spec": (None, "fsdp")},
+        {"pattern": r".*/b$", "ndim": 2, "spec": ()},
+        {"pattern":
+         r".*(embed|lm_head|q_proj|k_proj|v_proj|gate_proj|up_proj).*/b$",
+         "ndim": 3, "spec": (None, None, "model")},
+        {"pattern": r".*(o_proj|down_proj).*/b$", "ndim": 3,
+         "spec": (None, None, "fsdp")},
+        {"pattern": r".*/b$", "ndim": 3, "spec": ()},
+        # MoE expert banks are the remaining ndim-3 leaves: stacked dim
+        # on 'expert', FFN hidden on 'model', the rest on 'fsdp'
+        {"pattern": r".*w_down.*", "ndim": 3,
+         "spec": ("expert", "model", "fsdp")},
+        {"pattern": r".*", "ndim": 3, "spec": ("expert", "fsdp", "model")},
+        {"pattern": r".*router.*", "spec": ()},
+        # column-parallel projections
+        {"pattern":
+         r".*(embed|lm_head|q_proj|k_proj|v_proj|gate_proj|up_proj).*",
+         "spec": ("fsdp", "model")},
+        # row-parallel projections
+        {"pattern": r".*(o_proj|down_proj).*", "spec": ("model", "fsdp")},
+        {"pattern": r".*", "spec": ("fsdp", None)},
+    ),
+    # Megatron-style rules keyed on bert param names; a rule whose
+    # named dims don't divide the mesh extents falls through.
+    "bert": (
+        {"pattern": r".*(query|key|value|ffn_in).*", "ndim": 2,
+         "spec": ("fsdp", "model"), "divisible": "require"},
+        {"pattern": r".*(attn_out|ffn_out).*", "ndim": 2,
+         "spec": ("model", "fsdp"), "divisible": "require"},
+        {"pattern": r".*", "ndim": 2, "spec": ("fsdp", None),
+         "divisible": "require"},
+        {"pattern": r".*", "spec": ()},
+    ),
+    # 2D kernels over ('fsdp','model'); a dim that does not divide its
+    # mesh axis (or whose axis extent is 1) falls back to replication
+    # for THAT dim (e.g. the (hidden, 10) classifier head under
+    # model>1) rather than erroring at device_put.
+    "vit": (
+        {"pattern": r".*", "ndim": 2, "spec": ("fsdp", "model"),
+         "divisible": "drop_or_unit"},
+        {"pattern": r".*", "ndim": 4,  # patch-embed conv kernel
+         "spec": (None, None, None, "model"), "divisible": "drop_or_unit"},
+        {"pattern": r".*", "spec": ()},
+    ),
+    # FSDP rules: shard large kernels' output-channel dim over 'fsdp';
+    # replicate BN scale/bias (tiny). Shared by resnet/inception/vgg.
+    "resnet": (
+        {"pattern": r".*", "ndim": 4, "spec": (None, None, None, "fsdp"),
+         "divisible": "require"},
+        {"pattern": r".*", "ndim": 2, "spec": ("fsdp", None),
+         "divisible": "require"},
+        {"pattern": r".*", "spec": ()},
+    ),
+    # conv kernels' output channels over 'fsdp' where divisible.
+    "unet": (
+        {"pattern": r".*", "ndim": 4, "spec": (None, None, None, "fsdp"),
+         "divisible": "require"},
+        {"pattern": r".*", "spec": ()},
+    ),
+    # MoEMLP param tree: expert banks on ('expert','fsdp'/'model'),
+    # router replicated. llama's ndim-3 rules delegate here in spirit —
+    # the two tables MUST stay in lockstep (tests/test_layout.py pins
+    # them equal).
+    "moe": (
+        {"pattern": r".*w_down.*", "ndim": 3,
+         "spec": ("expert", "model", "fsdp")},
+        {"pattern": r".*", "ndim": 3, "spec": ("expert", "fsdp", "model")},
+        {"pattern": r".*", "spec": ()},
+    ),
+}
+
+# Activation / host-IO placements, by role.
+ACTIVATION_SPECS = {
+    # leading (batch) dim over every data-like axis, rest replicated
+    "batch": (("data", "fsdp"),),
+    # (B, S) token prompts: batch on 'data', positions replicated
+    "prompt": ("data", None),
+    # (B,) per-row planes (prompt lengths, row flags)
+    "per_row": ("data",),
+    # scalars / rng keys / whole-tree replication
+    "replicated": (),
+    # (B, S, H, D) attention operands under mesh flash-attention
+    # shard_map: batch over the data axes, heads TP on 'model'
+    "attn_bshd": (("data", "fsdp"), None, "model", None),
+}
+
+# KV-cache leaves under mesh-sharded decode, keyed by leaf rank:
+# K/V (B, S, kv_heads, D) shard batch on 'data' and heads on 'model'
+# (each TP shard holds only its heads' cache — the HBM split that makes
+# 7B-class serving fit), int8-KV scale planes (B, S, kv_heads) follow
+# their heads, the segment-id plane (B, S) shards on 'data', the scalar
+# write index replicates.
+DECODE_CACHE_SPECS = {
+    4: ("data", None, "model", None),
+    3: ("data", None, "model"),
+    2: ("data", None),
+}
+
+# The continuous engine's row-admitted cache: TP on 'model' only, batch
+# replicated (row-wise admission keeps the batch axis unsharded).
+SERVE_CACHE_SPECS = {
+    4: (None, None, "model", None),
+    3: (None, None, "model"),
+}
+
+
+# ---------------------------------------------------------------------------
+# rule engine
+# ---------------------------------------------------------------------------
+
+
+def _axis_extent(axis_sizes: Mapping[str, int], entry) -> int:
+    if entry is None:
+        return 1
+    if isinstance(entry, (tuple, list)):
+        return math.prod(_axis_extent(axis_sizes, a) for a in entry)
+    return int(axis_sizes.get(entry, 1))
+
+
+def _apply_divisibility(
+    spec: tuple, shape: tuple, axis_sizes: Mapping[str, int], mode: str
+) -> tuple | None:
+    """Resolve a rule's spec against a leaf shape. Returns the concrete
+    spec tuple, or None when mode='require' and a named dim does not
+    divide (the rule falls through)."""
+    if mode == "strict":
+        return spec
+    out = []
+    for d, entry in enumerate(spec):
+        if entry is None:
+            out.append(None)
+            continue
+        extent = _axis_extent(axis_sizes, entry)
+        size = shape[d] if d < len(shape) else 0
+        if mode == "require":
+            if extent and size % extent:
+                return None
+            out.append(entry)
+        elif mode == "drop_or_unit":
+            out.append(
+                entry if extent > 1 and size % extent == 0 else None
+            )
+        else:  # pragma: no cover - table validation catches this
+            raise ValueError(f"unknown divisibility mode {mode!r}")
+    return tuple(out)
+
+
+class SpecLayout:
+    """One compiled layout table: named axes + pattern rules.
+
+    The declarative source lives in :data:`LAYOUT_TABLES`; instances
+    are created once per table by :func:`get_layout` and cached.
+    """
+
+    def __init__(self, name: str, rules: tuple):
+        self.name = name
+        self._rules = tuple(
+            (
+                re.compile(r["pattern"]),
+                tuple(r["spec"]),
+                r.get("ndim"),
+                r.get("max_ndim"),
+                r.get("divisible", "strict"),
+            )
+            for r in rules
+        )
+
+    def spec(
+        self,
+        path_name: str,
+        shape: tuple,
+        axis_sizes: Mapping[str, int] | None = None,
+    ) -> P:
+        """PartitionSpec for one leaf: first rule whose pattern matches
+        ``path_name`` and whose rank filter admits ``shape`` (subject
+        to the rule's divisibility mode) wins."""
+        ndim = len(shape)
+        axis_sizes = axis_sizes or {}
+        for pat, spec, r_ndim, r_max, divisible in self._rules:
+            if r_ndim is not None and ndim != r_ndim:
+                continue
+            if r_max is not None and ndim > r_max:
+                continue
+            if not pat.search(path_name):
+                continue
+            resolved = _apply_divisibility(spec, shape, axis_sizes, divisible)
+            if resolved is None:
+                continue  # 'require' rule fell through
+            return P(*resolved)
+        raise ValueError(
+            f"layout table {self.name!r} has no rule for {path_name!r} "
+            f"(shape {shape}); tables must end in a catch-all"
+        )
+
+
+_LAYOUTS: dict[str, SpecLayout] = {}
+
+
+def get_layout(name: str) -> SpecLayout:
+    """The compiled :class:`SpecLayout` for one table in
+    :data:`LAYOUT_TABLES` (cached)."""
+    layout = _LAYOUTS.get(name)
+    if layout is None:
+        try:
+            rules = LAYOUT_TABLES[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown layout table {name!r}; declared: "
+                f"{sorted(LAYOUT_TABLES)}"
+            ) from None
+        layout = _LAYOUTS[name] = SpecLayout(name, rules)
+    return layout
+
+
+def _path_name(path) -> str:
+    """'/'-joined tree path: dict keys AND dataclass-leaf attr names,
+    so a LoRA factor reads 'layer0/attn/q_proj/kernel/a'."""
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is not None:
+            parts.append(str(key))
+            continue
+        name = getattr(p, "name", None)
+        if name is not None:
+            parts.append(str(name))
+            continue
+        idx = getattr(p, "idx", None)
+        parts.append(str(idx) if idx is not None else str(p))
+    return "/".join(parts)
+
+
+def param_shardings(params: Any, mesh: Mesh, layout: str | SpecLayout):
+    """NamedShardings for a param pytree from one layout table.
+
+    Works on concrete arrays and ``ShapeDtypeStruct`` leaves alike
+    (tools/shardcheck.py lowers abstractly), so the table is usable
+    before any memory is allocated.
+    """
+    import jax
+
+    table = layout if isinstance(layout, SpecLayout) else get_layout(layout)
+    axis_sizes = dict(mesh.shape)
+
+    def rule(path, leaf) -> NamedSharding:
+        return NamedSharding(
+            mesh,
+            table.spec(_path_name(path), tuple(leaf.shape), axis_sizes),
+        )
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+# ---------------------------------------------------------------------------
+# role helpers — the only sanctioned PartitionSpec/NamedSharding
+# constructors outside this module's tables (SH001)
+# ---------------------------------------------------------------------------
+
+
+def sharding(mesh: Mesh, spec: P | tuple) -> NamedSharding:
+    """Wrap a spec (PartitionSpec or plain axis tuple) for ``mesh``."""
+    if not isinstance(spec, P):
+        spec = P(*spec)
+    return NamedSharding(mesh, spec)
+
+
+def activation_spec(role: str, ndim: int | None = None) -> P:
+    """The declared activation/IO spec for one role in
+    :data:`ACTIVATION_SPECS`; ``ndim`` pads trailing dims with None
+    (a PartitionSpec shorter than the rank leaves trailing dims
+    unsharded anyway — padding only matters for readability)."""
+    try:
+        spec = ACTIVATION_SPECS[role]
+    except KeyError:
+        raise KeyError(
+            f"unknown activation role {role!r}; declared: "
+            f"{sorted(ACTIVATION_SPECS)}"
+        ) from None
+    if ndim is not None and ndim > len(spec):
+        spec = tuple(spec) + (None,) * (ndim - len(spec))
+    return P(*spec)
+
+
+def activation_sharding(
+    mesh: Mesh, role: str, ndim: int | None = None
+) -> NamedSharding:
+    return NamedSharding(mesh, activation_spec(role, ndim))
+
+
+def batch_spec(ndim: int = 1) -> P:
+    """Batch pytree leaf: leading dim over ('data','fsdp'), rest
+    replicated."""
+    return activation_spec("batch", ndim)
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(ndim))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def decode_cache_spec(x, tp: bool = True) -> P:
+    """PartitionSpec for one KV-cache leaf under mesh-sharded decode
+    (see :data:`DECODE_CACHE_SPECS`). ``tp=False`` drops the 'model'
+    head sharding — the speculative draft's cache, whose weights are
+    replicated."""
+    spec = DECODE_CACHE_SPECS.get(x.ndim, ())
+    if not tp:
+        spec = tuple(None if a == "model" else a for a in spec)
+    return P(*spec)
+
+
+def decode_cache_sharding(mesh: Mesh, x, tp: bool = True) -> NamedSharding:
+    return NamedSharding(mesh, decode_cache_spec(x, tp=tp))
+
+
+def serve_cache_spec(x) -> P:
+    """The continuous engine's cache spec (see
+    :data:`SERVE_CACHE_SPECS`): TP on 'model' only, batch replicated."""
+    return P(*SERVE_CACHE_SPECS.get(x.ndim, ()))
+
+
+def serve_cache_sharding(mesh: Mesh, x) -> NamedSharding:
+    return NamedSharding(mesh, serve_cache_spec(x))
+
+
+def expert_bank_spec(param_name: str) -> P:
+    """PartitionSpec for one 3-dim MoE expert bank leaf, from the 'moe'
+    table — single source of truth; the llama table carries the same
+    rules so model-level and module-level specs cannot diverge."""
+    return get_layout("moe").spec(param_name, (0, 0, 0))
+
+
+def fsdp_leaf_spec(
+    shape: tuple,
+    n_shard: int,
+    axis: str = "fsdp",
+    min_shard_elements: int = 1024,
+) -> P:
+    """The generic shape-driven FSDP rule: shard the LARGEST dim
+    divisible by the fsdp axis size; tiny tensors (biases, norms) stay
+    replicated. This mirrors how the reference's PS spread variables
+    across ps shards (greedy variable placement), re-expressed as mesh
+    sharding."""
+    if n_shard == 1 or math.prod(shape) < min_shard_elements:
+        return P()
+    for d in sorted(range(len(shape)), key=lambda i: shape[i], reverse=True):
+        if shape[d] % n_shard == 0:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            return P(*spec)
+    return P()
+
+
+def fsdp_leaf_sharding(
+    mesh: Mesh,
+    shape: tuple,
+    axis: str = "fsdp",
+    min_shard_elements: int = 1024,
+) -> NamedSharding:
+    return NamedSharding(
+        mesh,
+        fsdp_leaf_spec(
+            tuple(shape), mesh.shape[axis], axis, min_shard_elements
+        ),
+    )
+
+
+def tp_only(mesh: Mesh, sh: NamedSharding) -> NamedSharding:
+    """Project a sharding onto the 'model' (TP) axis only — the serving
+    engine's weight placement: the training rules also shard on 'fsdp',
+    which with a replicated batch would force a weight all-gather on
+    every per-token decode step."""
+
+    def keep(ax):
+        if isinstance(ax, (tuple, list)):  # multi-axis dim
+            kept = tuple(a for a in ax if a == "model")
+            return kept[0] if kept else None
+        return ax if ax == "model" else None
+
+    return NamedSharding(mesh, P(*(keep(ax) for ax in sh.spec)))

@@ -29,12 +29,16 @@ from typing import Mapping, Sequence
 import jax
 import numpy as np
 from jax.experimental import mesh_utils
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
-MESH_AXES = ("data", "fsdp", "pipe", "expert", "model", "seq")
-
-# Batch dimension shards over every data-like axis.
-BATCH_AXES = ("data", "fsdp")
+# The axis names (and every spec built over them) are DECLARED in the
+# layout table; this module re-exports them for its long-standing
+# importers. See docs/DESIGN.md "Layout table".
+from tensorflowonspark_tpu.compute.layout import (  # noqa: F401
+    BATCH_AXES,
+    MESH_AXES,
+)
+from tensorflowonspark_tpu.compute import layout as _layout
 
 
 def make_mesh(
@@ -136,12 +140,13 @@ def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
 
     A PartitionSpec shorter than the array rank leaves trailing dims
     unsharded, so the default works for any-rank leaves of a batch pytree.
+    (Delegates to the layout table's 'batch' activation role.)
     """
-    return NamedSharding(mesh, P(BATCH_AXES, *([None] * (ndim - 1))))
+    return _layout.batch_sharding(mesh, ndim)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
+    return _layout.replicated(mesh)
 
 
 def data_parallel_size(mesh: Mesh) -> int:

@@ -23,8 +23,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from flax import struct
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
+from tensorflowonspark_tpu.compute import layout as _layout
 from tensorflowonspark_tpu.compute.mesh import batch_sharding, replicated
 from tensorflowonspark_tpu.obs import spans as obs_spans
 
@@ -60,25 +61,17 @@ def fsdp_shardings(
     """Derive FSDP NamedShardings for a param pytree.
 
     Rule: shard the *largest* dimension divisible by the fsdp axis size;
-    tiny tensors (biases, norms) stay replicated. This mirrors how the
-    reference's PS spread variables across ps shards
+    tiny tensors (biases, norms) stay replicated (the layout table's
+    generic shape-driven rule, :func:`layout.fsdp_leaf_spec`). This
+    mirrors how the reference's PS spread variables across ps shards
     (greedy variable placement), re-expressed as mesh sharding.
     """
-    n_shard = mesh.shape[axis]
 
     def rule(x) -> NamedSharding:
-        shape = np.shape(x)
-        if n_shard == 1 or np.size(x) < min_shard_elements:
-            return replicated(mesh)
-        dims = sorted(
-            range(len(shape)), key=lambda d: shape[d], reverse=True
+        return _layout.fsdp_leaf_sharding(
+            mesh, np.shape(x), axis=axis,
+            min_shard_elements=min_shard_elements,
         )
-        for d in dims:
-            if shape[d] % n_shard == 0:
-                spec = [None] * len(shape)
-                spec[d] = axis
-                return NamedSharding(mesh, P(*spec))
-        return replicated(mesh)
 
     return jax.tree.map(rule, params)
 
@@ -177,6 +170,64 @@ def build_train_step(
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
+    step = make_step_fn(
+        loss_fn,
+        tx,
+        mesh,
+        accum_steps=accum_steps,
+        batch_weight_fn=batch_weight_fn,
+    )
+
+    def jit_with(state_sh):
+        return jax.jit(
+            step,
+            in_shardings=(state_sh, batch_sharding(mesh)),
+            out_shardings=(state_sh, replicated(mesh)),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    compiled: dict[str, Any] = {}
+
+    def wrapped(state: TrainState, batch):
+        if "fn" not in compiled:
+            psh = (
+                param_shardings
+                if param_shardings is not None
+                else jax.tree.map(lambda _: replicated(mesh), state.params)
+            )
+            compiled["fn"] = jit_with(state_shardings(state, mesh, psh))
+        # Host-side step span (obs/): measures DISPATCH time — jit
+        # returns as soon as the computation is enqueued, so the
+        # data-wait vs step split reads as "host blocked here" only
+        # when the caller's fetch forces it. StepTraceAnnotation makes
+        # an active jax.profiler device trace group this step's XLA
+        # ops under the same step number. A host-side call counter, not
+        # state.step: fetching the device scalar per step would sync.
+        n = compiled["n"] = compiled.get("n", 0) + 1
+        with obs_spans.get_tracer().step_span("train.step", step_num=n):
+            return compiled["fn"](state, batch)
+
+    return wrapped
+
+
+def make_step_fn(
+    loss_fn: Callable[[Any, Any], jax.Array],
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    accum_steps: int = 1,
+    batch_weight_fn: Callable[[Any], jax.Array] | None = None,
+) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
+    """The UNJITTED ``(state, batch) -> (state, loss)`` train step.
+
+    :func:`build_train_step` jits this with shardings/donation;
+    ``tools/shardcheck.py`` lowers it abstractly (AOT, on faux CPU
+    devices) to census the collectives the layout table implies — both
+    consumers must see the SAME program, which is why this is one
+    function and not two copies.
+    """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
     def grads_of(state: TrainState, batch):
         if accum_steps == 1:
             return jax.value_and_grad(loss_fn)(state.params, batch)
@@ -254,36 +305,7 @@ def build_train_step(
             loss,
         )
 
-    def jit_with(state_sh):
-        return jax.jit(
-            step,
-            in_shardings=(state_sh, batch_sharding(mesh)),
-            out_shardings=(state_sh, replicated(mesh)),
-            donate_argnums=(0,) if donate else (),
-        )
-
-    compiled: dict[str, Any] = {}
-
-    def wrapped(state: TrainState, batch):
-        if "fn" not in compiled:
-            psh = (
-                param_shardings
-                if param_shardings is not None
-                else jax.tree.map(lambda _: replicated(mesh), state.params)
-            )
-            compiled["fn"] = jit_with(state_shardings(state, mesh, psh))
-        # Host-side step span (obs/): measures DISPATCH time — jit
-        # returns as soon as the computation is enqueued, so the
-        # data-wait vs step split reads as "host blocked here" only
-        # when the caller's fetch forces it. StepTraceAnnotation makes
-        # an active jax.profiler device trace group this step's XLA
-        # ops under the same step number. A host-side call counter, not
-        # state.step: fetching the device scalar per step would sync.
-        n = compiled["n"] = compiled.get("n", 0) + 1
-        with obs_spans.get_tracer().step_span("train.step", step_num=n):
-            return compiled["fn"](state, batch)
-
-    return wrapped
+    return step
 
 
 def build_eval_step(

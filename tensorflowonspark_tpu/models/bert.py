@@ -26,7 +26,9 @@ import dataclasses
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+
+from tensorflowonspark_tpu.compute import layout
 
 from tensorflowonspark_tpu.ops.attention import dot_product_attention
 
@@ -175,26 +177,11 @@ class BertForMLM(nn.Module):
 
 
 def bert_param_shardings(params, mesh: Mesh):
-    """Megatron-style rules keyed on param names (see module docstring)."""
-    tp = mesh.shape.get("model", 1)
-    fsdp = mesh.shape.get("fsdp", 1)
-
-    def rule(path, leaf) -> NamedSharding:
-        names = [getattr(p, "key", str(p)) for p in path]
-        joined = "/".join(names)
-        if leaf.ndim == 2:
-            din, dout = leaf.shape
-            col = any(s in joined for s in ("query", "key", "value", "ffn_in"))
-            row = any(s in joined for s in ("attn_out", "ffn_out"))
-            if col and dout % tp == 0 and din % fsdp == 0:
-                return NamedSharding(mesh, P("fsdp", "model"))
-            if row and din % tp == 0 and dout % fsdp == 0:
-                return NamedSharding(mesh, P("model", "fsdp"))
-            if din % fsdp == 0:
-                return NamedSharding(mesh, P("fsdp", None))
-        return NamedSharding(mesh, P())
-
-    return jax.tree_util.tree_map_with_path(rule, params)
+    """Megatron-style rules keyed on param names (see module docstring)
+    — the declarative 'bert' table in
+    :mod:`tensorflowonspark_tpu.compute.layout`: a rule whose named
+    dims don't divide the mesh extents falls through to the next."""
+    return layout.param_shardings(params, mesh, "bert")
 
 
 def classification_loss_fn(model: BertForClassification):

@@ -23,7 +23,9 @@ import functools
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec
+
+from tensorflowonspark_tpu.compute import layout
 
 from tensorflowonspark_tpu.ops.attention import dot_product_attention
 from tensorflowonspark_tpu.ops.lora import (
@@ -786,56 +788,16 @@ class Llama(nn.Module):
 
 
 def llama_param_shardings(params, mesh: Mesh):
-    """Mesh sharding rules for a Llama param tree.
+    """Mesh sharding rules for a Llama param tree — the declarative
+    'llama' table in :mod:`tensorflowonspark_tpu.compute.layout`.
 
     Megatron layout on the ('fsdp', 'model') axes; biases/norms replicated.
     With mesh model=1 this degrades to pure FSDP (the Llama-2-7B baseline
-    config); with fsdp=1 to pure TP.
+    config); with fsdp=1 to pure TP. MoE expert banks and LoRA factor
+    halves are rules in the same table, so model-level and module-level
+    specs cannot diverge.
     """
-
-    def rule(path, leaf) -> NamedSharding:
-        names = [
-            p.key for p in path if isinstance(p, jax.tree_util.DictKey)
-        ]
-        joined = "/".join(names)
-        ndim = leaf.ndim
-        attr = getattr(path[-1], "name", None)
-        if ndim <= 1:
-            return NamedSharding(mesh, P())
-        if ndim == 3 and attr not in ("a", "b"):
-            # MoE expert banks (E, d, f) / (E, f, d); multi-LoRA
-            # adapter banks (K, in, r)/(K, r, out) are the OTHER ndim-3
-            # leaves and take the factor rules below instead
-            from tensorflowonspark_tpu.parallel.moe import (
-                moe_expert_bank_spec,
-            )
-
-            return NamedSharding(mesh, moe_expert_bank_spec(joined))
-        if "router" in joined:
-            return NamedSharding(mesh, P())
-        if any(k in joined for k in ("embed", "lm_head", "q_proj",
-                                     "k_proj", "v_proj", "gate_proj",
-                                     "up_proj")):
-            pair = ("fsdp", "model")  # col-parallel
-        elif any(k in joined for k in ("o_proj", "down_proj")):
-            pair = ("model", "fsdp")  # row-parallel
-        else:
-            pair = ("fsdp", None)
-        # LoRA factors inside a wrapped kernel: the base shards like the
-        # kernel it replaces; `a` (in, r) keeps the input half, `b`
-        # (r, out) the output half — consistent with the TP math (the
-        # rank dim stays replicated; it is tiny by construction). For a
-        # multi-LoRA BANK the same halves apply behind the leading K
-        # slots dim (replicated — every chip serves every adapter).
-        if attr == "a":
-            spec = (pair[0], None) if ndim == 2 else (None, pair[0], None)
-            return NamedSharding(mesh, P(*spec))
-        if attr == "b":
-            spec = (None, pair[1]) if ndim == 2 else (None, None, pair[1])
-            return NamedSharding(mesh, P(*spec))
-        return NamedSharding(mesh, P(*pair))
-
-    return jax.tree_util.tree_map_with_path(rule, params)
+    return layout.param_shardings(params, mesh, "llama")
 
 
 def init_cache(shapes):
@@ -858,20 +820,15 @@ def init_cache(shapes):
     return jax.tree_util.tree_map_with_path(init, shapes)
 
 
-def decode_cache_spec(x: jax.Array) -> P:
+def decode_cache_spec(x: jax.Array) -> PartitionSpec:
     """PartitionSpec for one KV-cache leaf under mesh-sharded decode:
     K/V (B, S, kv_heads, D) shard batch on 'data' and heads on 'model'
     (each TP shard holds only its heads' cache — the HBM split that
     makes 7B-class serving fit), int8-KV scale planes (B, S, kv_heads)
     follow their heads, the segment-id plane (B, S) shards on 'data',
-    the scalar write index replicates."""
-    if x.ndim == 4:
-        return P("data", None, "model", None)
-    if x.ndim == 3:
-        return P("data", None, "model")
-    if x.ndim == 2:
-        return P("data", None)
-    return P()
+    the scalar write index replicates. Declared as
+    ``layout.DECODE_CACHE_SPECS``."""
+    return layout.decode_cache_spec(x)
 
 
 def generate(
@@ -965,9 +922,9 @@ def generate(
         # no-op for already-placed serving calls).
         params = jax.device_put(params, llama_param_shardings(params, mesh))
         prompt = jax.device_put(
-            prompt, NamedSharding(mesh, P("data", None))
+            prompt, layout.activation_sharding(mesh, "prompt")
         )
-        rng = jax.device_put(rng, NamedSharding(mesh, P()))
+        rng = jax.device_put(rng, layout.replicated(mesh))
     # int8 weight-only decode: quantized trees (ops/quant.py
     # quantize_tree) pass straight through — QDense / the embed gather /
     # the head projection consume QuantTensor leaves natively, so the
@@ -1003,7 +960,9 @@ def generate(
             f"width); got {host.tolist()}"
         )
     if mesh is not None:
-        lengths = jax.device_put(lengths, NamedSharding(mesh, P("data")))
+        lengths = jax.device_put(
+            lengths, layout.activation_sharding(mesh, "per_row")
+        )
     return run(params, prompt, rng, lengths)
 
 
@@ -1095,7 +1054,7 @@ def _build_generate(
             return cache
         return jax.tree.map(
             lambda x: jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, decode_cache_spec(x))
+                x, layout.decode_cache_sharding(mesh, x)
             ),
             cache,
         )

@@ -24,7 +24,9 @@ import dataclasses
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+
+from tensorflowonspark_tpu.compute import layout
 
 from tensorflowonspark_tpu.ops.batch_norm import FusedBatchNorm
 
@@ -151,16 +153,9 @@ class ResNet(nn.Module):
 
 def resnet_param_shardings(params, mesh: Mesh):
     """FSDP rules: shard large kernels' output-channel dim over 'fsdp';
-    replicate BN scale/bias (tiny)."""
-
-    def rule(path, leaf) -> NamedSharding:
-        if leaf.ndim == 4 and leaf.shape[-1] % mesh.shape.get("fsdp", 1) == 0:
-            return NamedSharding(mesh, P(None, None, None, "fsdp"))
-        if leaf.ndim == 2 and leaf.shape[0] % mesh.shape.get("fsdp", 1) == 0:
-            return NamedSharding(mesh, P("fsdp", None))
-        return NamedSharding(mesh, P())
-
-    return jax.tree_util.tree_map_with_path(rule, params)
+    replicate BN scale/bias (tiny) — the declarative 'resnet' table in
+    :mod:`tensorflowonspark_tpu.compute.layout`."""
+    return layout.param_shardings(params, mesh, "resnet")
 
 
 def loss_fn(model: ResNet):

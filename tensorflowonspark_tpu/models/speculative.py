@@ -33,7 +33,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+
+from tensorflowonspark_tpu.compute import layout
 
 __all__ = ["speculative_generate", "speculative_accept"]
 
@@ -162,9 +164,11 @@ def speculative_generate(
             )
         params = jax.device_put(params, llama_param_shardings(params, mesh))
         draft_params = jax.device_put(
-            draft_params, NamedSharding(mesh, P())
+            draft_params, layout.replicated(mesh)
         )
-        prompt = jax.device_put(prompt, NamedSharding(mesh, P("data", None)))
+        prompt = jax.device_put(
+            prompt, layout.activation_sharding(mesh, "prompt")
+        )
     run = _build_speculative(
         model,
         draft_model,
@@ -178,7 +182,7 @@ def speculative_generate(
         temperature=float(temperature),
     )
     if mesh is not None:
-        rng = jax.device_put(rng, NamedSharding(mesh, P()))
+        rng = jax.device_put(rng, layout.replicated(mesh))
     if prompt_lengths is None:
         return run(params, draft_params, prompt, rng)
     lengths = jnp.asarray(prompt_lengths, jnp.int32)
@@ -195,7 +199,9 @@ def speculative_generate(
             f"width); got {host.tolist()}"
         )
     if mesh is not None:
-        lengths = jax.device_put(lengths, NamedSharding(mesh, P("data")))
+        lengths = jax.device_put(
+            lengths, layout.activation_sharding(mesh, "per_row")
+        )
     return run(params, draft_params, prompt, rng, lengths)
 
 
@@ -226,19 +232,15 @@ def _build_speculative(
     def constrain(cache, tp_sharded):
         # pin both KV caches at the loop boundary: the target's like
         # generate's mesh path (batch on 'data', heads on 'model'), the
-        # draft's batch-sharded only (its weights are replicated)
+        # draft's batch-sharded only (its weights are replicated —
+        # layout.decode_cache_spec(tp=False) drops the head axis)
         if mesh is None:
             return cache
-        from tensorflowonspark_tpu.models.llama import decode_cache_spec
-
-        def spec(x):
-            sp = decode_cache_spec(x)
-            if not tp_sharded and x.ndim == 4:
-                sp = P("data", None, None, None)
-            return NamedSharding(mesh, sp)
-
         return jax.tree.map(
-            lambda x: jax.lax.with_sharding_constraint(x, spec(x)), cache
+            lambda x: jax.lax.with_sharding_constraint(
+                x, layout.decode_cache_sharding(mesh, x, tp=tp_sharded)
+            ),
+            cache,
         )
 
     @jax.jit

@@ -23,7 +23,9 @@ import dataclasses
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+
+from tensorflowonspark_tpu.compute import layout
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,14 +88,9 @@ class UNet(nn.Module):
 
 def unet_param_shardings(params, mesh: Mesh):
     """FSDP rules: shard conv kernels' output channels over 'fsdp' where
-    divisible; replicate norm scale/bias (tiny)."""
-
-    def rule(path, leaf) -> NamedSharding:
-        if leaf.ndim == 4 and leaf.shape[-1] % mesh.shape.get("fsdp", 1) == 0:
-            return NamedSharding(mesh, P(None, None, None, "fsdp"))
-        return NamedSharding(mesh, P())
-
-    return jax.tree_util.tree_map_with_path(rule, params)
+    divisible; replicate norm scale/bias (tiny) — the declarative
+    'unet' table in :mod:`tensorflowonspark_tpu.compute.layout`."""
+    return layout.param_shardings(params, mesh, "unet")
 
 
 def loss_fn(model: UNet):

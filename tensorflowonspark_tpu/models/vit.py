@@ -28,7 +28,9 @@ import dataclasses
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+
+from tensorflowonspark_tpu.compute import layout
 
 from tensorflowonspark_tpu.ops.attention import dot_product_attention
 
@@ -149,29 +151,7 @@ def vit_param_shardings(params, mesh: Mesh):
     falls back to replication for that dim (e.g. the (hidden, 10)
     classifier head under model>1) rather than erroring at device_put.
     """
-    fsdp = mesh.shape.get("fsdp", 1)
-    tp = mesh.shape.get("model", 1)
-
-    def axis(extent, size, name):
-        return name if size % extent == 0 and extent > 1 else None
-
-    def rule(path, leaf) -> NamedSharding:
-        if leaf.ndim == 2:
-            return NamedSharding(
-                mesh,
-                P(
-                    axis(fsdp, leaf.shape[0], "fsdp"),
-                    axis(tp, leaf.shape[1], "model"),
-                ),
-            )
-        if leaf.ndim == 4:  # patch-embed conv kernel
-            return NamedSharding(
-                mesh,
-                P(None, None, None, axis(tp, leaf.shape[3], "model")),
-            )
-        return NamedSharding(mesh, P())
-
-    return jax.tree_util.tree_map_with_path(rule, params)
+    return layout.param_shardings(params, mesh, "vit")
 
 
 def loss_fn(model: ViT):

@@ -281,12 +281,11 @@ def mesh_flash_attention(
     (checked by the ``auto`` gate in :func:`_flash_mesh`; direct callers
     get shard_map's own divisibility errors).
     """
-    from jax.sharding import PartitionSpec as P
-
+    from tensorflowonspark_tpu.compute import layout
     from tensorflowonspark_tpu.ops.flash_attention import flash_attention
     from tensorflowonspark_tpu.parallel.context import sp_specs_and_args
 
-    spec = P(("data", "fsdp"), None, "model", None)
+    spec = layout.activation_spec("attn_bshd")
 
     def body(q, k, v, segment_ids=None):
         # positional: custom_vjp functions reject keyword arguments

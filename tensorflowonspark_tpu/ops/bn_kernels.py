@@ -231,10 +231,10 @@ def _mesh_stats(stats_fn, arrays, mesh):
     and psum the per-shard partial sums. Sums are exact identities under
     this split (each row lands in exactly one shard), so the result
     equals the single-device kernel up to fp32 summation order."""
-    from jax.sharding import PartitionSpec as P
+    from tensorflowonspark_tpu.compute import layout
 
-    axes = ("data", "fsdp")
-    spec = P(axes, *([None] * (arrays[0].ndim - 1)))
+    axes = layout.BATCH_AXES
+    spec = layout.batch_spec(arrays[0].ndim)
 
     def body(*arrs):
         a, b = stats_fn(*arrs)
@@ -244,7 +244,10 @@ def _mesh_stats(stats_fn, arrays, mesh):
         body,
         mesh=mesh,
         in_specs=(spec,) * len(arrays),
-        out_specs=(P(), P()),
+        out_specs=(
+            layout.activation_spec("replicated"),
+            layout.activation_spec("replicated"),
+        ),
         check_vma=False,
     )
     return fn(*arrays)

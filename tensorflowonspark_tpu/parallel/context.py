@@ -84,6 +84,8 @@ def sp_specs_and_args(base_spec, q, k, v, segment_ids=None):
     in_specs: tuple = (base_spec, base_spec, base_spec)
     args: tuple = (q, k, v)
     if segment_ids is not None:
-        in_specs = in_specs + (P(base_spec[0], base_spec[1]),)
+        in_specs = in_specs + (  # lint: layout-ok: the segment-ids spec is the leading two dims of the caller's q spec (parametric seq axis), not a fixed table row
+            P(base_spec[0], base_spec[1]),
+        )
         args = args + (segment_ids,)
     return in_specs, args

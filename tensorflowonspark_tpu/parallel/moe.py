@@ -29,7 +29,9 @@ import math
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tensorflowonspark_tpu.compute import layout
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,26 +152,14 @@ class MoEMLP(nn.Module):
 
 def moe_expert_bank_spec(param_name: str) -> P:
     """PartitionSpec for one 3-dim expert bank leaf: stacked dim on
-    ``expert``, FFN hidden on ``model``, the remaining dim on ``fsdp``.
-
-    Single source of truth — ``llama_param_shardings`` delegates here for
-    MoE leaves, so model-level and module-level rules cannot diverge.
-    """
-    if "w_down" in param_name:  # (E, f, d)
-        return P("expert", "model", "fsdp")
-    return P("expert", "fsdp", "model")  # (E, d, f)
+    ``expert``, FFN hidden on ``model``, the remaining dim on ``fsdp``
+    — the declarative 'moe' table in
+    :mod:`tensorflowonspark_tpu.compute.layout` (the llama table
+    carries the same rules, pinned equal by tests/test_layout.py)."""
+    return layout.expert_bank_spec(param_name)
 
 
 def moe_param_shardings(params, mesh: Mesh):
     """Sharding rules for an MoEMLP param tree: expert banks per
     :func:`moe_expert_bank_spec`; the router is replicated."""
-
-    def rule(path, leaf):
-        names = "/".join(
-            p.key for p in path if isinstance(p, jax.tree_util.DictKey)
-        )
-        if leaf.ndim == 3:  # (E, d, f) or (E, f, d) expert banks
-            return NamedSharding(mesh, moe_expert_bank_spec(names))
-        return NamedSharding(mesh, P())
-
-    return jax.tree_util.tree_map_with_path(rule, params)
+    return layout.param_shardings(params, mesh, "moe")

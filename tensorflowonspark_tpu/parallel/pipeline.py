@@ -97,8 +97,10 @@ def gpipe(
 
     Returns (num_micro, mb_size, ...) outputs of the final stage.
     """
-    param_specs = jax.tree.map(lambda _: P(pipe_axis), stage_params)
-    mb_spec = P(None, batch_axes)
+    param_specs = jax.tree.map(  # lint: layout-ok: stage placement over the caller-chosen pipe axis; shard_map operand spec, not a model layout
+        lambda _: P(pipe_axis), stage_params
+    )
+    mb_spec = P(None, batch_axes)  # lint: layout-ok: microbatch spec over caller-chosen dp axes; shard_map operand spec, not a model layout
     fn = compat.shard_map(
         functools.partial(
             _gpipe_local, stage_fn=stage_fn, axis_name=pipe_axis

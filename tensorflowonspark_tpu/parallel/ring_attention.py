@@ -217,7 +217,7 @@ def mesh_ring_attention(
     """
     from tensorflowonspark_tpu.parallel.context import sp_specs_and_args
 
-    qspec = P(("data", "fsdp"), seq_axis, "model", None)
+    qspec = P(("data", "fsdp"), seq_axis, "model", None)  # lint: layout-ok: SP operand spec over the caller-chosen seq axis; shard_map plumbing, not a model layout
     body = functools.partial(
         ring_attention, axis_name=seq_axis, causal=causal, scale=scale,
         window=window,

@@ -115,7 +115,7 @@ def mesh_ulysses_attention(
         )
     from tensorflowonspark_tpu.parallel.context import sp_specs_and_args
 
-    spec = P(("data", "fsdp"), seq_axis, "model", None)
+    spec = P(("data", "fsdp"), seq_axis, "model", None)  # lint: layout-ok: SP operand spec over the caller-chosen seq axis; shard_map plumbing, not a model layout
     body = functools.partial(
         _ulysses_local,
         axis_name=seq_axis,

@@ -608,8 +608,7 @@ class ContinuousBatcher:
         self._model = model
         self._mesh = mesh
         if mesh is not None:
-            from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as P
+            from tensorflowonspark_tpu.compute import layout
             from tensorflowonspark_tpu.models.llama import (
                 llama_param_shardings,
             )
@@ -636,23 +635,16 @@ class ContinuousBatcher:
                     other,
                 )
 
-            def keep(ax):
-                if isinstance(ax, (tuple, list)):  # multi-axis dim
-                    kept = tuple(a for a in ax if a == "model")
-                    return kept[0] if kept else None
-                return ax if ax == "model" else None
-
-            def tp_only(sh: NamedSharding) -> NamedSharding:
-                # Keep ONLY the 'model' (TP) placement; the training
-                # rules also shard on 'fsdp', which with a replicated
-                # batch would force a weight all-gather on every
-                # per-token decode step.
-                return NamedSharding(mesh, P(*(keep(ax) for ax in sh.spec)))
-
+            # Keep ONLY the 'model' (TP) placement; the training
+            # rules also shard on 'fsdp', which with a replicated
+            # batch would force a weight all-gather on every
+            # per-token decode step. One source of truth: the llama
+            # layout table projected through layout.tp_only.
             params = jax.device_put(
                 params,
                 jax.tree.map(
-                    tp_only, llama_param_shardings(params, mesh)
+                    lambda sh: layout.tp_only(mesh, sh),
+                    llama_param_shardings(params, mesh),
                 ),
             )
         self._params = params
@@ -1599,18 +1591,11 @@ class ContinuousBatcher:
         mesh."""
         if self._mesh is None:
             return cache
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        def spec(x):
-            if x.ndim == 4:  # K/V: heads on 'model'
-                return P(None, None, "model", None)
-            if x.ndim == 3:  # int8-KV scale planes follow their heads
-                return P(None, None, "model")
-            return P()
+        from tensorflowonspark_tpu.compute import layout
 
         return jax.tree.map(
             lambda x: jax.lax.with_sharding_constraint(
-                x, NamedSharding(self._mesh, spec(x))
+                x, layout.serve_cache_sharding(self._mesh, x)
             ),
             cache,
         )
@@ -1684,7 +1669,7 @@ class ContinuousBatcher:
             return cached
         body = self._decode_body()
 
-        @jax.jit
+        @jax.jit  # lint: layout-ok: params/cache arrive pre-committed to the engine TP layout at construction (layout.tp_only + serve_cache_sharding); donation would free the persistent slot buffers the scheduler reuses
         def block(
             params, cache, tok, pos, temps, ads, kps, seeds, pens,
             counts, bias_ids, bias_vals, gates,
@@ -1718,7 +1703,7 @@ class ContinuousBatcher:
         model = self._model
         constrain = self._constrain_cache
 
-        @jax.jit
+        @jax.jit  # lint: layout-ok: params/cache arrive pre-committed to the engine TP layout at construction (layout.tp_only + serve_cache_sharding); donation would free the persistent slot buffers the scheduler reuses
         def prefill(
             params, prompt, length, temps, ads, kps, seed_1, bid_1,
             bval_1,
@@ -1801,7 +1786,7 @@ class ContinuousBatcher:
         model = self._model
         constrain = self._constrain_cache
 
-        @jax.jit
+        @jax.jit  # lint: layout-ok: params/cache arrive pre-committed to the engine TP layout at construction (layout.tp_only + serve_cache_sharding); donation would free the persistent slot buffers the scheduler reuses
         def chunk(params, cache, tokens, positions, ads):
             logits, updated = model.apply(
                 {"params": params, "cache": cache},

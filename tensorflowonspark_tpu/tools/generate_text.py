@@ -458,11 +458,11 @@ def main(argv: list[str] | None = None) -> int:
         )
         draft_params = _load_params(args.draft_checkpoint, dcfg)
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
+            from tensorflowonspark_tpu.compute import layout
 
             # replicate the draft once, not per chunk
             draft_params = jax.device_put(
-                draft_params, NamedSharding(mesh, PartitionSpec())
+                draft_params, layout.replicated(mesh)
             )
         draft = (Llama(dcfg), draft_params)
 

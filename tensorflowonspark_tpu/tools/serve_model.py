@@ -1110,7 +1110,7 @@ def _build_gen_fn(gen: dict):
                 f"--gen-batch-size ({bsz}) must be divisible by the "
                 f"mesh 'data' extent ({mesh.shape['data']})"
             )
-        from jax.sharding import NamedSharding, PartitionSpec
+        from tensorflowonspark_tpu.compute import layout
         from tensorflowonspark_tpu.models.llama import llama_param_shardings
 
         # Pre-place the weights in their layouts ONCE at startup (target
@@ -1121,9 +1121,7 @@ def _build_gen_fn(gen: dict):
         if draft is not None:
             draft = (
                 draft[0],
-                jax.device_put(
-                    draft[1], NamedSharding(mesh, PartitionSpec())
-                ),
+                jax.device_put(draft[1], layout.replicated(mesh)),
             )
 
     def gen_fn(prompts: list[list[int]]) -> list[list[int]]:

@@ -1,0 +1,49 @@
+"""Seeded SH001–SH004 violations: specs built behind the layout table's
+back, an undeclared axis name, an unconstrained hot-path jit, and a
+with_sharding_constraint spec no table rule declares."""
+
+import jax
+from jax import sharding as jsh
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def raw_spec(mesh):
+    spec = P("data", None)  # SEEDED VIOLATION: raw PartitionSpec
+    return NamedSharding(mesh, spec)  # SEEDED VIOLATION: raw NamedSharding
+
+
+def escaped_spec(mesh, n):
+    # a justified construction is NOT flagged
+    return P(*([None] * n))  # lint: layout-ok: fixture exercises the escape grammar
+
+
+def typo_axis():
+    return P("fdsp", None)  # SEEDED VIOLATION: axis typo (SH002 + SH001)
+
+
+def module_alias_spec():
+    # `from jax import sharding` style must not bypass SH001
+    return jsh.PartitionSpec("data")  # SEEDED VIOLATION: aliased module
+
+
+def bad_constraint(x):
+    # the axes exist, but NO table rule declares ('model', 'data')
+    return jax.lax.with_sharding_constraint(
+        x,
+        P("model", "data"),  # SEEDED VIOLATION: matches no layout rule
+    )
+
+
+def unsharded_step(params, batch):
+    return params
+
+
+def hot_step_builder(state):
+    step = jax.jit(unsharded_step)  # SEEDED VIOLATION: SH003 hot jit
+    return step
+
+
+def cold_step_builder(state):
+    # identical jit NOT on the hot graph: must not be flagged
+    step = jax.jit(unsharded_step)
+    return step

@@ -113,3 +113,34 @@ class OrderedLocks:
 
     def stop(self) -> None:
         self._pump.join(timeout=10.0)  # bounded join satisfies TH001
+
+
+# -- SH neighborhoods -------------------------------------------------------
+
+
+def consumes_layout_table(mesh, params):
+    # the sanctioned path: specs come FROM the table, never built raw
+    from tensorflowonspark_tpu.compute import layout
+
+    psh = layout.param_shardings(params, mesh, "llama")
+    return layout.batch_sharding(mesh, 2), psh
+
+
+def declared_constraint(x):
+    # escaped construction (no SH001) whose spec IS a declared rule —
+    # a naive SH004 would flag every literal constraint
+    return jax.lax.with_sharding_constraint(
+        x,
+        jax.sharding.PartitionSpec("data", None),  # lint: layout-ok: clean fixture, the declared 'prompt' role spelled literally
+    )
+
+
+def hot_sharded_builder(state, shardings, mesh):
+    # hot root (the test points hot_roots here): jit WITH in_shardings
+    # — SH003's clean neighborhood
+    def sharded_step(params, batch):
+        return params
+
+    step = jax.jit(sharded_step, in_shardings=(shardings, None))
+    donated = jax.jit(sharded_step, donate_argnums=(0,))
+    return step, donated

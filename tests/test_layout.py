@@ -1,0 +1,293 @@
+"""The declarative layout table (compute/layout.py).
+
+Three layers:
+
+- **Table goldens** — each model family's rules evaluated on
+  representative leaf names/shapes must reproduce the hand-rolled specs
+  they replaced (the PR-11 migration is behavior-preserving by
+  construction; these pin it).
+- **Cross-table lockstep** — the llama table's MoE rules equal the moe
+  table's (one source of truth, two consumers).
+- **Layout ↔ elastic round-trip** — ``fit_axis_shapes`` +
+  ``reshard_state`` driven from the table across shrink/grow keep
+  params byte-identical AND the shardcheck collective census identical
+  before/after reshard; a seeded table mutation (dropping the fsdp
+  axis from one rule) is caught as a census diff.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tensorflowonspark_tpu.compute import layout
+from tensorflowonspark_tpu.compute.mesh import (
+    batch_sharding,
+    fit_axis_shapes,
+    make_mesh,
+    replicated,
+)
+
+
+# -- table goldens ----------------------------------------------------------
+
+
+def spec_of(table, name, shape, axis_sizes=None):
+    return layout.get_layout(table).spec(
+        name, shape, axis_sizes or {"data": 2, "fsdp": 2, "model": 2}
+    )
+
+
+def test_llama_table_core_rules():
+    # column-parallel projections
+    assert spec_of("llama", "embed/embedding", (256, 128)) == P("fsdp", "model")
+    assert spec_of("llama", "lm_head", (128, 256)) == P("fsdp", "model")
+    assert spec_of("llama", "layer0/attn/q_proj/kernel", (128, 128)) == P(
+        "fsdp", "model"
+    )
+    # row-parallel
+    assert spec_of("llama", "layer0/attn/o_proj/kernel", (128, 128)) == P(
+        "model", "fsdp"
+    )
+    assert spec_of("llama", "layer0/mlp/down_proj/kernel", (256, 128)) == P(
+        "model", "fsdp"
+    )
+    # biases / norms replicated; router replicated
+    assert spec_of("llama", "layer0/attn_norm/scale", (128,)) == P()
+    assert spec_of("llama", "layer0/moe/router/kernel", (128, 8)) == P()
+    # generic 2-D fallback
+    assert spec_of("llama", "layer0/other/kernel", (128, 128)) == P(
+        "fsdp", None
+    )
+
+
+def test_llama_table_lora_factors():
+    # 'a' keeps the input half of the base pair, 'b' the output half
+    assert spec_of("llama", "layer0/attn/q_proj/kernel/a", (128, 8)) == P(
+        "fsdp", None
+    )
+    assert spec_of("llama", "layer0/attn/q_proj/kernel/b", (8, 128)) == P(
+        None, "model"
+    )
+    assert spec_of("llama", "layer0/attn/o_proj/kernel/a", (128, 8)) == P(
+        "model", None
+    )
+    assert spec_of("llama", "layer0/attn/o_proj/kernel/b", (8, 128)) == P(
+        None, "fsdp"
+    )
+    # multi-LoRA banks: same halves behind the leading K slots dim
+    assert spec_of("llama", "layer0/attn/q_proj/kernel/a", (4, 128, 8)) == P(
+        None, "fsdp", None
+    )
+    assert spec_of("llama", "layer0/attn/q_proj/kernel/b", (4, 8, 128)) == P(
+        None, None, "model"
+    )
+
+
+def test_llama_and_moe_tables_lockstep():
+    # MoE expert banks: identical specs from both tables, any route
+    for name, shape in [
+        ("layer0/moe/w_gate", (4, 128, 256)),
+        ("layer0/moe/w_up", (4, 128, 256)),
+        ("layer0/moe/w_down", (4, 256, 128)),
+    ]:
+        assert spec_of("llama", name, shape) == spec_of("moe", name, shape)
+        assert spec_of("moe", name, shape) == layout.expert_bank_spec(name)
+    assert layout.expert_bank_spec("w_down") == P("expert", "model", "fsdp")
+    assert layout.expert_bank_spec("w_gate") == P("expert", "fsdp", "model")
+
+
+def test_bert_table_divisibility_fallthrough():
+    sizes = {"fsdp": 2, "model": 2}
+    assert spec_of("bert", "layer_0/attention/query/kernel", (128, 128),
+                   sizes) == P("fsdp", "model")
+    assert spec_of("bert", "layer_0/attention/attn_out/kernel", (128, 128),
+                   sizes) == P("model", "fsdp")
+    # odd output dim: the col rule falls through to the generic 2-D rule
+    assert spec_of("bert", "pooler/query/kernel", (128, 3), sizes) == P(
+        "fsdp", None
+    )
+    # odd both: replicated
+    assert spec_of("bert", "head/kernel", (3, 3), sizes) == P()
+
+
+def test_vit_table_per_dim_drop():
+    sizes = {"fsdp": 2, "model": 2}
+    assert spec_of("vit", "encoder/kernel", (128, 128), sizes) == P(
+        "fsdp", "model"
+    )
+    # an indivisible head dim under model=2: drop dim 1 only
+    assert spec_of("vit", "head/kernel", (128, 11), sizes) == P("fsdp", None)
+    # unit extents drop too (the historical vit behavior)
+    assert spec_of("vit", "encoder/kernel", (128, 128),
+                   {"fsdp": 1, "model": 2}) == P(None, "model")
+
+
+def test_resnet_unet_tables():
+    sizes = {"fsdp": 4}
+    assert spec_of("resnet", "conv/kernel", (3, 3, 64, 128), sizes) == P(
+        None, None, None, "fsdp"
+    )
+    assert spec_of("resnet", "dense/kernel", (128, 10), sizes) == P(
+        "fsdp", None
+    )
+    assert spec_of("resnet", "bn/scale", (64,), sizes) == P()
+    assert spec_of("unet", "conv/kernel", (3, 3, 64, 128), sizes) == P(
+        None, None, None, "fsdp"
+    )
+    assert spec_of("unet", "dense/kernel", (128, 10), sizes) == P()
+
+
+def test_role_helpers():
+    assert layout.batch_spec(3) == P(("data", "fsdp"), None, None)
+    assert layout.activation_spec("prompt") == P("data", None)
+    x4 = jnp.zeros((2, 4, 2, 8))
+    x3 = jnp.zeros((2, 4, 2))
+    assert layout.decode_cache_spec(x4) == P("data", None, "model", None)
+    assert layout.decode_cache_spec(x4, tp=False) == P(
+        "data", None, None, None
+    )
+    assert layout.decode_cache_spec(x3) == P("data", None, "model")
+    assert layout.serve_cache_spec(x4) == P(None, None, "model", None)
+    assert layout.serve_cache_spec(jnp.zeros(())) == P()
+    assert layout.fsdp_leaf_spec((4096, 31), 4) == P("fsdp", None)
+    assert layout.fsdp_leaf_spec((31, 4096), 4) == P(None, "fsdp")
+    assert layout.fsdp_leaf_spec((8,), 4) == P()  # tiny -> replicated
+
+
+def test_tp_only_projection(mesh8):
+    sh = layout.sharding(mesh8, P(("fsdp", "model"), None))
+    assert layout.tp_only(mesh8, sh).spec == P("model", None)
+    sh2 = layout.sharding(mesh8, P("fsdp", "model"))
+    assert layout.tp_only(mesh8, sh2).spec == P(None, "model")
+
+
+def test_unknown_table_and_missing_catchall():
+    with pytest.raises(KeyError, match="unknown layout table"):
+        layout.get_layout("nope")
+    bare = layout.SpecLayout(
+        "bare", ({"pattern": r"x", "spec": ("fsdp",)},)
+    )
+    with pytest.raises(ValueError, match="catch-all"):
+        bare.spec("y", (4,))
+
+
+# -- layout ↔ elastic round-trip with census equality -----------------------
+
+
+def _toy_params():
+    k = jax.random.PRNGKey(0)
+    ks = jax.random.split(k, 4)
+    return {
+        "embed": {"embedding": jax.random.normal(ks[0], (64, 32))},
+        "layer0": {
+            "q_proj": {"kernel": jax.random.normal(ks[1], (32, 64))},
+            "o_proj": {"kernel": jax.random.normal(ks[2], (64, 32))},
+            "norm": {"scale": jax.random.normal(ks[3], (32,))},
+        },
+    }
+
+
+def _toy_step(params, batch):
+    h = batch @ params["embed"]["embedding"]
+    h = h @ params["layer0"]["q_proj"]["kernel"]
+    h = h @ params["layer0"]["o_proj"]["kernel"]
+    return jnp.sum(h * params["layer0"]["norm"]["scale"])
+
+
+def _census_for(mesh, params, batch_shape):
+    from tensorflowonspark_tpu.analysis import shardcheck as sc
+
+    psh = layout.param_shardings(params, mesh, "llama")
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
+    )
+    batch = jax.ShapeDtypeStruct(batch_shape, jnp.float32)
+    return sc.hlo_census(
+        _toy_step,
+        (abstract, batch),
+        in_shardings=(psh, batch_sharding(mesh, len(batch_shape))),
+        out_shardings=replicated(mesh),
+    )
+
+
+def test_layout_elastic_roundtrip_bytes_and_census():
+    """Shrink 8→4 devices then grow back: params byte-identical, and the
+    table-derived collective census identical before/after."""
+    from tensorflowonspark_tpu.compute.elastic import reshard_state
+
+    devices = jax.devices()[:8]
+    spec = {"data": 2, "fsdp": -1, "model": 2}
+    mesh_a = make_mesh(fit_axis_shapes(spec, 8), devices=devices)
+    params = _toy_params()
+    placed = jax.tree.map(
+        jax.device_put, params, layout.param_shardings(params, mesh_a, "llama")
+    )
+    census_before = _census_for(mesh_a, params, (8, 64))
+
+    # shrink to 4 devices: the elastic axis absorbs the change
+    mesh_b = make_mesh(fit_axis_shapes(spec, 4), devices=devices[:4])
+    shrunk = reshard_state(
+        placed, layout.param_shardings(params, mesh_b, "llama")
+    )
+    # grow back to 8
+    mesh_c = make_mesh(fit_axis_shapes(spec, 8), devices=devices)
+    regrown = reshard_state(
+        shrunk, layout.param_shardings(params, mesh_c, "llama")
+    )
+
+    flat_a = jax.tree.leaves(jax.tree.map(jax.device_get, placed))
+    flat_c = jax.tree.leaves(jax.tree.map(jax.device_get, regrown))
+    for a, c in zip(flat_a, flat_c):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+    census_after = _census_for(mesh_c, params, (8, 64))
+    assert census_before == census_after
+
+
+def test_seeded_layout_mutation_is_a_census_diff():
+    """Dropping the fsdp axis from the col-parallel rule (the ISSUE's
+    worked example of an accidental layout edit) changes the collective
+    census — the regression shardcheck exists to catch."""
+    from tensorflowonspark_tpu.analysis import shardcheck as sc
+
+    mesh = make_mesh({"data": 2, "fsdp": 2, "model": 2})
+    params = _toy_params()
+    base = _census_for(mesh, params, (8, 64))
+
+    mutated_rules = []
+    for rule in layout.LAYOUT_TABLES["llama"]:
+        if rule["spec"] == ("fsdp", "model"):
+            rule = dict(rule, spec=(None, "model"))  # drop the fsdp axis
+        mutated_rules.append(rule)
+    mutated = layout.SpecLayout("llama-mutated", tuple(mutated_rules))
+
+    psh = layout.param_shardings(params, mesh, mutated)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
+    )
+    cur = sc.hlo_census(
+        _toy_step,
+        (abstract, jax.ShapeDtypeStruct((8, 64), jnp.float32)),
+        in_shardings=(psh, batch_sharding(mesh, 2)),
+        out_shardings=replicated(mesh),
+    )
+    diff = sc.diff_census({"hlo": base}, {"hlo": cur})
+    assert diff, "a dropped fsdp axis must change the census"
+
+
+def test_param_shardings_matches_model_functions(mesh8):
+    """The public model entry points ARE table lookups now — pin the
+    delegation (llama here; the zoo suites cover the conv families)."""
+    from tensorflowonspark_tpu.models.llama import llama_param_shardings
+
+    params = _toy_params()
+    via_model = llama_param_shardings(params, mesh8)
+    via_table = layout.param_shardings(params, mesh8, "llama")
+    assert all(
+        jax.tree.leaves(jax.tree.map(lambda a, b: a == b, via_model, via_table))
+    )
+    assert (
+        via_model["embed"]["embedding"].spec == P("fsdp", "model")
+    )

@@ -44,6 +44,8 @@ def fixture_cfg(**kw) -> Config:
         hot_roots=(
             f"{FIXTURES}/bad_hot_sync.py::serve_loop",
             f"{FIXTURES}/clean.py::hot_but_clean",
+            f"{FIXTURES}/clean.py::hot_sharded_builder",
+            f"{FIXTURES}/bad_sharding.py::hot_step_builder",
         ),
     )
     base.update(kw)
@@ -241,9 +243,78 @@ def test_clean_fixture_zero_false_positives(fixture_findings):
     assert not noise, [f.render() for f in noise]
 
 
+# -- SH: sharding/layout discipline (shardcheck static head) ----------------
+
+
+def test_sh001_raw_spec_construction(fixture_findings):
+    rel = f"{FIXTURES}/bad_sharding.py"
+    hits = by_rule(fixture_findings, "SH001")
+    assert all(f.path == rel for f in hits), [f.render() for f in hits]
+    assert {f.line for f in hits} == {
+        _line_of("bad_sharding.py", 'P("data", None)  # SEEDED'),
+        _line_of("bad_sharding.py", "NamedSharding(mesh, spec)"),
+        _line_of("bad_sharding.py", '"fdsp"'),
+        _line_of("bad_sharding.py", '"model", "data"'),
+        _line_of("bad_sharding.py", "jsh.PartitionSpec"),
+    }, [f.render() for f in hits]
+
+
+def test_sh001_layout_ok_escape(fixture_findings):
+    line = _line_of("bad_sharding.py", "lint: layout-ok: fixture")
+    assert not [
+        f
+        for f in fixture_findings
+        if f.line == line and f.path.endswith("bad_sharding.py")
+    ]
+
+
+def test_sh002_undeclared_axis(fixture_findings):
+    hits = by_rule(fixture_findings, "SH002")
+    assert len(hits) == 1, [f.render() for f in hits]
+    assert hits[0].path == f"{FIXTURES}/bad_sharding.py"
+    assert hits[0].line == _line_of("bad_sharding.py", '"fdsp"')
+    assert "'fdsp'" in hits[0].message and "MESH_AXES" in hits[0].message
+
+
+def test_sh003_hot_unsharded_jit(fixture_findings):
+    hits = by_rule(fixture_findings, "SH003")
+    assert len(hits) == 1, [f.render() for f in hits]
+    assert hits[0].path == f"{FIXTURES}/bad_sharding.py"
+    assert hits[0].line == _line_of(
+        "bad_sharding.py", "jax.jit(unsharded_step)  # SEEDED"
+    )
+    # the identical jit in cold_step_builder (not on the hot graph)
+    # must NOT be flagged — covered by the len == 1 above
+
+
+def test_sh004_constraint_outside_table(fixture_findings):
+    hits = by_rule(fixture_findings, "SH004")
+    assert len(hits) == 1, [f.render() for f in hits]
+    assert hits[0].path == f"{FIXTURES}/bad_sharding.py"
+    assert hits[0].line == _line_of(
+        "bad_sharding.py", '"model", "data"'
+    )
+    assert "matches no rule" in hits[0].message
+
+
+def test_sh_clean_fixture_has_table_consumers(fixture_findings):
+    """The clean fixture's layout-consuming functions (table lookups, a
+    declared-spec constraint, hot jits WITH shardings/donation) produce
+    zero SH findings — guarded by test_clean_fixture_zero_false_
+    positives; this pins the neighborhoods actually being present."""
+    src = open(os.path.join(ROOT, FIXTURES, "clean.py")).read()
+    assert "param_shardings" in src
+    assert "with_sharding_constraint" in src
+    assert "in_shardings" in src
+
+
 def test_holds_lock_allowlist(fixture_findings):
     line = _line_of("bad_lock.py", "allowlisted")
-    assert not [f for f in fixture_findings if f.line == line]
+    assert not [
+        f
+        for f in fixture_findings
+        if f.line == line and f.path.endswith("bad_lock.py")
+    ]
 
 
 # -- rule toggles + baseline mechanics --------------------------------------

@@ -1,0 +1,192 @@
+"""shardcheck: the collective-census trace head + its CLI gate.
+
+The layout tests (tests/test_layout.py) cover census equality across
+elastic reshard and the seeded-mutation diff; this suite covers the
+census machinery itself — jaxpr-head provenance, HLO-head detection of
+GSPMD-inserted collectives, determinism, the diff/gate mechanics, and
+the CLI entry point.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tensorflowonspark_tpu.analysis import shardcheck as sc
+from tensorflowonspark_tpu.compute import layout
+from tensorflowonspark_tpu.compute.mesh import (
+    batch_sharding,
+    make_mesh,
+    replicated,
+)
+from tensorflowonspark_tpu.utils import compat
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+# -- jaxpr head -------------------------------------------------------------
+
+
+def test_jaxpr_census_counts_explicit_psum_with_provenance():
+    mesh = make_mesh({"data": 8})
+
+    def body(w, x):
+        partial = x @ w
+        return jax.lax.psum(partial, ("data", "fsdp"))
+
+    fn = compat.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(layout.activation_spec("replicated"),
+                  layout.batch_spec(2)),
+        out_specs=layout.activation_spec("replicated"),
+    )
+    params = {"dense": {"kernel": jnp.zeros((16, 16))}}
+
+    def step(p, batch):
+        return fn(p["dense"]["kernel"], batch)
+
+    census = sc.jaxpr_census(
+        step,
+        (params, jnp.zeros((8, 16))),
+        arg_names=("params", "batch"),
+    )
+    # shard_map lowers the replicated operand + psum through
+    # pbroadcast/psum2 on this jax; exactly one reduction either way
+    psums = {k: v for k, v in census.items() if k.startswith("psum")}
+    assert len(psums) == 1, census
+    (key, count), = psums.items()
+    assert count == 1
+    # provenance: the reduction's operands trace back to the params root
+    assert "params/dense/kernel" in key
+
+
+def test_jaxpr_census_empty_without_collectives():
+    assert sc.jaxpr_census(lambda x: x * 2, (jnp.ones((4,)),)) == {}
+
+
+def test_jaxpr_census_accepts_abstract_args():
+    x = jax.ShapeDtypeStruct((4, 4), jnp.float32)
+    assert sc.jaxpr_census(lambda a: a @ a, (x,)) == {}
+
+
+# -- HLO head ---------------------------------------------------------------
+
+
+def _fsdp_program():
+    mesh = make_mesh({"data": 2, "fsdp": 4})
+    params = {"w": jax.ShapeDtypeStruct((64, 64), jnp.float32)}
+    psh = jax.tree.map(
+        lambda s: layout.fsdp_leaf_sharding(mesh, s.shape,
+                                            min_shard_elements=1),
+        params,
+    )
+
+    def step(p, batch):
+        return jnp.sum(batch @ p["w"])
+
+    batch = jax.ShapeDtypeStruct((8, 64), jnp.float32)
+    return mesh, step, params, psh, batch
+
+
+def test_hlo_census_sees_gspmd_collectives():
+    """FSDP-sharded weight x replicated-output matmul: GSPMD must move
+    data (all-gather/all-reduce/reduce-scatter). The jaxpr shows NONE
+    of it — exactly why the HLO head exists."""
+    mesh, step, params, psh, batch = _fsdp_program()
+    hlo = sc.hlo_census(
+        step,
+        (params, batch),
+        in_shardings=(psh, batch_sharding(mesh, 2)),
+        out_shardings=replicated(mesh),
+    )
+    assert hlo, "expected GSPMD-inserted collectives"
+    assert any(
+        op.split(" ")[0].rstrip("0123456789.") in
+        ("all-gather", "all-reduce", "reduce-scatter")
+        for op in hlo
+    ), hlo
+    assert sc.jaxpr_census(step, (params, batch)) == {}
+
+
+def test_census_is_deterministic():
+    mesh, step, params, psh, batch = _fsdp_program()
+    kw = dict(
+        in_shardings=(psh, batch_sharding(mesh, 2)),
+        out_shardings=replicated(mesh),
+    )
+    a = sc.census(step, (params, batch), **kw)
+    b = sc.census(step, (params, batch), **kw)
+    assert a["jaxpr"] == b["jaxpr"] and a["hlo"] == b["hlo"]
+
+
+# -- diff / gate mechanics --------------------------------------------------
+
+
+def test_diff_census_reports_both_directions():
+    base = {"jaxpr": {"psum[a]": 2}, "hlo": {"all-gather f32[8]": 1}}
+    cur = {"jaxpr": {"psum[a]": 3}, "hlo": {"all-reduce f32[8]": 1}}
+    diff = sc.diff_census(base, cur)
+    assert len(diff) == 3, diff
+    assert any("psum[a]: baseline 2 != current 3" in d for d in diff)
+    assert any("all-gather" in d for d in diff)
+    assert any("all-reduce" in d for d in diff)
+    assert sc.diff_census(base, base) == []
+
+
+def test_committed_baseline_shape():
+    """tools/shardcheck_baseline.json is the llama1b gate artifact: it
+    must carry both heads plus the meta the gate pins."""
+    with open(os.path.join(ROOT, "tools", "shardcheck_baseline.json")) as f:
+        data = json.load(f)
+    assert set(data) >= {"meta", "jaxpr", "hlo"}
+    assert data["meta"]["model"] == "llama1b"
+    assert data["hlo"], "llama1b on a 3-axis mesh must show collectives"
+    assert all(
+        isinstance(v, int) and v > 0 for v in data["hlo"].values()
+    )
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_tiny_census_and_gate(tmp_path):
+    """End-to-end: the CLI lowers the real train step for the tiny
+    model, writes a census, and gates green against its own output."""
+    out = tmp_path / "census.json"
+    base = tmp_path / "baseline.json"
+    cmd = [
+        sys.executable, os.path.join(ROOT, "tools", "shardcheck.py"),
+        "--model", "tiny", "--seq", "16", "--batch", "8",
+        "--baseline", str(base), "--json", str(out),
+    ]
+    proc = subprocess.run(
+        cmd + ["--write-baseline"],
+        cwd=ROOT, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    census = json.loads(out.read_text())
+    assert census["hlo"], "sharded tiny train step must show collectives"
+
+    proc = subprocess.run(
+        cmd + ["--gate"],
+        cwd=ROOT, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "matches the baseline" in proc.stdout
+
+    # a tampered baseline (one extra all-gather) must fail the gate
+    data = json.loads(base.read_text())
+    data["hlo"]["all-gather f32[9999]"] = 1
+    base.write_text(json.dumps(data))
+    proc = subprocess.run(
+        cmd + ["--gate"],
+        cwd=ROOT, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+    assert "DIFFERS" in proc.stdout

@@ -68,6 +68,14 @@ SLOW_SUITES = [
 ]
 SLOW_TIMEOUT = 900.0
 
+# The slow tier also runs the shardcheck collective-census gate: the
+# llama1b train step is AOT-lowered on faux CPU devices and its
+# collective census diffed against tools/shardcheck_baseline.json — a
+# layout-table edit that adds an unintended all-gather fails here
+# (docs/STATIC_ANALYSIS.md "Sharding/layout analyzer").
+SHARDCHECK_CMD = ["tools/shardcheck.py", "--model", "llama1b", "--gate"]
+SHARDCHECK_TIMEOUT = 900.0
+
 _FAIL_RE = re.compile(r"^(?:FAILED|ERROR)\s+(\S+)")
 
 
@@ -298,6 +306,34 @@ def main(argv: list[str] | None = None) -> int:
         for f in res["failed"]:
             print(f"    {f}")
         all_failed.update(res["failed"])
+
+    if args.slow and not args.suites:
+        t1 = time.monotonic()
+        try:
+            gate = subprocess.run(
+                [sys.executable, os.path.join(REPO_ROOT, *SHARDCHECK_CMD[:1]),
+                 *SHARDCHECK_CMD[1:]],
+                cwd=REPO_ROOT,
+                capture_output=True,
+                text=True,
+                timeout=SHARDCHECK_TIMEOUT,
+            )
+            gate_rc = gate.returncode
+            gate_out = gate.stdout + (
+                ("\n" + gate.stderr) if gate.stderr else ""
+            )
+        except subprocess.TimeoutExpired as e:
+            gate_rc = -1
+            gate_out = f"shardcheck gate timed out: {e}"
+        status = "ok" if gate_rc == 0 else "FAILED"
+        print(
+            f"[gate] tools/shardcheck.py (llama1b census): {status} "
+            f"({round(time.monotonic() - t1, 1)}s)",
+            flush=True,
+        )
+        if gate_rc != 0:
+            all_failed.add("tools/shardcheck.py::CENSUS_GATE")
+            print(gate_out[-1500:])
     total_s = round(time.monotonic() - t0, 1)
 
     if args.write_baseline:

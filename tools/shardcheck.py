@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""shardcheck CLI — collective census of the train step vs a baseline.
+
+Lowers the REAL train step (``compute.train.make_step_fn`` + the
+declarative layout table) abstractly on faux CPU devices — no parameter
+memory is allocated — and counts collectives in the jaxpr (explicit
+``psum``/``all_gather``/… with parameter provenance) and in the
+SPMD-partitioned compiled HLO (the all-gathers GSPMD inserts to satisfy
+the shardings). The census is diffed against a committed per-model
+baseline, so an unintended collective introduced by a layout-table edit
+fails the build instead of quietly eating MFU.
+
+Usage (from the repo root)::
+
+    python tools/shardcheck.py --model tiny             # quick look
+    python tools/shardcheck.py --model llama1b --gate   # what CI runs
+    python tools/shardcheck.py --model llama1b --write-baseline
+    python tools/shardcheck.py --model tiny --json out.json
+
+Exit codes: 0 census matches the baseline (or no gate requested),
+1 census diff, 2 usage error. The slow tier (``tools/run_tier1.py
+--slow``) runs the llama1b gate; see docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join("tools", "shardcheck_baseline.json")
+DEFAULT_MESH = "data=2,fsdp=2,model=2"
+N_FAUX_DEVICES = 8
+
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def _force_cpu_devices() -> None:
+    """Faux CPU device farm — must run BEFORE jax initializes."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={N_FAUX_DEVICES}"
+        ).strip()
+
+
+def build_census(model_name: str, mesh_spec: str, batch: int, seq: int):
+    """Census of the llama train step for one (model, mesh, shape)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu.analysis import shardcheck as sc
+    from tensorflowonspark_tpu.compute import layout
+    from tensorflowonspark_tpu.compute.mesh import (
+        batch_sharding,
+        make_mesh,
+        parse_axis_spec,
+        replicated,
+    )
+    from tensorflowonspark_tpu.compute.train import (
+        TrainState,
+        make_step_fn,
+        state_shardings,
+    )
+    from tensorflowonspark_tpu.models.llama import (
+        Llama,
+        LlamaConfig,
+        llama_loss_fn,
+    )
+
+    if model_name == "llama1b":
+        cfg = LlamaConfig.llama_1b(max_seq_len=seq, remat=False)
+    elif model_name == "tiny":
+        cfg = LlamaConfig.tiny(max_seq_len=seq, remat=False)
+    else:
+        raise SystemExit(f"shardcheck: unknown --model {model_name!r}")
+
+    mesh = make_mesh(parse_axis_spec(mesh_spec))
+    model = Llama(cfg)
+    token_loss = llama_loss_fn(model)
+
+    def loss_fn(params, b):
+        return token_loss(params, b["tokens"])
+
+    tx = optax.adamw(1e-3)
+    tokens = jax.ShapeDtypeStruct((batch, seq + 1), jnp.int32)
+    params = jax.eval_shape(
+        lambda t: model.init(jax.random.PRNGKey(0), t[:, :-1])["params"],
+        tokens,
+    )
+    state = TrainState(
+        step=jax.ShapeDtypeStruct((), jnp.int32),
+        params=params,
+        opt_state=jax.eval_shape(tx.init, params),
+    )
+    psh = layout.param_shardings(params, mesh, "llama")
+    ssh = state_shardings(state, mesh, psh)
+    step = make_step_fn(loss_fn, tx, mesh)
+    batch_tree = {"tokens": tokens}
+    return sc.census(
+        step,
+        (state, batch_tree),
+        in_shardings=(ssh, batch_sharding(mesh)),
+        out_shardings=(ssh, replicated(mesh)),
+        donate_argnums=(0,),
+        arg_names=("state", "batch"),
+        meta={
+            "model": model_name,
+            "mesh": mesh_spec,
+            "batch": batch,
+            "seq": seq,
+            "devices": N_FAUX_DEVICES,
+        },
+    )
+
+
+def main(argv: list | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="shardcheck",
+        description="collective census of the sharded train step, "
+        "gated against a committed baseline",
+    )
+    ap.add_argument("--model", default="llama1b",
+                    help="llama1b (the bench config) or tiny")
+    ap.add_argument("--mesh", default=DEFAULT_MESH,
+                    help=f"axis spec (default {DEFAULT_MESH!r}; must "
+                    f"multiply to {N_FAUX_DEVICES})")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=128,
+                    help="sequence length to trace at (collective "
+                    "STRUCTURE is layout-determined, so a short seq "
+                    "keeps the CPU compile fast)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current census as the baseline")
+    ap.add_argument("--gate", action="store_true",
+                    help="diff the census against the baseline; "
+                    "exit 1 on any difference")
+    ap.add_argument("--json", default=None,
+                    help="also dump the census to this path")
+    args = ap.parse_args(argv)
+
+    if args.write_baseline and args.gate:
+        ap.error("--write-baseline and --gate are mutually exclusive")
+
+    _force_cpu_devices()
+
+    from tensorflowonspark_tpu.analysis.shardcheck import diff_census
+
+    cur = build_census(args.model, args.mesh, args.batch, args.seq)
+
+    baseline_path = (
+        args.baseline
+        if os.path.isabs(args.baseline)
+        else os.path.join(REPO_ROOT, args.baseline)
+    )
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(cur, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if args.write_baseline:
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            json.dump(cur, f, indent=2, sort_keys=True)
+            f.write("\n")
+        n = len(cur["jaxpr"]) + len(cur["hlo"])
+        print(
+            f"shardcheck: wrote {n} census entr(y/ies) to "
+            f"{os.path.relpath(baseline_path, REPO_ROOT)}"
+        )
+        return 0
+
+    total = sum(cur["jaxpr"].values()) + sum(cur["hlo"].values())
+    print(
+        f"shardcheck: {args.model} on {args.mesh}: "
+        f"{sum(cur['jaxpr'].values())} jaxpr collective(s), "
+        f"{sum(cur['hlo'].values())} HLO collective(s) "
+        f"({total} total)"
+    )
+    for head in ("jaxpr", "hlo"):
+        for key, n in cur[head].items():
+            print(f"  {head}: {key}: {n}")
+
+    if not args.gate:
+        return 0
+
+    try:
+        with open(baseline_path, "r", encoding="utf-8") as f:
+            baseline = json.load(f)
+    except OSError as e:
+        print(f"shardcheck: cannot read baseline: {e}", file=sys.stderr)
+        return 1
+    bmeta = {
+        k: v
+        for k, v in baseline.get("meta", {}).items()
+        if k != "jax_version"
+    }
+    cmeta = {k: v for k, v in cur["meta"].items() if k != "jax_version"}
+    if bmeta != cmeta:
+        print(
+            f"shardcheck: baseline meta {bmeta} != current {cmeta} — "
+            "regenerate with --write-baseline at the gated config",
+            file=sys.stderr,
+        )
+        return 1
+    diff = diff_census(baseline, cur)
+    if diff:
+        print("shardcheck: census DIFFERS from the baseline:")
+        for line in diff:
+            print(f"  {line}")
+        print(
+            "shardcheck: a layout edit changed the collective traffic "
+            "of the train step; if intended, refresh with "
+            "--write-baseline and justify in the PR"
+        )
+        return 1
+    print("shardcheck: census matches the baseline")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
