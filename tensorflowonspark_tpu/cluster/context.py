@@ -101,17 +101,55 @@ class TFNodeContext:
         :meth:`get_data_feed`, no driver in the data loop. ``reader``
         overrides manifest expansion (custom formats); extra kwargs
         reach the ``IngestFeed`` constructor (``records_per_chunk``,
-        ``retry``)."""
+        ``retry``, ``publish_blocks``, ``adopt_timeout``).
+
+        Plans published by an elastic cluster carry ``handover: True``:
+        the returned feed is then wired into the live-shard-
+        redistribution protocol — it watches the membership epoch via
+        the elastic watcher, publishes its replay cursor to the
+        driver's durable table, and adopts driver re-splits on epoch
+        bumps (docs/ROBUSTNESS.md "Live shard redistribution")."""
         from tensorflowonspark_tpu.cluster.node import fetch_ingest_plan
         from tensorflowonspark_tpu.feed.ingest import IngestFeed
 
         plan = fetch_ingest_plan(self.mgr, timeout=timeout)
+        wires: dict[str, Any] = {}
+        server_addr = self.extras.get("server_addr")
+        if plan.get("handover") and server_addr is not None:
+            from tensorflowonspark_tpu.cluster import reservation
+            from tensorflowonspark_tpu.cluster.node import (
+                publish_ingest_cursor,
+            )
+            from tensorflowonspark_tpu.compute import elastic
+
+            client = reservation.Client(server_addr)
+            eid = self.executor_id
+
+            def _publish(payload: dict[str, Any]) -> None:
+                publish_ingest_cursor(client, eid, payload)
+
+            def _plan_fetch(min_epoch: int, fetch_timeout: float):
+                try:
+                    return fetch_ingest_plan(
+                        self.mgr,
+                        timeout=fetch_timeout,
+                        min_epoch=min_epoch,
+                    )
+                except TimeoutError:
+                    return None
+
+            wires = {
+                "plan_fetch": _plan_fetch,
+                "cursor_publish": _publish,
+                "epoch_watch": elastic.current_epoch,
+            }
         return IngestFeed(
             plan["manifests"],
             input_mapping=input_mapping,
             reader=reader,
             plan_epoch=int(plan.get("epoch", 0)),
             worker_index=self.executor_id,
+            **wires,
             **kwargs,
         )
 

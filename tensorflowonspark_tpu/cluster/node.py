@@ -229,6 +229,10 @@ def run_node(
         tb_port=tb_port,
         log_dir=log_dir,
     )
+    # The handover protocol's cursor wire needs the reservation server
+    # address (cursors must outlive this process — see
+    # publish_ingest_cursor); ctx.get_ingest_feed wires it up.
+    ctx.extras["server_addr"] = list(cluster_meta["server_addr"])
 
     # 5. run the user fn; ferry exceptions to the driver via the error queue
     #    (reference: the 'error' queue contract in TFSparkNode)
@@ -554,12 +558,18 @@ def publish_ingest_plan(
     shard_index: int = 0,
     num_shards: int = 1,
     plan_id: str | None = None,
+    handover: bool = False,
+    complete: bool = False,
 ) -> None:
     """Driver side of the pull-plane handshake: publish one node's
-    shard plan to its manager KV. THE owner of the plan's wire shape —
-    `TFCluster._publish_ingest_plan` and the feed-plane bench's
-    staggered mode both go through here, so the dict
-    :func:`fetch_ingest_plan` returns cannot fork between producers."""
+    shard plan to its manager KV, keyed by the membership ``epoch``.
+    THE owner of the plan's wire shape — `TFCluster._publish_ingest_plan`
+    and the feed-plane bench's staggered mode both go through here, so
+    the dict :func:`fetch_ingest_plan` returns cannot fork between
+    producers. ``handover`` arms the consumer's live-redistribution
+    protocol (``ctx.get_ingest_feed`` wires the watcher + cursor
+    publisher); ``complete`` is the driver's end-of-dataset marker —
+    lingering consumers stop instead of waiting for more work."""
     mgr.set(
         INGEST_PLAN_KEY,
         {
@@ -568,12 +578,17 @@ def publish_ingest_plan(
             "shard_index": int(shard_index),
             "num_shards": int(num_shards),
             "manifests": list(manifests),
+            "handover": bool(handover),
+            "complete": bool(complete),
         },
     )
 
 
 def fetch_ingest_plan(
-    mgr: tf_manager.ManagerHandle, timeout: float = 600.0, poll: float = 0.25
+    mgr: tf_manager.ManagerHandle,
+    timeout: float = 600.0,
+    poll: float = 0.25,
+    min_epoch: int = 0,
 ) -> dict[str, Any]:
     """Node side of the pull plane's control handshake: block until the
     driver publishes this node's shard plan (``TFCluster.assign_shards``
@@ -582,22 +597,44 @@ def fetch_ingest_plan(
 
     Probed rather than pushed: ``map_fun`` typically asks for its feed
     before the driver has planned shards, exactly like the feed-timeout
-    KV. Raises TimeoutError after ``timeout`` seconds — an ingest
-    consumer on a cluster whose driver never planned shards is a
+    KV. ``min_epoch`` is the handover protocol's adoption wait: plans
+    stamped with an older membership epoch (the pre-reconfigure shard
+    this consumer just drained) are skipped until the driver publishes
+    the re-split. Raises TimeoutError after ``timeout`` seconds — an
+    ingest consumer on a cluster whose driver never planned shards is a
     programming error that must not block forever.
     """
     failpoint("ingest.manifest_fetch")
     deadline = time.monotonic() + timeout
     while True:
         plan = mgr.get(INGEST_PLAN_KEY)
-        if plan is not None:
+        if plan is not None and int(plan.get("epoch", 0)) >= int(min_epoch):
             return plan
         if time.monotonic() >= deadline:
             raise TimeoutError(
-                f"no ingest plan published within {timeout}s — did the "
-                "driver call TFCluster.assign_shards()?"
+                f"no ingest plan (epoch >= {min_epoch}) published within "
+                f"{timeout}s — did the driver call "
+                "TFCluster.assign_shards()?"
             )
         time.sleep(poll)
+
+
+def publish_ingest_cursor(
+    client: reservation.Client, executor_id: int, payload: dict[str, Any]
+) -> None:
+    """Node side of the handover protocol's cursor wire, beside
+    :func:`publish_ingest_plan`: ship one consumer's replay cursor to
+    the DRIVER-side table (``reservation.Server`` ``ICURSOR``) — the
+    one store that survives this node being SIGKILLed, which is exactly
+    what the crash-handover path seeds a redistribution from. Payload:
+    ``{"epoch", "final", "cursor", "records_per_chunk",
+    "frame_blocks"}`` (see ``IngestFeed._publish_cursor``)."""
+    if failpoint("ingest.cursor_publish") == "drop":
+        # chaos: a lost publication — the driver falls back to the
+        # previous cursor; duplicates widen by the staleness, zero-gap
+        # is untouched (the documented degradation)
+        return
+    client.publish_cursor(executor_id, payload)
 
 
 def feed_partition(

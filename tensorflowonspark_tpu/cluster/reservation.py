@@ -67,6 +67,13 @@ class Reservations:
         # Active membership (executor ids). None until seal(): before the
         # startup barrier completes, "membership" is just the roster.
         self._active_ids: list[int] | None = None  # guarded-by: self._lock
+        # Pull-plane replay cursors, by executor id (the handover
+        # protocol's durable store — docs/ROBUSTNESS.md "Live shard
+        # redistribution"). Lives HERE, on the driver, precisely so a
+        # SIGKILLed node's last published cursor survives it: remove()
+        # deliberately leaves this table alone, because a dead node's
+        # cursor is the seed its orphaned shard is redistributed from.
+        self._cursors: dict[int, dict[str, Any]] = {}  # guarded-by: self._lock
 
     def add(self, meta: dict[str, Any]) -> None:
         # Idempotent per executor_id: Client._call retries the REG when
@@ -205,6 +212,21 @@ class Reservations:
             self._epoch += 1
             return self._epoch
 
+    # -- pull-plane replay cursors (live shard redistribution) ---------
+
+    def put_cursor(self, executor_id: int, payload: dict[str, Any]) -> None:
+        """Record one node's latest ingest replay cursor (latest wins —
+        consumption claims only ever grow, so the newest publication
+        supersedes)."""
+        with self._lock:
+            self._cursors[int(executor_id)] = dict(payload)
+
+    def cursors(self) -> dict[int, dict[str, Any]]:
+        """Every node's latest cursor payload — departed nodes
+        included (their last publication is the redistribution seed)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._cursors.items()}
+
     def membership(self) -> dict[str, Any]:
         """{"epoch": int, "roster": active roster} in one locked read —
         the QEPOCH payload (an epoch and someone ELSE's roster would
@@ -279,6 +301,11 @@ class Server:
       an NTP-style clock-offset estimate (``obs.cluster.
       note_clock_sync``) that ``tools/trace_merge.py`` uses to align
       per-node trace timelines
+    - ``ICURSOR`` {executor_id, payload} → ack; records the node's
+      latest pull-plane replay cursor in the driver-side table
+      (``Reservations.put_cursor`` — the live-shard-redistribution
+      protocol's durable cursor store, which must outlive the
+      publishing node)
     - ``STOP``  → ack; raises the stop flag that `Client.await_stop` and
       node watchdogs observe (out-of-band cluster kill)
     """
@@ -363,6 +390,13 @@ class Server:
                         conn,
                         {"type": "OK", **self.reservations.membership()},
                     )
+                elif mtype == "ICURSOR":
+                    # pull-plane cursor publication (handover protocol):
+                    # stored driver-side so it survives the publisher
+                    self.reservations.put_cursor(
+                        msg["executor_id"], msg.get("payload") or {}
+                    )
+                    MessageSocket.send(conn, {"type": "OK"})
                 elif mtype == "HEARTBEAT":
                     self.reservations.heartbeat(msg["executor_id"])
                     MessageSocket.send(
@@ -476,6 +510,21 @@ class Client:
 
     def get_reservations(self) -> list[dict[str, Any]]:
         return self._call({"type": "QINFO"})["cluster_info"]
+
+    def publish_cursor(
+        self, executor_id: int, payload: dict[str, Any]
+    ) -> None:
+        """Publish this node's pull-plane replay cursor to the driver's
+        durable table (``ICURSOR``). Payloads must be JSON-shaped —
+        cursors are ``{stream: seq | [seq, skip]}`` dicts, which are."""
+        self._call(
+            {
+                "type": "ICURSOR",
+                "executor_id": int(executor_id),
+                "payload": payload,
+            },
+            timeout=10.0,
+        )
 
     def membership(self) -> dict[str, Any]:
         """Current membership: ``{"epoch": int, "roster": [...]}`` —

@@ -76,6 +76,15 @@ class TFCluster:
         # a reconfigure (remove/admit + epoch bump) instead of raising.
         self.elastic = bool(cluster_meta.get("elastic", False))
         self.elastic_min_nodes = int(cluster_meta.get("elastic_min_nodes", 1))
+        # Live shard redistribution (docs/ROBUSTNESS.md): with
+        # ingest_handover (the default), an elastic reconfigure
+        # RE-SPLITS the remaining records over the survivors instead of
+        # re-publishing stable shards — the PR-8 stable assignment
+        # stays as the ingest_handover=False fallback.
+        self.ingest_handover = bool(cluster_meta.get("ingest_handover", True))
+        self.handover_timeout = float(
+            cluster_meta.get("handover_timeout", 30.0)
+        )
         # The startup barrier roster is epoch-0 membership.
         server.reservations.seal()
         # Executors that elastically LEFT (death or voluntary): their
@@ -89,9 +98,16 @@ class TFCluster:
         # with the same one (run() fills this in).
         self._node_env: dict[str, str] = {}
         # Pull-plane shard map (assign_shards): executor id -> manifest
-        # list, STABLE after assignment so an elastic reconfigure can
-        # re-publish without ever moving a shard between nodes.
-        self._ingest_shards: dict[int, list[Any]] | None = None
+        # list. With the handover protocol armed (elastic +
+        # ingest_handover) this is the CURRENT plan — each reconfigure
+        # replaces it with the re-split of the remaining records; with
+        # handover off it is stable per executor id forever (PR-8).
+        # Guarded: the supervise thread re-splits while the user thread
+        # may still be assigning/tearing down.
+        self._ingest_lock = threading.Lock()
+        self._ingest_shards: dict[int, list[Any]] | None = None  # guarded-by: self._ingest_lock
+        self._ingest_complete = False  # guarded-by: self._ingest_lock
+        self._ingest_republished = False  # guarded-by: self._ingest_lock
         # -- cluster observability plane (obs.cluster; docs/OBSERVABILITY.md)
         # Liveness surfaced in the registry: per-executor heartbeat age
         # as a render-time collector (PR 4's plane was invisible to
@@ -782,18 +798,23 @@ class TFCluster:
         again. Use ``feed.manifest.split_manifest`` first when one
         large file must feed many nodes.
 
-        Assignment is computed ONCE, over the workers at assign time,
-        and is then **stable per executor id**: an elastic reconfigure
-        re-publishes each active executor's ORIGINAL shard — a
-        replacement for executor *k* (``launch_replacement`` reuses the
-        id) fetches *k*'s shard and seeds its predecessor's persisted
-        replay cursor (``IngestFeed.seed_cursor``) for an exactly-once
-        handover. Shards are never re-split between live nodes, so a
-        survivor mid-drain and a rejoiner can never hold overlapping
-        records. A shard whose executor id has no active owner is
-        logged loudly as UNREAD — a permanent shrink needs a fresh
-        ``assign_shards`` (new streams, new cursors), not a silent
-        re-plan under running consumers.
+        With the handover protocol armed (``elastic=True`` +
+        ``ingest_handover``, the default), the plan FOLLOWS membership:
+        every reconfigure re-splits the *remaining* records over the
+        survivors from the consumers' published replay cursors
+        (:meth:`_redistribute_ingest_plan`) — no shard is ever left
+        unread by a permanent shrink, and a joiner picks up real work.
+
+        With handover off (``ingest_handover=False``, or a non-elastic
+        cluster), assignment is computed ONCE and is then **stable per
+        executor id**: an elastic reconfigure re-publishes each active
+        executor's ORIGINAL shard — a replacement for executor *k*
+        (``launch_replacement`` reuses the id) fetches *k*'s shard and
+        seeds its predecessor's persisted replay cursor
+        (``IngestFeed.seed_cursor``). A shard whose executor id has no
+        active owner is then logged loudly as UNREAD (and counted in
+        the ``ingest_unread_shards`` gauge) — the recorded limitation
+        the handover protocol exists to remove.
         """
         if self.input_mode != InputMode.TENSORFLOW:
             raise RuntimeError(
@@ -805,27 +826,85 @@ class TFCluster:
 
         workers = self.workers
         shards = plan_manifests(list(manifests), len(workers))
-        self._ingest_shards = {
-            w["executor_id"]: shard for w, shard in zip(workers, shards)
-        }
-        self._publish_ingest_plan()
+        with self._ingest_lock:
+            self._ingest_shards = {
+                w["executor_id"]: shard for w, shard in zip(workers, shards)
+            }
+            # a fresh assignment is a fresh dataset: a completion
+            # latched by the PREVIOUS dataset must neither suppress
+            # this one's completion nor prematurely release its
+            # consumers at the next reconfigure
+            self._ingest_complete = False
+            self._ingest_republished = False
+        failed = self._publish_ingest_plan()
+        if failed:
+            # At ASSIGN time a publish failure is the caller's problem
+            # (the pre-handover behavior): without a plan, consumers
+            # block the full fetch timeout blaming a missing
+            # assign_shards call. Reconfigure-time republishes stay
+            # best-effort (the next bump retries).
+            raise RuntimeError(
+                f"ingest: plan publish failed for node(s) {failed} — "
+                "no consumer on those nodes will receive a shard"
+            )
 
-    def _publish_ingest_plan(self) -> None:
+    @property
+    def _handover_armed(self) -> bool:
+        return self.elastic and self.ingest_handover
+
+    def _publish_ingest_plan(self, complete: bool = False) -> list[int]:
+        """Publish the current plan to every live worker's manager KV;
+        returns the executor ids whose publish failed after retries
+        (callers decide whether that is fatal — assign time — or
+        best-effort — reconfigure time)."""
         workers = self.workers
         epoch = self.membership_epoch()
+        with self._ingest_lock:
+            shards = {
+                k: list(v) for k, v in (self._ingest_shards or {}).items()
+            }
+            republish = self._ingest_republished
+            self._ingest_republished = True
+        # Never RPC a node the liveness plane declared dead: a wedged
+        # process's kernel still accepts the connect and hangs the
+        # handshake (same rule as shutdown/_check_errors).
+        dead = set(self.dead_nodes())
+        failed: list[int] = []
+        from tensorflowonspark_tpu.utils.retry import RetryPolicy
+
+        # A re-split plan is load-bearing: the consumer is blocked in
+        # plan_fetch(min_epoch) and a lost publish escalates to a node
+        # TimeoutError after adopt_timeout — so transient RPC blips are
+        # retried here (short, bounded) rather than merely logged.
+        policy = RetryPolicy(
+            max_attempts=3, base_delay=0.1, max_delay=0.5, deadline_s=5.0
+        )
         for w in workers:
             eid = w["executor_id"]
-            tfnode_runtime.publish_ingest_plan(
-                tfnode_runtime.connect_manager(w),
-                self._ingest_shards.get(eid, []),
-                epoch=epoch,
-                shard_index=eid,
-                num_shards=len(self._ingest_shards),
-                plan_id=self.cluster_meta.get("id"),
-            )
+            if eid in dead:
+                continue
+            try:
+                policy.call(
+                    lambda w=w, eid=eid: tfnode_runtime.publish_ingest_plan(
+                        tfnode_runtime.connect_manager(w),
+                        shards.get(eid, []),
+                        epoch=epoch,
+                        shard_index=eid,
+                        num_shards=len(shards),
+                        plan_id=self.cluster_meta.get("id"),
+                        handover=self._handover_armed,
+                        complete=complete,
+                    ),
+                    retry_on=(ConnectionError, OSError, EOFError),
+                    site="ingest.plan_publish",
+                )
+            except (ConnectionError, OSError, EOFError) as e:
+                failed.append(eid)
+                logger.warning(
+                    "ingest: plan publish to node %s failed (%s)", eid, e
+                )
         unowned = sorted(
-            set(self._ingest_shards)
-            - {w["executor_id"] for w in workers}
+            set(shards) - {w["executor_id"] for w in workers}
         )
         if unowned:
             logger.warning(
@@ -834,19 +913,234 @@ class TFCluster:
                 "replacement with the same id rejoins",
                 unowned,
             )
+        reg = default_registry()
+        # the log-only UNREAD warning, as a scrapeable signal (0 when
+        # every shard has an owner — the gauge must CLEAR on recovery)
+        reg.gauge(
+            "ingest_unread_shards",
+            "published shards with no active owner (manifests unread "
+            "until a replacement rejoins); nonzero is data loss in "
+            "progress",
+        ).set(len(unowned))
+        from tensorflowonspark_tpu.feed.ingest import metrics as _ing_metrics
+
+        _ing_metrics()["plan_epoch"].set(epoch)
         flightrec.note(
-            "ingest_plan",
+            "ingest_plan_republish" if republish else "ingest_plan",
             epoch=epoch,
-            shards={k: len(v) for k, v in self._ingest_shards.items()},
+            shards={k: len(v) for k, v in shards.items()},
             unowned=unowned,
+            complete=complete,
+            publish_failed=failed,
         )
+        if republish:
+            # a republish is always part of an incident (membership
+            # change / completion) — leave the postmortem artifact now
+            flightrec.dump_now("ingest_plan_republish")
         logger.info(
             "ingest plan published: %d shard(s) over %d worker(s) "
-            "(epoch %d)",
-            len(self._ingest_shards),
+            "(epoch %d%s)",
+            len(shards),
             len(workers),
             epoch,
+            ", complete" if complete else "",
         )
+        return failed
+
+    def _await_handover_cursors(
+        self, epoch: int, fresh_ids: "set[int] | frozenset" = frozenset()
+    ) -> dict[int, dict]:
+        """Bounded wait for every live, actively-consuming worker to
+        drain and publish a cursor stamped >= ``epoch``. Dead nodes
+        cannot publish (their last periodic cursor is the seed — the
+        crash-handover duplicate bound), ``done`` consumers (final or
+        terminated) will never publish again and their content is
+        already exact, and a straggler past ``handover_timeout``
+        degrades to its last cursor with a loud warning — duplicates
+        bounded by the staleness, zero-gap untouched either way.
+        ``fresh_ids`` are executor ids admitted by THIS reconfigure: a
+        cursor retained under such an id belongs to a dead predecessor
+        (the replacement is still blocked waiting for the very plan
+        this wait precedes) — waiting on it would stall every
+        crash→rejoin handover for the full timeout."""
+        res = self.server.reservations
+        active = {w["executor_id"] for w in self.workers}
+        deadline = time.monotonic() + self.handover_timeout
+        while True:
+            cursors = res.cursors()
+            waiting = sorted(
+                eid
+                for eid, p in cursors.items()
+                if eid in active
+                and eid not in fresh_ids
+                and not p.get("final")
+                and not p.get("done")
+                and int(p.get("epoch", 0)) < epoch
+            )
+            if not waiting:
+                return cursors
+            if time.monotonic() >= deadline:
+                logger.warning(
+                    "ingest: handover drain timed out after %.1fs "
+                    "waiting for node(s) %s — proceeding with their "
+                    "last published cursors (duplicates bounded by the "
+                    "staleness; zero-gap unaffected)",
+                    self.handover_timeout,
+                    waiting,
+                )
+                return cursors
+            time.sleep(0.1)
+
+    def _redistribute_ingest_plan(
+        self, epoch: int, fresh_ids: "set[int] | frozenset" = frozenset()
+    ) -> None:
+        """The tentpole: make the ingest plan follow membership. Wait
+        for the cooperative drain, merge every published cursor
+        (departed nodes' last publications included), re-split the
+        REMAINING records over the surviving workers, and publish the
+        new plan keyed by the membership epoch."""
+        from tensorflowonspark_tpu.feed.manifest import (
+            merge_cursor_payloads,
+            replan_manifests,
+            stream_id,
+        )
+
+        cursors = self._await_handover_cursors(epoch, fresh_ids=fresh_ids)
+        merged = merge_cursor_payloads(cursors.values())
+        active = sorted(w["executor_id"] for w in self.workers)
+        if not active:
+            logger.warning(
+                "ingest: no surviving workers to redistribute to"
+            )
+            return
+        # A TERMINATED consumer (done, not final — deliberate early
+        # stop) will never read again: assigning it work would leave
+        # that work unread forever. Deal only to workers that still
+        # consume; if none remain, fall back to all (the completion
+        # check accepts terminated consumers, so nothing hangs).
+        consuming = [
+            eid
+            for eid in active
+            if not (
+                (p := cursors.get(eid)) is not None
+                and p.get("done")
+                and not p.get("final")
+            )
+        ]
+        if consuming:
+            active = consuming
+        with self._ingest_lock:
+            old = self._ingest_shards or {}
+        # A FINAL publication proves exactly one thing: the shard its
+        # publisher CURRENTLY owns is exhausted. Consumers keep
+        # consumed-state for streams from earlier plan generations
+        # forever (the restart-seeding contract), so a final's cursor
+        # may name streams now owned — and still mid-read — by someone
+        # else; marking those final would drop their unconsumed
+        # remainder (a zero-gap violation). Scope each node's finals
+        # to the streams of ITS current shard.
+        finals = {
+            sid
+            for eid, p in cursors.items()
+            if p.get("final")
+            for sid in (
+                {stream_id(m) for m in old.get(eid, ())}
+                & set(p.get("cursor") or {})
+            )
+        }
+        # The re-split's header scans (scan_frames — the only point the
+        # driver touches data files) run OUTSIDE _ingest_lock: slow or
+        # flaky storage must never wedge shutdown()'s force-complete or
+        # a concurrent assign behind this lock.
+        try:
+            new = replan_manifests(old, merged, active, final_streams=finals)
+        except (OSError, ValueError) as e:
+            # A transient storage blip here — plausibly correlated with
+            # the very failure being handled — must degrade, not crash
+            # supervise(): republish the CURRENT plan at the new epoch.
+            # Consumers drain and re-adopt identical shards; their
+            # reseeded cursors dedupe the re-read, so correctness holds
+            # and only the redistribution is deferred.
+            logger.warning(
+                "ingest: re-split failed (%s); republishing the "
+                "current plan unchanged at epoch %d",
+                e,
+                epoch,
+            )
+            new = old
+        with self._ingest_lock:
+            if (self._ingest_shards or {}) is not old:
+                # a concurrent assign_shards superseded this plan while
+                # we were re-planning; its fresh publish wins
+                logger.warning(
+                    "ingest: plan reassigned mid-redistribution; "
+                    "dropping the stale re-split"
+                )
+                return
+            moved = sum(
+                1 for eid in new if new[eid] != old.get(eid, [])
+            )
+            self._ingest_shards = new
+        default_registry().counter(
+            "ingest_redistributed_shards_total",
+            "node shards whose manifest set changed in a live "
+            "redistribution",
+        ).inc(moved)
+        logger.warning(
+            "ingest: redistributed remaining records over %d worker(s) "
+            "at epoch %d (%d shard(s) changed)",
+            len(active),
+            epoch,
+            moved,
+        )
+        self._publish_ingest_plan()
+
+    def _maybe_complete_ingest(self) -> None:
+        """Supervise-loop completion check: once every active worker's
+        latest cursor is FINAL at the current epoch — or the worker
+        TERMINATED (deliberate early stop; it will never consume again
+        and must not gate the others) — the current plan is as consumed
+        as it will ever be: publish the completion marker so lingering
+        consumers (waiting to absorb more work) stop. Flag-based, not
+        block-math-based: a final publication is the consumer's own
+        exhaustion proof."""
+        with self._ingest_lock:
+            if (
+                self._ingest_shards is None
+                or self._ingest_complete
+            ):
+                return
+        if not self._handover_armed:
+            return
+        epoch = self.membership_epoch()
+        cursors = self.server.reservations.cursors()
+        active = [w["executor_id"] for w in self.workers]
+        if not active:
+            return
+        for eid in active:
+            p = cursors.get(eid)
+            if p is None:
+                return
+            if p.get("done") and not p.get("final"):
+                continue  # terminated: never publishes again
+            if not p.get("final") or int(p.get("epoch", 0)) < epoch:
+                return
+        self._finish_ingest_plan()
+
+    def _finish_ingest_plan(self) -> None:
+        """Publish the completion marker (idempotent): lingering
+        consumers see ``complete`` on their next plan poll and stop.
+        Also forced by :meth:`shutdown` so a teardown without
+        supervision can never leave consumers lingering."""
+        with self._ingest_lock:
+            if self._ingest_shards is None or self._ingest_complete:
+                return
+            self._ingest_complete = True
+        armed = self._handover_armed
+        if not armed:
+            return
+        logger.info("ingest: plan complete — releasing consumers")
+        self._publish_ingest_plan(complete=True)
 
     # ------------------------------------------------------------------
     def membership_epoch(self) -> int:
@@ -924,22 +1218,37 @@ class TFCluster:
             sorted(m["executor_id"] for m in joined),
             len(self.cluster_info),
         )
-        # Re-publish the pull plane's (stable, per-executor-id) shard
-        # plans: survivors' plans are unchanged by construction, and a
-        # just-admitted replacement's fresh manager gets its
-        # predecessor's shard. Best-effort — a mid-loop failure is
-        # harmless because no plan CONTENT ever changes, only the
-        # epoch stamp.
-        if self._ingest_shards is not None:
-            try:
-                self._publish_ingest_plan()
-            except (ConnectionError, OSError, EOFError) as e:
-                logger.warning(
-                    "elastic: ingest plan re-publish failed (%s); "
-                    "a rejoining node must wait for the next "
-                    "reconfigure to fetch its shard",
-                    e,
+        # Make the ingest plan follow membership. Handover armed (the
+        # default): REDISTRIBUTE — wait for the cooperative drain, then
+        # re-split the remaining records over the survivors (zero
+        # shards left unread by a permanent shrink). Handover off: the
+        # PR-8 fallback — re-publish each active id's stable shard
+        # (content never changes, so a mid-loop failure is harmless; a
+        # replacement fetches its predecessor's shard + disk cursor).
+        with self._ingest_lock:
+            has_plan = self._ingest_shards is not None
+            plan_done = self._ingest_complete
+        if has_plan and plan_done:
+            # A joiner admitted AFTER dataset completion must still
+            # learn the dataset is done — its fresh manager KV has no
+            # plan, and it would otherwise block in fetch_ingest_plan.
+            self._publish_ingest_plan(complete=True)
+        elif has_plan:
+            if self._handover_armed:
+                self._redistribute_ingest_plan(
+                    epoch,
+                    fresh_ids={m["executor_id"] for m in joined},
                 )
+            else:
+                try:
+                    self._publish_ingest_plan()
+                except (ConnectionError, OSError, EOFError) as e:
+                    logger.warning(
+                        "elastic: ingest plan re-publish failed (%s); "
+                        "a rejoining node must wait for the next "
+                        "reconfigure to fetch its shard",
+                        e,
+                    )
         return epoch
 
     def _elastic_scan(self) -> bool:
@@ -1033,6 +1342,11 @@ class TFCluster:
                     terminal = {
                         k: v for k, v in terminal.items() if k in active
                     }
+                # Handover consumers LINGER after exhausting their
+                # shard (they may yet absorb a dead peer's remainder);
+                # once every active consumer is final at the current
+                # epoch, release them.
+                self._maybe_complete_ingest()
             else:
                 failed = self.launcher.poll_failed()
                 if failed:
@@ -1108,6 +1422,10 @@ class TFCluster:
                 "shutdown: skipping manager RPCs to dead node(s) %s",
                 sorted(dead),
             )
+        # A teardown must never leave handover consumers lingering for
+        # more work: force the completion marker (idempotent; no-op
+        # when supervise already published it or no plan exists).
+        self._finish_ingest_plan()
         node_errors = self._collect_errors(skip=dead)
         feed_queues = (
             [q for q in self.queues if q not in ("output", "error", "control")]
@@ -1251,6 +1569,8 @@ def run(
     flightrec_dir: str | None = "logs",
     elastic: bool = False,
     elastic_min_nodes: int = 1,
+    ingest_handover: bool = True,
+    handover_timeout: float = 30.0,
 ) -> TFCluster:
     """Start a cluster and return its handle.
 
@@ -1337,6 +1657,12 @@ def run(
         # run_with_restarts — is then the only recovery).
         "elastic": elastic,
         "elastic_min_nodes": elastic_min_nodes,
+        # Live shard redistribution (docs/ROBUSTNESS.md): elastic
+        # reconfigures RE-SPLIT the remaining ingest records over the
+        # survivors (cooperative drain bounded by handover_timeout);
+        # False falls back to PR-8 stable per-executor-id shards.
+        "ingest_handover": ingest_handover,
+        "handover_timeout": handover_timeout,
         "distributed": distributed,
         "queue_maxsize": queue_maxsize,
         "manager_mode": "remote",

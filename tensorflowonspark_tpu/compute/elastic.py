@@ -54,6 +54,7 @@ __all__ = [
     "ElasticTrainer",
     "InMemoryRecoveryUnavailable",
     "MembershipWatcher",
+    "current_epoch",
     "host_snapshot",
     "membership",
     "notify_membership",
@@ -166,6 +167,15 @@ def notify_membership(epoch: int, roster: list[dict[str, Any]]) -> bool:
 def membership() -> tuple[int, list[dict[str, Any]] | None]:
     """(epoch, roster) as last notified; roster None before any notify."""
     return _watcher.current()
+
+
+def current_epoch() -> int:
+    """The membership epoch alone — the per-block poll of the ingest
+    handover protocol (``IngestFeed._handover_due``): the SAME
+    heartbeat-fed watcher ``ElasticTrainer.changed()`` reads, so the
+    data plane and the compute plane observe one consistent epoch
+    sequence."""
+    return _watcher.current()[0]
 
 
 def wait_for_epoch(min_epoch: int, timeout: float = 30.0) -> bool:

@@ -50,6 +50,30 @@ def _replay_counter():
         "replayed duplicate frames dropped by the seq cursor, by queue",
     )
 
+def normalize_cursor_entry(v: Any) -> tuple[int, int]:
+    """Canonical ``(seq, skip)`` form of one replay-cursor entry — THE
+    serialization both planes (and the driver's shard re-planner)
+    agree on. An entry is either a plain int ``seq`` (block ``seq`` is
+    the last fully-consumed one; the push plane's ``DataFeed.cursor``
+    format) or a ``[seq, skip]`` pair (additionally the first ``skip``
+    records of block ``seq + 1`` left in batches — the pull plane's
+    record-exact mid-block form). Entries are JSON round-trip safe by
+    construction: ints and two-int lists."""
+    if isinstance(v, (list, tuple)):
+        if len(v) != 2:
+            raise ValueError(f"malformed cursor entry {v!r}: want [seq, skip]")
+        return int(v[0]), int(v[1])
+    return int(v), 0
+
+
+def cursor_covers(a: Any, b: Any) -> bool:
+    """True when consumption claim ``a`` covers at least as many
+    records as ``b`` (same stream). Claims are append-only truths —
+    anything either side says was consumed, was — so merging two
+    cursors for one stream keeps whichever covers more."""
+    return normalize_cursor_entry(a) >= normalize_cursor_entry(b)
+
+
 class ReplayCursor:
     """Per-stream frame/chunk sequence cursor — THE exactly-once and
     ordering primitive both data planes share.
@@ -115,12 +139,18 @@ class ReplayCursor:
         with self._lock:
             return dict(self._state)
 
-    def seed(self, cursor: dict[str, int]) -> None:
+    def seed(self, cursor: dict[str, Any]) -> None:
         """Adopt a snapshot: pieces at or below each stream's seeded
-        seq are treated as replayed duplicates, not gaps."""
+        seq are treated as replayed duplicates, not gaps. Entries may
+        be plain ints or the pull plane's ``[seq, skip]`` form (see
+        :func:`normalize_cursor_entry`); only the whole-block part
+        seeds here — record-level trimming is the feed's job
+        (``IngestFeed.seed_cursor``)."""
         with self._lock:
-            for stream, seq in cursor.items():
-                self._state[str(stream)] = int(seq)
+            for stream, entry in cursor.items():
+                seq, _skip = normalize_cursor_entry(entry)
+                if seq >= 0:
+                    self._state[str(stream)] = seq
 
     def clear(self) -> None:
         with self._lock:

@@ -58,12 +58,18 @@ from collections import deque
 from typing import Any, Callable, Iterator, Sequence
 
 from tensorflowonspark_tpu.feed.columnar import ColumnAssembler, ColumnChunk
-from tensorflowonspark_tpu.feed.datafeed import ReplayCursor, columnize_rows
+from tensorflowonspark_tpu.feed.datafeed import (
+    ReplayCursor,
+    columnize_rows,
+    normalize_cursor_entry,
+)
 from tensorflowonspark_tpu.feed.manifest import (
     FileManifest,
     read_manifest,
     read_manifest_chunks,
+    stream_id,
 )
+from tensorflowonspark_tpu.obs import flightrec
 from tensorflowonspark_tpu.obs import spans as obs_spans
 from tensorflowonspark_tpu.utils.failpoints import FailpointError, failpoint
 from tensorflowonspark_tpu.utils.retry import DEFAULT_RETRYABLE, RetryPolicy
@@ -115,23 +121,31 @@ def metrics() -> dict[str, Any]:
                         "feed_ingest_records_total",
                         "records ingested by executor-local readers",
                     ),
+                    # live shard redistribution (handover protocol)
+                    "plan_epoch": r.gauge(
+                        "ingest_plan_epoch",
+                        "membership epoch of the ingest plan currently "
+                        "consumed (node) / published (driver)",
+                    ),
+                    "handover_s": r.histogram(
+                        "ingest_handover_seconds",
+                        "wall seconds from handover drain to re-split "
+                        "adoption",
+                    ),
+                    "cursor_publishes": r.counter(
+                        "ingest_cursor_publishes_total",
+                        "replay-cursor publications to the driver KV, "
+                        "by kind",
+                    ),
                 }
     return _metrics
 
 
 # -- stream identity ---------------------------------------------------------
-
-
-def stream_id(m: Any) -> str:
-    """Deterministic replay-stream id for one manifest: a pure function
-    of WHAT is read (path + record range), never of when or by whom —
-    a restarted reader, a relaunched node, or an elastic re-plan
-    re-derives the same id, which is what lets a seeded
-    :class:`ReplayCursor` recognize the already-consumed prefix."""
-    if isinstance(m, FileManifest):
-        stop = "" if m.stop is None else int(m.stop)
-        return f"{m.path}@{int(m.start)}:{stop}"
-    return f"manifest:{m!r}"
+# stream_id now lives in feed/manifest.py (the driver's shard
+# re-planner needs it without importing this module); re-exported here
+# unchanged — a pure function of WHAT is read, which is what lets a
+# seeded ReplayCursor recognize the already-consumed prefix.
 
 
 class RowPiece(list):
@@ -340,10 +354,29 @@ class IngestFeed:
         retry: RetryPolicy | None = None,
         plan_epoch: int = 0,
         worker_index: int | None = None,
+        plan_fetch: Callable[[int, float], dict | None] | None = None,
+        cursor_publish: Callable[[dict], None] | None = None,
+        epoch_watch: Callable[[], int] | None = None,
+        publish_blocks: int = 32,
+        adopt_timeout: float = 120.0,
     ):
+        """``plan_fetch`` / ``cursor_publish`` / ``epoch_watch`` arm the
+        live-shard-redistribution protocol (all three together — wired
+        by ``ctx.get_ingest_feed`` when the driver published the plan
+        with ``handover`` set): the feed watches the membership epoch
+        (``epoch_watch``, one int read per block), publishes its
+        record-exact replay cursor every ``publish_blocks`` fully
+        consumed blocks — the crash-handover duplicate bound — and on
+        an epoch bump drains to a block boundary, publishes, and adopts
+        the driver's re-split (``plan_fetch(min_epoch, timeout)``,
+        bounded by ``adopt_timeout``). Unarmed (the default), behavior
+        is exactly the PR-8 static-shard feed."""
         self.input_mapping = input_mapping
         self.plan_epoch = int(plan_epoch)
         self.worker_index = worker_index
+        self._user_reader = reader
+        self._records_per_chunk = int(records_per_chunk)
+        self._retry = retry
         self._reader = ShardReader(
             manifests,
             reader=reader,
@@ -379,6 +412,26 @@ class IngestFeed:
         # this feed's own progress)
         self._done: dict[str, Any] = {}  # guarded-by: self._cursor_lock
         self._pending_skip: dict[str, tuple[int, int]] = {}  # seeded offsets  # guarded-by: self._cursor_lock
+        # -- live shard redistribution (handover protocol) -----------------
+        self._plan_fetch = plan_fetch
+        self._cursor_publish = cursor_publish
+        self._epoch_watch = epoch_watch
+        self._handover = (
+            plan_fetch is not None
+            and epoch_watch is not None
+        )
+        self._publish_blocks = max(1, int(publish_blocks))
+        self._adopt_timeout = float(adopt_timeout)
+        self._blocks_since_publish = 0  # guarded-by: self._cursor_lock
+        self._terminated = False
+        self._complete = False
+        if self._handover:
+            metrics()["plan_epoch"].set(self.plan_epoch)
+            # announce the subscription: an epoch bump landing before
+            # the first periodic publication must still find this
+            # consumer in the driver's cursor table, so the drain wait
+            # covers it (zero-dup needs the driver to wait for us)
+            self._publish_cursor(final=False, kind="announce")
 
     # -- replay cursor -------------------------------------------------
     def cursor(self) -> dict[str, Any]:
@@ -392,12 +445,15 @@ class IngestFeed:
         mid-block. Checkpoint it beside the train state. Safe to call
         from any thread while the feed is being consumed."""
         with self._cursor_lock:
-            out: dict[str, Any] = dict(self._done)
-            if self._delivered and self._head_consumed:
-                s, q, _ln, base = self._delivered[0]
-                if s is not None:
-                    out[s] = [q - 1, base + self._head_consumed]
-            return out
+            return self._cursor_locked()
+
+    def _cursor_locked(self) -> dict[str, Any]:  # lint: holds-lock
+        out: dict[str, Any] = dict(self._done)
+        if self._delivered and self._head_consumed:
+            s, q, _ln, base = self._delivered[0]
+            if s is not None:
+                out[s] = [q - 1, base + self._head_consumed]
+        return out
 
     def seed_cursor(self, cursor: dict[str, Any]) -> None:
         """Adopt a :meth:`cursor` snapshot BEFORE consuming. Whole
@@ -417,10 +473,7 @@ class IngestFeed:
         with self._cursor_lock:
             for s, v in cursor.items():
                 s = str(s)
-                if isinstance(v, (list, tuple)):
-                    seq0, skip = int(v[0]), int(v[1])
-                else:
-                    seq0, skip = int(v), 0
+                seq0, skip = normalize_cursor_entry(v)
                 if seq0 >= 0:
                     seed[s] = seq0
                 if skip > 0:
@@ -430,16 +483,219 @@ class IngestFeed:
                     self._done[s] = seq0
         self._seq.seed(seed)
 
+    # -- live shard redistribution (the handover protocol) --------------
+    def _handover_due(self) -> bool:
+        """One int compare per block: has the membership epoch moved
+        past the plan this feed is consuming?"""
+        return self._handover and self._epoch_watch() > self.plan_epoch
+
+    def publish_cursor(self, final: bool = False) -> None:
+        """Publish this feed's record-exact replay cursor to the driver
+        KV now (best-effort, like the periodic beat). A planned leaver
+        calls this right before exiting so the re-split starts from an
+        exact cursor — zero duplicates — instead of the last periodic
+        one."""
+        self._publish_cursor(final=final, kind="explicit")
+
+    def _publish_cursor(
+        self,
+        epoch: int | None = None,
+        final: bool = False,
+        kind: str = "periodic",
+        done: bool | None = None,
+    ) -> None:
+        """Best-effort by contract: a lost publication can only widen
+        the crash-handover duplicate window (the driver falls back to
+        an older cursor), never lose records — so a failure here warns
+        and moves on rather than killing training.
+
+        Default stamp is ``plan_epoch`` — the plan this cursor was
+        consumed UNDER — never the watched epoch: a periodic beat that
+        landed after a bump but before this feed drained must not
+        satisfy the driver's drain wait (it would release the re-split
+        while this consumer is still emitting old-plan records). Only
+        the drain/final paths, which have actually stopped consuming,
+        pass the observed epoch explicitly."""
+        if self._cursor_publish is None:
+            return
+        if epoch is None:
+            epoch = self.plan_epoch
+        payload = {
+            "epoch": int(epoch),
+            "final": bool(final),
+            # done = this consumer will NEVER consume again (final OR
+            # terminated): the driver stops waiting on it, stops
+            # assigning it work, and completion need not require a
+            # fresh stamp from it
+            "done": bool(final if done is None else done),
+            "cursor": self.cursor(),
+            "records_per_chunk": self._records_per_chunk,
+            # block→record math hint for the driver's re-planner: a
+            # custom reader streams records_per_chunk blocks even over
+            # 'columnar'-format manifests
+            "frame_blocks": False if self._user_reader is not None else None,
+        }
+        try:
+            self._cursor_publish(payload)
+            metrics()["cursor_publishes"].inc(kind=kind)
+        except Exception as e:  # noqa: BLE001 - best-effort by contract
+            logger.warning(
+                "ingest: cursor publication failed (%s) — the driver "
+                "will fall back to the last one it has (duplicates "
+                "bounded by the staleness, zero-gap unaffected)",
+                e,
+            )
+
+    def _run_handover(self) -> None:
+        """Cooperative adoption, the consumer side of the protocol:
+        (1) drain to a block boundary on the old plan — every record
+        that left in a batch is consumed; read-but-unconsumed records
+        buffered in the feed are DISCARDED for replay (the re-split
+        covers them, so discarding is what makes the handover
+        zero-dup/zero-gap); (2) publish the record-exact ``[seq,
+        skip]`` cursor; (3) adopt the driver's re-split for the new
+        epoch, reseeding the sequence cursor from consumed state."""
+        t0 = time.monotonic()
+        observed = max(self._epoch_watch(), self.plan_epoch)
+        skip_publish = failpoint("ingest.handover_drain") == "drop"
+        with self._cursor_lock:
+            # fold the consumed snapshot (incl. the partial head's
+            # [seq, skip]) into _done, then drop everything unconsumed
+            self._done = self._cursor_locked()
+            self._delivered.clear()
+            self._head_consumed = 0
+            self._pending_skip.clear()
+        self._buffer = []
+        if self._assembler is not None and len(self._assembler):
+            self._assembler.take(len(self._assembler))  # discard: replays
+        it, self._iter = self._iter, None
+        if it is not None and hasattr(it, "close"):
+            it.close()
+        if not skip_publish:
+            self._publish_cursor(epoch=observed, final=False, kind="drain")
+        failpoint("ingest.plan_adopt")
+        plan = self._plan_fetch(observed, self._adopt_timeout)
+        if plan is None:
+            raise TimeoutError(
+                f"ingest handover: no plan for membership epoch >= "
+                f"{observed} within {self._adopt_timeout}s — the driver "
+                "stopped republishing (worker "
+                f"{self.worker_index if self.worker_index is not None else '?'})"
+            )
+        self._adopt(plan)
+        dt = time.monotonic() - t0
+        metrics()["handover_s"].observe(dt)
+        flightrec.note(
+            "ingest_handover",
+            worker=self.worker_index,
+            from_epoch=observed,
+            epoch=self.plan_epoch,
+            manifests=len(self._reader.manifests),
+            seconds=round(dt, 3),
+        )
+        logger.info(
+            "ingest: handover to plan epoch %d (%d manifest(s), %.3fs)",
+            self.plan_epoch,
+            len(self._reader.manifests),
+            dt,
+        )
+
+    def _adopt(self, plan: dict) -> None:
+        """Swap in a re-split plan: fresh reader, fresh block-sequence
+        cursor reseeded from consumed state (a zero-consumption stream
+        keeps its id across a re-split, and the old cursor's accepted
+        blocks would wrongly dedupe its legitimate re-read)."""
+        manifests = list(plan.get("manifests") or [])
+        from tensorflowonspark_tpu.feed.datafeed import _replay_counter
+
+        with self._cursor_lock:
+            self.plan_epoch = int(plan.get("epoch", self.plan_epoch))
+            self._complete = bool(plan.get("complete"))
+            self._pending_skip = {}
+            done = dict(self._done)
+        self._seq = ReplayCursor(
+            name=f"ingest shard (worker "
+            f"{self.worker_index if self.worker_index is not None else '?'})",
+            on_drop=lambda _s: _replay_counter().inc(queue="ingest"),
+        )
+        # re-seed from consumed state through the ONE entry-splitting
+        # implementation (seed_cursor re-derives _done from its own
+        # snapshot — idempotent)
+        self.seed_cursor(done)
+        self._reader = ShardReader(
+            manifests,
+            reader=self._user_reader,
+            records_per_chunk=self._records_per_chunk,
+            retry=self._retry,
+        )
+        self._iter = None
+        self._exhausted = False
+        metrics()["plan_epoch"].set(self.plan_epoch)
+
+    def _await_redistribution(self) -> bool:
+        """Shard exhausted under an armed handover: publish the FINAL
+        cursor (full consumption, the driver's completion signal) and
+        linger for either a plan-epoch bump — adopt the re-split and
+        return True (more work may exist) — or the driver's completion
+        marker / :meth:`terminate` — return False, the feed is done.
+        The linger is what lets a survivor that finished its own shard
+        early absorb a dead peer's remainder instead of exiting."""
+        if not self._handover or self._terminated or self._complete:
+            return False
+        published_final = False
+        while True:
+            if self._terminated:
+                return False
+            if self._handover_due():
+                self._run_handover()
+                return not self._complete
+            if not published_final:
+                # Published only while NO bump is pending, stamped with
+                # the PLAN epoch: finality at epoch E means "I adopted
+                # plan E and consumed all of it". Stamping the watched
+                # epoch here would let a final slip out between a bump
+                # and this consumer's adoption — the driver's
+                # completion check would then release everyone while
+                # the re-split's manifests are still unread (a
+                # zero-gap race).
+                self._publish_cursor(
+                    epoch=self.plan_epoch, final=True, kind="final"
+                )
+                published_final = True
+            plan = self._plan_fetch(self.plan_epoch, 0.0)
+            if (
+                plan is not None
+                and plan.get("complete")
+                and int(plan.get("epoch", 0)) >= self.plan_epoch
+            ):
+                self._complete = True
+                return False
+            time.sleep(0.25)
+
     # -- iteration core ------------------------------------------------
     def _pieces_iter(self) -> Iterator[Any]:
         if self._iter is None:
             self._iter = self._reader.pieces(self._seq)
         return self._iter
 
-    def _pull_piece(self) -> Any | None:
+    def _pull_piece(self, inline_handover: bool = True) -> Any | None:
         """Next piece off the reader, seeded-skip applied and delivery
-        recorded for the consumed-cursor bookkeeping."""
+        recorded for the consumed-cursor bookkeeping.
+
+        With the handover armed, an epoch bump observed here either
+        runs the handover INLINE (default — safe whenever every
+        read-but-unconsumed record lives in feed-owned buffers, which
+        the drain discards for replay) or, with
+        ``inline_handover=False``, returns ``None`` as a PAUSE so the
+        caller can release externally buffered rows first (the
+        mapping-less ``batch_stream``, whose pending rows sit inside
+        ``fixed_size_batches``)."""
         while not self._exhausted:
+            if self._handover_due():
+                if not inline_handover:
+                    return None  # pause: caller drains, then hands over
+                self._run_handover()
+                continue
             piece = next(self._pieces_iter(), None)
             if piece is None:
                 self._exhausted = True
@@ -470,7 +726,11 @@ class IngestFeed:
     def _advance_consumed(self, n: int) -> None:
         """Records left the feed in a batch (or were dropped at the
         tail): pop fully-consumed pieces off the delivery FIFO and
-        advance the per-stream done cursor."""
+        advance the per-stream done cursor. Every ``publish_blocks``
+        fully consumed blocks, the handover-armed feed publishes its
+        cursor to the driver KV — the periodic beat whose interval
+        bounds crash-handover duplicates."""
+        publish = False
         with self._cursor_lock:
             self._head_consumed += int(n)
             while self._delivered:
@@ -481,15 +741,35 @@ class IngestFeed:
                 self._head_consumed -= ln
                 if s is not None:
                     self._done[s] = q
+                    self._blocks_since_publish += 1
+            if (
+                self._handover
+                and self._blocks_since_publish >= self._publish_blocks
+            ):
+                self._blocks_since_publish = 0
+                publish = True
+        if publish:
+            self._publish_cursor(final=False, kind="periodic")
 
     def should_stop(self) -> bool:
         """True once the shard is exhausted AND every buffered record
-        has left in a batch (``DataFeed.should_stop`` contract)."""
-        return (
+        has left in a batch (``DataFeed.should_stop`` contract).
+
+        Handover-armed feeds add one clause: an exhausted-and-drained
+        feed is not DONE until the driver says the whole dataset is
+        (completion marker) or an epoch bump hands it more work — so
+        this call may BLOCK while it lingers (bounded by driver
+        progress; ``terminate()`` from another thread unblocks it)."""
+        drained = (
             self._exhausted
             and not self._buffer
             and (self._assembler is None or len(self._assembler) == 0)
         )
+        if not drained:
+            return False
+        if not self._handover or self._terminated or self._complete:
+            return True
+        return not self._await_redistribution()
 
     def next_batch(self, batch_size: int) -> list | dict[str, Any]:
         """Up to ``batch_size`` records; partial only at shard end.
@@ -514,11 +794,24 @@ class IngestFeed:
         self._advance_consumed(n)
         return out
 
-    def _next_raw(self, batch_size: int, account: bool = True) -> list:
+    def _next_raw(
+        self,
+        batch_size: int,
+        account: bool = True,
+        inline_handover: bool = True,
+    ) -> list:
         """Up to ``batch_size`` raw records. ``account=False`` defers
         the consumed-cursor advance to the caller — rows handed to an
         intermediate buffer (``fixed_size_batches``) have NOT left the
-        feed yet, and counting them consumed would punch resume holes."""
+        feed yet, and counting them consumed would punch resume holes.
+
+        An inline handover is only legal while every pulled row is in
+        FEED-OWNED buffers (the drain discards those for replay); rows
+        already moved into the local ``batch`` are neither claimed by
+        the drain cursor nor discarded, so once ``batch`` is non-empty
+        an epoch bump PAUSES the loop instead (partial batch out,
+        consumption accounted against the old plan; the handover runs
+        on the next call, when the slate is clean)."""
         batch: list[Any] = []
         while len(batch) < batch_size:
             take = batch_size - len(batch)
@@ -526,7 +819,9 @@ class IngestFeed:
                 batch.extend(self._buffer[:take])
                 del self._buffer[:take]
                 continue
-            piece = self._pull_piece()
+            piece = self._pull_piece(
+                inline_handover=inline_handover and not batch
+            )
             if piece is None:
                 break
             if isinstance(piece, ColumnChunk):
@@ -560,34 +855,62 @@ class IngestFeed:
             # consumption is advanced per EMITTED batch, never when rows
             # merely enter fixed_size_batches' pending buffer — those
             # rows have not left the feed, and counting them consumed
-            # would make a checkpointed cursor skip them on resume
-            pulled = 0
+            # would make a checkpointed cursor skip them on resume.
+            # Handover pauses must happen OUTSIDE _pull_piece here
+            # (inline_handover=False): rows pending inside
+            # fixed_size_batches are out of the feed's reach, so the
+            # drain first lets the batcher flush its trimmed tail, then
+            # hands over — the un-emitted sub-multiple remainder stays
+            # unconsumed and replays under the re-split.
+            while True:
+                pulled = 0
+                paused = [False]
 
-            def records():
-                nonlocal pulled
-                while not self.should_stop():
-                    rows = self._next_raw(batch_size, account=False)
-                    if not rows:
-                        return
-                    pulled += len(rows)
-                    yield from rows
+                def records():
+                    nonlocal pulled
+                    while True:
+                        if self._handover_due():
+                            paused[0] = True
+                            return
+                        rows = self._next_raw(
+                            batch_size, account=False, inline_handover=False
+                        )
+                        if not rows:
+                            paused[0] = self._handover_due()
+                            return
+                        pulled += len(rows)
+                        yield from rows
 
-            emitted = 0
-            for batch in fixed_size_batches(
-                records(),
-                batch_size,
-                multiple_of,
-                assemble=lambda rows: list(rows),
-            ):
-                emitted += len(batch)
-                self._advance_consumed(len(batch))
-                yield batch
-            # normal exhaustion: the sub-multiple remainder was DROPPED
-            # (drop-remainder semantics) — dropped counts as consumed.
-            # Unreached on an early generator close, where the pending
-            # rows were never delivered and must replay.
-            self._advance_consumed(pulled - emitted)
-            return
+                emitted = 0
+                for batch in fixed_size_batches(
+                    records(),
+                    batch_size,
+                    multiple_of,
+                    assemble=lambda rows: list(rows),
+                ):
+                    emitted += len(batch)
+                    self._advance_consumed(len(batch))
+                    yield batch
+                if paused[0]:
+                    # the pulled-but-unemitted remainder was NOT
+                    # advanced: the handover discards it for replay
+                    self._run_handover()
+                    continue
+                # normal exhaustion: the sub-multiple remainder was
+                # DROPPED (drop-remainder semantics) — dropped counts
+                # as consumed. Unreached on an early generator close,
+                # where the pending rows were never delivered and must
+                # replay.
+                self._advance_consumed(pulled - emitted)
+                if (
+                    self._exhausted
+                    and self._handover
+                    and not self._terminated
+                    and not self._complete
+                    and self._await_redistribution()
+                ):
+                    continue
+                return
         if self._assembler is None or self._assembler.mapping != mapping:
             old = self._assembler
             self._assembler = ColumnAssembler(dict(mapping))
@@ -612,11 +935,34 @@ class IngestFeed:
                 if piece is None:
                     break
                 asm.push(piece)
-            if len(asm) < bs:
-                break
-            batch = asm.take(bs)
-            self._advance_consumed(bs)
-            yield batch
+            if len(asm) >= bs:
+                batch = asm.take(bs)
+                self._advance_consumed(bs)
+                yield batch
+                continue
+            # reader exhausted (handover pauses run inline on this
+            # path — every buffered record is feed-owned)
+            if (
+                self._handover
+                and not self._terminated
+                and not self._complete
+            ):
+                # plan boundary: flush the buffered tail exactly like
+                # the feed end (one short batch + drop-remainder), so
+                # the FINAL cursor the await publishes is exact, then
+                # linger for a re-split or the completion marker
+                yield from self._flush_tail(asm, multiple_of)
+                if self._await_redistribution():
+                    continue
+            break
+        yield from self._flush_tail(asm, multiple_of)
+
+    def _flush_tail(self, asm: ColumnAssembler, multiple_of: int):
+        """Feed-end tail contract, shared by final exhaustion and every
+        handover plan boundary: emit the largest ``multiple_of``
+        multiple as one (short) batch, drop the sub-multiple remainder
+        loudly — dropped counts as consumed (a resume or re-split must
+        not replay it; same semantics as the push wire)."""
         tail = len(asm) - len(asm) % multiple_of
         rem = len(asm) % multiple_of
         if rem:
@@ -630,16 +976,27 @@ class IngestFeed:
             self._advance_consumed(tail)
             yield batch
         if len(asm):
-            # discard the sub-multiple remainder (drop-remainder
-            # semantics, same as the push wire's column_batches) —
-            # dropped counts as consumed: a resume must not replay it
             asm.take(len(asm))
             self._advance_consumed(rem)
 
     def terminate(self) -> None:
         """Stop reading (early stop). Purely local — there is no
-        producer to signal on the pull plane."""
+        producer to signal on the pull plane — except that a
+        handover-armed feed publishes its cursor once more (best
+        effort) so the driver's view of this consumer is as fresh as
+        possible, and any blocked :meth:`should_stop` linger unblocks."""
+        self._terminated = True
         self._exhausted = True
         it, self._iter = self._iter, None
         if it is not None and hasattr(it, "close"):
             it.close()
+        if self._handover:
+            # a terminated feed consumes nothing more, so its cursor is
+            # drain-exact: stamp the observed epoch, sparing the driver
+            # a full drain-timeout wait on a consumer that cannot answer
+            self._publish_cursor(
+                epoch=max(self.plan_epoch, self._epoch_watch()),
+                final=False,
+                kind="terminate",
+                done=True,
+            )

@@ -38,11 +38,16 @@ from typing import Any, Callable, Iterator, Sequence
 __all__ = [
     "FileManifest",
     "ManifestFeed",
+    "consumed_records",
     "manifest_records",
+    "merge_cursor_payloads",
     "plan_manifests",
     "read_manifest",
     "read_manifest_chunks",
+    "remaining_manifest",
+    "replan_manifests",
     "split_manifest",
+    "stream_id",
 ]
 
 
@@ -177,6 +182,195 @@ def split_manifest(
             )
         lo = hi
     return out
+
+
+def stream_id(m: Any) -> str:
+    """Deterministic replay-stream id for one manifest: a pure function
+    of WHAT is read (path + record range), never of when or by whom —
+    a restarted reader, a relaunched node, an elastic re-plan, or the
+    driver's shard re-planner all re-derive the same id, which is what
+    lets consumed-cursor state and manifests be matched up across
+    processes. A re-split's remaining manifest (advanced ``start``) is
+    by construction a FRESH stream."""
+    if isinstance(m, FileManifest):
+        stop = "" if m.stop is None else int(m.stop)
+        return f"{m.path}@{int(m.start)}:{stop}"
+    return f"manifest:{m!r}"
+
+
+# ---------------------------------------------------------------------------
+# live shard redistribution: re-planning over per-stream replay cursors
+# (docs/ROBUSTNESS.md "Live shard redistribution"). The driver side of
+# the handover protocol: given the manifests of the CURRENT plan and the
+# union of published consumed-cursors, compute the manifests of the
+# REMAINING records and deal them over the surviving workers.
+# ---------------------------------------------------------------------------
+
+
+def _columnar_block_lengths(m: FileManifest) -> list[int]:
+    """Record count of each block a ``'columnar'`` manifest's reader
+    yields, via header-only frame scans — the exact ``lo``/``hi``
+    slicing of :func:`read_manifest_chunks` replayed over
+    ``scan_frames`` counts, so block ordinal ``seq`` maps back to a
+    record offset without touching payload bytes."""
+    from tensorflowonspark_tpu.feed.columnar import scan_frames
+
+    out: list[int] = []
+    pos = 0
+    for _off, _span, n in scan_frames(m.path):
+        lo = max(m.start - pos, 0)
+        hi = n if m.stop is None else min(m.stop - pos, n)
+        pos += n
+        if hi <= lo:
+            if m.stop is not None and pos >= m.stop:
+                break
+            continue
+        out.append(hi - lo)
+    return out
+
+
+def consumed_records(
+    m: FileManifest,
+    entry: Any,
+    records_per_chunk: int = 1024,
+    frame_blocks: bool | None = None,
+) -> int:
+    """Records of manifest ``m`` a replay-cursor entry proves consumed,
+    counted from ``m.start``. ``entry`` is a
+    :func:`~tensorflowonspark_tpu.feed.datafeed.normalize_cursor_entry`
+    form (``seq`` or ``[seq, skip]``); ``None`` means nothing consumed.
+
+    Block→record math depends on how the consumer read the manifest:
+    ``'columnar'`` manifests (read without a custom reader) have
+    frame-sliced blocks — resolved exactly via a header-only scan —
+    while every other format streams ``records_per_chunk``-sized blocks
+    (``data.readers.columnar_pieces``; the publisher's payload carries
+    its value so both sides agree). Pass ``frame_blocks`` to override
+    the format-based default (a custom ``reader=`` over a
+    ``'columnar'``-format manifest uses chunk math).
+    """
+    if entry is None:
+        return 0
+    from tensorflowonspark_tpu.feed.datafeed import normalize_cursor_entry
+
+    seq, skip = normalize_cursor_entry(entry)
+    if seq < 0:
+        return max(0, skip)
+    if frame_blocks is None:
+        frame_blocks = m.format == "columnar"
+    if frame_blocks:
+        lengths = _columnar_block_lengths(m)
+        whole = sum(lengths[: seq + 1])
+        partial = (
+            min(skip, lengths[seq + 1]) if seq + 1 < len(lengths) else 0
+        )
+        return whole + partial
+    # Fixed-size blocks: exact for every mid-stream block (only the tail
+    # can be short, and a consumed tail means the stream is finished —
+    # the overshoot then lands past the range and reads nothing).
+    return (seq + 1) * int(records_per_chunk) + skip
+
+
+def remaining_manifest(
+    m: FileManifest,
+    entry: Any,
+    records_per_chunk: int = 1024,
+    frame_blocks: bool | None = None,
+    final: bool = False,
+) -> FileManifest | None:
+    """The manifest of ``m``'s UNCONSUMED records (``start`` advanced
+    past the cursor's consumed prefix — a fresh replay stream), or
+    ``None`` when nothing remains. ``final`` asserts full consumption
+    regardless of the entry (an exhausted consumer's flag beats block
+    math — for non-columnar formats the total is not knowable without
+    a full read)."""
+    if final:
+        return None
+    consumed = consumed_records(
+        m, entry, records_per_chunk=records_per_chunk, frame_blocks=frame_blocks
+    )
+    if consumed <= 0:
+        return m
+    if m.format == "columnar" and (frame_blocks is None or frame_blocks):
+        if consumed >= manifest_records(m):
+            return None
+    elif m.stop is not None and m.start + consumed >= m.stop:
+        return None
+    return dataclasses.replace(m, start=m.start + consumed)
+
+
+def merge_cursor_payloads(
+    payloads: Iterator[dict[str, Any]] | Sequence[dict[str, Any]],
+) -> dict[str, dict[str, Any]]:
+    """Union the per-node cursor publications into one per-stream view:
+    ``{stream: {"entry", "records_per_chunk", "frame_blocks"}}``.
+
+    Under any single plan each stream has one owner, but across plan
+    generations (and across a crash, where the dead node's LAST
+    publication and a survivor's re-read both speak for overlapping
+    ranges) two payloads can claim the same stream — consumption claims
+    are append-only truths, so the one covering more records wins
+    (:func:`~tensorflowonspark_tpu.feed.datafeed.cursor_covers`)."""
+    from tensorflowonspark_tpu.feed.datafeed import cursor_covers
+
+    merged: dict[str, dict[str, Any]] = {}
+    for p in payloads:
+        rpc = int(p.get("records_per_chunk", 1024) or 1024)
+        fb = p.get("frame_blocks")
+        for s, entry in (p.get("cursor") or {}).items():
+            s = str(s)
+            prev = merged.get(s)
+            if prev is None or cursor_covers(entry, prev["entry"]):
+                merged[s] = {
+                    "entry": entry,
+                    "records_per_chunk": rpc,
+                    "frame_blocks": fb,
+                }
+    return merged
+
+
+def replan_manifests(
+    shards: dict[int, Sequence[FileManifest]],
+    merged_cursors: dict[str, dict[str, Any]],
+    active_ids: Sequence[int],
+    final_streams: Sequence[str] = (),
+) -> dict[int, list[FileManifest]]:
+    """THE re-split: deal the remaining records of a plan over the
+    surviving workers.
+
+    ``shards`` is the current plan (executor id → manifests; departed
+    ids' shards included — their remainders are exactly what must be
+    redistributed), ``merged_cursors`` the
+    :func:`merge_cursor_payloads` union, ``final_streams`` the stream
+    ids whose owners declared exhaustion (full consumption without
+    block math). Returns a plan covering **every** active id (possibly
+    with an empty shard) whose manifests partition the unconsumed
+    records exactly — zero-gap and zero-dup by construction, because
+    consumed prefixes are excluded and each remainder is assigned to
+    exactly one worker. Deterministic: original (executor id, position)
+    order in, round-robin over sorted active ids out."""
+    if not active_ids:
+        raise ValueError("cannot replan over an empty active worker set")
+    finals = set(final_streams)
+    remaining: list[FileManifest] = []
+    for eid in sorted(shards):
+        for m in shards[eid]:
+            sid = stream_id(m)
+            info = merged_cursors.get(sid)
+            rm = remaining_manifest(
+                m,
+                None if info is None else info["entry"],
+                records_per_chunk=(
+                    1024 if info is None else info["records_per_chunk"]
+                ),
+                frame_blocks=None if info is None else info["frame_blocks"],
+                final=sid in finals,
+            )
+            if rm is not None:
+                remaining.append(rm)
+    ids = sorted(int(i) for i in active_ids)
+    dealt = plan_manifests(remaining, len(ids))
+    return {eid: shard for eid, shard in zip(ids, dealt)}
 
 
 def _sliced(rows: Iterator[Any], m: FileManifest) -> Iterator[Any]:

@@ -89,6 +89,15 @@ SITES = frozenset(
         "ingest.open_shard",  # ShardReader, before opening one shard
         "ingest.read_block",  # ShardReader, per block read ("drop" aware:
         # a dropped block is surfaced by the replay cursor's gap check)
+        # live shard redistribution (the handover protocol — see
+        # docs/ROBUSTNESS.md "Live shard redistribution")
+        "ingest.handover_drain",  # IngestFeed, draining to a block
+        # boundary on the old plan ("drop" aware: a dropped drain skips
+        # the cursor publication — the stale-cursor duplicate bound)
+        "ingest.cursor_publish",  # node, publishing a replay cursor to
+        # the driver KV ("drop" aware: a lost publication widens the
+        # crash-handover duplicate window, never breaks zero-gap)
+        "ingest.plan_adopt",  # IngestFeed, before adopting a re-split
         # serving plane
         "engine.submit",  # ContinuousBatcher enqueue (caller thread)
         "engine.dispatch",  # scheduler, before a decode-block dispatch

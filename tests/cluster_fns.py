@@ -693,6 +693,61 @@ def ingest_restart_fn(args, ctx):
         f.write("ok")
 
 
+def ingest_handover_fn(args, ctx):
+    """Live-shard-redistribution map_fun (handover e2e): drains this
+    node's driver-published shard through a handover-armed IngestFeed,
+    persisting the consumed values + the plan epoch after EVERY batch
+    (atomic replace) — so even a SIGKILLed node leaves an exact record
+    of what it trained on, which is what the exactly-once accounting
+    (zero-gap, duplicates <= one publication interval) is computed
+    from. Optional planned leave: after ``leave_after`` batches,
+    publish an exact cursor and exit(3) — the cooperative shrink; a
+    replacement with the same executor id skips the leave (marker
+    file) and consumes its re-split share."""
+    import json
+    import time
+
+    import numpy as np
+
+    d = args["dir"]
+    state_path = os.path.join(d, f"consumed{ctx.executor_id}.json")
+    state = {"values": [], "epochs": []}
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            state = json.load(f)
+    feed = ctx.get_ingest_feed(
+        input_mapping={"x": "x"},
+        timeout=float(args.get("timeout", 120)),
+        publish_blocks=int(args.get("publish_blocks", 2)),
+    )
+    left_marker = os.path.join(d, "left")
+    n_batches = 0
+    for cols in feed.batch_stream(int(args.get("batch", 4))):
+        state["values"].extend(np.ravel(cols["x"]).tolist())
+        state["epochs"].append(feed.plan_epoch)
+        tmp = state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, state_path)
+        n_batches += 1
+        if args.get("step_sleep"):
+            time.sleep(float(args["step_sleep"]))
+        if (
+            args.get("leave_after")
+            and ctx.executor_id == int(args.get("leave_id", 1))
+            and n_batches >= int(args["leave_after"])
+            and not os.path.exists(left_marker)
+        ):
+            with open(left_marker, "w") as f:
+                f.write("1")
+            # planned leave: an EXACT cursor first, so the re-split
+            # starts precisely where training stopped (zero-dup)
+            feed.publish_cursor()
+            os._exit(3)
+    with open(os.path.join(d, f"done{ctx.executor_id}"), "w") as f:
+        f.write("ok")
+
+
 def _elastic_recipe():
     """Shared pieces of the elastic chaos tests: a tiny linear model
     whose data order is a pure function of the step index (the replay

@@ -31,8 +31,19 @@ def ingest_typo_site():
     failpoint("ingest.read_blck")  # SEEDED VIOLATION FP001: unregistered
 
 
+def handover_typo_site():
+    failpoint("ingest.handover_drian")  # SEEDED VIOLATION FP001: unregistered
+
+
 def ingest_clean_sites():
     # registered pull-plane sites: must NOT be flagged
     failpoint("ingest.manifest_fetch")
     failpoint("ingest.open_shard")
     failpoint("ingest.read_block")
+
+
+def handover_clean_sites():
+    # registered live-redistribution sites: must NOT be flagged
+    failpoint("ingest.handover_drain")
+    failpoint("ingest.cursor_publish")
+    failpoint("ingest.plan_adopt")

@@ -388,6 +388,8 @@ def test_assign_shards_stable_per_executor_id(monkeypatch):
         "connect_manager",
         lambda w: _KV(w["executor_id"]),
     )
+    import threading
+
     c = object.__new__(tfc.TFCluster)
     c.input_mode = tfc.InputMode.TENSORFLOW
     c.cluster_info = [
@@ -397,7 +399,15 @@ def test_assign_shards_stable_per_executor_id(monkeypatch):
     c.server = SimpleNamespace(
         reservations=SimpleNamespace(epoch=lambda: 0)
     )
+    # the stable (handover-off) publish path under test
+    c.elastic = False
+    c.ingest_handover = True
+    c.heartbeat_interval = 0.0
+    c._shutdown_done = False
+    c._ingest_lock = threading.Lock()
     c._ingest_shards = None
+    c._ingest_complete = False
+    c._ingest_republished = False
     ms = [FileManifest(f"f{i}") for i in range(7)]
     c.assign_shards(ms)
     original = {k: v["manifests"] for k, v in published.items()}
