@@ -57,6 +57,7 @@ __all__ = [
     "MetricsAggregator",
     "clock_sync",
     "export_meta",
+    "merge_families",
     "note_clock_sync",
     "parse_prometheus_text",
     "serve_text",
@@ -245,6 +246,49 @@ def _label_items_str(items: Iterable[tuple[str, str]]) -> str:
 def _render_label_items(items: Iterable[tuple[str, str]]) -> str:
     inner = _label_items_str(items)
     return "{" + inner + "}" if inner else ""
+
+
+def merge_families(
+    by_key: dict[Any, dict[str, Any]], label: str = "node"
+) -> str:
+    """Merge several parsed expositions (``{key: families}``, each
+    families dict shaped like :func:`parse_prometheus_text`'s output)
+    into ONE valid exposition: every sample re-labelled
+    ``<label>="<key>"``, one TYPE line per family. A sample that
+    already carries the label (honor_labels=false convention) yields
+    it to the merge key, surviving as ``exported_<label>``.
+
+    Shared by the driver's aggregated ``/metrics``
+    (:meth:`MetricsAggregator.render`, ``label="node"``) and the
+    serving fleet router's ``/metrics`` (``label="replica"``) so the
+    two merge planes cannot drift."""
+    by_family: dict[str, dict[str, Any]] = {}
+    for key, families in sorted(by_key.items(), key=lambda kv: str(kv[0])):
+        for fam, data in families.items():
+            out = by_family.setdefault(
+                fam, {"type": data.get("type"), "samples": []}
+            )
+            if out["type"] is None:
+                out["type"] = data.get("type")
+            for (sname, labels), value in sorted(data["samples"].items()):
+                d = dict(labels)
+                if label in d:
+                    d[f"exported_{label}"] = d.pop(label)
+                d[label] = str(key)
+                merged = tuple(sorted(d.items()))
+                out["samples"].append((sname, merged, value))
+    lines: list[str] = []
+    for fam in sorted(by_family):
+        data = by_family[fam]
+        lines.append(f"# TYPE {fam} {data['type'] or 'untyped'}")
+        for sname, labels, value in data["samples"]:
+            v = (
+                str(int(value))
+                if float(value).is_integer() and abs(value) < 1e15
+                else repr(float(value))
+            )
+            lines.append(f"{sname}{_render_label_items(labels)} {v}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 # -- driver-side aggregation -------------------------------------------------
@@ -468,43 +512,20 @@ class MetricsAggregator:
 
     def render(self) -> str:
         """The merge as ONE valid exposition: every sample re-labelled
-        ``node="<key>"``, one TYPE line per family (the driver
-        ``/metrics`` endpoint body). Prometheus-side aggregation
-        (``sum by (...)``) then works unmodified."""
+        ``node="<key>"`` (honor_labels=false: a sample's own node label
+        survives as ``exported_node``), one TYPE line per family (the
+        driver ``/metrics`` endpoint body) — :func:`merge_families`.
+        Prometheus-side aggregation (``sum by (...)``) then works
+        unmodified."""
         snap = self.last_scrape() or self.scrape_once()
-        by_family: dict[str, dict[str, Any]] = {}
-        for key, entry in sorted(snap.items(), key=lambda kv: str(kv[0])):
-            if not entry.get("ok"):
-                continue
-            for fam, data in entry["families"].items():
-                out = by_family.setdefault(
-                    fam, {"type": data.get("type"), "samples": []}
-                )
-                if out["type"] is None:
-                    out["type"] = data.get("type")
-                for (sname, labels), value in sorted(data["samples"].items()):
-                    d = dict(labels)
-                    if "node" in d:
-                        # Prometheus honor_labels=false convention: a
-                        # scraped sample's own node label (e.g. the
-                        # driver's per-executor liveness gauges) yields
-                        # to the scrape key, surviving as exported_node.
-                        d["exported_node"] = d.pop("node")
-                    d["node"] = str(key)
-                    merged = tuple(sorted(d.items()))
-                    out["samples"].append((sname, merged, value))
-        lines: list[str] = []
-        for fam in sorted(by_family):
-            data = by_family[fam]
-            lines.append(f"# TYPE {fam} {data['type'] or 'untyped'}")
-            for sname, labels, value in data["samples"]:
-                v = (
-                    str(int(value))
-                    if float(value).is_integer() and abs(value) < 1e15
-                    else repr(float(value))
-                )
-                lines.append(f"{sname}{_render_label_items(labels)} {v}")
-        return "\n".join(lines) + ("\n" if lines else "")
+        return merge_families(
+            {
+                key: entry["families"]
+                for key, entry in snap.items()
+                if entry.get("ok")
+            },
+            label="node",
+        )
 
 
 # -- HTTP --------------------------------------------------------------------

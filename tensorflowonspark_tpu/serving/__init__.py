@@ -5,7 +5,11 @@ The reference had no serving story beyond per-executor batch inference
 partitions). This package is the rebuild's beyond-reference serving
 layer: :mod:`engine` provides slot-based continuous batching — requests
 join and leave a persistent batched decode loop at token granularity
-instead of waiting for fixed-batch windows.
+instead of waiting for fixed-batch windows; :mod:`fleet` +
+:mod:`router` own N engine replicas behind one health-routed surface
+(failover, draining, load shedding — the client sees one engine, the
+system owns N). The fleet modules import lazily here: the single-engine
+path must not pay for them.
 """
 
 from tensorflowonspark_tpu.serving.engine import (
@@ -20,4 +24,26 @@ __all__ = [
     "DeadlineExceeded",
     "EngineOverloaded",
     "EngineWedged",
+    "FleetOverloaded",
+    "FleetRouter",
+    "FleetUnavailable",
+    "ReplicaGone",
+    "ServingFleet",
 ]
+
+
+def __getattr__(name):
+    if name in (
+        "ServingFleet",
+        "FleetOverloaded",
+        "FleetUnavailable",
+        "ReplicaGone",
+    ):
+        from tensorflowonspark_tpu.serving import fleet as _fleet
+
+        return getattr(_fleet, name)
+    if name == "FleetRouter":
+        from tensorflowonspark_tpu.serving.router import FleetRouter
+
+        return FleetRouter
+    raise AttributeError(name)

@@ -732,6 +732,12 @@ class ContinuousBatcher:
             self._prefix_store = None
         self._queue: "queue.Queue" = queue.Queue()
         self._closed = False  # guarded-by: self._submit_lock
+        # True only while warmup() runs its throwaway requests: a fresh
+        # replica compiling is ALIVE but not READY — health probers
+        # must see the difference (a warmup stall otherwise looks
+        # wedged). Single writer (the warmup caller); racy bool reads
+        # from health() are benign.
+        self._warming = False
         self._stop_now = threading.Event()
         self._submit_lock = threading.Lock()
         self._prefill_cache: dict = {}
@@ -1376,9 +1382,11 @@ class ContinuousBatcher:
         # indistinguishable from the wedges it hunts, and warmup exists
         # precisely to take them before traffic.
         self._watchdog_suspended = True
+        self._warming = True
         try:
             self._warmup_requests()
         finally:
+            self._warming = False
             self._watchdog_suspended = False
 
     def _warmup_requests(self) -> None:
@@ -1448,6 +1456,30 @@ class ContinuousBatcher:
         self._tracer.record("engine.queue", dur)
         self._m_phase.observe(dur, phase="queue")
 
+    def health(self) -> dict:
+        """Liveness vs readiness, split (the ``/healthz`` contract —
+        docs/ROBUSTNESS.md "Serving fleet"): ``live`` = the scheduler
+        thread exists and runs; ``ready`` = live AND warmup is not in
+        progress AND the engine is not closed/draining. A warming or
+        draining engine is alive (do not restart it) but must not
+        receive new traffic (do not route to it)."""
+        live = self._thread.is_alive()
+        return {
+            "live": live,
+            "ready": bool(live and not self._warming and not self._closed),  # lint: lockfree-read: advisory health probe; a torn one-bool read is benign and the submit lock must not be taken per probe
+            "warming": self._warming,
+            "closed": self._closed,  # lint: lockfree-read: same advisory snapshot as above
+        }
+
+    def unresolved(self) -> int:
+        """Accepted-but-not-yet-resolved request count — the drain
+        quiescence metric ``close(drain=True)`` polls, exposed for
+        fleet supervisors that must know when a DRAINING replica has
+        run out its in-flight work."""
+        return self._accepted_total - (  # lint: lockfree-read: monotonic counters; a stale read only delays one supervisor poll
+            self.completed + self._failed_total
+        )
+
     def stats(self) -> dict:
         """Scheduler observability (served at the HTTP ``/stats``
         endpoint): slot occupancy, queue depth, lifetime counters."""
@@ -1477,6 +1509,11 @@ class ContinuousBatcher:
             "admitted": self.admitted,
             "completed": self.completed,
             "cancelled": self.cancelled,
+            # accepted-but-unresolved (the drain quiescence metric;
+            # counts queued requests `admitted` cannot see and uses
+            # the same accounting close(drain=True) polls) — remote
+            # fleet supervisors read it off /stats
+            "unresolved": self.unresolved(),
             "tokens_emitted": self.tokens_emitted,
             # degradation surface: terminal deadline expiries, watchdog
             # fires, and (after close()) whether the scheduler actually

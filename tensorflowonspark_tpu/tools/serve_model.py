@@ -72,6 +72,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -133,8 +134,31 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self) -> None:  # noqa: N802 - http.server API
-        if self.path == "/healthz":
-            self._reply(200, {"status": "ok", "export_dir": self.export_dir})
+        if self.path in ("/healthz", "/readyz"):
+            # Liveness vs readiness, SPLIT (docs/ROBUSTNESS.md "Serving
+            # fleet"): live = the process/scheduler runs (restarting a
+            # live server helps nobody); ready = route traffic here
+            # (false during warmup and drain — a warmup stall must not
+            # look wedged to a prober, and a draining server must fall
+            # out of rotation without being killed). /healthz answers
+            # 200 iff live, /readyz 200 iff ready; in fleet mode the
+            # body carries the per-replica split too.
+            h = {"live": True, "ready": True}
+            if self.gen_engine is not None:
+                try:
+                    h = self.gen_engine.health()
+                except Exception:  # noqa: BLE001 - a dead engine is a
+                    # health verdict, not a 500
+                    h = {"live": False, "ready": False}
+            ok = h.get("live") if self.path == "/healthz" else h.get("ready")
+            self._reply(
+                200 if ok else 503,
+                {
+                    "status": "ok" if h.get("live") else "dead",
+                    "export_dir": self.export_dir,
+                    **h,
+                },
+            )
         elif self.path == "/signature" and self.model is not None:
             self._reply(200, self.model.meta)
         elif self.path == "/v1/models":
@@ -167,7 +191,14 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/stats":
             stats: dict = {"mode": "aot" if self.model is not None else ""}
             if self.gen_engine is not None:
-                stats.update(self.gen_engine.stats(), mode="continuous")
+                stats.update(
+                    self.gen_engine.stats(),
+                    mode=(
+                        "fleet"
+                        if getattr(self.gen_engine, "IS_FLEET", False)
+                        else "continuous"
+                    ),
+                )
             elif self.gen_batcher is not None:
                 stats.update(
                     mode="coalesced",
@@ -407,6 +438,9 @@ class _Handler(BaseHTTPRequestHandler):
             DeadlineExceeded,
             EngineOverloaded,
             EngineWedged,
+            FleetOverloaded,
+            FleetUnavailable,
+            ReplicaGone,
         )
 
         logprobs = None
@@ -437,23 +471,54 @@ class _Handler(BaseHTTPRequestHandler):
                                 logprobs[i * n : (i + 1) * n]
                                 for i in range(len(prompts))
                             ]
+                except FleetOverloaded as e:
+                    # router admission shed: the deadline cannot be met
+                    # from queue-depth estimates (or every queue is
+                    # full) — tell the client WHEN to come back
+                    self._reply(
+                        429,
+                        {"error": str(e),
+                         "error_type": "FleetOverloaded"},
+                        {"Retry-After": str(int(math.ceil(e.retry_after)))},
+                    )
+                    return
+                except FleetUnavailable as e:
+                    # full-fleet drain / no ready replica
+                    self._reply(
+                        503,
+                        {"error": str(e),
+                         "error_type": "FleetUnavailable"},
+                        {"Retry-After": "2"},
+                    )
+                    return
                 except EngineOverloaded as e:
                     self._reply(
-                        503, {"error": str(e)}, {"Retry-After": "1"}
+                        503,
+                        {"error": str(e),
+                         "error_type": "EngineOverloaded"},
+                        {"Retry-After": "1"},
                     )
                     return
                 except DeadlineExceeded as e:
                     # the documented degradation contract: an expired
                     # per-request budget is a gateway-timeout class
                     # outcome, not a server defect
-                    self._reply(504, {"error": str(e)})
-                    return
-                except EngineWedged as e:
-                    # the watchdog aborted in-flight work and the engine
-                    # keeps serving — a retryable unavailability, not a
-                    # generic 500
                     self._reply(
-                        503, {"error": str(e)}, {"Retry-After": "1"}
+                        504,
+                        {"error": str(e),
+                         "error_type": "DeadlineExceeded"},
+                    )
+                    return
+                except (EngineWedged, ReplicaGone) as e:
+                    # the watchdog aborted in-flight work (or the
+                    # replica died and failover was already spent) and
+                    # the fleet/engine keeps serving — a retryable
+                    # unavailability, not a generic 500
+                    self._reply(
+                        503,
+                        {"error": str(e),
+                         "error_type": type(e).__name__},
+                        {"Retry-After": "1"},
                     )
                     return
                 except ValueError as e:
@@ -552,7 +617,12 @@ class _Handler(BaseHTTPRequestHandler):
         trailer. The response is close-delimited (no Content-Length);
         a mid-stream failure surfaces as an ``{"error": ...}`` line
         since the 200 status is already on the wire."""
-        from tensorflowonspark_tpu.serving import EngineOverloaded
+        from tensorflowonspark_tpu.serving import (
+            EngineOverloaded,
+            FleetOverloaded,
+            FleetUnavailable,
+            ReplicaGone,
+        )
 
         try:
             gen = self.gen_engine.stream(
@@ -572,8 +642,26 @@ class _Handler(BaseHTTPRequestHandler):
                 logit_bias=logit_bias,
                 deadline_s=deadline_s,
             )
+        except FleetOverloaded as e:
+            self._reply(
+                429,
+                {"error": str(e), "error_type": "FleetOverloaded"},
+                {"Retry-After": str(int(math.ceil(e.retry_after)))},
+            )
+            return
+        except (FleetUnavailable, ReplicaGone) as e:
+            self._reply(
+                503,
+                {"error": str(e), "error_type": type(e).__name__},
+                {"Retry-After": "2"},
+            )
+            return
         except EngineOverloaded as e:
-            self._reply(503, {"error": str(e)}, {"Retry-After": "1"})
+            self._reply(
+                503,
+                {"error": str(e), "error_type": "EngineOverloaded"},
+                {"Retry-After": "1"},
+            )
             return
         except ValueError as e:  # submit-side prompt validation
             self._reply(400, {"error": str(e)})
@@ -613,7 +701,12 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 self.wfile.write(
                     json.dumps(
-                        {"error": f"{type(e).__name__}: {e}"}
+                        {
+                            "error": f"{type(e).__name__}: {e}",
+                            # typed so a fleet router fronting THIS
+                            # server can reconstruct the engine error
+                            "error_type": type(e).__name__,
+                        }
                     ).encode()
                     + b"\n"
                 )
@@ -976,37 +1069,72 @@ def _build_engine(gen: dict):
     params = _load_params(
         gen["checkpoint"], cfg, lora_scale=gen.get("lora_scale")
     )
-    engine = ContinuousBatcher(
-        model,
-        params,
-        slots=int(gen.get("slots") or gen.get("batch_size", 8)),
-        prompt_widths=widths,
-        temperature=float(gen.get("temperature", 0.0)),
-        top_k=gen.get("top_k"),
-        top_p=gen.get("top_p"),
-        min_p=gen.get("min_p"),
-        eos_id=gen.get("eos_id"),
-        seed=int(gen.get("seed", 0)),
-        mesh=mesh,
-        max_queue=gen.get("max_queue"),
-        prefill_chunk=gen.get("prefill_chunk"),
-        prefix_cache=gen.get("prefix_cache"),
-        # `or 8` would map an EXPLICIT 0 to 8; only None (unset) takes
-        # the default — explicit values pass through to the engine's
-        # own max(1, ...) clamp, consistent with direct construction.
-        decode_block=(
-            8 if gen.get("decode_block") is None
-            else int(gen["decode_block"])
-        ),
-        pipeline_depth=(
-            2 if gen.get("pipeline_depth") is None
-            else int(gen["pipeline_depth"])
-        ),
-        watchdog_s=(
-            None if gen.get("watchdog_s") is None
-            else float(gen["watchdog_s"])
-        ),
-    )
+
+    def factory():
+        # One engine per call: the fleet path respawns replicas through
+        # this, so everything scheduler-stateful must be built fresh
+        # here (model/params are shared read-only — jax arrays).
+        return ContinuousBatcher(
+            model,
+            params,
+            slots=int(gen.get("slots") or gen.get("batch_size", 8)),
+            prompt_widths=widths,
+            temperature=float(gen.get("temperature", 0.0)),
+            top_k=gen.get("top_k"),
+            top_p=gen.get("top_p"),
+            min_p=gen.get("min_p"),
+            eos_id=gen.get("eos_id"),
+            seed=int(gen.get("seed", 0)),
+            mesh=mesh,
+            max_queue=gen.get("max_queue"),
+            prefill_chunk=gen.get("prefill_chunk"),
+            prefix_cache=gen.get("prefix_cache"),
+            # `or 8` would map an EXPLICIT 0 to 8; only None (unset)
+            # takes the default — explicit values pass through to the
+            # engine's own max(1, ...) clamp, consistent with direct
+            # construction.
+            decode_block=(
+                8 if gen.get("decode_block") is None
+                else int(gen["decode_block"])
+            ),
+            pipeline_depth=(
+                2 if gen.get("pipeline_depth") is None
+                else int(gen["pipeline_depth"])
+            ),
+            watchdog_s=(
+                None if gen.get("watchdog_s") is None
+                else float(gen["watchdog_s"])
+            ),
+        )
+
+    n_replicas = int(gen.get("replicas") or 1)
+    if n_replicas > 1:
+        # The fleet plane: N in-process replicas (each with its own
+        # scheduler + watchdog) behind the health-routing FleetRouter —
+        # the handler talks to the router exactly as it would to one
+        # engine (docs/SERVING.md "Serving fleet").
+        from tensorflowonspark_tpu.serving.fleet import ServingFleet
+        from tensorflowonspark_tpu.serving.router import FleetRouter
+
+        t0 = time.monotonic()
+        fleet = ServingFleet(
+            factory=factory,
+            replicas=n_replicas,
+            probe_interval=float(gen.get("probe_interval") or 1.0),
+            warmup=bool(gen.get("warmup")),
+        )
+        router = FleetRouter(
+            fleet,
+            default_temperature=float(gen.get("temperature", 0.0)),
+        )
+        logger.info(
+            "serving fleet of %d replicas ready in %.1fs",
+            n_replicas,
+            time.monotonic() - t0,
+        )
+        return router, max_new, model, params
+
+    engine = factory()
     if gen.get("warmup"):
         t0 = time.monotonic()
         engine.warmup()
@@ -1414,6 +1542,31 @@ def main(argv: list[str] | None = None) -> int:
         "whole-bucket prefill",
     )
     p.add_argument(
+        "--gen-replicas",
+        type=int,
+        default=1,
+        help="continuous engine: run this many engine replicas (each "
+        "with its own scheduler/watchdog) behind a health-routing "
+        "fleet router — prefix-aware placement, failover, draining, "
+        "deadline-based load shedding (429/503). 1 = the single "
+        "engine, no router",
+    )
+    p.add_argument(
+        "--gen-probe-interval",
+        type=float,
+        default=1.0,
+        help="fleet mode: replica health-probe cadence in seconds; an "
+        "unhealthy replica flips to draining within miss_limit "
+        "probes and is respawned",
+    )
+    p.add_argument(
+        "--port-file",
+        default=None,
+        help="write the actually-bound port (useful with --port 0) to "
+        "this file once the server is ready to accept requests — the "
+        "spawn barrier fleet supervisors poll",
+    )
+    p.add_argument(
         "--gen-watchdog",
         type=float,
         default=None,
@@ -1427,6 +1580,13 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
     if args.export_dir is None and args.llama_checkpoint is None:
         p.error("need --export-dir and/or --llama-checkpoint")
+    if args.gen_replicas > 1 and args.gen_engine != "continuous":
+        p.error(
+            "--gen-replicas > 1 requires --gen-engine continuous "
+            "(the fleet router fronts continuous engines)"
+        )
+    if args.gen_replicas < 1:
+        p.error(f"--gen-replicas must be >= 1, got {args.gen_replicas}")
     logging.basicConfig(level=logging.INFO)
     gen = None
     if args.llama_checkpoint is not None:
@@ -1462,6 +1622,8 @@ def main(argv: list[str] | None = None) -> int:
             lora_scale=args.gen_lora_scale,
             drain_on_shutdown=args.gen_drain_on_shutdown,
             served_model_name=args.served_model_name,
+            replicas=args.gen_replicas,
+            probe_interval=args.gen_probe_interval,
         )
     server = make_server(
         args.export_dir, args.port, args.batch_size, host=args.host, gen=gen
@@ -1473,6 +1635,17 @@ def main(argv: list[str] | None = None) -> int:
         args.export_dir or args.llama_checkpoint,
         server.server_address[1],
     )
+    if args.port_file:
+        # atomic (tmp + rename): a poller must never read a torn port.
+        # Written AFTER make_server returns — the engine is built (and
+        # warmed, with --gen-warmup), so the file doubles as the
+        # replica spawn barrier.
+        import os as _os
+
+        tmp = f"{args.port_file}.tmp.{_os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(str(server.server_address[1]))
+        _os.replace(tmp, args.port_file)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -102,6 +102,15 @@ SITES = frozenset(
         "engine.submit",  # ContinuousBatcher enqueue (caller thread)
         "engine.dispatch",  # scheduler, before a decode-block dispatch
         "engine.fetch",  # scheduler, before a block fetch
+        # serving fleet (serving/fleet.py + router.py — see
+        # docs/ROBUSTNESS.md "Serving fleet")
+        "fleet.dispatch",  # router, before handing a request to a
+        # replica ("drop" aware: a lost dispatch surfaces as a LOUD
+        # terminal/failover via ReplicaGone — never a hang)
+        "fleet.replica_probe",  # fleet probe loop, per replica round
+        # (a raised probe is a missed beat toward DRAINING)
+        "fleet.replica_spawn",  # replica (re)spawn, before the engine/
+        # process is built (a raise exercises respawn retry/DEAD)
         # checkpoint plane
         "checkpoint.save",  # orbax save (inside the retry)
         "checkpoint.restore",  # orbax restore (inside the retry)

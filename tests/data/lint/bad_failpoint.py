@@ -47,3 +47,14 @@ def handover_clean_sites():
     failpoint("ingest.handover_drain")
     failpoint("ingest.cursor_publish")
     failpoint("ingest.plan_adopt")
+
+
+def fleet_typo_site():
+    failpoint("fleet.dispach")  # SEEDED VIOLATION FP001: unregistered
+
+
+def fleet_clean_sites():
+    # registered serving-fleet sites: must NOT be flagged
+    failpoint("fleet.dispatch")
+    failpoint("fleet.replica_probe")
+    failpoint("fleet.replica_spawn")

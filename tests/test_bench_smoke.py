@@ -151,3 +151,45 @@ def test_real_chip_prefix_bench_smoke():
     assert out["prefix_hits"] >= 3
     assert out["prefix_tokens_saved"] > 0
     assert out["ttft_cold_ms"] > 0 and out["step_time_ms"] > 0
+
+
+def test_bench_serve_fleet_smoke_emits_scaling_and_artifact():
+    """bench.py --serve-fleet end-to-end on the tiny model: the
+    replicas=1 vs 2 saturation legs must emit a finite scaling ratio
+    (uncontended projection + contended wall ratio), zero sheds/
+    failovers in an unsaturated run, and commit the
+    benchmarks/results/serve_fleet_*.json artifact."""
+    import math
+
+    env = dict(
+        os.environ,
+        BENCH_SMOKE="1",
+        BENCH_ALLOW_CPU="1",
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PALLAS_AXON_REMOTE_COMPILE="",
+    )
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--serve-fleet"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "serve_fleet_scaling"
+    assert out["smoke"] is True
+    assert math.isfinite(out["value"]) and out["value"] > 0
+    assert math.isfinite(out["wall_ratio_contended"])
+    assert out["wall_ratio_contended"] > 0
+    for leg in ("fleet_replicas1", "fleet_replicas2"):
+        assert out[leg]["tokens_per_sec"] > 0
+        assert out[leg]["shed"] == 0
+        assert out[leg]["failovers"] == 0
+    assert len(out["fleet_replicas2"]["uncontended_per_replica"]) == 2
+    art = os.path.join(REPO, out["artifact"])
+    assert os.path.exists(art)
+    on_disk = json.load(open(art))
+    assert on_disk["metric"] == "serve_fleet_scaling"

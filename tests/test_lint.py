@@ -172,10 +172,11 @@ def test_failpoint_rule_reports_seeded_violations(fixture_findings):
         _line_of("bad_failpoint.py", "elastic.epoch_bmp"),
         _line_of("bad_failpoint.py", "ingest.read_blck"),
         _line_of("bad_failpoint.py", "ingest.handover_drian"),
+        _line_of("bad_failpoint.py", "fleet.dispach"),
     }, [f.render() for f in hits]
     dynamic = [f for f in hits if "string literal" in f.message]
     unregistered = [f for f in hits if "not registered" in f.message]
-    assert len(dynamic) == 1 and len(unregistered) == 4
+    assert len(dynamic) == 1 and len(unregistered) == 5
     # the REGISTERED elastic + pull-plane sites are in the rule's
     # registry view: the fixture's clean literals produced no findings
     clean_lines = {
@@ -188,6 +189,9 @@ def test_failpoint_rule_reports_seeded_violations(fixture_findings):
         _line_of("bad_failpoint.py", '"ingest.handover_drain"'),
         _line_of("bad_failpoint.py", '"ingest.cursor_publish"'),
         _line_of("bad_failpoint.py", '"ingest.plan_adopt"'),
+        _line_of("bad_failpoint.py", '"fleet.dispatch"'),
+        _line_of("bad_failpoint.py", '"fleet.replica_probe"'),
+        _line_of("bad_failpoint.py", '"fleet.replica_spawn"'),
     }
     assert not clean_lines & {f.line for f in hits}
 

@@ -60,12 +60,14 @@ SLOW_SUITES = [
     "tests/test_chaos.py",
     "tests/test_elastic.py",
     "tests/test_engine_pipeline.py",
+    "tests/test_fleet.py",  # SIGKILL-a-replica + overload-shedding e2e
     "tests/test_handover.py",  # SIGKILL-handover + cooperative re-split e2e
     "tests/test_ingest.py",  # crash-mid-shard restart e2e (exactly-once)
     "tests/test_native_asan.py",
     "tests/test_native_tsan.py",
     ("tests/test_chaos.py", TFSAN_ENV),
     ("tests/test_elastic.py", TFSAN_ENV),
+    ("tests/test_fleet.py", TFSAN_ENV),
     ("tests/test_handover.py", TFSAN_ENV),
 ]
 SLOW_TIMEOUT = 900.0
