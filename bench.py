@@ -181,6 +181,131 @@ def _bench_llama(steps: int = 10, smoke: bool = False) -> None:
         _partial["mfu_pct"] = tflops_per_chip / peak * 100
 
 
+def _bench_zero_ab(smoke: bool, legs: list) -> None:
+    """``--zero``: the cross-replica sharded weight update A/B.
+
+    Runs the llama train bench at a FIXED batch on a pure
+    data-parallel mesh (``mesh_axis='data'`` — replicated params, the
+    regime where the pre-ZeRO optimizer update is computed redundantly
+    on every replica) once per requested ``zero_sharding`` setting, and
+    commits one artifact with step time, MFU (TPU only), and the
+    isolated optimizer-span ms per leg. A smoke run additionally runs
+    the byte-identity gate (``tests/test_bench_smoke.py``): the
+    weight-update decomposition on identical gradients must be
+    byte-exact (``update_params_match`` — elementwise math, only
+    placement changes), while the full train legs' digests are reported
+    beside it (they may differ by gradient-reduction summation order,
+    ~1 ulp). Artifact:
+    ``benchmarks/results/zero_weight_update.json`` (``_<backend>_smoke``
+    suffixed for smoke runs so CI can never clobber chip evidence).
+    """
+    import jax
+
+    from benchmarks import real_chip
+
+    results: dict = {}
+    for leg in legs:
+        ns = argparse.Namespace(
+            steps=4 if smoke else 10,
+            batch_size=8,
+            seq=64 if smoke else 1024,
+            attention="auto",
+            remat="none",
+            precision="fp32",
+            moments="bf16",
+            model_scale="tiny" if smoke else "1b",
+            mesh_axis="data",
+            zero_sharding=(leg == "on"),
+            measure_update=True,
+            # digesting 1B fp32 params off-device is smoke-only; the
+            # real-chip A/B trusts the CI byte-identity gate
+            params_digest=smoke,
+        )
+        res = real_chip.bench_llama1b(ns)
+        n_chips = len(jax.devices())
+        step_time = res["dt"] / ns.steps
+        tflops = res["flops_fallback"] / step_time / n_chips / 1e12
+        entry = {
+            "step_time_ms": round(step_time * 1e3, 1),
+            "weight_update_ms": res["weight_update_ms"],
+            "final_loss": round(res["loss"], 4),
+        }
+        if jax.default_backend() == "tpu" and not smoke:
+            entry["mfu_pct"] = round(
+                tflops / real_chip.V5E_PEAK_TFLOPS * 100, 1
+            )
+        if "params_digest" in res:
+            entry["params_digest"] = res["params_digest"]
+        results[f"zero_{leg}"] = entry
+
+    if smoke:
+        _partial["smoke"] = True
+        # The byte-identity gate: the weight-update DECOMPOSITION must
+        # be byte-exact on identical gradients (elementwise math, only
+        # placement changes). The full train legs' digests may differ
+        # by gradient-reduction summation order (reduce-scatter vs
+        # all-reduce grouping) — reported, not gated.
+        ab = real_chip.update_ab_digests(
+            argparse.Namespace(seq=16, model_scale="tiny", mesh_axis="data")
+        )
+        _partial["update_params_match"] = ab["on"] == ab["off"]
+    out = {
+        "metric": "zero_weight_update",
+        # the headline: replicated-optimizer span ÷ ZeRO-sharded span
+        # (>1 = the cross-replica partition pays)
+        "value": round(
+            results.get("zero_off", {}).get("weight_update_ms", 0)
+            / max(
+                results.get("zero_on", {}).get("weight_update_ms", 1e-9),
+                1e-9,
+            ),
+            3,
+        )
+        if {"zero_on", "zero_off"} <= set(results)
+        else 0,
+        "unit": "x",
+        "vs_baseline": round(
+            results.get("zero_off", {}).get("step_time_ms", 0)
+            / max(results.get("zero_on", {}).get("step_time_ms", 1e-9), 1e-9),
+            3,
+        )
+        if {"zero_on", "zero_off"} <= set(results)
+        else 0,
+        "backend": jax.default_backend(),
+        "chips": len(jax.devices()),
+        "batch": 8,
+        "seq": 64 if smoke else 1024,
+        **results,
+        **_partial,
+    }
+    if {"zero_on", "zero_off"} <= set(results) and smoke:
+        out["train_params_match"] = (
+            results["zero_on"]["params_digest"]
+            == results["zero_off"]["params_digest"]
+        )
+    if {"zero_on", "zero_off"} <= set(results):
+        path = os.path.join(
+            "benchmarks",
+            "results",
+            "zero_weight_update"
+            + (f"_{jax.default_backend()}_smoke" if smoke else "")
+            + ".json",
+        )
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(out, f, indent=2, sort_keys=True)
+                f.write("\n")
+            out["artifact"] = path
+        except OSError as e:
+            out["artifact_error"] = str(e)
+    else:
+        # a single-leg quick look must never clobber the committed
+        # two-leg A/B evidence the BASELINE row reads
+        out["artifact_skipped"] = "partial legs; artifact needs on AND off"
+    _emit(out)
+
+
 def _bench_mnist_feed(steps: int = 40) -> None:
     """MNIST end-to-end through the data plane: columnar wire frames →
     sliced column batches → staged ``DevicePrefetcher.from_feed`` H2D —
@@ -767,6 +892,19 @@ def main(argv: list[str] | None = None) -> None:
         "tiny model)",
     )
     ap.add_argument(
+        "--zero",
+        nargs="?",
+        const="on,off",
+        default=None,
+        metavar="on,off",
+        help="run the cross-replica sharded weight-update A/B instead "
+        "of the headline bench: the llama train step at fixed batch on "
+        "a pure data-parallel mesh with zero_sharding on vs off, "
+        "committing benchmarks/results/zero_weight_update*.json "
+        "(step_time_ms, MFU on TPU, optimizer-span ms per leg; "
+        "BENCH_SMOKE=1 for the tiny model + params byte-identity hash)",
+    )
+    ap.add_argument(
         "--serve",
         action="store_true",
         help="measure the serving engine tax instead of training MFU: "
@@ -830,6 +968,13 @@ def main(argv: list[str] | None = None) -> None:
     _partial["chips"] = len(jax.devices())
 
     smoke = bool(os.environ.get("BENCH_SMOKE"))
+    if args.zero:
+        legs = [leg.strip() for leg in args.zero.split(",") if leg.strip()]
+        bad = [leg for leg in legs if leg not in ("on", "off")]
+        if bad or not legs:
+            ap.error(f"--zero legs must be 'on'/'off', got {bad or args.zero!r}")
+        _bench_zero_ab(smoke, legs)
+        return
     if args.serve_fleet:
         _bench_serve_fleet(smoke)
         return

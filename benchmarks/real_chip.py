@@ -287,7 +287,14 @@ def bench_llama1b(args):
     from tensorflowonspark_tpu.parallel import use_mesh
     import jax.numpy as jnp
 
-    mesh = make_mesh({"fsdp": len(jax.devices())})
+    # mesh_axis="data" puts the bench in the pure data-parallel regime
+    # (replicated params, replicated optimizer pre-ZeRO) — the
+    # bench.py --zero A/B leg's configuration, where the cross-replica
+    # sharded weight update (zero_sharding, arXiv 2004.13336) is the
+    # variable under test. The default stays the FSDP headline config.
+    mesh_axis = getattr(args, "mesh_axis", "fsdp")
+    mesh = make_mesh({mesh_axis: len(jax.devices())})
+    zero_sharding = getattr(args, "zero_sharding", True)
     b = args.batch_size or 8
     seq = args.seq or 1024
     # model_scale="tiny" swaps in the smoke-test decoder so the WHOLE
@@ -326,12 +333,21 @@ def bench_llama1b(args):
     psh = llama_param_shardings(params, mesh)
     params = jax.tree.map(jax.device_put, params, psh)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
-    state = TrainState.create(params, tx)
+    # shard_state (not bare create): commits the optimizer tree to the
+    # layout-table shardings — with zero_sharding on, the Adam moments
+    # land data-partitioned at init instead of being resharded by the
+    # first jitted step
+    from tensorflowonspark_tpu.compute import shard_state
+
+    state = shard_state(
+        TrainState.create(params, tx), mesh, psh, zero_sharding=zero_sharding
+    )
     token_loss = llama_loss_fn(
         model, logit_chunk=getattr(args, "logit_chunk", None)
     )
     step = build_train_step(
-        lambda p, bt: token_loss(p, bt["tokens"]), tx, mesh, param_shardings=psh
+        lambda p, bt: token_loss(p, bt["tokens"]), tx, mesh,
+        param_shardings=psh, zero_sharding=zero_sharding,
     )
     batch = {
         "tokens": rng.integers(0, cfg.vocab_size, size=(b, seq + 1)).astype(
@@ -341,7 +357,7 @@ def bench_llama1b(args):
     make_batch = lambda: shard_batch(mesh, batch)
     with use_mesh(mesh):
         state, dt, loss = _bench_step(step, state, make_batch, args.steps)
-    return dict(
+    res = dict(
         examples=b,
         dt=dt,
         loss=loss,
@@ -349,6 +365,129 @@ def bench_llama1b(args):
         n_params=n_params,
         tokens=b * seq,
     )
+    if getattr(args, "params_digest", False):
+        res["params_digest"] = _params_digest(state.params)
+    if getattr(args, "measure_update", False):
+        # LAST: the update-only timing loop donates `state`
+        res["weight_update_ms"] = _time_weight_update(
+            tx, mesh, psh, state, zero_sharding, args.steps
+        )
+    return res
+
+
+def _params_digest(params) -> str:
+    """sha256 over the host bytes of every param leaf, in tree-leaf
+    order — the byte-identity currency of the --zero A/B gates."""
+    import hashlib
+
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(params)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _time_weight_update(tx, mesh, psh, state, zero_sharding, steps):
+    """Isolated optimizer-update time (ms/step): the weight update alone
+    against fixed pre-placed gradients (each step consumes the previous
+    step's donated state, so the chain serializes; one host fetch at the
+    end is the timing barrier) — the 'optimizer-span ms' column of the
+    bench.py --zero A/B artifact.
+    Also feeds the train_weight_update_seconds histogram +
+    train.weight_update span via build_update_step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.compute import (
+        build_update_step,
+        zero_update_shardings,
+    )
+
+    upd = build_update_step(
+        tx, mesh, param_shardings=psh, zero_sharding=zero_sharding
+    )
+    gsh = zero_update_shardings(state.params, mesh, psh) if zero_sharding else psh
+    grads = jax.tree.map(
+        lambda p, s: jax.device_put(
+            jnp.full(p.shape, 1e-4, jnp.float32), s
+        ),
+        state.params,
+        gsh,
+    )
+    state = upd(state, grads)  # compile + warm
+    state = upd(state, grads)
+    np.asarray(state.step)  # barrier
+    n = max(2, int(steps))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state = upd(state, grads)
+    np.asarray(state.step)  # host fetch: the honest end-of-work barrier
+    return round((time.perf_counter() - t0) / n * 1e3, 3)
+
+
+def update_ab_digests(ns, k: int = 4):
+    """Byte-identity probe for the bench.py --zero smoke gate: K
+    IDENTICAL-gradient weight updates through the ZeRO-sharded and the
+    replicated update step, from the same initial state; returns the
+    two final-param sha256 digests. The sharded Adam/decay/lr
+    arithmetic is elementwise per leaf, so the cross-replica
+    decomposition must be byte-exact here — unlike the full train legs,
+    whose gradient REDUCTION order legitimately differs
+    (reduce-scatter vs all-reduce summation grouping, ~1 ulp on the
+    embedding grad after a few steps)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.compute import (
+        TrainState,
+        build_update_step,
+        shard_state,
+        zero_update_shardings,
+    )
+    from tensorflowonspark_tpu.compute import optim
+    from tensorflowonspark_tpu.compute.mesh import make_mesh
+    from tensorflowonspark_tpu.models.llama import (
+        Llama,
+        LlamaConfig,
+        llama_param_shardings,
+    )
+    from tensorflowonspark_tpu.parallel import use_mesh
+
+    mesh = make_mesh({getattr(ns, "mesh_axis", "data"): len(jax.devices())})
+    scale = getattr(ns, "model_scale", "tiny")
+    make_cfg = LlamaConfig.tiny if scale == "tiny" else LlamaConfig.llama_1b
+    cfg = make_cfg(max_seq_len=ns.seq, remat=False)
+    model = Llama(cfg)
+    with use_mesh(mesh):
+        params = model.init(
+            jax.random.PRNGKey(0), np.zeros((2, ns.seq), np.int32)
+        )["params"]
+    tx = optim.adamw(1e-4, moment_dtype=jnp.bfloat16)
+    psh = llama_param_shardings(params, mesh)
+    rng = np.random.default_rng(7)
+    grads_host = jax.tree.map(
+        lambda p: (rng.standard_normal(p.shape) * 1e-2).astype(np.float32),
+        params,
+    )
+    digests = {}
+    for zero in (True, False):
+        state = shard_state(
+            TrainState.create(jax.tree.map(jnp.array, params), tx),
+            mesh, psh, zero_sharding=zero,
+        )
+        gsh = zero_update_shardings(params, mesh, psh) if zero else psh
+        grads = jax.tree.map(jax.device_put, grads_host, gsh)
+        upd = build_update_step(
+            tx, mesh, param_shardings=psh, zero_sharding=zero
+        )
+        for _ in range(k):
+            state = upd(state, grads)
+        digests["on" if zero else "off"] = _params_digest(state.params)
+    return digests
 
 
 def _llama1b_decode_setup(args, prompt_len: int | None = None):

@@ -81,6 +81,14 @@ run 600 python benchmarks/pallas_bn_smoke.py
 run 1200 python benchmarks/real_chip.py --config resnet50 \
   --profile "${PROFILE_DIR:-/tmp/resnet50_pallasbn_profile}"
 
+# 10. ZeRO cross-replica weight update A/B (ISSUE 14): zero_sharding
+#     on vs off at fixed batch, committing
+#     benchmarks/results/zero_weight_update.json (step_time_ms, MFU,
+#     optimizer-span ms per leg). NOTE single-chip expectation: data=1
+#     makes the partition inert — this leg documents "off reproduces
+#     current numbers"; the span win needs a multi-chip pod.
+run 900 python bench.py --zero
+
 # 3'. Inception-v3 with Pallas-BN. LAST: its fused-BN compile is the
 #     suspected wedge of both the round-3 and round-4 windows.
 run 1800 python benchmarks/real_chip.py --config inception_v3

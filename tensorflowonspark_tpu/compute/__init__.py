@@ -16,6 +16,7 @@ from tensorflowonspark_tpu.compute.layout import (
     LAYOUT_TABLES,
     SpecLayout,
     get_layout,
+    optimizer_state_spec,
     param_shardings,
 )
 from tensorflowonspark_tpu.compute.mesh import (
@@ -33,9 +34,11 @@ from tensorflowonspark_tpu.compute.train import (
     TrainState,
     build_train_step,
     build_eval_step,
+    build_update_step,
     fsdp_shardings,
     shard_state,
     state_shardings,
+    zero_update_shardings,
 )
 
 __all__ = [
@@ -43,6 +46,7 @@ __all__ = [
     "MESH_AXES",
     "SpecLayout",
     "get_layout",
+    "optimizer_state_spec",
     "param_shardings",
     "ElasticTrainer",
     "host_snapshot",
@@ -54,9 +58,11 @@ __all__ = [
     "TrainState",
     "build_train_step",
     "build_eval_step",
+    "build_update_step",
     "fsdp_shardings",
     "shard_state",
     "state_shardings",
+    "zero_update_shardings",
     "adamw",
     "mixed_precision_adamw",
 ]

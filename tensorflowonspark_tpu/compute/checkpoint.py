@@ -5,6 +5,15 @@ TF (``ModelCheckpoint``/``BackupAndRestore``) and contributed pathing plus a
 chief-only export convention. Here orbax gives async + sharded checkpoints;
 the chief-writes convention is enforced by the caller
 (``TFNodeContext.export_saved_model``).
+
+Sharded-state contract: save/restore is placement-agnostic — a
+ZeRO-partitioned optimizer tree (Adam moments / mixed-precision masters
+data-axis sharded per ``LAYOUT_TABLES['optimizer']``) round-trips
+byte-identically, with restore committing each array to the TARGET's
+sharding (so restoring into a ``shard_state(..., zero_sharding=...)``
+target reproduces either knob setting's placement regardless of which
+one wrote the checkpoint). Pinned by tests/test_elastic.py's orbax
+round-trip of a ZeRO-sharded TrainState.
 """
 
 from __future__ import annotations

@@ -227,12 +227,17 @@ def default_shardings_fn(state: Any, mesh) -> Any:
     """Shardings for a (re-formed) mesh: FSDP over params via
     :func:`~tensorflowonspark_tpu.compute.train.fsdp_shardings` (the
     layout table's generic shape-driven rule), the optimizer tree
-    mirrored, scalars replicated — the same axis rules training started
-    with, re-derived for the new device count. Model-table consumers
-    pass ``shardings_fn=lambda s, m: state_shardings(s, m,
+    mirrored — ZeRO data-axis partitioned by ``state_shardings``'s
+    default, so a reconfigure re-derives the same cross-replica weight
+    update layout training ran with, for the NEW device count — and
+    scalars replicated. Model-table consumers pass
+    ``shardings_fn=lambda s, m: state_shardings(s, m,
     layout.param_shardings(s.params, m, "<table>"))`` instead; either
-    way the reshard round-trip is byte-identical and its shardcheck
-    collective census is stable (tests/test_layout.py)."""
+    way the reshard round-trip is byte-identical (values never change,
+    only placement — ``reshard_state`` moves bytes through host memory)
+    and its shardcheck collective census is stable
+    (tests/test_layout.py, incl. the ZeRO-partitioned moments and
+    mixed-precision masters)."""
     from tensorflowonspark_tpu.compute.train import (
         fsdp_shardings,
         state_shardings,

@@ -47,6 +47,7 @@ __all__ = [
     "DECODE_CACHE_SPECS",
     "LAYOUT_TABLES",
     "MESH_AXES",
+    "OPTIMIZER_PARAM_STATE_PATTERN",
     "SERVE_CACHE_SPECS",
     "SpecLayout",
     "activation_sharding",
@@ -59,6 +60,8 @@ __all__ = [
     "fsdp_leaf_sharding",
     "fsdp_leaf_spec",
     "get_layout",
+    "optimizer_state_sharding",
+    "optimizer_state_spec",
     "param_shardings",
     "replicated",
     "serve_cache_sharding",
@@ -205,7 +208,39 @@ LAYOUT_TABLES = {
         {"pattern": r".*", "ndim": 3, "spec": ("expert", "fsdp", "model")},
         {"pattern": r".*", "spec": ()},
     ),
+    # Optimizer-state rules — the ZeRO-style cross-replica weight-update
+    # partition (PAPERS.md, arXiv 2004.13336). Patterns match the
+    # '/'-joined opt-state field path PREFIXED to the param path (an
+    # Adam moment for a wrapped kernel reads '0/mu/layer0/attn/q_proj/
+    # kernel'); unlike the model tables above, a matching rule's spec is
+    # MERGED onto the param leaf's own table spec dim-by-dim by
+    # :func:`optimizer_state_spec` — the rule names the EXTRA axes the
+    # state leaf shards over, not its full layout. Per-param state
+    # (Adam moments mu/nu, mixed-precision fp32 masters, SGD momentum
+    # traces — and the in-step gradient 'update' tensors feeding them)
+    # additionally partitions its leading dim over the 'data' replica
+    # axis, so the weight update computes on 1/data_extent of each leaf
+    # instead of redundantly on every replica; 'drop_or_unit' keeps the
+    # existing divisibility semantics — an indivisible (or data=1) leaf
+    # drops back to mirroring its param. Scalars (Adam's bias-correction
+    # 'count') and any undeclared field mirror/replicate unchanged.
+    "optimizer": (
+        {"pattern": r".*", "max_ndim": 0, "spec": ()},
+        {"pattern": r"(^|/)(mu|nu|master|trace|momentum|update)(/|$)",
+         "spec": ("data",), "divisible": "drop_or_unit"},
+        {"pattern": r".*", "spec": ()},
+    ),
 }
+
+# The optimizer table's per-param-state field pattern, re-declared for
+# consumers that need the ROLE without a shape (train.state_shardings'
+# explicit mirror-vs-replicate resolution). MUST stay textually equal to
+# the 'optimizer' table rule above (tests/test_layout.py pins them; the
+# table itself must stay a pure literal for the AST analyzer, so the
+# string is duplicated rather than referenced).
+OPTIMIZER_PARAM_STATE_PATTERN = (
+    r"(^|/)(mu|nu|master|trace|momentum|update)(/|$)"
+)
 
 # Activation / host-IO placements, by role.
 ACTIVATION_SPECS = {
@@ -348,6 +383,100 @@ def get_layout(name: str) -> SpecLayout:
             ) from None
         layout = _LAYOUTS[name] = SpecLayout(name, rules)
     return layout
+
+
+def _dim_axes(entry) -> tuple:
+    """One spec dim entry as a flat tuple of axis names."""
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
+def optimizer_state_spec(
+    path_name: str,
+    shape: tuple,
+    base_spec,
+    axis_sizes: Mapping[str, int] | None = None,
+) -> P:
+    """PartitionSpec for one optimizer-state leaf: the 'optimizer'
+    table's first matching rule MERGED onto the leaf's mirrored param
+    spec (``base_spec``) dim-by-dim.
+
+    A rule dim naming an axis prepends that axis to the base dim's axis
+    set; under ``drop_or_unit`` the axis is kept only when its extent is
+    > 1 and the dim size divides the COMBINED extent (new axis × the
+    base spec's axes on that dim) — otherwise the dim falls back to the
+    mirrored base, which is exactly the drop-to-replicated-across-data
+    contract for indivisible leaves. ``base_spec`` of ``P()`` (a
+    replicated param, pure-DP training) makes the merge a plain
+    data-axis partition — the arXiv 2004.13336 setting.
+    """
+    axis_sizes = axis_sizes or {}
+    base = tuple(base_spec)
+    ndim = len(shape)
+    for pat, spec, r_ndim, r_max, divisible in get_layout("optimizer")._rules:
+        if r_ndim is not None and ndim != r_ndim:
+            continue
+        if r_max is not None and ndim > r_max:
+            continue
+        if not pat.search(path_name):
+            continue
+        out = []
+        fell_through = False
+        changed = False
+        for d in range(ndim):
+            base_entry = base[d] if d < len(base) else None
+            add = spec[d] if d < len(spec) else None
+            base_axes = _dim_axes(base_entry)
+            if add is None or add in base_axes:
+                out.append(base_entry)
+                continue
+            add_extent = _axis_extent(axis_sizes, add)
+            combined = add_extent * _axis_extent(
+                axis_sizes, base_axes or None
+            )
+            divides = combined > 0 and shape[d] % combined == 0
+            if divisible == "drop_or_unit":
+                if add_extent <= 1 or not divides:
+                    out.append(base_entry)
+                    continue
+            elif divisible == "require":
+                if not divides:
+                    fell_through = True
+                    break
+            # 'strict': divisibility is the caller's contract
+            out.append((add, *base_axes) if base_axes else add)
+            changed = True
+        if fell_through:
+            continue
+        if not changed:
+            # nothing merged: return the base VERBATIM (trailing Nones
+            # and all), so a fully-dropped leaf compares equal to its
+            # mirrored param spec — consumers no-op on that equality
+            return P(*base)
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+    raise ValueError(
+        f"optimizer layout table has no rule for {path_name!r} "
+        f"(shape {shape}); tables must end in a catch-all"
+    )
+
+
+def optimizer_state_sharding(
+    mesh: Mesh,
+    path_name: str,
+    shape: tuple,
+    base_spec,
+) -> NamedSharding:
+    return NamedSharding(
+        mesh,
+        optimizer_state_spec(
+            path_name, tuple(shape), base_spec, dict(mesh.shape)
+        ),
+    )
 
 
 def _path_name(path) -> str:

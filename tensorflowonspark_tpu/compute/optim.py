@@ -24,6 +24,20 @@ Two transformations, both optax-compatible:
   Updates are applied to the master; params are exactly
   ``master.astype(param_dtype)`` every step, so tiny updates accumulate
   in fp32 instead of vanishing into bf16 round-off.
+
+Sharding contract (the ZeRO cross-replica weight update, arXiv
+2004.13336): both optimizers' per-param state is partitionable along
+the ``'data'`` replica axis — every update is ELEMENTWISE per leaf
+(moment EMAs, bias correction by the replicated scalar ``count``,
+decoupled weight decay, the master delta), so GSPMD computes it on a
+1/N shard and the result is byte-identical to the replicated
+computation. The state FIELD NAMES are load-bearing: ``mu``/``nu``/
+``master`` (and the scalar ``count``) are what
+``LAYOUT_TABLES['optimizer']`` (compute/layout.py) keys the
+data-partition and replication rules on, and what
+``train.state_shardings`` resolves explicitly — rename a field and the
+layout silently degrades to replicated, so tests/test_layout.py pins
+the pattern and tests/test_compute.py the resolution.
 """
 
 from __future__ import annotations

@@ -9,6 +9,15 @@ with one mechanism: ``jax.jit`` over a mesh with ``NamedSharding``.
 - FSDP = additionally shard params/optimizer state on ``'fsdp'`` — the
   sharded-state role the reference's parameter servers played, without the
   asymmetric-role processes.
+- ZeRO (``zero_sharding=True``, the default) = additionally partition
+  the optimizer state and the weight update across the ``'data'``
+  replica axis (arXiv 2004.13336, the PAPERS.md recipe): the gradient
+  mean's psum lowers to a reduce-scatter the scheduler overlaps into
+  the backward, the Adam/master update computes on 1/N of every leaf,
+  and one all-gather republishes the updated params. The layout is
+  derived from ``LAYOUT_TABLES['optimizer']``
+  (:func:`layout.optimizer_state_spec`), never hand-built here; the
+  replicated path stays available as ``zero_sharding=False`` for A/B.
 
 Adding TP/SP later is a sharding-rule change, not a rewrite (the mesh
 already carries ``model``/``seq`` axes).
@@ -16,6 +25,8 @@ already carries ``model``/``seq`` axes).
 
 from __future__ import annotations
 
+import re
+import time
 from typing import Any, Callable
 
 import jax
@@ -28,6 +39,16 @@ from jax.sharding import Mesh, NamedSharding
 from tensorflowonspark_tpu.compute import layout as _layout
 from tensorflowonspark_tpu.compute.mesh import batch_sharding, replicated
 from tensorflowonspark_tpu.obs import spans as obs_spans
+
+# The layout table's declared per-param optimizer-state roles (Adam
+# moments, masters, momentum traces): the EXPLICIT resolution
+# state_shardings uses instead of shape-coincidence guessing.
+_PER_PARAM_STATE_RE = re.compile(_layout.OPTIMIZER_PARAM_STATE_PATTERN)
+
+# The named scope grouping the optimizer's device ops in traces.
+# obs/trace_report.py's 'weight_update' classifier keys on this literal
+# (lockstep-pinned by tests/test_obs.py).
+WEIGHT_UPDATE_SCOPE = "train.weight_update"
 
 
 @struct.dataclass
@@ -76,50 +97,113 @@ def fsdp_shardings(
     return jax.tree.map(rule, params)
 
 
-def state_shardings(state: TrainState, mesh: Mesh, param_shardings: Any) -> TrainState:
-    """Shardings for a full TrainState.
+def state_shardings(
+    state: TrainState,
+    mesh: Mesh,
+    param_shardings: Any,
+    zero_sharding: bool = True,
+) -> TrainState:
+    """Shardings for a full TrainState, derived from the layout table's
+    optimizer-state rules (``LAYOUT_TABLES['optimizer']``).
 
-    Optimizer-state subtrees that structurally mirror the param tree (Adam
-    moments, momentum, etc.) reuse the param shardings position-for-
-    position; everything else (step counts, scalars) is replicated.
+    Optimizer-state subtrees that structurally mirror the param tree
+    (Adam moments, momentum traces, mixed-precision masters) reuse the
+    param shardings position-for-position; with ``zero_sharding=True``
+    (the default) the per-param state fields the table declares
+    additionally partition over the ``'data'`` replica axis — the
+    ZeRO-style cross-replica weight update (arXiv 2004.13336) — with
+    the table's divisibility semantics dropping indivisible leaves back
+    to the mirrored spec. Scalars and undeclared fields replicate.
+
+    Resolution is EXPLICIT: whether a subtree mirrors the param tree is
+    decided by tree structure, and — for the one-leaf param tree where
+    ANY lone array matches structurally (e.g. Adam's scalar ``count``)
+    — by the field's declared role in the table, not by the old
+    shape-coincidence special case.
     """
     params_treedef = jax.tree.structure(state.params)
-    single_param = params_treedef.num_leaves == 1
-    param_leaf_shapes = [np.shape(p) for p in jax.tree.leaves(state.params)]
+    multi_leaf = params_treedef.num_leaves > 1
 
-    def mirrors_params(node) -> bool:
+    def mirrors_params(node, path: str) -> bool:
         if jax.tree.structure(node) != params_treedef:
             return False
-        if single_param:
-            # A one-leaf treedef matches any lone array (e.g. Adam's
-            # `count` scalar); require the shape to match too.
-            return [np.shape(x) for x in jax.tree.leaves(node)] == param_leaf_shapes
-        return True
+        if multi_leaf:
+            return True
+        return bool(_PER_PARAM_STATE_RE.search(path))
 
-    def rec(node):
-        if mirrors_params(node):
-            return param_shardings
+    def mirrored(node, path: str):
+        def leaf_rule(ppath, psh, leaf) -> NamedSharding:
+            if not zero_sharding:
+                return psh
+            name = _layout._path_name(ppath)
+            return _layout.optimizer_state_sharding(
+                mesh,
+                f"{path}/{name}" if name else path,
+                np.shape(leaf),
+                psh.spec,
+            )
+
+        return jax.tree_util.tree_map_with_path(
+            leaf_rule, param_shardings, node
+        )
+
+    def rec(node, path: str):
+        if mirrors_params(node, path):
+            return mirrored(node, path)
         if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
-            return type(node)(*(rec(c) for c in node))
+            return type(node)(*(
+                rec(getattr(node, f), f"{path}/{f}" if path else f)
+                for f in node._fields
+            ))
         if isinstance(node, (tuple, list)):
-            return type(node)(rec(c) for c in node)
+            return type(node)(
+                rec(c, f"{path}/{i}" if path else str(i))
+                for i, c in enumerate(node)
+            )
         if isinstance(node, dict):
-            return {k: rec(v) for k, v in node.items()}
+            return {
+                k: rec(v, f"{path}/{k}" if path else str(k))
+                for k, v in node.items()
+            }
         return jax.tree.map(lambda _: replicated(mesh), node)
 
     return TrainState(
         step=replicated(mesh),
         params=param_shardings,
-        opt_state=rec(state.opt_state),
+        opt_state=rec(state.opt_state, ""),
     )
 
 
+def zero_update_shardings(
+    params: Any, mesh: Mesh, param_shardings: Any
+) -> Any:
+    """NamedShardings for a param-shaped UPDATE tree (gradients,
+    optimizer deltas) under the layout table's ZeRO rules: each leaf's
+    param spec plus the ``'data'`` partition where divisible. This is
+    the sharding the gradient reduce-scatters INTO and the sharded Adam
+    update computes in."""
+
+    def rule(path, p, psh) -> NamedSharding:
+        return _layout.optimizer_state_sharding(
+            mesh,
+            "update/" + _layout._path_name(path),
+            np.shape(p),
+            psh.spec,
+        )
+
+    return jax.tree_util.tree_map_with_path(rule, params, param_shardings)
+
+
 def shard_state(
-    state: TrainState, mesh: Mesh, param_shardings: Any
+    state: TrainState,
+    mesh: Mesh,
+    param_shardings: Any,
+    zero_sharding: bool = True,
 ) -> TrainState:
     """Commit every leaf of ``state`` to its mesh sharding: params to
     ``param_shardings``, optimizer subtrees that mirror the param tree
-    likewise, scalars (step, Adam count) replicated.
+    likewise (ZeRO data-axis partitioned by default — see
+    :func:`state_shardings`), scalars (step, Adam count) replicated.
 
     Create train state as ``shard_state(TrainState.create(p, tx), mesh,
     psh)`` whenever it will be checkpointed: orbax restores each array to
@@ -130,7 +214,9 @@ def shard_state(
     multi-controller FSDP instead of implicitly resharding.
     """
     return jax.tree.map(
-        jax.device_put, state, state_shardings(state, mesh, param_shardings)
+        jax.device_put,
+        state,
+        state_shardings(state, mesh, param_shardings, zero_sharding),
     )
 
 
@@ -142,6 +228,7 @@ def build_train_step(
     donate: bool = True,
     accum_steps: int = 1,
     batch_weight_fn: Callable[[Any], jax.Array] | None = None,
+    zero_sharding: bool = True,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Compile ``(state, batch) -> (state, loss)`` with mesh shardings.
 
@@ -149,6 +236,20 @@ def build_train_step(
     batch; since the batch is sharded over ``('data','fsdp')``, XLA lowers
     the mean's reduction to a psum over ICI — the entire gradient-sync
     machinery the reference delegated to NCCL/PS.
+
+    ``zero_sharding`` (default True) turns that psum into the ZeRO
+    decomposition where the mesh has a ``'data'`` axis wider than 1:
+    gradients reduce-scatter into the layout table's data-partitioned
+    update layout (overlappable with the backward), the optimizer state
+    lives and updates in the same partition, and the updated params
+    all-gather back to their table shardings. ``zero_sharding=False``
+    is the replicated-optimizer escape hatch for A/B: the weight-update
+    decomposition itself is elementwise, hence byte-identical across
+    knobs on identical gradients (``bench.py --zero``'s smoke gate pins
+    this); the full train paths agree to reduction-order tolerance
+    (reduce-scatter vs all-reduce summation grouping, ~1 ulp). State
+    committed with :func:`shard_state` should use the SAME knob value
+    (a mismatched state is re-committed once at the first call).
 
     ``accum_steps > 1`` runs gradient accumulation: the batch's leading
     dim splits into that many microbatches, a ``lax.scan`` accumulates
@@ -170,22 +271,6 @@ def build_train_step(
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
-    step = make_step_fn(
-        loss_fn,
-        tx,
-        mesh,
-        accum_steps=accum_steps,
-        batch_weight_fn=batch_weight_fn,
-    )
-
-    def jit_with(state_sh):
-        return jax.jit(
-            step,
-            in_shardings=(state_sh, batch_sharding(mesh)),
-            out_shardings=(state_sh, replicated(mesh)),
-            donate_argnums=(0,) if donate else (),
-        )
-
     compiled: dict[str, Any] = {}
 
     def wrapped(state: TrainState, batch):
@@ -195,7 +280,29 @@ def build_train_step(
                 if param_shardings is not None
                 else jax.tree.map(lambda _: replicated(mesh), state.params)
             )
-            compiled["fn"] = jit_with(state_shardings(state, mesh, psh))
+            step = make_step_fn(
+                loss_fn,
+                tx,
+                mesh,
+                accum_steps=accum_steps,
+                batch_weight_fn=batch_weight_fn,
+                param_shardings=psh,
+                zero_sharding=zero_sharding,
+            )
+            state_sh = state_shardings(state, mesh, psh, zero_sharding)
+            compiled["fn"] = jax.jit(
+                step,
+                in_shardings=(state_sh, batch_sharding(mesh)),
+                out_shardings=(state_sh, replicated(mesh)),
+                donate_argnums=(0,) if donate else (),
+            )
+            # First-call commit: a state built without shard_state
+            # (moments inherit the PARAM placement via zeros_like)
+            # arrives committed off the ZeRO layout, which explicit
+            # in_shardings reject rather than silently reshard.
+            # device_put is a no-op for already-matching leaves, and
+            # every subsequent step's input is this step's output.
+            state = jax.tree.map(jax.device_put, state, state_sh)
         # Host-side step span (obs/): measures DISPATCH time — jit
         # returns as soon as the computation is enqueued, so the
         # data-wait vs step split reads as "host blocked here" only
@@ -216,6 +323,8 @@ def make_step_fn(
     mesh: Mesh,
     accum_steps: int = 1,
     batch_weight_fn: Callable[[Any], jax.Array] | None = None,
+    param_shardings: Any | None = None,
+    zero_sharding: bool = True,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """The UNJITTED ``(state, batch) -> (state, loss)`` train step.
 
@@ -224,13 +333,47 @@ def make_step_fn(
     devices) to census the collectives the layout table implies — both
     consumers must see the SAME program, which is why this is one
     function and not two copies.
+
+    With ``zero_sharding`` on (and ``param_shardings`` given, on a mesh
+    whose ``'data'`` axis is wider than 1) the gradient tree is pinned
+    to the layout table's data-partitioned update layout before the
+    optimizer update: GSPMD then lowers the grad mean's psum to a
+    reduce-scatter (which the latency-hiding scheduler overlaps into
+    the backward), the Adam/master arithmetic runs on the shard, and
+    the updated params all-gather back to their own shardings — the
+    arXiv 2004.13336 dataflow. The optimizer arithmetic itself is
+    grouped under a ``train.weight_update`` ``jax.named_scope`` so
+    device traces attribute its ops (``obs.trace_report``'s
+    ``weight_update`` category).
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
+    zero_on = (
+        zero_sharding
+        and param_shardings is not None
+        and dict(mesh.shape).get("data", 1) > 1
+    )
+
+    def scatter(tree):
+        """Pin a param-shaped gradient/carry tree to the ZeRO update
+        layout (a no-op leaf-wise where the table dropped the data
+        axis, and entirely when the knob is off)."""
+        if not zero_on:
+            return tree
+        shardings = zero_update_shardings(tree, mesh, param_shardings)
+
+        def pin(g, sh, psh):
+            if sh.spec == psh.spec:
+                return g  # dropped-to-mirrored leaf: nothing to add
+            return jax.lax.with_sharding_constraint(g, sh)
+
+        return jax.tree.map(pin, tree, shardings, param_shardings)
+
     def grads_of(state: TrainState, batch):
         if accum_steps == 1:
-            return jax.value_and_grad(loss_fn)(state.params, batch)
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+            return loss, scatter(grads)
 
         dp_extent = mesh.shape["data"] * mesh.shape["fsdp"]
 
@@ -255,14 +398,19 @@ def make_step_fn(
 
         micro = jax.tree.map(split, batch)
         # fp32 carry regardless of param dtype: summing bf16 gradient
-        # trees would round at each add; optax updates widen anyway
-        zeros = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        # trees would round at each add; optax updates widen anyway.
+        # Under ZeRO the carry lives scattered too: each microbatch's
+        # reduce lands as a reduce-scatter accumulated into the shard.
+        zeros = scatter(
+            jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
         )
 
         def body(carry, mb):
             loss_sum, grad_sum, w_sum = carry
             loss, grads = jax.value_and_grad(loss_fn)(state.params, mb)
+            grads = scatter(grads)
             w = (
                 jnp.ones((), jnp.float32)
                 if batch_weight_fn is None
@@ -296,16 +444,87 @@ def make_step_fn(
 
         with use_mesh(mesh):
             loss, grads = grads_of(state, batch)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        return (
-            TrainState(
-                step=state.step + 1, params=new_params, opt_state=new_opt
-            ),
-            loss,
-        )
+        return _apply_weight_update(tx, state, grads), loss
 
     return step
+
+
+def _apply_weight_update(
+    tx: optax.GradientTransformation, state: TrainState, grads
+) -> TrainState:
+    """The optimizer apply shared by :func:`make_step_fn` and
+    :func:`build_update_step` — ONE implementation, so the isolated
+    A/B span (bench.py --zero) measures exactly what the train step
+    runs, under the named scope device traces attribute
+    (obs.trace_report's ``weight_update`` category — the before/after
+    evidence for the ZeRO A/B)."""
+    with jax.named_scope(WEIGHT_UPDATE_SCOPE):
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+    return TrainState(
+        step=state.step + 1, params=new_params, opt_state=new_opt
+    )
+
+
+def build_update_step(
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    param_shardings: Any | None = None,
+    zero_sharding: bool = True,
+    donate: bool = True,
+) -> Callable[[TrainState, Any], TrainState]:
+    """Compile ``(state, grads) -> state`` — the weight update ALONE.
+
+    Same shardings/donation discipline as :func:`build_train_step`
+    (gradients arrive in the ZeRO update layout when the knob is on),
+    so the optimizer fraction of step time is measurable in isolation:
+    the ``bench.py --zero`` A/B leg times this against fixed gradients.
+    Every call runs under a ``train.weight_update`` span and is
+    observed into the ``train_weight_update_seconds`` histogram; like
+    ``train.step`` the span measures DISPATCH — callers timing the
+    device must barrier on a fetched leaf.
+    """
+    from tensorflowonspark_tpu.obs.registry import default_registry
+
+    hist = default_registry().histogram(
+        "train_weight_update_seconds",
+        "wall seconds per optimizer weight-update dispatch",
+    )
+
+    def update(state: TrainState, grads) -> TrainState:
+        return _apply_weight_update(tx, state, grads)
+
+    compiled: dict[str, Any] = {}
+
+    def wrapped(state: TrainState, grads) -> TrainState:
+        if "fn" not in compiled:
+            psh = (
+                param_shardings
+                if param_shardings is not None
+                else jax.tree.map(lambda _: replicated(mesh), state.params)
+            )
+            state_sh = state_shardings(state, mesh, psh, zero_sharding)
+            grad_sh = (
+                zero_update_shardings(state.params, mesh, psh)
+                if zero_sharding
+                else psh
+            )
+            compiled["fn"] = jax.jit(
+                update,
+                in_shardings=(state_sh, grad_sh),
+                out_shardings=state_sh,
+                donate_argnums=(0,) if donate else (),
+            )
+            # same first-call commit as build_train_step: accept states
+            # built without shard_state
+            state = jax.tree.map(jax.device_put, state, state_sh)
+        t0 = time.perf_counter()
+        with obs_spans.span(WEIGHT_UPDATE_SCOPE):
+            out = compiled["fn"](state, grads)
+        hist.observe(time.perf_counter() - t0)
+        return out
+
+    return wrapped
 
 
 def build_eval_step(

@@ -56,10 +56,21 @@ __all__ = [
 # names as they appear in trace event names (``fusion.123``,
 # ``%dot.45``, ``copy-start``, ``all-reduce.7``, ``infeed`` ...); the
 # first matching category wins, so transfer/copy names are tested
-# before the broad vector fallback.
-CATEGORIES = ("mxu", "vector", "copy", "infeed", "collective", "host")
+# before the broad vector fallback. ``weight_update`` is tested first
+# of all: ops lowered under the train step's
+# ``jax.named_scope("train.weight_update")`` (the optimizer update —
+# Adam moments, masters, and the ZeRO reduce-scatter/all-gather pair)
+# carry the scope in their metadata-derived names, and the optimizer
+# fraction of step time is exactly what the ``bench.py --zero`` A/B
+# reads out of a committed ``*_trace_report.json``.
+CATEGORIES = (
+    "weight_update", "mxu", "vector", "copy", "infeed", "collective",
+    "host",
+)
 
 _PATTERNS = (
+    # the train step's optimizer scope (see compute/train.make_step_fn)
+    ("weight_update", re.compile(r"train\.weight_update", re.I)),
     # device-to-device / host-device data movement and layout changes
     ("infeed", re.compile(
         r"infeed|outfeed|host-to-device|device-to-host|"
@@ -264,6 +275,13 @@ def attribution(
         "host_total_us": int(host_total),
         "mxu_fraction": (
             round(cat_us.get("mxu", 0) / device_total, 4)
+            if device_total
+            else 0.0
+        ),
+        # the optimizer fraction of device time — the number the ZeRO
+        # cross-replica weight update (bench.py --zero) exists to shrink
+        "weight_update_fraction": (
+            round(cat_us.get("weight_update", 0) / device_total, 4)
             if device_total
             else 0.0
         ),

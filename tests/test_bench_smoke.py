@@ -88,6 +88,47 @@ def test_bench_serve_smoke_emits_engine_tax():
     assert os.path.exists(os.path.join(REPO, out["trace_report"]))
 
 
+def test_bench_zero_smoke_ab_and_byte_identity():
+    """bench.py --zero end-to-end on the tiny model: both knob legs run
+    on a pure data-parallel mesh, the isolated optimizer span is
+    measured per leg, the weight-update decomposition is BYTE-IDENTICAL
+    across knobs on identical gradients (the ZeRO math owns nothing but
+    placement), and the A/B artifact is committed."""
+    env = dict(
+        os.environ,
+        BENCH_SMOKE="1",
+        BENCH_ALLOW_CPU="1",
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PALLAS_AXON_REMOTE_COMPILE="",
+    )
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--zero"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "zero_weight_update"
+    assert out["smoke"] is True
+    for leg in ("zero_on", "zero_off"):
+        assert out[leg]["step_time_ms"] > 0
+        assert out[leg]["weight_update_ms"] > 0
+    # same loss to the reported precision on both legs
+    assert out["zero_on"]["final_loss"] == out["zero_off"]["final_loss"]
+    # the byte-identity gate: identical grads through the sharded vs
+    # replicated weight update -> identical params, bit for bit
+    assert out["update_params_match"] is True
+    art = os.path.join(REPO, out["artifact"])
+    assert os.path.exists(art)
+    on_disk = json.load(open(art))
+    assert on_disk["metric"] == "zero_weight_update"
+    assert on_disk["update_params_match"] is True
+
+
 def test_bench_relay_gate_fails_fast_when_relay_down():
     """With the relay marker present and no ports listening, bench must
     emit a distinct relay_unreachable line in seconds, exit 3."""

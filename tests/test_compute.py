@@ -103,9 +103,11 @@ def test_train_step_fsdp(mesh8):
     assert losses[-1] < losses[0]  # it learns
     # params remained sharded on fsdp axis
     assert state.params["w1"].sharding.spec == P(None, "fsdp")
-    # adam moments follow the param shardings
+    # adam moments follow the param shardings PLUS the default ZeRO
+    # data-axis partition on their divisible leading dim (mesh8 carries
+    # data=2: 8 % 2 == 0)
     mu = state.opt_state[0].mu
-    assert mu["w1"].sharding.spec == P(None, "fsdp")
+    assert mu["w1"].sharding.spec == P("data", "fsdp")
 
 
 def test_state_shardings_structural(mesh8):
@@ -116,13 +118,135 @@ def test_state_shardings_structural(mesh8):
         "a": NamedSharding(mesh8, P("fsdp", None)),
         "b": NamedSharding(mesh8, P(None, "fsdp")),
     }
+    # the replicated-optimizer escape hatch: moments mirror their own
+    # param position-for-position, nothing else
+    ssh_off = state_shardings(state, mesh8, psh, zero_sharding=False)
+    assert ssh_off.opt_state[0].mu["a"].spec == P("fsdp", None)
+    assert ssh_off.opt_state[0].mu["b"].spec == P(None, "fsdp")
+    assert ssh_off.opt_state[0].count.spec == P()
+    assert ssh_off.step.spec == P()
+    # default (ZeRO on): the data axis merges onto each moment's own
+    # param spec where the dim divides (8 % (2*4) == 0 on dim 0 of 'a',
+    # 8 % 2 == 0 on dim 0 of 'b'); count/step stay replicated
     ssh = state_shardings(state, mesh8, psh)
-    # same-shaped params with different shardings: moments must follow
-    # their own param, not the other one's
-    assert ssh.opt_state[0].mu["a"].spec == P("fsdp", None)
-    assert ssh.opt_state[0].mu["b"].spec == P(None, "fsdp")
+    assert ssh.opt_state[0].mu["a"].spec == P(("data", "fsdp"))
+    assert ssh.opt_state[0].mu["b"].spec == P("data", "fsdp")
     assert ssh.opt_state[0].count.spec == P()
     assert ssh.step.spec == P()
+
+
+def test_state_shardings_explicit_role_resolution(mesh8):
+    """The mirrors-params decision is by declared field role, not shape
+    coincidence: with a ONE-leaf param tree, Adam's scalar count (and
+    any undeclared same-shaped lone array) resolves replicated, while
+    mu/nu still mirror (and ZeRO-partition) — the train.py:90-99
+    one-leaf special case is gone."""
+    import collections
+
+    params = jnp.zeros((8, 8))  # a bare one-leaf param tree
+    tx = optax.adam(1e-3)
+    state = TrainState.create(params, tx)
+    psh = NamedSharding(mesh8, P("fsdp", None))
+    ssh = state_shardings(state, mesh8, psh)
+    assert ssh.opt_state[0].count.spec == P()
+    assert ssh.opt_state[0].mu.spec == P(("data", "fsdp"))
+    assert ssh.opt_state[0].nu.spec == P(("data", "fsdp"))
+
+    # an UNDECLARED field holding a lone array — even one whose shape
+    # happens to equal the single param's — replicates instead of
+    # accidentally inheriting the param sharding
+    Fake = collections.namedtuple("Fake", ["lookalike"])
+    fake_state = TrainState(
+        step=state.step,
+        params=params,
+        opt_state=(Fake(lookalike=jnp.zeros((8, 8))),),
+    )
+    fssh = state_shardings(fake_state, mesh8, psh)
+    assert fssh.opt_state[0].lookalike.spec == P()
+
+
+def test_zero_train_step_matches_replicated(mesh_dp):
+    """zero_sharding on vs off on a pure data-parallel mesh: same
+    params trajectory (byte-identical on this toy — no embedding-style
+    scatter grads whose reduce order could shift), moments genuinely
+    data-partitioned only on the ZeRO leg."""
+
+    def loss_fn(params, batch):
+        pred = jnp.tanh(batch["x"] @ params["w1"]) @ params["w2"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    rng = np.random.default_rng(5)
+    params = {
+        "w1": jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32)),
+        "w2": jnp.asarray(rng.normal(size=(8, 2)).astype(np.float32)),
+    }
+    tx = optax.adamw(1e-2)
+    batch = shard_batch(
+        mesh_dp,
+        {
+            "x": rng.normal(size=(32, 16)).astype(np.float32),
+            "y": rng.normal(size=(32, 2)).astype(np.float32),
+        },
+    )
+
+    def run(zero):
+        state = TrainState.create(jax.tree.map(jnp.array, params), tx)
+        step = build_train_step(
+            loss_fn, tx, mesh_dp, zero_sharding=zero
+        )
+        for _ in range(5):
+            state, loss = step(state, batch)
+        return state, float(loss)
+
+    s_on, l_on = run(True)
+    s_off, l_off = run(False)
+    assert l_on == l_off
+    on_bytes = [
+        np.asarray(x).tobytes()
+        for x in jax.tree.leaves(jax.device_get(s_on.params))
+    ]
+    off_bytes = [
+        np.asarray(x).tobytes()
+        for x in jax.tree.leaves(jax.device_get(s_off.params))
+    ]
+    assert on_bytes == off_bytes
+    # the ZeRO leg's moments really are partitioned across the replicas
+    assert s_on.opt_state[0].mu["w1"].sharding.spec == P("data")
+    assert s_off.opt_state[0].mu["w1"].sharding.spec == P()
+
+
+def test_build_update_step_matches_inline_update(mesh_dp):
+    """The isolated weight-update step (the bench's optimizer-span
+    probe) must produce exactly tx.update + apply_updates, ZeRO-sharded
+    or not, and feed the train_weight_update_seconds histogram."""
+    from tensorflowonspark_tpu.compute import build_update_step
+    from tensorflowonspark_tpu.obs.registry import default_registry
+
+    rng = np.random.default_rng(11)
+    params = {"w": jnp.asarray(rng.normal(size=(16, 4)).astype(np.float32))}
+    grads = {"w": jnp.asarray(rng.normal(size=(16, 4)).astype(np.float32))}
+    tx = optax.adamw(1e-2)
+
+    # eager single-device reference (jit fusion may differ by ~1 ulp,
+    # so the reference check is allclose; the on-vs-off check is exact)
+    ref_state = TrainState.create(jax.tree.map(jnp.array, params), tx)
+    upd, new_opt = tx.update(grads, ref_state.opt_state, ref_state.params)
+    ref_params = optax.apply_updates(ref_state.params, upd)
+
+    results = {}
+    for zero in (True, False):
+        state = TrainState.create(jax.tree.map(jnp.array, params), tx)
+        step = build_update_step(tx, mesh_dp, zero_sharding=zero)
+        out = step(state, jax.tree.map(jnp.array, grads))
+        np.testing.assert_allclose(
+            np.asarray(out.params["w"]), np.asarray(ref_params["w"]),
+            rtol=1e-6,
+        )
+        assert int(out.step) == 1
+        results[zero] = np.asarray(out.params["w"]).tobytes()
+    # the sharded decomposition is elementwise: byte-exact across knobs
+    assert results[True] == results[False]
+    assert "train_weight_update_seconds" in default_registry().render()
 
 
 def test_checkpoint_roundtrip(tmp_path, mesh_dp):

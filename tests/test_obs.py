@@ -263,6 +263,24 @@ def test_classify_op():
     assert trace_report.classify_op("exp.7") == "vector"
     assert trace_report.classify_op("fusion.88") == "vector"
     assert trace_report.classify_op("dot.1", device=False) == "host"
+    # the train step's optimizer scope wins over every other category —
+    # a weight-update matmul/collective counts as optimizer time; the
+    # scope literal is pinned against compute/train.py's constant so a
+    # rename in one site cannot silently kill the category
+    from tensorflowonspark_tpu.compute.train import WEIGHT_UPDATE_SCOPE
+
+    assert (
+        trace_report.classify_op(f"{WEIGHT_UPDATE_SCOPE}/fusion.3")
+        == "weight_update"
+    )
+    assert (
+        trace_report.classify_op("jit(step)/train.weight_update/all-gather.2")
+        == "weight_update"
+    )
+    assert (
+        trace_report.classify_op("train.weight_update/dot.1", device=False)
+        == "host"
+    )
     assert trace_report.is_device_lane("/device:TPU:0")
     assert not trace_report.is_device_lane("python main thread")
 
@@ -284,6 +302,30 @@ def test_attribution_table_from_synthetic_trace():
     assert att["device_total_us"] == 100
     assert att["host_total_us"] == 50
     assert att["mxu_fraction"] == 0.3
+    # no scoped optimizer ops in this trace: fraction present and zero
+    assert cats["weight_update"] == {"us": 0, "pct": 0.0}
+    assert att["weight_update_fraction"] == 0.0
+
+
+def test_attribution_weight_update_fraction():
+    """Device ops under the train.weight_update named scope land in
+    their own category and the optimizer fraction of device time is
+    reported — the number the ZeRO A/B (bench.py --zero) reads."""
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "dot.1", "ts": 0,
+         "dur": 60},
+        {"ph": "X", "pid": 1, "tid": 1,
+         "name": "jit(step)/train.weight_update/fusion.9", "ts": 60,
+         "dur": 40},
+    ]
+    att = trace_report.attribution(
+        trace_report.self_times(events), trace_report.lane_names(events)
+    )
+    assert att["categories"]["weight_update"] == {"us": 40, "pct": 40.0}
+    assert att["weight_update_fraction"] == 0.4
+    assert att["mxu_fraction"] == 0.6
 
 
 def test_self_times_partial_overlap_clamps_and_warns():

@@ -170,6 +170,20 @@ def test_mixed_precision_in_build_train_step():
     assert float(l) < l0
     assert state.params["w"].dtype == jnp.bfloat16
     assert state.opt_state.master["w"].dtype == jnp.float32
+    # the default ZeRO layout partitioned the fp32 master across the
+    # data replicas (8 % 4 == 0 on this data=4 mesh; bias of 4 too)
+    w_spec = state.opt_state.master["w"].sharding.spec
+    assert "data" in [
+        ax
+        for e in w_spec
+        for ax in (e if isinstance(e, tuple) else (e,))
+    ]
+    # and the bf16 params themselves stayed UNpartitioned across data
+    # (they all-gather back every step)
+    assert all(
+        "data" not in (e if isinstance(e, tuple) else (e,))
+        for e in state.params["w"].sharding.spec
+    )
 
 
 def test_adamw_accepts_schedule():

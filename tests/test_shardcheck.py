@@ -140,15 +140,30 @@ def test_diff_census_reports_both_directions():
 
 def test_committed_baseline_shape():
     """tools/shardcheck_baseline.json is the llama1b gate artifact: it
-    must carry both heads plus the meta the gate pins."""
+    must carry both heads plus the meta the gate pins, at BOTH
+    zero_sharding knob settings (top-level = ZeRO on, 'zero_off' = the
+    replicated escape hatch), and their delta must show the sharded
+    weight update's signature."""
     with open(os.path.join(ROOT, "tools", "shardcheck_baseline.json")) as f:
         data = json.load(f)
-    assert set(data) >= {"meta", "jaxpr", "hlo"}
+    assert set(data) >= {"meta", "jaxpr", "hlo", "zero_off"}
     assert data["meta"]["model"] == "llama1b"
-    assert data["hlo"], "llama1b on a 3-axis mesh must show collectives"
-    assert all(
-        isinstance(v, int) and v > 0 for v in data["hlo"].values()
-    )
+    for heads in (data, data["zero_off"]):
+        assert heads["hlo"], "llama1b on a 3-axis mesh must show collectives"
+        assert all(
+            isinstance(v, int) and v > 0 for v in heads["hlo"].values()
+        )
+    # the intended delta: the ZeRO leg scattered the weight-gradient
+    # reduces (CPU's partitioner lowers reduce-scatter to permute
+    # chains / all-to-all), so it carries strictly FEWER all-reduce
+    # instances than the replicated leg — an eyeballable committed diff
+    def all_reduces(heads):
+        return sum(
+            n for k, n in heads["hlo"].items() if k.startswith("all-reduce")
+        )
+
+    assert data["hlo"] != data["zero_off"]["hlo"]
+    assert all_reduces(data) < all_reduces(data["zero_off"])
 
 
 # -- CLI --------------------------------------------------------------------
@@ -172,6 +187,10 @@ def test_cli_tiny_census_and_gate(tmp_path):
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     census = json.loads(out.read_text())
     assert census["hlo"], "sharded tiny train step must show collectives"
+    # the default census carries both zero-knob settings, and they
+    # differ (the ZeRO weight update's collective delta)
+    assert census["zero_off"]["hlo"]
+    assert census["hlo"] != census["zero_off"]["hlo"]
 
     proc = subprocess.run(
         cmd + ["--gate"],
@@ -179,6 +198,13 @@ def test_cli_tiny_census_and_gate(tmp_path):
     )
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "matches the baseline" in proc.stdout
+
+    # a single-knob quick look gates against its own baseline section
+    proc = subprocess.run(
+        cmd + ["--gate", "--zero", "off"],
+        cwd=ROOT, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
 
     # a tampered baseline (one extra all-gather) must fail the gate
     data = json.loads(base.read_text())

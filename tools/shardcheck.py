@@ -10,11 +10,19 @@ the shardings). The census is diffed against a committed per-model
 baseline, so an unintended collective introduced by a layout-table edit
 fails the build instead of quietly eating MFU.
 
+The census is taken at BOTH settings of the train step's
+``zero_sharding`` knob by default (``--zero both``): the committed
+baseline's top-level heads are the ZeRO (default-on) weight update, its
+``zero_off`` section the replicated escape hatch, and the delta between
+them is the intended reduce-scatter/all-gather pair of the cross-replica
+sharded weight update (arXiv 2004.13336) — machine-checked at both ends.
+
 Usage (from the repo root)::
 
     python tools/shardcheck.py --model tiny             # quick look
     python tools/shardcheck.py --model llama1b --gate   # what CI runs
     python tools/shardcheck.py --model llama1b --write-baseline
+    python tools/shardcheck.py --model tiny --zero off  # one knob only
     python tools/shardcheck.py --model tiny --json out.json
 
 Exit codes: 0 census matches the baseline (or no gate requested),
@@ -48,8 +56,15 @@ def _force_cpu_devices() -> None:
         ).strip()
 
 
-def build_census(model_name: str, mesh_spec: str, batch: int, seq: int):
-    """Census of the llama train step for one (model, mesh, shape)."""
+def build_census(
+    model_name: str,
+    mesh_spec: str,
+    batch: int,
+    seq: int,
+    zero_sharding: bool = True,
+):
+    """Census of the llama train step for one (model, mesh, shape,
+    zero knob)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -99,8 +114,10 @@ def build_census(model_name: str, mesh_spec: str, batch: int, seq: int):
         opt_state=jax.eval_shape(tx.init, params),
     )
     psh = layout.param_shardings(params, mesh, "llama")
-    ssh = state_shardings(state, mesh, psh)
-    step = make_step_fn(loss_fn, tx, mesh)
+    ssh = state_shardings(state, mesh, psh, zero_sharding=zero_sharding)
+    step = make_step_fn(
+        loss_fn, tx, mesh, param_shardings=psh, zero_sharding=zero_sharding
+    )
     batch_tree = {"tokens": tokens}
     return sc.census(
         step,
@@ -119,6 +136,22 @@ def build_census(model_name: str, mesh_spec: str, batch: int, seq: int):
     )
 
 
+def build_both_censuses(model_name: str, mesh_spec: str, batch: int, seq: int):
+    """One artifact carrying BOTH zero-knob settings: the top-level
+    'jaxpr'/'hlo' heads are the DEFAULT (``zero_sharding=True``) train
+    step, 'zero_off' holds the replicated-optimizer escape hatch. The
+    committed diff between them IS the intended reduce-scatter/
+    all-gather delta of the ZeRO weight update."""
+    on = build_census(model_name, mesh_spec, batch, seq, zero_sharding=True)
+    off = build_census(model_name, mesh_spec, batch, seq, zero_sharding=False)
+    return {
+        "meta": on["meta"],
+        "jaxpr": on["jaxpr"],
+        "hlo": on["hlo"],
+        "zero_off": {"jaxpr": off["jaxpr"], "hlo": off["hlo"]},
+    }
+
+
 def main(argv: list | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="shardcheck",
@@ -135,6 +168,11 @@ def main(argv: list | None = None) -> int:
                     help="sequence length to trace at (collective "
                     "STRUCTURE is layout-determined, so a short seq "
                     "keeps the CPU compile fast)")
+    ap.add_argument("--zero", choices=("on", "off", "both"), default="both",
+                    help="which zero_sharding knob setting(s) to census: "
+                    "'both' (default — what the committed baseline and "
+                    "the CI gate carry), or a single setting for a "
+                    "quick look")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
     ap.add_argument("--write-baseline", action="store_true",
                     help="write the current census as the baseline")
@@ -147,12 +185,21 @@ def main(argv: list | None = None) -> int:
 
     if args.write_baseline and args.gate:
         ap.error("--write-baseline and --gate are mutually exclusive")
+    if args.write_baseline and args.zero != "both":
+        ap.error("--write-baseline requires --zero both (the committed "
+                 "baseline carries both knob settings)")
 
     _force_cpu_devices()
 
     from tensorflowonspark_tpu.analysis.shardcheck import diff_census
 
-    cur = build_census(args.model, args.mesh, args.batch, args.seq)
+    if args.zero == "both":
+        cur = build_both_censuses(args.model, args.mesh, args.batch, args.seq)
+    else:
+        cur = build_census(
+            args.model, args.mesh, args.batch, args.seq,
+            zero_sharding=(args.zero == "on"),
+        )
 
     baseline_path = (
         args.baseline
@@ -175,16 +222,25 @@ def main(argv: list | None = None) -> int:
         )
         return 0
 
-    total = sum(cur["jaxpr"].values()) + sum(cur["hlo"].values())
-    print(
-        f"shardcheck: {args.model} on {args.mesh}: "
-        f"{sum(cur['jaxpr'].values())} jaxpr collective(s), "
-        f"{sum(cur['hlo'].values())} HLO collective(s) "
-        f"({total} total)"
-    )
-    for head in ("jaxpr", "hlo"):
-        for key, n in cur[head].items():
-            print(f"  {head}: {key}: {n}")
+    # (section label, census heads dict) pairs to print/gate — the
+    # default knob setting under "", the escape hatch under "zero_off"
+    sections = [("", cur)]
+    if "zero_off" in cur:
+        sections.append(("zero_off", cur["zero_off"]))
+    for label, heads in sections:
+        total = sum(heads["jaxpr"].values()) + sum(heads["hlo"].values())
+        tag = f" [{label}]" if label else (
+            " [zero_on]" if args.zero == "both" else f" [zero_{args.zero}]"
+        )
+        print(
+            f"shardcheck: {args.model} on {args.mesh}{tag}: "
+            f"{sum(heads['jaxpr'].values())} jaxpr collective(s), "
+            f"{sum(heads['hlo'].values())} HLO collective(s) "
+            f"({total} total)"
+        )
+        for head in ("jaxpr", "hlo"):
+            for key, n in heads[head].items():
+                print(f"  {head}: {key}: {n}")
 
     if not args.gate:
         return 0
@@ -208,7 +264,30 @@ def main(argv: list | None = None) -> int:
             file=sys.stderr,
         )
         return 1
-    diff = diff_census(baseline, cur)
+    diff = []
+    if args.zero == "off":
+        if "zero_off" not in baseline:
+            diff.append(
+                "baseline has no zero_off section — regenerate with "
+                "--write-baseline"
+            )
+        else:
+            diff += diff_census(baseline["zero_off"], cur)
+    else:
+        diff += diff_census(baseline, cur)
+        if "zero_off" in cur:
+            if "zero_off" not in baseline:
+                diff.append(
+                    "baseline has no zero_off section — regenerate with "
+                    "--write-baseline"
+                )
+            else:
+                diff += [
+                    f"zero_off: {line}"
+                    for line in diff_census(
+                        baseline["zero_off"], cur["zero_off"]
+                    )
+                ]
     if diff:
         print("shardcheck: census DIFFERS from the baseline:")
         for line in diff:
