@@ -757,6 +757,226 @@ def _bench_serve_fleet(smoke: bool) -> None:
     _emit(result)
 
 
+def _bench_rollout(smoke: bool) -> None:
+    """``--rollout``: chaos-proving zero-downtime weight rollout.
+
+    A 2-replica in-process fleet behind the health-routing router
+    serves SUSTAINED streaming load while K successive weight versions
+    roll through the :class:`RolloutController` (per-seat drain →
+    between-block swap → re-warm → readiness-gated rejoin). The
+    committed artifact asserts the acceptance contract directly:
+
+    - **zero dropped or hung requests** — every stream started during
+      the run resolves as ok or a typed shed (worker joins bound it;
+      non-shed errors fail the bench),
+    - **admitted p99 within the deadline budget** throughout the
+      rollouts (every request carries ``deadline_s``; admitted =
+      not shed at admission),
+    - **every completion stamped with a coherent weights version** —
+      a stamp from the published set, with the post-rollout tail
+      entirely on the final version.
+
+    Artifact: ``benchmarks/results/rollout_<backend>[_smoke].json``.
+    """
+    import threading as _threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from benchmarks.real_chip import _llama1b_decode_setup
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+    from tensorflowonspark_tpu.serving.fleet import ServingFleet
+    from tensorflowonspark_tpu.serving.rollout import RolloutController
+    from tensorflowonspark_tpu.serving.router import FleetRouter
+
+    ns = argparse.Namespace(
+        batch_size=2 if smoke else 4,
+        seq=16 if smoke else 64,
+        new_tokens=8 if smoke else 32,
+        spec_k=0,
+        model_scale="tiny" if smoke else "1b",
+        kv_quantize=False,
+    )
+    if smoke:
+        _partial["smoke"] = True
+    b, new_tokens, cfg, model, prompts = _llama1b_decode_setup(ns)
+    rng = jax.random.PRNGKey(0)
+    base_params = jax.tree.map(
+        jax.device_put,
+        model.init(rng, jnp.asarray(prompts[:2]))["params"],
+    )
+    n_versions = 2 if smoke else 3
+    deadline_s = 60.0 if smoke else 120.0
+    n_workers = 4
+    versions = {}
+    for k in range(1, n_versions + 1):
+        vp = model.init(
+            jax.random.PRNGKey(k), jnp.asarray(prompts[:2])
+        )["params"]
+        versions[f"v{k}"] = jax.tree.map(_np.asarray, vp)
+    published = {"v0", *versions}
+
+    def factory():
+        return ContinuousBatcher(
+            model,
+            base_params,
+            slots=b,
+            prompt_widths=(prompts.shape[1],),
+        )
+
+    fleet = ServingFleet(
+        factory=factory,
+        replicas=2,
+        probe_interval=0.5,
+        warmup=False,
+        drain_timeout=30.0,
+    )
+    router = FleetRouter(fleet)
+    ctl = RolloutController(
+        fleet, drain_timeout=60.0, verify_timeout=120.0
+    )
+    results: dict[int, tuple] = {}
+    stop_load = _threading.Event()
+    phase = {"current": "v0"}  # version being served when issued
+
+    def load_worker(widx: int) -> None:
+        n = 0
+        while not stop_load.is_set():
+            key = widx * 1_000_000 + n
+            n += 1
+            t0 = time.perf_counter()
+            try:
+                s = router.stream(
+                    prompts[key % len(prompts)].tolist(),
+                    new_tokens,
+                    deadline_s=deadline_s,
+                )
+                toks = list(s)
+                results[key] = (
+                    "ok",
+                    time.perf_counter() - t0,
+                    s.weights_version,
+                    len(toks),
+                    phase["current"],
+                )
+            except BaseException as e:  # noqa: BLE001 - the verdict
+                results[key] = (
+                    "err",
+                    time.perf_counter() - t0,
+                    type(e).__name__,
+                    0,
+                    phase["current"],
+                )
+            time.sleep(0.01)
+
+    workers = [
+        _threading.Thread(target=load_worker, args=(i,), daemon=True)
+        for i in range(n_workers)
+    ]
+    t_start = time.perf_counter()
+    for t in workers:
+        t.start()
+    time.sleep(1.0)
+    outcomes = []
+    for k in range(1, n_versions + 1):
+        ver = f"v{k}"
+        out = ctl.publish(versions[ver], version=ver)
+        outcomes.append({"version": ver, "outcome": out})
+        phase["current"] = ver
+        time.sleep(0.5)  # serve a beat between versions
+    time.sleep(1.0)  # post-rollout tail on the final version
+    stop_load.set()
+    hung = 0
+    for t in workers:
+        t.join(timeout=max(120.0, deadline_s + 60.0))
+        if t.is_alive():
+            hung += 1
+    wall_s = time.perf_counter() - t_start
+    router.close()
+
+    oks = [v for v in results.values() if v[0] == "ok"]
+    errs = [v for v in results.values() if v[0] == "err"]
+    sheds = [
+        v
+        for v in errs
+        if v[2] in ("FleetOverloaded", "FleetUnavailable")
+    ]
+    hard_errors = [v for v in errs if v not in sheds]
+    latencies = sorted(v[1] for v in oks)
+    p99 = (
+        latencies[max(0, int(len(latencies) * 0.99) - 1)]
+        if latencies
+        else float("inf")
+    )
+    version_counts: dict[str, int] = {}
+    bad_stamps = 0
+    for v in oks:
+        stamp = v[2]
+        version_counts[stamp] = version_counts.get(stamp, 0) + 1
+        if stamp not in published:
+            bad_stamps += 1
+    final_ver = f"v{n_versions}"
+    tail_ok = [v for v in oks if v[4] == final_ver]
+    tail_on_final = sum(1 for v in tail_ok if v[2] == final_ver)
+    checks = {
+        "zero_dropped_or_hung": hung == 0 and not hard_errors,
+        "all_rollouts_completed": all(
+            o["outcome"] == "completed" for o in outcomes
+        ),
+        "admitted_p99_within_deadline": p99 <= deadline_s,
+        "every_completion_version_stamped": bad_stamps == 0
+        and all(v[2] is not None for v in oks),
+        "tail_serves_final_version": (
+            tail_ok and tail_on_final == len(tail_ok)
+        )
+        or not tail_ok,
+    }
+    result = {
+        "metric": "rollout_zero_downtime",
+        "value": float(len(oks)),
+        "unit": "requests",
+        "vs_baseline": 1.0 if all(checks.values()) else 0.0,
+        "passed": all(checks.values()),
+        "checks": checks,
+        "versions_rolled": n_versions,
+        "rollouts": outcomes,
+        "requests_ok": len(oks),
+        "requests_shed": len(sheds),
+        "requests_hard_errors": len(hard_errors),
+        "hung_workers": hung,
+        "admitted_p99_s": round(p99, 3),
+        "deadline_budget_s": deadline_s,
+        "version_counts": version_counts,
+        "rollout_stats": ctl.stats(),
+        "wall_s": round(wall_s, 1),
+        "replicas": 2,
+        "new_tokens": new_tokens,
+        **_partial,
+    }
+    path = os.path.join(
+        "benchmarks",
+        "results",
+        f"rollout_{jax.default_backend()}"
+        + ("_smoke" if smoke else "")
+        + ".json",
+    )
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        result["artifact"] = path
+    except OSError as e:
+        result["artifact_error"] = str(e)
+    _emit(result)
+    if not all(checks.values()):
+        raise SystemExit(
+            f"rollout bench failed acceptance checks: "
+            f"{ {k: v for k, v in checks.items() if not v} }"
+        )
+
+
 def _relay_dial_probe(timeout: float = 180.0) -> tuple[bool, str]:
     """One short-lived subprocess dial: (ok, detail). ok=True iff jax
     backend init completes. Distinguishes a HEALTHY relay from a
@@ -905,6 +1125,17 @@ def main(argv: list[str] | None = None) -> None:
         "BENCH_SMOKE=1 for the tiny model + params byte-identity hash)",
     )
     ap.add_argument(
+        "--rollout",
+        action="store_true",
+        help="chaos-prove zero-downtime weight rollout: a 2-replica "
+        "fleet serves sustained streaming load while K successive "
+        "versions hot-swap through the RolloutController; the "
+        "committed benchmarks/results/rollout_*.json asserts zero "
+        "dropped/hung requests, admitted p99 within the deadline "
+        "budget, and coherent per-completion version stamps "
+        "(BENCH_SMOKE=1 for the tiny model)",
+    )
+    ap.add_argument(
         "--serve",
         action="store_true",
         help="measure the serving engine tax instead of training MFU: "
@@ -977,6 +1208,9 @@ def main(argv: list[str] | None = None) -> None:
         return
     if args.serve_fleet:
         _bench_serve_fleet(smoke)
+        return
+    if args.rollout:
+        _bench_rollout(smoke)
         return
     if args.serve:
         # the serving bench commits its own span-based trace report;

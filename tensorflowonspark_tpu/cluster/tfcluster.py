@@ -786,7 +786,14 @@ class TFCluster:
 
     # ------------------------------------------------------------------
     # pull plane (driverless sharded ingestion — feed/ingest.py)
-    def assign_shards(self, manifests: Iterable[Any]) -> None:
+    def assign_shards(
+        self,
+        manifests: Iterable[Any],
+        *,
+        seed: int | None = None,
+        epoch: int = 0,
+        split: int = 1,
+    ) -> None:
         """Plan and publish the pull plane's shard assignment
         (``InputMode.TENSORFLOW`` only): ``manifests`` (typically
         :class:`~tensorflowonspark_tpu.feed.manifest.FileManifest`
@@ -815,6 +822,15 @@ class TFCluster:
         active owner is then logged loudly as UNREAD (and counted in
         the ``ingest_unread_shards`` gauge) — the recorded limitation
         the handover protocol exists to remove.
+
+        ``seed``/``epoch``/``split`` thread the per-epoch seeded
+        shuffle (``feed.manifest.plan_manifests``): the SAME
+        (seed, epoch) pair always re-derives the same plan — cursor-
+        exact resume composes with ``reshuffle_each_iteration`` — and
+        each epoch's manifests carry epoch-folded stream ids, so one
+        ``assign_shards(..., seed=s, epoch=e)`` + drain cycle per
+        epoch gives pull-mode training a fresh deterministic
+        permutation per pass.
         """
         if self.input_mode != InputMode.TENSORFLOW:
             raise RuntimeError(
@@ -825,7 +841,10 @@ class TFCluster:
         from tensorflowonspark_tpu.feed.manifest import plan_manifests
 
         workers = self.workers
-        shards = plan_manifests(list(manifests), len(workers))
+        shards = plan_manifests(
+            list(manifests), len(workers), seed=seed, epoch=epoch,
+            split=split,
+        )
         with self._ingest_lock:
             self._ingest_shards = {
                 w["executor_id"]: shard for w, shard in zip(workers, shards)

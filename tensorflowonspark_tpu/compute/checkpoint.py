@@ -57,6 +57,34 @@ def _canonicalize_leaves(state: Any) -> Any:
     )
 
 
+def checkpoint_complete(path: str) -> bool:
+    """True iff ``path`` holds a COMMITTED orbax checkpoint.
+
+    The serving rollout channel (``serving/rollout.py``) publishes
+    checkpoint directories to live engines; a torn or in-progress write
+    must never be hot-swapped into a serving fleet. Two signals, both
+    required: the directory exists under its FINAL name (orbax writes
+    into a ``*.orbax-checkpoint-tmp-*`` directory and renames at
+    commit — on posix the final name existing IS the commit), and the
+    ``_CHECKPOINT_METADATA`` finalization marker is present (guards
+    partially-copied directories, e.g. an interrupted rsync between
+    filesystems, where the rename atomicity did not travel).
+
+    Remote URIs (``gs://...`` and friends) cannot be probed with local
+    filesystem calls: the tmp-name rejection still applies (orbax's
+    rename-at-commit naming travels with the store), but a final-named
+    remote path is TRUSTED — the publisher's contract is to publish
+    only after the save fully landed (``CheckpointManager.wait()``)."""
+    path = _abs(path)
+    if "orbax-checkpoint-tmp" in os.path.basename(path.rstrip("/")):
+        return False
+    if "://" in path:
+        return True
+    if not os.path.isdir(path):
+        return False
+    return os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA"))
+
+
 def save_checkpoint(path: str, state: Any, force: bool = True) -> str:
     """Synchronously write ``state`` (any pytree) to ``path``."""
     path = _abs(path)
@@ -207,6 +235,12 @@ class CheckpointManager:
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    def step_path(self, step: int) -> str:
+        """Directory of one saved step (the unit the rollout channel
+        publishes: ``serving.rollout.publish_checkpoint(path=
+        mgr.step_path(step), ...)`` after :meth:`wait`)."""
+        return os.path.join(self.directory, str(int(step)))
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

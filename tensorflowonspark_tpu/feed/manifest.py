@@ -71,6 +71,12 @@ class FileManifest:
     start: int = 0
     stop: int | None = None
     binary_features: tuple[str, ...] = ()
+    # Training epoch this manifest instance belongs to (pull-mode
+    # per-epoch shuffle): folded into :func:`stream_id`, so epoch 1's
+    # re-read of the same records is a FRESH replay stream — consumed-
+    # cursor state from epoch 0 can never suppress (or be suppressed
+    # by) another epoch's pass. 0 keeps the legacy stream id exactly.
+    epoch: int = 0
 
 
 def read_manifest(
@@ -121,7 +127,13 @@ def read_manifest_chunks(m: FileManifest):
 
 
 def plan_manifests(
-    manifests: Sequence[FileManifest], num_shards: int
+    manifests: Sequence[FileManifest],
+    num_shards: int,
+    *,
+    seed: int | None = None,
+    epoch: int = 0,
+    split: int = 1,
+    reader: Callable[[FileManifest], Iterator[Any]] | None = None,
 ) -> list[list[FileManifest]]:
     """Deterministic round-robin shard assignment — the driver side of
     the pull plane's manifest planning (``TFCluster.assign_shards``).
@@ -135,10 +147,53 @@ def plan_manifests(
     Shards may be empty when ``len(manifests) < num_shards`` — a node
     with an empty shard sees an immediately-exhausted feed, not an
     error (skewed file counts are normal at small scale).
+
+    **Per-epoch seeded shuffle** (ROADMAP 4a, the pull-mode
+    ``reshuffle_each_iteration``): ``seed`` permutes the manifests with
+    a PRNG keyed on ``(seed, epoch)`` — the SAME (seed, epoch) pair
+    always reproduces the same plan byte-for-byte (what lets a
+    restarted driver, an elastic re-plan, or a resumed run re-derive
+    it), while each epoch draws a fresh permutation. ``split > 1``
+    first splits every manifest into up to that many contiguous
+    record-range pieces (:func:`split_manifest` — header-only for
+    ``'columnar'``), making the shuffle block-granular rather than
+    file-granular. The ``epoch`` is stamped onto every planned manifest
+    and folded into its :func:`stream_id`, so record-exact replay
+    cursors stay exact across epochs (resume mid-epoch is zero-dup/
+    zero-gap — a cursor from epoch *e* speaks only for epoch *e*'s
+    streams). ``seed=None`` with ``epoch > 0`` stamps the epoch without
+    permuting.
     """
     if num_shards < 1:
         raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if split < 1:
+        raise ValueError(f"split must be >= 1, got {split}")
+    if epoch < 0:
+        raise ValueError(f"epoch must be >= 0, got {epoch}")
     ms = list(manifests)
+    if split > 1:
+        ms = [
+            piece
+            for m in ms
+            for piece in split_manifest(m, split, reader)
+        ]
+    if epoch and any(
+        isinstance(m, FileManifest) and m.epoch != epoch for m in ms
+    ):
+        ms = [
+            dataclasses.replace(m, epoch=int(epoch))
+            if isinstance(m, FileManifest)
+            else m
+            for m in ms
+        ]
+    if seed is not None:
+        import random
+
+        # keyed on (seed, epoch): same pair -> same permutation on any
+        # host/run (random.Random is version-stable for shuffle);
+        # different epochs draw independent permutations
+        rng = random.Random(1_000_003 * int(seed) + int(epoch))
+        rng.shuffle(ms)
     return [ms[i::num_shards] for i in range(num_shards)]
 
 
@@ -191,10 +246,17 @@ def stream_id(m: Any) -> str:
     driver's shard re-planner all re-derive the same id, which is what
     lets consumed-cursor state and manifests be matched up across
     processes. A re-split's remaining manifest (advanced ``start``) is
-    by construction a FRESH stream."""
+    by construction a FRESH stream, and a manifest planned for a later
+    ``epoch`` folds the epoch in (``#e<n>``) — each shuffled epoch's
+    pass over the same records is its own stream, so cursor
+    determinism composes with per-epoch reshuffling. Epoch 0 keeps the
+    pre-shuffle id byte-identical (persisted cursors stay valid)."""
     if isinstance(m, FileManifest):
         stop = "" if m.stop is None else int(m.stop)
-        return f"{m.path}@{int(m.start)}:{stop}"
+        sid = f"{m.path}@{int(m.start)}:{stop}"
+        if m.epoch:
+            sid += f"#e{int(m.epoch)}"
+        return sid
     return f"manifest:{m!r}"
 
 

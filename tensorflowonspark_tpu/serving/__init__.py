@@ -17,6 +17,7 @@ from tensorflowonspark_tpu.serving.engine import (
     DeadlineExceeded,
     EngineOverloaded,
     EngineWedged,
+    WeightsIncompatible,
 )
 
 __all__ = [
@@ -28,7 +29,10 @@ __all__ = [
     "FleetRouter",
     "FleetUnavailable",
     "ReplicaGone",
+    "RolloutController",
     "ServingFleet",
+    "WeightsIncompatible",
+    "WeightsUpdate",
 ]
 
 
@@ -46,4 +50,8 @@ def __getattr__(name):
         from tensorflowonspark_tpu.serving.router import FleetRouter
 
         return FleetRouter
+    if name in ("RolloutController", "WeightsUpdate"):
+        from tensorflowonspark_tpu.serving import rollout as _rollout
+
+        return getattr(_rollout, name)
     raise AttributeError(name)

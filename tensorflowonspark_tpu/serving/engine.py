@@ -114,6 +114,16 @@ class EngineWedged(RuntimeError):
     keep serving — see ``ContinuousBatcher(watchdog_s=...)``."""
 
 
+class WeightsIncompatible(ValueError):
+    """``swap_weights`` payload does not fit the running engine: tree
+    structure, leaf shape/dtype, or LoRA factor layout differs from the
+    weights currently serving. The swap is REJECTED before anything is
+    placed on device — the engine keeps serving its current version —
+    and a rollout controller treats this as a per-replica failure that
+    triggers automatic rollback (docs/ROBUSTNESS.md "Rolling weight
+    updates")."""
+
+
 def _row_truncate(scaled, ks, ps):
     """Per-row top-k/top-p mask over (B, vocab) temperature-scaled
     logits: top-k first, then top-p renormalized over the k survivors
@@ -320,6 +330,11 @@ class _Pending:
     deadline_s: float | None = None
     submitted_at: float = 0.0  # time.monotonic() at enqueue
     first_token_at: float | None = None  # set when token 0 emits
+    # the engine weights version this request RESOLVED under, stamped on
+    # the scheduler thread at retirement — the same thread that applies
+    # weight swaps, so the stamp is coherent by construction (a rollout
+    # bench asserts every completion carries one; see swap_weights)
+    weights_version: str | None = None
     # resolve-once latch (guarded by the engine's _resolve_lock): a
     # request resolves as EXACTLY one of completed/failed even when the
     # watchdog thread races the scheduler — whoever flips this delivers
@@ -372,6 +387,12 @@ class _Stream:
     @property
     def logprobs(self):
         return self._p.logprobs
+
+    @property
+    def weights_version(self):
+        """The weights version this request resolved under (set with
+        ``result``, i.e. once the stream is exhausted)."""
+        return self._p.weights_version
 
     def close(self) -> None:
         if not self._done:
@@ -446,6 +467,19 @@ class _PrefillJob:
     # (doubles after each insert — see _advance_job)
     next_insert_depth: int = 0
     boundary_inserts: int = 0  # made so far, capped per request
+
+
+@dataclasses.dataclass
+class _SwapRequest:
+    """A validated, device-placed weight tree waiting for the scheduler
+    to install it between decode blocks (see ``swap_weights``). All
+    expensive work (validation, host→device transfer) already happened
+    on the caller thread — installation is a pointer flip."""
+
+    placed: object
+    version: str
+    event: threading.Event
+    error: BaseException | None = None  # set if the swap was aborted
 
 
 class _PrefixStore:
@@ -582,6 +616,10 @@ class ContinuousBatcher:
     """
 
     _STOP = object()
+    # queue sentinel that only WAKES an idle scheduler (so a pending
+    # weight swap is noticed without a request arriving); carries no
+    # state change itself
+    _WAKE = object()
 
     def __init__(
         self,
@@ -603,6 +641,7 @@ class ContinuousBatcher:
         decode_block: int = 8,
         pipeline_depth: int = 2,
         watchdog_s: float | None = None,
+        weights_version: str = "v0",
     ):
         cfg = model.cfg
         self._model = model
@@ -732,6 +771,15 @@ class ContinuousBatcher:
             self._prefix_store = None
         self._queue: "queue.Queue" = queue.Queue()
         self._closed = False  # guarded-by: self._submit_lock
+        # Hot weight swap (zero-downtime rollout): the label of the
+        # weights currently serving, and the validated/placed update
+        # waiting for the scheduler to install between decode blocks.
+        # _weights_version is written ONLY on the scheduler thread (at
+        # apply) and read racily by stats/health — a str rebind is
+        # atomic and a one-iteration-stale read is benign.
+        self._weights_version = str(weights_version)
+        self._weights_swaps = 0  # applied swaps (scheduler-thread-owned)
+        self._pending_swap: _SwapRequest | None = None  # guarded-by: self._submit_lock
         # True only while warmup() runs its throwaway requests: a fresh
         # replica compiling is ALIVE but not READY — health probers
         # must see the difference (a warmup stall otherwise looks
@@ -1273,11 +1321,15 @@ class ContinuousBatcher:
         presence_penalty: float | None = None,
         logit_bias: "dict[int, float] | None" = None,
         deadline_s: float | None = None,
+        return_versions: bool = False,
     ) -> "list[list[int]] | tuple[list[list[int]], list[list[float]]]":
         """Blocking decode of several prompts admitted ATOMICALLY (all
         rows accepted or an EngineOverloaded/ValueError before any row
         enters the queue) — the multi-row /generate path. Rows decode
-        concurrently, interleaved with other requests' rows."""
+        concurrently, interleaved with other requests' rows.
+        ``return_versions``: also return each row's per-request
+        ``weights_version`` stamp (appended as the trailing element of
+        the return tuple) — the rollout coherence surface."""
         ps = self._enqueue_all(
             [(p, None) for p in prompts],
             max_new_tokens,
@@ -1300,9 +1352,12 @@ class ContinuousBatcher:
         for p in ps:
             if p.error is not None:
                 raise p.error
+        out: tuple = ([p.result for p in ps],)
         if return_logprobs:
-            return [p.result for p in ps], [p.logprobs for p in ps]
-        return [p.result for p in ps]
+            out += ([p.logprobs for p in ps],)
+        if return_versions:
+            out += ([p.weights_version for p in ps],)
+        return out if len(out) > 1 else out[0]
 
     def stream(
         self,
@@ -1436,6 +1491,226 @@ class ContinuousBatcher:
             # queue and not touching the store.
             self._prefix_store.clear()
 
+    # -- hot weight swap (zero-downtime rollout) ----------------------
+
+    @property
+    def weights_version(self) -> str:
+        """Label of the weights currently serving (written only by the
+        scheduler thread at swap time; observability readers tolerate
+        one-swap staleness — per-request coherence comes from the
+        ``_Pending.weights_version`` stamp, not this property)."""
+        return self._weights_version
+
+    def current_weights(self) -> "tuple[str, object]":
+        """``(version, params)`` of the tree currently serving — the
+        rollback retention surface: a rollout controller snapshots this
+        (a reference, not a copy — jax arrays are immutable) before
+        swapping, and re-installs it on rollback. Read it only while
+        the seat is quiesced/held if the pair must be mutually
+        consistent."""
+        return self._weights_version, self._params
+
+    def swap_weights(
+        self,
+        new_params,
+        *,
+        version: str,
+        kind: str = "full",
+        timeout: float = 120.0,
+    ) -> str:
+        """Replace the serving weights WITHOUT restarting the engine.
+
+        All expensive work happens on the CALLER thread: the update is
+        validated against the running tree (structure, per-leaf
+        shape/dtype — any mismatch is a synchronous
+        :class:`WeightsIncompatible`, and the engine keeps serving its
+        current version) and placed on device mirroring each running
+        leaf's sharding. The scheduler then installs the prepared tree
+        between decode blocks — a pointer flip, so the serving stall is
+        one in-flight-window drain, not a restart. The prefix cache is
+        cleared at install (stored K/V was computed under the old
+        weights; resuming prefill from it post-swap would serve stale
+        state), and compiled programs are reused (same shapes/dtypes/
+        shardings ⇒ no recompile).
+
+        ``kind='full'``: ``new_params`` carries the exact pytree of the
+        running weights — host numpy or jax arrays; a
+        ``compute.elastic.host_snapshot`` of a co-trained state's
+        params is exactly this shape. ``kind='lora'``:
+        ``new_params`` is a nested mapping mirroring the params dict
+        down to LoRA kernels, each as ``{"a": ..., "b": ...}`` — only
+        the factors transfer, the resident base weights are reused by
+        reference (the cheap adapter-only swap; see
+        ``serving.rollout.lora_state``).
+
+        Requests decoding ACROSS the install finish under the new
+        weights and are stamped with the new version at retirement —
+        drain first (the fleet rollout controller does) when a request
+        must never span versions. Returns the installed version label.
+        """
+        if kind not in ("full", "lora"):
+            raise ValueError(f"kind must be 'full' or 'lora', got {kind!r}")
+        version = str(version)
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("engine shutting down")
+            if self._pending_swap is not None:
+                raise RuntimeError("a weight swap is already pending")
+        placed = self._place_update(new_params, kind)
+        req = _SwapRequest(
+            placed=placed, version=version, event=threading.Event()
+        )
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("engine shutting down")
+            if self._pending_swap is not None:
+                raise RuntimeError("a weight swap is already pending")
+            self._pending_swap = req
+        self._queue.put(self._WAKE)  # an idle scheduler must notice
+        if not req.event.wait(timeout):
+            with self._submit_lock:
+                if self._pending_swap is req:
+                    self._pending_swap = None
+                    raise TimeoutError(
+                        f"weight swap to {version!r} not applied within "
+                        f"{timeout}s (scheduler busy or wedged)"
+                    )
+            # the scheduler claimed it just as we timed out: the
+            # install is in flight — wait it out briefly
+            req.event.wait(10.0)
+        if not req.event.is_set():
+            raise TimeoutError(
+                f"weight swap to {version!r} not applied within {timeout}s"
+            )
+        if req.error is not None:
+            raise req.error
+        return version
+
+    def _place_update(self, new_params, kind: str):
+        """Validate + device-place an update against the running tree
+        (caller thread). Raises :class:`WeightsIncompatible` on any
+        structure/shape/dtype mismatch BEFORE anything is installed."""
+        if kind == "lora":
+            return self._graft_lora(self._params, new_params, "params")
+        old_paths, old_def = jax.tree_util.tree_flatten_with_path(
+            self._params
+        )
+        new_leaves, new_def = jax.tree.flatten(new_params)
+        if old_def != new_def:
+            raise WeightsIncompatible(
+                "full-swap tree structure differs from the running "
+                f"weights ({new_def.num_leaves} leaves vs "
+                f"{old_def.num_leaves} running; static fields — e.g. a "
+                "LoRA scale — count too)"
+            )
+        placed = [
+            self._place_leaf(old, new, jax.tree_util.keystr(path))
+            for (path, old), new in zip(old_paths, new_leaves)
+        ]
+        return jax.tree.unflatten(old_def, placed)
+
+    @staticmethod
+    def _place_leaf(old, new, where: str):
+        if new is old:
+            return old  # re-install of a retained tree: nothing to move
+        shape = tuple(getattr(new, "shape", ()))
+        dtype = getattr(new, "dtype", None)
+        if shape != tuple(old.shape) or (
+            dtype is not None and np.dtype(dtype) != np.dtype(old.dtype)
+        ):
+            raise WeightsIncompatible(
+                f"leaf {where}: update has shape {shape} dtype {dtype}, "
+                f"running weights have {tuple(old.shape)} "
+                f"{np.dtype(old.dtype)}"
+            )
+        sharding = getattr(old, "sharding", None)
+        if sharding is not None:
+            return jax.device_put(new, sharding)
+        return jax.device_put(new)
+
+    def _graft_lora(self, old_node, upd, where: str):
+        """Adapter-only update: descend the running tree along the
+        update's keys and replace exactly the LoRA ``a``/``b`` factors,
+        keeping every base weight by reference (zero transfer cost for
+        the frozen bulk)."""
+        from tensorflowonspark_tpu.ops.lora import (
+            LoraTensor,
+            MultiLoraTensor,
+        )
+
+        if isinstance(old_node, (LoraTensor, MultiLoraTensor)):
+            if (
+                not isinstance(upd, dict)
+                or set(upd) != {"a", "b"}
+            ):
+                raise WeightsIncompatible(
+                    f"{where}: adapter update must be an {{'a','b'}} "
+                    f"mapping, got {type(upd).__name__} "
+                    f"{sorted(upd) if isinstance(upd, dict) else ''}"
+                )
+            return old_node.replace(
+                a=self._place_leaf(old_node.a, upd["a"], where + ".a"),
+                b=self._place_leaf(old_node.b, upd["b"], where + ".b"),
+            )
+        if isinstance(old_node, dict):
+            if not isinstance(upd, dict):
+                raise WeightsIncompatible(
+                    f"{where}: expected a mapping along the params "
+                    f"tree, got {type(upd).__name__}"
+                )
+            unknown = set(upd) - set(old_node)
+            if unknown:
+                raise WeightsIncompatible(
+                    f"{where}: update names keys absent from the "
+                    f"running weights: {sorted(unknown)}"
+                )
+            return {
+                k: (
+                    self._graft_lora(v, upd[k], f"{where}/{k}")
+                    if k in upd
+                    else v
+                )
+                for k, v in old_node.items()
+            }
+        raise WeightsIncompatible(
+            f"{where}: adapter update path does not terminate at a "
+            f"LoRA kernel (found {type(old_node).__name__}); use "
+            "kind='full' for non-LoRA weights"
+        )
+
+    def _apply_pending_swap(self) -> None:
+        """Scheduler thread: install a prepared swap between decode
+        blocks. In-flight blocks were dispatched against the old tree
+        and stay functionally valid — sweep them out, then flip."""
+        with self._submit_lock:
+            req, self._pending_swap = self._pending_swap, None
+        if req is None:
+            return
+        self._drain_window("swap")
+        self._params = req.placed
+        self._weights_version = req.version
+        self._weights_swaps += 1
+        if self._prefix_store is not None:
+            # stored prefixes' K/V was computed under the OLD weights —
+            # a post-swap hit would resume prefill from stale state
+            # (the router drops its affinity entries via replica_reset)
+            self._prefix_store.clear()
+        req.event.set()
+        logger.info(
+            "engine weights swapped to %r (swap #%d)",
+            req.version,
+            self._weights_swaps,
+        )
+
+    def _abort_pending_swap(self, err: BaseException) -> None:
+        """Fail a waiting swap when the scheduler exits before applying
+        it (shutdown or loop death) — its caller must not hang."""
+        with self._submit_lock:
+            req, self._pending_swap = self._pending_swap, None
+        if req is not None:
+            req.error = RuntimeError(f"weight swap aborted: {err}")
+            req.event.set()
+
     @contextlib.contextmanager
     def _phase(self, phase: str):
         """Measure one scheduler phase into both surfaces: the span
@@ -1469,6 +1744,7 @@ class ContinuousBatcher:
             "ready": bool(live and not self._warming and not self._closed),  # lint: lockfree-read: advisory health probe; a torn one-bool read is benign and the submit lock must not be taken per probe
             "warming": self._warming,
             "closed": self._closed,  # lint: lockfree-read: same advisory snapshot as above
+            "weights_version": self._weights_version,
         }
 
     def unresolved(self) -> int:
@@ -1521,6 +1797,11 @@ class ContinuousBatcher:
             "deadline_expired": self.deadline_expired,
             "watchdog_fires": self.watchdog_fires,
             "stopped_cleanly": self._stopped_cleanly,
+            # hot-swap surface: the serving weights label + how many
+            # swaps this engine has applied (scheduler-thread writes;
+            # point-in-time reads like the rest of /stats)
+            "weights_version": self._weights_version,
+            "weights_swaps": self._weights_swaps,
             "prefill_in_progress": self._job is not None,
             # queue wait + prefill, averaged over completed requests
             "ttft_avg_ms": round(self._ttft_sum / done * 1e3, 3)
@@ -2423,6 +2704,10 @@ class ContinuousBatcher:
         self.completed += 1
         p.result = out
         p.logprobs = lps
+        # stamped on the scheduler thread — the thread that applies
+        # weight swaps — so a completion's version is exactly the tree
+        # it finished decoding under (rollout coherence contract)
+        p.weights_version = self._weights_version
         # result/logprobs are set BEFORE the terminal marker is queued:
         # a stream consumer that sees the emitter-delivered True and
         # reads .result gets the final value.
@@ -2439,6 +2724,7 @@ class ContinuousBatcher:
             return
         p.result = []
         p.logprobs = []
+        p.weights_version = self._weights_version
         self.cancelled += 1
         self.completed += 1
         self._m_completed.inc()
@@ -2487,7 +2773,7 @@ class ContinuousBatcher:
                 item = self._queue.get_nowait()
             except queue.Empty:
                 return
-            if item is self._STOP:
+            if item is self._STOP or item is self._WAKE:
                 continue
             self._fail_one(item, RuntimeError("engine shutting down"))
 
@@ -2641,8 +2927,17 @@ class ContinuousBatcher:
                     if self._job is not None:
                         self._fail_one(self._job.p, err)
                         self._job = None
+                    self._abort_pending_swap(err)
                     self._fail_all(err)
                     return
+                if (
+                    self._pending_swap is not None  # lint: lockfree-read: claim is re-checked under _submit_lock in _apply_pending_swap; a stale None only delays the install one iteration
+                    and self._job is None
+                ):
+                    # between decode blocks, never mid-chunked-prefill
+                    # (a prompt half-prefilled under two weight versions
+                    # would hold internally inconsistent K/V)
+                    self._apply_pending_swap()
                 if self._window and all(e is None for e in self._live):
                     # every row retired mid-window: the remaining
                     # in-flight blocks hold only discards — drop them
@@ -2688,8 +2983,13 @@ class ContinuousBatcher:
                         # a queued STOP is only reached after it ends
                         self._drain_window("shutdown")
                         self._pending_first.clear()
-                        self._fail_all(RuntimeError("engine shutting down"))
+                        err = RuntimeError("engine shutting down")
+                        self._abort_pending_swap(err)
+                        self._fail_all(err)
                         return
+                    if item is self._WAKE:
+                        # woke only so the top-of-loop swap check runs
+                        break
                     if item.cancelled:
                         self._resolve_unadmitted_cancel(item)
                         continue
@@ -2840,6 +3140,7 @@ class ContinuousBatcher:
             if self._job is not None:
                 self._fail_one(self._job.p, e)
                 self._job = None
+            self._abort_pending_swap(e)
             self._fail_all(e)
         finally:
             # Wind down the delivery thread once the scheduler is done:

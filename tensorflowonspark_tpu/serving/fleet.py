@@ -234,6 +234,7 @@ class SubprocessReplica:
         request_timeout: float = 120.0,
         probe_timeout: float = 2.0,
         env: dict | None = None,
+        admin_token: str | None = None,
     ):
         self.rid = int(rid)
         self._argv = list(spawn_argv)
@@ -241,6 +242,10 @@ class SubprocessReplica:
         self._request_timeout = float(request_timeout)
         self._probe_timeout = float(probe_timeout)
         self._env = dict(env) if env is not None else None
+        # shared secret for the child's /admin/reload (weight hot-swap);
+        # injected into the child env at spawn so only this supervisor
+        # can drive reloads
+        self._admin_token = admin_token
         self.proc: subprocess.Popen | None = None
         self.port: int | None = None
 
@@ -260,6 +265,8 @@ class SubprocessReplica:
             port_file,
         ]
         env = dict(os.environ if self._env is None else self._env)
+        if self._admin_token is not None:
+            env["TFOS_ADMIN_TOKEN"] = self._admin_token
         self.proc = subprocess.Popen(
             argv,
             stdout=subprocess.DEVNULL,
@@ -318,12 +325,21 @@ class SubprocessReplica:
                 f"{type(e).__name__}: {e}"
             ) from e
 
-    def _post(self, path: str, payload: dict, timeout: float):
+    def _post(
+        self,
+        path: str,
+        payload: dict,
+        timeout: float,
+        headers: dict | None = None,
+    ):
         body = json.dumps(payload).encode()
         req = urllib.request.Request(
             self._url(path),
             data=body,
-            headers={"Content-Type": "application/json"},
+            headers={
+                "Content-Type": "application/json",
+                **(headers or {}),
+            },
         )
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -413,6 +429,8 @@ class SubprocessReplica:
             }
         if kw.get("return_logprobs") or kw.get("yield_logprobs"):
             body["logprobs"] = True
+        if kw.get("return_versions"):
+            body["versions"] = True
         return body
 
     def submit_many(self, prompts, max_new_tokens, **kw):
@@ -425,9 +443,12 @@ class SubprocessReplica:
         status, payload = self._post("/generate", body, timeout)
         if status != 200:
             self._raise_mapped(status, payload)
+        out: tuple = (payload["completions"],)
         if kw.get("return_logprobs"):
-            return payload["completions"], payload["logprobs"]
-        return payload["completions"]
+            out += (payload["logprobs"],)
+        if kw.get("return_versions"):
+            out += (payload.get("weights_versions"),)
+        return out if len(out) > 1 else out[0]
 
     def stream(self, tokens, max_new_tokens, **kw):
         body = self._request_body([tokens], max_new_tokens, kw)
@@ -442,6 +463,52 @@ class SubprocessReplica:
         return _HTTPStream(
             self, body, bool(kw.get("yield_logprobs")), timeout
         )
+
+    def reload(
+        self,
+        *,
+        version: str,
+        kind: str = "full",
+        path: str,
+        step: int | None = None,
+        timeout: float = 600.0,
+    ) -> dict:
+        """Hot-swap the child's serving weights through its
+        authenticated ``/admin/reload`` (the child loads ``path`` — an
+        orbax checkpoint directory — itself, swaps between decode
+        blocks, and re-warms before answering). Raises
+        :class:`~tensorflowonspark_tpu.serving.engine.WeightsIncompatible`
+        on a shape/layout mismatch (HTTP 409) so a rollout controller
+        can trigger rollback, :class:`ReplicaGone` on transport death."""
+        if self._admin_token is None:
+            raise RuntimeError(
+                f"replica {self.rid} has no admin token; spawn it "
+                "through a ServingFleet (or pass admin_token=)"
+            )
+        body: dict = {"version": str(version), "kind": kind, "path": path}
+        if step is not None:
+            body["step"] = int(step)
+        status, payload = self._post(
+            "/admin/reload",
+            body,
+            timeout,
+            headers={"Authorization": f"Bearer {self._admin_token}"},
+        )
+        if status != 200:
+            from tensorflowonspark_tpu.serving.engine import (
+                WeightsIncompatible,
+            )
+
+            msg = str(payload.get("error", f"HTTP {status}"))
+            if (
+                status == 409
+                or payload.get("error_type") == "WeightsIncompatible"
+            ):
+                raise WeightsIncompatible(msg)
+            raise RuntimeError(
+                f"replica {self.rid} reload failed: HTTP {status}: {msg}"
+            )
+        return payload
 
     # -- lifecycle -----------------------------------------------------
 
@@ -520,6 +587,7 @@ class _HTTPStream:
         self._done = False
         self.result = None
         self.logprobs = None
+        self.weights_version = None  # from the done-trailer
         try:
             self._conn = http.client.HTTPConnection(
                 "127.0.0.1", replica.port, timeout=timeout
@@ -582,6 +650,7 @@ class _HTTPStream:
             self._done = True
             self.result = line.get("completion")
             self.logprobs = line.get("logprobs")
+            self.weights_version = line.get("weights_version")
             self._conn.close()
             raise StopIteration
         if "error" in line:
@@ -637,6 +706,10 @@ class _ReplicaSlot:
         # these, reset on every successful install, so a seat that
         # respawns successfully N times over weeks never goes DEAD
         self.spawn_failures = 0  # guarded-by: self._lock
+        # True while a rollout controller holds the seat in DRAINING
+        # (hold_seat/release_seat): the respawn supervisor must leave a
+        # held seat alone — the holder owns its lifecycle
+        self.hold = False  # guarded-by: self._lock
         self.last_reason: str | None = None  # guarded-by: self._lock
         # last probe-round health verdict (fleet.health() serves THIS
         # instead of re-probing every replica per call)
@@ -743,6 +816,22 @@ class ServingFleet:
         # the router registers itself here to be told when a seat's
         # engine is replaced (its affinity/load state for it is stale)
         self.listener = None
+        # a rollout controller registers itself here: called with
+        # (rid, handle) after a respawned replica passes readiness but
+        # BEFORE it is installed/routable, so a seat respawned
+        # mid-rollout rejoins at the fleet's target weights version
+        # instead of resurrecting the boot checkpoint
+        self.rollout_hook = None
+        # shared secret for subprocess children's /admin/reload —
+        # generated per fleet, injected into each child's env at spawn
+        self.admin_token: str | None = None
+        if spawn_argv is not None:
+            token = self._spawn_kwargs.pop("admin_token", None)
+            if token is None:
+                import secrets
+
+                token = secrets.token_hex(16)
+            self.admin_token = token
 
         self.metrics = (
             registry if registry is not None else obs_registry.Registry()
@@ -828,7 +917,10 @@ class ServingFleet:
                 rid, self._factory, warmup=self._warmup
             )
         return SubprocessReplica(
-            rid, self._spawn_argv, **self._spawn_kwargs
+            rid,
+            self._spawn_argv,
+            admin_token=self.admin_token,
+            **self._spawn_kwargs,
         )
 
     def _await_readiness(self, handle, timeout: float = 120.0) -> None:
@@ -1091,6 +1183,19 @@ class ServingFleet:
             try:
                 handle.start()
                 self._await_readiness(handle)
+                hook = self.rollout_hook
+                if hook is not None:
+                    # mid-rollout respawn: bring the fresh replica (it
+                    # boots on the ORIGINAL checkpoint) to the fleet's
+                    # current target weights BEFORE it becomes routable
+                    try:
+                        hook(slot.rid, handle)
+                    except Exception:  # noqa: BLE001 - rejoin anyway
+                        logger.exception(
+                            "replica %d rollout re-sync failed; seat "
+                            "rejoins on its boot weights",
+                            slot.rid,
+                        )
             except Exception as e:  # noqa: BLE001 - retried with backoff
                 self._m_respawns.inc(outcome="failed")
                 logger.warning(
@@ -1151,6 +1256,72 @@ class ServingFleet:
         self._set_state_gauge(slot.rid, old, DEAD)
         flightrec.note("replica_dead", replica=slot.rid, reason=reason)
         logger.error("replica %d is DEAD: %s", slot.rid, reason)
+
+    # -- rollout seat holds (serving/rollout.py drives these) ----------
+
+    def hold_seat(self, rid: int, reason: str = "rollout") -> None:
+        """Flip a READY seat to DRAINING **without** scheduling a
+        respawn — the caller (a rollout controller) owns the seat until
+        :meth:`release_seat` or :meth:`force_respawn`. The router stops
+        placing new load the moment the state flips; in-flight requests
+        keep running on the handle (drain by polling
+        ``handle.unresolved()``)."""
+        slot = self._slots[int(rid)]
+        with slot._lock:
+            if slot.state != READY:
+                raise RuntimeError(
+                    f"replica {rid} is {slot.state}, not ready"
+                )
+            slot.state = DRAINING
+            slot.hold = True
+            slot.last_reason = reason
+            gen = slot.generation
+        self._set_state_gauge(slot.rid, READY, DRAINING)
+        flightrec.note(
+            "replica_drain", replica=slot.rid, reason=reason,
+            generation=gen, hold=True,
+        )
+
+    def release_seat(self, rid: int) -> None:
+        """Return a held seat to the routable set — the rejoin gate.
+        Callers verify readiness FIRST (the rollout controller gates on
+        the replica's own ``/readyz``-equivalent health); a fresh clean
+        verdict is installed so a stale cached probe cannot shadow-fail
+        the rejoined seat until the next round."""
+        slot = self._slots[int(rid)]
+        with slot._lock:
+            if not slot.hold:
+                raise RuntimeError(f"replica {rid} is not held")
+            slot.hold = False
+            if self.closed:
+                return  # close() already swept the seat
+            slot.state = READY
+            slot.misses = 0
+            slot.last_health = {"live": True, "ready": True}
+        self._set_state_gauge(slot.rid, DRAINING, READY)
+
+    def force_respawn(self, rid: int, reason: str) -> None:
+        """Last-resort seat recovery for a holder whose restore failed
+        (e.g. rollback could not re-install the prior weights): clear
+        the hold and run the ordinary respawn path — a FRESH replica
+        from the factory/spawn argv, serving its boot weights."""
+        slot = self._slots[int(rid)]
+        with slot._lock:
+            slot.hold = False
+            if slot.state == DEAD:
+                return
+            old = slot.state if slot.state != DRAINING else None
+            slot.state = DRAINING
+            slot.last_reason = reason
+        if old is not None:
+            self._set_state_gauge(slot.rid, old, DRAINING)
+        flightrec.note("replica_drain", replica=slot.rid, reason=reason)
+        threading.Thread(
+            target=self._respawn_seat,
+            args=(slot, reason),
+            daemon=True,
+            name=f"fleet-respawn-{slot.rid}",
+        ).start()
 
     # -- drain / shutdown ----------------------------------------------
 

@@ -472,6 +472,12 @@ class FleetRouter:
 
     # -- observability -------------------------------------------------
 
+    @property
+    def fleet(self) -> ServingFleet:
+        """The fleet behind this router (rollout controllers target
+        it; the router stays the request-path surface)."""
+        return self._fleet
+
     def health(self) -> dict:
         return self._fleet.health()
 
@@ -687,6 +693,16 @@ class _RoutedStream:
     @property
     def logprobs(self):
         return None if self._inner is None else self._inner.logprobs
+
+    @property
+    def weights_version(self):
+        """The serving replica's per-request weights stamp (rollout
+        coherence surface) — None until the stream resolves."""
+        return (
+            None
+            if self._inner is None
+            else getattr(self._inner, "weights_version", None)
+        )
 
     def close(self) -> None:
         if self._inner is not None:

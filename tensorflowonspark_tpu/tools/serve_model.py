@@ -57,6 +57,18 @@ Endpoints::
                                 keep this server's ``{"error": str}``
                                 shape.
     GET  /v1/models          -> single-model list (``--served-model-name``)
+    POST /admin/reload       -> authenticated weight hot-swap (token
+                                from --admin-token-file or
+                                TFOS_ADMIN_TOKEN; 403 without one):
+                                body {"version", "path", "kind"} loads
+                                a published orbax checkpoint and swaps
+                                it into the live engine(s) between
+                                decode blocks — synchronous for a
+                                single engine, 202 + rolling update in
+                                fleet mode. ``--rollout-channel DIR``
+                                instead watches a publication channel
+                                (docs/SERVING.md "Rolling weight
+                                updates")
 
 Usage::
 
@@ -98,6 +110,12 @@ class _Handler(BaseHTTPRequestHandler):
     gen_max_new: int = 64  # per-request decode budget in engine mode
     score_fn: Any = None  # sequences -> per-token logprobs (/score)
     model_name: str = "default"  # /v1/models id + completion envelopes
+    # zero-downtime weight rollout (docs/SERVING.md "Rolling weight
+    # updates"): the RolloutController driving this server's engine(s),
+    # and the shared secret gating POST /admin/reload (None = endpoint
+    # disabled — hot-swapping weights is an operator-only surface)
+    rollout_ctl: Any = None
+    admin_token: str | None = None
     # per-server lock (set in make_server): serializes jax dispatch on
     # one model while the HTTP layer stays threaded, so health checks
     # never queue behind a big batch
@@ -199,6 +217,8 @@ class _Handler(BaseHTTPRequestHandler):
                         else "continuous"
                     ),
                 )
+                if self.rollout_ctl is not None:
+                    stats["rollout"] = self.rollout_ctl.stats()
             elif self.gen_batcher is not None:
                 stats.update(
                     mode="coalesced",
@@ -213,6 +233,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802 - http.server API
         if self.path == "/generate":
             self._do_generate()
+            return
+        if self.path == "/admin/reload":
+            self._do_admin_reload()
             return
         if self.path == "/v1/completions":
             self._do_v1_completions()
@@ -249,6 +272,107 @@ class _Handler(BaseHTTPRequestHandler):
         # outside the try: a client hanging up mid-response must not be
         # logged as a prediction failure nor answered with a second reply
         self._reply(200, {"predictions": [_to_jsonable(p) for p in preds]})
+
+    def _do_admin_reload(self) -> None:
+        """Authenticated hot weight swap (docs/SERVING.md "Rolling
+        weight updates"). Body: ``{"version": ..., "path": <committed
+        orbax checkpoint dir>, "kind": "full"|"lora", "step": N?}``.
+
+        Single-engine mode answers SYNCHRONOUSLY once the swap,
+        re-warm, and verification finished (this is the surface a
+        fleet supervisor's ``SubprocessReplica.reload`` drives): 200
+        on ``completed``, 409 on a shape/layout mismatch
+        (``WeightsIncompatible`` — the caller triggers rollback), 500
+        otherwise. Fleet mode (the router front-end) starts a rolling
+        update in the background and answers 202 — rolling N replicas
+        under drain is minutes, not an HTTP round trip."""
+        import hmac
+
+        if self.admin_token is None:
+            self._reply(
+                403,
+                {"error": "admin endpoint disabled (no admin token "
+                          "configured: set TFOS_ADMIN_TOKEN or "
+                          "--admin-token-file)"},
+            )
+            return
+        auth = self.headers.get("Authorization", "")
+        token = (
+            auth[len("Bearer "):]
+            if auth.startswith("Bearer ")
+            else self.headers.get("X-Admin-Token", "")
+        )
+        if not hmac.compare_digest(token, self.admin_token):
+            self._reply(403, {"error": "invalid admin token"})
+            return
+        if self.rollout_ctl is None:
+            self._reply(
+                400,
+                {"error": "/admin/reload requires --gen-engine "
+                          "continuous"},
+            )
+            return
+        from tensorflowonspark_tpu.serving.rollout import WeightsUpdate
+
+        try:
+            payload = self._read_json_body()
+            update = WeightsUpdate(
+                version=str(payload["version"]),
+                kind=str(payload.get("kind") or "full"),
+                path=str(payload["path"]),
+                step=(
+                    None
+                    if payload.get("step") is None
+                    else int(payload["step"])
+                ),
+            )
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        ctl = self.rollout_ctl
+        if getattr(self.gen_engine, "IS_FLEET", False):
+            threading.Thread(
+                target=ctl.roll, args=(update,), daemon=True,
+                name="admin-rollout",
+            ).start()
+            self._reply(
+                202, {"status": "rolling", "version": update.version}
+            )
+            return
+        t0 = time.monotonic()
+        try:
+            outcome = ctl.roll(update)
+        except Exception as e:  # noqa: BLE001 - ferried to the caller
+            logger.exception("admin reload crashed")
+            self._reply(
+                500,
+                {"error": f"{type(e).__name__}: {e}",
+                 "error_type": type(e).__name__},
+            )
+            return
+        if outcome == "completed":
+            self._reply(
+                200,
+                {
+                    "status": "completed",
+                    "version": update.version,
+                    "swap_seconds": round(time.monotonic() - t0, 3),
+                },
+            )
+            return
+        err = ctl.last_error or {}
+        etype = err.get("type", "RolloutFailed")
+        self._reply(
+            409 if etype == "WeightsIncompatible" else 500,
+            {
+                "error": (
+                    f"rollout {outcome}: "
+                    f"{err.get('error', 'unknown failure')}"
+                ),
+                "error_type": etype,
+                "outcome": outcome,
+            },
+        )
 
     def _do_score(self) -> None:
         if self.score_fn is None:
@@ -323,6 +447,9 @@ class _Handler(BaseHTTPRequestHandler):
             req_bias = payload.get("logit_bias")
             req_deadline = payload.get("deadline_s")
             want_logprobs = bool(payload.get("logprobs"))
+            # rollout coherence surface: stamp each completion with the
+            # weights version it resolved under (continuous engine only)
+            want_versions = bool(payload.get("versions"))
             if (
                 temperature is not None
                 or max_new is not None
@@ -339,6 +466,7 @@ class _Handler(BaseHTTPRequestHandler):
                 or req_bias is not None
                 or req_deadline is not None
                 or want_logprobs
+                or want_versions
             ) and self.gen_engine is None:
                 raise ValueError(
                     "per-request temperature/max_new_tokens/eos_id/"
@@ -444,6 +572,7 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
         logprobs = None
+        versions = None
         try:
             if self.gen_engine is not None:
                 try:
@@ -454,7 +583,14 @@ class _Handler(BaseHTTPRequestHandler):
                         want_logprobs, adapter, stop, req_top_k,
                         req_top_p, req_seed, req_min_p, req_fpen,
                         req_ppen, req_bias, req_deadline,
+                        want_versions,
                     )
+                    versions = None
+                    if want_versions:
+                        *rest, versions = completions
+                        completions = (
+                            rest[0] if len(rest) == 1 else tuple(rest)
+                        )
                     if want_logprobs:
                         completions, logprobs = completions
                     if n > 1 and v1_meta is None:
@@ -469,6 +605,11 @@ class _Handler(BaseHTTPRequestHandler):
                         if logprobs is not None:
                             logprobs = [
                                 logprobs[i * n : (i + 1) * n]
+                                for i in range(len(prompts))
+                            ]
+                        if versions is not None:
+                            versions = [
+                                versions[i * n : (i + 1) * n]
                                 for i in range(len(prompts))
                             ]
                 except FleetOverloaded as e:
@@ -591,6 +732,8 @@ class _Handler(BaseHTTPRequestHandler):
         body = {"completions": completions}
         if logprobs is not None:
             body["logprobs"] = logprobs
+        if versions is not None:
+            body["weights_versions"] = versions
         self._reply(200, body)
 
     def _engine_stream(
@@ -693,6 +836,9 @@ class _Handler(BaseHTTPRequestHandler):
                 trailer["logprobs"] = (
                     gen.logprobs if gen.result is not None else lps
                 )
+            wv = getattr(gen, "weights_version", None)
+            if wv is not None:
+                trailer["weights_version"] = wv
             self.wfile.write(json.dumps(trailer).encode() + b"\n")
         except (BrokenPipeError, ConnectionResetError):
             logger.info("stream client disconnected")
@@ -735,6 +881,7 @@ class _Handler(BaseHTTPRequestHandler):
         presence_penalty=None,
         logit_bias=None,
         deadline_s=None,
+        want_versions=False,
     ):
         """Continuous-batching path: the request's rows are admitted
         ATOMICALLY (all accepted, or a 400/503 before any decodes — a
@@ -757,6 +904,7 @@ class _Handler(BaseHTTPRequestHandler):
             presence_penalty=presence_penalty,
             logit_bias=logit_bias,
             deadline_s=deadline_s,
+            return_versions=want_versions,
         )
 
 
@@ -1284,10 +1432,16 @@ class _Server(ThreadingHTTPServer):
 
     gen_batcher = None
     gen_engine = None
+    rollout_ctl = None
     drain_on_shutdown = False
 
     def shutdown(self) -> None:
         super().shutdown()
+        if self.rollout_ctl is not None:
+            # stop watching the channel BEFORE the engines go away —
+            # a rollout racing teardown would hold seats of a closing
+            # fleet
+            self.rollout_ctl.stop()
         if self.gen_batcher is not None:
             self.gen_batcher.close()
         if self.gen_engine is not None:
@@ -1349,6 +1503,28 @@ def make_server(
     window = float(gen.get("batch_window", 0.0) or 0.0) if gen else 0.0
     if gen_fn is not None and window > 0:
         batcher = _GenBatcher(gen_fn, lock, window, gen_bsz)
+    rollout_ctl = None
+    if engine is not None:
+        # Zero-downtime weight rollout plane (docs/SERVING.md "Rolling
+        # weight updates"): a controller always fronts the continuous
+        # engine(s) — /admin/reload drives it directly, and
+        # --rollout-channel additionally starts the channel watcher.
+        # Construction is cheap: no threads until start().
+        from tensorflowonspark_tpu.serving.rollout import (
+            RolloutController,
+            checkpoint_loader,
+        )
+
+        rollout_ctl = RolloutController(
+            engine.fleet
+            if getattr(engine, "IS_FLEET", False)
+            else engine,
+            channel_dir=gen.get("rollout_channel"),
+            loader=checkpoint_loader(lm_params),
+            poll_interval=float(gen.get("rollout_poll") or 2.0),
+        )
+        if gen.get("rollout_channel"):
+            rollout_ctl.start()
     handler = type(
         "_BoundHandler",
         (_Handler,),
@@ -1370,12 +1546,17 @@ def make_server(
                 if gen
                 else "default"
             ),
+            "rollout_ctl": rollout_ctl,
+            "admin_token": (
+                gen.get("admin_token") if gen else None
+            ),
             "predict_lock": lock,
         },
     )
     server = _Server((host, port), handler)
     server.gen_batcher = batcher
     server.gen_engine = engine
+    server.rollout_ctl = rollout_ctl
     server.drain_on_shutdown = bool(
         gen.get("drain_on_shutdown") if gen else False
     )
@@ -1567,6 +1748,31 @@ def main(argv: list[str] | None = None) -> int:
         "spawn barrier fleet supervisors poll",
     )
     p.add_argument(
+        "--admin-token-file",
+        default=None,
+        help="enable the authenticated POST /admin/reload weight "
+        "hot-swap endpoint with the token read from this file "
+        "(alternatively set TFOS_ADMIN_TOKEN — fleet supervisors "
+        "inject it into subprocess replicas); without a token the "
+        "endpoint answers 403",
+    )
+    p.add_argument(
+        "--rollout-channel",
+        default=None,
+        help="continuous engine: watch this checkpoint publication "
+        "channel directory (an atomically-written LATEST pointer at "
+        "orbax step dirs; see serving/rollout.py) and hot-swap each "
+        "newly published version into the live engine(s) — rolled one "
+        "replica at a time under router health with --gen-replicas, "
+        "with automatic rollback on failure",
+    )
+    p.add_argument(
+        "--rollout-poll",
+        type=float,
+        default=2.0,
+        help="rollout channel poll interval in seconds",
+    )
+    p.add_argument(
         "--gen-watchdog",
         type=float,
         default=None,
@@ -1587,7 +1793,20 @@ def main(argv: list[str] | None = None) -> int:
         )
     if args.gen_replicas < 1:
         p.error(f"--gen-replicas must be >= 1, got {args.gen_replicas}")
+    if args.rollout_channel and args.gen_engine != "continuous":
+        p.error(
+            "--rollout-channel requires --gen-engine continuous "
+            "(only the continuous engine hot-swaps weights)"
+        )
     logging.basicConfig(level=logging.INFO)
+    admin_token = None
+    if args.admin_token_file:
+        with open(args.admin_token_file, encoding="utf-8") as f:
+            admin_token = f.read().strip() or None
+    if admin_token is None:
+        import os as _os
+
+        admin_token = _os.environ.get("TFOS_ADMIN_TOKEN") or None
     gen = None
     if args.llama_checkpoint is not None:
         gen = dict(
@@ -1624,6 +1843,9 @@ def main(argv: list[str] | None = None) -> int:
             served_model_name=args.served_model_name,
             replicas=args.gen_replicas,
             probe_interval=args.gen_probe_interval,
+            admin_token=admin_token,
+            rollout_channel=args.rollout_channel,
+            rollout_poll=args.rollout_poll,
         )
     server = make_server(
         args.export_dir, args.port, args.batch_size, host=args.host, gen=gen

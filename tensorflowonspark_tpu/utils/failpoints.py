@@ -111,6 +111,15 @@ SITES = frozenset(
         # (a raised probe is a missed beat toward DRAINING)
         "fleet.replica_spawn",  # replica (re)spawn, before the engine/
         # process is built (a raise exercises respawn retry/DEAD)
+        # zero-downtime weight rollout (serving/rollout.py — see
+        # docs/ROBUSTNESS.md "Rolling weight updates")
+        "rollout.publish",  # channel manifest write ("drop" aware: a
+        # lost publication is bounded staleness — watchers keep serving
+        # the prior version, never a torn pointer)
+        "rollout.swap",  # controller, before swapping one seat (a
+        # raise triggers automatic rollback of already-swapped seats)
+        "rollout.verify",  # controller, post-swap verification of a
+        # seat (a raise = failed warmup/health regression → rollback)
         # checkpoint plane
         "checkpoint.save",  # orbax save (inside the retry)
         "checkpoint.restore",  # orbax restore (inside the retry)

@@ -30,24 +30,22 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: the suite is compile-dominated (hundreds
-# of jit programs, most identical across runs), so cache XLA executables
-# on disk keyed by HLO hash. First run pays full compile; repeat runs —
-# the local iteration loop this exists for — skip it. Safe across code
-# changes (key = hash of the lowered program, not the Python source).
-# Subprocess nodes inherit the env var and share the cache.
-# Per-user path: a fixed /tmp name would break (or be poisonable) for
-# every user but the first on a shared machine.
-_cache_dir = os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(
-        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
-        "tensorflowonspark_tpu",
-        "jax_test_compile_cache",
-    ),
-)
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# Persistent compilation cache: DISABLED (environment drift, found in
+# PR 15's tier-1): on this jaxlib (0.4.36 CPU), a MULTI-DEVICE/sharded
+# executable restored from the persistent cache corrupts the heap when
+# executed more than once — glibc aborts with "corrupted double-linked
+# list" (reproduced standalone: the sharded llama train step on the
+# 8-device mesh passes on the compile run, SIGABRTs on every
+# cache-hit run; single-device programs are unaffected;
+# jax_persistent_cache_enable_xla_caches="none" does not help). The
+# crash surfaced as native aborts in test_models /
+# test_engine_pipeline and SIGSEGVs in bench subprocesses that
+# inherited JAX_COMPILATION_CACHE_DIR from this env. No knob excludes
+# only sharded programs, so the suite pays repeat compiles instead of
+# flaky native crashes. Re-enable (cache dir + min_compile_time 0.5s +
+# the env setdefault so node subprocesses share it) only after a
+# jaxlib bump proves the round-trip sound for sharded executables.
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 
 import pytest  # noqa: E402
 

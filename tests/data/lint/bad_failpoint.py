@@ -58,3 +58,14 @@ def fleet_clean_sites():
     failpoint("fleet.dispatch")
     failpoint("fleet.replica_probe")
     failpoint("fleet.replica_spawn")
+
+
+def rollout_typo_site():
+    failpoint("rollout.swpa")  # SEEDED VIOLATION FP001: unregistered
+
+
+def rollout_clean_sites():
+    # registered weight-rollout sites: must NOT be flagged
+    failpoint("rollout.publish")
+    failpoint("rollout.swap")
+    failpoint("rollout.verify")

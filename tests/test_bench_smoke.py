@@ -234,3 +234,45 @@ def test_bench_serve_fleet_smoke_emits_scaling_and_artifact():
     assert os.path.exists(art)
     on_disk = json.load(open(art))
     assert on_disk["metric"] == "serve_fleet_scaling"
+
+
+def test_bench_rollout_smoke_zero_downtime_artifact():
+    """bench.py --rollout end-to-end on the tiny model: K=2 versions
+    hot-swap through a 2-replica fleet under sustained streaming load;
+    the emitted JSON (and committed artifact) must pass every
+    acceptance check — zero dropped/hung requests, admitted p99 within
+    the deadline budget, coherent per-completion version stamps."""
+    env = dict(
+        os.environ,
+        BENCH_SMOKE="1",
+        BENCH_ALLOW_CPU="1",
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PALLAS_AXON_REMOTE_COMPILE="",
+    )
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--rollout"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "rollout_zero_downtime"
+    assert out["smoke"] is True
+    assert out["passed"] is True, out["checks"]
+    assert all(out["checks"].values()), out["checks"]
+    assert out["versions_rolled"] == 2
+    assert all(
+        r["outcome"] == "completed" for r in out["rollouts"]
+    )
+    assert out["requests_ok"] > 0
+    assert out["requests_hard_errors"] == 0
+    assert out["hung_workers"] == 0
+    assert out["admitted_p99_s"] <= out["deadline_budget_s"]
+    assert set(out["version_counts"]) <= {"v0", "v1", "v2"}
+    art = os.path.join(REPO, out["artifact"])
+    assert os.path.exists(art)
+    assert json.load(open(art))["metric"] == "rollout_zero_downtime"

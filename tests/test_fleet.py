@@ -875,7 +875,7 @@ def test_engine_health_split(tiny):
         h = eng.health()
         assert h == {
             "live": True, "ready": True, "warming": False,
-            "closed": False,
+            "closed": False, "weights_version": "v0",
         }
         eng._warming = True
         assert eng.health()["ready"] is False
@@ -1039,7 +1039,15 @@ def test_fleet_sigkill_replica_under_streaming_load(tiny, tmp_path):
         "--gen-engine", "continuous", "--gen-width", "8",
         "--max-new-tokens", "64", "--gen-slots", "4", "--gen-warmup",
     ]
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # throwaway compile cache for the SIGKILL-able children: a killed
+    # process must never share a persistent compile cache others read
+    # (a kill mid-write can tear an entry; see tests/test_rollout.py's
+    # SIGKILL e2e and tests/conftest.py for the full note)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        JAX_COMPILATION_CACHE_DIR=str(tmp_path / "child-jax-cache"),
+    )
     fleet = ServingFleet(
         spawn_argv=argv,
         replicas=2,

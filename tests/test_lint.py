@@ -173,10 +173,11 @@ def test_failpoint_rule_reports_seeded_violations(fixture_findings):
         _line_of("bad_failpoint.py", "ingest.read_blck"),
         _line_of("bad_failpoint.py", "ingest.handover_drian"),
         _line_of("bad_failpoint.py", "fleet.dispach"),
+        _line_of("bad_failpoint.py", "rollout.swpa"),
     }, [f.render() for f in hits]
     dynamic = [f for f in hits if "string literal" in f.message]
     unregistered = [f for f in hits if "not registered" in f.message]
-    assert len(dynamic) == 1 and len(unregistered) == 5
+    assert len(dynamic) == 1 and len(unregistered) == 6
     # the REGISTERED elastic + pull-plane sites are in the rule's
     # registry view: the fixture's clean literals produced no findings
     clean_lines = {
@@ -192,6 +193,9 @@ def test_failpoint_rule_reports_seeded_violations(fixture_findings):
         _line_of("bad_failpoint.py", '"fleet.dispatch"'),
         _line_of("bad_failpoint.py", '"fleet.replica_probe"'),
         _line_of("bad_failpoint.py", '"fleet.replica_spawn"'),
+        _line_of("bad_failpoint.py", '"rollout.publish"'),
+        _line_of("bad_failpoint.py", '"rollout.swap"'),
+        _line_of("bad_failpoint.py", '"rollout.verify"'),
     }
     assert not clean_lines & {f.line for f in hits}
 

@@ -1,0 +1,1351 @@
+"""Zero-downtime weight rollout: channel atomicity, rolling hot-swap
+under router health, automatic rollback, version-coherent serving.
+
+Fast tier drives the RolloutController against scripted stub engines
+(deterministic, no compiles) plus real-tiny-engine legs for the swap
+hook itself (full + LoRA parity, prefix/affinity invalidation) and one
+HTTP leg for the authenticated /admin/reload. The two slow chaos e2e
+tests SIGKILL a subprocess replica mid-rollout under streaming load,
+and publish a corrupt checkpoint under load (automatic rollback, old
+version served throughout).
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tensorflowonspark_tpu.serving.engine import WeightsIncompatible
+from tensorflowonspark_tpu.serving.fleet import (
+    DRAINING,
+    READY,
+    ServingFleet,
+)
+from tensorflowonspark_tpu.serving.rollout import (
+    MANIFEST_NAME,
+    RolloutController,
+    WeightsUpdate,
+    checkpoint_loader,
+    lora_state,
+    publish_checkpoint,
+    publish_params,
+    read_latest,
+)
+from tensorflowonspark_tpu.serving.router import FleetRouter
+from tensorflowonspark_tpu.utils import failpoints
+
+
+@pytest.fixture(autouse=True)
+def _no_failpoints():
+    yield
+    failpoints.disarm_all()
+
+
+# -- scripted stub engines ---------------------------------------------------
+
+
+class _StubMetrics:
+    def render(self):
+        return "# TYPE stub_up gauge\nstub_up 1\n"
+
+
+class _StubEngine:
+    """Engine-shaped double with a scriptable hot-swap surface."""
+
+    def __init__(self, events=None, version="v0"):
+        self.version = str(version)
+        self.live = True
+        self.ready = True
+        self.swap_log = []  # (version, kind)
+        self.swap_error = None  # raised by swap_weights when set
+        self.swap_error_once = None  # raised by the FIRST swap only
+        self.probe_error = None  # raised by submit (the re-warm probe)
+        self.probe_kwargs = []  # kwargs of each re-warm probe submit
+        # after a swap, report not-ready for this many health() calls
+        # (then ready again) — exercises the readiness gate
+        self.not_ready_health_calls = 0
+        self._pending_not_ready = 0
+        self.health_calls = 0
+        self.unresolved_count = 0
+        self.closed = False
+        self.metrics = _StubMetrics()
+        self._events = events if events is not None else []
+
+    def warmup(self):
+        pass
+
+    def health(self):
+        self.health_calls += 1
+        ready = self.ready
+        if self._pending_not_ready > 0:
+            self._pending_not_ready -= 1
+            ready = False
+        return {
+            "live": self.live,
+            "ready": ready,
+            "weights_version": self.version,
+        }
+
+    def stats(self):
+        return {
+            "slots": 2,
+            "slots_busy": 0,
+            "queue_depth": 0,
+            "watchdog_fires": 0,
+            "weights_version": self.version,
+            "unresolved": self.unresolved_count,
+        }
+
+    def unresolved(self):
+        return self.unresolved_count
+
+    def current_weights(self):
+        return self.version, {"w": self.version}
+
+    def swap_weights(self, new_params, *, version, kind="full",
+                     timeout=120.0):
+        if self.swap_error_once is not None:
+            err, self.swap_error_once = self.swap_error_once, None
+            raise err
+        if self.swap_error is not None:
+            raise self.swap_error
+        self.swap_log.append((str(version), kind))
+        self._events.append(("swap", id(self), str(version)))
+        self.version = str(version)
+        self._pending_not_ready = self.not_ready_health_calls
+        return self.version
+
+    def submit(self, tokens, max_new_tokens, **kw):
+        self.probe_kwargs.append(dict(kw))
+        if self.probe_error is not None:
+            raise self.probe_error
+        return [7] * int(max_new_tokens)
+
+    def submit_many(self, prompts, max_new_tokens, **kw):
+        return [[7] * min(int(max_new_tokens), 3) for _ in prompts]
+
+    def stream(self, tokens, max_new_tokens, **kw):
+        raise NotImplementedError
+
+    def close(self, drain=False, drain_timeout=300.0):
+        self.closed = True
+        self.live = False
+        self.ready = False
+
+
+def _stub_fleet(n=2, events=None, **kw):
+    made = []
+    events = events if events is not None else []
+
+    def factory():
+        e = _StubEngine(events=events)
+        made.append(e)
+        return e
+
+    kw.setdefault("probe_interval", 5.0)  # tests drive probes manually
+    kw.setdefault("warmup", False)
+    kw.setdefault("respawn_backoff_s", 0.01)
+    kw.setdefault("drain_timeout", 2.0)
+    fleet = ServingFleet(factory=factory, replicas=n, **kw)
+    return fleet, made, events
+
+
+def _ctl(fleet, **kw):
+    kw.setdefault("drain_timeout", 2.0)
+    kw.setdefault("verify_timeout", 2.0)
+    return RolloutController(fleet, **kw)
+
+
+def _gauge_values(registry, name="fleet_weights_version"):
+    out = {}
+    for line in registry.render().splitlines():
+        if line.startswith(name + "{"):
+            labels, val = line[len(name):].rsplit(" ", 1)
+            out[labels] = float(val)
+    return out
+
+
+# -- publication channel -----------------------------------------------------
+
+
+def _fake_complete_ckpt(tmp_path, name="ck"):
+    d = tmp_path / name
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "_CHECKPOINT_METADATA").write_text("{}")
+    return str(d)
+
+
+def test_publish_read_latest_round_trip(tmp_path):
+    ch = str(tmp_path / "chan")
+    ck = _fake_complete_ckpt(tmp_path)
+    publish_checkpoint(ch, version="v7", path=ck, kind="lora", step=7)
+    upd = read_latest(ch)
+    assert upd == WeightsUpdate(
+        version="v7", kind="lora", path=ck, step=7
+    )
+
+
+def test_read_latest_empty_and_missing_channel(tmp_path):
+    assert read_latest(str(tmp_path / "nope")) is None
+
+
+def test_read_latest_rejects_torn_pointer(tmp_path):
+    ch = tmp_path / "chan"
+    ch.mkdir()
+    # truncated mid-write: unparsable JSON must be ignored, not crash
+    (ch / MANIFEST_NAME).write_text('{"crc": 123, "manifest": {"ver')
+    assert read_latest(str(ch)) is None
+    # parsable but CRC-mismatched (content torn across a non-atomic FS)
+    ck = _fake_complete_ckpt(tmp_path)
+    publish_checkpoint(str(ch), version="v1", path=ck)
+    raw = json.loads((ch / MANIFEST_NAME).read_text())
+    raw["manifest"]["version"] = "v2-tampered"
+    (ch / MANIFEST_NAME).write_text(json.dumps(raw))
+    assert read_latest(str(ch)) is None
+
+
+def test_read_latest_rejects_partial_checkpoint(tmp_path):
+    ch = str(tmp_path / "chan")
+    # no _CHECKPOINT_METADATA: an uncommitted/partially copied dir
+    partial = tmp_path / "partial"
+    partial.mkdir()
+    (partial / "manifest.ocdbt").write_text("x")
+    publish_checkpoint(ch, version="v1", path=str(partial))
+    assert read_latest(ch) is None
+    # an orbax tmp dir name is in-progress by definition
+    tmpdir = tmp_path / "step.orbax-checkpoint-tmp-123"
+    tmpdir.mkdir()
+    (tmpdir / "_CHECKPOINT_METADATA").write_text("{}")
+    publish_checkpoint(ch, version="v2", path=str(tmpdir))
+    assert read_latest(ch) is None
+    # a pointer at a path that does not exist at all
+    publish_checkpoint(ch, version="v3", path=str(tmp_path / "gone"))
+    assert read_latest(ch) is None
+
+
+def test_read_latest_trusts_final_named_remote_paths(tmp_path):
+    """Review regression: a remote URI cannot be probed with local FS
+    calls — a final-named gs:// path must be accepted (publisher's
+    contract), while a remote tmp-named dir is still rejected."""
+    from tensorflowonspark_tpu.compute.checkpoint import (
+        checkpoint_complete,
+    )
+
+    assert checkpoint_complete("gs://bucket/ckpt/50")
+    assert not checkpoint_complete(
+        "gs://bucket/ckpt/50.orbax-checkpoint-tmp-123"
+    )
+    ch = str(tmp_path / "chan")
+    publish_checkpoint(ch, version="v9", path="gs://bucket/ckpt/50")
+    upd = read_latest(ch)
+    assert upd is not None and upd.path == "gs://bucket/ckpt/50"
+
+
+def test_publish_failpoint_drop_is_lost_publication(tmp_path):
+    ch = str(tmp_path / "chan")
+    ck = _fake_complete_ckpt(tmp_path)
+    failpoints.arm("rollout.publish", "drop", count=1)
+    publish_checkpoint(ch, version="v1", path=ck)
+    assert read_latest(ch) is None  # nothing written
+    publish_checkpoint(ch, version="v2", path=ck)  # disarmed: lands
+    assert read_latest(ch).version == "v2"
+
+
+# -- controller over scripted stubs ------------------------------------------
+
+
+def test_rolling_order_one_seat_at_a_time_under_hold():
+    events = []
+    fleet, stubs, _ = _stub_fleet(events=events)
+    orig_hold, orig_release = fleet.hold_seat, fleet.release_seat
+
+    def hold(rid, reason="rollout"):
+        events.append(("hold", rid))
+        return orig_hold(rid, reason)
+
+    def release(rid):
+        events.append(("release", rid))
+        return orig_release(rid)
+
+    fleet.hold_seat, fleet.release_seat = hold, release
+    try:
+        ctl = _ctl(fleet)
+        assert ctl.publish({"w": 1}, version="v1") == "completed"
+        # strictly one seat at a time: hold(0) .. release(0) fully
+        # precedes hold(1) .. release(1)
+        seq = [e for e in events if e[0] in ("hold", "release")]
+        assert seq == [
+            ("hold", 0), ("release", 0), ("hold", 1), ("release", 1),
+        ], events
+        assert [s.version for s in stubs] == ["v1", "v1"]
+        assert fleet.states() == {0: READY, 1: READY}
+    finally:
+        fleet.close()
+
+
+def test_rejoin_gated_on_readiness():
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        for s in stubs:
+            s.not_ready_health_calls = 3  # warming after each swap
+        ctl = _ctl(fleet, verify_timeout=5.0)
+        assert ctl.publish({"w": 1}, version="v1") == "completed"
+        # the verify loop actually polled through the not-ready phase
+        assert all(s.health_calls >= 3 for s in stubs)
+        assert fleet.states() == {0: READY, 1: READY}
+    finally:
+        fleet.close()
+
+
+def test_drain_waits_for_quiescence_then_swaps():
+    fleet, stubs, _ = _stub_fleet(n=1)
+    try:
+        stubs[0].unresolved_count = 1
+
+        def finish():
+            time.sleep(0.3)
+            stubs[0].unresolved_count = 0
+
+        t = threading.Thread(target=finish, daemon=True)
+        ctl = _ctl(fleet, drain_timeout=5.0)
+        t.start()
+        t0 = time.monotonic()
+        assert ctl.publish({"w": 1}, version="v1") == "completed"
+        assert time.monotonic() - t0 >= 0.25  # waited for quiescence
+    finally:
+        fleet.close()
+
+
+def test_drain_timeout_rolls_back():
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        stubs[0].unresolved_count = 7  # never quiesces
+        ctl = _ctl(fleet, drain_timeout=0.3)
+        assert ctl.publish({"w": 1}, version="v1") == "rolled_back"
+        assert stubs[0].swap_log == []  # weights never touched
+        assert fleet.states() == {0: READY, 1: READY}
+        assert [s.version for s in stubs] == ["v0", "v0"]
+    finally:
+        fleet.close()
+
+
+def test_rollback_on_failed_warmup_restores_swapped_seats():
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        stubs[1].probe_error = RuntimeError("decode exploded")
+        ctl = _ctl(fleet)
+        assert ctl.publish({"w": 1}, version="v1") == "rolled_back"
+        # seat 0 swapped v1 then rolled back to v0; seat 1's failed
+        # swap also restored
+        assert [v for v, _ in stubs[0].swap_log] == ["v1", "v0"]
+        assert stubs[0].version == "v0"
+        assert stubs[1].version == "v0"
+        assert fleet.states() == {0: READY, 1: READY}
+        err = ctl.last_error
+        assert err and err["type"] == "RuntimeError"
+        assert ctl.stats()["outcomes"] == {"rolled_back": 1}
+    finally:
+        fleet.close()
+
+
+def test_rollback_on_health_regression():
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        # seat 1 never comes back ready after its swap
+        stubs[1].not_ready_health_calls = 10_000
+        ctl = _ctl(fleet, verify_timeout=0.4)
+        assert ctl.publish({"w": 1}, version="v1") == "rolled_back"
+        assert stubs[0].version == "v0"
+        # the regressed seat was restored too (rollback re-swap resets
+        # the not-ready counter again, then verify passes eventually —
+        # restore escalated to respawn if it could not)
+        assert fleet.states()[0] == READY
+    finally:
+        fleet.close()
+
+
+def test_rollback_on_weights_incompatible():
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        stubs[0].swap_error = WeightsIncompatible("shape mismatch")
+        ctl = _ctl(fleet)
+        assert ctl.publish({"w": 1}, version="v1") == "rolled_back"
+        assert ctl.last_error["type"] == "WeightsIncompatible"
+        assert [s.version for s in stubs] == ["v0", "v0"]
+        assert fleet.states() == {0: READY, 1: READY}
+    finally:
+        fleet.close()
+
+
+def test_mixed_version_fleet_metrics_labelling():
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        ctl = _ctl(fleet)
+        # seat 1 is held away (e.g. draining for other reasons): the
+        # rollout covers seat 0 only — a legitimately mixed fleet
+        fleet.hold_seat(1, reason="test")
+        assert ctl.publish({"w": 1}, version="v1") == "completed"
+        assert stubs[0].version == "v1" and stubs[1].version == "v0"
+        vals = _gauge_values(fleet.metrics)
+        assert vals['{replica="0"}'] != vals['{replica="1"}'], vals
+        # per-seat versions ride the controller stats too
+        assert ctl.stats()["applied"] == {"0": "v1"}
+        fleet.release_seat(1)
+    finally:
+        fleet.close()
+
+
+def test_swap_failpoint_rolls_back_before_any_seat_touched():
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        failpoints.arm("rollout.swap", "raise", count=1)
+        ctl = _ctl(fleet)
+        assert ctl.publish({"w": 1}, version="v1") == "rolled_back"
+        assert all(s.swap_log == [] for s in stubs)
+        assert fleet.states() == {0: READY, 1: READY}
+    finally:
+        fleet.close()
+
+
+def test_verify_failpoint_rolls_back_swapped_seat():
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        failpoints.arm("rollout.verify", "raise", count=1)
+        ctl = _ctl(fleet)
+        assert ctl.publish({"w": 1}, version="v1") == "rolled_back"
+        # seat 0 swapped, verify raised, rollback re-installed v0
+        assert [v for v, _ in stubs[0].swap_log] == ["v1", "v0"]
+        assert stubs[1].swap_log == []
+        assert fleet.states() == {0: READY, 1: READY}
+    finally:
+        fleet.close()
+
+
+def test_respawned_replica_resyncs_to_target_version():
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        ctl = _ctl(fleet)
+        assert ctl.publish({"w": 1}, version="v1") == "completed"
+        # kill seat 0's engine: request-path verdict drains + respawns
+        fleet.report_failure(0, "test kill", generation=0)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if (
+                fleet.states()[0] == READY
+                and len(stubs) >= 3
+                and stubs[-1].version == "v1"
+            ):
+                break
+            time.sleep(0.02)
+        assert fleet.states()[0] == READY
+        fresh = stubs[-1]
+        assert fresh.version == "v1", "respawn hook must re-sync"
+        assert ("v1", "full") in fresh.swap_log
+    finally:
+        fleet.close()
+
+
+def test_lost_seat_is_skipped_not_rolled_back():
+    """A seat that leaves READY between placement and hold (SIGKILL →
+    probe drain) must be SKIPPED — healthy seats keep the new version,
+    no rollback."""
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        ctl = _ctl(fleet)
+        orig_hold = fleet.hold_seat
+
+        def hold(rid, reason="rollout"):
+            if rid == 0:
+                raise RuntimeError("replica 0 is draining, not ready")
+            return orig_hold(rid, reason)
+
+        fleet.hold_seat = hold
+        assert ctl.publish({"w": 1}, version="v1") == "completed"
+        assert stubs[1].version == "v1"
+    finally:
+        fleet.close()
+
+
+def test_swap_uses_fresh_handle_after_respawn_between_placement_and_hold():
+    """Review regression: a seat that changed hands between rollout
+    placement and its turn must be swapped through the CURRENT handle,
+    never the rollout-start snapshot's orphaned engine."""
+    from tensorflowonspark_tpu.serving.fleet import InProcessReplica
+
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        ctl = _ctl(fleet)
+        fresh = _StubEngine()
+        orig_hold = fleet.hold_seat
+        state = {"done": False}
+
+        def hold(rid, reason="rollout"):
+            if rid == 0 and not state["done"]:
+                state["done"] = True
+                # emulate a respawn that landed after placement: a new
+                # generation's engine sits behind the seat
+                slot = fleet._slots[0]
+                nh = InProcessReplica(0, lambda: fresh, warmup=False)
+                nh.engine = fresh
+                with slot._lock:
+                    slot.handle = nh
+                    slot.generation += 1
+            return orig_hold(rid, reason)
+
+        fleet.hold_seat = hold
+        assert ctl.publish({"w": 1}, version="v1") == "completed"
+        assert fresh.version == "v1", "fresh engine must be swapped"
+        assert stubs[0].swap_log == [], (
+            "the orphaned placement-time engine must not be touched"
+        )
+    finally:
+        fleet.close()
+
+
+def test_straggler_ready_after_placement_converges():
+    """Review regression: a seat that was NOT READY at placement time
+    (respawning) but rejoined on old weights before completion is
+    converged by the unconditional straggler sweep."""
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        ctl = _ctl(fleet)
+        real_views = fleet.views
+        calls = {"n": 0}
+
+        def views():
+            out = real_views()
+            calls["n"] += 1
+            if calls["n"] == 1:
+                # placement sees seat 1 mid-respawn
+                for v in out:
+                    if v["rid"] == 1:
+                        v["state"] = DRAINING
+            return out
+
+        fleet.views = views
+        assert ctl.publish({"w": 1}, version="v1") == "completed"
+        assert stubs[1].version == "v1", "sweep must converge seat 1"
+    finally:
+        fleet.close()
+
+
+def test_watcher_restarts_after_stop(tmp_path):
+    """Review regression: stop() then start() must actually resume
+    watching (the stop event is cleared, the respawn hook
+    re-registered)."""
+    ch = str(tmp_path / "chan")
+    ck = _fake_complete_ckpt(tmp_path)
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        ctl = _ctl(
+            fleet,
+            channel_dir=ch,
+            loader=lambda upd: {"path": upd.path},
+            poll_interval=0.05,
+        )
+        ctl.start()
+        ctl.stop()
+        assert fleet.rollout_hook is None
+        ctl.start()
+        assert fleet.rollout_hook is not None
+        publish_checkpoint(ch, version="v1", path=ck)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if all(s.version == "v1" for s in stubs):
+                break
+            time.sleep(0.02)
+        assert [s.version for s in stubs] == ["v1", "v1"]
+        ctl.stop()
+    finally:
+        fleet.close()
+
+
+def test_warmup_probe_is_deadline_bounded():
+    """Review regression: the re-warm probe must carry a deadline — a
+    decode that hangs under the new weights becomes a rollback, not a
+    forever-held seat wedging the roll lock."""
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        ctl = _ctl(fleet, verify_timeout=3.0)
+        assert ctl.publish({"w": 1}, version="v1") == "completed"
+        for s in stubs:
+            assert s.probe_kwargs, "probe must have run"
+            assert s.probe_kwargs[-1].get("deadline_s") == 3.0
+    finally:
+        fleet.close()
+
+
+def test_swap_timeout_takes_restore_path_not_bare_release():
+    """Review regression: an in-process swap TIMEOUT means the
+    scheduler may still install the new tree after the controller gave
+    up — the seat must go through the restore path (prior re-applied)
+    rather than rejoining on an unknown version."""
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        stubs[0].swap_error_once = TimeoutError(
+            "weight swap not applied within 0.1s"
+        )
+        ctl = _ctl(fleet)
+        assert ctl.publish({"w": 1}, version="v1") == "rolled_back"
+        # the restore path RE-INSTALLED the prior on the timed-out seat
+        # (the second swap_weights call succeeds and records it)
+        assert ("v0", "full") in stubs[0].swap_log, stubs[0].swap_log
+        assert stubs[0].version == "v0"
+        assert fleet.states() == {0: READY, 1: READY}
+    finally:
+        fleet.close()
+
+
+class _FakeSubprocHandle:
+    """Subprocess-shaped replica double: reload()-only weight surface
+    (no .engine, no swap_weights)."""
+
+    kind = "subprocess"
+
+    def __init__(self, rid):
+        self.rid = rid
+        self.reloads = []
+        self.metrics = _StubMetrics()
+        self.version = "v0"
+
+    def start(self):
+        pass
+
+    def health(self):
+        return {
+            "live": True, "ready": True,
+            "weights_version": self.version,
+        }
+
+    def stats(self):
+        return {"slots": 2, "watchdog_fires": 0, "unresolved": 0}
+
+    def unresolved(self):
+        return 0
+
+    def reload(self, *, version, kind="full", path, step=None,
+               timeout=600.0):
+        self.reloads.append((version, kind, path))
+        self.version = str(version)
+        return {"status": "completed", "version": version}
+
+    def terminate(self, drain=True, timeout=30.0):
+        pass
+
+    def kill(self):
+        pass
+
+
+def test_params_only_update_on_subprocess_fleet_fails_fast(tmp_path):
+    """Review regression: a params-only (no path) update can never
+    reach subprocess replicas — the rollout must fail BEFORE any seat
+    is held/drained/respawned, not escalate a config error into a
+    fleet restart. A path-published update reaches them via reload."""
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        # make every seat subprocess-shaped
+        fakes = []
+        for slot in fleet._slots.values():
+            with slot._lock:
+                fake = _FakeSubprocHandle(slot.rid)
+                fakes.append(fake)
+                slot.handle = fake
+        ctl = _ctl(fleet)
+        assert ctl.publish({"w": 1}, version="v1") == "failed"
+        assert ctl.last_error["type"] == "WeightsIncompatible"
+        assert fleet.states() == {0: READY, 1: READY}
+        assert all(not f.reloads for f in fakes), "nothing touched"
+        # the path-published form DOES roll through reload()
+        ck = _fake_complete_ckpt(tmp_path)
+        assert (
+            ctl.publish(version="v2", path=ck) == "completed"
+        )
+        assert all(
+            f.reloads == [("v2", "full", ck)] for f in fakes
+        )
+    finally:
+        fleet.close()
+
+
+def test_no_swappable_seat_is_failed_outcome():
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        ctl = _ctl(fleet)
+        fleet.hold_seat(0, reason="test")
+        fleet.hold_seat(1, reason="test")
+        assert ctl.publish({"w": 1}, version="v1") == "failed"
+        assert ctl.stats()["outcomes"] == {"failed": 1}
+        fleet.release_seat(0)
+        fleet.release_seat(1)
+    finally:
+        fleet.close()
+
+
+def test_watcher_rolls_new_channel_versions(tmp_path):
+    ch = str(tmp_path / "chan")
+    ck = _fake_complete_ckpt(tmp_path)
+    fleet, stubs, _ = _stub_fleet()
+    try:
+        # stub loader: in-process seats turn the path into params
+        ctl = _ctl(
+            fleet,
+            channel_dir=ch,
+            loader=lambda upd: {"path": upd.path},
+            poll_interval=0.05,
+        )
+        ctl.start()
+        publish_checkpoint(ch, version="v1", path=ck)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if all(s.version == "v1" for s in stubs):
+                break
+            time.sleep(0.02)
+        assert [s.version for s in stubs] == ["v1", "v1"]
+        ctl.stop()
+    finally:
+        fleet.close()
+
+
+# -- real engines ------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=False)
+    model = Llama(cfg)
+    p0 = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    p1 = model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, model, p0, p1
+
+
+def _ref(model, params, prompt, n):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.models.llama import generate
+
+    return np.asarray(
+        generate(model, params, jnp.asarray([prompt], jnp.int32), n)
+    )[0].tolist()
+
+
+def test_engine_swap_weights_full_and_version_stamps(tiny):
+    import numpy as np
+    import jax
+
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+
+    cfg, model, p0, p1 = tiny
+    eng = ContinuousBatcher(model, p0, slots=2, prompt_widths=(8,))
+    try:
+        assert eng.submit([1, 2, 3], 4) == _ref(model, p0, [1, 2, 3], 4)
+        assert eng.weights_version == "v0"
+        # host numpy payload exercises the device-placement path
+        eng.swap_weights(
+            jax.tree.map(np.asarray, p1), version="v1"
+        )
+        comps, vers = eng.submit_many(
+            [[1, 2, 3]], 4, return_versions=True
+        )
+        assert comps[0] == _ref(model, p1, [1, 2, 3], 4)
+        assert vers == ["v1"]
+        st = eng.stats()
+        assert st["weights_version"] == "v1"
+        assert st["weights_swaps"] == 1
+        assert eng.health()["weights_version"] == "v1"
+        s = eng.stream([4, 5], 3)
+        list(s)
+        assert s.weights_version == "v1"
+    finally:
+        eng.close()
+
+
+def test_engine_swap_rejects_mismatches_and_keeps_serving(tiny):
+    import numpy as np
+    import jax
+
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+
+    cfg, model, p0, p1 = tiny
+    eng = ContinuousBatcher(model, p0, slots=2, prompt_widths=(8,))
+    try:
+        want = _ref(model, p0, [9, 9], 4)
+        # wrong leaf shapes
+        bad = jax.tree.map(
+            lambda x: np.zeros((2, 2), np.float32), p0
+        )
+        with pytest.raises(WeightsIncompatible, match="shape"):
+            eng.swap_weights(bad, version="vX")
+        # wrong tree structure
+        with pytest.raises(WeightsIncompatible, match="structure"):
+            eng.swap_weights({"just": np.zeros(3)}, version="vX")
+        # wrong dtype
+        bad_dtype = jax.tree.map(
+            lambda x: np.asarray(x, np.float64), p0
+        )
+        with pytest.raises(WeightsIncompatible, match="dtype"):
+            eng.swap_weights(bad_dtype, version="vX")
+        # unknown kind
+        with pytest.raises(ValueError, match="kind"):
+            eng.swap_weights(p1, version="vX", kind="delta")
+        # the engine never stopped serving v0
+        assert eng.weights_version == "v0"
+        assert eng.submit([9, 9], 4) == want
+    finally:
+        eng.close()
+
+
+def test_engine_lora_swap_parity_with_full_rebuild(tiny):
+    """Adapter-only swap (factors grafted onto resident bases) serves
+    byte-identically to an engine freshly built with the updated
+    tree."""
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.ops.lora import LoraTensor, add_lora
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+
+    cfg, model, p0, _ = tiny
+    base_tree = add_lora(p0, rank=2, rng=jax.random.PRNGKey(3))
+
+    # "trained" adapters: perturb every factor pair
+    def bump(node):
+        if isinstance(node, LoraTensor):
+            return node.replace(
+                a=node.a + 0.03, b=node.b + 0.05
+            )
+        return node
+
+    trained = jax.tree.map(
+        bump, base_tree,
+        is_leaf=lambda n: isinstance(n, LoraTensor),
+    )
+    update = lora_state(trained)
+    assert update, "LoRA tree must yield a factor payload"
+
+    eng = ContinuousBatcher(
+        model, base_tree, slots=2, prompt_widths=(8,)
+    )
+    ref = ContinuousBatcher(
+        model, trained, slots=2, prompt_widths=(8,)
+    )
+    try:
+        before = eng.submit([1, 2, 3], 4)
+        eng.swap_weights(update, version="adapters-1", kind="lora")
+        after = eng.submit([1, 2, 3], 4)
+        want = ref.submit([1, 2, 3], 4)
+        assert after == want
+        assert after != before  # the factors really changed decoding
+        # factor-shape mismatch is rejected, engine keeps serving
+        bad = lora_state(base_tree)
+        first = next(iter(bad.values()))
+        while isinstance(first, dict) and "a" not in first:
+            first = next(iter(first.values()))
+        # descend to a factor dict and corrupt it
+        def corrupt(d):
+            for k, v in d.items():
+                if isinstance(v, dict) and set(v) == {"a", "b"}:
+                    v["a"] = np.zeros((1, 1), np.float32)
+                    return True
+                if isinstance(v, dict) and corrupt(v):
+                    return True
+            return False
+
+        assert corrupt(bad)
+        with pytest.raises(WeightsIncompatible):
+            eng.swap_weights(bad, version="x", kind="lora")
+        assert eng.weights_version == "adapters-1"
+    finally:
+        eng.close()
+        ref.close()
+
+
+def test_post_swap_affinity_never_reaches_stale_prefix_state(tiny):
+    """Satellite regression: after a rollout, the swapped replica's
+    _PrefixStore is EMPTY and the router's affinity index dropped its
+    entries — an extension request re-prefills under the NEW weights
+    instead of resuming stale KV."""
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+
+    cfg, model, p0, p1 = tiny
+
+    def factory():
+        return ContinuousBatcher(
+            model, p0, slots=2, prompt_widths=(8,),
+            prefill_chunk=4, prefix_cache=4,
+        )
+
+    fleet = ServingFleet(
+        factory=factory, replicas=2, probe_interval=5.0,
+        warmup=False, drain_timeout=5.0,
+    )
+    router = FleetRouter(fleet)
+    # warmup_probe off: the probe request would itself insert ONE
+    # fresh (new-weights) prefix entry, blurring the emptiness check
+    ctl = _ctl(
+        fleet, drain_timeout=10.0, verify_timeout=30.0,
+        warmup_probe=False,
+    )
+    try:
+        base = [5, 6, 7, 8, 9, 10]
+        router.submit(base, 2)
+        assert router.stats()["router"]["affinity_entries"] >= 1
+        stores = [
+            v["handle"].engine.stats().get("prefix_cache_entries", 0)
+            for v in fleet.views()
+        ]
+        assert sum(stores) >= 1  # warm prefill state for OLD weights
+        import jax
+        import numpy as np
+
+        assert (
+            ctl.publish(jax.tree.map(np.asarray, p1), version="v1")
+            == "completed"
+        )
+        # both invalidation layers fired
+        assert router.stats()["router"]["affinity_entries"] == 0
+        for v in fleet.views():
+            st = v["handle"].engine.stats()
+            assert st.get("prefix_cache_entries", 0) == 0
+            assert st["weights_version"] == "v1"
+        # the extension decodes correctly under the NEW weights
+        ext = base + [11, 12]
+        got, vers = router.submit_many([ext], 3, return_versions=True)
+        assert got[0] == _ref(model, p1, ext, 3)
+        assert vers == ["v1"]
+    finally:
+        router.close()
+
+
+def test_single_engine_controller_swap_and_rollback(tiny):
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+
+    cfg, model, p0, p1 = tiny
+    eng = ContinuousBatcher(model, p0, slots=2, prompt_widths=(8,))
+    ctl = RolloutController(eng, verify_timeout=30.0)
+    try:
+        assert (
+            ctl.publish(jax.tree.map(np.asarray, p1), version="v1")
+            == "completed"
+        )
+        assert eng.weights_version == "v1"
+        assert eng.stats()["weights_swaps"] == 1
+        bad = jax.tree.map(lambda x: np.zeros((1,), np.float32), p0)
+        assert ctl.publish(bad, version="v2") == "rolled_back"
+        assert eng.weights_version == "v1"
+        # review regression: a PRE-swap failure (validation rejected
+        # the tree; the engine was never touched) must not pay a
+        # rollback re-install — no extra swap happened
+        assert eng.stats()["weights_swaps"] == 1
+        assert eng.submit([1, 2, 3], 4) == _ref(model, p1, [1, 2, 3], 4)
+    finally:
+        eng.close()
+
+
+def test_checkpoint_loader_handles_manager_step_dirs(tiny, tmp_path):
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.compute.checkpoint import (
+        CheckpointManager,
+        checkpoint_complete,
+    )
+
+    cfg, model, p0, p1 = tiny
+    host1 = jax.tree.map(np.asarray, p1)
+    with CheckpointManager(
+        str(tmp_path / "mgr"), async_save=False
+    ) as mgr:
+        mgr.save(5, host1)
+        step_path = mgr.step_path(5)
+    assert checkpoint_complete(step_path)
+    ch = str(tmp_path / "chan")
+    publish_checkpoint(ch, version="step-5", path=step_path, step=5)
+    upd = read_latest(ch)
+    assert upd.version == "step-5" and upd.step == 5
+    load = checkpoint_loader(p0)
+    restored = load(upd)
+    ref = jax.tree.map(np.asarray, p1)
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(ref)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def _post(url, payload, token=None, timeout=120):
+    headers = {"Content-Type": "application/json"}
+    if token is not None:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers=headers
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_serve_model_admin_reload_auth_and_version_stamps(
+    tiny, tmp_path
+):
+    """The authenticated /admin/reload HTTP surface: 403 without/with a
+    wrong token, 200 + hot swap with the right one, 409 on a
+    shape-mismatched checkpoint (WeightsIncompatible), and the
+    /generate + stream version stamps."""
+    import http.client
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.compute.checkpoint import (
+        CheckpointManager,
+    )
+    from tensorflowonspark_tpu.tools import serve_model
+
+    cfg, model, p0, p1 = tiny
+    ckpt = str(tmp_path / "ckpt")
+    with CheckpointManager(ckpt, async_save=False) as mgr:
+        mgr.save(0, {"params": p0})
+    ch = str(tmp_path / "chan")
+    upd = publish_params(
+        ch, jax.tree.map(np.asarray, p1), version="step-100"
+    )
+    bad = publish_params(
+        ch,
+        {"embed": np.zeros((3, 3), np.float32)},
+        version="bad-shapes",
+    )
+
+    server = serve_model.make_server(
+        None,
+        port=0,
+        gen=dict(
+            checkpoint=ckpt, model="tiny", width=8, max_new_tokens=8,
+            engine="continuous", slots=2, admin_token="sekrit",
+        ),
+    )
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        code, out = _post(
+            base + "/admin/reload",
+            {"version": "step-100", "path": upd.path},
+        )
+        assert code == 403
+        code, out = _post(
+            base + "/admin/reload",
+            {"version": "step-100", "path": upd.path},
+            token="wrong",
+        )
+        assert code == 403
+        code, out = _post(
+            base + "/admin/reload",
+            {"version": "step-100", "path": upd.path},
+            token="sekrit",
+        )
+        assert code == 200 and out["status"] == "completed", out
+        code, out = _post(
+            base + "/generate",
+            {"prompts": [[1, 2, 3]], "versions": True},
+        )
+        assert code == 200
+        assert out["completions"][0] == _ref(model, p1, [1, 2, 3], 8)
+        assert out["weights_versions"] == ["step-100"]
+        # stream trailer carries the stamp
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request(
+            "POST", "/generate",
+            json.dumps({"prompts": [[1, 2]], "stream": True}),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        trailer = None
+        for raw in resp:
+            line = json.loads(raw)
+            if line.get("done"):
+                trailer = line
+        conn.close()
+        assert trailer and trailer["weights_version"] == "step-100"
+        # shape-mismatched published checkpoint -> 409, still serving
+        code, out = _post(
+            base + "/admin/reload",
+            {"version": "bad-shapes", "path": bad.path},
+            token="sekrit",
+        )
+        assert code == 409 and out["error_type"] == "WeightsIncompatible", out
+        code, out = _post(
+            base + "/generate",
+            {"prompts": [[1, 2, 3]], "versions": True},
+        )
+        assert out["weights_versions"] == ["step-100"]
+    finally:
+        server.shutdown()
+
+
+# -- chaos e2e (slow) --------------------------------------------------------
+
+
+def _wait(pred, timeout, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.mark.slow
+def test_rollout_sigkill_replica_mid_rollout(tiny, tmp_path):
+    """SIGKILL one of 2 subprocess replicas WHILE a rollout is in
+    flight under streaming load: the rollout completes (or rolls back)
+    with zero silent drops — every request resolves as ok or exactly
+    one typed error — and the fleet converges healthy with every READY
+    replica on ONE coherent version (the respawned seat re-syncs
+    through the rollout hook)."""
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.compute.checkpoint import (
+        CheckpointManager,
+    )
+
+    cfg, model, p0, p1 = tiny
+    ckpt = str(tmp_path / "ckpt")
+    with CheckpointManager(ckpt, async_save=False) as mgr:
+        mgr.save(0, {"params": p0})
+    ch = str(tmp_path / "chan")
+    upd = publish_params(
+        ch, jax.tree.map(np.asarray, p1), version="v1"
+    )
+    argv = [
+        "--llama-checkpoint", ckpt, "--model", "tiny",
+        "--gen-engine", "continuous", "--gen-width", "8",
+        "--max-new-tokens", "64", "--gen-slots", "4", "--gen-warmup",
+    ]
+    # children get a THROWAWAY compile cache: this test SIGKILLs them,
+    # and a SIGKILL-able process must never share a persistent compile
+    # cache others read (a kill mid-write can tear an entry) — also
+    # keeps the run hermetic if the operator's shell exports
+    # JAX_COMPILATION_CACHE_DIR (the conftest itself no longer sets
+    # one; see tests/conftest.py on the sharded-executable
+    # deserialization heap corruption)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        JAX_COMPILATION_CACHE_DIR=str(tmp_path / "child-jax-cache"),
+    )
+    fleet = ServingFleet(
+        spawn_argv=argv,
+        replicas=2,
+        probe_interval=0.5,
+        drain_timeout=15.0,
+        spawn_kwargs={"env": env, "spawn_timeout": 300.0},
+    )
+    router = FleetRouter(fleet)
+    ctl = RolloutController(
+        fleet, drain_timeout=30.0, verify_timeout=60.0,
+        swap_timeout=300.0,
+    )
+    results: dict[int, tuple] = {}
+    stop_load = threading.Event()
+
+    def load_worker(i):
+        n = 0
+        while not stop_load.is_set():
+            key = i * 10_000 + n
+            n += 1
+            try:
+                s = router.stream([1 + (key % 5), 2, 3], 8)
+                toks = list(s)
+                results[key] = ("ok", toks, s.weights_version)
+            except BaseException as e:  # noqa: BLE001 - the verdict
+                results[key] = ("err", type(e).__name__, None)
+            time.sleep(0.05)
+
+    outcome_box = {}
+
+    def do_roll():
+        outcome_box["outcome"] = ctl.roll(upd)
+
+    try:
+        workers = [
+            threading.Thread(target=load_worker, args=(i,), daemon=True)
+            for i in range(4)
+        ]
+        for t in workers:
+            t.start()
+        time.sleep(2.0)
+        roller = threading.Thread(target=do_roll, daemon=True)
+        roller.start()
+        # SIGKILL a replica while the rollout is in flight
+        time.sleep(1.0)
+        victim = None
+        for v in fleet.views():
+            if getattr(v["handle"], "pid", None) is not None:
+                victim = v
+                break
+        assert victim is not None
+        os.kill(victim["handle"].pid, 9)
+        roller.join(timeout=600)
+        assert not roller.is_alive(), "rollout must terminate"
+        assert outcome_box["outcome"] in ("completed", "rolled_back")
+        # the fleet converges: both seats READY again (respawn done)
+        _wait(
+            lambda: fleet.states() == {0: READY, 1: READY},
+            240.0,
+            "fleet to re-converge READY",
+        )
+        # ... and on ONE coherent version everywhere
+        want = "v1" if outcome_box["outcome"] == "completed" else "v0"
+
+        def versions_converged():
+            vs = set()
+            for v in fleet.views():
+                try:
+                    vs.add(
+                        v["handle"].health().get("weights_version")
+                    )
+                except Exception:  # noqa: BLE001 - probe race
+                    return False
+            return vs == {want}
+
+        _wait(versions_converged, 240.0, f"all replicas on {want}")
+        stop_load.set()
+        for t in workers:
+            t.join(timeout=30)
+        # zero silent drops: every request resolved as ok or a typed
+        # error; nothing hung (joined workers prove it), and every OK
+        # completion carries a version stamp from the published set
+        assert results, "load must have run"
+        for key, verdict in results.items():
+            assert verdict[0] in ("ok", "err"), (key, verdict)
+            if verdict[0] == "ok":
+                assert verdict[2] in ("v0", "v1"), (key, verdict)
+        n_ok = sum(1 for v in results.values() if v[0] == "ok")
+        assert n_ok > 0
+    finally:
+        stop_load.set()
+        router.close()
+
+
+@pytest.mark.slow
+def test_rollout_corrupt_checkpoint_under_load_rolls_back(
+    tiny, tmp_path
+):
+    """A corrupt (shape-mismatched) checkpoint published under
+    sustained load triggers AUTOMATIC rollback; the fleet serves the
+    old version throughout — zero failed requests, every completion
+    stamped with the old version, flightrec carries the rollback."""
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.obs import flightrec
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+
+    cfg, model, p0, p1 = tiny
+    rec_path = str(tmp_path / "flightrec-rollout.json")
+    flightrec.install(rec_path, process="rollout-test")
+
+    def factory():
+        return ContinuousBatcher(
+            model, p0, slots=4, prompt_widths=(8,)
+        )
+
+    fleet = ServingFleet(
+        factory=factory, replicas=2, probe_interval=0.5,
+        warmup=False, drain_timeout=10.0,
+    )
+    router = FleetRouter(fleet)
+    ch = str(tmp_path / "chan")
+    ctl = RolloutController(
+        fleet,
+        channel_dir=ch,
+        loader=checkpoint_loader(p0),
+        poll_interval=0.2,
+        drain_timeout=30.0,
+        verify_timeout=60.0,
+    )
+    ctl.start()
+    results: dict[int, tuple] = {}
+    stop_load = threading.Event()
+
+    def load_worker(i):
+        n = 0
+        while not stop_load.is_set():
+            key = i * 10_000 + n
+            n += 1
+            try:
+                comps, vers = router.submit_many(
+                    [[1 + (key % 5), 2, 3]], 6, return_versions=True
+                )
+                results[key] = ("ok", comps[0], vers[0])
+            except BaseException as e:  # noqa: BLE001 - the verdict
+                results[key] = ("err", type(e).__name__, None)
+            time.sleep(0.02)
+
+    try:
+        workers = [
+            threading.Thread(target=load_worker, args=(i,), daemon=True)
+            for i in range(4)
+        ]
+        for t in workers:
+            t.start()
+        time.sleep(1.0)
+        # publish a checkpoint whose tree does not fit the engines
+        publish_params(
+            ch,
+            {"embed": np.zeros((3, 3), np.float32)},
+            version="corrupt-1",
+        )
+        _wait(
+            lambda: ctl.stats()["outcomes"].get("rolled_back", 0) >= 1,
+            120.0,
+            "automatic rollback",
+        )
+        time.sleep(2.0)  # keep serving a beat after the rollback
+        stop_load.set()
+        for t in workers:
+            t.join(timeout=30)
+        assert fleet.states() == {0: READY, 1: READY}
+        want = _ref(model, p0, [1, 2, 3], 6)
+        n_ok = 0
+        for key, verdict in results.items():
+            assert verdict[0] == "ok", (
+                "zero failed requests expected", key, verdict,
+            )
+            assert verdict[2] == "v0", (key, verdict)
+            n_ok += 1
+            if key % 10_000 == 0:
+                assert verdict[1] == want
+        # request COUNT scales with host speed (the instrumented
+        # TFOS_TFSAN rerun decodes noticeably slower under witnessed
+        # locks); the zero-failures/zero-wrong-stamp loop above is the
+        # actual gate — this only proves the load really ran
+        assert n_ok > 3
+        assert ctl.stats()["target_version"] is None
+        # the rollback incident was dumped to the flight record
+        with open(rec_path, encoding="utf-8") as f:
+            rec = json.load(f)
+        kinds = [e["kind"] for e in rec["events"]]
+        assert "rollout_begin" in kinds
+        assert "rollout_rollback" in kinds
+    finally:
+        stop_load.set()
+        ctl.stop()
+        router.close()
