@@ -612,6 +612,8 @@ def _bench_serve_fleet(smoke: bool) -> None:
     import jax.numpy as jnp
 
     from benchmarks.real_chip import _llama1b_decode_setup
+    from tensorflowonspark_tpu.obs.history import History
+    from tensorflowonspark_tpu.obs.slo import SLOEvaluator, router_slos
     from tensorflowonspark_tpu.serving import ContinuousBatcher
     from tensorflowonspark_tpu.serving.fleet import ServingFleet
     from tensorflowonspark_tpu.serving.router import FleetRouter
@@ -678,9 +680,20 @@ def _bench_serve_fleet(smoke: bool) -> None:
                 raise errors[0]
 
         fire(n_replicas * b, 4, tag=0)  # compile/warm every replica
+        # the SLO budget gate (obs.slo): one History window over the
+        # timed fire, warmup compiles excluded via the window cursor
+        fleet.metrics.window()
+        hist = History(source=f"bench.serve_fleet.r{n_replicas}")
+        ev = SLOEvaluator(
+            router_slos(latency_objective_s=30.0 if smoke else 10.0),
+            hist,
+            registry=fleet.metrics,
+        )
         t0 = time.perf_counter()
         fire(requests, new_tokens, tag=1)
         dt = time.perf_counter() - t0
+        hist.scrape_registry(fleet.metrics)
+        verdicts = ev.evaluate()
         st = router.stats()["router"]
         # uncontended: each replica alone, one full b-row batch,
         # self-timed — the per-chip rate a one-replica-per-chip pod
@@ -708,6 +721,8 @@ def _bench_serve_fleet(smoke: bool) -> None:
             requests=requests,
             shed=sum(st["shed"].values()) if st["shed"] else 0,
             failovers=st["failovers"],
+            slo_breaching=sorted(v.slo for v in verdicts if v.breached),
+            slo=[v.as_dict() for v in verdicts],
         )
         router.close()
         return out
@@ -785,6 +800,8 @@ def _bench_rollout(smoke: bool) -> None:
     import numpy as _np
 
     from benchmarks.real_chip import _llama1b_decode_setup
+    from tensorflowonspark_tpu.obs.history import History
+    from tensorflowonspark_tpu.obs.slo import SLOEvaluator, router_slos
     from tensorflowonspark_tpu.serving import ContinuousBatcher
     from tensorflowonspark_tpu.serving.fleet import ServingFleet
     from tensorflowonspark_tpu.serving.rollout import RolloutController
@@ -836,6 +853,15 @@ def _bench_rollout(smoke: bool) -> None:
     ctl = RolloutController(
         fleet, drain_timeout=60.0, verify_timeout=120.0
     )
+    # the SLO budget gate (obs.slo): windowed history over the whole
+    # run; the latency objective IS the deadline budget, so "admitted
+    # p99 within deadline" and the declarative SLO agree by design
+    hist = History(source="bench.rollout")
+    slo_ev = SLOEvaluator(
+        router_slos(latency_objective_s=deadline_s),
+        hist,
+        registry=fleet.metrics,
+    )
     results: dict[int, tuple] = {}
     stop_load = _threading.Event()
     phase = {"current": "v0"}  # version being served when issued
@@ -884,6 +910,7 @@ def _bench_rollout(smoke: bool) -> None:
         out = ctl.publish(versions[ver], version=ver)
         outcomes.append({"version": ver, "outcome": out})
         phase["current"] = ver
+        hist.scrape_registry(fleet.metrics)
         time.sleep(0.5)  # serve a beat between versions
     time.sleep(1.0)  # post-rollout tail on the final version
     stop_load.set()
@@ -893,6 +920,8 @@ def _bench_rollout(smoke: bool) -> None:
         if t.is_alive():
             hung += 1
     wall_s = time.perf_counter() - t_start
+    hist.scrape_registry(fleet.metrics)
+    slo_verdicts = slo_ev.evaluate()
     router.close()
 
     oks = [v for v in results.values() if v[0] == "ok"]
@@ -931,6 +960,12 @@ def _bench_rollout(smoke: bool) -> None:
             tail_ok and tail_on_final == len(tail_ok)
         )
         or not tail_ok,
+        # the declarative gate: rollouts must not burn the fleet's
+        # latency budget (availability verdicts are reported below but
+        # not gated — transient drain sheds are the tolerated cost)
+        "slo_latency_silent": not any(
+            v.slo == "fleet_latency" and v.breached for v in slo_verdicts
+        ),
     }
     result = {
         "metric": "rollout_zero_downtime",
@@ -948,6 +983,7 @@ def _bench_rollout(smoke: bool) -> None:
         "admitted_p99_s": round(p99, 3),
         "deadline_budget_s": deadline_s,
         "version_counts": version_counts,
+        "slo": [v.as_dict() for v in slo_verdicts],
         "rollout_stats": ctl.stats(),
         "wall_s": round(wall_s, 1),
         "replicas": 2,
@@ -973,6 +1009,240 @@ def _bench_rollout(smoke: bool) -> None:
     if not all(checks.values()):
         raise SystemExit(
             f"rollout bench failed acceptance checks: "
+            f"{ {k: v for k, v in checks.items() if not v} }"
+        )
+
+
+def _bench_serve_slo(smoke: bool) -> None:
+    """``--serve-slo``: the end-to-end trace + SLO burn proof (ISSUE 16).
+
+    A 2-replica in-process fleet behind the health-routing router runs
+    two legs against ONE History + SLO evaluator:
+
+    - **clean leg**: requests well inside the latency objective — the
+      evaluator must stay silent (no false burn at baseline);
+    - **armed leg**: ``fleet.dispatch`` drops the proof request's first
+      dispatch (a forced failover hop) while ``engine.submit`` delays
+      it past the objective, then a latency failpoint slows the rest of
+      the leg — the fleet_latency SLO must fire exactly here, with the
+      availability SLO (no sheds) still silent.
+
+    The proof request is traced end-to-end: the committed artifact
+    asserts one trace id spans router placement -> failover hop ->
+    replica -> engine segments with >= 95% of its wall time attributed
+    to named segments, and that the timeline round-trips through
+    ``obs.trace_merge``. Artifact:
+    ``benchmarks/results/serve_slo_<backend>[_smoke].json``.
+    """
+    import threading as _threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.real_chip import _llama1b_decode_setup
+    from tensorflowonspark_tpu.obs import reqtrace, trace_merge
+    from tensorflowonspark_tpu.obs.history import History
+    from tensorflowonspark_tpu.obs.slo import SLOEvaluator, router_slos
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+    from tensorflowonspark_tpu.serving.fleet import ServingFleet
+    from tensorflowonspark_tpu.serving.router import FleetRouter
+    from tensorflowonspark_tpu.utils import failpoints
+
+    ns = argparse.Namespace(
+        batch_size=2 if smoke else 4,
+        seq=16 if smoke else 64,
+        new_tokens=8 if smoke else 32,
+        spec_k=0,
+        model_scale="tiny" if smoke else "1b",
+        kv_quantize=False,
+    )
+    if smoke:
+        _partial["smoke"] = True
+    b, new_tokens, cfg, model, prompts = _llama1b_decode_setup(ns)
+    params = jax.tree.map(
+        jax.device_put,
+        model.init(
+            jax.random.PRNGKey(0), jnp.asarray(prompts[:2])
+        )["params"],
+    )
+    # retain EVERY finished trace: the proof below reads the ring back
+    ring = reqtrace.install(capacity=64, sample_every=1)
+
+    def factory():
+        return ContinuousBatcher(
+            model,
+            params,
+            slots=b,
+            prompt_widths=(prompts.shape[1],),
+        )
+
+    fleet = ServingFleet(
+        factory=factory,
+        replicas=2,
+        probe_interval=0.5,
+        warmup=False,
+        drain_timeout=10.0,
+    )
+    router = FleetRouter(fleet)
+    objective_s = 1.0  # a bucket edge: fraction_le needs no interpolation
+    delay_s = 1.6  # past the objective, inside the next bucket
+    history = History(source="bench.serve_slo")
+    ev = SLOEvaluator(
+        router_slos(
+            latency_objective_s=objective_s,
+            latency_budget=0.1,
+            shed_budget=0.02,
+            fast_burn=5.0,  # breach at >= 50% of requests slow (fast)
+            slow_burn=2.5,  # and >= 25% over the slow window
+        ),
+        history,
+        registry=fleet.metrics,
+    )
+    clean_n, armed_n = (4, 5) if smoke else (8, 10)
+
+    def fire(count: int, tag: int, trace: str | None = None) -> None:
+        errors: list = []
+
+        def one(i):
+            try:
+                router.submit(
+                    prompts[(tag + i) % len(prompts)].tolist(),
+                    new_tokens,
+                    **({"trace": trace} if trace and i == 0 else {}),
+                )
+            except BaseException as e:  # noqa: BLE001 - ferried
+                errors.append(e)
+
+        threads = [
+            _threading.Thread(target=one, args=(i,))
+            for i in range(count)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
+    try:
+        fire(2 * b, tag=0)  # compile/warm both replicas
+        # consume the warmup's registry window so the evaluator's first
+        # scrape delta covers exactly the clean leg, not the compiles
+        fleet.metrics.window()
+
+        fire(clean_n, tag=100)
+        history.scrape_registry(fleet.metrics)
+        clean_verdicts = ev.evaluate()
+
+        # -- armed leg: proof request takes a forced failover hop AND
+        # the latency delay; the rest of the leg is just slow ---------
+        proof_tid = reqtrace.mint(route="bench.proof")
+        t_proof = time.perf_counter()
+        failpoints.arm("fleet.dispatch", "drop", count=1)
+        failpoints.arm("engine.submit", "delay", delay_s=delay_s, count=1)
+        fire(1, tag=200, trace=proof_tid)
+        proof_wall = time.perf_counter() - t_proof
+        reqtrace.finish(proof_tid, outcome="ok")
+        failpoints.arm(
+            "fleet.dispatch", "delay", delay_s=delay_s, count=armed_n
+        )
+        fire(armed_n - 1, tag=300)
+        failpoints.disarm_all()
+        history.scrape_registry(fleet.metrics)
+        armed_verdicts = ev.evaluate()
+        # one more scrape so the breach counter + burn gauges the
+        # evaluation just wrote are themselves in the windowed history
+        history.scrape_registry(fleet.metrics)
+    finally:
+        failpoints.disarm_all()
+        router.close()
+
+    # -- the trace proof ----------------------------------------------
+    attribution = ring.attribution(proof_tid) or {}
+    record = reqtrace.get_record(proof_tid) or {}
+    seg_names = {s["name"] for s in record.get("segments", ())}
+    ev_names = {e["name"] for e in record.get("events", ())}
+    merged_events = 0
+    trace_path = os.path.join(
+        "benchmarks", "results", "serve_slo_proof_trace.json"
+    )
+    chrome = reqtrace.to_chrome(proof_tid)
+    if chrome is not None:
+        os.makedirs(os.path.dirname(trace_path), exist_ok=True)
+        with open(trace_path, "w", encoding="utf-8") as f:
+            json.dump(chrome, f)
+        merged_events = len(
+            trace_merge.merge_traces([trace_path]).get("traceEvents") or []
+        )
+
+    clean_breached = sorted(v.slo for v in clean_verdicts if v.breached)
+    armed_breached = sorted(v.slo for v in armed_verdicts if v.breached)
+    checks = {
+        "clean_leg_silent": not clean_breached,
+        "armed_leg_fires_latency_slo": armed_breached == ["fleet_latency"],
+        "breach_is_rising_edge_once": history.delta(
+            "slo_breaches_total", window_s=None
+        ) == 1.0,
+        "proof_trace_retained": proof_tid in ring.ids(),
+        "proof_spans_router_to_engine": (
+            "router.submit" in seg_names
+            and any(n.startswith("engine.") for n in seg_names)
+            and "router.failover" in ev_names
+        ),
+        "proof_attribution_ge_95pct": (
+            attribution.get("covered_fraction", 0.0) >= 0.95
+        ),
+        "proof_slower_than_objective": proof_wall >= objective_s,
+        "timeline_merges": merged_events > 0,
+    }
+    result = {
+        "metric": "serve_slo_burn_gate",
+        "value": 1.0 if all(checks.values()) else 0.0,
+        "unit": "pass",
+        "vs_baseline": 1.0 if all(checks.values()) else 0.0,
+        "passed": all(checks.values()),
+        "checks": checks,
+        "objective_s": objective_s,
+        "armed_delay_s": delay_s,
+        "requests_clean": clean_n,
+        "requests_armed": armed_n,
+        "proof_trace_id": proof_tid,
+        "proof_wall_s": round(proof_wall, 3),
+        "attribution": attribution,
+        "slo_clean": [v.as_dict() for v in clean_verdicts],
+        "slo_armed": [v.as_dict() for v in armed_verdicts],
+        "reqtrace": ring.stats(),
+        "history": history.to_artifact(
+            names=(
+                "router_request_seconds",
+                "router_requests_total",
+                "router_shed_total",
+                "slo_burn_rate",
+                "slo_breaches_total",
+            )
+        ),
+        "merged_trace_events": merged_events,
+        **_partial,
+    }
+    path = os.path.join(
+        "benchmarks",
+        "results",
+        f"serve_slo_{jax.default_backend()}"
+        + ("_smoke" if smoke else "")
+        + ".json",
+    )
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        result["artifact"] = path
+    except OSError as e:
+        result["artifact_error"] = str(e)
+    _emit(result)
+    if not all(checks.values()):
+        raise SystemExit(
+            f"serve-slo bench failed acceptance checks: "
             f"{ {k: v for k, v in checks.items() if not v} }"
         )
 
@@ -1136,6 +1406,18 @@ def main(argv: list[str] | None = None) -> None:
         "(BENCH_SMOKE=1 for the tiny model)",
     )
     ap.add_argument(
+        "--serve-slo",
+        action="store_true",
+        help="end-to-end trace + SLO burn proof: a 2-replica fleet "
+        "runs a clean leg then a failpoint-armed leg (one forced "
+        "failover hop + a latency delay) against one History-backed "
+        "SLO evaluator; the committed benchmarks/results/serve_slo_*"
+        ".json asserts the latency SLO fires exactly on the armed leg "
+        "and that the proof request's trace attributes >= 95% of its "
+        "wall time to named router/engine segments (BENCH_SMOKE=1 for "
+        "the tiny model)",
+    )
+    ap.add_argument(
         "--serve",
         action="store_true",
         help="measure the serving engine tax instead of training MFU: "
@@ -1211,6 +1493,9 @@ def main(argv: list[str] | None = None) -> None:
         return
     if args.rollout:
         _bench_rollout(smoke)
+        return
+    if args.serve_slo:
+        _bench_serve_slo(smoke)
         return
     if args.serve:
         # the serving bench commits its own span-based trace report;

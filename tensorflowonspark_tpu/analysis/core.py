@@ -64,6 +64,8 @@ class Config:
     # LK/JX/HS knobs (see each analyzer module)
     compat_module: str = "tensorflowonspark_tpu/utils/compat.py"
     failpoints_module: str = "tensorflowonspark_tpu/utils/failpoints.py"
+    # the EVENTS catalog OB002 validates flightrec.note names against
+    flightrec_module: str = "tensorflowonspark_tpu/obs/flightrec.py"
     # the declarative layout table the SH rules enforce (analysis/sharding.py)
     layout_module: str = "tensorflowonspark_tpu/compute/layout.py"
     moved_jax_symbols: tuple = ("shard_map", "lax.axis_size")
@@ -171,6 +173,8 @@ def load_config(root: str, pyproject: str | None = None) -> Config:
         cfg.compat_module = section["compat_module"]
     if "failpoints_module" in section:
         cfg.failpoints_module = section["failpoints_module"]
+    if "flightrec_module" in section:
+        cfg.flightrec_module = section["flightrec_module"]
     if "layout_module" in section:
         cfg.layout_module = section["layout_module"]
     if "moved_jax_symbols" in section:
@@ -273,6 +277,7 @@ def run_lint(root: str, cfg: Config) -> list:
     from tensorflowonspark_tpu.analysis import (
         blocking,
         failpoints as fp_rule,
+        flightrecnames,
         hostsync,
         jaxapi,
         lockorder,
@@ -307,6 +312,7 @@ def run_lint(root: str, cfg: Config) -> list:
         findings.extend(prefetchrule.check(pkg, cfg))
     if "OB" in enabled:
         findings.extend(obsmetrics.check(pkg, cfg))
+        findings.extend(flightrecnames.check(pkg, cfg))
     if {"HS", "TL"} & enabled:
         findings.extend(
             hostsync.check(

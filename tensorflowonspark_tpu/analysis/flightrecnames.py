@@ -1,0 +1,160 @@
+"""OB002: flight-recorder event names must be registered literals.
+
+Postmortem tooling greps flight-recorder dumps by exact event kind
+(``"fleet_shed"``, ``"slo_breach"``, ...), and tests assert on them;
+a ``flightrec.note("flet_shed", ...)`` typo records an event nobody
+will ever query — the black box silently loses the incident it existed
+to capture. ``note()`` cannot validate at runtime (it must never raise,
+and a registry check on every hot-path call would be pure overhead), so
+the check runs at build time, the FP001 pattern applied to events:
+
+- a ``note(...)`` call whose event argument is not a plain string
+  literal is flagged — with ONE structured exception: a conditional
+  expression (``"a" if cond else "b"``) whose branches are BOTH
+  registered literals, which keeps the names greppable;
+- a literal name missing from the catalog is flagged.
+
+The catalog is the ``EVENTS`` frozenset in ``cfg.flightrec_module``,
+parsed standalone from disk (fixture runs that lint only a test
+directory still validate against the real catalog). Only names resolved
+to the flightrec module via this module's imports are checked — an
+unrelated ``rec.note(kind, ...)`` on some other object is not an event
+emission. ``dump_now()`` reasons are deliberately out of scope: they
+are free-form "why this dump was cut" text, not a queryable stream.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tensorflowonspark_tpu.analysis.core import Config, Finding, Module, Package
+
+__all__ = ["check"]
+
+_FR_MODULE = "tensorflowonspark_tpu.obs.flightrec"
+
+
+def _registered_events(root: str, cfg: Config) -> set | None:
+    """The EVENTS literal from the flightrec module, or None when it
+    cannot be read (the rule then only enforces literalness)."""
+    path = os.path.join(root, cfg.flightrec_module)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "EVENTS"
+            for t in node.targets
+        ):
+            continue
+        consts = {
+            n.value
+            for n in ast.walk(node.value)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)
+        }
+        if consts:
+            return consts
+    return None
+
+
+class _Checker(ast.NodeVisitor):
+    """Flags bad ``note(...)`` calls. Which names count as "the
+    flightrec note function" is resolved from this module's imports —
+    method calls on arbitrary objects (``rec.note(kind, ...)`` inside
+    the recorder itself, a queue's ``note``) are not event emissions."""
+
+    def __init__(self, mod: Module, events: set | None):
+        self.mod = mod
+        self.events = events
+        self.fn_names: set = set()  # local names bound to note()
+        self.mod_names: set = set()  # local names bound to the module
+        self.findings: list = []
+
+    def _flag(self, node: ast.AST, msg: str) -> None:
+        self.findings.append(
+            Finding(
+                "OB002", self.mod.relpath, node.lineno, node.col_offset, msg
+            )
+        )
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            if alias.name == _FR_MODULE:
+                self.mod_names.add(alias.asname or _FR_MODULE)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.level == 0 and node.module == _FR_MODULE:
+            for alias in node.names:
+                if alias.name == "note":
+                    self.fn_names.add(alias.asname or alias.name)
+        elif node.level == 0 and node.module == _FR_MODULE.rsplit(".", 1)[0]:
+            for alias in node.names:
+                if alias.name == "flightrec":
+                    self.mod_names.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    def _is_note_call(self, func: ast.AST) -> bool:
+        if isinstance(func, ast.Name):
+            return func.id in self.fn_names
+        if isinstance(func, ast.Attribute) and func.attr == "note":
+            parts: list = []
+            base = func.value
+            while isinstance(base, ast.Attribute):
+                parts.append(base.attr)
+                base = base.value
+            if isinstance(base, ast.Name):
+                parts.append(base.id)
+                dotted = ".".join(reversed(parts))
+                return dotted in self.mod_names or dotted == _FR_MODULE
+        return False
+
+    def _check_literal(self, node: ast.Call, value: str) -> None:
+        if self.events is not None and value not in self.events:
+            self._flag(
+                node,
+                f"flightrec event '{value}' is not registered in "
+                "obs/flightrec.py EVENTS — postmortem tooling grepping "
+                "the catalog will never find it",
+            )
+
+    def visit_Call(self, node):
+        if self._is_note_call(node.func):
+            arg = node.args[0] if node.args else None
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                self._check_literal(node, arg.value)
+            elif (
+                isinstance(arg, ast.IfExp)
+                and isinstance(arg.body, ast.Constant)
+                and isinstance(arg.body.value, str)
+                and isinstance(arg.orelse, ast.Constant)
+                and isinstance(arg.orelse.value, str)
+            ):
+                # "a" if cond else "b": both arms stay greppable —
+                # validate each against the catalog
+                self._check_literal(node, arg.body.value)
+                self._check_literal(node, arg.orelse.value)
+            else:
+                self._flag(
+                    node,
+                    "flightrec event name must be a string literal (or "
+                    "a conditional between two literals) — dynamic "
+                    "names defeat the registered-event check and make "
+                    "dumps un-greppable",
+                )
+        self.generic_visit(node)
+
+
+def check(pkg: Package, cfg: Config) -> list:
+    events = _registered_events(pkg.root, cfg)
+    findings: list = []
+    for mod in pkg.modules:
+        checker = _Checker(mod, events)
+        checker.visit(mod.tree)
+        findings.extend(checker.findings)
+    return findings

@@ -29,14 +29,29 @@ Plus the cluster-wide plane (docs/OBSERVABILITY.md):
   flight recorder (rolling snapshots + event-triggered dumps).
 - :mod:`~tensorflowonspark_tpu.obs.trace_merge` — clock-aligned merge
   of driver + node traces into one timeline (``tools/trace_merge.py``).
+
+And the request-level plane (ISSUE 16, docs/OBSERVABILITY.md):
+
+- :mod:`~tensorflowonspark_tpu.obs.reqtrace` — per-request distributed
+  tracing with tail-sampled retention (``X-TFOS-Trace`` propagation,
+  ``GET /debugz/trace/<id>``).
+- :mod:`~tensorflowonspark_tpu.obs.history` — bounded windowed
+  time-series rings over metric scrapes (rates, percentiles, JSONL
+  spill) — the autotune controller's read substrate.
+- :mod:`~tensorflowonspark_tpu.obs.slo` — declarative SLOs with
+  multi-window burn-rate evaluation over History.
+- :mod:`~tensorflowonspark_tpu.obs.snapshot` — one-command incident
+  bundle (``tools/obs_snapshot.py``).
 """
 
+from tensorflowonspark_tpu.obs.history import History
 from tensorflowonspark_tpu.obs.registry import (
     CONTENT_TYPE,
     Registry,
     default_registry,
     sanitize_name,
 )
+from tensorflowonspark_tpu.obs.slo import SLO, SLOEvaluator
 from tensorflowonspark_tpu.obs.spans import (
     SpanTracer,
     get_tracer,
@@ -48,7 +63,10 @@ from tensorflowonspark_tpu.obs.spans import (
 
 __all__ = [
     "CONTENT_TYPE",
+    "History",
     "Registry",
+    "SLO",
+    "SLOEvaluator",
     "SpanTracer",
     "default_registry",
     "get_tracer",

@@ -316,12 +316,18 @@ class MetricsAggregator:
         timeout: float = 5.0,
         registry: Registry | None = None,
         driver_key: str = "driver",
+        history: Any = None,
     ):
         self.targets = targets
         self.interval = max(0.2, float(interval))
         self.timeout = float(timeout)
         self.registry = registry if registry is not None else default_registry()
         self.driver_key = driver_key
+        # optional obs.history.History: every scrape round's parsed
+        # families land in its bounded rings (labelled node=<key>), so
+        # the driver holds WINDOWS of cluster telemetry — rates and
+        # percentiles over the last N rounds — not just the last scrape
+        self.history = history
         self._lock = threading.Lock()
         # {node_key: {"ok", "samples", "types", "error", "scraped_at"}}
         self._last: dict[Any, dict[str, Any]] = {}  # guarded-by: self._lock
@@ -399,6 +405,19 @@ class MetricsAggregator:
         dt_cpu = time.thread_time() - c0
         self._m_seconds.observe(dt)
         self._note_ingest_rates(results)
+        if self.history is not None:
+            for key, entry in results.items():
+                if not entry.get("ok"):
+                    continue
+                try:
+                    self.history.record_families(
+                        entry["families"],
+                        extra_labels={"node": str(key)},
+                        t=entry.get("scraped_at"),
+                    )
+                except Exception as e:  # noqa: BLE001 - the windowed
+                    # store is an observer; it must not fail the scrape
+                    logger.warning("history record failed: %s", e)
         with self._lock:
             self._last = results
             self.total_scrape_s += dt

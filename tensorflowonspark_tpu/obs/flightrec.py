@@ -41,9 +41,58 @@ from tensorflowonspark_tpu.obs.registry import Registry, default_registry
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["FlightRecorder", "dump_now", "get", "install", "note"]
+__all__ = [
+    "EVENTS",
+    "FlightRecorder",
+    "dump_now",
+    "get",
+    "install",
+    "note",
+]
 
 FORMAT_VERSION = 1
+
+#: The registered event-name catalog. Postmortem tooling greps dumps by
+#: these exact strings, so ``note()`` call sites must use literals from
+#: this set — lint rule OB002 (``analysis/flightrecnames.py``) parses
+#: this assignment from disk (the FP001 pattern) and flags dynamic or
+#: unregistered names at build time. Adding an event = add the literal
+#: here, ``note()`` it at the site, document it in
+#: docs/OBSERVABILITY.md. (``dump_now`` *reasons* are free-form — they
+#: name why a dump was cut, not a queryable event stream.)
+EVENTS = frozenset(
+    {
+        # cluster liveness / supervision (cluster/*)
+        "node_start",
+        "dead_node",
+        "supervise_restart",
+        "map_fun_error",
+        "membership_epoch",
+        # elastic reconfiguration (compute/elastic.py, cluster/tfcluster.py)
+        "elastic_epoch_bump",
+        "elastic_reconfigure",
+        "elastic_reconfigure_failed",
+        "elastic_hydrate",
+        # ingest plane (feed/ingest.py, cluster/tfcluster.py)
+        "ingest_plan",
+        "ingest_plan_republish",
+        "ingest_handover",
+        # serving fleet (serving/*)
+        "engine_watchdog",
+        "fleet_shed",
+        "fleet_drain",
+        "replica_drain",
+        "replica_respawn",
+        "replica_dead",
+        "replica_swap",
+        "rollout_begin",
+        "rollout_complete",
+        "rollout_rollback",
+        # observability plane (obs/slo.py, utils/lockwitness.py)
+        "slo_breach",
+        "tfsan",
+    }
+)
 
 
 class FlightRecorder:

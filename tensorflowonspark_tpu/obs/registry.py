@@ -408,7 +408,11 @@ class Registry:
         (``""`` for the unlabeled series). Counter/gauge entries are
         ``{"value", "delta"}``; histogram entries are ``{"count",
         "sum", "delta_count", "delta_sum"}`` (windowed mean latency =
-        ``delta_sum / delta_count``). The first call's deltas equal the
+        ``delta_sum / delta_count``) plus the cumulative bucket view —
+        ``"le"`` (finite bucket bounds), ``"buckets"`` (cumulative
+        counts per bound; ``count`` is the implicit ``+Inf``), and
+        ``"delta_buckets"`` — so a consumer (``obs.history``) can
+        derive windowed percentiles. The first call's deltas equal the
         values (window start = registry birth). Collectors run first,
         like :meth:`render`.
         """
@@ -425,7 +429,7 @@ class Registry:
                 series: dict[str, Any] = {}
                 with m._lock:
                     items = [
-                        (k, (v["count"], v["sum"]))
+                        (k, (v["count"], v["sum"], list(v["counts"])))
                         if isinstance(m, Histogram)
                         else (k, v)
                         for k, v in sorted(m._series.items())
@@ -433,14 +437,28 @@ class Registry:
                 for key, v in items:
                     wkey = (name, key)
                     if isinstance(m, Histogram):
-                        prev = self._window_prev.get(wkey, (0, 0.0))
+                        cnt, tot, buckets = v
+                        prev = self._window_prev.get(
+                            wkey, (0, 0.0, [0] * len(buckets))
+                        )
+                        # pre-extension windows stored (count, sum) only
+                        prev_b = (
+                            prev[2]
+                            if len(prev) > 2
+                            else [0] * len(buckets)
+                        )
                         entry = {
-                            "count": v[0],
-                            "sum": v[1],
-                            "delta_count": v[0] - prev[0],
-                            "delta_sum": v[1] - prev[1],
+                            "count": cnt,
+                            "sum": tot,
+                            "delta_count": cnt - prev[0],
+                            "delta_sum": tot - prev[1],
+                            "le": list(m.buckets),
+                            "buckets": buckets,
+                            "delta_buckets": [
+                                b - p for b, p in zip(buckets, prev_b)
+                            ],
                         }
-                        self._window_prev[wkey] = v
+                        self._window_prev[wkey] = (cnt, tot, buckets)
                     else:
                         prev_v = self._window_prev.get(wkey, 0.0)
                         entry = {"value": v, "delta": v - prev_v}

@@ -1,0 +1,250 @@
+"""One-command incident bundle: scrape, dump, merge — then page someone.
+
+During an incident the evidence is scattered across processes that may
+be about to die: the driver registry, each node runtime's ``/metrics``,
+every serving replica's ``/metrics`` + ``/debugz`` trace ring, and the
+flight-recorder dumps already on disk. This module gathers all of it
+into ONE postmortem directory in a single pass (``tools/obs_snapshot.py``
+is the CLI)::
+
+    out/
+      MANIFEST.json           what was collected, from where, and what
+                              failed (a dead source is a recorded error,
+                              never an aborted bundle)
+      metrics/<source>.prom   raw Prometheus expositions, one per URL
+      traces/<source>-<id>.trace.json
+                              per-request timelines pulled from each
+                              ``/debugz`` ring (Chrome-trace JSON)
+      flightrec/<name>.json   flight-recorder dumps copied from disk
+      merged_trace.json       every trace above — debugz timelines and
+                              flightrec span exports — clock-aligned
+                              into one timeline via
+                              :mod:`~tensorflowonspark_tpu.obs.trace_merge`
+
+Everything here is stdlib-only (urllib + json + shutil), so the CLI
+runs through the stub-package fast path without importing jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globlib
+import json
+import logging
+import os
+import re
+import shutil
+import time
+import urllib.request
+from typing import Any, Iterable, Mapping, Sequence
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["collect_bundle", "main"]
+
+
+def _slug(text: str) -> str:
+    """Filesystem-safe name for a URL/source ("http://h:8500/metrics"
+    -> "h_8500_metrics")."""
+    text = re.sub(r"^[a-z]+://", "", str(text))
+    return re.sub(r"[^A-Za-z0-9._-]+", "_", text).strip("_") or "src"
+
+
+def _fetch(url: str, timeout: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
+def _normalize_sources(sources: Iterable[Any]) -> list[tuple[str, str]]:
+    """``(name, url)`` pairs from urls, ``name=url`` strings, pairs, or
+    ``{name: url}`` mappings."""
+    out: list[tuple[str, str]] = []
+    for src in sources or ():
+        if isinstance(src, Mapping):
+            out.extend((str(k), str(v)) for k, v in src.items())
+        elif isinstance(src, (tuple, list)) and len(src) == 2:
+            out.append((str(src[0]), str(src[1])))
+        elif isinstance(src, str) and "=" in src.split("://", 1)[0]:
+            name, url = src.split("=", 1)
+            out.append((name, url))
+        else:
+            out.append((_slug(src), str(src)))
+    return out
+
+
+def collect_bundle(
+    out_dir: str,
+    metrics_urls: Iterable[Any] = (),
+    debugz_urls: Iterable[Any] = (),
+    flightrec_globs: Sequence[str] = (),
+    trace_files: Sequence[str] = (),
+    timeout: float = 5.0,
+) -> dict[str, Any]:
+    """Collect one incident bundle under ``out_dir``; returns the
+    manifest (also written as ``MANIFEST.json``). Per-source failures
+    are recorded in the manifest — an incident bundle's job is to
+    save whatever is still reachable, not to be atomic."""
+    os.makedirs(out_dir, exist_ok=True)
+    manifest: dict[str, Any] = {
+        "snapshot_version": 1,
+        "written_unix": time.time(),
+        "metrics": [],
+        "traces": [],
+        "flightrec": [],
+        "errors": [],
+    }
+
+    def _err(source: str, e: BaseException) -> None:
+        manifest["errors"].append(
+            {"source": source, "error": f"{type(e).__name__}: {e}"}
+        )
+
+    # -- raw Prometheus expositions -----------------------------------
+    metrics_dir = os.path.join(out_dir, "metrics")
+    for name, url in _normalize_sources(metrics_urls):
+        try:
+            text = _fetch(url, timeout)
+            os.makedirs(metrics_dir, exist_ok=True)
+            path = os.path.join(metrics_dir, f"{_slug(name)}.prom")
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(text)
+            manifest["metrics"].append({"name": name, "url": url})
+        except Exception as e:  # noqa: BLE001 - recorded per source
+            _err(url, e)
+
+    # -- tail-sampled request timelines from each /debugz ring --------
+    traces_dir = os.path.join(out_dir, "traces")
+    mergeable: list[str] = []
+    for name, base in _normalize_sources(debugz_urls):
+        base = base.rstrip("/")
+        try:
+            listing = json.loads(
+                _fetch(f"{base}/debugz/traces", timeout)
+            )
+        except Exception as e:  # noqa: BLE001 - recorded per source
+            _err(base, e)
+            continue
+        for tid in listing.get("trace_ids") or []:
+            try:
+                data = _fetch(f"{base}/debugz/trace/{tid}", timeout)
+                os.makedirs(traces_dir, exist_ok=True)
+                path = os.path.join(
+                    traces_dir, f"{_slug(name)}-{_slug(tid)}.trace.json"
+                )
+                with open(path, "w", encoding="utf-8") as f:
+                    f.write(data)
+                mergeable.append(path)
+                manifest["traces"].append(
+                    {"source": name, "trace_id": tid}
+                )
+            except Exception as e:  # noqa: BLE001 - one evicted trace
+                # must not lose the rest of the ring
+                _err(f"{base}/debugz/trace/{tid}", e)
+
+    # -- flight-recorder dumps already on disk ------------------------
+    rec_dir = os.path.join(out_dir, "flightrec")
+    for pattern in flightrec_globs or ():
+        for path in sorted(globlib.glob(pattern)):
+            try:
+                os.makedirs(rec_dir, exist_ok=True)
+                dst = os.path.join(rec_dir, os.path.basename(path))
+                shutil.copyfile(path, dst)
+                mergeable.append(dst)
+                manifest["flightrec"].append(os.path.basename(path))
+            except Exception as e:  # noqa: BLE001 - recorded per file
+                _err(path, e)
+    mergeable.extend(p for p in (trace_files or ()) if os.path.exists(p))
+
+    # -- one clock-aligned timeline over everything -------------------
+    if mergeable:
+        from tensorflowonspark_tpu.obs import trace_merge
+
+        try:
+            merged = trace_merge.merge_traces(mergeable)
+            merged_path = os.path.join(out_dir, "merged_trace.json")
+            with open(merged_path, "w", encoding="utf-8") as f:
+                json.dump(merged, f)
+            manifest["merged_trace"] = {
+                "path": "merged_trace.json",
+                "events": len(merged.get("traceEvents") or []),
+                "sources": len(mergeable),
+            }
+        except Exception as e:  # noqa: BLE001 - a torn trace must not
+            # lose the raw files already saved
+            _err("merge", e)
+
+    with open(
+        os.path.join(out_dir, "MANIFEST.json"), "w", encoding="utf-8"
+    ) as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    return manifest
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="obs_snapshot",
+        description="collect one incident bundle: /metrics scrapes, "
+        "/debugz trace rings, flight-recorder dumps, and a merged "
+        "cluster timeline",
+    )
+    p.add_argument("-o", "--out", required=True, help="bundle directory")
+    p.add_argument(
+        "--metrics",
+        action="append",
+        default=[],
+        metavar="[NAME=]URL",
+        help="a /metrics endpoint to scrape (repeatable): the driver, "
+        "a node runtime's metrics_urls() entry, a replica",
+    )
+    p.add_argument(
+        "--debugz",
+        action="append",
+        default=[],
+        metavar="[NAME=]URL",
+        help="a serve_model base URL whose /debugz trace ring to dump "
+        "(repeatable)",
+    )
+    p.add_argument(
+        "--flightrec",
+        action="append",
+        default=[],
+        metavar="GLOB",
+        help="flight-recorder dump glob (repeatable; default "
+        "logs/flightrec-*.json when none given)",
+    )
+    p.add_argument(
+        "--trace",
+        action="append",
+        default=[],
+        metavar="FILE",
+        help="extra Chrome-trace file to fold into the merge "
+        "(repeatable)",
+    )
+    p.add_argument("--timeout", type=float, default=5.0)
+    args = p.parse_args(argv)
+    recs = args.flightrec or ["logs/flightrec-*.json"]
+    manifest = collect_bundle(
+        args.out,
+        metrics_urls=args.metrics,
+        debugz_urls=args.debugz,
+        flightrec_globs=recs,
+        trace_files=args.trace,
+        timeout=args.timeout,
+    )
+    print(
+        json.dumps(
+            {
+                "out": args.out,
+                "metrics": len(manifest["metrics"]),
+                "traces": len(manifest["traces"]),
+                "flightrec": len(manifest["flightrec"]),
+                "errors": len(manifest["errors"]),
+                "merged": "merged_trace" in manifest,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

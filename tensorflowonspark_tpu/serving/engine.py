@@ -80,6 +80,7 @@ import numpy as np
 
 from tensorflowonspark_tpu.models.llama import Llama
 from tensorflowonspark_tpu.obs import registry as obs_registry
+from tensorflowonspark_tpu.obs import reqtrace
 from tensorflowonspark_tpu.obs import spans as obs_spans
 from tensorflowonspark_tpu.utils.failpoints import failpoint
 
@@ -349,6 +350,14 @@ class _Pending:
     # ContinuousBatcher._emit) so consumer-side work never runs on the
     # scheduler's critical path.
     sink: "queue.Queue | None" = None
+    # distributed request tracing (obs.reqtrace): the trace id this
+    # request rides, or None (near-zero cost — every stamp below is
+    # gated on `trace is not None`). `trace_mark` is the scheduler's
+    # per-request segment cursor: monotonic time of the last stamped
+    # segment boundary, advanced queue -> prefill -> decode blocks ->
+    # finish so the segment union covers the request's wall time.
+    trace: str | None = None
+    trace_mark: float | None = None
 
 
 class _Stream:
@@ -1145,6 +1154,7 @@ class ContinuousBatcher:
         logit_bias: "dict[int, float] | None" = None,
         decode_block_pin: int | None = None,
         deadline_s: float | None = None,
+        trace: str | None = None,
     ) -> list[_Pending]:
         """Validate then enqueue a group ATOMICALLY: either every row is
         accepted or none is — a partially admitted multi-row request
@@ -1200,6 +1210,7 @@ class ContinuousBatcher:
                 ),
                 submitted_at=time.monotonic(),
                 sink=sink,
+                trace=trace,
             )
             for (tokens, sink), rs in zip(requests, row_seeds)
         ]
@@ -1248,12 +1259,13 @@ class ContinuousBatcher:
         logit_bias: "dict[int, float] | None" = None,
         decode_block_pin: int | None = None,
         deadline_s: float | None = None,
+        trace: str | None = None,
     ) -> _Pending:
         return self._enqueue_all(
             [(tokens, sink)], max_new_tokens, temperature, eos_id,
             adapter, stop, top_k, top_p, seed, min_p,
             frequency_penalty, presence_penalty, logit_bias,
-            decode_block_pin, deadline_s,
+            decode_block_pin, deadline_s, trace=trace,
         )[0]
 
     def submit(
@@ -1273,6 +1285,7 @@ class ContinuousBatcher:
         presence_penalty: float | None = None,
         logit_bias: "dict[int, float] | None" = None,
         deadline_s: float | None = None,
+        trace: str | None = None,
     ) -> "list[int] | tuple[list[int], list[float]]":
         """Blocking decode. ``temperature``, ``top_k``, ``top_p`` and
         ``eos_id`` override the engine-wide defaults FOR THIS REQUEST
@@ -1296,6 +1309,7 @@ class ContinuousBatcher:
             presence_penalty=presence_penalty,
             logit_bias=logit_bias,
             deadline_s=deadline_s,
+            trace=trace,
         )
         p.event.wait()
         if p.error is not None:
@@ -1322,6 +1336,7 @@ class ContinuousBatcher:
         logit_bias: "dict[int, float] | None" = None,
         deadline_s: float | None = None,
         return_versions: bool = False,
+        trace: str | None = None,
     ) -> "list[list[int]] | tuple[list[list[int]], list[list[float]]]":
         """Blocking decode of several prompts admitted ATOMICALLY (all
         rows accepted or an EngineOverloaded/ValueError before any row
@@ -1346,6 +1361,7 @@ class ContinuousBatcher:
             logit_bias,
             None,
             deadline_s,
+            trace=trace,
         )
         for p in ps:
             p.event.wait()
@@ -1376,6 +1392,7 @@ class ContinuousBatcher:
         presence_penalty: float | None = None,
         logit_bias: "dict[int, float] | None" = None,
         deadline_s: float | None = None,
+        trace: str | None = None,
     ):
         """Yield completion tokens AS THEY DECODE (one engine step of
         latency each) instead of blocking for the full result.
@@ -1406,6 +1423,7 @@ class ContinuousBatcher:
             presence_penalty=presence_penalty,
             logit_bias=logit_bias,
             deadline_s=deadline_s,
+            trace=trace,
         )
 
         # An explicit iterator, NOT a generator: close() on a
@@ -1690,6 +1708,10 @@ class ContinuousBatcher:
         self._params = req.placed
         self._weights_version = req.version
         self._weights_swaps += 1
+        # the swap joins every in-flight request's timeline: a traced
+        # completion whose tokens span the install shows exactly where
+        # its weights changed (rollout coherence evidence)
+        reqtrace.mark("engine.weights_swap", version=req.version)
         if self._prefix_store is not None:
             # stored prefixes' K/V was computed under the OLD weights —
             # a post-swap hit would resume prefill from stale state
@@ -1727,9 +1749,13 @@ class ContinuousBatcher:
         self._m_phase.observe(time.monotonic() - t0, phase=phase)
 
     def _observe_queue_wait(self, p: _Pending) -> None:
-        dur = time.monotonic() - p.submitted_at
+        now = time.monotonic()
+        dur = now - p.submitted_at
         self._tracer.record("engine.queue", dur)
         self._m_phase.observe(dur, phase="queue")
+        if p.trace is not None:
+            reqtrace.segment(p.trace, "engine.queue", dur)
+            p.trace_mark = now
 
     def health(self) -> dict:
         """Liveness vs readiness, split (the ``/healthz`` contract —
@@ -2526,6 +2552,14 @@ class ContinuousBatcher:
         thread so stream consumers are off the decode critical path."""
         if p.first_token_at is None:
             p.first_token_at = time.monotonic()
+            if p.trace is not None and p.trace_mark is not None:
+                # dequeue -> first token: the request's prefill share
+                # (includes its chunked-prefill dispatch waits)
+                reqtrace.segment(
+                    p.trace, "engine.prefill",
+                    p.first_token_at - p.trace_mark,
+                )
+                p.trace_mark = p.first_token_at
         if p.sink is not None:
             self._emitter.deliver(p.sink, (token, logprob))
 
@@ -2605,6 +2639,19 @@ class ContinuousBatcher:
                     self._emit(p, t, lps[-1])
                     if self._finished(p, out, t):
                         self._retire(row)
+            now = time.monotonic()
+            for entry in self._live:
+                if entry is None:
+                    continue
+                p = entry[0]
+                if p.trace is not None and p.trace_mark is not None:
+                    # this block's wall share for the request: dispatch
+                    # + fetch wait + sweep since the last stamp
+                    reqtrace.segment(
+                        p.trace, "engine.decode", now - p.trace_mark,
+                        tokens=k,
+                    )
+                    p.trace_mark = now
         if self._window:
             dur = time.monotonic() - t0
             self._overlap_hidden_s += dur
@@ -2708,6 +2755,19 @@ class ContinuousBatcher:
         # weight swaps — so a completion's version is exactly the tree
         # it finished decoding under (rollout coherence contract)
         p.weights_version = self._weights_version
+        if p.trace is not None:
+            if p.trace_mark is not None:
+                # tail of the final decode block up to retirement
+                reqtrace.segment(
+                    p.trace, "engine.decode", now - p.trace_mark
+                )
+                p.trace_mark = now
+            reqtrace.event(
+                p.trace, "engine.retire",
+                tokens=len(out),
+                weights_version=p.weights_version,
+                cancelled=p.cancelled,
+            )
         # result/logprobs are set BEFORE the terminal marker is queued:
         # a stream consumer that sees the emitter-delivered True and
         # reads .result gets the final value.
@@ -2757,6 +2817,11 @@ class ContinuousBatcher:
             self._failed_total += 1
         self._m_failed.inc()
         p.error = err
+        if p.trace is not None:
+            reqtrace.event(
+                p.trace, "engine.fail", error=type(err).__name__
+            )
+            reqtrace.flag(p.trace, error=type(err).__name__)
         if p.sink is not None:
             self._emitter.deliver(p.sink, err)
         p.event.set()

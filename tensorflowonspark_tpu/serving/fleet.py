@@ -57,7 +57,7 @@ import time
 import urllib.error
 import urllib.request
 
-from tensorflowonspark_tpu.obs import flightrec
+from tensorflowonspark_tpu.obs import flightrec, reqtrace
 from tensorflowonspark_tpu.obs import registry as obs_registry
 from tensorflowonspark_tpu.serving.engine import (
     DeadlineExceeded,
@@ -434,13 +434,22 @@ class SubprocessReplica:
         return body
 
     def submit_many(self, prompts, max_new_tokens, **kw):
+        # the trace id crosses the process boundary as a header, not a
+        # body field — the child's ingress adopts it exactly like any
+        # external caller's X-TFOS-Trace
+        trace = kw.pop("trace", None)
         body = self._request_body(prompts, max_new_tokens, kw)
         timeout = self._request_timeout
         if kw.get("deadline_s") is not None:
             # the HTTP wait must outlive the engine's own deadline so
             # the typed 504 (not a socket timeout) is what comes back
             timeout = max(timeout, float(kw["deadline_s"]) + 30.0)
-        status, payload = self._post("/generate", body, timeout)
+        status, payload = self._post(
+            "/generate",
+            body,
+            timeout,
+            headers={reqtrace.HEADER: trace} if trace else None,
+        )
         if status != 200:
             self._raise_mapped(status, payload)
         out: tuple = (payload["completions"],)
@@ -451,6 +460,7 @@ class SubprocessReplica:
         return out if len(out) > 1 else out[0]
 
     def stream(self, tokens, max_new_tokens, **kw):
+        trace = kw.pop("trace", None)
         body = self._request_body([tokens], max_new_tokens, kw)
         body["stream"] = True
         timeout = self._request_timeout
@@ -461,7 +471,8 @@ class SubprocessReplica:
             # a dead replica (which would drain a healthy one)
             timeout = max(timeout, float(kw["deadline_s"]) + 30.0)
         return _HTTPStream(
-            self, body, bool(kw.get("yield_logprobs")), timeout
+            self, body, bool(kw.get("yield_logprobs")), timeout,
+            trace=trace,
         )
 
     def reload(
@@ -581,13 +592,16 @@ class _HTTPStream:
     _conn = None  # class default: __del__ must be safe when the
     # constructor raised before the connection existed
 
-    def __init__(self, replica, body, yield_logprobs, timeout):
+    def __init__(self, replica, body, yield_logprobs, timeout, trace=None):
         self._rid = replica.rid
         self._yield_logprobs = yield_logprobs
         self._done = False
         self.result = None
         self.logprobs = None
         self.weights_version = None  # from the done-trailer
+        headers = {"Content-Type": "application/json"}
+        if trace:
+            headers[reqtrace.HEADER] = trace
         try:
             self._conn = http.client.HTTPConnection(
                 "127.0.0.1", replica.port, timeout=timeout
@@ -596,7 +610,7 @@ class _HTTPStream:
                 "POST",
                 "/generate",
                 json.dumps(body),
-                {"Content-Type": "application/json"},
+                headers,
             )
             self._resp = self._conn.getresponse()
         except Exception as e:  # noqa: BLE001 - transport = replica gone

@@ -69,7 +69,7 @@ import threading
 import time
 import zlib
 
-from tensorflowonspark_tpu.obs import flightrec
+from tensorflowonspark_tpu.obs import flightrec, reqtrace
 from tensorflowonspark_tpu.obs import registry as obs_registry
 from tensorflowonspark_tpu.serving.engine import WeightsIncompatible
 from tensorflowonspark_tpu.serving.fleet import READY
@@ -849,6 +849,13 @@ class RolloutController:
             swap_kind=update.kind, seconds=round(dur, 3),
             generation=view["generation"],
         )
+        # every request in flight DURING the swap gets the rollout on
+        # its own timeline — a trace spanning a version flip shows
+        # exactly where it happened
+        reqtrace.mark(
+            "rollout.replica_swap", replica=rid,
+            version=update.version,
+        )
         logger.info(
             "replica %d -> %r in %.2fs", rid, update.version, dur
         )
@@ -1066,6 +1073,9 @@ class RolloutController:
         flightrec.note(
             "replica_swap", replica=0, version=update.version,
             swap_kind=update.kind,
+        )
+        reqtrace.mark(
+            "rollout.replica_swap", replica=0, version=update.version
         )
         flightrec.note("rollout_complete", version=update.version)
         return "completed"

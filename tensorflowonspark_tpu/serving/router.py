@@ -47,7 +47,7 @@ import threading
 import time
 from collections import OrderedDict
 
-from tensorflowonspark_tpu.obs import flightrec
+from tensorflowonspark_tpu.obs import flightrec, reqtrace
 
 from tensorflowonspark_tpu.serving.engine import (
     EngineOverloaded,
@@ -214,6 +214,12 @@ class FleetRouter:
             "router_queue_depth",
             "requests dispatched by the router and not yet resolved",
         )
+        self._h_latency = reg.histogram(
+            "router_request_seconds",
+            "end-to-end latency of successfully routed requests "
+            "(placement through reply) — the fleet_latency SLO "
+            "substrate",
+        )
 
         def _collect(depth=self._g_depth):
             with self._lock:
@@ -262,13 +268,17 @@ class FleetRouter:
         depth = max(depth, outstanding)
         return rate * (depth / slots + 1.0)
 
-    def _shed(self, reason: str) -> None:  # lint: holds-lock
+    def _shed(self, reason: str, trace: str | None = None) -> None:  # lint: holds-lock
         # callers hold self._lock (counter inc nests the metric's own
         # lock under ours; nothing ever nests the other way)
         self._m_shed.inc(reason=reason)
         first = reason not in self._shed_counts
         self._shed_counts[reason] = self._shed_counts.get(reason, 0) + 1
-        flightrec.note("fleet_shed", reason=reason)
+        # the shed decision is attributed on the victim's trace (and
+        # the trace id rides the flight-recorder event, so a
+        # postmortem joins the two planes by id)
+        flightrec.note("fleet_shed", reason=reason, trace=trace)
+        reqtrace.event(trace, "router.shed", reason=reason)
         if first:
             # shedding beginning (per reason) is an incident: persist
             # the record — on a daemon thread, the dump's IO must not
@@ -279,14 +289,14 @@ class FleetRouter:
                 daemon=True,
             ).start()
 
-    def _place(self, tokens, adapter: int, deadline_s, exclude):
+    def _place(self, tokens, adapter: int, deadline_s, exclude, trace=None):
         """Pick the replica for one request: affinity first, then
         least-loaded; deadline admission on the pick (affinity yields
         to feasibility). Bumps the pick's outstanding count and
         records the prompt in the affinity index before returning."""
         if self._fleet.draining or self._fleet.closed:
             with self._lock:
-                self._shed("drain")
+                self._shed("drain", trace=trace)
             raise FleetUnavailable(
                 "fleet is draining; no new requests are admitted"
             )
@@ -297,7 +307,7 @@ class FleetRouter:
         ]
         if not ready:
             with self._lock:
-                self._shed("no_ready")
+                self._shed("no_ready", trace=trace)
             raise FleetUnavailable("no ready replica")
         with self._lock:
             outstanding = {
@@ -343,7 +353,7 @@ class FleetRouter:
                     )
                     est_alt = waits[alt["rid"]]
                     if est_alt > float(deadline_s):
-                        self._shed("deadline")
+                        self._shed("deadline", trace=trace)
                         raise FleetOverloaded(
                             f"deadline_s={deadline_s} cannot be met: "
                             f"best replica's estimated completion is "
@@ -358,12 +368,15 @@ class FleetRouter:
 
     def _resolve(self, rid: int, outcome: str, t0=None) -> None:
         self._m_requests.inc(replica=str(rid), outcome=outcome)
+        dur = None
+        if outcome == "ok" and t0 is not None:
+            dur = time.monotonic() - t0
+            self._h_latency.observe(dur)
         with self._lock:
             n = self._outstanding.get(rid, 0)
             if n > 0:
                 self._outstanding[rid] = n - 1
-            if outcome == "ok" and t0 is not None:
-                dur = time.monotonic() - t0
+            if dur is not None:
                 prev = self._est_req_s.get(rid)
                 self._est_req_s[rid] = (
                     dur
@@ -395,9 +408,34 @@ class FleetRouter:
         before the reply (wedge, severed replica, armed dispatch
         failpoint) fail over exactly once — no token ever reached the
         caller, so the retry is invisible; the failing replica drains
-        and respawns."""
+        and respawns.
+
+        A ``trace=`` kwarg (or a fresh mint when tracing is on) rides
+        the whole routed lifetime: placement, each failover hop, and
+        the dispatch to the replica happen on the SAME trace — the
+        replica handle forwards the id to the engine (in-process) or
+        across the wire as ``X-TFOS-Trace`` (subprocess)."""
         if not prompts:
             raise ValueError("prompts must be a non-empty list")
+        tid, owned = reqtrace.ensure(kw.pop("trace", None), route="submit")
+        if tid is not None:
+            kw["trace"] = tid
+        t_req = time.monotonic()
+        try:
+            out = self._submit_many_routed(prompts, max_new_tokens, tid, kw)
+        except BaseException as e:
+            reqtrace.flag(tid, error=type(e).__name__)
+            if owned:
+                reqtrace.finish(
+                    tid, outcome="error", error=type(e).__name__
+                )
+            raise
+        reqtrace.segment(tid, "router.submit", time.monotonic() - t_req)
+        if owned:
+            reqtrace.finish(tid, outcome="ok")
+        return out
+
+    def _submit_many_routed(self, prompts, max_new_tokens, tid, kw):
         adapter = int(kw.get("adapter") or 0)
         deadline_s = kw.get("deadline_s")
         tried: set[int] = set()
@@ -405,18 +443,22 @@ class FleetRouter:
         for attempt in (0, 1):
             try:
                 pick = self._place(
-                    prompts[0], adapter, deadline_s, tried
+                    prompts[0], adapter, deadline_s, tried, trace=tid
                 )
             except FleetUnavailable:
                 if isinstance(last_err, EngineOverloaded):
                     with self._lock:
-                        self._shed("queue_full")
+                        self._shed("queue_full", trace=tid)
                     raise FleetOverloaded(
                         "every routable replica's queue is full"
                     ) from last_err
                 if last_err is not None:
                     raise last_err from None
                 raise
+            reqtrace.event(
+                tid, "router.place",
+                replica=pick["rid"], attempt=attempt,
+            )
             t0 = time.monotonic()
             try:
                 if failpoint("fleet.dispatch") == "drop":
@@ -441,6 +483,11 @@ class FleetRouter:
                 last_err = e
                 if attempt == 0:
                     self._note_failover()
+                    reqtrace.event(
+                        tid, "router.failover",
+                        replica=pick["rid"], error=type(e).__name__,
+                    )
+                    reqtrace.flag(tid, failover=True)
                     continue
                 raise
             except EngineOverloaded as e:
@@ -450,7 +497,7 @@ class FleetRouter:
                 if attempt == 0:
                     continue
                 with self._lock:
-                    self._shed("queue_full")
+                    self._shed("queue_full", trace=tid)
                 raise FleetOverloaded(
                     f"every routable replica's queue is full: {e}"
                 ) from e
@@ -548,6 +595,15 @@ class _RoutedStream:
         self._router = router
         self._tokens = list(tokens)
         self._max_new = max_new
+        # adopt (or mint) the request trace before kw is forwarded —
+        # the id rides kw into the replica handle so connect retries
+        # and the eventual engine segments land on the SAME trace
+        self._trace, self._trace_owned = reqtrace.ensure(
+            kw.pop("trace", None), route="stream"
+        )
+        self._trace_done = False
+        if self._trace is not None:
+            kw["trace"] = self._trace
         self._kw = kw
         self._adapter = int(kw.get("adapter") or 0)
         self._deadline = kw.get("deadline_s")
@@ -564,7 +620,12 @@ class _RoutedStream:
         self._rid: int | None = None
         self._gen: int | None = None
         self._t0: float | None = None
-        self._open()
+        self._t_req = time.monotonic()
+        try:
+            self._open()
+        except BaseException as e:
+            self._trace_finish("error", error=type(e).__name__)
+            raise
 
     def _open(self) -> None:
         """Place + connect. Failover-eligible connect failures consume
@@ -577,12 +638,14 @@ class _RoutedStream:
             try:
                 pick = self._router._place(
                     self._tokens, self._adapter, self._deadline,
-                    self._tried,
+                    self._tried, trace=self._trace,
                 )
             except FleetUnavailable:
                 if isinstance(self._overload_err, EngineOverloaded):
                     with self._router._lock:
-                        self._router._shed("queue_full")
+                        self._router._shed(
+                            "queue_full", trace=self._trace
+                        )
                     raise FleetOverloaded(
                         "every routable replica's queue is full"
                     ) from self._overload_err
@@ -592,6 +655,9 @@ class _RoutedStream:
                         "no replica left to fail over to"
                     ) from None
                 raise
+            reqtrace.event(
+                self._trace, "router.place", replica=pick["rid"]
+            )
             self._rid = pick["rid"]
             self._gen = pick["generation"]
             self._t0 = time.monotonic()
@@ -616,6 +682,12 @@ class _RoutedStream:
                     self._resolved = True
                     self._failed_over = True
                     self._router._note_failover()
+                    reqtrace.event(
+                        self._trace, "router.failover",
+                        replica=pick["rid"],
+                        error=type(e).__name__,
+                    )
+                    reqtrace.flag(self._trace, failover=True)
                     continue
                 self._router._resolve(pick["rid"], "error")
                 self._resolved = True
@@ -630,7 +702,9 @@ class _RoutedStream:
                     self._overload_err = e
                     continue
                 with self._router._lock:
-                    self._router._shed("queue_full")
+                    self._router._shed(
+                        "queue_full", trace=self._trace
+                    )
                 raise FleetOverloaded(
                     f"every routable replica's queue is full: {e}"
                 ) from e
@@ -665,8 +739,20 @@ class _RoutedStream:
                     self._resolved = True
                     self._failed_over = True
                     self._router._note_failover()
+                    reqtrace.event(
+                        self._trace, "router.failover",
+                        replica=self._rid,
+                        error=type(e).__name__,
+                    )
+                    reqtrace.flag(self._trace, failover=True)
                     self._tried.add(self._rid)
-                    self._open()  # raises terminally if it can't
+                    try:
+                        self._open()  # raises terminally if it can't
+                    except BaseException as te:
+                        self._trace_finish(
+                            "error", error=type(te).__name__
+                        )
+                        raise
                     continue
                 # mid-stream (or budget spent): exactly ONE terminal
                 self._finish("error")
@@ -684,6 +770,28 @@ class _RoutedStream:
             self._router._resolve(
                 self._rid, outcome,
                 self._t0 if outcome == "ok" else None,
+            )
+        self._trace_finish(outcome)
+
+    def _trace_finish(self, outcome: str, **detail) -> None:
+        """Terminal trace stamp — idempotent, because the accounting
+        terminal (:meth:`_finish`) and the exception terminals (a
+        raise out of ``_open``) can both fire for one stream."""
+        if self._trace is None or self._trace_done:
+            return
+        self._trace_done = True
+        reqtrace.segment(
+            self._trace, "router.stream",
+            time.monotonic() - self._t_req,
+        )
+        if outcome == "error":
+            reqtrace.flag(
+                self._trace, error=detail.get("error", True)
+            )
+        if self._trace_owned:
+            reqtrace.finish(
+                self._trace, outcome=outcome,
+                tokens=self._yielded, **detail,
             )
 
     @property

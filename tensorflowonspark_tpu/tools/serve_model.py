@@ -19,6 +19,18 @@ Endpoints::
                                 spans, plus the overlap pipeline's
                                 pipeline_depth / inflight_depth /
                                 drain_stalls / overlap_hidden_ms
+    GET  /statusz            -> SLO burn-rate verdicts (multi-window)
+                                + windowed-history stats + trace-ring
+                                stats; pumps the telemetry window on
+                                demand so pollers see fresh verdicts
+    GET  /debugz/traces      -> tail-sampled request-trace ring stats
+                                + retained trace ids
+    GET  /debugz/trace/<id>  -> one retained request timeline as a
+                                Chrome trace (merge with node traces
+                                via tools/trace_merge.py). Requests
+                                adopt an ``X-TFOS-Trace`` header (or
+                                mint an id); every JSON reply — 429/
+                                503/504 included — echoes ``trace``
     GET  /signature          -> the artifact's signature metadata
     POST /predict            -> body {"rows": [<row>, ...]}
                                 (rows as dicts per input_mapping, or raw
@@ -90,6 +102,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
+from tensorflowonspark_tpu.obs import reqtrace
 from tensorflowonspark_tpu.tools.run_model import _to_jsonable
 
 logger = logging.getLogger(__name__)
@@ -116,6 +129,16 @@ class _Handler(BaseHTTPRequestHandler):
     # disabled — hot-swapping weights is an operator-only surface)
     rollout_ctl: Any = None
     admin_token: str | None = None
+    # request-level observability plane (docs/OBSERVABILITY.md):
+    # the _ObsPlane pumping this server's registry into a windowed
+    # History and evaluating SLO burn rates (/statusz); None = no
+    # continuous engine to observe
+    obs_plane: Any = None
+    # the CURRENT request's trace id (adopted from X-TFOS-Trace or
+    # minted at ingress); _reply stamps it into every JSON body so
+    # error answers — 429/503/504 included — are trace-attributable
+    _trace: str | None = None
+    _last_code: int = 200
     # per-server lock (set in make_server): serializes jax dispatch on
     # one model while the HTTP layer stays threaded, so health checks
     # never queue behind a big batch
@@ -131,6 +154,8 @@ class _Handler(BaseHTTPRequestHandler):
     def _reply(
         self, code: int, payload: dict, headers: dict | None = None
     ) -> None:
+        if self._trace is not None and "trace" not in payload:
+            payload = {**payload, "trace": self._trace}
         self._reply_text(
             code, json.dumps(payload), "application/json", headers
         )
@@ -142,6 +167,7 @@ class _Handler(BaseHTTPRequestHandler):
         content_type: str,
         headers: dict | None = None,
     ) -> None:
+        self._last_code = code
         body = text.encode()
         self.send_response(code)
         self.send_header("Content-Type", content_type)
@@ -152,6 +178,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self) -> None:  # noqa: N802 - http.server API
+        self._trace = None
         if self.path in ("/healthz", "/readyz"):
             # Liveness vs readiness, SPLIT (docs/ROBUSTNESS.md "Serving
             # fleet"): live = the process/scheduler runs (restarting a
@@ -227,10 +254,40 @@ class _Handler(BaseHTTPRequestHandler):
             elif self.gen_fn is not None:
                 stats["mode"] = "fixed"
             self._reply(200, stats)
+        elif self.path == "/statusz":
+            # the SLO verdict surface: pump the windowed history NOW
+            # (deterministic for pollers/tests — no waiting on the
+            # background cadence) and report burn rates + breaches
+            out: dict = {"export_dir": self.export_dir}
+            if self.obs_plane is not None:
+                try:
+                    self.obs_plane.pump()
+                    out.update(self.obs_plane.statusz())
+                except Exception as e:  # noqa: BLE001 - a broken
+                    # evaluator is a report, not a 500 — /statusz is
+                    # what operators read DURING incidents
+                    out["error"] = f"{type(e).__name__}: {e}"
+            out["reqtrace"] = reqtrace.get_ring().stats()
+            self._reply(200, out)
+        elif self.path == "/debugz/traces":
+            ring = reqtrace.get_ring()
+            self._reply(200, {**ring.stats(), "trace_ids": ring.ids()})
+        elif self.path.startswith("/debugz/trace/"):
+            tid = self.path.rsplit("/", 1)[1]
+            data = reqtrace.to_chrome(tid)
+            if data is None:
+                self._reply(
+                    404,
+                    {"error": f"no retained trace {tid!r} (unknown, "
+                              "evicted, or not tail-sampled)"},
+                )
+            else:
+                self._reply(200, data)
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self) -> None:  # noqa: N802 - http.server API
+        self._trace = None
         if self.path == "/generate":
             self._do_generate()
             return
@@ -329,6 +386,9 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             self._reply(400, {"error": str(e)})
             return
+        # stamp the rollout onto every in-flight request's timeline:
+        # a trace spanning the swap shows WHICH weights served it
+        reqtrace.mark("admin.reload", version=update.version)
         ctl = self.rollout_ctl
         if getattr(self.gen_engine, "IS_FLEET", False):
             threading.Thread(
@@ -417,6 +477,43 @@ class _Handler(BaseHTTPRequestHandler):
         self._do_generate(payload=payload, v1_meta=meta)
 
     def _do_generate(self, payload=None, v1_meta=None) -> None:
+        """Trace-owning ingress shell around :meth:`_generate_inner`:
+        adopt the caller's ``X-TFOS-Trace`` id (a routed hop from a
+        fleet parent — flagged ``propagated`` so the hop is always
+        retrievable by the parent's tooling) or mint a fresh one, then
+        stamp the terminal ``http.generate`` segment and finish the
+        record with the HTTP outcome. Whoever BEGAN the trace finishes
+        it — an in-process router/engine below us only appends."""
+        hdr = self.headers.get(reqtrace.HEADER)
+        tid, owned = reqtrace.ensure(hdr, route="http.generate")
+        if tid is not None and hdr:
+            reqtrace.flag(tid, propagated=True)
+        self._trace = tid
+        self._last_code = 200
+        t0 = time.monotonic()
+        try:
+            self._generate_inner(payload, v1_meta, tid)
+        except BaseException as e:
+            reqtrace.flag(tid, error=type(e).__name__)
+            if owned:
+                reqtrace.finish(
+                    tid, outcome="error", error=type(e).__name__
+                )
+            raise
+        code = self._last_code
+        reqtrace.segment(
+            tid, "http.generate", time.monotonic() - t0
+        )
+        if code >= 400:
+            reqtrace.flag(tid, http_error=code)
+        if owned:
+            reqtrace.finish(
+                tid,
+                outcome="ok" if code < 400 else "error",
+                http_status=code,
+            )
+
+    def _generate_inner(self, payload=None, v1_meta=None, trace=None) -> None:
         if self.gen_fn is None and self.gen_engine is None:
             self._reply(
                 400, {"error": "server was not started with "
@@ -560,6 +657,7 @@ class _Handler(BaseHTTPRequestHandler):
                 prompts[0], temperature, max_new, eos_id, want_logprobs,
                 adapter, stop, req_top_k, req_top_p, req_seed,
                 req_min_p, req_fpen, req_ppen, req_bias, req_deadline,
+                trace=trace,
             )
             return
         from tensorflowonspark_tpu.serving import (
@@ -583,7 +681,7 @@ class _Handler(BaseHTTPRequestHandler):
                         want_logprobs, adapter, stop, req_top_k,
                         req_top_p, req_seed, req_min_p, req_fpen,
                         req_ppen, req_bias, req_deadline,
-                        want_versions,
+                        want_versions, trace=trace,
                     )
                     versions = None
                     if want_versions:
@@ -615,11 +713,14 @@ class _Handler(BaseHTTPRequestHandler):
                 except FleetOverloaded as e:
                     # router admission shed: the deadline cannot be met
                     # from queue-depth estimates (or every queue is
-                    # full) — tell the client WHEN to come back
+                    # full) — tell the client WHEN to come back, and
+                    # WHERE the number came from (the router's
+                    # queue-depth/EWMA estimate, not a fixed backoff)
                     self._reply(
                         429,
                         {"error": str(e),
-                         "error_type": "FleetOverloaded"},
+                         "error_type": "FleetOverloaded",
+                         "retry_after_src": "router_estimate"},
                         {"Retry-After": str(int(math.ceil(e.retry_after)))},
                     )
                     return
@@ -628,7 +729,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self._reply(
                         503,
                         {"error": str(e),
-                         "error_type": "FleetUnavailable"},
+                         "error_type": "FleetUnavailable",
+                         "retry_after_src": "static"},
                         {"Retry-After": "2"},
                     )
                     return
@@ -636,7 +738,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self._reply(
                         503,
                         {"error": str(e),
-                         "error_type": "EngineOverloaded"},
+                         "error_type": "EngineOverloaded",
+                         "retry_after_src": "static"},
                         {"Retry-After": "1"},
                     )
                     return
@@ -658,7 +761,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self._reply(
                         503,
                         {"error": str(e),
-                         "error_type": type(e).__name__},
+                         "error_type": type(e).__name__,
+                         "retry_after_src": "static"},
                         {"Retry-After": "1"},
                     )
                     return
@@ -753,6 +857,7 @@ class _Handler(BaseHTTPRequestHandler):
         presence_penalty=None,
         logit_bias=None,
         deadline_s=None,
+        trace=None,
     ) -> None:
         """Stream one completion as newline-delimited JSON: a
         ``{"token": t}`` line per decoded token (one engine step of
@@ -784,25 +889,29 @@ class _Handler(BaseHTTPRequestHandler):
                 presence_penalty=presence_penalty,
                 logit_bias=logit_bias,
                 deadline_s=deadline_s,
+                trace=trace,
             )
         except FleetOverloaded as e:
             self._reply(
                 429,
-                {"error": str(e), "error_type": "FleetOverloaded"},
+                {"error": str(e), "error_type": "FleetOverloaded",
+                 "retry_after_src": "router_estimate"},
                 {"Retry-After": str(int(math.ceil(e.retry_after)))},
             )
             return
         except (FleetUnavailable, ReplicaGone) as e:
             self._reply(
                 503,
-                {"error": str(e), "error_type": type(e).__name__},
+                {"error": str(e), "error_type": type(e).__name__,
+                 "retry_after_src": "static"},
                 {"Retry-After": "2"},
             )
             return
         except EngineOverloaded as e:
             self._reply(
                 503,
-                {"error": str(e), "error_type": "EngineOverloaded"},
+                {"error": str(e), "error_type": "EngineOverloaded",
+                 "retry_after_src": "static"},
                 {"Retry-After": "1"},
             )
             return
@@ -832,6 +941,8 @@ class _Handler(BaseHTTPRequestHandler):
             # back to the raw tokens if the iterator wasn't exhausted
             final = gen.result if gen.result is not None else out
             trailer = {"done": True, "completion": final}
+            if trace is not None:
+                trailer["trace"] = trace
             if want_logprobs:
                 trailer["logprobs"] = (
                     gen.logprobs if gen.result is not None else lps
@@ -844,17 +955,20 @@ class _Handler(BaseHTTPRequestHandler):
             logger.info("stream client disconnected")
         except Exception as e:  # noqa: BLE001 - status already sent
             logger.exception("stream failed mid-decode")
+            reqtrace.flag(trace, error=type(e).__name__)
             try:
+                err_line = {
+                    "error": f"{type(e).__name__}: {e}",
+                    # typed so a fleet router fronting THIS server
+                    # can reconstruct the engine error
+                    "error_type": type(e).__name__,
+                }
+                if trace is not None:
+                    # the 200 is long gone: the error TRAILER is the
+                    # only place the stream's trace id can ride
+                    err_line["trace"] = trace
                 self.wfile.write(
-                    json.dumps(
-                        {
-                            "error": f"{type(e).__name__}: {e}",
-                            # typed so a fleet router fronting THIS
-                            # server can reconstruct the engine error
-                            "error_type": type(e).__name__,
-                        }
-                    ).encode()
-                    + b"\n"
+                    json.dumps(err_line).encode() + b"\n"
                 )
             except OSError:
                 pass
@@ -882,6 +996,7 @@ class _Handler(BaseHTTPRequestHandler):
         logit_bias=None,
         deadline_s=None,
         want_versions=False,
+        trace=None,
     ):
         """Continuous-batching path: the request's rows are admitted
         ATOMICALLY (all accepted, or a 400/503 before any decodes — a
@@ -905,6 +1020,7 @@ class _Handler(BaseHTTPRequestHandler):
             logit_bias=logit_bias,
             deadline_s=deadline_s,
             return_versions=want_versions,
+            trace=trace,
         )
 
 
@@ -1426,6 +1542,62 @@ def _build_gen_fn(gen: dict):
     return gen_fn, bsz, model, params
 
 
+class _ObsPlane:
+    """The serving process's windowed-telemetry + SLO plane: ONE
+    History pumping ONE registry (``Registry.window()`` deltas are
+    stateful, so the registry gets exactly one pumping consumer), and
+    an :class:`~tensorflowonspark_tpu.obs.slo.SLOEvaluator` reading
+    burn rates off it. A background thread pumps on ``interval`` so
+    ``slo_burn_rate`` stays current between requests; ``/statusz``
+    additionally pumps on demand so pollers see fresh verdicts."""
+
+    def __init__(self, registry, slos, interval: float = 5.0):
+        from tensorflowonspark_tpu.obs import History, SLOEvaluator
+
+        self.registry = registry
+        self.history = History(source="serve_model")
+        self.evaluator = SLOEvaluator(slos, self.history, registry=registry)
+        self.interval = float(interval)
+        self._pump_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def pump(self):
+        """One scrape + evaluation; serialized (the background cadence
+        and /statusz share the registry's single delta window)."""
+        with self._pump_lock:
+            self.history.scrape_registry(self.registry)
+            return self.evaluator.evaluate()
+
+    def statusz(self) -> dict:
+        return {
+            "slo": self.evaluator.statusz(),
+            "history": self.history.stats(),
+        }
+
+    def start(self) -> None:
+        if self.interval <= 0 or self._thread is not None:
+            return
+
+        def loop() -> None:
+            while not self._stop.wait(self.interval):
+                try:
+                    self.pump()
+                except Exception as e:  # noqa: BLE001 - keep pumping
+                    logger.warning("obs pump failed: %s", e)
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="obs-pump"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+
 class _Server(ThreadingHTTPServer):
     """ThreadingHTTPServer that also releases the request batcher's
     worker thread (and the params its closure pins) on shutdown."""
@@ -1433,10 +1605,13 @@ class _Server(ThreadingHTTPServer):
     gen_batcher = None
     gen_engine = None
     rollout_ctl = None
+    obs_plane = None
     drain_on_shutdown = False
 
     def shutdown(self) -> None:
         super().shutdown()
+        if self.obs_plane is not None:
+            self.obs_plane.stop()
         if self.rollout_ctl is not None:
             # stop watching the channel BEFORE the engines go away —
             # a rollout racing teardown would hold seats of a closing
@@ -1525,6 +1700,36 @@ def make_server(
         )
         if gen.get("rollout_channel"):
             rollout_ctl.start()
+    obs_plane = None
+    if engine is not None:
+        # SLO burn-rate plane over the engine's (or, in fleet mode,
+        # the router's) registry — /statusz reads it, and the gauges
+        # land in the same registry /metrics already renders
+        from tensorflowonspark_tpu.obs.slo import (
+            default_serving_slos,
+            router_slos,
+        )
+
+        if getattr(engine, "IS_FLEET", False):
+            slos = router_slos(
+                latency_objective_s=float(
+                    gen.get("slo_latency_s") or 30.0
+                ),
+                shed_budget=float(gen.get("slo_error_budget") or 0.02),
+            )
+            obs_registry = engine.fleet.metrics
+        else:
+            slos = default_serving_slos(
+                ttft_objective_s=float(gen.get("slo_ttft_s") or 2.5),
+                error_budget=float(gen.get("slo_error_budget") or 0.02),
+            )
+            obs_registry = engine.metrics
+        obs_plane = _ObsPlane(
+            obs_registry,
+            slos,
+            interval=float(gen.get("obs_window_s") or 5.0),
+        )
+        obs_plane.start()
     handler = type(
         "_BoundHandler",
         (_Handler,),
@@ -1550,6 +1755,7 @@ def make_server(
             "admin_token": (
                 gen.get("admin_token") if gen else None
             ),
+            "obs_plane": obs_plane,
             "predict_lock": lock,
         },
     )
@@ -1557,6 +1763,7 @@ def make_server(
     server.gen_batcher = batcher
     server.gen_engine = engine
     server.rollout_ctl = rollout_ctl
+    server.obs_plane = obs_plane
     server.drain_on_shutdown = bool(
         gen.get("drain_on_shutdown") if gen else False
     )
@@ -1773,6 +1980,37 @@ def main(argv: list[str] | None = None) -> int:
         help="rollout channel poll interval in seconds",
     )
     p.add_argument(
+        "--slo-ttft-s",
+        type=float,
+        default=2.5,
+        help="single-engine SLO: time-to-first-token objective in "
+        "seconds (GET /statusz reports multi-window burn rates; "
+        "breaches count in slo_breaches_total and dump the flight "
+        "recorder)",
+    )
+    p.add_argument(
+        "--slo-latency-s",
+        type=float,
+        default=30.0,
+        help="fleet SLO (--gen-replicas > 1): end-to-end routed "
+        "request latency objective in seconds",
+    )
+    p.add_argument(
+        "--slo-error-budget",
+        type=float,
+        default=0.02,
+        help="SLO error budget: allowed bad-request fraction (errors "
+        "single-engine, admission sheds in fleet mode)",
+    )
+    p.add_argument(
+        "--obs-window-s",
+        type=float,
+        default=5.0,
+        help="windowed-telemetry pump cadence in seconds: each tick "
+        "scrapes the serving registry into the bounded History rings "
+        "and re-evaluates the SLO burn rates",
+    )
+    p.add_argument(
         "--gen-watchdog",
         type=float,
         default=None,
@@ -1846,6 +2084,10 @@ def main(argv: list[str] | None = None) -> int:
             admin_token=admin_token,
             rollout_channel=args.rollout_channel,
             rollout_poll=args.rollout_poll,
+            slo_ttft_s=args.slo_ttft_s,
+            slo_latency_s=args.slo_latency_s,
+            slo_error_budget=args.slo_error_budget,
+            obs_window_s=args.obs_window_s,
         )
     server = make_server(
         args.export_dir, args.port, args.batch_size, host=args.host, gen=gen

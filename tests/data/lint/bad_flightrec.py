@@ -1,0 +1,49 @@
+"""Fixture: seeded OB002 violations — a dynamic flight-recorder event
+name and a typo'd one (the black-box entry no postmortem grep will ever
+find); plus CLEAN registered events — including the structured
+conditional form — and an unrelated ``rec.note`` that must not flag."""
+
+from tensorflowonspark_tpu.obs import flightrec
+from tensorflowonspark_tpu.obs.flightrec import note
+
+EVENT = "fleet_shed"
+
+
+def dynamic_event():
+    flightrec.note(EVENT, reason="x")  # SEEDED VIOLATION OB002: non-literal
+
+
+def typo_event():
+    flightrec.note("flet_shed", reason="x")  # SEEDED VIOLATION OB002: typo
+
+
+def typo_via_bare_note():
+    note("rollout_rolback")  # SEEDED VIOLATION OB002: unregistered
+
+
+def half_registered_conditional(republish):
+    # one IfExp arm is a typo: flags once, on that arm
+    flightrec.note("ingest_plan_repblish" if republish else "ingest_plan")
+
+
+def clean_events():
+    # registered catalog events: must NOT be flagged
+    flightrec.note("fleet_shed", reason="drain")
+    flightrec.note("slo_breach", slo="fleet_latency")
+    note("replica_swap", replica=0)
+
+
+def clean_conditional(republish):
+    # both arms registered: the structured exception, must NOT flag
+    flightrec.note("ingest_plan_republish" if republish else "ingest_plan")
+
+
+class _OtherRecorder:
+    def note(self, kind, **detail):
+        return kind
+
+
+def unrelated_note_method():
+    # a note() on some other object is not a flightrec emission
+    rec = _OtherRecorder()
+    rec.note("whatever_dynamic_" + "name")

@@ -129,6 +129,51 @@ def test_bench_zero_smoke_ab_and_byte_identity():
     assert on_disk["update_params_match"] is True
 
 
+def test_bench_serve_slo_smoke_burn_gate_and_trace_proof():
+    """bench.py --serve-slo end-to-end on the tiny model: a clean leg
+    must leave every SLO silent, the armed (latency-failpoint) leg must
+    fire exactly the latency SLO as exactly one rising edge, and the
+    proof request's merged timeline must attribute >= 95% of its wall
+    time across router -> engine segments."""
+    env = dict(
+        os.environ,
+        BENCH_SMOKE="1",
+        BENCH_ALLOW_CPU="1",
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PALLAS_AXON_REMOTE_COMPILE="",
+    )
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--serve-slo"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "serve_slo_burn_gate"
+    assert out["smoke"] is True
+    assert out["passed"] is True, out["checks"]
+    assert all(out["checks"].values()), out["checks"]
+    # the SLO plane fired exactly where the failpoint was armed
+    assert not any(v["breached"] for v in out["slo_clean"])
+    assert [v["slo"] for v in out["slo_armed"] if v["breached"]] == [
+        "fleet_latency"
+    ]
+    # the end-to-end trace proof: wall time attributed, both layers on
+    assert out["attribution"]["covered_fraction"] >= 0.95
+    segs = set(out["attribution"]["segments_s"])
+    assert "router.submit" in segs
+    assert any(s.startswith("engine.") for s in segs)
+    assert out["proof_wall_s"] > out["objective_s"]
+    assert out["merged_trace_events"] > 0
+    art = os.path.join(REPO, out["artifact"])
+    assert os.path.exists(art)
+    assert json.load(open(art))["metric"] == "serve_slo_burn_gate"
+
+
 def test_bench_relay_gate_fails_fast_when_relay_down():
     """With the relay marker present and no ports listening, bench must
     emit a distinct relay_unreachable line in seconds, exit 3."""

@@ -107,6 +107,7 @@ def test_registry_window_deltas():
     assert w1["ticks_total"]["series"][""] == {"value": 5.0, "delta": 5.0}
     assert w1["wait_seconds"]["series"][""] == {
         "count": 1, "sum": 0.5, "delta_count": 1, "delta_sum": 0.5,
+        "le": [1.0], "buckets": [1], "delta_buckets": [1],
     }
     c.inc(2)
     h.observe(0.25)
@@ -115,6 +116,7 @@ def test_registry_window_deltas():
     assert w2["ticks_total"]["series"][""] == {"value": 7.0, "delta": 2.0}
     assert w2["wait_seconds"]["series"][""]["delta_count"] == 2
     assert w2["wait_seconds"]["series"][""]["delta_sum"] == pytest.approx(0.5)
+    assert w2["wait_seconds"]["series"][""]["delta_buckets"] == [2]
     # quiet window: zero deltas
     assert r.window()["ticks_total"]["series"][""]["delta"] == 0.0
 

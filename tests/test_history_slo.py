@@ -1,0 +1,392 @@
+"""Windowed telemetry history (obs/history.py) + SLO burn-rate plane
+(obs/slo.py).
+
+History is the read substrate both the SLO evaluator and the future
+autotune controller consume, so its selector semantics (None = sum all
+label sets, dict = label-subset filter, str = exact rendered key),
+windowing, and histogram interpolation are pinned here with explicit
+timestamps — no sleeps, no wall-clock flake. The SLO tests pin the
+multi-window breach contract: BOTH windows must burn, breaches are
+rising-edge counted, an empty window never false-fires, and the onset
+lands in the flight recorder.
+"""
+
+import time
+
+import pytest
+
+from tensorflowonspark_tpu.obs import flightrec
+from tensorflowonspark_tpu.obs.history import History
+from tensorflowonspark_tpu.obs.registry import Registry
+from tensorflowonspark_tpu.obs.slo import (
+    SLO,
+    SLOEvaluator,
+    default_serving_slos,
+    router_slos,
+)
+
+T0 = 1_000_000.0  # fixed epoch base: every test stamps scrapes itself
+
+
+# -- History: selectors, windows, math ---------------------------------------
+
+
+def test_counter_selector_semantics():
+    reg = Registry()
+    c = reg.counter("jobs_total")
+    c.inc(2, route="a")
+    c.inc(3, route="b")
+    hist = History()
+    hist.scrape_registry(reg, t=T0)
+    # None sums every label set (Prometheus-style)
+    assert hist.delta("jobs_total", None, window_s=None) == 5.0
+    # dict is a label-SUBSET filter
+    assert hist.delta("jobs_total", {"route": "a"}, window_s=None) == 2.0
+    # str is the exact rendered series key
+    keys = hist.labels_of("jobs_total")
+    assert len(keys) == 2
+    by_key = {
+        k: hist.delta("jobs_total", k, window_s=None) for k in keys
+    }
+    assert sorted(by_key.values()) == [2.0, 3.0]
+    assert hist.delta("jobs_total", {"route": "nope"}, window_s=None) == 0.0
+    assert hist.names() == ["jobs_total"]
+
+
+def test_delta_windows_by_scrape_time():
+    reg = Registry()
+    c = reg.counter("events_total")
+    hist = History()
+    c.inc(4)
+    hist.scrape_registry(reg, t=T0)
+    c.inc(6)
+    hist.scrape_registry(reg, t=T0 + 100)
+    # trailing 60s from T0+130 sees only the second scrape's delta
+    assert hist.delta("events_total", window_s=60.0, now=T0 + 130) == 6.0
+    assert hist.delta("events_total", window_s=None) == 10.0
+    # a window past every point is empty, not an error
+    assert hist.delta("events_total", window_s=60.0, now=T0 + 1000) == 0.0
+
+
+def test_rate_needs_two_points_and_divides_by_span():
+    reg = Registry()
+    c = reg.counter("ticks_total")
+    hist = History()
+    c.inc(5)
+    hist.scrape_registry(reg, t=T0)
+    assert hist.rate("ticks_total", window_s=None) is None
+    c.inc(5)
+    hist.scrape_registry(reg, t=T0 + 10)
+    assert hist.rate("ticks_total", window_s=None) == pytest.approx(0.5)
+
+
+def test_histogram_fraction_le_interpolates_and_percentile():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 3.0):
+        h.observe(v)
+    hist = History()
+    hist.scrape_registry(reg, t=T0)
+    q = dict(window_s=None)
+    # exact bucket edge: no interpolation
+    assert hist.fraction_le("lat_seconds", 2.0, **q) == pytest.approx(0.5)
+    # mid-bucket: linear within the straddling (2, 4] bucket
+    assert hist.fraction_le("lat_seconds", 3.0, **q) == pytest.approx(0.75)
+    # below the first edge interpolates from zero
+    assert hist.fraction_le("lat_seconds", 0.5, **q) == pytest.approx(0.125)
+    assert hist.percentile("lat_seconds", 0.5, **q) == pytest.approx(2.0)
+    assert hist.percentile("lat_seconds", 1.0, **q) == pytest.approx(4.0)
+    # observations above the top finite bucket clamp to it
+    h.observe(10.0)
+    hist.scrape_registry(reg, t=T0 + 1)
+    assert hist.percentile("lat_seconds", 1.0, **q) == pytest.approx(4.0)
+    assert hist.fraction_le("lat_seconds", 4.0, **q) == pytest.approx(0.8)
+    with pytest.raises(ValueError):
+        hist.percentile("lat_seconds", 1.5, **q)
+
+
+def test_fraction_le_none_without_observations():
+    hist = History()
+    assert hist.fraction_le("nope_seconds", 1.0, window_s=None) is None
+    reg = Registry()
+    reg.histogram("idle_seconds", buckets=(1.0,))
+    hist.scrape_registry(reg, t=T0)
+    # a histogram with zero in-window observations is "no data", not 0%
+    assert hist.fraction_le("idle_seconds", 1.0, window_s=None) is None
+
+
+def test_ring_capacity_bounds_memory_not_lifetime_count():
+    reg = Registry()
+    c = reg.counter("spins_total")
+    hist = History(capacity=4)
+    for i in range(10):
+        c.inc()
+        hist.scrape_registry(reg, t=T0 + i)
+    assert len(hist.series("spins_total", "")) == 4
+    assert hist.stats() == {"series": 1, "points": 10, "capacity": 4}
+    # delta over the full window only sees retained points — eviction
+    # shrinks the window, it does not corrupt the sums
+    assert hist.delta("spins_total", window_s=None) == 4.0
+
+
+def test_to_artifact_filters_names_and_is_json_shaped():
+    reg = Registry()
+    reg.counter("keep_total").inc(3)
+    reg.gauge("drop_me").set(1.0)
+    hist = History(source="unit")
+    hist.scrape_registry(reg, t=T0)
+    art = hist.to_artifact(names=("keep_total",))
+    assert art["history_version"] == 1
+    assert art["source"] == "unit"
+    assert [s["name"] for s in art["series"]] == ["keep_total"]
+    (s,) = art["series"]
+    assert s["kind"] == "counter"
+    assert s["points"][0]["value"] == 3.0
+    assert s["points"][0]["delta"] == 3.0
+
+
+def test_record_families_driver_scrape_path():
+    """The MetricsAggregator path: parsed Prometheus families, joined
+    with per-node labels, deltas computed against the previous point."""
+    hist = History()
+    fam = {
+        "pulls_total": {
+            "type": "counter",
+            "samples": {("pulls_total", (("shard", "0"),)): 5.0},
+        }
+    }
+    hist.record_families(fam, extra_labels={"node": "3"}, t=T0)
+    fam["pulls_total"]["samples"][("pulls_total", (("shard", "0"),))] = 9.0
+    hist.record_families(fam, extra_labels={"node": "3"}, t=T0 + 10)
+    assert hist.delta(
+        "pulls_total", {"node": "3", "shard": "0"}, window_s=None
+    ) == 9.0
+    # second point's delta is vs the first, not vs zero
+    pts = hist.series("pulls_total", {"node": "3", "shard": "0"})
+    assert [e["delta"] for _, e in pts] == [5.0, 4.0]
+
+
+def test_record_families_histogram_regrouping():
+    hist = History()
+    fam = {
+        "wait_seconds": {
+            "type": "histogram",
+            "samples": {
+                ("wait_seconds_bucket", (("le", "1.0"),)): 2.0,
+                ("wait_seconds_bucket", (("le", "+Inf"),)): 3.0,
+                ("wait_seconds_sum", ()): 4.5,
+                ("wait_seconds_count", ()): 3.0,
+            },
+        }
+    }
+    hist.record_families(fam, t=T0)
+    (pt,) = [e for _, e in hist.series("wait_seconds", "")]
+    assert pt["le"] == [1.0]
+    assert pt["buckets"] == [2]
+    assert pt["count"] == 3 and pt["sum"] == 4.5
+    assert pt["delta_count"] == 3
+    # 2 of 3 observations <= 1.0
+    assert hist.fraction_le("wait_seconds", 1.0, window_s=None) == (
+        pytest.approx(2 / 3)
+    )
+
+
+# -- SLO declarations ---------------------------------------------------------
+
+
+def test_slo_declaration_validation():
+    with pytest.raises(ValueError, match="kind"):
+        SLO(name="x", kind="vibes", metric="m")
+    with pytest.raises(ValueError, match="objective"):
+        SLO(name="x", kind="latency", metric="m")
+    with pytest.raises(ValueError, match="total_metric"):
+        SLO(name="x", kind="error_rate", metric="m")
+    with pytest.raises(ValueError, match="budget"):
+        SLO(name="x", kind="latency", metric="m", objective=1.0, budget=1.5)
+    dup = SLO(name="x", kind="latency", metric="m", objective=1.0)
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOEvaluator((dup, dup), History())
+
+
+def test_builtin_slo_sets_are_valid_and_distinct():
+    serving = default_serving_slos()
+    routed = router_slos(latency_objective_s=2.0)
+    for slos in (serving, routed):
+        names = [s.name for s in slos]
+        assert len(set(names)) == len(names)
+    assert {s.kind for s in routed} == {"latency", "availability"}
+
+
+# -- SLO evaluation: multi-window burn, rising edge ---------------------------
+
+
+def _latency_evaluator(buckets=(1.0, 2.0)):
+    """A 1s-objective latency SLO with burn thresholds 5x fast / 2.5x
+    slow over a 10% budget — breach needs >= 50% of fast-window
+    observations slow AND >= 25% of slow-window ones."""
+    reg = Registry()
+    h = reg.histogram("req_seconds", buckets=buckets)
+    hist = History()
+    slo = SLO(
+        name="lat",
+        kind="latency",
+        metric="req_seconds",
+        objective=1.0,
+        budget=0.1,
+        fast_window_s=60.0,
+        slow_window_s=300.0,
+        fast_burn=5.0,
+        slow_burn=2.5,
+    )
+    ev = SLOEvaluator((slo,), hist, registry=reg)
+    return reg, h, hist, ev
+
+
+def test_empty_window_never_false_fires():
+    _reg, _h, _hist, ev = _latency_evaluator()
+    (v,) = ev.evaluate(now=T0)
+    assert not v.breached
+    assert v.burn_fast == 0.0 and v.burn_slow == 0.0
+    assert v.bad_fraction_fast is None
+    assert ev.breaching() == []
+
+
+def test_latency_breach_is_rising_edge_counted(tmp_path):
+    reg, h, hist, ev = _latency_evaluator()
+    rec = flightrec.install(str(tmp_path / "rec.json"), registry=reg)
+    try:
+        breach_count = lambda: reg.counter("slo_breaches_total").value(
+            slo="lat"
+        )
+        # clean leg: all observations under the objective
+        for _ in range(10):
+            h.observe(0.5)
+        hist.scrape_registry(reg, t=T0)
+        (v,) = ev.evaluate(now=T0)
+        assert not v.breached and breach_count() == 0.0
+
+        # half the fast window goes slow: burn hits exactly 5x fast
+        # (10/20 bad over a 10% budget) and 2.5x+ slow
+        for _ in range(10):
+            h.observe(1.5)
+        hist.scrape_registry(reg, t=T0 + 10)
+        (v,) = ev.evaluate(now=T0 + 10)
+        assert v.breached
+        assert v.burn_fast == pytest.approx(5.0)
+        assert ev.breaching() == ["lat"]
+        assert breach_count() == 1.0
+
+        # still breaching: the counter counts ONSETS, not cycles
+        (v,) = ev.evaluate(now=T0 + 11)
+        assert v.breached and breach_count() == 1.0
+        ev_names = [
+            e for e in rec.snapshot("t")["events"]
+            if e["kind"] == "slo_breach"
+        ]
+        assert len(ev_names) == 1
+        assert ev_names[0]["slo"] == "lat"
+        assert ev_names[0]["slo_kind"] == "latency"
+
+        # recovery: a clean fast window (old points age out) clears it
+        for _ in range(30):
+            h.observe(0.5)
+        hist.scrape_registry(reg, t=T0 + 90)
+        (v,) = ev.evaluate(now=T0 + 120)
+        assert not v.breached and ev.breaching() == []
+        assert breach_count() == 1.0
+
+        # a second onset counts again
+        for _ in range(40):
+            h.observe(1.5)
+        hist.scrape_registry(reg, t=T0 + 125)
+        (v,) = ev.evaluate(now=T0 + 125)
+        assert v.breached and breach_count() == 2.0
+    finally:
+        rec.stop()
+        flightrec._recorder = None
+
+
+def test_breach_requires_both_windows():
+    """A spike confined to the fast window (slow window diluted under
+    its threshold) must NOT breach — the slow window is the blip
+    filter."""
+    reg, h, hist, ev = _latency_evaluator()
+    # 280s of clean history dominates the slow window
+    for _ in range(90):
+        h.observe(0.5)
+    hist.scrape_registry(reg, t=T0)
+    # then a 100%-slow burst inside the fast window only
+    for _ in range(10):
+        h.observe(1.5)
+    hist.scrape_registry(reg, t=T0 + 280)
+    (v,) = ev.evaluate(now=T0 + 280)
+    assert v.burn_fast == pytest.approx(10.0)  # 10/10 bad / 0.1
+    assert v.burn_slow == pytest.approx(1.0)  # 10/100 bad / 0.1
+    assert not v.breached
+
+
+def test_availability_kind_counts_sheds_against_offered_load():
+    reg = Registry()
+    shed = reg.counter("shed_total")
+    reqs = reg.counter("requests_total")
+    hist = History()
+    slo = SLO(
+        name="avail",
+        kind="availability",
+        metric="shed_total",
+        total_metric="requests_total",
+        budget=0.1,
+        fast_window_s=60.0,
+        slow_window_s=300.0,
+        fast_burn=5.0,
+        slow_burn=2.5,
+    )
+    ev = SLOEvaluator((slo,), hist, registry=reg)
+    # 5 sheds over 45 admitted = 10% of OFFERED load (45+5): burn 1.0
+    shed.inc(5)
+    reqs.inc(45)
+    hist.scrape_registry(reg, t=T0)
+    (v,) = ev.evaluate(now=T0)
+    assert v.burn_fast == pytest.approx(1.0)
+    assert not v.breached
+    # 30 sheds / 30 admitted = 50% bad: evaluated once the clean
+    # scrape has aged out of the fast window, burn is 5x fast and
+    # 35/110 = 3.2x slow — both over threshold
+    shed.inc(30)
+    reqs.inc(30)
+    hist.scrape_registry(reg, t=T0 + 70)
+    (v,) = ev.evaluate(now=T0 + 100)
+    assert v.burn_fast == pytest.approx(5.0)
+    assert v.breached
+
+
+def test_statusz_and_burn_gauges_surface():
+    reg, h, hist, ev = _latency_evaluator()
+    for _ in range(4):
+        h.observe(1.5)
+    hist.scrape_registry(reg, t=T0)
+    ev.evaluate(now=T0)
+    st = ev.statusz()
+    assert st["evaluations"] == 1
+    assert st["breaching"] == ["lat"]
+    (row,) = st["slos"]
+    assert row["slo"] == "lat" and row["breached"] is True
+    assert row["budget"] == 0.1 and row["objective"] == 1.0
+    # the burn gauges are exported per window, scrapeable mid-incident
+    g = reg.gauge("slo_burn_rate")
+    assert g.value(slo="lat", window="fast") == pytest.approx(10.0)
+    assert g.value(slo="lat", window="slow") == pytest.approx(10.0)
+    assert ev.last_verdicts()[0].as_dict() == row
+
+
+def test_history_scrape_roundtrip_wallclock():
+    """One un-stamped scrape (real time.time()) — the default path
+    serve_model's pump uses — lands queryable within a trailing
+    window."""
+    reg = Registry()
+    reg.counter("live_total").inc(7)
+    hist = History()
+    n = hist.scrape_registry(reg)
+    assert n == 1
+    assert hist.delta("live_total", window_s=60.0) == 7.0
+    assert time.time() - hist.series("live_total", "")[0][0] < 5.0

@@ -221,6 +221,44 @@ def test_obs_metric_rule_reports_seeded_violations(fixture_findings):
     assert len(dynamic) == 2 and len(snake) == 2 and len(suffix) == 2
 
 
+def test_flightrec_rule_reports_seeded_violations(fixture_findings):
+    """OB002: flightrec event names must be registered literals — one
+    finding per seeded violation (dynamic name, typo via module attr,
+    typo via bare note, typo'd IfExp arm), clean emissions — including
+    the both-arms-registered conditional — untouched."""
+    rel = f"{FIXTURES}/bad_flightrec.py"
+    hits = by_rule(fixture_findings, "OB002")
+    assert all(f.path == rel for f in hits), [f.render() for f in hits]
+    assert {f.line for f in hits} == {
+        _line_of("bad_flightrec.py", "flightrec.note(EVENT"),
+        _line_of("bad_flightrec.py", "flet_shed"),
+        _line_of("bad_flightrec.py", "rollout_rolback"),
+        _line_of("bad_flightrec.py", "ingest_plan_repblish"),
+    }, [f.render() for f in hits]
+    dynamic = [f for f in hits if "string literal" in f.message]
+    unregistered = [f for f in hits if "not registered" in f.message]
+    assert len(dynamic) == 1 and len(unregistered) == 3
+    clean_lines = {
+        _line_of("bad_flightrec.py", '"fleet_shed", reason="drain"'),
+        _line_of("bad_flightrec.py", '"slo_breach"'),
+        _line_of("bad_flightrec.py", '"replica_swap"'),
+        _line_of("bad_flightrec.py", '"ingest_plan_republish" if'),
+        _line_of("bad_flightrec.py", "whatever_dynamic_"),
+    }
+    assert not clean_lines & {f.line for f in hits}
+
+
+def test_flightrec_registry_matches_rule_view():
+    """The events OB002 validates against are exactly the runtime
+    catalog — drift would let the rule pass names tests and tooling
+    grep for in vain."""
+    from tensorflowonspark_tpu.analysis import flightrecnames
+    from tensorflowonspark_tpu.obs.flightrec import EVENTS
+
+    events = flightrecnames._registered_events(ROOT, Config())
+    assert events == set(EVENTS)
+
+
 def test_failpoint_registry_matches_rule_view():
     """The sites the FP rule validates against are exactly the runtime
     registry — a drift here would let the rule pass names arm() then

@@ -1,0 +1,536 @@
+"""Per-request distributed tracing: the propagation invariants.
+
+The contract under test (obs/reqtrace.py + the serving plane's
+stamps): ONE trace id follows a request across router placement,
+forced failover, the subprocess HTTP boundary, and the engine's
+scheduler segments — with exactly one terminal outcome, bounded
+memory under overload, tail-sampled retention, and a near-zero
+disabled fast path.
+
+Suites: unit-level ring mechanics; router/fleet invariants over
+scripted stub engines (no jax); one real serve_model round trip
+(tiny model) proving the ``X-TFOS-Trace`` ingress adoption and the
+``/debugz`` read surface; the disabled-overhead bar.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu.obs import reqtrace, trace_merge
+from tensorflowonspark_tpu.serving.engine import EngineOverloaded
+from tensorflowonspark_tpu.serving.fleet import ServingFleet, SubprocessReplica
+from tensorflowonspark_tpu.serving.router import (
+    FleetOverloaded,
+    FleetRouter,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring():
+    """Every test gets its own deterministic ring: retain everything
+    (sample_every=1) unless the test installs its own."""
+    reqtrace.install(capacity=64, sample_every=1, slow_s=10.0)
+    yield
+    reqtrace._reset_for_tests()
+
+
+# -- stub serving plane (no jax) --------------------------------------------
+
+
+class _StubStream:
+    def __init__(self, tokens):
+        self._tokens = list(tokens)
+        self._i = 0
+        self.result = None
+        self.logprobs = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._i >= len(self._tokens):
+            self.result = list(self._tokens)
+            raise StopIteration
+        t = self._tokens[self._i]
+        self._i += 1
+        return t
+
+    def close(self):
+        pass
+
+
+class _StubMetrics:
+    def render(self):
+        return "# TYPE stub_up gauge\nstub_up 1\n"
+
+
+class _StubEngine:
+    """Engine-shaped double: the router/fleet surface with injectable
+    submit/stream failures."""
+
+    def __init__(self):
+        self.live = True
+        self.ready = True
+        self.submit_error = None
+        self.stream_error = None
+        self.calls = []
+        self.metrics = _StubMetrics()
+
+    def warmup(self):
+        pass
+
+    def health(self):
+        return {"live": self.live, "ready": self.ready}
+
+    def stats(self):
+        return {
+            "slots": 2,
+            "slots_busy": 0,
+            "queue_depth": 0,
+            "watchdog_fires": 0,
+            "admitted": len(self.calls),
+            "completed": len(self.calls),
+        }
+
+    def unresolved(self):
+        return 0
+
+    def submit_many(self, prompts, max_new_tokens, **kw):
+        self.calls.append(list(prompts))
+        if self.submit_error is not None:
+            raise self.submit_error
+        return [[7] * min(int(max_new_tokens), 3) for _ in prompts]
+
+    def stream(self, tokens, max_new_tokens, **kw):
+        self.calls.append([list(tokens)])
+        if self.stream_error is not None:
+            raise self.stream_error
+        return _StubStream(list(range(min(int(max_new_tokens), 4))))
+
+    def close(self, drain=False, drain_timeout=300.0):
+        self.live = False
+        self.ready = False
+
+
+def _stub_fleet(n=2, **kw):
+    made = []
+
+    def factory():
+        e = _StubEngine()
+        made.append(e)
+        return e
+
+    kw.setdefault("probe_interval", 5.0)
+    kw.setdefault("warmup", False)
+    kw.setdefault("respawn_backoff_s", 0.01)
+    kw.setdefault("drain_timeout", 2.0)
+    return ServingFleet(factory=factory, replicas=n, **kw), made
+
+
+def _only_retained_record():
+    ring = reqtrace.get_ring()
+    ids = ring.ids()
+    assert len(ids) == 1, f"expected exactly one retained trace: {ids}"
+    return ring.get(ids[0])
+
+
+# -- ring mechanics ----------------------------------------------------------
+
+
+def test_ring_bounds_under_overload():
+    """Begun-but-never-finished traces (a client that died mid-flight,
+    an overload wave) must not leak: the live map is bounded at
+    4x capacity, the retained ring at capacity."""
+    ring = reqtrace.install(capacity=8, sample_every=1)
+    for _ in range(100):
+        ring.begin()
+    st = ring.stats()
+    assert st["live"] <= 32
+    assert st["evicted_live"] == 100 - st["live"]
+    for _ in range(50):
+        ring.finish(ring.begin(), outcome="error")
+    st = ring.stats()
+    assert st["retained"] <= 8
+    assert len(ring.ids()) <= 8
+
+
+def test_tail_sampling_keeps_slow_error_flagged_and_1_in_n():
+    ring = reqtrace.install(capacity=32, sample_every=4, slow_s=0.05)
+
+    err = ring.begin()
+    ring.finish(err, outcome="error")
+    assert err in ring.ids(), "error outcome must be retained"
+
+    failover = ring.begin()
+    ring.flag(failover, failover=True)
+    ring.finish(failover, outcome="ok")
+    assert failover in ring.ids(), "flagged (failover) must be retained"
+
+    slow = ring.begin()
+    time.sleep(0.06)
+    ring.finish(slow, outcome="ok")
+    assert slow in ring.ids(), "slow >= slow_s must be retained"
+
+    fast = [ring.begin() for _ in range(8)]
+    for tid in fast:
+        ring.finish(tid, outcome="ok")
+    kept = [t for t in fast if t in ring.ids()]
+    assert 0 < len(kept) < len(fast), (
+        "plain fast-ok traces ride 1-in-N sampling: some kept, not all"
+    )
+
+
+def test_ensure_ownership_protocol():
+    """ensure() begins exactly once per id: the second caller adopts
+    without owning, so only the beginner's finish() terminates it."""
+    tid, owned = reqtrace.ensure(None, route="a")
+    assert owned and tid
+    same, owned2 = reqtrace.ensure(tid, route="b")
+    assert same == tid and not owned2
+    assert reqtrace.finish(tid, outcome="ok")
+    rec = reqtrace.get_record(tid)
+    assert rec["outcome"] == "ok"
+    assert rec["meta"].get("route") == "a", "first beginner's meta wins"
+
+
+def test_mark_lands_on_every_live_trace_only():
+    a = reqtrace.begin()
+    b = reqtrace.begin()
+    done = reqtrace.begin()
+    reqtrace.finish(done, outcome="ok")
+    n = reqtrace.mark("engine.weights_swap", version="v2")
+    assert n == 2
+    for tid in (a, b):
+        reqtrace.finish(tid, outcome="ok")
+        names = [e["name"] for e in reqtrace.get_record(tid)["events"]]
+        assert "engine.weights_swap" in names
+    names = [e["name"] for e in reqtrace.get_record(done)["events"]]
+    assert "engine.weights_swap" not in names
+
+
+def test_attribution_merges_overlapping_segments():
+    ring = reqtrace.get_ring()
+    tid = ring.begin()
+    ring.segment(tid, "a", 1.0, t_s=0.0)
+    ring.segment(tid, "b", 1.0, t_s=0.5)  # overlaps a by 0.5
+    ring.finish(tid, outcome="ok")
+    att = ring.attribution(tid)
+    # union, not sum: [0,1] u [0.5,1.5] covers 1.5s even though the
+    # per-name totals sum to 2.0
+    assert abs(att["covered_s"] - 1.5) < 1e-6
+    assert att["segments_s"] == {"a": 1.0, "b": 1.0}
+
+
+def test_to_chrome_merges_through_trace_merge(tmp_path):
+    tid = reqtrace.begin(route="unit")
+    reqtrace.segment(tid, "router.submit", 0.01, t_s=0.0)
+    reqtrace.event(tid, "router.place", replica=0)
+    reqtrace.finish(tid, outcome="ok")
+    chrome = reqtrace.to_chrome(tid)
+    assert chrome["metadata"]["trace_id"] == tid
+    path = tmp_path / "t.trace.json"
+    path.write_text(json.dumps(chrome))
+    merged = trace_merge.merge_traces([str(path)])
+    names = {e.get("name") for e in merged["traceEvents"]}
+    assert "router.submit" in names and "router.place" in names
+
+
+# -- router invariants over stub engines -------------------------------------
+
+
+def test_submit_failover_is_one_trace_with_hop_and_one_terminal():
+    """The headline invariant: a forced failover is ONE trace carrying
+    the router.place of both attempts, a router.failover hop event,
+    the failover flag, and exactly one ok terminal."""
+    fleet, stubs = _stub_fleet(2)
+    try:
+        router = FleetRouter(fleet)
+        stubs[0].submit_error = ConnectionError("dispatch torn")
+        stubs[1].submit_error = None
+        out = router.submit([1, 2, 3], 4)
+        assert out == [7, 7, 7]
+    finally:
+        fleet.close()
+    rec = _only_retained_record()
+    events = [(e["name"], e) for e in rec["events"]]
+    places = [e for n, e in events if n == "router.place"]
+    hops = [e for n, e in events if n == "router.failover"]
+    assert [p["attempt"] for p in places] == [0, 1]
+    assert len(places) == 2 and places[0]["replica"] != places[1]["replica"]
+    assert len(hops) == 1 and hops[0]["error"] == "ConnectionError"
+    assert rec["flags"].get("failover") is True
+    assert rec["outcome"] == "ok", "ONE terminal, and it is the retry's"
+    assert any(s["name"] == "router.submit" for s in rec["segments"])
+    assert reqtrace.get_ring().stats()["finished"] == 1
+
+
+def test_stream_connect_failover_single_trace():
+    fleet, stubs = _stub_fleet(2)
+    try:
+        router = FleetRouter(fleet)
+        stubs[0].stream_error = ConnectionError("connect torn")
+        stubs[1].stream_error = None
+        s = router.stream([1, 2], 4)
+        toks = list(s)
+        assert toks == [0, 1, 2, 3]
+    finally:
+        fleet.close()
+    rec = _only_retained_record()
+    names = [e["name"] for e in rec["events"]]
+    assert names.count("router.failover") == 1
+    assert rec["flags"].get("failover") is True
+    assert rec["outcome"] == "ok"
+    assert any(s["name"] == "router.stream" for s in rec["segments"])
+
+
+def test_shed_trace_attribution():
+    """A shed request's trace records the router.shed event and an
+    error terminal — the 429's trace id leads somewhere useful."""
+    fleet, stubs = _stub_fleet(2)
+    try:
+        router = FleetRouter(fleet)
+        for st in stubs:
+            st.submit_error = EngineOverloaded("queue full")
+        with pytest.raises(FleetOverloaded):
+            router.submit([1, 2], 4)
+    finally:
+        fleet.close()
+    rec = _only_retained_record()
+    names = [e["name"] for e in rec["events"]]
+    assert "router.shed" in names
+    assert rec["outcome"] == "error"
+    assert rec["flags"].get("error") == "FleetOverloaded"
+
+
+def test_propagated_trace_is_adopted_not_owned():
+    """A caller-minted id survives the router round trip unchanged and
+    stays LIVE until the caller finishes it (the serve_model parent
+    owns the terminal, not the router)."""
+    fleet, _stubs = _stub_fleet(1)
+    try:
+        router = FleetRouter(fleet)
+        tid = reqtrace.mint(route="parent")
+        router.submit([1, 2], 4, trace=tid)
+        rec = reqtrace.get_record(tid)
+        assert rec["outcome"] is None, "router must not finish a foreign id"
+        assert any(
+            s["name"] == "router.submit" for s in rec["segments"]
+        ), "but it does stamp its segment on the shared trace"
+        reqtrace.finish(tid, outcome="ok")
+        assert tid in reqtrace.get_ring().ids()
+    finally:
+        fleet.close()
+
+
+def test_subprocess_replica_sends_trace_header():
+    """The id crosses the process boundary as X-TFOS-Trace, never as a
+    body field (the child's ingress adopts it like any client's)."""
+    rep = SubprocessReplica(0, ["unused"])
+    seen = {}
+
+    def fake_post(path, payload, timeout, headers=None):
+        seen["path"] = path
+        seen["headers"] = dict(headers or {})
+        seen["body"] = payload
+        return 200, {"completions": [[1]]}
+
+    rep._post = fake_post
+    rep.submit_many([[1, 2]], 4, trace="abc123")
+    assert seen["headers"].get(reqtrace.HEADER) == "abc123"
+    assert "trace" not in seen["body"]
+    rep.submit_many([[1, 2]], 4)
+    assert reqtrace.HEADER not in seen["headers"]
+
+
+# -- serve_model ingress round trip (tiny model) ------------------------------
+
+
+@pytest.mark.slow
+def test_serve_model_header_roundtrip_and_debugz(tmp_path):
+    """POST /generate with X-TFOS-Trace: the child adopts the parent's
+    id (one trace, both halves), stamps engine segments on it, echoes
+    it in the reply, and serves the retained timeline on /debugz."""
+    import urllib.request
+
+    from tests.test_generate_cli import _post, _tiny_checkpoint
+    from tensorflowonspark_tpu.tools import serve_model
+
+    cfg, model, params, ckpt_dir = _tiny_checkpoint(tmp_path)
+    server = serve_model.make_server(
+        None,
+        port=0,
+        gen=dict(
+            checkpoint=ckpt_dir,
+            model="tiny",
+            config_overrides='{"remat": false, "dtype": "float32"}',
+            width=8,
+            batch_size=3,
+            max_new_tokens=4,
+            engine="continuous",
+        ),
+    )
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        parent_tid = reqtrace.mint(route="parent.test")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompts": [[1, 2, 3]]}).encode(),
+            headers={
+                "Content-Type": "application/json",
+                reqtrace.HEADER: parent_tid,
+            },
+        )
+        with urllib.request.urlopen(req) as r:
+            body = json.loads(r.read())
+        assert body["trace"] == parent_tid, "reply carries the shared id"
+        rec = reqtrace.get_record(parent_tid)
+        seg_names = {s["name"] for s in rec["segments"]}
+        assert "http.generate" in seg_names
+        assert any(n.startswith("engine.") for n in seg_names), (
+            "the engine's scheduler segments landed on the SAME trace"
+        )
+        assert rec["flags"].get("propagated") is True
+        assert rec["outcome"] is None, (
+            "the minting parent owns the terminal, not the ingress"
+        )
+        reqtrace.finish(parent_tid, outcome="ok")
+
+        # un-headered request: the ingress mints and owns its own
+        code, body2 = _post(port, "/generate", {"prompts": [[2, 3]]})
+        assert code == 200 and body2["trace"] != parent_tid
+        assert reqtrace.get_record(body2["trace"])["outcome"] == "ok"
+
+        # the /debugz read surface serves the retained timelines
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debugz/traces"
+        ) as r:
+            listing = json.loads(r.read())
+        assert parent_tid in listing["trace_ids"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debugz/trace/{parent_tid}"
+        ) as r:
+            chrome = json.loads(r.read())
+        assert chrome["metadata"]["trace_id"] == parent_tid
+        # and /statusz exposes ring stats beside the SLO block
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statusz"
+        ) as r:
+            statusz = json.loads(r.read())
+        assert statusz["reqtrace"]["retained"] >= 1
+        assert "slo" in statusz
+    finally:
+        server.shutdown()
+
+
+# -- incident bundle (tools/obs_snapshot.py) ---------------------------------
+
+
+def test_obs_snapshot_bundle_collects_scrapes_traces_and_merges(tmp_path):
+    """collect_bundle against a live /metrics + /debugz source: raw
+    expositions saved, every retained timeline pulled, on-disk
+    flight-recorder dumps folded in, ONE merged clock-aligned timeline
+    written — and a dead source is a recorded error, not an aborted
+    bundle."""
+    import http.server
+    import json as _json
+
+    from tensorflowonspark_tpu.obs import flightrec, snapshot
+
+    # a retained trace to serve from /debugz
+    tid = reqtrace.begin(route="bundle")
+    reqtrace.segment(tid, "router.submit", 0.01, t_s=0.0)
+    reqtrace.finish(tid, outcome="ok")
+
+    class _Src(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            ring = reqtrace.get_ring()
+            if self.path == "/metrics":
+                body = b"# TYPE up gauge\nup 1\n"
+            elif self.path == "/debugz/traces":
+                body = _json.dumps(
+                    {**ring.stats(), "trace_ids": ring.ids()}
+                ).encode()
+            elif self.path.startswith("/debugz/trace/"):
+                body = _json.dumps(
+                    reqtrace.to_chrome(self.path.rsplit("/", 1)[1])
+                ).encode()
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Src)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    rec = flightrec.install(str(tmp_path / "fr.json"), process="bundle")
+    try:
+        rec.note("fleet_shed", reason="test")
+        dump = rec.dump("unit")
+        out = tmp_path / "bundle"
+        manifest = snapshot.collect_bundle(
+            str(out),
+            metrics_urls=[f"replica0={base}/metrics",
+                          "http://127.0.0.1:1/metrics"],  # dead source
+            debugz_urls=[("replica0", base)],
+            flightrec_globs=[dump],
+            timeout=5.0,
+        )
+    finally:
+        server.shutdown()
+        flightrec._recorder = None
+
+    assert [m["name"] for m in manifest["metrics"]] == ["replica0"]
+    assert (out / "metrics" / "replica0.prom").read_text().startswith(
+        "# TYPE up"
+    )
+    assert {t["trace_id"] for t in manifest["traces"]} == {tid}
+    assert manifest["flightrec"] == ["fr.json"]
+    assert manifest["merged_trace"]["events"] > 0
+    merged = json.loads((out / "merged_trace.json").read_text())
+    names = {e.get("name") for e in merged["traceEvents"]}
+    assert "router.submit" in names
+    # the unreachable source is an error entry, nothing more
+    assert len(manifest["errors"]) == 1
+    assert "127.0.0.1:1" in manifest["errors"][0]["source"]
+    assert _json.load(open(out / "MANIFEST.json"))["snapshot_version"] == 1
+
+
+# -- the overhead bar ---------------------------------------------------------
+
+
+def test_disabled_tracing_per_call_overhead_bar(monkeypatch):
+    """Acceptance: tracing off (TFOS_REQTRACE=0) must cost one env
+    check per request boundary and a None-compare per stamp — budget
+    1.5 us/call (failpoint-bar methodology). The engine stamps ~4
+    helper calls per request plus one per decode block, so at this
+    bound the disabled tax on tok/s is far below the 2% ceiling."""
+    monkeypatch.setenv("TFOS_REQTRACE", "0")
+    reqtrace._reset_for_tests()
+    tid, owned = reqtrace.ensure(None)
+    assert tid is None and not owned
+    n = 100_000
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            reqtrace.segment(None, "engine.decode", 0.001)
+        best = min(best, (time.perf_counter() - t0) / n)
+    assert best < 1.5e-6, f"disabled stamp costs {best * 1e9:.0f}ns/call"
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            reqtrace.ensure(None)
+        best = min(best, (time.perf_counter() - t0) / n)
+    assert best < 2.5e-6, f"disabled ensure costs {best * 1e9:.0f}ns/call"

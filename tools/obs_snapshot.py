@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""obs_snapshot CLI — one-command incident bundle.
+
+Usage (from the repo root)::
+
+    python tools/obs_snapshot.py -o out/incident \
+        --metrics driver=http://127.0.0.1:9100/metrics \
+        --metrics http://127.0.0.1:8500/metrics \
+        --debugz http://127.0.0.1:8500 \
+        --flightrec 'logs/flightrec-*.json'
+
+Scrapes every given ``/metrics`` endpoint, dumps each serve_model
+``/debugz`` trace ring, copies flight-recorder dumps, and merges all
+collected traces into one clock-aligned ``merged_trace.json``
+(chrome://tracing / Perfetto). Per-source failures are recorded in
+``MANIFEST.json`` — a dead process never aborts the bundle. Details:
+docs/OBSERVABILITY.md.
+"""
+
+import os
+import sys
+import types
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# Stub parent package (trace_merge.py pattern): obs.snapshot is
+# stdlib-only, and the real tensorflowonspark_tpu/__init__ costs ~8 s
+# of jax/flax imports an incident bundle never uses.
+if "tensorflowonspark_tpu" not in sys.modules:
+    _stub = types.ModuleType("tensorflowonspark_tpu")
+    _stub.__path__ = [os.path.join(_REPO_ROOT, "tensorflowonspark_tpu")]
+    sys.modules["tensorflowonspark_tpu"] = _stub
+
+from tensorflowonspark_tpu.obs.snapshot import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
