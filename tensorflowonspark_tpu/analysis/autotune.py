@@ -1,0 +1,172 @@
+"""AT001: tunable knobs mutate only through their sanctioned paths.
+
+The autotune controller (``autotune/registry.py``) is only trustworthy
+if it is the ONLY writer of the knobs it tunes: an ad-hoc
+``engine._decode_block = 8`` anywhere else silently invalidates every
+baseline/revert decision the controller makes (it would revert to a
+value nobody set, or judge a regression caused by the stranger's
+write). So the registry module declares, as plain literals:
+
+- :data:`TUNABLE_ATTRS` — the protected attribute names; and
+- :data:`SANCTIONED` — the ``ClassName.method`` qualified names allowed
+  to assign them (each knob's constructor default plus its declared
+  live-actuation method).
+
+This rule (the FP001 pattern: both literals are parsed standalone from
+``cfg.autotune_module`` on disk, no import) flags every other
+assignment — plain, augmented, or annotated — to a protected attribute
+anywhere in the linted package. A justified exception carries
+``# lint: knob-ok: <why>`` on the assignment's line; the justification
+text is required, exactly like ``lockfree-read``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tensorflowonspark_tpu.analysis.core import Config, Finding, Module, Package
+
+KNOB_OK_RE = re.compile(r"#\s*lint:\s*knob-ok\b:?\s*(.*)")
+
+__all__ = ["check", "KNOB_OK_RE"]
+
+
+def _registry_literals(root: str, cfg: Config) -> tuple:
+    """``(TUNABLE_ATTRS, SANCTIONED)`` string sets parsed from the
+    registry module on disk, or ``(None, None)`` when it cannot be
+    read — the rule then no-ops (a repo without the autotune plane has
+    nothing to protect)."""
+    path = os.path.join(root, cfg.autotune_module)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None, None
+    out = {"TUNABLE_ATTRS": None, "SANCTIONED": None}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id in out:
+                out[t.id] = {
+                    n.value
+                    for n in ast.walk(node.value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)
+                }
+    return out["TUNABLE_ATTRS"], out["SANCTIONED"]
+
+
+class _Checker(ast.NodeVisitor):
+    """Flags assignments to protected attributes outside sanctioned
+    ``ClassName.method`` scopes. The scope stack tracks (class,
+    function) nesting; a nested helper/lambda inside a sanctioned
+    method inherits its sanction (the method owns that code)."""
+
+    def __init__(self, mod: Module, attrs: set, sanctioned: set):
+        self.mod = mod
+        self.attrs = attrs
+        self.sanctioned = sanctioned
+        self._stack: list = []  # ("class"|"fn", name)
+        self.findings: list = []
+
+    # -- scope tracking -------------------------------------------------
+
+    def visit_ClassDef(self, node):
+        self._stack.append(("class", node.name))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def _visit_fn(self, node):
+        self._stack.append(("fn", node.name))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def _in_sanctioned_scope(self) -> bool:
+        for i in range(len(self._stack) - 1):
+            kind, name = self._stack[i]
+            nkind, nname = self._stack[i + 1]
+            if kind == "class" and nkind == "fn":
+                if f"{name}.{nname}" in self.sanctioned:
+                    return True
+        return False
+
+    # -- assignment forms -----------------------------------------------
+
+    def _check_target(self, stmt: ast.stmt, target: ast.AST) -> None:
+        for node in ast.walk(target):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in self.attrs
+            ):
+                self._flag_unless_ok(stmt, node.attr)
+                return
+
+    def _flag_unless_ok(self, stmt: ast.stmt, attr: str) -> None:
+        if self._in_sanctioned_scope():
+            return
+        end = getattr(stmt, "end_lineno", stmt.lineno) or stmt.lineno
+        for line in range(stmt.lineno, end + 1):
+            c = self.mod.comments.get(line)
+            m = KNOB_OK_RE.search(c) if c else None
+            if m is not None:
+                if m.group(1).strip():
+                    return  # justified: suppressed, reviewed in place
+                self.findings.append(
+                    Finding(
+                        "AT001",
+                        self.mod.relpath,
+                        stmt.lineno,
+                        stmt.col_offset,
+                        "'# lint: knob-ok:' requires a justification "
+                        "(why is this write outside the registry safe "
+                        "for the controller?)",
+                    )
+                )
+                return
+        self.findings.append(
+            Finding(
+                "AT001",
+                self.mod.relpath,
+                stmt.lineno,
+                stmt.col_offset,
+                f"tunable attribute '{attr}' assigned outside its "
+                "sanctioned actuation path (autotune/registry.py "
+                "SANCTIONED) — an untracked write invalidates the "
+                "controller's baseline/revert bookkeeping; route it "
+                "through KnobRegistry.set or justify with "
+                "'# lint: knob-ok: <why>'",
+            )
+        )
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._check_target(node, t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_target(node, node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._check_target(node, node.target)
+        self.generic_visit(node)
+
+
+def check(pkg: Package, cfg: Config) -> list:
+    attrs, sanctioned = _registry_literals(pkg.root, cfg)
+    if not attrs:
+        return []
+    sanctioned = sanctioned or set()
+    findings: list = []
+    for mod in pkg.modules:
+        checker = _Checker(mod, attrs, sanctioned)
+        checker.visit(mod.tree)
+        findings.extend(checker.findings)
+    return findings

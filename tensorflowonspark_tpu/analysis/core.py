@@ -37,7 +37,7 @@ __all__ = [
 ]
 
 DEFAULT_RULES = (
-    "LK", "JX", "HS", "TL", "FP", "PF", "OB", "BL", "TH", "SH",
+    "LK", "JX", "HS", "TL", "FP", "PF", "OB", "BL", "TH", "SH", "AT",
 )
 
 
@@ -66,6 +66,8 @@ class Config:
     failpoints_module: str = "tensorflowonspark_tpu/utils/failpoints.py"
     # the EVENTS catalog OB002 validates flightrec.note names against
     flightrec_module: str = "tensorflowonspark_tpu/obs/flightrec.py"
+    # the TUNABLE_ATTRS/SANCTIONED literals AT001 enforces
+    autotune_module: str = "tensorflowonspark_tpu/autotune/registry.py"
     # the declarative layout table the SH rules enforce (analysis/sharding.py)
     layout_module: str = "tensorflowonspark_tpu/compute/layout.py"
     moved_jax_symbols: tuple = ("shard_map", "lax.axis_size")
@@ -175,6 +177,8 @@ def load_config(root: str, pyproject: str | None = None) -> Config:
         cfg.failpoints_module = section["failpoints_module"]
     if "flightrec_module" in section:
         cfg.flightrec_module = section["flightrec_module"]
+    if "autotune_module" in section:
+        cfg.autotune_module = section["autotune_module"]
     if "layout_module" in section:
         cfg.layout_module = section["layout_module"]
     if "moved_jax_symbols" in section:
@@ -275,6 +279,7 @@ def run_lint(root: str, cfg: Config) -> list:
     """Run every enabled analyzer over the package; findings sorted by
     (path, line, rule)."""
     from tensorflowonspark_tpu.analysis import (
+        autotune as autotune_rule,
         blocking,
         failpoints as fp_rule,
         flightrecnames,
@@ -308,6 +313,8 @@ def run_lint(root: str, cfg: Config) -> list:
         findings.extend(sharding_rule.check(pkg, cfg))
     if "FP" in enabled:
         findings.extend(fp_rule.check(pkg, cfg))
+    if "AT" in enabled:
+        findings.extend(autotune_rule.check(pkg, cfg))
     if "PF" in enabled:
         findings.extend(prefetchrule.check(pkg, cfg))
     if "OB" in enabled:

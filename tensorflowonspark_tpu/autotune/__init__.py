@@ -1,0 +1,27 @@
+"""tfos.autotune — feedback-controlled online knob tuning.
+
+The tf.data result (arXiv 2101.12127) applied to this repo's own
+knobs: a :class:`KnobRegistry` of declared tunables (the ONE sanctioned
+mutation path — lint rule AT001 enforces it), a gradient-free
+:class:`Controller` (hill-climb with hysteresis, per-knob cooldown,
+one move per history window, automatic revert on regression, SLO-breach
+back-off), and concrete :mod:`policies` for the feed, engine, router,
+and ingest planes. Fully auditable (flightrec events + metrics +
+decision log) and fully killable (``TFOS_AUTOTUNE=0``, per-knob
+freeze). See docs/AUTOTUNE.md.
+"""
+
+from tensorflowonspark_tpu.autotune.controller import Controller, Policy
+from tensorflowonspark_tpu.autotune.registry import (
+    Knob,
+    KnobRegistry,
+    enabled,
+)
+
+__all__ = [
+    "Controller",
+    "Knob",
+    "KnobRegistry",
+    "Policy",
+    "enabled",
+]

@@ -1,0 +1,492 @@
+"""Feedback controller: hill-climb with hysteresis over obs history.
+
+The tf.data Plateau/HillClimb shape (arXiv 2101.12127) adapted to this
+repo's signals: each **window** (one :meth:`Controller.step` call,
+typically driven right after a ``History.scrape_registry`` pump) the
+controller makes at most ONE knob move, then spends the next window
+judging it against the policy's objective:
+
+- **improved** beyond the hysteresis band → keep, and keep direction
+  (momentum);
+- **regressed** beyond the band → revert through the registry, flip
+  direction, and put the knob on **cooldown** for N windows;
+- **inside the band** → keep the value, drop the momentum (plateau).
+
+Gradient-free, single-writer, and fully auditable: every move/revert/
+back-off is a registered flight-recorder event
+(``autotune_decision`` / ``autotune_revert`` / ``autotune_frozen``),
+an ``autotune_decisions_total{knob,direction}`` /
+``autotune_reverts_total`` metric bump, and a row in the bounded
+decision log (:meth:`Controller.decision_log`, dumped into incident
+bundles by ``tools/obs_snapshot.py --autotune``).
+
+SLO interaction: given an :class:`~tensorflowonspark_tpu.obs.slo.
+SLOEvaluator`, the controller **backs off** while any SLO is in
+breach — it reverts its unjudged move (the move may be the cause) and
+makes no new ones until the burn clears. Tuning must never fight the
+alert that pages a human (docs/AUTOTUNE.md).
+
+Kill switch: with ``TFOS_AUTOTUNE=0`` :meth:`step` is one env read and
+an immediate return — the disabled path is micro-benched in
+``tests/test_autotune.py`` alongside the failpoint/tfsan bars.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from tensorflowonspark_tpu.autotune.registry import KnobRegistry, enabled
+from tensorflowonspark_tpu.obs import flightrec
+from tensorflowonspark_tpu.obs.history import History
+from tensorflowonspark_tpu.obs.registry import Registry, default_registry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Controller", "Policy"]
+
+
+@dataclass
+class Policy:
+    """How one registered knob is tuned.
+
+    ``objective(history, now)`` returns the score the controller
+    MAXIMIZES (throughput, negative latency, ...), or None while the
+    window holds no signal. ``hint(history, now)`` optionally biases
+    the next move's direction (+1 grow / -1 shrink / 0 no opinion) from
+    a domain signal — e.g. grow prefetch depth while ``feed.data_wait``
+    dominates the step time. ``target(history, now)`` switches the
+    policy to DIRECT mode: each eligible window computes a target value
+    (e.g. the router's measured p90 service time) and applies it —
+    no verdict/revert cycle, because a direct policy only tightens an
+    estimate rather than trading throughput against latency.
+    """
+
+    knob: str
+    objective: Callable[[History, float], float | None] | None = None
+    hint: Callable[[History, float], int] | None = None
+    target: Callable[[History, float], float | None] | None = None
+    rel_eps: float = 0.05  # hysteresis band, relative
+    cooldown_windows: int = 2  # windows a reverted knob sits out
+    max_pending_windows: int = 3  # verdict patience without signal
+
+    def __post_init__(self):
+        if (self.objective is None) == (self.target is None):
+            raise ValueError(
+                f"policy for {self.knob!r}: exactly one of objective "
+                "(hill-climb) or target (direct) is required"
+            )
+
+
+class _KnobState:
+    """Per-policy controller bookkeeping (guarded by Controller._lock)."""
+
+    __slots__ = (
+        "direction",
+        "cooldown",
+        "pending_from",
+        "pending_to",
+        "pending_baseline",
+        "pending_windows",
+    )
+
+    def __init__(self):
+        self.direction = 1
+        self.cooldown = 0
+        self.pending_from: float | None = None
+        self.pending_to: float | None = None
+        self.pending_baseline: float | None = None
+        self.pending_windows = 0
+
+
+class Controller:
+    """One feedback loop over one History and one KnobRegistry.
+
+    Driver-side (feed/ingest/router knobs over the driver's history
+    pump) and engine-local (serving knobs over the replica's own
+    registry) instances are the same class — what differs is which
+    knobs/policies are wired in.
+    """
+
+    def __init__(
+        self,
+        knobs: KnobRegistry,
+        history: History,
+        policies: list[Policy] | tuple[Policy, ...],
+        *,
+        slo=None,
+        metrics_registry: Registry | None = None,
+        source: str = "autotune",
+        log_capacity: int = 512,
+    ):
+        names = [p.knob for p in policies]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate policy knobs: {names}")
+        for p in policies:
+            knobs.knob(p.knob)  # unknown knob = loud ctor error
+        self.knobs = knobs
+        self.history = history
+        self.policies = tuple(policies)
+        self.slo = slo  # SLOEvaluator or None
+        self.source = source
+        reg = (
+            metrics_registry
+            if metrics_registry is not None
+            else default_registry()
+        )
+        self._m_decisions = reg.counter(
+            "autotune_decisions_total",
+            "controller knob moves, by knob and direction",
+        )
+        self._m_reverts = reg.counter(
+            "autotune_reverts_total",
+            "controller moves undone after the objective regressed",
+        )
+        self._g_value = reg.gauge(
+            "autotune_knob_value",
+            "current value of each registered knob the controller "
+            "drives",
+        )
+        self._lock = threading.Lock()
+        self._state = {
+            p.knob: _KnobState() for p in self.policies
+        }  # guarded-by: self._lock
+        self._log: deque = deque(
+            maxlen=max(1, int(log_capacity))
+        )  # guarded-by: self._lock
+        self._rr = 0  # round-robin cursor  # guarded-by: self._lock
+        self._windows = 0  # guarded-by: self._lock
+        self._backing_off = False  # SLO-breach latch  # guarded-by: self._lock
+
+    # -- audit trail ----------------------------------------------------
+
+    def _record(self, action: str, knob: str, **details: Any) -> dict:
+        row = {
+            "t_unix": time.time(),
+            "action": action,
+            "knob": knob,
+            **details,
+        }
+        with self._lock:
+            self._log.append(row)
+        return row
+
+    def decision_log(self) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in self._log]
+
+    def to_artifact(self) -> dict[str, Any]:
+        """JSON-safe audit bundle: the decision log plus the knobs'
+        final state — what bench commits and obs_snapshot collects."""
+        with self._lock:
+            log = [dict(r) for r in self._log]
+            windows = self._windows
+        return {
+            "autotune_version": 1,
+            "source": self.source,
+            "windows": windows,
+            "knobs": self.knobs.snapshot(),
+            "decisions": log,
+        }
+
+    def dump(self, path: str | None = None) -> str:
+        """Write the audit bundle to ``path`` (default
+        ``logs/autotune-<source>.json`` — the glob
+        ``tools/obs_snapshot.py --autotune`` folds into incident
+        bundles). Atomic via rename."""
+        if path is None:
+            path = os.path.join("logs", f"autotune-{self.source}.json")
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.to_artifact(), f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    # -- the loop body --------------------------------------------------
+
+    def step(self, now: float | None = None) -> list[dict]:
+        """One controller window. Returns the decision rows recorded
+        this window (empty when nothing moved). Call after the history
+        pump's scrape so the objectives see fresh points."""
+        if not enabled():
+            return []  # the kill switch: one env read, nothing touched
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            self._windows += 1
+
+        rows: list[dict] = []
+        if self._slo_backoff(now, rows):
+            return rows
+        moved = self._judge_pending(now, rows)
+        if not moved:
+            self._propose_move(now, rows)
+        return rows
+
+    # -- SLO back-off ---------------------------------------------------
+
+    def _slo_backoff(self, now: float, rows: list[dict]) -> bool:
+        """While any SLO is in breach: revert the unjudged move (it may
+        be the cause) and freeze all new moves. Returns True when
+        backing off."""
+        if self.slo is None:
+            return False
+        try:
+            breaching = self.slo.breaching()
+        except Exception:  # noqa: BLE001 - a broken evaluator must not
+            # kill the tuning loop; fail open (no back-off)
+            logger.exception("autotune: SLO evaluator failed")
+            return False
+        if not breaching:
+            with self._lock:
+                was = self._backing_off
+                self._backing_off = False
+            if was:
+                rows.append(self._record("resume", "*", reason="slo_clear"))
+            return False
+        with self._lock:
+            rising = not self._backing_off
+            self._backing_off = True
+        if rising:
+            flightrec.note(
+                "autotune_frozen",
+                knob="*",
+                reason="slo_breach",
+                slos=",".join(breaching),
+            )
+            rows.append(
+                self._record(
+                    "backoff",
+                    "*",
+                    reason="slo_breach",
+                    slos=list(breaching),
+                )
+            )
+        # revert any move still awaiting a verdict: under a breach we
+        # cannot attribute the burn, so undo our own last change
+        for p in self.policies:
+            with self._lock:
+                st = self._state[p.knob]
+                pending = st.pending_from is not None
+            if pending:
+                rows.append(self._revert(p, st, reason="slo_breach"))
+        return True
+
+    # -- verdict on the last move --------------------------------------
+
+    def _judge_pending(self, now: float, rows: list[dict]) -> bool:
+        """Resolve at most one pending move's verdict. A revert
+        consumes the window's move budget (returns True)."""
+        for p in self.policies:
+            if p.target is not None:
+                continue  # direct policies carry no verdict cycle
+            with self._lock:
+                st = self._state[p.knob]
+                if st.pending_from is None:
+                    continue
+                baseline = st.pending_baseline
+                st.pending_windows += 1
+                patience_exhausted = (
+                    st.pending_windows > p.max_pending_windows
+                )
+            score = p.objective(self.history, now)
+            if score is None:
+                if patience_exhausted:
+                    # windows of silence: treat as a failed move (the
+                    # signal died right after we touched the knob)
+                    rows.append(self._revert(p, st, reason="no_signal"))
+                    return True
+                continue
+            if baseline is None:
+                # no pre-move baseline (cold start): accept and seed
+                self._accept(p, st, score, rows, momentum=False)
+                continue
+            band = abs(baseline) * p.rel_eps
+            if score >= baseline + band:
+                self._accept(p, st, score, rows, momentum=True)
+            elif score <= baseline - band:
+                rows.append(self._revert(p, st, reason="regression"))
+                return True
+            else:
+                self._accept(p, st, score, rows, momentum=False)
+        return False
+
+    def _accept(
+        self,
+        p: Policy,
+        st: _KnobState,
+        score: float,
+        rows: list[dict],
+        momentum: bool,
+    ) -> None:
+        with self._lock:
+            frm, to = st.pending_from, st.pending_to
+            st.pending_from = None
+            if not momentum:
+                st.direction = 0  # plateau: next hint re-picks
+        rows.append(
+            self._record(
+                "accept",
+                p.knob,
+                value=to,
+                moved_from=frm,
+                score=score,
+                momentum=momentum,
+            )
+        )
+
+    def _revert(self, p: Policy, st: _KnobState, reason: str) -> dict:
+        with self._lock:
+            frm, to = st.pending_from, st.pending_to
+            st.pending_from = None
+            st.direction = -st.direction if st.direction else -1
+            st.cooldown = p.cooldown_windows
+        if frm is None:  # raced with another resolver: nothing to undo
+            return self._record("revert", p.knob, reason=reason, noop=True)
+        actual = self.knobs.set(p.knob, frm)
+        self._m_reverts.inc(knob=p.knob)
+        self._g_value.set(actual, knob=p.knob)
+        flightrec.note(
+            "autotune_revert",
+            knob=p.knob,
+            moved_to=to,
+            reverted_to=actual,
+            reason=reason,
+        )
+        return self._record(
+            "revert", p.knob, value=actual, undone=to, reason=reason
+        )
+
+    # -- the next move --------------------------------------------------
+
+    def _propose_move(self, now: float, rows: list[dict]) -> None:
+        """One knob move per window: round-robin over eligible
+        policies, direction from the policy hint (falling back to
+        stored momentum, then +1)."""
+        n = len(self.policies)
+        for i in range(n):
+            with self._lock:
+                p = self.policies[(self._rr + i) % n]
+                st = self._state[p.knob]
+                if st.cooldown > 0:
+                    st.cooldown -= 1
+                    continue
+                if st.pending_from is not None:
+                    continue  # still awaiting a verdict
+            if self.knobs.frozen(p.knob) is not None:
+                continue
+            if p.target is not None:
+                if self._apply_direct(p, st, now, rows):
+                    with self._lock:
+                        self._rr = (self._rr + i + 1) % n
+                    return
+                continue
+            if self._apply_climb(p, st, now, rows):
+                with self._lock:
+                    self._rr = (self._rr + i + 1) % n
+                return
+        with self._lock:
+            self._rr = (self._rr + 1) % n if n else 0
+
+    def _apply_direct(
+        self, p: Policy, st: _KnobState, now: float, rows: list[dict]
+    ) -> bool:
+        tgt = p.target(self.history, now)
+        if tgt is None:
+            return False
+        k = self.knobs.knob(p.knob)
+        current = self.knobs.current(p.knob)
+        want = k.clamp(tgt)
+        if abs(want - current) < k.step:
+            return False
+        actual = self.knobs.set(p.knob, want)
+        if actual == current:
+            return False  # frozen race or dropped apply: no movement
+        direction = "up" if actual > current else "down"
+        self._m_decisions.inc(knob=p.knob, direction=direction)
+        self._g_value.set(actual, knob=p.knob)
+        flightrec.note(
+            "autotune_decision",
+            knob=p.knob,
+            direction=direction,
+            moved_from=current,
+            moved_to=actual,
+            mode="direct",
+        )
+        rows.append(
+            self._record(
+                "move",
+                p.knob,
+                mode="direct",
+                direction=direction,
+                moved_from=current,
+                value=actual,
+                cost_hint=k.cost_hint,
+            )
+        )
+        return True
+
+    def _apply_climb(
+        self, p: Policy, st: _KnobState, now: float, rows: list[dict]
+    ) -> bool:
+        direction = 0
+        if p.hint is not None:
+            try:
+                direction = int(p.hint(self.history, now) or 0)
+            except Exception:  # noqa: BLE001 - a broken hint falls back
+                # to momentum rather than killing the loop
+                logger.exception("autotune: hint for %s failed", p.knob)
+        if direction == 0:
+            with self._lock:
+                direction = st.direction or 1
+        k = self.knobs.knob(p.knob)
+        current = self.knobs.current(p.knob)
+        want = k.clamp(current + direction * k.step)
+        if want == current:
+            # at a bound: try the other way once
+            direction = -direction
+            want = k.clamp(current + direction * k.step)
+            if want == current:
+                return False
+        baseline = p.objective(self.history, now)
+        actual = self.knobs.set(p.knob, want)
+        if actual == current:
+            return False  # dropped apply / frozen race: nothing moved
+        dir_label = "up" if actual > current else "down"
+        with self._lock:
+            st.direction = 1 if actual > current else -1
+            st.pending_from = current
+            st.pending_to = actual
+            st.pending_baseline = baseline
+            st.pending_windows = 0
+        self._m_decisions.inc(knob=p.knob, direction=dir_label)
+        self._g_value.set(actual, knob=p.knob)
+        flightrec.note(
+            "autotune_decision",
+            knob=p.knob,
+            direction=dir_label,
+            moved_from=current,
+            moved_to=actual,
+            mode="climb",
+        )
+        rows.append(
+            self._record(
+                "move",
+                p.knob,
+                mode="climb",
+                direction=dir_label,
+                moved_from=current,
+                value=actual,
+                baseline=baseline,
+                cost_hint=k.cost_hint,
+            )
+        )
+        return True

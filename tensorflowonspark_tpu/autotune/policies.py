@@ -1,0 +1,258 @@
+"""Concrete knob policies over the repo's own telemetry.
+
+Each builder wires ONE component's live-settable knob (its declared
+actuation method — the registry is the only writer) to the history
+signals named in docs/AUTOTUNE.md:
+
+- :func:`prefetch_depth_policy` — grow ``DevicePrefetcher`` depth while
+  ``feed.data_wait`` dominates the step, shrink when the queue is
+  already hiding the producer;
+- :func:`engine_knob_policies` — trade ``decode_block`` /
+  ``pipeline_depth`` throughput against an admission-latency budget
+  (``history.percentile`` of the request-latency histogram vs the
+  deadline);
+- :func:`router_estimate_policy` — tighten the ``FleetRouter``'s
+  completion estimate from the measured duration distribution (direct
+  mode: an estimate only informs admission, there is nothing to
+  revert);
+- :func:`ingest_publish_policy` — adapt ``publish_blocks`` to the
+  measured cursor-publish overhead (publish often enough for a tight
+  crash-replay bound, rarely enough that the RPC cost stays noise).
+
+Builders return ``(Knob, Policy)`` pairs; callers register the knob
+and hand the policy to a :class:`~tensorflowonspark_tpu.autotune.
+controller.Controller`. Objectives/hints returning ``None`` (no
+in-window signal) make the controller hold still — never guess.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from tensorflowonspark_tpu.autotune.controller import Policy
+from tensorflowonspark_tpu.autotune.registry import Knob
+from tensorflowonspark_tpu.obs.history import History
+
+__all__ = [
+    "counter_rate_objective",
+    "engine_knob_policies",
+    "ingest_publish_policy",
+    "prefetch_depth_policy",
+    "router_estimate_policy",
+]
+
+
+def counter_rate_objective(
+    metric: str,
+    labels: dict | None = None,
+    window_s: float = 30.0,
+) -> Callable[[History, float], float | None]:
+    """The throughput objective: per-second increase of a counter over
+    the trailing window (None while the window lacks two points)."""
+
+    def objective(hist: History, now: float) -> float | None:
+        return hist.rate(metric, labels, window_s=window_s, now=now)
+
+    return objective
+
+
+# -- feed plane --------------------------------------------------------------
+
+
+def prefetch_depth_policy(
+    prefetcher,
+    *,
+    objective_metric: str = "feed_batches_total",
+    lo: int = 1,
+    hi: int = 16,
+    window_s: float = 30.0,
+    wait_dominance: float = 0.15,
+) -> tuple[Knob, Policy]:
+    """Depth knob for a live :class:`~tensorflowonspark_tpu.feed.
+    prefetch.DevicePrefetcher`. Hint: grow while the consumer spends
+    more than ``wait_dominance`` of wall time blocked in
+    ``feed.data_wait`` (the queue is starving the device — the
+    dominance signal the tf.data controller keys on); shrink when the
+    wait share is negligible (staged buffers are just pinning host
+    memory). The objective is the prefetcher's delivered batches/sec."""
+    knob = Knob(
+        name="feed.prefetch_depth",
+        lo=float(lo),
+        hi=float(hi),
+        step=1.0,
+        apply=prefetcher.set_depth,
+        get=lambda: prefetcher.stats()["depth"],
+        cost_hint="queue-resize",
+    )
+
+    def hint(hist: History, now: float) -> int:
+        wait_s = hist.delta_sum(
+            "feed_data_wait_seconds", window_s=window_s, now=now
+        )
+        share = wait_s / window_s
+        if share > wait_dominance:
+            return 1
+        if share < wait_dominance / 4.0:
+            return -1
+        return 0
+
+    return knob, Policy(
+        knob=knob.name,
+        objective=counter_rate_objective(
+            objective_metric, window_s=window_s
+        ),
+        hint=hint,
+    )
+
+
+# -- serving engine ----------------------------------------------------------
+
+
+def engine_knob_policies(
+    engine,
+    *,
+    deadline_s: float,
+    latency_metric: str = "router_request_seconds",
+    throughput_metric: str = "engine_tokens_emitted_total",
+    decode_block_hi: int = 32,
+    pipeline_depth_hi: int = 4,
+    window_s: float = 30.0,
+    headroom: float = 0.8,
+) -> list[tuple[Knob, Policy]]:
+    """``decode_block`` and ``pipeline_depth`` knobs for a running
+    engine, actuated through ``ContinuousBatcher.set_knobs`` (installed
+    between decode blocks, exactly like a weight swap). Hint: while the
+    admission p99 sits above ``headroom × deadline_s`` the latency
+    budget is being eaten — shrink (a smaller block retires requests at
+    finer granularity); with p99 comfortably inside the budget, grow
+    toward throughput. Objective: decoded tokens/sec."""
+
+    def latency_hint(hist: History, now: float) -> int:
+        p99 = hist.percentile(
+            latency_metric, 0.99, window_s=window_s, now=now
+        )
+        if p99 is None:
+            return 0
+        if p99 > headroom * deadline_s:
+            return -1
+        if p99 < 0.5 * headroom * deadline_s:
+            return 1
+        return 0
+
+    objective = counter_rate_objective(
+        throughput_metric, window_s=window_s
+    )
+    block = Knob(
+        name="engine.decode_block",
+        lo=1.0,
+        hi=float(decode_block_hi),
+        step=1.0,
+        apply=lambda v: engine.set_knobs(decode_block=int(v)),
+        get=lambda: engine.stats()["decode_block"],
+        cost_hint="recompile-per-new-k",
+    )
+    depth = Knob(
+        name="engine.pipeline_depth",
+        lo=1.0,
+        hi=float(pipeline_depth_hi),
+        step=1.0,
+        apply=lambda v: engine.set_knobs(pipeline_depth=int(v)),
+        get=lambda: engine.stats()["pipeline_depth"],
+        cost_hint="window-drain",
+    )
+    return [
+        (block, Policy(knob=block.name, objective=objective, hint=latency_hint)),
+        (depth, Policy(knob=depth.name, objective=objective, hint=latency_hint)),
+    ]
+
+
+# -- fleet router ------------------------------------------------------------
+
+
+def router_estimate_policy(
+    router,
+    *,
+    latency_metric: str = "router_request_seconds",
+    q: float = 0.9,
+    lo_s: float = 0.001,
+    hi_s: float = 120.0,
+    window_s: float = 60.0,
+) -> tuple[Knob, Policy]:
+    """Direct policy: every eligible window, re-seed the router's
+    cold-start service estimate from the measured latency distribution
+    (q-quantile), replacing the ctor's hardcoded
+    ``service_time_hint_s`` guess. Direct mode — an estimate only
+    informs admission feasibility, so there is no objective to judge
+    and nothing to revert."""
+    knob = Knob(
+        name="router.service_estimate_s",
+        lo=lo_s,
+        hi=hi_s,
+        step=lo_s,
+        apply=router.set_service_estimate,
+        get=router.service_estimate,
+        cost_hint="estimate-only",
+        integer=False,
+    )
+
+    def target(hist: History, now: float) -> float | None:
+        return hist.percentile(
+            latency_metric, q, window_s=window_s, now=now
+        )
+
+    return knob, Policy(knob=knob.name, target=target)
+
+
+# -- ingest pull plane -------------------------------------------------------
+
+
+def ingest_publish_policy(
+    apply: Callable[[int], Any],
+    get: Callable[[], int],
+    *,
+    objective_metric: str = "feed_ingest_records_total",
+    lo: int = 1,
+    hi: int = 256,
+    step: int = 8,
+    window_s: float = 30.0,
+    overhead_budget: float = 0.02,
+) -> tuple[Knob, Policy]:
+    """``publish_blocks`` knob: how many fully-consumed blocks between
+    replay-cursor publications. ``apply``/``get`` reach the feed —
+    node-local runs pass ``feed.set_publish_blocks`` directly; a
+    driver-side controller passes the KV re-publish path
+    (``TFCluster.publish_feed_knobs``), which the node's ingest loop
+    adopts at its next block boundary. Hint: while the measured
+    cursor-publish overhead exceeds ``overhead_budget`` of ingest wall
+    time, publish less often (grow); when overhead is negligible,
+    shrink toward a tighter crash-replay duplicate bound."""
+    knob = Knob(
+        name="ingest.publish_blocks",
+        lo=float(lo),
+        hi=float(hi),
+        step=float(step),
+        apply=apply,
+        get=get,
+        cost_hint="kv-republish",
+    )
+
+    def hint(hist: History, now: float) -> int:
+        publish_s = hist.delta_sum(
+            "ingest_cursor_publish_seconds", window_s=window_s, now=now
+        )
+        if publish_s <= 0.0:
+            return 0
+        share = publish_s / window_s
+        if share > overhead_budget:
+            return 1
+        if share < overhead_budget / 4.0:
+            return -1
+        return 0
+
+    return knob, Policy(
+        knob=knob.name,
+        objective=counter_rate_objective(
+            objective_metric, window_s=window_s
+        ),
+        hint=hint,
+    )

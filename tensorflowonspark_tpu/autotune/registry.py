@@ -1,0 +1,276 @@
+"""Knob registry: THE sanctioned mutation path for performance knobs.
+
+The repo grew dozens of hand-set performance knobs (prefetch depth,
+``decode_block``, ``pipeline_depth``, ``publish_blocks``, router
+service-time estimates, ...). tf.data's core result (arXiv 2101.12127)
+is that a feedback controller beats static hand-tuning — but a
+controller is only trustworthy if it is the ONLY writer: a knob mutated
+behind its back makes every revert decision wrong. So every tunable is
+declared here as a :class:`Knob` (name, bounds, step granularity, the
+actuation callback, a cost hint), and :meth:`KnobRegistry.set` is the
+one path that mutates it. Raw attribute mutation of a tunable outside
+its declared actuation methods is a build failure — tfoslint rule
+AT001 (``analysis/autotune.py``) parses :data:`TUNABLE_ATTRS` and
+:data:`SANCTIONED` from this file (the FP001 pattern) and flags
+everything else; a justified exception carries
+``# lint: knob-ok: <why>``.
+
+Failure injection: the apply path threads the drop-aware
+``autotune.apply`` failpoint. A dropped apply skips the actuation
+callback entirely; the registry then records the READBACK value (what
+the component actually runs with), so a lost apply can never wedge the
+registry into believing a move happened — the controller sees no
+movement, its objective does not improve, and it reverts cleanly.
+
+Kill switch: ``TFOS_AUTOTUNE=0`` disables every controller
+(:func:`enabled`); per-knob ``freeze`` pins one knob while the rest
+keep tuning. With the switch off or all knobs frozen nothing in the
+serving/feed path changes — the registry is pure bookkeeping until a
+controller drives it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from tensorflowonspark_tpu.obs import flightrec
+from tensorflowonspark_tpu.utils.failpoints import failpoint
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "Knob",
+    "KnobRegistry",
+    "SANCTIONED",
+    "TUNABLE_ATTRS",
+    "enabled",
+]
+
+#: Attribute names AT001 protects: an ``obj.<attr> = ...`` assignment
+#: anywhere in the package is a violation unless it happens inside a
+#: :data:`SANCTIONED` function or carries ``# lint: knob-ok: <why>``.
+#: Kept as a plain literal frozenset — the lint rule parses this
+#: assignment from DISK (ast, no import), exactly like FP001's SITES.
+TUNABLE_ATTRS = frozenset(
+    {
+        "_decode_block",  # serving/engine.py ContinuousBatcher
+        "_pipeline_depth",  # serving/engine.py ContinuousBatcher
+        "_prefetch_depth",  # feed/prefetch.py DevicePrefetcher
+        "_publish_blocks",  # feed/ingest.py IngestFeed
+        "_service_time_hint",  # serving/router.py FleetRouter
+        "_seed_est_s",  # serving/router.py FleetRouter (history seed)
+    }
+)
+
+#: ``ClassName.method`` qualified names allowed to assign the
+#: attributes above: each knob's constructor default and its declared
+#: live-actuation path. Everything else mutating a tunable is exactly
+#: the ad-hoc knob poking this registry exists to end.
+SANCTIONED = frozenset(
+    {
+        "ContinuousBatcher.__init__",
+        "ContinuousBatcher._apply_pending_knobs",
+        "DevicePrefetcher.__init__",
+        "DevicePrefetcher.set_depth",
+        "IngestFeed.__init__",
+        "IngestFeed.set_publish_blocks",
+        "FleetRouter.__init__",
+        "FleetRouter.set_service_estimate",
+        "FleetRouter.seed_from_history",
+    }
+)
+
+
+def enabled() -> bool:
+    """The process-wide kill switch: ``TFOS_AUTOTUNE=0`` (or
+    false/no/off) disables every controller. Read per call — one dict
+    lookup — so tests and operators can flip it live."""
+    return os.environ.get("TFOS_AUTOTUNE", "1").strip().lower() not in (
+        "0",
+        "false",
+        "no",
+        "off",
+    )
+
+
+@dataclass
+class Knob:
+    """One registered tunable.
+
+    ``apply`` is the actuation callback — always one of the component's
+    declared live-set methods (``set_knobs``, ``set_depth``, ...), so
+    the component's own locking/validation runs on every move. ``get``
+    reads the value actually in effect (the readback); when provided,
+    the registry trusts it over its own bookkeeping, which is what
+    makes a dropped/failed apply self-correcting. ``cost_hint`` is a
+    free-form note the controller surfaces in its decision log
+    ("recompile", "queue-resize", "kv-republish") so an operator
+    reading the audit trail knows what each move cost.
+    """
+
+    name: str
+    lo: float
+    hi: float
+    step: float
+    apply: Callable[[float], Any]
+    get: Callable[[], float] | None = None
+    cost_hint: str = ""
+    integer: bool = True
+
+    def __post_init__(self):
+        if self.lo > self.hi:
+            raise ValueError(
+                f"knob {self.name!r}: lo {self.lo} > hi {self.hi}"
+            )
+        if self.step <= 0:
+            raise ValueError(
+                f"knob {self.name!r}: step must be > 0, got {self.step}"
+            )
+
+    def clamp(self, value: float) -> float:
+        """Snap ``value`` to the knob's step grid (anchored at ``lo``)
+        inside ``[lo, hi]``."""
+        v = max(self.lo, min(self.hi, float(value)))
+        v = self.lo + round((v - self.lo) / self.step) * self.step
+        v = max(self.lo, min(self.hi, v))
+        return float(int(round(v))) if self.integer else v
+
+
+class KnobRegistry:
+    """Declared knobs + freeze state; :meth:`set` is the one mutation
+    path. Thread-safe: the lock covers bookkeeping only — actuation
+    callbacks run OUTSIDE it (they may block on the component's own
+    apply machinery, e.g. the engine scheduler's between-blocks
+    install)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._knobs: dict[str, Knob] = {}  # guarded-by: self._lock
+        self._frozen: dict[str, str] = {}  # name -> reason  # guarded-by: self._lock
+        self._values: dict[str, float] = {}  # last readback  # guarded-by: self._lock
+
+    # -- declaration ----------------------------------------------------
+
+    def register(self, knob: Knob) -> Knob:
+        seed = None
+        if knob.get is not None:
+            # readback OUTSIDE the registry lock: get() may take the
+            # component's own lock, and nothing component-side may ever
+            # nest under ours
+            try:
+                seed = float(knob.get())
+            except Exception:  # noqa: BLE001 - readback is best-effort
+                pass
+        with self._lock:
+            if knob.name in self._knobs:
+                raise ValueError(f"knob {knob.name!r} already registered")
+            self._knobs[knob.name] = knob
+            if seed is not None:
+                self._values[knob.name] = seed
+        return knob
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._knobs)
+
+    def knob(self, name: str) -> Knob:
+        with self._lock:
+            try:
+                return self._knobs[name]
+            except KeyError:
+                raise KeyError(
+                    f"unknown knob {name!r}; registered: "
+                    f"{sorted(self._knobs)}"
+                ) from None
+
+    # -- freeze ---------------------------------------------------------
+
+    def freeze(self, name: str, reason: str = "operator") -> None:
+        """Pin one knob: the controller skips it until :meth:`unfreeze`.
+        Audited — a frozen knob that silently stopped tuning would look
+        identical to a broken controller."""
+        k = self.knob(name)
+        with self._lock:
+            already = k.name in self._frozen
+            self._frozen[k.name] = reason
+        if not already:
+            flightrec.note("autotune_frozen", knob=k.name, reason=reason)
+
+    def unfreeze(self, name: str) -> None:
+        with self._lock:
+            self._frozen.pop(name, None)
+
+    def frozen(self, name: str) -> str | None:
+        """The freeze reason, or None when the knob is live."""
+        with self._lock:
+            return self._frozen.get(name)
+
+    def all_frozen(self) -> bool:
+        with self._lock:
+            return bool(self._knobs) and set(self._frozen) >= set(
+                self._knobs
+            )
+
+    # -- read -----------------------------------------------------------
+
+    def current(self, name: str) -> float:
+        """The value in effect: live readback when the knob declares
+        ``get``, else the last value this registry applied."""
+        k = self.knob(name)
+        if k.get is not None:
+            v = float(k.get())
+            with self._lock:
+                self._values[name] = v
+            return v
+        with self._lock:
+            return self._values.get(name, k.lo)
+
+    def snapshot(self) -> dict[str, dict[str, Any]]:
+        """JSON-safe view of every knob (bench artifacts, /statusz)."""
+        out: dict[str, dict[str, Any]] = {}
+        for name in self.names():
+            k = self.knob(name)
+            out[name] = {
+                "value": self.current(name),
+                "lo": k.lo,
+                "hi": k.hi,
+                "step": k.step,
+                "cost_hint": k.cost_hint,
+                "frozen": self.frozen(name),
+            }
+        return out
+
+    # -- the one mutation path ------------------------------------------
+
+    def set(self, name: str, value: float) -> float:
+        """Apply ``value`` (clamped to the knob's grid) through the
+        knob's actuation callback; returns the value actually in effect
+        afterwards. Frozen knobs do not move. A dropped apply (the
+        ``autotune.apply`` failpoint) skips the callback — the readback
+        keeps registry state truthful, so the caller observes no
+        movement instead of a lie. A RAISING callback propagates after
+        the registry re-reads the component (consistent either way)."""
+        k = self.knob(name)
+        if self.frozen(name) is not None:
+            return self.current(name)
+        target = k.clamp(value)
+        if failpoint("autotune.apply") == "drop":
+            # chaos: the lost apply. Nothing was actuated; re-read the
+            # component so our bookkeeping cannot drift from reality.
+            logger.warning(
+                "autotune apply dropped (failpoint): knob %s -> %s "
+                "not actuated",
+                name,
+                target,
+            )
+            return self.current(name)
+        try:
+            k.apply(int(target) if k.integer else target)
+        finally:
+            # success or raise, the registry's view is the readback
+            actual = self.current(name)
+        return actual

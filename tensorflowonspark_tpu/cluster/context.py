@@ -109,11 +109,19 @@ class TFNodeContext:
         the elastic watcher, publishes its replay cursor to the
         driver's durable table, and adopts driver re-splits on epoch
         bumps (docs/ROBUSTNESS.md "Live shard redistribution")."""
-        from tensorflowonspark_tpu.cluster.node import fetch_ingest_plan
+        from tensorflowonspark_tpu.cluster.node import (
+            fetch_feed_knobs,
+            fetch_ingest_plan,
+        )
         from tensorflowonspark_tpu.feed.ingest import IngestFeed
 
         plan = fetch_ingest_plan(self.mgr, timeout=timeout)
-        wires: dict[str, Any] = {}
+        # Driver-pushed feed knobs (autotune): wired unconditionally —
+        # one non-blocking KV read per (time-gated) poll; a cluster
+        # that never tunes simply never publishes the key.
+        wires: dict[str, Any] = {
+            "knob_fetch": lambda: fetch_feed_knobs(self.mgr),
+        }
         server_addr = self.extras.get("server_addr")
         if plan.get("handover") and server_addr is not None:
             from tensorflowonspark_tpu.cluster import reservation
@@ -138,11 +146,11 @@ class TFNodeContext:
                 except TimeoutError:
                     return None
 
-            wires = {
-                "plan_fetch": _plan_fetch,
-                "cursor_publish": _publish,
-                "epoch_watch": elastic.current_epoch,
-            }
+            wires.update(
+                plan_fetch=_plan_fetch,
+                cursor_publish=_publish,
+                epoch_watch=elastic.current_epoch,
+            )
         return IngestFeed(
             plan["manifests"],
             input_mapping=input_mapping,

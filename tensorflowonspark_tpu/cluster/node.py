@@ -619,6 +619,46 @@ def fetch_ingest_plan(
         time.sleep(poll)
 
 
+# Manager KV key carrying driver-pushed feed knobs (autotune): the
+# driver-side controller re-publishes tuned node-side knobs here;
+# IngestFeed polls it at block boundaries and adopts by seq.
+FEED_KNOBS_KEY = "feed_knobs"
+
+
+def publish_feed_knobs(
+    mgr: tf_manager.ManagerHandle,
+    knobs: dict[str, Any],
+    seq: int = 0,
+) -> None:
+    """Driver side of the feed-knob wire, beside
+    :func:`publish_ingest_plan`: publish tuned node-side feed knobs
+    (currently ``publish_blocks``) to one node's manager KV. ``seq``
+    must be monotonically increasing per node — the consumer adopts a
+    publication exactly once and ignores stale republishes, so a
+    controller's revert is just the next publication."""
+    mgr.set(
+        FEED_KNOBS_KEY,
+        {"seq": int(seq), "knobs": dict(knobs)},
+    )
+
+
+def fetch_feed_knobs(
+    mgr: tf_manager.ManagerHandle,
+) -> dict[str, Any] | None:
+    """Node side of the feed-knob wire: one non-blocking KV read —
+    ``{"seq", "knobs"}`` or None when the driver never tuned anything.
+    Unlike :func:`fetch_ingest_plan` this never probes: knobs are an
+    optimization, not a dependency, so a feed with no publication just
+    keeps its constructor values."""
+    pub = mgr.get(FEED_KNOBS_KEY)
+    if pub is None:
+        return None
+    return {
+        "seq": int(pub.get("seq", 0)),
+        "knobs": dict(pub.get("knobs") or {}),
+    }
+
+
 def publish_ingest_cursor(
     client: reservation.Client, executor_id: int, payload: dict[str, Any]
 ) -> None:

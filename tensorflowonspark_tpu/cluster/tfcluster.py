@@ -108,6 +108,9 @@ class TFCluster:
         self._ingest_shards: dict[int, list[Any]] | None = None  # guarded-by: self._ingest_lock
         self._ingest_complete = False  # guarded-by: self._ingest_lock
         self._ingest_republished = False  # guarded-by: self._ingest_lock
+        # Driver-pushed feed knobs (autotune): monotonically increasing
+        # publication seq — consumers adopt each publication once.
+        self._feed_knob_seq = 0  # guarded-by: self._ingest_lock
         # -- cluster observability plane (obs.cluster; docs/OBSERVABILITY.md)
         # Liveness surfaced in the registry: per-executor heartbeat age
         # as a render-time collector (PR 4's plane was invisible to
@@ -963,6 +966,46 @@ class TFCluster:
             len(workers),
             epoch,
             ", complete" if complete else "",
+        )
+        return failed
+
+    def publish_feed_knobs(self, **knobs: Any) -> list[int]:
+        """Driver-side autotune actuation for NODE-side feed knobs
+        (currently ``publish_blocks``): re-publish the tuned values to
+        every live worker's manager KV under a fresh monotonically
+        increasing seq. Each node's ``IngestFeed`` polls the key at
+        block boundaries and adopts a publication exactly once — a
+        controller revert is simply the next publication. Best-effort
+        like the plan republish: returns the executor ids whose
+        publish failed (the next publication covers them)."""
+        if not knobs:
+            raise ValueError("publish_feed_knobs: no knobs given")
+        with self._ingest_lock:
+            self._feed_knob_seq += 1
+            seq = self._feed_knob_seq
+        dead = set(self.dead_nodes())
+        failed: list[int] = []
+        for w in self.workers:
+            eid = w["executor_id"]
+            if eid in dead:
+                continue
+            try:
+                tfnode_runtime.publish_feed_knobs(
+                    tfnode_runtime.connect_manager(w), knobs, seq=seq
+                )
+            except (ConnectionError, OSError, EOFError) as e:
+                failed.append(eid)
+                logger.warning(
+                    "feed knobs publish to node %s failed (%s) — the "
+                    "next publication covers it",
+                    eid,
+                    e,
+                )
+        logger.info(
+            "feed knobs published (seq %d): %s%s",
+            seq,
+            knobs,
+            f"; failed for {failed}" if failed else "",
         )
         return failed
 

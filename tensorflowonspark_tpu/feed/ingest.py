@@ -137,6 +137,11 @@ def metrics() -> dict[str, Any]:
                         "replay-cursor publications to the driver KV, "
                         "by kind",
                     ),
+                    "cursor_publish_s": r.histogram(
+                        "ingest_cursor_publish_seconds",
+                        "wall seconds per replay-cursor publication "
+                        "(the autotune publish_blocks overhead signal)",
+                    ),
                 }
     return _metrics
 
@@ -359,6 +364,7 @@ class IngestFeed:
         epoch_watch: Callable[[], int] | None = None,
         publish_blocks: int = 32,
         adopt_timeout: float = 120.0,
+        knob_fetch: Callable[[], dict | None] | None = None,
     ):
         """``plan_fetch`` / ``cursor_publish`` / ``epoch_watch`` arm the
         live-shard-redistribution protocol (all three together — wired
@@ -420,9 +426,15 @@ class IngestFeed:
             plan_fetch is not None
             and epoch_watch is not None
         )
-        self._publish_blocks = max(1, int(publish_blocks))
+        self._publish_blocks = max(1, int(publish_blocks))  # guarded-by: self._cursor_lock
         self._adopt_timeout = float(adopt_timeout)
         self._blocks_since_publish = 0  # guarded-by: self._cursor_lock
+        # Driver-pushed feed knobs (autotune): a driver-side controller
+        # re-publishes {seq, knobs} to the KV; this feed polls at block
+        # boundaries (time-gated) and adopts monotonically by seq.
+        self._knob_fetch = knob_fetch
+        self._knob_seq = -1  # last adopted knob publication seq
+        self._knob_poll_ts = 0.0  # consumer-thread-only time gate
         self._terminated = False
         self._complete = False
         if self._handover:
@@ -536,8 +548,14 @@ class IngestFeed:
             "frame_blocks": False if self._user_reader is not None else None,
         }
         try:
+            t0 = time.perf_counter()
             self._cursor_publish(payload)
-            metrics()["cursor_publishes"].inc(kind=kind)
+            met = metrics()
+            met["cursor_publishes"].inc(kind=kind)
+            # measured per-publication cost: the autotune
+            # publish_blocks policy trades this overhead against the
+            # crash-replay duplicate bound
+            met["cursor_publish_s"].observe(time.perf_counter() - t0)
         except Exception as e:  # noqa: BLE001 - best-effort by contract
             logger.warning(
                 "ingest: cursor publication failed (%s) — the driver "
@@ -750,6 +768,62 @@ class IngestFeed:
                 publish = True
         if publish:
             self._publish_cursor(final=False, kind="periodic")
+        self._maybe_adopt_knobs()
+
+    def set_publish_blocks(self, blocks: int) -> int:
+        """Live-set the cursor-publication interval (the autotune
+        actuation path for the ``ingest.publish_blocks`` knob): how
+        many fully consumed blocks between periodic replay-cursor
+        publications — the knob trading publication RPC overhead
+        against the crash-handover duplicate bound. Returns the value
+        in effect."""
+        blocks = max(1, int(blocks))
+        with self._cursor_lock:
+            self._publish_blocks = blocks
+        return blocks
+
+    def publish_blocks(self) -> int:
+        """The cursor-publication interval in effect (knob readback)."""
+        with self._cursor_lock:
+            return self._publish_blocks
+
+    def _maybe_adopt_knobs(self, now: float | None = None) -> None:
+        """Consumer thread, outside the cursor lock: poll the driver's
+        feed-knob publication (time-gated — at most one KV read every
+        few seconds regardless of batch rate) and adopt it
+        monotonically by seq. Best-effort like the cursor beat: a
+        failed fetch warns once per poll and keeps the current knobs."""
+        if self._knob_fetch is None:
+            return
+        if now is None:
+            now = time.monotonic()
+        if now - self._knob_poll_ts < 5.0:
+            return
+        self._knob_poll_ts = now
+        try:
+            pub = self._knob_fetch()
+        except Exception as e:  # noqa: BLE001 - best-effort by contract
+            logger.warning(
+                "ingest: feed-knob fetch failed (%s) — keeping the "
+                "current knobs",
+                e,
+            )
+            return
+        if not pub:
+            return
+        seq = int(pub.get("seq", 0))
+        if seq <= self._knob_seq:
+            return  # already adopted (or a stale republish)
+        self._knob_seq = seq
+        knobs = pub.get("knobs") or {}
+        if "publish_blocks" in knobs:
+            self.set_publish_blocks(int(knobs["publish_blocks"]))
+            logger.info(
+                "ingest: adopted driver feed knobs seq=%d "
+                "(publish_blocks=%d)",
+                seq,
+                self.publish_blocks(),
+            )
 
     def should_stop(self) -> bool:
         """True once the shard is exhausted AND every buffered record

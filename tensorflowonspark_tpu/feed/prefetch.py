@@ -39,6 +39,43 @@ logger = logging.getLogger(__name__)
 _DONE = object()
 
 
+# -- obs ---------------------------------------------------------------------
+
+_metrics_lock = threading.Lock()
+_metrics: dict[str, Any] | None = None
+
+
+def metrics() -> dict[str, Any]:
+    """Consumer-side feed counters in the process-global obs registry.
+    The ``feed.data_wait`` span already narrates per-wait timing into
+    the trace plane, but spans do not land in the metrics registry —
+    and the autotune prefetch-depth policy needs a *windowed* wait
+    share (``History.delta_sum`` over ``feed_data_wait_seconds``) plus
+    a delivered-batches throughput objective (``feed_batches_total``)
+    to decide grow-vs-shrink. Registered lazily so merely importing the
+    feed package never touches the registry."""
+    global _metrics
+    if _metrics is None:
+        with _metrics_lock:
+            if _metrics is None:
+                from tensorflowonspark_tpu.obs.registry import default_registry
+
+                r = default_registry()
+                _metrics = {
+                    "data_wait_s": r.histogram(
+                        "feed_data_wait_seconds",
+                        "seconds the training loop blocked waiting for "
+                        "the next device batch",
+                    ),
+                    "batches": r.counter(
+                        "feed_batches_total",
+                        "device batches delivered to the training loop "
+                        "by DevicePrefetcher",
+                    ),
+                }
+    return _metrics
+
+
 class _StagingPool:
     """Rotating host staging buffers for the producer thread.
 
@@ -62,6 +99,18 @@ class _StagingPool:
         self._inflight: list[Any] = [None] * max(1, slots)
         self._i = 0
         self._staged_i: int | None = None
+
+    def ensure(self, slots: int) -> None:
+        """Grow the pool (never shrink: a retired slot's buffer may
+        still back an enqueued batch). Called from the producer thread
+        between batches when a live ``set_depth`` widened the window
+        past the pool built at construction — without this, a deeper
+        queue would let ``stage`` rewrite a host buffer whose batch is
+        still waiting to be consumed."""
+        extra = int(slots) - len(self._slots)
+        if extra > 0:
+            self._slots.extend([None] * extra)
+            self._inflight.extend([None] * extra)
 
     def stage(self, batch):
         if not isinstance(batch, dict):
@@ -133,6 +182,7 @@ class DevicePrefetcher:
         # via stats() — the "is the input plane keeping up" numbers next
         # to the feed.transfer/feed.data_wait spans.
         self._lock = threading.Lock()
+        self._prefetch_depth = max(1, int(depth))  # guarded-by: self._lock
         self._transferred = 0  # guarded-by: self._lock
         self._transfer_s = 0.0  # guarded-by: self._lock
         self._thread = threading.Thread(
@@ -189,24 +239,53 @@ class DevicePrefetcher:
             for cols in feed.batch_stream(batch_size, multiple_of, **kwargs):
                 yield cols
 
+        holder: dict = {}  # filled after cls() below; producer-thread read
+
         def stage_and_transfer(cols):
+            pf = holder.get("pf")
+            if pf is not None:
+                # a live set_depth may have widened the window; the
+                # pool must cover queue depth + consumer + staging
+                staging.ensure(pf.stats()["depth"] + 2)
             if prepare is not None:
                 cols = prepare(cols)
             out = transform(staging.stage(cols))
             staging.commit(out)
             return out
 
-        return cls(host_batches(), depth=depth, transform=stage_and_transfer)
+        pf = cls(host_batches(), depth=depth, transform=stage_and_transfer)
+        holder["pf"] = pf
+        return pf
 
     def stats(self) -> dict:
         """Producer-side counters: batches transferred to device and
         total transfer seconds (divide for the mean transfer cost this
-        prefetcher is hiding). Safe from any thread."""
+        prefetcher is hiding), plus the current prefetch depth. Safe
+        from any thread."""
         with self._lock:
             return {
                 "transferred": self._transferred,
                 "transfer_s": self._transfer_s,
+                "depth": self._prefetch_depth,
             }
+
+    def set_depth(self, depth: int) -> int:
+        """Live-resize the prefetch window (the autotune actuation path
+        for the ``feed.prefetch_depth`` knob). ``queue.Queue`` freezes
+        ``maxsize`` at construction but only consults it under its own
+        mutex, so a guarded rewrite plus ``not_full.notify_all()`` is a
+        safe live resize: growing immediately unblocks a producer
+        waiting in ``put``; shrinking takes effect as the consumer
+        drains the (briefly oversized) queue down to the new bound.
+        Returns the depth actually in effect."""
+        depth = max(1, int(depth))
+        q = self._queue
+        with q.mutex:
+            q.maxsize = depth
+            q.not_full.notify_all()
+        with self._lock:
+            self._prefetch_depth = depth
+        return depth
 
     def _run(self, it: Iterator[Any]) -> None:
         try:
@@ -252,13 +331,17 @@ class DevicePrefetcher:
         # data-wait: how long the training loop sat here is THE
         # input-bound-vs-compute-bound discriminator (tf.data's
         # bottleneck analysis asks exactly this question)
+        t0 = time.perf_counter()
         with obs_spans.span("feed.data_wait"):
             batch, err = self._queue.get()
+        m = metrics()
+        m["data_wait_s"].observe(time.perf_counter() - t0)
         if batch is _DONE:
             self._stop.set()
             if err is not None:
                 raise err
             raise StopIteration
+        m["batches"].inc()
         return batch
 
     def close(self) -> bool:

@@ -91,6 +91,12 @@ EVENTS = frozenset(
         # observability plane (obs/slo.py, utils/lockwitness.py)
         "slo_breach",
         "tfsan",
+        # online knob tuning (autotune/ — docs/AUTOTUNE.md): every
+        # controller move, every regression revert, and every freeze
+        # (operator or SLO-breach back-off) is auditable after the fact
+        "autotune_decision",
+        "autotune_revert",
+        "autotune_frozen",
     }
 )
 

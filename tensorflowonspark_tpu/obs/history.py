@@ -308,6 +308,23 @@ class History:
             )
         return float(out)
 
+    def delta_sum(
+        self,
+        name: str,
+        labels: Mapping[str, Any] | str | None = None,
+        window_s: float | None = 60.0,
+        now: float | None = None,
+    ) -> float:
+        """Total increase of a histogram's ``sum`` over the window
+        (seconds spent, bytes moved, ...), summed across matching
+        series — the time-share complement of :meth:`delta`'s count
+        view; autotune overhead hints read this. 0.0 with no points."""
+        out = 0.0
+        for ls in self._matching_keys(name, labels):
+            pts = self._window_points(name, ls, window_s, now)
+            out += sum(e.get("delta_sum", 0.0) for _, e in pts)
+        return float(out)
+
     def _bucket_deltas(
         self, name, labels, window_s, now
     ) -> tuple[list[float], list[float], float] | None:

@@ -16,6 +16,9 @@ is the CLI)::
                               per-request timelines pulled from each
                               ``/debugz`` ring (Chrome-trace JSON)
       flightrec/<name>.json   flight-recorder dumps copied from disk
+      autotune/<name>.json    autotune decision logs copied from disk
+                              (Controller.dump artifacts — every knob
+                              move/revert around the incident)
       merged_trace.json       every trace above — debugz timelines and
                               flightrec span exports — clock-aligned
                               into one timeline via
@@ -78,6 +81,7 @@ def collect_bundle(
     debugz_urls: Iterable[Any] = (),
     flightrec_globs: Sequence[str] = (),
     trace_files: Sequence[str] = (),
+    autotune_globs: Sequence[str] = (),
     timeout: float = 5.0,
 ) -> dict[str, Any]:
     """Collect one incident bundle under ``out_dir``; returns the
@@ -91,6 +95,7 @@ def collect_bundle(
         "metrics": [],
         "traces": [],
         "flightrec": [],
+        "autotune": [],
         "errors": [],
     }
 
@@ -154,6 +159,20 @@ def collect_bundle(
             except Exception as e:  # noqa: BLE001 - recorded per file
                 _err(path, e)
     mergeable.extend(p for p in (trace_files or ()) if os.path.exists(p))
+
+    # -- autotune decision logs already on disk -----------------------
+    # (Controller.dump artifacts: was the controller moving a knob
+    # right before the incident? The audit trail answers it.)
+    at_dir = os.path.join(out_dir, "autotune")
+    for pattern in autotune_globs or ():
+        for path in sorted(globlib.glob(pattern)):
+            try:
+                os.makedirs(at_dir, exist_ok=True)
+                dst = os.path.join(at_dir, os.path.basename(path))
+                shutil.copyfile(path, dst)
+                manifest["autotune"].append(os.path.basename(path))
+            except Exception as e:  # noqa: BLE001 - recorded per file
+                _err(path, e)
 
     # -- one clock-aligned timeline over everything -------------------
     if mergeable:
@@ -220,15 +239,25 @@ def main(argv: Sequence[str] | None = None) -> int:
         help="extra Chrome-trace file to fold into the merge "
         "(repeatable)",
     )
+    p.add_argument(
+        "--autotune",
+        action="append",
+        default=[],
+        metavar="GLOB",
+        help="autotune decision-log glob (repeatable; default "
+        "logs/autotune-*.json when none given)",
+    )
     p.add_argument("--timeout", type=float, default=5.0)
     args = p.parse_args(argv)
     recs = args.flightrec or ["logs/flightrec-*.json"]
+    at_globs = args.autotune or ["logs/autotune-*.json"]
     manifest = collect_bundle(
         args.out,
         metrics_urls=args.metrics,
         debugz_urls=args.debugz,
         flightrec_globs=recs,
         trace_files=args.trace,
+        autotune_globs=at_globs,
         timeout=args.timeout,
     )
     print(
@@ -238,6 +267,7 @@ def main(argv: Sequence[str] | None = None) -> int:
                 "metrics": len(manifest["metrics"]),
                 "traces": len(manifest["traces"]),
                 "flightrec": len(manifest["flightrec"]),
+                "autotune": len(manifest["autotune"]),
                 "errors": len(manifest["errors"]),
                 "merged": "merged_trace" in manifest,
             }

@@ -491,6 +491,20 @@ class _SwapRequest:
     error: BaseException | None = None  # set if the swap was aborted
 
 
+@dataclasses.dataclass
+class _KnobRequest:
+    """A validated scheduler-knob change (``decode_block`` /
+    ``pipeline_depth``) waiting for the scheduler to install between
+    decode blocks — the same discipline as a weight swap (see
+    ``set_knobs``): the loop owns both knobs, so a caller-thread
+    mutation would race the dispatch/fetch bookkeeping."""
+
+    decode_block: int | None
+    pipeline_depth: int | None
+    event: threading.Event
+    error: BaseException | None = None  # set if the change was aborted
+
+
 class _PrefixStore:
     """LRU of prompt→single-row-KV-cache entries for prefix reuse.
 
@@ -789,6 +803,9 @@ class ContinuousBatcher:
         self._weights_version = str(weights_version)
         self._weights_swaps = 0  # applied swaps (scheduler-thread-owned)
         self._pending_swap: _SwapRequest | None = None  # guarded-by: self._submit_lock
+        # Live scheduler-knob change (autotune actuation path), applied
+        # between decode blocks exactly like a pending weight swap.
+        self._pending_knobs: _KnobRequest | None = None  # guarded-by: self._submit_lock
         # True only while warmup() runs its throwaway requests: a fresh
         # replica compiling is ALIVE but not READY — health probers
         # must see the difference (a warmup stall otherwise looks
@@ -1731,6 +1748,117 @@ class ContinuousBatcher:
             req, self._pending_swap = self._pending_swap, None
         if req is not None:
             req.error = RuntimeError(f"weight swap aborted: {err}")
+            req.event.set()
+
+    # -- live scheduler knobs (autotune actuation) --------------------
+
+    def set_knobs(
+        self,
+        *,
+        decode_block: int | None = None,
+        pipeline_depth: int | None = None,
+        timeout: float = 30.0,
+    ) -> dict:
+        """Change ``decode_block`` and/or ``pipeline_depth`` on a RUNNING
+        engine — the autotune actuation path for the engine knobs.
+
+        Both knobs are owned by the scheduler thread (``decode_block``
+        picks the compiled block program each iteration;
+        ``pipeline_depth`` bounds the dispatch-ahead window), so the
+        change is staged here and installed by the scheduler between
+        decode blocks, exactly like :meth:`swap_weights`: the install
+        drains the in-flight window first (a depth shrink under
+        dispatched-but-unfetched blocks would corrupt the window
+        accounting), then rebinds — a new ``decode_block`` compiles its
+        block program lazily at first use (``_block_cache``). Returns
+        the knob values actually in effect after the install.
+        """
+        if decode_block is None and pipeline_depth is None:
+            return {
+                "decode_block": self._decode_block,
+                "pipeline_depth": self._pipeline_depth,
+            }
+        if decode_block is not None and int(decode_block) < 1:
+            raise ValueError(
+                f"decode_block must be >= 1, got {decode_block}"
+            )
+        if pipeline_depth is not None and int(pipeline_depth) < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {pipeline_depth}"
+            )
+        req = _KnobRequest(
+            decode_block=(
+                None if decode_block is None else int(decode_block)
+            ),
+            pipeline_depth=(
+                None if pipeline_depth is None else int(pipeline_depth)
+            ),
+            event=threading.Event(),
+        )
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("engine shutting down")
+            if self._pending_knobs is not None:
+                raise RuntimeError("a knob change is already pending")
+            self._pending_knobs = req
+        self._queue.put(self._WAKE)  # an idle scheduler must notice
+        if not req.event.wait(timeout):
+            with self._submit_lock:
+                if self._pending_knobs is req:
+                    self._pending_knobs = None
+                    raise TimeoutError(
+                        f"knob change not applied within {timeout}s "
+                        "(scheduler busy or wedged)"
+                    )
+            # the scheduler claimed it just as we timed out: the
+            # install is in flight — wait it out briefly
+            req.event.wait(10.0)
+        if not req.event.is_set():
+            raise TimeoutError(
+                f"knob change not applied within {timeout}s"
+            )
+        if req.error is not None:
+            raise req.error
+        return {
+            "decode_block": self._decode_block,
+            "pipeline_depth": self._pipeline_depth,
+        }
+
+    def _apply_pending_knobs(self) -> None:
+        """Scheduler thread: install a staged knob change between decode
+        blocks. The caller (``_loop``) rebinds its local ``depth``
+        immediately after — it snapshots ``_pipeline_depth`` once at
+        loop entry."""
+        with self._submit_lock:
+            req, self._pending_knobs = self._pending_knobs, None
+        if req is None:
+            return
+        # in-flight blocks were dispatched under the old knobs — sweep
+        # them out so the window restarts under the new depth/block
+        self._drain_window("knobs")
+        if req.decode_block is not None:
+            self._decode_block = max(1, int(req.decode_block))
+        if req.pipeline_depth is not None:
+            self._pipeline_depth = max(1, int(req.pipeline_depth))
+        reqtrace.mark(
+            "engine.knobs",
+            decode_block=self._decode_block,
+            pipeline_depth=self._pipeline_depth,
+        )
+        req.event.set()
+        logger.info(
+            "engine knobs applied: decode_block=%d pipeline_depth=%d",
+            self._decode_block,
+            self._pipeline_depth,
+        )
+
+    def _abort_pending_knobs(self, err: BaseException) -> None:
+        """Fail a waiting knob change when the scheduler exits before
+        applying it — its caller must not hang."""
+        with self._submit_lock:
+            req, self._pending_knobs = self._pending_knobs, None
+        if req is not None:
+            req.error = RuntimeError(f"knob change aborted: {err}")
             req.event.set()
 
     @contextlib.contextmanager
@@ -2993,6 +3121,7 @@ class ContinuousBatcher:
                         self._fail_one(self._job.p, err)
                         self._job = None
                     self._abort_pending_swap(err)
+                    self._abort_pending_knobs(err)
                     self._fail_all(err)
                     return
                 if (
@@ -3003,6 +3132,14 @@ class ContinuousBatcher:
                     # (a prompt half-prefilled under two weight versions
                     # would hold internally inconsistent K/V)
                     self._apply_pending_swap()
+                if (
+                    self._pending_knobs is not None  # lint: lockfree-read: claim is re-checked under _submit_lock in _apply_pending_knobs; a stale None only delays the install one iteration
+                    and self._job is None
+                ):
+                    # knob installs follow the weight-swap discipline:
+                    # between decode blocks, never mid-chunked-prefill
+                    self._apply_pending_knobs()
+                    depth = self._pipeline_depth  # rebind loop snapshot
                 if self._window and all(e is None for e in self._live):
                     # every row retired mid-window: the remaining
                     # in-flight blocks hold only discards — drop them
@@ -3050,6 +3187,7 @@ class ContinuousBatcher:
                         self._pending_first.clear()
                         err = RuntimeError("engine shutting down")
                         self._abort_pending_swap(err)
+                        self._abort_pending_knobs(err)
                         self._fail_all(err)
                         return
                     if item is self._WAKE:
@@ -3206,6 +3344,7 @@ class ContinuousBatcher:
                 self._fail_one(self._job.p, e)
                 self._job = None
             self._abort_pending_swap(e)
+            self._abort_pending_knobs(e)
             self._fail_all(e)
         finally:
             # Wind down the delivery thread once the scheduler is done:

@@ -187,6 +187,10 @@ class FleetRouter:
         self._affinity = _AffinityIndex(affinity_capacity)  # guarded-by: self._lock
         self._outstanding: dict[int, int] = {}  # guarded-by: self._lock
         self._est_req_s: dict[int, float] = {}  # guarded-by: self._lock
+        # Measured fleet-wide service-time seed (history percentile or
+        # an autotune install); beats the ctor's hardcoded hint in the
+        # cold-start estimate chain — see _wait_estimate.
+        self._seed_est_s: float | None = None  # guarded-by: self._lock
         self._shed_counts: dict[str, int] = {}  # guarded-by: self._lock
         self._failovers = 0  # guarded-by: self._lock
         self._affinity_hits = 0  # guarded-by: self._lock
@@ -239,6 +243,54 @@ class FleetRouter:
             self._affinity.drop_replica(rid)
             self._est_req_s.pop(rid, None)
 
+    # -- service estimate (autotune actuation / cold-start seed) ------
+
+    def set_service_estimate(self, seconds: float) -> float:
+        """Install a measured fleet-wide service-time seed — the
+        autotune actuation path for the ``router.service_estimate_s``
+        knob. It replaces the ctor's ``service_time_hint_s`` guess in
+        the admission estimate chain for replicas with no per-replica
+        EWMA yet; replicas with observed completions keep their own
+        EWMAs (this is a cold-start floor, not an override)."""
+        v = float(seconds)
+        if v <= 0:
+            raise ValueError(
+                f"service estimate must be > 0 seconds, got {seconds}"
+            )
+        with self._lock:
+            self._seed_est_s = v
+        return v
+
+    def service_estimate(self) -> float:
+        """The cold-start service estimate currently in effect (the
+        knob readback): the measured seed when installed, else the
+        ctor hint, else 0.0 (no estimate — admission can't judge)."""
+        with self._lock:
+            return self._seed_est_s or self._service_time_hint or 0.0
+
+    def seed_from_history(
+        self,
+        history,
+        *,
+        metric: str = "router_request_seconds",
+        q: float = 0.9,
+        window_s: float = 60.0,
+        now: float | None = None,
+    ) -> float | None:
+        """Seed the admission estimate from the measured duration
+        distribution: the ``q``-quantile of the request-latency
+        histogram over the trailing window, when one exists. Returns
+        the installed seed, or None (no in-window signal — the chain
+        keeps its current fallbacks). The percentile scan runs OUTSIDE
+        ``self._lock`` (History takes its own lock; nothing blocking
+        runs under ours)."""
+        est = history.percentile(metric, q, window_s=window_s, now=now)
+        if est is None or est <= 0.0:
+            return None
+        with self._lock:
+            self._seed_est_s = float(est)
+        return float(est)
+
     # -- placement / admission ----------------------------------------
 
     @staticmethod
@@ -254,10 +306,19 @@ class FleetRouter:
     def _wait_estimate(self, view: dict, outstanding: int) -> float:  # lint: holds-lock
         """Expected completion latency of a NEW request on this
         replica, from queue-depth + an EWMA of observed request
-        durations (``service_time_hint_s`` seeds it before any
-        completion). 0.0 = no estimate yet — admit (can't judge).
-        Callers hold ``self._lock``."""
-        rate = self._est_req_s.get(view["rid"]) or self._service_time_hint
+        durations. Before any completion lands on a replica the chain
+        falls back to the MEASURED fleet-wide seed (history percentile
+        via ``seed_from_history`` / ``set_service_estimate``) and only
+        then to the ctor's hardcoded ``service_time_hint_s`` guess —
+        a stale pessimistic hint otherwise sheds feasible requests on
+        every cold start (fresh replica, respawn, or router restart).
+        0.0 = no estimate yet — admit (can't judge). Callers hold
+        ``self._lock``."""
+        rate = (
+            self._est_req_s.get(view["rid"])
+            or self._seed_est_s
+            or self._service_time_hint
+        )
         if not rate:
             return 0.0
         st = view["stats"] or {}

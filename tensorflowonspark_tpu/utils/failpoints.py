@@ -127,6 +127,11 @@ SITES = frozenset(
         "elastic.epoch_bump",  # driver, before publishing a new epoch
         "elastic.reshard_gather",  # node, gathering state to host memory
         "elastic.rejoin_init",  # joining node, before peer/ckpt hydration
+        # online knob tuning (autotune/registry.py — docs/AUTOTUNE.md)
+        "autotune.apply",  # KnobRegistry.set, before the actuation
+        # callback ("drop" aware: a lost apply leaves the knob at its
+        # readback value — the controller observes no movement and
+        # reverts cleanly; the registry never wedges)
     }
 )
 

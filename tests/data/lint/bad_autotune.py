@@ -1,0 +1,57 @@
+"""Fixture: seeded AT001 violations — ad-hoc mutation of tunable knob
+attributes outside their sanctioned actuation paths (the untracked
+writes that silently invalidate the autotune controller's
+baseline/revert bookkeeping) — plus CLEAN cases: sanctioned scopes
+named by the registry, a justified ``# lint: knob-ok`` escape, and a
+non-tunable attribute the rule must ignore."""
+
+
+class _FakeEngine:
+    pass
+
+
+def poke_engine(eng: _FakeEngine) -> None:
+    eng._decode_block = 8  # SEEDED VIOLATION AT001: ad-hoc knob write
+
+
+def poke_prefetcher(pf) -> None:
+    pf._prefetch_depth += 1  # SEEDED VIOLATION AT001: aug-assign write
+
+
+def poke_unjustified(feed) -> None:
+    # SEEDED VIOLATION AT001: the escape below has no justification
+    feed._publish_blocks = 4  # lint: knob-ok:
+
+
+def poke_justified(router) -> None:
+    # justified escape: must NOT be flagged
+    router._service_time_hint = 0.5  # lint: knob-ok: test harness pins the hint before any controller exists
+
+
+def poke_untracked(eng) -> None:
+    # not a tunable attribute name: must NOT be flagged
+    eng._decode_blocks = 8
+
+
+class ContinuousBatcher:
+    """Sanctioned scopes (registry SANCTIONED names this class.method):
+    must NOT be flagged."""
+
+    def __init__(self, decode_block: int = 4):
+        self._decode_block = decode_block
+        self._pipeline_depth = 2
+
+    def _apply_pending_knobs(self) -> None:
+        self._decode_block = 8
+        self._pipeline_depth = 1
+
+    def not_sanctioned(self) -> None:
+        self._pipeline_depth = 3  # SEEDED VIOLATION AT001: wrong method
+
+
+class DevicePrefetcher:
+    def __init__(self, depth: int = 2):
+        self._prefetch_depth = depth  # sanctioned ctor: must NOT flag
+
+    def set_depth(self, depth: int) -> None:
+        self._prefetch_depth = depth  # sanctioned setter: must NOT flag

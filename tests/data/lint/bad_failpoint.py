@@ -64,6 +64,15 @@ def rollout_typo_site():
     failpoint("rollout.swpa")  # SEEDED VIOLATION FP001: unregistered
 
 
+def autotune_typo_site():
+    failpoint("autotune.aply")  # SEEDED VIOLATION FP001: unregistered
+
+
+def autotune_clean_site():
+    # registered knob-tuning site: must NOT be flagged
+    failpoint("autotune.apply")
+
+
 def rollout_clean_sites():
     # registered weight-rollout sites: must NOT be flagged
     failpoint("rollout.publish")

@@ -1,0 +1,671 @@
+"""tfos.autotune: knob registry, hill-climb controller, policies.
+
+Tier-1 tests drive the controller against scripted in-memory knobs and
+objective functions (deterministic, no compiles): climb-to-peak with
+revert-on-overshoot, hysteresis plateaus, cooldown, freeze, the SLO
+back-off latch, the ``autotune.apply`` drop failpoint (both a lost
+forward apply and a lost revert), the ``TFOS_AUTOTUNE=0`` kill switch
+(including its micro-benched cost bound), and the two live actuation
+paths that need no model: ``DevicePrefetcher.set_depth`` and the
+router's measured cold-start seed (``seed_from_history``).
+"""
+
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu.autotune import (
+    Controller,
+    Knob,
+    KnobRegistry,
+    Policy,
+)
+from tensorflowonspark_tpu.autotune.registry import enabled
+from tensorflowonspark_tpu.obs import flightrec
+from tensorflowonspark_tpu.obs.history import History
+from tensorflowonspark_tpu.obs.registry import Registry
+from tensorflowonspark_tpu.utils import failpoints
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    rec = flightrec.install(str(tmp_path / "rec.json"), process="t")
+    yield rec
+    rec.stop()
+    with flightrec._install_lock:
+        flightrec._recorder = None
+
+
+def _mem_knob(name="k", lo=1.0, hi=10.0, step=1.0, start=4.0, **kw):
+    """An in-memory knob: apply writes a box, get reads it back."""
+    box = {"v": float(start), "applies": 0}
+
+    def apply(v):
+        box["v"] = float(v)
+        box["applies"] += 1
+
+    return Knob(
+        name=name, lo=lo, hi=hi, step=step, apply=apply,
+        get=lambda: box["v"], **kw,
+    ), box
+
+
+def _controller(policies, knobs, **kw):
+    kw.setdefault("metrics_registry", Registry())
+    return Controller(knobs, History(source="t"), list(policies), **kw)
+
+
+def _objective(fn, box):
+    """Scripted objective: score is a pure function of the knob value
+    (the history/now args are ignored — the physics live in ``fn``)."""
+    return lambda hist, now: fn(box["v"])
+
+
+# -- Knob / KnobRegistry ----------------------------------------------------
+
+
+def test_clamp_snaps_to_grid_and_bounds():
+    k, _ = _mem_knob(lo=1.0, hi=9.0, step=2.0)
+    assert k.clamp(6.2) == 7.0  # grid anchored at lo: 1,3,5,7,9
+    assert k.clamp(100.0) == 9.0
+    assert k.clamp(-5.0) == 1.0
+    k2, _ = _mem_knob(name="f", lo=0.0, hi=1.0, step=0.25, integer=False)
+    assert k2.clamp(0.6) == 0.5
+    with pytest.raises(ValueError):
+        Knob(name="bad", lo=2.0, hi=1.0, step=1.0, apply=lambda v: None)
+    with pytest.raises(ValueError):
+        Knob(name="bad", lo=0.0, hi=1.0, step=0.0, apply=lambda v: None)
+
+
+def test_registry_set_readback_and_duplicate():
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=4.0)
+    reg.register(knob)
+    assert reg.current("k") == 4.0
+    assert reg.set("k", 6.4) == 6.0  # clamped to the step grid
+    assert box["v"] == 6.0
+    # readback is the source of truth: a component-side change (e.g. a
+    # validation floor inside the actuation method) wins over bookkeeping
+    box["v"] = 5.0
+    assert reg.current("k") == 5.0
+    with pytest.raises(ValueError):
+        reg.register(knob)
+    with pytest.raises(KeyError):
+        reg.set("nope", 1.0)
+
+
+def test_registry_freeze_blocks_the_mutation_path():
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=4.0)
+    reg.register(knob)
+    reg.freeze("k", reason="operator")
+    assert reg.frozen("k") == "operator"
+    assert reg.all_frozen()
+    assert reg.set("k", 9.0) == 4.0  # frozen: no actuation
+    assert box["applies"] == 0
+    assert reg.snapshot()["k"]["frozen"] == "operator"
+    reg.unfreeze("k")
+    assert reg.frozen("k") is None
+    assert reg.set("k", 9.0) == 9.0
+
+
+def test_registry_dropped_apply_stays_truthful():
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=4.0)
+    reg.register(knob)
+    failpoints.arm("autotune.apply", "drop", count=1)
+    assert reg.set("k", 7.0) == 4.0  # nothing actuated, no lie
+    assert box["applies"] == 0
+    assert reg.set("k", 7.0) == 7.0  # failpoint exhausted
+
+
+# -- the hill-climb loop ----------------------------------------------------
+
+
+def test_climb_converges_to_interior_peak_and_reverts_overshoot(recorder):
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=4.0)
+    reg.register(knob)
+    metrics = Registry()
+    ctrl = _controller(
+        [Policy(knob="k", objective=_objective(lambda v: -((v - 7.0) ** 2), box))],
+        reg,
+        metrics_registry=metrics,
+    )
+    for i in range(14):
+        ctrl.step(now=float(i))
+    # peak at 7: climbed 4->7, overshoot to 8 judged as regression
+    assert box["v"] == 7.0
+    log = ctrl.decision_log()
+    actions = [r["action"] for r in log]
+    assert "move" in actions and "accept" in actions and "revert" in actions
+    reverts = [r for r in log if r["action"] == "revert"]
+    assert reverts[0]["reason"] == "regression"
+    assert reverts[0]["undone"] == 8.0 and reverts[0]["value"] == 7.0
+    assert metrics.counter("autotune_reverts_total").value(knob="k") >= 1
+    assert metrics.counter("autotune_decisions_total").value(
+        knob="k", direction="up"
+    ) >= 3
+    assert metrics.gauge("autotune_knob_value").value(knob="k") == 7.0
+    # every move/revert is on the flight record
+    kinds = [e["kind"] for e in recorder.snapshot("t")["events"]]
+    assert "autotune_decision" in kinds and "autotune_revert" in kinds
+
+
+def test_cooldown_after_revert_sits_out_windows():
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=7.0)
+    reg.register(knob)
+    # any move off 7 regresses -> the first judged move reverts
+    ctrl = _controller(
+        [Policy(knob="k", objective=_objective(lambda v: -abs(v - 7.0), box))],
+        reg,
+    )
+    ctrl.step(now=0.0)  # move 7 -> 8
+    rows = ctrl.step(now=1.0)  # judged: regression -> revert, cooldown=2
+    assert [r["action"] for r in rows] == ["revert"]
+    assert ctrl.step(now=2.0) == []  # cooldown window 1
+    assert ctrl.step(now=3.0) == []  # cooldown window 2
+    rows = ctrl.step(now=4.0)  # eligible again (flipped direction)
+    assert [r["action"] for r in rows] == ["move"]
+    assert rows[0]["direction"] == "down"
+
+
+def test_plateau_inside_band_accepts_without_reverting():
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=4.0)
+    reg.register(knob)
+    ctrl = _controller(
+        [Policy(knob="k", objective=_objective(lambda v: 100.0, box))], reg
+    )
+    for i in range(8):
+        ctrl.step(now=float(i))
+    log = ctrl.decision_log()
+    accepts = [r for r in log if r["action"] == "accept"]
+    assert accepts and all(r["momentum"] is False for r in accepts)
+    assert not any(r["action"] == "revert" for r in log)
+
+
+def test_hint_biases_direction():
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=5.0)
+    reg.register(knob)
+    ctrl = _controller(
+        [
+            Policy(
+                knob="k",
+                objective=_objective(lambda v: 100.0, box),
+                hint=lambda hist, now: -1,
+            )
+        ],
+        reg,
+    )
+    rows = ctrl.step(now=0.0)
+    assert rows[0]["direction"] == "down" and box["v"] == 4.0
+
+
+def test_frozen_knob_is_skipped_until_unfrozen():
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=4.0)
+    reg.register(knob)
+    reg.freeze("k", reason="incident")
+    ctrl = _controller(
+        [Policy(knob="k", objective=_objective(lambda v: v, box))], reg
+    )
+    assert ctrl.step(now=0.0) == []
+    assert box["v"] == 4.0
+    reg.unfreeze("k")
+    assert [r["action"] for r in ctrl.step(now=1.0)] == ["move"]
+
+
+def test_direct_policy_applies_target_without_verdict():
+    reg = KnobRegistry()
+    knob, box = _mem_knob(
+        name="est", lo=0.0, hi=10.0, step=0.05, start=5.0, integer=False
+    )
+    reg.register(knob)
+    ctrl = _controller(
+        [Policy(knob="est", target=lambda hist, now: 0.5)], reg
+    )
+    rows = ctrl.step(now=0.0)
+    assert rows[0]["mode"] == "direct" and box["v"] == 0.5
+    # converged: within one step of the target -> no further rows
+    assert ctrl.step(now=1.0) == []
+
+
+def test_policy_requires_exactly_one_mode():
+    with pytest.raises(ValueError):
+        Policy(knob="k")
+    with pytest.raises(ValueError):
+        Policy(
+            knob="k",
+            objective=lambda h, n: 0.0,
+            target=lambda h, n: 0.0,
+        )
+
+
+def test_no_signal_patience_reverts():
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=4.0)
+    reg.register(knob)
+    ctrl = _controller(
+        [
+            Policy(
+                knob="k",
+                objective=lambda hist, now: None,
+                max_pending_windows=2,
+            )
+        ],
+        reg,
+    )
+    ctrl.step(now=0.0)  # move on cold start (no baseline needed)
+    assert ctrl.step(now=1.0) == []  # patience 1
+    assert ctrl.step(now=2.0) == []  # patience 2
+    rows = ctrl.step(now=3.0)  # signal died: treat the move as failed
+    assert [r["action"] for r in rows] == ["revert"]
+    assert rows[0]["reason"] == "no_signal" and box["v"] == 4.0
+
+
+# -- SLO back-off ------------------------------------------------------------
+
+
+class _FakeSLO:
+    def __init__(self):
+        self.breach: list = []
+
+    def breaching(self):
+        return list(self.breach)
+
+
+def test_slo_breach_freezes_moves_and_reverts_pending(recorder):
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=4.0)
+    reg.register(knob)
+    slo = _FakeSLO()
+    ctrl = _controller(
+        [Policy(knob="k", objective=_objective(lambda v: v, box))],
+        reg,
+        slo=slo,
+    )
+    ctrl.step(now=0.0)  # move 4 -> 5, pending
+    slo.breach = ["router_latency_p99"]
+    rows = ctrl.step(now=1.0)
+    actions = [r["action"] for r in rows]
+    # rising edge: one backoff row, and the unjudged move is undone
+    assert actions == ["backoff", "revert"]
+    assert rows[1]["reason"] == "slo_breach" and box["v"] == 4.0
+    assert ctrl.step(now=2.0) == []  # still breaching: no rows, no moves
+    assert box["v"] == 4.0
+    slo.breach = []
+    rows = ctrl.step(now=3.0)
+    assert [r["action"] for r in rows] == ["resume"]
+    ctrl.step(now=4.0)  # the breach-revert left the knob on cooldown
+    rows = ctrl.step(now=5.0)
+    assert [r["action"] for r in rows] == ["move"]  # tuning resumes
+    kinds = [e["kind"] for e in recorder.snapshot("t")["events"]]
+    assert "autotune_frozen" in kinds
+
+
+def test_broken_slo_evaluator_fails_open():
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=4.0)
+    reg.register(knob)
+
+    class _Broken:
+        def breaching(self):
+            raise RuntimeError("evaluator died")
+
+    ctrl = _controller(
+        [Policy(knob="k", objective=_objective(lambda v: v, box))],
+        reg,
+        slo=_Broken(),
+    )
+    assert [r["action"] for r in ctrl.step(now=0.0)] == ["move"]
+
+
+# -- chaos: the lost apply ---------------------------------------------------
+
+
+def test_dropped_forward_apply_means_no_pending_move():
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=4.0)
+    reg.register(knob)
+    metrics = Registry()
+    ctrl = _controller(
+        [Policy(knob="k", objective=_objective(lambda v: v, box))],
+        reg,
+        metrics_registry=metrics,
+    )
+    failpoints.arm("autotune.apply", "drop", count=1)
+    assert ctrl.step(now=0.0) == []  # apply lost: nothing moved,
+    assert box["v"] == 4.0  # nothing pending, no decision recorded
+    assert metrics.counter("autotune_decisions_total").value(
+        knob="k", direction="up"
+    ) == 0
+    rows = ctrl.step(now=1.0)  # failpoint exhausted: tuning resumes
+    assert [r["action"] for r in rows] == ["move"] and box["v"] == 5.0
+
+
+def test_dropped_revert_apply_keeps_registry_truthful():
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=7.0)
+    reg.register(knob)
+    ctrl = _controller(
+        [Policy(knob="k", objective=_objective(lambda v: -abs(v - 7.0), box))],
+        reg,
+    )
+    ctrl.step(now=0.0)  # move 7 -> 8 (will regress)
+    failpoints.arm("autotune.apply", "drop", count=1)
+    rows = ctrl.step(now=1.0)  # revert's apply is LOST
+    assert [r["action"] for r in rows] == ["revert"]
+    # the row records the READBACK (still 8): the registry never claims
+    # a revert that did not actuate
+    assert rows[0]["value"] == 8.0 and box["v"] == 8.0
+    # after cooldown the controller moves again from the true value
+    ctrl.step(now=2.0)
+    ctrl.step(now=3.0)
+    rows = ctrl.step(now=4.0)
+    assert [r["action"] for r in rows] == ["move"]
+    assert rows[0]["moved_from"] == 8.0
+
+
+# -- kill switch -------------------------------------------------------------
+
+
+def test_kill_switch_disables_every_move(monkeypatch):
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=4.0)
+    reg.register(knob)
+    ctrl = _controller(
+        [Policy(knob="k", objective=_objective(lambda v: v, box))], reg
+    )
+    monkeypatch.setenv("TFOS_AUTOTUNE", "0")
+    assert not enabled()
+    for i in range(5):
+        assert ctrl.step(now=float(i)) == []
+    assert box["v"] == 4.0 and box["applies"] == 0
+    monkeypatch.setenv("TFOS_AUTOTUNE", "1")
+    assert enabled()
+    assert [r["action"] for r in ctrl.step(now=9.0)] == ["move"]
+
+
+def test_kill_switch_disabled_path_is_cheap(monkeypatch):
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=4.0)
+    reg.register(knob)
+    ctrl = _controller(
+        [Policy(knob="k", objective=_objective(lambda v: v, box))], reg
+    )
+    monkeypatch.setenv("TFOS_AUTOTUNE", "0")
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ctrl.step(now=0.0)
+    per_step = (time.perf_counter() - t0) / n
+    # one env read + an immediate return: generous CI bound
+    assert per_step < 200e-6, f"disabled step cost {per_step * 1e6:.1f}us"
+
+
+# -- History.delta_sum (the wait-share hint's read) --------------------------
+
+
+def test_history_delta_sum_windows_histogram_time():
+    r = Registry()
+    h = r.histogram("feed_data_wait_seconds", "t")
+    hist = History(source="t")
+    h.observe(0.5)
+    hist.scrape_registry(r, t=100.0)
+    h.observe(0.25)
+    h.observe(0.25)
+    hist.scrape_registry(r, t=110.0)
+    # only the second scrape's delta lands in the (105, 110] window
+    assert hist.delta_sum(
+        "feed_data_wait_seconds", window_s=5.0, now=110.0
+    ) == pytest.approx(0.5)
+    assert hist.delta_sum(
+        "feed_data_wait_seconds", window_s=60.0, now=110.0
+    ) == pytest.approx(1.0)
+
+
+# -- live actuation: prefetcher depth ---------------------------------------
+
+
+def test_prefetcher_set_depth_live_resize_unblocks_producer():
+    from tensorflowonspark_tpu.feed.prefetch import DevicePrefetcher
+
+    produced = threading.Semaphore(0)
+
+    def gen():
+        for i in range(64):
+            produced.release()
+            yield {"i": i}
+
+    pf = DevicePrefetcher(gen(), depth=1, transform=lambda b: b)
+    try:
+        time.sleep(0.2)  # producer fills depth-1 queue and blocks
+        assert pf.stats()["depth"] == 1
+        before = 64 - len(
+            [None for _ in range(64) if produced.acquire(blocking=False)]
+        )
+        assert pf.set_depth(8) == 8  # growth must unblock the put()
+        assert pf.stats()["depth"] == 8
+        deadline = time.monotonic() + 5.0
+        drained = 0
+        for _ in pf:
+            drained += 1
+            if drained >= 16 or time.monotonic() > deadline:
+                break
+        assert drained >= 16
+        assert before < 64  # the depth-1 queue really was backpressuring
+    finally:
+        pf.close()
+
+
+def test_prefetch_depth_policy_wires_the_live_knob():
+    from tensorflowonspark_tpu.autotune.policies import (
+        prefetch_depth_policy,
+    )
+    from tensorflowonspark_tpu.feed.prefetch import DevicePrefetcher
+
+    def gen():
+        while True:
+            yield {"i": 0}
+
+    pf = DevicePrefetcher(gen(), depth=2, transform=lambda b: b)
+    try:
+        knob, policy = prefetch_depth_policy(pf, lo=1, hi=16, window_s=1.0)
+        reg = KnobRegistry()
+        reg.register(knob)
+        assert reg.current("feed.prefetch_depth") == 2.0
+        assert reg.set("feed.prefetch_depth", 5.0) == 5.0
+        assert pf.stats()["depth"] == 5
+        assert policy.knob == knob.name
+    finally:
+        pf.close()
+
+
+# -- live actuation: router cold-start seed ---------------------------------
+
+
+def _stub_fleet_for_router():
+    from tensorflowonspark_tpu.serving.fleet import ServingFleet
+
+    class _StubMetrics:
+        def render(self):
+            return "# TYPE stub_up gauge\nstub_up 1\n"
+
+    class _StubEngine:
+        live = True
+        ready = True
+        metrics = _StubMetrics()
+
+        def warmup(self):
+            pass
+
+        def health(self):
+            return {"live": True, "ready": True}
+
+        def stats(self):
+            return {
+                "slots": 2,
+                "slots_busy": 0,
+                "queue_depth": 0,
+                "watchdog_fires": 0,
+                "admitted": 0,
+                "completed": 0,
+            }
+
+        def unresolved(self):
+            return 0
+
+        def submit_many(self, prompts, max_new_tokens, **kw):
+            return [[7] * min(int(max_new_tokens), 3) for _ in prompts]
+
+        def close(self, drain=False, drain_timeout=300.0):
+            pass
+
+    return ServingFleet(
+        factory=_StubEngine,
+        replicas=1,
+        probe_interval=0.1,
+        warmup=False,
+        drain_timeout=2.0,
+    )
+
+
+def test_router_cold_start_seed_replaces_pessimistic_hint():
+    """Regression: a pessimistic static ``service_time_hint_s`` must not
+    keep shedding feasible deadlines once measured latency exists — the
+    measured seed (``seed_from_history`` / the autotune direct policy)
+    takes precedence in the estimate chain."""
+    from tensorflowonspark_tpu.serving.fleet import FleetOverloaded
+    from tensorflowonspark_tpu.serving.router import FleetRouter
+
+    fleet = _stub_fleet_for_router()
+    try:
+        router = FleetRouter(fleet, service_time_hint_s=20.0)
+        assert router.service_estimate() == 20.0
+        with pytest.raises(FleetOverloaded):
+            router.submit([1], 2, deadline_s=5.0)  # hint says infeasible
+
+        # measured reality: requests take ~50ms
+        r = Registry()
+        h = r.histogram("router_request_seconds", "t")
+        hist = History(source="t")
+        hist.scrape_registry(r, t=100.0)
+        for _ in range(20):
+            h.observe(0.05)
+        hist.scrape_registry(r, t=101.0)
+        est = router.seed_from_history(hist, window_s=60.0, now=101.0)
+        assert est is not None and est < 1.0
+        assert router.service_estimate() == pytest.approx(est)
+        assert router.submit([1], 2, deadline_s=5.0) == [7, 7]
+    finally:
+        fleet.close()
+
+
+def test_router_estimate_policy_direct_mode():
+    from tensorflowonspark_tpu.autotune.policies import (
+        router_estimate_policy,
+    )
+    from tensorflowonspark_tpu.serving.router import FleetRouter
+
+    fleet = _stub_fleet_for_router()
+    try:
+        router = FleetRouter(fleet, service_time_hint_s=20.0)
+        knob, policy = router_estimate_policy(
+            router, q=0.9, lo_s=0.001, window_s=60.0
+        )
+        reg = KnobRegistry()
+        reg.register(knob)
+        assert policy.target is not None  # direct mode: no verdict cycle
+
+        r = Registry()
+        h = r.histogram("router_request_seconds", "t")
+        hist = History(source="t")
+        hist.scrape_registry(r, t=100.0)
+        for _ in range(20):
+            h.observe(0.05)
+        hist.scrape_registry(r, t=101.0)
+        ctrl = Controller(
+            reg, hist, [policy], metrics_registry=Registry(), source="t"
+        )
+        rows = ctrl.step(now=101.0)
+        assert rows and rows[0]["mode"] == "direct"
+        assert router.service_estimate() < 1.0
+    finally:
+        fleet.close()
+
+
+# -- concurrency stress (slow tier; runs again under the tfsan witness) ------
+
+
+@pytest.mark.slow
+def test_concurrent_steps_freeze_and_snapshot_are_race_free():
+    """The controller's documented single-writer claim under fire: many
+    threads stepping the same controller while an operator thread
+    freezes/unfreezes and readers snapshot — no exception, no torn
+    registry state, and the knob never leaves its declared bounds.
+    Under TFOS_TFSAN=1 this run also feeds the lock witness the full
+    controller/registry/prefetcher-free lock graph."""
+    reg = KnobRegistry()
+    knob, box = _mem_knob(start=4.0)
+    reg.register(knob)
+    ctrl = _controller(
+        [Policy(knob="k", objective=_objective(lambda v: -abs(v - 7.0), box))],
+        reg,
+    )
+    stop = threading.Event()
+    errors: list = []
+    now = {"t": 0.0}
+    now_lock = threading.Lock()
+
+    def stepper():
+        try:
+            while not stop.is_set():
+                with now_lock:
+                    now["t"] += 1.0
+                    t = now["t"]
+                ctrl.step(now=t)
+        except BaseException as e:  # noqa: BLE001 - ferried to assert
+            errors.append(e)
+
+    def operator():
+        try:
+            while not stop.is_set():
+                reg.freeze("k", reason="drill")
+                reg.unfreeze("k")
+        except BaseException as e:  # noqa: BLE001 - ferried to assert
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                snap = reg.snapshot()["k"]
+                assert knob.lo <= snap["value"] <= knob.hi
+                ctrl.decision_log()
+                ctrl.to_artifact()
+        except BaseException as e:  # noqa: BLE001 - ferried to assert
+            errors.append(e)
+
+    threads = (
+        [threading.Thread(target=stepper) for _ in range(4)]
+        + [threading.Thread(target=operator)]
+        + [threading.Thread(target=reader) for _ in range(2)]
+    )
+    for t in threads:
+        t.start()
+    time.sleep(2.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not errors, errors[:3]
+    assert knob.lo <= reg.current("k") <= knob.hi

@@ -321,3 +321,53 @@ def test_bench_rollout_smoke_zero_downtime_artifact():
     art = os.path.join(REPO, out["artifact"])
     assert os.path.exists(art)
     assert json.load(open(art))["metric"] == "rollout_zero_downtime"
+
+
+def test_bench_autotune_smoke_recovers_and_audits():
+    """bench.py --autotune end-to-end: boot BOTH legs (mnist feed
+    physics, tiny-model serve fleet) with deliberately bad knobs and
+    let the controller recover >=90% of the hand-tuned throughput
+    online. Every knob move must be on the flight record, and at least
+    one leg must exercise the revert path (hill-climb past the peak)."""
+    env = dict(
+        os.environ,
+        BENCH_SMOKE="1",
+        BENCH_ALLOW_CPU="1",
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PALLAS_AXON_REMOTE_COMPILE="",
+    )
+    env.pop("TFOS_AUTOTUNE", None)  # the leg under test tunes live
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--autotune"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "autotune_recovery"
+    assert out["smoke"] is True
+    assert out["feed_leg"]["recovered_frac"] >= 0.9
+    assert out["serve_leg"]["recovered_frac"] >= 0.9
+    assert out["value"] >= 0.9
+    assert out["autotune_reverts_total"] > 0
+    assert out["autotune_decisions_total"] > 0
+    # every move/revert is a registered flightrec event
+    assert (
+        out["flightrec_autotune_events"] >= out["autotune_decisions_total"]
+    )
+    # the feed leg must actually have climbed off the bad boot depth
+    assert out["feed_leg"]["final_depth"] > out["feed_leg"]["initial_depth"]
+    # the router's pessimistic boot estimate must have been tightened
+    assert (
+        out["serve_leg"]["service_estimate_after_s"]
+        < out["serve_leg"]["service_estimate_before_s"]
+    )
+    art = os.path.join(REPO, out["artifact"])
+    assert os.path.exists(art)
+    on_disk = json.load(open(art))
+    assert on_disk["metric"] == "autotune_recovery"
+    assert on_disk["value"] >= 0.9

@@ -174,10 +174,11 @@ def test_failpoint_rule_reports_seeded_violations(fixture_findings):
         _line_of("bad_failpoint.py", "ingest.handover_drian"),
         _line_of("bad_failpoint.py", "fleet.dispach"),
         _line_of("bad_failpoint.py", "rollout.swpa"),
+        _line_of("bad_failpoint.py", "autotune.aply"),
     }, [f.render() for f in hits]
     dynamic = [f for f in hits if "string literal" in f.message]
     unregistered = [f for f in hits if "not registered" in f.message]
-    assert len(dynamic) == 1 and len(unregistered) == 6
+    assert len(dynamic) == 1 and len(unregistered) == 7
     # the REGISTERED elastic + pull-plane sites are in the rule's
     # registry view: the fixture's clean literals produced no findings
     clean_lines = {
@@ -196,6 +197,34 @@ def test_failpoint_rule_reports_seeded_violations(fixture_findings):
         _line_of("bad_failpoint.py", '"rollout.publish"'),
         _line_of("bad_failpoint.py", '"rollout.swap"'),
         _line_of("bad_failpoint.py", '"rollout.verify"'),
+        _line_of("bad_failpoint.py", '"autotune.apply"'),
+    }
+    assert not clean_lines & {f.line for f in hits}
+
+
+def test_autotune_rule_reports_seeded_violations(fixture_findings):
+    """AT001: tunable knob attributes assigned outside the registry's
+    SANCTIONED scopes — ad-hoc writes flagged (plain, augmented, and an
+    escape with no justification), sanctioned ctor/actuation scopes and
+    a justified escape untouched."""
+    rel = f"{FIXTURES}/bad_autotune.py"
+    hits = by_rule(fixture_findings, "AT001")
+    assert all(f.path == rel for f in hits), [f.render() for f in hits]
+    assert {f.line for f in hits} == {
+        _line_of("bad_autotune.py", "eng._decode_block = 8"),
+        _line_of("bad_autotune.py", "pf._prefetch_depth += 1"),
+        _line_of("bad_autotune.py", "feed._publish_blocks = 4"),
+        _line_of("bad_autotune.py", "self._pipeline_depth = 3"),
+    }, [f.render() for f in hits]
+    unjustified = [f for f in hits if "requires a justification" in f.message]
+    adhoc = [f for f in hits if "sanctioned actuation path" in f.message]
+    assert len(unjustified) == 1 and len(adhoc) == 3
+    # sanctioned scopes and the justified escape are silent
+    clean_lines = {
+        _line_of("bad_autotune.py", "router._service_time_hint = 0.5"),
+        _line_of("bad_autotune.py", "eng._decode_blocks = 8"),
+        _line_of("bad_autotune.py", "self._decode_block = decode_block"),
+        _line_of("bad_autotune.py", "self._prefetch_depth = depth  # sanctioned ctor"),
     }
     assert not clean_lines & {f.line for f in hits}
 

@@ -1492,3 +1492,44 @@ def test_block_decode_budget_overrun_discarded(tiny):
         assert eng.tokens_emitted == 5  # surplus never recorded
     finally:
         eng.close()
+
+
+def test_engine_set_knobs_live_token_identical(tiny):
+    """The autotune actuation path: ``set_knobs`` on a RUNNING engine —
+    including mid-decode — re-blocks the schedule without changing a
+    single emitted token, and ``stats()`` reports the installed values
+    (the readback the knob registry trusts)."""
+    cfg, model, params = tiny
+    eng = ContinuousBatcher(
+        model, params, slots=2, prompt_widths=(8,),
+        decode_block=1, pipeline_depth=1,
+    )
+    try:
+        p = [1, 2, 3]
+        want = _reference(model, params, p, 8)
+        assert eng.submit(p, 8) == want
+
+        got = eng.set_knobs(decode_block=4, pipeline_depth=2)
+        assert got == {"decode_block": 4, "pipeline_depth": 2}
+        st = eng.stats()
+        assert st["decode_block"] == 4 and st["pipeline_depth"] == 2
+        assert eng.submit(p, 8) == want  # same tokens, new blocking
+
+        # mid-flight: flip the knobs while a request is decoding
+        out: list = []
+        t = threading.Thread(
+            target=lambda: out.append(eng.submit([7, 5], 12))
+        )
+        t.start()
+        eng.set_knobs(decode_block=2)
+        t.join(timeout=60.0)
+        assert not t.is_alive()
+        assert out[0] == _reference(model, params, [7, 5], 12)
+        assert eng.stats()["decode_block"] == 2
+
+        with pytest.raises(ValueError):
+            eng.set_knobs(decode_block=0)
+        with pytest.raises(ValueError):
+            eng.set_knobs(pipeline_depth=-1)
+    finally:
+        eng.close()
