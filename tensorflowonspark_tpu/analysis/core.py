@@ -37,7 +37,7 @@ __all__ = [
 ]
 
 DEFAULT_RULES = (
-    "LK", "JX", "HS", "TL", "FP", "PF", "OB", "BL", "TH", "SH", "AT",
+    "LK", "JX", "HS", "TL", "FP", "PF", "OB", "BL", "TH", "SH", "AT", "WR",
 )
 
 
@@ -70,6 +70,8 @@ class Config:
     autotune_module: str = "tensorflowonspark_tpu/autotune/registry.py"
     # the declarative layout table the SH rules enforce (analysis/sharding.py)
     layout_module: str = "tensorflowonspark_tpu/compute/layout.py"
+    # the declarative wire catalog the WR rules enforce (analysis/wire.py)
+    wire_module: str = "tensorflowonspark_tpu/cluster/wire.py"
     moved_jax_symbols: tuple = ("shard_map", "lax.axis_size")
     hot_roots: tuple = (
         "tensorflowonspark_tpu/serving/engine.py::ContinuousBatcher._loop",
@@ -181,6 +183,8 @@ def load_config(root: str, pyproject: str | None = None) -> Config:
         cfg.autotune_module = section["autotune_module"]
     if "layout_module" in section:
         cfg.layout_module = section["layout_module"]
+    if "wire_module" in section:
+        cfg.wire_module = section["wire_module"]
     if "moved_jax_symbols" in section:
         cfg.moved_jax_symbols = tuple(section["moved_jax_symbols"])
     if "hot_roots" in section:
@@ -290,6 +294,7 @@ def run_lint(root: str, cfg: Config) -> list:
         obsmetrics,
         prefetchrule,
         sharding as sharding_rule,
+        wire as wire_rule,
     )
 
     pkg, findings = parse_package(root, cfg)
@@ -311,6 +316,8 @@ def run_lint(root: str, cfg: Config) -> list:
         findings.extend(jaxapi.check(pkg, cfg))
     if "SH" in enabled:
         findings.extend(sharding_rule.check(pkg, cfg))
+    if "WR" in enabled:
+        findings.extend(wire_rule.check(pkg, cfg))
     if "FP" in enabled:
         findings.extend(fp_rule.check(pkg, cfg))
     if "AT" in enabled:

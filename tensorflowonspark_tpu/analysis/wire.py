@@ -1,0 +1,488 @@
+"""WR: the wire-schema static head — wirecheck's lint half.
+
+The wire catalog (``cluster/wire.py``) is only a single source of
+truth while nothing constructs or parses wire payloads behind its
+back. These rules make that structural:
+
+- **WR001** — raw wire construction or parsing outside the codec
+  module: a dict literal carrying a declared message-kind ``"type"``
+  tag (build it with ``wire.encode``); a string-literal subscript /
+  ``.get`` on a value that came straight off the wire
+  (``MessageSocket.receive(...)``, a ``mgr.get(<declared KV key>)``
+  probe) — parse it with ``wire.decode`` first; or a ``mgr.set`` of a
+  declared KV key whose payload is a raw dict/string literal instead
+  of a ``wire.encode(...)`` call.
+- **WR002** — an undeclared wire name: a message-kind literal absent
+  from the catalog (in a ``"type"`` tag or compared against a
+  ``wire.message_kind(...)`` result), or a manager-KV key string
+  literal — undeclared keys must be declared in ``WIRE_SCHEMAS``;
+  declared ones must be spelled via the ``cluster/wire.py`` constant,
+  never inlined (the bare ``"feed_timeout"`` probe this family was
+  built to catch).
+- **WR003** — a field the declared schema does not have:
+  ``wire.encode("<schema>", bogus=...)`` keywords, and
+  ``d["bogus"]`` / ``d.get("bogus")`` reads on a value assigned from
+  ``wire.decode("<schema>", ...)``.
+
+Escape for a deliberate exception: ``# lint: wire-ok: <why>`` on the
+flagged line (or the line above) — the justification is mandatory.
+
+The catalog is a **pure literal** precisely so this analyzer can
+``ast.literal_eval`` it without importing anything; the KV key
+constants beside it (``NAME = _kv_key_of("kv.x")``) are resolved from
+the same parse, so migrated call sites that spell
+``mgr.get(FEED_KNOBS_KEY)`` are recognized as declared-key probes.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tensorflowonspark_tpu.analysis.core import Config, Finding, Module, Package
+
+__all__ = ["check"]
+
+WIRE_OK_RE = re.compile(r"#\s*lint:\s*wire-ok:\s*\S")
+
+_WIRE_MODULE = "tensorflowonspark_tpu.cluster.wire"
+
+# receivers whose .get/.set with a string-literal key is a manager-KV
+# wire call (the repo-wide naming convention for ManagerHandle values)
+_MGR_NAMES = {"mgr", "manager", "_mgr"}
+
+# codec entry points whose first-argument schema name WR003 validates
+_CODEC_FNS = {"encode", "decode"}
+
+# bare-value codec schemas take codec-specific keywords, not fields
+_SCALAR_KWS = {"value"}
+_CURSOR_KWS = {"seq", "skip"}
+
+
+class WireCatalog:
+    """The declared catalog, AST-read from ``cfg.wire_module``."""
+
+    def __init__(self, schemas: dict, key_consts: dict, parsed: bool):
+        self.schemas = schemas  # name -> schema entry dict
+        self.parsed = parsed
+        self.kinds = {
+            sc["kind"]
+            for sc in schemas.values()
+            if isinstance(sc.get("kind"), str)
+        }
+        self.kv_keys = {
+            sc["kv_key"]: name
+            for name, sc in schemas.items()
+            if isinstance(sc.get("kv_key"), str)
+        }
+        # constant name -> kv key string (INGEST_PLAN_KEY = ...)
+        self.key_consts = key_consts
+
+    def fields(self, name: str) -> set | None:
+        sc = self.schemas.get(name)
+        if sc is None:
+            return None
+        out = set(sc.get("fields", ()))
+        if sc.get("codec") == "scalar":
+            out |= _SCALAR_KWS
+        if sc.get("codec") == "cursor_entry":
+            out |= _CURSOR_KWS
+        return out
+
+
+def _load_catalog(root: str, cfg: Config) -> WireCatalog:
+    path = os.path.join(root, cfg.wire_module)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return WireCatalog({}, {}, parsed=False)
+    schemas: dict = {}
+    key_consts: dict = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "WIRE_SCHEMAS" in targets:
+            try:
+                schemas = ast.literal_eval(node.value)
+            except (ValueError, TypeError, SyntaxError):
+                return WireCatalog({}, {}, parsed=False)
+        elif (
+            len(targets) == 1
+            and isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Name)
+            and node.value.func.id == "_kv_key_of"
+            and node.value.args
+            and isinstance(node.value.args[0], ast.Constant)
+        ):
+            key_consts[targets[0]] = node.value.args[0].value
+    if not schemas:
+        return WireCatalog({}, {}, parsed=False)
+    # resolve constant names to actual key strings via the table
+    resolved = {
+        const: schemas[sname]["kv_key"]
+        for const, sname in key_consts.items()
+        if sname in schemas and "kv_key" in schemas[sname]
+    }
+    return WireCatalog(schemas, resolved, parsed=True)
+
+
+def _has_escape(mod: Module, node: ast.AST) -> bool:
+    end = getattr(node, "end_lineno", node.lineno) or node.lineno
+    for line in range(max(1, node.lineno - 1), end + 1):
+        c = mod.comments.get(line)
+        if c and WIRE_OK_RE.search(c):
+            return True
+    return False
+
+
+def _terminal_name(node: ast.AST) -> str | None:
+    """``a.b.mgr`` → ``mgr``; ``mgr`` → ``mgr``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _attr_chain(node: ast.AST) -> str | None:
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Checker(ast.NodeVisitor):
+    """One module's WR pass. Which names count as "the wire codec" is
+    resolved from this module's imports (the FP001 pattern), so an
+    unrelated local ``encode`` helper is never misread."""
+
+    def __init__(self, mod: Module, cat: WireCatalog, is_wire_module: bool):
+        self.mod = mod
+        self.cat = cat
+        self.is_wire_module = is_wire_module
+        self.wire_mods: set = set()  # local names bound to the wire module
+        self.wire_fns: dict = {}  # local name -> codec fn name
+        self.findings: list = []
+        # per-function taint state (reset by visit_FunctionDef)
+        self._tainted: set = set()  # raw wire values (receive / kv probe)
+        self._decoded: dict = {}  # var name -> schema name (wire.decode)
+        # names assigned from wire.message_kind(...) — module-wide
+        # (kind vars are short-lived dispatch locals; monotonic is fine)
+        self._kind_vars: set = set()
+
+    def _flag(self, rule: str, node: ast.AST, msg: str) -> None:
+        if _has_escape(self.mod, node):
+            return
+        self.findings.append(
+            Finding(rule, self.mod.relpath, node.lineno, node.col_offset, msg)
+        )
+
+    # -- import resolution ------------------------------------------------
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            if alias.name == _WIRE_MODULE:
+                self.wire_mods.add(alias.asname or _WIRE_MODULE)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.level == 0 and node.module == _WIRE_MODULE:
+            for alias in node.names:
+                if alias.name in _CODEC_FNS:
+                    self.wire_fns[alias.asname or alias.name] = alias.name
+        elif node.level == 0 and node.module == _WIRE_MODULE.rsplit(".", 1)[0]:
+            for alias in node.names:
+                if alias.name == "wire":
+                    self.wire_mods.add(alias.asname or "wire")
+        self.generic_visit(node)
+
+    def _codec_call(self, node: ast.Call) -> str | None:
+        """'encode' / 'decode' / 'message_kind' when ``node`` calls the
+        wire codec, else None."""
+        func = node.func
+        if isinstance(func, ast.Name):
+            return self.wire_fns.get(func.id)
+        if isinstance(func, ast.Attribute):
+            base = _attr_chain(func.value)
+            if base in self.wire_mods or base == _WIRE_MODULE:
+                return func.attr
+        return None
+
+    # -- taint sources ----------------------------------------------------
+
+    def _is_receive_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        chain = _attr_chain(node.func)
+        return bool(chain) and chain.endswith("MessageSocket.receive")
+
+    def _kv_key_of_arg(self, arg: ast.AST) -> str | None:
+        """The declared KV key named by a .get/.set key argument —
+        via literal or via a registry constant — else None."""
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value if arg.value in self.cat.kv_keys else None
+        name = _terminal_name(arg)
+        if name is not None:
+            return self.cat.key_consts.get(name)
+        return None
+
+    def _is_kv_probe(self, node: ast.AST) -> bool:
+        """``<mgr>.get(<declared key>)`` — a raw KV read."""
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and len(node.args) >= 1
+            and _terminal_name(node.func.value) in _MGR_NAMES
+            and self._kv_key_of_arg(node.args[0]) is not None
+        )
+
+    # -- per-function pass -------------------------------------------------
+
+    def _function_pass(self, node) -> None:
+        outer_t, outer_d = self._tainted, self._decoded
+        self._tainted, self._decoded = set(), {}
+        self.generic_visit(node)
+        self._tainted, self._decoded = outer_t, outer_d
+
+    visit_FunctionDef = _function_pass
+    visit_AsyncFunctionDef = _function_pass
+
+    def visit_Assign(self, node):
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            val = node.value
+            if self._is_receive_call(val) or self._is_kv_probe(val):
+                self._tainted.add(tgt)
+            elif isinstance(val, ast.Call):
+                fn = self._codec_call(val)
+                if (
+                    fn == "decode"
+                    and val.args
+                    and isinstance(val.args[0], ast.Constant)
+                    and isinstance(val.args[0].value, str)
+                ):
+                    self._decoded[tgt] = val.args[0].value
+                    self._tainted.discard(tgt)
+                else:
+                    self._tainted.discard(tgt)
+                    self._decoded.pop(tgt, None)
+            else:
+                self._tainted.discard(tgt)
+                self._decoded.pop(tgt, None)
+        self.generic_visit(node)
+
+    # -- field accesses ----------------------------------------------------
+
+    def _field_access(self, node: ast.AST, var: str, field: str) -> None:
+        if var in self._tainted and not self.is_wire_module:
+            self._flag(
+                "WR001", node,
+                f"raw wire field read {var}[{field!r}] on an undecoded "
+                "payload — route it through wire.decode(<schema>, ...) "
+                "so the declared schema (and its compat gate) covers "
+                "this consumer",
+            )
+        elif var in self._decoded:
+            sname = self._decoded[var]
+            fields = self.cat.fields(sname)
+            if fields is not None and field not in fields:
+                self._flag(
+                    "WR003", node,
+                    f"field {field!r} is not declared by wire schema "
+                    f"'{sname}' — declare it in WIRE_SCHEMAS (and bump "
+                    "the version per the compat policy) before reading "
+                    "it",
+                )
+
+    def visit_Subscript(self, node):
+        if (
+            isinstance(node.value, ast.Name)
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)
+        ):
+            self._field_access(node, node.value.id, node.slice.value)
+        self.generic_visit(node)
+
+    # -- calls -------------------------------------------------------------
+
+    def visit_Call(self, node):
+        func = node.func
+        # d.get("field") on tainted/decoded values
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "get"
+            and isinstance(func.value, ast.Name)
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            self._field_access(node, func.value.id, node.args[0].value)
+        # manager-KV calls: key discipline + raw-literal publishes
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in ("get", "set")
+            and _terminal_name(func.value) in _MGR_NAMES
+            and node.args
+        ):
+            self._kv_call(node, func)
+        # wire.encode schema-name + keyword validation
+        fn = self._codec_call(node)
+        if fn in ("encode", "decode") and not self.is_wire_module:
+            self._codec_fields(node, fn)
+        self.generic_visit(node)
+
+    def _kv_call(self, node: ast.Call, func: ast.Attribute) -> None:
+        key = node.args[0]
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            if not self.is_wire_module:
+                if key.value in self.cat.kv_keys:
+                    self._flag(
+                        "WR002", node,
+                        f"bare manager-KV key literal {key.value!r} — "
+                        "spell it via the cluster/wire.py registry "
+                        "constant so every probe and publish of this "
+                        "wire is greppable from one place",
+                    )
+                elif self.cat.parsed:
+                    self._flag(
+                        "WR002", node,
+                        f"manager-KV key {key.value!r} is not declared "
+                        "in cluster/wire.py WIRE_SCHEMAS — every "
+                        "cross-process KV wire needs a declared schema "
+                        "and key constant",
+                    )
+        if (
+            func.attr == "set"
+            and len(node.args) >= 2
+            and self._kv_key_of_arg(key) is not None
+            and not self.is_wire_module
+        ):
+            payload = node.args[1]
+            if isinstance(payload, ast.Dict) or (
+                isinstance(payload, ast.Constant)
+                and isinstance(payload.value, str)
+            ):
+                self._flag(
+                    "WR001", node,
+                    "raw payload published to a declared KV wire — "
+                    "construct it with wire.encode(<schema>, ...) so "
+                    "the declared shape (and its golden-corpus gate) "
+                    "covers this producer",
+                )
+
+    def _codec_fields(self, node: ast.Call, fn: str) -> None:
+        if not (
+            node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            return
+        sname = node.args[0].value
+        fields = self.cat.fields(sname)
+        if fields is None:
+            if self.cat.parsed:
+                self._flag(
+                    "WR003", node,
+                    f"wire.{fn} names undeclared schema {sname!r} — "
+                    "declare it in cluster/wire.py WIRE_SCHEMAS",
+                )
+            return
+        if fn == "encode":
+            for kw in node.keywords:
+                if kw.arg is not None and kw.arg not in fields:
+                    self._flag(
+                        "WR003", node,
+                        f"field {kw.arg!r} is not declared by wire "
+                        f"schema '{sname}' — declare it in WIRE_SCHEMAS "
+                        "(and bump the version per the compat policy) "
+                        "before writing it",
+                    )
+
+    # -- message dicts and kind literals -----------------------------------
+
+    def visit_Dict(self, node):
+        for k, v in zip(node.keys, node.values):
+            if (
+                isinstance(k, ast.Constant)
+                and k.value == "type"
+                and isinstance(v, ast.Constant)
+                and isinstance(v.value, str)
+            ):
+                if self.is_wire_module:
+                    continue
+                if v.value in self.cat.kinds:
+                    self._flag(
+                        "WR001", node,
+                        f"raw wire-message dict for kind {v.value!r} — "
+                        "construct it with wire.encode(<schema>, ...) "
+                        "so the declared shape (and its golden-corpus "
+                        "gate) covers this producer",
+                    )
+                elif self.cat.parsed:
+                    self._flag(
+                        "WR002", node,
+                        f"message kind {v.value!r} is not declared in "
+                        "cluster/wire.py WIRE_SCHEMAS — every "
+                        "cross-process message kind needs a declared "
+                        "schema",
+                    )
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):
+        # <kind var from wire.message_kind(...)> == "<literal>"
+        if (
+            isinstance(node.left, ast.Name)
+            and node.left.id in self._kind_vars
+            and len(node.comparators) == 1
+            and isinstance(node.comparators[0], ast.Constant)
+            and isinstance(node.comparators[0].value, str)
+            and self.cat.parsed
+            and node.comparators[0].value not in self.cat.kinds
+        ):
+            self._flag(
+                "WR002", node,
+                f"message kind {node.comparators[0].value!r} is not "
+                "declared in cluster/wire.py WIRE_SCHEMAS — a dispatch "
+                "arm for it can never match a sanctioned producer",
+            )
+        self.generic_visit(node)
+
+
+def _track_kind_vars(checker: _Checker, tree: ast.AST) -> None:
+    """Pre-pass: collect names assigned from ``wire.message_kind(...)``
+    so Compare checks work regardless of visit order."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+            and checker._codec_call(node.value) == "message_kind"
+        ):
+            checker._kind_vars.add(node.targets[0].id)
+
+
+def check(pkg: Package, cfg: Config) -> list:
+    cat = _load_catalog(pkg.root, cfg)
+    wire_rel = cfg.wire_module.replace(os.sep, "/")
+    findings: list = []
+    for mod in pkg.modules:
+        checker = _Checker(mod, cat, is_wire_module=(mod.relpath == wire_rel))
+        # imports first so the kind-var pre-pass can resolve the codec
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Import):
+                checker.visit_Import(n)
+            elif isinstance(n, ast.ImportFrom):
+                checker.visit_ImportFrom(n)
+        _track_kind_vars(checker, mod.tree)
+        checker.visit(mod.tree)
+        findings.extend(checker.findings)
+    return findings

@@ -29,6 +29,7 @@ import numpy as np
 
 from tensorflowonspark_tpu.cluster import manager as tf_manager
 from tensorflowonspark_tpu.cluster import reservation
+from tensorflowonspark_tpu.cluster import wire
 from tensorflowonspark_tpu.cluster.context import TFNodeContext
 from tensorflowonspark_tpu.cluster.marker import EndOfFeed, EndPartition
 from tensorflowonspark_tpu.utils import util
@@ -240,13 +241,13 @@ def run_node(
         if cluster_meta.get("auto_initialize_distributed", True):
             ctx.initialize_distributed()
         map_fun(tf_args, ctx)
-        mgr.set("state", "finished")
+        publish_node_state(mgr, "finished")
     except Exception as map_err:
         tb = traceback.format_exc()
         logger.error("map_fun failed:\n%s", tb)
         flightrec.note("map_fun_error", error=repr(map_err))
         flightrec.dump_now("map_fun_error")
-        mgr.set("state", "error")
+        publish_node_state(mgr, "error")
         try:
             mgr.get_queue("error").put(
                 {"executor_id": executor_id, "traceback": tb}, timeout=10
@@ -546,9 +547,28 @@ def connect_manager(node: dict[str, Any]) -> tf_manager.ManagerHandle:
     return tf_manager.connect(node["addr"], bytes.fromhex(node["authkey"]))
 
 
+def publish_node_state(mgr: tf_manager.ManagerHandle, state: str) -> None:
+    """Publish this node's lifecycle state to its manager KV (schema
+    ``kv.node_state`` — a closed enum, so a typo'd state string dies at
+    the producer instead of silently never matching a reader's
+    comparison)."""
+    mgr.set(wire.NODE_STATE_KEY, wire.encode("kv.node_state", value=state))
+
+
+def fetch_node_state(mgr: tf_manager.ManagerHandle) -> str:
+    """The node's current lifecycle state (``"running"`` when nothing
+    was ever published — the manager seeds the key at startup)."""
+    raw = mgr.get(wire.NODE_STATE_KEY)
+    if raw is None:
+        return "running"
+    return wire.decode("kv.node_state", str(raw))["value"]
+
+
 # Manager KV key carrying a node's pull-plane shard assignment
 # (TFCluster.assign_shards publishes it; fetch_ingest_plan probes it).
-INGEST_PLAN_KEY = "ingest_plan"
+# Declared in cluster/wire.py (schema ``kv.ingest_plan``); re-exported
+# here because this module is the wire's producer/consumer home.
+INGEST_PLAN_KEY = wire.INGEST_PLAN_KEY
 
 
 def publish_ingest_plan(
@@ -572,15 +592,16 @@ def publish_ingest_plan(
     lingering consumers stop instead of waiting for more work."""
     mgr.set(
         INGEST_PLAN_KEY,
-        {
-            "epoch": int(epoch),
-            "plan_id": plan_id,
-            "shard_index": int(shard_index),
-            "num_shards": int(num_shards),
-            "manifests": list(manifests),
-            "handover": bool(handover),
-            "complete": bool(complete),
-        },
+        wire.encode(
+            "kv.ingest_plan",
+            epoch=int(epoch),
+            plan_id=plan_id,
+            shard_index=int(shard_index),
+            num_shards=int(num_shards),
+            manifests=list(manifests),
+            handover=bool(handover),
+            complete=bool(complete),
+        ),
     )
 
 
@@ -607,9 +628,11 @@ def fetch_ingest_plan(
     failpoint("ingest.manifest_fetch")
     deadline = time.monotonic() + timeout
     while True:
-        plan = mgr.get(INGEST_PLAN_KEY)
-        if plan is not None and int(plan.get("epoch", 0)) >= int(min_epoch):
-            return plan
+        raw = mgr.get(INGEST_PLAN_KEY)
+        if raw is not None:
+            plan = wire.decode("kv.ingest_plan", raw)
+            if plan["epoch"] >= int(min_epoch):
+                return plan
         if time.monotonic() >= deadline:
             raise TimeoutError(
                 f"no ingest plan (epoch >= {min_epoch}) published within "
@@ -622,7 +645,8 @@ def fetch_ingest_plan(
 # Manager KV key carrying driver-pushed feed knobs (autotune): the
 # driver-side controller re-publishes tuned node-side knobs here;
 # IngestFeed polls it at block boundaries and adopts by seq.
-FEED_KNOBS_KEY = "feed_knobs"
+# Declared in cluster/wire.py (schema ``kv.feed_knobs``).
+FEED_KNOBS_KEY = wire.FEED_KNOBS_KEY
 
 
 def publish_feed_knobs(
@@ -638,7 +662,7 @@ def publish_feed_knobs(
     controller's revert is just the next publication."""
     mgr.set(
         FEED_KNOBS_KEY,
-        {"seq": int(seq), "knobs": dict(knobs)},
+        wire.encode("kv.feed_knobs", seq=int(seq), knobs=dict(knobs)),
     )
 
 
@@ -650,12 +674,13 @@ def fetch_feed_knobs(
     Unlike :func:`fetch_ingest_plan` this never probes: knobs are an
     optimization, not a dependency, so a feed with no publication just
     keeps its constructor values."""
-    pub = mgr.get(FEED_KNOBS_KEY)
-    if pub is None:
+    raw = mgr.get(FEED_KNOBS_KEY)
+    if raw is None:
         return None
+    pub = wire.decode("kv.feed_knobs", raw)
     return {
-        "seq": int(pub.get("seq", 0)),
-        "knobs": dict(pub.get("knobs") or {}),
+        "seq": int(pub["seq"]),
+        "knobs": dict(pub["knobs"]),
     }
 
 
@@ -716,7 +741,7 @@ def feed_partition(
     from tensorflowonspark_tpu.feed import columnar as col
     from tensorflowonspark_tpu.obs import spans as obs_spans
 
-    if str(mgr.get("state")) in ("terminating", "finished", "error"):
+    if fetch_node_state(mgr) in ("terminating", "finished", "error"):
         # Early-stop path: consume and discard remaining partitions
         # (reference: the state check at the top of ``_train``; 'finished'
         # and 'error' additionally, since our map_fun may have already
@@ -850,7 +875,7 @@ def collect_results(
             # Fail fast if the consumer crashed instead of blocking for the
             # whole feed_timeout; the driver will surface its traceback
             # from the error queue.
-            if str(mgr.get("state")) == "error":
+            if fetch_node_state(mgr) == "error":
                 raise RuntimeError(
                     "node entered error state while collecting results"
                 ) from None
@@ -946,9 +971,9 @@ def shutdown_node(node: dict[str, Any], queues=("input",)) -> None:
     Reference: ``TFSparkNode._shutdown`` (set state, push terminal markers).
     """
     mgr = connect_manager(node)
-    state = str(mgr.get("state"))
+    state = fetch_node_state(mgr)
     if state == "running":
-        mgr.set("state", "terminating")
+        publish_node_state(mgr, "terminating")
     # Best-effort markers: the 'terminating' state already makes the node
     # drain, so a full queue here is a warning, not a hang.
     _push_end_of_feed(node, queues, timeout=30, must_deliver=False)

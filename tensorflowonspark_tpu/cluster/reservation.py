@@ -10,7 +10,11 @@ per-node manager addresses for the data plane.
 
 Wire format: 4-byte big-endian length prefix + JSON (the reference used
 pickle; JSON avoids arbitrary-code deserialization from the network and is
-plenty for roster dicts).
+plenty for roster dicts). Message SHAPES are declared in
+``cluster/wire.py`` (the ``reservation.*`` schemas) and every
+construction/parse here routes through its codecs — the protocol is
+frozen-by-policy because a registering node may be running an older or
+newer incarnation than the driver.
 """
 
 from __future__ import annotations
@@ -23,6 +27,7 @@ import threading
 import time
 from typing import Any
 
+from tensorflowonspark_tpu.cluster import wire
 from tensorflowonspark_tpu.utils.failpoints import failpoint
 from tensorflowonspark_tpu.utils.retry import RetryPolicy
 
@@ -365,58 +370,95 @@ class Server:
                     msg = MessageSocket.receive(conn)
                 except (ConnectionError, socket.timeout, ValueError):
                     return
-                mtype = msg.get("type")
-                if mtype == "REG":
-                    self.reservations.add(msg["node"])
-                    if self.reservations.done():
-                        self.done.set()
-                    MessageSocket.send(conn, {"type": "OK"})
-                elif mtype == "QUERY":
+                mtype = wire.message_kind(msg)
+                try:
+                    if mtype == "REG":
+                        req = wire.decode("reservation.REG", msg)
+                        self.reservations.add(req["node"])
+                        if self.reservations.done():
+                            self.done.set()
+                        MessageSocket.send(
+                            conn, wire.encode("reservation.REG.reply")
+                        )
+                    elif mtype == "QUERY":
+                        MessageSocket.send(
+                            conn,
+                            wire.encode(
+                                "reservation.QUERY.reply",
+                                done=self.reservations.done(),
+                            ),
+                        )
+                    elif mtype == "QINFO":
+                        MessageSocket.send(
+                            conn,
+                            wire.encode(
+                                "reservation.QINFO.reply",
+                                cluster_info=self.reservations.get(),
+                            ),
+                        )
+                    elif mtype == "QNUM":
+                        MessageSocket.send(
+                            conn,
+                            wire.encode(
+                                "reservation.QNUM.reply",
+                                remaining=self.reservations.remaining(),
+                            ),
+                        )
+                    elif mtype == "QEPOCH":
+                        MessageSocket.send(
+                            conn,
+                            wire.encode(
+                                "reservation.QEPOCH.reply",
+                                **self.reservations.membership(),
+                            ),
+                        )
+                    elif mtype == "ICURSOR":
+                        # pull-plane cursor publication (handover
+                        # protocol): stored driver-side so it survives
+                        # the publisher
+                        req = wire.decode("reservation.ICURSOR", msg)
+                        self.reservations.put_cursor(
+                            req["executor_id"], req.get("payload") or {}
+                        )
+                        MessageSocket.send(
+                            conn, wire.encode("reservation.ICURSOR.reply")
+                        )
+                    elif mtype == "HEARTBEAT":
+                        req = wire.decode("reservation.HEARTBEAT", msg)
+                        self.reservations.heartbeat(req["executor_id"])
+                        MessageSocket.send(
+                            conn,
+                            wire.encode(
+                                "reservation.HEARTBEAT.reply",
+                                stop=self._stop.is_set(),
+                                # elastic plane: the beat a node already
+                                # pays for is how it learns membership
+                                # moved
+                                epoch=self.reservations.epoch(),
+                                server_unix=time.time(),
+                            ),
+                        )
+                    elif mtype == "STOP":
+                        self._stop.set()
+                        MessageSocket.send(
+                            conn, wire.encode("reservation.STOP.reply")
+                        )
+                        return
+                    else:
+                        MessageSocket.send(
+                            conn,
+                            wire.encode(
+                                "reservation.ERR",
+                                error=f"unknown type {mtype!r}",
+                            ),
+                        )
+                except wire.WireDecodeError as e:
+                    # a malformed request (foreign speaker, version
+                    # skew beyond the frozen contract): reject THIS
+                    # message loudly, keep the connection's loop —
+                    # same containment as an unknown kind
                     MessageSocket.send(
-                        conn, {"type": "OK", "done": self.reservations.done()}
-                    )
-                elif mtype == "QINFO":
-                    MessageSocket.send(
-                        conn,
-                        {"type": "OK", "cluster_info": self.reservations.get()},
-                    )
-                elif mtype == "QNUM":
-                    MessageSocket.send(
-                        conn,
-                        {"type": "OK", "remaining": self.reservations.remaining()},
-                    )
-                elif mtype == "QEPOCH":
-                    MessageSocket.send(
-                        conn,
-                        {"type": "OK", **self.reservations.membership()},
-                    )
-                elif mtype == "ICURSOR":
-                    # pull-plane cursor publication (handover protocol):
-                    # stored driver-side so it survives the publisher
-                    self.reservations.put_cursor(
-                        msg["executor_id"], msg.get("payload") or {}
-                    )
-                    MessageSocket.send(conn, {"type": "OK"})
-                elif mtype == "HEARTBEAT":
-                    self.reservations.heartbeat(msg["executor_id"])
-                    MessageSocket.send(
-                        conn,
-                        {
-                            "type": "OK",
-                            "stop": self._stop.is_set(),
-                            # elastic plane: the beat a node already pays
-                            # for is how it learns membership moved
-                            "epoch": self.reservations.epoch(),
-                            "server_unix": time.time(),
-                        },
-                    )
-                elif mtype == "STOP":
-                    self._stop.set()
-                    MessageSocket.send(conn, {"type": "OK"})
-                    return
-                else:
-                    MessageSocket.send(
-                        conn, {"type": "ERR", "error": f"unknown type {mtype!r}"}
+                        conn, wire.encode("reservation.ERR", error=str(e))
                     )
 
     def await_reservations(
@@ -492,24 +534,34 @@ class Client:
             retry_on=(ConnectionError, TimeoutError, OSError, FailpointError),
             site="reservation.call",
         )
-        if reply.get("type") == "ERR":
-            raise RuntimeError(f"reservation server error: {reply.get('error')}")
+        if wire.message_kind(reply) == "ERR":
+            err = wire.decode("reservation.ERR", reply)
+            raise RuntimeError(f"reservation server error: {err['error']}")
         return reply
 
     def register(self, node_meta: dict[str, Any]) -> None:
         failpoint("reservation.register")
-        self._call({"type": "REG", "node": node_meta})
+        self._call(wire.encode("reservation.REG", node=node_meta))
 
     def heartbeat(self, executor_id: int) -> dict[str, Any]:
         """One liveness beat; the reply carries the server's stop flag."""
         failpoint("reservation.heartbeat")
-        return self._call(
-            {"type": "HEARTBEAT", "executor_id": int(executor_id)},
-            timeout=10.0,
+        return wire.decode(
+            "reservation.HEARTBEAT.reply",
+            self._call(
+                wire.encode(
+                    "reservation.HEARTBEAT", executor_id=int(executor_id)
+                ),
+                timeout=10.0,
+            ),
         )
 
     def get_reservations(self) -> list[dict[str, Any]]:
-        return self._call({"type": "QINFO"})["cluster_info"]
+        reply = wire.decode(
+            "reservation.QINFO.reply",
+            self._call(wire.encode("reservation.QINFO")),
+        )
+        return reply["cluster_info"]
 
     def publish_cursor(
         self, executor_id: int, payload: dict[str, Any]
@@ -518,21 +570,24 @@ class Client:
         durable table (``ICURSOR``). Payloads must be JSON-shaped —
         cursors are ``{stream: seq | [seq, skip]}`` dicts, which are."""
         self._call(
-            {
-                "type": "ICURSOR",
-                "executor_id": int(executor_id),
-                "payload": payload,
-            },
+            wire.encode(
+                "reservation.ICURSOR",
+                executor_id=int(executor_id),
+                payload=payload,
+            ),
             timeout=10.0,
         )
 
     def membership(self) -> dict[str, Any]:
         """Current membership: ``{"epoch": int, "roster": [...]}`` —
         fetched by node heartbeaters when a beat reply's epoch moves."""
-        reply = self._call({"type": "QEPOCH"}, timeout=10.0)
+        reply = wire.decode(
+            "reservation.QEPOCH.reply",
+            self._call(wire.encode("reservation.QEPOCH"), timeout=10.0),
+        )
         return {
-            "epoch": int(reply.get("epoch", 0)),
-            "roster": reply.get("roster", []),
+            "epoch": int(reply["epoch"]),
+            "roster": reply["roster"],
         }
 
     def await_reservations(
@@ -540,7 +595,11 @@ class Client:
     ) -> list[dict[str, Any]]:
         deadline = time.monotonic() + timeout
         while True:
-            if self._call({"type": "QUERY"})["done"]:
+            reply = wire.decode(
+                "reservation.QUERY.reply",
+                self._call(wire.encode("reservation.QUERY")),
+            )
+            if reply["done"]:
                 return self.get_reservations()
             if time.monotonic() > deadline:
                 raise TimeoutError(
@@ -550,7 +609,7 @@ class Client:
             time.sleep(poll_interval)
 
     def request_stop(self) -> None:
-        self._call({"type": "STOP"})
+        self._call(wire.encode("reservation.STOP"))
 
 
 def _local_ip() -> str:

@@ -27,6 +27,7 @@ from typing import Any, Callable, Iterable, Iterator, Sequence
 
 from tensorflowonspark_tpu.cluster import node as tfnode_runtime
 from tensorflowonspark_tpu.cluster import reservation
+from tensorflowonspark_tpu.cluster import wire
 from tensorflowonspark_tpu.cluster.launchers import LocalLauncher
 from tensorflowonspark_tpu.obs import cluster as obs_cluster
 from tensorflowonspark_tpu.obs import flightrec
@@ -343,7 +344,10 @@ class TFCluster:
                 # publish the feed policy to the node: DataFeed pull
                 # loops bound their queue waits by the same timeout the
                 # driver feeds under (see DataFeed._next_raw/FeedTimeout)
-                mgr.set("feed_timeout", feed_timeout)
+                mgr.set(
+                    wire.FEED_TIMEOUT_KEY,
+                    wire.encode("kv.feed_timeout", value=float(feed_timeout)),
+                )
                 for part in assignments[widx]:
                     tfnode_runtime.feed_partition(
                         mgr,
@@ -563,7 +567,7 @@ class TFCluster:
                         mgr = tfnode_runtime.connect_manager(w)
                         # 'finished' too: a map_fun that terminate()s and
                         # returns flips terminating -> finished immediately.
-                        state = str(mgr.get("state"))
+                        state = tfnode_runtime.fetch_node_state(mgr)
                         if state in ("terminating", "finished", "error"):
                             terminated[i] = True
                     except (ConnectionError, OSError, EOFError):
@@ -1923,7 +1927,7 @@ def _probe_node_states(
     def probe(i: int, node_meta: dict[str, Any]) -> None:
         try:
             mgr = tfnode_runtime.connect_manager(node_meta)
-            results[i].append(str(mgr.get("state")))
+            results[i].append(tfnode_runtime.fetch_node_state(mgr))
         except (ConnectionError, OSError, EOFError):
             results[i].append("unreachable")
 

@@ -1,0 +1,756 @@
+"""tfos.wire — THE declarative catalog of every cross-process wire
+surface, plus the sanctioned codecs that construction and parsing must
+route through.
+
+The system's headline guarantees assume *mixed-version coexistence*:
+rolling weight rollout keeps old replicas serving while new ones warm,
+elastic rejoin replays cursors persisted by a dead incarnation, and the
+driver→node KV wires (knobs, plans, timeouts) are read by whatever code
+the node happens to be running. Every one of those bytes-cross-a-
+boundary formats is declared HERE, once, as a pure-literal schema —
+version, field set, and compatibility policy — and every producer and
+consumer goes through :func:`encode` / :func:`decode` so a format edit
+is a table edit with a machine-checked blast radius, never a silent
+fork in some call site.
+
+Enforcement is three-headed (the PR-11 pattern, applied to the
+protocol plane):
+
+- ``analysis/wire.py`` — the WR lint family: raw wire-dict
+  construction or ``msg["..."]`` parsing outside this module (WR001),
+  undeclared message kinds / manager-KV key literals (WR002), fields
+  absent from the declared schema (WR003).
+- ``tools/wirecheck.py`` — the compat gate: a committed golden corpus
+  (``tools/wirecheck_corpus/``) of canonical serialized instances; the
+  gate diffs current serialization against the committed shape digest
+  (drift must bump the schema version deliberately) and decodes the
+  committed OLD bytes with current code — the rolling-upgrade
+  guarantee, enforced forever.
+- runtime — :func:`encode` rejects undeclared fields and missing
+  required ones at the producer; :func:`decode` validates kind/required
+  /types at the consumer and IGNORES undeclared extras (that tolerance
+  is what lets an old reader survive an add-only-optional publisher).
+
+``WIRE_SCHEMAS`` is a **pure literal** (like ``compute/layout.py``'s
+tables and ``utils/failpoints.py``'s SITES) precisely so the analyzer
+and the docs drift gate can AST-read it without importing anything;
+this module itself imports only the stdlib, so even ``feed/`` modules
+on the hot data path can import it without a jax/numpy tax.
+
+Compat policy vocabulary:
+
+- ``"frozen"`` — the field set is immutable at a given version; ANY
+  shape change requires a version bump (and the old version's corpus
+  bytes must still decode).
+- ``"add_only_optional"`` — new OPTIONAL fields may be added at the
+  same version (old readers ignore them by construction); removals,
+  renames, retypes, and new *required* fields need a version bump.
+
+Schema entry shape::
+
+    "<plane>.<NAME>": {
+        "version": 1,               # bumped on deliberate format change
+        "compat": "frozen" | "add_only_optional",
+        "transport": "message" | "kv" | "frame" | "pointer" | "http"
+                     | "entry",
+        "fields": {"name": "<type>", ...},   # declared wire order
+        "required": ["name", ...],
+        # transport == "message" only:
+        "kind": "REG", "role": "request" | "reply",
+        # transport == "kv" only:
+        "kv_key": "ingest_plan",
+        # bare-value schemas (scalar KV, cursor entries):
+        "codec": "scalar" | "cursor_entry",
+        # codec == "scalar" only — the enum of legal values, if closed:
+        "values": [...],
+    }
+
+Type vocabulary: ``str int float bool list dict bytes any`` with an
+optional ``|null`` suffix (``float`` accepts ints; ``bool`` is not an
+``int`` here). Field order in ``fields`` is the WIRE order — encode
+emits keys in declared order so JSON/pickle bytes stay deterministic
+and byte-identical to the pre-catalog writers.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = [
+    "WIRE_SCHEMAS",
+    "WireError",
+    "WireSchemaError",
+    "WireDecodeError",
+    "encode",
+    "decode",
+    "message_kind",
+    "kind_to_schema",
+    "schema",
+    "kv_key",
+    "encode_cursor_entry",
+    "decode_cursor_entry",
+    "INGEST_PLAN_KEY",
+    "FEED_KNOBS_KEY",
+    "FEED_TIMEOUT_KEY",
+    "NODE_STATE_KEY",
+    "ELASTIC_STATE_KEY",
+]
+
+
+# ---------------------------------------------------------------------------
+# the catalog (pure literal — AST-read by analysis/wire.py, tools/
+# wirecheck.py, and the docs/WIRE.md drift gate; keep it that way)
+# ---------------------------------------------------------------------------
+
+WIRE_SCHEMAS = {
+    # -- reservation rendezvous protocol (length-prefixed JSON over TCP;
+    #    cluster/reservation.py MessageSocket). The whole family is
+    #    frozen: requests may come from a node incarnation older OR
+    #    newer than the driver, so the shape is load-bearing both ways.
+    "reservation.REG": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "REG",
+        "role": "request",
+        "fields": {"type": "str", "node": "dict"},
+        "required": ["type", "node"],
+    },
+    "reservation.REG.reply": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "OK",
+        "role": "reply",
+        "fields": {"type": "str"},
+        "required": ["type"],
+    },
+    "reservation.QUERY": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "QUERY",
+        "role": "request",
+        "fields": {"type": "str"},
+        "required": ["type"],
+    },
+    "reservation.QUERY.reply": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "OK",
+        "role": "reply",
+        "fields": {"type": "str", "done": "bool"},
+        "required": ["type", "done"],
+    },
+    "reservation.QINFO": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "QINFO",
+        "role": "request",
+        "fields": {"type": "str"},
+        "required": ["type"],
+    },
+    "reservation.QINFO.reply": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "OK",
+        "role": "reply",
+        "fields": {"type": "str", "cluster_info": "list"},
+        "required": ["type", "cluster_info"],
+    },
+    "reservation.QNUM": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "QNUM",
+        "role": "request",
+        "fields": {"type": "str"},
+        "required": ["type"],
+    },
+    "reservation.QNUM.reply": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "OK",
+        "role": "reply",
+        "fields": {"type": "str", "remaining": "int"},
+        "required": ["type", "remaining"],
+    },
+    "reservation.QEPOCH": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "QEPOCH",
+        "role": "request",
+        "fields": {"type": "str"},
+        "required": ["type"],
+    },
+    "reservation.QEPOCH.reply": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "OK",
+        "role": "reply",
+        "fields": {"type": "str", "epoch": "int", "roster": "list"},
+        "required": ["type", "epoch", "roster"],
+    },
+    "reservation.HEARTBEAT": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "HEARTBEAT",
+        "role": "request",
+        "fields": {"type": "str", "executor_id": "int"},
+        "required": ["type", "executor_id"],
+    },
+    "reservation.HEARTBEAT.reply": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "OK",
+        "role": "reply",
+        "fields": {
+            "type": "str",
+            "stop": "bool",
+            "epoch": "int",
+            "server_unix": "float",
+        },
+        "required": ["type", "stop", "epoch", "server_unix"],
+    },
+    "reservation.ICURSOR": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "ICURSOR",
+        "role": "request",
+        "fields": {"type": "str", "executor_id": "int", "payload": "dict"},
+        "required": ["type", "executor_id"],
+    },
+    "reservation.ICURSOR.reply": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "OK",
+        "role": "reply",
+        "fields": {"type": "str"},
+        "required": ["type"],
+    },
+    "reservation.STOP": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "STOP",
+        "role": "request",
+        "fields": {"type": "str"},
+        "required": ["type"],
+    },
+    "reservation.STOP.reply": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "OK",
+        "role": "reply",
+        "fields": {"type": "str"},
+        "required": ["type"],
+    },
+    "reservation.ERR": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "message",
+        "kind": "ERR",
+        "role": "reply",
+        "fields": {"type": "str", "error": "str"},
+        "required": ["type", "error"],
+    },
+    # -- manager KV wires (cluster/manager.py kdict; driver ↔ node).
+    "kv.ingest_plan": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "kv",
+        "kv_key": "ingest_plan",
+        "fields": {
+            "epoch": "int",
+            "plan_id": "str|null",
+            "shard_index": "int",
+            "num_shards": "int",
+            "manifests": "list",
+            "handover": "bool",
+            "complete": "bool",
+        },
+        "required": ["epoch", "shard_index", "num_shards", "manifests"],
+    },
+    "kv.feed_knobs": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "kv",
+        "kv_key": "feed_knobs",
+        "fields": {"seq": "int", "knobs": "dict"},
+        "required": ["seq", "knobs"],
+    },
+    "kv.feed_timeout": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "kv",
+        "kv_key": "feed_timeout",
+        "codec": "scalar",
+        "fields": {"value": "float"},
+        "required": ["value"],
+    },
+    "kv.node_state": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "kv",
+        "kv_key": "state",
+        "codec": "scalar",
+        "fields": {"value": "str"},
+        "required": ["value"],
+        "values": ["running", "terminating", "finished", "error"],
+    },
+    "kv.elastic_state": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "kv",
+        "kv_key": "elastic:state",
+        "codec": "scalar",
+        "fields": {"value": "bytes"},
+        "required": ["value"],
+    },
+    # -- replay cursors (persisted beside checkpoints, shipped through
+    #    ICURSOR, merged by the driver's shard re-planner). An entry is
+    #    a bare int ``seq`` or a two-int ``[seq, skip]`` — both forms
+    #    are live on the wire forever.
+    "ingest.cursor_entry": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "entry",
+        "codec": "cursor_entry",
+        "fields": {"seq": "int", "skip": "int"},
+        "required": ["seq"],
+    },
+    "ingest.cursor_payload": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "message",
+        "kind": None,
+        "role": None,
+        "fields": {
+            "epoch": "int",
+            "final": "bool",
+            "done": "bool",
+            "cursor": "dict",
+            "records_per_chunk": "int|null",
+            "frame_blocks": "bool|null",
+        },
+        "required": ["epoch", "final", "cursor"],
+    },
+    # -- columnar frame header (feed/columnar.py ``TFC\\x01`` frames:
+    #    shm ring, TCP feed, framed shard files). The header dict is
+    #    pickled in declared order; payload layout comes from ``cols``.
+    "columnar.frame_header": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "frame",
+        "fields": {
+            "v": "int",
+            "qname": "str|null",
+            "kind": "str",
+            "n": "int",
+            "cols": "list",
+            "payload_crc": "int|null",
+            "stream": "str|null",
+            "seq": "int",
+        },
+        "required": ["v", "kind", "n", "cols", "seq"],
+    },
+    # -- weight-rollout publication channel (serving/rollout.py LATEST
+    #    pointer: one JSON record, CRC-framed for torn-write rejection).
+    "rollout.manifest": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "pointer",
+        "fields": {
+            "version": "str",
+            "kind": "str",
+            "path": "str",
+            "step": "int|null",
+        },
+        "required": ["version", "kind", "path"],
+    },
+    "rollout.latest": {
+        "version": 1,
+        "compat": "frozen",
+        "transport": "pointer",
+        "fields": {"crc": "int", "manifest": "dict"},
+        "required": ["crc", "manifest"],
+    },
+    # -- serve_model HTTP bodies (tools/serve_model.py ↔ serving/
+    #    fleet.py + external clients; NDJSON stream lines + trailers).
+    "serve.error": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "http",
+        "fields": {
+            "error": "str",
+            "error_type": "str",
+            "retry_after_src": "str",
+            "outcome": "str",
+            "trace": "str",
+        },
+        "required": ["error"],
+    },
+    "serve.completion": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "http",
+        "fields": {
+            "completions": "list",
+            "logprobs": "list",
+            "weights_versions": "list",
+            "trace": "str",
+        },
+        "required": ["completions"],
+    },
+    "serve.stream_chunk": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "http",
+        "fields": {"token": "any", "logprob": "float"},
+        "required": ["token"],
+    },
+    "serve.stream_trailer": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "http",
+        "fields": {
+            "done": "bool",
+            "completion": "any",
+            "logprobs": "list",
+            "weights_version": "str",
+            "trace": "str",
+        },
+        "required": ["done", "completion"],
+    },
+    "serve.stream_error": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "http",
+        "fields": {"error": "str", "error_type": "str", "trace": "str"},
+        "required": ["error"],
+    },
+    "serve.reload": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "http",
+        "fields": {
+            "status": "str",
+            "version": "str",
+            "swap_seconds": "float",
+        },
+        "required": ["status"],
+    },
+}
+
+
+# ---------------------------------------------------------------------------
+# errors
+# ---------------------------------------------------------------------------
+
+
+class WireError(ValueError):
+    """Base for all wire-codec failures (a ValueError so transport
+    loops that already treat malformed input as a connection-level
+    reject — ``MessageSocket.receive``, ``decode_frame`` — keep
+    working)."""
+
+
+class WireSchemaError(WireError):
+    """Producer-side misuse: unknown schema, undeclared field, missing
+    required field, bad type AT CONSTRUCTION. Always a programming
+    error at the call site — never data-dependent."""
+
+
+class WireDecodeError(WireError):
+    """Consumer-side rejection: the payload does not satisfy the
+    declared schema (wrong kind, missing required field, bad type).
+    Data-dependent — a torn write or a foreign speaker, not
+    necessarily a bug here."""
+
+
+# ---------------------------------------------------------------------------
+# schema lookup
+# ---------------------------------------------------------------------------
+
+
+def schema(name: str) -> dict:
+    """The declared schema entry, or raise :class:`WireSchemaError`."""
+    try:
+        return WIRE_SCHEMAS[name]
+    except KeyError:
+        raise WireSchemaError(
+            f"undeclared wire schema {name!r} — declare it in "
+            "cluster/wire.py WIRE_SCHEMAS"
+        ) from None
+
+
+def kv_key(name: str) -> str:
+    """The manager-KV key string a ``kv.*`` schema rides on."""
+    sc = schema(name)
+    try:
+        return sc["kv_key"]
+    except KeyError:
+        raise WireSchemaError(f"{name!r} is not a KV schema") from None
+
+
+def message_kind(msg: Any) -> str | None:
+    """The wire ``type`` tag of a raw reservation message (the ONE
+    sanctioned peek at an undecoded message — dispatch on this, then
+    :func:`decode` with the kind's schema)."""
+    if isinstance(msg, dict):
+        kind = msg.get("type")
+        return kind if isinstance(kind, str) else None
+    return None
+
+
+def kind_to_schema(kind: str) -> str | None:
+    """Schema name for a request-side message kind, or None when the
+    kind is undeclared (the server's unknown-type ERR path)."""
+    return _REQUEST_KINDS.get(kind)
+
+
+# ---------------------------------------------------------------------------
+# type checking
+# ---------------------------------------------------------------------------
+
+_TYPES = {
+    "str": str,
+    "int": int,
+    "float": (int, float),
+    "bool": bool,
+    "list": (list, tuple),
+    "dict": dict,
+    "bytes": (bytes, bytearray),
+}
+
+
+def _type_ok(value: Any, typestr: str) -> bool:
+    for alt in typestr.split("|"):
+        if alt in ("null", "none"):
+            if value is None:
+                return True
+            continue
+        if alt == "any":
+            return True
+        base = _TYPES[alt]
+        if isinstance(value, bool) and alt not in ("bool", "any"):
+            continue  # bool is an int in Python, not on the wire
+        if isinstance(value, base):
+            return True
+    return False
+
+
+def _check_field(name: str, field: str, value: Any, typestr: str,
+                 exc: type) -> None:
+    if not _type_ok(value, typestr):
+        raise exc(
+            f"{name}.{field}: expected {typestr}, got "
+            f"{type(value).__name__} ({value!r})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# the sanctioned codecs
+# ---------------------------------------------------------------------------
+
+
+def encode(name: str, **fields: Any) -> Any:
+    """Construct one wire value for schema ``name``.
+
+    - message schemas return the dict WITH the ``type`` tag injected
+      (callers never spell the kind literal);
+    - dict schemas (KV, header, pointer, HTTP) return the dict with
+      keys in declared wire order — byte-deterministic under both
+      ``json.dumps`` and pickle;
+    - scalar schemas take ``value=`` and return the bare value;
+    - the cursor-entry schema takes ``seq=``/``skip=`` and returns the
+      bare int / two-int list the persisted format uses.
+
+    Undeclared fields, missing required fields, and type mismatches
+    raise :class:`WireSchemaError` at the producer — the earliest
+    possible moment."""
+    sc = schema(name)
+    codec = sc.get("codec")
+    if codec == "scalar":
+        extra = set(fields) - {"value"}
+        if extra or "value" not in fields:
+            raise WireSchemaError(
+                f"{name}: scalar schema takes exactly value=, got "
+                f"{sorted(fields)}"
+            )
+        value = fields["value"]
+        _check_field(name, "value", value, sc["fields"]["value"],
+                     WireSchemaError)
+        values = sc.get("values")
+        if values is not None and value not in values:
+            raise WireSchemaError(
+                f"{name}: value {value!r} not in declared enum {values}"
+            )
+        return value
+    if codec == "cursor_entry":
+        extra = set(fields) - {"seq", "skip"}
+        if extra or "seq" not in fields:
+            raise WireSchemaError(
+                f"{name}: cursor entries take seq= and optional skip=, "
+                f"got {sorted(fields)}"
+            )
+        return encode_cursor_entry(fields["seq"], fields.get("skip", 0))
+    declared = sc["fields"]
+    kind = sc.get("kind")
+    if kind is not None and "type" in fields:
+        raise WireSchemaError(
+            f"{name}: the codec owns the 'type' tag — do not pass it"
+        )
+    undeclared = [k for k in fields if k not in declared]
+    if undeclared:
+        raise WireSchemaError(
+            f"{name}: undeclared field(s) {undeclared} — declare them "
+            "in WIRE_SCHEMAS (and bump the version per the compat "
+            "policy) before writing them"
+        )
+    for req in sc["required"]:
+        if req == "type" and kind is not None:
+            continue
+        if req not in fields:
+            raise WireSchemaError(f"{name}: missing required field {req!r}")
+    out: dict[str, Any] = {}
+    for k, typestr in declared.items():  # declared order == wire order
+        if k == "type" and kind is not None:
+            out["type"] = kind
+            continue
+        if k in fields:
+            _check_field(name, k, fields[k], typestr, WireSchemaError)
+            out[k] = fields[k]
+    return out
+
+
+def decode(name: str, payload: Any) -> dict[str, Any]:
+    """Validate one received wire value against schema ``name`` and
+    return its declared fields (scalar schemas come back as
+    ``{"value": ...}``; cursor entries as ``{"seq", "skip"}``).
+
+    Required fields must be present with declared types; undeclared
+    extras are IGNORED — that asymmetry is the rolling-upgrade
+    tolerance: an old reader survives an add-only-optional publisher.
+    Rejection raises :class:`WireDecodeError`."""
+    sc = schema(name)
+    codec = sc.get("codec")
+    if codec == "scalar":
+        _check_field(name, "value", payload, sc["fields"]["value"],
+                     WireDecodeError)
+        values = sc.get("values")
+        if values is not None and payload not in values:
+            raise WireDecodeError(
+                f"{name}: value {payload!r} not in declared enum {values}"
+            )
+        return {"value": payload}
+    if codec == "cursor_entry":
+        seq, skip = decode_cursor_entry(payload)
+        return {"seq": seq, "skip": skip}
+    if not isinstance(payload, dict):
+        raise WireDecodeError(
+            f"{name}: expected a dict payload, got "
+            f"{type(payload).__name__}"
+        )
+    kind = sc.get("kind")
+    if kind is not None and payload.get("type") != kind:
+        raise WireDecodeError(
+            f"{name}: expected type {kind!r}, got "
+            f"{payload.get('type')!r}"
+        )
+    for req in sc["required"]:
+        if req not in payload:
+            raise WireDecodeError(
+                f"{name}: missing required field {req!r}"
+            )
+    out: dict[str, Any] = {}
+    for k, typestr in sc["fields"].items():
+        if k in payload:
+            _check_field(name, k, payload[k], typestr, WireDecodeError)
+            out[k] = payload[k]
+    return out
+
+
+def encode_cursor_entry(seq: Any, skip: Any = 0):
+    """One replay-cursor entry in its persisted wire form: the bare int
+    ``seq`` when no mid-block skip exists, else the two-int
+    ``[seq, skip]`` pair — exactly the two forms
+    :func:`decode_cursor_entry` accepts forever."""
+    seq = int(seq)
+    skip = int(skip)
+    return seq if skip == 0 else [seq, skip]
+
+
+def decode_cursor_entry(v: Any) -> tuple[int, int]:
+    """Canonical ``(seq, skip)`` of one replay-cursor entry — THE
+    serialization both data planes (and the driver's shard re-planner)
+    agree on. Accepts the plain-int ``seq`` form (push plane) and the
+    ``[seq, skip]`` pair (pull plane's record-exact mid-block form);
+    anything else is malformed."""
+    if isinstance(v, (list, tuple)):
+        if len(v) != 2:
+            raise WireDecodeError(
+                f"malformed cursor entry {v!r}: want [seq, skip]"
+            )
+        return int(v[0]), int(v[1])
+    return int(v), 0
+
+
+# ---------------------------------------------------------------------------
+# KV key registry (derived from the table so the string exists ONCE;
+# analysis/wire.py resolves these names back to their keys by AST)
+# ---------------------------------------------------------------------------
+
+
+def _kv_key_of(name: str) -> str:
+    return WIRE_SCHEMAS[name]["kv_key"]
+
+
+INGEST_PLAN_KEY = _kv_key_of("kv.ingest_plan")
+FEED_KNOBS_KEY = _kv_key_of("kv.feed_knobs")
+FEED_TIMEOUT_KEY = _kv_key_of("kv.feed_timeout")
+NODE_STATE_KEY = _kv_key_of("kv.node_state")
+ELASTIC_STATE_KEY = _kv_key_of("kv.elastic_state")
+
+
+# ---------------------------------------------------------------------------
+# table sanity (import-time: a malformed catalog entry is a programming
+# error that must not survive to a wire call)
+# ---------------------------------------------------------------------------
+
+
+def _validate_table() -> dict[str, str]:
+    request_kinds: dict[str, str] = {}
+    kv_keys: dict[str, str] = {}
+    for name, sc in WIRE_SCHEMAS.items():
+        assert isinstance(sc.get("version"), int) and sc["version"] >= 1, name
+        assert sc.get("compat") in ("frozen", "add_only_optional"), name
+        fields = sc.get("fields")
+        assert isinstance(fields, dict) and fields, name
+        for f, t in fields.items():
+            for alt in t.split("|"):
+                assert alt in _TYPES or alt in ("any", "null"), (name, f, t)
+        assert set(sc.get("required", ())) <= set(fields), name
+        kind = sc.get("kind")
+        if kind is not None and sc.get("role") == "request":
+            assert kind not in request_kinds, f"duplicate kind {kind}"
+            request_kinds[kind] = name
+        key = sc.get("kv_key")
+        if key is not None:
+            assert key not in kv_keys, f"duplicate kv key {key}"
+            kv_keys[key] = name
+    return request_kinds
+
+
+_REQUEST_KINDS = _validate_table()

@@ -43,6 +43,7 @@ from typing import Any, Callable, Mapping
 
 import numpy as np
 
+from tensorflowonspark_tpu.cluster import wire
 from tensorflowonspark_tpu.obs import flightrec
 from tensorflowonspark_tpu.obs import spans as obs_spans
 from tensorflowonspark_tpu.obs.registry import default_registry
@@ -63,8 +64,10 @@ __all__ = [
 ]
 
 # Default manager-KV key a survivor publishes its host snapshot under
-# (what a joiner's peer hydration reads).
-STATE_KEY = "elastic:state"
+# (what a joiner's peer hydration reads). Declared in cluster/wire.py
+# WIRE_SCHEMAS ("kv.elastic_state") — this re-export keeps the
+# compute-plane import name.
+STATE_KEY = wire.ELASTIC_STATE_KEY
 
 
 class InMemoryRecoveryUnavailable(RuntimeError):

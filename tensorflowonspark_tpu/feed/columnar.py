@@ -66,6 +66,8 @@ from zlib import crc32
 
 import numpy as np
 
+from tensorflowonspark_tpu.cluster import wire
+
 logger = logging.getLogger(__name__)
 
 __all__ = [
@@ -422,16 +424,19 @@ def encode_parts(
             payload_crc = crc32(a, payload_crc)
         off = _align(off + nb)
     header = pickle.dumps(
-        {
-            "v": 1,
-            "qname": qname,
-            "kind": chunk.kind,
-            "n": chunk.n,
-            "cols": cols,
-            "payload_crc": payload_crc,
-            "stream": stream,
-            "seq": seq,
-        },
+        # Declared-order encode keeps the pickled header byte-identical
+        # to every frame ever written (schema: columnar.frame_header).
+        wire.encode(
+            "columnar.frame_header",
+            v=1,
+            qname=qname,
+            kind=chunk.kind,
+            n=int(chunk.n),
+            cols=cols,
+            payload_crc=payload_crc,
+            stream=stream,
+            seq=int(seq),
+        ),
         protocol=pickle.HIGHEST_PROTOCOL,
     )
     head = _PREFIX.pack(MAGIC, len(header), crc32(header)) + header
@@ -494,7 +499,7 @@ def decode_frame(buf, path: str | None = None) -> ColumnChunk:
     header_bytes = bytes(mv[_PREFIX.size : _PREFIX.size + hlen])
     if len(header_bytes) != hlen or crc32(header_bytes) != hcrc:
         raise ValueError("columnar frame header CRC mismatch (corrupt frame)")
-    h = pickle.loads(header_bytes)
+    h = wire.decode("columnar.frame_header", pickle.loads(header_bytes))
     payload_start = _align(_PREFIX.size + hlen)
     verify = _VERIFY_PAYLOAD and h.get("payload_crc") is not None
     keys, arrays = [], []
@@ -532,7 +537,7 @@ def _frame_header(mv, offset: int = 0) -> tuple[dict, int]:
     header_bytes = bytes(
         mv[offset + _PREFIX.size : offset + _PREFIX.size + hlen]
     )
-    h = pickle.loads(header_bytes)
+    h = wire.decode("columnar.frame_header", pickle.loads(header_bytes))
     payload = 0
     for _, _, _, off, nb in h["cols"]:
         payload = max(payload, _align(off + nb))

@@ -22,6 +22,7 @@ from typing import Any, Sequence
 
 import numpy as np
 
+from tensorflowonspark_tpu.cluster import wire
 from tensorflowonspark_tpu.cluster.marker import EndOfFeed, EndPartition, Marker
 from tensorflowonspark_tpu.feed.columnar import (
     ColumnAssembler,
@@ -58,12 +59,13 @@ def normalize_cursor_entry(v: Any) -> tuple[int, int]:
     format) or a ``[seq, skip]`` pair (additionally the first ``skip``
     records of block ``seq + 1`` left in batches — the pull plane's
     record-exact mid-block form). Entries are JSON round-trip safe by
-    construction: ints and two-int lists."""
-    if isinstance(v, (list, tuple)):
-        if len(v) != 2:
-            raise ValueError(f"malformed cursor entry {v!r}: want [seq, skip]")
-        return int(v[0]), int(v[1])
-    return int(v), 0
+    construction: ints and two-int lists.
+
+    The wire form itself is declared in ``cluster/wire.py`` (schema
+    ``ingest.cursor_entry``); this is the feed-plane name for its
+    decoder, kept because every consumer in both planes imports it
+    from here."""
+    return wire.decode_cursor_entry(v)
 
 
 def cursor_covers(a: Any, b: Any) -> bool:
@@ -416,9 +418,11 @@ class DataFeed:
         never latched as a default, so a publish that lands after the
         first pull still takes effect."""
         if self._feed_timeout is None:
-            published = self.mgr.get("feed_timeout")
+            published = self.mgr.get(wire.FEED_TIMEOUT_KEY)
             if published is not None:
-                self._feed_timeout = float(published)
+                self._feed_timeout = float(
+                    wire.decode("kv.feed_timeout", published)["value"]
+                )
         return self._feed_timeout
 
     def _pull(self):
@@ -534,7 +538,10 @@ class DataFeed:
         the top of ``TFSparkNode._train``).
         """
         logger.info("DataFeed terminating; draining input queue")
-        self.mgr.set("state", "terminating")
+        self.mgr.set(
+            wire.NODE_STATE_KEY,
+            wire.encode("kv.node_state", value="terminating"),
+        )
         # Idle window for "the queue is drained": policy-driven (bounded
         # by the feed timeout when one exists) rather than a hardcoded
         # constant, but still short — this is a quiet-period detector,

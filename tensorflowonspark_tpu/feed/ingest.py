@@ -57,6 +57,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Iterator, Sequence
 
+from tensorflowonspark_tpu.cluster import wire
 from tensorflowonspark_tpu.feed.columnar import ColumnAssembler, ColumnChunk
 from tensorflowonspark_tpu.feed.datafeed import (
     ReplayCursor,
@@ -464,7 +465,9 @@ class IngestFeed:
         if self._delivered and self._head_consumed:
             s, q, _ln, base = self._delivered[0]
             if s is not None:
-                out[s] = [q - 1, base + self._head_consumed]
+                out[s] = wire.encode_cursor_entry(
+                    q - 1, base + self._head_consumed
+                )
         return out
 
     def seed_cursor(self, cursor: dict[str, Any]) -> None:
@@ -490,9 +493,9 @@ class IngestFeed:
                     seed[s] = seq0
                 if skip > 0:
                     self._pending_skip[s] = (seq0 + 1, skip)
-                    self._done[s] = [seq0, skip]
+                    self._done[s] = wire.encode_cursor_entry(seq0, skip)
                 elif seq0 >= 0:
-                    self._done[s] = seq0
+                    self._done[s] = wire.encode_cursor_entry(seq0)
         self._seq.seed(seed)
 
     # -- live shard redistribution (the handover protocol) --------------
@@ -532,21 +535,22 @@ class IngestFeed:
             return
         if epoch is None:
             epoch = self.plan_epoch
-        payload = {
-            "epoch": int(epoch),
-            "final": bool(final),
+        payload = wire.encode(
+            "ingest.cursor_payload",
+            epoch=int(epoch),
+            final=bool(final),
             # done = this consumer will NEVER consume again (final OR
             # terminated): the driver stops waiting on it, stops
             # assigning it work, and completion need not require a
             # fresh stamp from it
-            "done": bool(final if done is None else done),
-            "cursor": self.cursor(),
-            "records_per_chunk": self._records_per_chunk,
+            done=bool(final if done is None else done),
+            cursor=self.cursor(),
+            records_per_chunk=self._records_per_chunk,
             # block→record math hint for the driver's re-planner: a
             # custom reader streams records_per_chunk blocks even over
             # 'columnar'-format manifests
-            "frame_blocks": False if self._user_reader is not None else None,
-        }
+            frame_blocks=False if self._user_reader is not None else None,
+        )
         try:
             t0 = time.perf_counter()
             self._cursor_publish(payload)

@@ -69,6 +69,7 @@ import threading
 import time
 import zlib
 
+from tensorflowonspark_tpu.cluster import wire
 from tensorflowonspark_tpu.obs import flightrec, reqtrace
 from tensorflowonspark_tpu.obs import registry as obs_registry
 from tensorflowonspark_tpu.serving.engine import WeightsIncompatible
@@ -143,12 +144,13 @@ def publish_checkpoint(
     itself is fully written (``CheckpointManager.wait()`` for async
     saves) — :func:`read_latest` independently refuses incomplete
     checkpoint directories."""
-    manifest = {
-        "version": str(version),
-        "kind": str(kind),
-        "path": os.path.abspath(path) if "://" not in path else path,
-        "step": None if step is None else int(step),
-    }
+    manifest = wire.encode(
+        "rollout.manifest",
+        version=str(version),
+        kind=str(kind),
+        path=os.path.abspath(path) if "://" not in path else path,
+        step=None if step is None else int(step),
+    )
     if failpoint("rollout.publish") == "drop":
         # a LOST publication: watchers simply keep serving the prior
         # version until the next publish — staleness, never corruption
@@ -159,7 +161,9 @@ def publish_checkpoint(
         return manifest
     body = _manifest_body(manifest)
     record = json.dumps(
-        {"crc": zlib.crc32(body), "manifest": manifest}
+        wire.encode(
+            "rollout.latest", crc=zlib.crc32(body), manifest=manifest
+        )
     )
     os.makedirs(channel_dir, exist_ok=True)
     tmp = os.path.join(
@@ -212,14 +216,17 @@ def read_latest(channel_dir: str) -> WeightsUpdate | None:
     except OSError:
         return None
     try:
-        doc = json.loads(raw)
-        manifest = doc["manifest"]
-        if int(doc["crc"]) != zlib.crc32(_manifest_body(manifest)):
+        doc = wire.decode("rollout.latest", json.loads(raw))
+        # CRC over the manifest AS WRITTEN (extras included) — a newer
+        # add-only publisher's pointer still verifies on this reader.
+        raw_manifest = doc["manifest"]
+        if int(doc["crc"]) != zlib.crc32(_manifest_body(raw_manifest)):
             logger.warning(
                 "rollout channel %s: LATEST pointer CRC mismatch "
                 "(torn write) — ignored", channel_dir,
             )
             return None
+        manifest = wire.decode("rollout.manifest", raw_manifest)
         version = str(manifest["version"])
         kind = str(manifest.get("kind") or "full")
         path = manifest.get("path")

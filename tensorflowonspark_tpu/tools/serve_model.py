@@ -102,6 +102,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
+from tensorflowonspark_tpu.cluster import wire
 from tensorflowonspark_tpu.obs import reqtrace
 from tensorflowonspark_tpu.tools.run_model import _to_jsonable
 
@@ -396,7 +397,11 @@ class _Handler(BaseHTTPRequestHandler):
                 name="admin-rollout",
             ).start()
             self._reply(
-                202, {"status": "rolling", "version": update.version}
+                202,
+                wire.encode(
+                    "serve.reload", status="rolling",
+                    version=update.version,
+                ),
             )
             return
         t0 = time.monotonic()
@@ -406,32 +411,37 @@ class _Handler(BaseHTTPRequestHandler):
             logger.exception("admin reload crashed")
             self._reply(
                 500,
-                {"error": f"{type(e).__name__}: {e}",
-                 "error_type": type(e).__name__},
+                wire.encode(
+                    "serve.error",
+                    error=f"{type(e).__name__}: {e}",
+                    error_type=type(e).__name__,
+                ),
             )
             return
         if outcome == "completed":
             self._reply(
                 200,
-                {
-                    "status": "completed",
-                    "version": update.version,
-                    "swap_seconds": round(time.monotonic() - t0, 3),
-                },
+                wire.encode(
+                    "serve.reload",
+                    status="completed",
+                    version=update.version,
+                    swap_seconds=round(time.monotonic() - t0, 3),
+                ),
             )
             return
         err = ctl.last_error or {}
         etype = err.get("type", "RolloutFailed")
         self._reply(
             409 if etype == "WeightsIncompatible" else 500,
-            {
-                "error": (
+            wire.encode(
+                "serve.error",
+                error=(
                     f"rollout {outcome}: "
                     f"{err.get('error', 'unknown failure')}"
                 ),
-                "error_type": etype,
-                "outcome": outcome,
-            },
+                error_type=etype,
+                outcome=outcome,
+            ),
         )
 
     def _do_score(self) -> None:
@@ -718,9 +728,11 @@ class _Handler(BaseHTTPRequestHandler):
                     # queue-depth/EWMA estimate, not a fixed backoff)
                     self._reply(
                         429,
-                        {"error": str(e),
-                         "error_type": "FleetOverloaded",
-                         "retry_after_src": "router_estimate"},
+                        wire.encode(
+                            "serve.error", error=str(e),
+                            error_type="FleetOverloaded",
+                            retry_after_src="router_estimate",
+                        ),
                         {"Retry-After": str(int(math.ceil(e.retry_after)))},
                     )
                     return
@@ -728,18 +740,22 @@ class _Handler(BaseHTTPRequestHandler):
                     # full-fleet drain / no ready replica
                     self._reply(
                         503,
-                        {"error": str(e),
-                         "error_type": "FleetUnavailable",
-                         "retry_after_src": "static"},
+                        wire.encode(
+                            "serve.error", error=str(e),
+                            error_type="FleetUnavailable",
+                            retry_after_src="static",
+                        ),
                         {"Retry-After": "2"},
                     )
                     return
                 except EngineOverloaded as e:
                     self._reply(
                         503,
-                        {"error": str(e),
-                         "error_type": "EngineOverloaded",
-                         "retry_after_src": "static"},
+                        wire.encode(
+                            "serve.error", error=str(e),
+                            error_type="EngineOverloaded",
+                            retry_after_src="static",
+                        ),
                         {"Retry-After": "1"},
                     )
                     return
@@ -749,8 +765,10 @@ class _Handler(BaseHTTPRequestHandler):
                     # outcome, not a server defect
                     self._reply(
                         504,
-                        {"error": str(e),
-                         "error_type": "DeadlineExceeded"},
+                        wire.encode(
+                            "serve.error", error=str(e),
+                            error_type="DeadlineExceeded",
+                        ),
                     )
                     return
                 except (EngineWedged, ReplicaGone) as e:
@@ -760,9 +778,11 @@ class _Handler(BaseHTTPRequestHandler):
                     # unavailability, not a generic 500
                     self._reply(
                         503,
-                        {"error": str(e),
-                         "error_type": type(e).__name__,
-                         "retry_after_src": "static"},
+                        wire.encode(
+                            "serve.error", error=str(e),
+                            error_type=type(e).__name__,
+                            retry_after_src="static",
+                        ),
                         {"Retry-After": "1"},
                     )
                     return
@@ -833,12 +853,12 @@ class _Handler(BaseHTTPRequestHandler):
                 },
             )
             return
-        body = {"completions": completions}
+        kw: dict[str, Any] = {"completions": completions}
         if logprobs is not None:
-            body["logprobs"] = logprobs
+            kw["logprobs"] = logprobs
         if versions is not None:
-            body["weights_versions"] = versions
-        self._reply(200, body)
+            kw["weights_versions"] = versions
+        self._reply(200, wire.encode("serve.completion", **kw))
 
     def _engine_stream(
         self,
@@ -894,24 +914,33 @@ class _Handler(BaseHTTPRequestHandler):
         except FleetOverloaded as e:
             self._reply(
                 429,
-                {"error": str(e), "error_type": "FleetOverloaded",
-                 "retry_after_src": "router_estimate"},
+                wire.encode(
+                    "serve.error", error=str(e),
+                    error_type="FleetOverloaded",
+                    retry_after_src="router_estimate",
+                ),
                 {"Retry-After": str(int(math.ceil(e.retry_after)))},
             )
             return
         except (FleetUnavailable, ReplicaGone) as e:
             self._reply(
                 503,
-                {"error": str(e), "error_type": type(e).__name__,
-                 "retry_after_src": "static"},
+                wire.encode(
+                    "serve.error", error=str(e),
+                    error_type=type(e).__name__,
+                    retry_after_src="static",
+                ),
                 {"Retry-After": "2"},
             )
             return
         except EngineOverloaded as e:
             self._reply(
                 503,
-                {"error": str(e), "error_type": "EngineOverloaded",
-                 "retry_after_src": "static"},
+                wire.encode(
+                    "serve.error", error=str(e),
+                    error_type="EngineOverloaded",
+                    retry_after_src="static",
+                ),
                 {"Retry-After": "1"},
             )
             return
@@ -929,10 +958,12 @@ class _Handler(BaseHTTPRequestHandler):
                 if want_logprobs:
                     t, lp = item
                     lps.append(lp)
-                    line = {"token": t, "logprob": lp}
+                    line = wire.encode(
+                        "serve.stream_chunk", token=t, logprob=lp
+                    )
                 else:
                     t = item
-                    line = {"token": t}
+                    line = wire.encode("serve.stream_chunk", token=t)
                 out.append(t)
                 self.wfile.write(json.dumps(line).encode() + b"\n")
                 self.wfile.flush()
@@ -940,16 +971,17 @@ class _Handler(BaseHTTPRequestHandler):
             # streamed tokens include any matched stop suffix); fall
             # back to the raw tokens if the iterator wasn't exhausted
             final = gen.result if gen.result is not None else out
-            trailer = {"done": True, "completion": final}
+            tkw: dict[str, Any] = {"done": True, "completion": final}
             if trace is not None:
-                trailer["trace"] = trace
+                tkw["trace"] = trace
             if want_logprobs:
-                trailer["logprobs"] = (
+                tkw["logprobs"] = (
                     gen.logprobs if gen.result is not None else lps
                 )
             wv = getattr(gen, "weights_version", None)
             if wv is not None:
-                trailer["weights_version"] = wv
+                tkw["weights_version"] = wv
+            trailer = wire.encode("serve.stream_trailer", **tkw)
             self.wfile.write(json.dumps(trailer).encode() + b"\n")
         except (BrokenPipeError, ConnectionResetError):
             logger.info("stream client disconnected")
@@ -957,7 +989,7 @@ class _Handler(BaseHTTPRequestHandler):
             logger.exception("stream failed mid-decode")
             reqtrace.flag(trace, error=type(e).__name__)
             try:
-                err_line = {
+                ekw: dict[str, Any] = {
                     "error": f"{type(e).__name__}: {e}",
                     # typed so a fleet router fronting THIS server
                     # can reconstruct the engine error
@@ -966,7 +998,8 @@ class _Handler(BaseHTTPRequestHandler):
                 if trace is not None:
                     # the 200 is long gone: the error TRAILER is the
                     # only place the stream's trace id can ride
-                    err_line["trace"] = trace
+                    ekw["trace"] = trace
+                err_line = wire.encode("serve.stream_error", **ekw)
                 self.wfile.write(
                     json.dumps(err_line).encode() + b"\n"
                 )

@@ -1,0 +1,113 @@
+"""Seeded WR001–WR003 violations: wire payloads built and parsed
+behind the cluster/wire.py catalog's back, undeclared message kinds and
+manager-KV keys, and fields no declared schema has — plus the clean
+neighborhoods (sanctioned encode/decode round-trips, registry-constant
+KV calls, dynamic ``type`` tags) that must stay silent."""
+
+from tensorflowonspark_tpu.cluster import wire
+
+
+class MessageSocket:
+    @staticmethod
+    def receive(sock):
+        return {}
+
+
+# -- WR001: raw construction / parsing outside the codec --------------------
+
+
+def raw_message_dict(node):
+    return {"type": "REG", "node": node}  # SEEDED VIOLATION WR001: raw dict
+
+
+def raw_receive_read(sock):
+    msg = MessageSocket.receive(sock)
+    return msg["node"]  # SEEDED VIOLATION WR001: undecoded field read
+
+
+def raw_probe_read(mgr):
+    raw = mgr.get(wire.INGEST_PLAN_KEY)
+    return raw["epoch"]  # SEEDED VIOLATION WR001: undecoded KV read
+
+
+def raw_kv_publish(mgr):
+    # SEEDED VIOLATION WR001: raw dict published to a declared KV wire
+    mgr.set(wire.FEED_KNOBS_KEY, {"seq": 1, "knobs": {}})
+
+
+# -- WR002: undeclared wire names -------------------------------------------
+
+
+def bare_key_probe(mgr):
+    return mgr.get("feed_timeout")  # SEEDED VIOLATION WR002: bare key
+
+
+def undeclared_key_publish(mgr):
+    mgr.set("mystery_key", b"x")  # SEEDED VIOLATION WR002: undeclared key
+
+
+def undeclared_kind():
+    return {"type": "BOGUS"}  # SEEDED VIOLATION WR002: undeclared kind
+
+
+def undeclared_dispatch_arm(msg):
+    mtype = wire.message_kind(msg)
+    if mtype == "NOPE":  # SEEDED VIOLATION WR002: unmatchable arm
+        return True
+    return mtype == "HEARTBEAT"  # a declared kind: not flagged
+
+
+# -- WR003: fields the declared schema does not have ------------------------
+
+
+def undeclared_encode_field(node):
+    # SEEDED VIOLATION WR003: 'rack' is not a reservation.REG field
+    return wire.encode("reservation.REG", node=node, rack="r1")
+
+
+def undeclared_decoded_field(msg):
+    d = wire.decode("reservation.HEARTBEAT.reply", msg)
+    return d["jitter"]  # SEEDED VIOLATION WR003: undeclared field read
+
+
+def undeclared_schema_name(node):
+    # SEEDED VIOLATION WR003: no such schema in WIRE_SCHEMAS
+    return wire.encode("reservation.BOGUS", node=node)
+
+
+# -- the escape hatch: a justification silences the line --------------------
+
+
+def escaped_bare_key(mgr):
+    # a justified exception is NOT flagged
+    return mgr.get("feed_timeout")  # lint: wire-ok: fixture exercises the escape grammar
+
+
+# -- clean neighborhoods: none of these may be flagged ----------------------
+
+
+def sanctioned_round_trip(sock, mgr):
+    msg = MessageSocket.receive(sock)
+    reg = wire.decode("reservation.REG", msg)  # decode clears the taint
+    mgr.set(
+        wire.FEED_KNOBS_KEY,
+        wire.encode("kv.feed_knobs", seq=1, knobs={}),
+    )
+    return reg["node"]  # a declared field of the decoded schema
+
+
+def declared_get_read(msg):
+    d = wire.decode("kv.ingest_plan", msg)
+    return d.get("handover")  # declared optional field: not flagged
+
+
+def dynamic_type_tag(kind):
+    return {"type": kind}  # non-literal tag: not a raw wire dict
+
+
+def unrelated_dict():
+    return {"type": 3, "other": "x"}  # non-string tag: not a wire kind
+
+
+def unrelated_get(cfg):
+    return cfg.get("feed_timeout")  # not a manager receiver: untouched

@@ -2,6 +2,7 @@
 path (llama sharded step + MNIST data plane + JSON assembly) must run,
 not just its relay fail-fast gate."""
 
+import glob
 import json
 import os
 import subprocess
@@ -12,6 +13,61 @@ import pytest
 pytestmark = pytest.mark.e2e
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The committed benchmarks/results/*_smoke.json artifacts are scored on
+# a quiet single-chip host; every regeneration (pytest-driven included)
+# must record the same environment or the drift gate below fails.
+BASELINE_CHIPS = 1
+
+
+def _artifact_env() -> dict:
+    """Subprocess env for bench runs that COMMIT chips-stamped smoke
+    artifacts: the conftest's ``--xla_force_host_platform_device_count
+    =8`` is scrubbed so a pytest-driven regeneration records the
+    host-true chip count instead of 8 faux devices (the drifted-
+    artifact footgun the chips gate exists to catch)."""
+    env = dict(
+        os.environ,
+        BENCH_SMOKE="1",
+        BENCH_ALLOW_CPU="1",
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PALLAS_AXON_REMOTE_COMPILE="",
+    )
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+@pytest.mark.e2e
+def test_committed_smoke_artifacts_record_baseline_chips():
+    """Environment guard: every committed chips-stamped smoke artifact
+    must record the baseline environment (a quiet single-chip host) —
+    a run that inherited pytest's 8-device XLA forcing fails HERE
+    instead of committing a drifted artifact (the PR-17 footgun)."""
+    arts = sorted(
+        glob.glob(
+            os.path.join(REPO, "benchmarks", "results", "*_smoke.json")
+        )
+    )
+    assert arts, "no committed smoke artifacts found"
+    for path in arts:
+        with open(path) as f:
+            art = json.load(f)
+        if "chips" not in art:
+            continue
+        assert art["chips"] == BASELINE_CHIPS, (
+            f"{os.path.relpath(path, REPO)} records chips="
+            f"{art['chips']} (baseline {BASELINE_CHIPS}) — it was "
+            "regenerated under pytest's 8-device XLA forcing; rerun "
+            "bench.py directly on a quiet host (BENCH_SMOKE=1 "
+            "BENCH_ALLOW_CPU=1 JAX_PLATFORMS=cpu, no "
+            "xla_force_host_platform_device_count) before committing"
+        )
 
 
 def test_bench_smoke_emits_complete_json():
@@ -94,14 +150,7 @@ def test_bench_zero_smoke_ab_and_byte_identity():
     measured per leg, the weight-update decomposition is BYTE-IDENTICAL
     across knobs on identical gradients (the ZeRO math owns nothing but
     placement), and the A/B artifact is committed."""
-    env = dict(
-        os.environ,
-        BENCH_SMOKE="1",
-        BENCH_ALLOW_CPU="1",
-        JAX_PLATFORMS="cpu",
-        PALLAS_AXON_POOL_IPS="",
-        PALLAS_AXON_REMOTE_COMPILE="",
-    )
+    env = _artifact_env()
     proc = subprocess.run(
         [sys.executable, "bench.py", "--zero"],
         cwd=REPO,
@@ -135,14 +184,7 @@ def test_bench_serve_slo_smoke_burn_gate_and_trace_proof():
     fire exactly the latency SLO as exactly one rising edge, and the
     proof request's merged timeline must attribute >= 95% of its wall
     time across router -> engine segments."""
-    env = dict(
-        os.environ,
-        BENCH_SMOKE="1",
-        BENCH_ALLOW_CPU="1",
-        JAX_PLATFORMS="cpu",
-        PALLAS_AXON_POOL_IPS="",
-        PALLAS_AXON_REMOTE_COMPILE="",
-    )
+    env = _artifact_env()
     proc = subprocess.run(
         [sys.executable, "bench.py", "--serve-slo"],
         cwd=REPO,
@@ -247,14 +289,7 @@ def test_bench_serve_fleet_smoke_emits_scaling_and_artifact():
     benchmarks/results/serve_fleet_*.json artifact."""
     import math
 
-    env = dict(
-        os.environ,
-        BENCH_SMOKE="1",
-        BENCH_ALLOW_CPU="1",
-        JAX_PLATFORMS="cpu",
-        PALLAS_AXON_POOL_IPS="",
-        PALLAS_AXON_REMOTE_COMPILE="",
-    )
+    env = _artifact_env()
     proc = subprocess.run(
         [sys.executable, "bench.py", "--serve-fleet"],
         cwd=REPO,
@@ -287,14 +322,7 @@ def test_bench_rollout_smoke_zero_downtime_artifact():
     the emitted JSON (and committed artifact) must pass every
     acceptance check — zero dropped/hung requests, admitted p99 within
     the deadline budget, coherent per-completion version stamps."""
-    env = dict(
-        os.environ,
-        BENCH_SMOKE="1",
-        BENCH_ALLOW_CPU="1",
-        JAX_PLATFORMS="cpu",
-        PALLAS_AXON_POOL_IPS="",
-        PALLAS_AXON_REMOTE_COMPILE="",
-    )
+    env = _artifact_env()
     proc = subprocess.run(
         [sys.executable, "bench.py", "--rollout"],
         cwd=REPO,
@@ -329,14 +357,7 @@ def test_bench_autotune_smoke_recovers_and_audits():
     let the controller recover >=90% of the hand-tuned throughput
     online. Every knob move must be on the flight record, and at least
     one leg must exercise the revert path (hill-climb past the peak)."""
-    env = dict(
-        os.environ,
-        BENCH_SMOKE="1",
-        BENCH_ALLOW_CPU="1",
-        JAX_PLATFORMS="cpu",
-        PALLAS_AXON_POOL_IPS="",
-        PALLAS_AXON_REMOTE_COMPILE="",
-    )
+    env = _artifact_env()
     env.pop("TFOS_AUTOTUNE", None)  # the leg under test tunes live
     proc = subprocess.run(
         [sys.executable, "bench.py", "--autotune"],

@@ -387,6 +387,88 @@ def test_sh_clean_fixture_has_table_consumers(fixture_findings):
     assert "in_shardings" in src
 
 
+# -- WR: wire-schema discipline (wirecheck static head) ---------------------
+
+
+def test_wr001_raw_wire_construction_and_parsing(fixture_findings):
+    """WR001: wire payloads built or parsed outside the codec — a raw
+    ``"type"``-tagged dict for a declared kind, undecoded field reads
+    on a ``MessageSocket.receive`` / declared-KV-probe result, and a
+    raw dict published to a declared KV key."""
+    rel = f"{FIXTURES}/bad_wire.py"
+    hits = by_rule(fixture_findings, "WR001")
+    assert all(f.path == rel for f in hits), [f.render() for f in hits]
+    assert {f.line for f in hits} == {
+        _line_of("bad_wire.py", '{"type": "REG", "node": node}'),
+        _line_of("bad_wire.py", 'msg["node"]  # SEEDED'),
+        _line_of("bad_wire.py", 'raw["epoch"]  # SEEDED'),
+        _line_of("bad_wire.py", 'mgr.set(wire.FEED_KNOBS_KEY, {"seq"'),
+    }, [f.render() for f in hits]
+
+
+def test_wr002_undeclared_wire_names(fixture_findings):
+    """WR002: a bare declared-KV-key literal (spell the constant), an
+    undeclared KV key, an undeclared ``"type"`` kind literal, and a
+    dispatch arm comparing a ``wire.message_kind`` result against an
+    unmatchable kind."""
+    rel = f"{FIXTURES}/bad_wire.py"
+    hits = by_rule(fixture_findings, "WR002")
+    assert all(f.path == rel for f in hits), [f.render() for f in hits]
+    assert {f.line for f in hits} == {
+        _line_of("bad_wire.py", 'mgr.get("feed_timeout")  # SEEDED'),
+        _line_of("bad_wire.py", 'mgr.set("mystery_key"'),
+        _line_of("bad_wire.py", '{"type": "BOGUS"}'),
+        _line_of("bad_wire.py", 'mtype == "NOPE"'),
+    }, [f.render() for f in hits]
+    bare = [f for f in hits if "registry constant" in f.message]
+    undeclared = [f for f in hits if "not declared" in f.message]
+    assert len(bare) == 1 and len(undeclared) == 3
+    # the declared-kind comparison arm stays silent
+    ok_line = _line_of("bad_wire.py", 'mtype == "HEARTBEAT"')
+    assert ok_line not in {f.line for f in hits}
+
+
+def test_wr003_undeclared_fields_and_schemas(fixture_findings):
+    """WR003: an encode keyword absent from the declared schema, a read
+    of an undeclared field on a decoded value, and a codec call naming
+    a schema the catalog does not declare — each message names the
+    schema AND the field."""
+    rel = f"{FIXTURES}/bad_wire.py"
+    hits = by_rule(fixture_findings, "WR003")
+    assert all(f.path == rel for f in hits), [f.render() for f in hits]
+    assert {f.line for f in hits} == {
+        _line_of("bad_wire.py", 'rack="r1"'),
+        _line_of("bad_wire.py", 'd["jitter"]'),
+        _line_of("bad_wire.py", '"reservation.BOGUS", node=node'),
+    }, [f.render() for f in hits]
+    rack = [f for f in hits if "'rack'" in f.message]
+    jitter = [f for f in hits if "'jitter'" in f.message]
+    assert rack and "reservation.REG" in rack[0].message
+    assert jitter and "reservation.HEARTBEAT.reply" in jitter[0].message
+
+
+def test_wr_wire_ok_escape(fixture_findings):
+    line = _line_of("bad_wire.py", "lint: wire-ok: fixture")
+    assert not [
+        f
+        for f in fixture_findings
+        if f.line == line and f.path.endswith("bad_wire.py")
+    ]
+
+
+def test_wr_clean_neighborhoods_silent(fixture_findings):
+    """Sanctioned encode/decode round trips, declared-field reads on
+    decoded values, registry-constant KV calls, and dynamic/non-wire
+    ``type`` dicts produce zero WR findings."""
+    start = _line_of("bad_wire.py", "clean neighborhoods")
+    noise = [
+        f
+        for f in fixture_findings
+        if f.path.endswith("bad_wire.py") and f.line > start
+    ]
+    assert not noise, [f.render() for f in noise]
+
+
 def test_holds_lock_allowlist(fixture_findings):
     line = _line_of("bad_lock.py", "allowlisted")
     assert not [

@@ -1,0 +1,95 @@
+"""docs/WIRE.md schema-table drift gate.
+
+The table claims to list EVERY declared wire schema. Claims drift;
+this gate doesn't: it ``ast.literal_eval``s the ``WIRE_SCHEMAS``
+table (the same import-free read the WR analyzer uses) and diffs both
+directions against the doc rows — a schema added without a row fails,
+and so does a row naming a schema the table no longer declares, or a
+row whose version/compat/transport went stale.
+"""
+
+import ast
+import os
+import re
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WIRE_PY = os.path.join(
+    ROOT, "tensorflowonspark_tpu", "cluster", "wire.py"
+)
+DOC = os.path.join(ROOT, "docs", "WIRE.md")
+
+# | `name` | vN | compat | transport |
+_ROW = re.compile(
+    r"^\|\s*`([a-zA-Z0-9_.]+)`\s*\|\s*v(\d+)\s*\|"
+    r"\s*(frozen|add_only_optional)\s*\|\s*([a-z]+)\s*\|"
+)
+
+
+def _declared_schemas() -> dict:
+    with open(WIRE_PY, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=WIRE_PY)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "WIRE_SCHEMAS"
+            for t in node.targets
+        ):
+            return ast.literal_eval(node.value)
+    raise AssertionError("WIRE_SCHEMAS literal not found in wire.py")
+
+
+def _doc_rows() -> dict:
+    out = {}
+    with open(DOC, encoding="utf-8") as f:
+        for line in f:
+            m = _ROW.match(line.strip())
+            if m:
+                assert m.group(1) not in out, (
+                    f"duplicate doc row for {m.group(1)}"
+                )
+                out[m.group(1)] = {
+                    "version": int(m.group(2)),
+                    "compat": m.group(3),
+                    "transport": m.group(4),
+                }
+    return out
+
+
+def test_every_schema_has_a_doc_row():
+    declared, rows = _declared_schemas(), _doc_rows()
+    missing = sorted(set(declared) - set(rows))
+    assert not missing, (
+        f"undocumented wire schemas (add rows to docs/WIRE.md): "
+        f"{missing}"
+    )
+
+
+def test_no_stale_doc_rows():
+    declared, rows = _declared_schemas(), _doc_rows()
+    stale = sorted(set(rows) - set(declared))
+    assert not stale, (
+        f"docs/WIRE.md rows for undeclared schemas (remove them): "
+        f"{stale}"
+    )
+
+
+def test_doc_rows_match_declarations():
+    declared, rows = _declared_schemas(), _doc_rows()
+    for name in sorted(set(declared) & set(rows)):
+        sc, row = declared[name], rows[name]
+        assert row["version"] == sc["version"], (
+            f"{name}: doc says v{row['version']}, table declares "
+            f"v{sc['version']}"
+        )
+        assert row["compat"] == sc["compat"], (
+            f"{name}: doc says {row['compat']}, table declares "
+            f"{sc['compat']}"
+        )
+        assert row["transport"] == sc.get("transport"), (
+            f"{name}: doc says {row['transport']}, table declares "
+            f"{sc.get('transport')}"
+        )
+
+
+def test_table_is_nonempty():
+    rows = _doc_rows()
+    assert len(rows) >= 30, f"suspiciously small doc table: {len(rows)}"

@@ -1,0 +1,185 @@
+"""wirecheck: the golden-corpus compatibility gate and the wire codecs.
+
+Three layers:
+
+- **Codec round trips**: every declared schema's canonical instance
+  survives serialize → deserialize → ``wire.decode`` under its own
+  transport codec — BOTH persisted cursor-entry forms included — and
+  the committed corpus bytes equal what current code produces.
+- **Rejection paths**: torn corpus bytes fail loudly; a seeded schema
+  mutation (a renamed reservation field) is reported by the gate with
+  the schema name AND the field-level delta; ``--write-baseline``
+  refuses a frozen-schema change at the same version.
+- **The CLI gate** (tier-1, not slow-marked): ``tools/wirecheck.py
+  --gate`` over the real registry + committed corpus exits 0 inside a
+  30 s budget — the check ``tools/run_tier1.py`` runs after the suites.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tensorflowonspark_tpu.cluster import wire
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _load_wirecheck():
+    spec = importlib.util.spec_from_file_location(
+        "wirecheck_tool", os.path.join(ROOT, "tools", "wirecheck.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def wc():
+    return _load_wirecheck()
+
+
+# -- codec round trips -------------------------------------------------------
+
+
+def test_every_schema_round_trips(wc):
+    for name in wire.WIRE_SCHEMAS:
+        blob = wc.serialize_corpus(name)
+        assert isinstance(blob, bytes) and blob, name
+        n = wc.decode_corpus(name, blob)
+        assert n >= 1, name
+
+
+def test_cursor_entry_corpus_carries_both_forms(wc):
+    instances = wc.canonical_instances("ingest.cursor_entry")
+    forms = {type(i) for i in instances}
+    assert int in forms and list in forms, instances
+    for inst in instances:
+        seq, skip = wire.decode_cursor_entry(inst)
+        assert wire.encode_cursor_entry(seq, skip) == inst
+
+
+def test_committed_corpus_matches_current_serialization(wc):
+    cdir = os.path.join(ROOT, wc.CORPUS_DIR)
+    for name, entry in wc.build_baseline()["schemas"].items():
+        path = os.path.join(cdir, f"{name}@v{entry['version']}.bin")
+        assert os.path.exists(path), (
+            f"{name}: missing corpus file — run tools/wirecheck.py "
+            "--write-baseline"
+        )
+        with open(path, "rb") as f:
+            assert f.read() == wc.serialize_corpus(name), (
+                f"{name}: corpus bytes drifted"
+            )
+
+
+def test_committed_baseline_matches_declarations(wc):
+    with open(os.path.join(ROOT, wc.BASELINE_PATH)) as f:
+        committed = json.load(f)["schemas"]
+    current = wc.build_baseline()["schemas"]
+    assert committed == current, (
+        "wirecheck baseline out of date — run tools/wirecheck.py "
+        "--write-baseline (compat-policy enforced)"
+    )
+
+
+# -- rejection paths ---------------------------------------------------------
+
+
+def test_torn_corpus_entry_rejected_loudly(wc):
+    for name in ("reservation.HEARTBEAT", "columnar.frame_header",
+                 "rollout.latest"):
+        blob = wc.serialize_corpus(name)
+        with pytest.raises(Exception):
+            wc.decode_corpus(name, blob[: len(blob) // 2])
+
+
+def test_corrupt_instance_rejected_with_schema_name(wc):
+    import pickle
+
+    instances = pickle.loads(wc.serialize_corpus("reservation.HEARTBEAT"))
+    broken = dict(instances[0])
+    del broken["executor_id"]
+    with pytest.raises(wire.WireDecodeError, match="executor_id"):
+        wire.decode("reservation.HEARTBEAT", broken)
+
+
+def test_seeded_mutation_names_schema_and_field(wc, tmp_path, capsys):
+    """Rename a reservation field in the baselined shape: the gate must
+    fail and its report must name the schema and the moved field."""
+    mutated = wc.build_baseline()
+    entry = mutated["schemas"]["reservation.REG"]
+    entry["fields"] = {"type": "str", "peer": "dict"}
+    entry["required"] = ["type", "peer"]
+    entry["digest"] = wc.shape_digest(
+        {k: v for k, v in entry.items() if k != "digest"}
+    )
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps(mutated))
+    rc = wc.gate(str(path))
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "reservation.REG" in out
+    assert "'node'" in out and "'peer'" in out
+    assert "bump the version" in out
+
+
+def test_write_baseline_refuses_frozen_change(wc, tmp_path, capsys):
+    """A frozen schema whose shape changed at the same version is a
+    refused re-baseline, not a silent overwrite."""
+    old = wc.build_baseline()
+    entry = old["schemas"]["reservation.REG"]
+    entry["fields"] = {"type": "str", "peer": "dict"}
+    entry["required"] = ["type", "peer"]
+    entry["digest"] = wc.shape_digest(
+        {k: v for k, v in entry.items() if k != "digest"}
+    )
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps(old))
+    rc = wc.write_baseline(str(path))
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "frozen" in out and "reservation.REG" in out
+    # the refused run must not have touched the baseline
+    assert json.loads(path.read_text()) == old
+
+
+def test_write_baseline_allows_optional_addition(wc):
+    """add_only_optional sanctions a same-version optional addition —
+    the compat check, not a filesystem write."""
+    old = wc.schema_shape("serve.error")
+    old["digest"] = wc.shape_digest(old)
+    new = wc.schema_shape("serve.error")
+    new["fields"] = {**new["fields"], "hint": "str"}
+    new["digest"] = wc.shape_digest(new)
+    assert wc._compat_violation("serve.error", old, new) is None
+    # ... but a same-version REQUIRED addition is refused
+    worse = wc.schema_shape("serve.error")
+    worse["fields"] = {**worse["fields"], "hint": "str"}
+    worse["required"] = worse["required"] + ["hint"]
+    worse["digest"] = wc.shape_digest(worse)
+    why = wc._compat_violation("serve.error", old, worse)
+    assert why and "hint" in why
+
+
+# -- the CLI gate ------------------------------------------------------------
+
+
+def test_cli_gate_green_within_budget():
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "wirecheck.py"),
+         "--gate"],
+        cwd=ROOT,
+        capture_output=True,
+        text=True,
+        timeout=30,
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "clean" in proc.stdout
+    assert elapsed < 30, f"wirecheck gate took {elapsed:.1f}s (budget 30s)"

@@ -86,6 +86,15 @@ SLOW_TIMEOUT = 900.0
 SHARDCHECK_CMD = ["tools/shardcheck.py", "--model", "llama1b", "--gate"]
 SHARDCHECK_TIMEOUT = 900.0
 
+# Every full run (fast AND slow tier) also runs the wirecheck compat
+# gate: the declared wire-schema table (cluster/wire.py WIRE_SCHEMAS)
+# is diffed against tools/wirecheck_baseline.json and every committed
+# golden-corpus file is re-decoded with current code — a schema edit
+# that breaks persisted bytes or silently changes serialization fails
+# here (docs/WIRE.md). Sub-second on a laptop; the budget is generous.
+WIRECHECK_CMD = ["tools/wirecheck.py", "--gate"]
+WIRECHECK_TIMEOUT = 30.0
+
 _FAIL_RE = re.compile(r"^(?:FAILED|ERROR)\s+(\S+)")
 
 
@@ -316,6 +325,35 @@ def main(argv: list[str] | None = None) -> int:
         for f in res["failed"]:
             print(f"    {f}")
         all_failed.update(res["failed"])
+
+    if not args.suites:
+        t1 = time.monotonic()
+        try:
+            wgate = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO_ROOT, WIRECHECK_CMD[0]),
+                 *WIRECHECK_CMD[1:]],
+                cwd=REPO_ROOT,
+                capture_output=True,
+                text=True,
+                timeout=WIRECHECK_TIMEOUT,
+            )
+            wgate_rc = wgate.returncode
+            wgate_out = wgate.stdout + (
+                ("\n" + wgate.stderr) if wgate.stderr else ""
+            )
+        except subprocess.TimeoutExpired as e:
+            wgate_rc = -1
+            wgate_out = f"wirecheck gate timed out: {e}"
+        status = "ok" if wgate_rc == 0 else "FAILED"
+        print(
+            f"[gate] tools/wirecheck.py (wire-schema compat): {status} "
+            f"({round(time.monotonic() - t1, 1)}s)",
+            flush=True,
+        )
+        if wgate_rc != 0:
+            all_failed.add("tools/wirecheck.py::WIRE_GATE")
+            print(wgate_out[-1500:])
 
     if args.slow and not args.suites:
         t1 = time.monotonic()

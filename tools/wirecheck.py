@@ -1,0 +1,568 @@
+#!/usr/bin/env python
+"""wirecheck CLI — golden-corpus compatibility gate for the wire registry.
+
+Every cross-process surface this repo speaks is declared once, in
+``tensorflowonspark_tpu/cluster/wire.py`` (``WIRE_SCHEMAS``). This tool
+pins those declarations two ways:
+
+1. **Shape baseline** (``tools/wirecheck_baseline.json``) — a digest of
+   each schema's declared shape (fields, types, required set, version,
+   compat policy). Any edit to a declaration fails the gate until the
+   change is re-baselined, and ``--write-baseline`` REFUSES a re-baseline
+   that violates the schema's compat policy at the same version:
+   ``frozen`` schemas may not change at all; ``add_only_optional``
+   schemas may only gain optional fields. Breaking changes require a
+   version bump in the table — a deliberate, reviewable act.
+
+2. **Golden corpus** (``tools/wirecheck_corpus/<name>@v<N>.bin``) — the
+   canonical instance of each schema, serialized with the schema's own
+   transport codec (pickle for reservation messages / manager-KV values,
+   a real CRC-framed columnar frame, JSON for pointer and HTTP bodies)
+   and committed. The gate re-serializes the canonical instance with
+   CURRENT code and compares bytes (serialization drift — a peer built
+   from an older commit would disagree), and decodes EVERY committed
+   corpus file — old versions included, they are kept forever — with
+   current code (wire-compat with already-persisted bytes: cursors in
+   flight, frames on disk, LATEST pointers in channels).
+
+Usage (from the repo root)::
+
+    python tools/wirecheck.py --gate             # what CI runs
+    python tools/wirecheck.py --write-baseline   # after a declared change
+    python tools/wirecheck.py --list             # show the table
+
+Exit codes: 0 gate green (or listing), 1 compat violation / drift,
+2 usage error or refused re-baseline. ``tools/run_tier1.py`` runs the
+gate after the suites (like the shardcheck census gate); conventions:
+docs/WIRE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import pickle
+import pickletools
+import sys
+import types
+import zlib
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# Stub parent package (just a __path__): cluster/wire.py is stdlib-only
+# and feed/columnar.py needs only numpy — executing the package's real
+# __init__ would pull ~8 s of jax imports the gate never uses.
+if "tensorflowonspark_tpu" not in sys.modules:
+    _stub = types.ModuleType("tensorflowonspark_tpu")
+    _stub.__path__ = [os.path.join(_REPO_ROOT, "tensorflowonspark_tpu")]
+    sys.modules["tensorflowonspark_tpu"] = _stub
+
+from tensorflowonspark_tpu.cluster import wire  # noqa: E402
+
+BASELINE_PATH = os.path.join("tools", "wirecheck_baseline.json")
+CORPUS_DIR = os.path.join("tools", "wirecheck_corpus")
+
+# Committed corpus bytes must be replayable by every interpreter that
+# can run this repo — pin the pickle protocol instead of HIGHEST.
+_PICKLE_PROTOCOL = 4
+
+
+# ---------------------------------------------------------------------------
+# canonical instances
+# ---------------------------------------------------------------------------
+
+# Deterministic per-type samples; field-specific overrides below keep
+# the corpus recognizably shaped like real traffic.
+_TYPE_SAMPLES = {
+    "int": 7,
+    "float": 0.5,
+    "bool": True,
+    "list": [],
+    "dict": {},
+    "bytes": b"\x00golden",
+    "any": "tok",
+}
+
+_FIELD_OVERRIDES: dict[str, dict[str, object]] = {
+    "reservation.REG": {
+        "node": {"executor_id": 0, "host": "10.0.0.1", "port": 7077},
+    },
+    "reservation.QINFO.reply": {
+        "cluster_info": [
+            {"executor_id": 0, "host": "10.0.0.1", "port": 7077}
+        ],
+    },
+    "reservation.QEPOCH.reply": {"roster": [0, 1]},
+    "reservation.ICURSOR": {
+        "payload": {
+            "epoch": 1,
+            "final": False,
+            "done": False,
+            "cursor": {"train-0": 17, "train-1": [42, 3]},
+        },
+    },
+    "kv.ingest_plan": {"manifests": [["part-0000", 0, 128]]},
+    "kv.feed_knobs": {"knobs": {"records_per_chunk": 256}},
+    "kv.feed_timeout": {"value": 600.0},
+    "kv.node_state": {"value": "running"},
+    "ingest.cursor_payload": {
+        # both cursor-entry wire forms ride inside the payload too
+        "cursor": {"train-0": 17, "train-1": [42, 3]},
+    },
+    "rollout.manifest": {
+        "version": "v1",
+        "kind": "full",
+        "path": "/ckpt/versions/v1",
+        "step": 120,
+    },
+    "serve.completion": {"completions": [[1, 2, 3]]},
+    "serve.stream_chunk": {"token": 42, "logprob": -0.25},
+    "serve.stream_trailer": {"completion": [1, 2, 3]},
+}
+
+
+def _sample(field: str, typestr: str):
+    t = typestr[:-5] if typestr.endswith("|null") else typestr
+    if t == "str":
+        return f"golden-{field}"
+    return _TYPE_SAMPLES[t]
+
+
+def canonical_instances(name: str) -> list:
+    """The schema's canonical wire values (as shipped, pre-transport).
+
+    Every declared field is populated (optional ones included) so the
+    corpus exercises the full declared surface; the cursor-entry schema
+    contributes BOTH persisted forms (bare int and ``[seq, skip]``)."""
+    sc = wire.schema(name)
+    if sc.get("codec") == "cursor_entry":
+        return [wire.encode_cursor_entry(17),
+                wire.encode_cursor_entry(42, 3)]
+    if sc.get("codec") == "scalar":
+        over = _FIELD_OVERRIDES.get(name, {})
+        return [wire.encode(name, value=over.get(
+            "value", _sample("value", sc["fields"]["value"])))]
+    if name == "columnar.frame_header":
+        return [_canonical_frame_header()]
+    if name == "rollout.latest":
+        manifest = canonical_instances("rollout.manifest")[0]
+        body = json.dumps(manifest, sort_keys=True).encode("utf-8")
+        return [wire.encode(
+            "rollout.latest", crc=zlib.crc32(body), manifest=manifest
+        )]
+    over = _FIELD_OVERRIDES.get(name, {})
+    kw = {}
+    for f, typestr in sc["fields"].items():
+        if f == "type":  # injected by encode for message schemas
+            continue
+        kw[f] = over.get(f, _sample(f, typestr))
+    return [wire.encode(name, **kw)]
+
+
+def _canonical_chunk():
+    """A tiny deterministic ColumnChunk for the columnar frame corpus."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.feed.columnar import columnize_records
+
+    return columnize_records(
+        [
+            {"x": np.float32(i) / 4, "y": i}
+            for i in range(4)
+        ]
+    )
+
+
+def _canonical_frame_header() -> dict:
+    from tensorflowonspark_tpu.feed import columnar
+
+    blob = _canonical_frame_bytes()
+    _, hlen, _ = columnar._PREFIX.unpack_from(blob, 0)
+    header = pickle.loads(blob[columnar._PREFIX.size:
+                               columnar._PREFIX.size + hlen])
+    return wire.decode("columnar.frame_header", header)
+
+
+def _canonical_frame_bytes() -> bytes:
+    from tensorflowonspark_tpu.feed.columnar import frame_bytes
+
+    return frame_bytes(
+        _canonical_chunk(), qname="golden", stream="golden-0", seq=3
+    )
+
+
+# ---------------------------------------------------------------------------
+# serialization (the transport codecs)
+# ---------------------------------------------------------------------------
+
+
+def _stable_pickle(obj) -> bytes:
+    """Deterministic pickle: fixed protocol, memo-free optimized stream
+    (byte-stable across runs for the plain dict/list/int payloads the
+    registry declares)."""
+    return pickletools.optimize(
+        pickle.dumps(obj, protocol=_PICKLE_PROTOCOL)
+    )
+
+
+def serialize_corpus(name: str) -> bytes:
+    """Canonical corpus bytes for ``name`` under its own transport."""
+    sc = wire.schema(name)
+    if sc.get("transport") == "frame":
+        return _canonical_frame_bytes()
+    instances = canonical_instances(name)
+    if sc.get("transport") in ("pointer", "http"):
+        return b"".join(
+            json.dumps(i).encode("utf-8") + b"\n" for i in instances
+        )
+    # message / kv / entry transports all ship pickled python values
+    return _stable_pickle(instances)
+
+
+def decode_corpus(name: str, blob: bytes) -> int:
+    """Decode committed corpus bytes with CURRENT code; returns the
+    number of instances decoded. Raises on any rejection — a failure
+    here means current code can no longer read persisted bytes."""
+    sc = wire.schema(name)
+    if sc.get("transport") == "frame":
+        from tensorflowonspark_tpu.feed.columnar import decode_frame
+
+        chunk = decode_frame(blob)  # header rides wire.decode inside
+        if chunk.n <= 0:
+            raise wire.WireDecodeError(f"{name}: empty canonical frame")
+        return 1
+    if sc.get("transport") in ("pointer", "http"):
+        instances = [
+            json.loads(line)
+            for line in blob.decode("utf-8").splitlines()
+            if line
+        ]
+    else:
+        instances = pickle.loads(blob)
+    if not instances:
+        raise wire.WireDecodeError(f"{name}: empty corpus file")
+    for inst in instances:
+        wire.decode(name, inst)
+    return len(instances)
+
+
+# ---------------------------------------------------------------------------
+# shape baseline
+# ---------------------------------------------------------------------------
+
+
+def schema_shape(name: str) -> dict:
+    """The declaration as baselined: everything a peer must agree on."""
+    sc = wire.schema(name)
+    shape = {
+        "version": sc["version"],
+        "compat": sc["compat"],
+        "transport": sc.get("transport"),
+        "fields": dict(sc["fields"]),
+        "required": list(sc["required"]),
+    }
+    for extra in ("kind", "codec", "kv_key", "values"):
+        if sc.get(extra) is not None:
+            shape[extra] = sc[extra]
+    return shape
+
+
+def shape_digest(shape: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(shape, sort_keys=True).encode("utf-8")
+    ).hexdigest()
+
+
+def build_baseline() -> dict:
+    schemas = {}
+    for name in wire.WIRE_SCHEMAS:
+        shape = schema_shape(name)
+        schemas[name] = {**shape, "digest": shape_digest(shape)}
+    return {
+        "_meta": {
+            "tool": "wirecheck",
+            "format": 1,
+            "note": "regenerate with: python tools/wirecheck.py "
+                    "--write-baseline (compat-policy enforced)",
+        },
+        "schemas": schemas,
+    }
+
+
+def _shape_diff(old: dict, new: dict) -> list[str]:
+    """Human-readable field-level diff naming schema parts that moved."""
+    out = []
+    of, nf = old.get("fields", {}), new.get("fields", {})
+    for f in sorted(set(of) - set(nf)):
+        out.append(f"field {f!r} removed (was {of[f]})")
+    for f in sorted(set(nf) - set(of)):
+        req = " REQUIRED" if f in new.get("required", []) else " optional"
+        out.append(f"field {f!r} added ({nf[f]},{req})")
+    for f in sorted(set(of) & set(nf)):
+        if of[f] != nf[f]:
+            out.append(f"field {f!r} retyped {of[f]} -> {nf[f]}")
+    oreq, nreq = set(old.get("required", [])), set(new.get("required", []))
+    for f in sorted(nreq - oreq):
+        out.append(f"field {f!r} became required")
+    for f in sorted(oreq - nreq):
+        out.append(f"field {f!r} became optional")
+    for k in ("compat", "transport", "kind", "codec", "kv_key", "values"):
+        if old.get(k) != new.get(k):
+            out.append(f"{k} changed {old.get(k)!r} -> {new.get(k)!r}")
+    return out or ["shape changed (no field-level delta — check ordering)"]
+
+
+def _compat_violation(name: str, old: dict, new: dict) -> str | None:
+    """Why re-baselining ``new`` over ``old`` at the SAME version would
+    break the schema's declared compat policy; None when allowed."""
+    if new["version"] != old["version"]:
+        return None  # a version bump sanctions any change
+    if old["digest"] == new["digest"]:
+        return None
+    policy = old.get("compat", "frozen")
+    if policy == "frozen":
+        return (
+            f"{name} is frozen at v{old['version']} but its shape "
+            "changed — bump the schema version in cluster/wire.py "
+            "WIRE_SCHEMAS to make the break deliberate"
+        )
+    # add_only_optional: existing fields immutable, required set
+    # immutable, additions must be optional
+    problems = []
+    of, nf = old["fields"], new["fields"]
+    for f in of:
+        if f not in nf:
+            problems.append(f"removed field {f!r}")
+        elif of[f] != nf[f]:
+            problems.append(f"retyped field {f!r}")
+    if set(old["required"]) != set(new["required"]):
+        problems.append("changed the required set")
+    for f in set(nf) - set(of):
+        if f in new["required"]:
+            problems.append(f"added REQUIRED field {f!r}")
+    for k in ("transport", "kind", "codec", "kv_key", "values"):
+        if old.get(k) != new.get(k):
+            problems.append(f"changed {k}")
+    if not problems:
+        return None  # pure optional addition — sanctioned
+    return (
+        f"{name} is add-only-optional at v{old['version']} but the "
+        f"change {', '.join(problems)} — bump the schema version in "
+        "cluster/wire.py WIRE_SCHEMAS to make the break deliberate"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+
+def _corpus_files() -> dict[str, list[tuple[int, str]]]:
+    """{schema name: [(version, path), ...]} for every committed file."""
+    out: dict[str, list[tuple[int, str]]] = {}
+    cdir = os.path.join(_REPO_ROOT, CORPUS_DIR)
+    if not os.path.isdir(cdir):
+        return out
+    for fn in sorted(os.listdir(cdir)):
+        if not fn.endswith(".bin") or "@v" not in fn:
+            continue
+        name, _, ver = fn[:-4].rpartition("@v")
+        try:
+            out.setdefault(name, []).append(
+                (int(ver), os.path.join(cdir, fn))
+            )
+        except ValueError:
+            out.setdefault(fn, []).append((-1, os.path.join(cdir, fn)))
+    return out
+
+
+def gate(baseline_path: str) -> int:
+    problems: list[str] = []
+    try:
+        with open(baseline_path, encoding="utf-8") as f:
+            baseline = json.load(f).get("schemas", {})
+    except (OSError, ValueError) as e:
+        print(f"wirecheck: cannot read baseline {baseline_path}: {e}")
+        return 1
+
+    current = build_baseline()["schemas"]
+
+    # 1. shape drift vs the committed baseline
+    for name, entry in current.items():
+        old = baseline.get(name)
+        if old is None:
+            problems.append(
+                f"{name}: declared but not baselined — run "
+                "tools/wirecheck.py --write-baseline"
+            )
+            continue
+        if entry["digest"] != old.get("digest"):
+            if entry["version"] == old.get("version"):
+                lines = "; ".join(_shape_diff(old, entry))
+                problems.append(
+                    f"{name}: shape drifted at v{entry['version']} "
+                    f"({lines}) — bump the version for a breaking "
+                    "change, then --write-baseline"
+                )
+            else:
+                problems.append(
+                    f"{name}: v{old.get('version')} -> "
+                    f"v{entry['version']} not re-baselined — run "
+                    "tools/wirecheck.py --write-baseline"
+                )
+    for name in sorted(set(baseline) - set(current)):
+        problems.append(
+            f"{name}: baselined but no longer declared — removing a "
+            "wire schema orphans persisted bytes; --write-baseline "
+            "to confirm"
+        )
+
+    # 2. corpus coverage + byte drift + decode-the-past
+    files = _corpus_files()
+    for name, entry in current.items():
+        have = dict(files.get(name, []))
+        cur_path = have.get(entry["version"])
+        if cur_path is None:
+            problems.append(
+                f"{name}: no corpus file for v{entry['version']} "
+                f"({CORPUS_DIR}/{name}@v{entry['version']}.bin) — run "
+                "--write-baseline"
+            )
+            continue
+        with open(cur_path, "rb") as f:
+            committed = f.read()
+        if serialize_corpus(name) != committed:
+            problems.append(
+                f"{name}: serialization drift — current code produces "
+                f"different bytes than the committed v{entry['version']} "
+                "corpus (a peer built from an older commit would "
+                "disagree); if deliberate, bump the schema version and "
+                "--write-baseline"
+            )
+    for name, versions in sorted(files.items()):
+        if name not in current:
+            for _, path in versions:
+                problems.append(
+                    f"{os.path.relpath(path, _REPO_ROOT)}: corpus file "
+                    "for an undeclared schema — stale, remove via "
+                    "--write-baseline"
+                )
+            continue
+        for ver, path in versions:
+            try:
+                n = decode_corpus(name, open(path, "rb").read())
+            except Exception as e:  # noqa: BLE001 - each is a verdict
+                problems.append(
+                    f"{name}@v{ver}: committed corpus bytes no longer "
+                    f"decode with current code — {type(e).__name__}: {e}"
+                )
+            else:
+                if ver == current[name]["version"] and n < 1:
+                    problems.append(f"{name}@v{ver}: empty corpus")
+
+    if problems:
+        print(f"wirecheck: {len(problems)} problem(s)")
+        for p in problems:
+            print(f"  FAIL  {p}")
+        return 1
+    n_files = sum(len(v) for v in files.values())
+    print(
+        f"wirecheck: clean — {len(current)} schema(s), "
+        f"{n_files} corpus file(s) decoded"
+    )
+    return 0
+
+
+def write_baseline(baseline_path: str) -> int:
+    new = build_baseline()
+    try:
+        with open(baseline_path, encoding="utf-8") as f:
+            old = json.load(f).get("schemas", {})
+    except (OSError, ValueError):
+        old = {}
+
+    refusals = []
+    for name, entry in new["schemas"].items():
+        if name in old:
+            why = _compat_violation(name, old[name], entry)
+            if why:
+                refusals.append(why)
+    if refusals:
+        print(f"wirecheck: REFUSED — {len(refusals)} compat violation(s)")
+        for r in refusals:
+            print(f"  {r}")
+        return 2
+
+    cdir = os.path.join(_REPO_ROOT, CORPUS_DIR)
+    os.makedirs(cdir, exist_ok=True)
+    written = 0
+    for name, entry in new["schemas"].items():
+        path = os.path.join(cdir, f"{name}@v{entry['version']}.bin")
+        blob = serialize_corpus(name)
+        if not os.path.exists(path) or open(path, "rb").read() != blob:
+            with open(path, "wb") as f:
+                f.write(blob)
+            written += 1
+    # corpus files for schemas that left the table are stale (the gate
+    # flags them); older VERSIONS of live schemas are kept forever
+    removed = 0
+    for name, versions in _corpus_files().items():
+        if name not in new["schemas"]:
+            for _, path in versions:
+                os.remove(path)
+                removed += 1
+    with open(baseline_path, "w", encoding="utf-8") as f:
+        json.dump(new, f, indent=2, sort_keys=True)
+        f.write("\n")
+    dropped = sorted(set(old) - set(new["schemas"]))
+    print(
+        f"wirecheck: baselined {len(new['schemas'])} schema(s), wrote "
+        f"{written} corpus file(s)"
+        + (f", removed {removed} stale corpus file(s)" if removed else "")
+        + (f", dropped {len(dropped)} baseline entr(ies): "
+           f"{', '.join(dropped)}" if dropped else "")
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="wirecheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--gate", action="store_true",
+                    help="diff declarations + corpus vs the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="re-baseline (compat-policy enforced)")
+    ap.add_argument("--list", action="store_true",
+                    help="print the declared schema table")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help=f"baseline path (default {BASELINE_PATH})")
+    args = ap.parse_args(argv)
+
+    baseline_path = (
+        args.baseline if os.path.isabs(args.baseline)
+        else os.path.join(_REPO_ROOT, args.baseline)
+    )
+    if args.list:
+        for name in wire.WIRE_SCHEMAS:
+            sc = wire.schema(name)
+            print(
+                f"{name:28s} v{sc['version']}  {sc['compat']:18s} "
+                f"{sc.get('transport') or sc.get('codec')}"
+            )
+        return 0
+    if args.write_baseline:
+        return write_baseline(baseline_path)
+    if args.gate:
+        return gate(baseline_path)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
