@@ -20,12 +20,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BASELINE_CHIPS = 1
 
 
-def _artifact_env() -> dict:
-    """Subprocess env for bench runs that COMMIT chips-stamped smoke
-    artifacts: the conftest's ``--xla_force_host_platform_device_count
-    =8`` is scrubbed so a pytest-driven regeneration records the
-    host-true chip count instead of 8 faux devices (the drifted-
-    artifact footgun the chips gate exists to catch)."""
+def _artifact_env(results_dir: str) -> dict:
+    """Subprocess env for bench e2e runs. Artifacts are REDIRECTED to
+    ``results_dir`` (via TFOS_BENCH_RESULTS_DIR) so a pytest run can
+    never overwrite the committed quiet-host baselines in
+    benchmarks/results/ with a contended-host run — regenerating a
+    committed artifact is always a deliberate direct ``bench.py``
+    invocation on a quiet host. The conftest's
+    ``--xla_force_host_platform_device_count=8`` is also scrubbed so
+    the run records the host-true chip count instead of 8 faux devices
+    (the drifted-artifact footgun the chips gate exists to catch)."""
     env = dict(
         os.environ,
         BENCH_SMOKE="1",
@@ -33,6 +37,7 @@ def _artifact_env() -> dict:
         JAX_PLATFORMS="cpu",
         PALLAS_AXON_POOL_IPS="",
         PALLAS_AXON_REMOTE_COMPILE="",
+        TFOS_BENCH_RESULTS_DIR=results_dir,
     )
     flags = [
         f
@@ -70,7 +75,7 @@ def test_committed_smoke_artifacts_record_baseline_chips():
         )
 
 
-def test_bench_smoke_emits_complete_json():
+def test_bench_smoke_emits_complete_json(tmp_path):
     env = dict(
         os.environ,
         BENCH_SMOKE="1",
@@ -78,6 +83,7 @@ def test_bench_smoke_emits_complete_json():
         JAX_PLATFORMS="cpu",
         PALLAS_AXON_POOL_IPS="",
         PALLAS_AXON_REMOTE_COMPILE="",
+        TFOS_BENCH_RESULTS_DIR=str(tmp_path),
     )
     # a clean XLA_FLAGS: the conftest's 8-device forcing is fine but not
     # required; bench must work with whatever the driver environment has
@@ -105,7 +111,7 @@ def test_bench_smoke_emits_complete_json():
     assert out["mnist_final_loss"] > 0
 
 
-def test_bench_serve_smoke_emits_engine_tax():
+def test_bench_serve_smoke_emits_engine_tax(tmp_path):
     """bench.py --serve end-to-end on the tiny model: the serving-tax
     measurement (engine tokens/sec at pipeline_depth 1 and 2 vs raw
     single-stream generate) must emit a finite engine_tax JSON line and
@@ -119,6 +125,7 @@ def test_bench_serve_smoke_emits_engine_tax():
         JAX_PLATFORMS="cpu",
         PALLAS_AXON_POOL_IPS="",
         PALLAS_AXON_REMOTE_COMPILE="",
+        TFOS_BENCH_RESULTS_DIR=str(tmp_path),
     )
     proc = subprocess.run(
         [sys.executable, "bench.py", "--serve"],
@@ -144,13 +151,13 @@ def test_bench_serve_smoke_emits_engine_tax():
     assert os.path.exists(os.path.join(REPO, out["trace_report"]))
 
 
-def test_bench_zero_smoke_ab_and_byte_identity():
+def test_bench_zero_smoke_ab_and_byte_identity(tmp_path):
     """bench.py --zero end-to-end on the tiny model: both knob legs run
     on a pure data-parallel mesh, the isolated optimizer span is
     measured per leg, the weight-update decomposition is BYTE-IDENTICAL
     across knobs on identical gradients (the ZeRO math owns nothing but
     placement), and the A/B artifact is committed."""
-    env = _artifact_env()
+    env = _artifact_env(str(tmp_path))
     proc = subprocess.run(
         [sys.executable, "bench.py", "--zero"],
         cwd=REPO,
@@ -178,13 +185,13 @@ def test_bench_zero_smoke_ab_and_byte_identity():
     assert on_disk["update_params_match"] is True
 
 
-def test_bench_serve_slo_smoke_burn_gate_and_trace_proof():
+def test_bench_serve_slo_smoke_burn_gate_and_trace_proof(tmp_path):
     """bench.py --serve-slo end-to-end on the tiny model: a clean leg
     must leave every SLO silent, the armed (latency-failpoint) leg must
     fire exactly the latency SLO as exactly one rising edge, and the
     proof request's merged timeline must attribute >= 95% of its wall
     time across router -> engine segments."""
-    env = _artifact_env()
+    env = _artifact_env(str(tmp_path))
     proc = subprocess.run(
         [sys.executable, "bench.py", "--serve-slo"],
         cwd=REPO,
@@ -281,7 +288,7 @@ def test_real_chip_prefix_bench_smoke():
     assert out["ttft_cold_ms"] > 0 and out["step_time_ms"] > 0
 
 
-def test_bench_serve_fleet_smoke_emits_scaling_and_artifact():
+def test_bench_serve_fleet_smoke_emits_scaling_and_artifact(tmp_path):
     """bench.py --serve-fleet end-to-end on the tiny model: the
     replicas=1 vs 2 saturation legs must emit a finite scaling ratio
     (uncontended projection + contended wall ratio), zero sheds/
@@ -289,7 +296,7 @@ def test_bench_serve_fleet_smoke_emits_scaling_and_artifact():
     benchmarks/results/serve_fleet_*.json artifact."""
     import math
 
-    env = _artifact_env()
+    env = _artifact_env(str(tmp_path))
     proc = subprocess.run(
         [sys.executable, "bench.py", "--serve-fleet"],
         cwd=REPO,
@@ -316,13 +323,13 @@ def test_bench_serve_fleet_smoke_emits_scaling_and_artifact():
     assert on_disk["metric"] == "serve_fleet_scaling"
 
 
-def test_bench_rollout_smoke_zero_downtime_artifact():
+def test_bench_rollout_smoke_zero_downtime_artifact(tmp_path):
     """bench.py --rollout end-to-end on the tiny model: K=2 versions
     hot-swap through a 2-replica fleet under sustained streaming load;
     the emitted JSON (and committed artifact) must pass every
     acceptance check — zero dropped/hung requests, admitted p99 within
     the deadline budget, coherent per-completion version stamps."""
-    env = _artifact_env()
+    env = _artifact_env(str(tmp_path))
     proc = subprocess.run(
         [sys.executable, "bench.py", "--rollout"],
         cwd=REPO,
@@ -351,14 +358,19 @@ def test_bench_rollout_smoke_zero_downtime_artifact():
     assert json.load(open(art))["metric"] == "rollout_zero_downtime"
 
 
-def test_bench_autotune_smoke_recovers_and_audits():
+def test_bench_autotune_smoke_recovers_and_audits(tmp_path):
     """bench.py --autotune end-to-end: boot BOTH legs (mnist feed
     physics, tiny-model serve fleet) with deliberately bad knobs and
     let the controller recover >=90% of the hand-tuned throughput
     online. Every knob move must be on the flight record, and at least
     one leg must exercise the revert path (hill-climb past the peak)."""
-    env = _artifact_env()
+    env = _artifact_env(str(tmp_path))
     env.pop("TFOS_AUTOTUNE", None)  # the leg under test tunes live
+    committed = os.path.join(
+        REPO, "benchmarks", "results", "autotune_cpu_smoke.json"
+    )
+    with open(committed, "rb") as f:
+        committed_bytes = f.read()
     proc = subprocess.run(
         [sys.executable, "bench.py", "--autotune"],
         cwd=REPO,
@@ -392,3 +404,10 @@ def test_bench_autotune_smoke_recovers_and_audits():
     on_disk = json.load(open(art))
     assert on_disk["metric"] == "autotune_recovery"
     assert on_disk["value"] >= 0.9
+    # redirect regression guard: the e2e run lands its artifact in the
+    # scratch dir and leaves the committed quiet-host baseline
+    # byte-untouched (a contended pytest rerun once clobbered it with a
+    # failing run)
+    assert os.path.dirname(art) == str(tmp_path)
+    with open(committed, "rb") as f:
+        assert f.read() == committed_bytes
