@@ -156,6 +156,7 @@ class TFNodeContext:
             input_mapping=input_mapping,
             reader=reader,
             plan_epoch=int(plan.get("epoch", 0)),
+            plan_seq=int(plan.get("seq") or 0),
             worker_index=self.executor_id,
             **wires,
             **kwargs,

@@ -580,6 +580,7 @@ def publish_ingest_plan(
     plan_id: str | None = None,
     handover: bool = False,
     complete: bool = False,
+    seq: int | None = None,
 ) -> None:
     """Driver side of the pull-plane handshake: publish one node's
     shard plan to its manager KV, keyed by the membership ``epoch``.
@@ -589,7 +590,10 @@ def publish_ingest_plan(
     producers. ``handover`` arms the consumer's live-redistribution
     protocol (``ctx.get_ingest_feed`` wires the watcher + cursor
     publisher); ``complete`` is the driver's end-of-dataset marker —
-    lingering consumers stop instead of waiting for more work."""
+    lingering consumers stop instead of waiting for more work. ``seq``
+    is the plan GENERATION within one membership epoch (the growing-
+    dataset wire — ``TFCluster.extend_shards`` bumps it so a lingering
+    consumer adopts appended shards without a membership bump)."""
     mgr.set(
         INGEST_PLAN_KEY,
         wire.encode(
@@ -601,6 +605,7 @@ def publish_ingest_plan(
             manifests=list(manifests),
             handover=bool(handover),
             complete=bool(complete),
+            seq=None if seq is None else int(seq),
         ),
     )
 

@@ -109,6 +109,22 @@ class TFCluster:
         self._ingest_shards: dict[int, list[Any]] | None = None  # guarded-by: self._ingest_lock
         self._ingest_complete = False  # guarded-by: self._ingest_lock
         self._ingest_republished = False  # guarded-by: self._ingest_lock
+        # Plan GENERATION within a membership epoch (the growing-
+        # dataset wire): bumped by assign_shards and extend_shards so a
+        # lingering consumer can tell appended work from a stale
+        # republish, and so completion requires finals at the CURRENT
+        # generation (a final published before an append must not
+        # complete the grown dataset).
+        self._ingest_seq = 0  # guarded-by: self._ingest_lock
+        # Online mode (run_online): suppress the supervise loop's
+        # auto-completion while the dataset is still growing; shutdown
+        # clears it so teardown always releases lingering consumers.
+        self._ingest_hold_completion = False  # guarded-by: self._ingest_lock
+        # Serializes whole plan-mutation episodes (an epoch re-split vs
+        # a growth append) INCLUDING their out-of-lock IO, so neither
+        # can clobber the other's published plan. Ordering:
+        # _ingest_replan_lock > _ingest_lock, never the reverse.
+        self._ingest_replan_lock = threading.Lock()
         # Driver-pushed feed knobs (autotune): monotonically increasing
         # publication seq — consumers adopt each publication once.
         self._feed_knob_seq = 0  # guarded-by: self._ingest_lock
@@ -862,6 +878,10 @@ class TFCluster:
             # consumers at the next reconfigure
             self._ingest_complete = False
             self._ingest_republished = False
+            # a fresh dataset is also a fresh plan generation (never a
+            # reset: the seq must stay monotonic per membership epoch
+            # so consumers can order publications)
+            self._ingest_seq += 1
         failed = self._publish_ingest_plan()
         if failed:
             # At ASSIGN time a publish failure is the caller's problem
@@ -873,6 +893,99 @@ class TFCluster:
                 f"ingest: plan publish failed for node(s) {failed} — "
                 "no consumer on those nodes will receive a shard"
             )
+
+    def extend_shards(self, manifests: Iterable[Any]) -> None:
+        """APPEND manifests to the RUNNING plan (the growing-dataset
+        wire — docs/ROBUSTNESS.md "Online continual loop"): the new
+        manifests are dealt round-robin across the current workers,
+        each worker's cumulative shard is republished under the SAME
+        membership epoch with a bumped plan generation (``seq``), and
+        a lingering consumer (exhaustion-linger) adopts exactly the
+        appended streams instead of completing. Active consumers are
+        never interrupted — they discover the growth at their own
+        exhaustion. Requires the handover protocol (``elastic=True`` +
+        ``ingest_handover``): without the linger there is no consumer-
+        side hook to hand appended work to."""
+        if self.input_mode != InputMode.TENSORFLOW:
+            raise RuntimeError(
+                "extend_shards() requires InputMode.TENSORFLOW"
+            )
+        if not self._handover_armed:
+            raise RuntimeError(
+                "extend_shards() requires the handover protocol "
+                "(elastic=True + ingest_handover) — a static plan has "
+                "no lingering consumers to adopt appended shards"
+            )
+        new = list(manifests)
+        if not new:
+            return
+        # Serialize the whole append against a concurrent epoch
+        # re-split: interleaving their read-modify-write cycles could
+        # publish a plan missing either the appended shards or the
+        # re-split (both are zero-gap violations).
+        with self._ingest_replan_lock:
+            workers = self.workers
+            if not workers:
+                logger.warning(
+                    "ingest: no live workers to extend the plan to — "
+                    "appended manifests deferred to the next call"
+                )
+                return
+            from tensorflowonspark_tpu.feed.manifest import plan_manifests
+
+            shards = plan_manifests(new, len(workers))
+            with self._ingest_lock:
+                if self._ingest_shards is None:
+                    self._ingest_shards = {}
+                base = self._ingest_shards
+                for w, shard in zip(workers, shards):
+                    eid = w["executor_id"]
+                    base[eid] = list(base.get(eid, ())) + list(shard)
+                self._ingest_seq += 1
+                seq = self._ingest_seq
+                # appended work un-latches a completed dataset: the
+                # grown plan must complete on ITS OWN finals
+                self._ingest_complete = False
+            logger.info(
+                "ingest: extended plan with %d manifest(s) over %d "
+                "worker(s) (seq %d)",
+                len(new),
+                len(workers),
+                seq,
+            )
+            self._publish_ingest_plan()
+
+    def hold_ingest_completion(self, hold: bool = True) -> None:
+        """Suppress (or release) the supervise loop's auto-completion
+        of the ingest plan: an online loop's dataset is never "as
+        consumed as it will ever be" while traffic still flows, so
+        all-finals must not release the lingering consumers between
+        growth cycles. :meth:`shutdown` force-releases regardless."""
+        with self._ingest_lock:
+            self._ingest_hold_completion = bool(hold)
+
+    def run_online(self, log_root: str, **kw: Any) -> Any:
+        """Start the continual-training loop over a live traffic log
+        (``tfos.online``): holds ingest completion open, then polls
+        ``log_root`` for sealed traffic-log manifests and appends them
+        to the running plan via :meth:`extend_shards` on a daemon
+        thread. Keyword arguments pass through to
+        :class:`tensorflowonspark_tpu.online.OnlineLoop` (notably
+        ``channel_dir=`` — the rollout channel whose published
+        ``weights_version`` is the trainer-progress signal for stall
+        detection). Returns the started loop; call ``.stop()`` to end
+        it (releasing the hold so the run can drain), or let
+        :meth:`shutdown` force-release. Run :meth:`supervise` alongside
+        — growth publication rides the same plan machinery elastic
+        reshards use."""
+        if not self._handover_armed:
+            raise RuntimeError(
+                "run_online() requires the handover protocol "
+                "(elastic=True + ingest_handover)"
+            )
+        from tensorflowonspark_tpu.online import OnlineLoop
+
+        return OnlineLoop(self, log_root, **kw).start()
 
     @property
     def _handover_armed(self) -> bool:
@@ -891,6 +1004,7 @@ class TFCluster:
             }
             republish = self._ingest_republished
             self._ingest_republished = True
+            seq = self._ingest_seq
         # Never RPC a node the liveness plane declared dead: a wedged
         # process's kernel still accepts the connect and hangs the
         # handshake (same rule as shutdown/_check_errors).
@@ -920,6 +1034,7 @@ class TFCluster:
                         plan_id=self.cluster_meta.get("id"),
                         handover=self._handover_armed,
                         complete=complete,
+                        seq=seq,
                     ),
                     retry_on=(ConnectionError, OSError, EOFError),
                     site="ingest.plan_publish",
@@ -954,6 +1069,7 @@ class TFCluster:
         flightrec.note(
             "ingest_plan_republish" if republish else "ingest_plan",
             epoch=epoch,
+            seq=seq,
             shards={k: len(v) for k, v in shards.items()},
             unowned=unowned,
             complete=complete,
@@ -1064,7 +1180,16 @@ class TFCluster:
         for the cooperative drain, merge every published cursor
         (departed nodes' last publications included), re-split the
         REMAINING records over the surviving workers, and publish the
-        new plan keyed by the membership epoch."""
+        new plan keyed by the membership epoch. The whole episode runs
+        under the replan lock so a concurrent growth append
+        (:meth:`extend_shards`) cannot interleave with the re-split's
+        read-modify-write."""
+        with self._ingest_replan_lock:
+            self._redistribute_ingest_plan_locked(epoch, fresh_ids)
+
+    def _redistribute_ingest_plan_locked(
+        self, epoch: int, fresh_ids: "set[int] | frozenset" = frozenset()
+    ) -> None:  # lint: holds-lock
         from tensorflowonspark_tpu.feed.manifest import (
             merge_cursor_payloads,
             replan_manifests,
@@ -1174,8 +1299,13 @@ class TFCluster:
             if (
                 self._ingest_shards is None
                 or self._ingest_complete
+                # online mode: the dataset is still growing — never
+                # auto-release the lingering consumers (shutdown
+                # force-completes regardless)
+                or self._ingest_hold_completion
             ):
                 return
+            seq = self._ingest_seq
         if not self._handover_armed:
             return
         epoch = self.membership_epoch()
@@ -1190,6 +1320,11 @@ class TFCluster:
             if p.get("done") and not p.get("final"):
                 continue  # terminated: never publishes again
             if not p.get("final") or int(p.get("epoch", 0)) < epoch:
+                return
+            if int(p.get("plan_seq") or 0) < seq:
+                # a final published BEFORE the last append proves only
+                # the pre-growth dataset was consumed — the grown plan
+                # must earn its own finals
                 return
         self._finish_ingest_plan()
 
@@ -1490,7 +1625,11 @@ class TFCluster:
             )
         # A teardown must never leave handover consumers lingering for
         # more work: force the completion marker (idempotent; no-op
-        # when supervise already published it or no plan exists).
+        # when supervise already published it or no plan exists). The
+        # online hold is released first — run_online's growing dataset
+        # ends HERE, by definition.
+        with self._ingest_lock:
+            self._ingest_hold_completion = False
         self._finish_ingest_plan()
         node_errors = self._collect_errors(skip=dead)
         feed_queues = (

@@ -94,6 +94,7 @@ __all__ = [
     "FEED_TIMEOUT_KEY",
     "NODE_STATE_KEY",
     "ELASTIC_STATE_KEY",
+    "LIVELOG_KEY",
 ]
 
 
@@ -279,6 +280,7 @@ WIRE_SCHEMAS = {
             "manifests": "list",
             "handover": "bool",
             "complete": "bool",
+            "seq": "int|null",
         },
         "required": ["epoch", "shard_index", "num_shards", "manifests"],
     },
@@ -343,6 +345,7 @@ WIRE_SCHEMAS = {
             "cursor": "dict",
             "records_per_chunk": "int|null",
             "frame_blocks": "bool|null",
+            "plan_seq": "int|null",
         },
         "required": ["epoch", "final", "cursor"],
     },
@@ -385,6 +388,56 @@ WIRE_SCHEMAS = {
         "transport": "pointer",
         "fields": {"crc": "int", "manifest": "dict"},
         "required": ["crc", "manifest"],
+    },
+    # -- live-traffic log (feed/livelog.py): sealed-segment manifest
+    #    files the driver's online loop discovers and appends to the
+    #    running ingest plan (docs/ROBUSTNESS.md "Online continual
+    #    loop"). The manifest is a JSON file beside the sealed frame
+    #    segment; the announce KV is a node→driver discovery hint.
+    "livelog.manifest": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "pointer",
+        "fields": {
+            "path": "str",
+            "records": "int",
+            "bytes": "int",
+            "seq": "int",
+            "stream": "str",
+            "sealed_unix": "float",
+            "first_unix": "float|null",
+            "last_unix": "float|null",
+        },
+        "required": ["path", "records", "bytes", "seq", "stream"],
+    },
+    "kv.livelog_announce": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "kv",
+        "kv_key": "livelog",
+        "fields": {
+            "dir": "str",
+            "seq": "int",
+            "records": "int|null",
+        },
+        "required": ["dir", "seq"],
+    },
+    # -- online-loop freshness beacon (online.py): one JSON record the
+    #    driver loop rewrites each cycle so external probes (bench,
+    #    dashboards) can read loop health without the obs registry.
+    "online.freshness": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "pointer",
+        "fields": {
+            "t_unix": "float",
+            "cycle": "int",
+            "data_age_s": "float|null",
+            "loop_lag_s": "float|null",
+            "weights_version": "str|null",
+            "trained_records": "int|null",
+        },
+        "required": ["t_unix", "cycle"],
     },
     # -- serve_model HTTP bodies (tools/serve_model.py ↔ serving/
     #    fleet.py + external clients; NDJSON stream lines + trailers).
@@ -722,6 +775,7 @@ FEED_KNOBS_KEY = _kv_key_of("kv.feed_knobs")
 FEED_TIMEOUT_KEY = _kv_key_of("kv.feed_timeout")
 NODE_STATE_KEY = _kv_key_of("kv.node_state")
 ELASTIC_STATE_KEY = _kv_key_of("kv.elastic_state")
+LIVELOG_KEY = _kv_key_of("kv.livelog_announce")
 
 
 # ---------------------------------------------------------------------------

@@ -143,6 +143,13 @@ def metrics() -> dict[str, Any]:
                         "wall seconds per replay-cursor publication "
                         "(the autotune publish_blocks overhead signal)",
                     ),
+                    # growing-dataset wire (TFCluster.extend_shards)
+                    "growth_adoptions": r.counter(
+                        "ingest_growth_adoptions_total",
+                        "same-epoch plan-generation bumps adopted by a "
+                        "lingering consumer (appended shards absorbed "
+                        "without a membership bump)",
+                    ),
                 }
     return _metrics
 
@@ -359,6 +366,7 @@ class IngestFeed:
         records_per_chunk: int = 1024,
         retry: RetryPolicy | None = None,
         plan_epoch: int = 0,
+        plan_seq: int = 0,
         worker_index: int | None = None,
         plan_fetch: Callable[[int, float], dict | None] | None = None,
         cursor_publish: Callable[[dict], None] | None = None,
@@ -380,6 +388,11 @@ class IngestFeed:
         is exactly the PR-8 static-shard feed."""
         self.input_mapping = input_mapping
         self.plan_epoch = int(plan_epoch)
+        # plan GENERATION within the membership epoch (the growing-
+        # dataset wire): TFCluster.extend_shards bumps it; the
+        # exhaustion-linger adopts a same-epoch plan with a higher seq
+        # as appended work instead of completing
+        self.plan_seq = int(plan_seq)
         self.worker_index = worker_index
         self._user_reader = reader
         self._records_per_chunk = int(records_per_chunk)
@@ -550,6 +563,10 @@ class IngestFeed:
             # custom reader streams records_per_chunk blocks even over
             # 'columnar'-format manifests
             frame_blocks=False if self._user_reader is not None else None,
+            # plan generation this cursor was consumed under: the
+            # driver's completion check must not accept a final
+            # published BEFORE the dataset grew (growing-dataset wire)
+            plan_seq=self.plan_seq,
         )
         try:
             t0 = time.perf_counter()
@@ -632,6 +649,7 @@ class IngestFeed:
 
         with self._cursor_lock:
             self.plan_epoch = int(plan.get("epoch", self.plan_epoch))
+            self.plan_seq = int(plan.get("seq") or 0)
             self._complete = bool(plan.get("complete"))
             self._pending_skip = {}
             done = dict(self._done)
@@ -653,6 +671,41 @@ class IngestFeed:
         self._iter = None
         self._exhausted = False
         metrics()["plan_epoch"].set(self.plan_epoch)
+
+    def _adopt_growth(self, plan: dict) -> None:
+        """Adopt a same-epoch plan-generation bump from the linger: the
+        plan's manifest list is CUMULATIVE (old shard + appended), but
+        at linger time every current stream is fully consumed — so the
+        reader is rebuilt over only the streams ``_done`` has no state
+        for (the appended ones), avoiding an O(history) re-scan per
+        growth cycle. ``_done`` keeps the full consumed prefix, so
+        ``cursor()`` still reports exactly-once state over the whole
+        grown dataset."""
+        with self._cursor_lock:
+            consumed = set(self._done)
+        manifests = [
+            m
+            for m in (plan.get("manifests") or [])
+            if stream_id(m) not in consumed
+        ]
+        n_appended = len(manifests)
+        self._adopt(dict(plan, manifests=manifests))
+        metrics()["growth_adoptions"].inc()
+        flightrec.note(
+            "ingest_handover",
+            worker=self.worker_index,
+            cause="growth",
+            epoch=self.plan_epoch,
+            plan_seq=self.plan_seq,
+            manifests=n_appended,
+        )
+        logger.info(
+            "ingest: adopted grown plan seq %d (%d appended "
+            "manifest(s) at epoch %d)",
+            self.plan_seq,
+            n_appended,
+            self.plan_epoch,
+        )
 
     def _await_redistribution(self) -> bool:
         """Shard exhausted under an armed handover: publish the FINAL
@@ -692,6 +745,20 @@ class IngestFeed:
             ):
                 self._complete = True
                 return False
+            if (
+                plan is not None
+                and not plan.get("complete")
+                and int(plan.get("epoch", 0)) == self.plan_epoch
+                and int(plan.get("seq") or 0) > self.plan_seq
+            ):
+                # the growing-dataset wire: a SAME-epoch plan with a
+                # higher generation is appended work (TFCluster.
+                # extend_shards) — adopt it and resume consuming. The
+                # final published above is stamped with the OLD seq, so
+                # the driver's completion check cannot mistake it for
+                # exhaustion of the grown dataset.
+                self._adopt_growth(plan)
+                return True
             time.sleep(0.25)
 
     # -- iteration core ------------------------------------------------

@@ -1,0 +1,609 @@
+"""Crash-safe rotating live-traffic log: serving → training data.
+
+The online continual loop (docs/ROBUSTNESS.md "Online continual
+loop") needs serving traffic to BECOME training data while both
+planes keep running. This module is the serve-side half: a
+:class:`TrafficLog` that fleet replicas / ``serve_model`` feed with
+one record per completed request, written as 64-aligned columnar
+frames (``feed/columnar.py`` — the exact format ``FileManifest(
+format="columnar")`` reads back zero-copy), rotated into sealed
+segment files whose JSON manifests the driver's online loop
+discovers (:func:`discover_manifests`) and appends to the RUNNING
+ingest plan.
+
+Hard rules, in order:
+
+1. **Never block the serve path.** :meth:`TrafficLog.append` is one
+   lock + a buffered frame write; any failure (disk full, armed
+   ``online.log_append`` failpoint, closed log) DROPS the record and
+   counts it in ``online_records_dropped_total{reason}`` — lost data
+   is counted, never lied about, and never a request error.
+2. **Crash-safe.** The active segment is append-only self-framing
+   bytes: a SIGKILL mid-write leaves at most one torn tail frame,
+   which the CRC codec rejects — :func:`TrafficLog.recover` (run at
+   construction) truncates the tear, seals the rest, and republishes
+   any sealed segment whose manifest publication was lost. Manifests
+   are written tmp + ``os.replace`` so a reader never sees a torn
+   JSON file (wire schema ``livelog.manifest``).
+3. **Bounded disk.** ``disk_budget_bytes`` caps sealed-segment bytes
+   with drop-oldest semantics: the oldest sealed segment (and its
+   manifest) is deleted and its records counted as dropped
+   (``reason="disk_budget"``). A stalled trainer therefore bounds log
+   growth at the budget — the loop degrades to a sliding window of
+   the freshest traffic instead of filling the disk.
+
+Records are columnized with FIXED widths (the columnar codec rejects
+ragged rows): token ids pad to ``prompt_width``/``completion_width``
+int32 columns with explicit ``*_len`` columns, and the version/trace
+stamps pad to fixed-width space-padded strings (trailing NULs would
+be trimmed by numpy's S/U dtypes). :func:`decode_records` undoes the
+padding for consumers that want the original shapes back.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from tensorflowonspark_tpu.cluster import wire
+from tensorflowonspark_tpu.obs import flightrec
+from tensorflowonspark_tpu.utils.failpoints import failpoint
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "TrafficLog",
+    "decode_records",
+    "discover_manifests",
+    "manifest_to_file",
+]
+
+#: Fixed column widths for the string stamps (space-padded; a version
+#: or trace id longer than this is truncated — stamps are short ids,
+#: not payloads).
+VERSION_WIDTH = 24
+TRACE_WIDTH = 24
+
+_MANIFEST_DIR = "manifests"
+_ACTIVE_SUFFIX = ".tfc.active"
+_SEALED_SUFFIX = ".tfc"
+
+_metrics_lock = threading.Lock()
+_metrics: dict[str, Any] | None = None
+
+
+def metrics() -> dict[str, Any]:
+    """Traffic-log counters in the process-global obs registry."""
+    global _metrics
+    if _metrics is None:
+        with _metrics_lock:
+            if _metrics is None:
+                from tensorflowonspark_tpu.obs.registry import (
+                    default_registry,
+                )
+
+                r = default_registry()
+                _metrics = {
+                    "frames": r.counter(
+                        "online_frames_logged_total",
+                        "columnar frames appended to the live-traffic "
+                        "log",
+                    ),
+                    "dropped": r.counter(
+                        "online_records_dropped_total",
+                        "live-traffic records dropped instead of "
+                        "logged, by reason (failpoint|io_error|closed|"
+                        "disk_budget); nonzero is lost training data — "
+                        "counted, never lied about",
+                    ),
+                }
+    return _metrics
+
+
+def _pad_tokens(tokens: Any, width: int) -> tuple[np.ndarray, int]:
+    arr = np.asarray(list(tokens) if tokens is not None else [], np.int32)
+    n = min(int(arr.shape[0]), width)
+    out = np.zeros((width,), np.int32)
+    out[:n] = arr[:n]
+    return out, n
+
+
+def _pad_str(s: str | None, width: int) -> str:
+    s = "" if s is None else str(s)
+    return (s[:width]).ljust(width)
+
+
+class TrafficLog:
+    """Rotating columnar frame writer for per-request traffic records.
+
+    ``root`` is the log directory (one per serving process — segment
+    names embed ``stream``, so several logs may share a manifest
+    consumer but never a directory). ``announce`` is an optional
+    callback invoked with each published manifest dict — the hook a
+    node uses to push a ``kv.livelog_announce`` discovery hint to the
+    driver KV; discovery itself needs only the shared filesystem.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        stream: str = "live",
+        prompt_width: int = 32,
+        completion_width: int = 32,
+        frame_records: int = 32,
+        rotate_records: int = 256,
+        rotate_seconds: float | None = None,
+        disk_budget_bytes: int | None = None,
+        announce: Callable[[dict], None] | None = None,
+    ):
+        if rotate_records < 1 or frame_records < 1:
+            raise ValueError("rotate_records/frame_records must be >= 1")
+        self.root = os.path.abspath(root)
+        self.stream = str(stream)
+        self.prompt_width = int(prompt_width)
+        self.completion_width = int(completion_width)
+        self.frame_records = int(frame_records)
+        self.rotate_records = int(rotate_records)
+        self.rotate_seconds = rotate_seconds
+        self.disk_budget_bytes = disk_budget_bytes
+        self.announce = announce
+        os.makedirs(os.path.join(self.root, _MANIFEST_DIR), exist_ok=True)
+        self._lock = threading.Lock()
+        self._buf: list[dict] = []  # guarded-by: self._lock
+        self._file = None  # open active segment  # guarded-by: self._lock
+        self._seq = 0  # next segment seq  # guarded-by: self._lock
+        self._frame_seq = 0  # within segment  # guarded-by: self._lock
+        self._seg_records = 0  # guarded-by: self._lock
+        self._seg_opened = 0.0  # wall clock  # guarded-by: self._lock
+        self._seg_first: float | None = None  # guarded-by: self._lock
+        self._seg_last: float | None = None  # guarded-by: self._lock
+        # sealed segments still on disk, oldest first:
+        # [(seq, path, manifest_path, bytes, records)]
+        self._sealed: list[tuple] = []  # guarded-by: self._lock
+        self._closed = False  # guarded-by: self._lock
+        self.recover()
+
+    # -- naming --------------------------------------------------------
+
+    def _seg_name(self, seq: int) -> str:
+        return f"{self.stream}-{seq:08d}"
+
+    def _active_path(self, seq: int) -> str:
+        return os.path.join(self.root, self._seg_name(seq) + _ACTIVE_SUFFIX)
+
+    def _sealed_path(self, seq: int) -> str:
+        return os.path.join(self.root, self._seg_name(seq) + _SEALED_SUFFIX)
+
+    def _manifest_path(self, seq: int) -> str:
+        return os.path.join(
+            self.root, _MANIFEST_DIR, self._seg_name(seq) + ".json"
+        )
+
+    # -- serve-path append ---------------------------------------------
+
+    def append(
+        self,
+        prompt: Any,
+        completion: Any,
+        *,
+        outcome: float = 0.0,
+        weights_version: str | None = None,
+        trace_id: str | None = None,
+    ) -> bool:
+        """Log one completed request; returns False when the record
+        was dropped (counted). NEVER raises and never blocks beyond
+        one buffered frame write — the serve path's latency is the
+        priority, the record is best-effort."""
+        if failpoint("online.log_append") == "drop":
+            metrics()["dropped"].inc(reason="failpoint")
+            return False
+        p, p_len = _pad_tokens(prompt, self.prompt_width)
+        c, c_len = _pad_tokens(completion, self.completion_width)
+        now = time.time()
+        record = {
+            "t_unix": np.float64(now),
+            "prompt": p,
+            "prompt_len": np.int32(p_len),
+            "completion": c,
+            "completion_len": np.int32(c_len),
+            "outcome": np.float64(outcome),
+            "weights_version": _pad_str(weights_version, VERSION_WIDTH),
+            "trace_id": _pad_str(trace_id, TRACE_WIDTH),
+        }
+        with self._lock:
+            if self._closed:
+                metrics()["dropped"].inc(reason="closed")
+                return False
+            self._buf.append(record)
+            if self._seg_first is None:
+                self._seg_first = now
+            self._seg_last = now
+            try:
+                if len(self._buf) >= self.frame_records:
+                    self._flush_locked()
+                if self._rotation_due_locked(now):
+                    self._seal_locked()
+            except (OSError, ValueError) as e:
+                lost = len(self._buf)
+                self._buf = []
+                metrics()["dropped"].inc(lost, reason="io_error")
+                logger.warning(
+                    "traffic log append failed (%s): dropped %d "
+                    "buffered record(s) — serve path unaffected",
+                    e,
+                    lost,
+                )
+                return False
+        return True
+
+    def _rotation_due_locked(self, now: float) -> bool:  # lint: holds-lock
+        # count buffered records too: with rotate_records below the
+        # frame size, rotation is what forces the flush
+        pending = self._seg_records + len(self._buf)
+        if pending >= self.rotate_records:
+            return True
+        return (
+            self.rotate_seconds is not None
+            and pending > 0
+            and now - self._seg_opened >= self.rotate_seconds
+        )
+
+    def _flush_locked(self) -> None:  # lint: holds-lock
+        """Columnize the buffered records into ONE frame and append it
+        to the active segment (opened lazily)."""
+        from tensorflowonspark_tpu.feed.columnar import (
+            _PAD,
+            _align,
+            columnize_records,
+            frame_bytes,
+        )
+
+        if not self._buf:
+            return
+        batch, self._buf = self._buf, []
+        chunk = columnize_records(batch)
+        if chunk is None:  # fixed widths make this unreachable in
+            # practice; treat like any other io failure if it happens
+            raise ValueError("traffic records failed to columnize")
+        if self._file is None:
+            self._file = open(self._active_path(self._seq), "ab")
+            self._seg_opened = time.time()
+        data = frame_bytes(
+            chunk,
+            stream=self._seg_name(self._seq),
+            seq=self._frame_seq,
+        )
+        self._file.write(data)
+        self._file.write(_PAD[: _align(len(data)) - len(data)])
+        self._file.flush()
+        self._frame_seq += 1
+        self._seg_records += len(batch)
+        metrics()["frames"].inc()
+
+    # -- rotation / sealing --------------------------------------------
+
+    def rotate(self) -> dict | None:
+        """Seal the active segment now (if it has records) and publish
+        its manifest; returns the manifest dict or None when the
+        segment was empty. The driver-facing flush hook — the online
+        loop calls it so a slow trickle of traffic still becomes
+        training data each cycle."""
+        with self._lock:
+            if self._closed:
+                return None
+            try:
+                return self._seal_locked()
+            except (OSError, ValueError) as e:
+                lost = len(self._buf)
+                self._buf = []
+                if lost:
+                    metrics()["dropped"].inc(lost, reason="io_error")
+                logger.warning("traffic log rotate failed (%s)", e)
+                return None
+
+    def _seal_locked(self) -> dict | None:  # lint: holds-lock
+        self._flush_locked()
+        if self._seg_records == 0:
+            return None
+        seq = self._seq
+        f, self._file = self._file, None
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        sealed = self._sealed_path(seq)
+        os.replace(self._active_path(seq), sealed)
+        records = self._seg_records
+        first, last = self._seg_first, self._seg_last
+        self._seq += 1
+        self._frame_seq = 0
+        self._seg_records = 0
+        self._seg_first = self._seg_last = None
+        manifest = self._publish_locked(
+            seq, sealed, records, first=first, last=last
+        )
+        self._enforce_budget_locked()
+        return manifest
+
+    def _publish_locked(
+        self,
+        seq: int,
+        sealed: str,
+        records: int,
+        first: float | None = None,
+        last: float | None = None,
+    ) -> dict | None:  # lint: holds-lock
+        nbytes = os.path.getsize(sealed)
+        manifest = wire.encode(
+            "livelog.manifest",
+            path=sealed,
+            records=int(records),
+            bytes=int(nbytes),
+            seq=int(seq),
+            stream=self.stream,
+            sealed_unix=time.time(),
+            first_unix=first,
+            last_unix=last,
+        )
+        mpath = self._manifest_path(seq)
+        if failpoint("online.manifest_publish") == "drop":
+            # a lost publication: the sealed segment stays on disk,
+            # undiscovered until recover() republishes it — bounded
+            # staleness, never lost data
+            logger.warning(
+                "traffic log manifest publication for segment %d "
+                "dropped (failpoint online.manifest_publish) — "
+                "recover() will republish",
+                seq,
+            )
+            self._sealed.append((seq, sealed, mpath, nbytes, records))
+            return None
+        tmp = f"{mpath}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as mf:
+            json.dump(manifest, mf)
+            mf.write("\n")
+        os.replace(tmp, mpath)
+        self._sealed.append((seq, sealed, mpath, nbytes, records))
+        flightrec.note(
+            "online_manifest_publish",
+            stream=self.stream,
+            seq=seq,
+            records=records,
+            bytes=nbytes,
+        )
+        if self.announce is not None:
+            try:
+                self.announce(manifest)
+            except Exception as e:  # noqa: BLE001 - announce is a hint
+                logger.warning("traffic log announce failed (%s)", e)
+        return manifest
+
+    def _enforce_budget_locked(self) -> None:  # lint: holds-lock
+        if self.disk_budget_bytes is None:
+            return
+        total = sum(s[3] for s in self._sealed)
+        while len(self._sealed) > 1 and total > self.disk_budget_bytes:
+            seq, path, mpath, nbytes, records = self._sealed.pop(0)
+            total -= nbytes
+            for p in (path, mpath):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+            metrics()["dropped"].inc(records, reason="disk_budget")
+            logger.warning(
+                "traffic log over disk budget: dropped oldest sealed "
+                "segment %d (%d record(s), %d bytes) — a lagging "
+                "trainer sees a sliding window, not unbounded disk",
+                seq,
+                records,
+                nbytes,
+            )
+
+    def sealed_bytes(self) -> int:
+        """Total bytes of sealed segments still on disk (the quantity
+        the disk budget caps) — the loop's stall-detection input."""
+        with self._lock:
+            return sum(s[3] for s in self._sealed)
+
+    # -- recovery ------------------------------------------------------
+
+    def recover(self) -> int:
+        """Crash recovery (also run at construction): truncate the torn
+        tail frame of any leftover ``.active`` segment, seal what
+        survives, republish manifests lost before the crash, and resume
+        numbering after the highest existing segment. Returns the
+        number of segments recovered or republished."""
+        from tensorflowonspark_tpu.feed.columnar import decode_frame
+
+        fixed = 0
+        with self._lock:
+            by_seq: dict[int, str] = {}
+            for fn in sorted(os.listdir(self.root)):
+                if not fn.startswith(self.stream + "-"):
+                    continue
+                stem = fn[len(self.stream) + 1 :]
+                if fn.endswith(_ACTIVE_SUFFIX):
+                    seqs = stem[: -len(_ACTIVE_SUFFIX)]
+                elif fn.endswith(_SEALED_SUFFIX):
+                    seqs = stem[: -len(_SEALED_SUFFIX)]
+                else:
+                    continue
+                try:
+                    by_seq[int(seqs)] = os.path.join(self.root, fn)
+                except ValueError:
+                    continue
+            for seq in sorted(by_seq):
+                path = by_seq[seq]
+                if path.endswith(_ACTIVE_SUFFIX):
+                    good, records = _scan_intact(path, decode_frame)
+                    size = os.path.getsize(path)
+                    if good < size:
+                        with open(path, "r+b") as f:
+                            f.truncate(good)
+                        logger.warning(
+                            "traffic log recovery: truncated torn "
+                            "tail of %s (%d -> %d bytes)",
+                            path,
+                            size,
+                            good,
+                        )
+                    if records == 0:
+                        os.remove(path)
+                        continue
+                    sealed = self._sealed_path(seq)
+                    os.replace(path, sealed)
+                    self._publish_locked(seq, sealed, records)
+                    fixed += 1
+                elif not os.path.exists(self._manifest_path(seq)):
+                    # sealed before the crash, manifest publication
+                    # lost (or dropped by the failpoint): republish
+                    _, records = _scan_intact(path, decode_frame)
+                    self._publish_locked(seq, path, records)
+                    fixed += 1
+                else:
+                    records = _manifest_records(self._manifest_path(seq))
+                    self._sealed.append(
+                        (
+                            seq,
+                            path,
+                            self._manifest_path(seq),
+                            os.path.getsize(path),
+                            records,
+                        )
+                    )
+            if by_seq:
+                self._seq = max(by_seq) + 1
+            self._enforce_budget_locked()
+        return fixed
+
+    # -- lifecycle -----------------------------------------------------
+
+    def close(self, seal: bool = True) -> None:
+        """Stop accepting records; ``seal=True`` (default) publishes
+        the in-progress segment so buffered traffic is not stranded."""
+        with self._lock:
+            if self._closed:
+                return
+            try:
+                if seal:
+                    self._seal_locked()
+                elif self._file is not None:
+                    self._file.close()
+            except (OSError, ValueError) as e:
+                logger.warning("traffic log close failed (%s)", e)
+            finally:
+                self._file = None
+                self._closed = True
+
+
+def _scan_intact(path: str, decode_frame) -> tuple[int, int]:
+    """(intact_byte_length, record_count) of a framed file: walk frames
+    from the head, fully CRC-verifying each; stop at the first torn /
+    truncated / corrupt frame."""
+    from tensorflowonspark_tpu.feed.columnar import (
+        _PREFIX,
+        _align,
+        frame_span,
+    )
+
+    good = 0
+    records = 0
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return 0, 0
+    size = len(data)
+    mv = memoryview(data)
+    while good + _PREFIX.size <= size:
+        try:
+            span = frame_span(mv, good)
+            if good + span > size:
+                break  # truncated mid-payload
+            # decode_frame verifies header + payload CRCs; a torn tail
+            # fails here (short buffers / bit flips → ValueError)
+            chunk = decode_frame(mv[good : good + span])
+            records += len(chunk)
+            good += _align(span)
+        except Exception:  # noqa: BLE001 - any tear ends the scan
+            break
+    return good, records
+
+
+def _manifest_records(mpath: str) -> int:
+    try:
+        with open(mpath, encoding="utf-8") as f:
+            return int(json.load(f).get("records", 0))
+    except (OSError, ValueError):
+        return 0
+
+
+# -- driver-side discovery ---------------------------------------------------
+
+
+def discover_manifests(
+    root: str, *, after_seq: int = -1, stream: str | None = None
+) -> list[dict]:
+    """Scan a traffic log's manifest directory and return the decoded
+    manifests with ``seq > after_seq``, ordered by seq — the driver
+    loop's per-poll discovery step. A torn or malformed manifest file
+    is skipped loudly (the writer publishes atomically, so this only
+    happens to foreign files)."""
+    failpoint("online.discover")
+    mdir = os.path.join(os.path.abspath(root), _MANIFEST_DIR)
+    out: list[dict] = []
+    try:
+        names = sorted(os.listdir(mdir))
+    except OSError:
+        return out
+    for fn in names:
+        if not fn.endswith(".json"):
+            continue
+        path = os.path.join(mdir, fn)
+        try:
+            with open(path, encoding="utf-8") as f:
+                m = wire.decode("livelog.manifest", json.load(f))
+        except (OSError, ValueError, wire.WireError) as e:
+            logger.warning(
+                "skipping malformed traffic-log manifest %s (%s)", path, e
+            )
+            continue
+        if m["seq"] <= after_seq:
+            continue
+        if stream is not None and m["stream"] != stream:
+            continue
+        out.append(m)
+    out.sort(key=lambda m: (m["stream"], m["seq"]))
+    return out
+
+
+def manifest_to_file(m: dict) -> Any:
+    """A published livelog manifest as the ``FileManifest`` the ingest
+    plane plans and reads (``format="columnar"``)."""
+    from tensorflowonspark_tpu.feed.manifest import FileManifest
+
+    return FileManifest(path=m["path"], format="columnar")
+
+
+def decode_records(rows: Iterator[Any]) -> Iterator[dict]:
+    """Undo the fixed-width padding: yields dicts with ``prompt`` /
+    ``completion`` trimmed to their true lengths and the string stamps
+    stripped — the trainer-side view of logged traffic."""
+    for r in rows:
+        p_len = int(r["prompt_len"])
+        c_len = int(r["completion_len"])
+        yield {
+            "t_unix": float(r["t_unix"]),
+            "prompt": np.asarray(r["prompt"])[:p_len],
+            "completion": np.asarray(r["completion"])[:c_len],
+            "outcome": float(r["outcome"]),
+            "weights_version": str(r["weights_version"]).rstrip(),
+            "trace_id": str(r["trace_id"]).rstrip(),
+        }

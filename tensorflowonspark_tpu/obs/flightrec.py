@@ -97,6 +97,13 @@ EVENTS = frozenset(
         "autotune_decision",
         "autotune_revert",
         "autotune_frozen",
+        # online continual loop (feed/livelog.py + online.py — see
+        # docs/ROBUSTNESS.md "Online continual loop"): every loop cycle
+        # (manifests discovered, data age, lag), every sealed-segment
+        # manifest publication, and every stall onset is auditable
+        "online_cycle",
+        "online_stall",
+        "online_manifest_publish",
     }
 )
 

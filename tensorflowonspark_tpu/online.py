@@ -1,0 +1,453 @@
+"""tfos.online — the continual-training driver loop over live traffic.
+
+Closes the loop the previous subsystems opened one edge at a time:
+serving replicas append each completed request to a
+:class:`~tensorflowonspark_tpu.feed.livelog.TrafficLog`
+(``feed/livelog.py``), sealed segments publish manifests, and this
+module's :class:`OnlineLoop` — running on the driver next to
+``TFCluster.supervise`` — discovers those manifests each poll and
+*appends* them to the RUNNING elastic training run via
+``TFCluster.extend_shards`` (the growing-dataset wire: a same-epoch
+plan-generation bump that lingering ``IngestFeed`` consumers adopt
+without a membership epoch). When the trainer publishes a checkpoint
+(``serving.rollout.publish_checkpoint``), the PR-15 rollout watcher
+rolls the serving fleet, new completions are stamped with the new
+``weights_version``, and the next discovered segment carries them —
+one closed loop.
+
+Health is first-class, not bolted on:
+
+- ``online_data_age_seconds`` — age of the newest sealed traffic the
+  trainer has been handed (how stale is the data we train on);
+- ``online_loop_lag_seconds`` — time since the serving weights last
+  advanced (how stale is the model we serve);
+- ``online_cycles_total{outcome}`` — ok | idle | stall |
+  discover_error | extend_error per poll;
+- a **freshness SLO** (:func:`online_slos`): every cycle observes the
+  data age into the ``online_freshness_seconds`` histogram and the
+  standard multi-window ``obs.slo`` evaluator burns against the
+  declared objective — same machinery, same ``slo_breach`` black-box
+  dump, as the serving SLOs.
+
+Stall detection: when fresh traffic keeps sealing but trainer progress
+(a new published ``weights_version``, or whatever ``progress_fn``
+reports) has not advanced for ``stall_after_s``, the loop notes an
+``online_stall`` flight-recorder event and counts the cycle as a
+stall. Disk stays bounded regardless — the TrafficLog's
+``disk_budget_bytes`` drops oldest sealed segments (counted in
+``online_records_dropped_total{reason="disk_budget"}``), so a lagging
+trainer sees a sliding window, never unbounded growth.
+
+Every cycle also publishes a ``online.freshness`` beacon (wire schema;
+single JSON record, tmp + ``os.replace``) next to the traffic log, so
+anything outside the driver process — dashboards, the bench harness, a
+second driver deciding whether to take over — can read loop health
+without importing this module.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable
+
+from tensorflowonspark_tpu.cluster import wire
+from tensorflowonspark_tpu.feed import livelog
+from tensorflowonspark_tpu.obs import flightrec
+from tensorflowonspark_tpu.obs.history import History
+from tensorflowonspark_tpu.obs.slo import SLO, SLOEvaluator
+from tensorflowonspark_tpu.utils.failpoints import failpoint
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["OnlineLoop", "online_slos", "metrics"]
+
+#: Beacon file name, published under the traffic-log root.
+BEACON_NAME = "freshness.json"
+
+_metrics_lock = threading.Lock()
+_metrics: dict[str, Any] | None = None
+
+
+def metrics() -> dict[str, Any]:
+    """The loop's instruments in the process-global obs registry."""
+    global _metrics
+    if _metrics is None:
+        with _metrics_lock:
+            if _metrics is None:
+                from tensorflowonspark_tpu.obs.registry import (
+                    default_registry,
+                )
+
+                r = default_registry()
+                _metrics = {
+                    "data_age": r.gauge(
+                        "online_data_age_seconds",
+                        "age of the newest sealed traffic segment "
+                        "handed to the training run (freshness of the "
+                        "data plane)",
+                    ),
+                    "loop_lag": r.gauge(
+                        "online_loop_lag_seconds",
+                        "time since trainer progress last advanced "
+                        "the published weights (freshness of the "
+                        "model plane)",
+                    ),
+                    "cycles": r.counter(
+                        "online_cycles_total",
+                        "online-loop poll cycles by outcome (ok|idle|"
+                        "stall|discover_error|extend_error)",
+                    ),
+                    "freshness": r.histogram(
+                        "online_freshness_seconds",
+                        "per-cycle observations of data age — the "
+                        "series the freshness SLO burns against",
+                    ),
+                }
+    return _metrics
+
+
+def online_slos(
+    freshness_objective_s: float = 30.0,
+    freshness_budget: float = 0.2,
+    fast_window_s: float = 30.0,
+    slow_window_s: float = 120.0,
+    fast_burn: float = 2.0,
+    slow_burn: float = 1.5,
+) -> tuple[SLO, ...]:
+    """The continual loop's objective: the data the trainer holds is
+    no older than ``freshness_objective_s`` for at least
+    ``1 - freshness_budget`` of cycles. Windows and burn thresholds
+    default much tighter than the serving SLOs — a continual loop that
+    goes stale for minutes has already failed its purpose."""
+    return (
+        SLO(
+            name="online_freshness",
+            kind="latency",
+            metric="online_freshness_seconds",
+            objective=freshness_objective_s,
+            budget=freshness_budget,
+            fast_window_s=fast_window_s,
+            slow_window_s=slow_window_s,
+            fast_burn=fast_burn,
+            slow_burn=slow_burn,
+            description="training-data age within the freshness "
+            "objective",
+        ),
+    )
+
+
+class OnlineLoop:
+    """The driver-side poll loop: discover sealed traffic → append it
+    to the running cluster's ingest plan → watch trainer progress →
+    publish health.
+
+    ``cluster`` needs ``extend_shards(files)`` (and, when present,
+    ``hold_ingest_completion`` — held on :meth:`start`, released on
+    :meth:`stop` so the run can drain and complete). ``progress_fn``
+    reports trainer progress as any comparable token (default: the
+    rollout channel's published ``weights_version`` via
+    ``serving.rollout.read_latest`` when ``channel_dir`` is given);
+    a changed token is progress.
+
+    Drive it either with :meth:`start`/:meth:`stop` (daemon thread,
+    the production shape) or by calling :meth:`step` directly (tests,
+    bench)."""
+
+    def __init__(
+        self,
+        cluster: Any,
+        log_root: str,
+        *,
+        stream: str | None = None,
+        channel_dir: str | None = None,
+        after: dict[str, int] | None = None,
+        progress_fn: Callable[[], Any] | None = None,
+        poll_interval_s: float = 1.0,
+        stall_after_s: float = 30.0,
+        freshness_objective_s: float = 30.0,
+        beacon_path: str | None = None,
+        registry: Any = None,
+        evaluator: SLOEvaluator | None = None,
+    ):
+        if channel_dir is None and progress_fn is None:
+            logger.warning(
+                "online loop without channel_dir or progress_fn: "
+                "trainer progress is invisible, stall detection is off"
+            )
+        self.cluster = cluster
+        self.log_root = os.path.abspath(log_root)
+        self.stream = stream
+        self.channel_dir = channel_dir
+        self.progress_fn = progress_fn
+        self.poll_interval_s = float(poll_interval_s)
+        self.stall_after_s = float(stall_after_s)
+        self.beacon_path = beacon_path or os.path.join(
+            self.log_root, BEACON_NAME
+        )
+        if registry is None:
+            from tensorflowonspark_tpu.obs.registry import default_registry
+
+            registry = default_registry()
+        self._registry = registry
+        if evaluator is None:
+            # the freshness SLO gets its own pumping History: windows
+            # are relative to the previous scrape, and sharing a
+            # registry pump across components interleaves them
+            self._history = History(source="online.loop")
+            evaluator = SLOEvaluator(
+                online_slos(freshness_objective_s=freshness_objective_s),
+                self._history,
+                registry=registry,
+            )
+        else:
+            self._history = evaluator.history
+        self.evaluator = evaluator
+
+        self._lock = threading.Lock()
+        # seeded with `after` for segments already in the initial
+        # assign_shards plan — the loop appends only what comes later
+        self._after: dict[str, int] = dict(after or {})  # guarded-by: self._lock
+        self._cycle = 0  # guarded-by: self._lock
+        self._extended = 0  # manifests appended  # guarded-by: self._lock
+        self._extended_records = 0  # guarded-by: self._lock
+        self._last_data_unix: float | None = None  # guarded-by: self._lock
+        self._last_progress_unix: float | None = None  # guarded-by: self._lock
+        self._progress_token: Any = None  # guarded-by: self._lock
+        self._stalled = False  # guarded-by: self._lock
+        self._stalls = 0  # guarded-by: self._lock
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- progress ------------------------------------------------------
+
+    def _read_progress(self) -> Any:
+        """The trainer-progress token, or ``None`` when unknowable."""
+        if self.progress_fn is not None:
+            return self.progress_fn()
+        if self.channel_dir is None:
+            return None
+        from tensorflowonspark_tpu.serving.rollout import read_latest
+
+        update = read_latest(self.channel_dir)
+        return None if update is None else update.version
+
+    # -- one poll ------------------------------------------------------
+
+    def step(self, now: float | None = None) -> dict[str, Any]:
+        """One poll cycle; returns the cycle summary (also noted to the
+        flight recorder). Never raises — a failed discover or extend is
+        an *outcome*, not a crash: the loop's job is to keep polling."""
+        now = time.time() if now is None else float(now)
+        m = metrics()
+        with self._lock:
+            self._cycle += 1
+            cycle = self._cycle
+            after = dict(self._after)
+        outcome = "idle"
+        discovered = 0
+
+        # 1. discover newly sealed traffic
+        try:
+            found = livelog.discover_manifests(
+                self.log_root,
+                after_seq=min(after.values(), default=-1),
+                stream=self.stream,
+            )
+        except Exception as e:  # noqa: BLE001 - the loop must keep polling
+            logger.warning("online discover failed (%s) — next poll", e)
+            found, outcome = [], "discover_error"
+        fresh = [
+            f for f in found if f["seq"] > after.get(f["stream"], -1)
+        ]
+
+        # 2. append them to the running ingest plan
+        if fresh:
+            discovered = len(fresh)
+            try:
+                self.cluster.extend_shards(
+                    [livelog.manifest_to_file(f) for f in fresh]
+                )
+            except Exception as e:  # noqa: BLE001 - keep polling
+                logger.warning(
+                    "online extend_shards failed (%s) — manifests stay "
+                    "undiscovered and retry next poll", e,
+                )
+                outcome = "extend_error"
+            else:
+                outcome = "ok"
+                with self._lock:
+                    for f in fresh:
+                        prev = self._after.get(f["stream"], -1)
+                        self._after[f["stream"]] = max(prev, f["seq"])
+                        self._extended += 1
+                        self._extended_records += int(f["records"])
+                        sealed = float(f.get("sealed_unix") or now)
+                        if (self._last_data_unix is None
+                                or sealed > self._last_data_unix):
+                            self._last_data_unix = sealed
+
+        # 3. trainer progress / stall detection
+        stalled_now = False
+        try:
+            token = self._read_progress()
+        except Exception as e:  # noqa: BLE001 - keep polling
+            logger.warning("online progress probe failed (%s)", e)
+            token = None
+        if failpoint("online.train_stall") == "drop":
+            token = None  # chaos: the trainer looks frozen this poll
+        with self._lock:
+            if token is not None and token != self._progress_token:
+                self._progress_token = token
+                self._last_progress_unix = now
+                self._stalled = False
+            watching = (
+                self.channel_dir is not None or self.progress_fn is not None
+            )
+            data_age = (
+                0.0 if self._last_data_unix is None
+                else max(0.0, now - self._last_data_unix)
+            )
+            loop_lag = (
+                0.0 if self._last_progress_unix is None
+                else max(0.0, now - self._last_progress_unix)
+            )
+            # a stall needs BOTH edges: data arriving, trainer not —
+            # an idle log or a pre-first-checkpoint warmup is not one
+            if (
+                watching
+                and self._last_data_unix is not None
+                and self._last_progress_unix is not None
+                and loop_lag > self.stall_after_s
+                and self._last_data_unix > self._last_progress_unix
+            ):
+                stalled_now = not self._stalled
+                self._stalled = True
+                if stalled_now:
+                    self._stalls += 1
+            stamped = self._progress_token
+            trained = self._extended_records
+
+        if stalled_now:
+            outcome = "stall"
+            flightrec.note(
+                "online_stall",
+                cycle=cycle,
+                loop_lag_s=round(loop_lag, 3),
+                data_age_s=round(data_age, 3),
+                stall_after_s=self.stall_after_s,
+            )
+            logger.warning(
+                "online loop stall: no trainer progress for %.1fs with "
+                "fresh traffic pending — log growth stays bounded by "
+                "the disk budget", loop_lag,
+            )
+            flightrec.dump_now("online_stall")
+
+        # 4. health: gauges, freshness histogram, SLO burn, beacon
+        m["data_age"].set(data_age)
+        m["loop_lag"].set(loop_lag)
+        m["freshness"].observe(data_age)
+        m["cycles"].inc(outcome=outcome)
+        self._history.scrape_registry(self._registry, t=now)
+        verdicts = self.evaluator.evaluate(now=now)
+        self._publish_beacon(
+            now, cycle, data_age, loop_lag, stamped, trained
+        )
+        flightrec.note(
+            "online_cycle",
+            cycle=cycle,
+            outcome=outcome,
+            discovered=discovered,
+            data_age_s=round(data_age, 3),
+            loop_lag_s=round(loop_lag, 3),
+        )
+        return {
+            "cycle": cycle,
+            "outcome": outcome,
+            "discovered": discovered,
+            "data_age_s": data_age,
+            "loop_lag_s": loop_lag,
+            "weights_version": stamped,
+            "breaching": [v.slo for v in verdicts if v.breached],
+        }
+
+    def _publish_beacon(
+        self,
+        now: float,
+        cycle: int,
+        data_age: float,
+        loop_lag: float,
+        version: Any,
+        trained: int,
+    ) -> None:
+        doc = wire.encode(
+            "online.freshness",
+            t_unix=now,
+            cycle=cycle,
+            data_age_s=round(data_age, 3),
+            loop_lag_s=round(loop_lag, 3),
+            weights_version=None if version is None else str(version),
+            trained_records=trained,
+        )
+        tmp = self.beacon_path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.beacon_path)
+        except OSError as e:
+            logger.warning("freshness beacon write failed (%s)", e)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "OnlineLoop":
+        """Hold ingest completion open and begin polling on a daemon
+        thread. Idempotent."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        hold = getattr(self.cluster, "hold_ingest_completion", None)
+        if hold is not None:
+            hold(True)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tfos-online-loop", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 - never kill the loop thread
+                logger.exception("online loop cycle failed — continuing")
+            self._stop.wait(self.poll_interval_s)
+
+    def stop(self, timeout: float = 10.0, release_hold: bool = True) -> None:
+        """Stop polling; by default release the completion hold so the
+        lingering consumers can finish once their cursors cover the
+        final plan generation."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        self._thread = None
+        if release_hold:
+            hold = getattr(self.cluster, "hold_ingest_completion", None)
+            if hold is not None:
+                hold(False)
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "cycles": self._cycle,
+                "manifests_extended": self._extended,
+                "records_extended": self._extended_records,
+                "stalls": self._stalls,
+                "stalled": self._stalled,
+                "weights_version": self._progress_token,
+                "after_seq": dict(self._after),
+            }

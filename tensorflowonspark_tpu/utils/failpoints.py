@@ -132,6 +132,21 @@ SITES = frozenset(
         # callback ("drop" aware: a lost apply leaves the knob at its
         # readback value — the controller observes no movement and
         # reverts cleanly; the registry never wedges)
+        # online continual loop (feed/livelog.py + online.py — see
+        # docs/ROBUSTNESS.md "Online continual loop")
+        "online.log_append",  # TrafficLog.append, before buffering a
+        # record ("drop" aware: a dropped record is LOST and counted in
+        # online_records_dropped_total{reason=failpoint} — never lied
+        # about, never blocks the serve path)
+        "online.manifest_publish",  # TrafficLog seal, before writing
+        # the frame manifest ("drop" aware: a lost publication leaves a
+        # sealed segment undiscovered until recovery republishes it)
+        "online.discover",  # driver loop, before scanning the manifest
+        # directory (a raise = one missed discovery poll; the next
+        # cycle covers it)
+        "online.train_stall",  # driver loop, trainer-progress check
+        # ("drop" aware: simulates a stalled trainer — the loop must
+        # bound log growth and cut an online_stall flightrec event)
     }
 )
 

@@ -748,6 +748,60 @@ def ingest_handover_fn(args, ctx):
         f.write("ok")
 
 
+def online_consumer_fn(args, ctx):
+    """Online continual-loop map_fun (chaos e2e): drains a GROWING
+    traffic-log dataset through a handover-armed IngestFeed, recording
+    every consumed ``trace_id`` after EVERY batch (atomic replace) —
+    the exactly-once ledger even across SIGKILL — and, on the chief,
+    publishes a real orbax checkpoint to the rollout channel every
+    ``ckpt_batches`` batches so the driver-side online loop observes
+    trainer progress the same way a serving fleet's watcher would."""
+    import json
+    import time
+
+    import numpy as np
+
+    d = args["dir"]
+    state_path = os.path.join(d, f"consumed{ctx.executor_id}.json")
+    state = {"traces": [], "epochs": []}
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            state = json.load(f)
+    feed = ctx.get_ingest_feed(
+        input_mapping={"trace_id": "trace_id"},
+        timeout=float(args.get("timeout", 120)),
+        publish_blocks=int(args.get("publish_blocks", 2)),
+    )
+    channel = args.get("channel")
+    ckpt_every = int(args.get("ckpt_batches", 4))
+    n_batches = 0
+    for cols in feed.batch_stream(int(args.get("batch", 4))):
+        state["traces"].extend(
+            str(t).rstrip() for t in np.ravel(cols["trace_id"]).tolist()
+        )
+        state["epochs"].append(feed.plan_epoch)
+        tmp = state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, state_path)
+        n_batches += 1
+        if channel and ctx.executor_id == 0 and n_batches % ckpt_every == 0:
+            from tensorflowonspark_tpu.serving.rollout import (
+                publish_params,
+            )
+
+            publish_params(
+                channel,
+                {"step": np.asarray(n_batches, np.int32)},
+                version=f"step-{n_batches:06d}",
+                step=n_batches,
+            )
+        if args.get("step_sleep"):
+            time.sleep(float(args["step_sleep"]))
+    with open(os.path.join(d, f"done{ctx.executor_id}"), "w") as f:
+        f.write("ok")
+
+
 def _elastic_recipe():
     """Shared pieces of the elastic chaos tests: a tiny linear model
     whose data order is a pure function of the step index (the replay

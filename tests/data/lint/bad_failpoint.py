@@ -78,3 +78,15 @@ def rollout_clean_sites():
     failpoint("rollout.publish")
     failpoint("rollout.swap")
     failpoint("rollout.verify")
+
+
+def online_typo_site():
+    failpoint("online.discver")  # SEEDED VIOLATION FP001: unregistered
+
+
+def online_clean_sites():
+    # registered continual-loop sites: must NOT be flagged
+    failpoint("online.log_append")
+    failpoint("online.manifest_publish")
+    failpoint("online.discover")
+    failpoint("online.train_stall")

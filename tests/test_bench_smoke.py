@@ -411,3 +411,55 @@ def test_bench_autotune_smoke_recovers_and_audits(tmp_path):
     assert os.path.dirname(art) == str(tmp_path)
     with open(committed, "rb") as f:
         assert f.read() == committed_bytes
+
+
+def test_bench_online_smoke_continual_loop_closes(tmp_path):
+    """bench.py --online end-to-end on the tiny model: a 2-replica
+    fleet serves under sustained load while every beat's traffic is
+    sealed, discovered, trained into a new weights version, and rolled
+    out — the emitted JSON (and redirected artifact) must pass every
+    acceptance check: the served generation shifts onto live-trained
+    weights, zero dropped/hung requests, zero dropped log records,
+    admitted p99 within the deadline, no stalls, final data age within
+    the freshness objective."""
+    env = _artifact_env(str(tmp_path))
+    committed = os.path.join(
+        REPO, "benchmarks", "results", "online_cpu_smoke.json"
+    )
+    with open(committed, "rb") as f:
+        committed_bytes = f.read()
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--online"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "online_continual_loop"
+    assert out["smoke"] is True
+    assert out["passed"] is True, out["checks"]
+    assert all(out["checks"].values()), out["checks"]
+    # the loop's point: by the tail beat the fleet serves weights
+    # trained from traffic logged mid-run
+    assert out["fresh_share_late"] >= 0.9
+    assert out["fresh_share_late"] > out["fresh_share_early"]
+    assert out["records_trained"] > 0
+    assert out["requests_ok"] > 0
+    assert out["requests_hard_errors"] == 0
+    assert out["hung_workers"] == 0
+    assert out["log_records_dropped"] == 0
+    assert out["admitted_p99_s"] <= out["deadline_budget_s"]
+    assert out["loop_stats"]["stalls"] == 0
+    assert all(
+        c["rollout_outcome"] == "completed" for c in out["cycles"]
+    )
+    art = os.path.join(REPO, out["artifact"])
+    assert os.path.exists(art)
+    assert json.load(open(art))["metric"] == "online_continual_loop"
+    # redirect guard: the committed quiet-host baseline stays untouched
+    assert os.path.dirname(art) == str(tmp_path)
+    with open(committed, "rb") as f:
+        assert f.read() == committed_bytes

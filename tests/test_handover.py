@@ -543,6 +543,9 @@ def _standin_cluster(workers, shards, cursors, epoch=1, handover=True):
     c._ingest_shards = {k: list(v) for k, v in shards.items()}
     c._ingest_complete = False
     c._ingest_republished = True
+    c._ingest_seq = 0
+    c._ingest_hold_completion = False
+    c._ingest_replan_lock = threading.Lock()
     c.server = SimpleNamespace(
         reservations=SimpleNamespace(
             epoch=lambda: epoch, cursors=lambda: dict(cursors)

@@ -508,6 +508,9 @@ def test_assign_shards_stable_per_executor_id(monkeypatch):
     c._ingest_shards = None
     c._ingest_complete = False
     c._ingest_republished = False
+    c._ingest_seq = 0
+    c._ingest_hold_completion = False
+    c._ingest_replan_lock = threading.Lock()
     ms = [FileManifest(f"f{i}") for i in range(7)]
     c.assign_shards(ms)
     original = {k: v["manifests"] for k, v in published.items()}

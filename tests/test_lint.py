@@ -175,10 +175,11 @@ def test_failpoint_rule_reports_seeded_violations(fixture_findings):
         _line_of("bad_failpoint.py", "fleet.dispach"),
         _line_of("bad_failpoint.py", "rollout.swpa"),
         _line_of("bad_failpoint.py", "autotune.aply"),
+        _line_of("bad_failpoint.py", "online.discver"),
     }, [f.render() for f in hits]
     dynamic = [f for f in hits if "string literal" in f.message]
     unregistered = [f for f in hits if "not registered" in f.message]
-    assert len(dynamic) == 1 and len(unregistered) == 7
+    assert len(dynamic) == 1 and len(unregistered) == 8
     # the REGISTERED elastic + pull-plane sites are in the rule's
     # registry view: the fixture's clean literals produced no findings
     clean_lines = {
@@ -198,6 +199,10 @@ def test_failpoint_rule_reports_seeded_violations(fixture_findings):
         _line_of("bad_failpoint.py", '"rollout.swap"'),
         _line_of("bad_failpoint.py", '"rollout.verify"'),
         _line_of("bad_failpoint.py", '"autotune.apply"'),
+        _line_of("bad_failpoint.py", '"online.log_append"'),
+        _line_of("bad_failpoint.py", '"online.manifest_publish"'),
+        _line_of("bad_failpoint.py", '"online.discover"'),
+        _line_of("bad_failpoint.py", '"online.train_stall"'),
     }
     assert not clean_lines & {f.line for f in hits}
 

@@ -66,6 +66,7 @@ SLOW_SUITES = [
     "tests/test_ingest.py",  # crash-mid-shard restart e2e (exactly-once)
     "tests/test_native_asan.py",
     "tests/test_native_tsan.py",
+    "tests/test_online.py",  # SIGKILL-trainer + serving-chaos continual-loop e2e
     "tests/test_reqtrace.py",  # trace header round trip through serve_model
     "tests/test_rollout.py",  # SIGKILL-mid-rollout + corrupt-ckpt e2e
     ("tests/test_autotune.py", TFSAN_ENV),
@@ -73,6 +74,7 @@ SLOW_SUITES = [
     ("tests/test_elastic.py", TFSAN_ENV),
     ("tests/test_fleet.py", TFSAN_ENV),
     ("tests/test_handover.py", TFSAN_ENV),
+    ("tests/test_online.py", TFSAN_ENV),
     ("tests/test_reqtrace.py", TFSAN_ENV),
     ("tests/test_rollout.py", TFSAN_ENV),
 ]

@@ -105,13 +105,37 @@ _FIELD_OVERRIDES: dict[str, dict[str, object]] = {
             "cursor": {"train-0": 17, "train-1": [42, 3]},
         },
     },
-    "kv.ingest_plan": {"manifests": [["part-0000", 0, 128]]},
+    "kv.ingest_plan": {"manifests": [["part-0000", 0, 128]], "seq": 2},
     "kv.feed_knobs": {"knobs": {"records_per_chunk": 256}},
     "kv.feed_timeout": {"value": 600.0},
     "kv.node_state": {"value": "running"},
     "ingest.cursor_payload": {
         # both cursor-entry wire forms ride inside the payload too
         "cursor": {"train-0": 17, "train-1": [42, 3]},
+        "plan_seq": 2,
+    },
+    "livelog.manifest": {
+        "path": "/logs/traffic/live-00000007.tfc",
+        "records": 256,
+        "bytes": 65536,
+        "seq": 7,
+        "stream": "live",
+        "sealed_unix": 1754000000.0,
+        "first_unix": 1753999990.0,
+        "last_unix": 1753999999.5,
+    },
+    "kv.livelog_announce": {
+        "dir": "/logs/traffic",
+        "seq": 7,
+        "records": 256,
+    },
+    "online.freshness": {
+        "t_unix": 1754000000.0,
+        "cycle": 12,
+        "data_age_s": 3.5,
+        "loop_lag_s": 8.25,
+        "weights_version": "step-001200",
+        "trained_records": 4096,
     },
     "rollout.manifest": {
         "version": "v1",
