@@ -17,7 +17,10 @@ signals named in docs/AUTOTUNE.md:
   revert);
 - :func:`ingest_publish_policy` — adapt ``publish_blocks`` to the
   measured cursor-publish overhead (publish often enough for a tight
-  crash-replay bound, rarely enough that the RPC cost stays noise).
+  crash-replay bound, rarely enough that the RPC cost stays noise);
+- :func:`cache_budget_policy` — grow the ``cachetier.CacheTier``
+  byte budget while the hit share is still rising and host memory
+  headroom exists, shrink before the host hits reclaim.
 
 Builders return ``(Knob, Policy)`` pairs; callers register the knob
 and hand the policy to a :class:`~tensorflowonspark_tpu.autotune.
@@ -34,6 +37,7 @@ from tensorflowonspark_tpu.autotune.registry import Knob
 from tensorflowonspark_tpu.obs.history import History
 
 __all__ = [
+    "cache_budget_policy",
     "counter_rate_objective",
     "engine_knob_policies",
     "ingest_publish_policy",
@@ -201,6 +205,120 @@ def router_estimate_policy(
         )
 
     return knob, Policy(knob=knob.name, target=target)
+
+
+# -- cache tier --------------------------------------------------------------
+
+
+def _meminfo_headroom() -> float | None:
+    """Fraction of physical memory still available
+    (``MemAvailable / MemTotal`` from /proc/meminfo), or None when the
+    file is unreadable (non-Linux) — the policy then holds still
+    rather than guess."""
+    try:
+        fields: dict[str, int] = {}
+        with open("/proc/meminfo", encoding="ascii") as f:
+            for line in f:
+                name, _, rest = line.partition(":")
+                if name in ("MemTotal", "MemAvailable"):
+                    fields[name] = int(rest.split()[0])
+        total = fields.get("MemTotal", 0)
+        avail = fields.get("MemAvailable")
+        if total <= 0 or avail is None:
+            return None
+        return avail / total
+    except OSError:
+        return None
+
+
+def cache_budget_policy(
+    tier,
+    *,
+    objective_metric: str = "cachetier_hits_total",
+    lo_bytes: int = 64 << 20,
+    hi_bytes: int = 4 << 30,
+    step_bytes: int = 64 << 20,
+    window_s: float = 30.0,
+    min_headroom_frac: float = 0.2,
+    headroom_fn: Callable[[], float | None] | None = None,
+) -> tuple[Knob, Policy]:
+    """Capacity knob for a live :class:`~tensorflowonspark_tpu.
+    cachetier.service.CacheTier`, actuated through
+    ``CacheTier.set_capacity`` (shrink evicts immediately — the cost
+    hint). Hint: GROW while the tier's hit share is still rising across
+    the window (more budget is still converting misses into hits) AND
+    host memory headroom exists; SHRINK when headroom drops below half
+    the floor (the cache must never push the host into reclaim — it is
+    an optimization, not a tenant); hold otherwise. Objective: cache
+    hits/sec — the controller's objective-revert undoes a grow that
+    stopped paying. ``headroom_fn`` is injectable for tests; the
+    default reads ``/proc/meminfo`` and holds still when unreadable."""
+    knob = Knob(
+        name="cachetier.capacity_bytes",
+        lo=float(lo_bytes),
+        hi=float(hi_bytes),
+        step=float(step_bytes),
+        apply=tier.set_capacity,
+        get=lambda: tier.capacity_bytes,
+        cost_hint="evict-on-shrink",
+    )
+    headroom = headroom_fn if headroom_fn is not None else _meminfo_headroom
+
+    def _hit_share(hist: History, now: float, w: float) -> float | None:
+        # delta (not delta_sum): hits/misses are counters, and
+        # delta_sum only reads histogram `sum` increases
+        hits = hist.delta(
+            "cachetier_hits_total", window_s=w, now=now
+        )
+        misses = hist.delta(
+            "cachetier_misses_total", window_s=w, now=now
+        )
+        if hits + misses <= 0:
+            return None
+        return hits / (hits + misses)
+
+    def hint(hist: History, now: float) -> int:
+        head = headroom()
+        if head is None:
+            return 0
+        if head < min_headroom_frac / 2.0:
+            return -1
+        # "rising" = the trailing window's hit share beats the window
+        # before it (both derived from the same counters: the older
+        # window is the 2w delta minus the recent w delta)
+        recent = _hit_share(hist, now, window_s)
+        if recent is None:
+            return 0
+        hits_2w = hist.delta(
+            "cachetier_hits_total", window_s=2 * window_s, now=now
+        )
+        misses_2w = hist.delta(
+            "cachetier_misses_total", window_s=2 * window_s, now=now
+        )
+        hits_w = hist.delta(
+            "cachetier_hits_total", window_s=window_s, now=now
+        )
+        misses_w = hist.delta(
+            "cachetier_misses_total", window_s=window_s, now=now
+        )
+        prior_hits = hits_2w - hits_w
+        prior_misses = misses_2w - misses_w
+        if prior_hits + prior_misses <= 0:
+            # no prior-window traffic to compare against: grow only on
+            # real recent traffic with headroom (cold start)
+            return 1 if head > min_headroom_frac else 0
+        prior = prior_hits / (prior_hits + prior_misses)
+        if recent > prior and head > min_headroom_frac:
+            return 1
+        return 0
+
+    return knob, Policy(
+        knob=knob.name,
+        objective=counter_rate_objective(
+            objective_metric, window_s=window_s
+        ),
+        hint=hint,
+    )
 
 
 # -- ingest pull plane -------------------------------------------------------

@@ -57,6 +57,7 @@ __all__ = [
 #: assignment from DISK (ast, no import), exactly like FP001's SITES.
 TUNABLE_ATTRS = frozenset(
     {
+        "_capacity_bytes",  # cachetier/service.py CacheTier
         "_decode_block",  # serving/engine.py ContinuousBatcher
         "_pipeline_depth",  # serving/engine.py ContinuousBatcher
         "_prefetch_depth",  # feed/prefetch.py DevicePrefetcher
@@ -72,6 +73,8 @@ TUNABLE_ATTRS = frozenset(
 #: the ad-hoc knob poking this registry exists to end.
 SANCTIONED = frozenset(
     {
+        "CacheTier.__init__",
+        "CacheTier.set_capacity",
         "ContinuousBatcher.__init__",
         "ContinuousBatcher._apply_pending_knobs",
         "DevicePrefetcher.__init__",

@@ -1,0 +1,250 @@
+"""Fleet-global prefix L2 — the serving plane's cachetier client.
+
+The per-engine ``_PrefixStore`` (serving/engine.py) stays L1: device-
+resident, scheduler-thread-only, zero-copy hits. This module is the L2
+behind it — a :class:`PrefixL2` wraps a cachetier client (in-process
+``LocalClient`` for `InProcessReplica`s, TCP ``CacheClient`` for
+subprocess ones) so a prefix prefilled by ANY replica is reusable by
+all of them. At fleet scale the shared system-prompt prefix is the
+single largest recoverable compute saving; before this tier, router
+prefix-affinity was a correctness-shaped crutch papering over the
+re-prefill (it now demotes to a locality hint — serving/router.py).
+
+Keying — the exactness contract::
+
+    prefix|<weights_version>|<adapter>|<t0,t1,...,tk>
+
+``weights_version`` and adapter are baked into every key, so a PR-15
+rollout invalidates EXACTLY (drop the old version's key prefix, touch
+nothing else) and a stale-version cache can never extend a new-version
+decode: the new version's lookups simply never construct the old keys.
+
+Latency contract (the cache is never a liveness dependency):
+
+- :meth:`lookup` runs on the engine scheduler thread, so it carries a
+  TOTAL deadline across its depth probes (miss-on-timeout, default
+  50 ms) and never raises;
+- :meth:`offer` is fire-and-forget: the scheduler thread enqueues the
+  device-array leaves and returns; a background filler thread pays the
+  device→host transfer + pickle + transport, with a bounded drop-oldest
+  queue so a slow or dead service sheds offers instead of backpressure.
+
+Values are pickled lists of contiguous numpy arrays — a bit-exact
+round-trip of the single-row KV cache leaves (the engine owns the
+treedef; see ``ContinuousBatcher._l2_reconstruct``).
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Sequence
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["PrefixL2", "prefix_key", "version_prefix"]
+
+NS = "prefix"
+
+
+def prefix_key(version: Any, adapter: str | None, tokens: Sequence[int]) -> str:
+    """The L2 key of one ``(weights_version, adapter, token-prefix)``."""
+    toks = ",".join(str(int(t)) for t in tokens)
+    return f"{version}|{adapter or ''}|{toks}"
+
+
+def version_prefix(version: Any) -> str:
+    """The key prefix owned by one weights version — the argument a
+    rollout passes to ``invalidate`` to reclaim that version exactly."""
+    return f"{version}|"
+
+
+class PrefixL2:
+    """The engine-facing L2 facade over a cachetier client."""
+
+    def __init__(
+        self,
+        client: Any,
+        *,
+        chunk: int,
+        lookup_timeout_s: float = 0.05,
+        fill_queue: int = 32,
+        dedup_window: int = 256,
+        own_client: bool = False,
+    ):
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.client = client
+        self._own_client = bool(own_client)
+        self.chunk = int(chunk)
+        self.lookup_timeout_s = float(lookup_timeout_s)
+        self._lock = threading.Lock()
+        self._hits = 0  # guarded-by: self._lock
+        self._misses = 0  # guarded-by: self._lock
+        self._offered = 0  # guarded-by: self._cv
+        self._offer_drops = 0  # guarded-by: self._cv
+        self._offer_dedups = 0  # guarded-by: self._cv
+        self._closed = False  # guarded-by: self._cv
+        # Offer dedup: a key's value is deterministic (the KV cache is
+        # a pure function of (version, adapter, tokens) — the version
+        # is IN the key), so re-publishing a recently-offered key buys
+        # nothing and costs a device→host copy + pickle per repeat —
+        # on a saturated host that transfer tax is the difference
+        # between the L2 paying for itself and not. Bounded window, and
+        # self-healing: a lookup MISS on a key evicts it here (see
+        # lookup), so an entry the tier dropped (LRU pressure, daemon
+        # respawn) is re-offered the next time any request completes it.
+        self._recent: "OrderedDict[str, None]" = OrderedDict()  # guarded-by: self._cv
+        self._dedup_window = max(0, int(dedup_window))
+        # fire-and-forget offers: the scheduler thread appends leaves
+        # (no transfer, no pickle) and the filler thread pays the rest
+        self._q: deque[tuple[str, list]] = deque(maxlen=fill_queue)  # guarded-by: self._cv
+        self._cv = threading.Condition()
+        self._filler = threading.Thread(
+            target=self._fill_loop, name="prefix-l2-filler", daemon=True
+        )
+        self._filler.start()
+
+    # -- lookup (scheduler thread; bounded, never raises) --------------
+
+    def _depths(self, n: int) -> list[int]:
+        """Candidate stored depths for an ``n``-token prompt, longest
+        first: the full prompt plus the L1 boundary-insert ladder
+        (``chunk * 2**k``) — exactly the depths any engine inserts at,
+        so probing anything else would be wasted roundtrips."""
+        out = {n}
+        d = self.chunk
+        while d < n:
+            out.add(d)
+            d *= 2
+        return sorted(out, reverse=True)
+
+    def lookup(
+        self, tokens: Sequence[int], adapter: str | None, version: Any
+    ) -> tuple[list, int] | None:
+        """Longest cached prefix of ``tokens`` under this version —
+        ``(numpy leaves, depth)`` — or None. Spends at most
+        ``lookup_timeout_s`` across ALL depth probes; a slow or dead
+        service is a miss, never a stall."""
+        n = len(tokens)
+        if n < 2:
+            return None
+        deadline = time.monotonic() + self.lookup_timeout_s
+        try:
+            for depth in self._depths(n):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                key = prefix_key(version, adapter, tokens[:depth])
+                blob = self.client.lookup(NS, key, timeout_s=remaining)
+                if blob is None:
+                    # the tier does not have this key — clear it from
+                    # the offer-dedup window so the next engine that
+                    # completes this prefix re-publishes it (the self-
+                    # heal that makes dedup safe under LRU eviction
+                    # and daemon respawn)
+                    with self._cv:
+                        self._recent.pop(key, None)
+                    continue
+                leaves = pickle.loads(blob)
+                if not isinstance(leaves, list):
+                    continue
+                with self._lock:
+                    self._hits += 1
+                return leaves, depth
+        except Exception:  # noqa: BLE001 - L2 failure IS a miss
+            logger.warning("prefix L2 lookup failed", exc_info=True)
+        with self._lock:
+            self._misses += 1
+        return None
+
+    # -- offer (scheduler thread enqueues; filler thread pays) ---------
+
+    def offer(
+        self,
+        tokens: Sequence[int],
+        leaves: list,
+        adapter: str | None,
+        version: Any,
+    ) -> None:
+        """Publish one prefix's cache leaves, fire-and-forget. ``leaves``
+        are the flattened single-row cache arrays (device or host); the
+        device→host transfer happens on the filler thread, never
+        here."""
+        key = prefix_key(version, adapter, tokens)
+        with self._cv:
+            if self._closed:
+                return
+            if self._dedup_window:
+                if key in self._recent:
+                    self._recent.move_to_end(key)
+                    self._offer_dedups += 1
+                    return
+                self._recent[key] = None
+                while len(self._recent) > self._dedup_window:
+                    self._recent.popitem(last=False)
+            if len(self._q) == self._q.maxlen:
+                self._offer_drops += 1
+            self._q.append((key, list(leaves)))
+            self._offered += 1
+            self._cv.notify()
+
+    def _fill_loop(self) -> None:
+        import numpy as np
+
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait(timeout=0.5)
+                if self._closed and not self._q:
+                    return
+                key, leaves = self._q.popleft()
+            try:
+                # jax arrays are immutable, so reading them from this
+                # thread is safe; np.asarray is the device→host sync
+                host = [np.ascontiguousarray(np.asarray(x)) for x in leaves]
+                blob = pickle.dumps(host, protocol=pickle.HIGHEST_PROTOCOL)
+                self.client.fill(NS, key, blob)
+            except Exception:  # noqa: BLE001 - a lost offer is a miss later
+                logger.warning("prefix L2 offer failed", exc_info=True)
+
+    # -- maintenance ---------------------------------------------------
+
+    def invalidate_version(self, version: Any) -> int:
+        """Exact-by-key reclamation of one weights version (the rollout
+        hook); returns entries dropped (0 when the service is down —
+        harmless: the old version's keys can never be looked up again)."""
+        try:
+            return self.client.invalidate(NS, version_prefix(version))
+        except Exception:  # noqa: BLE001 - reclamation is best-effort
+            logger.warning("prefix L2 invalidate failed", exc_info=True)
+            return 0
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            hits, misses = self._hits, self._misses
+        with self._cv:
+            offered, drops = self._offered, self._offer_drops
+            dedups = self._offer_dedups
+        return {
+            "l2_hits": hits,
+            "l2_misses": misses,
+            "l2_offered": offered,
+            "l2_offer_drops": drops,
+            "l2_offer_dedups": dedups,
+        }
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._filler.join(timeout=2.0)
+        if self._own_client:
+            try:
+                self.client.close()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                logger.warning("prefix L2 client close failed",
+                               exc_info=True)

@@ -504,6 +504,106 @@ WIRE_SCHEMAS = {
         },
         "required": ["status"],
     },
+    # -- disaggregated cache tier (cachetier/service.py: length-prefixed
+    #    pickled header + raw payload bytes over TCP; the fleet-global
+    #    prefix L2 and the shared frame cache both speak it). Requests
+    #    are add_only_optional: the service is restart-at-will (clients
+    #    treat every transport error as a miss), so mixed-version
+    #    client/daemon pairs are the NORMAL state during a roll.
+    "cachetier.LOOKUP": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "message",
+        "kind": "CLOOKUP",
+        "role": "request",
+        "fields": {
+            "type": "str",
+            "ns": "str",
+            "key": "str",
+            "path": "str|null",
+            "off": "int|null",
+            "span": "int|null",
+        },
+        "required": ["type", "ns", "key"],
+    },
+    "cachetier.LOOKUP.reply": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "message",
+        "kind": "COK",
+        "role": "reply",
+        "fields": {"type": "str", "hit": "bool", "nbytes": "int"},
+        "required": ["type", "hit", "nbytes"],
+    },
+    "cachetier.FILL": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "message",
+        "kind": "CFILL",
+        "role": "request",
+        "fields": {
+            "type": "str",
+            "ns": "str",
+            "key": "str",
+            "nbytes": "int",
+        },
+        "required": ["type", "ns", "key", "nbytes"],
+    },
+    "cachetier.FILL.reply": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "message",
+        "kind": "COK",
+        "role": "reply",
+        "fields": {"type": "str", "stored": "bool"},
+        "required": ["type", "stored"],
+    },
+    "cachetier.INVALIDATE": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "message",
+        "kind": "CINVAL",
+        "role": "request",
+        "fields": {"type": "str", "ns": "str", "prefix": "str"},
+        "required": ["type", "ns", "prefix"],
+    },
+    "cachetier.INVALIDATE.reply": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "message",
+        "kind": "COK",
+        "role": "reply",
+        "fields": {"type": "str", "dropped": "int"},
+        "required": ["type", "dropped"],
+    },
+    "cachetier.STATS": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "message",
+        "kind": "CSTATS",
+        "role": "request",
+        "fields": {"type": "str"},
+        "required": ["type"],
+    },
+    "cachetier.STATS.reply": {
+        "version": 1,
+        "compat": "add_only_optional",
+        "transport": "message",
+        "kind": "COK",
+        "role": "reply",
+        "fields": {
+            "type": "str",
+            "hits": "int",
+            "misses": "int",
+            "fills": "int",
+            "evictions": "int",
+            "entries": "int",
+            "bytes": "int",
+            "capacity_bytes": "int",
+            "backing_read_bytes": "int",
+        },
+        "required": ["type", "hits", "misses", "entries", "bytes"],
+    },
 }
 
 

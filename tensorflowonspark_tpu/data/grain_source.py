@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 import struct
 import threading
+from collections import OrderedDict
 from typing import Any, Sequence
 
 _LEN = struct.Struct("<Q")
@@ -161,11 +162,24 @@ class ColumnarFrameDataSource:
     shape. This is the random-access tier of executor-local ingestion:
     grain's samplers own sharding/shuffling/resume, while sequential
     shard drains go through ``feed.ingest.IngestFeed``.
+
+    ``frame_cache`` (a ``cachetier.FrameCache``) optionally fronts the
+    mmap reads: a frame missing from the local decoded-frame LRU is
+    fetched through the shared read-through cache tier first, so N
+    co-located sources over one dataset hit backing storage once per
+    frame instead of once per source. Cache failure degrades to the
+    local mmap path; the facade is process-local and is dropped on
+    pickle (grain worker processes re-attach their own if desired).
     """
 
     _CACHE_FRAMES = 4
 
-    def __init__(self, paths: "str | Sequence[str]"):
+    def __init__(
+        self,
+        paths: "str | Sequence[str]",
+        *,
+        frame_cache: "Any | None" = None,
+    ):
         import glob
 
         if isinstance(paths, str):
@@ -180,16 +194,19 @@ class ColumnarFrameDataSource:
         from tensorflowonspark_tpu.feed.columnar import scan_frames
 
         self._files = files
-        # (file_idx, byte_offset, first_record_index) per frame; the
-        # parallel _starts list serves bisect.
-        self._frames: list[tuple[int, int, int]] = []
+        self._frame_cache = frame_cache
+        # (file_idx, byte_offset, byte_span, first_record_index) per
+        # frame; the parallel _starts list serves bisect. The span is
+        # the frame_cache key ingredient (scan_frames header index =
+        # the cache tier's key space).
+        self._frames: list[tuple[int, int, int, int]] = []
         self._starts: list[int] = []
         total = 0
         for fi, path in enumerate(files):
-            for off, _span, n in scan_frames(path):
+            for off, span, n in scan_frames(path):
                 if n == 0:
                     continue
-                self._frames.append((fi, off, total))
+                self._frames.append((fi, off, span, total))
                 self._starts.append(total)
                 total += n
         self._total = total
@@ -201,7 +218,10 @@ class ColumnarFrameDataSource:
         # dict pop/insert race here corrupts the eviction order or drops
         # a racing insert mid-rehash)
         self._cache_lock = threading.Lock()
-        self._cache: dict[tuple[int, int], Any] = {}  # (fi, off) -> chunk  # guarded-by: self._cache_lock
+        # (fi, off) -> chunk, true LRU: hits move-to-end, eviction pops
+        # the head — FIFO here silently evicted the HOT frame under a
+        # sampler's locality and re-decoded it every touch.
+        self._cache: "OrderedDict[tuple[int, int], Any]" = OrderedDict()  # guarded-by: self._cache_lock
 
     def __getstate__(self):
         # grain worker processes pickle the source: mmaps, decoded
@@ -209,7 +229,8 @@ class ColumnarFrameDataSource:
         # lazily.
         state = self.__dict__.copy()
         state["_mmaps"] = {}
-        state["_cache"] = {}
+        state["_cache"] = OrderedDict()
+        state["_frame_cache"] = None  # holds a socket/lock; re-attach
         del state["_cache_lock"]  # unpicklable; recreated in __setstate__
         return state
 
@@ -233,21 +254,32 @@ class ColumnarFrameDataSource:
                 new.close()
         return mm
 
-    def _chunk(self, fi: int, off: int):
+    def _chunk(self, fi: int, off: int, span: int):
         key = (fi, off)
         with self._cache_lock:
             chunk = self._cache.get(key)
+            if chunk is not None:
+                self._cache.move_to_end(key)  # LRU: a hit IS recency
         if chunk is None:
             from tensorflowonspark_tpu.feed.columnar import decode_frame
 
             # decode outside the lock (it is the expensive part; a
             # racing double-decode of one frame is benign — last insert
             # wins and both views are valid)
-            chunk = decode_frame(memoryview(self._mmap(fi))[off:])
+            blob = None
+            if self._frame_cache is not None:
+                # shared tier first (one backing read per frame fleet-
+                # wide); None = miss/down → local mmap exactly as before
+                blob = self._frame_cache.get(self._files[fi], off, span)
+            if blob is not None:
+                chunk = decode_frame(memoryview(blob))
+            else:
+                chunk = decode_frame(memoryview(self._mmap(fi))[off:])
             with self._cache_lock:
                 if len(self._cache) >= self._CACHE_FRAMES:
-                    self._cache.pop(next(iter(self._cache)))
+                    self._cache.popitem(last=False)
                 self._cache[key] = chunk
+                self._cache.move_to_end(key)
         return chunk
 
     def __getitem__(self, index: int):
@@ -256,8 +288,8 @@ class ColumnarFrameDataSource:
         if not 0 <= index < self._total:
             raise IndexError(index)
         fidx = bisect.bisect_right(self._starts, index) - 1
-        fi, off, start = self._frames[fidx]
-        return self._chunk(fi, off).view(index - start, index - start + 1).rows()[0]
+        fi, off, span, start = self._frames[fidx]
+        return self._chunk(fi, off, span).view(index - start, index - start + 1).rows()[0]
 
     def __del__(self):  # pragma: no cover - best-effort cleanup
         for mm in getattr(self, "_mmaps", {}).values():

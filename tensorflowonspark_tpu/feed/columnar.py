@@ -617,9 +617,18 @@ def write_frames(
     return n
 
 
-def read_frames(path: str) -> Iterator[ColumnChunk]:
+def read_frames(path: str, *, frame_cache=None) -> Iterator[ColumnChunk]:
     """Yield the ColumnChunks of a framed file as zero-copy views over
-    one shared mmap (kept alive by the views' base chain)."""
+    one shared mmap (kept alive by the views' base chain).
+
+    ``frame_cache`` (a ``cachetier.FrameCache``) optionally fronts the
+    payload reads: the local mmap still serves the header walk (a few
+    pages), but each frame's payload is fetched through the shared
+    read-through tier — so N co-located readers of one file fault its
+    payload bytes in from backing storage ONCE, fleet-wide. A cache
+    miss/outage (``get`` → None) decodes from the local mmap exactly as
+    before; frames are immutable, so the two paths are byte-identical.
+    """
     import mmap as _mmap
 
     with open(path, "rb") as f:
@@ -631,7 +640,15 @@ def read_frames(path: str) -> Iterator[ColumnChunk]:
     off = 0
     while off + _PREFIX.size <= size:
         span = frame_span(mv, off)
-        yield decode_frame(mv[off : off + span], path="manifest")
+        blob = (
+            frame_cache.get(path, off, span)
+            if frame_cache is not None
+            else None
+        )
+        if blob is not None:
+            yield decode_frame(memoryview(blob), path="manifest")
+        else:
+            yield decode_frame(mv[off : off + span], path="manifest")
         off += _align(span)
 
 

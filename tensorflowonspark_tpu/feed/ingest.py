@@ -203,10 +203,16 @@ class ShardReader:
         reader: Callable[[Any], Iterator[Any]] | None = None,
         records_per_chunk: int = 1024,
         retry: RetryPolicy | None = None,
+        frame_cache: Any | None = None,
     ):
         self.manifests = list(manifests)
         self.reader = reader
         self.records_per_chunk = int(records_per_chunk)
+        # Optional cachetier.FrameCache: 'columnar' manifests fetch
+        # frame payloads through the shared read-through tier (one
+        # backing read per frame across N co-located readers); cache
+        # failure falls back to the local mmap — never an error.
+        self.frame_cache = frame_cache
         self.retry = (
             retry
             if retry is not None
@@ -270,7 +276,9 @@ class ShardReader:
         ):
             # the on-disk wire format: zero-copy views over one mmap,
             # payload-CRC-verified per frame
-            yield from read_manifest_chunks(m)
+            yield from read_manifest_chunks(
+                m, frame_cache=self.frame_cache
+            )
             return
         from tensorflowonspark_tpu.data.readers import columnar_pieces
 
@@ -374,6 +382,7 @@ class IngestFeed:
         publish_blocks: int = 32,
         adopt_timeout: float = 120.0,
         knob_fetch: Callable[[], dict | None] | None = None,
+        frame_cache: Any | None = None,
     ):
         """``plan_fetch`` / ``cursor_publish`` / ``epoch_watch`` arm the
         live-shard-redistribution protocol (all three together — wired
@@ -397,11 +406,13 @@ class IngestFeed:
         self._user_reader = reader
         self._records_per_chunk = int(records_per_chunk)
         self._retry = retry
+        self._frame_cache = frame_cache
         self._reader = ShardReader(
             manifests,
             reader=reader,
             records_per_chunk=records_per_chunk,
             retry=retry,
+            frame_cache=frame_cache,
         )
         from tensorflowonspark_tpu.feed.datafeed import _replay_counter
 
@@ -667,6 +678,7 @@ class IngestFeed:
             reader=self._user_reader,
             records_per_chunk=self._records_per_chunk,
             retry=self._retry,
+            frame_cache=self._frame_cache,
         )
         self._iter = None
         self._exhausted = False

@@ -108,14 +108,15 @@ def read_manifest(
         )
 
 
-def read_manifest_chunks(m: FileManifest):
+def read_manifest_chunks(m: FileManifest, *, frame_cache=None):
     """ColumnChunks of a ``'columnar'`` manifest, honoring its
     ``start``/``stop`` record range by chunk-slicing (views — the mmap
-    stays shared)."""
+    stays shared). ``frame_cache`` routes frame payload reads through
+    the shared cache tier (see ``columnar.read_frames``)."""
     from tensorflowonspark_tpu.feed.columnar import read_frames
 
     pos = 0
-    for chunk in read_frames(m.path):
+    for chunk in read_frames(m.path, frame_cache=frame_cache):
         lo = max(m.start - pos, 0)
         hi = len(chunk) if m.stop is None else min(m.stop - pos, len(chunk))
         pos += len(chunk)

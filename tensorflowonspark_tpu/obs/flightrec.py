@@ -88,6 +88,13 @@ EVENTS = frozenset(
         "rollout_begin",
         "rollout_complete",
         "rollout_rollback",
+        # disaggregated cache tier (cachetier/ + serving/fleet.py —
+        # docs/SERVING.md "Cache tier"): daemon lifecycle and rollout
+        # reclamation are the post-mortem surface for "why did the
+        # fleet hit-rate fall off a cliff at 14:03"
+        "cachetier_spawn",
+        "cachetier_respawn",
+        "cachetier_invalidate",
         # observability plane (obs/slo.py, utils/lockwitness.py)
         "slo_breach",
         "tfsan",

@@ -661,6 +661,7 @@ class ContinuousBatcher:
         max_queue: int | None = None,
         prefill_chunk: int | None = None,
         prefix_cache: int | None = None,
+        prefix_l2=None,
         decode_block: int = 8,
         pipeline_depth: int = 2,
         watchdog_s: float | None = None,
@@ -792,6 +793,15 @@ class ContinuousBatcher:
             self._prefix_store = _PrefixStore(prefix_cache)
         else:
             self._prefix_store = None
+        if prefix_l2 is not None and self._prefix_store is None:
+            # The L2 feeds and is fed through the L1 insert/lookup
+            # sites; without an L1 neither exists.
+            raise ValueError("prefix_l2 requires prefix_cache")
+        # Fleet-global prefix L2 (cachetier.PrefixL2 or None). Rebound
+        # atomically by attach_prefix_l2; the scheduler thread reads it
+        # racily — a one-iteration-stale None/instance is benign (one
+        # extra miss or one extra offer to a live client).
+        self._prefix_l2 = prefix_l2
         self._queue: "queue.Queue" = queue.Queue()
         self._closed = False  # guarded-by: self._submit_lock
         # Hot weight swap (zero-downtime rollout): the label of the
@@ -1995,6 +2005,14 @@ class ContinuousBatcher:
                 if self._prefix_store is not None
                 else {}
             ),
+            **(
+                {
+                    f"prefix_{k}": v
+                    for k, v in self._prefix_l2.stats().items()
+                }
+                if self._prefix_l2 is not None
+                else {}
+            ),
         }
 
     def close(self, drain: bool = False, drain_timeout: float = 300.0) -> None:
@@ -2052,6 +2070,11 @@ class ContinuousBatcher:
             # budget. Only once the loop thread is truly gone: it reads
             # the store without a lock.
             self._prefix_store.clear()
+        if self._prefix_l2 is not None and not self._thread.is_alive():
+            # Stop the L2 filler thread (pending offers drain or drop);
+            # the underlying client/tier belongs to the fleet, not this
+            # engine, so only the facade winds down here.
+            self._prefix_l2.close()
 
     # -- compiled pieces ----------------------------------------------
 
@@ -2326,6 +2349,63 @@ class ContinuousBatcher:
         # init the position plane to -1, not 0)
         return init_cache(self._single_row_cache_shapes)
 
+    def _l2_offer(self, tokens: list[int], cache_1, adapter) -> None:
+        """Publish one L1-inserted prefix to the fleet L2, fire-and-
+        forget: the scheduler thread hands the (immutable) device
+        leaves to the L2's filler thread and returns — the device→host
+        transfer and transport never run here."""
+        l2 = self._prefix_l2
+        if l2 is None or self._warming:
+            # warmup's throwaway prompts are cleared from L1 afterwards;
+            # publishing them fleet-wide would be respawn-time junk
+            return
+        try:
+            l2.offer(
+                tokens,
+                jax.tree_util.tree_leaves(cache_1),
+                adapter,
+                self._weights_version,
+            )
+        except Exception:  # noqa: BLE001 - a lost offer is a later miss
+            logger.warning("prefix L2 offer failed", exc_info=True)
+
+    def _l2_reconstruct(self, leaves):
+        """Rebuild a single-row cache pytree from L2 host leaves, or
+        None when the payload does not match this engine's cache
+        structure (a foreign config's entry — treat as a miss; the
+        shape/dtype check is the exactness guard)."""
+        import numpy as np
+
+        flat, treedef = jax.tree_util.tree_flatten(
+            self._single_row_cache_shapes
+        )
+        if not isinstance(leaves, list) or len(leaves) != len(flat):
+            return None
+        placed = []
+        for arr, want in zip(leaves, flat):
+            got = tuple(getattr(arr, "shape", ()))
+            if getattr(arr, "dtype", None) != want.dtype:
+                return None
+            if got != tuple(want.shape):
+                # a stepped cache's scalar planes (positions) come back
+                # as the batch-1 row, shape (1, *template); fold that
+                # row axis away — anything else is a foreign config
+                if got != (1, *want.shape):
+                    return None
+                arr = np.asarray(arr).reshape(want.shape)
+            placed.append(jax.device_put(arr))
+        return jax.tree_util.tree_unflatten(treedef, placed)
+
+    def attach_prefix_l2(self, l2) -> None:
+        """Attach (or detach with None) the fleet-global prefix L2 on a
+        RUNNING engine — the ServingFleet injection path for factory-
+        built replicas. The rebind is a single atomic reference swap;
+        the scheduler reads ``_prefix_l2`` racily and a one-iteration-
+        stale view is benign (one extra miss or offer)."""
+        if l2 is not None and self._prefix_store is None:
+            raise ValueError("prefix_l2 requires prefix_cache")
+        self._prefix_l2 = l2
+
     def _start_job(self, p: _Pending, row: int) -> _PrefillJob:
         temp = (
             self._temperature
@@ -2343,6 +2423,25 @@ class ContinuousBatcher:
             cache_1, resume = self._prefix_store.lookup(
                 p.tokens, p.adapter
             )
+            if cache_1 is None and self._prefix_l2 is not None:
+                # L1 miss → bounded-latency fleet-global probe. A hit
+                # is a prefix some OTHER replica prefilled under the
+                # SAME weights version (the version is baked into the
+                # key, so a stale-version cache can never extend this
+                # decode). The reconstructed cache is inserted into L1
+                # so repeats on this replica stay device-local.
+                hit = self._prefix_l2.lookup(
+                    p.tokens, p.adapter, self._weights_version
+                )
+                if hit is not None:
+                    rebuilt = self._l2_reconstruct(hit[0])
+                    if rebuilt is not None:
+                        depth = hit[1]
+                        cache_1 = rebuilt
+                        resume = min(depth, len(p.tokens) - 1)
+                        self._prefix_store.insert(
+                            p.tokens[:depth], cache_1, p.adapter
+                        )
         if cache_1 is None:
             cache_1 = self._single_row_cache()
         return _PrefillJob(
@@ -2424,6 +2523,8 @@ class ContinuousBatcher:
                     job.p.tokens[: job.next_pos], job.cache_1,
                     job.p.adapter,
                 )
+                self._l2_offer(job.p.tokens[: job.next_pos], job.cache_1,
+                               job.p.adapter)
                 job.next_insert_depth = 2 * job.next_pos
                 job.boundary_inserts += 1
             return (
@@ -2435,6 +2536,7 @@ class ContinuousBatcher:
             self._prefix_store.insert(
                 job.p.tokens, job.cache_1, job.p.adapter
             )
+            self._l2_offer(job.p.tokens, job.cache_1, job.p.adapter)
         # final chunk: it contains the prompt's last true position
         tok_1, lp_1 = self._sample1_fn(
             logits,

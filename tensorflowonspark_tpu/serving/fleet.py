@@ -774,6 +774,38 @@ class _ReplicaSlot:
             }
 
 
+def _normalize_l2_spec(prefix_l2) -> dict | None:
+    """Canonical ``{"mode", "capacity_bytes", "lookup_timeout_s"}`` for
+    the fleet's ``prefix_l2=`` argument, or None (off)."""
+    if prefix_l2 is None:
+        return None
+    spec = {
+        "mode": "inproc",
+        "capacity_bytes": 256 << 20,
+        "lookup_timeout_s": 0.05,
+    }
+    if isinstance(prefix_l2, str):
+        spec["mode"] = prefix_l2
+    elif isinstance(prefix_l2, dict):
+        spec.update(prefix_l2)
+    else:
+        raise ValueError(
+            f"prefix_l2 must be None, 'inproc', 'spawn', or a dict; "
+            f"got {prefix_l2!r}"
+        )
+    if spec["mode"] not in ("inproc", "spawn"):
+        raise ValueError(
+            f"prefix_l2 mode must be 'inproc' or 'spawn', got "
+            f"{spec['mode']!r}"
+        )
+    if int(spec["capacity_bytes"]) < 1:
+        raise ValueError(
+            f"prefix_l2 capacity_bytes must be >= 1, got "
+            f"{spec['capacity_bytes']}"
+        )
+    return spec
+
+
 class ServingFleet:
     """N replica seats + the health/supervision plane over them.
 
@@ -802,6 +834,7 @@ class ServingFleet:
         start_timeout: float = 600.0,
         registry: obs_registry.Registry | None = None,
         spawn_kwargs: dict | None = None,
+        prefix_l2=None,
     ):
         if (factory is None) == (spawn_argv is None):
             raise ValueError(
@@ -846,6 +879,31 @@ class ServingFleet:
 
                 token = secrets.token_hex(16)
             self.admin_token = token
+
+        # -- fleet-global prefix L2 (cachetier) ------------------------
+        # prefix_l2: None (off), "inproc" (one shared in-process
+        # CacheTier — the InProcessReplica spelling), "spawn" (a
+        # supervised cachetier daemon subprocess — survives nothing,
+        # needs to survive nothing: clients degrade to L1-only on any
+        # outage), or a dict with {"mode", "capacity_bytes",
+        # "lookup_timeout_s"} overrides.
+        self._l2_spec = _normalize_l2_spec(prefix_l2)
+        self.cache_tier = None  # inproc mode: the shared store
+        self.cachetier_address: str | None = None  # spawn mode: host:port
+        self._cache_lock = threading.Lock()
+        self._cache_proc = None  # guarded-by: self._cache_lock
+        self._cache_respawns = 0  # guarded-by: self._cache_lock
+        self._cache_admin = None  # invalidate/stats client (fleet-owned)
+        if self._l2_spec is not None:
+            self._start_prefix_l2()
+            if self._factory is not None:
+                self._factory = self._wrap_factory_with_l2(self._factory)
+            elif self.cachetier_address is not None:
+                # subprocess replicas learn the daemon address via the
+                # serve_model flag; each child builds its own CacheClient
+                self._spawn_argv = list(self._spawn_argv) + [
+                    "--cachetier-l2", self.cachetier_address,
+                ]
 
         self.metrics = (
             registry if registry is not None else obs_registry.Registry()
@@ -936,6 +994,178 @@ class ServingFleet:
             admin_token=self.admin_token,
             **self._spawn_kwargs,
         )
+
+    # -- prefix L2 plumbing (cachetier) --------------------------------
+
+    def _start_prefix_l2(self) -> None:
+        from tensorflowonspark_tpu.cachetier import CacheTier, LocalClient
+
+        spec = self._l2_spec
+        if spec["mode"] == "inproc":
+            self.cache_tier = CacheTier(
+                capacity_bytes=spec["capacity_bytes"]
+            )
+            self._cache_admin = LocalClient(self.cache_tier)
+            return
+        self._spawn_cache_daemon(port=0)
+        from tensorflowonspark_tpu.cachetier import CacheClient
+
+        self._cache_admin = CacheClient(self.cachetier_address)
+        threading.Thread(
+            target=self._cache_supervise_loop,
+            daemon=True,
+            name="fleet-cachetier-supervise",
+        ).start()
+
+    def _spawn_cache_daemon(self, port: int) -> None:
+        """Spawn the cachetier daemon and wait out its port-file barrier.
+        Respawns pass the ORIGINAL bound port so every client's cached
+        address stays valid across a daemon death."""
+        pf = tempfile.mktemp(prefix="tfos-cachetier-port-")
+        argv = [
+            sys.executable,
+            "-m",
+            "tensorflowonspark_tpu.cachetier.service",
+            "--port", str(port),
+            "--port-file", pf,
+            "--capacity-bytes", str(self._l2_spec["capacity_bytes"]),
+        ]
+        proc = subprocess.Popen(argv)
+        deadline = time.monotonic() + 30.0
+        try:
+            while not os.path.exists(pf):
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        "cachetier daemon exited during startup "
+                        f"(rc={proc.returncode})"
+                    )
+                if time.monotonic() > deadline:
+                    proc.kill()
+                    raise TimeoutError(
+                        "cachetier daemon did not publish its port "
+                        "within 30s"
+                    )
+                time.sleep(0.02)
+            with open(pf) as f:
+                bound = int(f.read().strip())
+        finally:
+            try:
+                os.unlink(pf)
+            except OSError:
+                pass
+        with self._cache_lock:
+            self._cache_proc = proc
+        self.cachetier_address = f"127.0.0.1:{bound}"
+        flightrec.note("cachetier_spawn", address=self.cachetier_address)
+
+    def _cache_supervise_loop(self) -> None:
+        """Respawn a dead cachetier daemon (warm state is lost — that
+        is fine, it is a CACHE). While it is down, every client is
+        already degrading to L1-only misses; nothing here is urgent or
+        load-bearing, so failures just log and retry next round."""
+        while not self._stop.wait(self.probe_interval):
+            with self._cache_lock:
+                proc = self._cache_proc
+                respawns = self._cache_respawns
+            if proc is None or proc.poll() is None:
+                continue
+            if respawns >= self.max_respawns:
+                logger.error(
+                    "cachetier daemon dead and respawn budget (%d) "
+                    "spent; fleet continues L1-only",
+                    self.max_respawns,
+                )
+                return
+            with self._cache_lock:
+                self._cache_respawns += 1
+            port = int(self.cachetier_address.rpartition(":")[2])
+            try:
+                self._spawn_cache_daemon(port=port)
+                flightrec.note("cachetier_respawn", port=port)
+                logger.warning(
+                    "cachetier daemon respawned on port %d", port
+                )
+            except Exception:  # noqa: BLE001 - retry next round
+                logger.warning(
+                    "cachetier daemon respawn failed", exc_info=True
+                )
+
+    def _new_l2(self, chunk: int):
+        """One PrefixL2 facade for one replica (own filler thread; the
+        underlying store/daemon is fleet-shared)."""
+        from tensorflowonspark_tpu.cachetier import (
+            CacheClient,
+            LocalClient,
+            PrefixL2,
+        )
+
+        spec = self._l2_spec
+        if spec["mode"] == "inproc":
+            client, own = LocalClient(self.cache_tier), False
+        else:
+            client, own = CacheClient(self.cachetier_address), True
+        return PrefixL2(
+            client,
+            chunk=chunk,
+            lookup_timeout_s=spec["lookup_timeout_s"],
+            own_client=own,
+        )
+
+    def _wrap_factory_with_l2(self, inner):
+        """Attach a fresh PrefixL2 to every factory-built engine
+        (including respawns). Attach failure degrades to L1-only —
+        never blocks a replica from serving."""
+
+        def factory(*a, **kw):
+            eng = inner(*a, **kw)
+            try:
+                chunk = getattr(eng, "_prefill_chunk", None)
+                has_l1 = getattr(eng, "_prefix_store", None) is not None
+                if chunk and has_l1 and hasattr(eng, "attach_prefix_l2"):
+                    eng.attach_prefix_l2(self._new_l2(int(chunk)))
+                else:
+                    logger.warning(
+                        "prefix_l2 configured but the engine has no "
+                        "prefix cache (prefix_cache/prefill_chunk "
+                        "unset); replica continues without L2"
+                    )
+            except Exception:  # noqa: BLE001 - L2 is optional
+                logger.warning("prefix L2 attach failed", exc_info=True)
+            return eng
+
+        return factory
+
+    def invalidate_prefix_version(self, version) -> int:
+        """Drop one weights version's prefix entries from the fleet L2
+        (the rollout reclamation hook) — exact by key construction;
+        returns entries dropped (0 when no L2 / service down: harmless,
+        the old version's keys can never be looked up again)."""
+        if self._cache_admin is None:
+            return 0
+        from tensorflowonspark_tpu.cachetier import prefix as _prefix
+
+        try:
+            n = self._cache_admin.invalidate(
+                _prefix.NS, _prefix.version_prefix(version)
+            )
+        except Exception:  # noqa: BLE001 - reclamation is best-effort
+            logger.warning("prefix L2 invalidate failed", exc_info=True)
+            return 0
+        if n:
+            flightrec.note(
+                "cachetier_invalidate", version=str(version), dropped=n
+            )
+        return n
+
+    def cache_stats(self) -> dict | None:
+        """The shared cache tier's counters (None when no L2 is
+        configured or the daemon is unreachable)."""
+        if self._cache_admin is None:
+            return None
+        try:
+            return self._cache_admin.stats()
+        except Exception:  # noqa: BLE001 - stats are best-effort
+            return None
 
     def _await_readiness(self, handle, timeout: float = 120.0) -> None:
         """The rejoin gate: a (re)spawned replica joins the routable
@@ -1368,3 +1598,19 @@ class ServingFleet:
                 logger.exception("replica %s teardown failed", rid)
         if self._probe_thread is not None and self._probe_thread.is_alive():
             self._probe_thread.join(timeout=self.probe_interval + 5.0)
+        # cache tier teardown AFTER the replicas: their engines' close
+        # paths may still flush L2 offers, all of which tolerate a dead
+        # service anyway
+        with self._cache_lock:
+            proc, self._cache_proc = self._cache_proc, None
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            try:
+                proc.wait(timeout=5.0)
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
+        if self._cache_admin is not None:
+            try:
+                self._cache_admin.close()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass

@@ -706,6 +706,7 @@ class RolloutController:
         # hook — is swapped in place here (a no-op sweep when every
         # READY seat already reports the target version).
         self._sync_stragglers(update)
+        self._reclaim_l2(update, swapped)
         self._note_error(None, "")
         self._count_outcome("completed")
         flightrec.note(
@@ -720,6 +721,35 @@ class RolloutController:
             else "",
         )
         return "completed"
+
+    def _reclaim_l2(
+        self,
+        update: WeightsUpdate,
+        swapped: list[tuple[int, WeightsUpdate | None]],
+    ) -> None:
+        """After a COMPLETED roll, reclaim the replaced versions'
+        prefix entries from the fleet L2 (tfos.cachetier) — exact by
+        key construction, never a flush: entries under other adapters/
+        versions survive untouched. Runs only once every seat serves
+        the target; mid-rollout the old version's keys are still live
+        on unswapped seats. Best-effort: a down cache service just
+        means the dead keys age out via LRU (they can never be looked
+        up again — version is baked into every key)."""
+        fleet = self._fleet
+        if fleet is None:
+            return
+        old = {
+            str(pr.version)
+            for _, pr in swapped
+            if pr is not None and str(pr.version) != str(update.version)
+        }
+        for ver in sorted(old):
+            dropped = fleet.invalidate_prefix_version(ver)
+            if dropped:
+                logger.info(
+                    "rollout of %r reclaimed %d prefix L2 entrie(s) "
+                    "of prior version %r", update.version, dropped, ver,
+                )
 
     def _sync_stragglers(self, update: WeightsUpdate) -> None:
         """Post-completion convergence pass: any READY seat still
@@ -1075,6 +1105,12 @@ class RolloutController:
         self._record_applied(0, update)
         with self._lock:
             self._target_update = update
+        if str(prior.version) != str(update.version):
+            # same reclamation contract as the fleet path — the
+            # engine's own L2 facade, when one is attached
+            l2 = getattr(eng, "_prefix_l2", None)
+            if l2 is not None:
+                l2.invalidate_version(prior.version)
         self._note_error(None, "")
         self._count_outcome("completed")
         flightrec.note(

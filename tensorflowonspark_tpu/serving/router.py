@@ -170,6 +170,7 @@ class FleetRouter:
         *,
         default_temperature: float = 0.0,
         affinity_capacity: int = 512,
+        affinity_load_slack: float = 1.0,
         service_time_hint_s: float | None = None,
         ewma_alpha: float = 0.3,
     ):
@@ -195,6 +196,17 @@ class FleetRouter:
         self._failovers = 0  # guarded-by: self._lock
         self._affinity_hits = 0  # guarded-by: self._lock
         self._affinity_misses = 0  # guarded-by: self._lock
+        self._affinity_bypasses = 0  # guarded-by: self._lock
+        # With a fleet-global prefix L2 behind every replica
+        # (tfos.cachetier), a "cold" replica recovers a warm prefix
+        # from L2 instead of re-prefilling — so prefix affinity demotes
+        # from placement-correctness to a cache-LOCALITY hint, and the
+        # warm pick yields to the least-loaded replica whenever the
+        # normalized load skew exceeds this slack.
+        self._affinity_is_hint = (
+            getattr(fleet, "_l2_spec", None) is not None
+        )
+        self._affinity_load_slack = float(affinity_load_slack)
 
         reg = fleet.metrics
         self._m_requests = reg.counter(
@@ -212,7 +224,9 @@ class FleetRouter:
         )
         self._m_affinity = reg.counter(
             "router_affinity_total",
-            "prefix-affinity placements by outcome (hit/miss)",
+            "prefix-affinity placements by outcome (hit/miss/bypass — "
+            "bypass = warm replica yielded to least-loaded because a "
+            "prefix L2 makes the miss recoverable)",
         )
         self._g_depth = reg.gauge(
             "router_queue_depth",
@@ -382,7 +396,31 @@ class FleetRouter:
                     if v["rid"] == hit_rid:
                         pick = v
                         break
-            if pick is not None:
+            bypassed = False
+            if pick is not None and self._affinity_is_hint:
+                # L2 configured: a miss here is recoverable, so the
+                # warm replica only wins while roughly as idle as the
+                # least-loaded one (see the ctor comment).
+                least = min(
+                    ready,
+                    key=lambda v: (
+                        self._load(v, outstanding[v["rid"]]),
+                        v["rid"],
+                    ),
+                )
+                skew = self._load(
+                    pick, outstanding[pick["rid"]]
+                ) - self._load(least, outstanding[least["rid"]])
+                if (
+                    least["rid"] != pick["rid"]
+                    and skew > self._affinity_load_slack
+                ):
+                    bypassed = True
+                    pick = least
+            if bypassed:
+                self._affinity_bypasses += 1
+                self._m_affinity.inc(outcome="bypass")
+            elif pick is not None:
                 self._affinity_hits += 1
                 self._m_affinity.inc(outcome="hit")
             else:
@@ -605,6 +643,7 @@ class FleetRouter:
                 "shed": dict(self._shed_counts),
                 "affinity_hits": self._affinity_hits,
                 "affinity_misses": self._affinity_misses,
+                "affinity_bypasses": self._affinity_bypasses,
                 "affinity_entries": len(self._affinity),
             }
         return {"fleet": self._fleet.stats(), "router": router}

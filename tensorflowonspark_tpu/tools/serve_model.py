@@ -1367,6 +1367,28 @@ def _build_engine(gen: dict):
         gen["checkpoint"], cfg, lora_scale=gen.get("lora_scale")
     )
 
+    def _new_prefix_l2():
+        # Fresh per engine (each facade owns a filler thread + client);
+        # any construction failure degrades to L1-only — the cache tier
+        # must never keep a replica from serving.
+        addr = gen.get("cachetier_l2")
+        if not addr or not gen.get("prefix_cache"):
+            return None
+        try:
+            from tensorflowonspark_tpu.cachetier import (
+                CacheClient,
+                PrefixL2,
+            )
+
+            return PrefixL2(
+                CacheClient(addr),
+                chunk=int(gen.get("prefill_chunk") or 1),
+                own_client=True,
+            )
+        except Exception:  # noqa: BLE001 - L2 is optional
+            logger.warning("cachetier L2 attach failed", exc_info=True)
+            return None
+
     def factory():
         # One engine per call: the fleet path respawns replicas through
         # this, so everything scheduler-stateful must be built fresh
@@ -1386,6 +1408,7 @@ def _build_engine(gen: dict):
             max_queue=gen.get("max_queue"),
             prefill_chunk=gen.get("prefill_chunk"),
             prefix_cache=gen.get("prefix_cache"),
+            prefix_l2=_new_prefix_l2(),
             # `or 8` would map an EXPLICIT 0 to 8; only None (unset)
             # takes the default — explicit values pass through to the
             # engine's own max(1, ...) clamp, consistent with direct
@@ -1933,6 +1956,15 @@ def main(argv: list[str] | None = None) -> int:
         "Requires --gen-prefill-chunk",
     )
     p.add_argument(
+        "--cachetier-l2",
+        default=None,
+        metavar="HOST:PORT",
+        help="continuous engine: attach the fleet-global prefix L2 at "
+        "this cachetier daemon address (a ServingFleet in spawn mode "
+        "injects it); requires --gen-prefix-cache. The service is an "
+        "optimization, never a dependency — unreachable = L1-only",
+    )
+    p.add_argument(
         "--gen-decode-block",
         type=int,
         default=8,
@@ -2105,6 +2137,7 @@ def main(argv: list[str] | None = None) -> int:
             max_queue=args.gen_max_queue,
             prefill_chunk=args.gen_prefill_chunk,
             prefix_cache=args.gen_prefix_cache,
+            cachetier_l2=args.cachetier_l2,
             decode_block=args.gen_decode_block,
             pipeline_depth=args.gen_pipeline_depth,
             watchdog_s=args.gen_watchdog,

@@ -147,6 +147,18 @@ SITES = frozenset(
         "online.train_stall",  # driver loop, trainer-progress check
         # ("drop" aware: simulates a stalled trainer — the loop must
         # bound log growth and cut an online_stall flightrec event)
+        # disaggregated cache tier (cachetier/ — docs/SERVING.md
+        # "Cache tier"; the cache is an optimization, never a liveness
+        # dependency, and every site here is shaped to prove it)
+        "cachetier.lookup",  # CacheTier.lookup, before probing the
+        # store ("drop" aware: a dropped lookup IS a miss — the caller
+        # recomputes/refetches; never a hang)
+        "cachetier.fill",  # CacheTier.fill, before storing an entry
+        # ("drop" aware: a dropped fill is simply not cached — the next
+        # lookup misses and the consumer read-throughs again)
+        "cachetier.evict",  # CacheTier eviction loop, per evicted
+        # entry ("drop" aware: a dropped eviction ends the round —
+        # the store runs transiently over budget, never corrupts)
     }
 )
 

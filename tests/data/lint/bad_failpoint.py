@@ -90,3 +90,14 @@ def online_clean_sites():
     failpoint("online.manifest_publish")
     failpoint("online.discover")
     failpoint("online.train_stall")
+
+
+def cachetier_typo_site():
+    failpoint("cachetier.lokup")  # SEEDED VIOLATION FP001: unregistered
+
+
+def cachetier_clean_sites():
+    # registered cache-tier sites: must NOT be flagged
+    failpoint("cachetier.lookup")
+    failpoint("cachetier.fill")
+    failpoint("cachetier.evict")

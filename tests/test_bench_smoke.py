@@ -223,6 +223,40 @@ def test_bench_serve_slo_smoke_burn_gate_and_trace_proof(tmp_path):
     assert json.load(open(art))["metric"] == "serve_slo_burn_gate"
 
 
+def test_bench_cache_smoke_readthrough_gate(tmp_path):
+    """bench.py --cache end-to-end on the tiny model: the serving A/B
+    must show cross-replica L2 hits (> 0) with the fleet faster than
+    the L1-only leg, and the training leg's two concurrent readers
+    must cost ~one backing pass, not two."""
+    env = _artifact_env(str(tmp_path))
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--cache"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "cachetier_readthrough"
+    assert out["smoke"] is True
+    # serving: the tier must pay for itself on shared-prefix traffic
+    assert out["l2_hits"] > 0
+    assert out["cache_l1_only"]["l2_hits"] == 0  # control leg really off
+    assert out["value"] > 1.0, (
+        out["tokens_per_sec_l2"],
+        out["tokens_per_sec_l1_only"],
+    )
+    # training: 2 readers, ~1x backing reads (2.0 = the tier saved
+    # nothing; the slack absorbs one concurrent-miss race per frame)
+    assert 0.99 <= out["training_backing_ratio"] <= 1.5
+    assert out["cache_training"]["readers"] == 2
+    art = os.path.join(str(tmp_path), os.path.basename(out["artifact"]))
+    assert os.path.exists(art)
+    assert json.load(open(art))["metric"] == "cachetier_readthrough"
+
+
 def test_bench_relay_gate_fails_fast_when_relay_down():
     """With the relay marker present and no ports listening, bench must
     emit a distinct relay_unreachable line in seconds, exit 3."""

@@ -1,0 +1,866 @@
+"""tfos.cachetier: the disaggregated read-through cache tier.
+
+Unit tier drives the store (exact keying, byte-budget LRU, per-entry
+cap, prefix-exact invalidation, failpoints), the TCP transport
+(round-trip, miss-on-timeout against a dead service), the PrefixL2
+facade (version/adapter isolation, depth ladder), and the training-
+plane frame cache (two concurrent readers cost ONE backing pass; the
+grain source's hot-frame LRU regression). Real-tiny-engine legs prove
+the serving contract end to end: a prefix prefilled on one replica is
+an L2 hit on another with byte-identical output, and a rollout
+reclaims EXACTLY the old weights version's entries. The slow chaos e2e
+SIGKILLs the cachetier daemon under load — the fleet keeps serving
+(cache is an optimization, never a liveness dependency) and the
+supervisor respawns it on the same port.
+"""
+
+import os
+import pickle
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.cachetier import (
+    CacheClient,
+    CacheServer,
+    CacheTier,
+    FrameCache,
+    LocalClient,
+    PrefixL2,
+)
+from tensorflowonspark_tpu.cachetier.prefix import prefix_key, version_prefix
+from tensorflowonspark_tpu.serving.fleet import ServingFleet
+from tensorflowonspark_tpu.serving.router import FleetRouter
+from tensorflowonspark_tpu.utils import failpoints
+
+
+@pytest.fixture(autouse=True)
+def _no_failpoints():
+    yield
+    failpoints.disarm_all()
+
+
+def _free_port() -> int:
+    """A port with NO listener (bound then released) — connection
+    refused, not filtered."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# -- CacheTier: the store ----------------------------------------------------
+
+
+def test_tier_exact_keying_and_lru_eviction():
+    tier = CacheTier(capacity_bytes=64)
+    assert tier.fill("prefix", "a", b"x" * 24)
+    assert tier.fill("prefix", "b", b"y" * 24)
+    # exact bytes back; a hit refreshes recency
+    assert tier.lookup("prefix", "a") == b"x" * 24
+    # namespaces partition the key space
+    assert tier.lookup("frames", "a") is None
+    # third fill overflows: the LRU victim is "b" (a was refreshed)
+    assert tier.fill("prefix", "c", b"z" * 24)
+    assert tier.lookup("prefix", "b") is None
+    assert tier.lookup("prefix", "a") == b"x" * 24
+    assert tier.lookup("prefix", "c") == b"z" * 24
+    st = tier.stats()
+    assert st["entries"] == 2
+    assert st["bytes"] == 48
+    assert st["evictions"] == 1
+    assert st["fills"] == 3
+    assert st["hits"] == 3 and st["misses"] == 2
+
+
+def test_tier_per_entry_cap_and_capacity_knob():
+    tier = CacheTier(capacity_bytes=100)
+    # a blob over half the budget is refused outright — admitting it
+    # would evict most of the working set for one entry
+    assert not tier.fill("frames", "huge", b"x" * 51)
+    assert tier.lookup("frames", "huge") is None
+    assert tier.fill("frames", "a", b"x" * 40)
+    assert tier.fill("frames", "b", b"y" * 40)
+    assert tier.stats()["bytes"] == 80
+    # the autotune actuation path: shrinking evicts immediately
+    assert tier.capacity_bytes == 100
+    tier.set_capacity(50)
+    st = tier.stats()
+    assert st["capacity_bytes"] == 50
+    assert st["bytes"] <= 50
+    assert st["entries"] == 1
+    with pytest.raises(ValueError):
+        tier.set_capacity(0)
+
+
+def test_tier_invalidate_is_prefix_exact():
+    tier = CacheTier(capacity_bytes=1 << 20)
+    tier.fill("prefix", "v0|a|1,2", b"old")
+    tier.fill("prefix", "v0|b|1,2", b"old2")
+    tier.fill("prefix", "v1|a|1,2", b"new")
+    tier.fill("frames", "v0|decoy", b"frame")
+    # drops EXACTLY the v0 prefix keys: other versions and other
+    # namespaces are untouched
+    assert tier.invalidate("prefix", "v0|") == 2
+    assert tier.lookup("prefix", "v0|a|1,2") is None
+    assert tier.lookup("prefix", "v1|a|1,2") == b"new"
+    assert tier.lookup("frames", "v0|decoy") == b"frame"
+    assert tier.invalidate("prefix", "v0|") == 0
+
+
+def test_tier_failpoints_degrade_never_corrupt():
+    tier = CacheTier(capacity_bytes=20)
+    assert tier.fill("x", "k", b"val")
+    # a dropped lookup IS a miss, not a hang or an error
+    failpoints.arm("cachetier.lookup", "drop", count=1)
+    assert tier.lookup("x", "k") is None
+    assert tier.lookup("x", "k") == b"val"
+    # a dropped fill is refused (the entry simply is not cached)
+    failpoints.arm("cachetier.fill", "drop", count=1)
+    assert not tier.fill("x", "k2", b"v2")
+    assert tier.lookup("x", "k2") is None
+    # a dropped evict round leaves the store transiently over budget;
+    # the next fill resumes eviction — never corrupts
+    failpoints.arm("cachetier.evict", "drop")
+    assert tier.fill("x", "a", b"x" * 10)
+    assert tier.fill("x", "b", b"y" * 10)
+    assert tier.stats()["bytes"] > 20  # over budget, by design
+    failpoints.disarm_all()
+    assert tier.fill("x", "c", b"z")
+    assert tier.stats()["bytes"] <= 20
+
+
+def test_tier_get_frame_read_through(tmp_path):
+    path = str(tmp_path / "backing.bin")
+    payload = bytes(range(256)) * 4
+    with open(path, "wb") as f:
+        f.write(payload)
+    tier = CacheTier(capacity_bytes=1 << 20)
+    # miss: the pread happens IN the service and fills the store
+    assert tier.get_frame(path, 16, 64) == payload[16:80]
+    st = tier.stats()
+    assert st["backing_read_bytes"] == 64
+    # hit: no second backing read
+    assert tier.get_frame(path, 16, 64) == payload[16:80]
+    assert tier.stats()["backing_read_bytes"] == 64
+    # failure is a fallback signal, never an exception
+    assert tier.get_frame(str(tmp_path / "gone.bin"), 0, 8) is None
+    # short read (span past EOF) is refused, not returned torn
+    assert tier.get_frame(path, len(payload) - 4, 64) is None
+
+
+# -- TCP transport -----------------------------------------------------------
+
+
+def test_cache_server_roundtrip(tmp_path):
+    tier = CacheTier(capacity_bytes=1 << 20)
+    srv = CacheServer(tier).start()
+    cl = CacheClient(srv.address)
+    try:
+        # fills are fire-and-forget: wait for the filler to drain
+        cl.fill("prefix", "v0||1,2,3", b"blob-bytes")
+        assert _wait(lambda: tier.stats()["fills"] == 1)
+        assert cl.lookup("prefix", "v0||1,2,3", timeout_s=2.0) == b"blob-bytes"
+        assert cl.lookup("prefix", "nope", timeout_s=2.0) is None
+        # read-through frames over the wire
+        path = str(tmp_path / "f.bin")
+        with open(path, "wb") as f:
+            f.write(b"0123456789")
+        assert cl.get_frame(path, 2, 6, timeout_s=2.0) == b"234567"
+        st = cl.stats()
+        assert st["entries"] == 2
+        assert st["backing_read_bytes"] == 6
+        assert cl.invalidate("prefix", "v0|") == 1
+        assert cl.lookup("prefix", "v0||1,2,3", timeout_s=2.0) is None
+    finally:
+        cl.close()
+        srv.close()
+
+
+def test_lookup_miss_on_timeout_never_hangs():
+    # no listener: connection refused — a miss in bounded time
+    cl = CacheClient(f"127.0.0.1:{_free_port()}")
+    try:
+        t0 = time.monotonic()
+        assert cl.lookup("prefix", "k", timeout_s=0.2) is None
+        # down-backoff: the immediate retry short-circuits
+        assert cl.lookup("prefix", "k", timeout_s=0.2) is None
+        assert time.monotonic() - t0 < 2.0
+        # fills and stats degrade the same way (no exception, no hang)
+        cl.fill("prefix", "k", b"v")
+        assert cl.stats() is None
+    finally:
+        cl.close()
+
+
+def test_lookup_bounded_after_server_death():
+    tier = CacheTier(capacity_bytes=1 << 20)
+    srv = CacheServer(tier).start()
+    cl = CacheClient(srv.address)
+    try:
+        cl.fill("prefix", "k", b"v")
+        assert _wait(lambda: tier.stats()["fills"] == 1)
+        assert cl.lookup("prefix", "k", timeout_s=2.0) == b"v"
+        srv.close()
+        t0 = time.monotonic()
+        assert cl.lookup("prefix", "k", timeout_s=0.3) is None
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        cl.close()
+        srv.close()
+
+
+# -- PrefixL2: the serving-plane facade --------------------------------------
+
+
+def test_prefix_l2_version_and_adapter_isolation():
+    tier = CacheTier(capacity_bytes=1 << 20)
+    l2 = PrefixL2(LocalClient(tier), chunk=4, lookup_timeout_s=1.0)
+    try:
+        leaves = [
+            np.arange(12, dtype=np.float32).reshape(3, 4),
+            np.ones((2, 2), np.int32) * 7,
+        ]
+        toks = list(range(100, 108))
+        l2.offer(toks, leaves, None, "v0")
+        assert _wait(lambda: tier.stats()["fills"] == 1)
+        # longest-prefix hit at the stored depth, bit-exact round-trip
+        hit = l2.lookup(toks + [1, 2], None, "v0")
+        assert hit is not None
+        got, depth = hit
+        assert depth == 8
+        assert len(got) == 2
+        for a, b in zip(got, leaves):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_array_equal(a, b)
+        # the exactness contract: another adapter or another weights
+        # version NEVER sees this entry (its keys are simply different)
+        assert l2.lookup(toks, "lora-a", "v0") is None
+        assert l2.lookup(toks, None, "v1") is None
+        # rollout reclamation is exact by key prefix
+        assert l2.invalidate_version("v0") == 1
+        assert l2.lookup(toks, None, "v0") is None
+        st = l2.stats()
+        assert st["l2_hits"] == 1
+        assert st["l2_offered"] == 1
+    finally:
+        l2.close()
+
+
+def test_prefix_l2_probes_the_boundary_ladder():
+    """Entries land at L1 boundary-insert depths (chunk * 2**k); a
+    longer prompt's lookup probes exactly that ladder and returns the
+    LONGEST stored prefix."""
+    tier = CacheTier(capacity_bytes=1 << 20)
+    l2 = PrefixL2(LocalClient(tier), chunk=4, lookup_timeout_s=1.0)
+    try:
+        toks = list(range(16))
+        l2.offer(toks[:4], [np.zeros(2, np.float32)], None, "v0")
+        l2.offer(toks[:8], [np.ones(2, np.float32)], None, "v0")
+        assert _wait(lambda: tier.stats()["fills"] == 2)
+        got, depth = l2.lookup(toks[:13], None, "v0")
+        assert depth == 8
+        np.testing.assert_array_equal(got[0], np.ones(2, np.float32))
+        # key construction matches the module helpers exactly
+        assert tier.lookup("prefix", prefix_key("v0", None, toks[:8])) is not None
+        assert prefix_key("v0", None, [1, 2]).startswith(version_prefix("v0"))
+    finally:
+        l2.close()
+
+
+def test_l2_offer_dedup_skips_repeat_publishes_and_self_heals():
+    """A key's value is a pure function of (version, adapter, tokens),
+    so a repeat offer buys nothing and costs a host copy + pickle per
+    request — the dedup window must swallow it. And the window must
+    SELF-HEAL: after the tier loses the entry (rollout, daemon respawn,
+    LRU pressure), an observed lookup miss re-arms the offer."""
+    tier = CacheTier(capacity_bytes=1 << 20)
+    l2 = PrefixL2(LocalClient(tier), chunk=4, lookup_timeout_s=1.0)
+    try:
+        toks = [11, 12, 13, 14]
+        leaves = [np.zeros(2, np.float32)]
+        l2.offer(toks, leaves, None, "v0")
+        assert _wait(lambda: tier.stats()["fills"] == 1)
+        l2.offer(toks, leaves, None, "v0")
+        time.sleep(0.15)  # a real repeat fill would land well inside this
+        st = l2.stats()
+        assert st["l2_offered"] == 1
+        assert st["l2_offer_dedups"] == 1
+        assert tier.stats()["fills"] == 1
+        # tier drops the entry; the next lookup MISSES and clears the
+        # probed keys from the window, so the offer publishes again
+        assert l2.invalidate_version("v0") == 1
+        assert l2.lookup(toks + [9, 9], None, "v0") is None
+        l2.offer(toks, leaves, None, "v0")
+        assert _wait(lambda: tier.stats()["fills"] == 2)
+        assert l2.stats()["l2_offered"] == 2
+    finally:
+        l2.close()
+
+
+# -- frame cache: the training plane -----------------------------------------
+
+
+def _write_framed(tmp_path, name="data.colf", n=24, per_frame=4):
+    from tensorflowonspark_tpu.feed import columnar as col
+
+    path = str(tmp_path / name)
+    records = [
+        {"x": np.arange(6, dtype=np.float32) + i, "y": np.int64(i)}
+        for i in range(n)
+    ]
+    col.write_frames(path, records, records_per_frame=per_frame)
+    return path, records
+
+
+def test_two_readers_cost_one_backing_pass(tmp_path):
+    """The tentpole claim for training: N co-located readers over one
+    framed dataset fetch each frame from backing storage ~once — the
+    read-through pread happens in the shared service."""
+    from tensorflowonspark_tpu.data.grain_source import (
+        ColumnarFrameDataSource,
+    )
+    from tensorflowonspark_tpu.feed.columnar import scan_frames
+
+    path, records = _write_framed(tmp_path, n=32, per_frame=4)
+    spans = [span for _, span, n in scan_frames(path) if n]
+    payload = sum(spans)
+    tier = CacheTier(capacity_bytes=1 << 20)
+    srcs = [
+        ColumnarFrameDataSource(path, frame_cache=FrameCache(LocalClient(tier)))
+        for _ in range(2)
+    ]
+    out = [[None] * len(records) for _ in srcs]
+
+    def read_all(ri, order):
+        for i in order:
+            out[ri][i] = srcs[ri][i]
+
+    # opposed iteration orders: the readers touch mostly-disjoint
+    # frames first, then each serves the other's fills from the tier
+    threads = [
+        threading.Thread(target=read_all, args=(0, range(len(records)))),
+        threading.Thread(
+            target=read_all, args=(1, range(len(records) - 1, -1, -1))
+        ),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+        assert not t.is_alive()
+    # every record, byte-identical from both readers
+    for ri in range(2):
+        for i, r in enumerate(records):
+            np.testing.assert_array_equal(out[ri][i]["x"], r["x"])
+            assert int(out[ri][i]["y"]) == i
+    st = tier.stats()
+    # ~1x the dataset: exactly one backing read per frame, modulo the
+    # rare race where both readers miss one frame at the crossing point
+    assert payload <= st["backing_read_bytes"] <= payload + 2 * max(spans)
+    assert st["hits"] > 0  # the second reader actually hit the tier
+    # the facade is process-local: dropped on pickle (grain workers)
+    clone = pickle.loads(pickle.dumps(srcs[0]))
+    assert clone._frame_cache is None
+    assert len(clone) == len(records)
+
+
+def test_read_frames_via_cache_is_identical(tmp_path):
+    from tensorflowonspark_tpu.feed.columnar import read_frames, scan_frames
+
+    path, records = _write_framed(tmp_path, n=12, per_frame=5)
+    tier = CacheTier(capacity_bytes=1 << 20)
+    fc = FrameCache(LocalClient(tier))
+    plain = [r for c in read_frames(path) for r in c.rows()]
+    cached = [r for c in read_frames(path, frame_cache=fc) for r in c.rows()]
+    assert len(plain) == len(cached) == 12
+    for a, b in zip(plain, cached):
+        np.testing.assert_array_equal(a["x"], b["x"])
+        assert int(a["y"]) == int(b["y"])
+    payload = sum(span for _, span, n in scan_frames(path) if n)
+    assert tier.stats()["backing_read_bytes"] == payload
+    # a second cached pass is served from the tier: zero new backing IO
+    list(read_frames(path, frame_cache=fc))
+    assert tier.stats()["backing_read_bytes"] == payload
+
+
+def test_shard_reader_threads_frame_cache(tmp_path):
+    from tensorflowonspark_tpu.feed.datafeed import ReplayCursor
+    from tensorflowonspark_tpu.feed.ingest import ShardReader
+    from tensorflowonspark_tpu.feed.manifest import FileManifest
+
+    path, records = _write_framed(tmp_path, n=10, per_frame=4)
+    tier = CacheTier(capacity_bytes=1 << 20)
+    m = FileManifest(path, format="columnar")
+    reader = ShardReader([m], frame_cache=FrameCache(LocalClient(tier)))
+    pieces = list(reader.pieces(ReplayCursor()))
+    assert sum(len(pc) for pc in pieces) == 10
+    assert tier.stats()["fills"] > 0  # the drain went through the tier
+
+
+def test_grain_lru_keeps_hot_frame(tmp_path):
+    """Satellite regression: the decoded-frame cache is true LRU — a
+    sampler's hot frame survives eviction pressure (FIFO silently
+    evicted it and re-decoded every touch)."""
+    from tensorflowonspark_tpu.data.grain_source import (
+        ColumnarFrameDataSource,
+    )
+
+    path, _ = _write_framed(tmp_path, n=6, per_frame=1)  # 6 frames
+    src = ColumnarFrameDataSource(path)
+    assert src._CACHE_FRAMES == 4
+    for i in range(4):  # fill the cache: frames 0..3
+        src[i]
+    key0 = tuple(src._frames[0][:2])  # (file_idx, offset) of frame 0
+    hot = src._cache[key0]
+    src[0]  # re-touch: LRU refreshes frame 0's recency
+    src[4]  # pressure: evicts frame 1 (the LRU head), NOT frame 0
+    assert key0 in src._cache
+    assert src._cache[key0] is hot  # same decode — never re-paid
+    key1 = tuple(src._frames[1][:2])
+    assert key1 not in src._cache
+
+
+# -- router: affinity demotes to a locality hint -----------------------------
+
+
+class _StubMetrics:
+    def render(self):
+        return "# TYPE stub_up gauge\nstub_up 1\n"
+
+
+class _StubEngine:
+    """Minimal engine-shaped double for placement tests (the full
+    scriptable version lives in tests/test_fleet.py)."""
+
+    def __init__(self):
+        self.live = True
+        self.ready = True
+        self.calls = []
+        self.closed = False
+        self.metrics = _StubMetrics()
+
+    def warmup(self):
+        pass
+
+    def health(self):
+        return {"live": self.live, "ready": self.ready}
+
+    def stats(self):
+        return {
+            "slots": 2,
+            "slots_busy": 0,
+            "queue_depth": 0,
+            "watchdog_fires": 0,
+            "admitted": len(self.calls),
+            "completed": len(self.calls),
+        }
+
+    def unresolved(self):
+        return 0
+
+    def submit_many(self, prompts, max_new_tokens, **kw):
+        self.calls.append(list(prompts))
+        return [[7] * min(int(max_new_tokens), 3) for _ in prompts]
+
+    def close(self, drain=False, drain_timeout=300.0):
+        self.closed = True
+        self.live = False
+        self.ready = False
+
+
+def _stub_fleet(n=2, **kw):
+    made = []
+
+    def factory():
+        e = _StubEngine()
+        made.append(e)
+        return e
+
+    kw.setdefault("probe_interval", 5.0)
+    kw.setdefault("warmup", False)
+    kw.setdefault("drain_timeout", 2.0)
+    return ServingFleet(factory=factory, replicas=n, **kw), made
+
+
+def _load_and_extend(router, stubs, base, extra_load):
+    """Warm ``base`` on one replica, load that replica by
+    ``extra_load`` outstanding, then submit the extension; returns
+    (warm_rid, other_rid)."""
+    router.submit(base, 2)
+    warm = 0 if stubs[0].calls else 1
+    other = 1 - warm
+    with router._lock:
+        router._outstanding[other] = 0
+        router._outstanding[warm] = (
+            router._outstanding.get(warm, 0) + extra_load
+        )
+    router.submit(base + [9, 10], 2)
+    return warm, other
+
+
+def test_affinity_bypasses_overloaded_warm_replica_with_l2():
+    """With a prefix L2 behind the fleet, affinity is a locality HINT:
+    when the warm replica's load skew exceeds the slack, placement
+    yields to the least-loaded replica (the miss is recoverable from
+    the shared tier) and accounts a bypass."""
+    fleet, stubs = _stub_fleet(2, prefix_l2="inproc")
+    try:
+        router = FleetRouter(fleet)
+        warm, other = _load_and_extend(router, stubs, [5, 6, 7, 8], 4)
+        st = router.stats()["router"]
+        assert st["affinity_bypasses"] >= 1
+        assert len(stubs[other].calls) == 1  # the extension moved
+        assert len(stubs[warm].calls) == 1
+        assert (
+            'router_affinity_total{outcome="bypass"}'
+            in router.metrics_text()
+        )
+    finally:
+        fleet.close()
+
+
+def test_affinity_still_wins_under_slack_and_without_l2():
+    # comparable load (skew <= slack): warm routing still wins even
+    # with an L2 — locality is free when it costs nothing
+    fleet, stubs = _stub_fleet(2, prefix_l2="inproc")
+    try:
+        router = FleetRouter(fleet)
+        warm, other = _load_and_extend(router, stubs, [5, 6, 7, 8], 1)
+        st = router.stats()["router"]
+        assert st["affinity_hits"] >= 1
+        assert st["affinity_bypasses"] == 0
+        assert len(stubs[warm].calls) == 2
+    finally:
+        fleet.close()
+    # no L2 configured: affinity keeps its placement-correctness role —
+    # the warm replica is the ONLY place the prefix exists
+    fleet, stubs = _stub_fleet(2)
+    try:
+        router = FleetRouter(fleet)
+        warm, other = _load_and_extend(router, stubs, [5, 6, 7, 8], 4)
+        st = router.stats()["router"]
+        assert st["affinity_bypasses"] == 0
+        assert len(stubs[warm].calls) == 2
+    finally:
+        fleet.close()
+
+
+def test_affinity_load_slack_is_tunable():
+    fleet, stubs = _stub_fleet(2, prefix_l2="inproc")
+    try:
+        router = FleetRouter(fleet, affinity_load_slack=100.0)
+        warm, other = _load_and_extend(router, stubs, [5, 6, 7, 8], 4)
+        assert router.stats()["router"]["affinity_bypasses"] == 0
+        assert len(stubs[warm].calls) == 2
+    finally:
+        fleet.close()
+
+
+# -- fleet spec / knob plane -------------------------------------------------
+
+
+def test_l2_spec_normalization():
+    from tensorflowonspark_tpu.serving.fleet import _normalize_l2_spec
+
+    assert _normalize_l2_spec(None) is None
+    spec = _normalize_l2_spec("inproc")
+    assert spec["mode"] == "inproc"
+    assert spec["capacity_bytes"] == 256 << 20
+    assert spec["lookup_timeout_s"] == 0.05
+    spec = _normalize_l2_spec({"mode": "spawn", "capacity_bytes": 1 << 20})
+    assert spec["mode"] == "spawn" and spec["capacity_bytes"] == 1 << 20
+    with pytest.raises(ValueError, match="mode"):
+        _normalize_l2_spec("tcp")
+    with pytest.raises(ValueError, match="capacity"):
+        _normalize_l2_spec({"capacity_bytes": 0})
+    with pytest.raises(ValueError, match="prefix_l2"):
+        _normalize_l2_spec(17)
+
+
+def test_cache_budget_policy_grows_on_rising_hit_rate():
+    """Satellite: the autotune knob grows the byte budget while the
+    hit-rate is rising AND memory headroom exists, backs off hard when
+    headroom is gone, and actuates the tier directly."""
+    from tensorflowonspark_tpu.autotune.policies import cache_budget_policy
+    from tensorflowonspark_tpu.obs.history import History
+    from tensorflowonspark_tpu.obs.registry import Registry
+
+    head = {"v": 0.5}
+    tier = CacheTier(capacity_bytes=1 << 20)
+    knob, pol = cache_budget_policy(
+        tier,
+        lo_bytes=1 << 20,
+        hi_bytes=8 << 20,
+        step_bytes=1 << 20,
+        window_s=10.0,
+        headroom_fn=lambda: head["v"],
+    )
+    assert knob.name == "cachetier.capacity_bytes"
+    # the knob actuates the store (the SANCTIONED set-capacity path)
+    knob.apply(2 << 20)
+    assert tier.capacity_bytes == 2 << 20
+    assert knob.get() == 2 << 20
+
+    r = Registry()
+    hits = r.counter("cachetier_hits_total", "t")
+    misses = r.counter("cachetier_misses_total", "t")
+    hist = History(source="t")
+    # prior window (90, 100]: 10% hit share
+    hits.inc(1)
+    misses.inc(9)
+    hist.scrape_registry(r, t=95.0)
+    # recent window (100, 110]: 80% — rising
+    hits.inc(8)
+    misses.inc(2)
+    hist.scrape_registry(r, t=105.0)
+    assert pol.hint(hist, 110.0) == 1  # rising + headroom: grow
+    head["v"] = 0.05  # below min_headroom_frac/2: shed NOW
+    assert pol.hint(hist, 110.0) == -1
+    head["v"] = None  # unreadable meminfo: hold still
+    assert pol.hint(hist, 110.0) == 0
+    # falling hit share: hold even with headroom
+    head["v"] = 0.5
+    hits.inc(1)
+    misses.inc(9)
+    hist.scrape_registry(r, t=115.0)
+    assert pol.hint(hist, 120.0) == 0
+
+
+# -- real-engine e2e ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, remat=False)
+    model = Llama(cfg)
+    p0 = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    p1 = model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, model, p0, p1
+
+
+def _tiny_fleet(tiny, **kw):
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+
+    cfg, model, p0, p1 = tiny
+
+    def factory():
+        return ContinuousBatcher(
+            model, p0, slots=2, prompt_widths=(8,),
+            prefill_chunk=4, prefix_cache=4,
+        )
+
+    kw.setdefault("probe_interval", 0.5)
+    kw.setdefault("warmup", False)
+    kw.setdefault("drain_timeout", 5.0)
+    return ServingFleet(factory=factory, replicas=2, **kw)
+
+
+def test_fleet_l2_cross_replica_hit_is_byte_exact(tiny):
+    """The tentpole serving claim: a prefix prefilled by replica 0 is
+    an L2 hit on replica 1, and the hit-path output is IDENTICAL to a
+    cold engine's — the cache changes cost, never results."""
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+
+    cfg, model, p0, p1 = tiny
+    fleet = _tiny_fleet(tiny, prefix_l2="inproc")
+    try:
+        views = fleet.views()
+        base = [5, 6, 7, 8, 9, 10, 11, 12]
+        got0 = views[0]["handle"].submit_many([base], 3)
+        # the fire-and-forget filler publishes off the scheduler thread
+        assert _wait(
+            lambda: (fleet.cache_stats() or {}).get("entries", 0) > 0
+        )
+        ext = base + [13, 14]
+        got1 = views[1]["handle"].submit_many([ext], 3)
+        st1 = views[1]["handle"].stats()
+        assert st1["prefix_l2_hits"] >= 1
+        ref = ContinuousBatcher(
+            model, p0, slots=2, prompt_widths=(8,),
+            prefill_chunk=4, prefix_cache=4,
+        )
+        try:
+            want = ref.submit_many([ext], 3)
+        finally:
+            ref.close()
+        assert got1 == want
+        assert got0  # replica 0 itself served fine
+        # fleet-level reclamation drops every v0 entry
+        assert fleet.invalidate_prefix_version("v0") > 0
+        assert (fleet.cache_stats() or {}).get("entries") == 0
+    finally:
+        fleet.close()
+
+
+def test_l2_hit_reconstructs_the_offered_cache(tiny):
+    """Regression: a STEPPED single-row cache's scalar planes round-
+    trip through the L2 as batch-1 rows — shape ``(1,)`` against the
+    template's ``()``. Reconstruct must fold that axis and apply the
+    hit; rejecting it silently re-prefills from token 0, every "hit"
+    byte-exact and worthless (hit counters and output-equality tests
+    all stay green while the tier saves zero compute)."""
+    from tensorflowonspark_tpu.serving import ContinuousBatcher
+
+    cfg, model, p0, p1 = tiny
+    tier = CacheTier(capacity_bytes=32 << 20)
+    eng = ContinuousBatcher(
+        model, p0, slots=2, prompt_widths=(8,),
+        prefill_chunk=4, prefix_cache=4,
+    )
+    try:
+        eng.attach_prefix_l2(
+            PrefixL2(LocalClient(tier), chunk=4, lookup_timeout_s=1.0)
+        )
+        base = [5, 6, 7, 8, 9, 10, 11, 12]
+        eng.submit_many([base], 2)
+        assert _wait(lambda: tier.stats()["fills"] > 0)
+        hit = eng._prefix_l2.lookup(
+            base + [13, 14], None, eng._weights_version
+        )
+        assert hit is not None and hit[1] >= 4
+        # the payload an engine actually publishes must reconstruct
+        assert eng._l2_reconstruct(hit[0]) is not None
+    finally:
+        eng.close()
+
+
+def test_rollout_reclaims_exactly_the_old_version(tiny):
+    """Rollout under a warm L2: after the fleet converges on v1, the
+    tier holds ZERO v0 prefix entries — and ONLY those were dropped
+    (other namespaces and the new version's keys survive)."""
+    import jax
+
+    from tensorflowonspark_tpu.serving.rollout import RolloutController
+
+    cfg, model, p0, p1 = tiny
+    fleet = _tiny_fleet(
+        tiny, probe_interval=5.0, drain_timeout=10.0, prefix_l2="inproc"
+    )
+    ctl = RolloutController(
+        fleet, drain_timeout=10.0, verify_timeout=30.0,
+        warmup_probe=False,
+    )
+    try:
+        base = [5, 6, 7, 8, 9, 10, 11, 12]
+        for v in fleet.views():
+            v["handle"].submit_many([base], 2)
+        assert _wait(
+            lambda: (fleet.cache_stats() or {}).get("entries", 0) > 0
+        )
+        # sentinels that must SURVIVE the reclamation: another
+        # namespace, and the incoming version's own key space
+        fleet.cache_tier.fill("frames", "decoy", b"frame-bytes")
+        fleet.cache_tier.fill("prefix", "v1|sentinel|1,2", b"new-bytes")
+        assert (
+            ctl.publish(jax.tree.map(np.asarray, p1), version="v1")
+            == "completed"
+        )
+        with fleet.cache_tier._lock:
+            keys = list(fleet.cache_tier._entries)
+        stale = [
+            k for k in keys if k[0] == "prefix" and k[1].startswith("v0|")
+        ]
+        assert stale == []  # the old version is GONE
+        assert ("frames", "decoy") in keys  # ...and nothing else is
+        assert ("prefix", "v1|sentinel|1,2") in keys
+        for v in fleet.views():
+            assert v["handle"].stats()["weights_version"] == "v1"
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+def test_fleet_sigkill_cachetier_daemon_under_load(tiny, tmp_path):
+    """Chaos e2e: SIGKILL the cachetier daemon mid-load. The fleet
+    keeps serving with ZERO failed or hung requests (every lookup
+    degrades to a bounded-latency miss), and the supervisor respawns
+    the daemon on the SAME port so cached client addresses stay
+    valid."""
+    from tensorflowonspark_tpu.obs import flightrec
+
+    rec = flightrec.install(
+        str(tmp_path / "flightrec-cachetier.json"), process="cachetier-test"
+    )
+    fleet = _tiny_fleet(
+        tiny,
+        probe_interval=0.3,
+        prefix_l2={"mode": "spawn", "capacity_bytes": 32 << 20},
+    )
+    router = FleetRouter(fleet)
+    results: dict[int, object] = {}
+    N = 8
+
+    def one(i):
+        try:
+            results[i] = (
+                "ok",
+                router.submit([20 + i, 3, 4, 5, 6, 7, 8, 9], 4),
+            )
+        except BaseException as e:  # noqa: BLE001 - the verdict
+            results[i] = ("err", e)
+
+    try:
+        with fleet._cache_lock:
+            daemon = fleet._cache_proc
+        assert daemon is not None and daemon.poll() is None
+        addr_before = fleet.cachetier_address
+        # warm traffic so the tier is live before the kill
+        router.submit([11, 12, 13, 14, 15, 16, 17, 18], 3)
+        threads = [
+            threading.Thread(target=one, args=(i,), daemon=True)
+            for i in range(N)
+        ]
+        for t in threads:
+            t.start()
+        os.kill(daemon.pid, 9)
+        # ZERO failed, ZERO hung: the cache is never a liveness
+        # dependency — every in-flight request resolves ok
+        for t in threads:
+            t.join(timeout=120.0)
+            assert not t.is_alive(), "a request hung on a dead cache"
+        assert set(results) == set(range(N))
+        for kind, payload in results.values():
+            assert kind == "ok", payload
+            assert payload
+        # the fleet still serves fresh traffic while the tier is down
+        assert router.submit([30, 31, 32, 33, 34, 35, 36, 37], 3)
+        # the supervisor respawns the daemon on the ORIGINAL port and
+        # the admin client reconnects (down-backoff included)
+        assert _wait(
+            lambda: fleet._cache_respawns >= 1
+            and fleet.cache_stats() is not None,
+            timeout=30.0,
+            interval=0.2,
+        ), "cachetier daemon was not respawned"
+        assert fleet.cachetier_address == addr_before
+        kinds = [e["kind"] for e in rec.snapshot("test")["events"]]
+        assert "cachetier_spawn" in kinds
+        assert "cachetier_respawn" in kinds
+    finally:
+        router.close()
+        rec.stop()
+        with flightrec._install_lock:
+            flightrec._recorder = None

@@ -176,10 +176,11 @@ def test_failpoint_rule_reports_seeded_violations(fixture_findings):
         _line_of("bad_failpoint.py", "rollout.swpa"),
         _line_of("bad_failpoint.py", "autotune.aply"),
         _line_of("bad_failpoint.py", "online.discver"),
+        _line_of("bad_failpoint.py", "cachetier.lokup"),
     }, [f.render() for f in hits]
     dynamic = [f for f in hits if "string literal" in f.message]
     unregistered = [f for f in hits if "not registered" in f.message]
-    assert len(dynamic) == 1 and len(unregistered) == 8
+    assert len(dynamic) == 1 and len(unregistered) == 9
     # the REGISTERED elastic + pull-plane sites are in the rule's
     # registry view: the fixture's clean literals produced no findings
     clean_lines = {
@@ -203,6 +204,9 @@ def test_failpoint_rule_reports_seeded_violations(fixture_findings):
         _line_of("bad_failpoint.py", '"online.manifest_publish"'),
         _line_of("bad_failpoint.py", '"online.discover"'),
         _line_of("bad_failpoint.py", '"online.train_stall"'),
+        _line_of("bad_failpoint.py", '"cachetier.lookup"'),
+        _line_of("bad_failpoint.py", '"cachetier.fill"'),
+        _line_of("bad_failpoint.py", '"cachetier.evict"'),
     }
     assert not clean_lines & {f.line for f in hits}
 

@@ -58,6 +58,7 @@ DEFAULT_TIMEOUT = 420.0  # per suite; the slowest tier-1 suite is ~3 min
 TFSAN_ENV = {"TFOS_TFSAN": "1"}
 SLOW_SUITES = [
     "tests/test_autotune.py",  # controller/registry + live actuation
+    "tests/test_cachetier.py",  # SIGKILL-the-cache-daemon e2e
     "tests/test_chaos.py",
     "tests/test_elastic.py",
     "tests/test_engine_pipeline.py",
@@ -70,6 +71,7 @@ SLOW_SUITES = [
     "tests/test_reqtrace.py",  # trace header round trip through serve_model
     "tests/test_rollout.py",  # SIGKILL-mid-rollout + corrupt-ckpt e2e
     ("tests/test_autotune.py", TFSAN_ENV),
+    ("tests/test_cachetier.py", TFSAN_ENV),
     ("tests/test_chaos.py", TFSAN_ENV),
     ("tests/test_elastic.py", TFSAN_ENV),
     ("tests/test_fleet.py", TFSAN_ENV),

@@ -105,6 +105,19 @@ _FIELD_OVERRIDES: dict[str, dict[str, object]] = {
             "cursor": {"train-0": 17, "train-1": [42, 3]},
         },
     },
+    "cachetier.LOOKUP": {
+        "ns": "prefix",
+        "key": "v3|lora-a|17,42,99",
+        "path": "/data/shard-0000.tfc",
+        "off": 4096,
+        "span": 65536,
+    },
+    "cachetier.FILL": {
+        "ns": "prefix",
+        "key": "v3|lora-a|17,42,99",
+        "nbytes": 65536,
+    },
+    "cachetier.INVALIDATE": {"ns": "prefix", "prefix": "v2|"},
     "kv.ingest_plan": {"manifests": [["part-0000", 0, 128]], "seq": 2},
     "kv.feed_knobs": {"knobs": {"records_per_chunk": 256}},
     "kv.feed_timeout": {"value": 600.0},
